@@ -1,0 +1,80 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (reference surveyed in /root/repo/SURVEY.md).
+
+Architecture (not a port — see SURVEY.md §7):
+  - storage/compute: jax.Array over PJRT; every op is a jnp/jax kernel that
+    XLA compiles and fuses (replaces phi kernels + CINN).
+  - eager autograd: tape of jax.vjp closures (replaces paddle/fluid/eager).
+  - traced path: paddle_tpu.jit traces the same ops under jax.jit/pjit
+    (replaces PIR + interpreter).
+  - distributed: mesh-first (jax.sharding) — paddle_tpu.distributed.
+"""
+__version__ = "0.1.0"
+
+from .core import (
+    Tensor, Parameter, to_tensor, no_grad, enable_grad, is_grad_enabled,
+    set_grad_enabled,
+    float16, float32, float64, bfloat16, int8, int16, int32, int64,
+    uint8, bool_, complex64, complex128,
+    set_device, get_device, device_count, is_compiled_with_tpu,
+    seed, get_rng_state, set_rng_state,
+)
+from .core.autograd import grad
+from .core.device import is_compiled_with_cuda
+
+# functional op surface (YAML-driven)
+from .ops import *  # noqa: F401,F403
+from . import ops
+from .ops import OP_TABLE
+
+from . import linalg
+
+# framework-level namespaces are imported lazily below to keep import cheap
+from . import nn
+from . import optimizer
+from . import io
+from . import vision
+from . import metric
+from . import amp
+from . import jit
+from . import static
+from . import distributed
+from . import autograd
+from . import distribution
+from . import hapi
+from . import profiler
+from .hapi import Model, summary
+from .framework import save, load, set_default_dtype, get_default_dtype
+from .utils.flags import set_flags, get_flags
+
+import jax as _jax
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def numel(x):
+    return to_tensor(x.size)
+
+
+def shape(x):
+    return to_tensor(x.shape, dtype="int64")
+
+
+def rank(x):
+    return to_tensor(x.ndim)
+
+
+def device_get(x):
+    return x.cpu()
+
+
+def synchronize():
+    """Block until all dispatched device work completes (reference:
+    paddle.device.synchronize / cudaDeviceSynchronize)."""
+    _jax.effects_barrier()
+
+
+disable_static = lambda place=None: None  # dygraph is the default mode
+enable_static = None  # bound in paddle_tpu.static

@@ -1,0 +1,1 @@
+"""Placeholder — populated in a later milestone this round."""

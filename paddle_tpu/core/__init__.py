@@ -1,0 +1,22 @@
+"""Core runtime: Tensor, autograd tape, op dispatch, RNG, device handling.
+
+Plays the role of the reference's PHI core (paddle/phi/core/dense_tensor.h:37,
+paddle/fluid/eager/) but TPU-native: the "kernel" for every op is a jax/jnp
+function that XLA compiles, and the autograd tape records `jax.vjp` closures
+instead of hand-written grad kernels.
+"""
+from .tensor import Tensor, Parameter, to_tensor
+from .autograd import no_grad, enable_grad, is_grad_enabled, set_grad_enabled, backward
+from . import dtypes
+from .dtypes import (
+    float16, float32, float64, bfloat16, int8, int16, int32, int64,
+    uint8, bool_, complex64, complex128,
+)
+from .device import set_device, get_device, device_count, is_compiled_with_tpu
+from .random import seed, get_rng_state, set_rng_state, next_key
+
+__all__ = [
+    "Tensor", "Parameter", "to_tensor", "no_grad", "enable_grad",
+    "is_grad_enabled", "set_grad_enabled", "backward", "dtypes",
+    "set_device", "get_device", "device_count", "seed", "next_key",
+]

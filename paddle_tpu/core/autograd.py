@@ -1,0 +1,235 @@
+"""Eager autograd tape.
+
+TPU-native analogue of the reference's eager engine
+(paddle/fluid/eager/grad_node_info.h:197, paddle/fluid/eager/backward.cc:473):
+each differentiable op call records one `GradNode` holding the `jax.vjp`
+closure of its jnp "kernel" (residuals live on device inside the closure, the
+moral equivalent of the reference's TensorWrapper saves). `backward()` is a
+reverse topological walk with cotangent accumulation.
+
+There are no hand-written grad kernels: `jax.vjp` *is* the grad-kernel
+generator, which is the idiomatic XLA replacement for the reference's 345
+backward.yaml entries.
+"""
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool):
+    _state.grad_enabled = bool(mode)
+
+
+class _GradModeGuard:
+    def __init__(self, mode):
+        self._mode = mode
+
+    def __call__(self, func):
+        # usable as decorator too, mirroring paddle.no_grad
+        def wrapper(*args, **kwargs):
+            with self.__class__(self._mode):
+                return func(*args, **kwargs)
+        return wrapper
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+def no_grad(func=None):
+    guard = _GradModeGuard(False)
+    return guard(func) if callable(func) else _GradModeGuard(False)
+
+
+def enable_grad(func=None):
+    guard = _GradModeGuard(True)
+    return guard(func) if callable(func) else _GradModeGuard(True)
+
+
+class TapeRef:
+    """Snapshot of a tensor's tape position at record time. Needed because
+    inplace ops rebind the Python Tensor object to a new node (the reference
+    tracks this with inplace version counters on TensorWrapper,
+    paddle/fluid/eager/tensor_wrapper.h:39); the recorded edge must keep
+    pointing at the producing node as of the forward call."""
+
+    __slots__ = ("tensor", "node", "out_idx")
+
+    def __init__(self, tensor):
+        self.tensor = tensor
+        self.node = tensor._node
+        self.out_idx = tensor._out_idx
+
+
+class GradNode:
+    """One recorded op. `vjp_fn` maps output cotangents -> input cotangents
+    for the *differentiable* inputs (`parents`, in order)."""
+
+    __slots__ = ("name", "vjp_fn", "parents", "out_avals", "n_outputs")
+
+    def __init__(self, name, vjp_fn, parents, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.parents = [TapeRef(p) for p in parents]  # strong refs keep graph alive
+        self.out_avals = out_avals      # list[(shape, dtype)]
+        self.n_outputs = len(out_avals)
+
+    def __repr__(self):
+        return f"<GradNode {self.name} n_out={self.n_outputs}>"
+
+
+def _zero_cotangent(shape, dtype):
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    # integer/bool primal outputs take float0 cotangents in jax
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _accumulate(a, b):
+    if a is None:
+        return b
+    return a + b
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False, _only_leaves=None):
+    """Run reverse-mode accumulation from `tensors` (list or single Tensor).
+
+    Mirrors egr::Backward (paddle/fluid/eager/backward.cc:473): seeds the
+    output cotangents, walks nodes in reverse topological order, deposits
+    into leaf `.grad`, honors per-tensor hooks, frees the graph unless
+    retain_graph.
+    """
+    from .tensor import Tensor  # cycle
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # (node, out_idx) -> cotangent
+    cotangents = {}
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        seed = g.data if isinstance(g, Tensor) else g
+        if seed is None:
+            if t.data.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {list(t.data.shape)}")
+            seed = jnp.ones_like(t.data)
+        if t._node is None:
+            if not t.stop_gradient and (_only_leaves is None or id(t) in _only_leaves):
+                t._deposit_grad(seed)
+            continue
+        key = (id(t._node), t._out_idx)
+        cotangents[key] = _accumulate(cotangents.get(key), seed)
+        roots.append(t._node)
+
+    # topological order (iterative DFS over node graph)
+    topo, visited = [], set()
+    stack = [(n, False) for n in roots]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            topo.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for ref in node.parents:
+            if ref.node is not None and id(ref.node) not in visited:
+                stack.append((ref.node, False))
+
+    for node in reversed(topo):
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "trying to backward through the graph a second time: "
+                "specify retain_graph=True on the first backward")
+        couts = []
+        for i, (shape, dtype) in enumerate(node.out_avals):
+            c = cotangents.pop((id(node), i), None)
+            couts.append(c if c is not None else _zero_cotangent(shape, dtype))
+        in_grads = node.vjp_fn(tuple(couts) if node.n_outputs > 1 else couts[0])
+        for ref, g in zip(node.parents, in_grads):
+            t = ref.tensor
+            for hook in t._hooks:
+                out = hook(t._wrap_grad(g))
+                if out is not None:
+                    g = out.data if isinstance(out, Tensor) else out
+            if ref.node is None or t._retain_grad:
+                if not t.stop_gradient and (_only_leaves is None or id(t) in _only_leaves):
+                    t._deposit_grad(g)
+            if ref.node is not None:
+                key = (id(ref.node), ref.out_idx)
+                cotangents[key] = _accumulate(cotangents.get(key), g)
+        if not retain_graph:
+            node.vjp_fn = None
+            node.parents = []
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """paddle.grad equivalent (reference: egr::Grad, backward.cc:490):
+    returns grads of `outputs` w.r.t. `inputs` without touching `.grad`.
+
+    Implemented by running the tape walk while capturing cotangents for
+    `inputs`. create_graph (higher order) is supported by re-tracing through
+    `jax.vjp` of the functionalized subgraph — currently limited to
+    create_graph=False on the tape path; use jit/functional API for
+    higher-order.
+    """
+    from .tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True on the eager tape is not supported yet; "
+            "use paddle_tpu.incubate.autograd (functional jax.grad) instead")
+    if retain_graph is None:
+        retain_graph = False
+
+    # stash and restore .grad of the input leaves, run backward capturing
+    # grads ONLY for `inputs` (other leaves' .grad stays untouched)
+    stash = [(t, t.grad, t._retain_grad, t.stop_gradient) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t._retain_grad = True
+        t.stop_gradient = False
+    try:
+        backward(outputs, grad_tensors=grad_outputs, retain_graph=retain_graph,
+                 _only_leaves={id(t) for t in inputs})
+        result = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise ValueError(
+                        "one of the inputs is not reachable from outputs; "
+                        "pass allow_unused=True to return None for it")
+                result.append(None)
+            else:
+                result.append(t.grad)
+    finally:
+        for (t, g, r, s) in stash:
+            t.grad = g
+            t._retain_grad = r
+            t.stop_gradient = s
+    return result

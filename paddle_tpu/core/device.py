@@ -1,0 +1,55 @@
+"""Device handling. The reference's Place/DeviceContext/DeviceManager stack
+(paddle/phi/core/device_context.h:37, paddle/phi/backends/device_manager.h:134)
+collapses on TPU: PJRT *is* the device plugin ABI, and jax owns contexts and
+streams. We keep a thin Place-like API for source compatibility."""
+import jax
+
+
+class Place:
+    def __init__(self, kind: str, index: int = 0):
+        self.kind = kind
+        self.index = index
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.index})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and (self.kind, self.index) == (other.kind, other.index)
+
+
+_current_device = None
+
+
+def _platform():
+    return jax.devices()[0].platform
+
+
+def set_device(device: str):
+    """Accepts 'tpu', 'cpu', 'tpu:0' etc. On this stack data placement is
+    managed by jax; this only records intent + validates availability."""
+    global _current_device
+    kind, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    avail = {d.platform for d in jax.devices()}
+    if kind not in avail and kind != "cpu":
+        raise ValueError(f"device '{kind}' not available; have {sorted(avail)}")
+    _current_device = Place(kind, idx)
+    return _current_device
+
+
+def get_device() -> str:
+    if _current_device is not None:
+        return f"{_current_device.kind}:{_current_device.index}"
+    return f"{_platform()}:0"
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+def is_compiled_with_cuda() -> bool:  # source-compat shim
+    return False

@@ -1,0 +1,76 @@
+"""Op dispatch: the single choke point every eager op goes through.
+
+Reference analogue: the generated `<op>_ad_func` + PHI API dispatch chain
+(paddle/fluid/eager/auto_code_generator/, paddle/phi/api/lib/api.cc via
+api_gen.py:544). Here the whole chain collapses to one function: flatten
+Tensor args, run the jnp kernel (optionally under `jax.vjp` to capture the
+grad closure), wrap outputs. Works identically on concrete arrays (eager)
+and on jax tracers (inside jit/to_static), which is what lets the same
+layer code serve both execution modes.
+"""
+import jax
+from jax.tree_util import tree_flatten, tree_unflatten
+
+from . import autograd as ag
+from .autograd import GradNode
+
+_amp_hook = None  # installed by paddle_tpu.amp; signature (name, args, kwargs) -> (args, kwargs)
+
+
+def set_amp_hook(fn):
+    global _amp_hook
+    _amp_hook = fn
+
+
+def apply_op(name, impl, args, kwargs, differentiable=True):
+    from .tensor import Tensor
+
+    if _amp_hook is not None:
+        args, kwargs = _amp_hook(name, args, kwargs)
+
+    leaves, treedef = tree_flatten((args, kwargs),
+                                   is_leaf=lambda x: isinstance(x, Tensor))
+    tensor_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    record = (differentiable and ag.is_grad_enabled()
+              and any(not leaves[i].stop_gradient for i in tensor_idx))
+
+    plain = list(leaves)
+    for i in tensor_idx:
+        plain[i] = leaves[i].data
+
+    if not record:
+        a, k = tree_unflatten(treedef, plain)
+        out = impl(*a, **k)
+        return _wrap(name, out, node=None)
+
+    diff_idx = [i for i in tensor_idx if not leaves[i].stop_gradient]
+    parents = [leaves[i] for i in diff_idx]
+
+    def fn(*diff_arrays):
+        nl = list(plain)
+        for j, i in enumerate(diff_idx):
+            nl[i] = diff_arrays[j]
+        a, k = tree_unflatten(treedef, nl)
+        return impl(*a, **k)
+
+    out, vjp_fn = jax.vjp(fn, *(plain[i] for i in diff_idx))
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    node = GradNode(name, vjp_fn, parents,
+                    [(o.shape, o.dtype) for o in outs])
+    return _wrap(name, out, node=node)
+
+
+def _wrap(name, out, node):
+    from .tensor import Tensor
+
+    def one(arr, idx):
+        t = Tensor(arr, stop_gradient=(node is None))
+        if node is not None:
+            t._node = node
+            t._out_idx = idx
+        return t
+
+    if isinstance(out, (tuple, list)):
+        return tuple(one(o, i) for i, o in enumerate(out))
+    return one(out, 0)

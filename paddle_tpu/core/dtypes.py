@@ -1,0 +1,58 @@
+"""Dtype surface. The reference exposes paddle.float32 etc. backed by
+phi::DataType (paddle/phi/common/*); here dtypes are numpy/jnp dtypes directly,
+which is what XLA wants. bfloat16 is first-class (TPU-native default for
+training compute)."""
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+bfloat16 = jnp.bfloat16
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_NAME_TO_DTYPE = {
+    "float16": float16, "fp16": float16,
+    "float32": float32, "fp32": float32,
+    "float64": float64, "fp64": float64,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a user-supplied dtype (string / np / jnp) to a numpy dtype.
+
+    With jax x64 disabled (the TPU-native default), 64-bit requests
+    canonicalize to 32-bit silently — same behavior as jnp.asarray, minus
+    the per-call warning."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        dtype = _NAME_TO_DTYPE[dtype]
+    d = np.dtype(dtype)
+    import jax
+    if not jax.config.x64_enabled:
+        d = {np.dtype(np.int64): np.dtype(np.int32),
+             np.dtype(np.float64): np.dtype(np.float32),
+             np.dtype(np.uint64): np.dtype(np.uint32),
+             np.dtype(np.complex128): np.dtype(np.complex64)}.get(d, d)
+    return d
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.inexact)
+
+
+def is_integer(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.integer)

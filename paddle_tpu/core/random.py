@@ -1,0 +1,40 @@
+"""Global RNG. The reference keeps per-device Generator state with
+(seed, offset) philox counters (paddle/phi/core/generator.h:32); on TPU the
+idiomatic equivalent is a jax PRNG key chain: `seed()` resets the root key,
+every consumer splits one subkey off the chain. Deterministic and
+trace-friendly (keys are data, not host state, when used under jit)."""
+import threading
+
+import jax
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _get():
+    key = getattr(_state, "key", None)
+    if key is None:
+        key = jax.random.PRNGKey(_DEFAULT_SEED)
+        _state.key = key
+    return key
+
+
+def seed(s: int):
+    """paddle.seed equivalent: reseed the global generator chain."""
+    _state.key = jax.random.PRNGKey(int(s))
+    return _state.key
+
+
+def next_key():
+    """Split one subkey off the global chain (host-side eager use)."""
+    key = _get()
+    _state.key, sub = jax.random.split(key)
+    return sub
+
+
+def get_rng_state():
+    return _get()
+
+
+def set_rng_state(key):
+    _state.key = key

@@ -1,0 +1,294 @@
+"""Eager Tensor.
+
+The reference's user tensor is paddle::Tensor (paddle/phi/api/include/tensor.h:82)
+over DenseTensor (paddle/phi/core/dense_tensor.h:37) with AutogradMeta
+(paddle/fluid/eager/autograd_meta.h:61) bolted on. Here the storage *is* a
+jax.Array (a PJRT buffer on TPU — device memory, sharding, and layout are
+owned by the runtime), and the autograd meta is three slots: `_node`,
+`_out_idx`, `stop_gradient`.
+
+Semantics follow paddle: tensors default to stop_gradient=True; Parameters
+default to stop_gradient=False; `.backward()` seeds the tape walk.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd as ag
+from .dtypes import convert_dtype
+from .dispatch import apply_op
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx",
+                 "_hooks", "_retain_grad", "name", "persistable", "trainable",
+                 "__weakref__")
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            dt = convert_dtype(dtype)
+            if dt is None and isinstance(data, (bool, int, float, list, tuple)):
+                # paddle default dtypes: python floats -> float32, ints -> int64
+                # (jax x64 is off, so int64 canonicalizes to int32 — TPU-friendly)
+                arr = np.asarray(data)
+                if arr.dtype == np.float64:
+                    dt = np.float32
+            data = jnp.asarray(data, dtype=dt)
+        elif dtype is not None:
+            data = data.astype(convert_dtype(dtype))
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_idx = 0
+        self._hooks = []
+        self._retain_grad = False
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+
+    # -- storage --------------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    @data.setter
+    def data(self, value):
+        self._data = value._data if isinstance(value, Tensor) else value
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        from .device import Place
+        try:
+            dev = list(self._data.devices())[0]
+            return Place(dev.platform, dev.id)
+        except Exception:
+            return Place("traced", 0)
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def T(self):
+        from .. import ops
+        return ops.t(self)
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    def element_size(self):
+        return self._data.dtype.itemsize
+
+    def is_contiguous(self):
+        return True
+
+    def contiguous(self):
+        return self
+
+    # -- host interop ---------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return self._data.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    # -- autograd -------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        ag.backward(self, grad_tensors=None if grad_tensor is None else [grad_tensor],
+                    retain_graph=retain_graph)
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self):
+        self._node = None
+        self._out_idx = 0
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        return apply_op("clone", jnp.copy, (self,), {})
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(handle_self):
+                if hook in self._hooks:
+                    self._hooks.remove(hook)
+        return _Handle()
+
+    def retain_grads(self):
+        self._retain_grad = True
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data), stop_gradient=True)
+        else:
+            self.grad = None
+
+    def _deposit_grad(self, g):
+        if getattr(g, "dtype", None) == jax.dtypes.float0:
+            return
+        if self.grad is None:
+            self.grad = Tensor(g, stop_gradient=True)
+        else:
+            self.grad = Tensor(self.grad._data + g, stop_gradient=True)
+
+    def _wrap_grad(self, g):
+        return Tensor(g, stop_gradient=True)
+
+    # -- dtype / device -------------------------------------------------
+    def astype(self, dtype):
+        from .. import ops
+        return ops.cast(self, dtype)
+
+    cast = astype
+
+    def cpu(self):
+        cpu_dev = jax.devices("cpu")[0]
+        return Tensor(jax.device_put(self._data, cpu_dev), stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str) and a.split(":")[0] in ("cpu", "tpu", "gpu", "xpu", "npu"):
+                continue  # placement (incl. 'tpu:0' forms) is runtime-managed
+            dtype = a
+        return self.astype(dtype) if dtype is not None else self
+
+    def pin_memory(self):
+        return self
+
+    # -- mutation -------------------------------------------------------
+    def set_value(self, value):
+        value = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        self._data = value.astype(self._data.dtype)
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return apply_op("getitem", lambda x: x[idx], (self,), {})
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+
+        def impl(x, v):
+            v = jnp.asarray(v, dtype=x.dtype) if not hasattr(v, "dtype") else v.astype(x.dtype)
+            return x.at[idx].set(v)
+        out = apply_op("setitem", impl, (self, value), {})
+        # the tensor becomes the op's output in-place (autograd-correct
+        # inplace write, same role as the reference's inplace version
+        # counter on TensorWrapper)
+        self._data = out._data
+        self._node = out._node
+        self._out_idx = out._out_idx
+        self.stop_gradient = out.stop_gradient
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __index__(self):
+        return int(self._data)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return str(self)
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_info},\n       {np.asarray(self._data)})")
+
+    def __hash__(self):
+        return id(self)
+
+    # arithmetic dunders are attached by ops.registry at import time so the
+    # whole operator surface stays YAML-driven; see paddle_tpu/ops/registry.py
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+
+def _unwrap_index(idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._data
+        return i
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+class Parameter(Tensor):
+    """Trainable leaf tensor (reference: paddle Parameter / EagerParamBase,
+    python/paddle/base/framework.py)."""
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    def __init__(self, data, dtype=None, trainable=True, name=None):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor equivalent."""
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)

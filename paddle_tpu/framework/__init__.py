@@ -1,0 +1,11 @@
+"""Placeholder — populated at M2 (save/load, default dtype)."""
+_default_dtype = "float32"
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = d
+def get_default_dtype():
+    return _default_dtype
+def save(obj, path, **kw):
+    raise NotImplementedError
+def load(path, **kw):
+    raise NotImplementedError

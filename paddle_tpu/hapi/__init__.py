@@ -1,0 +1,4 @@
+"""Placeholder — populated at M2."""
+Model = None
+def summary(*a, **k):
+    raise NotImplementedError

@@ -1,0 +1,22 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py re-exports
+tensor/linalg.py functions)."""
+from .ops import (  # noqa: F401
+    matmul, mm, bmm, dot, inner, outer, cross, mv, addmm, einsum, norm,
+    vector_norm, matrix_norm, dist, matrix_power, matrix_rank, inverse, pinv,
+    det, slogdet, cholesky, cholesky_solve, qr, svd, eig, eigh, eigvals,
+    eigvalsh, solve, triangular_solve, lstsq, lu, kron, corrcoef, cov,
+    histogram, bincount,
+)
+
+inv = inverse
+multi_dot = None  # bound below
+
+
+def _multi_dot(tensors):
+    out = tensors[0]
+    for t in tensors[1:]:
+        out = matmul(out, t)
+    return out
+
+
+multi_dot = _multi_dot

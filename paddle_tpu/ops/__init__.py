@@ -1,0 +1,8 @@
+"""Public functional op namespace (YAML-driven; see registry.py)."""
+from . import registry as _registry
+
+_ns = _registry.load_registry()
+globals().update(_ns)
+OP_TABLE = _registry.OP_TABLE
+
+__all__ = sorted(_ns.keys())

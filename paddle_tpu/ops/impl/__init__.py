@@ -1,0 +1,4 @@
+"""jnp-backed op kernels, grouped by category (mirrors the categories of the
+reference's paddle/phi/ops/yaml/ops.yaml). Every function here is pure and
+traceable; the registry wires them through core.dispatch.apply_op for tape
+recording."""

@@ -1,0 +1,180 @@
+"""Creation ops (reference: full/empty/arange/... in paddle/phi/ops/yaml/ops.yaml,
+kernels paddle/phi/kernels/full_kernel.h etc.)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dtypes import convert_dtype
+from ...core import random as _random
+
+
+def _shape(shape):
+    if hasattr(shape, "data"):
+        shape = np.asarray(shape.data)
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype="float32"):
+    return jnp.zeros(_shape(shape), convert_dtype(dtype))
+
+
+def ones(shape, dtype="float32"):
+    return jnp.ones(_shape(shape), convert_dtype(dtype))
+
+
+def full(shape, fill_value, dtype=None):
+    if hasattr(fill_value, "data"):
+        fill_value = fill_value.data
+    return jnp.full(_shape(shape), fill_value, convert_dtype(dtype))
+
+
+def empty(shape, dtype="float32"):
+    return jnp.zeros(_shape(shape), convert_dtype(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=convert_dtype(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=convert_dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=convert_dtype(dtype))
+
+
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=convert_dtype(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if hasattr(start, "data"):
+        start = start.item()
+    if hasattr(end, "data"):
+        end = end.item()
+    if hasattr(step, "data"):
+        step = step.item()
+    if end is None:
+        start, end = 0, start
+    dt = convert_dtype(dtype)
+    if dt is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dt = convert_dtype("int64")
+        else:
+            dt = np.float32
+    return jnp.arange(start, end, step, dtype=dt)
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=convert_dtype(dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base, dtype=convert_dtype(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    return jnp.eye(int(num_rows), None if num_columns is None else int(num_columns),
+                   dtype=convert_dtype(dtype))
+
+
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
+        return jnp.where(mask, d, padding_value)
+    return jnp.diag(x, k=offset)
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args):
+    args = [a.data if hasattr(a, "data") else a for a in
+            (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return tuple(jnp.meshgrid(*args, indexing="ij"))
+
+
+def assign(x, output=None):
+    x = x.data if hasattr(x, "data") else jnp.asarray(x)
+    return jnp.copy(x)
+
+
+def complex(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+# -- random ------------------------------------------------------------
+def _key(key):
+    return _random.next_key() if key is None else key
+
+
+def rand(shape, dtype="float32", key=None):
+    return jax.random.uniform(_key(key), _shape(shape), convert_dtype(dtype) or jnp.float32)
+
+
+def randn(shape, dtype="float32", key=None):
+    return jax.random.normal(_key(key), _shape(shape), convert_dtype(dtype) or jnp.float32)
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, key=None):
+    return jax.random.uniform(_key(key), _shape(shape),
+                              convert_dtype(dtype) or jnp.float32, minval=min, maxval=max)
+
+
+def normal(mean=0.0, std=1.0, shape=None, key=None):
+    mean = mean.data if hasattr(mean, "data") else mean
+    std = std.data if hasattr(std, "data") else std
+    if shape is None:
+        # per-element samples broadcast over mean/std shapes (paddle semantics)
+        shape = jnp.broadcast_shapes(jnp.shape(mean), jnp.shape(std))
+    out = jax.random.normal(_key(key), _shape(shape))
+    return out * std + mean
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype="float32", key=None):
+    return jax.random.normal(_key(key), _shape(shape), convert_dtype(dtype)) * std + mean
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", key=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_key(key), _shape(shape), low, high,
+                              convert_dtype(dtype) or jnp.int32)
+
+
+def randperm(n, dtype="int64", key=None):
+    return jax.random.permutation(_key(key), int(n)).astype(convert_dtype(dtype))
+
+
+def bernoulli(x, key=None):
+    return jax.random.bernoulli(_key(key), x).astype(x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False, key=None):
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    if replacement:
+        return jax.random.categorical(_key(key), logits, axis=-1,
+                                      shape=(*x.shape[:-1], num_samples)).astype(_i64())
+    # without replacement: gumbel top-k trick
+    g = jax.random.gumbel(_key(key), x.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(_i64())
+
+
+def _i64():
+    """Index dtype: int64 when x64 is on, else canonical int32 (silent)."""
+    import jax
+    return jnp.int64 if jax.config.x64_enabled else jnp.int32

@@ -1,0 +1,193 @@
+"""Linear algebra (reference: paddle/phi/kernels/matmul_kernel.h, funcs/blas →
+cuBLAS; here jnp.matmul → MXU, the TPU systolic array — keep matmuls large and
+bf16 for peak throughput)."""
+import jax
+import jax.numpy as jnp
+
+
+def _arr(x):
+    return x.data if hasattr(x, "data") else x
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    y = _arr(y)
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def mm(x, y):
+    return jnp.matmul(x, _arr(y))
+
+
+def bmm(x, y):
+    return jnp.matmul(x, _arr(y))
+
+
+def dot(x, y):
+    return jnp.sum(x * _arr(y), axis=-1)
+
+
+def inner(x, y):
+    return jnp.inner(x, _arr(y))
+
+
+def outer(x, y):
+    return jnp.outer(x, _arr(y))
+
+
+def cross(x, y, axis=None):
+    return jnp.cross(x, _arr(y), axis=-1 if axis is None else axis)
+
+
+def mv(x, vec):
+    return jnp.matmul(x, _arr(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(_arr(x), _arr(y))
+
+
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *[_arr(o) for o in operands])
+
+
+def norm(x, p="fro", axis=None, keepdim=False):
+    if axis is None and p in ("fro", 2):
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    if p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=_tup(axis), keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=_tup(axis), keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=_tup(axis), keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=_tup(axis), keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=_tup(axis), keepdims=keepdim) ** (1.0 / p)
+
+
+def _tup(axis):
+    if axis is None:
+        return None
+    return tuple(axis) if isinstance(axis, (list, tuple)) else int(axis)
+
+
+def vector_norm(x, p=2, axis=None, keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=_tup(axis), keepdims=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+
+def dist(x, y, p=2):
+    return norm(x - _arr(y), p=float(p) if p != "fro" else p)
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, tol=tol)
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((_arr(y), not upper), x)
+
+
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def eig(x):
+    # XLA has no general eig on TPU; host-eager fallback via numpy
+    import numpy as np
+    w, v = np.linalg.eig(np.asarray(x))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvals(x):
+    import numpy as np
+    return jnp.asarray(np.linalg.eigvals(np.asarray(x)))
+
+
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, _arr(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, _arr(y), lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, _arr(y), rcond=rcond)
+    return sol, res, rank, sv
+
+
+def lu(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv + 1  # paddle pivots are 1-based
+
+
+def kron(x, y):
+    return jnp.kron(x, _arr(y))
+
+
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=_arr(fweights) if fweights is not None else None,
+                   aweights=_arr(aweights) if aweights is not None else None)
+
+
+def histogram(x, bins=100, min=0, max=0):
+    range_ = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=range_)
+    return hist
+
+
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=_arr(weights) if weights is not None else None,
+                        minlength=minlength)

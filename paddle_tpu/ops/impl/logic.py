@@ -1,0 +1,89 @@
+"""Comparison / logic ops (reference: paddle/phi/kernels/compare_kernels.cc,
+logical kernels). All non-differentiable."""
+import jax.numpy as jnp
+
+
+def _arr(x):
+    return x.data if hasattr(x, "data") else x
+
+
+def equal(x, y):
+    return jnp.equal(x, _arr(y))
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, _arr(y))
+
+
+def less_than(x, y):
+    return jnp.less(x, _arr(y))
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, _arr(y))
+
+
+def greater_than(x, y):
+    return jnp.greater(x, _arr(y))
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, _arr(y))
+
+
+def logical_and(x, y):
+    return jnp.logical_and(x, _arr(y))
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, _arr(y))
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, _arr(y))
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def isreal(x):
+    return jnp.isreal(x)
+
+
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, _arr(y), rtol=float(_arr(rtol)), atol=float(_arr(atol)),
+                        equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, _arr(y), rtol=float(_arr(rtol)), atol=float(_arr(atol)),
+                       equal_nan=equal_nan)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, _arr(y))
+
+
+def is_empty(x):
+    return jnp.asarray(x.size == 0)

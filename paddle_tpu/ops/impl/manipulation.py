@@ -1,0 +1,330 @@
+"""Shape/layout manipulation (reference: reshape/concat/split/... kernels under
+paddle/phi/kernels/, stride view kernels paddle/phi/kernels/stride/). On XLA
+these are metadata ops or cheap copies the compiler lays out; no view/stride
+machinery is needed."""
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+builtins_slice = builtins.slice
+
+
+def _arr(x):
+    return x.data if hasattr(x, "data") else x
+
+
+def _shape_arg(shape):
+    if hasattr(shape, "data"):
+        return tuple(int(s) for s in np.asarray(shape.data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(_arr(s)) if not isinstance(s, int) else s for s in shape)
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, _shape_arg(shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    new_shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    axis = axis % x.ndim
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, int(_arr(axis)))
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, tuple(int(p) for p in perm))
+
+
+def t(x):
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+def concat(xs, axis=0):
+    axis = int(_arr(axis))
+    return jnp.concatenate([_arr(x) for x in xs], axis=axis)
+
+
+def stack(xs, axis=0):
+    return jnp.stack([_arr(x) for x in xs], axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    axis = int(_arr(axis))
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = [int(s) for s in num_or_sections]
+    # paddle allows one -1 section
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = x.shape[axis] - known
+    idx = np.cumsum(sections)[:-1]
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=int(_arr(axis))))
+
+
+def unbind(x, axis=0):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def unstack(x, axis=0, num=None):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, _shape_arg(repeat_times))
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, _arr(repeats), axis=axis)
+
+
+def expand(x, shape):
+    shape = _shape_arg(shape)
+    # paddle expand: -1 keeps original dim; illegal in newly-added leading dims
+    full = []
+    offset = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            if i < offset:
+                raise ValueError(
+                    f"expand: -1 in target shape position {i} adds a new "
+                    f"leading dim and cannot be inferred (x has {x.ndim} dims)")
+            full.append(x.shape[i - offset])
+        else:
+            full.append(s)
+    return jnp.broadcast_to(x, tuple(full))
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, _arr(y).shape)
+
+
+def broadcast_to(x, shape):
+    return expand(x, shape)
+
+
+def broadcast_tensors(xs):
+    return tuple(jnp.broadcast_arrays(*[_arr(x) for x in xs]))
+
+
+def flip(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def roll(x, shifts, axis=None):
+    if hasattr(shifts, "data"):
+        shifts = tuple(int(s) for s in np.atleast_1d(np.asarray(shifts.data)))
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    pad = [int(_arr(p)) for p in pad] if not isinstance(pad, int) else [pad] * (2 * x.ndim)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # paddle order: last-dim-first pairs? No: len==2*ndim means per-dim pairs
+        # in dim order (like np.pad flat list)
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec applies to trailing spatial dims (NCHW/NCDHW conventions):
+        # e.g. [l, r] pads W; [l, r, t, b] pads (H, W)
+        n_spatial = len(pad) // 2
+        width = [(0, 0)] * nd
+        if data_format in ("NCHW", "NCL", "NCDHW"):
+            dims = list(range(nd - n_spatial, nd))
+        else:  # NHWC-like: spatial dims sit between batch and channel
+            dims = list(range(nd - n_spatial - 1, nd - 1))
+        for j, d in enumerate(reversed(dims)):
+            width[d] = (pad[2 * j], pad[2 * j + 1])
+    mode_map = {"constant": "constant", "reflect": "reflect",
+                "replicate": "edge", "circular": "wrap"}
+    if mode == "constant":
+        return jnp.pad(x, width, mode="constant", constant_values=value)
+    return jnp.pad(x, width, mode=mode_map[mode])
+
+
+def gather(x, index, axis=0):
+    index = _arr(index)
+    if index.ndim == 0:
+        index = index[None]
+    return jnp.take(x, index, axis=int(_arr(axis)))
+
+
+def gather_nd(x, index):
+    index = _arr(index)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, _arr(index), axis=axis)
+
+
+def index_sample(x, index):
+    index = _arr(index)
+    rows = jnp.arange(x.shape[0])[:, None]
+    return x[rows, index]
+
+
+def take_along_axis(x, indices, axis, broadcast=True):
+    return jnp.take_along_axis(x, _arr(indices), axis=axis)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    indices = _arr(indices)
+    values = _arr(values)
+    if not hasattr(values, "shape") or getattr(values, "shape", ()) != indices.shape:
+        values = jnp.broadcast_to(jnp.asarray(values, dtype=x.dtype), indices.shape)
+    # build full fancy index
+    idx = list(jnp.indices(indices.shape))
+    idx[axis] = indices
+    idx = tuple(idx)
+    if reduce == "assign":
+        return x.at[idx].set(values.astype(x.dtype))
+    if reduce in ("add", "sum"):
+        return x.at[idx].add(values.astype(x.dtype))
+    if reduce in ("mul", "multiply"):
+        return x.at[idx].multiply(values.astype(x.dtype))
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+def scatter(x, index, updates, overwrite=True):
+    index = _arr(index)
+    updates = _arr(updates)
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle !overwrite: zero target rows then accumulate
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    index = _arr(index)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(_arr(updates))
+
+
+def scatter_nd(index, updates, shape):
+    index = _arr(index)
+    zeros = jnp.zeros(_shape_arg(shape), dtype=_arr(updates).dtype)
+    return scatter_nd_add(zeros, index, updates)
+
+
+def index_add(x, index, axis, value):
+    index = _arr(index)
+    value = _arr(value)
+    sl = [slice(None)] * x.ndim
+    sl[axis] = index
+    return x.at[tuple(sl)].add(value)
+
+
+def index_put(x, indices, value, accumulate=False):
+    indices = tuple(_arr(i) for i in indices)
+    value = _arr(value)
+    if accumulate:
+        return x.at[indices].add(value)
+    return x.at[indices].set(value)
+
+
+def slice(x, axes, starts, ends):
+    sl = [builtins_slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        sl[ax] = builtins_slice(int(_arr(st)), int(_arr(en)))
+    return x[tuple(sl)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    sl = [builtins_slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        sl[ax] = builtins_slice(int(_arr(st)), int(_arr(en)), int(_arr(sd)))
+    return x[tuple(sl)]
+
+
+def crop(x, shape=None, offsets=None):
+    shape = _shape_arg(shape)
+    offsets = [0] * x.ndim if offsets is None else [int(_arr(o)) for o in offsets]
+    sl = tuple(builtins_slice(o, o + (s if s != -1 else x.shape[i] - o))
+               for i, (o, s) in enumerate(zip(offsets, shape)))
+    return x[sl]
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def view(x, shape):
+    return reshape(x, shape)
+
+
+def view_as(x, other):
+    return jnp.reshape(x, _arr(other).shape)
+
+
+def atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+
+def atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+def atleast_3d(x):
+    return jnp.atleast_3d(x)
+
+
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, _arr(y), axes=axes)
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, int(_arr(num_classes)), dtype=jnp.float32)
+
+
+def tolist_shape(x):
+    return list(x.shape)

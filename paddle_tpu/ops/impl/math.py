@@ -1,0 +1,354 @@
+"""Elementwise math (reference: paddle/phi/kernels/elementwise_*, activation
+kernels; op schemas in paddle/phi/ops/yaml/ops.yaml). All shapes broadcast by
+jnp rules; XLA fuses chains of these into single kernels, which is the
+TPU-native replacement for the reference's hand-fused elementwise CUDA."""
+import jax
+import jax.numpy as jnp
+
+from ...core.dtypes import convert_dtype
+
+
+def _arr(x):
+    return x.data if hasattr(x, "data") else x
+
+
+# -- binary -------------------------------------------------------------
+def add(x, y):
+    return jnp.add(x, _arr(y))
+
+
+def subtract(x, y):
+    return jnp.subtract(_arr(x), _arr(y))
+
+
+def multiply(x, y):
+    return jnp.multiply(x, _arr(y))
+
+
+def divide(x, y):
+    return jnp.true_divide(_arr(x), _arr(y))
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(_arr(x), _arr(y))
+
+
+def remainder(x, y):
+    return jnp.remainder(_arr(x), _arr(y))
+
+
+def mod(x, y):
+    return jnp.remainder(_arr(x), _arr(y))
+
+
+def pow(x, y):
+    return jnp.power(_arr(x), _arr(y))
+
+
+def maximum(x, y):
+    return jnp.maximum(x, _arr(y))
+
+
+def minimum(x, y):
+    return jnp.minimum(x, _arr(y))
+
+
+def fmax(x, y):
+    return jnp.fmax(x, _arr(y))
+
+
+def fmin(x, y):
+    return jnp.fmin(x, _arr(y))
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, _arr(y))
+
+
+def hypot(x, y):
+    return jnp.hypot(x, _arr(y))
+
+
+def copysign(x, y):
+    return jnp.copysign(x, _arr(y))
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, _arr(y))
+
+
+def nextafter(x, y):
+    return jnp.nextafter(x, _arr(y))
+
+
+def ldexp(x, y):
+    return jnp.ldexp(x, _arr(y))
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, _arr(y))
+
+
+def gcd(x, y):
+    return jnp.gcd(x, _arr(y))
+
+
+def lcm(x, y):
+    return jnp.lcm(x, _arr(y))
+
+
+# -- unary --------------------------------------------------------------
+def abs(x):
+    return jnp.abs(x)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def logsigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def erf(x):
+    return jax.lax.erf(x)
+
+
+def erfinv(x):
+    return jax.lax.erf_inv(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def round(x, decimals=0):
+    return jnp.round(x, decimals)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+def polygamma(x, n=1):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, _arr(min) if min is not None else None,
+                    _arr(max) if max is not None else None)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def lerp(x, y, weight):
+    return x + _arr(weight) * (_arr(y) - x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def exponent(x):  # frexp exponent part
+    return jnp.frexp(x)[1]
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def increment(x, value=1.0):
+    return x + value
+
+
+def cast(x, dtype):
+    return x.astype(convert_dtype(dtype))
+
+
+# -- bitwise ------------------------------------------------------------
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, _arr(y))
+
+
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, _arr(y))
+
+
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, _arr(y))
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def bitwise_left_shift(x, y):
+    return jnp.left_shift(x, _arr(y))
+
+
+def bitwise_right_shift(x, y):
+    return jnp.right_shift(x, _arr(y))

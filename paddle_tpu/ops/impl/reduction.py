@@ -1,0 +1,145 @@
+"""Reductions & scans (reference: paddle/phi/kernels/reduce_*, cum_* kernels).
+Paddle argument conventions kept: axis (int | list | None), keepdim."""
+import jax
+import jax.numpy as jnp
+
+from ...core.dtypes import convert_dtype
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if hasattr(axis, "data"):
+        import numpy as np
+        a = np.asarray(axis.data)
+        return tuple(int(v) for v in a.ravel()) if a.ndim else int(a)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=_axis(axis), dtype=convert_dtype(dtype), keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_axis(axis), keepdims=keepdim, dtype=convert_dtype(dtype))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_axis(axis), dtype=convert_dtype(dtype), keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    q = q.data if hasattr(q, "data") else q
+    return jnp.quantile(x, q, axis=_axis(axis), keepdims=keepdim, method=interpolation)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return jnp.cumsum(x, axis=_axis(axis), dtype=convert_dtype(dtype))
+
+
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = jnp.ravel(x)
+        dim = 0
+    return jnp.cumprod(x, axis=_axis(dim), dtype=convert_dtype(dtype))
+
+
+def cummax(x, axis=None):
+    if axis is None:
+        x, axis = jnp.ravel(x), 0
+    vals = jax.lax.cummax(x, axis=axis)
+    idx = jnp.broadcast_to(jnp.arange(x.shape[axis]).reshape(
+        [-1 if i == (axis % x.ndim) else 1 for i in range(x.ndim)]), x.shape)
+    amax = jnp.where(x == vals, idx, 0)
+    return vals, jax.lax.cummax(amax, axis=axis).astype(_i64())
+
+
+def cummin(x, axis=None):
+    if axis is None:
+        x, axis = jnp.ravel(x), 0
+    vals = jax.lax.cummin(x, axis=axis)
+    idx = jnp.broadcast_to(jnp.arange(x.shape[axis]).reshape(
+        [-1 if i == (axis % x.ndim) else 1 for i in range(x.ndim)]), x.shape)
+    amin = jnp.where(x == vals, idx, 0)
+    return vals, jax.lax.cummax(amin, axis=axis).astype(_i64())
+
+
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x, axis = jnp.ravel(x), 0
+    return jax.lax.cumlogsumexp(x, axis=_axis(axis))
+
+
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def _i64():
+    """Index dtype: int64 when x64 is on, else canonical int32 (silent)."""
+    import jax
+    return jnp.int64 if jax.config.x64_enabled else jnp.int32

@@ -1,0 +1,176 @@
+"""Search / sort / sampling ops (reference: paddle/phi/kernels/
+{argsort,top_k,where,index}_kernel*). Ops with data-dependent output shapes
+(nonzero, masked_select, unique) are host-eager only — XLA requires static
+shapes; the reference has the same dichotomy between dygraph and
+to_static-compatible ops."""
+import jax
+import jax.numpy as jnp
+
+
+def _arr(x):
+    return x.data if hasattr(x, "data") else x
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(_i64() if dtype in ("int64", None) else jnp.int32)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(_i64() if dtype in ("int64", None) else jnp.int32)
+
+
+def argsort(x, axis=-1, descending=False, stable=True):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out.astype(_i64())
+
+
+def sort(x, axis=-1, descending=False, stable=True):
+    out = jnp.sort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    k = int(_arr(k))
+    if axis is None:
+        axis = -1
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(_i64()))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    axis = axis % x.ndim
+    s = jnp.sort(x, axis=axis)
+    si = jnp.argsort(x, axis=axis)
+    vals = jnp.take(s, k - 1, axis=axis)
+    idx = jnp.take(si, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(_i64())
+
+
+def mode(x, axis=-1, keepdim=False):
+    # mode along axis: sort, then per-position run length = pos - run_start + 1
+    # (run_start tracked with a segment cummax so counts reset at boundaries)
+    axis = axis % x.ndim
+    sorted_x = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+    pos = jnp.broadcast_to(jnp.arange(n).reshape(
+        [-1 if i == axis else 1 for i in range(x.ndim)]), x.shape)
+    first = jnp.take(sorted_x, jnp.array([0]), axis=axis)
+    change = jnp.concatenate(
+        [jnp.ones_like(first, dtype=jnp.int32),
+         (jnp.diff(sorted_x, axis=axis) != 0).astype(jnp.int32)], axis=axis)
+    run_start = jax.lax.cummax(pos * change, axis=axis)
+    counts = pos - run_start + 1
+    best = jnp.argmax(counts, axis=axis, keepdims=True)  # end of the longest run
+    vals = jnp.take_along_axis(sorted_x, best, axis=axis)
+    idx = jnp.argmax((x == vals).astype(jnp.int32), axis=axis, keepdims=True)
+    if not keepdim:
+        vals = jnp.squeeze(vals, axis)
+        idx = jnp.squeeze(idx, axis)
+    return vals, idx.astype(_i64())
+
+
+def where(condition, x=None, y=None):
+    condition = _arr(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return jnp.where(condition, _arr(x), _arr(y))
+
+
+def nonzero(x, as_tuple=False):
+    import numpy as np
+    idx = np.nonzero(np.asarray(_arr(x)))
+    if as_tuple:
+        return tuple(jnp.asarray(i)[:, None].astype(_i64()) for i in idx)
+    return jnp.stack([jnp.asarray(i) for i in idx], axis=1).astype(_i64())
+
+
+def masked_select(x, mask):
+    import numpy as np
+    xa, ma = np.asarray(_arr(x)), np.asarray(_arr(mask))
+    return jnp.asarray(xa[ma])
+
+
+def masked_fill(x, mask, value):
+    value = _arr(value)
+    return jnp.where(_arr(mask), jnp.asarray(value, dtype=x.dtype), x)
+
+
+def masked_scatter(x, mask, value):
+    import numpy as np
+    xa = np.asarray(_arr(x)).copy()
+    ma = np.asarray(_arr(mask))
+    va = np.asarray(_arr(value)).ravel()
+    xa[ma] = va[: int(ma.sum())]
+    return jnp.asarray(xa)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    import numpy as np
+    res = np.unique(np.asarray(_arr(x)), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    import numpy as np
+    xa = np.asarray(_arr(x))
+    if axis is None:
+        xa = xa.ravel()
+        keep = np.concatenate([[True], xa[1:] != xa[:-1]])
+    else:
+        raise NotImplementedError("unique_consecutive with axis")
+    out = [jnp.asarray(xa[keep])]
+    if return_inverse:
+        out.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        out.append(jnp.asarray(np.diff(np.append(idx, len(xa)))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, _arr(values),
+                           side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else _i64())
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    out = jnp.searchsorted(_arr(sorted_sequence), x, side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else _i64())
+
+
+def take(x, index, mode="raise"):
+    index = _arr(index)
+    flat = jnp.ravel(x)
+    n = flat.shape[0]
+    if mode == "wrap":
+        index = index % n
+    elif mode == "clip":
+        index = jnp.clip(index, 0, n - 1)
+    else:  # 'raise': bounds-check eagerly when concrete (jit traces fall back to clamping)
+        import numpy as np
+        if not isinstance(index, jax.core.Tracer):
+            ia = np.asarray(index)
+            if ia.size and (ia.min() < -n or ia.max() >= n):
+                raise IndexError(
+                    f"take(): index out of range for tensor of {n} elements")
+    return flat[index]
+
+
+def _i64():
+    """Index dtype: int64 when x64 is on, else canonical int32 (silent)."""
+    import jax
+    return jnp.int64 if jax.config.x64_enabled else jnp.int32

@@ -1,0 +1,142 @@
+"""Op registry: loads ops.yaml, binds each entry to its jnp kernel, and
+generates the public functional API + Tensor methods + inplace variants.
+
+This is the runtime equivalent of the reference's codegen fan-out
+(paddle/phi/api/generator/api_gen.py, eager_gen.py, python_c_gen.py): one
+YAML drives the C++ API, autograd nodes, and Python bindings there; here one
+YAML drives the functional namespace, the tape hook, and the Tensor method
+surface. Extra metadata (spmd rules) is attached by paddle_tpu.distributed.
+"""
+import functools
+import importlib
+import os
+
+import yaml
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+
+_YAML_PATH = os.path.join(os.path.dirname(__file__), "yaml", "ops.yaml")
+
+OP_TABLE = {}  # name -> OpInfo
+
+
+class OpInfo:
+    __slots__ = ("name", "module", "impl", "differentiable", "method",
+                 "aliases", "inplace", "fn")
+
+    def __init__(self, name, module, impl, differentiable, method, aliases, inplace):
+        self.name = name
+        self.module = module
+        self.impl = impl
+        self.differentiable = differentiable
+        self.method = method
+        self.aliases = aliases
+        self.inplace = inplace
+        self.fn = None
+
+
+def _make_public_fn(info):
+    impl, name, diff = info.impl, info.name, info.differentiable
+
+    def fn(*args, **kwargs):
+        return apply_op(name, impl, args, kwargs, differentiable=diff)
+
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__doc__ = impl.__doc__
+    fn.op_info = info
+    return fn
+
+
+def _make_method(fn):
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+    method.__name__ = fn.__name__
+    return method
+
+
+def _make_inplace_method(fn):
+    """Trailing-underscore inplace variant (paddle add_/clip_/...): runs the
+    op, then rebinds this tensor to the op output — autograd-correct inplace,
+    same contract as the reference's inplace ops + version counter."""
+    def method(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        self._data = out._data
+        self._node = out._node
+        self._out_idx = out._out_idx
+        self.stop_gradient = out.stop_gradient and self.stop_gradient
+        return self
+    method.__name__ = fn.__name__ + "_"
+    return method
+
+
+def load_registry():
+    with open(_YAML_PATH) as f:
+        spec = yaml.safe_load(f)
+
+    namespace = {}
+    for category, block in spec.items():
+        defaults = block.get("defaults", {})
+        mod = importlib.import_module(f".impl.{category}", package=__package__)
+        for entry in block["ops"]:
+            name = entry["name"]
+            info = OpInfo(
+                name=name,
+                module=category,
+                impl=getattr(mod, name),
+                differentiable=entry.get("diff", defaults.get("diff", True)),
+                method=entry.get("method", defaults.get("method", True)),
+                aliases=entry.get("alias", []),
+                inplace=entry.get("inplace", False),
+            )
+            fn = _make_public_fn(info)
+            info.fn = fn
+            OP_TABLE[name] = info
+            namespace[name] = fn
+            for alias in info.aliases:
+                namespace[alias] = fn
+            if info.method:
+                setattr(Tensor, name, _make_method(fn))
+                for alias in info.aliases:
+                    setattr(Tensor, alias, _make_method(fn))
+            if info.inplace:
+                setattr(Tensor, name + "_", _make_inplace_method(fn))
+                namespace[name + "_"] = getattr(Tensor, name + "_")
+    _attach_dunders(namespace)
+    return namespace
+
+
+def _attach_dunders(ns):
+    """Operator protocol — generated from the same registry (reference wires
+    these in python/paddle/base/dygraph/math_op_patch.py)."""
+    def rev(fn):
+        def r(self, other):
+            return fn(Tensor(other) if not isinstance(other, Tensor) else other, self)
+        return r
+
+    binary = {
+        "__add__": "add", "__sub__": "subtract", "__mul__": "multiply",
+        "__truediv__": "divide", "__floordiv__": "floor_divide",
+        "__mod__": "remainder", "__pow__": "pow", "__matmul__": "matmul",
+        "__lt__": "less_than", "__le__": "less_equal", "__gt__": "greater_than",
+        "__ge__": "greater_equal", "__eq__": "equal", "__ne__": "not_equal",
+        "__and__": "bitwise_and", "__or__": "bitwise_or", "__xor__": "bitwise_xor",
+        "__lshift__": "bitwise_left_shift", "__rshift__": "bitwise_right_shift",
+    }
+    for dunder, op in binary.items():
+        setattr(Tensor, dunder, _make_method(ns[op]))
+    for dunder, op in [("__radd__", "add"), ("__rsub__", "subtract"),
+                       ("__rmul__", "multiply"), ("__rtruediv__", "divide"),
+                       ("__rpow__", "pow"), ("__rmod__", "remainder"),
+                       ("__rmatmul__", "matmul"), ("__rand__", "bitwise_and"),
+                       ("__ror__", "bitwise_or"), ("__rxor__", "bitwise_xor"),
+                       ("__rfloordiv__", "floor_divide"),
+                       ("__rlshift__", "bitwise_left_shift"),
+                       ("__rrshift__", "bitwise_right_shift")]:
+        setattr(Tensor, dunder, rev(ns[op]))
+    setattr(Tensor, "__neg__", _make_method(ns["neg"]))
+    setattr(Tensor, "__abs__", _make_method(ns["abs"]))
+    setattr(Tensor, "__invert__", _make_method(ns["bitwise_not"]))
+    # keep identity hash alongside __eq__ returning tensors
+    Tensor.__hash__ = lambda self: id(self)

@@ -1,0 +1,10 @@
+"""Placeholder flag registry — real implementation at M8."""
+_FLAGS = {}
+def set_flags(d):
+    _FLAGS.update(d)
+def get_flags(keys=None):
+    if keys is None:
+        return dict(_FLAGS)
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _FLAGS.get(k) for k in keys}

@@ -1,0 +1,33 @@
+"""Test harness config: force an 8-device virtual CPU mesh so all distributed
+tests run without TPU hardware (reference pattern: test/custom_runtime/ fake
+custom_cpu plugin — test a backend without the hardware; here the PJRT CPU
+client plays that role).
+
+Must run before the first jax backend initialization; the axon sitecustomize
+may have already registered a TPU platform, so we also flip jax_platforms
+back to cpu in-process.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    yield

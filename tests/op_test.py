@@ -1,0 +1,57 @@
+"""OpTest-style harness (reference: test/legacy_test/op_test.py:418):
+`check_output` compares op results against a numpy reference; `check_grad`
+compares tape-computed analytic grads against central finite differences.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(op, np_ref, *np_inputs, rtol=1e-5, atol=1e-6, kwargs=None):
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(a) for a in np_inputs]
+    got = op(*tensors, **kwargs)
+    want = np_ref(*np_inputs, **kwargs)
+    if not isinstance(got, (tuple, list)):
+        got, want = [got], [want]
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g.numpy(), dtype=np.asarray(w).dtype),
+                                   w, rtol=rtol, atol=atol)
+
+
+def numeric_grad(op, np_inputs, wrt, eps=1e-3, kwargs=None):
+    """Central finite differences of sum(op(...)) w.r.t. input `wrt`."""
+    kwargs = kwargs or {}
+    base = [np.array(a, dtype=np.float64) for a in np_inputs]
+
+    def f(x):
+        args = list(base)
+        args[wrt] = x
+        out = op(*[paddle.to_tensor(a.astype(np.float32)) for a in args], **kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return float(out.sum().item())
+
+    x = base[wrt]
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(op, np_inputs, wrt=0, rtol=1e-2, atol=1e-3, eps=1e-3, kwargs=None):
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(np.asarray(a, dtype=np.float32), stop_gradient=False)
+               for a in np_inputs]
+    out = op(*tensors, **kwargs)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    out.sum().backward()
+    analytic = tensors[wrt].grad.numpy()
+    numeric = numeric_grad(op, np_inputs, wrt, eps=eps, kwargs=kwargs)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)

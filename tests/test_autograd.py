@@ -1,0 +1,152 @@
+"""Autograd engine tests (reference patterns: test/legacy_test/
+test_imperative_*.py, egr::Backward semantics)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_blocks_flow():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0])  # stop_gradient=True by default
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    z = (d * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])  # no flow through d
+
+
+def test_shared_subexpression():
+    # diamond: y = x*x; z = y + y -> dz/dx = 4x
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    z = (y + y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_retain_graph_and_double_backward_error():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+    z = (x * 3).sum()
+    z.backward()
+    with pytest.raises(RuntimeError, match="second time"):
+        z.backward()
+
+
+def test_non_scalar_backward_requires_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError, match="scalar"):
+        y.backward()
+    y.backward(grad_tensor=paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor([[3.0, 1.0, 2.0]], stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1.0, 0.0, 1.0]])
+
+
+def test_hooks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+    handle = x.register_hook(lambda g: seen.append(g.numpy()))
+    (x * 5).backward()
+    assert len(seen) == 1 and seen[0][0] == 5.0
+    handle.remove()
+    x.clear_grad()
+    (x * 5).backward()
+    assert len(seen) == 1
+
+
+def test_retain_grads_non_leaf():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.retain_grads()
+    (y * 3).sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+    @paddle.no_grad()
+    def f(t):
+        return t * 3
+    assert f(x).stop_gradient
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0], stop_gradient=False)
+    z = (x * x * y).sum()
+    gx, gy = paddle.grad(z, [x, y])
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    np.testing.assert_allclose(gy.numpy(), [4.0])
+    # .grad not polluted
+    assert x.grad is None and y.grad is None
+
+
+def test_grad_through_getitem_and_setitem():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x[1:] * 2
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+    a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    b = a * 1.0
+    b[0] = 5.0
+    b.sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [0.0, 1.0])
+
+
+def test_inplace_method_autograd():
+    x = paddle.to_tensor([1.0, -2.0], stop_gradient=False)
+    y = x * 1.0
+    y.clip_(min=0.0)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0])
+
+
+def test_zero_out_degree_multi_roots():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    z = x * 3
+    paddle.core.autograd.backward([y.sum(), z.sum()])
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])

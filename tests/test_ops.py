@@ -1,0 +1,295 @@
+"""Per-op numerics vs numpy (OpTest check_output pattern,
+test/legacy_test/op_test.py:2881)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+
+class TestBinaryOps:
+    def test_add(self, rng):
+        check_output(paddle.add, np.add, rng.standard_normal((3, 4), dtype=np.float32),
+                     rng.standard_normal((3, 4), dtype=np.float32))
+
+    def test_broadcast(self, rng):
+        check_output(paddle.multiply, np.multiply,
+                     rng.standard_normal((3, 1, 4), dtype=np.float32),
+                     rng.standard_normal((5, 1), dtype=np.float32))
+
+    def test_divide(self, rng):
+        a = rng.standard_normal((4,), dtype=np.float32)
+        b = rng.standard_normal((4,), dtype=np.float32) + 2.0
+        check_output(paddle.divide, np.divide, a, b)
+
+    def test_pow_maximum_minimum(self, rng):
+        a = np.abs(rng.standard_normal((3, 3), dtype=np.float32)) + 0.5
+        b = rng.standard_normal((3, 3), dtype=np.float32)
+        check_output(paddle.pow, np.power, a, np.float32(2.0))
+        check_output(paddle.maximum, np.maximum, a, b)
+        check_output(paddle.minimum, np.minimum, a, b)
+
+    def test_mod_floordiv(self):
+        a = np.array([7, -7, 9], dtype=np.int32)
+        b = np.array([3, 3, -4], dtype=np.int32)
+        check_output(paddle.remainder, np.remainder, a, b)
+        check_output(paddle.floor_divide, np.floor_divide, a, b)
+
+
+class TestUnaryOps:
+    @pytest.mark.parametrize("name,np_fn", [
+        ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt), ("tanh", np.tanh),
+        ("sin", np.sin), ("cos", np.cos), ("abs", np.abs), ("floor", np.floor),
+        ("ceil", np.ceil), ("square", np.square), ("log1p", np.log1p),
+        ("expm1", np.expm1), ("sign", np.sign),
+    ])
+    def test_unary(self, name, np_fn, rng):
+        x = np.abs(rng.standard_normal((2, 5), dtype=np.float32)) + 0.1
+        check_output(getattr(paddle, name), np_fn, x)
+
+    def test_sigmoid_rsqrt(self, rng):
+        x = np.abs(rng.standard_normal((4,), dtype=np.float32)) + 0.5
+        check_output(paddle.rsqrt, lambda a: 1 / np.sqrt(a), x)
+        check_output(paddle.sigmoid, lambda a: 1 / (1 + np.exp(-a)), x)
+
+    def test_clip(self, rng):
+        x = rng.standard_normal((10,), dtype=np.float32)
+        got = paddle.clip(paddle.to_tensor(x), min=-0.5, max=0.5)
+        np.testing.assert_allclose(got.numpy(), np.clip(x, -0.5, 0.5))
+
+    def test_cast(self):
+        x = paddle.to_tensor([1.7, -2.3])
+        assert paddle.cast(x, "int32").numpy().tolist() == [1, -2]
+        assert x.astype("bool").numpy().tolist() == [True, True]
+
+
+class TestReductions:
+    @pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False), (1, True), ([0, 1], False)])
+    def test_sum_mean(self, axis, keepdim, rng):
+        x = rng.standard_normal((3, 4), dtype=np.float32)
+        ax = tuple(axis) if isinstance(axis, list) else axis
+        np.testing.assert_allclose(
+            paddle.sum(paddle.to_tensor(x), axis=axis, keepdim=keepdim).numpy(),
+            np.sum(x, axis=ax, keepdims=keepdim), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.mean(paddle.to_tensor(x), axis=axis, keepdim=keepdim).numpy(),
+            np.mean(x, axis=ax, keepdims=keepdim), rtol=1e-6)
+
+    def test_max_min_prod(self, rng):
+        x = rng.standard_normal((3, 4), dtype=np.float32)
+        check_output(paddle.max, lambda a: np.max(a), x)
+        check_output(paddle.min, lambda a: np.min(a), x)
+        np.testing.assert_allclose(paddle.prod(paddle.to_tensor(x), axis=1).numpy(),
+                                   np.prod(x, axis=1), rtol=1e-5)
+
+    def test_std_var_unbiased(self, rng):
+        x = rng.standard_normal((5, 6), dtype=np.float32)
+        np.testing.assert_allclose(paddle.std(paddle.to_tensor(x)).item(),
+                                   np.std(x, ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.var(paddle.to_tensor(x), unbiased=False).item(),
+                                   np.var(x), rtol=1e-5)
+
+    def test_cumsum_logsumexp(self, rng):
+        x = rng.standard_normal((3, 4), dtype=np.float32)
+        np.testing.assert_allclose(paddle.cumsum(paddle.to_tensor(x), axis=1).numpy(),
+                                   np.cumsum(x, axis=1), rtol=1e-5)
+        from scipy.special import logsumexp as sls
+        np.testing.assert_allclose(paddle.logsumexp(paddle.to_tensor(x)).item(),
+                                   sls(x), rtol=1e-5)
+
+    def test_argmax_argmin(self, rng):
+        x = rng.standard_normal((3, 4), dtype=np.float32)
+        assert paddle.argmax(paddle.to_tensor(x)).item() == np.argmax(x)
+        np.testing.assert_array_equal(
+            paddle.argmin(paddle.to_tensor(x), axis=1).numpy(), np.argmin(x, axis=1))
+
+
+class TestManipulation:
+    def test_reshape_transpose(self, rng):
+        x = rng.standard_normal((2, 3, 4), dtype=np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(paddle.reshape(t, [4, 6]).numpy(), x.reshape(4, 6))
+        np.testing.assert_array_equal(paddle.reshape(t, [-1]).numpy(), x.ravel())
+        np.testing.assert_array_equal(paddle.transpose(t, [2, 0, 1]).numpy(),
+                                      x.transpose(2, 0, 1))
+        np.testing.assert_array_equal(t.flatten(1, 2).numpy(), x.reshape(2, 12))
+
+    def test_concat_split_stack(self, rng):
+        a = rng.standard_normal((2, 3), dtype=np.float32)
+        b = rng.standard_normal((2, 3), dtype=np.float32)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_array_equal(paddle.concat([ta, tb], axis=1).numpy(),
+                                      np.concatenate([a, b], axis=1))
+        np.testing.assert_array_equal(paddle.stack([ta, tb]).numpy(), np.stack([a, b]))
+        parts = paddle.split(paddle.to_tensor(a), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        parts = paddle.split(paddle.to_tensor(a), [1, -1], axis=1)
+        assert parts[1].shape == [2, 2]
+
+    def test_squeeze_unsqueeze_tile(self, rng):
+        x = rng.standard_normal((2, 1, 3), dtype=np.float32)
+        t = paddle.to_tensor(x)
+        assert paddle.squeeze(t, 1).shape == [2, 3]
+        assert paddle.unsqueeze(t, 0).shape == [1, 2, 1, 3]
+        np.testing.assert_array_equal(paddle.tile(paddle.to_tensor([1, 2]), [2, 2]).numpy(),
+                                      np.tile([1, 2], (2, 2)))
+
+    def test_gather_scatter(self, rng):
+        x = rng.standard_normal((5, 3), dtype=np.float32)
+        idx = np.array([0, 2, 4])
+        np.testing.assert_array_equal(
+            paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx)).numpy(), x[idx])
+        upd = np.ones((2, 3), dtype=np.float32)
+        got = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor([1, 3]),
+                             paddle.to_tensor(upd))
+        want = x.copy(); want[[1, 3]] = 1.0
+        np.testing.assert_array_equal(got.numpy(), want)
+
+    def test_gather_nd(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        idx = paddle.to_tensor(np.array([[0, 1], [2, 3]]))
+        np.testing.assert_array_equal(paddle.gather_nd(x, idx).numpy(), [1.0, 11.0])
+
+    def test_pad(self, rng):
+        x = rng.standard_normal((1, 2, 3, 3), dtype=np.float32)
+        got = paddle.pad(paddle.to_tensor(x), [1, 1, 2, 2])
+        assert got.shape == [1, 2, 7, 5]
+        np.testing.assert_array_equal(got.numpy()[:, :, 2:5, 1:4], x)
+
+    def test_where_masked_fill(self, rng):
+        x = rng.standard_normal((4,), dtype=np.float32)
+        y = rng.standard_normal((4,), dtype=np.float32)
+        c = x > 0
+        np.testing.assert_array_equal(
+            paddle.where(paddle.to_tensor(c), paddle.to_tensor(x), paddle.to_tensor(y)).numpy(),
+            np.where(c, x, y))
+
+    def test_one_hot(self):
+        got = paddle.one_hot(paddle.to_tensor([0, 2]), 3)
+        np.testing.assert_array_equal(got.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+    def test_topk_sort(self, rng):
+        x = rng.standard_normal((3, 5), dtype=np.float32)
+        v, i = paddle.topk(paddle.to_tensor(x), 2)
+        want = np.sort(x, axis=1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(v.numpy(), want, rtol=1e-6)
+        np.testing.assert_allclose(paddle.sort(paddle.to_tensor(x), descending=True).numpy(),
+                                   -np.sort(-x, axis=1), rtol=1e-6)
+
+
+class TestLinalg:
+    def test_matmul_transpose_flags(self, rng):
+        a = rng.standard_normal((3, 4), dtype=np.float32)
+        b = rng.standard_normal((5, 4), dtype=np.float32)
+        got = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b), transpose_y=True)
+        np.testing.assert_allclose(got.numpy(), a @ b.T, rtol=1e-5)
+
+    def test_batched_matmul(self, rng):
+        a = rng.standard_normal((2, 3, 4), dtype=np.float32)
+        b = rng.standard_normal((2, 4, 5), dtype=np.float32)
+        check_output(paddle.matmul, np.matmul, a, b, rtol=1e-5)
+
+    def test_einsum_norm(self, rng):
+        a = rng.standard_normal((3, 4), dtype=np.float32)
+        np.testing.assert_allclose(paddle.einsum("ij->ji", paddle.to_tensor(a)).numpy(),
+                                   a.T)
+        np.testing.assert_allclose(paddle.norm(paddle.to_tensor(a)).item(),
+                                   np.linalg.norm(a), rtol=1e-5)
+
+    def test_solve_inverse(self, rng):
+        a = rng.standard_normal((3, 3), dtype=np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b = rng.standard_normal((3, 2), dtype=np.float32)
+        np.testing.assert_allclose(paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+                                   np.linalg.solve(a, b), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(paddle.inverse(paddle.to_tensor(a)).numpy(),
+                                   np.linalg.inv(a), rtol=1e-4, atol=1e-5)
+
+
+class TestLogic:
+    def test_compare(self):
+        a = paddle.to_tensor([1.0, 2.0, 3.0])
+        b = paddle.to_tensor([3.0, 2.0, 1.0])
+        assert (a < b).numpy().tolist() == [True, False, False]
+        assert (a == b).numpy().tolist() == [False, True, False]
+        assert paddle.equal_all(a, a).item() is True
+
+    def test_isnan_isinf(self):
+        x = paddle.to_tensor([1.0, float("nan"), float("inf")])
+        assert paddle.isnan(x).numpy().tolist() == [False, True, False]
+        assert paddle.isinf(x).numpy().tolist() == [False, False, True]
+
+    def test_allclose(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        assert paddle.allclose(a, a + 1e-9).item() is True
+
+
+class TestCreation:
+    def test_basics(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2], dtype="int32").numpy().tolist() == [1, 1]
+        assert paddle.full([2], 7.0).numpy().tolist() == [7.0, 7.0]
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_array_equal(paddle.arange(1, 10, 3).numpy(), np.arange(1, 10, 3))
+        assert paddle.eye(3).numpy().trace() == 3.0
+        assert paddle.tril(paddle.ones([3, 3])).numpy().sum() == 6.0
+
+    def test_like_variants(self):
+        x = paddle.to_tensor([[1.0, 2.0]])
+        assert paddle.zeros_like(x).shape == [1, 2]
+        assert paddle.full_like(x, 3.0).numpy().tolist() == [[3.0, 3.0]]
+
+    def test_random_determinism(self):
+        paddle.seed(7)
+        a = paddle.rand([4])
+        paddle.seed(7)
+        b = paddle.rand([4])
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        r = paddle.randperm(10)
+        assert sorted(r.numpy().tolist()) == list(range(10))
+        u = paddle.uniform([1000], min=2.0, max=3.0)
+        assert 2.0 <= float(u.min().item()) and float(u.max().item()) <= 3.0
+
+
+class TestGrads:
+    """check_grad pattern (op_test.py:3075): analytic vs finite differences."""
+
+    def test_matmul_grad(self, rng):
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        b = rng.standard_normal((4, 2)).astype(np.float32)
+        check_grad(paddle.matmul, [a, b], wrt=0)
+        check_grad(paddle.matmul, [a, b], wrt=1)
+
+    @pytest.mark.parametrize("name", ["exp", "tanh", "sigmoid", "log1p", "sin"])
+    def test_unary_grads(self, name, rng):
+        x = rng.standard_normal((2, 3)).astype(np.float32) * 0.5
+        check_grad(getattr(paddle, name), [x])
+
+    def test_reduction_grads(self, rng):
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        check_grad(paddle.sum, [x])
+        check_grad(paddle.mean, [x])
+        check_grad(lambda t: paddle.max(t, axis=1), [x])
+
+    def test_broadcast_grad(self, rng):
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        b = rng.standard_normal((4,)).astype(np.float32)
+        check_grad(paddle.add, [a, b], wrt=1)
+        check_grad(paddle.multiply, [a, b], wrt=1)
+
+    def test_gather_grad(self, rng):
+        x = rng.standard_normal((5, 2)).astype(np.float32)
+        idx = np.array([1, 3])
+        check_grad(lambda t: paddle.gather(t, paddle.to_tensor(idx)), [x])
+
+    def test_concat_grad(self, rng):
+        a = rng.standard_normal((2, 2)).astype(np.float32)
+        b = rng.standard_normal((2, 2)).astype(np.float32)
+        check_grad(lambda t1, t2: paddle.concat([t1, t2], axis=0), [a, b], wrt=0)
+        check_grad(lambda t1, t2: paddle.concat([t1, t2], axis=0), [a, b], wrt=1)
+
+    def test_softmax_chain_grad(self, rng):
+        x = rng.standard_normal((4,)).astype(np.float32)
+        def f(t):
+            e = paddle.exp(t - paddle.max(t))
+            return (e / paddle.sum(e)) * paddle.to_tensor([1.0, 2.0, 3.0, 4.0])
+        check_grad(f, [x])

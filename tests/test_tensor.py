@@ -1,0 +1,117 @@
+"""Tensor surface tests (reference: test/legacy_test/test_var_base.py etc.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_dtypes():
+    assert paddle.to_tensor([1.0, 2.0]).dtype == np.float32
+    assert paddle.to_tensor(np.array([1, 2], dtype=np.int32)).dtype == np.int32
+    assert paddle.to_tensor([True]).dtype == np.bool_
+    t = paddle.to_tensor([1, 2], dtype="float32")
+    assert t.dtype == np.float32
+    t2 = paddle.to_tensor(t)
+    assert t2.shape == t.shape
+
+
+def test_properties():
+    t = paddle.to_tensor(np.zeros((2, 3, 4), dtype=np.float32))
+    assert t.shape == [2, 3, 4]
+    assert t.ndim == 3
+    assert t.size == 24
+    assert t.numel() == 24
+    assert len(t) == 2
+    assert t.element_size() == 4
+
+
+def test_item_tolist_numpy():
+    t = paddle.to_tensor([[1.0, 2.0]])
+    assert t.tolist() == [[1.0, 2.0]]
+    assert paddle.to_tensor(3.5).item() == 3.5
+    assert isinstance(t.numpy(), np.ndarray)
+
+
+def test_indexing():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    t = paddle.to_tensor(x)
+    np.testing.assert_array_equal(t[1].numpy(), x[1])
+    np.testing.assert_array_equal(t[:, 1:, ::2].numpy(), x[:, 1:, ::2])
+    np.testing.assert_array_equal(t[..., -1].numpy(), x[..., -1])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_array_equal(t[0, idx].numpy(), x[0, [0, 2]])
+    mask = t > 10
+    # boolean mask indexing is host-eager (dynamic shape)
+    np.testing.assert_array_equal(paddle.masked_select(t, mask).numpy(), x[x > 10])
+
+
+def test_setitem():
+    x = np.zeros((3, 3), dtype=np.float32)
+    t = paddle.to_tensor(x)
+    t[1] = 5.0
+    assert t.numpy()[1].tolist() == [5.0] * 3
+    t[0, 0] = paddle.to_tensor(2.0)
+    assert t.numpy()[0, 0] == 2.0
+
+
+def test_iteration():
+    t = paddle.to_tensor([[1.0], [2.0]])
+    rows = [r.item() for r in t]
+    assert rows == [1.0, 2.0]
+
+
+def test_methods_attached_from_registry():
+    t = paddle.to_tensor([[1.0, 4.0]])
+    assert t.sqrt().numpy().tolist() == [[1.0, 2.0]]
+    assert t.sum().item() == 5.0
+    assert t.reshape([2]).shape == [2]
+    assert t.t().shape == [2, 1]
+    assert t.T.shape == [2, 1]
+
+
+def test_inplace_variants():
+    t = paddle.to_tensor([1.0, 2.0])
+    t.add_(paddle.to_tensor([1.0, 1.0]))
+    assert t.numpy().tolist() == [2.0, 3.0]
+    t.scale_(2.0)
+    assert t.numpy().tolist() == [4.0, 6.0]
+
+
+def test_clone_detach_semantics():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    c = t.clone()
+    assert not c.stop_gradient  # clone participates in autograd
+    d = t.detach()
+    assert d.stop_gradient
+    d.zero_()
+    # detach shares nothing after functional update (jax arrays immutable)
+    assert t.numpy()[0] == 1.0
+
+
+def test_cast_and_astype():
+    t = paddle.to_tensor([1.5])
+    assert t.astype("int32").dtype == np.int32
+    assert t.astype(paddle.bfloat16).dtype == paddle.core.dtypes.convert_dtype("bfloat16")
+
+
+def test_repr_contains_shape():
+    t = paddle.to_tensor([1.0])
+    assert "shape=[1]" in repr(t)
+
+
+def test_parameter():
+    p = paddle.Parameter(np.ones((2, 2), dtype=np.float32))
+    assert not p.stop_gradient
+    assert p.trainable
+    assert p.persistable
+
+
+def test_dunder_scalar_mix():
+    t = paddle.to_tensor([2.0])
+    assert (1 + t).numpy()[0] == 3.0
+    assert (1 - t).numpy()[0] == -1.0
+    assert (3 / t).numpy()[0] == 1.5
+    assert (t ** 2).numpy()[0] == 4.0
+    assert (2 ** t).numpy()[0] == 4.0
+    assert (-t).numpy()[0] == -2.0
+    assert abs(paddle.to_tensor([-2.0])).numpy()[0] == 2.0
