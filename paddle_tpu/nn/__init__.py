@@ -1,1 +1,40 @@
-"""Placeholder — populated in a later milestone this round."""
+"""paddle.nn surface (reference: python/paddle/nn/__init__.py — ~150 layers)."""
+from .layer import Layer, ParamAttr
+from . import functional
+from . import initializer
+from .clip import (ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+                   clip_grad_norm_)
+
+from .layers.common import (
+    Identity, Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
+    Embedding, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+    PixelShuffle, PixelUnshuffle, Pad1D, Pad2D, Pad3D, CosineSimilarity,
+    PairwiseDistance, Unfold,
+)
+from .layers.conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose
+from .layers.norm import (
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    LayerNorm, RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D,
+    InstanceNorm3D, LocalResponseNorm,
+)
+from .layers.pooling import (
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+)
+from .layers.activation import (
+    ReLU, ReLU6, GELU, SiLU, Swish, Mish, Sigmoid, Tanh, Softmax, LogSoftmax,
+    LeakyReLU, ELU, SELU, CELU, Softplus, Softshrink, Softsign, Hardshrink,
+    Hardtanh, Hardsigmoid, Hardswish, Tanhshrink, ThresholdedReLU, Maxout,
+    GLU, PReLU, RReLU, LogSigmoid,
+)
+from .layers.container import Sequential, LayerList, ParameterList, LayerDict
+from .layers.loss import (
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    SmoothL1Loss, HuberLoss, KLDivLoss, MarginRankingLoss,
+    CosineEmbeddingLoss, TripletMarginLoss,
+)
+from .layers.transformer import (
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layers.rnn import LSTM, GRU, SimpleRNN, LSTMCell, GRUCell

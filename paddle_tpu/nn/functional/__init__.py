@@ -1,0 +1,35 @@
+"""paddle.nn.functional surface (reference: python/paddle/nn/functional/)."""
+from .activation import (
+    relu, relu_, relu6, gelu, silu, swish, sigmoid, hardsigmoid, hardswish,
+    hardtanh, hardshrink, softshrink, tanhshrink, thresholded_relu, leaky_relu,
+    elu, selu, celu, mish, softplus, softsign, tanh, softmax, log_softmax,
+    log_sigmoid, glu, prelu, maxout, rrelu,
+)
+from .common import (
+    linear, dropout, dropout2d, dropout3d, alpha_dropout, embedding, one_hot,
+    normalize, cosine_similarity, pairwise_distance, interpolate, upsample,
+    pixel_shuffle, pixel_unshuffle, unfold, label_smooth,
+)
+from .conv import conv1d, conv2d, conv3d, conv2d_transpose
+from .pooling import (
+    max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
+    adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_max_pool2d,
+)
+from .norm import (
+    batch_norm, layer_norm, rms_norm, group_norm, instance_norm,
+    local_response_norm,
+)
+from .loss import (
+    cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,
+    smooth_l1_loss, huber_loss, binary_cross_entropy,
+    binary_cross_entropy_with_logits, kl_div, margin_ranking_loss,
+    hinge_embedding_loss, cosine_embedding_loss, triplet_margin_loss,
+    sigmoid_focal_loss, log_loss, square_error_cost,
+)
+from .attention import (
+    flash_attention, scaled_dot_product_attention, flashmask_attention,
+    flash_attn_unpadded,
+)
+
+# ops that live in the core registry but are also exposed via F (paddle parity)
+from ...ops import pad  # noqa: F401

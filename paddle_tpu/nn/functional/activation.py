@@ -1,0 +1,171 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py;
+kernels paddle/phi/kernels/activation_kernel.*). XLA fuses these into adjacent
+matmuls — no hand-fused bias+act kernel needed on TPU for the common cases."""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+
+
+def _op(name, impl, *args, **kwargs):
+    return apply_op(name, impl, args, kwargs)
+
+
+def relu(x):
+    return _op("relu", jax.nn.relu, x)
+
+
+def relu6(x):
+    return _op("relu6", jax.nn.relu6, x)
+
+
+def relu_(x):
+    out = relu(x)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient and x.stop_gradient
+    return x
+
+
+def gelu(x, approximate=False):
+    return _op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def silu(x):
+    return _op("silu", jax.nn.silu, x)
+
+
+swish = silu
+
+
+def sigmoid(x):
+    return _op("sigmoid", jax.nn.sigmoid, x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return _op("hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+
+
+def hardswish(x):
+    return _op("hardswish", lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return _op("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5):
+    return _op("hardshrink",
+               lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5):
+    def impl(a):
+        return jnp.where(a > threshold, a - threshold,
+                         jnp.where(a < -threshold, a + threshold, 0.0))
+    return _op("softshrink", impl, x)
+
+
+def tanhshrink(x):
+    return _op("tanhshrink", lambda a: a - jnp.tanh(a), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return _op("thresholded_relu",
+               lambda a: jnp.where(a > threshold, a, value), x)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _op("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def elu(x, alpha=1.0):
+    return _op("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return _op("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0):
+    return _op("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def mish(x):
+    return _op("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    def impl(a):
+        scaled = beta * a
+        return jnp.where(scaled > threshold, a, jax.nn.softplus(scaled) / beta)
+    return _op("softplus", impl, x)
+
+
+def softsign(x):
+    return _op("softsign", jax.nn.soft_sign, x)
+
+
+def tanh(x):
+    return _op("tanh", jnp.tanh, x)
+
+
+def softmax(x, axis=-1, dtype=None):
+    def impl(a):
+        if dtype is not None:
+            from ...core.dtypes import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return _op("softmax", impl, x)
+
+
+def log_softmax(x, axis=-1, dtype=None):
+    def impl(a):
+        if dtype is not None:
+            from ...core.dtypes import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return _op("log_softmax", impl, x)
+
+
+def log_sigmoid(x):
+    return _op("log_sigmoid", jax.nn.log_sigmoid, x)
+
+
+def glu(x, axis=-1):
+    def impl(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return _op("glu", impl, x)
+
+
+def prelu(x, weight, data_format="NCHW"):
+    def impl(a, w):
+        if w.size == 1:
+            w_b = w.reshape(())
+        elif data_format == "NCHW" and a.ndim > 2:
+            w_b = w.reshape((1, -1) + (1,) * (a.ndim - 2))
+        else:
+            w_b = w
+        return jnp.where(a > 0, a, w_b * a)
+    return _op("prelu", impl, x, weight)
+
+
+def maxout(x, groups, axis=1):
+    def impl(a):
+        axis_ = axis % a.ndim
+        c = a.shape[axis_]
+        new_shape = (a.shape[:axis_] + (c // groups, groups) + a.shape[axis_ + 1:])
+        return jnp.max(a.reshape(new_shape), axis=axis_ + 1)
+    return _op("maxout", impl, x)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=True):
+    from ...core import random as _random
+    if training:
+        def impl(a):
+            k = _random.next_key()
+            slope = jax.random.uniform(k, a.shape, jnp.float32, lower, upper).astype(a.dtype)
+            return jnp.where(a >= 0, a, slope * a)
+        return _op("rrelu", impl, x)
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)

@@ -1,0 +1,152 @@
+"""Attention functionals.
+
+Reference surface: python/paddle/nn/functional/flash_attention.py:358
+(flash_attention), :756 (flash_attn_unpadded), :1299 (flashmask_attention),
+scaled_dot_product_attention. On TPU the fused kernel is a Pallas flash
+kernel (paddle_tpu/ops/pallas/flash_attention.py, M7 tier); this module holds
+the API and the XLA reference path used on CPU / for small shapes.
+"""
+import math
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core import random as _random
+
+_USE_PALLAS = True  # flipped off on CPU automatically inside _flash_available
+
+
+@functools.lru_cache(maxsize=1)
+def _flash_available():
+    try:
+        return _USE_PALLAS and jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _sdpa_ref(q, k, v, mask=None, dropout=0.0, causal=False, scale=None,
+              training=True):
+    """Reference attention in pure XLA ops, [B, S, H, D] layout (paddle's
+    flash_attention layout)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # [B,S,H,D] -> [B,H,S,D]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhsd,bhtd->bhst", qt, kt) * scale
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((s, t), dtype=bool), k=t - s)
+        logits = jnp.where(cm, logits, jnp.finfo(logits.dtype).min)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout > 0.0 and training:
+        keep = jax.random.bernoulli(_random.next_key(), 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, training=True):
+    """paddle.nn.functional.flash_attention.flash_attention parity:
+    inputs [batch, seqlen, num_heads, head_dim]; returns (out, softmax|None).
+
+    On TPU dispatches to the Pallas flash kernel (M7); elsewhere uses the XLA
+    reference path (XLA fuses it reasonably; the Pallas kernel wins at long
+    sequence)."""
+    if _flash_available() and dropout == 0.0 and not return_softmax:
+        from ...ops.pallas import flash_attention as pallas_flash
+        try:
+            def impl(q, k, v):
+                return pallas_flash.flash_attention_bshd(q, k, v, causal=causal)
+            out = apply_op("flash_attention", impl, (query, key, value), {})
+            return out, None
+        except Exception:
+            pass  # fall through to reference path
+
+    def impl(q, k, v):
+        return _sdpa_ref(q, k, v, dropout=dropout, causal=causal,
+                         training=training)
+    out = apply_op("flash_attention_ref", impl, (query, key, value), {})
+    return out, None
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True):
+    """paddle.nn.functional.scaled_dot_product_attention parity
+    ([B, S, H, D] layout, additive or bool mask)."""
+    if attn_mask is None:
+        out, _ = flash_attention(query, key, value, dropout=dropout_p,
+                                 causal=is_causal, training=training)
+        return out
+
+    def impl(q, k, v, m):
+        return _sdpa_ref(q, k, v, mask=m, dropout=dropout_p, causal=is_causal,
+                         training=training)
+    return apply_op("sdpa", impl, (query, key, value, attn_mask), {})
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=True):
+    """FlashMask (reference python/paddle/nn/functional/flash_attention.py:1299):
+    column-sparse mask attention for long context. The mask is given as
+    start/end row indices per column: position (r, c) is masked out when
+    r >= start[c] (LTS) etc. Reference path materializes the mask; the Pallas
+    kernel (M7+) consumes indices directly."""
+    if startend_row_indices is None:
+        out, _ = flash_attention(query, key, value, dropout=dropout, causal=causal)
+        return out
+
+    def impl(q, k, v, idx):
+        s = q.shape[1]
+        rows = jnp.arange(s)[:, None]  # query row index
+        # LTS convention: column c masks query rows r >= start[c]
+        start = idx[..., 0]  # [B, nh, S_k]
+        keep = rows[None, None] < start[:, :, None, :]
+        if causal:
+            cm = jnp.tril(jnp.ones((s, s), dtype=bool))
+            keep = jnp.logical_and(keep, cm)
+        return _sdpa_ref(q, k, v, mask=keep, dropout=dropout, causal=False)
+    return apply_op("flashmask_attention", impl,
+                    (query, key, value, startend_row_indices), {})
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False, training=True):
+    """Var-len attention (reference flash_attn_unpadded, :756): packed
+    [total_tokens, H, D] with cumulative sequence offsets. XLA wants static
+    shapes, so this builds a segment mask over the packed layout — the
+    idiomatic TPU equivalent of varlen flash (segment-ids pattern)."""
+    def impl(q, k, v, cu_q, cu_k):
+        total_q = q.shape[0]
+        total_k = k.shape[0]
+        pos_q = jnp.arange(total_q)
+        pos_k = jnp.arange(total_k)
+        seg_q = jnp.searchsorted(cu_q[1:], pos_q, side="right")
+        seg_k = jnp.searchsorted(cu_k[1:], pos_k, side="right")
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            off_q = pos_q - jnp.take(cu_q, seg_q)
+            off_k = pos_k - jnp.take(cu_k, seg_k)
+            mask = jnp.logical_and(mask, off_q[:, None] >= off_k[None, :])
+        d = q.shape[-1]
+        sc = scale if scale is not None else 1.0 / math.sqrt(d)
+        logits = jnp.einsum("shd,thd->hst", q, k) * sc
+        logits = jnp.where(mask[None], logits, jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+        if dropout > 0.0 and training:
+            keep = jax.random.bernoulli(_random.next_key(), 1.0 - dropout, probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+        return jnp.einsum("hst,thd->shd", probs, v)
+    out = apply_op("flash_attn_unpadded", impl,
+                   (query, key, value, cu_seqlens_q, cu_seqlens_k), {})
+    return out, None

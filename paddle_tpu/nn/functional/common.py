@@ -1,0 +1,200 @@
+"""Common functionals: linear, dropout, embedding, normalize, interpolate,
+similarity (reference: python/paddle/nn/functional/common.py, input.py)."""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core import random as _random
+
+
+def _op(name, impl, *args, **kwargs):
+    return apply_op(name, impl, args, kwargs)
+
+
+def linear(x, weight, bias=None):
+    """paddle convention: weight is [in_features, out_features]."""
+    if bias is None:
+        return _op("linear", lambda a, w: jnp.matmul(a, w), x, weight)
+    return _op("linear", lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return _op("dropout_scale", lambda a: a * (1.0 - p), x)
+        return x
+
+    def impl(a):
+        key = _random.next_key()
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+    return _op("dropout", impl, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def impl(a):
+        key = _random.next_key()
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+    return _op("alpha_dropout", impl, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    def impl(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+    return _op("embedding", impl, x, weight)
+
+
+def one_hot(x, num_classes):
+    return _op("one_hot",
+               lambda a: jax.nn.one_hot(a, int(num_classes), dtype=jnp.float32), x,
+               )
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    def impl(a):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return _op("normalize", impl, x)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def impl(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return _op("cosine_similarity", impl, x1, x2)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    def impl(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+    return _op("pairwise_distance", impl, x, y)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    """2D resize (nearest / bilinear / bicubic) via jax.image."""
+    def impl(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+        else:
+            n, h, w, c = a.shape
+        if size is not None:
+            out_h, out_w = int(size[0]), int(size[1])
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else (scale_factor, scale_factor)
+            out_h, out_w = int(h * sf[0]), int(w * sf[1])
+        method = {"nearest": "nearest", "bilinear": "linear",
+                  "bicubic": "cubic", "linear": "linear"}[mode]
+        if data_format == "NCHW":
+            out = jax.image.resize(a, (n, c, out_h, out_w), method=method)
+        else:
+            out = jax.image.resize(a, (n, out_h, out_w, c), method=method)
+        return out
+    return _op("interpolate", impl, x)
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = int(upscale_factor)
+
+    def impl(a):
+        if data_format != "NCHW":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        n, c, h, w = a.shape
+        oc = c // (r * r)
+        out = a.reshape(n, oc, r, r, h, w)
+        out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+        out = out.reshape(n, oc, h * r, w * r)
+        if data_format != "NCHW":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return _op("pixel_shuffle", impl, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = int(downscale_factor)
+
+    def impl(a):
+        if data_format != "NCHW":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        n, c, h, w = a.shape
+        out = a.reshape(n, c, h // r, r, w // r, r)
+        out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+        out = out.reshape(n, c * r * r, h // r, w // r)
+        if data_format != "NCHW":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return _op("pixel_unshuffle", impl, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (reference: unfold op). Returns [N, C*kh*kw, L]."""
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) \
+        else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    ph, pw = (paddings, paddings) if isinstance(paddings, int) else paddings[:2]
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+
+    def impl(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        out_h = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        out_w = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        cols = []
+        for i in range(kh):
+            for j in range(kw):
+                patch = a[:, :, i * dh: i * dh + out_h * sh: sh,
+                          j * dw: j * dw + out_w * sw: sw]
+                cols.append(patch.reshape(n, c, -1))
+        out = jnp.stack(cols, axis=2)  # [N, C, kh*kw, L]
+        return out.reshape(n, c * kh * kw, -1)
+    return _op("unfold", impl, x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    def impl(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist.data if hasattr(prior_dist, "data") else prior_dist
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+    return _op("label_smooth", impl, label)

@@ -1,0 +1,135 @@
+"""Convolutions (reference: python/paddle/nn/functional/conv.py; kernels
+paddle/phi/kernels/gpudnn/conv_* -> cuDNN). Here: lax.conv_general_dilated,
+which XLA maps onto the MXU — the TPU path needs no vendor conv library."""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+
+
+def _pair(v, n=2):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _padding(padding, spatial):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * spatial
+    padding = list(padding)
+    if len(padding) == spatial:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * spatial:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(spatial)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, spatial,
+             data_format, name):
+    strides = _pair(stride, spatial)
+    dilations = _pair(dilation, spatial)
+    pad = _padding(padding, spatial)
+    if spatial == 1:
+        dn_str = ("NCH", "OIH", "NCH") if data_format in ("NCL", "NCH") else ("NHC", "OIH", "NHC")
+    elif spatial == 2:
+        dn_str = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC")
+    else:
+        dn_str = ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW" else ("NDHWC", "OIDHW", "NDHWC")
+
+    def impl(a, w, *maybe_b):
+        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, dn_str)
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if a.dtype == jnp.float32 else None)
+        out = out.astype(a.dtype)
+        if maybe_b:
+            b = maybe_b[0]
+            if data_format.startswith("NC"):
+                out = out + b.reshape((1, -1) + (1,) * spatial)
+            else:
+                out = out + b
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply_op(name, impl, args, {})
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    data_format, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    data_format, "conv3d")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCHW"):
+    """Transposed conv. paddle weight layout: [in, out//groups, kh, kw]."""
+    strides = _pair(stride, 2)
+    dilations = _pair(dilation, 2)
+    opad = _pair(output_padding, 2)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    pads = _padding(padding, 2)
+    if output_size is not None:
+        # derive the extra high-side padding that realizes the requested
+        # output (reference: ConvTranspose output_size semantics)
+        x_arr = x.data if hasattr(x, "data") else x
+        w_arr = weight.data if hasattr(weight, "data") else weight
+        osz = _pair(output_size, 2)
+        opad = tuple(
+            osz[i] - ((x_arr.shape[2 + i] - 1) * strides[i]
+                      - pads[i][0] - pads[i][1]
+                      + dilations[i] * (w_arr.shape[2 + i] - 1) + 1)
+            for i in range(2))
+        if any(p < 0 or p >= strides[i] for i, p in enumerate(opad)):
+            raise ValueError(
+                f"output_size {list(osz)} not reachable with stride {strides}")
+
+    def impl(a, w, *maybe_b):
+        # express as gradient of conv: use conv_general_dilated with lhs_dilation
+        kh, kw = w.shape[2], w.shape[3]
+        # flip spatial dims and swap in/out channels -> [out, in, kh, kw]
+        w_t = jnp.flip(w, axis=(2, 3))
+        w_t = jnp.swapaxes(w_t, 0, 1)  # [out//groups? ...]
+        if groups > 1:
+            # [in, out/g, kh, kw] -> split in into g groups
+            ci = a.shape[1]
+            w_g = w.reshape(groups, ci // groups, w.shape[1], kh, kw)
+            w_g = jnp.flip(w_g, axis=(3, 4))
+            w_t = jnp.swapaxes(w_g, 1, 2).reshape(
+                groups * w.shape[1], ci // groups, kh, kw)
+        pad_h = dilations[0] * (kh - 1) - pads[0][0]
+        pad_h2 = dilations[0] * (kh - 1) - pads[0][1] + opad[0]
+        pad_w = dilations[1] * (kw - 1) - pads[1][0]
+        pad_w2 = dilations[1] * (kw - 1) - pads[1][1] + opad[1]
+        dn = jax.lax.conv_dimension_numbers(a.shape, w_t.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+        out = jax.lax.conv_general_dilated(
+            a, w_t, window_strides=(1, 1),
+            padding=[(pad_h, pad_h2), (pad_w, pad_w2)],
+            lhs_dilation=strides, rhs_dilation=dilations,
+            dimension_numbers=dn, feature_group_count=groups)
+        if maybe_b:
+            out = out + maybe_b[0].reshape(1, -1, 1, 1)
+        return out
+
+    if data_format != "NCHW":
+        raise NotImplementedError("conv2d_transpose NHWC")
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply_op("conv2d_transpose", impl, args, {})

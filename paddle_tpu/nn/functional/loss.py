@@ -1,0 +1,237 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py; kernels
+cross_entropy / softmax_with_cross_entropy etc.)."""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    """paddle.nn.functional.cross_entropy (reference:
+    python/paddle/nn/functional/loss.py cross_entropy): input is logits by
+    default (use_softmax=True), label is int class ids or soft distribution."""
+    def impl(logits, lbl, *maybe_w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label:
+            tgt = lbl
+            if label_smoothing > 0:
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / n_classes
+            loss = -jnp.sum(tgt * logp, axis=axis)
+            valid = jnp.ones(loss.shape, dtype=logp.dtype)
+        else:
+            lbl_i = lbl.astype(jnp.int32)
+            if lbl_i.ndim == logits.ndim:  # [N, 1] style labels
+                lbl_i = jnp.squeeze(lbl_i, axis=axis)
+            valid = (lbl_i != ignore_index)
+            safe = jnp.where(valid, lbl_i, 0)
+            picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0] \
+                if axis in (-1, logits.ndim - 1) else \
+                jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis).squeeze(axis)
+            if label_smoothing > 0:
+                smooth = -jnp.mean(logp, axis=axis)
+                loss = (1 - label_smoothing) * (-picked) + label_smoothing * smooth
+            else:
+                loss = -picked
+            if maybe_w:
+                w = maybe_w[0]
+                loss = loss * jnp.take(w, safe)
+            loss = jnp.where(valid, loss, 0.0)
+            valid = valid.astype(logp.dtype)
+        if reduction == "mean":
+            if maybe_w and not soft_label:
+                w = maybe_w[0]
+                lbl_i = lbl.astype(jnp.int32)
+                if lbl_i.ndim == logits.ndim:
+                    lbl_i = jnp.squeeze(lbl_i, axis=axis)
+                safe = jnp.where(valid > 0, lbl_i, 0)
+                denom = jnp.sum(jnp.take(w, safe) * valid)
+            else:
+                denom = jnp.maximum(jnp.sum(valid), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply_op("cross_entropy", impl, args, {})
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    def impl(logp, lbl, *maybe_w):
+        lbl_i = lbl.astype(jnp.int32)
+        valid = (lbl_i != ignore_index)
+        safe = jnp.where(valid, lbl_i, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        loss = -picked
+        if maybe_w:
+            loss = loss * jnp.take(maybe_w[0], safe)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.take(maybe_w[0], safe) * valid if maybe_w else valid
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(denom.astype(logp.dtype)), 1e-12)
+        return _reduce(loss, reduction)
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply_op("nll_loss", impl, args, {})
+
+
+def mse_loss(input, label, reduction="mean"):
+    def impl(a, b):
+        return _reduce((a - b) ** 2, reduction)
+    return apply_op("mse_loss", impl, (input, label), {})
+
+
+def l1_loss(input, label, reduction="mean"):
+    def impl(a, b):
+        return _reduce(jnp.abs(a - b), reduction)
+    return apply_op("l1_loss", impl, (input, label), {})
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    def impl(a, b):
+        d = a - b
+        abs_d = jnp.abs(d)
+        loss = jnp.where(abs_d < delta, 0.5 * d * d / delta, abs_d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply_op("smooth_l1_loss", impl, (input, label), {})
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    def impl(a, b):
+        d = a - b
+        abs_d = jnp.abs(d)
+        loss = jnp.where(abs_d <= delta, 0.5 * d * d,
+                         delta * (abs_d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply_op("huber_loss", impl, (input, label), {})
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    def impl(p, y, *maybe_w):
+        p_ = jnp.clip(p, 1e-12, 1.0 - 1e-7)
+        loss = -(y * jnp.log(p_) + (1 - y) * jnp.log1p(-p_))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce(loss, reduction)
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply_op("binary_cross_entropy", impl, args, {})
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    def impl(z, y, *rest):
+        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+        loss = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        i = 0
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+            loss = loss * (y * (pw - 1) + 1)
+        if weight is not None:
+            loss = loss * rest[i]
+        return _reduce(loss, reduction)
+    args = [logit, label]
+    if pos_weight is not None:
+        args.append(pos_weight)
+    if weight is not None:
+        args.append(weight)
+    return apply_op("bce_with_logits", impl, tuple(args), {})
+
+
+def kl_div(input, label, reduction="mean", log_target=False):
+    def impl(logp, tgt):
+        if log_target:
+            loss = jnp.exp(tgt) * (tgt - logp)
+        else:
+            t = jnp.maximum(tgt, 0)
+            loss = jnp.where(tgt > 0, tgt * (jnp.log(jnp.maximum(tgt, 1e-30)) - logp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply_op("kl_div", impl, (input, label), {})
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    def impl(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(loss, reduction)
+    return apply_op("margin_ranking_loss", impl, (input, other, label), {})
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    def impl(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply_op("hinge_embedding_loss", impl, (input, label), {})
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    def impl(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply_op("cosine_embedding_loss", impl, (input1, input2, label), {})
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def impl(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+        return _reduce(loss, reduction)
+    return apply_op("triplet_margin_loss", impl, (input, positive, negative), {})
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    def impl(z, y, *maybe_n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if maybe_n:
+            loss = loss / maybe_n[0]
+        return _reduce(loss, reduction)
+    args = (logit, label) if normalizer is None else (logit, label, normalizer)
+    return apply_op("sigmoid_focal_loss", impl, args, {})
+
+
+def log_loss(input, label, epsilon=1e-4):
+    def impl(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return apply_op("log_loss", impl, (input, label), {})
+
+
+def square_error_cost(input, label):
+    def impl(a, b):
+        return (a - b) ** 2
+    return apply_op("square_error_cost", impl, (input, label), {})

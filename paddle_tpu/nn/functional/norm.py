@@ -1,0 +1,157 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py;
+phi kernels batch_norm/layer_norm/group_norm + fused_layernorm in §2.9 of the
+survey — on TPU, XLA fuses the normalization math; a Pallas fused RMSNorm
+covers the long-row case)."""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None):
+    """Reference semantics (paddle/phi/kernels/batch_norm_kernel.h): in
+    training mode uses batch statistics and updates running stats in place;
+    in eval uses running stats."""
+    if use_global_stats is None:
+        use_global_stats = not training
+    ch_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else -1
+    axes = tuple(i for i in range(x.ndim) if i != (ch_axis % x.ndim))
+
+    def shape_c(a):
+        s = [1] * x.ndim
+        s[ch_axis % x.ndim] = -1
+        return a.reshape(s)
+
+    if not use_global_stats:
+        # batch stats; update running stats host-side (eager semantics)
+        def impl(a, *wb):
+            mean = jnp.mean(a, axis=axes)
+            var = jnp.var(a, axis=axes)
+            out = (a - shape_c(mean)) / jnp.sqrt(shape_c(var) + epsilon)
+            if len(wb) == 2:
+                out = out * shape_c(wb[0]) + shape_c(wb[1])
+            return out, mean, var
+        args = (x,) if weight is None else (x, weight, bias)
+        out, mean, var = apply_op("batch_norm", impl, args, {})
+        if isinstance(running_mean, Tensor) and not isinstance(mean.data, jax.core.Tracer):
+            m = momentum
+            running_mean.set_value(m * running_mean.data + (1 - m) * mean.data)
+            running_var.set_value(m * running_var.data + (1 - m) * var.data)
+        return out
+
+    def impl(a, rm, rv, *wb):
+        out = (a - shape_c(rm)) / jnp.sqrt(shape_c(rv) + epsilon)
+        if len(wb) == 2:
+            out = out * shape_c(wb[0]) + shape_c(wb[1])
+        return out
+    args = (x, running_mean, running_var) if weight is None \
+        else (x, running_mean, running_var, weight, bias)
+    return apply_op("batch_norm_infer", impl, args, {})
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+    axes = tuple(range(-n_axes, 0))
+
+    def impl(a, *wb):
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        if len(wb) >= 1 and wb[0] is not None:
+            out = out * wb[0]
+        if len(wb) == 2 and wb[1] is not None:
+            out = out + wb[1]
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply_op("layer_norm", impl, tuple(args), {})
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    """RMSNorm (capability beyond the snapshot's python surface; the reference
+    carries fused_rms_norm in fused_ops.yaml). Hot path for Llama."""
+    def impl(a, *w):
+        dtype = a.dtype
+        a32 = a.astype(jnp.float32)
+        ms = jnp.mean(a32 * a32, axis=-1, keepdims=True)
+        out = a32 * jax.lax.rsqrt(ms + epsilon)
+        out = out.astype(dtype)
+        if w:
+            out = out * w[0]
+        return out
+    args = (x,) if weight is None else (x, weight)
+    return apply_op("rms_norm", impl, args, {})
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    def impl(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        spatial = a.shape[2:]
+        g = num_groups
+        out = a.reshape(n, g, c // g, *spatial)
+        axes = tuple(range(2, out.ndim))
+        mean = jnp.mean(out, axis=axes, keepdims=True)
+        var = jnp.var(out, axis=axes, keepdims=True)
+        out = (out - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.reshape(a.shape)
+        if wb:
+            shape = (1, c) + (1,) * len(spatial)
+            out = out * wb[0].reshape(shape)
+            if len(wb) == 2:
+                out = out + wb[1].reshape(shape)
+        return out
+    if data_format != "NCHW" and data_format != "NCL":
+        raise NotImplementedError("group_norm channels-last")
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply_op("group_norm", impl, tuple(args), {})
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW"):
+    def impl(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        if wb:
+            c = a.shape[1]
+            shape = (1, c) + (1,) * (a.ndim - 2)
+            out = out * wb[0].reshape(shape)
+            if len(wb) == 2:
+                out = out + wb[1].reshape(shape)
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply_op("instance_norm", impl, tuple(args), {})
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    def impl(a):
+        sq = a * a
+        half = size // 2
+        # sum over channel window
+        pad = [(0, 0)] * a.ndim
+        pad[1] = (half, size - 1 - half)
+        sq = jnp.pad(sq, pad)
+        acc = sum(sq[:, i:i + a.shape[1]] for i in range(size))
+        return a / (k + alpha * acc) ** beta
+    return apply_op("local_response_norm", impl, (x,), {})

@@ -1,0 +1,203 @@
+"""Pooling (reference: python/paddle/nn/functional/pooling.py; phi pool
+kernels). lax.reduce_window is the XLA-native pooling primitive. ceil_mode is
+implemented by extending the high-side padding (with -inf for max, with
+count-corrected zeros for avg) — reduce_window itself is floor-mode."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def _resolve_pads(kernel, stride, padding, ceil_mode, in_sizes):
+    """Per-spatial-dim (lo, hi) pads, with hi extended for ceil_mode."""
+    n = len(in_sizes)
+    k = _tup(kernel, n)
+    s = _tup(stride, n)
+    p = _tup(padding, n)
+    pads = []
+    for i in range(n):
+        hi = p[i]
+        if ceil_mode:
+            span = in_sizes[i] + 2 * p[i] - k[i]
+            rem = span % s[i]
+            if rem:
+                hi += s[i] - rem
+        pads.append((p[i], hi))
+    return k, s, pads
+
+
+def _pool_nd(x, kernel, stride, padding, spatial, kind, name, ceil_mode=False,
+             exclusive=True, divisor_override=None):
+    if stride is None:
+        stride = kernel
+    if isinstance(padding, str):
+        window = (1, 1) + _tup(kernel, spatial)
+        strides = (1, 1) + _tup(stride, spatial)
+        pad = padding.upper()
+
+        def impl_str(a):
+            if kind == "max":
+                return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, window,
+                                             strides, pad)
+            out = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pad)
+            return out / int(np.prod(_tup(kernel, spatial)))
+        return apply_op(name, impl_str, (x,), {})
+
+    def impl(a):
+        in_sizes = a.shape[2:]
+        k, s, sp_pads = _resolve_pads(kernel, stride, padding, ceil_mode, in_sizes)
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = [(0, 0), (0, 0)] + sp_pads
+        if kind == "max":
+            return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, window,
+                                         strides, pads)
+        out = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
+        if divisor_override:
+            return out / divisor_override
+        padded = any(lo or hi for lo, hi in sp_pads)
+        if exclusive and padded:
+            counts = jax.lax.reduce_window(jnp.ones_like(a), 0.0, jax.lax.add,
+                                           window, strides, pads)
+            return out / counts
+        if padded and ceil_mode:
+            # include_pad but ceil: the ceil-extension region must still be
+            # excluded (paddle counts only the declared padding)
+            base = [(lo, lo) for lo, _ in sp_pads]
+            ones = jnp.pad(jnp.ones_like(a), [(0, 0), (0, 0)] + base,
+                           constant_values=1.0)  # declared pad counts
+            extra = [(0, hi - lo) for lo, hi in sp_pads]
+            ones = jnp.pad(ones, [(0, 0), (0, 0)] + extra)  # ceil region doesn't
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                           strides, [(0, 0)] * (spatial + 2))
+            return out / jnp.maximum(counts, 1.0)
+        return out / int(np.prod(k))
+    return apply_op(name, impl, (x,), {})
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW"):
+    out = _pool_nd(x, kernel_size, stride, padding, 2, "max", "max_pool2d",
+                   ceil_mode=ceil_mode)
+    if return_mask:
+        return out, _max_pool2d_indices(x, kernel_size, stride, padding)
+    return out
+
+
+def _max_pool2d_indices(x, kernel_size, stride, padding):
+    kh, kw = _tup(kernel_size, 2)
+    if stride is None:
+        stride = kernel_size
+
+    def impl(a):
+        n, c, h, w = a.shape
+        flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+        flat_idx = jnp.broadcast_to(flat_idx, a.shape)
+
+        def reducer(xv, yv):
+            xval, xidx = xv
+            yval, yidx = yv
+            take_y = yval > xval
+            return (jnp.where(take_y, yval, xval), jnp.where(take_y, yidx, xidx))
+        sh, sw = _tup(stride, 2)
+        ph, pw = _tup(padding, 2) if not isinstance(padding, str) else (0, 0)
+        _, out_i = jax.lax.reduce_window(
+            (a, flat_idx), (-jnp.inf, jnp.float32(-1)), reducer,
+            (1, 1, kh, kw), (1, 1, sh, sw),
+            [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+        return out_i.astype(jnp.int32)
+    return apply_op("max_pool2d_indices", impl, (x,), {}, differentiable=False)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW"):
+    return _pool_nd(x, kernel_size, stride, padding, 2, "avg", "avg_pool2d",
+                    ceil_mode=ceil_mode, exclusive=exclusive,
+                    divisor_override=divisor_override)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = stride if stride is None or isinstance(stride, int) else stride[0]
+    p = padding if isinstance(padding, int) else padding[0]
+    return _pool_nd(x, k, s, p, 1, "max", "max_pool1d", ceil_mode=ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = stride if stride is None or isinstance(stride, int) else stride[0]
+    p = padding if isinstance(padding, int) else padding[0]
+    return _pool_nd(x, k, s, p, 1, "avg", "avg_pool1d", ceil_mode=ceil_mode,
+                    exclusive=exclusive)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    return _pool_nd(x, kernel_size, stride, padding, 3, "max", "max_pool3d",
+                    ceil_mode=ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW"):
+    return _pool_nd(x, kernel_size, stride, padding, 3, "avg", "avg_pool3d",
+                    ceil_mode=ceil_mode, exclusive=exclusive,
+                    divisor_override=divisor_override)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    oh, ow = _tup(output_size, 2)
+
+    def impl(a):
+        n, c, h, w = a.shape
+        if oh is not None and h % oh == 0 and w % ow == 0:
+            out = a.reshape(n, c, oh, h // oh, ow, w // ow)
+            return out.mean(axis=(3, 5))
+        rows = [(int(np.floor(i * h / oh)), int(np.ceil((i + 1) * h / oh)))
+                for i in range(oh)]
+        cols = [(int(np.floor(j * w / ow)), int(np.ceil((j + 1) * w / ow)))
+                for j in range(ow)]
+        return jnp.stack([
+            jnp.stack([a[:, :, r0:r1, c0:c1].mean(axis=(2, 3))
+                       for (c0, c1) in cols], axis=-1)
+            for (r0, r1) in rows], axis=-2)
+    return apply_op("adaptive_avg_pool2d", impl, (x,), {})
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
+    oh, ow = _tup(output_size, 2)
+
+    def impl(a):
+        n, c, h, w = a.shape
+        if h % oh == 0 and w % ow == 0:
+            out = a.reshape(n, c, oh, h // oh, ow, w // ow)
+            return out.max(axis=(3, 5))
+        rows = [(int(np.floor(i * h / oh)), int(np.ceil((i + 1) * h / oh)))
+                for i in range(oh)]
+        cols = [(int(np.floor(j * w / ow)), int(np.ceil((j + 1) * w / ow)))
+                for j in range(ow)]
+        return jnp.stack([
+            jnp.stack([a[:, :, r0:r1, c0:c1].max(axis=(2, 3))
+                       for (c0, c1) in cols], axis=-1)
+            for (r0, r1) in rows], axis=-2)
+    return apply_op("adaptive_max_pool2d", impl, (x,), {})
+
+
+def adaptive_avg_pool1d(x, output_size):
+    o = output_size if isinstance(output_size, int) else output_size[0]
+
+    def impl(a):
+        n, c, l = a.shape
+        if l % o == 0:
+            return a.reshape(n, c, o, l // o).mean(axis=3)
+        bounds = [(int(np.floor(i * l / o)), int(np.ceil((i + 1) * l / o)))
+                  for i in range(o)]
+        return jnp.stack([a[:, :, b0:b1].mean(axis=2) for (b0, b1) in bounds],
+                         axis=-1)
+    return apply_op("adaptive_avg_pool1d", impl, (x,), {})

@@ -1,0 +1,260 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py). The scan over
+time is lax.scan — the XLA-native recurrence (compiles to a single fused loop
+on TPU instead of per-step kernel launches)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ..layer import Layer
+from .. import initializer as I
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               attr=weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               attr=weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size],
+                                             attr=bias_ih_attr,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size],
+                                             attr=bias_hh_attr,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ... import ops
+            b = inputs.shape[0]
+            states = (ops.zeros([b, self.hidden_size]),
+                      ops.zeros([b, self.hidden_size]))
+        h, c = states
+
+        def impl(x, h_, c_, wih, whh, bih, bhh):
+            return _lstm_step(x, h_, c_, wih, whh, bih, bhh)
+        h2, c2 = apply_op("lstm_cell", impl,
+                          (inputs, h, c, self.weight_ih, self.weight_hh,
+                           self.bias_ih, self.bias_hh), {})
+        return h2, (h2, c2)
+
+
+def _lstm_step(x, h, c, wih, whh, bih, bhh):
+    gates = x @ wih.T + h @ whh.T + bih + bhh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, c2
+
+
+def _gru_step(x, h, wih, whh, bih, bhh):
+    gi = x @ wih.T + bih
+    gh = h @ whh.T + bhh
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    return (1 - z) * n + z * h
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size],
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size],
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ... import ops
+            states = ops.zeros([inputs.shape[0], self.hidden_size])
+
+        def impl(x, h, wih, whh, bih, bhh):
+            return _gru_step(x, h, wih, whh, bih, bhh)
+        h2 = apply_op("gru_cell", impl,
+                      (inputs, states, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh), {})
+        return h2, h2
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) recurrence via lax.scan."""
+
+    MODE = "LSTM"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirectional else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[self.MODE]
+        std = 1.0 / np.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 else hidden_size * self.num_directions
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                self.add_parameter("weight_ih" + sfx, self.create_parameter(
+                    [gate_mult * hidden_size, in_sz], default_initializer=u))
+                self.add_parameter("weight_hh" + sfx, self.create_parameter(
+                    [gate_mult * hidden_size, hidden_size], default_initializer=u))
+                self.add_parameter("bias_ih" + sfx, self.create_parameter(
+                    [gate_mult * hidden_size], default_initializer=u))
+                self.add_parameter("bias_hh" + sfx, self.create_parameter(
+                    [gate_mult * hidden_size], default_initializer=u))
+
+    def _step_fn(self):
+        if self.MODE == "LSTM":
+            return _lstm_step
+        if self.MODE == "GRU":
+            return _gru_step
+        act = jnp.tanh if self.MODE == "RNN_TANH" else jax.nn.relu
+        def step(x, h, wih, whh, bih, bhh):
+            return act(x @ wih.T + h @ whh.T + bih + bhh)
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = self.MODE == "LSTM"
+        step = self._step_fn()
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        params = []
+        for layer in range(nl):
+            for d in range(nd):
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                params.extend([
+                    self._parameters["weight_ih" + sfx],
+                    self._parameters["weight_hh" + sfx],
+                    self._parameters["bias_ih" + sfx],
+                    self._parameters["bias_hh" + sfx]])
+        time_major = self.time_major
+        has_init = initial_states is not None
+        has_len = sequence_length is not None
+        extra = []
+        if has_init:
+            extra.extend(initial_states if is_lstm else [initial_states])
+        if has_len:
+            extra.append(sequence_length)
+
+        def impl(x, *flat):
+            flat_params = flat[: 4 * nl * nd]
+            rest = list(flat[4 * nl * nd:])
+            h0_all = c0_all = seq_len = None
+            if has_init:
+                h0_all = rest.pop(0)
+                if is_lstm:
+                    c0_all = rest.pop(0)
+            if has_len:
+                seq_len = rest.pop(0)
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # -> [T, B, C]
+            t_len, b = x.shape[0], x.shape[1]
+            steps_fwd = jnp.arange(t_len)
+            h_outs, c_outs = [], []
+            inp = x
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(nd):
+                    li = layer * nd + d
+                    wih, whh, bih, bhh = flat_params[li * 4: li * 4 + 4]
+                    seq = jnp.flip(inp, axis=0) if d == 1 else inp
+                    # valid-step mask: for the reverse direction the flipped
+                    # sequence has pad steps FIRST, so valid is t >= T - len
+                    if seq_len is not None:
+                        if d == 1:
+                            valid = steps_fwd[:, None] >= (t_len - seq_len)[None, :]
+                        else:
+                            valid = steps_fwd[:, None] < seq_len[None, :]
+                        valid = valid[..., None].astype(x.dtype)  # [T, B, 1]
+                    else:
+                        valid = None
+                    h0 = h0_all[li] if h0_all is not None else jnp.zeros((b, hs), x.dtype)
+                    if is_lstm:
+                        c0 = c0_all[li] if c0_all is not None else jnp.zeros((b, hs), x.dtype)
+
+                        def body(carry, xt_v):
+                            h_, c_ = carry
+                            xt, v = xt_v
+                            h2, c2 = step(xt, h_, c_, wih, whh, bih, bhh)
+                            if v is not None:
+                                h2 = v * h2 + (1 - v) * h_
+                                c2 = v * c2 + (1 - v) * c_
+                            return (h2, c2), h2
+                        if valid is None:
+                            (hT, cT), outs = jax.lax.scan(
+                                lambda c, xt: body(c, (xt, None)), (h0, c0), seq)
+                        else:
+                            (hT, cT), outs = jax.lax.scan(body, (h0, c0), (seq, valid))
+                        c_outs.append(cT)
+                    else:
+                        def body(carry, xt_v):
+                            xt, v = xt_v
+                            h2 = step(xt, carry, wih, whh, bih, bhh)
+                            if v is not None:
+                                h2 = v * h2 + (1 - v) * carry
+                            return h2, h2
+                        if valid is None:
+                            hT, outs = jax.lax.scan(
+                                lambda c, xt: body(c, (xt, None)), h0, seq)
+                        else:
+                            hT, outs = jax.lax.scan(body, h0, (seq, valid))
+                    h_outs.append(hT)
+                    if d == 1:
+                        outs = jnp.flip(outs, axis=0)
+                    dir_outs.append(outs)
+                inp = jnp.concatenate(dir_outs, axis=-1) if nd == 2 else dir_outs[0]
+            out = inp if time_major else jnp.swapaxes(inp, 0, 1)
+            h_stack = jnp.stack(h_outs)
+            if is_lstm:
+                return out, h_stack, jnp.stack(c_outs)
+            return out, h_stack
+
+        res = apply_op(self.MODE.lower(), impl, (inputs, *params, *extra), {})
+        if is_lstm:
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        self.MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)

@@ -1,1 +1,5 @@
-"""Placeholder — populated in a later milestone this round."""
+"""paddle.optimizer surface (reference: python/paddle/optimizer/)."""
+from .optimizer import Optimizer
+from .optimizers import (SGD, Momentum, Adam, AdamW, Adagrad, RMSProp,
+                         Adadelta, Adamax, Lamb)
+from . import lr

@@ -1,0 +1,139 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py:128).
+
+Updates are pure per-param jnp functions jitted once and cached by XLA per
+(shape, dtype) — the TPU equivalent of the reference's fused multi-tensor
+CUDA paths (`_apply_optimize`, optimizer.py:1613). Master weights
+(multi_precision) keep fp32 copies for bf16/fp16 params — same contract as
+the reference's master-weight machinery in amp O2.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import autograd as ag
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided (dygraph mode)")
+        self._parameter_list = list(parameters)
+        self._lr = learning_rate
+        self._lr_scheduler = learning_rate if isinstance(learning_rate, LRScheduler) else None
+        self.regularization = weight_decay
+        if weight_decay is None:
+            self._weight_decay = 0.0
+        elif isinstance(weight_decay, (int, float)) and not isinstance(weight_decay, bool):
+            self._weight_decay = float(weight_decay)
+        else:  # L2Decay-style object with a .coeff
+            self._weight_decay = float(getattr(weight_decay, "coeff", 0.0))
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        # state: dict param-id -> dict of arrays
+        self._accumulators = {}
+        self._step_count = 0
+
+    # -- lr --------------------------------------------------------------
+    def get_lr(self):
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if self._lr_scheduler is not None:
+            raise RuntimeError("can't set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr_scheduler = scheduler
+
+    # -- state -----------------------------------------------------------
+    def _state(self, p):
+        st = self._accumulators.get(id(p))
+        if st is None:
+            st = self._create_state(p)
+            if self._multi_precision and p.dtype in (jnp.bfloat16, jnp.float16):
+                st["master"] = p.data.astype(jnp.float32)
+            self._accumulators[id(p)] = st
+        return st
+
+    def _create_state(self, p):
+        return {}
+
+    def state_dict(self):
+        out = {"@step": self._step_count}
+        if self._lr_scheduler is not None:
+            out["@lr"] = self._lr_scheduler.state_dict()
+        for i, p in enumerate(self._parameter_list):
+            st = self._accumulators.get(id(p))
+            if st:
+                key = p.name or f"param_{i}"
+                for k, v in st.items():
+                    out[f"{key}.{k}"] = Tensor(v)
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("@step", 0))
+        if self._lr_scheduler is not None and "@lr" in state:
+            self._lr_scheduler.set_state_dict(state["@lr"])
+        for i, p in enumerate(self._parameter_list):
+            key = p.name or f"param_{i}"
+            st = self._state(p)
+            for k in list(st.keys()):
+                full = f"{key}.{k}"
+                if full in state:
+                    v = state[full]
+                    st[k] = v.data if isinstance(v, Tensor) else jnp.asarray(v)
+
+    # -- stepping ----------------------------------------------------------
+    def _params_grads(self):
+        out = []
+        for p in self._parameter_list:
+            if p.grad is not None and p.trainable:
+                out.append((p, p.grad))
+        return out
+
+    @ag.no_grad()
+    def step(self):
+        params_grads = self._params_grads()
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        lr = self.get_lr()
+        for p, g in params_grads:
+            lr_p = lr * p.optimize_attr.get("learning_rate", 1.0)
+            st = self._state(p)
+            self._apply_one(p, g.data, st, lr_p)
+
+    def _apply_one(self, p, g, st, lr):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, self._params_grads()
+
+    # decoupled helper: L2 "weight_decay" for the SGD family folds into grads
+    def _l2(self, p, g, st):
+        if self._weight_decay:
+            master = st.get("master")
+            base = master if master is not None else p.data
+            return g.astype(jnp.float32) + self._weight_decay * base.astype(jnp.float32)
+        return g
+
+    def _write_back(self, p, st, new_master_or_param):
+        if "master" in st:
+            st["master"] = new_master_or_param
+            p._data = new_master_or_param.astype(p.dtype)
+        else:
+            p._data = new_master_or_param.astype(p.dtype)

@@ -1,0 +1,307 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,adam,
+adamw,adagrad,rmsprop,adadelta,lamb,adamax}.py). Each per-param update is a
+pure jitted function; XLA fuses the whole update into one kernel per param."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+@jax.jit
+def _sgd_update(p, g, lr):
+    return p - lr * g
+
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def _momentum_update(p, g, vel, lr, mu, use_nesterov):
+    v2 = mu * vel + g
+    step = (g + mu * v2) if use_nesterov else v2
+    return p - lr * step, v2
+
+
+@jax.jit
+def _adam_update(p, g, m, v, lr, b1, b2, eps, t):
+    g = g.astype(m.dtype)
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * (g * g)
+    mhat = m2 / (1 - b1 ** t)
+    vhat = v2 / (1 - b2 ** t)
+    return p - lr * mhat / (jnp.sqrt(vhat) + eps), m2, v2
+
+
+@jax.jit
+def _adamw_update(p, g, m, v, lr, b1, b2, eps, t, wd):
+    g = g.astype(m.dtype)
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * (g * g)
+    mhat = m2 / (1 - b1 ** t)
+    vhat = v2 / (1 - b2 ** t)
+    return p * (1 - lr * wd) - lr * mhat / (jnp.sqrt(vhat) + eps), m2, v2
+
+
+@jax.jit
+def _adagrad_update(p, g, acc, lr, eps):
+    acc2 = acc + g * g
+    return p - lr * g / (jnp.sqrt(acc2) + eps), acc2
+
+
+@functools.partial(jax.jit, static_argnums=(8,))
+def _rmsprop_update(p, g, mean_sq, mom, lr, rho, eps, momentum, centered, mean_g):
+    ms2 = rho * mean_sq + (1 - rho) * g * g
+    if centered:
+        mg2 = rho * mean_g + (1 - rho) * g
+        denom = jnp.sqrt(ms2 - mg2 * mg2 + eps)
+    else:
+        mg2 = mean_g
+        denom = jnp.sqrt(ms2 + eps)
+    mom2 = momentum * mom + lr * g / denom
+    return p - mom2, ms2, mom2, mg2
+
+
+@jax.jit
+def _adadelta_update(p, g, avg_sq, avg_dx, lr, rho, eps):
+    avg_sq2 = rho * avg_sq + (1 - rho) * g * g
+    dx = jnp.sqrt(avg_dx + eps) / jnp.sqrt(avg_sq2 + eps) * g
+    avg_dx2 = rho * avg_dx + (1 - rho) * dx * dx
+    return p - lr * dx, avg_sq2, avg_dx2
+
+
+@jax.jit
+def _adamax_update(p, g, m, u, lr, b1, b2, eps, t):
+    m2 = b1 * m + (1 - b1) * g
+    u2 = jnp.maximum(b2 * u, jnp.abs(g))
+    return p - lr / (1 - b1 ** t) * m2 / (u2 + eps), m2, u2
+
+
+@jax.jit
+def _lamb_update(p, g, m, v, lr, b1, b2, eps, t, wd):
+    g = g.astype(m.dtype)
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g * g
+    mhat = m2 / (1 - b1 ** t)
+    vhat = v2 / (1 - b2 ** t)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    p_norm = jnp.linalg.norm(p)
+    r_norm = jnp.linalg.norm(r)
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    return p - lr * trust * r, m2, v2
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _apply_one(self, p, g, st, lr):
+        g = self._l2(p, g, st)
+        base = st.get("master", p.data)
+        new = _sgd_update(base.astype(jnp.float32) if "master" in st else base,
+                          g.astype(base.dtype) if "master" not in st else g,
+                          jnp.float32(lr))
+        self._write_back(p, st, new)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_state(self, p):
+        return {"velocity": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _apply_one(self, p, g, st, lr):
+        g = self._l2(p, g, st).astype(jnp.float32)
+        base = st.get("master", p.data.astype(jnp.float32))
+        new, st["velocity"] = _momentum_update(
+            base, g, st["velocity"], jnp.float32(lr),
+            jnp.float32(self._momentum), self._use_nesterov)
+        self._write_back(p, st, new)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_state(self, p):
+        return {"moment1": jnp.zeros(p.data.shape, jnp.float32),
+                "moment2": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _apply_one(self, p, g, st, lr):
+        g = self._l2(p, g, st)
+        base = st.get("master", p.data.astype(jnp.float32))
+        new, st["moment1"], st["moment2"] = _adam_update(
+            base, g, st["moment1"], st["moment2"], jnp.float32(lr),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._epsilon), jnp.float32(self._step_count))
+        self._write_back(p, st, new)
+
+
+class AdamW(Optimizer):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py).
+    weight_decay here is the decoupled coefficient, default 0.01."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        if isinstance(weight_decay, (int, float)) and not isinstance(weight_decay, bool):
+            self._wd = float(weight_decay)
+        else:
+            self._wd = float(getattr(weight_decay, "coeff", 0.01))
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _create_state(self, p):
+        return {"moment1": jnp.zeros(p.data.shape, jnp.float32),
+                "moment2": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _apply_one(self, p, g, st, lr):
+        wd = self._wd
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(p.name)):
+            wd = 0.0
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        base = st.get("master", p.data.astype(jnp.float32))
+        new, st["moment1"], st["moment2"] = _adamw_update(
+            base, g, st["moment1"], st["moment2"], jnp.float32(lr),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._epsilon), jnp.float32(self._step_count),
+            jnp.float32(wd))
+        self._write_back(p, st, new)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_state(self, p):
+        return {"moment": jnp.full(p.data.shape, self._init_acc, jnp.float32)}
+
+    def _apply_one(self, p, g, st, lr):
+        g = self._l2(p, g, st).astype(jnp.float32)
+        base = st.get("master", p.data.astype(jnp.float32))
+        new, st["moment"] = _adagrad_update(base, g, st["moment"],
+                                            jnp.float32(lr),
+                                            jnp.float32(self._epsilon))
+        self._write_back(p, st, new)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_state(self, p):
+        return {"mean_square": jnp.zeros(p.data.shape, jnp.float32),
+                "momentum_acc": jnp.zeros(p.data.shape, jnp.float32),
+                "mean_grad": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _apply_one(self, p, g, st, lr):
+        g = self._l2(p, g, st).astype(jnp.float32)
+        base = st.get("master", p.data.astype(jnp.float32))
+        new, st["mean_square"], st["momentum_acc"], st["mean_grad"] = \
+            _rmsprop_update(base, g, st["mean_square"], st["momentum_acc"],
+                            jnp.float32(lr), jnp.float32(self._rho),
+                            jnp.float32(self._epsilon),
+                            jnp.float32(self._momentum), self._centered,
+                            st["mean_grad"])
+        self._write_back(p, st, new)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _create_state(self, p):
+        return {"avg_squared_grad": jnp.zeros(p.data.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _apply_one(self, p, g, st, lr):
+        g = self._l2(p, g, st).astype(jnp.float32)
+        base = st.get("master", p.data.astype(jnp.float32))
+        new, st["avg_squared_grad"], st["avg_squared_update"] = \
+            _adadelta_update(base, g, st["avg_squared_grad"],
+                             st["avg_squared_update"], jnp.float32(lr),
+                             jnp.float32(self._rho), jnp.float32(self._epsilon))
+        self._write_back(p, st, new)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_state(self, p):
+        return {"moment": jnp.zeros(p.data.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _apply_one(self, p, g, st, lr):
+        g = self._l2(p, g, st).astype(jnp.float32)
+        base = st.get("master", p.data.astype(jnp.float32))
+        new, st["moment"], st["inf_norm"] = _adamax_update(
+            base, g, st["moment"], st["inf_norm"], jnp.float32(lr),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._epsilon), jnp.float32(self._step_count))
+        self._write_back(p, st, new)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _create_state(self, p):
+        return {"moment1": jnp.zeros(p.data.shape, jnp.float32),
+                "moment2": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _apply_one(self, p, g, st, lr):
+        wd = self._wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        g = g.astype(jnp.float32)
+        base = st.get("master", p.data.astype(jnp.float32))
+        new, st["moment1"], st["moment2"] = _lamb_update(
+            base, g, st["moment1"], st["moment2"], jnp.float32(lr),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._epsilon), jnp.float32(self._step_count),
+            jnp.float32(wd))
+        self._write_back(p, st, new)

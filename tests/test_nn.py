@@ -1,0 +1,391 @@
+"""nn.Layer + layers + functional tests (reference patterns:
+test/legacy_test/test_layers.py, per-layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLayerBase:
+    def test_parameter_registration(self):
+        l = nn.Linear(3, 4)
+        assert len(l.parameters()) == 2
+        names = dict(l.named_parameters())
+        assert "weight" in names and "bias" in names
+
+    def test_sublayer_traversal(self):
+        net = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+        assert len(net.parameters()) == 4
+        assert len(list(net.named_sublayers())) == 3
+        assert len(list(net.children())) == 3
+
+    def test_train_eval_propagation(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_state_dict_roundtrip(self):
+        l1 = nn.Linear(3, 3)
+        l2 = nn.Linear(3, 3)
+        missing, unexpected = l2.set_state_dict(l1.state_dict())
+        assert not missing and not unexpected
+        np.testing.assert_array_equal(l1.weight.numpy(), l2.weight.numpy())
+
+    def test_buffers_in_state_dict(self):
+        bn = nn.BatchNorm1D(4)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+    def test_forward_hooks(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        h1 = l.register_forward_pre_hook(lambda layer, inp: calls.append("pre"))
+        h2 = l.register_forward_post_hook(lambda layer, inp, out: calls.append("post"))
+        l(paddle.rand([1, 2]))
+        assert calls == ["pre", "post"]
+        h1.remove(); h2.remove()
+        l(paddle.rand([1, 2]))
+        assert calls == ["pre", "post"]
+
+    def test_apply_and_to_dtype(self):
+        net = nn.Linear(2, 2)
+        net.to(dtype="bfloat16")
+        assert net.weight.dtype == paddle.core.dtypes.convert_dtype("bfloat16")
+
+    def test_layerlist_parameterlist(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4 and len(ll.parameters()) == 8
+        pl = nn.ParameterList([paddle.Parameter(np.ones((2, 2), np.float32))])
+        assert len(pl.parameters()) == 1
+
+
+class TestLayersForward:
+    def test_linear_shapes(self):
+        l = nn.Linear(8, 3)
+        assert l(paddle.rand([4, 8])).shape == [4, 3]
+        assert l(paddle.rand([2, 5, 8])).shape == [2, 5, 3]
+
+    def test_conv2d_vs_manual(self, rng):
+        conv = nn.Conv2D(1, 1, 3, bias_attr=False)
+        w = np.ones((1, 1, 3, 3), np.float32)
+        conv.weight.set_value(w)
+        x = np.ones((1, 1, 5, 5), np.float32)
+        out = conv(paddle.to_tensor(x))
+        assert out.shape == [1, 1, 3, 3]
+        np.testing.assert_allclose(out.numpy(), np.full((1, 1, 3, 3), 9.0))
+
+    def test_conv2d_stride_padding_groups(self):
+        conv = nn.Conv2D(4, 8, 3, stride=2, padding=1, groups=2)
+        out = conv(paddle.rand([2, 4, 8, 8]))
+        assert out.shape == [2, 8, 4, 4]
+
+    def test_conv2d_transpose(self):
+        deconv = nn.Conv2DTranspose(3, 6, 4, stride=2, padding=1)
+        out = deconv(paddle.rand([1, 3, 8, 8]))
+        assert out.shape == [1, 6, 16, 16]
+
+    def test_pools(self):
+        x = paddle.rand([1, 2, 8, 8])
+        assert nn.MaxPool2D(2)(x).shape == [1, 2, 4, 4]
+        assert nn.AvgPool2D(2, stride=2)(x).shape == [1, 2, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+        np.testing.assert_allclose(
+            nn.AdaptiveAvgPool2D(1)(x).numpy().ravel(),
+            x.numpy().mean(axis=(2, 3)).ravel(), rtol=1e-5)
+
+    def test_maxpool_matches_numpy(self, rng):
+        x = rng.standard_normal((1, 1, 4, 4)).astype(np.float32)
+        got = nn.MaxPool2D(2)(paddle.to_tensor(x)).numpy()
+        want = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(got, want)
+
+    def test_batchnorm_train_vs_eval(self, rng):
+        bn = nn.BatchNorm1D(4)
+        x = paddle.to_tensor(rng.standard_normal((16, 4)).astype(np.float32) * 3 + 1)
+        out = bn(x)
+        np.testing.assert_allclose(out.numpy().mean(axis=0), np.zeros(4), atol=1e-5)
+        np.testing.assert_allclose(out.numpy().std(axis=0), np.ones(4), atol=1e-2)
+        # running stats moved toward batch stats
+        assert abs(bn._mean.numpy().mean()) > 0
+        bn.eval()
+        out2 = bn(x)
+        assert not np.allclose(out2.numpy(), out.numpy())
+
+    def test_layernorm(self, rng):
+        ln = nn.LayerNorm(8)
+        x = paddle.to_tensor(rng.standard_normal((2, 8)).astype(np.float32) * 5)
+        out = ln(x).numpy()
+        np.testing.assert_allclose(out.mean(-1), np.zeros((2,)), atol=1e-5)
+
+    def test_rmsnorm(self, rng):
+        rn = nn.RMSNorm(8)
+        x = rng.standard_normal((2, 8)).astype(np.float32)
+        out = rn(paddle.to_tensor(x)).numpy()
+        want = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, want, rtol=1e-4)
+
+    def test_groupnorm_instancenorm(self):
+        x = paddle.rand([2, 4, 5, 5])
+        assert nn.GroupNorm(2, 4)(x).shape == [2, 4, 5, 5]
+        assert nn.InstanceNorm2D(4)(x).shape == [2, 4, 5, 5]
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor([[0, 1, 2]]))
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_array_equal(out.numpy()[0, 0], np.zeros(4))
+
+    def test_dropout_modes(self):
+        x = paddle.ones([1000])
+        d = nn.Dropout(0.5)
+        out = d(x)
+        kept = out.numpy() != 0
+        assert 0.3 < kept.mean() < 0.7
+        np.testing.assert_allclose(out.numpy()[kept], 2.0)  # upscale_in_train
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+    def test_flatten_identity(self):
+        x = paddle.rand([2, 3, 4])
+        assert nn.Flatten()(x).shape == [2, 12]
+        assert nn.Identity()(x).shape == [2, 3, 4]
+
+    def test_lstm_gru(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        out, (h, c) = lstm(paddle.rand([2, 5, 4]))
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [2, 2, 8] and c.shape == [2, 2, 8]
+        gru = nn.GRU(4, 8, direction="bidirect")
+        out, h = gru(paddle.rand([2, 5, 4]))
+        assert out.shape == [2, 5, 16]
+
+    def test_lstm_grad_flows(self):
+        lstm = nn.LSTM(3, 4)
+        out, _ = lstm(paddle.rand([1, 4, 3]))
+        out.sum().backward()
+        assert lstm._parameters["weight_ih_l0"].grad is not None
+
+    def test_multihead_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.rand([2, 6, 16])
+        assert mha(x).shape == [2, 6, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        out = enc(paddle.rand([2, 5, 16]))
+        assert out.shape == [2, 5, 16]
+        # distinct layers (deepcopy) - params differ in identity
+        p0 = enc.layers[0].linear1.weight
+        p1 = enc.layers[1].linear1.weight
+        assert p0 is not p1
+
+
+class TestFunctional:
+    def test_activations_numerics(self, rng):
+        x = rng.standard_normal((5,)).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(F.leaky_relu(t, 0.1).numpy(),
+                                   np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+        np.testing.assert_allclose(
+            F.softmax(t).numpy(), np.exp(x) / np.exp(x).sum(), rtol=1e-5)
+        np.testing.assert_allclose(F.hardswish(t).numpy(),
+                                   x * np.clip(x + 3, 0, 6) / 6, rtol=1e-5)
+
+    def test_cross_entropy_matches_manual(self, rng):
+        logits = rng.standard_normal((4, 5)).astype(np.float32)
+        labels = np.array([0, 2, 4, 1])
+        got = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels)).item()
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        want = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self, rng):
+        logits = rng.standard_normal((4, 5)).astype(np.float32)
+        labels = np.array([0, -100, 4, -100])
+        got = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                              ignore_index=-100).item()
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        want = -np.log(p[[0, 2], [0, 4]]).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self, rng):
+        logits = rng.standard_normal((3, 4)).astype(np.float32)
+        soft = np.abs(rng.standard_normal((3, 4))).astype(np.float32)
+        soft /= soft.sum(-1, keepdims=True)
+        got = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft),
+                              soft_label=True).item()
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        want = -(soft * logp).sum(-1).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_bce_with_logits_stable(self):
+        z = paddle.to_tensor([100.0, -100.0])
+        y = paddle.to_tensor([1.0, 0.0])
+        loss = F.binary_cross_entropy_with_logits(z, y).item()
+        assert np.isfinite(loss) and loss < 1e-6
+
+    def test_losses_reduce_modes(self, rng):
+        a = paddle.to_tensor(rng.standard_normal((3, 2)).astype(np.float32))
+        b = paddle.to_tensor(rng.standard_normal((3, 2)).astype(np.float32))
+        assert F.mse_loss(a, b, reduction="none").shape == [3, 2]
+        np.testing.assert_allclose(F.mse_loss(a, b, reduction="sum").item(),
+                                   ((a.numpy() - b.numpy()) ** 2).sum(), rtol=1e-5)
+
+    def test_kl_div(self, rng):
+        logp = np.log(np.array([[0.3, 0.7]], np.float32))
+        tgt = np.array([[0.5, 0.5]], np.float32)
+        got = F.kl_div(paddle.to_tensor(logp), paddle.to_tensor(tgt),
+                       reduction="sum").item()
+        want = (tgt * (np.log(tgt) - logp)).sum()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_linear_grad(self, rng):
+        from op_test import check_grad
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        w = rng.standard_normal((4, 2)).astype(np.float32)
+        b = rng.standard_normal((2,)).astype(np.float32)
+        check_grad(F.linear, [x, w, b], wrt=1)
+
+    def test_conv2d_grad(self, rng):
+        from op_test import check_grad
+        x = rng.standard_normal((1, 2, 5, 5)).astype(np.float32)
+        w = rng.standard_normal((3, 2, 3, 3)).astype(np.float32)
+        check_grad(lambda a, b: F.conv2d(a, b), [x, w], wrt=1, rtol=2e-2)
+
+    def test_sdpa_matches_manual(self, rng):
+        q = rng.standard_normal((1, 3, 2, 4)).astype(np.float32)
+        k = rng.standard_normal((1, 3, 2, 4)).astype(np.float32)
+        v = rng.standard_normal((1, 3, 2, 4)).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v)).numpy()
+        # manual per-head
+        for h in range(2):
+            qs, ks, vs = q[0, :, h], k[0, :, h], v[0, :, h]
+            logits = qs @ ks.T / np.sqrt(4)
+            p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+            np.testing.assert_allclose(out[0, :, h], p @ vs, rtol=1e-4, atol=1e-5)
+
+    def test_causal_attention_masks_future(self, rng):
+        q = rng.standard_normal((1, 4, 1, 8)).astype(np.float32)
+        k = rng.standard_normal((1, 4, 1, 8)).astype(np.float32)
+        v = rng.standard_normal((1, 4, 1, 8)).astype(np.float32)
+        out, _ = F.flash_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                   paddle.to_tensor(v), causal=True)
+        # first position attends only to itself
+        np.testing.assert_allclose(out.numpy()[0, 0, 0], v[0, 0, 0], rtol=1e-5)
+
+    def test_interpolate(self):
+        x = paddle.rand([1, 1, 4, 4])
+        assert F.interpolate(x, scale_factor=2, mode="nearest").shape == [1, 1, 8, 8]
+        assert F.interpolate(x, size=(2, 2), mode="bilinear").shape == [1, 1, 2, 2]
+
+    def test_grad_clip_global_norm(self):
+        p1 = paddle.Parameter(np.zeros((2,), np.float32))
+        p2 = paddle.Parameter(np.zeros((2,), np.float32))
+        g1 = paddle.to_tensor([3.0, 0.0])
+        g2 = paddle.to_tensor([0.0, 4.0])
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip([(p1, g1), (p2, g2)])
+        total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+class TestInitializers:
+    def test_constant_and_assign(self):
+        from paddle_tpu.nn import initializer as I
+        l = nn.Linear(2, 3, weight_attr=nn.ParamAttr(initializer=I.Constant(0.5)))
+        np.testing.assert_array_equal(l.weight.numpy(), np.full((2, 3), 0.5))
+        l2 = nn.Linear(2, 2, weight_attr=nn.ParamAttr(
+            initializer=I.Assign(np.eye(2, dtype=np.float32))))
+        np.testing.assert_array_equal(l2.weight.numpy(), np.eye(2))
+
+    def test_xavier_statistics(self):
+        from paddle_tpu.nn import initializer as I
+        w = I.XavierNormal()((200, 300), np.float32)
+        std = float(np.asarray(w).std())
+        expect = np.sqrt(2.0 / 500)
+        assert abs(std - expect) / expect < 0.1
+
+
+class TestReviewRegressions:
+    """Regression tests for code-review findings on the M1 milestone."""
+
+    def test_decoder_cache_per_layer(self):
+        layer = nn.TransformerDecoderLayer(8, 2, 16, dropout=0.0)
+        dec = nn.TransformerDecoder(layer, 2)
+        cache = dec.gen_cache()
+        assert len(cache) == 2
+        tgt = paddle.rand([1, 1, 8]); mem = paddle.rand([1, 3, 8])
+        dec(tgt, mem, cache=cache)
+        dec(tgt, mem, cache=cache)
+        # each layer's cache grew independently to 2 positions
+        assert cache[0]["k"].shape[1] == 2 and cache[1]["k"].shape[1] == 2
+        with pytest.raises(TypeError, match="per-layer"):
+            dec(tgt, mem, cache={})
+
+    def test_lstm_initial_states_used(self):
+        lstm = nn.LSTM(2, 3)
+        x = paddle.rand([1, 4, 2])
+        h0 = paddle.ones([1, 1, 3]) * 5.0
+        c0 = paddle.ones([1, 1, 3]) * 5.0
+        out0, _ = lstm(x)
+        out1, _ = lstm(x, initial_states=(h0, c0))
+        assert not np.allclose(out0.numpy(), out1.numpy())
+
+    def test_lstm_sequence_length_masks_pads(self):
+        lstm = nn.LSTM(2, 3)
+        x = np.random.RandomState(0).randn(2, 5, 2).astype(np.float32)
+        x_masked = x.copy(); x_masked[0, 3:] = 99.0  # garbage in pad region
+        seq_len = paddle.to_tensor(np.array([3, 5]))
+        _, (h1, _) = lstm(paddle.to_tensor(x), sequence_length=seq_len)
+        _, (h2, _) = lstm(paddle.to_tensor(x_masked), sequence_length=seq_len)
+        np.testing.assert_allclose(h1.numpy(), h2.numpy(), rtol=1e-5)
+
+    def test_adamw_int_zero_weight_decay(self):
+        import paddle_tpu.optimizer as opt
+        p = paddle.Parameter(np.array([1.0], np.float32))
+        optim = opt.AdamW(learning_rate=0.1, weight_decay=0, parameters=[p],
+                          beta1=0.0, beta2=0.0)
+        (p * 0.0).sum().backward()
+        optim.step()
+        np.testing.assert_allclose(p.numpy(), [1.0])  # no decay applied
+
+    def test_conv_transpose_output_size(self):
+        deconv = nn.Conv2DTranspose(1, 1, 3, stride=2, padding=1)
+        x = paddle.rand([1, 1, 4, 4])
+        assert deconv(x).shape == [1, 1, 7, 7]
+        assert deconv(x, output_size=[8, 8]).shape == [1, 1, 8, 8]
+        with pytest.raises(ValueError, match="not reachable"):
+            deconv(x, output_size=[20, 20])
+
+    def test_ceil_mode_pooling(self):
+        x = paddle.rand([1, 1, 6, 6])
+        assert F.max_pool2d(x, 3, stride=2, ceil_mode=True).shape == [1, 1, 3, 3]
+        assert F.max_pool2d(x, 3, stride=2, ceil_mode=False).shape == [1, 1, 2, 2]
+
+    def test_avg_pool1d_exclusive_edges(self):
+        x = paddle.ones([1, 1, 4])
+        out = F.avg_pool1d(x, 3, stride=1, padding=1)  # exclusive=True default
+        np.testing.assert_allclose(out.numpy()[0, 0], [1.0, 1.0, 1.0, 1.0])
+
+    def test_hook_key_no_reuse(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        l.register_forward_pre_hook(lambda m, i: calls.append("a"))
+        h2 = l.register_forward_pre_hook(lambda m, i: calls.append("b"))
+        h2.remove()
+        l.register_forward_pre_hook(lambda m, i: calls.append("c"))
+        l(paddle.rand([1, 2]))
+        assert calls == ["a", "c"]
+
+    def test_activation_layer_name_kwarg(self):
+        out = nn.ReLU(name="act")(paddle.to_tensor([-1.0, 1.0]))
+        assert out.numpy().tolist() == [0.0, 1.0]
