@@ -1,1 +1,4 @@
-"""Placeholder — populated in a later milestone this round."""
+"""paddle.autograd surface (reference: python/paddle/autograd/)."""
+from ..core.autograd import backward, grad, no_grad, enable_grad
+from .py_layer import PyLayer, PyLayerContext
+from .functional import jacobian, hessian, vjp, jvp

@@ -1,0 +1,85 @@
+"""Functional higher-order autodiff (reference: python/paddle/incubate/
+autograd/functional.py — jacobian/hessian/vjp/jvp). Here these are direct
+jax transforms over functionalized inputs — higher-order comes free from XLA
+autodiff rather than generated double-grad nodes."""
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x.data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _wrap(x):
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap(v) for v in x)
+    return Tensor(x) if not isinstance(x, Tensor) else x
+
+
+def _functionalize(func):
+    def f(*arrays):
+        out = func(*[Tensor(a) for a in arrays])
+        if isinstance(out, (tuple, list)):
+            return tuple(o.data for o in out)
+        return out.data
+    return f
+
+
+def vjp(func, xs, v=None):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    f = _functionalize(func)
+    out, vjp_fn = jax.vjp(f, *[x.data for x in xs])
+    if v is None:
+        seed = jnp.ones_like(out) if not isinstance(out, tuple) else \
+            tuple(jnp.ones_like(o) for o in out)
+    else:
+        seed = _unwrap(v)
+        # normalize the cotangent container to match the primal output's
+        # structure (paddle documents v as a list; jax requires exact treedef)
+        if isinstance(out, tuple):
+            if not isinstance(seed, (list, tuple)):
+                seed = (seed,)
+            seed = tuple(seed)
+        elif isinstance(seed, (list, tuple)):
+            seed = seed[0]
+    grads = vjp_fn(seed)
+    return _wrap(out), _wrap(list(grads))
+
+
+def jvp(func, xs, v=None):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    f = _functionalize(func)
+    primals = [x.data for x in xs]
+    tangents = _unwrap(v) if v is not None else [jnp.ones_like(p) for p in primals]
+    if not isinstance(tangents, (list, tuple)):
+        tangents = [tangents]
+    out, jv = jax.jvp(f, tuple(primals), tuple(tangents))
+    return _wrap(out), _wrap(jv)
+
+
+def jacobian(func, xs, is_batched=False):
+    single = not isinstance(xs, (list, tuple))
+    xs_l = [xs] if single else list(xs)
+    f = _functionalize(func)
+    jac = jax.jacrev(f, argnums=tuple(range(len(xs_l))))(
+        *[x.data for x in xs_l])
+    if single:
+        jac = jac[0]
+    return _wrap(jac)
+
+
+def hessian(func, xs, is_batched=False):
+    single = not isinstance(xs, (list, tuple))
+    xs_l = [xs] if single else list(xs)
+    f = _functionalize(func)
+    hes = jax.hessian(f, argnums=tuple(range(len(xs_l))))(
+        *[x.data for x in xs_l])
+    if single:
+        hes = hes[0][0]
+    return _wrap(hes)

@@ -1,0 +1,79 @@
+"""PyLayer: user-defined autograd functions (reference:
+python/paddle/autograd/py_layer.py + paddle/fluid/eager/pylayer/).
+
+The forward runs eagerly; a GradNode is recorded whose vjp calls the user's
+static backward. This is the one place user python runs inside the backward
+walk (everything else is jax.vjp closures)."""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import autograd as ag
+from ..core.autograd import GradNode
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+
+class PyLayer:
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        record = ag.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_args)
+        with ag._GradModeGuard(False):
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        if not record:
+            return out
+
+        diff_parents = [t for t in tensor_args if not t.stop_gradient]
+
+        def vjp_fn(cotangents):
+            couts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            wrapped = [Tensor(c) for c in couts]
+            with ag._GradModeGuard(False):
+                grads = cls.backward(ctx, *wrapped)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            # paddle contract: backward returns one grad per forward Tensor
+            # input, in order; pick out the ones for differentiable parents
+            grads_by_tensor = dict(zip((id(t) for t in tensor_args), grads))
+            flat = []
+            for t in diff_parents:
+                g = grads_by_tensor.get(id(t))
+                if g is None:
+                    flat.append(jnp.zeros_like(t.data))
+                else:
+                    flat.append(g.data if isinstance(g, Tensor) else g)
+            return tuple(flat)
+
+        node = GradNode(cls.__name__, vjp_fn, diff_parents,
+                        [(o.data.shape, o.data.dtype) for o in outs])
+        for i, o in enumerate(outs):
+            o._node = node
+            o._out_idx = i
+            o.stop_gradient = False
+        return out
+
+
+def once_differentiable(fn):
+    return fn

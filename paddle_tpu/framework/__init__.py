@@ -1,11 +1,61 @@
-"""Placeholder — populated at M2 (save/load, default dtype)."""
+"""paddle.framework-level utilities: save/load (reference:
+python/paddle/framework/io.py:773,1020) and default dtype."""
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
 _default_dtype = "float32"
+
+
 def set_default_dtype(d):
     global _default_dtype
-    _default_dtype = d
+    _default_dtype = d if isinstance(d, str) else np.dtype(d).name
+
+
 def get_default_dtype():
     return _default_dtype
-def save(obj, path, **kw):
-    raise NotImplementedError
-def load(path, **kw):
-    raise NotImplementedError
+
+
+def _to_storable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj.data),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_storable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_storable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name")
+            return t
+        return {k: _from_storable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_storable(v, return_numpy) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save: state_dicts / nested structures of Tensors."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_storable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_storable(obj, return_numpy=return_numpy)

@@ -1,4 +1,4 @@
-"""Placeholder — populated at M2."""
-Model = None
-def summary(*a, **k):
-    raise NotImplementedError
+"""paddle hapi (reference: python/paddle/hapi/)."""
+from .model import Model
+from .summary import summary
+from . import callbacks

@@ -1,0 +1,211 @@
+"""hapi Model (reference: python/paddle/hapi/model.py:1472,2200 — Keras-like
+fit/evaluate/predict + callbacks)."""
+import time
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import autograd as ag
+from ..io import DataLoader
+from .callbacks import CallbackList, ProgBarLogger
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _update_metric(metric, out, label):
+    """Metric.compute may return a single array or a tuple of update() args
+    (the base Metric.compute passes through (pred, label))."""
+    res = metric.compute(out, label)
+    if isinstance(res, tuple):
+        metric.update(*res)
+    else:
+        metric.update(res)
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._jit = False
+        self._train_fn = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, jit=False,
+                amp_configs=None):
+        """jit=True compiles forward+loss into one XLA program per signature
+        (to_static over the loss graph). Leave False for models whose layers
+        mutate host state in forward (BatchNorm running stats)."""
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _as_list(metrics)
+        self._jit = jit
+        if jit:
+            from ..jit import to_static
+            network = self.network
+            loss_fn = loss
+
+            def fwd_loss(x, y):
+                out = network(x)
+                return loss_fn(out, y), out
+            self._train_fn = to_static(fwd_loss)
+        return self
+
+    # -- single steps -----------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        y = labels[0] if isinstance(labels, (list, tuple)) else labels
+        if self._train_fn is not None:
+            loss, out = self._train_fn(x, y)
+        else:
+            out = self.network(x)
+            loss = self._loss(out, y)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        for m in self._metrics:
+            _update_metric(m, out.detach(), y)
+        return loss
+
+    @ag.no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        y = labels[0] if isinstance(labels, (list, tuple)) else labels
+        out = self.network(x)
+        loss = self._loss(out, y) if self._loss else None
+        for m in self._metrics:
+            _update_metric(m, out, y)
+        return loss, out
+
+    @ag.no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        return self.network(x)
+
+    # -- loops ------------------------------------------------------------
+    def _to_loader(self, data, batch_size, shuffle, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._to_loader(train_data, batch_size, shuffle, num_workers)
+        eval_loader = self._to_loader(eval_data, batch_size, False, num_workers)
+        cbs = CallbackList(_as_list(callbacks) or [ProgBarLogger(log_freq, verbose)])
+        cbs.set_model(self)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbs.on_begin("train", {"epochs": epochs, "steps": steps,
+                               "metrics": self._metric_names()})
+        stop = False
+        for epoch in range(epochs):
+            if stop:
+                break
+            cbs.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbs.on_batch_begin("train", step, logs)
+                x, y = batch[0], batch[1] if len(batch) > 1 else None
+                update = ((step + 1) % accumulate_grad_batches == 0)
+                loss = self.train_batch(x, y, update=update)
+                logs = {"loss": float(loss.item()), "step": step}
+                for m in self._metrics:
+                    res = m.accumulate()
+                    names = m.name() if isinstance(m.name(), list) else [m.name()]
+                    vals = res if isinstance(res, list) else [res]
+                    logs.update(dict(zip(names, vals)))
+                cbs.on_batch_end("train", step, logs)
+                if num_iters is not None and step + 1 >= num_iters:
+                    break
+                if getattr(cbs, "stop_training", False):
+                    stop = True
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          num_workers=num_workers)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbs.on_epoch_end(epoch, logs)
+            if getattr(cbs, "stop_training", False):
+                stop = True
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+        cbs.on_end("train", logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._to_loader(eval_data, batch_size, False, num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            x, y = batch[0], batch[1] if len(batch) > 1 else None
+            loss, _ = self.eval_batch(x, y)
+            if loss is not None:
+                losses.append(float(loss.item()))
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = res if isinstance(res, list) else [res]
+            logs.update(dict(zip(names, vals)))
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._to_loader(test_data, batch_size, False, num_workers)
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(x).numpy())
+        if stack_outputs:
+            return [np.concatenate(outs, axis=0)]
+        return [outs]
+
+    def _metric_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    # -- persistence ------------------------------------------------------
+    def save(self, path, training=True):
+        from .. import framework
+        framework.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            framework.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .. import framework
+        state = framework.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(framework.load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)

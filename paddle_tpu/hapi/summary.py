@@ -1,0 +1,48 @@
+"""Model summary (reference: python/paddle/hapi/model_summary.py)."""
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table and return {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+    from ..nn.layer import Layer
+
+    def make_hook(name):
+        def hook(layer, inputs, outputs):
+            n_params = sum(p.size for p in layer._parameters.values()
+                           if p is not None)
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else "-"
+            rows.append((name, type(layer).__name__, shape, n_params))
+        return hook
+
+    for name, sub in net.named_sublayers(include_self=False):
+        if not sub._sub_layers:  # leaves only
+            hooks.append(sub.register_forward_post_hook(make_hook(name)))
+
+    if input is not None:
+        x = input
+        net(x)
+    elif input_size is not None:
+        from .. import ops
+        shape = list(input_size)
+        x = ops.zeros(shape, dtypes or "float32")
+        net(x)
+    for h in hooks:
+        h.remove()
+
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if p.trainable)
+    if rows:
+        w = max(len(r[0]) for r in rows) + 2
+        print(f"{'Layer':<{w}}{'Type':<20}{'Output Shape':<20}{'Params':>10}")
+        print("-" * (w + 50))
+        for name, t, shape, n in rows:
+            print(f"{name:<{w}}{t:<20}{str(shape):<20}{n:>10}")
+        print("-" * (w + 50))
+    print(f"Total params: {total}")
+    print(f"Trainable params: {trainable}")
+    return {"total_params": int(total), "trainable_params": int(trainable)}

@@ -1,1 +1,7 @@
-"""Placeholder — populated in a later milestone this round."""
+"""paddle.io surface (reference: python/paddle/io/)."""
+from .dataset import (Dataset, IterableDataset, TensorDataset, ConcatDataset,
+                      ChainDataset, Subset, random_split)
+from .sampler import (Sampler, SequenceSampler, RandomSampler,
+                      SubsetRandomSampler, WeightedRandomSampler, BatchSampler,
+                      DistributedBatchSampler)
+from .dataloader import DataLoader, default_collate_fn

@@ -1,1 +1,19 @@
-"""Placeholder — populated in a later milestone this round."""
+"""paddle.jit — the trace/compile path.
+
+Reference architecture (SURVEY.md §3.3): to_static → SOT bytecode VM →
+StatementIR → PIR → CINN/NVRTC → PirInterpreter. TPU-native replacement:
+to_static → jax trace → StableHLO → XLA → PJRT executable. The whole
+PIR+CINN+interpreter stack collapses into jax.jit; what remains ours is the
+capture policy and the autograd splice:
+
+A `to_static` function runs as ONE fused op on the eager tape — forward is a
+single compiled XLA program, and `loss.backward()` flows through it via the
+same jax.vjp mechanism every op uses (so eager code around compiled regions
+keeps working, the moral equivalent of the reference's graph-break fallback).
+"""
+from .api import to_static, not_to_static, TracedLayer
+from .functional import state_arrays, functional_call, pure_call
+from .io import save, load
+
+__all__ = ["to_static", "not_to_static", "save", "load", "state_arrays",
+           "functional_call", "pure_call", "TracedLayer"]

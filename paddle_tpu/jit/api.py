@@ -1,0 +1,181 @@
+"""to_static (reference: python/paddle/jit/api.py:197).
+
+Capture policy: re-trace per new (structure, shape, dtype, static-constant)
+signature — the guard role of the reference's SOT guards
+(python/paddle/jit/sot/.../guard.py) is played by jax.jit's signature cache
+plus a per-constant impl cache here. Python control flow is evaluated at
+trace time (same as the reference's AST path); data-dependent branching needs
+lax.cond / explicit eager fallback, which mirrors the reference's graph-break
+semantics.
+"""
+import functools
+
+import numpy as np
+import jax
+from jax.tree_util import tree_flatten, tree_unflatten
+
+from ..core.tensor import Tensor
+from ..core import autograd as ag
+from ..core.dispatch import apply_op
+from ..nn.layer import Layer
+
+_NOT_TO_STATIC = set()
+
+
+def not_to_static(fn):
+    """API parity marker: a function marked not_to_static is returned
+    unwrapped by to_static (XLA has no partial-graph execution; the eager
+    fallback is simply not compiling)."""
+    _NOT_TO_STATIC.add(fn)
+    return fn
+
+
+def _collect_layers(fn):
+    layers = []
+    if isinstance(fn, Layer):
+        layers.append(fn)
+    bound_self = getattr(fn, "__self__", None)
+    if isinstance(bound_self, Layer):
+        layers.append(bound_self)
+    closure = getattr(fn, "__closure__", None)
+    if closure:
+        for cell in closure:
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if isinstance(v, Layer) and v not in layers:
+                layers.append(v)
+    return layers
+
+
+def _const_key(leaf):
+    try:
+        hash(leaf)
+        return leaf
+    except TypeError:
+        return (type(leaf).__name__, id(leaf))
+
+
+class StaticFunction:
+    """Callable that runs its function as one compiled XLA program while
+    remaining a differentiable node on the eager tape (see package docstring)."""
+
+    def __init__(self, fn, input_spec=None, build_strategy=None,
+                 backend=None, full_graph=True):
+        self._fn = fn
+        self._layers = _collect_layers(fn)
+        self._name = getattr(fn, "__name__", type(fn).__name__)
+        self._cache = {}  # key -> (jitted_impl, out_treedef_box)
+        functools.update_wrapper(self, fn,
+                                 assigned=("__name__", "__doc__", "__qualname__"),
+                                 updated=())
+
+    @property
+    def layers(self):
+        return list(self._layers)
+
+    def _state_tensors(self):
+        out = []
+        for l in self._layers:
+            for _, p in l.named_parameters():
+                out.append(p)
+            for _, b in l.named_buffers():
+                if isinstance(b, Tensor):
+                    out.append(b)
+        return out
+
+    def _prepare(self, args, kwargs):
+        state = self._state_tensors()
+        leaves, treedef = tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        # numpy arrays become traced inputs too (avoid baking data as consts)
+        leaves = [Tensor(l) if isinstance(l, np.ndarray) else l for l in leaves]
+        tensor_idx = tuple(i for i, l in enumerate(leaves)
+                           if isinstance(l, Tensor))
+        const_sig = tuple((i, _const_key(l)) for i, l in enumerate(leaves)
+                          if i not in set(tensor_idx))
+        # training modes are trace-time constants (Dropout/BN read
+        # self.training) -> they must be part of the compile-cache key
+        mode_sig = tuple(l.training for layer in self._layers
+                         for _, l in layer.named_sublayers(include_self=True))
+        key = (treedef, tensor_idx, len(state), const_sig, mode_sig)
+        cached = self._cache.get(key)
+        if cached is None:
+            fn = self._fn
+            state_tensors = state
+            out_box = {}
+            consts = [None if i in set(tensor_idx) else l
+                      for i, l in enumerate(leaves)]
+
+            def impl(*flat_arrays):
+                state_arrays = flat_arrays[:len(state_tensors)]
+                arg_arrays = flat_arrays[len(state_tensors):]
+                rebuilt = list(consts)
+                for j, i in enumerate(tensor_idx):
+                    rebuilt[i] = Tensor(arg_arrays[j])
+                args2, kwargs2 = tree_unflatten(treedef, rebuilt)
+                from .functional import _swapped
+                with ag._GradModeGuard(False):
+                    with _swapped(state_tensors, list(state_arrays)):
+                        out = fn(*args2, **kwargs2)
+                out_leaves, out_treedef = tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                out_box["treedef"] = out_treedef
+                flat_out = tuple(o.data if isinstance(o, Tensor) else o
+                                 for o in out_leaves)
+                return flat_out if len(flat_out) != 1 else flat_out[0]
+
+            impl.__name__ = f"to_static_{self._name}"
+            # the jit boundary: everything inside is one XLA program
+            cached = (jax.jit(impl), out_box)
+            self._cache[key] = cached
+        impl, out_box = cached
+        call_tensors = tuple(state) + tuple(leaves[i] for i in tensor_idx)
+        return impl, out_box, call_tensors
+
+    def __call__(self, *args, **kwargs):
+        impl, out_box, call_tensors = self._prepare(args, kwargs)
+        out = apply_op(f"to_static[{self._name}]", impl, call_tensors, {})
+        out_leaves = list(out) if isinstance(out, tuple) else [out]
+        treedef = out_box.get("treedef")
+        if treedef is None:
+            return out
+        return tree_unflatten(treedef, out_leaves)
+
+    def concrete_program(self, *args, **kwargs):
+        """Lowered StableHLO text for this signature (role of the reference's
+        PIR program dump; also what jit.save persists)."""
+        impl, _, call_tensors = self._prepare(args, kwargs)
+        flat = [t.data for t in call_tensors]
+        return impl.lower(*flat).as_text(dialect="stablehlo")
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True):
+    """paddle.jit.to_static parity (python/paddle/jit/api.py:197)."""
+    def decorate(fn):
+        if fn in _NOT_TO_STATIC:
+            return fn
+        return StaticFunction(fn, input_spec, build_strategy, backend,
+                              full_graph)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+class TracedLayer:
+    """Minimal dygraph-to-trace capture object (reference:
+    python/paddle/jit/api.py TracedLayer.trace)."""
+
+    def __init__(self, static_fn):
+        self._static_fn = static_fn
+
+    @classmethod
+    def trace(cls, layer, inputs):
+        sf = to_static(layer)
+        out = sf(*inputs)
+        return out, cls(sf)
+
+    def __call__(self, *args):
+        return self._static_fn(*args)

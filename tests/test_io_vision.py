@@ -1,0 +1,197 @@
+"""io + vision + metric + framework save/load tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (DataLoader, Dataset, TensorDataset, ConcatDataset,
+                           Subset, random_split, BatchSampler, RandomSampler,
+                           SequenceSampler, DistributedBatchSampler,
+                           WeightedRandomSampler)
+from paddle_tpu.vision.datasets import MNIST, Cifar10
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.metric import Accuracy, Precision, Recall, Auc
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), int(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+class TestDatasets:
+    def test_tensor_dataset_and_splits(self):
+        xs = paddle.to_tensor(np.arange(10, dtype=np.float32))
+        ds = TensorDataset([xs])
+        assert len(ds) == 10
+        assert ds[3][0].item() == 3.0
+        a, b = random_split(RangeDataset(10), [7, 3])
+        assert len(a) == 7 and len(b) == 3
+        assert sorted(a.indices + b.indices) == list(range(10))
+
+    def test_concat_subset(self):
+        ds = ConcatDataset([RangeDataset(3), RangeDataset(4)])
+        assert len(ds) == 7
+        assert ds[5][0] == 2.0
+        sub = Subset(RangeDataset(10), [2, 4])
+        assert sub[1][0] == 4.0
+
+    def test_mnist_synthetic(self):
+        ds = MNIST(mode="train", synthetic_size=32)
+        img, label = ds[0]
+        assert img.shape == (1, 28, 28) and img.dtype == np.float32
+        assert 0 <= label <= 9
+        assert len(ds) == 32
+        # deterministic across constructions
+        ds2 = MNIST(mode="train", synthetic_size=32)
+        np.testing.assert_array_equal(ds.images, ds2.images)
+
+
+class TestSamplers:
+    def test_batch_sampler_drop_last(self):
+        bs = BatchSampler(RangeDataset(10), batch_size=3, drop_last=True)
+        batches = list(bs)
+        assert len(batches) == 3 and all(len(b) == 3 for b in batches)
+        bs2 = BatchSampler(RangeDataset(10), batch_size=3, drop_last=False)
+        assert len(list(bs2)) == 4
+
+    def test_random_sampler_covers_all(self):
+        idx = list(RandomSampler(RangeDataset(10)))
+        assert sorted(idx) == list(range(10))
+
+    def test_distributed_batch_sampler_partitions(self):
+        parts = []
+        for rank in range(4):
+            s = DistributedBatchSampler(RangeDataset(16), batch_size=2,
+                                        num_replicas=4, rank=rank)
+            got = [i for b in s for i in b]
+            assert len(got) == 4
+            parts.extend(got)
+        assert sorted(parts) == list(range(16))
+
+    def test_weighted_sampler(self):
+        s = WeightedRandomSampler([0.0, 0.0, 1.0], num_samples=10)
+        assert all(i == 2 for i in s)
+
+
+class TestDataLoader:
+    def test_collation(self):
+        dl = DataLoader(RangeDataset(10), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4] and y.shape == [4]
+        assert y.dtype in (np.int32, np.int64)
+
+    def test_shuffle_epochs_differ(self):
+        dl = DataLoader(RangeDataset(32), batch_size=32, shuffle=True)
+        a = next(iter(dl))[0].numpy()
+        b = next(iter(dl))[0].numpy()
+        assert not np.array_equal(a, b)
+
+    def test_background_prefetch(self):
+        dl = DataLoader(RangeDataset(20), batch_size=5, num_workers=2)
+        xs = [b[0].numpy() for b in dl]
+        assert len(xs) == 4
+        np.testing.assert_array_equal(np.concatenate(xs), np.arange(20))
+
+    def test_worker_error_propagates(self):
+        class Bad(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                raise RuntimeError("boom")
+        with pytest.raises(RuntimeError, match="boom"):
+            list(DataLoader(Bad(), batch_size=2, num_workers=1))
+
+    def test_dict_collation(self):
+        class DictDs(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return {"x": np.float32(i), "y": i}
+        b = next(iter(DataLoader(DictDs(), batch_size=4)))
+        assert b["x"].shape == [4] and b["y"].shape == [4]
+
+
+class TestTransforms:
+    def test_compose_pipeline(self):
+        t = T.Compose([T.Normalize(mean=0.5, std=0.5)])
+        img = np.full((1, 4, 4), 1.0, np.float32)
+        out = t(img)
+        np.testing.assert_allclose(out, np.ones((1, 4, 4)))
+
+    def test_resize_crop_flip(self):
+        img = np.random.rand(3, 8, 8).astype(np.float32)
+        assert T.Resize(4)(img).shape == (3, 4, 4)
+        assert T.CenterCrop(4)(img).shape == (3, 4, 4)
+        assert T.RandomCrop(6)(img).shape == (3, 6, 6)
+        flipped = T.RandomHorizontalFlip(prob=1.0)(img)
+        np.testing.assert_array_equal(flipped, img[..., ::-1])
+
+    def test_to_tensor(self):
+        hwc = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+        out = T.ToTensor()(hwc)
+        assert out.shape == (3, 8, 8) and out.max() <= 1.0
+
+
+class TestMetrics:
+    def test_accuracy(self):
+        m = Accuracy()
+        pred = paddle.to_tensor([[0.1, 0.9], [0.8, 0.2]])
+        label = paddle.to_tensor([1, 1])
+        m.update(m.compute(pred, label))
+        assert m.accumulate() == 0.5
+
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = paddle.to_tensor([[0.5, 0.3, 0.2]])
+        label = paddle.to_tensor([1])
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert top1 == 0.0 and top2 == 1.0
+
+    def test_precision_recall(self):
+        p = Precision(); r = Recall()
+        preds = paddle.to_tensor([0.9, 0.9, 0.1, 0.1])
+        labels = paddle.to_tensor([1, 0, 1, 0])
+        p.update(preds, labels); r.update(preds, labels)
+        assert p.accumulate() == 0.5
+        assert r.accumulate() == 0.5
+
+    def test_auc_perfect(self):
+        m = Auc()
+        m.update(paddle.to_tensor([0.9, 0.8, 0.1, 0.2]),
+                 paddle.to_tensor([1, 1, 0, 0]))
+        assert m.accumulate() > 0.99
+
+
+class TestSaveLoad:
+    def test_state_dict_roundtrip(self, tmp_path):
+        from paddle_tpu import nn
+        net = nn.Linear(3, 3)
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(net.state_dict(), path)
+        state = paddle.load(path)
+        net2 = nn.Linear(3, 3)
+        net2.set_state_dict(state)
+        np.testing.assert_array_equal(net.weight.numpy(), net2.weight.numpy())
+
+    def test_nested_structures(self, tmp_path):
+        obj = {"a": paddle.to_tensor([1.0]), "b": [paddle.to_tensor([2]), 3],
+               "c": "str"}
+        path = str(tmp_path / "obj")
+        paddle.save(obj, path)
+        back = paddle.load(path)
+        assert back["b"][1] == 3 and back["c"] == "str"
+        assert back["a"].numpy()[0] == 1.0
+        arrs = paddle.load(path, return_numpy=True)
+        assert isinstance(arrs["a"], np.ndarray)

@@ -1,0 +1,287 @@
+"""jit/to_static tests (reference: test/dygraph_to_static/ — eager vs traced
+numerics parity is the core gate, SURVEY.md M3)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.jit as jit
+import paddle_tpu.optimizer as opt
+
+
+def test_to_static_matches_eager():
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    x = paddle.rand([3, 4])
+    eager = net(x).numpy()
+    static_net = jit.to_static(net)
+    traced = static_net(x).numpy()
+    np.testing.assert_allclose(eager, traced, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_backward_flows_to_params():
+    net = nn.Linear(3, 1)
+    sf = jit.to_static(net)
+    x = paddle.rand([5, 3])
+    loss = sf(x).sum()
+    loss.backward()
+    assert net.weight.grad is not None
+    # matches eager grads
+    g_static = net.weight.grad.numpy().copy()
+    net.clear_gradients()
+    net(x).sum().backward()
+    np.testing.assert_allclose(g_static, net.weight.grad.numpy(), rtol=1e-5)
+
+
+def test_to_static_sees_param_updates():
+    # params are traced inputs, not baked constants
+    net = nn.Linear(2, 1, bias_attr=False)
+    sf = jit.to_static(net)
+    x = paddle.ones([1, 2])
+    y1 = sf(x).numpy()
+    net.weight.set_value(net.weight.numpy() * 2)
+    y2 = sf(x).numpy()
+    np.testing.assert_allclose(y2, y1 * 2, rtol=1e-6)
+
+
+def test_to_static_function_closure():
+    net = nn.Linear(2, 2)
+
+    @jit.to_static
+    def f(x):
+        return net(x) * 2
+    x = paddle.rand([1, 2])
+    np.testing.assert_allclose(f(x).numpy(), (net(x) * 2).numpy(), rtol=1e-5)
+
+
+def test_to_static_scalar_arg_not_stale():
+    @jit.to_static
+    def f(x, scale=1.0):
+        return x * scale
+    x = paddle.to_tensor([1.0])
+    assert f(x, scale=2.0).item() == 2.0
+    assert f(x, scale=3.0).item() == 3.0  # new constant -> new compile
+
+
+def test_to_static_multiple_signatures():
+    net = nn.Linear(4, 4)
+    sf = jit.to_static(net)
+    assert sf(paddle.rand([2, 4])).shape == [2, 4]
+    assert sf(paddle.rand([7, 4])).shape == [7, 4]
+
+
+def test_to_static_structured_output():
+    @jit.to_static
+    def f(x):
+        return {"double": x * 2, "halves": (x / 2, x / 4)}
+    out = f(paddle.to_tensor([4.0]))
+    assert out["double"].item() == 8.0
+    assert out["halves"][1].item() == 1.0
+
+
+def test_concrete_program_stablehlo():
+    net = nn.Linear(2, 2)
+    sf = jit.to_static(net)
+    hlo = sf.concrete_program(paddle.rand([1, 2]))
+    assert "stablehlo" in hlo or "module" in hlo
+    assert "dot" in hlo  # the matmul survived lowering
+
+
+def test_jitted_training_converges():
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    optim = opt.Adam(learning_rate=0.05, parameters=net.parameters())
+    loss_layer = nn.MSELoss()
+
+    @jit.to_static
+    def loss_fn(x, y):
+        return loss_layer(net(x), y)
+    X = paddle.rand([64, 4])
+    Y = (X.sum(axis=1, keepdim=True) * 2 - 1)
+    for _ in range(200):
+        loss = loss_fn(X, Y)
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+    assert loss.item() < 1e-2
+
+
+def test_jit_save_load(tmp_path):
+    from paddle_tpu.vision.models import LeNet
+    net = LeNet()
+    path = str(tmp_path / "lenet")
+    jit.save(net, path, input_spec=[paddle.rand([1, 1, 28, 28])])
+    import os
+    assert os.path.exists(path + ".pdiparams")
+    assert os.path.exists(path + ".stablehlo")
+    loaded = jit.load(path)
+    from paddle_tpu.jit.io import LoadedProgram
+    if isinstance(loaded, LoadedProgram):
+        net2 = LeNet()
+        loaded.restore_into(net2)
+    else:
+        net2 = loaded
+    x = paddle.rand([1, 1, 28, 28])
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-5)
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return grad * 3 * x * x
+
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = Cube.apply(x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+    def test_pylayer_mixed_with_ops(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        z = (Double.apply(x * 2) + 1).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+class TestFunctionalAutograd:
+    def test_jacobian(self):
+        from paddle_tpu.autograd import jacobian
+        x = paddle.to_tensor([1.0, 2.0])
+        jac = jacobian(lambda t: t * t, x)
+        np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0]), rtol=1e-6)
+
+    def test_hessian(self):
+        from paddle_tpu.autograd import hessian
+        x = paddle.to_tensor([1.0, 2.0])
+        h = hessian(lambda t: (t * t * t).sum(), x)
+        np.testing.assert_allclose(h.numpy(), np.diag([6.0, 12.0]), rtol=1e-6)
+
+    def test_vjp_jvp(self):
+        from paddle_tpu.autograd import vjp, jvp
+        x = paddle.to_tensor([2.0])
+        out, (g,) = vjp(lambda t: t * t, [x])
+        np.testing.assert_allclose(g.numpy(), [4.0])
+        out, tang = jvp(lambda t: t * t, [x])
+        np.testing.assert_allclose(tang.numpy(), [4.0])
+
+
+def test_hapi_model_fit_lenet():
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.vision.datasets import MNIST
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.hapi import Model
+    paddle.seed(0)
+    train = MNIST(mode="train", synthetic_size=1024)
+    test = MNIST(mode="test", synthetic_size=128)
+    net = LeNet()
+    model = Model(net)
+    model.prepare(opt.Adam(learning_rate=5e-3, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy(), jit=True)
+    model.fit(train, epochs=15, batch_size=128, verbose=0)
+    res = model.evaluate(test, batch_size=128)
+    assert res["acc"] > 0.85, res
+
+
+def test_model_summary():
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.vision.models import LeNet
+    info = Model(LeNet()).summary((1, 1, 28, 28))
+    assert info["total_params"] == 61610  # LeNet parameter count
+
+
+class TestM3ReviewRegressions:
+    def test_to_static_respects_train_eval_mode(self):
+        net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.9))
+        sf = jit.to_static(net)
+        x = paddle.ones([64, 4])
+        net.train()
+        train_out = sf(x).numpy()
+        net.eval()
+        eval_out = sf(x).numpy()
+        assert (train_out == 0).sum() > 0       # dropout active in train
+        assert (eval_out == 0).sum() == 0       # and inactive in eval
+
+    def test_precision_via_hapi_compute(self):
+        from paddle_tpu.hapi.model import _update_metric
+        from paddle_tpu.metric import Precision
+        m = Precision()
+        _update_metric(m, paddle.to_tensor([0.9, 0.1]), paddle.to_tensor([1, 0]))
+        assert m.accumulate() == 1.0
+
+    def test_early_stopping_on_eval_metric(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        from paddle_tpu.vision.datasets import MNIST
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.metric import Accuracy
+        paddle.seed(0)
+        net = nn.Sequential(nn.Flatten(0 if False else 1), nn.Linear(784, 10))
+        model = Model(net)
+        model.prepare(opt.SGD(learning_rate=0.0, parameters=net.parameters()),
+                      nn.CrossEntropyLoss(), Accuracy())
+        es = EarlyStopping(monitor="eval_acc", mode="max", patience=0)
+        ds = MNIST(mode="train", synthetic_size=64)
+        model.fit(ds, eval_data=ds, epochs=5, batch_size=64, verbose=0,
+                  callbacks=[es])
+        # lr=0 -> eval_acc never improves -> stops after ~2 epochs, not 5
+        assert es.wait > 0
+
+    def test_dataloader_abandoned_iterator_no_leak(self):
+        import threading
+        import time
+        from paddle_tpu.io import DataLoader
+        before = set(threading.enumerate())
+        for _ in range(5):
+            dl = DataLoader(RangeDatasetForLeak(), batch_size=1, num_workers=2)
+            it = iter(dl)
+            next(it)
+            it.close()  # abandon mid-epoch
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            leaked = [t for t in threading.enumerate()
+                      if t not in before and t.is_alive()]
+            if not leaked:
+                break
+            time.sleep(0.1)
+        assert not leaked, leaked
+
+    def test_jit_save_with_input_spec(self, tmp_path):
+        import paddle_tpu.static as static
+        net = nn.Linear(4, 2)
+        path = str(tmp_path / "m")
+        jit.save(net, path, input_spec=[static.InputSpec(shape=[None, 4])])
+        import os
+        assert os.path.exists(path + ".stablehlo")
+
+    def test_vjp_list_cotangent_tuple_output(self):
+        from paddle_tpu.autograd import vjp
+        x = paddle.to_tensor([2.0]); y = paddle.to_tensor([3.0])
+        out, grads = vjp(lambda a, b: (a * b, a + b), [x, y],
+                         v=[paddle.to_tensor([1.0]), paddle.to_tensor([0.0])])
+        np.testing.assert_allclose(grads[0].numpy(), [3.0])
+
+
+class RangeDatasetForLeak:
+    def __getitem__(self, i):
+        return np.float32(i)
+
+    def __len__(self):
+        return 100
