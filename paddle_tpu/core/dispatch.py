@@ -16,6 +16,10 @@ from .autograd import GradNode
 
 _amp_hook = None  # installed by paddle_tpu.amp; signature (name, args, kwargs) -> (args, kwargs)
 
+# ops allowed to consume Partial-placement DTensors (they implement the
+# pending reduction); everything else must reshard first
+_PARTIAL_OK = {"reshard_p", "to_global", "shard_tensor"}
+
 
 def set_amp_hook(fn):
     global _amp_hook
@@ -31,6 +35,16 @@ def apply_op(name, impl, args, kwargs, differentiable=True):
     leaves, treedef = tree_flatten((args, kwargs),
                                    is_leaf=lambda x: isinstance(x, Tensor))
     tensor_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+
+    if name not in _PARTIAL_OK:
+        for i in tensor_idx:
+            meta = getattr(leaves[i], "_dist_meta", None)
+            if meta is not None and meta.partial_axes:
+                raise RuntimeError(
+                    f"op '{name}' got a Partial-placement DTensor; reshard it "
+                    "first (dist.reshard(x, mesh, [Replicate()...]) or "
+                    "dist.all_reduce) — partial tensors hold unreduced "
+                    "per-device contributions")
     record = (differentiable and ag.is_grad_enabled()
               and any(not leaves[i].stop_gradient for i in tensor_idx))
 

@@ -22,7 +22,7 @@ from .dispatch import apply_op
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx",
                  "_hooks", "_retain_grad", "name", "persistable", "trainable",
-                 "__weakref__")
+                 "_dist_meta", "__weakref__", "__dict__")
 
     def __init__(self, data, dtype=None, stop_gradient=True, name=None):
         if isinstance(data, Tensor):
@@ -60,10 +60,18 @@ class Tensor:
 
     @property
     def shape(self):
+        # Partial-placement DTensors store hidden leading stack dims (see
+        # paddle_tpu/distributed/dtensor.py); logical shape excludes them
+        meta = getattr(self, "_dist_meta", None)
+        if meta is not None and meta.partial_axes:
+            return list(self._data.shape[len(meta.partial_axes):])
         return list(self._data.shape)
 
     @property
     def ndim(self):
+        meta = getattr(self, "_dist_meta", None)
+        if meta is not None and meta.partial_axes:
+            return self._data.ndim - len(meta.partial_axes)
         return self._data.ndim
 
     @property

@@ -1,1 +1,33 @@
-"""Placeholder — populated in a later milestone this round."""
+"""paddle.distributed surface (reference: python/paddle/distributed/).
+
+Architecture (SURVEY.md §2.7/§2.8/§5.8): the reference's ProcessGroup family,
+CommContexts, c_* collective ops, and TCPStore bootstrap collapse into a
+mesh-first design — ProcessMesh wraps jax.sharding.Mesh, collectives are
+either GSPMD reshards (eager global context) or lax collectives (per-device
+shard_map context), and multi-host bootstrap is the JAX coordination service.
+"""
+from .mesh import ProcessMesh, set_mesh, get_mesh, auto_mesh
+from .placement import Placement, Shard, Replicate, Partial, ReduceType
+from .dtensor import (shard_tensor, reshard, dtensor_from_fn,
+                      dtensor_from_local, dtensor_to_local, dtensor_to_global,
+                      unshard_dtensor, shard_layer, shard_param,
+                      is_dist_tensor)
+from .collective import (ReduceOp, Group, new_group, get_group, all_reduce,
+                         all_gather, all_gather_object, reduce_scatter,
+                         broadcast, reduce, scatter, all_to_all,
+                         all_to_all_single, send, recv, isend, irecv, P2POp,
+                         batch_isend_irecv, barrier, wait,
+                         broadcast_object_list)
+from .parallel import (init_parallel_env, get_rank, get_world_size,
+                       ParallelEnv, DataParallel)
+from .spmd_rules import RULE_TABLE, get_rule, register_rule
+from . import fleet
+from .auto_parallel import to_static as _ap_to_static  # noqa: F401 (optional)
+from . import auto_parallel
+
+# paddle.distributed.launch parity helpers
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Single-controller SPMD drives all local devices from one process, so
+    spawn degenerates to a direct call (reference spawn.py forks per GPU)."""
+    init_parallel_env()
+    return func(*args)

@@ -1,0 +1,15 @@
+"""Semi-auto parallel API (reference: python/paddle/distributed/auto_parallel/
+api.py — shard_tensor:220, reshard:797, shard_layer:908, to_static:2952,
+shard_optimizer:1430+, shard_dataloader:3475).
+
+The dygraph DTensor pieces live in ..dtensor; this module adds the
+training-oriented wrappers: shard_optimizer (ZeRO stages as placement
+policies), shard_dataloader, and to_static → DistModel (trace + pjit over the
+mesh, replacing Engine._parallel_pir's pass pipeline with GSPMD)."""
+from ..dtensor import (shard_tensor, reshard, shard_layer, dtensor_from_fn,
+                       dtensor_from_local, dtensor_to_local)
+from ..mesh import ProcessMesh, get_mesh, set_mesh
+from ..placement import Shard, Replicate, Partial
+from .api import (ShardingStage1, ShardingStage2, ShardingStage3,
+                  shard_optimizer, shard_dataloader, to_static, DistModel,
+                  Strategy)

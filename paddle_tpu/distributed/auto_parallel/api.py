@@ -1,0 +1,177 @@
+"""auto_parallel training API (reference file:line cited per class)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor
+from ..mesh import ProcessMesh, get_mesh
+from ..placement import Shard, Replicate, Partial
+from ..dtensor import shard_param, _get_meta
+
+
+class Strategy:
+    """reference: auto_parallel/strategy.py — pass-through knob bundle."""
+
+    def __init__(self, config=None):
+        self.sharding = _Cfg(enable=False, degree=1, stage=1)
+        self.amp = _Cfg(enable=False, dtype="bfloat16", level="O2")
+        self.recompute = _Cfg(enable=False)
+        self.pipeline = _Cfg(enable=False, schedule_mode="1F1B",
+                             accumulate_steps=1)
+        self.gradient_merge = _Cfg(enable=False, k_steps=1)
+
+
+class _Cfg:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class _ShardingStage:
+    """Optimizer-placement policies (reference api.py:1430 ShardingStage1,
+    :1522 Stage2, :1638 Stage3): passed to shard_optimizer to shard states
+    (1/2) or params+states (3) over a mesh axis."""
+
+    stage = 1
+
+    def __init__(self, axis_name="dp", mesh=None):
+        self.axis_name = axis_name
+        self.mesh = mesh
+
+    def _mesh(self):
+        return self.mesh or get_mesh()
+
+
+class ShardingStage1(_ShardingStage):
+    stage = 1
+
+
+class ShardingStage2(_ShardingStage):
+    stage = 2
+
+
+class ShardingStage3(_ShardingStage):
+    stage = 3
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """dist.shard_optimizer (api.py:1430): apply the ShardingStage policy to
+    the optimizer's state creation (and, for stage 3, to the params now)."""
+    if shard_fn is None:
+        return optimizer
+    mesh = shard_fn._mesh()
+    axis = shard_fn.axis_name
+    if axis not in mesh.dim_names:
+        axis = mesh.dim_names[0]
+    jm = mesh.jax_mesh
+    n = mesh.get_dim_size(axis)
+
+    if shard_fn.stage >= 3:
+        for p in optimizer._parameter_list:
+            if p.ndim >= 1 and p.shape[0] % n == 0:
+                shard_param(p, mesh,
+                            [Shard(0) if nm == axis else Replicate()
+                             for nm in mesh.dim_names])
+
+    orig_create = optimizer._create_state
+
+    def sharded_create(p):
+        st = orig_create(p)
+        for k, v in st.items():
+            if v.ndim >= 1 and v.shape[0] % n == 0:
+                spec = PartitionSpec(axis, *([None] * (v.ndim - 1)))
+                st[k] = jax.device_put(v, NamedSharding(jm, spec))
+        return st
+    optimizer._create_state = sharded_create
+    return optimizer
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """dist.shard_dataloader (api.py:3475): yield batches with inputs sharded
+    onto the mesh's data axis."""
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    axis = shard_dims if isinstance(shard_dims, str) else \
+        (mesh.dim_names[0] if shard_dims is None else mesh.dim_names[shard_dims])
+    from ..dtensor import shard_tensor
+
+    class _Sharded:
+        def __iter__(self):
+            pl = [Shard(0) if nm == axis else Replicate()
+                  for nm in mesh.dim_names]
+
+            def place(item, key=None):
+                if isinstance(item, Tensor) and item.ndim >= 1 \
+                        and (input_keys is None or key is None
+                             or key in input_keys):
+                    return shard_tensor(item, mesh, pl)
+                return item
+
+            for batch in dataloader:
+                if isinstance(batch, dict):
+                    yield {k: place(v, k) for k, v in batch.items()}
+                elif isinstance(batch, (list, tuple)):
+                    yield type(batch)(place(v) for v in batch)
+                else:
+                    yield place(batch)
+
+        def __len__(self):
+            return len(dataloader)
+    return _Sharded()
+
+
+class DistModel:
+    """dist.to_static product (reference api.py:2254): wraps layer + loss +
+    optimizer into compiled train/eval steps over the mesh. The reference's
+    Engine pass pipeline (mix2dist → propagation → partition → reshard) is
+    GSPMD: we jit the functional train step with DTensor params as sharded
+    inputs and let XLA place every collective."""
+
+    def __init__(self, layer, loader, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        self.network = layer
+        self._loader = loader
+        self._loss = loss
+        self._optimizer = optimizer
+        self._mode = "train"
+        self._train_fn = None
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            from ...jit import to_static
+            if self._train_fn is None:
+                network, loss = self.network, self._loss
+
+                def fwd(*a):
+                    out = network(*a[:-1])
+                    return loss(out, a[-1])
+                self._train_fn = to_static(fwd)
+            loss = self._train_fn(*args)
+            loss.backward()
+            if self._optimizer is not None:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            return loss
+        return self.network(*args)
+
+    def state_dict(self, *a, **k):
+        return self.network.state_dict(*a, **k)
+
+    def dist_main_program(self, mode=None):
+        return None  # PIR program object has no analogue; see concrete HLO
+
+    def set_state_dict(self, *a, **k):
+        return self.network.set_state_dict(*a, **k)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              metrics=None):
+    """dist.to_static (api.py:2952)."""
+    return DistModel(layer, loader, loss, optimizer, strategy, metrics)

@@ -1,0 +1,329 @@
+"""Collective communication API (reference: python/paddle/distributed/
+communication/ over ProcessGroupNCCL, paddle/fluid/distributed/collective/).
+
+TPU-native collapse (SURVEY.md §5.8): ProcessGroup + CommContext + c_* ops
+become one mesh-collectives module. Two execution contexts:
+
+1. **Per-device context** (inside shard_map / a traced SPMD region): these
+   functions lower to jax.lax collectives (psum/all_gather/ppermute/...),
+   which XLA schedules on ICI.
+2. **Eager global context** (single-controller, arrays are globally sharded):
+   a collective is a resharding of the global array; XLA emits the same ICI
+   collective under the hood. `tensor` is updated in place to keep paddle's
+   mutation contract.
+
+Groups name a mesh axis rather than a rank list: `new_group` on a
+ProcessMesh axis is the reference's per-axis NCCL communicator.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from .mesh import ProcessMesh, get_mesh
+
+_group_registry = {}
+_next_group_id = 0
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communicator = one mesh axis (or the full flat device set)."""
+
+    def __init__(self, mesh, axis_name, gid=0):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.id = gid
+
+    @property
+    def nranks(self):
+        return self.mesh.get_dim_size(self.axis_name)
+
+    world_size = nranks
+
+    @property
+    def ranks(self):
+        return list(range(self.nranks))
+
+    def get_group_rank(self, rank):
+        return rank
+
+    @property
+    def process_ids(self):
+        return self.mesh.process_ids
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name}, nranks={self.nranks})"
+
+
+def _default_group():
+    mesh = get_mesh()
+    if mesh is None:
+        n = jax.device_count()
+        mesh = ProcessMesh(np.arange(n), dim_names=["world"])
+        from .mesh import set_mesh
+        set_mesh(mesh)
+    return Group(mesh, mesh.dim_names[0], 0)
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None,
+              mesh=None):
+    """Create a group. Mesh-axis form is canonical; a ranks list over all
+    devices maps to the default axis (rank-subset groups need a sub-mesh)."""
+    global _next_group_id
+    if mesh is not None and axis_name is not None:
+        _next_group_id += 1
+        g = Group(mesh, axis_name, _next_group_id)
+        _group_registry[g.id] = g
+        return g
+    g = _default_group()
+    _group_registry[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    return _group_registry.get(gid) or _default_group()
+
+
+def _in_spmd_context(x):
+    arr = x.data if isinstance(x, Tensor) else x
+    return isinstance(arr, jax.core.Tracer)
+
+
+def _axis(group):
+    g = group or _default_group()
+    return g.axis_name, g
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis, g = _axis(group)
+    if _in_spmd_context(tensor):
+        arr = tensor.data if isinstance(tensor, Tensor) else tensor
+        fn = {"sum": jax.lax.psum, "max": jax.lax.pmax,
+              "min": jax.lax.pmin}.get(op)
+        if fn is None:
+            if op == "avg":
+                out = jax.lax.pmean(arr, axis)
+            else:
+                raise ValueError(f"unsupported reduce op {op}")
+        else:
+            out = fn(arr, axis)
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    # eager global context: sum of per-rank values == materializing a Partial
+    from .dtensor import _get_meta, reshard
+    from .placement import Replicate, Partial
+    meta = _get_meta(tensor)
+    if meta is not None and meta.partial_axes:
+        stored = meta.placements[meta.partial_axes[0]].reduce_type
+        if stored != op and not (stored == "sum" and op == ReduceOp.SUM):
+            raise ValueError(
+                f"all_reduce(op={op}) on a Partial({stored!r}) tensor: the "
+                "pending reduction type is fixed at Partial creation")
+        out = reshard(tensor, meta.mesh, [Replicate()] * meta.mesh.ndim)
+        tensor._data = out._data
+        tensor._dist_meta = out._dist_meta
+        return tensor
+    # replicated input: per-rank values are identical
+    if op == ReduceOp.SUM:
+        tensor._data = tensor.data * g.nranks
+    elif op == ReduceOp.PROD:
+        tensor._data = tensor.data ** g.nranks
+    elif op in (ReduceOp.AVG, ReduceOp.MAX, ReduceOp.MIN):
+        pass  # avg/max/min of identical values is the value
+    else:
+        raise ValueError(f"unsupported reduce op {op}")
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    axis_name, g = _axis(group)
+    if _in_spmd_context(tensor):
+        arr = tensor.data if isinstance(tensor, Tensor) else tensor
+        out = jax.lax.all_gather(arr, axis_name)
+        if tensor_list is not None and isinstance(tensor_list, list):
+            for i in range(out.shape[0]):
+                tensor_list.append(Tensor(out[i]))
+            return tensor_list
+        return out
+    # eager: gather shards of a dim-0-sharded dtensor
+    from .dtensor import _get_meta, dtensor_to_global
+    meta = _get_meta(tensor)
+    full = dtensor_to_global(tensor) if meta is not None else tensor
+    n = g.nranks
+    chunk = full.shape[0] // n if meta is not None and any(
+        p.is_shard() for p in meta.placements) else full.shape[0]
+    if tensor_list is not None:
+        if meta is not None and any(p.is_shard(0) for p in meta.placements):
+            for i in range(n):
+                tensor_list.append(full[i * chunk:(i + 1) * chunk])
+        else:
+            for _ in range(n):
+                tensor_list.append(Tensor(full.data))
+    return tensor_list
+
+
+def all_gather_object(obj_list, obj, group=None):
+    _, g = _axis(group)
+    for _ in range(g.nranks):
+        obj_list.append(obj)
+    return obj_list
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    axis_name, g = _axis(group)
+    if _in_spmd_context(tensor_or_tensor_list):
+        arr = tensor_or_tensor_list
+        arr = arr.data if isinstance(arr, Tensor) else arr
+        out = jax.lax.psum_scatter(arr, axis_name, tiled=True)
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    from .dtensor import _get_meta, reshard
+    from .placement import Shard
+    meta = _get_meta(tensor_or_tensor_list)
+    if meta is not None and meta.partial_axes:
+        out = reshard(tensor_or_tensor_list, meta.mesh,
+                      [Shard(0) if i in meta.partial_axes else p
+                       for i, p in enumerate(meta.placements)])
+        tensor._data = out._data
+        tensor._dist_meta = out._dist_meta
+        return tensor
+    raise ValueError("eager reduce_scatter expects a Partial dtensor")
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    # single-controller SPMD: replicated arrays are already consistent; in a
+    # per-device context broadcasting from rank 0 is a select + psum
+    axis_name, g = _axis(group)
+    if _in_spmd_context(tensor):
+        arr = tensor.data if isinstance(tensor, Tensor) else tensor
+        idx = jax.lax.axis_index(axis_name)
+        masked = jnp.where(idx == src, arr, jnp.zeros_like(arr))
+        out = jax.lax.psum(masked, axis_name)
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Single-controller analogue: the per-rank chunks become a dim-0-sharded
+    stack ([nranks, *chunk]) — each device holds exactly its chunk; per-rank
+    code inside shard_map sees the local [*chunk] slice."""
+    axis_name, g = _axis(group)
+    if _in_spmd_context(tensor):
+        raise NotImplementedError("scatter inside shard_map: index the "
+                                  "gathered array with lax.axis_index")
+    if tensor_list:
+        stacked = Tensor(jnp.stack([t.data for t in tensor_list]))
+        from .dtensor import shard_tensor
+        from .placement import Shard
+        out = shard_tensor(stacked, g.mesh, [Shard(0)])
+        tensor._data = out._data
+        tensor._dist_meta = out._dist_meta
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    axis_name, g = _axis(group)
+    if in_tensor_list and _in_spmd_context(in_tensor_list[0]):
+        arrs = [t.data if isinstance(t, Tensor) else t for t in in_tensor_list]
+        stacked = jnp.stack(arrs)  # [nranks, ...] per device
+        out = jax.lax.all_to_all(stacked, axis_name, split_axis=0,
+                                 concat_axis=0, tiled=False)
+        for i in range(out.shape[0]):
+            out_tensor_list.append(Tensor(out[i]))
+        return out_tensor_list
+    # eager single-controller: transpose of the [src, dst] mailbox
+    for i in range(g.nranks):
+        out_tensor_list.append(in_tensor_list[i])
+    return out_tensor_list
+
+
+def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
+                      out_split_sizes=None, group=None, sync_op=True):
+    axis_name, g = _axis(group)
+    if _in_spmd_context(in_tensor):
+        arr = in_tensor.data if isinstance(in_tensor, Tensor) else in_tensor
+        out = jax.lax.all_to_all(arr.reshape(g.nranks, -1, *arr.shape[1:]),
+                                 axis_name, split_axis=0, concat_axis=0,
+                                 tiled=False).reshape(arr.shape)
+        if isinstance(out_tensor, Tensor):
+            out_tensor._data = out
+            return out_tensor
+        return out
+    out_tensor._data = in_tensor.data
+    return out_tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P is ppermute in the SPMD world (pipeline helpers use it directly);
+    eager single-controller send/recv is a no-op pair."""
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def isend(tensor, dst=0, group=None):
+    return _DoneTask()
+
+
+def irecv(tensor, src=0, group=None):
+    return _DoneTask()
+
+
+class _DoneTask:
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    return [_DoneTask() for _ in p2p_op_list]
+
+
+def barrier(group=None):
+    jax.effects_barrier()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and hasattr(tensor.data, "block_until_ready"):
+        tensor.data.block_until_ready()
+
+
+# -- torch.distributed-style object store (used by checkpoint coordination) --
+def broadcast_object_list(obj_list, src=0, group=None):
+    return obj_list

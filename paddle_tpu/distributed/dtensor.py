@@ -1,0 +1,276 @@
+"""DTensor: distributed tensors as sharded jax.Arrays.
+
+Reference: DistTensor (paddle/phi/core/distributed/auto_parallel/dist_tensor.h:39)
++ shard_tensor/reshard APIs (python/paddle/distributed/auto_parallel/api.py:220,797)
++ the 15 C++ reshard functions (paddle/phi/core/distributed/auto_parallel/reshard/).
+
+TPU-native collapse: a DTensor is an ordinary Tensor whose jax.Array carries a
+NamedSharding over a ProcessMesh — GSPMD is the reshard/dispatch engine, so the
+15 hand-written reshard functions become device_put with a new sharding (XLA
+emits the collective: slice for r→s, all-gather for s→r, collective-permute
+for s→s', all-reduce/reduce-scatter for p→r / p→s).
+
+Partial storage convention: a Partial placement on mesh axis a is stored with
+a hidden leading dim of size |a| (each slice = one device's unreduced
+contribution), sharded over a. Logical shape excludes hidden dims. Only
+reshard and add consume partial tensors directly, matching the reference's
+reshard-before-use discipline (dist_api_gen.py reshards inputs ahead of every
+local kernel)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+from .mesh import ProcessMesh
+from .placement import Placement, Shard, Replicate, Partial
+
+
+def _spec_for(mesh, placements, n_logical_dims):
+    """PartitionSpec for the STORAGE array (hidden partial dims first)."""
+    partial_axes = [mesh.dim_names[i] for i, p in enumerate(placements)
+                    if isinstance(p, Partial)]
+    entries = [None] * n_logical_dims
+    for axis_idx, p in enumerate(placements):
+        if isinstance(p, Shard):
+            name = mesh.dim_names[axis_idx]
+            if entries[p.dim] is None:
+                entries[p.dim] = name
+            elif isinstance(entries[p.dim], tuple):
+                entries[p.dim] = entries[p.dim] + (name,)
+            else:
+                entries[p.dim] = (entries[p.dim], name)
+    return PartitionSpec(*partial_axes, *entries), partial_axes
+
+
+def _normalize_placements(mesh, placements):
+    placements = list(placements)
+    while len(placements) < mesh.ndim:
+        placements.append(Replicate())
+    return tuple(placements)
+
+
+class _DistMeta:
+    __slots__ = ("mesh", "placements")
+
+    def __init__(self, mesh, placements):
+        self.mesh = mesh
+        self.placements = tuple(placements)
+
+    @property
+    def partial_axes(self):
+        return [i for i, p in enumerate(self.placements)
+                if isinstance(p, Partial)]
+
+
+def is_dist_tensor(t):
+    return getattr(t, "_dist_meta", None) is not None
+
+
+def _get_meta(t):
+    return getattr(t, "_dist_meta", None)
+
+
+def _set_meta(t, mesh, placements):
+    t._dist_meta = _DistMeta(mesh, placements)
+    return t
+
+
+# expose paddle-style properties on Tensor
+def _placements(self):
+    m = _get_meta(self)
+    return list(m.placements) if m else None
+
+
+def _process_mesh(self):
+    m = _get_meta(self)
+    return m.mesh if m else None
+
+
+def _is_dist(self):
+    return is_dist_tensor(self)
+
+
+Tensor.placements = property(_placements)
+Tensor.process_mesh = property(_process_mesh)
+Tensor.is_dist = _is_dist
+
+
+def shard_tensor(x, mesh, placements, dtype=None, stop_gradient=None):
+    """dist.shard_tensor (api.py:220): global tensor in, DTensor out."""
+    if not isinstance(x, Tensor):
+        x = Tensor(x, dtype=dtype)
+    mesh = mesh if isinstance(mesh, ProcessMesh) else ProcessMesh(mesh)
+    placements = _normalize_placements(mesh, placements)
+    partial_idx = [i for i, p in enumerate(placements) if isinstance(p, Partial)]
+    jm = mesh.jax_mesh
+    spec, partial_axes = _spec_for(mesh, placements, x.ndim)
+
+    if partial_idx:
+        if len(partial_idx) > 1:
+            raise NotImplementedError("multiple Partial axes in shard_tensor")
+        i = partial_idx[0]
+        n = mesh.shape[i]
+        red = placements[i].reduce_type
+
+        def impl(a):
+            # invariant: materializing the stack with the reduce op must give
+            # back `a`. sum: coordinate 0 holds a, rest hold zeros (paddle
+            # RToP); avg/max/min: every coordinate holds a; prod: coordinate
+            # 0 holds a, rest ones
+            if red == "sum":
+                ident = jnp.zeros_like(a)[None]
+                pad = jnp.concatenate([ident] * (n - 1), axis=0) if n > 1 else None
+            elif red in ("avg", "max", "min"):
+                pad = jnp.concatenate([a[None]] * (n - 1), axis=0) if n > 1 else None
+            else:  # prod
+                ident = jnp.ones_like(a)[None]
+                pad = jnp.concatenate([ident] * (n - 1), axis=0) if n > 1 else None
+            stacked = jnp.concatenate([a[None], pad], axis=0) \
+                if pad is not None else a[None]
+            return jax.device_put(stacked, NamedSharding(jm, spec))
+        out = apply_op("shard_tensor", impl, (x,), {})
+    else:
+        def impl(a):
+            return jax.device_put(a, NamedSharding(jm, spec))
+        out = apply_op("shard_tensor", impl, (x,), {})
+    if stop_gradient is None:
+        out.stop_gradient = x.stop_gradient
+    else:
+        out.stop_gradient = stop_gradient
+    return _set_meta(out, mesh, placements)
+
+
+def reshard(x, mesh, placements):
+    """dist.reshard (api.py:797): change placements, inserting the collective
+    XLA chooses (the r/s/p x cross-mesh matrix of
+    paddle/phi/core/distributed/auto_parallel/reshard/)."""
+    mesh = mesh if isinstance(mesh, ProcessMesh) else ProcessMesh(mesh)
+    placements = _normalize_placements(mesh, placements)
+    src = _get_meta(x)
+    jm = mesh.jax_mesh
+    dst_partial = [i for i, p in enumerate(placements) if isinstance(p, Partial)]
+    src_partial = src.partial_axes if src else []
+    # Tensor.ndim is already logical (hidden partial dims excluded)
+    logical_ndim = x.ndim
+    spec, _ = _spec_for(mesh, placements, logical_ndim)
+
+    if src_partial:
+        # materialize the pending reduction, then place
+        n_hidden = len(src_partial)
+        red = src.placements[src_partial[0]].reduce_type
+
+        def impl(a):
+            if red in ("sum", "avg"):
+                full = jnp.sum(a, axis=tuple(range(n_hidden)))
+                if red == "avg":
+                    sizes = np.prod([src.mesh.shape[i] for i in src_partial])
+                    full = full / sizes
+            elif red == "max":
+                full = jnp.max(a, axis=tuple(range(n_hidden)))
+            elif red == "min":
+                full = jnp.min(a, axis=tuple(range(n_hidden)))
+            else:
+                full = jnp.prod(a, axis=tuple(range(n_hidden)))
+            return jax.device_put(full, NamedSharding(jm, spec))
+        if dst_partial:
+            raise NotImplementedError("partial -> partial reshard")
+        out = apply_op("reshard_p", impl, (x,), {})
+    elif dst_partial:
+        # r/s -> p: coordinate 0 holds the value (reference ReshardRToP)
+        out = shard_tensor(dtensor_to_global(x), mesh, placements,
+                           stop_gradient=x.stop_gradient)
+        return out
+    else:
+        def impl(a):
+            return jax.device_put(a, NamedSharding(jm, spec))
+        out = apply_op("reshard", impl, (x,), {})
+    out.stop_gradient = x.stop_gradient
+    return _set_meta(out, mesh, placements)
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """dist.dtensor_from_fn (api.py): build from a creation op then place."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def dtensor_from_local(local, mesh, placements):
+    """Assemble a DTensor from per-process local shards. Single-controller:
+    local IS the global slice when processes==1; multi-host uses
+    jax.make_array_from_process_local_data."""
+    mesh = mesh if isinstance(mesh, ProcessMesh) else ProcessMesh(mesh)
+    placements = _normalize_placements(mesh, placements)
+    arr = local.data if isinstance(local, Tensor) else jnp.asarray(local)
+    if jax.process_count() > 1:
+        sharding = NamedSharding(mesh.jax_mesh,
+                                 _spec_for(mesh, placements, arr.ndim)[0])
+        garr = jax.make_array_from_process_local_data(sharding, np.asarray(arr))
+        t = Tensor(garr)
+        return _set_meta(t, mesh, placements)
+    return shard_tensor(Tensor(arr), mesh, placements)
+
+
+def dtensor_to_global(x):
+    """Gather a DTensor to a fully-replicated plain array (sum-materializes
+    partial)."""
+    meta = _get_meta(x)
+    if meta is None:
+        return x
+    if meta.partial_axes:
+        x = reshard(x, meta.mesh, [Replicate()] * meta.mesh.ndim)
+    def impl(a):
+        return jax.device_put(a, NamedSharding(
+            meta.mesh.jax_mesh, PartitionSpec()))
+    out = apply_op("to_global", impl, (x,), {})
+    out.stop_gradient = x.stop_gradient
+    return out
+
+
+def dtensor_to_local(x, mesh=None, placements=None):
+    """Rank-0's local shard VIEW (reference dist.dtensor_to_local returns the
+    calling rank's shard; the single-controller analogue is the
+    lowest-device-id shard). This is a per-rank slice, not the whole tensor —
+    use dtensor_to_global / the distributed checkpoint API to materialize all
+    shards."""
+    meta = _get_meta(x)
+    if meta is None:
+        return x
+    shards = sorted(x.data.addressable_shards, key=lambda s: s.device.id)
+    return Tensor(np.asarray(shards[0].data))
+
+
+def unshard_dtensor(x):
+    return dtensor_to_global(x)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """dist.shard_layer (api.py:908): apply shard_fn(name, layer, mesh) to
+    every sublayer; default replicates parameters onto the mesh."""
+    def default_fn(name, sublayer, mesh):
+        for pname, p in list(sublayer._parameters.items()):
+            if p is None or is_dist_tensor(p):
+                continue
+            d = shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
+            p._data = d._data
+            _set_meta(p, d._dist_meta.mesh, d._dist_meta.placements)
+
+    fn = shard_fn or default_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def shard_param(param, mesh, placements):
+    """In-place re-placement of a Parameter (used by TP layers and FSDP)."""
+    d = shard_tensor(param.detach(), mesh, placements)
+    param._data = d._data
+    _set_meta(param, d._dist_meta.mesh, d._dist_meta.placements)
+    return param

@@ -1,0 +1,106 @@
+"""fleet façade (reference: python/paddle/distributed/fleet/fleet.py:218 —
+fleet.init / distributed_model / distributed_optimizer; DistributedStrategy
+from fleet/base/distributed_strategy.py)."""
+from .topology import (CommunicateTopology, HybridCommunicateGroup, set_hcg,
+                       get_hcg, AXES)
+from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                        VocabParallelEmbedding, ParallelCrossEntropy,
+                        TensorParallel)
+from .sp_layers import (ColumnSequenceParallelLinear,
+                        RowSequenceParallelLinear, all_gather_sequence,
+                        reduce_scatter_sequence,
+                        mark_as_sequence_parallel_parameter)
+from .sharding import (DygraphShardingOptimizer, GroupShardedStage2,
+                       GroupShardedStage3, group_sharded_parallel)
+from .hybrid_optimizer import HybridParallelOptimizer, HybridParallelClipGrad
+
+
+class DistributedStrategy:
+    """Knob bundle (reference: protobuf distributed_strategy.proto wrapped by
+    fleet/base/distributed_strategy.py). Plain attributes here — the traced
+    path reads them when building the mesh/jit."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+            "pp_configs": {},
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.find_unused_parameters = False
+
+
+_fleet_state = {"initialized": False, "strategy": None}
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level=None):
+    """fleet.init: build the 5-D hybrid topology mesh and the per-axis groups
+    (reference builds one NCCL comm per axis; here axes ARE the comms)."""
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    hcg = HybridCommunicateGroup(
+        dp_degree=hc.get("dp_degree", 1),
+        mp_degree=hc.get("mp_degree", 1),
+        pp_degree=hc.get("pp_degree", 1),
+        sharding_degree=hc.get("sharding_degree", 1),
+        sep_degree=hc.get("sep_degree", 1))
+    set_hcg(hcg)
+    _fleet_state["initialized"] = True
+    _fleet_state["strategy"] = strategy
+    return hcg
+
+
+def get_hybrid_communicate_group():
+    return get_hcg()
+
+
+def distributed_model(model):
+    """Pick the parallel wrapper (reference fleet/model.py). With mp only the
+    model's parallel layers already carry shardings; pp wraps in
+    PipelineParallel; otherwise DataParallel semantics are native (batch
+    sharding + XLA grad reduction)."""
+    hcg = get_hcg()
+    if hcg is None:
+        raise RuntimeError("call fleet.init first")
+    if hcg.get_pipe_parallel_world_size() > 1:
+        from .pipeline_parallel import PipelineParallel
+        return PipelineParallel(model, hcg, _fleet_state["strategy"])
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg)
+    from ..parallel import DataParallel
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return HybridParallelOptimizer(optimizer, get_hcg(),
+                                   strategy or _fleet_state["strategy"])
+
+
+def worker_num():
+    import jax
+    return jax.process_count()
+
+
+def worker_index():
+    import jax
+    return jax.process_index()
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def barrier_worker():
+    import jax
+    jax.effects_barrier()

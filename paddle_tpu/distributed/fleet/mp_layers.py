@@ -1,0 +1,205 @@
+"""Tensor-parallel layers (reference: python/paddle/distributed/fleet/layers/
+mpu/mp_layers.py — VocabParallelEmbedding:49, ColumnParallelLinear:336,
+RowParallelLinear:543, ParallelCrossEntropy:744).
+
+TPU-native mechanics: weights are DTensors sharded over the 'model' mesh
+axis; the matmul math runs on globally-sharded arrays, so XLA inserts the
+identity/allreduce pair that the reference implements as PyLayers
+(mpu/mp_ops.py:40-356) — forward allreduce for row-parallel, backward
+allreduce for column-parallel, all scheduled on ICI. ParallelCrossEntropy is
+written with shard_map because it needs per-shard max/sum exchange, mirroring
+c_softmax_with_cross_entropy."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...core.dispatch import apply_op
+from ... import nn
+from ...nn import initializer as I
+from ..placement import Shard, Replicate
+from ..dtensor import shard_param
+from .topology import get_hcg
+
+
+def _model_axis():
+    hcg = get_hcg()
+    if hcg is None:
+        raise RuntimeError("call fleet.init(is_collective=True) first")
+    return hcg.mesh, "model", hcg.get_model_parallel_world_size()
+
+
+class ColumnParallelLinear(nn.Layer):
+    """Weight [in, out] sharded on out (dim 1) across 'model'."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 name=None):
+        super().__init__()
+        mesh, axis, nranks = _model_axis()
+        self.mesh = mesh
+        self.axis = axis
+        self.gather_output = gather_output
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        shard_param(self.weight, mesh, self._pl(Shard(1)))
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            shard_param(self.bias, mesh, self._pl(Shard(0)))
+        else:
+            self.bias = None
+
+    def _pl(self, p):
+        return [p if n == self.axis else Replicate()
+                for n in self.mesh.dim_names]
+
+    def forward(self, x):
+        out = nn.functional.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            jm = self.mesh.jax_mesh
+
+            def impl(a):
+                return jax.device_put(a, NamedSharding(jm, P()))
+            out = apply_op("mp_gather", impl, (out,), {})
+        return out
+
+
+class RowParallelLinear(nn.Layer):
+    """Weight [in, out] sharded on in (dim 0); partial results all-reduced by
+    XLA when produced (reference: forward allreduce PyLayer)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, name=None):
+        super().__init__()
+        mesh, axis, nranks = _model_axis()
+        self.mesh = mesh
+        self.axis = axis
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        shard_param(self.weight, mesh,
+                    [Shard(0) if n == axis else Replicate()
+                     for n in mesh.dim_names])
+        # bias is applied AFTER the reduction, replicated
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        out = nn.functional.linear(x, self.weight)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class VocabParallelEmbedding(nn.Layer):
+    """Vocab-sharded embedding via shard_map: local masked lookup + psum
+    (reference mp_layers.py:49 / c_embedding kernel)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        mesh, axis, nranks = _model_axis()
+        self.mesh = mesh
+        self.axis = axis
+        self.nranks = nranks
+        self.num_embeddings = num_embeddings
+        self.per_part = num_embeddings // nranks
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        shard_param(self.weight, mesh,
+                    [Shard(0) if n == axis else Replicate()
+                     for n in mesh.dim_names])
+
+    def forward(self, x):
+        mesh, axis = self.mesh, self.axis
+        jm = mesh.jax_mesh
+        per_part = self.per_part
+        other = tuple(n for n in mesh.dim_names if n != axis)
+
+        def local_lookup(idx, w_local):
+            rank = jax.lax.axis_index(axis)
+            start = rank * per_part
+            local_idx = idx - start
+            in_range = (local_idx >= 0) & (local_idx < per_part)
+            safe = jnp.clip(local_idx, 0, per_part - 1)
+            out = jnp.take(w_local, safe, axis=0)
+            out = jnp.where(in_range[..., None], out, 0.0)
+            return jax.lax.psum(out, axis)
+
+        def impl(idx, w):
+            fn = shard_map(
+                local_lookup, mesh=jm,
+                in_specs=(P(), P(axis, None)),
+                out_specs=P(),
+                check_vma=False)
+            return fn(idx, w)
+        return apply_op("vocab_parallel_embedding", impl,
+                        (x, self.weight), {})
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Vocab-sharded softmax cross-entropy (reference mp_layers.py:744 /
+    c_softmax_with_cross_entropy kernel): global max + sum-exp + target logit
+    exchanged with psum over the model axis, logits never gathered."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        mesh, axis, nranks = _model_axis()
+        self.mesh = mesh
+        self.axis = axis
+        self.nranks = nranks
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        mesh, axis = self.mesh, self.axis
+        jm = mesh.jax_mesh
+        ignore = self.ignore_index
+
+        def local_ce(logits, lbl):
+            # logits: [B, V_local] on this shard
+            v_local = logits.shape[-1]
+            rank = jax.lax.axis_index(axis)
+            start = rank * v_local
+            # max is only for numerical stability; its gradient cancels, and
+            # pmax has no VJP rule — stop_gradient is exact here
+            gmax = jax.lax.stop_gradient(
+                jax.lax.pmax(jax.lax.stop_gradient(jnp.max(logits, axis=-1)),
+                             axis))
+            shifted = logits - gmax[..., None]
+            sumexp = jax.lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis)
+            local_lbl = lbl - start
+            in_range = (local_lbl >= 0) & (local_lbl < v_local)
+            safe = jnp.clip(local_lbl, 0, v_local - 1)
+            tgt = jnp.take_along_axis(shifted, safe[..., None], axis=-1)[..., 0]
+            tgt = jnp.where(in_range, tgt, 0.0)
+            tgt = jax.lax.psum(tgt, axis)
+            loss = jnp.log(sumexp) - tgt
+            return jnp.where(lbl == ignore, 0.0, loss)
+
+        def impl(logits, lbl):
+            fn = shard_map(local_ce, mesh=jm,
+                           in_specs=(P(None, axis), P()),
+                           out_specs=P(),
+                           check_vma=False)
+            return fn(logits, lbl)
+        return apply_op("parallel_cross_entropy", impl, (input, label), {})
+
+
+class TensorParallel(nn.Layer):
+    """Model wrapper (reference: meta_parallel/tensor_parallel.py:28). On
+    this stack parameters already carry their shardings; the wrapper is a
+    passthrough kept for API parity."""
+
+    def __init__(self, layers, hcg=None, **kwargs):
+        super().__init__()
+        self.add_sublayer("_layer", layers)
+
+    def forward(self, *args, **kwargs):
+        return self._sub_layers["_layer"](*args, **kwargs)

@@ -1,0 +1,198 @@
+"""ZeRO sharding stages (reference: DygraphShardingOptimizer
+fleet/meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py:54,
+GroupShardedStage2/3 meta_parallel/sharding/group_sharded_stage{2,3}.py,
+public API python/paddle/distributed/sharding/group_sharded.py:50).
+
+TPU-native mapping:
+- stage 1 (optimizer-state shard): optimizer accumulators are DTensors
+  sharded over the 'sharding' axis; the param update computes on shards and
+  the new params come back replicated (XLA inserts the all-gather — the
+  reference broadcasts params after the shard update).
+- stage 2 (+grad shard): grads are resharded onto the axis before the update
+  (reference reduce-scatters into per-rank grad buckets).
+- stage 3 (param shard / FSDP): params live sharded; each layer's forward
+  all-gathers its params on entry and drops them on exit via hooks
+  (reference: pre-forward/pre-backward allgather + release, stage3 :85). In
+  the traced path params simply stay sharded as jit inputs and GSPMD places
+  the all-gathers in-graph — that is the performance path used by
+  dryrun_multichip/bench.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor
+from ...optimizer.optimizer import Optimizer
+from ..placement import Shard, Replicate
+from ..mesh import ProcessMesh
+from ..dtensor import shard_param, _get_meta, _set_meta
+from .topology import get_hcg
+
+
+def _sharding_axis(hcg=None):
+    hcg = hcg or get_hcg()
+    if hcg is None:
+        raise RuntimeError("call fleet.init first")
+    return hcg.mesh, "sharding"
+
+
+def _shard_1d_spec(mesh, axis_name, ndim):
+    # shard dim 0 over the sharding axis; 0-d/scalar states stay replicated
+    if ndim == 0:
+        return PartitionSpec()
+    return PartitionSpec(axis_name, *([None] * (ndim - 1)))
+
+
+class DygraphShardingOptimizer:
+    """Stage 1/2 wrapper around any paddle_tpu Optimizer."""
+
+    def __init__(self, optimizer, hcg=None, stage=1):
+        self._inner = optimizer
+        self._mesh, self._axis = _sharding_axis(hcg)
+        self._stage = stage
+        self._wrap_states()
+
+    def _wrap_states(self):
+        inner = self._inner
+        mesh, axis = self._mesh, self._axis
+        jm = mesh.jax_mesh
+        orig_create = inner._create_state
+
+        def sharded_create(p):
+            st = orig_create(p)
+            for k, v in st.items():
+                if v.ndim >= 1 and v.shape[0] % mesh.get_dim_size(axis) == 0:
+                    st[k] = jax.device_put(
+                        v, NamedSharding(jm, _shard_1d_spec(mesh, axis, v.ndim)))
+            return st
+        inner._create_state = sharded_create
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        if self._stage >= 2:
+            # reshard grads onto the sharding axis before consuming them
+            mesh, axis = self._mesh, self._axis
+            jm = mesh.jax_mesh
+            for p in self._inner._parameter_list:
+                if p.grad is not None and p.grad.ndim >= 1 \
+                        and p.grad.shape[0] % mesh.get_dim_size(axis) == 0:
+                    p.grad._data = jax.device_put(
+                        p.grad.data,
+                        NamedSharding(jm, _shard_1d_spec(mesh, axis,
+                                                         p.grad.ndim)))
+        self._inner.step()
+        # keep params replicated (reference broadcast after shard update)
+        jm = self._mesh.jax_mesh
+        for p in self._inner._parameter_list:
+            if _get_meta(p) is None:
+                p._data = jax.device_put(p.data, NamedSharding(jm, PartitionSpec()))
+
+    def clear_grad(self, *a, **k):
+        return self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner.set_state_dict(sd)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+
+
+class GroupShardedStage2(DygraphShardingOptimizer):
+    def __init__(self, optimizer, hcg=None):
+        super().__init__(optimizer, hcg, stage=2)
+
+
+class GroupShardedStage3:
+    """Param-sharded model wrapper (eager FSDP). Params live sharded on dim 0
+    over the 'sharding' axis; forward pre-hooks re-place them replicated for
+    the layer's compute, post-hooks drop back to shards."""
+
+    def __init__(self, layer, optimizer=None, hcg=None, sync_comm=False,
+                 segment_size=2 ** 20):
+        self._layer = layer
+        self._mesh, self._axis = _sharding_axis(hcg)
+        self._optimizer = optimizer
+        jm = self._mesh.jax_mesh
+        naxis = self._mesh.get_dim_size(self._axis)
+        self._sharded_params = []
+        for _, p in layer.named_parameters():
+            if p.ndim >= 1 and p.shape[0] % naxis == 0:
+                shard_param(p, self._mesh,
+                            [Shard(0) if n == self._axis else Replicate()
+                             for n in self._mesh.dim_names])
+                self._sharded_params.append(p)
+        for _, sub in layer.named_sublayers(include_self=True):
+            if sub._parameters:
+                sub.register_forward_pre_hook(self._gather_hook(sub))
+                sub.register_forward_post_hook(self._release_hook(sub))
+
+    def _gather_hook(self, sub):
+        jm = self._mesh.jax_mesh
+
+        def hook(layer, inputs):
+            for p in layer._parameters.values():
+                if p is not None and _get_meta(p) is not None \
+                        and any(pl.is_shard() for pl in p.placements):
+                    p._shard_backup = p._data
+                    p._data = jax.device_put(
+                        p._data, NamedSharding(jm, PartitionSpec()))
+        return hook
+
+    def _release_hook(self, sub):
+        def hook(layer, inputs, outputs):
+            for p in layer._parameters.values():
+                backup = getattr(p, "_shard_backup", None) if p is not None else None
+                if backup is not None:
+                    # weights unchanged during forward; restore shard view
+                    p._data = backup
+                    p._shard_backup = None
+        return hook
+
+    def __call__(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+    def parameters(self, *a, **k):
+        return self._layer.parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layer.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layer.set_state_dict(*a, **k)
+
+    def train(self):
+        self._layer.train()
+        return self
+
+    def eval(self):
+        self._layer.eval()
+        return self
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=None,
+                           segment_size=None, sync_comm=False):
+    """Public API (reference group_sharded.py:50): level in
+    {'os', 'os_g', 'p_g_os'} -> stages 1/2/3."""
+    if level == "os":
+        optimizer = DygraphShardingOptimizer(optimizer, stage=1)
+    elif level == "os_g":
+        optimizer = GroupShardedStage2(optimizer)
+    elif level == "p_g_os":
+        model = GroupShardedStage3(model, optimizer)
+        optimizer = DygraphShardingOptimizer(optimizer, stage=2)
+    else:
+        raise ValueError(f"unknown level {level}")
+    return model, optimizer, scaler

@@ -1,0 +1,127 @@
+"""Hybrid topology (reference: python/paddle/distributed/fleet/base/
+topology.py:73-78,189 — 5-D axes [data, pipe, sharding, sep, model] and
+HybridCommunicateGroup building one communicator per axis).
+
+TPU-native: the topology IS a ProcessMesh with those axis names; "building a
+communicator" is just naming an axis (Group = mesh axis). The mesh layout
+maps onto ICI via jax's device-mesh layouter."""
+import numpy as np
+
+from ..mesh import ProcessMesh, auto_mesh, set_mesh
+from ..collective import Group, new_group
+
+AXES = ["data", "pipe", "sharding", "sep", "model"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._names = hybrid_group_names or AXES
+        self._dims = dims or [1] * len(self._names)
+
+    def get_hybrid_group_names(self):
+        return list(self._names)
+
+    def get_dim(self, name):
+        return self._dims[self._names.index(name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology=None, dp_degree=1, mp_degree=1, pp_degree=1,
+                 sharding_degree=1, sep_degree=1, order=None):
+        if topology is not None:
+            dims = dict(zip(topology._names, topology._dims))
+            dp_degree = dims.get("data", dp_degree)
+            pp_degree = dims.get("pipe", pp_degree)
+            sharding_degree = dims.get("sharding", sharding_degree)
+            sep_degree = dims.get("sep", sep_degree)
+            mp_degree = dims.get("model", mp_degree)
+        self._dp_degree = dp_degree
+        self._mp_degree = mp_degree
+        self._pp_degree = pp_degree
+        self._sharding_degree = sharding_degree
+        self._sep_degree = sep_degree
+        dims = [dp_degree, pp_degree, sharding_degree, sep_degree, mp_degree]
+        self.mesh = auto_mesh(*dims, dim_names=AXES)
+        set_mesh(self.mesh)
+        self._groups = {name: new_group(mesh=self.mesh, axis_name=name)
+                        for name in AXES}
+
+    # -- degrees ---------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # -- ranks (single-controller: the program is rank-agnostic; these
+    # return 0 so per-rank branching in ported code takes the rank-0 path) --
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_pipe_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    def get_global_rank(self):
+        import jax
+        return jax.process_index()
+
+    # -- groups ----------------------------------------------------------
+    def get_data_parallel_group(self):
+        return self._groups["data"]
+
+    def get_model_parallel_group(self):
+        return self._groups["model"]
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pipe"]
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self):
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, *a):
+        return self._groups["data"]
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def topology(self):
+        return self.mesh
+
+
+_hcg = None
+
+
+def set_hcg(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hcg():
+    return _hcg

@@ -1,0 +1,118 @@
+"""ProcessMesh (reference: paddle/phi/core/distributed/auto_parallel/
+process_mesh.h:34 + python/paddle/distributed/auto_parallel/process_mesh.py).
+
+Wraps jax.sharding.Mesh: process ids are device ids laid out in an ndarray;
+dim_names name the parallelism axes. On TPU the mesh layout IS the ICI
+topology mapping — jax's create_device_mesh picks a layout that keeps
+neighboring mesh coordinates physically adjacent, which is what makes
+collectives ride ICI instead of DCN."""
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        if isinstance(mesh, ProcessMesh):
+            self._shape = mesh.shape
+            self._dim_names = list(mesh.dim_names)
+            self._process_ids = list(mesh.process_ids)
+        else:
+            arr = np.asarray(mesh)
+            self._shape = list(arr.shape)
+            self._process_ids = arr.ravel().tolist()
+            if dim_names is None:
+                dim_names = [f"d{i}" for i in range(arr.ndim)]
+            self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    @property
+    def size(self):
+        return int(np.prod(self._shape))
+
+    def get_dim_size(self, dim_name):
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def dim_index(self, dim_name):
+        return self._dim_names.index(dim_name)
+
+    def get_mesh_with_dim(self, dim_name):
+        """Sub-mesh along one axis (parity with reference API)."""
+        idx = self.dim_index(dim_name)
+        arr = np.asarray(self._process_ids).reshape(self._shape)
+        moved = np.moveaxis(arr, idx, 0)
+        names = [dim_name] + [n for n in self._dim_names if n != dim_name]
+        return ProcessMesh(moved, dim_names=names)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            dev_by_id = {d.id: d for d in devices}
+            try:
+                arr = np.asarray([dev_by_id[i] for i in self._process_ids],
+                                 dtype=object).reshape(self._shape)
+            except KeyError:
+                # process ids beyond local devices (authoring a mesh for a
+                # larger pod): map modulo local device count so programs can
+                # still be built/dry-run locally
+                n = len(devices)
+                arr = np.asarray([devices[i % n] for i in self._process_ids],
+                                 dtype=object).reshape(self._shape)
+            self._jax_mesh = Mesh(arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._dim_names == other._dim_names
+                and self._process_ids == other._process_ids)
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._dim_names),
+                     tuple(self._process_ids)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")
+
+
+_global_mesh = None
+
+
+def set_mesh(mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh():
+    return _global_mesh
+
+
+def auto_mesh(*dim_sizes, dim_names=None):
+    """Build a ProcessMesh over the local devices with an ICI-friendly layout
+    (uses jax's create_device_mesh when shapes allow)."""
+    from jax.experimental import mesh_utils
+    shape = tuple(dim_sizes)
+    try:
+        devs = mesh_utils.create_device_mesh(shape)
+        ids = np.vectorize(lambda d: d.id)(devs)
+    except Exception:
+        ids = np.arange(int(np.prod(shape))).reshape(shape)
+    return ProcessMesh(ids, dim_names=dim_names)

@@ -1,0 +1,119 @@
+"""Process-level parallel env + DataParallel (reference:
+python/paddle/distributed/parallel.py:219,978).
+
+Single-controller SPMD note: one python process drives all local devices, so
+init_parallel_env's job shrinks from TCPStore rendezvous + per-rank NCCL
+comms to (multi-host only) jax.distributed.initialize — the JAX coordination
+service IS the TCPStore equivalent (SURVEY.md §5.8)."""
+import os
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor
+from .. import nn
+from .mesh import ProcessMesh, set_mesh, get_mesh
+
+_parallel_env = {"initialized": False}
+
+
+def init_parallel_env():
+    """Reference parallel.py:978. Reads the same env contract
+    (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER) when present to
+    bootstrap multi-host jax.distributed; on a single host it just builds the
+    default world mesh."""
+    if _parallel_env["initialized"]:
+        return
+    master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ENDPOINT")
+    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+    if master and nnodes > 1 and jax.process_count() == 1:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=master,
+                num_processes=nnodes,
+                process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+        except Exception as e:  # already initialized or single-host fallback
+            import warnings
+            warnings.warn(f"jax.distributed.initialize failed: {e!r}")
+    n = jax.device_count()
+    if get_mesh() is None:
+        set_mesh(ProcessMesh(np.arange(n), dim_names=["world"]))
+    _parallel_env["initialized"] = True
+
+
+def get_rank(group=None):
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return 0
+
+    local_rank = rank
+    nranks = world_size
+
+
+class DataParallel(nn.Layer):
+    """Reference: paddle.DataParallel (parallel.py:219) + EagerReducer
+    (reducer.h:88 — bucketed grad allreduce w/ comm overlap).
+
+    TPU-native: data parallelism is batch sharding over the 'data'/'world'
+    mesh axis. Inputs are sharded in the pre-forward; parameters stay
+    replicated, and XLA emits the gradient all-reduce inside the backward
+    program (contraction over the sharded batch dim), already overlapped by
+    the latency-hiding scheduler — the whole reducer/bucket machinery
+    dissolves into the compiler."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self.add_sublayer("_layers", layers)
+        mesh = get_mesh()
+        if mesh is None:
+            init_parallel_env()
+            mesh = get_mesh()
+        self._mesh = mesh
+        self._axis = mesh.dim_names[0]
+
+    def forward(self, *inputs, **kwargs):
+        from .dtensor import shard_tensor
+        from .placement import Shard, Replicate
+        pl = [Shard(0) if n == self._axis else Replicate()
+              for n in self._mesh.dim_names]
+        sharded = []
+        for x in inputs:
+            if isinstance(x, Tensor) and x.ndim >= 1 \
+                    and x.shape[0] % self._mesh.get_dim_size(self._axis) == 0 \
+                    and x.placements is None:
+                sharded.append(shard_tensor(x, self._mesh, pl))
+            else:
+                sharded.append(x)
+        return self._sub_layers["_layers"](*sharded, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._sub_layers["_layers"].state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._sub_layers["_layers"].set_state_dict(*a, **k)
+
+    def scale_loss(self, loss):
+        return loss  # grads reduce to the true global-batch mean in-graph
+
+    def apply_collective_grads(self):
+        pass  # no-op: XLA already reduced the grads

@@ -1,0 +1,239 @@
+"""SPMD rules (reference: paddle/phi/infermeta/spmd_rules/ — 119 rules, e.g.
+MatmulInferSpmd at matmul.h:25).
+
+Role on TPU: under jit, GSPMD does sharding propagation itself, so these
+rules are not needed per-op at dispatch time. They exist for (a) the eager
+DTensor API (deciding output placements + required input reshards, as
+dist_api_gen.py does per-op in the reference), (b) annotating traced graphs
+with sharding constraints at rule-decided points, and (c) parity/diagnostics.
+
+A rule takes (input specs, op attrs) and returns (input placements required,
+output placements). Specs are (mesh, placements, ndim) triples, abbreviated
+here to placements lists over a shared mesh.
+"""
+from .placement import Shard, Replicate, Partial
+
+RULE_TABLE = {}
+
+
+def register_rule(*names):
+    def deco(fn):
+        for n in names:
+            RULE_TABLE[n] = fn
+        return fn
+    return deco
+
+
+def get_rule(name):
+    return RULE_TABLE.get(name)
+
+
+def _replicate_like(placements):
+    return [Replicate() for _ in placements]
+
+
+@register_rule("matmul", "mm", "bmm")
+def matmul_rule(x_pl, y_pl, x_ndim=2, y_ndim=2, **attrs):
+    """Mirrors MatmulInferSpmd: batch/row sharding of x propagates to out;
+    column sharding of y propagates to out's last dim; matching shardings on
+    the contraction dim produce a Partial output."""
+    n_axes = len(x_pl)
+    out = [Replicate()] * n_axes
+    for a in range(n_axes):
+        px, py = x_pl[a], y_pl[a]
+        x_contract = isinstance(px, Shard) and px.dim == x_ndim - 1
+        y_contract = isinstance(py, Shard) and py.dim == max(y_ndim - 2, 0)
+        if x_contract and y_contract:
+            out[a] = Partial("sum")
+        elif isinstance(px, Shard) and px.dim < x_ndim - 1:
+            out[a] = Shard(px.dim)
+        elif isinstance(py, Shard) and py.dim == y_ndim - 1:
+            out[a] = Shard(x_ndim - 1)
+    return ([x_pl, y_pl], [out])
+
+
+@register_rule("add", "subtract", "multiply", "divide", "maximum", "minimum")
+def elementwise_binary_rule(x_pl, y_pl, **attrs):
+    """Align shardings; conflicting dims replicate the second input."""
+    out = []
+    y_req = []
+    for px, py in zip(x_pl, y_pl):
+        if isinstance(px, Shard):
+            out.append(px)
+            y_req.append(px)
+        elif isinstance(py, Shard):
+            out.append(py)
+            y_req.append(py)
+        else:
+            out.append(Replicate())
+            y_req.append(Replicate())
+    return ([list(x_pl), y_req], [out])
+
+
+@register_rule("relu", "gelu", "silu", "exp", "tanh", "sigmoid", "cast",
+               "scale", "dropout")
+def elementwise_unary_rule(x_pl, **attrs):
+    return ([list(x_pl)], [list(x_pl)])
+
+
+@register_rule("sum", "mean", "max", "min")
+def reduction_rule(x_pl, axis=None, x_ndim=None, **attrs):
+    """Reducing over a sharded dim yields Partial; other shardings survive
+    with dims renumbered (reference reduction.cc)."""
+    if axis is None:
+        out = [Partial("sum") if isinstance(p, Shard) else Replicate()
+               for p in x_pl]
+        return ([list(x_pl)], [out])
+    axes = set([axis] if isinstance(axis, int) else list(axis))
+    out = []
+    for p in x_pl:
+        if isinstance(p, Shard):
+            if p.dim in axes:
+                out.append(Partial("sum"))
+            else:
+                shift = sum(1 for a in axes if a < p.dim)
+                out.append(Shard(p.dim - shift))
+        else:
+            out.append(Replicate())
+    return ([list(x_pl)], [out])
+
+
+@register_rule("reshape")
+def reshape_rule(x_pl, src_shape=None, dst_shape=None, **attrs):
+    """Conservative: keep dim-0 sharding when dim 0 is preserved, otherwise
+    replicate (full symbolic mapping is reference reshape.cc)."""
+    out = []
+    for p in x_pl:
+        if isinstance(p, Shard) and p.dim == 0 and src_shape and dst_shape \
+                and src_shape[0] == dst_shape[0]:
+            out.append(Shard(0))
+        else:
+            out.append(Replicate())
+    req = [p if (isinstance(p, Shard) and p.dim == 0) else Replicate()
+           for p in x_pl]
+    return ([req], [out])
+
+
+@register_rule("transpose")
+def transpose_rule(x_pl, perm=None, **attrs):
+    out = []
+    for p in x_pl:
+        if isinstance(p, Shard) and perm is not None:
+            out.append(Shard(list(perm).index(p.dim)))
+        else:
+            out.append(p if not isinstance(p, Shard) else Replicate())
+    return ([list(x_pl)], [out])
+
+
+@register_rule("softmax", "log_softmax")
+def softmax_rule(x_pl, axis=-1, x_ndim=None, **attrs):
+    """Softmax dim must be unsharded (reference softmax.cc reshards it)."""
+    req = []
+    for p in x_pl:
+        if isinstance(p, Shard) and x_ndim is not None \
+                and p.dim == (axis % x_ndim):
+            req.append(Replicate())
+        else:
+            req.append(p)
+    return ([req], [list(req)])
+
+
+@register_rule("embedding")
+def embedding_rule(idx_pl, w_pl, **attrs):
+    """Row-sharded (vocab) weight -> Partial output; idx batch sharding
+    propagates (reference embedding.cc)."""
+    out = []
+    for pi, pw in zip(idx_pl, w_pl):
+        if isinstance(pw, Shard) and pw.dim == 0:
+            out.append(Partial("sum"))
+        elif isinstance(pi, Shard):
+            out.append(Shard(pi.dim))
+        elif isinstance(pw, Shard) and pw.dim == 1:
+            out.append(Shard(-1))
+        else:
+            out.append(Replicate())
+    return ([list(idx_pl), list(w_pl)], [out])
+
+
+@register_rule("layer_norm", "rms_norm")
+def norm_rule(x_pl, x_ndim=None, **attrs):
+    """Normalized (last) dim must be whole; leading shardings survive."""
+    req = []
+    for p in x_pl:
+        if isinstance(p, Shard) and x_ndim is not None and p.dim == x_ndim - 1:
+            req.append(Replicate())
+        else:
+            req.append(p)
+    return ([req], [list(req)])
+
+
+@register_rule("flash_attention", "sdpa")
+def flash_attention_rule(q_pl, k_pl, v_pl, **attrs):
+    """Reference flash_attention.cc: shard batch (dim 0) and heads (dim 2 of
+    [B,S,H,D]); sequence + head_dim replicated. (Sequence sharding is the
+    ring-attention upgrade — paddle_tpu.ops.pallas.ring_attention.)"""
+    def fix(pl):
+        out = []
+        for p in pl:
+            if isinstance(p, Shard) and p.dim in (0, 2):
+                out.append(p)
+            else:
+                out.append(Replicate() if isinstance(p, Shard) else p)
+        return out
+    q2, k2, v2 = fix(q_pl), fix(k_pl), fix(v_pl)
+    return ([q2, k2, v2], [q2])
+
+
+@register_rule("cross_entropy", "softmax_with_cross_entropy")
+def cross_entropy_rule(logits_pl, label_pl, x_ndim=None, **attrs):
+    """Class dim replicated unless using the parallel CE path
+    (fleet.ParallelCrossEntropy handles vocab-sharded logits)."""
+    req = []
+    for p in logits_pl:
+        if isinstance(p, Shard) and x_ndim is not None and p.dim == x_ndim - 1:
+            req.append(Replicate())
+        else:
+            req.append(p)
+    out = [p if isinstance(p, Shard) else Replicate() for p in req]
+    return ([req, list(label_pl)], [out])
+
+
+@register_rule("concat")
+def concat_rule(input_pls, axis=0, **attrs):
+    first = input_pls[0]
+    req = []
+    for p in first:
+        if isinstance(p, Shard) and p.dim == axis:
+            req.append(Replicate())
+        else:
+            req.append(p)
+    return ([req] * len(input_pls), [list(req)])
+
+
+@register_rule("split")
+def split_rule(x_pl, axis=0, **attrs):
+    req = []
+    for p in x_pl:
+        if isinstance(p, Shard) and p.dim == axis:
+            req.append(Replicate())
+        else:
+            req.append(p)
+    return ([req], [list(req)])
+
+
+@register_rule("fused_rope", "rope")
+def rope_rule(x_pl, **attrs):
+    return ([list(x_pl)], [list(x_pl)])
+
+
+@register_rule("fused_linear_param_grad_add")
+def fused_linear_param_grad_add_rule(x_pl, dy_pl, dw_pl, **attrs):
+    # dW += dY^T X : contraction over batch/sequence -> partial over any axis
+    # sharding those dims (reference fused_linear_param_grad_add spmd rule)
+    out = []
+    for px, pd in zip(x_pl, dy_pl):
+        if isinstance(px, Shard) and px.dim == 0:
+            out.append(Partial("sum"))
+        else:
+            out.append(Replicate())
+    return ([list(x_pl), list(dy_pl), list(dw_pl)], [out])
