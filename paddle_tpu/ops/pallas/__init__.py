@@ -1,0 +1,9 @@
+"""Pallas TPU kernel tier.
+
+Reference analogue: paddle/phi/kernels/fusion/gpu/ (the hand-fused CUDA
+kernels, SURVEY.md §2.9). On TPU these are Pallas kernels: flash attention
+(flash_attn_kernel.cu), rotary embedding (fused_rope_kernel.cu), fused
+rmsnorm (fused_layernorm_kernel.cu). XLA already fuses most elementwise
+chains; only kernels that need manual tiling/online-softmax live here.
+"""
+from . import flash_attention  # noqa: F401
