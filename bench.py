@@ -1,0 +1,89 @@
+"""Headline benchmark: Llama pretraining tokens/sec/chip (north star in
+BASELINE.md — the reference publishes no in-repo numbers, so vs_baseline is
+our measured MFU against the 0.5 MFU bar that A100 Megatron-class stacks
+report for Llama-2 pretraining).
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, pretrain
+
+    if on_tpu:
+        # ~350M-param llama (bf16 compute, fp32 master weights, per-layer
+        # remat) sized for a single chip
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=24, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16")
+        batch, seq, iters, warmup = 8, 2048, 20, 3
+    else:  # CPU smoke so the driver always gets a line
+        cfg = LlamaConfig.tiny(dtype="float32")
+        batch, seq, iters, warmup = 4, 64, 3, 1
+
+    model = LlamaForCausalLM(cfg)
+    mesh = pretrain.make_mesh(1, dp=1, fsdp=1, mp=1, sp=1)
+    params, opt_state, meta = pretrain.make_train_state(model, mesh)
+    step = pretrain.make_train_step(model, mesh, meta)
+    rng = np.random.default_rng(0)
+    batch_data = pretrain.shard_batch(
+        {"input_ids": rng.integers(0, cfg.vocab_size,
+                                   (batch, seq)).astype(np.int32),
+         "labels": rng.integers(0, cfg.vocab_size,
+                                (batch, seq)).astype(np.int32)}, mesh)
+
+    for _ in range(warmup):
+        params, opt_state, loss, gnorm = step(params, opt_state, batch_data)
+    float(loss)  # full sync (block_until_ready is a no-op through the tunnel)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss, gnorm = step(params, opt_state, batch_data)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+
+    # MFU: 6*N per token (fwd+bwd) + attention term, vs chip peak
+    n_params = sum(int(np.prod(p.shape)) for p in params.values())
+    flops_per_token = 6 * n_params + \
+        12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    achieved = flops_per_token * tokens_per_sec
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        peak = 197e12
+    elif "v5p" in kind or "v5" in kind:
+        peak = 459e12
+    elif "v4" in kind:
+        peak = 275e12
+    elif on_tpu:
+        peak = 275e12
+    else:
+        peak = 1e12  # nominal for CPU smoke
+    mfu = achieved / peak
+
+    print(json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": f"tokens/s ({'tpu' if on_tpu else 'cpu-smoke'}, "
+                f"{n_params/1e6:.0f}M params, bs{batch}x{seq}, "
+                f"mfu={mfu:.3f}, loss={float(loss):.3f})",
+        "vs_baseline": round(mfu / 0.5, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,7 @@ from .collective import (ReduceOp, Group, new_group, get_group, all_reduce,
 from .parallel import (init_parallel_env, get_rank, get_world_size,
                        ParallelEnv, DataParallel)
 from .spmd_rules import RULE_TABLE, get_rule, register_rule
+from .constraint import sharding_constraint, current_mesh
 from . import fleet
 from .auto_parallel import to_static as _ap_to_static  # noqa: F401 (optional)
 from . import auto_parallel

@@ -1,0 +1,58 @@
+"""Activation sharding constraints (the scaling-book recipe: annotate a few
+load-bearing activations, let GSPMD propagate the rest).
+
+Reference analogue: the static auto-parallel sharding-propagation "completion"
+pass (SURVEY.md §3.5 — engine.py:669 mix2dist → propagation); here the
+compiler does propagation natively and this helper is the annotation point.
+Model code calls `sharding_constraint(x, 'axes', ...)` unconditionally: it is
+a no-op outside a Mesh context, so the same model runs single-chip, under
+jit, or fully sharded.
+"""
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax._src import mesh as _mesh_lib
+
+from ..core.dispatch import apply_op
+
+__all__ = ["current_mesh", "sharding_constraint"]
+
+
+def current_mesh():
+    """The jax Mesh active via `with mesh:` (None when not in a mesh
+    context)."""
+    m = _mesh_lib.thread_resources.env.physical_mesh
+    return None if m.empty else m
+
+
+def _resolve(mesh, dims, ndim):
+    out = []
+    for d in range(ndim):
+        ax = dims[d] if d < len(dims) else None
+        if ax is None:
+            out.append(None)
+            continue
+        axes = ax if isinstance(ax, (tuple, list)) else (ax,)
+        # drop axes the mesh doesn't carry (or carries at size 1)
+        axes = tuple(a for a in axes if a in mesh.axis_names
+                     and mesh.shape[a] > 1)
+        out.append(axes if len(axes) > 1 else (axes[0] if axes else None))
+    return P(*out)
+
+
+def sharding_constraint(x, *dims):
+    """Constrain activation x to PartitionSpec(*dims) on the active mesh.
+    dims entries: axis name, tuple of axis names, or None. Axes absent from
+    the active mesh degrade to None; outside a mesh context this is the
+    identity (eager single-chip path)."""
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+
+    ndim = len(x.shape)
+    spec = _resolve(mesh, dims, ndim)
+
+    def impl(a):
+        return jax.lax.with_sharding_constraint(
+            a, NamedSharding(mesh, spec))
+
+    return apply_op("sharding_constraint", impl, (x,), {})

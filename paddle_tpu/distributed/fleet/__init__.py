@@ -13,6 +13,8 @@ from .sp_layers import (ColumnSequenceParallelLinear,
 from .sharding import (DygraphShardingOptimizer, GroupShardedStage2,
                        GroupShardedStage3, group_sharded_parallel)
 from .hybrid_optimizer import HybridParallelOptimizer, HybridParallelClipGrad
+from . import recompute as _recompute_mod
+from .recompute import recompute, recompute_sequential
 
 
 class DistributedStrategy:

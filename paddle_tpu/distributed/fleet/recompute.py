@@ -1,0 +1,83 @@
+"""Activation recomputation (reference: python/paddle/distributed/fleet/
+recompute/recompute.py:128,227 — a PyLayer that stashes RNG state and
+re-runs forward inside backward).
+
+TPU-native: `jax.checkpoint` (rematerialisation) IS the recompute mechanism —
+XLA re-emits the forward ops into the backward computation and schedules
+them, no manual PyLayer/RNG bookkeeping. Eager-mode: the checkpointed region
+enters the autograd tape as ONE op whose vjp rematerialises; traced mode:
+jax.checkpoint composes with jit directly.
+"""
+import jax
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ...jit.functional import pure_call
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, **kwargs):
+    """Run `function(*args)` without saving intermediate activations; they are
+    recomputed during backward (reference recompute.py:128). `function` may be
+    a Layer (its parameters participate in grad) or a pure function of its
+    tensor arguments."""
+    kwargs.pop("preserve_rng_state", None)  # jax keys are functional; nothing to stash
+    kwargs.pop("use_reentrant", None)
+
+    if isinstance(function, Layer):
+        params = {n: p for n, p in function.named_parameters()
+                  if not p.stop_gradient}
+
+        def impl(pdict, *arrs):
+            def inner(pd, *aa):
+                return pure_call(function, pd, None, *aa, **kwargs)
+            return jax.checkpoint(inner)(pdict, *arrs)
+
+        return apply_op("recompute", impl, (params, *args), {})
+
+    def impl(*arrs):
+        def inner(*aa):
+            wrapped = [Tensor(a) if not isinstance(a, Tensor) else a
+                       for a in aa]
+            out = function(*wrapped, **kwargs)
+            return jax.tree_util.tree_map(
+                lambda t: t.data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+        return jax.checkpoint(inner)(*arrs)
+
+    return apply_op("recompute", impl, args, {})
+
+
+class _Chunk(Layer):
+    """A run of sublayers checkpointed as one unit."""
+
+    def __init__(self, mods):
+        super().__init__()
+        from ...nn.layers.container import LayerList
+        self.mods = LayerList(mods)
+
+    def forward(self, *xs):
+        for m in self.mods:
+            xs = m(*xs) if isinstance(xs, tuple) else m(xs)
+            if not isinstance(xs, tuple):
+                xs = (xs,)
+        return xs if len(xs) > 1 else xs[0]
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Segmented recompute over a Sequential (reference
+    recompute_sequential): splits `functions` into `ctx['segments']` chunks,
+    each chunk checkpointed as a unit."""
+    segments = (ctx or {}).get("segments", 1)
+    if isinstance(functions, Layer):
+        functions = list(functions.children())
+    n = len(functions)
+    seg_len = max(1, n // max(1, segments))
+    out = args
+    for i in range(0, n, seg_len):
+        out = recompute(_Chunk(functions[i:i + seg_len]),
+                        *(out if isinstance(out, tuple) else (out,)),
+                        **kwargs)
+    return out

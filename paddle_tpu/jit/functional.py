@@ -45,7 +45,8 @@ def functional_call(layer, params, buffers, *args, **kwargs):
         if name in named_b:
             tensors.append(named_b[name])
             arrays.append(arr)
-    wrapped = [Tensor(a) if not isinstance(a, Tensor) else a for a in args]
+    wrapped = [a if a is None or isinstance(a, Tensor) else Tensor(a)
+               for a in args]
     with _swapped(tensors, arrays):
         return layer(*wrapped, **kwargs)
 

@@ -1,0 +1,20 @@
+"""paddle_tpu.models — LLM model families (flagship: Llama).
+
+The reference keeps its llama decoder in the auto-parallel test tree
+(/root/reference/test/auto_parallel/hybrid_strategy/
+semi_auto_parallel_llama_model.py); here LLM families are first-class,
+TPU-native (bf16-first, flash-attention Pallas path, mesh sharding plans).
+"""
+from . import llama
+from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM, LlamaPretrainingCriterion
+from . import gpt
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM
+from . import pretrain
+from .pretrain import make_train_state, make_train_step, llama_sharding_rules
+
+__all__ = [
+    "llama", "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+    "LlamaPretrainingCriterion", "gpt", "GPTConfig", "GPTModel",
+    "GPTForCausalLM", "pretrain", "make_train_state", "make_train_step",
+    "llama_sharding_rules",
+]

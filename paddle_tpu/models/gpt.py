@@ -1,0 +1,131 @@
+"""GPT-2/ERNIE-style decoder family (reference: ERNIE TP+PP config in
+BASELINE.json; the reference ships GPT layers through fleet mp tests,
+e.g. /root/reference/test/collective/fleet/ hybrid tests).
+
+Architecturally: learned position embeddings, pre-LayerNorm blocks, fused
+QKV projection (one [H, 3H] matmul — better MXU utilisation than three
+separate projections), gelu MLP. bf16-first like llama."""
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=None, max_position_embeddings=1024,
+                 layer_norm_epsilon=1e-5, initializer_range=0.02,
+                 dropout=0.0, tie_word_embeddings=True, dtype="bfloat16",
+                 **kwargs):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.initializer_range = initializer_range
+        self.dropout = dropout
+        self.tie_word_embeddings = tie_word_embeddings
+        self.dtype = dtype
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        base = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=128)
+        base.update(kw)
+        return cls(**base)
+
+
+def _attr(config):
+    return nn.ParamAttr(initializer=I.Normal(0.0, config.initializer_range))
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.head_dim
+        self.qkv_proj = nn.Linear(h, 3 * h, weight_attr=_attr(config))
+        self.out_proj = nn.Linear(h, h, weight_attr=_attr(config))
+        self.dropout = config.dropout
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads,
+                                        self.head_dim])
+        q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+        out, _ = F.flash_attention(q, k, v, dropout=self.dropout, causal=True,
+                                   training=self.training)
+        return self.out_proj(out.reshape([b, s, -1]))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        h = config.hidden_size
+        self.ln_1 = nn.LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.linear1 = nn.Linear(h, config.intermediate_size,
+                                 weight_attr=_attr(config))
+        self.linear2 = nn.Linear(config.intermediate_size, h,
+                                 weight_attr=_attr(config))
+        self.drop = nn.Dropout(config.dropout)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        return x + self.drop(self.linear2(F.gelu(self.linear1(self.ln_2(x)))))
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.word_embeddings = nn.Embedding(config.vocab_size,
+                                            config.hidden_size,
+                                            weight_attr=_attr(config))
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=_attr(config))
+        self.h = nn.LayerList([GPTBlock(config)
+                               for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        from .. import ops
+        if position_ids is None:
+            position_ids = ops.arange(0, input_ids.shape[1], dtype="int64")
+        x = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids)
+        if self.config.dtype == "bfloat16":
+            x = x.astype("bfloat16")
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.gpt = self.model = GPTModel(config)
+
+    def forward(self, input_ids, position_ids=None, labels=None):
+        hidden = self.model(input_ids, position_ids)
+        w = self.model.word_embeddings.weight
+        logits = F.linear(hidden, w.t().astype(hidden.dtype))
+        if labels is not None:
+            v = logits.shape[-1]
+            loss = F.cross_entropy(logits.reshape([-1, v]),
+                                   labels.reshape([-1]))
+            return logits, loss
+        return logits

@@ -30,6 +30,10 @@ from .attention import (
     flash_attention, scaled_dot_product_attention, flashmask_attention,
     flash_attn_unpadded,
 )
+from .rope import (
+    rotary_embedding_cos_sin, apply_rotary_pos_emb,
+    fused_rotary_position_embedding,
+)
 
 # ops that live in the core registry but are also exposed via F (paddle parity)
 from ...ops import pad  # noqa: F401

@@ -32,6 +32,10 @@ def _sdpa_ref(q, k, v, mask=None, dropout=0.0, causal=False, scale=None,
     flash_attention layout)."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if k.shape[2] != q.shape[2]:  # GQA: broadcast KV head groups
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     # [B,S,H,D] -> [B,H,S,D]
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)

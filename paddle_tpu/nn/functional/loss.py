@@ -21,8 +21,35 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     python/paddle/nn/functional/loss.py cross_entropy): input is logits by
     default (use_softmax=True), label is int class ids or soft distribution."""
     def impl(logits, lbl, *maybe_w):
+        last = axis in (-1, logits.ndim - 1)
+        if use_softmax and not soft_label and last and not maybe_w:
+            # streamed lse path: never materializes the [N, V] fp32
+            # log-softmax (2GB at 16k x 32k) — fp32 accumulation happens
+            # inside the fused reduction; bwd is softmax - onehot
+            lbl_i = lbl.astype(jnp.int32)
+            if lbl_i.ndim == logits.ndim:
+                lbl_i = jnp.squeeze(lbl_i, axis=-1)
+            valid = (lbl_i != ignore_index)
+            safe = jnp.where(valid, lbl_i, 0)
+            m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+            shifted = (logits - m).astype(jnp.float32)
+            lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) \
+                + m[..., 0].astype(jnp.float32)
+            picked = jnp.take_along_axis(
+                logits, safe[..., None], axis=-1)[..., 0].astype(jnp.float32)
+            loss = lse - picked
+            if label_smoothing > 0:
+                mean_l = jnp.mean(logits.astype(jnp.float32), axis=-1)
+                loss = (1 - label_smoothing) * loss \
+                    + label_smoothing * (lse - mean_l)
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return _reduce(loss, reduction)
         if use_softmax:
-            logp = jax.nn.log_softmax(logits, axis=axis)
+            # fp32 softmax accumulation regardless of logits dtype
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
         else:
             logp = jnp.log(jnp.maximum(logits, 1e-30))
         n_classes = logits.shape[axis]

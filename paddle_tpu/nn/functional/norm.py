@@ -59,13 +59,15 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
     axes = tuple(range(-n_axes, 0))
 
     def impl(a, *wb):
-        mean = jnp.mean(a, axis=axes, keepdims=True)
-        var = jnp.var(a, axis=axes, keepdims=True)
-        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        dtype = a.dtype
+        a32 = a.astype(jnp.float32)  # fp32 statistics, output in input dtype
+        mean = jnp.mean(a32, axis=axes, keepdims=True)
+        var = jnp.var(a32, axis=axes, keepdims=True)
+        out = ((a32 - mean) * jax.lax.rsqrt(var + epsilon)).astype(dtype)
         if len(wb) >= 1 and wb[0] is not None:
-            out = out * wb[0]
+            out = out * wb[0].astype(dtype)
         if len(wb) == 2 and wb[1] is not None:
-            out = out + wb[1]
+            out = out + wb[1].astype(dtype)
         return out
 
     args = [x]
@@ -86,7 +88,9 @@ def rms_norm(x, weight=None, epsilon=1e-6):
         out = a32 * jax.lax.rsqrt(ms + epsilon)
         out = out.astype(dtype)
         if w:
-            out = out * w[0]
+            # keep the compute dtype (a fp32 scale must not promote a bf16
+            # activation — that would silently turn the whole network fp32)
+            out = out * w[0].astype(dtype)
         return out
     args = (x,) if weight is None else (x, weight)
     return apply_op("rms_norm", impl, args, {})

@@ -1,0 +1,97 @@
+"""Rotary position embedding (reference: paddle/phi/kernels/fusion/gpu/
+fused_rope_kernel.cu + python/paddle/incubate/nn/functional/
+fused_rotary_position_embedding.py).
+
+TPU-native: RoPE is a bandwidth-bound elementwise op sandwiched between the
+QKV projection and attention — exactly what XLA fuses into neighbours for
+free, so the "fused" kernel here is a jnp expression (the Pallas flash kernel
+can also absorb it). Layout matches paddle: [batch, seq, heads, head_dim].
+"""
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+
+__all__ = [
+    "rotary_embedding_cos_sin", "apply_rotary_pos_emb",
+    "fused_rotary_position_embedding",
+]
+
+
+def rotary_embedding_cos_sin(seq_len, head_dim, base=10000.0,
+                             position_ids=None, dtype=jnp.float32):
+    """cos/sin tables [seq, head_dim//2] (fp32 accumulation, cast by caller)."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                          dtype=jnp.float32) / head_dim))
+    if position_ids is None:
+        t = jnp.arange(seq_len, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv_freq)                      # [S, D/2]
+    else:
+        freqs = position_ids[..., None].astype(jnp.float32) * inv_freq
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def _rotate(x, cos, sin, use_neox):
+    """x: [B, S, H, D]; cos/sin: [S, D/2] or [B, S, D/2] broadcastable."""
+    if cos.ndim == 2:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    else:  # [B, S, D/2] from position_ids
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    if use_neox:
+        # neox style: rotate [x_{0:D/2}, x_{D/2:D}] halves
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate([x1 * cos - x2 * sin,
+                                x2 * cos + x1 * sin], axis=-1)
+    # GPT-J / interleaved style: rotate even/odd pairs
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.reshape(x.shape)
+
+
+def apply_rotary_pos_emb(x, cos, sin, use_neox_rotary_style=True):
+    cdtype = x.dtype
+
+    def impl(a, c, s):
+        return _rotate(a, c.astype(cdtype), s.astype(cdtype),
+                       use_neox_rotary_style)
+    return apply_op("rope", impl, (x, cos, sin), {})
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    rotary_emb_base=10000.0):
+    """Parity with paddle.incubate.nn.functional.fused_rotary_position_embedding:
+    q/k/v are [B, S, H, D]; returns rotated (q, k, v) (v passes through when
+    given, matching the reference's optional-rotation contract)."""
+    head_dim = int(q.shape[-1])
+    seq_len = int(q.shape[1])
+    if cos is None or sin is None:
+        cos, sin = rotary_embedding_cos_sin(
+            seq_len, head_dim, base=rotary_emb_base, position_ids=position_ids)
+    else:
+        # paddle passes [1, S_max, 1, D] tables; reduce to canonical [S, D/2]
+        # respecting the pair layout: neox duplicates halves ([f, f]), GPT-J
+        # interleaves pairs ([f0, f0, f1, f1, ...])
+        cos = jnp.asarray(cos.data if hasattr(cos, "data") else cos)
+        sin = jnp.asarray(sin.data if hasattr(sin, "data") else sin)
+        cos = cos.reshape(cos.shape[-3], cos.shape[-1])
+        sin = sin.reshape(sin.shape[-3], sin.shape[-1])
+        if use_neox_rotary_style:
+            cos, sin = cos[:, : head_dim // 2], sin[:, : head_dim // 2]
+        else:
+            cos, sin = cos[:, 0::2], sin[:, 0::2]
+        if position_ids is not None:
+            # decode path: gather the rows for the requested positions
+            # (reference fused_rope gathers sin/cos by position_ids)
+            cos = jnp.take(cos, position_ids, axis=0)   # [B, S, D/2]
+            sin = jnp.take(sin, position_ids, axis=0)
+        elif cos.shape[0] != seq_len:
+            cos, sin = cos[:seq_len], sin[:seq_len]
+    outs = [apply_rotary_pos_emb(q, cos, sin, use_neox_rotary_style)]
+    outs.append(apply_rotary_pos_emb(k, cos, sin, use_neox_rotary_style)
+                if k is not None else None)
+    outs.append(v)
+    return tuple(outs)

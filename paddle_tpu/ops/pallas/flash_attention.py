@@ -10,9 +10,11 @@ backward is the standard two-pass flash backward (dq pass gridded over query
 blocks; dkv pass gridded over key blocks) using the saved logsumexp; the
 softmax-grad correction term delta = rowsum(do*o) is recomputed in-kernel.
 
-The saved logsumexp is materialized as [BH, S, 128] (value broadcast over a
-128-lane trailing dim) to satisfy TPU tiling constraints — same layout
-choice as jax.experimental.pallas.ops.tpu.flash_attention.
+The saved logsumexp is materialized as [BH, S, 8] f32 (one sublane tile —
+the minimum the TPU tiling constraints allow; a 128-lane-broadcast residual
+would cost 16x more HBM, 128MB/layer at 7B shapes). In-kernel running
+max/denominator scratch stays lane-broadcast [block_q, 128] for VPU-friendly
+shapes.
 
 Layout contract: [B, S, H, D] at the API boundary (paddle's flash_attention
 layout); kernels run on [B*H, S, D].
@@ -25,9 +27,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 512   # measured on v5e: (512, 1024) is ~3.4x faster than
+DEFAULT_BLOCK_K = 1024  # (128, 128) fwd+bwd and beats the stock jax kernel
 LANES = 128
+LSE_LANES = 8  # one f32 sublane tile: smallest legal trailing dim
 NEG_INF = -1e30
 
 _INTERPRET = False  # set True in tests to run kernels on CPU
@@ -104,8 +107,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         l = l_scr[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
         o_ref[0] = (acc[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[...] + jnp.log(jnp.where(l_scr[...] == 0.0, 1.0,
-                                                    l_scr[...]))
+        lse = m_scr[:, :1] + jnp.log(jnp.where(l_scr[:, :1] == 0.0, 1.0,
+                                               l_scr[:, :1]))
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
@@ -130,11 +134,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LSE_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, LSE_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -169,7 +173,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         v = v_ref[0].astype(jnp.float32)
         o = o_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, :1]                      # [BQ, 1]
+        lse = lse_ref[0, :, :1]                      # [BQ, 1]
         delta = jnp.sum(do * o, axis=1, keepdims=True)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -224,7 +228,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         v = v_ref[0].astype(jnp.float32)
         o = o_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, :1]
+        lse = lse_ref[0, :, :1]                      # [BQ, 1]
         delta = jnp.sum(do * o, axis=1, keepdims=True)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -280,7 +284,7 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LSE_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -298,7 +302,7 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LSE_LANES), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
