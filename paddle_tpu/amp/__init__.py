@@ -1,1 +1,198 @@
-"""Placeholder — populated in a later milestone this round."""
+"""Automatic mixed precision.
+
+Reference surface: python/paddle/amp/ — `auto_cast` (auto_cast.py:1006),
+O1/O2 op lists (amp_lists.py), `GradScaler`/`AmpScaler` dynamic loss scaling
+(grad_scaler.py:62,657), `decorate` master-weight handling.
+
+TPU-first design: bf16 is the native mixed-precision dtype (MXU computes in
+bf16 with fp32 accumulation), so `dtype='bfloat16'` is the default and needs
+no loss scaling; fp16 + dynamic GradScaler is kept for API parity. Casting
+is implemented as a hook on the single eager-dispatch choke point
+(paddle_tpu/core/dispatch.py set_amp_hook) — the same role as the AMP
+auto-cast hook the reference's codegen injects into every `<op>_ad_func`
+(paddle/fluid/eager/auto_code_generator/generator/eager_gen.py).
+"""
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dispatch as _dispatch
+from ..core.dtypes import convert_dtype
+
+from . import amp_lists
+from .amp_lists import white_list, black_list
+from .grad_scaler import GradScaler, AmpScaler, OptimizerState
+from . import debugging
+
+__all__ = [
+    "auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler",
+    "AmpScaler", "white_list", "black_list", "is_float16_supported",
+    "is_bfloat16_supported", "debugging",
+]
+
+_FLOATS = (jnp.float32, jnp.float16, jnp.bfloat16, jnp.float64)
+
+
+class _AmpState:
+    __slots__ = ("enable", "dtype", "level", "white", "black", "use_promote")
+
+    def __init__(self, enable, dtype, level, white, black, use_promote):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level
+        self.white = white
+        self.black = black
+        self.use_promote = use_promote
+
+
+_stack = []
+_in_hook = False
+
+
+def _cast(t, dtype):
+    if isinstance(t, Tensor) and t.dtype in _FLOATS and t.dtype != dtype:
+        from .. import ops
+        return ops.cast(t, dtype)
+    return t
+
+
+def _hook(name, args, kwargs):
+    """Installed on the eager dispatch path while any auto_cast is active."""
+    global _in_hook
+    if _in_hook or not _stack:
+        return args, kwargs
+    st = _stack[-1]
+    if not st.enable or name in ("cast", "getitem", "setitem", "clone"):
+        return args, kwargs
+
+    if name in st.black:
+        target = jnp.float32
+    elif name in st.white or st.level == "O2":
+        target = st.dtype
+    elif st.use_promote:
+        # gray ops: promote — run in fp32 if any float input is fp32,
+        # else keep the low-precision dtype flowing through
+        has_f32 = any(isinstance(a, Tensor) and a.dtype == jnp.float32
+                      for a in list(args) + list(kwargs.values()))
+        target = jnp.float32 if has_f32 else st.dtype
+    else:
+        return args, kwargs
+
+    _in_hook = True
+    try:
+        args = tuple(_cast(a, target) for a in args)
+        kwargs = {k: _cast(v, target) for k, v in kwargs.items()}
+    finally:
+        _in_hook = False
+    return args, kwargs
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """Mixed-precision context (reference: python/paddle/amp/auto_cast.py:1006).
+
+    level O1: white-list ops run in `dtype`, black-list ops in fp32, the rest
+    promote. level O2: everything but the black list runs in `dtype`.
+    """
+    if level not in ("O0", "O1", "O2", "OD"):
+        raise ValueError(f"level should be O0/OD/O1/O2, got {level}")
+    target = convert_dtype(dtype)
+    if jnp.dtype(target) not in (jnp.dtype(jnp.float16), jnp.dtype(jnp.bfloat16)):
+        raise ValueError(f"amp dtype must be float16/bfloat16, got {dtype}")
+    white, black = amp_lists._get_lists(level)
+    if custom_white_list:
+        white = white | set(custom_white_list)
+        black = black - set(custom_white_list)
+    if custom_black_list:
+        black = black | set(custom_black_list)
+        white = white - set(custom_black_list)
+    st = _AmpState(enable and level != "O0", jnp.dtype(target), level,
+                   white, black, use_promote)
+    _stack.append(st)
+    _sync_hook()
+    try:
+        yield
+    finally:
+        _stack.pop()
+        _sync_hook()
+
+
+def _master_hook(name, args, kwargs):
+    """Single hook in the dispatch slot: autocast casting first, then the
+    debugging collectors/checkers (so they see post-cast dtypes)."""
+    if _stack:
+        args, kwargs = _hook(name, args, kwargs)
+    if debugging._stats is not None or debugging._checker is not None:
+        args, kwargs = debugging._stats_hook(name, args, kwargs)
+    return args, kwargs
+
+
+def _sync_hook():
+    active = (bool(_stack) or debugging._stats is not None
+              or debugging._checker is not None)
+    _dispatch.set_amp_hook(_master_hook if active else None)
+
+
+amp_guard = auto_cast  # legacy alias (python/paddle/amp/auto_cast.py amp_guard)
+
+
+def _is_norm_param_holder(layer):
+    name = type(layer).__name__
+    return ("Norm" in name) or name in ("BatchNorm", "SyncBatchNorm")
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """Cast model params for O2 training, keep norm layers fp32, enable
+    optimizer master weights (reference: python/paddle/amp/auto_cast.py
+    amp_decorate path).
+    """
+    from ..nn.layer import Layer
+
+    if level not in ("O1", "O2"):
+        raise ValueError("decorate level must be O1 or O2")
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    single_opt = optimizers is not None and not isinstance(optimizers, (list, tuple))
+    opt_list = ([optimizers] if single_opt
+                else list(optimizers) if optimizers is not None else [])
+
+    if level == "O2":
+        target = convert_dtype(dtype)
+        excluded = set()
+        for m in model_list:
+            for sub in m.sublayers(include_self=True):
+                if _is_norm_param_holder(sub) or (
+                        excluded_layers and isinstance(sub, tuple(excluded_layers))):
+                    excluded.update(id(p) for p in sub.parameters(include_sublayers=False))
+        for m in model_list:
+            for p in m.parameters():
+                if p.dtype == jnp.float32 and id(p) not in excluded:
+                    p._data = p._data.astype(target)
+        for opt in opt_list:
+            if master_weight is None or master_weight:
+                opt._multi_precision = True
+
+    if save_dtype is not None:
+        for m in model_list:
+            m._amp_save_dtype = save_dtype
+
+    models_out = model_list[0] if single_model else model_list
+    if optimizers is None:
+        return models_out
+    return models_out, (opt_list[0] if single_opt else opt_list)
+
+
+amp_decorate = decorate
+
+
+def is_float16_supported(device=None):
+    return True  # XLA emulates fp16 on all backends; TPU computes natively
+
+
+def is_bfloat16_supported(device=None):
+    return True

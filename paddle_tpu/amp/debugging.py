@@ -1,0 +1,116 @@
+"""AMP debugging utilities (reference: python/paddle/amp/debugging.py —
+operator stats collection, tensor checking / nan-inf watch).
+
+The op-stats collector rides the same dispatch hook slot as auto_cast; the
+tensor checker is the eager analogue of FLAGS_check_nan_inf
+(paddle/common/flags.cc:72, paddle/fluid/eager/nan_inf_utils.cc).
+"""
+import contextlib
+from collections import defaultdict
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+_stats = None  # {op_name: {dtype_str: count}} while collecting
+_checker = None
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=None, output_dir=None,
+                 checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+
+
+def _stats_hook(name, args, kwargs):
+    prev_args, prev_kwargs = args, kwargs
+    if _stats is not None:
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, Tensor):
+                _stats[name][str(a.dtype)] += 1
+    if _checker is not None and _checker.enable:
+        cfg = _checker
+        if name not in cfg.skipped_op_list and (
+                not cfg.checked_op_list or name in cfg.checked_op_list):
+            for a in list(args) + list(kwargs.values()):
+                if isinstance(a, Tensor) and jnp.issubdtype(a.dtype, jnp.floating):
+                    if not bool(jnp.isfinite(a.data).all()):
+                        raise FloatingPointError(
+                            f"nan/inf detected in input of op '{name}'")
+    return prev_args, prev_kwargs
+
+
+def _install():
+    from . import _sync_hook
+    _sync_hook()
+
+
+_uninstall = _install
+
+
+def enable_operator_stats_collection():
+    global _stats
+    _stats = defaultdict(lambda: defaultdict(int))
+    _install()
+
+
+def disable_operator_stats_collection():
+    global _stats
+    stats = _stats
+    _stats = None
+    _uninstall()
+    if stats:
+        print("<{:-^120}>".format(" op list "))
+        fmt = "<{:-^40}" + "|{:-^17}" * 4 + ">"
+        print(fmt.format("Op Name", "FP16 Calls", "BF16 Calls",
+                         "FP32 Calls", "Other Calls"))
+        for op in sorted(stats):
+            d = stats[op]
+            f16 = d.get("float16", 0)
+            bf16 = d.get("bfloat16", 0)
+            f32 = d.get("float32", 0)
+            other = sum(v for k, v in d.items()
+                        if k not in ("float16", "bfloat16", "float32"))
+            print("<{:-^40}".format(op)
+                  + "|{:-^17}|{:-^17}|{:-^17}|{:-^17}>".format(f16, bf16, f32, other))
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def enable_tensor_checker(checker_config):
+    global _checker
+    _checker = checker_config
+    _install()
+
+
+def disable_tensor_checker():
+    global _checker
+    _checker = None
+    _uninstall()
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Check one tensor for nan/inf (reference: debugging.py check_numerics)."""
+    data = tensor.data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    num_nan = int(jnp.isnan(data).sum())
+    num_inf = int(jnp.isinf(data).sum())
+    if num_nan or num_inf:
+        raise FloatingPointError(
+            f"{num_nan} nan and {num_inf} inf in {op_type}:{var_name}")
+    return Tensor(jnp.asarray(num_nan)), Tensor(jnp.asarray(num_inf))

@@ -1,0 +1,190 @@
+"""Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py —
+GradScaler :657 wrapping AmpScaler :62).
+
+fp16 gradients underflow; scale the loss up before backward, unscale grads
+before the optimizer step, skip the step when any grad is inf/nan, and adapt
+the scale (×incr_ratio after incr_every_n_steps good steps, ×decr_ratio
+after decr_every_n_nan_or_inf bad ones). On TPU bf16 needs none of this —
+construct with enable=False (the methods become passthroughs, so training
+loops are dtype-agnostic).
+"""
+import enum
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import autograd as ag
+
+
+class OptimizerState(enum.Enum):
+    INIT = 0
+    UNSCALED = 1
+    STEPPED = 2
+
+
+class AmpScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        if incr_ratio <= 1.0:
+            raise ValueError("incr_ratio must be > 1")
+        if not 0.0 < decr_ratio < 1.0:
+            raise ValueError("decr_ratio must be in (0, 1)")
+        self._enable = bool(enable)
+        self._scale = float(init_loss_scaling)
+        self._init_loss_scaling = float(init_loss_scaling)
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._incr_every_n_steps = int(incr_every_n_steps)
+        self._decr_every_n_nan_or_inf = int(decr_every_n_nan_or_inf)
+        self._use_dynamic_loss_scaling = bool(use_dynamic_loss_scaling)
+        self._incr_count = 0
+        self._decr_count = 0
+        self._found_inf = False
+        self._opt_states = {}
+
+    # -- scaling ---------------------------------------------------------
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def _collect_grads(self, optimizer):
+        return [p for p in optimizer._parameter_list
+                if p.grad is not None and p.trainable]
+
+    @ag.no_grad()
+    def _unscale(self, optimizer):
+        if not self._enable:
+            return
+        state = self._opt_states.get(id(optimizer), OptimizerState.INIT)
+        if state is OptimizerState.UNSCALED:
+            raise RuntimeError("unscale_() has already been called on this "
+                               "optimizer since the last update()")
+        params = self._collect_grads(optimizer)
+        inv = 1.0 / self._scale
+        found = False
+        for p in params:
+            g = p.grad.data.astype(jnp.float32) * inv
+            if not bool(jnp.isfinite(g).all()):
+                found = True
+            p.grad = Tensor(g.astype(p.grad.dtype), stop_gradient=True)
+        self._found_inf = found
+        self._opt_states[id(optimizer)] = OptimizerState.UNSCALED
+
+    unscale_ = _unscale
+
+    # -- stepping --------------------------------------------------------
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        state = self._opt_states.get(id(optimizer), OptimizerState.INIT)
+        if state is OptimizerState.STEPPED:
+            raise RuntimeError("step() has already been called since the "
+                               "last update()")
+        if state is OptimizerState.INIT:
+            self._unscale(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._opt_states[id(optimizer)] = OptimizerState.STEPPED
+
+    def update(self):
+        if not self._enable:
+            return
+        if self._use_dynamic_loss_scaling:
+            if self._found_inf:
+                self._decr_count += 1
+                self._incr_count = 0
+                if self._decr_count >= self._decr_every_n_nan_or_inf:
+                    self._scale = max(self._scale * self._decr_ratio, 1.0)
+                    self._decr_count = 0
+            else:
+                self._incr_count += 1
+                self._decr_count = 0
+                if self._incr_count >= self._incr_every_n_steps:
+                    self._scale *= self._incr_ratio
+                    self._incr_count = 0
+        self._found_inf = False
+        self._opt_states.clear()
+
+    def minimize(self, optimizer, scaled_loss, *args, **kwargs):
+        if not self._enable:
+            optimizer.step()
+            optimizer.clear_grad()
+            return
+        self.step(optimizer)
+        self.update()
+
+    # -- introspection ---------------------------------------------------
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._use_dynamic_loss_scaling
+
+    def get_init_loss_scaling(self):
+        return self._init_loss_scaling
+
+    def set_init_loss_scaling(self, v):
+        self._init_loss_scaling = float(v)
+        self._scale = float(v)
+
+    def get_incr_ratio(self):
+        return self._incr_ratio
+
+    def set_incr_ratio(self, v):
+        if v <= 1.0:
+            raise ValueError("incr_ratio must be > 1")
+        self._incr_ratio = float(v)
+
+    def get_decr_ratio(self):
+        return self._decr_ratio
+
+    def set_decr_ratio(self, v):
+        if not 0.0 < v < 1.0:
+            raise ValueError("decr_ratio must be in (0, 1)")
+        self._decr_ratio = float(v)
+
+    def get_incr_every_n_steps(self):
+        return self._incr_every_n_steps
+
+    def set_incr_every_n_steps(self, v):
+        self._incr_every_n_steps = int(v)
+
+    def get_decr_every_n_nan_or_inf(self):
+        return self._decr_every_n_nan_or_inf
+
+    def set_decr_every_n_nan_or_inf(self, v):
+        self._decr_every_n_nan_or_inf = int(v)
+
+    def state_dict(self):
+        if not self._enable:
+            return {}
+        return {
+            "scale": np.float32(self._scale),
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+            "incr_count": self._incr_count,
+            "decr_count": self._decr_count,
+            "use_dynamic_loss_scaling": self._use_dynamic_loss_scaling,
+        }
+
+    def load_state_dict(self, state):
+        if not self._enable:
+            return
+        self._scale = float(state["scale"])
+        self._incr_ratio = float(state["incr_ratio"])
+        self._decr_ratio = float(state["decr_ratio"])
+        self._incr_every_n_steps = int(state["incr_every_n_steps"])
+        self._decr_every_n_nan_or_inf = int(state["decr_every_n_nan_or_inf"])
+        self._incr_count = int(state.get("incr_count", 0))
+        self._decr_count = int(state.get("decr_count", 0))
+        self._use_dynamic_loss_scaling = bool(state["use_dynamic_loss_scaling"])
+
+
+class GradScaler(AmpScaler):
+    """Public scaler (reference: grad_scaler.py:657)."""

@@ -1,0 +1,170 @@
+"""AMP tests (reference analogue: test/amp/ suite — autocast dtype routing,
+GradScaler dynamic scaling, O2 decorate master weights)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn, optimizer
+
+
+def test_o1_white_op_runs_low_precision():
+    x = paddle.randn([4, 8])
+    y = paddle.randn([8, 4])
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = paddle.matmul(x, y)
+    assert out.dtype == jnp.bfloat16
+    # outside the context fp32 again
+    assert paddle.matmul(x, y).dtype == jnp.float32
+
+
+def test_o1_black_op_stays_fp32():
+    x = paddle.rand([4, 8]) + 0.5
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        h = paddle.matmul(x, paddle.randn([8, 8]))  # bf16 now
+        out = paddle.log(h.astype("float32") * 0 + 1.0)
+        loss = paddle.nn.functional.softmax(h)
+    assert out.dtype == jnp.float32
+    assert loss.dtype == jnp.float32  # softmax black-listed
+
+
+def test_promote_gray_op():
+    x = paddle.randn([4, 8])
+    w = paddle.randn([8, 8])
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        h = paddle.matmul(x, w)          # bf16
+        out = paddle.add(h, x)           # gray: promote with fp32 x -> fp32
+    assert out.dtype == jnp.float32
+
+
+def test_custom_lists():
+    x = paddle.randn([4, 8])
+    with amp.auto_cast(custom_black_list={"matmul"}, level="O1",
+                       dtype="bfloat16"):
+        out = paddle.matmul(x, paddle.randn([8, 8]))
+    assert out.dtype == jnp.float32
+    with amp.auto_cast(custom_white_list={"add"}, level="O1",
+                       dtype="bfloat16"):
+        out = paddle.add(x, x)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_o0_disabled():
+    x = paddle.randn([4, 8])
+    with amp.auto_cast(enable=False):
+        out = paddle.matmul(x, paddle.randn([8, 8]))
+    assert out.dtype == jnp.float32
+
+
+def test_autocast_backward_grads_flow():
+    lin = nn.Linear(8, 8)
+    x = paddle.randn([4, 8])
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = lin(x)
+        loss = out.astype("float32").sum()
+    loss.backward()
+    assert lin.weight.grad is not None
+    assert lin.weight.grad.dtype == jnp.float32  # grads land in param dtype
+
+
+def test_decorate_o2_casts_params_keeps_norm_fp32():
+    model = nn.Sequential(nn.Linear(8, 8), nn.LayerNorm(8), nn.Linear(8, 2))
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    assert model[0].weight.dtype == jnp.bfloat16
+    assert model[1].weight.dtype == jnp.float32  # LayerNorm excluded
+    assert opt._multi_precision
+
+
+def test_o2_training_with_master_weights():
+    model = nn.Linear(8, 1)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    x = paddle.randn([16, 8])
+    with amp.auto_cast(level="O2", dtype="bfloat16"):
+        loss = model(x).sum()
+    loss.backward()
+    w_before = np.asarray(model.weight.data.astype(jnp.float32))
+    opt.step()
+    st = opt._accumulators[id(model.weight)]
+    assert "master" in st and st["master"].dtype == jnp.float32
+    assert not np.allclose(w_before,
+                           np.asarray(model.weight.data.astype(jnp.float32)))
+
+
+def test_grad_scaler_scales_and_unscales():
+    p = paddle.core.tensor.Parameter(np.ones([4], np.float32))
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p])
+    scaler = amp.GradScaler(init_loss_scaling=1024.0)
+    loss = (p * 2.0).sum()
+    scaled = scaler.scale(loss)
+    assert float(scaled) == pytest.approx(float(loss) * 1024.0)
+    scaled.backward()
+    scaler.step(opt)  # unscales internally: grad should be 2.0 each
+    scaler.update()
+    # p = 1 - 1.0 * 2.0
+    np.testing.assert_allclose(np.asarray(p.data), -1.0, rtol=1e-6)
+
+
+def test_grad_scaler_skips_on_inf_and_decays():
+    p = paddle.core.tensor.Parameter(np.ones([2], np.float32))
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p])
+    scaler = amp.GradScaler(init_loss_scaling=8.0, decr_every_n_nan_or_inf=1)
+    p.grad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(np.asarray(p.data), 1.0)  # step skipped
+    assert scaler._scale == 4.0  # decayed by decr_ratio=0.5
+
+
+def test_grad_scaler_growth():
+    p = paddle.core.tensor.Parameter(np.ones([2], np.float32))
+    opt = optimizer.SGD(learning_rate=0.0, parameters=[p])
+    scaler = amp.GradScaler(init_loss_scaling=2.0, incr_every_n_steps=2)
+    for _ in range(2):
+        p.grad = paddle.to_tensor(np.ones([2], np.float32))
+        scaler.step(opt)
+        scaler.update()
+    assert scaler._scale == 4.0
+
+
+def test_grad_scaler_disabled_passthrough():
+    p = paddle.core.tensor.Parameter(np.ones([2], np.float32))
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p])
+    scaler = amp.GradScaler(enable=False)
+    loss = (p * 3.0).sum()
+    assert scaler.scale(loss) is loss
+    loss.backward()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(np.asarray(p.data), -2.0, rtol=1e-6)
+
+
+def test_scaler_state_dict_roundtrip():
+    s = amp.GradScaler(init_loss_scaling=512.0)
+    s._incr_count = 7
+    st = s.state_dict()
+    s2 = amp.GradScaler()
+    s2.load_state_dict(st)
+    assert s2._scale == 512.0 and s2._incr_count == 7
+
+
+def test_operator_stats_collection():
+    x = paddle.randn([4, 4])
+    amp.debugging.enable_operator_stats_collection()
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        paddle.matmul(x, x)
+    stats = amp.debugging.disable_operator_stats_collection()
+    assert "matmul" in stats
+    assert stats["matmul"].get("bfloat16", 0) >= 2  # both inputs cast to bf16
+
+
+def test_tensor_checker_raises_on_nan():
+    cfg = amp.debugging.TensorCheckerConfig(enable=True)
+    amp.debugging.enable_tensor_checker(cfg)
+    try:
+        bad = paddle.to_tensor(np.array([1.0, np.nan], np.float32))
+        with pytest.raises(FloatingPointError):
+            paddle.exp(bad)
+    finally:
+        amp.debugging.disable_tensor_checker()
