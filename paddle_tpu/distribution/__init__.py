@@ -1,1 +1,36 @@
-"""Placeholder — populated in a later milestone this round."""
+"""Probability distributions (reference: python/paddle/distribution/ —
+~25 distributions, bijective transforms, KL registry; see SURVEY.md §2.10).
+
+TPU note: sampling is reparameterized wherever the reference's is, and all
+math is jnp — distributions compose with jit/pjit and the autograd tape.
+"""
+from .distribution import Distribution, ExponentialFamily
+from .continuous import (Normal, LogNormal, Uniform, Laplace, Gumbel, Cauchy,
+                         Exponential, Gamma, Chi2, Beta, StudentT,
+                         ContinuousBernoulli)
+from .discrete import (Bernoulli, Geometric, Binomial, Categorical,
+                       Multinomial, Poisson)
+from .multivariate import Dirichlet, MultivariateNormal
+from .wrappers import Independent, TransformedDistribution
+from .transform import (Transform, AffineTransform, ExpTransform,
+                        PowerTransform, SigmoidTransform, TanhTransform,
+                        AbsTransform, SoftmaxTransform,
+                        StickBreakingTransform, StackTransform,
+                        ChainTransform, ReshapeTransform,
+                        IndependentTransform)
+from .kl import kl_divergence, register_kl
+from . import constraint
+from . import variable
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Normal", "LogNormal", "Uniform",
+    "Laplace", "Gumbel", "Cauchy", "Exponential", "Gamma", "Chi2", "Beta",
+    "StudentT", "ContinuousBernoulli", "Bernoulli", "Geometric", "Binomial",
+    "Categorical", "Multinomial", "Poisson", "Dirichlet",
+    "MultivariateNormal", "Independent", "TransformedDistribution",
+    "Transform", "AffineTransform", "ExpTransform", "PowerTransform",
+    "SigmoidTransform", "TanhTransform", "AbsTransform", "SoftmaxTransform",
+    "StickBreakingTransform", "StackTransform", "ChainTransform",
+    "ReshapeTransform", "IndependentTransform", "kl_divergence",
+    "register_kl", "constraint", "variable",
+]

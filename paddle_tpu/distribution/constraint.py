@@ -1,0 +1,43 @@
+"""Value constraints (reference: python/paddle/distribution/constraint.py)."""
+import jax.numpy as jnp
+
+from .distribution import _arr
+from ..core.tensor import Tensor
+
+
+class Constraint:
+    def __call__(self, value):
+        raise NotImplementedError
+
+
+class Real(Constraint):
+    def __call__(self, value):
+        v = _arr(value)
+        return Tensor(v == v)
+
+
+class Positive(Constraint):
+    def __call__(self, value):
+        return Tensor(_arr(value) > 0)
+
+
+class Range(Constraint):
+    def __init__(self, lower, upper):
+        self._lower = lower
+        self._upper = upper
+
+    def __call__(self, value):
+        v = _arr(value)
+        return Tensor((v >= self._lower) & (v <= self._upper))
+
+
+class Simplex(Constraint):
+    def __call__(self, value):
+        v = _arr(value)
+        return Tensor((v >= 0).all(axis=-1)
+                      & (jnp.abs(v.sum(axis=-1) - 1) < 1e-6))
+
+
+real = Real()
+positive = Positive()
+simplex = Simplex()

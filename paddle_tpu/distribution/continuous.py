@@ -1,0 +1,478 @@
+"""Continuous scalar distributions (reference: python/paddle/distribution/
+{normal,uniform,laplace,lognormal,gumbel,cauchy,exponential,gamma,beta,chi2,
+student_t,continuous_bernoulli}.py). Math over jnp / jax.random /
+jax.scipy.special; sampling reparameterized where the reference's is."""
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, ExponentialFamily, _arr, _shape
+from ..core.tensor import Tensor
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+def _bcast(*xs):
+    xs = [_arr(x) for x in xs]
+    shape = jnp.broadcast_shapes(*(x.shape for x in xs))
+    return [jnp.broadcast_to(x, shape) for x in xs], shape
+
+
+class Normal(ExponentialFamily):
+    def __init__(self, loc, scale, name=None):
+        (self.loc, self.scale), shape = _bcast(loc, scale)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        return Tensor(self.scale ** 2)
+
+    @property
+    def stddev(self):
+        return Tensor(self.scale)
+
+    def _sample(self, key, shape):
+        eps = jax.random.normal(key, shape + self._batch_shape,
+                                dtype=self.loc.dtype)
+        return self.loc + self.scale * eps
+
+    def _log_prob(self, value):
+        var = self.scale ** 2
+        return (-((value - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * _LOG_2PI)
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * _LOG_2PI + jnp.log(self.scale)
+                      + jnp.zeros_like(self.loc))
+
+    def cdf(self, value):
+        return Tensor(0.5 * (1 + jsp.erf(
+            (_arr(value) - self.loc) / (self.scale * math.sqrt(2)))))
+
+    def icdf(self, value):
+        return Tensor(self.loc + self.scale * math.sqrt(2)
+                      * jsp.erfinv(2 * _arr(value) - 1))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Normal):
+            vr = (self.scale / other.scale) ** 2
+            t1 = ((self.loc - other.loc) / other.scale) ** 2
+            return Tensor(0.5 * (vr + t1 - 1 - jnp.log(vr)))
+        return super().kl_divergence(other)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        (self.loc, self.scale), shape = _bcast(loc, scale)
+        self._base = Normal(self.loc, self.scale)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def _sample(self, key, shape):
+        return jnp.exp(self._base._sample(key, shape))
+
+    def _log_prob(self, value):
+        return self._base._log_prob(jnp.log(value)) - jnp.log(value)
+
+    def entropy(self):
+        return Tensor(self.loc + 0.5 + 0.5 * _LOG_2PI + jnp.log(self.scale))
+
+    def kl_divergence(self, other):
+        if isinstance(other, LogNormal):
+            return self._base.kl_divergence(other._base)
+        return super().kl_divergence(other)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        (self.low, self.high), shape = _bcast(low, high)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def _sample(self, key, shape):
+        u = jax.random.uniform(key, shape + self._batch_shape,
+                               dtype=self.low.dtype)
+        return self.low + (self.high - self.low) * u
+
+    def _log_prob(self, value):
+        inside = (value >= self.low) & (value <= self.high)
+        return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+    def cdf(self, value):
+        return Tensor(jnp.clip((_arr(value) - self.low)
+                               / (self.high - self.low), 0.0, 1.0))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        (self.loc, self.scale), shape = _bcast(loc, scale)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        return Tensor(2 * self.scale ** 2)
+
+    @property
+    def stddev(self):
+        return Tensor(math.sqrt(2) * self.scale)
+
+    def _sample(self, key, shape):
+        u = jax.random.uniform(key, shape + self._batch_shape,
+                               dtype=self.loc.dtype, minval=-0.5, maxval=0.5)
+        return self.loc - self.scale * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u))
+
+    def _log_prob(self, value):
+        return (-jnp.abs(value - self.loc) / self.scale
+                - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale))
+
+    def cdf(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, value):
+        p = _arr(value)
+        term = p - 0.5
+        return Tensor(self.loc - self.scale * jnp.sign(term)
+                      * jnp.log1p(-2 * jnp.abs(term)))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Laplace):
+            r = self.scale / other.scale
+            d = jnp.abs(self.loc - other.loc) / other.scale
+            return Tensor(r * jnp.exp(-d / r) + d - 1 + jnp.log(other.scale / self.scale))
+        return super().kl_divergence(other)
+
+
+class Gumbel(Distribution):
+    _EULER = 0.5772156649015329
+
+    def __init__(self, loc, scale, name=None):
+        (self.loc, self.scale), shape = _bcast(loc, scale)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * self._EULER)
+
+    @property
+    def variance(self):
+        return Tensor((math.pi ** 2 / 6) * self.scale ** 2)
+
+    @property
+    def stddev(self):
+        return Tensor(math.pi / math.sqrt(6) * self.scale)
+
+    def _sample(self, key, shape):
+        g = jax.random.gumbel(key, shape + self._batch_shape,
+                              dtype=self.loc.dtype)
+        return self.loc + self.scale * g
+
+    def _log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.scale) + 1 + self._EULER
+                      + jnp.zeros_like(self.loc))
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(jnp.exp(-jnp.exp(-z)))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        (self.loc, self.scale), shape = _bcast(loc, scale)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy has no variance")
+
+    def _sample(self, key, shape):
+        c = jax.random.cauchy(key, shape + self._batch_shape,
+                              dtype=self.loc.dtype)
+        return self.loc + self.scale * c
+
+    def _log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -math.log(math.pi) - jnp.log(self.scale) - jnp.log1p(z ** 2)
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * math.pi * self.scale)
+                      + jnp.zeros_like(self.loc))
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(jnp.arctan(z) / math.pi + 0.5)
+
+    def kl_divergence(self, other):
+        if isinstance(other, Cauchy):
+            # closed form (Chyzak & Nielsen 2019)
+            num = (self.scale + other.scale) ** 2 + (self.loc - other.loc) ** 2
+            den = 4 * self.scale * other.scale
+            return Tensor(jnp.log(num / den))
+        return super().kl_divergence(other)
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        (self.rate,), shape = _bcast(rate)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / self.rate ** 2)
+
+    def _sample(self, key, shape):
+        e = jax.random.exponential(key, shape + self._batch_shape,
+                                   dtype=self.rate.dtype)
+        return e / self.rate
+
+    def _log_prob(self, value):
+        return jnp.where(value >= 0, jnp.log(self.rate) - self.rate * value,
+                         -jnp.inf)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+    def cdf(self, value):
+        return Tensor(jnp.clip(-jnp.expm1(-self.rate * _arr(value)), 0.0))
+
+    @property
+    def _natural_parameters(self):
+        return (-self.rate,)
+
+    def _log_normalizer(self, eta):
+        return -jnp.log(-eta)
+
+    def kl_divergence(self, other):
+        if isinstance(other, Exponential):
+            r = self.rate / other.rate
+            return Tensor(jnp.log(r) + 1 / r - 1)
+        return super().kl_divergence(other)
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate, name=None):
+        (self.concentration, self.rate), shape = _bcast(concentration, rate)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def _sample(self, key, shape):
+        g = jax.random.gamma(key, self.concentration,
+                             shape + self._batch_shape,
+                             dtype=self.concentration.dtype)
+        return g / self.rate
+
+    def _log_prob(self, value):
+        a, b = self.concentration, self.rate
+        return (a * jnp.log(b) + (a - 1) * jnp.log(value) - b * value
+                - jsp.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return Tensor(a - jnp.log(b) + jsp.gammaln(a)
+                      + (1 - a) * jsp.digamma(a))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Gamma):
+            a1, b1, a2, b2 = (self.concentration, self.rate,
+                              other.concentration, other.rate)
+            return Tensor((a1 - a2) * jsp.digamma(a1) - jsp.gammaln(a1)
+                          + jsp.gammaln(a2) + a2 * (jnp.log(b1) - jnp.log(b2))
+                          + a1 * (b2 / b1 - 1))
+        return super().kl_divergence(other)
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        (df,), _ = _bcast(df)
+        self.df = df
+        super().__init__(df / 2.0, jnp.full_like(df, 0.5))
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta, name=None):
+        (self.alpha, self.beta), shape = _bcast(alpha, beta)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s ** 2 * (s + 1)))
+
+    def _sample(self, key, shape):
+        return jax.random.beta(key, self.alpha, self.beta,
+                               shape + self._batch_shape,
+                               dtype=self.alpha.dtype)
+
+    def _log_prob(self, value):
+        a, b = self.alpha, self.beta
+        return ((a - 1) * jnp.log(value) + (b - 1) * jnp.log1p(-value)
+                - (jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        lbeta = jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+        return Tensor(lbeta - (a - 1) * jsp.digamma(a)
+                      - (b - 1) * jsp.digamma(b)
+                      + (a + b - 2) * jsp.digamma(a + b))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Beta):
+            a1, b1, a2, b2 = self.alpha, self.beta, other.alpha, other.beta
+            lbeta1 = jsp.gammaln(a1) + jsp.gammaln(b1) - jsp.gammaln(a1 + b1)
+            lbeta2 = jsp.gammaln(a2) + jsp.gammaln(b2) - jsp.gammaln(a2 + b2)
+            return Tensor(lbeta2 - lbeta1
+                          + (a1 - a2) * jsp.digamma(a1)
+                          + (b1 - b2) * jsp.digamma(b1)
+                          + (a2 - a1 + b2 - b1) * jsp.digamma(a1 + b1))
+        return super().kl_divergence(other)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        (self.df, self.loc, self.scale), shape = _bcast(df, loc, scale)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+    @property
+    def variance(self):
+        v = jnp.where(self.df > 2,
+                      self.scale ** 2 * self.df / (self.df - 2),
+                      jnp.where(self.df > 1, jnp.inf, jnp.nan))
+        return Tensor(v)
+
+    def _sample(self, key, shape):
+        t = jax.random.t(key, self.df, shape + self._batch_shape,
+                         dtype=self.loc.dtype)
+        return self.loc + self.scale * t
+
+    def _log_prob(self, value):
+        df = self.df
+        z = (value - self.loc) / self.scale
+        return (jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2)
+                - 0.5 * jnp.log(df * math.pi) - jnp.log(self.scale)
+                - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+
+    def entropy(self):
+        df = self.df
+        return Tensor((df + 1) / 2 * (jsp.digamma((df + 1) / 2)
+                                      - jsp.digamma(df / 2))
+                      + 0.5 * jnp.log(df)
+                      + jsp.gammaln(df / 2) + jsp.gammaln(0.5)
+                      - jsp.gammaln((df + 1) / 2)
+                      + jnp.log(self.scale))
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        (self.probs,), shape = _bcast(probs)
+        self._lims = lims
+        super().__init__(batch_shape=shape)
+
+    def _outside(self):
+        return (self.probs < self._lims[0]) | (self.probs > self._lims[1])
+
+    def _cut_probs(self):
+        return jnp.where(self._outside(), self.probs,
+                         jnp.full_like(self.probs, self._lims[0]))
+
+    def _log_norm_const(self):
+        # log C(p); taylor expansion near p=0.5 for stability
+        p = self._cut_probs()
+        exact = jnp.log(jnp.abs(jnp.arctanh(1 - 2 * p))
+                        / jnp.abs(1 - 2 * p) * 2)
+        x = self.probs - 0.5
+        taylor = math.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x ** 2) * x ** 2
+        return jnp.where(self._outside(), exact, taylor)
+
+    @property
+    def mean(self):
+        p = self._cut_probs()
+        exact = p / (2 * p - 1) + 1 / (2 * jnp.arctanh(1 - 2 * p))
+        x = self.probs - 0.5
+        taylor = 0.5 + (1.0 / 3.0 + 16.0 / 45.0 * x ** 2) * x
+        return Tensor(jnp.where(self._outside(), exact, taylor))
+
+    @property
+    def variance(self):
+        p = self._cut_probs()
+        exact = p * (p - 1) / (1 - 2 * p) ** 2 + 1 / (2 * jnp.arctanh(1 - 2 * p)) ** 2
+        x = self.probs - 0.5
+        taylor = 1.0 / 12.0 - (1.0 / 15.0 - 128.0 / 945.0 * x ** 2) * x ** 2
+        return Tensor(jnp.where(self._outside(), exact, taylor))
+
+    def _sample(self, key, shape):
+        u = jax.random.uniform(key, shape + self._batch_shape,
+                               dtype=self.probs.dtype)
+        p = self._cut_probs()
+        # inverse-cdf: x = log1p(u*(2p-1)/(1-p)) / log(p/(1-p))
+        icdf = jnp.log1p((2 * p - 1) * u / (1 - p)) / jnp.log(p / (1 - p))
+        return jnp.where(self._outside(), icdf, u)
+
+    def _log_prob(self, value):
+        p = self.probs
+        return (value * jnp.log(p) + (1 - value) * jnp.log1p(-p)
+                + self._log_norm_const())
+
+    def entropy(self):
+        m = self.mean.data
+        p = self.probs
+        return Tensor(-(m * jnp.log(p) + (1 - m) * jnp.log1p(-p)
+                        + self._log_norm_const()))

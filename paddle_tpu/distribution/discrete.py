@@ -1,0 +1,297 @@
+"""Discrete distributions (reference: python/paddle/distribution/
+{bernoulli,binomial,categorical,geometric,multinomial,poisson}.py)."""
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, ExponentialFamily, _arr
+from ..core.tensor import Tensor
+
+
+def _bcast(*xs):
+    xs = [_arr(x) for x in xs]
+    shape = jnp.broadcast_shapes(*(x.shape for x in xs))
+    return [jnp.broadcast_to(x, shape) for x in xs], shape
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs, name=None):
+        (self.probs,), shape = _bcast(probs)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def logits(self):
+        return Tensor(jnp.log(self.probs) - jnp.log1p(-self.probs))
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def _sample(self, key, shape):
+        return jax.random.bernoulli(
+            key, self.probs, shape + self._batch_shape).astype(self.probs.dtype)
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax relaxation (reference bernoulli.py rsample)."""
+        from ..core import random as _random
+        shape = tuple(shape)
+        u = jax.random.uniform(_random.next_key(),
+                               shape + self._batch_shape,
+                               dtype=self.probs.dtype, minval=1e-6,
+                               maxval=1.0 - 1e-6)
+        logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        noise = jnp.log(u) - jnp.log1p(-u)
+        return Tensor(jax.nn.sigmoid((logits + noise) / temperature))
+
+    def _log_prob(self, value):
+        return (value * jnp.log(self.probs)
+                + (1 - value) * jnp.log1p(-self.probs))
+
+    def entropy(self):
+        p = self.probs
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+    def cdf(self, value):
+        v = _arr(value)
+        return Tensor(jnp.where(v < 0, 0.0,
+                                jnp.where(v < 1, 1 - self.probs, 1.0)))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Bernoulli):
+            p, q = self.probs, other.probs
+            return Tensor(p * (jnp.log(p) - jnp.log(q))
+                          + (1 - p) * (jnp.log1p(-p) - jnp.log1p(-q)))
+        return super().kl_divergence(other)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        (self.probs,), shape = _bcast(probs)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs) / self.probs ** 2)
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.sqrt((1 - self.probs)) / self.probs)
+
+    def _sample(self, key, shape):
+        u = jax.random.uniform(key, shape + self._batch_shape,
+                               dtype=self.probs.dtype, minval=1e-12)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.probs))
+
+    def _log_prob(self, value):
+        return value * jnp.log1p(-self.probs) + jnp.log(self.probs)
+
+    def pmf(self, k):
+        return self.prob(k)
+
+    def entropy(self):
+        p = self.probs
+        return Tensor(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+    def cdf(self, k):
+        return Tensor(1 - jnp.power(1 - self.probs, _arr(k) + 1))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Geometric):
+            # E[k] = (1-p)/p trials weight the continuation term
+            p, q = self.probs, other.probs
+            return Tensor(jnp.log(p / q)
+                          + (1 - p) / p * jnp.log((1 - p) / (1 - q)))
+        return super().kl_divergence(other)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        (tc, self.probs), shape = _bcast(total_count, probs)
+        self.total_count = tc.astype(self.probs.dtype)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def _sample(self, key, shape):
+        n_max = int(jnp.max(self.total_count))
+        full = shape + self._batch_shape
+        u = jax.random.uniform(key, (n_max,) + full, dtype=self.probs.dtype)
+        trials = (u < self.probs).astype(self.probs.dtype)
+        idx = jnp.arange(n_max).reshape((n_max,) + (1,) * len(full))
+        mask = idx < self.total_count
+        return jnp.sum(trials * mask, axis=0)
+
+    def _log_prob(self, value):
+        n, p = self.total_count, self.probs
+        logc = (jsp.gammaln(n + 1) - jsp.gammaln(value + 1)
+                - jsp.gammaln(n - value + 1))
+        return logc + value * jnp.log(p) + (n - value) * jnp.log1p(-p)
+
+    def entropy(self):
+        # exact by enumeration over support (reference binomial.py does same)
+        n_max = int(jnp.max(self.total_count))
+        ks = jnp.arange(0, n_max + 1, dtype=self.probs.dtype)
+        ks = ks.reshape((n_max + 1,) + (1,) * len(self._batch_shape))
+        lp = self._log_prob(ks)
+        valid = ks <= self.total_count
+        return Tensor(-jnp.sum(jnp.where(valid, jnp.exp(lp) * lp, 0.0), axis=0))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Binomial):
+            p, q = self.probs, other.probs
+            n = self.total_count
+            return Tensor(n * (p * jnp.log(p / q)
+                               + (1 - p) * jnp.log((1 - p) / (1 - q))))
+        return super().kl_divergence(other)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("either logits or probs required")
+        if logits is not None:
+            self.logits = _arr(logits)
+            self._probs = jax.nn.softmax(self.logits, axis=-1)
+        else:
+            self._probs = _arr(probs) / jnp.sum(_arr(probs), axis=-1,
+                                                keepdims=True)
+            self.logits = jnp.log(self._probs)
+        super().__init__(batch_shape=self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(self._probs)
+
+    @property
+    def num_categories(self):
+        return self.logits.shape[-1]
+
+    def _sample(self, key, shape):
+        return jax.random.categorical(key, self.logits,
+                                      shape=shape + self._batch_shape)
+
+    def sample(self, shape=()):
+        from ..core import random as _random
+        return Tensor(self._sample(_random.next_key(), tuple(shape)))
+
+    def _log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        idx = value.astype(jnp.int32)
+        logp = jnp.broadcast_to(logp, idx.shape + logp.shape[-1:])
+        return jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0]
+
+    def probabilities(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(-jnp.sum(self._probs * logp, axis=-1))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Categorical):
+            lp = jax.nn.log_softmax(self.logits, axis=-1)
+            lq = jax.nn.log_softmax(other.logits, axis=-1)
+            return Tensor(jnp.sum(self._probs * (lp - lq), axis=-1))
+        return super().kl_divergence(other)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        p = _arr(probs)
+        self._probs = p / jnp.sum(p, axis=-1, keepdims=True)
+        super().__init__(batch_shape=p.shape[:-1], event_shape=p.shape[-1:])
+
+    @property
+    def probs(self):
+        return Tensor(self._probs)
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self._probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self._probs * (1 - self._probs))
+
+    def _sample(self, key, shape):
+        logits = jnp.log(self._probs)
+        draws = jax.random.categorical(
+            key, logits, shape=(self.total_count,) + shape + self._batch_shape)
+        k = self._probs.shape[-1]
+        onehot = jax.nn.one_hot(draws, k, dtype=self._probs.dtype)
+        return jnp.sum(onehot, axis=0)
+
+    def _log_prob(self, value):
+        logc = (jsp.gammaln(jnp.asarray(self.total_count + 1.0))
+                - jnp.sum(jsp.gammaln(value + 1.0), axis=-1))
+        return logc + jnp.sum(value * jnp.log(self._probs), axis=-1)
+
+    def entropy(self):
+        # Monte-Carlo-free bound is complex; use E[-log p] over samples of the
+        # per-trial categorical scaled — reference uses enumeration for small n.
+        n = self.total_count
+        p = self._probs
+        cat_ent = -jnp.sum(p * jnp.log(p), axis=-1)
+        # exact for n==1, standard approximation otherwise
+        if n == 1:
+            return Tensor(cat_ent)
+        k = p.shape[-1]
+        approx = (0.5 * jnp.log((2 * math.pi * math.e * n) ** (k - 1)
+                                * jnp.prod(p, axis=-1)))
+        return Tensor(approx)
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        (self.rate,), shape = _bcast(rate)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def _sample(self, key, shape):
+        return jax.random.poisson(key, self.rate,
+                                  shape + self._batch_shape).astype(self.rate.dtype)
+
+    def _log_prob(self, value):
+        return (value * jnp.log(self.rate) - self.rate
+                - jsp.gammaln(value + 1))
+
+    def entropy(self):
+        # series approximation capped by enumeration for small rates
+        lam = self.rate
+        n_max = max(20, int(jnp.max(lam)) * 3 + 10)
+        ks = jnp.arange(0, n_max, dtype=lam.dtype)
+        ks = ks.reshape((n_max,) + (1,) * len(self._batch_shape))
+        lp = self._log_prob(ks)
+        return Tensor(-jnp.sum(jnp.exp(lp) * lp, axis=0))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Poisson):
+            r, s = self.rate, other.rate
+            return Tensor(r * jnp.log(r / s) + s - r)
+        return super().kl_divergence(other)

@@ -1,0 +1,116 @@
+"""Distribution base classes (reference: python/paddle/distribution/
+distribution.py, exponential_family.py).
+
+Internals hold jnp arrays; public methods take/return paddle_tpu Tensors.
+Sampling draws keys from the global threefry stream (core/random.py) — the
+TPU-native counterpart of the reference's philox Generator
+(paddle/phi/core/generator.h:32).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import random as _random
+
+
+def _arr(x, dtype=None):
+    if isinstance(x, Tensor):
+        a = x.data
+    else:
+        a = jnp.asarray(x, dtype=dtype or jnp.float32)
+        if a.dtype == jnp.float64:
+            a = a.astype(jnp.float32)
+    return a
+
+
+def _shape(s):
+    if s is None:
+        return ()
+    if isinstance(s, (int, np.integer)):
+        return (int(s),)
+    return tuple(int(i) for i in s)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = _shape(batch_shape)
+        self._event_shape = _shape(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    # -- sampling --------------------------------------------------------
+    def _sample(self, key, shape):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        return Tensor(jax.lax.stop_gradient(
+            self._sample(_random.next_key(), _shape(shape))))
+
+    def rsample(self, shape=()):
+        """Reparameterized sample; grads flow to the parameters."""
+        return Tensor(self._sample(_random.next_key(), _shape(shape)))
+
+    # -- densities -------------------------------------------------------
+    def _log_prob(self, value):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        return Tensor(self._log_prob(_arr(value)))
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self._log_prob(_arr(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return _shape(sample_shape) + self._batch_shape + self._event_shape
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(batch_shape={self._batch_shape}, "
+                f"event_shape={self._event_shape})")
+
+
+class ExponentialFamily(Distribution):
+    """Exponential-family base; Bregman-divergence entropy via autodiff of the
+    log-normalizer (reference: exponential_family.py uses the same trick)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        # H = A(η) - <η, ∇A(η)> - E[carrier]; ∇A obtained by autodiff of the
+        # summed log-normalizer (elementwise families ⇒ per-batch grads)
+        nparams = tuple(jnp.asarray(p) for p in self._natural_parameters)
+        grads = jax.grad(lambda ps: jnp.sum(self._log_normalizer(*ps)))(nparams)
+        ent = self._log_normalizer(*nparams) - self._mean_carrier_measure
+        for p, g in zip(nparams, grads):
+            ent = ent - p * g
+        return Tensor(ent)

@@ -1,0 +1,69 @@
+"""KL divergence registry (reference: python/paddle/distribution/kl.py —
+register_kl dispatch with MRO-based resolution)."""
+import jax.numpy as jnp
+
+from .distribution import Distribution
+from ..core.tensor import Tensor
+
+_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def decorator(fn):
+        _REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return decorator
+
+
+def _dispatch(p_cls, q_cls):
+    matches = [(pc, qc) for pc, qc in _REGISTRY
+               if issubclass(p_cls, pc) and issubclass(q_cls, qc)]
+    if not matches:
+        return None
+    # most specific match: smallest MRO distance
+    def key(pq):
+        pc, qc = pq
+        return (p_cls.__mro__.index(pc), q_cls.__mro__.index(qc))
+    return _REGISTRY[min(matches, key=key)]
+
+
+def kl_divergence(p, q):
+    fn = _dispatch(type(p), type(q))
+    if fn is not None:
+        return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+# -- registrations for pairs whose closed form lives on the class ---------
+from .continuous import (Normal, LogNormal, Laplace, Cauchy, Exponential,
+                         Gamma, Beta, Uniform)
+from .discrete import Bernoulli, Categorical, Geometric, Binomial, Poisson
+from .multivariate import Dirichlet, MultivariateNormal
+from .wrappers import Independent
+
+
+for cls in (Normal, LogNormal, Laplace, Cauchy, Exponential, Gamma, Beta,
+            Bernoulli, Categorical, Geometric, Binomial, Poisson, Dirichlet,
+            MultivariateNormal):
+    register_kl(cls, cls)(cls.kl_divergence)
+
+
+@register_kl(Uniform, Normal)
+def _kl_uniform_normal(p, q):
+    import math
+    # E_U[(x-μ)²] = ((b-μ)³ - (a-μ)³) / (3(b-a))
+    second_moment = (((p.high - q.loc) ** 3 - (p.low - q.loc) ** 3)
+                     / (3 * (p.high - p.low)))
+    return Tensor(-jnp.log(p.high - p.low) + jnp.log(q.scale)
+                  + 0.5 * math.log(2 * math.pi)
+                  + second_moment / (2 * q.scale ** 2))
+
+
+@register_kl(Independent, Independent)
+def _kl_independent(p, q):
+    if p.reinterpreted_batch_rank != q.reinterpreted_batch_rank:
+        raise NotImplementedError
+    inner = kl_divergence(p.base, q.base).data
+    axes = tuple(range(-p.reinterpreted_batch_rank, 0))
+    return Tensor(jnp.sum(inner, axis=axes) if axes else inner)

@@ -1,0 +1,147 @@
+"""Multivariate distributions (reference: python/paddle/distribution/
+{dirichlet,multivariate_normal,lkj_cholesky}.py)."""
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, ExponentialFamily, _arr
+from ..core.tensor import Tensor
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(batch_shape=self.concentration.shape[:-1],
+                         event_shape=self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / jnp.sum(self.concentration, axis=-1, keepdims=True))
+
+    @property
+    def variance(self):
+        a0 = jnp.sum(self.concentration, axis=-1, keepdims=True)
+        a = self.concentration
+        return Tensor(a * (a0 - a) / (a0 ** 2 * (a0 + 1)))
+
+    def _sample(self, key, shape):
+        return jax.random.dirichlet(key, self.concentration,
+                                    shape + self._batch_shape,
+                                    dtype=self.concentration.dtype)
+
+    def _log_prob(self, value):
+        a = self.concentration
+        lnB = jnp.sum(jsp.gammaln(a), axis=-1) - jsp.gammaln(jnp.sum(a, axis=-1))
+        return jnp.sum((a - 1) * jnp.log(value), axis=-1) - lnB
+
+    def entropy(self):
+        a = self.concentration
+        a0 = jnp.sum(a, axis=-1)
+        k = a.shape[-1]
+        lnB = jnp.sum(jsp.gammaln(a), axis=-1) - jsp.gammaln(a0)
+        return Tensor(lnB + (a0 - k) * jsp.digamma(a0)
+                      - jnp.sum((a - 1) * jsp.digamma(a), axis=-1))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Dirichlet):
+            a, b = self.concentration, other.concentration
+            a0 = jnp.sum(a, axis=-1, keepdims=True)
+            t = jnp.sum((a - b) * (jsp.digamma(a) - jsp.digamma(a0)), axis=-1)
+            lnBa = jnp.sum(jsp.gammaln(a), axis=-1) - jsp.gammaln(a0[..., 0])
+            lnBb = jnp.sum(jsp.gammaln(b), axis=-1) - jsp.gammaln(jnp.sum(b, axis=-1))
+            return Tensor(lnBb - lnBa + t)
+        return super().kl_divergence(other)
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _arr(loc)
+        given = sum(x is not None for x in
+                    (covariance_matrix, precision_matrix, scale_tril))
+        if given != 1:
+            raise ValueError("exactly one of covariance_matrix / "
+                             "precision_matrix / scale_tril required")
+        if scale_tril is not None:
+            self._scale_tril = _arr(scale_tril)
+        elif covariance_matrix is not None:
+            self._scale_tril = jnp.linalg.cholesky(_arr(covariance_matrix))
+        else:
+            prec = _arr(precision_matrix)
+            # chol(P^-1) via inverting the cholesky of P (flip trick keeps it
+            # triangular): P = LLᵀ ⇒ Σ = L^-ᵀ L^-1
+            Lp = jnp.linalg.cholesky(prec)
+            eye = jnp.eye(prec.shape[-1], dtype=prec.dtype)
+            Linv = jax.scipy.linalg.solve_triangular(Lp, eye, lower=True)
+            self._scale_tril = jnp.linalg.cholesky(
+                jnp.swapaxes(Linv, -1, -2) @ Linv)
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1],
+                                     self._scale_tril.shape[:-2])
+        self.loc = jnp.broadcast_to(self.loc, batch + self.loc.shape[-1:])
+        self._scale_tril = jnp.broadcast_to(
+            self._scale_tril, batch + self._scale_tril.shape[-2:])
+        super().__init__(batch_shape=batch, event_shape=self.loc.shape[-1:])
+
+    @property
+    def scale_tril(self):
+        return Tensor(self._scale_tril)
+
+    @property
+    def covariance_matrix(self):
+        L = self._scale_tril
+        return Tensor(L @ jnp.swapaxes(L, -1, -2))
+
+    @property
+    def precision_matrix(self):
+        cov = self.covariance_matrix.data
+        return Tensor(jnp.linalg.inv(cov))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        return Tensor(jnp.sum(self._scale_tril ** 2, axis=-1))
+
+    def _sample(self, key, shape):
+        full = shape + self._batch_shape + self._event_shape
+        eps = jax.random.normal(key, full, dtype=self.loc.dtype)
+        return self.loc + jnp.einsum("...ij,...j->...i", self._scale_tril, eps)
+
+    def _log_prob(self, value):
+        diff = value - self.loc
+        # solve L y = diff  (triangular) → mahalanobis = |y|^2
+        y = jax.scipy.linalg.solve_triangular(
+            self._scale_tril, diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(y ** 2, axis=-1)
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self._scale_tril, axis1=-2, axis2=-1)), axis=-1)
+        k = self.loc.shape[-1]
+        return -0.5 * (k * _LOG_2PI + maha) - half_logdet
+
+    def entropy(self):
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self._scale_tril, axis1=-2, axis2=-1)), axis=-1)
+        k = self.loc.shape[-1]
+        return Tensor(0.5 * k * (1 + _LOG_2PI) + half_logdet)
+
+    def kl_divergence(self, other):
+        if isinstance(other, MultivariateNormal):
+            k = self.loc.shape[-1]
+            L1, L2 = self._scale_tril, other._scale_tril
+            hld1 = jnp.sum(jnp.log(jnp.diagonal(L1, axis1=-2, axis2=-1)), axis=-1)
+            hld2 = jnp.sum(jnp.log(jnp.diagonal(L2, axis1=-2, axis2=-1)), axis=-1)
+            M = jax.scipy.linalg.solve_triangular(L2, L1, lower=True)
+            tr = jnp.sum(M ** 2, axis=(-2, -1))
+            diff = other.loc - self.loc
+            y = jax.scipy.linalg.solve_triangular(
+                L2, diff[..., None], lower=True)[..., 0]
+            maha = jnp.sum(y ** 2, axis=-1)
+            return Tensor(hld2 - hld1 + 0.5 * (tr + maha - k))
+        return super().kl_divergence(other)

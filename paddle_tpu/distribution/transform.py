@@ -1,0 +1,269 @@
+"""Bijective transforms (reference: python/paddle/distribution/transform.py).
+
+Each Transform supplies forward/inverse and the log|det J|; variable types
+mirror the reference (Type.BIJECTION etc. collapse to a bool here).
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import _arr
+from ..core.tensor import Tensor
+
+
+class Transform:
+    _is_injective = True
+
+    @property
+    def inv(self):
+        return _InverseTransform(self)
+
+    def forward(self, x):
+        return Tensor(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._forward_log_det_jacobian(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        y = _arr(y)
+        return Tensor(-self._forward_log_det_jacobian(self._inverse(y)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # subclass hooks over jnp arrays
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class _InverseTransform(Transform):
+    def __init__(self, base):
+        self._base = base
+
+    def _forward(self, x):
+        return self._base._inverse(x)
+
+    def _inverse(self, y):
+        return self._base._forward(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -self._base._forward_log_det_jacobian(self._base._inverse(x))
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    _is_injective = False
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class SoftmaxTransform(Transform):
+    _is_injective = False
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("softmax is not a diffeomorphism")
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^{K-1} → simplex Δ^K (reference transform.py)."""
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zpad = jnp.concatenate([z, jnp.ones_like(z[..., :1])], axis=-1)
+        one_minus = jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), 1 - z], axis=-1)
+        return zpad * jnp.cumprod(one_minus, axis=-1)
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        rem = 1 - jnp.cumsum(y_crop, axis=-1)
+        offset = y_crop.shape[-1] - jnp.arange(y_crop.shape[-1],
+                                               dtype=y.dtype)
+        z = y_crop / jnp.concatenate(
+            [jnp.ones_like(rem[..., :1]), rem[..., :-1]], axis=-1)
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        # triangular jacobian: dy_k/dx_k = c_k σ'(u_k), c_k = Π_{j<k}(1-z_j)
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        u = x - jnp.log(offset)
+        z = jax.nn.sigmoid(u)
+        stick = jnp.cumprod(1 - z, axis=-1)
+        c = jnp.concatenate([jnp.ones_like(z[..., :1]), stick[..., :-1]],
+                            axis=-1)
+        return jnp.sum(jnp.log(c) - jax.nn.softplus(u) - jax.nn.softplus(-u),
+                       axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _split(self, x):
+        return [jnp.squeeze(s, self.axis) for s in
+                jnp.split(x, x.shape[self.axis], axis=self.axis)]
+
+    def _forward(self, x):
+        return jnp.stack([t._forward(s) for t, s in
+                          zip(self.transforms, self._split(x))], self.axis)
+
+    def _inverse(self, y):
+        return jnp.stack([t._inverse(s) for t, s in
+                          zip(self.transforms, self._split(y))], self.axis)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.stack([t._forward_log_det_jacobian(s) for t, s in
+                          zip(self.transforms, self._split(x))], self.axis)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        ld = 0.0
+        for t in self.transforms:
+            ld = ld + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return ld
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, dtype=x.dtype)
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self.base._forward_log_det_jacobian(x)
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+        return jnp.sum(ld, axis=axes)

@@ -1,0 +1,44 @@
+"""Random-variable domain descriptors (reference:
+python/paddle/distribution/variable.py)."""
+from . import constraint
+
+
+class Variable:
+    def __init__(self, is_discrete=False, event_rank=0, constraint=None):
+        self.is_discrete = is_discrete
+        self.event_rank = event_rank
+        self._constraint = constraint
+
+    def constraint_check(self, value):
+        return self._constraint(value)
+
+
+class Real(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, constraint.real)
+
+
+class Positive(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, constraint.positive)
+
+
+class Independent(Variable):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._reinterpreted_batch_rank = reinterpreted_batch_rank
+        super().__init__(base.is_discrete,
+                         base.event_rank + reinterpreted_batch_rank,
+                         base._constraint)
+
+
+class Stack(Variable):
+    def __init__(self, vars, axis=0):
+        self._vars = vars
+        self._axis = axis
+        super().__init__(any(v.is_discrete for v in vars),
+                         max(v.event_rank for v in vars))
+
+
+real = Real()
+positive = Positive()

@@ -1,0 +1,94 @@
+"""Independent + TransformedDistribution wrappers (reference:
+python/paddle/distribution/{independent,transformed_distribution}.py)."""
+import jax.numpy as jnp
+
+from .distribution import Distribution, _arr, _shape
+from ..core.tensor import Tensor
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        if self.reinterpreted_batch_rank > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_rank exceeds batch rank")
+        k = self.reinterpreted_batch_rank
+        super().__init__(
+            batch_shape=base.batch_shape[:len(base.batch_shape) - k],
+            event_shape=base.batch_shape[len(base.batch_shape) - k:]
+            + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def _sample(self, key, shape):
+        return self.base._sample(key, shape)
+
+    def _log_prob(self, value):
+        lp = self.base._log_prob(value)
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+        return jnp.sum(lp, axis=axes) if axes else lp
+
+    def entropy(self):
+        ent = self.base.entropy().data
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+        return Tensor(jnp.sum(ent, axis=axes) if axes else ent)
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through a chain of transforms."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        shape = base.batch_shape + base.event_shape
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        # keep base's batch/event split convention on the transformed shape
+        nb = len(base.batch_shape)
+        super().__init__(batch_shape=shape[:nb], event_shape=shape[nb:])
+
+    def _sample(self, key, shape):
+        x = self.base._sample(key, shape)
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def sample(self, shape=()):
+        from ..core import random as _random
+        import jax
+        return Tensor(jax.lax.stop_gradient(
+            self._sample(_random.next_key(), _shape(shape))))
+
+    def rsample(self, shape=()):
+        from ..core import random as _random
+        return Tensor(self._sample(_random.next_key(), _shape(shape)))
+
+    def _log_prob(self, value):
+        # log p(y) = log p_base(x) - Σ log|det J|, each summed down to this
+        # distribution's batch shape (torch/paddle shape algebra)
+        event_rank = len(self._event_shape)
+        lp = 0.0
+        y = value
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            ld = t._forward_log_det_jacobian(x)
+            lp = lp - _sum_rightmost(ld, event_rank - (y.ndim - ld.ndim))
+            y = x
+        base_lp = self.base._log_prob(y)
+        lp = lp + _sum_rightmost(base_lp,
+                                 event_rank - len(self.base.event_shape))
+        return lp
+
+
+def _sum_rightmost(x, n):
+    if n <= 0:
+        return x
+    return jnp.sum(x, axis=tuple(range(-n, 0)))
