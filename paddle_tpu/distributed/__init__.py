@@ -23,6 +23,7 @@ from .parallel import (init_parallel_env, get_rank, get_world_size,
 from .spmd_rules import RULE_TABLE, get_rule, register_rule
 from .constraint import sharding_constraint, current_mesh
 from . import fleet
+from . import checkpoint
 from .auto_parallel import to_static as _ap_to_static  # noqa: F401 (optional)
 from . import auto_parallel
 

@@ -1,0 +1,33 @@
+"""Checkpoint metadata types (reference: python/paddle/distributed/
+checkpoint/metadata.py:20,31,41 — LocalTensorMetadata / LocalTensorIndex /
+Metadata)."""
+import dataclasses
+from typing import Dict, List, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalTensorMetadata:
+    """One saved shard of one logical tensor."""
+    global_offset: Tuple[int, ...]   # where the shard starts in the global tensor
+    local_shape: Tuple[int, ...]
+    dtype: str
+    file_name: str                   # which .distcp file holds it
+    key_in_file: str                 # npz key inside that file
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalTensorIndex:
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclasses.dataclass
+class Metadata:
+    # tensor_key -> global shape / dtype
+    global_shapes: Dict[str, Tuple[int, ...]] = dataclasses.field(default_factory=dict)
+    dtypes: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # tensor_key -> list of saved shards
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = dataclasses.field(default_factory=dict)
+    # non-tensor entries (python scalars, nested scheduler state, ...)
+    scalars: Dict[str, object] = dataclasses.field(default_factory=dict)
+    version: str = "1.0"

@@ -1,0 +1,131 @@
+"""Distributed checkpoint tests (reference analogue: the reshard-on-load
+coverage of test/auto_parallel/semi_auto_llama_save_load.py and
+test/distributed checkpoint unit tests)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import Shard, Replicate, ProcessMesh
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu import nn, optimizer
+
+
+@pytest.fixture
+def mesh():
+    return ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+
+
+def test_save_load_plain_roundtrip(tmp_path):
+    model = nn.Linear(8, 4)
+    sd = model.state_dict()
+    ckpt.save_state_dict(sd, str(tmp_path))
+    model2 = nn.Linear(8, 4)
+    sd2 = model2.state_dict()
+    ckpt.load_state_dict(sd2, str(tmp_path))
+    np.testing.assert_allclose(np.asarray(sd2["weight"].data),
+                               np.asarray(sd["weight"].data))
+    np.testing.assert_allclose(np.asarray(sd2["bias"].data),
+                               np.asarray(sd["bias"].data))
+
+
+def test_sharded_save_has_shard_metadata(tmp_path, mesh):
+    x = paddle.to_tensor(np.arange(32, dtype=np.float32).reshape(8, 4))
+    d = dist.shard_tensor(x, mesh, [Shard(0), Replicate()])
+    ckpt.save_state_dict({"w": d}, str(tmp_path))
+    import pickle
+    with open(os.path.join(str(tmp_path), "0.metadata"), "rb") as f:
+        meta = pickle.load(f)
+    shards = meta.state_dict_metadata["w"]
+    assert len(shards) == 2  # dp=2 shards; mp-replicas deduped
+    offsets = sorted(s.global_offset for s in shards)
+    assert offsets == [(0, 0), (4, 0)]
+    assert meta.global_shapes["w"] == (8, 4)
+
+
+def test_replica_dedup(tmp_path, mesh):
+    x = paddle.ones([4, 4])
+    d = dist.shard_tensor(x, mesh, [Replicate(), Replicate()])
+    ckpt.save_state_dict({"w": d}, str(tmp_path))
+    data = np.load(os.path.join(str(tmp_path), "0_0.distcp"))
+    assert len(data.files) == 1  # 8 replicas → 1 saved copy
+
+
+def test_reshard_on_load_shard0_to_shard1(tmp_path, mesh):
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(8, 8)).astype(np.float32))
+    src = dist.shard_tensor(x, mesh, [Shard(0), Replicate()])
+    ckpt.save_state_dict({"w": src}, str(tmp_path))
+    tgt = dist.shard_tensor(paddle.zeros([8, 8]), mesh,
+                            [Replicate(), Shard(1)])
+    sd = {"w": tgt}
+    ckpt.load_state_dict(sd, str(tmp_path))
+    np.testing.assert_allclose(np.asarray(sd["w"].data), np.asarray(x.data))
+    # target sharding preserved
+    assert sd["w"].placements[1] == Shard(1)
+
+
+def test_reshard_on_load_to_replicated_and_back(tmp_path, mesh):
+    x = paddle.to_tensor(np.random.default_rng(1).normal(
+        size=(4, 8)).astype(np.float32))
+    src = dist.shard_tensor(x, mesh, [Shard(0), Shard(1)])
+    ckpt.save_state_dict({"w": src}, str(tmp_path))
+    plain = paddle.zeros([4, 8])
+    sd = {"w": plain}
+    ckpt.load_state_dict(sd, str(tmp_path))
+    np.testing.assert_allclose(np.asarray(sd["w"].data), np.asarray(x.data))
+
+
+def test_optimizer_state_and_scalars(tmp_path):
+    model = nn.Linear(4, 2)
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    x = paddle.randn([8, 4])
+    model(x).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    ckpt.save_state_dict(sd, str(tmp_path))
+
+    model2 = nn.Linear(4, 2)
+    opt2 = optimizer.AdamW(learning_rate=1e-2, parameters=model2.parameters())
+    model2(x).sum().backward()
+    opt2.step()  # populate accumulators
+    sd2 = opt2.state_dict()
+    ckpt.load_state_dict(sd2, str(tmp_path))
+    assert sd2["@step"] == sd["@step"]
+    for k in sd:
+        if hasattr(sd[k], "data"):
+            np.testing.assert_allclose(np.asarray(sd2[k].data),
+                                       np.asarray(sd[k].data), rtol=1e-6)
+
+
+def test_shape_mismatch_raises(tmp_path):
+    ckpt.save_state_dict({"w": paddle.ones([4, 4])}, str(tmp_path))
+    with pytest.raises(ValueError, match="shape mismatch"):
+        ckpt.load_state_dict({"w": paddle.zeros([2, 4])}, str(tmp_path))
+
+
+def test_missing_key_raises(tmp_path):
+    ckpt.save_state_dict({"a": paddle.ones([2])}, str(tmp_path))
+    with pytest.raises(KeyError):
+        ckpt.load_state_dict({"b": paddle.zeros([2])}, str(tmp_path))
+
+
+def test_bfloat16_roundtrip(tmp_path):
+    x = paddle.ones([4, 4]).astype("bfloat16")
+    ckpt.save_state_dict({"w": x}, str(tmp_path))
+    tgt = paddle.zeros([4, 4]).astype("bfloat16")
+    sd = {"w": tgt}
+    ckpt.load_state_dict(sd, str(tmp_path))
+    assert str(sd["w"].dtype) == "bfloat16"
+    np.testing.assert_allclose(np.asarray(sd["w"].astype("float32").data), 1.0)
+
+
+def test_nested_state_dict(tmp_path):
+    sd = {"model": {"w": paddle.ones([2, 2])}, "meta": {"epoch": 7}}
+    ckpt.save_state_dict(sd, str(tmp_path))
+    sd2 = {"model": {"w": paddle.zeros([2, 2])}, "meta": {"epoch": 0}}
+    ckpt.load_state_dict(sd2, str(tmp_path))
+    np.testing.assert_allclose(np.asarray(sd2["model"]["w"].data), 1.0)
+    assert sd2["meta"]["epoch"] == 7
