@@ -15,6 +15,7 @@ from . import autograd as ag
 from .autograd import GradNode
 
 _amp_hook = None  # installed by paddle_tpu.amp; signature (name, args, kwargs) -> (args, kwargs)
+_op_tracer = None  # installed by paddle_tpu.profiler; signature (name) -> ctx manager
 
 # ops allowed to consume Partial-placement DTensors (they implement the
 # pending reduction); everything else must reshard first
@@ -26,7 +27,19 @@ def set_amp_hook(fn):
     _amp_hook = fn
 
 
+def set_op_tracer(fn):
+    global _op_tracer
+    _op_tracer = fn
+
+
 def apply_op(name, impl, args, kwargs, differentiable=True):
+    if _op_tracer is not None:
+        with _op_tracer(name):
+            return _apply_op_inner(name, impl, args, kwargs, differentiable)
+    return _apply_op_inner(name, impl, args, kwargs, differentiable)
+
+
+def _apply_op_inner(name, impl, args, kwargs, differentiable=True):
     from .tensor import Tensor
 
     if _amp_hook is not None:

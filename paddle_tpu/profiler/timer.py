@@ -1,0 +1,73 @@
+"""Throughput benchmark timer (reference: python/paddle/profiler/timer.py —
+`Benchmark` with reader/batch cost and ips, hapi hooks `benchmark()`)."""
+import time
+
+
+class _Stat:
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+        self.last = 0.0
+
+    def add(self, v):
+        self.total += v
+        self.count += 1
+        self.last = v
+
+    @property
+    def avg(self):
+        return self.total / max(self.count, 1)
+
+
+class Benchmark:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._step_start = None
+        self._reader_start = None
+        self.batch_cost = _Stat()
+        self.reader_cost = _Stat()
+        self.ips = _Stat()
+        self.steps = 0
+
+    def begin(self):
+        self._step_start = time.perf_counter()
+        self._reader_start = self._step_start
+
+    def before_reader(self):
+        self._reader_start = time.perf_counter()
+
+    def after_reader(self):
+        if self._reader_start is not None:
+            self.reader_cost.add(time.perf_counter() - self._reader_start)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._step_start is not None:
+            dur = now - self._step_start
+            self.batch_cost.add(dur)
+            if num_samples:
+                self.ips.add(num_samples / dur)
+            self.steps += 1
+        self._step_start = now
+
+    def end(self):
+        self._step_start = None
+
+    def step_info(self, unit=None):
+        u = unit or "samples"
+        msg = (f"batch_cost: {self.batch_cost.last:.5f} s "
+               f"(avg {self.batch_cost.avg:.5f} s)")
+        if self.reader_cost.count:
+            msg += f", reader_cost: {self.reader_cost.avg:.5f} s"
+        if self.ips.count:
+            msg += f", ips: {self.ips.last:.2f} {u}/s"
+        return msg
+
+
+_global_benchmark = Benchmark()
+
+
+def benchmark():
+    return _global_benchmark

@@ -1,0 +1,115 @@
+"""Profiler tests (reference analogue: test/legacy_test/test_profiler*.py —
+scheduler state machine, event capture, chrome trace export)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 make_scheduler, export_chrome_tracing)
+
+
+def test_scheduler_state_machine():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                           skip_first=1)
+    states = [sched(i) for i in range(6)]
+    assert states == [
+        ProfilerState.CLOSED,              # skip_first
+        ProfilerState.CLOSED,              # closed
+        ProfilerState.READY,               # ready
+        ProfilerState.RECORD,
+        ProfilerState.RECORD_AND_RETURN,   # last record step
+        ProfilerState.CLOSED,              # repeat exhausted
+    ]
+
+
+def test_profiler_captures_op_events():
+    p = Profiler()
+    p.start()
+    x = paddle.randn([32, 32])
+    for _ in range(3):
+        x = paddle.matmul(x, x)
+    summary = profiler.statistics.build_summary(
+        profiler._tracer.events)
+    p.stop()
+    assert "matmul" in summary.by_name
+    assert summary.by_name["matmul"].calls == 3
+    assert summary.by_name["matmul"].total_us > 0
+
+
+def test_record_event_user_range():
+    p = Profiler()
+    p.start()
+    with RecordEvent("my_block"):
+        paddle.randn([4])
+    summary = profiler.statistics.build_summary(profiler._tracer.events)
+    p.stop()
+    assert "my_block" in summary.by_name
+
+
+def test_record_event_outside_profiler_noop():
+    before = len(profiler._tracer.events)
+    with RecordEvent("ignored"):
+        pass
+    assert len(profiler._tracer.events) == before
+
+
+def test_chrome_trace_export(tmp_path):
+    done = {}
+
+    def on_ready(prof):
+        done["path"] = prof._last_export_path
+
+    p = Profiler(scheduler=make_scheduler(closed=0, ready=0, record=1,
+                                          repeat=1),
+                 on_trace_ready=export_chrome_tracing(str(tmp_path)))
+    p.start()
+    paddle.matmul(paddle.randn([8, 8]), paddle.randn([8, 8]))
+    p.step()
+    p.stop()
+    files = os.listdir(str(tmp_path))
+    assert any(f.endswith(".paddle_trace.json") for f in files)
+    path = os.path.join(str(tmp_path), files[0])
+    trace = profiler.load_profiler_result(path)
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "matmul" in names
+    assert all({"ph", "ts", "dur", "pid", "tid"} <= set(e)
+               for e in trace["traceEvents"])
+
+
+def test_profiler_scheduler_windows_gate_recording():
+    p = Profiler(scheduler=make_scheduler(closed=1, ready=0, record=1,
+                                          repeat=1))
+    p.start()                      # step 0: CLOSED
+    paddle.randn([4])
+    assert not p._recording
+    p.step()                       # step 1: RECORD_AND_RETURN (record=1)
+    assert p._recording
+    paddle.matmul(paddle.randn([4, 4]), paddle.randn([4, 4]))
+    p.step()                       # closes window
+    assert not p._recording
+    p.stop()
+
+
+def test_step_info_and_benchmark():
+    p = Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        paddle.randn([16])
+        p.step(num_samples=16)
+    info = p.step_info()
+    assert "batch_cost" in info and "ips" in info
+    p.stop()
+
+
+def test_summary_prints(capsys):
+    p = Profiler()
+    p.start()
+    paddle.matmul(paddle.randn([8, 8]), paddle.randn([8, 8]))
+    p.stop()
+    p.summary()
+    out = capsys.readouterr().out
+    assert "matmul" in out and "Calls" in out
