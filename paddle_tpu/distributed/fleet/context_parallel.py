@@ -1,0 +1,222 @@
+"""Context parallelism: ring attention + Ulysses (alltoall) attention.
+
+Capability-gap closure (SURVEY.md §5.7): the reference core has NO
+ring/context-parallel attention (only the `sep` mesh axis + alltoall
+primitive — Ulysses lives downstream in PaddleNLP, ring attention nowhere).
+Here both are first-class, built the TPU way:
+
+- **Ring attention**: sequence sharded over a mesh axis; K/V blocks rotate
+  around the ring via `lax.ppermute` (ICI neighbor exchange — the optimal
+  pattern for a TPU torus) while each device folds incoming blocks into a
+  flash-style online-softmax accumulator. Peak memory is O(S_local), so
+  context length scales linearly with ring size.
+- **Ulysses attention**: `lax.all_to_all` swaps the sharded dim from
+  sequence to heads (seq/p × H -> seq × H/p), runs full local attention,
+  and swaps back. Two alltoalls instead of p-1 ppermutes; best when
+  num_heads >= ring size.
+
+Both run inside `shard_map` so XLA schedules the collectives on ICI, and
+both are reverse-differentiable (the bwd pass re-runs the ring — jax
+derives it from the scan).
+
+Reference anchors for the surrounding API shape:
+- sep axis: python/paddle/distributed/fleet/base/topology.py:73-78
+- SegmentParallel wrapper: .../meta_parallel/segment_parallel.py:26
+- alltoall primitive: .../communication/stream/all_to_all.py
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from ...core.dispatch import apply_op
+from ... import nn
+from .topology import get_hcg
+
+_NEG = -1e30  # finite mask value; -inf breaks online-softmax edge cases
+
+
+def _sep_axis(mesh=None, axis_name=None):
+    if mesh is not None and axis_name is not None:
+        return mesh, axis_name
+    hcg = get_hcg()
+    if hcg is None:
+        raise RuntimeError(
+            "context parallelism needs a mesh: call fleet.init with "
+            "sep_degree>1, or pass mesh=/axis_name= explicitly")
+    return hcg.mesh, "sep"
+
+
+def _repeat_kv(q, k, v):
+    if k.shape[2] != q.shape[2]:  # GQA: broadcast KV head groups
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+# ---------------------------------------------------------------------------
+# ring attention
+# ---------------------------------------------------------------------------
+def _ring_attention_local(q, k, v, axis_name, causal, scale):
+    """Per-device body ([B, S_loc, H, D] shards, contiguous seq blocks).
+
+    Online softmax in f32: carry (k_blk, v_blk, m, l, acc); each step folds
+    the currently-held K/V block in, then ppermutes it one hop around the
+    ring. After step t the block on device i originated on device (i-t)%p,
+    so step 0 is the diagonal block — under causal masking its rows are
+    never fully masked, which keeps the running max finite from the start.
+    """
+    p = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+
+    b, s_loc, h, d = q.shape
+    kvh = k.shape[2]
+    rep = h // kvh  # GQA group size; K/V stay at kvh heads in the ring carry
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    # q: [B,S,H,D] -> [B, kvh, rep, S, D] (query heads grouped per KV head);
+    # k/v: [B,S,kvh,D] -> [B, kvh, S, D]
+    qt = jnp.swapaxes(q, 1, 2).reshape(b, kvh, rep, s_loc, d)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    q_pos = idx * s_loc + jnp.arange(s_loc)  # global query positions
+    perm = [(j, (j + 1) % p) for j in range(p)]
+
+    def step(carry, t):
+        kc, vc, m, l, acc = carry
+        src = (idx - t) % p  # origin rank of the block currently held
+        logits = jnp.einsum("bgrsd,bgtd->bgrst", qt, kc,
+                            preferred_element_type=jnp.float32) * sc
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            keep = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(keep[None, None, None], logits, _NEG)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        alpha = jnp.exp(m - m_new)                      # rescale old state
+        probs = jnp.exp(logits - m_new[..., None])
+        if causal:
+            probs = jnp.where(keep[None, None, None], probs, 0.0)
+        l_new = l * alpha + probs.sum(axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bgrst,bgtd->bgrsd", probs, vc.astype(jnp.float32))
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, kvh, rep, s_loc), _NEG, dtype=jnp.float32)
+    l0 = jnp.zeros((b, kvh, rep, s_loc), dtype=jnp.float32)
+    acc0 = jnp.zeros((b, kvh, rep, s_loc, d), dtype=jnp.float32)
+    (kt, vt, m, l, acc), _ = lax.scan(
+        jax.checkpoint(step), (kt, vt, m0, l0, acc0), jnp.arange(p))
+    out = (acc / l[..., None]).reshape(b, h, s_loc, d)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ring_attention(query, key, value, causal=True, scale=None, mesh=None,
+                   axis_name=None):
+    """Ring attention over the `sep` (context) mesh axis.
+
+    Inputs [batch, seqlen_local, num_heads, head_dim] with the sequence dim
+    sharded over the ring axis (contiguous blocks). Returns the attention
+    output with the same sharding. GQA supported.
+    """
+    mesh, axis = _sep_axis(mesh, axis_name)
+    jm = mesh.jax_mesh
+
+    def impl(q, k, v):
+        spec = P(None, axis, None, None)
+        fn = functools.partial(_ring_attention_local, axis_name=axis,
+                               causal=causal, scale=scale)
+        return shard_map(fn, mesh=jm, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+    return apply_op("ring_attention", impl, (query, key, value), {})
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (alltoall) attention
+# ---------------------------------------------------------------------------
+def _ulysses_local(q, k, v, axis_name, causal, scale):
+    """[B, S/p, H, D] -> alltoall -> [B, S, H/p, D] -> local attention ->
+    alltoall back. Head counts must divide the axis size."""
+    k, v = _repeat_kv(q, k, v)
+    a2a = functools.partial(lax.all_to_all, axis_name=axis_name, tiled=True)
+    q = a2a(q, split_axis=2, concat_axis=1)
+    k = a2a(k, split_axis=2, concat_axis=1)
+    v = a2a(v, split_axis=2, concat_axis=1)
+
+    d = q.shape[-1]
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhsd,bhtd->bhst", qt, kt,
+                        preferred_element_type=jnp.float32) * sc
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        keep = jnp.tril(jnp.ones((s, t), dtype=bool), k=t - s)
+        logits = jnp.where(keep, logits, _NEG)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs,
+                     vt.astype(jnp.float32)).astype(q.dtype)
+    out = jnp.swapaxes(out, 1, 2)
+    return a2a(out, split_axis=1, concat_axis=2)
+
+
+def ulysses_attention(query, key, value, causal=True, scale=None, mesh=None,
+                      axis_name=None):
+    """Ulysses sequence parallelism: alltoall head<->sequence exchange, then
+    full-sequence local attention over H/p heads. num_heads (and KV heads
+    after GQA broadcast) must be divisible by the axis size."""
+    mesh, axis = _sep_axis(mesh, axis_name)
+    jm = mesh.jax_mesh
+    p = mesh.get_dim_size(axis)
+    if query.shape[2] % p != 0:
+        raise ValueError(
+            f"ulysses_attention needs num_heads ({query.shape[2]}) divisible "
+            f"by the context-parallel degree ({p}); use ring_attention for "
+            "head counts smaller than the ring")
+
+    def impl(q, k, v):
+        spec = P(None, axis, None, None)
+        fn = functools.partial(_ulysses_local, axis_name=axis, causal=causal,
+                               scale=scale)
+        return shard_map(fn, mesh=jm, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+    return apply_op("ulysses_attention", impl, (query, key, value), {})
+
+
+# ---------------------------------------------------------------------------
+# SegmentParallel wrapper + helpers
+# ---------------------------------------------------------------------------
+def split_sequence(x, seq_axis=1, mesh=None, axis_name=None):
+    """Shard the sequence dim of a replicated tensor onto the sep axis
+    (entry point into a context-parallel region)."""
+    from ..dtensor import shard_tensor
+    from ..placement import Shard, Replicate
+    mesh, axis = _sep_axis(mesh, axis_name)
+    pl = [Shard(seq_axis) if n == axis else Replicate()
+          for n in mesh.dim_names]
+    return shard_tensor(x, mesh, pl)
+
+
+class SegmentParallel(nn.Layer):
+    """Reference meta_parallel/segment_parallel.py:26 — wraps a model whose
+    attention is context-parallel. Under single-controller SPMD the
+    reference's param-broadcast + sep-axis grad allreduce are what GSPMD
+    does for replicated params automatically; the wrapper's remaining job
+    is sharding the inputs along sequence."""
+
+    def __init__(self, layers, hcg=None, strategy=None, seq_axis=1):
+        super().__init__()
+        self._layers = layers
+        self._seq_axis = seq_axis
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(
+            split_sequence(x, self._seq_axis) if hasattr(x, "ndim")
+            and x.ndim > self._seq_axis else x for x in inputs)
+        return self._layers(*inputs, **kwargs)

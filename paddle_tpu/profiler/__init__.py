@@ -182,6 +182,8 @@ class Profiler:
         self._jax_trace_dir = None
         self._last_export_path = None
         self._summary = None
+        self._events = []  # snapshot of the last recorded window
+        self._step_begin = None
         self._benchmark = Benchmark()
 
     # -- lifecycle -------------------------------------------------------
@@ -216,10 +218,15 @@ class Profiler:
             self._step += 1
             return
         prev = self._state
-        if prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+        now = time.perf_counter()
+        if (prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+                and self._step_begin is not None):
             _tracer.record(f"ProfileStep#{self._step}",
-                           TracerEventType.ProfileStep, 0.0, 0.0,
+                           TracerEventType.ProfileStep,
+                           self._step_begin * 1e6,
+                           (now - self._step_begin) * 1e6,
                            threading.get_ident())
+        self._step_begin = now
         self._step += 1
         self._state = self._scheduler(self._step)
         if prev is ProfilerState.RECORD_AND_RETURN or (
@@ -239,22 +246,25 @@ class Profiler:
 
     def _start_recording(self):
         self._recording = True
+        self._step_begin = time.perf_counter()
         _dispatch.set_op_tracer(_op_tracer_ctx)
 
     def _stop_recording(self, return_trace):
         self._recording = False
         _dispatch.set_op_tracer(None)
-        self._summary = build_summary(_tracer.events)
+        self._events = list(_tracer.events)  # snapshot before clearing so
+        self._summary = build_summary(self._events)  # export() after stop works
+        _tracer.clear()
         if return_trace and self._on_trace_ready is not None:
             self._on_trace_ready(self)
-        _tracer.clear()
 
     # -- export ----------------------------------------------------------
     def _export_chrome(self, path):
+        source = _tracer.events if self._recording else self._events
         events = [{
             "name": name, "ph": "X", "cat": etype.name,
             "ts": ts, "dur": dur, "pid": os.getpid(), "tid": tid,
-        } for name, etype, ts, dur, tid in _tracer.events]
+        } for name, etype, ts, dur, tid in source]
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)
@@ -265,6 +275,7 @@ class Profiler:
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
         if self._summary is None:
-            self._summary = build_summary(_tracer.events)
+            self._summary = build_summary(
+                _tracer.events if self._recording else self._events)
         print_summary(self._summary, time_unit=time_unit)
         return self._summary

@@ -59,19 +59,22 @@ def test_record_event_outside_profiler_noop():
 
 def test_chrome_trace_export(tmp_path):
     done = {}
+    chrome_handler = export_chrome_tracing(str(tmp_path))
 
     def on_ready(prof):
+        chrome_handler(prof)
         done["path"] = prof._last_export_path
 
     p = Profiler(scheduler=make_scheduler(closed=0, ready=0, record=1,
                                           repeat=1),
-                 on_trace_ready=export_chrome_tracing(str(tmp_path)))
+                 on_trace_ready=on_ready)
     p.start()
     paddle.matmul(paddle.randn([8, 8]), paddle.randn([8, 8]))
     p.step()
     p.stop()
     files = os.listdir(str(tmp_path))
     assert any(f.endswith(".paddle_trace.json") for f in files)
+    assert done["path"] == os.path.join(str(tmp_path), files[0])
     path = os.path.join(str(tmp_path), files[0])
     trace = profiler.load_profiler_result(path)
     names = [e["name"] for e in trace["traceEvents"]]
@@ -113,3 +116,26 @@ def test_summary_prints(capsys):
     p.summary()
     out = capsys.readouterr().out
     assert "matmul" in out and "Calls" in out
+
+
+def test_export_after_stop_keeps_events(tmp_path):
+    # regression: stop() snapshots the window; export() after stop must not
+    # write an empty trace
+    p = Profiler()
+    p.start()
+    paddle.matmul(paddle.randn([8, 8]), paddle.randn([8, 8]))
+    p.stop()
+    path = str(tmp_path / "trace.json")
+    p.export(path)
+    trace = profiler.load_profiler_result(path)
+    assert any(e["name"] == "matmul" for e in trace["traceEvents"])
+
+
+def test_profile_step_marker_spans_step():
+    p = Profiler()
+    p.start()
+    paddle.randn([4])
+    p.step()
+    p.stop()
+    marks = [e for e in p._events if e[0].startswith("ProfileStep#")]
+    assert marks and all(ts > 0 and dur > 0 for _, _, ts, dur, _ in marks)
