@@ -43,6 +43,7 @@ from . import autograd
 from . import distribution
 from . import hapi
 from . import profiler
+from . import incubate
 from .hapi import Model, summary
 from .framework import save, load, set_default_dtype, get_default_dtype
 from .utils.flags import set_flags, get_flags

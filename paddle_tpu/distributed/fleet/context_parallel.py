@@ -86,9 +86,9 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale):
     q_pos = idx * s_loc + jnp.arange(s_loc)  # global query positions
     perm = [(j, (j + 1) % p) for j in range(p)]
 
-    def step(carry, t):
-        kc, vc, m, l, acc = carry
-        src = (idx - t) % p  # origin rank of the block currently held
+    def fold(kc, vc, src, m, l, acc):
+        """Fold the K/V block originating on rank `src` into the online
+        softmax state."""
         logits = jnp.einsum("bgrsd,bgtd->bgrst", qt, kc,
                             preferred_element_type=jnp.float32) * sc
         if causal:
@@ -103,15 +103,22 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale):
         l_new = l * alpha + probs.sum(axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
             "bgrst,bgtd->bgrsd", probs, vc.astype(jnp.float32))
+        return m_new, l_new, acc_new
+
+    def step(carry, t):
+        kc, vc, m, l, acc = carry
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
-        return (kc, vc, m_new, l_new, acc_new), None
+        m, l, acc = fold(kc, vc, (idx - t) % p, m, l, acc)
+        return (kc, vc, m, l, acc), None
 
     m0 = jnp.full((b, kvh, rep, s_loc), _NEG, dtype=jnp.float32)
     l0 = jnp.zeros((b, kvh, rep, s_loc), dtype=jnp.float32)
     acc0 = jnp.zeros((b, kvh, rep, s_loc, d), dtype=jnp.float32)
+    # diagonal block first (no hop), then p-1 permute+fold steps
+    m0, l0, acc0 = fold(kt, vt, idx, m0, l0, acc0)
     (kt, vt, m, l, acc), _ = lax.scan(
-        jax.checkpoint(step), (kt, vt, m0, l0, acc0), jnp.arange(p))
+        jax.checkpoint(step), (kt, vt, m0, l0, acc0), jnp.arange(1, p))
     out = (acc / l[..., None]).reshape(b, h, s_loc, d)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
@@ -139,30 +146,20 @@ def ring_attention(query, key, value, causal=True, scale=None, mesh=None,
 # ---------------------------------------------------------------------------
 # Ulysses (alltoall) attention
 # ---------------------------------------------------------------------------
-def _ulysses_local(q, k, v, axis_name, causal, scale):
+def _ulysses_local(q, k, v, axis_name, causal, scale, p):
     """[B, S/p, H, D] -> alltoall -> [B, S, H/p, D] -> local attention ->
-    alltoall back. Head counts must divide the axis size."""
-    k, v = _repeat_kv(q, k, v)
+    alltoall back. When the KV head count divides the axis size, K/V cross
+    the ICI at their native GQA head count and _sdpa_ref broadcasts them
+    locally — otherwise they are broadcast before the exchange."""
+    if k.shape[2] % p != 0:
+        k, v = _repeat_kv(q, k, v)
     a2a = functools.partial(lax.all_to_all, axis_name=axis_name, tiled=True)
     q = a2a(q, split_axis=2, concat_axis=1)
     k = a2a(k, split_axis=2, concat_axis=1)
     v = a2a(v, split_axis=2, concat_axis=1)
 
-    d = q.shape[-1]
-    sc = scale if scale is not None else 1.0 / math.sqrt(d)
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    logits = jnp.einsum("bhsd,bhtd->bhst", qt, kt,
-                        preferred_element_type=jnp.float32) * sc
-    if causal:
-        s, t = logits.shape[-2], logits.shape[-1]
-        keep = jnp.tril(jnp.ones((s, t), dtype=bool), k=t - s)
-        logits = jnp.where(keep, logits, _NEG)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhst,bhtd->bhsd", probs,
-                     vt.astype(jnp.float32)).astype(q.dtype)
-    out = jnp.swapaxes(out, 1, 2)
+    from ...nn.functional.attention import _sdpa_ref
+    out = _sdpa_ref(q, k, v, causal=causal, scale=scale)
     return a2a(out, split_axis=1, concat_axis=2)
 
 
@@ -183,7 +180,7 @@ def ulysses_attention(query, key, value, causal=True, scale=None, mesh=None,
     def impl(q, k, v):
         spec = P(None, axis, None, None)
         fn = functools.partial(_ulysses_local, axis_name=axis, causal=causal,
-                               scale=scale)
+                               scale=scale, p=p)
         return shard_map(fn, mesh=jm, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
     return apply_op("ulysses_attention", impl, (query, key, value), {})
@@ -214,9 +211,17 @@ class SegmentParallel(nn.Layer):
         super().__init__()
         self._layers = layers
         self._seq_axis = seq_axis
+        mesh, axis = _sep_axis()
+        self._degree = mesh.get_dim_size(axis)
+
+    def _shardable(self, x):
+        # only tensors with a real sequence dim divisible by the sep degree;
+        # leaves masks/labels/scalars replicated
+        return (hasattr(x, "ndim") and x.ndim > self._seq_axis
+                and x.shape[self._seq_axis] > 1
+                and x.shape[self._seq_axis] % self._degree == 0)
 
     def forward(self, *inputs, **kwargs):
-        inputs = tuple(
-            split_sequence(x, self._seq_axis) if hasattr(x, "ndim")
-            and x.ndim > self._seq_axis else x for x in inputs)
+        inputs = tuple(split_sequence(x, self._seq_axis)
+                       if self._shardable(x) else x for x in inputs)
         return self._layers(*inputs, **kwargs)

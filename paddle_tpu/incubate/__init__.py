@@ -1,0 +1,6 @@
+"""Staging namespace (reference: python/paddle/incubate/ — fused-op python
+bindings, MoE, asp sparsity, autograd extras)."""
+from . import distributed  # noqa: F401
+from . import nn  # noqa: F401
+from . import autograd  # noqa: F401
+from . import asp  # noqa: F401

@@ -1,0 +1,2 @@
+"""2:4 structured sparsity (reference: python/paddle/incubate/asp/).
+Populated by the asp milestone."""

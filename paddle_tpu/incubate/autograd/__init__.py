@@ -1,0 +1,2 @@
+"""Higher-order autodiff extras (reference: python/paddle/incubate/autograd/).
+Populated with jacobian/hessian."""

@@ -1,0 +1,4 @@
+"""MoE (reference: python/paddle/incubate/distributed/models/moe/)."""
+from .gate import (NaiveGate, SwitchGate, GShardGate, BaseGate,
+                   topk_capacity_dispatch)
+from .moe_layer import (MoELayer, ExpertMLP, global_scatter, global_gather)

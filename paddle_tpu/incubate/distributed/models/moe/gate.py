@@ -1,0 +1,128 @@
+"""MoE gates (reference: python/paddle/incubate/distributed/models/moe/gate/
+— naive_gate.py, switch_gate.py, gshard_gate.py).
+
+TPU-native form: gating must stay inside the traced graph with static
+shapes, so routing is expressed as capacity-bucketed one-hot dispatch /
+combine tensors ([tokens, experts, capacity]) rather than the reference's
+variable-length index lists — the einsum over these is what XLA shards and
+turns into the EP alltoall."""
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.nn import initializer as I
+
+
+def _capacity(num_tokens, num_experts, top_k, capacity_factor):
+    cap = int(capacity_factor * num_tokens * top_k / num_experts)
+    return max(cap, 1)
+
+
+def topk_capacity_dispatch(probs, top_k, capacity):
+    """Build (combine [T,E,C], dispatch [T,E,C] bool, aux_loss) from router
+    probabilities [T, E]. Iterative top-k with per-expert capacity: the i-th
+    choice of each token lands at its cumulative position in the expert's
+    buffer; overflow tokens are dropped (reference gshard semantics)."""
+    T, E = probs.shape
+    remaining = probs
+    location_base = jnp.zeros((E,), dtype=jnp.int32)
+    gates, ce_slots = [], []
+    first_mask = None
+    for i in range(top_k):
+        idx = jnp.argmax(remaining, axis=1)                     # [T]
+        mask = jax.nn.one_hot(idx, E, dtype=probs.dtype)        # [T,E]
+        if first_mask is None:
+            first_mask = mask
+        pos = (jnp.cumsum(mask, axis=0) - 1
+               + location_base[None, :]).astype(jnp.int32)      # [T,E]
+        keep = (pos < capacity).astype(probs.dtype)
+        mask = mask * keep
+        location_base = location_base + mask.sum(axis=0).astype(jnp.int32)
+        gates.append((probs * mask).sum(axis=1))                # [T]
+        slot = jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity,
+                              dtype=probs.dtype)                # [T,E,C]
+        ce_slots.append(mask[..., None] * slot)
+        remaining = remaining * (1.0 - jax.nn.one_hot(idx, E,
+                                                      dtype=probs.dtype))
+    denom = sum(gates)
+    denom = jnp.where(denom > 0, denom, 1.0)
+    combine = sum(g[:, None, None] / denom[:, None, None] * ce
+                  for g, ce in zip(gates, ce_slots))
+    dispatch = combine > 0
+    # load-balance loss over first choices (gshard eq.(4) / switch eq.(4)):
+    # E * sum_e f_e * P_e, minimized when routing is uniform
+    f = first_mask.mean(axis=0)
+    p = probs.mean(axis=0)
+    aux_loss = E * jnp.sum(f * p)
+    return combine, dispatch, aux_loss
+
+
+class BaseGate(nn.Layer):
+    def __init__(self, d_model, num_experts, top_k, capacity_factor=1.25):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=I.XavierNormal())
+
+    def routing(self, x):
+        """x [T, d] -> (combine [T,E,C], dispatch [T,E,C], aux_loss).
+        Pure-jnp body: called inside the MoE layer's traced op."""
+        raise NotImplementedError
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax routing, no jitter (reference naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2,
+                 capacity_factor=1.25):
+        super().__init__(d_model, num_expert * world_size, top_k,
+                         capacity_factor)
+
+    def routing(self, x, w):
+        logits = x @ w
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        cap = _capacity(x.shape[0], self.num_experts, self.top_k,
+                        self.capacity_factor)
+        return topk_capacity_dispatch(probs, self.top_k, cap)
+
+
+class SwitchGate(BaseGate):
+    """Top-1 routing with multiplicative jitter during training
+    (reference switch_gate.py; Switch-Transformer §2.2)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=1,
+                 switch_eps=0.1, capacity_factor=1.25):
+        super().__init__(d_model, num_expert * world_size, 1, capacity_factor)
+        self.switch_eps = switch_eps
+
+    def routing(self, x, w, rng_key=None):
+        logits = x @ w
+        if self.training and self.switch_eps > 0 and rng_key is not None:
+            noise = jax.random.uniform(
+                rng_key, logits.shape, minval=1.0 - self.switch_eps,
+                maxval=1.0 + self.switch_eps)
+            logits = logits * noise
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        cap = _capacity(x.shape[0], self.num_experts, 1,
+                        self.capacity_factor)
+        return topk_capacity_dispatch(probs, 1, cap)
+
+
+class GShardGate(BaseGate):
+    """Top-k (default 2) routing with capacity + load-balance loss
+    (reference gshard_gate.py; GShard §3.2)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2,
+                 capacity=(1.2, 2.4), group=None, capacity_factor=None):
+        cf = capacity_factor if capacity_factor is not None else capacity[0]
+        super().__init__(d_model, num_expert * world_size, top_k, cf)
+
+    def routing(self, x, w):
+        logits = x @ w
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        cap = _capacity(x.shape[0], self.num_experts, self.top_k,
+                        self.capacity_factor)
+        return topk_capacity_dispatch(probs, self.top_k, cap)

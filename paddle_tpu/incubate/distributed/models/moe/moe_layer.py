@@ -1,0 +1,203 @@
+"""MoE layer with expert parallelism (reference: python/paddle/incubate/
+distributed/models/moe/moe_layer.py:261 — MoELayer with gate +
+global_scatter/global_gather alltoall dispatch; spmd rules
+paddle/phi/infermeta/spmd_rules/moe_gate_dispatch.cc, moe_combine.cc).
+
+TPU-native mechanics: routing produces capacity-bucketed one-hot
+dispatch/combine tensors (static shapes — XLA's requirement), and expert
+computation is a batched einsum over an [E, ...] buffer. Two EP paths:
+
+- **einsum/GSPMD** (default): the [E, C, d] buffer carries a sharding
+  constraint on the expert dim; XLA inserts the alltoall pair
+  (dispatch/combine) automatically — the compiler plays the role of the
+  reference's global_scatter/global_gather ops.
+- **explicit alltoall**: `global_scatter`/`global_gather` below are the
+  shard_map + lax.all_to_all equivalents of the reference ops, for code
+  that wants the collective placement spelled out.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core.dispatch import apply_op
+from paddle_tpu.core import random as _random
+from paddle_tpu import nn
+from paddle_tpu.nn import initializer as I
+from .gate import NaiveGate, SwitchGate, GShardGate, BaseGate
+
+
+def _ep_constraint(arr, mesh, axis_name):
+    """Shard the leading (expert) dim over the EP axis inside the trace."""
+    if mesh is None or axis_name is None:
+        return arr
+    spec = [None] * arr.ndim
+    spec[0] = axis_name
+    return lax.with_sharding_constraint(
+        arr, jax.sharding.NamedSharding(mesh.jax_mesh, P(*spec)))
+
+
+# ---------------------------------------------------------------------------
+# explicit EP collectives (reference global_scatter/global_gather parity,
+# capacity-padded: counts are implicit in the static [E, C, d] layout)
+# ---------------------------------------------------------------------------
+def global_scatter(x, group=None, mesh=None, axis_name=None):
+    """Expert dispatch alltoall over the EP axis (P devices).
+
+    Input [E, P*C, d]: expert-major buffers, capacity dim sharded so each
+    source device holds its locally-routed [E, C, d] slots. Output has the
+    same global shape but expert-sharded: each device ends up holding ALL
+    devices' tokens for its E/P local experts. Reference: moe/global_scatter
+    (variable-count alltoall); capacity padding makes the shapes static."""
+    if group is not None:
+        mesh, axis_name = group.mesh, group.axis_name
+    jm = mesh.jax_mesh
+
+    def impl(a):
+        def local(v):  # [E, C, d] -> [E/P, P*C, d]
+            return lax.all_to_all(v, axis_name, split_axis=0, concat_axis=1,
+                                  tiled=True)
+        return shard_map(local, mesh=jm, in_specs=P(None, axis_name),
+                         out_specs=P(axis_name), check_vma=False)(a)
+    return apply_op("global_scatter", impl, (x,), {})
+
+
+def global_gather(x, group=None, mesh=None, axis_name=None):
+    """Inverse of global_scatter: expert-sharded [E, P*C, d] back to
+    capacity-sharded per-source buffers."""
+    if group is not None:
+        mesh, axis_name = group.mesh, group.axis_name
+    jm = mesh.jax_mesh
+
+    def impl(a):
+        def local(v):  # [E/P, P*C, d] -> [E, C, d]
+            return lax.all_to_all(v, axis_name, split_axis=1, concat_axis=0,
+                                  tiled=True)
+        return shard_map(local, mesh=jm, in_specs=P(axis_name),
+                         out_specs=P(None, axis_name), check_vma=False)(a)
+    return apply_op("global_gather", impl, (x,), {})
+
+
+class ExpertMLP(nn.Layer):
+    """Batched expert FFN: weights [E, d, ffn] / [E, ffn, d] so all experts
+    run as one einsum on the MXU (and shard over the EP axis)."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden],
+                                        default_initializer=I.XavierNormal())
+        self.b1 = self.create_parameter([num_experts, 1, d_hidden],
+                                        is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model],
+                                        default_initializer=I.XavierNormal())
+        self.b2 = self.create_parameter([num_experts, 1, d_model],
+                                        is_bias=True)
+        self.activation = activation
+
+
+class MoELayer(nn.Layer):
+    """Mixture-of-experts layer (reference moe_layer.py:261).
+
+    `experts` is either an ExpertMLP (batched, EP-shardable — preferred) or
+    a LayerList of per-expert Layers (reference style; runs experts in a
+    static python loop). The auxiliary load-balance loss of the last forward
+    is exposed as `.l_aux` (a Tensor participating in autograd — add it to
+    the training loss)."""
+
+    def __init__(self, d_model=None, experts=None, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, top_k=2,
+                 num_experts=None, capacity_factor=1.25, mesh=None,
+                 axis_name=None, **kwargs):
+        super().__init__()
+        if isinstance(gate, dict):  # reference config-dict form
+            top_k = gate.get("top_k", top_k)
+            gate_type = gate.get("type", "gshard")
+            gate = None
+        else:
+            gate_type = "naive"
+        if experts is None:
+            raise ValueError("experts required (ExpertMLP or LayerList)")
+        self.experts = experts
+        if isinstance(experts, ExpertMLP):
+            self.num_experts = experts.num_experts
+        else:
+            self.num_experts = len(experts)
+        if d_model is None:
+            if isinstance(experts, ExpertMLP):
+                d_model = experts.w1.shape[1]
+            elif gate is None:
+                raise ValueError(
+                    "d_model is required to build a gate when experts is a "
+                    "LayerList (it cannot be inferred)")
+        if gate is None:
+            cls = {"naive": NaiveGate, "switch": SwitchGate,
+                   "gshard": GShardGate}[gate_type]
+            gate = cls(d_model, self.num_experts, top_k=top_k,
+                       capacity_factor=capacity_factor)
+        self.gate = gate
+        if moe_group is not None:
+            mesh, axis_name = moe_group.mesh, moe_group.axis_name
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.l_aux = None
+
+    def forward(self, x):
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        gate = self.gate
+        mesh, axis_name = self.mesh, self.axis_name
+        experts = self.experts
+        batched = isinstance(experts, ExpertMLP)
+        rng_key = _random.next_key() if isinstance(gate, SwitchGate) \
+            and self.training else None
+
+        if batched:
+            act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+                   "silu": jax.nn.silu}[experts.activation]
+
+            def impl(xf, gw, w1, b1, w2, b2):
+                t = xf.reshape(-1, d)
+                routing = functools.partial(gate.routing, t, gw)
+                combine, dispatch, aux = routing(rng_key=rng_key) \
+                    if rng_key is not None else routing()
+                combine = combine.astype(xf.dtype)
+                buf = jnp.einsum("tec,td->ecd",
+                                 dispatch.astype(xf.dtype), t)
+                buf = _ep_constraint(buf, mesh, axis_name)  # EP alltoall here
+                h = act(jnp.einsum("ecd,edf->ecf", buf, w1) + b1)
+                out = jnp.einsum("ecf,efd->ecd", h, w2) + b2
+                out = _ep_constraint(out, mesh, axis_name)  # alltoall back
+                y = jnp.einsum("tec,ecd->td", combine, out)
+                return y.reshape(xf.shape), aux.astype(xf.dtype)
+
+            y, aux = apply_op(
+                "moe_layer", impl,
+                (x, gate.weight, experts.w1, experts.b1, experts.w2,
+                 experts.b2), {})
+        else:
+            # reference-style per-expert Layers: dispatch and combine are
+            # traced ops; the experts themselves run as ordinary eager Layer
+            # calls in between so their parameters stay on the tape
+            def dispatch_impl(xf, gw):
+                t = xf.reshape(-1, d)
+                routing = functools.partial(gate.routing, t, gw)
+                combine, dispatch, aux = routing(rng_key=rng_key) \
+                    if rng_key is not None else routing()
+                buf = jnp.einsum("tec,td->ecd",
+                                 dispatch.astype(xf.dtype), t)
+                return buf, combine.astype(xf.dtype), aux.astype(xf.dtype)
+
+            buf, combine, aux = apply_op("moe_gate_dispatch", dispatch_impl,
+                                         (x, gate.weight), {})
+            outs = [experts[e](buf[e]) for e in range(self.num_experts)]
+
+            def combine_impl(c, *eo):
+                out = jnp.stack(eo, axis=0)
+                return jnp.einsum("tec,ecd->td", c, out).reshape(x.shape)
+
+            y = apply_op("moe_combine", combine_impl, (combine, *outs), {})
+        self.l_aux = aux
+        return y

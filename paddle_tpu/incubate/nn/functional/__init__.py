@@ -1,0 +1,2 @@
+"""Fused-op python bindings land here (reference: python/paddle/incubate/
+nn/functional/). Populated by the fused/Pallas tier."""

@@ -1,0 +1,126 @@
+"""MoE / expert-parallel tests (reference test model: test/collective/fleet
+moe tests + incubate/distributed/models/moe). Routing invariants checked
+directly; EP checked against the unsharded run on the 8-device mesh."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import ProcessMesh
+from paddle_tpu.incubate.distributed.models.moe import (
+    MoELayer, ExpertMLP, NaiveGate, SwitchGate, GShardGate,
+    topk_capacity_dispatch, global_scatter, global_gather)
+
+
+class TestRouting:
+    def test_topk_dispatch_invariants(self, rng):
+        T, E, k, C = 64, 8, 2, 16
+        probs = jnp.asarray(rng.random((T, E)).astype(np.float32))
+        probs = probs / probs.sum(axis=1, keepdims=True)
+        combine, dispatch, aux = topk_capacity_dispatch(probs, k, C)
+        assert combine.shape == (T, E, C)
+        # each token routed to at most k slots, each slot at most one token
+        assert int(dispatch.sum(axis=(1, 2)).max()) <= k
+        assert int(dispatch.sum(axis=0).max()) <= 1
+        # combine weights normalized over the chosen experts
+        w = np.asarray(combine.sum(axis=(1, 2)))
+        routed = np.asarray(dispatch.sum(axis=(1, 2))) > 0
+        np.testing.assert_allclose(w[routed], 1.0, atol=1e-5)
+        assert float(aux) > 0
+
+    def test_capacity_drops_overflow(self):
+        # all tokens prefer expert 0; capacity forces drops
+        T, E, C = 32, 4, 4
+        probs = jnp.tile(jnp.asarray([[0.97, 0.01, 0.01, 0.01]]), (T, 1))
+        combine, dispatch, aux = topk_capacity_dispatch(probs, 1, C)
+        assert int(dispatch[:, 0].sum()) == C  # only C tokens make it
+
+
+class TestMoELayer:
+    def _x(self, rng, b=4, s=8, d=16):
+        return paddle.to_tensor(
+            rng.standard_normal((b, s, d)).astype(np.float32),
+            stop_gradient=False)
+
+    def test_batched_forward_backward(self, rng):
+        x = self._x(rng)
+        moe = MoELayer(d_model=16,
+                       experts=ExpertMLP(4, 16, 32),
+                       gate=NaiveGate(16, 4, top_k=2))
+        y = moe(x)
+        assert y.shape == x.shape
+        assert moe.l_aux is not None and float(moe.l_aux.numpy()) > 0
+        loss = y.sum() + moe.l_aux
+        loss.backward()
+        assert x.grad is not None
+        assert moe.experts.w1.grad is not None
+        assert moe.gate.weight.grad is not None
+        assert float(np.abs(moe.gate.weight.grad.numpy()).sum()) > 0
+
+    def test_layerlist_experts_grads(self, rng):
+        x = self._x(rng)
+        experts = nn.LayerList([nn.Linear(16, 16) for _ in range(4)])
+        moe = MoELayer(d_model=16, experts=experts,
+                       gate=NaiveGate(16, 4, top_k=2))
+        y = moe(x)
+        (y.sum() + moe.l_aux).backward()
+        for e in experts:
+            assert e.weight.grad is not None
+
+    def test_single_expert_equals_dense(self, rng):
+        # one expert with generous capacity == plain MLP on every token
+        d, ffn = 8, 16
+        x = self._x(rng, b=2, s=4, d=d)
+        mlp = ExpertMLP(1, d, ffn)
+        moe = MoELayer(d_model=d, experts=mlp,
+                       gate=NaiveGate(d, 1, top_k=1, capacity_factor=2.0))
+        y = moe(x)
+        t = x.numpy().reshape(-1, d)
+        h = np.asarray(jnp.asarray(t) @ mlp.w1.numpy()[0]) + mlp.b1.numpy()[0]
+        h = np.asarray(jnp.asarray(paddle.nn.functional.gelu(
+            paddle.to_tensor(h)).numpy()))
+        ref = (h @ mlp.w2.numpy()[0] + mlp.b2.numpy()[0]).reshape(x.shape)
+        np.testing.assert_allclose(y.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_switch_gate(self, rng):
+        x = self._x(rng)
+        moe = MoELayer(d_model=16, experts=ExpertMLP(4, 16, 32),
+                       gate=SwitchGate(16, 4))
+        moe.train()
+        y = moe(x)
+        assert y.shape == x.shape
+
+    def test_gshard_gate_config_dict(self, rng):
+        x = self._x(rng)
+        moe = MoELayer(d_model=16, experts=ExpertMLP(4, 16, 32),
+                       gate={"type": "gshard", "top_k": 2})
+        assert isinstance(moe.gate, GShardGate)
+        assert moe(x).shape == x.shape
+
+
+class TestExpertParallel:
+    def test_ep_matches_unsharded(self, rng):
+        mesh = ProcessMesh(np.arange(8), dim_names=["expert"])
+        x = rng.standard_normal((4, 8, 16)).astype(np.float32)
+        paddle.seed(7)
+        experts = ExpertMLP(8, 16, 32)
+        gate = NaiveGate(16, 8, top_k=2)
+        moe_ep = MoELayer(d_model=16, experts=experts, gate=gate,
+                          mesh=mesh, axis_name="expert")
+        moe_ref = MoELayer(d_model=16, experts=experts, gate=gate)
+        y_ep = moe_ep(paddle.to_tensor(x))
+        y_ref = moe_ref(paddle.to_tensor(x))
+        np.testing.assert_allclose(y_ep.numpy(), y_ref.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_global_scatter_gather_roundtrip(self, rng):
+        # E=8 experts, P=8 devices, C=4 slots/device: buffer [E, P*C, d]
+        mesh = ProcessMesh(np.arange(8), dim_names=["expert"])
+        buf = paddle.to_tensor(
+            rng.standard_normal((8, 32, 16)).astype(np.float32))
+        scattered = global_scatter(buf, mesh=mesh, axis_name="expert")
+        assert list(scattered.shape) == [8, 32, 16]
+        back = global_gather(scattered, mesh=mesh, axis_name="expert")
+        np.testing.assert_allclose(back.numpy(), buf.numpy(), rtol=1e-6,
+                                   atol=1e-6)
