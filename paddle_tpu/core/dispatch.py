@@ -14,6 +14,45 @@ from jax.tree_util import tree_flatten, tree_unflatten
 from . import autograd as ag
 from .autograd import GradNode
 
+
+def _block_on(out):
+    """FLAGS_benchmark: block until the op's outputs are materialised so
+    host wall-time is attributable per-op (reference FLAGS_benchmark forces
+    a device sync after each op). No-op under tracing."""
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    for o in outs:
+        if not isinstance(o, jax.core.Tracer):
+            try:
+                jax.block_until_ready(o)
+            except Exception:
+                pass
+
+
+def _check_nan_inf(name, out):
+    """FLAGS_check_nan_inf per-op output watch (reference: per-op check in
+    paddle/fluid/eager/nan_inf_utils.cc, flag at paddle/common/flags.cc:72).
+    Eager-only: tracers are skipped (inside jit there is no value yet)."""
+    import numpy as np
+    from ..utils import flags as _flags
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    for o in outs:
+        if isinstance(o, jax.core.Tracer) or not hasattr(o, "dtype"):
+            continue
+        if o.dtype.kind != "f" and o.dtype.kind != "c":
+            continue
+        arr = np.asarray(o)
+        bad = ~np.isfinite(arr)
+        if bad.any():
+            msg = (f"Operator '{name}' output contains "
+                   f"{int(np.isnan(arr).sum())} NaN / "
+                   f"{int(np.isinf(arr).sum())} Inf values "
+                   f"(shape {arr.shape}, dtype {arr.dtype})")
+            if _flags.check_nan_inf_level >= 1:
+                import warnings
+                warnings.warn(msg)
+            else:
+                raise FloatingPointError(msg)
+
 _amp_hook = None  # installed by paddle_tpu.amp; signature (name, args, kwargs) -> (args, kwargs)
 _op_tracer = None  # installed by paddle_tpu.profiler; signature (name) -> ctx manager
 
@@ -65,9 +104,15 @@ def _apply_op_inner(name, impl, args, kwargs, differentiable=True):
     for i in tensor_idx:
         plain[i] = leaves[i].data
 
+    from ..utils import flags as _flags
+
     if not record:
         a, k = tree_unflatten(treedef, plain)
         out = impl(*a, **k)
+        if _flags.check_nan_inf:
+            _check_nan_inf(name, out)
+        if _flags.benchmark_mode:
+            _block_on(out)
         return _wrap(name, out, node=None)
 
     diff_idx = [i for i in tensor_idx if not leaves[i].stop_gradient]
@@ -81,6 +126,10 @@ def _apply_op_inner(name, impl, args, kwargs, differentiable=True):
         return impl(*a, **k)
 
     out, vjp_fn = jax.vjp(fn, *(plain[i] for i in diff_idx))
+    if _flags.check_nan_inf:
+        _check_nan_inf(name, out)
+    if _flags.benchmark_mode:
+        _block_on(out)
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
     node = GradNode(name, vjp_fn, parents,

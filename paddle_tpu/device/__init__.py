@@ -1,0 +1,104 @@
+"""paddle.device parity namespace.
+
+Reference: python/paddle/device/ — set_device/get_device plus the
+per-device memory-stats API (paddle.device.cuda.max_memory_allocated,
+backed by paddle/phi/core/memory/stats.cc). On TPU the device arena is
+owned by PJRT, so device stats are read from PJRT's memory_stats();
+host-side pools are tracked by the native memstat counters
+(paddle_tpu/native/src/memstat.cc)."""
+import jax
+
+from ..core.device import (  # noqa: F401
+    Place, set_device, get_device, device_count, is_compiled_with_tpu,
+    is_compiled_with_cuda,
+)
+
+__all__ = [
+    "set_device", "get_device", "device_count", "is_compiled_with_tpu",
+    "is_compiled_with_cuda", "memory_allocated", "max_memory_allocated",
+    "memory_reserved", "reset_max_memory_allocated", "host_memory_stats",
+    "tpu", "cuda",
+]
+
+
+def _dev(device_id=None):
+    devs = jax.local_devices()
+    return devs[device_id or 0]
+
+
+def _stats(device_id=None):
+    d = _dev(device_id)
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device_id=None):
+    """Bytes currently live in the device arena (PJRT bytes_in_use)."""
+    return int(_stats(device_id).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device_id=None):
+    return int(_stats(device_id).get("peak_bytes_in_use",
+                                     memory_allocated(device_id)))
+
+
+def memory_reserved(device_id=None):
+    """Total arena size (PJRT bytes_limit / pool_bytes)."""
+    s = _stats(device_id)
+    return int(s.get("bytes_limit", s.get("pool_bytes", 0)))
+
+
+def reset_max_memory_allocated(device_id=None):
+    # PJRT exposes no peak reset; mirror into the native host counter so the
+    # API exists and host-side pools do reset.
+    try:
+        from .. import native
+        if native.AVAILABLE:
+            native.LIB.pt_memstat_reset_peak(device_id or 0)
+    except Exception:
+        pass
+
+
+def host_memory_stats(device_id=0):
+    """Framework host-pool counters from the native memstat registry."""
+    try:
+        from .. import native
+        if native.AVAILABLE:
+            L = native.LIB
+            return {
+                "current": int(L.pt_memstat_current(device_id)),
+                "peak": int(L.pt_memstat_peak(device_id)),
+                "total_alloc": int(L.pt_memstat_total_alloc(device_id)),
+                "num_allocs": int(L.pt_memstat_num_allocs(device_id)),
+            }
+    except Exception:
+        pass
+    return {"current": 0, "peak": 0, "total_alloc": 0, "num_allocs": 0}
+
+
+class _DeviceNS:
+    """paddle.device.cuda-style sub-namespace, device-agnostic."""
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(memory_reserved)
+    reset_max_memory_allocated = staticmethod(reset_max_memory_allocated)
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device_id=None):
+        # XLA dispatch is async. PJRT executes computations per device in
+        # enqueue order, so blocking on a fresh trivial computation committed
+        # to the device drains everything enqueued before it.
+        d = _dev(device_id)
+        x = jax.device_put(jax.numpy.zeros((), jax.numpy.float32), d)
+        jax.block_until_ready(jax.jit(lambda v: v + 1)(x))
+
+
+tpu = _DeviceNS()
+cuda = _DeviceNS()  # source-compat shim: code written for paddle.device.cuda

@@ -39,10 +39,11 @@ from .topology import get_hcg
 _NEG = -1e30  # finite mask value; -inf breaks online-softmax edge cases
 
 
-def _sep_axis(mesh=None, axis_name=None):
+def _sep_axis(mesh=None, axis_name=None, hcg=None):
     if mesh is not None and axis_name is not None:
         return mesh, axis_name
-    hcg = get_hcg()
+    if hcg is None:
+        hcg = get_hcg()
     if hcg is None:
         raise RuntimeError(
             "context parallelism needs a mesh: call fleet.init with "
@@ -211,8 +212,17 @@ class SegmentParallel(nn.Layer):
         super().__init__()
         self._layers = layers
         self._seq_axis = seq_axis
-        mesh, axis = _sep_axis()
-        self._degree = mesh.get_dim_size(axis)
+        self._hcg = hcg
+        # mesh lookup is deferred to first forward: the reference allows
+        # wrapping before fleet.init, and an explicit hcg= takes priority
+        self._degree_cache = None
+
+    @property
+    def _degree(self):
+        if self._degree_cache is None:
+            mesh, axis = _sep_axis(hcg=self._hcg)
+            self._degree_cache = mesh.get_dim_size(axis)
+        return self._degree_cache
 
     def _shardable(self, x):
         # only tensors with a real sequence dim divisible by the sep degree;

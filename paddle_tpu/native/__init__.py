@@ -1,0 +1,253 @@
+"""Native C++ runtime layer, loaded via ctypes.
+
+This is the framework's native tier (SURVEY.md §2.13): the components the
+reference implements in C++ and that stay C++ here — rendezvous TCPStore
+(tcp_store.h:121), host tracer ring buffer (host_tracer.cc), memory stats
+(memory/stats.cc), the flags registry (flags_native.cc) and the dataloader
+blocking queue (imperative/data_loader.cc). The XLA compute path never
+touches this layer; it serves the runtime around it.
+
+The shared library is built on first import with g++ (sources in src/),
+cached by content hash, and every consumer has a pure-Python fallback so
+the framework still works if no toolchain is present.
+"""
+import atexit
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src")
+_BUILD = os.path.join(_HERE, "_build")
+
+LIB = None
+AVAILABLE = False
+
+
+def _sources():
+    return sorted(
+        os.path.join(_SRC, f) for f in os.listdir(_SRC) if f.endswith(".cc"))
+
+
+def _build_lib():
+    srcs = _sources()
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    tag = h.hexdigest()[:16]
+    out = os.path.join(_BUILD, f"libpaddle_tpu_native_{tag}.so")
+    if os.path.exists(out):
+        return out
+    os.makedirs(_BUILD, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD)
+    os.close(fd)
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+           *srcs, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.rename(tmp, out)  # atomic: concurrent builders race benignly
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return out
+
+
+def _bind(lib):
+    c = ctypes
+    sigs = {
+        # flags
+        "pt_flag_define": (c.c_int, [c.c_char_p, c.c_char_p, c.c_char_p]),
+        "pt_flag_set": (c.c_int, [c.c_char_p, c.c_char_p]),
+        "pt_flag_get": (c.c_int, [c.c_char_p, c.c_char_p, c.c_int]),
+        "pt_flag_list": (c.c_int, [c.c_char_p, c.c_int]),
+        # tracer
+        "pt_trace_enable": (None, [c.c_long]),
+        "pt_trace_disable": (None, []),
+        "pt_trace_is_enabled": (c.c_int, []),
+        "pt_trace_clear": (None, []),
+        "pt_trace_record": (None, [c.c_char_p, c.c_int, c.c_double,
+                                   c.c_double, c.c_uint64]),
+        "pt_trace_count": (c.c_long, []),
+        "pt_trace_now_us": (c.c_double, []),
+        "pt_trace_drain": (c.c_long, [c.c_char_p, c.c_long, c.c_int]),
+        # memstat
+        "pt_memstat_alloc": (None, [c.c_int, c.c_int64]),
+        "pt_memstat_free": (None, [c.c_int, c.c_int64]),
+        "pt_memstat_current": (c.c_int64, [c.c_int]),
+        "pt_memstat_peak": (c.c_int64, [c.c_int]),
+        "pt_memstat_total_alloc": (c.c_int64, [c.c_int]),
+        "pt_memstat_num_allocs": (c.c_int64, [c.c_int]),
+        "pt_memstat_reset_peak": (None, [c.c_int]),
+        "pt_memstat_reset": (None, [c.c_int]),
+        # tcp store
+        "pt_store_server_start": (c.c_void_p, [c.c_int]),
+        "pt_store_server_port": (c.c_int, [c.c_void_p]),
+        "pt_store_server_stop": (None, [c.c_void_p]),
+        "pt_store_connect": (c.c_void_p, [c.c_char_p, c.c_int, c.c_long]),
+        "pt_store_close": (None, [c.c_void_p]),
+        "pt_store_set": (c.c_int, [c.c_void_p, c.c_char_p, c.c_char_p,
+                                   c.c_int]),
+        "pt_store_get": (c.c_long, [c.c_void_p, c.c_char_p, c.c_char_p,
+                                    c.c_long, c.c_long]),
+        "pt_store_add": (c.c_longlong, [c.c_void_p, c.c_char_p,
+                                        c.c_longlong]),
+        "pt_store_wait": (c.c_int, [c.c_void_p, c.c_char_p, c.c_long]),
+        "pt_store_check": (c.c_int, [c.c_void_p, c.c_char_p]),
+        "pt_store_delete": (c.c_int, [c.c_void_p, c.c_char_p]),
+        # queue
+        "pt_queue_create": (c.c_void_p, [c.c_long]),
+        "pt_queue_destroy": (None, [c.c_void_p]),
+        "pt_queue_push": (c.c_int, [c.c_void_p, c.c_uint64, c.c_long]),
+        "pt_queue_pop": (c.c_int, [c.c_void_p, c.POINTER(c.c_uint64),
+                                   c.c_long]),
+        "pt_queue_size": (c.c_long, [c.c_void_p]),
+        "pt_queue_close": (None, [c.c_void_p]),
+    }
+    for name, (res, args) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = res
+        fn.argtypes = args
+
+
+try:
+    LIB = ctypes.CDLL(_build_lib())
+    _bind(LIB)
+    AVAILABLE = True
+except Exception:  # no toolchain / sandboxed build: fall back to Python
+    LIB = None
+    AVAILABLE = False
+
+
+class TCPStore:
+    """Distributed KV store (reference: tcp_store.h:121 semantics:
+    set/get/add/wait + barrier built on add/wait).
+
+    One process passes is_master=True and hosts the server; every process
+    (master included) talks to it through a client connection.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 timeout_s=300):
+        if not AVAILABLE:
+            raise RuntimeError("native library unavailable; use "
+                               "paddle_tpu.distributed.store.PyStore")
+        self._server = None
+        self._timeout_ms = int(timeout_s * 1000)
+        if is_master:
+            self._server = LIB.pt_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = LIB.pt_store_server_port(self._server)
+        self.host, self.port = host, port
+        self._client = LIB.pt_store_connect(host.encode(), port,
+                                            self._timeout_ms)
+        if not self._client:
+            self.close()
+            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+        atexit.register(self.close)
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        rc = LIB.pt_store_set(self._client, key.encode(), value, len(value))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.set({key}) failed")
+
+    def get(self, key, timeout_ms=None):
+        t = self._timeout_ms if timeout_ms is None else timeout_ms
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = LIB.pt_store_get(self._client, key.encode(), buf, len(buf), t)
+        if n == -1:
+            raise TimeoutError(f"TCPStore.get({key}) timed out")
+        if n < 0:
+            raise RuntimeError(f"TCPStore.get({key}) connection error")
+        if n >= len(buf):  # value larger than buffer: retry sized
+            buf = ctypes.create_string_buffer(n + 1)
+            n = LIB.pt_store_get(self._client, key.encode(), buf, len(buf), t)
+        return buf.raw[:n]
+
+    def add(self, key, delta=1):
+        return int(LIB.pt_store_add(self._client, key.encode(), delta))
+
+    def wait(self, key, timeout_ms=None):
+        t = self._timeout_ms if timeout_ms is None else timeout_ms
+        rc = LIB.pt_store_wait(self._client, key.encode(), t)
+        if rc != 1:
+            raise TimeoutError(f"TCPStore.wait({key}) timed out")
+
+    def check(self, key):
+        return LIB.pt_store_check(self._client, key.encode()) == 1
+
+    def delete(self, key):
+        LIB.pt_store_delete(self._client, key.encode())
+
+    def barrier(self, name, world_size, timeout_ms=None):
+        """All-rank barrier: counter + release key (reference barrier idiom)."""
+        n = self.add(f"__barrier/{name}/count", 1)
+        if n == world_size:
+            self.set(f"__barrier/{name}/go", b"1")
+        self.wait(f"__barrier/{name}/go", timeout_ms)
+
+    def close(self):
+        if getattr(self, "_client", None):
+            LIB.pt_store_close(self._client)
+            self._client = None
+        if getattr(self, "_server", None):
+            LIB.pt_store_server_stop(self._server)
+            self._server = None
+
+
+class NativeQueue:
+    """Bounded blocking queue backed by the native tier; holds Python
+    objects via a token indirection (the C side only moves uint64s)."""
+
+    def __init__(self, capacity):
+        if not AVAILABLE:
+            raise RuntimeError("native library unavailable")
+        self._h = LIB.pt_queue_create(capacity)
+        self._objs = {}
+        self._next = 0
+        import threading
+        self._lock = threading.Lock()
+
+    def put(self, obj, timeout_ms=-1):
+        with self._lock:
+            tok = self._next
+            self._next += 1
+            self._objs[tok] = obj
+        rc = LIB.pt_queue_push(self._h, tok, timeout_ms)
+        if rc != 1:
+            with self._lock:
+                self._objs.pop(tok, None)
+            if rc == 0:
+                raise TimeoutError("queue.put timed out")
+            raise RuntimeError("queue closed")
+        return True
+
+    def get(self, timeout_ms=-1):
+        tok = ctypes.c_uint64()
+        rc = LIB.pt_queue_pop(self._h, ctypes.byref(tok), timeout_ms)
+        if rc == 0:
+            raise TimeoutError("queue.get timed out")
+        if rc == -1:
+            raise StopIteration
+        with self._lock:
+            return self._objs.pop(tok.value)
+
+    def qsize(self):
+        return LIB.pt_queue_size(self._h)
+
+    def close(self):
+        LIB.pt_queue_close(self._h)
+
+    def __del__(self):
+        # Safe once GC reaches us: worker threads hold a reference to the
+        # queue object, so no thread can still be blocked inside the handle.
+        h, self._h = getattr(self, "_h", None), None
+        if h and LIB is not None:
+            LIB.pt_queue_close(h)
+            LIB.pt_queue_destroy(h)

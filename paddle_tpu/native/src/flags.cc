@@ -1,0 +1,98 @@
+// Native flag registry.
+//
+// Reference analogue: paddle/common/flags.cc + flags_native.cc — a
+// self-implemented gflags-compatible registry exported to Python via
+// paddle.set_flags/get_flags and seeded from FLAGS_* environment variables.
+// Same contract here: flags are defined with a default + help string, a
+// FLAGS_<name> env var overrides the default at definition time, and Python
+// reads/writes through the C API below.
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Flag {
+  std::string value;
+  std::string default_value;
+  std::string help;
+};
+
+std::mutex g_mu;
+std::map<std::string, Flag>& registry() {
+  static std::map<std::string, Flag> r;
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Define a flag. If FLAGS_<name> is set in the environment the env value
+// wins over `def`. Re-defining an existing flag keeps its current value.
+int pt_flag_define(const char* name, const char* def, const char* help) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto& r = registry();
+  auto it = r.find(name);
+  if (it != r.end()) return 0;
+  Flag f;
+  f.default_value = def ? def : "";
+  f.help = help ? help : "";
+  std::string env_name = std::string("FLAGS_") + name;
+  const char* env = std::getenv(env_name.c_str());
+  f.value = env ? env : f.default_value;
+  r.emplace(name, std::move(f));
+  return 1;
+}
+
+int pt_flag_set(const char* name, const char* value) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto& r = registry();
+  auto it = r.find(name);
+  if (it == r.end()) return -1;
+  it->second.value = value ? value : "";
+  return 0;
+}
+
+// Copy the flag value into buf; returns the value length, or -1 if the flag
+// is unknown. A return >= buflen means the buffer was too small.
+int pt_flag_get(const char* name, char* buf, int buflen) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto& r = registry();
+  auto it = r.find(name);
+  if (it == r.end()) return -1;
+  const std::string& v = it->second.value;
+  if (buf && buflen > 0) {
+    int n = static_cast<int>(v.size()) < buflen - 1
+                ? static_cast<int>(v.size())
+                : buflen - 1;
+    std::memcpy(buf, v.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int>(v.size());
+}
+
+// Newline-separated "name=value" dump of all flags into buf. Returns the
+// total length needed (call with buflen=0 to size the buffer).
+int pt_flag_list(char* buf, int buflen) {
+  std::lock_guard<std::mutex> g(g_mu);
+  std::string out;
+  for (auto& kv : registry()) {
+    out += kv.first;
+    out += '=';
+    out += kv.second.value;
+    out += '\n';
+  }
+  if (buf && buflen > 0) {
+    int n = static_cast<int>(out.size()) < buflen - 1
+                ? static_cast<int>(out.size())
+                : buflen - 1;
+    std::memcpy(buf, out.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int>(out.size());
+}
+
+}  // extern "C"

@@ -1,0 +1,88 @@
+// Native memory statistics registry.
+//
+// Reference analogue: paddle/phi/core/memory/stats.cc — per-device
+// current/peak allocated counters behind
+// paddle.device.cuda.max_memory_allocated etc. On TPU the HBM arena is
+// owned by PJRT (queried separately via device.memory_stats()); these
+// counters track host-side pools and framework-attributed usage.
+#include <array>
+#include <atomic>
+#include <cstdint>
+
+namespace {
+
+constexpr int kMaxDevices = 64;
+
+struct Stat {
+  std::atomic<int64_t> current{0};
+  std::atomic<int64_t> peak{0};
+  std::atomic<int64_t> total_alloc{0};
+  std::atomic<int64_t> n_alloc{0};
+};
+
+std::array<Stat, kMaxDevices>& stats() {
+  static std::array<Stat, kMaxDevices> s;
+  return s;
+}
+
+inline Stat* get(int device) {
+  if (device < 0 || device >= kMaxDevices) return nullptr;
+  return &stats()[device];
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_memstat_alloc(int device, int64_t bytes) {
+  Stat* s = get(device);
+  if (!s) return;
+  int64_t cur = s->current.fetch_add(bytes) + bytes;
+  s->total_alloc.fetch_add(bytes);
+  s->n_alloc.fetch_add(1);
+  int64_t peak = s->peak.load();
+  while (cur > peak && !s->peak.compare_exchange_weak(peak, cur)) {
+  }
+}
+
+void pt_memstat_free(int device, int64_t bytes) {
+  Stat* s = get(device);
+  if (!s) return;
+  s->current.fetch_sub(bytes);
+}
+
+int64_t pt_memstat_current(int device) {
+  Stat* s = get(device);
+  return s ? s->current.load() : 0;
+}
+
+int64_t pt_memstat_peak(int device) {
+  Stat* s = get(device);
+  return s ? s->peak.load() : 0;
+}
+
+int64_t pt_memstat_total_alloc(int device) {
+  Stat* s = get(device);
+  return s ? s->total_alloc.load() : 0;
+}
+
+int64_t pt_memstat_num_allocs(int device) {
+  Stat* s = get(device);
+  return s ? s->n_alloc.load() : 0;
+}
+
+void pt_memstat_reset_peak(int device) {
+  Stat* s = get(device);
+  if (s) s->peak.store(s->current.load());
+}
+
+void pt_memstat_reset(int device) {
+  Stat* s = get(device);
+  if (!s) return;
+  s->current.store(0);
+  s->peak.store(0);
+  s->total_alloc.store(0);
+  s->n_alloc.store(0);
+}
+
+}  // extern "C"

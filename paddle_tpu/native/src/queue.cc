@@ -1,0 +1,90 @@
+// Native bounded blocking queue (token-passing).
+//
+// Reference analogue: the native side of the DataLoader pipeline
+// (paddle/fluid/imperative/data_loader.cc + the BlockingQueue underneath
+// the reader ops) — producers (worker threads decoding batches) hand
+// results to the consumer (the training loop) through a bounded queue so
+// prefetch depth is capped. Values are opaque uint64 tokens; the Python
+// side maps token -> batch object.
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+
+namespace {
+
+struct Queue {
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  std::deque<uint64_t> items;
+  size_t capacity;
+  bool closed = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_queue_create(long capacity) {
+  Queue* q = new Queue();
+  q->capacity = capacity > 0 ? static_cast<size_t>(capacity) : 1;
+  return q;
+}
+
+void pt_queue_destroy(void* h) { delete static_cast<Queue*>(h); }
+
+// Returns 1 on success, 0 on timeout, -1 if closed.
+int pt_queue_push(void* h, uint64_t token, long timeout_ms) {
+  Queue* q = static_cast<Queue*>(h);
+  std::unique_lock<std::mutex> g(q->mu);
+  auto pred = [&] { return q->closed || q->items.size() < q->capacity; };
+  if (timeout_ms < 0) {
+    q->not_full.wait(g, pred);
+  } else if (!q->not_full.wait_for(g, std::chrono::milliseconds(timeout_ms),
+                                   pred)) {
+    return 0;
+  }
+  if (q->closed) return -1;
+  q->items.push_back(token);
+  g.unlock();
+  q->not_empty.notify_one();
+  return 1;
+}
+
+// Returns 1 and fills *token on success, 0 on timeout, -1 if closed+empty.
+int pt_queue_pop(void* h, uint64_t* token, long timeout_ms) {
+  Queue* q = static_cast<Queue*>(h);
+  std::unique_lock<std::mutex> g(q->mu);
+  auto pred = [&] { return q->closed || !q->items.empty(); };
+  if (timeout_ms < 0) {
+    q->not_empty.wait(g, pred);
+  } else if (!q->not_empty.wait_for(g, std::chrono::milliseconds(timeout_ms),
+                                    pred)) {
+    return 0;
+  }
+  if (q->items.empty()) return -1;  // closed and drained
+  *token = q->items.front();
+  q->items.pop_front();
+  g.unlock();
+  q->not_full.notify_one();
+  return 1;
+}
+
+long pt_queue_size(void* h) {
+  Queue* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  return static_cast<long>(q->items.size());
+}
+
+// Close: producers get -1 on push; consumers drain remaining items then -1.
+void pt_queue_close(void* h) {
+  Queue* q = static_cast<Queue*>(h);
+  {
+    std::lock_guard<std::mutex> g(q->mu);
+    q->closed = true;
+  }
+  q->not_full.notify_all();
+  q->not_empty.notify_all();
+}
+
+}  // extern "C"

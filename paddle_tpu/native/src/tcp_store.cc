@@ -1,0 +1,348 @@
+// Native TCP key-value store for distributed rendezvous.
+//
+// Reference analogue: paddle/phi/core/distributed/store/tcp_store.h:121 —
+// the store every rank bootstraps through (set/get/add/wait/barrier) before
+// any collective communicator exists. Used here by the launcher master and
+// by init_parallel_env on multi-host DCN setups; single-host launches can
+// also use it as the worker-status KV.
+//
+// Protocol (length-prefixed, one request per round-trip, client serialises
+// concurrent calls with a per-connection lock on the Python side too):
+//   'S' u32 klen key u32 vlen val            -> u8 1
+//   'G' u32 klen key i64 timeout_ms         -> i32 vlen (-1 on timeout) val
+//   'A' u32 klen key i64 delta              -> i64 new_value
+//   'W' u32 klen key i64 timeout_ms         -> u8 (1 ok, 0 timeout)
+//   'C' u32 klen key                        -> u8 (key exists)
+//   'X' u32 klen key                        -> u8 1 (delete)
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conn_threads;
+  std::mutex conn_mu;
+  std::vector<int> conn_fds;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+
+  void handle(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      char op;
+      if (!read_full(fd, &op, 1)) break;
+      uint32_t klen;
+      if (!read_full(fd, &klen, 4) || klen > (1u << 20)) break;
+      std::string key(klen, '\0');
+      if (!read_full(fd, &key[0], klen)) break;
+
+      if (op == 'S') {
+        uint32_t vlen;
+        if (!read_full(fd, &vlen, 4) || vlen > (1u << 26)) break;
+        std::string val(vlen, '\0');
+        if (!read_full(fd, &val[0], vlen)) break;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          kv[key] = std::move(val);
+        }
+        cv.notify_all();
+        uint8_t ok = 1;
+        if (!write_full(fd, &ok, 1)) break;
+      } else if (op == 'G' || op == 'W') {
+        int64_t timeout_ms;
+        if (!read_full(fd, &timeout_ms, 8)) break;
+        std::unique_lock<std::mutex> g(mu);
+        auto pred = [&] { return stop.load() || kv.count(key) > 0; };
+        bool found;
+        if (timeout_ms < 0) {
+          cv.wait(g, pred);
+          found = kv.count(key) > 0;
+        } else {
+          found = cv.wait_for(g, std::chrono::milliseconds(timeout_ms), pred) &&
+                  kv.count(key) > 0;
+        }
+        if (op == 'W') {
+          g.unlock();
+          uint8_t ok = found ? 1 : 0;
+          if (!write_full(fd, &ok, 1)) break;
+        } else {
+          std::string val = found ? kv[key] : std::string();
+          g.unlock();
+          int32_t vlen = found ? static_cast<int32_t>(val.size()) : -1;
+          if (!write_full(fd, &vlen, 4)) break;
+          if (found && !write_full(fd, val.data(), val.size())) break;
+        }
+      } else if (op == 'A') {
+        int64_t delta;
+        if (!read_full(fd, &delta, 8)) break;
+        int64_t nv;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          int64_t cur = 0;
+          auto it = kv.find(key);
+          if (it != kv.end()) cur = std::strtoll(it->second.c_str(), nullptr, 10);
+          nv = cur + delta;
+          kv[key] = std::to_string(nv);
+        }
+        cv.notify_all();
+        if (!write_full(fd, &nv, 8)) break;
+      } else if (op == 'C') {
+        uint8_t ok;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          ok = kv.count(key) > 0 ? 1 : 0;
+        }
+        if (!write_full(fd, &ok, 1)) break;
+      } else if (op == 'X') {
+        {
+          std::lock_guard<std::mutex> g(mu);
+          kv.erase(key);
+        }
+        cv.notify_all();
+        uint8_t ok = 1;
+        if (!write_full(fd, &ok, 1)) break;
+      } else {
+        break;
+      }
+    }
+    // Deregister before close so stop() never shutdown()s a reused fd number.
+    {
+      std::lock_guard<std::mutex> g(conn_mu);
+      for (auto it = conn_fds.begin(); it != conn_fds.end(); ++it) {
+        if (*it == fd) {
+          conn_fds.erase(it);
+          break;
+        }
+      }
+    }
+    ::close(fd);
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one request/response in flight per connection
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_store_server_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+
+  Server* s = new Server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] {
+    while (!s->stop.load()) {
+      int cfd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (cfd < 0) {
+        if (s->stop.load()) break;
+        continue;
+      }
+      {
+        std::lock_guard<std::mutex> g(s->conn_mu);
+        s->conn_fds.push_back(cfd);
+      }
+      s->conn_threads.emplace_back([s, cfd] { s->handle(cfd); });
+    }
+  });
+  return s;
+}
+
+int pt_store_server_port(void* h) {
+  return h ? static_cast<Server*>(h)->port : -1;
+}
+
+void pt_store_server_stop(void* h) {
+  if (!h) return;
+  Server* s = static_cast<Server*>(h);
+  s->stop.store(true);
+  s->cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  // Unblock handlers stuck in recv() by shutting down every connection,
+  // then join them all — only after that is it safe to free the Server.
+  {
+    std::lock_guard<std::mutex> g(s->conn_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : s->conn_threads)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+// Connect with retry until timeout_ms elapses (workers may start before the
+// master's listener is up — same retry loop the reference client has).
+void* pt_store_connect(const char* host, int port, long timeout_ms) {
+  auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+  addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  std::string port_s = std::to_string(port);
+  for (;;) {
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(host, port_s.c_str(), &hints, &res) == 0 && res) {
+      int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0) {
+        if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+          ::freeaddrinfo(res);
+          int one = 1;
+          ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          Client* c = new Client();
+          c->fd = fd;
+          return c;
+        }
+        ::close(fd);
+      }
+      ::freeaddrinfo(res);
+    }
+    if (Clock::now() >= deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+}
+
+void pt_store_close(void* h) {
+  if (!h) return;
+  Client* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+static bool send_key(Client* c, char op, const char* key) {
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  return write_full(c->fd, &op, 1) && write_full(c->fd, &klen, 4) &&
+         write_full(c->fd, key, klen);
+}
+
+int pt_store_set(void* h, const char* key, const char* val, int vallen) {
+  Client* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  uint32_t vlen = static_cast<uint32_t>(vallen);
+  if (!send_key(c, 'S', key) || !write_full(c->fd, &vlen, 4) ||
+      !write_full(c->fd, val, vlen))
+    return -1;
+  uint8_t ok;
+  return read_full(c->fd, &ok, 1) ? 0 : -1;
+}
+
+long pt_store_get(void* h, const char* key, char* buf, long buflen,
+                  long timeout_ms) {
+  Client* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  int64_t t = timeout_ms;
+  if (!send_key(c, 'G', key) || !write_full(c->fd, &t, 8)) return -2;
+  int32_t vlen;
+  if (!read_full(c->fd, &vlen, 4)) return -2;
+  if (vlen < 0) return -1;  // timeout
+  std::string val(vlen, '\0');
+  if (vlen > 0 && !read_full(c->fd, &val[0], vlen)) return -2;
+  if (buf && buflen > 0) {
+    long n = vlen < buflen - 1 ? vlen : buflen - 1;
+    std::memcpy(buf, val.data(), n);
+    buf[n] = '\0';
+  }
+  return vlen;
+}
+
+long long pt_store_add(void* h, const char* key, long long delta) {
+  Client* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  int64_t d = delta;
+  if (!send_key(c, 'A', key) || !write_full(c->fd, &d, 8)) return -1;
+  int64_t nv;
+  if (!read_full(c->fd, &nv, 8)) return -1;
+  return nv;
+}
+
+int pt_store_wait(void* h, const char* key, long timeout_ms) {
+  Client* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  int64_t t = timeout_ms;
+  if (!send_key(c, 'W', key) || !write_full(c->fd, &t, 8)) return -1;
+  uint8_t ok;
+  if (!read_full(c->fd, &ok, 1)) return -1;
+  return ok ? 1 : 0;
+}
+
+int pt_store_check(void* h, const char* key) {
+  Client* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (!send_key(c, 'C', key)) return -1;
+  uint8_t ok;
+  if (!read_full(c->fd, &ok, 1)) return -1;
+  return ok ? 1 : 0;
+}
+
+int pt_store_delete(void* h, const char* key) {
+  Client* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (!send_key(c, 'X', key)) return -1;
+  uint8_t ok;
+  return read_full(c->fd, &ok, 1) ? 0 : -1;
+}
+
+}  // extern "C"

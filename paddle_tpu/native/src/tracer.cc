@@ -1,0 +1,116 @@
+// Native host tracer: a low-overhead ring buffer of host event ranges.
+//
+// Reference analogue: paddle/fluid/platform/profiler/host_tracer.cc +
+// common_event.h — RecordEvent ranges buffered natively and drained by the
+// Python profiler at export time. Names are interned so the hot record path
+// is a couple of integer stores under a short critical section.
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Event {
+  uint32_t name_id;
+  int32_t etype;
+  double ts_us;
+  double dur_us;
+  uint64_t tid;
+};
+
+std::mutex g_mu;
+bool g_enabled = false;
+size_t g_capacity = 1 << 20;
+std::vector<Event> g_events;
+std::vector<std::string> g_names;
+std::unordered_map<std::string, uint32_t> g_name_ids;
+
+uint32_t intern(const char* name) {
+  auto it = g_name_ids.find(name);
+  if (it != g_name_ids.end()) return it->second;
+  uint32_t id = static_cast<uint32_t>(g_names.size());
+  g_names.emplace_back(name);
+  g_name_ids.emplace(g_names.back(), id);
+  return id;
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_trace_enable(long capacity) {
+  std::lock_guard<std::mutex> g(g_mu);
+  g_enabled = true;
+  if (capacity > 0) g_capacity = static_cast<size_t>(capacity);
+  g_events.reserve(g_events.size() + 4096);
+}
+
+void pt_trace_disable() {
+  std::lock_guard<std::mutex> g(g_mu);
+  g_enabled = false;
+}
+
+int pt_trace_is_enabled() {
+  std::lock_guard<std::mutex> g(g_mu);
+  return g_enabled ? 1 : 0;
+}
+
+void pt_trace_clear() {
+  std::lock_guard<std::mutex> g(g_mu);
+  g_events.clear();
+}
+
+// Record a completed host range. Drops the event once the ring is full
+// (profiling a bounded window, as the reference's buffered tracer does).
+void pt_trace_record(const char* name, int etype, double ts_us, double dur_us,
+                     uint64_t tid) {
+  std::lock_guard<std::mutex> g(g_mu);
+  if (!g_enabled || g_events.size() >= g_capacity) return;
+  g_events.push_back(Event{intern(name), etype, ts_us, dur_us, tid});
+}
+
+long pt_trace_count() {
+  std::lock_guard<std::mutex> g(g_mu);
+  return static_cast<long>(g_events.size());
+}
+
+// Monotonic clock in microseconds — same epoch Python's time.monotonic()
+// family uses on Linux, so mixed native/Python events line up.
+double pt_trace_now_us() {
+  auto d = std::chrono::steady_clock::now().time_since_epoch();
+  return std::chrono::duration<double, std::micro>(d).count();
+}
+
+// Drain events as tab-separated lines "name\tetype\tts_us\tdur_us\ttid\n".
+// Returns required byte length; call with buflen=0 to size, then again to
+// fill. Export-time only, so the text roundtrip cost is irrelevant.
+long pt_trace_drain(char* buf, long buflen, int clear) {
+  std::lock_guard<std::mutex> g(g_mu);
+  std::string out;
+  out.reserve(g_events.size() * 48);
+  char line[160];
+  for (const Event& e : g_events) {
+    int n = std::snprintf(line, sizeof(line), "%d\t%.3f\t%.3f\t%llu",
+                          e.etype, e.ts_us, e.dur_us,
+                          static_cast<unsigned long long>(e.tid));
+    out += g_names[e.name_id];
+    out += '\t';
+    out.append(line, n);
+    out += '\n';
+  }
+  if (buf && buflen > 0) {
+    long n = static_cast<long>(out.size()) < buflen - 1
+                 ? static_cast<long>(out.size())
+                 : buflen - 1;
+    std::memcpy(buf, out.data(), n);
+    buf[n] = '\0';
+  }
+  if (clear && buf && buflen > static_cast<long>(out.size())) g_events.clear();
+  return static_cast<long>(out.size());
+}
+
+}  // extern "C"

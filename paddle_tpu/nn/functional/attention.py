@@ -40,7 +40,10 @@ def _sdpa_ref(q, k, v, mask=None, dropout=0.0, causal=False, scale=None,
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    logits = jnp.einsum("bhsd,bhtd->bhst", qt, kt) * scale
+    # QK logits and the prob·V reduction accumulate in f32 (MXU-native
+    # bf16-in/f32-accumulate); only the final output is cast back.
+    logits = jnp.einsum("bhsd,bhtd->bhst", qt, kt,
+                        preferred_element_type=jnp.float32) * scale
     if causal:
         s, t = logits.shape[-2], logits.shape[-1]
         cm = jnp.tril(jnp.ones((s, t), dtype=bool), k=t - s)
@@ -49,12 +52,13 @@ def _sdpa_ref(q, k, v, mask=None, dropout=0.0, causal=False, scale=None,
         if mask.dtype == jnp.bool_:
             logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
         else:
-            logits = logits + mask
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
     if dropout > 0.0 and training:
         keep = jax.random.bernoulli(_random.next_key(), 1.0 - dropout, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
-    out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs,
+                     vt.astype(jnp.float32)).astype(q.dtype)
     return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
 
 

@@ -56,8 +56,7 @@ class TracerEventType(enum.Enum):
 
 
 class _HostTracer:
-    """Append-only host event buffer. Swappable for the native C++ ring
-    buffer (core_native) when built — same record() contract."""
+    """Append-only host event buffer (pure-Python fallback)."""
 
     def __init__(self):
         self.events = []
@@ -72,7 +71,58 @@ class _HostTracer:
             self.events = []
 
 
-_tracer = _HostTracer()
+class _NativeHostTracer:
+    """Host ranges buffered by the native C++ ring buffer
+    (paddle_tpu/native/src/tracer.cc — the host_tracer.cc role): the record
+    hot path is a single ctypes call into an interned-name ring; events are
+    drained and parsed only at stop/export time."""
+
+    def __init__(self, lib, capacity=1 << 20):
+        self._n = lib
+        self._n.pt_trace_enable(capacity)
+
+    def record(self, name, etype, ts_us, dur_us, tid):
+        # names are arbitrary user strings; keep the TSV wire format parseable
+        if "\t" in name or "\n" in name:
+            name = name.replace("\t", " ").replace("\n", " ")
+        self._n.pt_trace_record(name.encode(), etype.value, ts_us, dur_us,
+                                tid)
+
+    @property
+    def events(self):
+        import ctypes
+        # size-then-fill can race with concurrent recording; retry until the
+        # fill call reports it fit
+        pad = 4096
+        while True:
+            need = self._n.pt_trace_drain(None, 0, 0)
+            buf = ctypes.create_string_buffer(need + pad)
+            got = self._n.pt_trace_drain(buf, len(buf), 0)
+            if got < len(buf) - 1:
+                break
+            pad *= 4
+        out = []
+        for line in buf.value.decode().splitlines():
+            name, etype, ts, dur, tid = line.rsplit("\t", 4)
+            out.append((name, TracerEventType(int(etype)), float(ts),
+                        float(dur), int(tid)))
+        return out
+
+    def clear(self):
+        self._n.pt_trace_clear()
+
+
+def _make_tracer():
+    try:
+        from .. import native as _native
+        if _native.AVAILABLE:
+            return _NativeHostTracer(_native.LIB)
+    except Exception:
+        pass
+    return _HostTracer()
+
+
+_tracer = _make_tracer()
 _active_profiler = None
 
 

@@ -1,10 +1,137 @@
-"""Placeholder flag registry — real implementation at M8."""
-_FLAGS = {}
-def set_flags(d):
-    _FLAGS.update(d)
+"""Runtime flag registry (reference: paddle/common/flags.cc ~100
+PHI_DEFINE_EXPORTED_* flags + the self-implemented gflags-compatible
+registry in flags_native.cc, exported as paddle.set_flags/get_flags and
+seeded from FLAGS_* env vars).
+
+Here the registry itself is native C++ (paddle_tpu/native/src/flags.cc)
+when the native tier is built, with a Python dict fallback. Flags that sit
+on hot paths (nan/inf checking in dispatch) are mirrored into module-level
+Python bools on every set so per-op reads cost one attribute lookup."""
+import os
+
+try:
+    from .. import native as _native
+    _N = _native.LIB if _native.AVAILABLE else None
+except Exception:
+    _N = None
+
+_py_flags = {}
+
+# (name, default, help) — the subset of the reference's flag surface that
+# is meaningful on the TPU stack (paddle/common/flags.cc:72-79 for
+# check_nan_inf; others by analogy).
+_DEFS = [
+    ("check_nan_inf", "false",
+     "Check every eager op's outputs for NaN/Inf and raise (reference: "
+     "FLAGS_check_nan_inf, checked per-op in eager nan_inf_utils.cc)."),
+    ("check_nan_inf_level", "0",
+     "0: raise on NaN/Inf; 1: warn only; 3: also report fp16/bf16 overflow."),
+    ("benchmark", "false",
+     "Block on every op (jax block_until_ready) so wall-time is attributable."),
+    ("allocator_strategy", "auto_growth",
+     "Informational on TPU: the HBM arena is owned by PJRT."),
+    ("use_stride_kernel", "true",
+     "Views/strided ops stay lazy (XLA fuses gathers); parity knob."),
+    ("low_precision_op_list", "0",
+     "Log ops hit by AMP low-precision casting (paddle.amp.debugging)."),
+    ("conv_workspace_size_limit", "512",
+     "Parity knob; XLA autotunes conv algorithms on TPU."),
+    ("cudnn_deterministic", "false",
+     "Deterministic kernels: forwards to XLA deterministic reductions intent."),
+    ("embedding_deterministic", "0",
+     "Deterministic embedding grad accumulation."),
+    ("max_inplace_grad_add", "0",
+     "Grad accumulation chunk threshold (parity knob)."),
+    ("init_allocated_mem", "false", "Poison fresh allocations (debug)."),
+    ("tracer_profile_fname", "",
+     "If set, dump the host tracer to this chrome-trace path at exit."),
+    ("enable_async_trace", "false",
+     "Collective watchdog tracing (comm_task_manager.h analogue)."),
+    ("stop_check_timeout", "900",
+     "Seconds a rank waits at bootstrap barriers before declaring a hang."),
+]
+
+# hot-path mirrors (read by core.dispatch every op)
+check_nan_inf = False
+check_nan_inf_level = 0
+benchmark_mode = False
+
+
+def _define_all():
+    for name, default, help_ in _DEFS:
+        if _N is not None:
+            _N.pt_flag_define(name.encode(), default.encode(), help_.encode())
+        else:
+            env = os.environ.get("FLAGS_" + name)
+            _py_flags.setdefault(name, env if env is not None else default)
+    _refresh_mirrors()
+
+
+def _get_raw(name):
+    if _N is not None:
+        import ctypes
+        b = ctypes.create_string_buffer(256)
+        n = _N.pt_flag_get(name.encode(), b, 256)
+        if n < 0:
+            return None
+        if n >= 256 - 1:  # value longer than the probe buffer: sized retry
+            b = ctypes.create_string_buffer(n + 1)
+            _N.pt_flag_get(name.encode(), b, n + 1)
+        return b.value.decode()
+    return _py_flags.get(name)
+
+
+def _coerce(v):
+    if v is None:
+        return None
+    s = str(v)
+    if s.lower() in ("true", "false"):
+        return s.lower() == "true"
+    try:
+        return int(s)
+    except ValueError:
+        try:
+            return float(s)
+        except ValueError:
+            return s
+
+
+def _refresh_mirrors():
+    global check_nan_inf, check_nan_inf_level, benchmark_mode
+    check_nan_inf = bool(_coerce(_get_raw("check_nan_inf")))
+    check_nan_inf_level = int(_coerce(_get_raw("check_nan_inf_level")) or 0)
+    benchmark_mode = bool(_coerce(_get_raw("benchmark")))
+
+
+def set_flags(flags):
+    """paddle.set_flags({'FLAGS_check_nan_inf': 1, ...}) — FLAGS_ prefix
+    optional, values coerced from bool/int/str."""
+    for k, v in flags.items():
+        name = k[6:] if k.startswith("FLAGS_") else k
+        sval = str(bool(v)).lower() if isinstance(v, bool) else str(v)
+        if _N is not None:
+            if _N.pt_flag_set(name.encode(), sval.encode()) != 0:
+                raise ValueError(f"unknown flag: {k}")
+        else:
+            if name not in _py_flags:
+                raise ValueError(f"unknown flag: {k}")
+            _py_flags[name] = sval
+    _refresh_mirrors()
+
+
 def get_flags(keys=None):
     if keys is None:
-        return dict(_FLAGS)
+        keys = [d[0] for d in _DEFS]
     if isinstance(keys, str):
         keys = [keys]
-    return {k: _FLAGS.get(k) for k in keys}
+    out = {}
+    for k in keys:
+        name = k[6:] if k.startswith("FLAGS_") else k
+        v = _get_raw(name)
+        if v is None:
+            raise ValueError(f"unknown flag: {k}")
+        out["FLAGS_" + name] = _coerce(v)
+    return out
+
+
+_define_all()

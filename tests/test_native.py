@@ -1,0 +1,213 @@
+"""Native C++ runtime tier tests: TCPStore rendezvous, host tracer ring,
+flags registry, memstat counters, blocking queue.
+
+Reference test model: test/cpp/ gtest suites for phi core + the TCPStore
+tests under test/legacy_test/test_collective_base.py's hand-rolled store.
+"""
+import threading
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import native
+
+
+requires_native = pytest.mark.skipif(not native.AVAILABLE,
+                                     reason="native lib not built")
+
+
+@requires_native
+class TestTCPStore:
+    def test_set_get_add(self):
+        s = native.TCPStore(is_master=True)
+        try:
+            s.set("alpha", b"1234")
+            assert s.get("alpha") == b"1234"
+            assert s.add("cnt", 3) == 3
+            assert s.add("cnt", -1) == 2
+            assert s.check("alpha") and not s.check("nope")
+            s.delete("alpha")
+            assert not s.check("alpha")
+        finally:
+            s.close()
+
+    def test_wait_blocks_until_set(self):
+        s = native.TCPStore(is_master=True)
+        c = native.TCPStore(port=s.port)
+        try:
+            def later():
+                time.sleep(0.15)
+                c.set("late", b"v")
+            t = threading.Thread(target=later)
+            t.start()
+            t0 = time.monotonic()
+            s.wait("late", timeout_ms=5000)
+            assert time.monotonic() - t0 >= 0.1
+            assert s.get("late") == b"v"
+            t.join()
+        finally:
+            c.close()
+            s.close()
+
+    def test_get_timeout(self):
+        s = native.TCPStore(is_master=True)
+        try:
+            with pytest.raises(TimeoutError):
+                s.get("missing", timeout_ms=100)
+        finally:
+            s.close()
+
+    def test_barrier(self):
+        s = native.TCPStore(is_master=True)
+        clients = [native.TCPStore(port=s.port) for _ in range(3)]
+        try:
+            done = []
+            def enter(c, i):
+                c.barrier("b1", 3, timeout_ms=5000)
+                done.append(i)
+            ts = [threading.Thread(target=enter, args=(c, i))
+                  for i, c in enumerate(clients)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=10)
+            assert sorted(done) == [0, 1, 2]
+        finally:
+            for c in clients:
+                c.close()
+            s.close()
+
+    def test_large_value(self):
+        s = native.TCPStore(is_master=True)
+        try:
+            big = bytes(200_000)
+            s.set("big", big)
+            assert s.get("big") == big
+        finally:
+            s.close()
+
+
+@requires_native
+class TestNativeQueue:
+    def test_fifo_and_capacity(self):
+        q = native.NativeQueue(2)
+        q.put(1)
+        q.put(2)
+        with pytest.raises(TimeoutError):
+            q.put(3, timeout_ms=50)
+        assert q.get() == 1
+        assert q.get() == 2
+
+    def test_close_drains(self):
+        q = native.NativeQueue(4)
+        q.put("a")
+        q.close()
+        assert q.get() == "a"
+        with pytest.raises(StopIteration):
+            q.get()
+
+    def test_threaded_producer_consumer(self):
+        q = native.NativeQueue(8)
+        N = 200
+        got = []
+        def prod():
+            for i in range(N):
+                q.put(i)
+            q.close()
+        def cons():
+            while True:
+                try:
+                    got.append(q.get())
+                except StopIteration:
+                    return
+        tp, tc = threading.Thread(target=prod), threading.Thread(target=cons)
+        tp.start(); tc.start(); tp.join(10); tc.join(10)
+        assert got == list(range(N))
+
+
+class TestFlags:
+    def test_set_get_roundtrip(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is True
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+        assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is False
+
+    def test_unknown_flag_raises(self):
+        with pytest.raises(ValueError):
+            paddle.set_flags({"FLAGS_definitely_not_a_flag": 1})
+
+    def test_nan_check_fires(self):
+        import numpy as np
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.array([1.0, 0.0]))
+            with pytest.raises(FloatingPointError):
+                paddle.log(paddle.to_tensor(np.array([-1.0])))
+            _ = x + x  # finite values pass
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_nan_check_warn_level(self):
+        import numpy as np
+        paddle.set_flags({"FLAGS_check_nan_inf": True,
+                          "FLAGS_check_nan_inf_level": 1})
+        try:
+            with pytest.warns(UserWarning):
+                paddle.log(paddle.to_tensor(np.array([-1.0])))
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False,
+                              "FLAGS_check_nan_inf_level": 0})
+
+
+@requires_native
+class TestMemstatAndTracer:
+    def test_memstat_counters(self):
+        L = native.LIB
+        L.pt_memstat_reset(7)
+        L.pt_memstat_alloc(7, 1000)
+        L.pt_memstat_alloc(7, 500)
+        L.pt_memstat_free(7, 300)
+        assert L.pt_memstat_current(7) == 1200
+        assert L.pt_memstat_peak(7) == 1500
+        assert L.pt_memstat_total_alloc(7) == 1500
+        assert L.pt_memstat_num_allocs(7) == 2
+        L.pt_memstat_reset_peak(7)
+        assert L.pt_memstat_peak(7) == 1200
+
+    def test_device_namespace(self):
+        stats = paddle.device.host_memory_stats()
+        assert set(stats) >= {"current", "peak"}
+        assert paddle.device.memory_allocated() >= 0
+
+    def test_native_tracer_roundtrip(self):
+        from paddle_tpu.profiler import (_NativeHostTracer,
+                                         TracerEventType)
+        tr = _NativeHostTracer(native.LIB, capacity=1024)
+        tr.clear()
+        tr.record("op_a", TracerEventType.Operator, 10.0, 5.0, 1)
+        tr.record("op_b", TracerEventType.Forward, 20.0, 2.5, 2)
+        evs = tr.events
+        assert evs[0][0] == "op_a" and evs[0][1] == TracerEventType.Operator
+        assert evs[1][2] == 20.0 and evs[1][3] == 2.5
+        tr.clear()
+        assert tr.events == []
+
+
+class TestProfilerWithNativeTracer:
+    def test_profile_window_exports(self, tmp_path):
+        import numpy as np
+        from paddle_tpu import profiler as P
+        p = P.Profiler(targets=[P.ProfilerTarget.CPU])
+        p.start()
+        x = paddle.to_tensor(np.ones((8, 8), np.float32))
+        for _ in range(3):
+            x = paddle.matmul(x, x)
+            p.step()
+        p.stop()
+        out = tmp_path / "trace.json"
+        p.export(str(out))
+        import json
+        data = json.loads(out.read_text())
+        names = [e["name"] for e in data["traceEvents"]]
+        assert any("matmul" in n for n in names)
