@@ -27,9 +27,43 @@ from . import checkpoint
 from .auto_parallel import to_static as _ap_to_static  # noqa: F401 (optional)
 from . import auto_parallel
 
-# paddle.distributed.launch parity helpers
-def spawn(func, args=(), nprocs=-1, **kwargs):
-    """Single-controller SPMD drives all local devices from one process, so
-    spawn degenerates to a direct call (reference spawn.py forks per GPU)."""
-    init_parallel_env()
-    return func(*args)
+from . import launch
+from . import auto_tuner
+
+
+def _spawn_worker(func, args, rank, nprocs, port):
+    import os
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_NNODES"] = str(nprocs)
+    os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, **kwargs):
+    """Reference spawn.py forks one process per GPU. On TPU a single
+    controller drives all local chips, so nprocs<=1 (the default) is a
+    direct call; nprocs>1 forks real processes with the PADDLE_* env
+    contract set (useful for multi-process CPU-mesh testing — the
+    reference's fake custom_cpu backend pattern)."""
+    if nprocs <= 1:
+        # parent-process init only on the direct-call path: forked workers
+        # must own their devices themselves (one libtpu owner per process)
+        init_parallel_env()
+        return func(*args)
+    import multiprocessing as mp
+    from .launch.master import free_port
+    ctx = mp.get_context("spawn")
+    port = free_port()
+    procs = [ctx.Process(target=_spawn_worker,
+                         args=(func, args, r, nprocs, port))
+             for r in range(nprocs)]
+    for p in procs:
+        p.start()
+    if not join:
+        return procs
+    for p in procs:
+        p.join()
+    bad = [p.exitcode for p in procs if p.exitcode != 0]
+    if bad:
+        raise RuntimeError(f"spawn: worker exit codes {bad}")

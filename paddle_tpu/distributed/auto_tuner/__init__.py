@@ -1,0 +1,15 @@
+"""Hybrid-parallel auto-tuner.
+
+Reference: python/paddle/distributed/auto_tuner/{tuner,search,prune,
+cost_model}.py — grid search over dp/mp/pp/sharding/micro-batch configs,
+prune rules that cut invalid or dominated points, a communication cost
+model to rank the rest, and a recorder of measured runs.
+
+TPU-native cost model: TP collectives ride ICI all-reduce, PP adds bubble
+time, DP adds one gradient all-reduce per step; HBM capacity bounds the
+(params+optimizer+activations)/device. All closed-form, no measurement
+needed to rank — measurement (run_fn) refines the top-k if provided."""
+from .tuner import AutoTuner, TunerConfig, default_candidates  # noqa: F401
+from .prune import PRUNE_RULES, prune  # noqa: F401
+from .cost_model import estimate_step_time, memory_per_device  # noqa: F401
+from .recorder import Recorder  # noqa: F401

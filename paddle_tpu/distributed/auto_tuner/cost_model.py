@@ -1,0 +1,97 @@
+"""Closed-form cost model for hybrid-parallel candidates.
+
+Reference: auto_tuner/cost_model.py. Times are relative (seconds with
+nominal hardware constants) — ranking is what matters, and the constants
+are TPU-shaped: MXU peak flops, HBM bandwidth, ICI link bandwidth."""
+from dataclasses import dataclass
+
+
+@dataclass
+class Hardware:
+    # v5p-ish nominal numbers; only ratios matter for ranking
+    flops_per_chip: float = 459e12       # bf16 peak
+    hbm_bytes: float = 95e9
+    ici_bw: float = 90e9                 # bytes/s per link direction
+    dcn_bw: float = 6.25e9
+    mfu: float = 0.4                     # achievable fraction of peak
+
+
+@dataclass
+class ModelSpec:
+    """Transformer LM described by its dimensions."""
+    layers: int
+    hidden: int
+    ffn: int
+    vocab: int
+    seq_len: int
+    heads: int = 0
+
+    @property
+    def params(self):
+        # attention qkvo (4 h^2) + gated FFN (gate/up/down: 3 h*ffn)
+        per_layer = 4 * self.hidden * self.hidden + 3 * self.hidden * self.ffn
+        return self.layers * per_layer + 2 * self.vocab * self.hidden
+
+    def flops_per_token(self):
+        # 6 * params fwd+bwd, + attention quadratic term
+        attn = 12 * self.layers * self.hidden * self.seq_len
+        return 6 * self.params + attn
+
+
+def memory_per_device(model, cfg, dtype_bytes=2, optim_bytes=12,
+                      recompute=True):
+    """Bytes/device: params + grads + Adam states sharded by (mp*pp*
+    sharding), activations by (mp*sp) with recompute collapsing them to
+    one layer's worth per pp stage."""
+    mp = cfg.get("mp_degree", 1)
+    pp = cfg.get("pp_degree", 1)
+    sh = cfg.get("sharding_degree", 1)
+    micro_bsz = cfg.get("micro_batch_size", 1)
+    p_shard = model.params / (mp * pp * max(sh, 1))
+    param_mem = p_shard * (dtype_bytes + dtype_bytes + optim_bytes)
+    act_per_layer = (micro_bsz * model.seq_len *
+                     model.hidden * dtype_bytes * (10 if not recompute else 2))
+    layers_here = max(model.layers // pp, 1)
+    act_mem = act_per_layer * (1 if recompute else layers_here) / mp
+    # pipeline keeps pp in-flight microbatch activations
+    return param_mem + act_mem * max(pp, 1)
+
+
+def estimate_step_time(model, cfg, global_batch, hw=None):
+    """Relative step time: compute + TP comm + PP bubble + DP/sharding
+    all-reduce, assuming compute/comm overlap only for DP."""
+    hw = hw or Hardware()
+    dp = cfg.get("dp_degree", 1)
+    mp = cfg.get("mp_degree", 1)
+    pp = cfg.get("pp_degree", 1)
+    sh = cfg.get("sharding_degree", 1)
+    micro_bsz = cfg.get("micro_batch_size", 1)
+    nchips = dp * mp * pp * max(sh, 1)
+
+    tokens = global_batch * model.seq_len
+    compute = model.flops_per_token() * tokens / (
+        nchips * hw.flops_per_chip * hw.mfu)
+
+    # TP: 4 all-reduces per layer (fwd+bwd of attn+mlp) over activations
+    act_bytes = micro_bsz * model.seq_len * model.hidden * 2
+    tp_comm = 0.0
+    if mp > 1:
+        n_micro = max(global_batch // (dp * max(sh, 1) * micro_bsz), 1)
+        per_ar = 2 * act_bytes * (mp - 1) / mp / hw.ici_bw
+        tp_comm = 4 * model.layers / pp * per_ar * n_micro
+
+    # PP bubble: (pp-1)/m fraction of compute
+    bubble = 0.0
+    if pp > 1:
+        n_micro = max(global_batch // (dp * max(sh, 1) * micro_bsz), 1)
+        bubble = compute * (pp - 1) / max(n_micro, 1)
+
+    # DP/sharding grad sync: ring all-reduce of the param shard, half
+    # overlappable with backward
+    grad_bytes = model.params / (mp * pp) * 2
+    dp_world = dp * max(sh, 1)
+    dp_comm = 0.0
+    if dp_world > 1:
+        dp_comm = 0.5 * 2 * grad_bytes * (dp_world - 1) / dp_world / hw.ici_bw
+
+    return compute + tp_comm + bubble + dp_comm

@@ -1,0 +1,64 @@
+"""Prune rules (reference: auto_tuner/prune.py — registered rule functions
+that reject candidate configs before costing)."""
+
+PRUNE_RULES = []
+
+
+def register_prune_rule(fn):
+    PRUNE_RULES.append(fn)
+    return fn
+
+
+@register_prune_rule
+def prune_by_world_size(cfg, ctx):
+    n = (cfg["dp_degree"] * cfg["mp_degree"] * cfg["pp_degree"]
+         * max(cfg.get("sharding_degree", 1), 1))
+    return n != ctx["num_devices"]
+
+
+@register_prune_rule
+def prune_by_layers(cfg, ctx):
+    layers = ctx.get("model").layers if ctx.get("model") else None
+    return layers is not None and layers % cfg["pp_degree"] != 0
+
+
+@register_prune_rule
+def prune_by_heads(cfg, ctx):
+    m = ctx.get("model")
+    return bool(m and m.heads and m.heads % cfg["mp_degree"] != 0)
+
+
+@register_prune_rule
+def prune_mp_across_hosts(cfg, ctx):
+    """TP wants the fastest fabric: keep it within one host's chips
+    (reference prunes mp > 8; ICI wraps at the slice, DCN is 10x slower)."""
+    per_host = ctx.get("devices_per_host", 8)
+    return cfg["mp_degree"] > per_host
+
+
+@register_prune_rule
+def prune_by_batch(cfg, ctx):
+    gbs = ctx.get("global_batch", 0)
+    denom = cfg["dp_degree"] * max(cfg.get("sharding_degree", 1), 1)
+    if gbs and gbs % denom != 0:
+        return True
+    mb = cfg.get("micro_batch_size", 1)
+    return gbs and (gbs // denom) % mb != 0
+
+
+@register_prune_rule
+def prune_by_memory(cfg, ctx):
+    m = ctx.get("model")
+    if m is None:
+        return False
+    from .cost_model import memory_per_device, Hardware
+    hw = ctx.get("hardware") or Hardware()
+    return memory_per_device(m, cfg) > hw.hbm_bytes * 0.92
+
+
+def prune(candidates, ctx):
+    kept = []
+    for c in candidates:
+        if not any(rule(c, ctx) for rule in PRUNE_RULES):
+            kept.append(c)
+    return kept

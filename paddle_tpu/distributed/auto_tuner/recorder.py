@@ -1,0 +1,34 @@
+"""Run recorder (reference: auto_tuner/recorder.py — history of tried
+configs with metrics, sort + csv dump)."""
+import csv
+import json
+
+
+class Recorder:
+    def __init__(self):
+        self.history = []
+
+    def add(self, cfg, metric, error=None):
+        self.history.append({"config": dict(cfg), "metric": metric,
+                             "error": error})
+
+    def best(self, larger_is_better=False):
+        ok = [h for h in self.history
+              if h["error"] is None and h["metric"] is not None]
+        if not ok:
+            return None
+        return (max if larger_is_better else min)(
+            ok, key=lambda h: h["metric"])
+
+    def save(self, path):
+        if path.endswith(".json"):
+            with open(path, "w") as f:
+                json.dump(self.history, f, indent=2)
+            return
+        keys = sorted({k for h in self.history for k in h["config"]})
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(keys + ["metric", "error"])
+            for h in self.history:
+                w.writerow([h["config"].get(k) for k in keys]
+                           + [h["metric"], h["error"]])

@@ -1,0 +1,85 @@
+"""Tuner driver (reference: auto_tuner/tuner.py + search.py — enumerate,
+prune, rank by cost model, optionally measure top-k with a user run_fn)."""
+import itertools
+from dataclasses import dataclass, field
+
+from .cost_model import estimate_step_time, Hardware
+from .prune import prune
+from .recorder import Recorder
+
+
+@dataclass
+class TunerConfig:
+    num_devices: int
+    global_batch: int
+    model: object = None            # cost_model.ModelSpec for model-aware mode
+    devices_per_host: int = 8
+    hardware: Hardware = field(default_factory=Hardware)
+    micro_batch_sizes: tuple = (1, 2, 4, 8)
+    use_sharding: bool = True
+    topk: int = 4
+
+
+def _degrees(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(cfg):
+    """Grid over divisor degrees of the device count (search.py all_cands)."""
+    n = cfg.num_devices
+    out = []
+    for dp, mp, pp in itertools.product(_degrees(n), repeat=3):
+        rest = n // (dp * mp * pp) if dp * mp * pp and n % (dp * mp * pp) == 0 \
+            else 0
+        if rest == 0:
+            continue
+        shardings = _degrees(rest) if cfg.use_sharding else [1]
+        for sh in shardings:
+            if dp * mp * pp * sh != n:
+                continue
+            for mb in cfg.micro_batch_sizes:
+                out.append({"dp_degree": dp, "mp_degree": mp,
+                            "pp_degree": pp, "sharding_degree": sh,
+                            "micro_batch_size": mb})
+    return out
+
+
+class AutoTuner:
+    def __init__(self, config: TunerConfig):
+        self.cfg = config
+        self.recorder = Recorder()
+
+    def search_space(self):
+        ctx = {"num_devices": self.cfg.num_devices,
+               "global_batch": self.cfg.global_batch,
+               "model": self.cfg.model,
+               "devices_per_host": self.cfg.devices_per_host,
+               "hardware": self.cfg.hardware}
+        return prune(default_candidates(self.cfg), ctx)
+
+    def rank(self, candidates=None):
+        cands = candidates if candidates is not None else self.search_space()
+        if self.cfg.model is None:
+            return cands  # nothing to rank on; caller measures
+        scored = [(estimate_step_time(self.cfg.model, c,
+                                      self.cfg.global_batch,
+                                      self.cfg.hardware), c)
+                  for c in cands]
+        scored.sort(key=lambda t: t[0])
+        return [c for _, c in scored]
+
+    def tune(self, run_fn=None):
+        """Rank the pruned space; if run_fn(cfg)->metric is given, measure
+        the top-k and return the measured best, else the model-ranked best."""
+        ranked = self.rank()
+        if not ranked:
+            raise ValueError("search space is empty after pruning")
+        if run_fn is None:
+            return ranked[0]
+        for c in ranked[:self.cfg.topk]:
+            try:
+                self.recorder.add(c, run_fn(c))
+            except Exception as e:  # a candidate OOMing is data, not an error
+                self.recorder.add(c, None, error=repr(e))
+        best = self.recorder.best()
+        return best["config"] if best else ranked[0]

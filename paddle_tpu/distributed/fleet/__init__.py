@@ -15,6 +15,7 @@ from .sharding import (DygraphShardingOptimizer, GroupShardedStage2,
 from .hybrid_optimizer import HybridParallelOptimizer, HybridParallelClipGrad
 from . import recompute as _recompute_mod
 from .recompute import recompute, recompute_sequential
+from .elastic import ElasticManager, ElasticStatus
 from .context_parallel import (ring_attention, ulysses_attention,
                                split_sequence, SegmentParallel)
 

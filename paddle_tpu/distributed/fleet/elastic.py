@@ -1,0 +1,92 @@
+"""Elastic training manager.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:125 —
+ElasticManager registers nodes in etcd, watches membership, and triggers
+scale-in/out or restart; levels: 0 = hold on peer failure, 1 = internal
+restart. Here the membership registry is the launcher's TCPStore master
+(the etcd role), and the restart mechanics live in the launch controller;
+this class is the in-process API: heartbeats, membership watch, and the
+restart/hold decision surface."""
+import json
+import threading
+import time
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, master, rank, nnodes, elastic_level=1,
+                 heartbeat_s=2.0, ttl_factor=5):
+        self.master = master
+        self.rank = rank
+        self.nnodes = nnodes
+        self.level = elastic_level
+        self.heartbeat_s = heartbeat_s
+        self.ttl_s = heartbeat_s * ttl_factor
+        self._stop = threading.Event()
+        self._threads = []
+        self._dead_peers = set()
+        self._lock = threading.Lock()
+
+    # -- liveness ---------------------------------------------------------
+    def start(self):
+        t = threading.Thread(target=self._beat_loop, daemon=True)
+        t.start()
+        w = threading.Thread(target=self._watch_loop, daemon=True)
+        w.start()
+        self._threads = [t, w]
+
+    def _beat_loop(self):
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                self.master.heartbeat(self.rank)
+            except Exception:
+                return
+
+    def _watch_loop(self):
+        # grace period so peers get a first heartbeat out
+        time.sleep(self.ttl_s)
+        while not self._stop.wait(self.heartbeat_s):
+            for r in range(self.nnodes):
+                if r == self.rank:
+                    continue
+                try:
+                    alive = self.master.peer_alive(r, self.ttl_s)
+                except Exception:
+                    return
+                with self._lock:
+                    if not alive:
+                        self._dead_peers.add(r)
+                    else:
+                        # peer recovered (elastic rejoin): clear it so
+                        # decide() doesn't demand restarts forever
+                        self._dead_peers.discard(r)
+
+    def dead_peers(self):
+        with self._lock:
+            return sorted(self._dead_peers)
+
+    def healthy(self):
+        return not self.dead_peers() and self.master.job_failed() is None
+
+    # -- decisions --------------------------------------------------------
+    def decide(self, local_ok=True):
+        """What should this node do now? (manager.py watch loop outcome)"""
+        if not local_ok:
+            self.master.announce_failure(self.rank, "local failure")
+            return ElasticStatus.ERROR
+        if self.healthy():
+            return ElasticStatus.COMPLETED
+        return (ElasticStatus.RESTART if self.level >= 1
+                else ElasticStatus.HOLD)
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)

@@ -1,0 +1,12 @@
+"""Distributed launcher (reference: python/paddle/distributed/launch/ —
+`python -m paddle.distributed.launch`, launch/main.py:23, controllers/).
+
+TPU-native model: the reference launches one process per GPU; on TPU the
+unit is one controller process per *host* (single-controller SPMD drives
+all local chips; hosts join via jax.distributed / the PJRT coordination
+service). The launcher's remaining jobs are exactly the reference ones:
+master rendezvous (here the native TCPStore, controllers/master.py:73
+role), rank assignment, the PADDLE_* env contract, process watch with
+restart (controllers/controller.py:35), and peer-failure propagation.
+"""
+from .main import launch, parse_args  # noqa: F401

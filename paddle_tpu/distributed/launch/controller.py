@@ -1,0 +1,213 @@
+"""Collective controller: rendezvous, spawn the worker, watch, restart.
+
+Reference: launch/controllers/collective.py:22 (CollectiveController
+builds per-rank containers + env) and controllers/controller.py:35
+(ControllerBase.watch — poll local procs + master status, propagate peer
+failure, restart within --max_restart)."""
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from .master import Master, free_port
+
+
+class Controller:
+    def __init__(self, args):
+        self.args = args
+        self.proc = None
+        self.restarts = 0
+        self._log_file = None
+        self._hb_stop = threading.Event()
+
+        single = args.nnodes == 1 and not args.master
+        if single:
+            # still rendezvous through a local store so the watch/heartbeat
+            # path is identical in both modes
+            self.endpoint = f"127.0.0.1:{free_port()}"
+            self.is_master = True
+        else:
+            if not args.master:
+                raise SystemExit("--master host:port is required for "
+                                 "--nnodes > 1")
+            self.endpoint = args.master
+            host = self.endpoint.split(":")[0]
+            self.is_master = args.rank == 0 or host in self._local_addrs()
+
+        self.master = Master(self.endpoint, is_master=self.is_master,
+                             job_id=args.job_id, timeout_s=args.timeout)
+
+    @staticmethod
+    def _local_addrs():
+        import socket
+        names = {"127.0.0.1", "localhost", socket.gethostname()}
+        try:
+            names.add(socket.gethostbyname(socket.gethostname()))
+        except OSError:
+            pass
+        return names
+
+    # -- env contract (reference: collective.py builds PADDLE_* per rank) --
+    def _worker_env(self, rank, peers, generation):
+        env = dict(os.environ)
+        coord_host = self.endpoint.split(":")[0]
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(self.args.nnodes),
+            "PADDLE_NNODES": str(self.args.nnodes),
+            "PADDLE_MASTER": f"{coord_host}:{peers[0]['coord_port']}",
+            "PADDLE_JOB_ID": self.args.job_id,
+            "PADDLE_RESTART_GENERATION": str(generation),
+            "PADDLE_LOCAL_SIZE": str(len(peers)),
+        })
+        if self.args.devices:
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_"
+                                f"device_count={self.args.devices}")
+        return env
+
+    def _spawn(self, rank, peers, generation):
+        env = self._worker_env(rank, peers, generation)
+        log_dir = self.args.log_dir
+        if self._log_file:
+            self._log_file.close()
+            self._log_file = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            self._log_file = open(
+                os.path.join(log_dir, f"workerlog.{rank}"), "ab")
+        cmd = [sys.executable] + ([ "-m", self.args.module] if self.args.module
+                                  else []) + self.args.script_args
+        self.proc = subprocess.Popen(cmd, env=env, stdout=self._log_file,
+                                     stderr=self._log_file)
+        return self.proc
+
+    def _heartbeat_loop(self, rank):
+        while not self._hb_stop.wait(self.args.heartbeat_s):
+            try:
+                self.master.heartbeat(rank)
+            except Exception:
+                return
+
+    def run(self):
+        """Main loop: rendezvous → spawn → watch; restart on failure up to
+        --max_restart (elastic level 1 semantics, manager.py:125)."""
+        args = self.args
+        while True:
+            # generation = local restart count: every node restarts exactly
+            # once per failure (its own, or a propagated peer failure), so
+            # the counters stay in lockstep and each generation's rendezvous
+            # keys start untouched — no teardown races.
+            generation = self.restarts
+            # every node offers a coordinator port; only the one that lands
+            # rank 0 is used (PADDLE_MASTER -> jax.distributed coordinator)
+            payload = {"host": self._myhost(), "coord_port": free_port()}
+            rank, peers = self.master.register(args.nnodes, payload,
+                                               generation=generation,
+                                               rank=args.rank)
+            proc = self._spawn(rank, peers, generation)
+            self._hb_stop.clear()
+            hb = threading.Thread(target=self._heartbeat_loop, args=(rank,),
+                                  daemon=True)
+            hb.start()
+
+            status = self._watch(rank, proc, generation)
+            if status == "ok":
+                # completion barrier: the store must stay up until every
+                # node is done, and a late peer failure fails/restarts this
+                # node too (the job is one gang)
+                status = self._await_job_done(rank, generation)
+            self._hb_stop.set()
+            hb.join(timeout=2)
+
+            if status == "ok":
+                return 0
+            self.restarts += 1
+            if self.restarts > args.max_restart:
+                print(f"[launch] rank {rank}: giving up after "
+                      f"{self.restarts - 1} restarts", file=sys.stderr)
+                return 1
+            print(f"[launch] rank {rank}: restarting "
+                  f"({self.restarts}/{args.max_restart}) after {status}",
+                  file=sys.stderr)
+
+    def _await_job_done(self, rank, generation):
+        """After local success: publish done, then wait for all peers to be
+        done (return "ok") or any to fail (return the failure)."""
+        ns = f"{self.args.job_id}/g{generation}"
+        try:
+            self.master.store.set(f"{ns}/done/{rank}", b"1")
+            while True:
+                failed = self.master.job_failed(generation)
+                if failed and failed.get("rank") != rank:
+                    return (f"peer rank {failed['rank']} failed after local "
+                            f"completion: {failed['reason']}")
+                if all(self.master.store.check(f"{ns}/done/{r}")
+                       for r in range(self.args.nnodes)):
+                    return "ok"
+                time.sleep(0.2)
+        except (RuntimeError, TimeoutError):
+            # store gone: its host only exits after all-done or give-up, and
+            # a give-up is already reported through that node's exit code
+            return "ok"
+
+    def _kill_worker(self, proc):
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    def _watch(self, rank, proc, generation):
+        """Poll the local proc, the generation's failure key, and peer
+        heartbeats (reference ControllerBase.watch). Hard node deaths —
+        where no launcher survives to announce the failure — surface
+        through the heartbeat TTL."""
+        ttl = self.args.heartbeat_s * 5
+        start = time.time()
+        last_hb_check = 0.0
+        try:
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    if rc == 0:
+                        return "ok"
+                    self.master.announce_failure(rank, f"exit code {rc}",
+                                                 generation)
+                    return f"local worker failed (rc={rc})"
+                failed = self.master.job_failed(generation)
+                if failed and failed.get("rank") != rank:
+                    self._kill_worker(proc)
+                    return (f"peer rank {failed['rank']} failed: "
+                            f"{failed['reason']}")
+                now = time.time()
+                if (self.args.nnodes > 1 and now - start > ttl
+                        and now - last_hb_check > self.args.heartbeat_s):
+                    last_hb_check = now
+                    for r in range(self.args.nnodes):
+                        if r != rank and not self.master.peer_alive(r, ttl):
+                            self.master.announce_failure(
+                                r, "heartbeat lost", generation)
+                            self._kill_worker(proc)
+                            return f"peer rank {r} heartbeat lost"
+                time.sleep(0.2)
+        except (RuntimeError, TimeoutError) as e:
+            self._kill_worker(proc)
+            return f"rendezvous store lost: {e}"
+
+    @staticmethod
+    def _myhost():
+        import socket
+        return socket.gethostname()
+
+    def close(self):
+        self._hb_stop.set()
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+        if self._log_file:
+            self._log_file.close()
+            self._log_file = None
+        self.master.close()

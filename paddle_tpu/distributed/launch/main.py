@@ -1,0 +1,49 @@
+"""`python -m paddle_tpu.distributed.launch` entry (reference:
+launch/main.py:23)."""
+import argparse
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a distributed job: one controller per host, "
+                    "rendezvous via TCPStore, watch + restart.")
+    p.add_argument("--master", default=None,
+                   help="host:port of the rendezvous store (rank-0 hosts it)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--rank", type=int, default=-1,
+                   help="optional fixed node rank; default arrival order")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--timeout", type=int, default=300)
+    p.add_argument("--heartbeat_s", type=float, default=2.0)
+    p.add_argument("--devices", type=int, default=0,
+                   help="if >0: run workers on a virtual CPU mesh with this "
+                        "many devices (test mode; mirrors the reference's "
+                        "fake custom_cpu plugin pattern)")
+    p.add_argument("--module", default=None,
+                   help="run script as a module (python -m)")
+    p.add_argument("script_args", nargs=argparse.REMAINDER,
+                   help="training script and its args")
+    args = p.parse_args(argv)
+    if args.script_args and args.script_args[0] == "--":
+        args.script_args = args.script_args[1:]
+    if not args.script_args and not args.module:
+        p.error("no training script given")
+    return args
+
+
+def launch(argv=None):
+    from .controller import Controller
+    args = parse_args(argv)
+    c = Controller(args)
+    try:
+        return c.run()
+    finally:
+        c.close()
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -1,0 +1,95 @@
+"""Rendezvous master over the native TCPStore.
+
+Reference: launch/controllers/master.py:73 (HTTPMaster) / :186 (ETCDMaster).
+The KV contract is the same: nodes register under a job namespace, the
+master assigns ranks by arrival order (atomic counter), every node blocks
+until the expected world arrives, and liveness is a heartbeat key per rank
+that peers watch."""
+import json
+import os
+import socket
+import time
+
+from ... import native
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class Master:
+    """One rendezvous endpoint. The node whose address matches `endpoint`
+    (or rank-0 in single-node mode) hosts the store server; all nodes
+    (master included) are clients."""
+
+    HEARTBEAT_KEY = "{job}/hb/{rank}"
+
+    def __init__(self, endpoint, is_master, job_id="default", timeout_s=300):
+        host, _, port = endpoint.partition(":")
+        self.host, self.port = host, int(port)
+        self.job = job_id
+        if is_master:
+            try:
+                self.store = native.TCPStore(host=host, port=self.port,
+                                             is_master=True,
+                                             timeout_s=timeout_s)
+                return
+            except RuntimeError:
+                # port already hosted (several nodes on one host — the
+                # loopback multi-node test pattern): join as client
+                pass
+        self.store = native.TCPStore(host=host, port=self.port,
+                                     is_master=False, timeout_s=timeout_s)
+
+    def register(self, nnodes, payload, generation=0, rank=None):
+        """Join generation `generation` of the job; returns (rank, peers)
+        once all nnodes arrived. Rank is arrival order unless a fixed rank
+        is given (reference master.py sync_peers semantics). All rendezvous
+        keys are generation-scoped so restarts never race a half-torn-down
+        epoch: a new generation's counters simply start fresh."""
+        ns = f"{self.job}/g{generation}"
+        arrivals = self.store.add(f"{ns}/joined", 1)
+        if arrivals > nnodes:
+            raise RuntimeError(
+                f"more nodes than --nnodes={nnodes} joined job {self.job} "
+                f"(generation {generation})")
+        if rank is None or rank < 0:
+            rank = arrivals - 1
+        self.store.set(f"{ns}/node/{rank}", json.dumps(payload))
+        if arrivals == nnodes:
+            self.store.set(f"{ns}/ready", b"1")
+        self.store.wait(f"{ns}/ready")
+        peers = [json.loads(self.store.get(f"{ns}/node/{r}"))
+                 for r in range(nnodes)]
+        return rank, peers
+
+    def heartbeat(self, rank):
+        self.store.set(self.HEARTBEAT_KEY.format(job=self.job, rank=rank),
+                       str(time.time()))
+
+    def peer_alive(self, rank, ttl_s):
+        key = self.HEARTBEAT_KEY.format(job=self.job, rank=rank)
+        if not self.store.check(key):
+            return True  # never beat yet — still starting
+        ts = float(self.store.get(key))
+        return (time.time() - ts) < ttl_s
+
+    def announce_failure(self, rank, reason, generation=0):
+        """Failure keys are generation-scoped and never deleted — peers of
+        generation g cannot miss the notification, and generation g+1
+        starts clean without any teardown."""
+        self.store.set(f"{self.job}/g{generation}/failed", json.dumps(
+            {"rank": rank, "reason": reason, "ts": time.time()}))
+
+    def job_failed(self, generation=0):
+        key = f"{self.job}/g{generation}/failed"
+        if self.store.check(key):
+            return json.loads(self.store.get(key))
+        return None
+
+    def close(self):
+        self.store.close()

@@ -180,7 +180,10 @@ class TCPStore:
             raise TimeoutError(f"TCPStore.wait({key}) timed out")
 
     def check(self, key):
-        return LIB.pt_store_check(self._client, key.encode()) == 1
+        rc = LIB.pt_store_check(self._client, key.encode())
+        if rc < 0:
+            raise RuntimeError(f"TCPStore.check({key}) connection error")
+        return rc == 1
 
     def delete(self, key):
         LIB.pt_store_delete(self._client, key.encode())

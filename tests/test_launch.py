@@ -1,0 +1,267 @@
+"""Launcher / elastic / auto-tuner tests.
+
+Reference test model: test/collective/test_communication_api_base.py —
+multi-node is simulated by launching N launcher processes against a
+loop-back master on one host (:62-77)."""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import native
+from paddle_tpu.distributed.auto_tuner import (AutoTuner, TunerConfig,
+                                               estimate_step_time,
+                                               memory_per_device, Recorder)
+from paddle_tpu.distributed.auto_tuner.cost_model import ModelSpec
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+from paddle_tpu.distributed.launch.master import Master, free_port
+
+requires_native = pytest.mark.skipif(not native.AVAILABLE,
+                                     reason="native lib not built")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_OK = """
+import os, sys
+rank = os.environ["PADDLE_TRAINER_ID"]
+n = os.environ["PADDLE_TRAINERS_NUM"]
+assert "PADDLE_MASTER" in os.environ
+print(f"hello from {rank}/{n}", flush=True)
+"""
+
+WORKER_FAIL_ONCE = """
+import os, sys
+marker = sys.argv[1] + "." + os.environ["PADDLE_TRAINER_ID"]
+gen = os.environ.get("PADDLE_RESTART_GENERATION", "0")
+if not os.path.exists(marker):
+    open(marker, "w").write("x")
+    sys.exit(7)
+print("recovered rank", os.environ["PADDLE_TRAINER_ID"], flush=True)
+"""
+
+
+def _run_launcher(args, script_body, script_args=(), timeout=90, tmp_path=None):
+    script = tmp_path / "worker.py"
+    script.write_text(script_body)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           *args, str(script), *map(str, script_args)]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+@requires_native
+class TestLauncher:
+    def test_single_node(self, tmp_path):
+        r = _run_launcher(["--log_dir", str(tmp_path / "logs")], WORKER_OK,
+                          tmp_path=tmp_path)
+        assert r.returncode == 0, r.stderr
+        log = (tmp_path / "logs" / "workerlog.0").read_text()
+        assert "hello from 0/1" in log
+
+    def test_restart_on_failure(self, tmp_path):
+        marker = tmp_path / "fail_once"
+        r = _run_launcher(["--max_restart", "2",
+                           "--log_dir", str(tmp_path / "logs")],
+                          WORKER_FAIL_ONCE, script_args=(marker,),
+                          tmp_path=tmp_path)
+        assert r.returncode == 0, r.stderr + r.stdout
+        log = (tmp_path / "logs" / "workerlog.0").read_text()
+        assert "recovered rank 0" in log
+        assert "restarting" in r.stderr
+
+    def test_exhausted_restarts_fail(self, tmp_path):
+        always_fail = "import sys; sys.exit(3)\n"
+        r = _run_launcher(["--max_restart", "1"], always_fail,
+                          tmp_path=tmp_path)
+        assert r.returncode == 1
+        assert "giving up" in r.stderr
+
+    def test_two_node_loopback(self, tmp_path):
+        """Two launcher processes rendezvous via one master (the reference
+        multi-node-on-one-host pattern)."""
+        port = free_port()
+        script = tmp_path / "worker.py"
+        script.write_text(WORKER_OK)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+        procs = []
+        for i in range(2):
+            cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                   "--nnodes", "2", "--master", f"127.0.0.1:{port}",
+                   "--rank", str(i),
+                   "--log_dir", str(tmp_path / f"logs{i}"), str(script)]
+            procs.append(subprocess.Popen(cmd, cwd=REPO, env=env,
+                                          stdout=subprocess.PIPE,
+                                          stderr=subprocess.PIPE, text=True))
+            time.sleep(0.3)  # node 0 (master) first
+        outs = [p.communicate(timeout=90) for p in procs]
+        assert all(p.returncode == 0 for p in procs), outs
+        ranks = set()
+        for i in range(2):
+            for f in (tmp_path / f"logs{i}").iterdir():
+                txt = f.read_text()
+                if "hello from" in txt:
+                    ranks.add(txt.split("hello from ")[1].split("/")[0])
+        assert ranks == {"0", "1"}
+
+
+@requires_native
+class TestMultiNodeRestart:
+    def test_peer_failure_restarts_both_nodes(self, tmp_path):
+        """Rank 1's worker dies once; failure propagates through the
+        generation-scoped key, BOTH nodes restart into generation 1, and
+        the job completes."""
+        port = free_port()
+        script = tmp_path / "worker.py"
+        script.write_text("""
+import os, sys
+rank = os.environ["PADDLE_TRAINER_ID"]
+gen = os.environ["PADDLE_RESTART_GENERATION"]
+marker = sys.argv[1] + ".failed_once"
+if rank == "1" and not os.path.exists(marker):
+    open(marker, "w").write("x")
+    sys.exit(9)
+print(f"gen{gen} rank{rank} done", flush=True)
+""")
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+        procs = []
+        for i in range(2):
+            cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                   "--nnodes", "2", "--master", f"127.0.0.1:{port}",
+                   "--rank", str(i), "--max_restart", "2",
+                   "--log_dir", str(tmp_path / f"logs{i}"),
+                   str(script), str(tmp_path / "m")]
+            procs.append(subprocess.Popen(cmd, cwd=REPO, env=env,
+                                          stdout=subprocess.PIPE,
+                                          stderr=subprocess.PIPE, text=True))
+            time.sleep(0.3)
+        outs = [p.communicate(timeout=120) for p in procs]
+        assert all(p.returncode == 0 for p in procs), outs
+        log0 = (tmp_path / "logs0" / "workerlog.0").read_text()
+        log1 = (tmp_path / "logs1" / "workerlog.1").read_text()
+        assert "gen1 rank0 done" in log0, (log0, outs)
+        assert "gen1 rank1 done" in log1, (log1, outs)
+        # both controllers reported the restart
+        assert any("restarting" in o[1] for o in outs)
+
+
+@requires_native
+class TestElastic:
+    def test_heartbeat_and_peer_loss(self):
+        ep = f"127.0.0.1:{free_port()}"
+        m0 = Master(ep, is_master=True, job_id="el")
+        m1 = Master(ep, is_master=False, job_id="el")
+        e0 = ElasticManager(m0, rank=0, nnodes=2, heartbeat_s=0.1)
+        e1 = ElasticManager(m1, rank=1, nnodes=2, heartbeat_s=0.1)
+        try:
+            e0.start(); e1.start()
+            time.sleep(0.8)
+            assert e0.healthy() and e1.healthy()
+            assert e0.decide() == ElasticStatus.COMPLETED
+            # rank 1 dies: stop its heartbeat
+            e1.stop()
+            deadline = time.time() + 5
+            while time.time() < deadline and e0.healthy():
+                time.sleep(0.1)
+            assert not e0.healthy()
+            assert 1 in e0.dead_peers()
+            assert e0.decide() == ElasticStatus.RESTART
+            e0.level = 0
+            assert e0.decide() == ElasticStatus.HOLD
+        finally:
+            e0.stop(); e1.stop()
+            m1.close(); m0.close()
+
+    def test_local_failure_announced(self):
+        ep = f"127.0.0.1:{free_port()}"
+        m0 = Master(ep, is_master=True, job_id="el2")
+        e0 = ElasticManager(m0, rank=0, nnodes=1, heartbeat_s=0.1)
+        try:
+            assert e0.decide(local_ok=False) == ElasticStatus.ERROR
+            assert m0.job_failed()["rank"] == 0
+        finally:
+            m0.close()
+
+
+class TestAutoTuner:
+    MODEL = ModelSpec(layers=24, hidden=2048, ffn=5504, vocab=32000,
+                      seq_len=2048, heads=16)
+
+    def test_search_space_covers_world(self):
+        t = AutoTuner(TunerConfig(num_devices=8, global_batch=32,
+                                  model=self.MODEL))
+        space = t.search_space()
+        assert space, "pruned to nothing"
+        for c in space:
+            assert (c["dp_degree"] * c["mp_degree"] * c["pp_degree"]
+                    * c["sharding_degree"]) == 8
+            assert self.MODEL.layers % c["pp_degree"] == 0
+            assert self.MODEL.heads % c["mp_degree"] == 0
+
+    def test_rank_prefers_parallel_over_serial_bottleneck(self):
+        cfg_good = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+                    "sharding_degree": 1, "micro_batch_size": 4}
+        cfg_bubble = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 8,
+                      "sharding_degree": 1, "micro_batch_size": 1}
+        t_good = estimate_step_time(self.MODEL, cfg_good, 32)
+        t_bub = estimate_step_time(self.MODEL, cfg_bubble, 32)
+        assert t_good < t_bub
+
+    def test_memory_prune_rejects_7b_on_one_chip(self):
+        big = ModelSpec(layers=32, hidden=4096, ffn=11008, vocab=32000,
+                        seq_len=4096, heads=32)
+        one_chip = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                    "sharding_degree": 1, "micro_batch_size": 1}
+        from paddle_tpu.distributed.auto_tuner.cost_model import Hardware
+        assert memory_per_device(big, one_chip) > Hardware().hbm_bytes
+
+    def test_tune_with_measurement(self):
+        t = AutoTuner(TunerConfig(num_devices=8, global_batch=32,
+                                  model=self.MODEL, topk=3))
+        calls = []
+        def run_fn(cfg):
+            calls.append(cfg)
+            return cfg["mp_degree"] * 1.0 + cfg["pp_degree"]  # fake time
+        best = t.tune(run_fn)
+        assert len(calls) == 3
+        assert best in calls
+        assert t.recorder.best()["config"] == best
+
+    def test_recorder_roundtrip(self, tmp_path):
+        r = Recorder()
+        r.add({"dp_degree": 2}, 1.5)
+        r.add({"dp_degree": 4}, 0.5)
+        r.add({"dp_degree": 8}, None, error="OOM")
+        assert r.best()["metric"] == 0.5
+        p = tmp_path / "hist.json"
+        r.save(str(p))
+        import json
+        assert len(json.loads(p.read_text())) == 3
+
+
+@requires_native
+def test_spawn_multiprocess(tmp_path):
+    # spawn with nprocs>1 forks workers with the env contract
+    script = tmp_path / "sp.py"
+    script.write_text("""
+import paddle_tpu.distributed as dist
+
+def work(out):
+    import os
+    with open(out + "." + os.environ["PADDLE_TRAINER_ID"], "w") as f:
+        f.write(os.environ["PADDLE_TRAINERS_NUM"])
+
+if __name__ == "__main__":
+    import sys
+    dist.spawn(work, args=(sys.argv[1],), nprocs=2)
+""")
+    out = tmp_path / "spawned"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    r = subprocess.run([sys.executable, str(script), str(out)], env=env,
+                       cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "spawned.0").read_text() == "2"
+    assert (tmp_path / "spawned.1").read_text() == "2"
