@@ -45,6 +45,9 @@ from . import hapi
 from . import profiler
 from . import incubate
 from . import device
+from . import sparse
+from . import fft
+from . import signal
 from .hapi import Model, summary
 from .framework import save, load, set_default_dtype, get_default_dtype
 from .utils.flags import set_flags, get_flags

@@ -21,8 +21,8 @@ from .dispatch import apply_op
 
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx",
-                 "_hooks", "_retain_grad", "name", "persistable", "trainable",
-                 "_dist_meta", "__weakref__", "__dict__")
+                 "_hooks", "_retain_grad", "name", "persistable",
+                 "_trainable", "_dist_meta", "__weakref__", "__dict__")
 
     def __init__(self, data, dtype=None, stop_gradient=True, name=None):
         if isinstance(data, Tensor):
@@ -47,7 +47,19 @@ class Tensor:
         self._retain_grad = False
         self.name = name
         self.persistable = False
-        self.trainable = not stop_gradient
+        self._trainable = None  # None: follow (not stop_gradient)
+
+    @property
+    def trainable(self):
+        # tracks stop_gradient unless explicitly set (Parameter sets it);
+        # keeps late `t.stop_gradient = False` visible to optimizers
+        if self._trainable is None:
+            return not self.stop_gradient
+        return self._trainable
+
+    @trainable.setter
+    def trainable(self, v):
+        self._trainable = bool(v)
 
     # -- storage --------------------------------------------------------
     @property

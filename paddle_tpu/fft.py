@@ -1,0 +1,145 @@
+"""paddle.fft parity namespace (reference: python/paddle/fft.py — ~30
+functions over the phi fft kernels, which bind cuFFT/onednn; here they
+lower to jnp.fft = XLA's native FFT ops, differentiable through the
+dispatch tape)."""
+import jax.numpy as jnp
+
+from .core.dispatch import apply_op
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftshift", "ifftshift", "fftfreq", "rfftfreq",
+]
+
+_NORMS = {"backward", "ortho", "forward", None}
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(f"norm must be one of {_NORMS}, got {norm!r}")
+    return norm
+
+
+def _wrap1(name, jfn):
+    def op(x, n=None, axis=-1, norm="backward"):
+        _check_norm(norm)
+
+        def impl(a):
+            return jfn(a, n=n, axis=axis, norm=norm)
+
+        return apply_op(f"fft_{name}", impl, (x,), {})
+    op.__name__ = name
+    op.__doc__ = f"paddle.fft.{name} (jnp.fft.{jfn.__name__} lowering)."
+    return op
+
+
+def _wrap2(name, jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward"):
+        _check_norm(norm)
+
+        def impl(a):
+            return jfn(a, s=s, axes=axes, norm=norm)
+
+        return apply_op(f"fft_{name}", impl, (x,), {})
+    op.__name__ = name
+    return op
+
+
+def _wrapn(name, jfn):
+    def op(x, s=None, axes=None, norm="backward"):
+        _check_norm(norm)
+
+        def impl(a):
+            return jfn(a, s=s, axes=axes, norm=norm)
+
+        return apply_op(f"fft_{name}", impl, (x,), {})
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1("fft", jnp.fft.fft)
+ifft = _wrap1("ifft", jnp.fft.ifft)
+rfft = _wrap1("rfft", jnp.fft.rfft)
+irfft = _wrap1("irfft", jnp.fft.irfft)
+hfft = _wrap1("hfft", jnp.fft.hfft)
+ihfft = _wrap1("ihfft", jnp.fft.ihfft)
+
+fft2 = _wrap2("fft2", jnp.fft.fft2)
+ifft2 = _wrap2("ifft2", jnp.fft.ifft2)
+rfft2 = _wrap2("rfft2", jnp.fft.rfft2)
+irfft2 = _wrap2("irfft2", jnp.fft.irfft2)
+
+
+fftn = _wrapn("fftn", jnp.fft.fftn)
+ifftn = _wrapn("ifftn", jnp.fft.ifftn)
+rfftn = _wrapn("rfftn", jnp.fft.rfftn)
+irfftn = _wrapn("irfftn", jnp.fft.irfftn)
+
+
+def _hfftn_impl(a, s, axes, norm):
+    """hfftn = fftn over the leading axes composed with hfft on the last
+    (norms are per-axis multiplicative, so composition preserves all three
+    norm modes). jnp.fft has only the 1D hfft/ihfft."""
+    axes = tuple(range(-a.ndim, 0)) if axes is None else tuple(axes)
+    n_last = None if s is None else s[-1]
+    out = a
+    if len(axes) > 1:
+        s_head = None if s is None else s[:-1]
+        out = jnp.fft.fftn(out, s=s_head, axes=axes[:-1], norm=norm)
+    return jnp.fft.hfft(out, n=n_last, axis=axes[-1], norm=norm)
+
+
+def _ihfftn_impl(a, s, axes, norm):
+    axes = tuple(range(-a.ndim, 0)) if axes is None else tuple(axes)
+    n_last = None if s is None else s[-1]
+    out = jnp.fft.ihfft(a, n=n_last, axis=axes[-1], norm=norm)
+    if len(axes) > 1:
+        s_head = None if s is None else s[:-1]
+        out = jnp.fft.ifftn(out, s=s_head, axes=axes[:-1], norm=norm)
+    return out
+
+
+def hfftn(x, s=None, axes=None, norm="backward"):
+    _check_norm(norm)
+    return apply_op("fft_hfftn",
+                    lambda a: _hfftn_impl(a, s, axes, norm), (x,), {})
+
+
+def ihfftn(x, s=None, axes=None, norm="backward"):
+    _check_norm(norm)
+    return apply_op("fft_ihfftn",
+                    lambda a: _ihfftn_impl(a, s, axes, norm), (x,), {})
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def fftshift(x, axes=None):
+    def impl(a):
+        return jnp.fft.fftshift(a, axes=axes)
+    return apply_op("fftshift", impl, (x,), {})
+
+
+def ifftshift(x, axes=None):
+    def impl(a):
+        return jnp.fft.ifftshift(a, axes=axes)
+    return apply_op("ifftshift", impl, (x,), {})
+
+
+def fftfreq(n, d=1.0, dtype=None):
+    from .core.tensor import to_tensor
+    import numpy as np
+    return to_tensor(np.fft.fftfreq(n, d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None):
+    from .core.tensor import to_tensor
+    import numpy as np
+    return to_tensor(np.fft.rfftfreq(n, d).astype(dtype or "float32"))

@@ -104,7 +104,10 @@ class Optimizer:
         self._step_count += 1
         lr = self.get_lr()
         for p, g in params_grads:
-            lr_p = lr * p.optimize_attr.get("learning_rate", 1.0)
+            # plain Tensors (e.g. sparse values) are optimizable too; only
+            # Parameter carries optimize_attr
+            attr = getattr(p, "optimize_attr", None) or {}
+            lr_p = lr * attr.get("learning_rate", 1.0)
             st = self._state(p)
             self._apply_one(p, g.data, st, lr_p)
 

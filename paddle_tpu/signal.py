@@ -1,0 +1,144 @@
+"""paddle.signal parity (reference: python/paddle/signal.py — stft/istft
+over the frame/overlap_add phi kernels). Framing is a strided gather;
+overlap-add is a scatter-add — both XLA-native."""
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dispatch import apply_op
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1):
+    """Slice overlapping frames (reference signal.py frame, kernel
+    funcs/frame_functor.h). axis=-1: [..., T] -> [..., frame_length, n];
+    axis=0: [T, ...] -> [n, frame_length, ...]."""
+    if axis not in (0, -1):
+        raise ValueError("frame supports axis 0 or -1")
+
+    def impl(a):
+        if axis == 0:
+            a = jnp.moveaxis(a, 0, -1)
+        t = a.shape[-1]
+        n = 1 + (t - frame_length) // hop_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        out = a[..., idx]            # [..., n, frame_length]
+        if axis == 0:
+            # [..., n, fl] -> [n, fl, ...]
+            return jnp.moveaxis(jnp.moveaxis(out, -1, 0), -1, 0)
+        return jnp.moveaxis(out, -2, -1)
+    return apply_op("frame", impl, (x,), {})
+
+
+def overlap_add(x, hop_length, axis=-1):
+    """Inverse of frame. axis=-1: [..., frame_length, n] -> [..., T];
+    axis=0: [n, frame_length, ...] -> [T, ...]."""
+    if axis not in (0, -1):
+        raise ValueError("overlap_add supports axis 0 or -1")
+
+    def impl(a):
+        if axis == 0:
+            # [n, fl, ...] -> [..., fl, n]
+            a = jnp.moveaxis(jnp.moveaxis(a, 0, -1), 0, -2)
+        fl, n = a.shape[-2], a.shape[-1]
+        t = (n - 1) * hop_length + fl
+        starts = jnp.arange(n) * hop_length
+        idx = (starts[None, :] + jnp.arange(fl)[:, None]).reshape(-1)
+        flat = a.reshape(a.shape[:-2] + (fl * n,))
+        out = jnp.zeros(a.shape[:-2] + (t,), a.dtype)
+        out = out.at[..., idx].add(flat)
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+    return apply_op("overlap_add", impl, (x,), {})
+
+
+def _window_array(window, n_fft):
+    if window is None:
+        return jnp.ones((n_fft,), jnp.float32)
+    if isinstance(window, Tensor):
+        return window.data
+    return jnp.asarray(np.asarray(window), jnp.float32)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True):
+    """Short-time Fourier transform (reference signal.py:141). Input
+    [B, T] or [T]; output [B, n_fft//2+1, n_frames] complex (onesided)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _window_array(window, win_length)
+    if win_length < n_fft:  # center-pad window to n_fft
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+
+    def impl(a):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if center:
+            a = jnp.pad(a, ((0, 0), (n_fft // 2, n_fft // 2)),
+                        mode=pad_mode)
+        t = a.shape[-1]
+        n = 1 + (t - n_fft) // hop_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = a[:, idx] * w          # [B, n, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        out = jnp.swapaxes(spec, -2, -1)  # [B, freq, n]
+        return out[0] if squeeze else out
+
+    return apply_op("stft", impl, (x,), {})
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False):
+    """Inverse STFT with window-envelope normalization (reference
+    signal.py:334)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _window_array(window, win_length)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+
+    if return_complex and onesided:
+        raise ValueError("return_complex=True requires onesided=False "
+                         "(a onesided spectrum reconstructs a real signal)")
+
+    def impl(spec):
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        spec = jnp.swapaxes(spec, -2, -1)      # [B, n, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w                     # [B, n, n_fft]
+        n = frames.shape[1]
+        t = (n - 1) * hop_length + n_fft
+        starts = jnp.arange(n) * hop_length
+        idx = (starts[:, None] + jnp.arange(n_fft)[None, :]).reshape(-1)
+        sig = jnp.zeros((frames.shape[0], t), frames.dtype)
+        sig = sig.at[:, idx].add(frames.reshape(frames.shape[0], -1))
+        env = jnp.zeros((t,), frames.dtype).at[idx].add(
+            jnp.tile(w * w, n))
+        sig = sig / jnp.maximum(env, 1e-11)
+        if center:
+            sig = sig[:, n_fft // 2: t - n_fft // 2]
+        if length is not None:
+            sig = sig[:, :length]
+        return sig[0] if squeeze else sig
+
+    return apply_op("istft", impl, (x,), {})

@@ -1,0 +1,27 @@
+"""paddle.sparse parity surface (reference: python/paddle/sparse/ — COO/CSR
+tensors, unary/binary value ops, matmul/masked_matmul, softmax, sparse nn;
+SURVEY.md §2.10 'sparse' row)."""
+from .tensor import SparseCooTensor, SparseCsrTensor
+from .ops import (
+    sparse_coo_tensor, sparse_csr_tensor, to_sparse_coo, to_sparse_csr,
+    coalesce, coo_to_csr, csr_to_coo,
+    add, subtract, multiply, divide, matmul, masked_matmul, softmax,
+    attention, cast,
+    abs, sin, tan, asin, atan, sinh, tanh, asinh, atanh, sqrt, square,
+    log1p, expm1, relu, relu6, leaky_relu, neg, sign,
+)
+from . import nn
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "to_sparse_coo", "to_sparse_csr", "coalesce",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "softmax", "attention", "cast", "nn",
+    "abs", "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "expm1", "relu", "relu6", "leaky_relu",
+    "neg", "sign",
+]
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)

@@ -1,0 +1,122 @@
+"""paddle.sparse.nn layers (reference: python/paddle/sparse/nn/layer/ —
+activation, norm, conv; functional transformer attention)."""
+import numpy as np
+
+from ..nn.layer import Layer
+from . import ops
+from .tensor import SparseCooTensor
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return ops.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return ops.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return ops.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return ops.softmax(x, self._axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the dense feature dim of a COO tensor's values
+    (reference sparse/nn/layer/norm.py:34 — normalizes nnz x channels)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        super().__init__()
+        from ..nn.layers.norm import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon)
+
+    def forward(self, x):
+        return x.with_values(self._bn(x.values()))
+
+
+class SubmConv3D(Layer):
+    """Submanifold sparse 3D convolution over COO voxels (reference
+    sparse/nn/layer/conv.py SubmConv3D; kernels sparse/gpu/conv_kernel.cu).
+
+    TPU lowering: for each kernel offset, shift input coordinates, match
+    them against output coordinates (host-side structure hash — the
+    reference's rulebook), then gather-matmul-scatter the values. The
+    submanifold property (output structure == input structure) keeps the
+    rulebook static."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, bias_attr=None):
+        super().__init__()
+        from ..nn.initializer import XavierUniform
+        ks = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._ks = ks
+        self.weight = self.create_parameter(
+            shape=[int(np.prod(ks)), in_channels, out_channels],
+            default_initializer=XavierUniform())
+        self.bias = (self.create_parameter(shape=[out_channels],
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x: SparseCooTensor):
+        import jax.numpy as jnp
+        from ..core.dispatch import apply_op
+
+        idx = np.asarray(x.indices().numpy())  # [4, nnz]: b, z, y, x
+        spatial = idx[1:4]
+        nnz = idx.shape[1]
+        # rulebook: for each kernel offset, (in_pos, out_pos) pairs
+        coord_key = {}
+        for i in range(nnz):
+            coord_key[(idx[0, i], *spatial[:, i])] = i
+        offs = [(dz, dy, dx)
+                for dz in range(self._ks[0]) for dy in range(self._ks[1])
+                for dx in range(self._ks[2])]
+        center = tuple(k // 2 for k in self._ks)
+        pairs = []  # (tap, in_i, out_i)
+        for t, (dz, dy, dx) in enumerate(offs):
+            sz, sy, sx = dz - center[0], dy - center[1], dx - center[2]
+            for i in range(nnz):
+                src = (idx[0, i], idx[1, i] + sz, idx[2, i] + sy,
+                       idx[3, i] + sx)
+                j = coord_key.get(src)
+                if j is not None:
+                    pairs.append((t, j, i))
+        taps = np.array([p[0] for p in pairs], np.int32)
+        src_i = np.array([p[1] for p in pairs], np.int32)
+        dst_i = np.array([p[2] for p in pairs], np.int32)
+
+        w, b = self.weight, self.bias
+
+        def impl(values, weight, *maybe_bias):
+            gathered = jnp.take(values, src_i, axis=0)
+            wk = jnp.take(weight, taps, axis=0)  # [pairs, Cin, Cout]
+            contrib = jnp.einsum("pc,pcd->pd", gathered, wk)
+            out = jnp.zeros((nnz, weight.shape[-1]), contrib.dtype)
+            out = out.at[dst_i].add(contrib)
+            if maybe_bias:
+                out = out + maybe_bias[0]
+            return out
+
+        args = (x.values(), w) + ((b,) if b is not None else ())
+        vals = apply_op("sparse_subm_conv3d", impl, args, {})
+        return x.with_values(vals)
+
+
+class functional:
+    attention = staticmethod(ops.attention)

@@ -1,0 +1,452 @@
+"""Sparse functional ops.
+
+Reference: paddle/phi/kernels/sparse/ (~22k LoC CUDA/C++, SURVEY.md §2.8
+layer row) + python/paddle/sparse/{unary,binary,multiary}.py.
+
+Every op is a composition of gather / scatter-add / segment reductions on
+the static-nnz value arrays — the XLA-friendly lowering of what the
+reference does with hand-written CUDA kernels. Autograd rides the normal
+dispatch tape through the `values` leaves."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor, to_tensor
+from .tensor import SparseCooTensor, SparseCsrTensor, _csr_row_ids
+
+
+# ---------------------------------------------------------------------------
+# creation / conversion
+# ---------------------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """paddle.sparse.sparse_coo_tensor (python/paddle/sparse/creation.py)."""
+    idx = np.asarray(indices.numpy() if isinstance(indices, Tensor)
+                     else indices)
+    vals = values if isinstance(values, Tensor) else to_tensor(
+        np.asarray(values), dtype=dtype)
+    if shape is None:
+        sparse_shape = tuple(int(m) + 1 for m in idx.max(axis=1)) \
+            if idx.size else (0,) * idx.shape[0]
+        shape = sparse_shape + tuple(vals.shape[1:])
+    t = SparseCooTensor(idx, vals, shape)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    vals = values if isinstance(values, Tensor) else to_tensor(
+        np.asarray(values), dtype=dtype)
+    t = SparseCsrTensor(crows, cols, vals, shape)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def coo_to_dense(sp):
+    """SparseCooTensor -> dense Tensor (scatter-add; duplicate coordinates
+    accumulate, matching coalesce-on-read semantics)."""
+    idx = sp.indices().data
+    shape = tuple(sp.shape)
+
+    def impl(values):
+        out = jnp.zeros(shape, dtype=values.dtype)
+        return out.at[tuple(idx)].add(values)
+
+    return apply_op("sparse_coo_to_dense", impl, (sp.values(),), {})
+
+
+def _batch_csr_layout(sp):
+    """Host-side structure decode for batched 3D CSR: per-batch nnz comes
+    from each batch's last crows entry (batches may have different nnz)."""
+    b, r, _ = sp.shape
+    crows_np = np.asarray(sp.crows().numpy()).reshape(b, r + 1)
+    nnz_per = crows_np[:, -1].astype(np.int64)
+    offsets = np.concatenate([[0], np.cumsum(nnz_per)])
+    return crows_np, nnz_per, offsets
+
+
+def csr_to_dense(sp):
+    crows, cols = sp.crows().data, sp.cols().data
+    shape = tuple(sp.shape)
+    if len(shape) == 3:
+        crows_np, nnz_per, offsets = _batch_csr_layout(sp)
+
+    def impl(values):
+        if len(shape) == 2:
+            rows = _csr_row_ids(crows, values.shape[0])
+            out = jnp.zeros(shape, dtype=values.dtype)
+            return out.at[rows, cols].add(values)
+        b, r, c = shape
+        out = jnp.zeros(shape, dtype=values.dtype)
+        for i in range(b):  # batched CSR shares the layout machinery
+            seg = slice(int(offsets[i]), int(offsets[i + 1]))
+            rows = _csr_row_ids(jnp.asarray(crows_np[i]), int(nnz_per[i]))
+            out = out.at[i, rows, cols[seg]].add(values[seg])
+        return out
+
+    return apply_op("sparse_csr_to_dense", impl, (sp.values(),), {})
+
+
+def csr_to_coo(sp):
+    shape = tuple(sp.shape)
+    crows, cols = sp.crows().data, sp.cols().data
+    if len(shape) == 2:
+        rows = _csr_row_ids(crows, sp.nnz)
+        indices = jnp.stack([rows, cols])
+    else:
+        b = shape[0]
+        crows_np, nnz_per, offsets = _batch_csr_layout(sp)
+        parts = []
+        for i in range(b):
+            n_i = int(nnz_per[i])
+            rows = _csr_row_ids(jnp.asarray(crows_np[i]), n_i)
+            batch = jnp.full((n_i,), i, dtype=jnp.int32)
+            parts.append(jnp.stack(
+                [batch, rows, cols[int(offsets[i]):int(offsets[i + 1])]]))
+        indices = jnp.concatenate(parts, axis=1)
+    return SparseCooTensor(to_tensor(np.asarray(indices)), sp.values(), shape)
+
+
+def coo_to_csr(sp):
+    """2D COO -> CSR. Sorts by (row, col) — host-side structure op, like the
+    reference's conversion kernel; values are gathered differentiably."""
+    if sp.sparse_dim != 2 or sp.dense_dim != 0:
+        raise ValueError("coo_to_csr supports 2D matrices")
+    idx = np.asarray(sp.indices().numpy())
+    order = np.lexsort((idx[1], idx[0]))
+    rows, cols = idx[0][order], idx[1][order]
+    nrows = sp.shape[0]
+    crows = np.zeros(nrows + 1, dtype=np.int32)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows).astype(np.int32)
+    perm = jnp.asarray(order)
+
+    def impl(values):
+        return jnp.take(values, perm, axis=0)
+
+    vals = apply_op("sparse_coo_to_csr_values", impl, (sp.values(),), {})
+    return SparseCsrTensor(crows, cols, vals, sp.shape)
+
+
+def to_sparse_coo(dense, sparse_dim=None):
+    """Dense -> COO. The mask (structure) is data-dependent, so this is an
+    eager/host boundary op — inside jit, keep tensors dense or carry a
+    static mask (reference: DenseToCoo kernel)."""
+    x = np.asarray(dense.numpy() if isinstance(dense, Tensor) else dense)
+    sparse_dim = sparse_dim or x.ndim
+    flat = x.reshape(x.shape[:sparse_dim] + (-1,))
+    mask = np.abs(flat).sum(axis=-1) != 0 if flat.shape[-1] > 1 \
+        else (flat[..., 0] != 0)
+    idx = np.stack(np.nonzero(mask)).astype(np.int32)
+    gather = tuple(idx)
+
+    def impl(d):
+        f = d.reshape(x.shape[:sparse_dim] + x.shape[sparse_dim:])
+        return f[gather]
+
+    vals = apply_op("dense_to_sparse_coo", impl,
+                    (dense if isinstance(dense, Tensor) else to_tensor(x),),
+                    {})
+    return SparseCooTensor(idx, vals, x.shape)
+
+
+def to_sparse_csr(dense):
+    return coo_to_csr(to_sparse_coo(dense, sparse_dim=2))
+
+
+def coalesce(sp):
+    """Merge duplicate coordinates (reference: CoalesceKernel). Structure is
+    host-side; value accumulation is differentiable segment_sum."""
+    idx = np.asarray(sp.indices().numpy())
+    flat = np.ravel_multi_index(idx, tuple(sp.shape[:sp.sparse_dim]))
+    uniq, inverse = np.unique(flat, return_inverse=True)
+    new_idx = np.stack(np.unravel_index(
+        uniq, tuple(sp.shape[:sp.sparse_dim]))).astype(np.int32)
+    seg = jnp.asarray(inverse.astype(np.int32))
+    n = len(uniq)
+
+    def impl(values):
+        return jax.ops.segment_sum(values, seg, num_segments=n)
+
+    vals = apply_op("sparse_coalesce", impl, (sp.values(),), {})
+    return SparseCooTensor(new_idx, vals, sp.shape, coalesced=True)
+
+
+# ---------------------------------------------------------------------------
+# elementwise
+# ---------------------------------------------------------------------------
+
+_UNARY = ["abs", "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh",
+          "atanh", "sqrt", "square", "log1p", "expm1", "relu", "neg",
+          "sign", "leaky_relu", "relu6"]
+
+
+def _unary_impl(name):
+    fns = {"relu": jax.nn.relu, "relu6": jax.nn.relu6, "neg": jnp.negative,
+           "leaky_relu": jax.nn.leaky_relu, "square": jnp.square}
+    return fns.get(name) or getattr(jnp, name)
+
+
+def _make_unary(name):
+    impl = _unary_impl(name)
+
+    def op(sp, *args, **kwargs):
+        if not (getattr(sp, "is_sparse_coo", False)
+                or getattr(sp, "is_sparse_csr", False)):
+            raise TypeError(f"sparse.{name} expects a sparse tensor")
+
+        def val_impl(values):
+            return impl(values, *args, **kwargs)
+
+        vals = apply_op(f"sparse_{name}", val_impl, (sp.values(),), {})
+        return sp.with_values(vals)
+
+    op.__name__ = name
+    op.__doc__ = (f"Elementwise {name} on the stored values (zero-preserving"
+                  f" ops only — reference python/paddle/sparse/unary.py).")
+    return op
+
+
+for _n in _UNARY:
+    globals()[_n] = _make_unary(_n)
+
+
+def cast(sp, index_dtype=None, value_dtype=None):
+    vals = sp.values().astype(value_dtype) if value_dtype else sp.values()
+    if index_dtype is None:
+        return sp.with_values(vals)
+    if getattr(sp, "is_sparse_csr", False):
+        return SparseCsrTensor(sp.crows().astype(index_dtype),
+                               sp.cols().astype(index_dtype), vals, sp.shape)
+    return SparseCooTensor(sp.indices().astype(index_dtype), vals, sp.shape)
+
+
+def _binary(name, fn, x, y):
+    """Sparse-sparse elementwise op. Fast path: identical structure —
+    operate on values directly. Otherwise union the structures (host-side)
+    and combine gathered values."""
+    if getattr(x, "is_sparse_csr", False):
+        if not getattr(y, "is_sparse_csr", False):
+            raise TypeError("both operands must be CSR")
+        same = (np.array_equal(np.asarray(x.crows().numpy()),
+                               np.asarray(y.crows().numpy()))
+                and np.array_equal(np.asarray(x.cols().numpy()),
+                                   np.asarray(y.cols().numpy())))
+        if same:
+            vals = apply_op(f"sparse_{name}", fn, (x.values(), y.values()),
+                            {})
+            return x.with_values(vals)
+        return coo_to_csr(_binary(name, fn, csr_to_coo(x), csr_to_coo(y)))
+
+    if not getattr(y, "is_sparse_coo", False):
+        raise TypeError("both operands must be sparse COO")
+    xi = np.asarray(x.indices().numpy())
+    yi = np.asarray(y.indices().numpy())
+    if xi.shape == yi.shape and np.array_equal(xi, yi):
+        vals = apply_op(f"sparse_{name}", fn, (x.values(), y.values()), {})
+        return x.with_values(vals)
+    # structure union: gather each side's values into the union layout
+    sparse_shape = tuple(x.shape[:x.sparse_dim])
+    xf = np.ravel_multi_index(xi, sparse_shape)
+    yf = np.ravel_multi_index(yi, sparse_shape)
+    uniq = np.unique(np.concatenate([xf, yf]))
+    pos_x = jnp.asarray(np.searchsorted(uniq, xf).astype(np.int32))
+    pos_y = jnp.asarray(np.searchsorted(uniq, yf).astype(np.int32))
+    n = len(uniq)
+    new_idx = np.stack(np.unravel_index(uniq, sparse_shape)).astype(np.int32)
+    dense_shape = tuple(x.values().shape[1:])
+
+    def impl(xv, yv):
+        xa = jnp.zeros((n,) + dense_shape, xv.dtype).at[pos_x].add(xv)
+        ya = jnp.zeros((n,) + dense_shape, yv.dtype).at[pos_y].add(yv)
+        return fn(xa, ya)
+
+    vals = apply_op(f"sparse_{name}", impl, (x.values(), y.values()), {})
+    return SparseCooTensor(new_idx, vals, x.shape)
+
+
+def add(x, y):
+    return _binary("add", jnp.add, x, y)
+
+
+def subtract(x, y):
+    return _binary("subtract", jnp.subtract, x, y)
+
+
+def multiply(x, y):
+    if isinstance(y, (int, float)):
+        return x.with_values(x.values() * y)
+    return _binary("multiply", jnp.multiply, x, y)
+
+
+def divide(x, y):
+    if isinstance(y, (int, float)):
+        return x.with_values(x.values() / y)
+    return _binary("divide", jnp.divide, x, y)
+
+
+# ---------------------------------------------------------------------------
+# matmul family
+# ---------------------------------------------------------------------------
+
+def matmul(sp, dense):
+    """sparse [M,K] @ dense [K,N] -> dense [M,N] (reference:
+    paddle/phi/kernels/sparse/gpu/matmul_kernel.cu over cuSPARSE).
+    Lowering: gather dense rows at cols, scale by values, scatter-add into
+    output rows — one fused gather-matmul XLA graph."""
+    if getattr(sp, "is_sparse_csr", False):
+        crows, cols = sp.crows().data, sp.cols().data
+        m = sp.shape[0]
+        rows_fn = lambda nnz: _csr_row_ids(crows, nnz)  # noqa: E731
+        cols_arr = cols
+    elif getattr(sp, "is_sparse_coo", False):
+        if sp.sparse_dim != 2:
+            raise ValueError("matmul supports 2D sparse matrices")
+        idx = sp.indices().data
+        m = sp.shape[0]
+        rows_fn = lambda nnz: idx[0]  # noqa: E731
+        cols_arr = idx[1]
+    else:
+        raise TypeError("matmul expects a sparse lhs")
+
+    def impl(values, d):
+        rows = rows_fn(values.shape[0])
+        contrib = values[:, None] * jnp.take(d, cols_arr, axis=0)
+        out = jnp.zeros((m, d.shape[1]), contrib.dtype)
+        return out.at[rows].add(contrib)
+
+    return apply_op("sparse_matmul", impl, (sp.values(), dense), {})
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense sampled at a sparse mask's coordinates (reference:
+    sparse/gpu/masked_matmul_kernel.cu, the SDDMM primitive). Returns a
+    sparse tensor with the mask's structure."""
+    if getattr(mask, "is_sparse_csr", False):
+        crows, cols = mask.crows().data, mask.cols().data
+        nnz = mask.nnz
+        rows = _csr_row_ids(crows, nnz)
+        make = lambda v: SparseCsrTensor(mask.crows(), mask.cols(), v,  # noqa: E731
+                                         mask.shape)
+    else:
+        idx = mask.indices().data
+        rows, cols = idx[0], idx[1]
+        make = lambda v: SparseCooTensor(mask.indices(), v, mask.shape)  # noqa: E731
+
+    def impl(a, b):
+        return jnp.einsum("nk,nk->n", jnp.take(a, rows, axis=0),
+                          jnp.take(b.T, cols, axis=0),
+                          preferred_element_type=jnp.float32).astype(a.dtype)
+
+    vals = apply_op("sparse_masked_matmul", impl, (x, y), {})
+    return make(vals)
+
+
+def softmax(sp, axis=-1):
+    """Row-wise softmax over stored values (reference:
+    sparse/gpu/softmax_kernel.cu — only last-axis supported)."""
+    if axis not in (-1, len(sp.shape) - 1):
+        raise ValueError("sparse softmax supports the last axis only")
+    if getattr(sp, "is_sparse_csr", False):
+        crows = sp.crows().data
+        shape = tuple(sp.shape)
+        if len(shape) == 2:
+            nseg = shape[0]
+            seg_of = lambda nnz: _csr_row_ids(crows, nnz)  # noqa: E731
+        else:
+            b, r, _ = shape
+            nseg = b * r
+            crows_np, nnz_per, offsets = _batch_csr_layout(sp)
+
+            def seg_of(nnz):
+                segs = []
+                for i in range(b):
+                    ids = _csr_row_ids(jnp.asarray(crows_np[i]),
+                                       int(nnz_per[i]))
+                    segs.append(ids + i * r)
+                return jnp.concatenate(segs)
+    else:
+        idx = sp.indices().data
+        sparse_shape = tuple(sp.shape[:sp.sparse_dim])
+        nseg = int(np.prod(sparse_shape[:-1]))
+        mult = np.concatenate([
+            (np.cumprod(sparse_shape[:-1][::-1])[::-1][1:]), [1]]).astype(
+                np.int32) if len(sparse_shape) > 2 else np.array(
+                    [1], dtype=np.int32)
+
+        def seg_of(nnz):
+            seg = jnp.zeros((nnz,), jnp.int32)
+            for d in range(sp.sparse_dim - 1):
+                seg = seg + idx[d] * int(mult[d])
+            return seg
+
+    def impl(values):
+        seg = seg_of(values.shape[0])
+        v32 = values.astype(jnp.float32)
+        mx = jax.ops.segment_max(v32, seg, num_segments=nseg)
+        ex = jnp.exp(v32 - jnp.take(mx, seg))
+        den = jax.ops.segment_sum(ex, seg, num_segments=nseg)
+        return (ex / jnp.take(den, seg)).astype(values.dtype)
+
+    return sp.with_values(apply_op("sparse_softmax", impl, (sp.values(),),
+                                   {}))
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None):
+    """Sparse attention: softmax((QK^T)∘mask + biases)·V where the mask is
+    a 2D CSR structure shared across batch and heads (reference:
+    python/paddle/sparse/nn/functional/transformer.py:26 sparse attention,
+    kernels sparse/gpu/fused_attention_kernel.cu). Layout [B, H, S, D];
+    key_padding_mask [B, S] and attn_mask [S, S] are additive biases
+    (-inf to exclude), gathered at the nnz coordinates."""
+    crows, cols = sparse_mask.crows().data, sparse_mask.cols().data
+    nnz = sparse_mask.nnz
+    s = int(sparse_mask.shape[-2])
+    extra = tuple(t for t in (key_padding_mask, attn_mask) if t is not None)
+
+    def impl(q, k, v, *masks):
+        rows = _csr_row_ids(crows[-(s + 1):], nnz)
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        mi = iter(masks)
+        kp = next(mi) if key_padding_mask is not None else None
+        am = next(mi) if attn_mask is not None else None
+        # per-(batch) additive bias at nnz positions
+        bias_b = (jnp.take(kp, cols, axis=1).astype(jnp.float32)
+                  if kp is not None else None)            # [B, nnz]
+        bias_s = (am[rows, cols].astype(jnp.float32)
+                  if am is not None else None)            # [nnz]
+
+        def one_head(qh, kh, vh, bias):
+            logits = jnp.einsum(
+                "nd,nd->n", jnp.take(qh, rows, axis=0),
+                jnp.take(kh, cols, axis=0),
+                preferred_element_type=jnp.float32) * scale
+            if bias is not None:
+                logits = logits + bias
+            mx = jax.ops.segment_max(logits, rows, num_segments=s)
+            ex = jnp.exp(logits - jnp.take(mx, rows))
+            den = jax.ops.segment_sum(ex, rows, num_segments=s)
+            p = ex / jnp.maximum(jnp.take(den, rows), 1e-30)
+            ctx = jax.ops.segment_sum(
+                p[:, None] * jnp.take(vh, cols, axis=0).astype(jnp.float32),
+                rows, num_segments=s)
+            return ctx.astype(qh.dtype)
+
+        def one_batch(qb, kb, vb, bb):
+            bias = bb
+            if bias_s is not None:
+                bias = bias_s if bias is None else bias + bias_s
+            return jax.vmap(lambda qh, kh, vh: one_head(qh, kh, vh, bias))(
+                qb, kb, vb)
+
+        if bias_b is not None:
+            return jax.vmap(one_batch)(q, k, v, bias_b)
+        return jax.vmap(lambda qb, kb, vb: one_batch(qb, kb, vb, None))(
+            q, k, v)
+
+    return apply_op("sparse_attention", impl,
+                    (query, key, value) + extra, {})

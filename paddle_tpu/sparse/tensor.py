@@ -1,0 +1,196 @@
+"""Sparse tensor types.
+
+Reference: paddle/phi/core/sparse_coo_tensor.h / sparse_csr_tensor.h and the
+python surface python/paddle/sparse/ (~5.6k LoC, SURVEY.md §2.10).
+
+TPU-native design: a sparse tensor is a struct of dense jax arrays —
+COO: indices [sparse_dim, nnz] + values [nnz, *dense_shape];
+CSR: crows [nrows+1] + cols [nnz] + values [nnz] — with STATIC nnz, so
+every sparse op lowers to gather/scatter/segment primitives XLA can tile
+(no dynamic shapes on the MXU path). Gradients flow through `values` only,
+exactly the reference's semantics (indices are structure, not data)."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+
+
+def _as_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        if dtype and not jnp.issubdtype(x.data.dtype, jnp.integer):
+            return x.astype(dtype)
+        return x
+    arr = np.asarray(x)
+    if dtype and not np.issubdtype(arr.dtype, np.integer):
+        return to_tensor(arr, dtype=dtype)
+    return to_tensor(arr)
+
+
+class SparseCooTensor:
+    """Coordinate-format sparse tensor (sparse_coo_tensor.h:30 analogue)."""
+
+    is_sparse_coo = True
+    is_sparse_csr = False
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self._indices = _as_tensor(indices, dtype="int32")
+        self._values = values if isinstance(values, Tensor) else to_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = coalesced
+        if self._indices.ndim != 2:
+            raise ValueError("indices must be [sparse_dim, nnz]")
+        if self._indices.shape[1] != self._values.shape[0]:
+            raise ValueError(
+                f"nnz mismatch: indices {self._indices.shape} vs values "
+                f"{self._values.shape}")
+
+    # -- paddle Tensor-surface parity ------------------------------------
+    def indices(self):
+        return self._indices
+
+    def values(self):
+        return self._values
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self):
+        return self._values.shape[0]
+
+    @property
+    def sparse_dim(self):
+        return self._indices.shape[0]
+
+    @property
+    def dense_dim(self):
+        return len(self._shape) - self.sparse_dim
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+    def backward(self, *a, **k):
+        return self._values.backward(*a, **k)
+
+    def with_values(self, values):
+        return SparseCooTensor(self._indices, values, self._shape,
+                               self._coalesced)
+
+    def to_dense(self):
+        from .ops import coo_to_dense
+        return coo_to_dense(self)
+
+    def coalesce(self):
+        from .ops import coalesce
+        return coalesce(self)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+    def __add__(self, other):
+        from .ops import add
+        return add(self, other)
+
+    def __mul__(self, other):
+        from .ops import multiply
+        return multiply(self, other)
+
+    def __sub__(self, other):
+        from .ops import subtract
+        return subtract(self, other)
+
+    def __matmul__(self, other):
+        from .ops import matmul
+        return matmul(self, other)
+
+
+class SparseCsrTensor:
+    """Compressed-sparse-row tensor (sparse_csr_tensor.h:30 analogue).
+    2D [rows, cols] or batched 3D [batch, rows, cols] with one shared
+    structure per batch element (crows [B*(R+1)] flattened, as the
+    reference stores it)."""
+
+    is_sparse_coo = False
+    is_sparse_csr = True
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = _as_tensor(crows, dtype="int32")
+        self._cols = _as_tensor(cols, dtype="int32")
+        self._values = values if isinstance(values, Tensor) else to_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        if len(self._shape) not in (2, 3):
+            raise ValueError("CSR supports 2D or batched 3D shapes")
+
+    def crows(self):
+        return self._crows
+
+    def cols(self):
+        return self._cols
+
+    def values(self):
+        return self._values
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self):
+        return self._values.shape[0]
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+    def backward(self, *a, **k):
+        return self._values.backward(*a, **k)
+
+    def with_values(self, values):
+        return SparseCsrTensor(self._crows, self._cols, values, self._shape)
+
+    def to_dense(self):
+        from .ops import csr_to_dense
+        return csr_to_dense(self)
+
+    def to_sparse_coo(self, sparse_dim=2):
+        from .ops import csr_to_coo
+        return csr_to_coo(self)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def _csr_row_ids(crows, nnz):
+    """Expand crows [R+1] into per-entry row ids with a static output size:
+    row_ids[i] = #{r : crows[r+1] <= i} (searchsorted keeps it XLA-static,
+    where the reference's CUDA kernel walks the row pointer)."""
+    return jnp.searchsorted(crows[1:], jnp.arange(nnz),
+                            side="right").astype(jnp.int32)

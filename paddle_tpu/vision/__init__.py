@@ -2,4 +2,5 @@
 from . import datasets
 from . import transforms
 from . import models
+from . import ops
 from .models import LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, MobileNetV1, AlexNet, VGG

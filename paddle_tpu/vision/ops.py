@@ -1,0 +1,292 @@
+"""paddle.vision.ops parity (reference: python/paddle/vision/ops.py —
+roi_align/roi_pool/nms/deform_conv2d/box utils over phi CUDA kernels
+paddle/phi/kernels/gpu/{roi_align,roi_pool,nms,deformable_conv}_kernel.cu).
+
+TPU lowering: RoI ops are bilinear gathers over a static sampling grid;
+deformable conv is a gather-matmul; NMS keeps the O(n^2) IoU matrix dense
+(fine for the post-top-k candidate counts it is used with) and runs the
+greedy suppression as a lax scan — all static shapes."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+__all__ = ["roi_align", "roi_pool", "nms", "deform_conv2d", "box_iou",
+           "DeformConv2D"]
+
+
+def _bilinear(feat, y, x):
+    """Sample feat [C, H, W] at float coords y/x [...], zero-padded."""
+    h, w = feat.shape[-2], feat.shape[-1]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1, wx1 = y - y0, x - x0
+    wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+
+    def tap(yi, xi, wgt):
+        inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        v = feat[:, yc, xc]            # [C, ...]
+        return v * (wgt * inb)[None]
+
+    return (tap(y0, x0, wy0 * wx0) + tap(y0, x0 + 1, wy0 * wx1)
+            + tap(y0 + 1, x0, wy1 * wx0) + tap(y0 + 1, x0 + 1, wy1 * wx1))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign (reference ops.py roi_align / roi_align_kernel.cu). x is
+    [N, C, H, W]; boxes [R, 4] (x1,y1,x2,y2) with boxes_num per image."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    nums = np.asarray(boxes_num.numpy() if isinstance(boxes_num, Tensor)
+                      else boxes_num).astype(np.int64)
+    batch_of = np.repeat(np.arange(len(nums)), nums).astype(np.int32)
+    if sampling_ratio > 0:
+        sr = sampling_ratio
+    else:
+        # reference: adaptive ceil(roi_size / output_size) per RoI; the
+        # static-shape lowering uses the max over the (eager) boxes so no
+        # bin is undersampled — under jit boxes are tracers, fall back to 2
+        sr = 2
+        try:
+            bx_np = np.asarray(boxes.numpy() if isinstance(boxes, Tensor)
+                               else boxes) * spatial_scale
+            if len(bx_np):
+                rh = np.maximum(bx_np[:, 3] - bx_np[:, 1], 1e-3)
+                rw = np.maximum(bx_np[:, 2] - bx_np[:, 0], 1e-3)
+                sr = int(min(8, max(1, np.ceil(
+                    max((rh / ph).max(), (rw / pw).max())))))
+        except Exception:
+            pass
+
+    def impl(feat, bx):
+        off = 0.5 if aligned else 0.0
+
+        def one_roi(b_idx, box):
+            x1, y1, x2, y2 = (box * spatial_scale) - off
+            rh = jnp.maximum(y2 - y1, 1e-6) if aligned else jnp.maximum(
+                y2 - y1, 1.0)
+            rw = jnp.maximum(x2 - x1, 1e-6) if aligned else jnp.maximum(
+                x2 - x1, 1.0)
+            bin_h, bin_w = rh / ph, rw / pw
+            gy = (y1 + bin_h * (jnp.arange(ph)[:, None]
+                                + (jnp.arange(sr)[None, :] + 0.5) / sr)
+                  ).reshape(-1)                       # [ph*sr]
+            gx = (x1 + bin_w * (jnp.arange(pw)[:, None]
+                                + (jnp.arange(sr)[None, :] + 0.5) / sr)
+                  ).reshape(-1)                       # [pw*sr]
+            yy = jnp.repeat(gy, pw * sr)
+            xx = jnp.tile(gx, ph * sr)
+            samp = _bilinear(feat[b_idx], yy, xx)     # [C, ph*sr*pw*sr]
+            samp = samp.reshape(feat.shape[1], ph, sr, pw, sr)
+            return samp.mean(axis=(2, 4))             # [C, ph, pw]
+
+        return jax.vmap(one_roi)(jnp.asarray(batch_of), bx)
+
+    return apply_op("roi_align", impl, (x, boxes), {})
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """RoIPool: exact integer-pixel max per quantized bin (reference
+    roi_pool_kernel.cu semantics). Static lowering: every feature pixel is
+    assigned its (bin_y, bin_x) and scatter-maxed into the [ph, pw] output
+    — O(H·W) per RoI, all static shapes."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    nums = np.asarray(boxes_num.numpy() if isinstance(boxes_num, Tensor)
+                      else boxes_num).astype(np.int64)
+    batch_of = np.repeat(np.arange(len(nums)), nums).astype(np.int32)
+
+    def impl(feat, bx):
+        h, w = feat.shape[-2], feat.shape[-1]
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+
+        def one_roi(b_idx, box):
+            x1, y1, x2, y2 = jnp.round(box * spatial_scale)
+            rh = jnp.maximum(y2 - y1 + 1, 1.0)
+            rw = jnp.maximum(x2 - x1 + 1, 1.0)
+            by = jnp.clip(jnp.floor((ys - y1) * ph / rh), 0, ph - 1)
+            bxx = jnp.clip(jnp.floor((xs - x1) * pw / rw), 0, pw - 1)
+            valid_y = (ys >= y1) & (ys <= y2)
+            valid_x = (xs >= x1) & (xs <= x2)
+            img = feat[b_idx]                       # [C, H, W]
+            neg = jnp.finfo(img.dtype).min
+            masked = jnp.where(valid_y[None, :, None]
+                               & valid_x[None, None, :], img, neg)
+            byg = jnp.broadcast_to(by[:, None].astype(jnp.int32), (h, w))
+            bxg = jnp.broadcast_to(bxx[None, :].astype(jnp.int32), (h, w))
+            out = jnp.full((img.shape[0], ph, pw), neg, img.dtype)
+            out = out.at[:, byg, bxg].max(masked)
+            return jnp.where(out == neg, 0.0, out)
+
+        return jax.vmap(one_roi)(jnp.asarray(batch_of), bx)
+
+    return apply_op("roi_pool", impl, (x, boxes), {})
+
+
+def box_iou(a, b):
+    """Pairwise IoU [Ra, Rb] (xyxy)."""
+    def impl(pa, pb):
+        lt = jnp.maximum(pa[:, None, :2], pb[None, :, :2])
+        rb = jnp.minimum(pa[:, None, 2:], pb[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        area_a = ((pa[:, 2] - pa[:, 0]) * (pa[:, 3] - pa[:, 1]))[:, None]
+        area_b = ((pb[:, 2] - pb[:, 0]) * (pb[:, 3] - pb[:, 1]))[None, :]
+        return inter / jnp.maximum(area_a + area_b - inter, 1e-9)
+
+    return apply_op("box_iou", impl, (a, b), {}, differentiable=False)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS (reference ops.py nms / nms_kernel.cu). Static-shape
+    suppression runs as a lax.scan over score order; the variable-length
+    index list materializes at the eager boundary (like the reference's
+    dynamic output)."""
+    n = boxes.shape[0]
+
+    def impl(bx, sc, cat_off):
+        order = jnp.argsort(-sc)
+        iou = _iou_mat(bx + cat_off[:, None])
+        iou_o = iou[order][:, order]
+
+        def step(keep, i):
+            # suppressed iff any higher-scoring kept box overlaps too much
+            sup = jnp.any(jnp.where(jnp.arange(n) < i,
+                                    (iou_o[i] > iou_threshold) & keep,
+                                    False))
+            k = jnp.logical_not(sup)
+            return keep.at[i].set(k), k
+
+        keep0 = jnp.zeros((n,), bool)
+        keep, _ = jax.lax.scan(step, keep0, jnp.arange(n))
+        mask = jnp.zeros((n,), bool).at[order].set(keep)
+        return mask
+
+    def _iou_mat(pa):
+        lt = jnp.maximum(pa[:, None, :2], pa[None, :, :2])
+        rb = jnp.minimum(pa[:, None, 2:], pa[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        area = (pa[:, 2] - pa[:, 0]) * (pa[:, 3] - pa[:, 1])
+        return inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                                   1e-9)
+
+    if scores is None:
+        sc = Tensor(jnp.arange(n, 0, -1, dtype=jnp.float32))
+    else:
+        sc = scores
+    if category_idxs is not None:
+        # offset boxes per category so cross-category IoU is 0 (batched NMS)
+        spread = 1e4
+        cat_off = category_idxs.astype("float32") * spread
+    else:
+        from ..core.tensor import to_tensor
+        cat_off = to_tensor(np.zeros(n, np.float32))
+
+    mask = apply_op("nms", impl, (boxes, sc, cat_off), {},
+                    differentiable=False)
+    keep_idx = np.nonzero(np.asarray(mask.numpy()))[0]
+    order = np.argsort(-np.asarray(sc.numpy())[keep_idx], kind="stable")
+    keep_idx = keep_idx[order]
+    if top_k is not None:
+        keep_idx = keep_idx[:top_k]
+    from ..core.tensor import to_tensor
+    return to_tensor(keep_idx.astype(np.int64))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable conv v1/v2 (reference ops.py deform_conv2d /
+    deformable_conv_kernel.cu). Gather-based: build the offset sampling
+    grid, bilinear-sample input per kernel tap, contract with the weight
+    on the MXU."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError("groups==1 supported")
+
+    def impl(inp, off, w, *rest):
+        m = rest[0] if (mask is not None) else None
+        b = rest[-1] if (bias is not None) else None
+        n, c, h, ww = inp.shape
+        co, ci, kh, kw = w.shape
+        oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        ow = (ww + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        # base grid per output position and tap
+        oy = jnp.arange(oh) * s[0] - p[0]
+        ox = jnp.arange(ow) * s[1] - p[1]
+        ky = jnp.arange(kh) * d[0]
+        kx = jnp.arange(kw) * d[1]
+        base_y = oy[:, None, None, None] + ky[None, None, :, None]
+        base_x = ox[None, :, None, None] + kx[None, None, None, :]
+        # offset: [N, 2*kh*kw, oh, ow] (y then x per tap, reference layout)
+        off = off.reshape(n, kh * kw, 2, oh, ow)
+        off_y = jnp.transpose(off[:, :, 0], (0, 2, 3, 1)).reshape(
+            n, oh, ow, kh, kw)
+        off_x = jnp.transpose(off[:, :, 1], (0, 2, 3, 1)).reshape(
+            n, oh, ow, kh, kw)
+        yy = base_y[None] + off_y
+        xx = base_x[None] + off_x
+
+        def one(img, ys, xs):
+            samp = _bilinear(img, ys.reshape(-1), xs.reshape(-1))
+            return samp.reshape(c, oh, ow, kh, kw)
+
+        sampled = jax.vmap(one)(inp, yy, xx)   # [N, C, oh, ow, kh, kw]
+        if m is not None:
+            mm = jnp.transpose(m.reshape(n, kh * kw, oh, ow),
+                               (0, 2, 3, 1)).reshape(n, oh, ow, kh, kw)
+            sampled = sampled * mm[:, None]
+        out = jnp.einsum("nchwyx,ocyx->nohw", sampled, w,
+                         preferred_element_type=jnp.float32).astype(
+                             inp.dtype)
+        if b is not None:
+            out = out + b[None, :, None, None]
+        return out
+
+    args = (x, offset, weight)
+    if mask is not None:
+        args = args + (mask,)
+    if bias is not None:
+        args = args + (bias,)
+    return apply_op("deform_conv2d", impl, args, {})
+
+
+class DeformConv2D:
+    """Layer wrapper (reference python/paddle/vision/ops.py DeformConv2D)."""
+
+    def __new__(cls, in_channels, out_channels, kernel_size, stride=1,
+                padding=0, dilation=1, deformable_groups=1, groups=1,
+                weight_attr=None, bias_attr=None):
+        from ..nn.layer import Layer
+        from ..nn.initializer import XavierUniform
+
+        ks = (kernel_size,) * 2 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+
+        class _DeformConv2D(Layer):
+            def __init__(self):
+                super().__init__()
+                self.weight = self.create_parameter(
+                    shape=[out_channels, in_channels // groups, *ks],
+                    default_initializer=XavierUniform())
+                self.bias = (None if bias_attr is False else
+                             self.create_parameter(shape=[out_channels],
+                                                   is_bias=True))
+
+            def forward(self, x, offset, mask=None):
+                return deform_conv2d(x, offset, self.weight, self.bias,
+                                     stride, padding, dilation,
+                                     deformable_groups, groups, mask)
+
+        return _DeformConv2D()

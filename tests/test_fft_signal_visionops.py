@@ -1,0 +1,218 @@
+"""fft / signal / vision.ops tests (reference: test/legacy_test
+test_fft.py, test_stft_op.py, test_roi_align_op.py, test_nms_op.py,
+test_deform_conv2d.py — numpy-reference comparisons)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+class TestFFT:
+    def test_fft_ifft_roundtrip(self):
+        x = np.random.default_rng(0).standard_normal(32).astype(np.float32)
+        t = paddle.to_tensor(x)
+        f = paddle.fft.fft(t)
+        np.testing.assert_allclose(f.numpy(), np.fft.fft(x), rtol=1e-4,
+                                   atol=1e-4)
+        back = paddle.fft.ifft(f)
+        np.testing.assert_allclose(back.numpy().real, x, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_rfft_matches_numpy(self):
+        x = np.random.default_rng(1).standard_normal((4, 16)).astype(
+            np.float32)
+        out = paddle.fft.rfft(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+
+    def test_fft2_and_norms(self):
+        x = np.random.default_rng(2).standard_normal((8, 8)).astype(
+            np.float32)
+        for norm in ["backward", "ortho", "forward"]:
+            out = paddle.fft.fft2(paddle.to_tensor(x), norm=norm).numpy()
+            np.testing.assert_allclose(out, np.fft.fft2(x, norm=norm),
+                                       rtol=1e-4, atol=1e-4)
+        with pytest.raises(ValueError):
+            paddle.fft.fft(paddle.to_tensor(x), norm="bogus")
+
+    def test_fftshift_freq(self):
+        x = np.arange(8, dtype=np.float32)
+        np.testing.assert_allclose(
+            paddle.fft.fftshift(paddle.to_tensor(x)).numpy(),
+            np.fft.fftshift(x))
+        np.testing.assert_allclose(paddle.fft.fftfreq(8, 0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5).astype(np.float32))
+
+    def test_rfft_grad(self):
+        x = paddle.to_tensor(np.random.default_rng(3).standard_normal(
+            16).astype(np.float32), stop_gradient=False)
+        y = paddle.fft.rfft(x)
+        (y.abs() ** 2).sum().backward()
+        assert x.grad is not None and x.grad.shape == [16]
+
+
+class TestHermitian:
+    def test_hfft_ihfft_1d(self):
+        x = np.random.default_rng(10).standard_normal(9).astype(np.float32) \
+            + 1j * np.random.default_rng(11).standard_normal(9).astype(
+                np.float32)
+        out = paddle.fft.hfft(paddle.to_tensor(x.astype(np.complex64)))
+        np.testing.assert_allclose(out.numpy(), np.fft.hfft(x), rtol=1e-3,
+                                   atol=1e-3)
+        back = paddle.fft.ihfft(paddle.to_tensor(np.fft.hfft(x).astype(
+            np.float32)))
+        np.testing.assert_allclose(back.numpy(), np.fft.ihfft(
+            np.fft.hfft(x)).astype(np.complex64), rtol=1e-3, atol=1e-3)
+
+    def test_hfftn_real_output_and_shape(self):
+        x = (np.random.default_rng(12).standard_normal((4, 6))
+             + 1j * np.random.default_rng(13).standard_normal((4, 6))
+             ).astype(np.complex64)
+        out = paddle.fft.hfftn(paddle.to_tensor(x))
+        assert out.numpy().dtype.kind == "f"
+        assert out.shape == [4, 10]  # last axis 2*(n-1)
+        # cross-check against fft-compose semantics on numpy
+        ref = np.fft.hfft(np.fft.fft(x, axis=0), axis=1)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-2, atol=1e-2)
+
+    def test_ihfft2_inverts_hfft2(self):
+        r = np.random.default_rng(14).standard_normal((4, 10)).astype(
+            np.float32)
+        spec = paddle.fft.ihfft2(paddle.to_tensor(r))
+        rec = paddle.fft.hfft2(spec, s=(4, 10))
+        np.testing.assert_allclose(rec.numpy(), r, rtol=1e-3, atol=1e-3)
+
+
+class TestSignal:
+    def test_frame_overlap_add_inverse(self):
+        x = np.random.default_rng(4).standard_normal(64).astype(np.float32)
+        fr = paddle.signal.frame(paddle.to_tensor(x), 16, 16)
+        assert fr.shape == [16, 4]
+        back = paddle.signal.overlap_add(fr, 16)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((2, 512)).astype(np.float32)
+        window = np.hanning(128).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=128,
+                                  hop_length=32,
+                                  window=paddle.to_tensor(window))
+        assert spec.shape[0] == 2 and spec.shape[1] == 65
+        rec = paddle.signal.istft(spec, n_fft=128, hop_length=32,
+                                  window=paddle.to_tensor(window),
+                                  length=512)
+        np.testing.assert_allclose(rec.numpy(), x, rtol=1e-3, atol=1e-3)
+
+    def test_frame_axis0(self):
+        x = np.arange(12, dtype=np.float32)
+        fr = paddle.signal.frame(paddle.to_tensor(x), 4, 4, axis=0)
+        assert fr.shape == [3, 4]
+        np.testing.assert_allclose(fr.numpy(), x.reshape(3, 4))
+        back = paddle.signal.overlap_add(fr, 4, axis=0)
+        np.testing.assert_allclose(back.numpy(), x)
+
+    def test_istft_return_complex(self):
+        rng = np.random.default_rng(15)
+        x = (rng.standard_normal((1, 256))
+             + 1j * rng.standard_normal((1, 256))).astype(np.complex64)
+        w = np.hanning(64).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=64,
+                                  hop_length=16, onesided=False,
+                                  window=paddle.to_tensor(w))
+        rec = paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                                  onesided=False, return_complex=True,
+                                  window=paddle.to_tensor(w), length=256)
+        assert rec.numpy().dtype.kind == "c"
+        np.testing.assert_allclose(rec.numpy(), x, rtol=1e-3, atol=1e-3)
+        with pytest.raises(ValueError):
+            paddle.signal.istft(spec, n_fft=64, onesided=True,
+                                return_complex=True)
+
+    def test_stft_matches_manual_dft(self):
+        x = np.sin(np.arange(256) * 0.3).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=64,
+                                  hop_length=64, center=False,
+                                  window=None).numpy()
+        frames = x[:256 - 0].reshape(-1, 64)[: spec.shape[-1]]
+        ref = np.fft.rfft(frames, axis=-1).T
+        np.testing.assert_allclose(spec, ref, rtol=1e-3, atol=1e-3)
+
+
+class TestVisionOps:
+    def test_roi_align_whole_image_identity_avg(self):
+        # RoI covering the full image with 1x1 output = global average
+        x = np.random.default_rng(6).standard_normal(
+            (1, 3, 8, 8)).astype(np.float32)
+        boxes = np.array([[0.0, 0.0, 8.0, 8.0]], np.float32)
+        out = vops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                             paddle.to_tensor(np.array([1], np.int32)),
+                             output_size=4, sampling_ratio=2,
+                             aligned=False)
+        assert out.shape == [1, 3, 4, 4]
+        np.testing.assert_allclose(out.numpy().mean(), x.mean(), rtol=0.05,
+                                   atol=0.05)
+
+    def test_roi_pool_max(self):
+        x = np.zeros((1, 1, 8, 8), np.float32)
+        x[0, 0, 2, 2] = 5.0
+        out = vops.roi_pool(paddle.to_tensor(x),
+                            paddle.to_tensor(np.array([[0, 0, 7, 7]],
+                                                      np.float32)),
+                            paddle.to_tensor(np.array([1], np.int32)),
+                            output_size=1)
+        np.testing.assert_allclose(out.numpy().reshape(()), 5.0)
+
+    def test_nms_suppresses_overlaps(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                          [0, 0, 9.5, 9.5]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7, 0.95], np.float32)
+        keep = vops.nms(paddle.to_tensor(boxes), 0.5,
+                        paddle.to_tensor(scores)).numpy()
+        assert list(keep) == [3, 2]
+
+    def test_nms_categories_kept_separately(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1], np.int64)
+        keep = vops.nms(paddle.to_tensor(boxes), 0.5,
+                        paddle.to_tensor(scores),
+                        category_idxs=paddle.to_tensor(cats),
+                        categories=[0, 1]).numpy()
+        assert set(keep) == {0, 1}
+
+    def test_box_iou(self):
+        a = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+        b = paddle.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 15, 15],
+                                       [20, 20, 30, 30]], np.float32))
+        iou = vops.box_iou(a, b).numpy()[0]
+        np.testing.assert_allclose(iou[0], 1.0, rtol=1e-5)
+        np.testing.assert_allclose(iou[1], 25.0 / 175.0, rtol=1e-4)
+        np.testing.assert_allclose(iou[2], 0.0)
+
+    def test_deform_conv2d_zero_offset_matches_conv(self):
+        rng = np.random.default_rng(8)
+        x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+        w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)
+        off = np.zeros((2, 18, 8, 8), np.float32)
+        out = vops.deform_conv2d(paddle.to_tensor(x),
+                                 paddle.to_tensor(off),
+                                 paddle.to_tensor(w), padding=1)
+        import paddle_tpu.nn.functional as F
+        ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_deform_conv2d_grad(self):
+        rng = np.random.default_rng(9)
+        x = paddle.to_tensor(rng.standard_normal((1, 2, 6, 6)).astype(
+            np.float32), stop_gradient=False)
+        w = paddle.to_tensor(rng.standard_normal((2, 2, 3, 3)).astype(
+            np.float32), stop_gradient=False)
+        off = paddle.to_tensor(
+            0.1 * rng.standard_normal((1, 18, 6, 6)).astype(np.float32),
+            stop_gradient=False)
+        out = vops.deform_conv2d(x, off, w, padding=1)
+        out.sum().backward()
+        assert x.grad is not None and w.grad is not None
+        assert off.grad is not None

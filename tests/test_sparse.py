@@ -1,0 +1,302 @@
+"""Sparse tensor tests (reference test model: test/legacy_test
+test_sparse_*.py — numpy-reference check_output/check_grad per op)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _rand_coo(shape=(4, 5), nnz=6, seed=0, dense_dims=()):
+    rng = np.random.default_rng(seed)
+    flat = rng.choice(np.prod(shape), size=nnz, replace=False)
+    idx = np.stack(np.unravel_index(flat, shape)).astype(np.int32)
+    vals = rng.standard_normal((nnz,) + dense_dims).astype(np.float32)
+    return idx, vals
+
+
+class TestCreationConversion:
+    def test_coo_roundtrip(self):
+        idx, vals = _rand_coo()
+        sp = sparse.sparse_coo_tensor(idx, vals, (4, 5))
+        dense = sp.to_dense().numpy()
+        ref = np.zeros((4, 5), np.float32)
+        ref[idx[0], idx[1]] = vals
+        np.testing.assert_allclose(dense, ref, rtol=1e-6)
+        sp2 = sparse.to_sparse_coo(paddle.to_tensor(ref), sparse_dim=2)
+        np.testing.assert_allclose(sp2.to_dense().numpy(), ref, rtol=1e-6)
+
+    def test_csr_roundtrip(self):
+        crows = [0, 2, 3, 5]
+        cols = [1, 3, 2, 0, 3]
+        vals = np.arange(1.0, 6.0, dtype=np.float32)
+        sp = sparse.sparse_csr_tensor(crows, cols, vals, (3, 4))
+        ref = np.zeros((3, 4), np.float32)
+        ref[0, 1], ref[0, 3], ref[1, 2], ref[2, 0], ref[2, 3] = vals
+        np.testing.assert_allclose(sp.to_dense().numpy(), ref)
+        coo = sp.to_sparse_coo()
+        np.testing.assert_allclose(coo.to_dense().numpy(), ref)
+        back = sparse.coo_to_csr(coo)
+        np.testing.assert_allclose(back.to_dense().numpy(), ref)
+
+    def test_coalesce_merges_duplicates(self):
+        idx = np.array([[0, 0, 1], [1, 1, 2]], np.int32)
+        sp = sparse.sparse_coo_tensor(idx, np.array([1.0, 2.0, 3.0],
+                                                    np.float32), (2, 3))
+        c = sp.coalesce()
+        assert c.nnz == 2
+        ref = np.zeros((2, 3), np.float32)
+        ref[0, 1] = 3.0
+        ref[1, 2] = 3.0
+        np.testing.assert_allclose(c.to_dense().numpy(), ref)
+
+    def test_dense_dim_values(self):
+        idx, vals = _rand_coo(shape=(3, 3), nnz=4, dense_dims=(2,))
+        sp = sparse.sparse_coo_tensor(idx, vals, (3, 3, 2))
+        assert sp.dense_dim == 1
+        d = sp.to_dense().numpy()
+        assert d.shape == (3, 3, 2)
+        np.testing.assert_allclose(d[idx[0], idx[1]], vals, rtol=1e-6)
+
+
+class TestElementwise:
+    def test_unary_ops_match_dense(self):
+        idx, vals = _rand_coo()
+        sp = sparse.sparse_coo_tensor(idx, np.abs(vals) + 0.1, (4, 5))
+        for name in ["sqrt", "sin", "tanh", "relu", "square", "log1p",
+                     "abs", "expm1"]:
+            out = getattr(sparse, name)(sp)
+            ref = getattr(np, name if hasattr(np, name) else "abs")(
+                np.abs(vals) + 0.1) if name != "relu" and name != "square" \
+                else (np.maximum(np.abs(vals) + 0.1, 0) if name == "relu"
+                      else (np.abs(vals) + 0.1) ** 2)
+            np.testing.assert_allclose(out.values().numpy(), ref, rtol=1e-5)
+
+    def test_add_same_structure(self):
+        idx, vals = _rand_coo()
+        a = sparse.sparse_coo_tensor(idx, vals, (4, 5))
+        b = sparse.sparse_coo_tensor(idx, 2 * vals, (4, 5))
+        out = sparse.add(a, b)
+        np.testing.assert_allclose(out.values().numpy(), 3 * vals, rtol=1e-6)
+
+    def test_add_different_structure(self):
+        ia, va = _rand_coo(seed=1)
+        ib, vb = _rand_coo(seed=2)
+        a = sparse.sparse_coo_tensor(ia, va, (4, 5))
+        b = sparse.sparse_coo_tensor(ib, vb, (4, 5))
+        out = sparse.add(a, b)
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   a.to_dense().numpy() + b.to_dense().numpy(),
+                                   rtol=1e-6)
+
+    def test_multiply_scalar(self):
+        idx, vals = _rand_coo()
+        a = sparse.sparse_coo_tensor(idx, vals, (4, 5))
+        np.testing.assert_allclose((a * 2.5).values().numpy(), vals * 2.5,
+                                   rtol=1e-6)
+
+
+class TestMatmul:
+    def test_coo_matmul_dense(self):
+        idx, vals = _rand_coo(shape=(4, 5), nnz=7)
+        sp = sparse.sparse_coo_tensor(idx, vals, (4, 5))
+        d = paddle.to_tensor(np.random.default_rng(3).standard_normal(
+            (5, 3)).astype(np.float32))
+        out = sparse.matmul(sp, d)
+        ref = sp.to_dense().numpy() @ d.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_csr_matmul_dense(self):
+        crows = [0, 2, 3, 5]
+        cols = [1, 3, 2, 0, 3]
+        vals = np.arange(1.0, 6.0, dtype=np.float32)
+        sp = sparse.sparse_csr_tensor(crows, cols, vals, (3, 4))
+        d = paddle.to_tensor(np.random.default_rng(4).standard_normal(
+            (4, 2)).astype(np.float32))
+        out = sparse.matmul(sp, d)
+        np.testing.assert_allclose(out.numpy(), sp.to_dense().numpy()
+                                   @ d.numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_matmul_grad(self):
+        idx, vals = _rand_coo(shape=(4, 5), nnz=7)
+        sp = sparse.sparse_coo_tensor(idx, vals, (4, 5),
+                                      stop_gradient=False)
+        d = paddle.to_tensor(np.random.default_rng(3).standard_normal(
+            (5, 3)).astype(np.float32), stop_gradient=False)
+        out = sparse.matmul(sp, d)
+        out.sum().backward()
+        assert sp.grad is not None and sp.grad.shape == [7]
+        assert d.grad is not None
+        # numeric check on dense rhs grad: d(sum)/dd = colsum of dense lhs
+        ref = np.tile(sp.to_dense().numpy().sum(0)[:, None], (1, 3))
+        np.testing.assert_allclose(d.grad.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_masked_matmul(self):
+        rng = np.random.default_rng(5)
+        a = paddle.to_tensor(rng.standard_normal((4, 6)).astype(np.float32))
+        b = paddle.to_tensor(rng.standard_normal((6, 4)).astype(np.float32))
+        crows = [0, 1, 3, 3, 4]
+        cols = [2, 0, 3, 1]
+        mask = sparse.sparse_csr_tensor(crows, cols,
+                                        np.ones(4, np.float32), (4, 4))
+        out = sparse.masked_matmul(a, b, mask)
+        full = a.numpy() @ b.numpy()
+        ref = np.array([full[0, 2], full[1, 0], full[1, 3], full[3, 1]])
+        np.testing.assert_allclose(out.values().numpy(), ref, rtol=1e-5,
+                                   atol=1e-5)
+
+
+class TestSoftmaxAttention:
+    def test_csr_softmax_rows(self):
+        crows = [0, 2, 3, 5]
+        cols = [1, 3, 2, 0, 3]
+        vals = np.array([1.0, 2.0, 5.0, 0.5, 0.7], np.float32)
+        sp = sparse.sparse_csr_tensor(crows, cols, vals, (3, 4))
+        out = sparse.softmax(sp).values().numpy()
+        r0 = np.exp([1, 2] - np.max([1, 2]))
+        r0 /= r0.sum()
+        r2 = np.exp(np.array([0.5, 0.7]) - 0.7)
+        r2 /= r2.sum()
+        np.testing.assert_allclose(out[:2], r0, rtol=1e-5)
+        np.testing.assert_allclose(out[2], 1.0, rtol=1e-6)
+        np.testing.assert_allclose(out[3:], r2, rtol=1e-5)
+
+    def test_sparse_attention_matches_masked_dense(self):
+        rng = np.random.default_rng(7)
+        B, H, S, D = 2, 2, 8, 4
+        q, k, v = (rng.standard_normal((B, H, S, D)).astype(np.float32)
+                   for _ in range(3))
+        # band mask as CSR
+        dense_mask = np.tril(np.triu(np.ones((S, S)), -2), 2)
+        crows = np.concatenate([[0], np.cumsum(dense_mask.sum(1))]).astype(
+            np.int32)
+        cols = np.concatenate([np.nonzero(r)[0] for r in dense_mask]).astype(
+            np.int32)
+        mask = sparse.sparse_csr_tensor(crows, cols,
+                                        np.ones(len(cols), np.float32),
+                                        (S, S))
+        out = sparse.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                               paddle.to_tensor(v), mask).numpy()
+        # dense reference
+        logits = q @ k.transpose(0, 1, 3, 2) / np.sqrt(D)
+        logits = np.where(dense_mask.astype(bool), logits, -1e30)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = p @ v
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+class TestBatchedCsr:
+    def test_nonuniform_batch_to_dense(self):
+        # batch 0 has 1 entry, batch 1 has 2 — per-batch nnz from crows
+        crows = [0, 1, 1, 0, 1, 2]
+        cols = [0, 1, 0]
+        vals = np.array([1.0, 2.0, 3.0], np.float32)
+        sp = sparse.sparse_csr_tensor(crows, cols, vals, (2, 2, 2))
+        d = sp.to_dense().numpy()
+        ref = np.zeros((2, 2, 2), np.float32)
+        ref[0, 0, 0] = 1.0
+        ref[1, 0, 1] = 2.0
+        ref[1, 1, 0] = 3.0
+        np.testing.assert_allclose(d, ref)
+
+    def test_nonuniform_batch_softmax(self):
+        crows = [0, 2, 2, 0, 1, 2]
+        cols = [0, 1, 1, 0]
+        vals = np.array([1.0, 1.0, 5.0, 7.0], np.float32)
+        sp = sparse.sparse_csr_tensor(crows, cols, vals, (2, 2, 2))
+        out = sparse.softmax(sp).values().numpy()
+        np.testing.assert_allclose(out[:2], [0.5, 0.5], rtol=1e-5)
+        np.testing.assert_allclose(out[2:], [1.0, 1.0], rtol=1e-5)
+
+
+class TestAttentionMasks:
+    def test_key_padding_mask_excludes_keys(self):
+        rng = np.random.default_rng(11)
+        B, H, S, D = 2, 1, 4, 4
+        q, k, v = (rng.standard_normal((B, H, S, D)).astype(np.float32)
+                   for _ in range(3))
+        dense_mask = np.ones((S, S))
+        crows = np.arange(0, S * S + 1, S).astype(np.int32)
+        cols = np.tile(np.arange(S), S).astype(np.int32)
+        mask = sparse.sparse_csr_tensor(crows, cols,
+                                        np.ones(S * S, np.float32), (S, S))
+        kp = np.zeros((B, S), np.float32)
+        kp[:, -1] = -1e30  # exclude last key everywhere
+        out = sparse.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                               paddle.to_tensor(v), mask,
+                               key_padding_mask=paddle.to_tensor(kp)).numpy()
+        logits = q @ k.transpose(0, 1, 3, 2) / np.sqrt(D)
+        logits[..., -1] = -1e30
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, p @ v, rtol=1e-4, atol=1e-4)
+
+    def test_attn_mask_additive(self):
+        rng = np.random.default_rng(12)
+        S, D = 4, 4
+        q, k, v = (rng.standard_normal((1, 1, S, D)).astype(np.float32)
+                   for _ in range(3))
+        crows = np.arange(0, S * S + 1, S).astype(np.int32)
+        cols = np.tile(np.arange(S), S).astype(np.int32)
+        mask = sparse.sparse_csr_tensor(crows, cols,
+                                        np.ones(S * S, np.float32), (S, S))
+        am = np.triu(np.full((S, S), -1e30, np.float32), 1)
+        out = sparse.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                               paddle.to_tensor(v), mask,
+                               attn_mask=paddle.to_tensor(am)).numpy()
+        logits = (q @ k.transpose(0, 1, 3, 2) / np.sqrt(D)) + am
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, p @ v, rtol=1e-4, atol=1e-4)
+
+
+class TestSparseNN:
+    def test_relu_layer_and_grad(self):
+        idx, vals = _rand_coo()
+        sp = sparse.sparse_coo_tensor(idx, vals, (4, 5),
+                                      stop_gradient=False)
+        out = sparse.nn.ReLU()(sp)
+        out.values().sum().backward()
+        np.testing.assert_allclose(sp.grad.numpy(),
+                                   (vals > 0).astype(np.float32))
+
+    def test_batchnorm(self):
+        idx, vals = _rand_coo(shape=(3, 3), nnz=5, dense_dims=(4,))
+        sp = sparse.sparse_coo_tensor(idx, vals, (3, 3, 4))
+        bn = sparse.nn.BatchNorm(4)
+        bn.train()
+        out = bn(sp)
+        got = out.values().numpy()
+        assert got.shape == (5, 4)
+        np.testing.assert_allclose(got.mean(0), 0.0, atol=1e-5)
+
+    def test_subm_conv3d_identity_kernel(self):
+        # a 1x1x1 kernel with identity weight must reproduce the input
+        rng = np.random.default_rng(9)
+        idx = np.array([[0, 0, 0], [0, 1, 2], [1, 0, 2], [2, 2, 0]],
+                       np.int32)  # [4 dims? b,z,y,x] -> need 4 rows
+        idx = np.stack([np.zeros(4, np.int32), idx[:, 0], idx[:, 1],
+                        idx[:, 2]])
+        vals = rng.standard_normal((4, 3)).astype(np.float32)
+        sp = sparse.sparse_coo_tensor(idx, vals, (1, 3, 3, 3, 3))
+        conv = sparse.nn.SubmConv3D(3, 3, kernel_size=1, bias_attr=False)
+        with paddle.no_grad():
+            conv.weight.set_value(np.eye(3, dtype=np.float32)[None])
+        out = conv(sp)
+        np.testing.assert_allclose(out.values().numpy(), vals, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_subm_conv3d_neighborhood(self):
+        # 3x3x3 all-ones kernel on two adjacent voxels sums neighbours
+        idx = np.stack([np.zeros(2, np.int32),
+                        np.array([1, 1], np.int32),
+                        np.array([1, 1], np.int32),
+                        np.array([0, 1], np.int32)])
+        vals = np.array([[1.0], [10.0]], np.float32)
+        sp = sparse.sparse_coo_tensor(idx, vals, (1, 3, 3, 3, 1))
+        conv = sparse.nn.SubmConv3D(1, 1, kernel_size=3, bias_attr=False)
+        with paddle.no_grad():
+            conv.weight.set_value(np.ones((27, 1, 1), np.float32))
+        out = conv(sp).values().numpy()
+        np.testing.assert_allclose(out[:, 0], [11.0, 11.0], rtol=1e-6)
