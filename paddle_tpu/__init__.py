@@ -48,6 +48,8 @@ from . import device
 from . import sparse
 from . import fft
 from . import signal
+from . import quantization
+from . import inference
 from .hapi import Model, summary
 from .framework import save, load, set_default_dtype, get_default_dtype
 from .utils.flags import set_flags, get_flags

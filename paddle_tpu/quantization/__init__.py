@@ -1,0 +1,17 @@
+"""paddle.quantization parity (reference: python/paddle/quantization/ —
+PTQ/QAT framework with observers and quanters; SURVEY.md §2.10)."""
+from .config import QuantConfig
+from .observers import (BaseObserver, AbsmaxObserver, EMAObserver,
+                        PercentileObserver, AbsmaxChannelWiseObserver)
+from .quanters import (FakeQuanterWithAbsMax, fake_quant, quantize,
+                       dequantize, quanter)
+from .qat import (QAT, PTQ, QuantedLinear, QuantedConv2D,
+                  InferQuantedLinear)
+
+__all__ = [
+    "QuantConfig", "BaseObserver", "AbsmaxObserver", "EMAObserver",
+    "PercentileObserver", "AbsmaxChannelWiseObserver",
+    "FakeQuanterWithAbsMax", "fake_quant", "quantize", "dequantize",
+    "quanter", "QAT", "PTQ", "QuantedLinear", "QuantedConv2D",
+    "InferQuantedLinear",
+]

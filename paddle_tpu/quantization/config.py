@@ -1,0 +1,44 @@
+"""QuantConfig (reference: python/paddle/quantization/config.py — maps
+layers/types/names to (activation, weight) quanter factories)."""
+import copy
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._global_act = activation
+        self._global_weight = weight
+        self._layer_cfg = {}   # id(layer) -> (act, weight)
+        self._type_cfg = {}    # type -> (act, weight)
+        self._name_cfg = {}    # layer name -> (act, weight)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for ly in layers:
+            self._layer_cfg[id(ly)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._type_cfg[t] = (activation, weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) \
+            else [layer_name]
+        for n in names:
+            self._name_cfg[n] = (activation, weight)
+
+    def config_for(self, layer, name=""):
+        """Resolution order: per-layer > per-name > per-type > global
+        (config.py _get_config_by_layer)."""
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        if name in self._name_cfg:
+            return self._name_cfg[name]
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self._global_act, self._global_weight)
+
+    def copy(self):
+        return copy.copy(self)

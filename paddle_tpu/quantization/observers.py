@@ -1,0 +1,128 @@
+"""Calibration observers (reference: python/paddle/quantization/observers/
+— AbsmaxObserver, EMD/MSE/hist/KL observers; each is a passthrough Layer
+that records activation statistics and later reports a quant scale)."""
+import numpy as np
+
+from ..nn.layer import Layer
+
+
+class BaseObserver(Layer):
+    """Passthrough layer that accumulates statistics on forward."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1  # per-tensor
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return 0.0
+
+    def forward(self, x):
+        self._observe(x)
+        return x
+
+    def _observe(self, x):
+        raise NotImplementedError
+
+    def _qbound(self):
+        return float(2 ** (self._quant_bits - 1) - 1)
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max of |x| (observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._absmax = 0.0
+
+    def _observe(self, x):
+        self._absmax = max(self._absmax,
+                           float(np.abs(np.asarray(x.numpy())).max()))
+
+    def scales(self):
+        return max(self._absmax, 1e-9) / self._qbound()
+
+
+class EMAObserver(BaseObserver):
+    """Exponential moving average of per-batch absmax (observers/emd.py
+    family; the QAT-friendly smoothed estimator)."""
+
+    def __init__(self, quant_bits=8, momentum=0.9):
+        super().__init__(quant_bits)
+        self._momentum = momentum
+        self._ema = None
+
+    def _observe(self, x):
+        m = float(np.abs(np.asarray(x.numpy())).max())
+        self._ema = m if self._ema is None else \
+            self._momentum * self._ema + (1 - self._momentum) * m
+
+    def scales(self):
+        return max(self._ema or 0.0, 1e-9) / self._qbound()
+
+
+class PercentileObserver(BaseObserver):
+    """Percentile of |x| over a histogram (observers/hist.py role) —
+    clips outliers that would waste int8 range."""
+
+    def __init__(self, quant_bits=8, percentile=99.9, bins=2048):
+        super().__init__(quant_bits)
+        self._percentile = percentile
+        self._hist = np.zeros(bins)
+        self._edges = None
+        self._bins = bins
+
+    def _observe(self, x):
+        a = np.abs(np.asarray(x.numpy())).reshape(-1)
+        hi = a.max() if a.size else 1.0
+        if self._edges is None or hi > self._edges[-1]:
+            # rescale histogram to the new range
+            new_edges = np.linspace(0, max(hi, 1e-9), self._bins + 1)
+            if self._edges is not None and self._hist.sum() > 0:
+                centers = (self._edges[:-1] + self._edges[1:]) / 2
+                idx = np.clip(np.searchsorted(new_edges, centers) - 1,
+                              0, self._bins - 1)
+                nh = np.zeros(self._bins)
+                np.add.at(nh, idx, self._hist)
+                self._hist = nh
+            self._edges = new_edges
+        idx = np.clip(np.searchsorted(self._edges, a) - 1, 0, self._bins - 1)
+        np.add.at(self._hist, idx, 1)
+
+    def scales(self):
+        if self._edges is None or self._hist.sum() == 0:
+            return 1e-9
+        c = np.cumsum(self._hist) / self._hist.sum()
+        i = int(np.searchsorted(c, self._percentile / 100.0))
+        amax = self._edges[min(i + 1, self._bins)]
+        return max(float(amax), 1e-9) / self._qbound()
+
+
+class AbsmaxChannelWiseObserver(BaseObserver):
+    """Per-output-channel absmax for weights (observers channel_wise)."""
+
+    def __init__(self, quant_bits=8, quant_axis=0):
+        super().__init__(quant_bits)
+        self._axis = quant_axis
+        self._absmax = None
+
+    def quant_axis(self):
+        return self._axis
+
+    def _observe(self, x):
+        a = np.abs(np.asarray(x.numpy()))
+        red = tuple(i for i in range(a.ndim) if i != self._axis)
+        m = a.max(axis=red) if red else a
+        self._absmax = m if self._absmax is None else np.maximum(
+            self._absmax, m)
+
+    def scales(self):
+        return np.maximum(self._absmax, 1e-9) / self._qbound()

@@ -1,0 +1,198 @@
+"""QAT / PTQ drivers (reference: python/paddle/quantization/{qat,ptq}.py —
+QAT.quantize wraps conv/linear with fake-quant layers; PTQ.quantize inserts
+observers, then convert() freezes scales into quantized inference layers)."""
+import numpy as np
+
+from .. import nn
+from ..core.dispatch import apply_op
+from ..core.tensor import to_tensor
+from ..nn.layer import Layer
+from .quanters import FakeQuanterWithAbsMax, fake_quant, quantize, dequantize
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized weight + activation (QAT simulation;
+    reference nn/quant/qat/linear.py QuantedLinear)."""
+
+    def __init__(self, linear, act_quanter=None, weight_quanter=None):
+        super().__init__()
+        self._inner = linear
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self._inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        from ..nn import functional as F
+        return F.linear(x, w, self._inner.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, conv, act_quanter=None, weight_quanter=None):
+        super().__init__()
+        self._inner = conv
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self._inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        from ..nn import functional as F
+        c = self._inner
+        return F.conv2d(x, w, c.bias, stride=c.stride, padding=c.padding,
+                        dilation=c.dilation, groups=c.groups)
+
+
+class InferQuantedLinear(Layer):
+    """Converted inference layer: int8 weight + f32 scale, dequantized at
+    matmul time (weight-only int8 — the TPU-relevant deployment mode;
+    reference onnx_format convert path)."""
+
+    def __init__(self, linear, weight_scale, quant_bits=8):
+        super().__init__()
+        w = linear.weight
+        scale = to_tensor(np.float32(weight_scale))
+        self.qweight = quantize(w, scale, quant_bits)
+        self.scale = scale
+        self.bias = linear.bias
+
+    def forward(self, x):
+        w = dequantize(self.qweight, self.scale)
+        from ..nn import functional as F
+        return F.linear(x, w, self.bias)
+
+
+_DEFAULT_QAT_TYPES = (nn.Linear, nn.Conv2D)
+
+
+def _wrap_layer(layer, act_q, w_q):
+    if isinstance(layer, nn.Linear):
+        return QuantedLinear(layer, act_q() if act_q else None,
+                             w_q() if w_q else None)
+    if isinstance(layer, nn.Conv2D):
+        return QuantedConv2D(layer, act_q() if act_q else None,
+                             w_q() if w_q else None)
+    return None
+
+
+def _replace_children(model, fn, prefix=""):
+    for name, child in list(model._sub_layers.items()):
+        full = f"{prefix}.{name}" if prefix else name
+        new = fn(child, full)
+        if new is not None:
+            model._sub_layers[name] = new
+        else:
+            _replace_children(child, fn, full)
+
+
+def _resolve_configs(config, model):
+    """Resolve every sub-layer's (act, weight) config against the ORIGINAL
+    model by qualified name. Per-layer configs key on id(layer), which a
+    deepcopy would invalidate — so resolution must happen pre-copy."""
+    resolved = {}
+
+    def walk(m, prefix=""):
+        for name, child in m._sub_layers.items():
+            full = f"{prefix}.{name}" if prefix else name
+            resolved[full] = config.config_for(child, full)
+            walk(child, full)
+
+    walk(model)
+    return resolved
+
+
+class QAT:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        resolved = _resolve_configs(self._config, model)
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def fn(layer, name):
+            act_q, w_q = resolved.get(name, (None, None))
+            if act_q is None and w_q is None:
+                return None
+            return _wrap_layer(layer, act_q, w_q)
+
+        _replace_children(model, fn)
+        return model
+
+    def convert(self, model, inplace=False):
+        """Freeze fake-quant scales into inference int8 layers."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def fn(layer, name):
+            if isinstance(layer, QuantedLinear) and layer.weight_quanter:
+                # recompute weight scale from the current weights
+                w = np.abs(np.asarray(layer._inner.weight.numpy())).max()
+                bound = 2 ** (layer.weight_quanter.bit_length() - 1) - 1
+                return InferQuantedLinear(layer._inner, w / bound)
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                return layer._inner
+            return None
+
+        _replace_children(model, fn)
+        return model
+
+
+class PTQ:
+    """Post-training quantization: insert observers, calibrate by running
+    forwards, then convert to quantized inference layers."""
+
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        resolved = _resolve_configs(self._config, model)
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def fn(layer, name):
+            act_f, w_f = resolved.get(name, (None, None))
+            if act_f is None and w_f is None:
+                return None
+            if isinstance(layer, _DEFAULT_QAT_TYPES):
+                wrapped = _wrap_layer(
+                    layer, act_f, None)
+                if w_f is not None:
+                    obs = w_f()
+                    obs(layer.weight)       # weights observable immediately
+                    wrapped.weight_quanter = None
+                    wrapped._weight_observer = obs
+                return wrapped
+            return None
+
+        _replace_children(model, fn)
+        return model
+
+    def convert(self, model, inplace=False):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def fn(layer, name):
+            if isinstance(layer, QuantedLinear):
+                obs = getattr(layer, "_weight_observer", None)
+                if obs is not None:
+                    return InferQuantedLinear(layer._inner,
+                                              float(np.max(obs.scales())),
+                                              obs.bit_length())
+                return layer._inner
+            if isinstance(layer, QuantedConv2D):
+                return layer._inner
+            return None
+
+        _replace_children(model, fn)
+        return model

@@ -1,0 +1,99 @@
+"""Fake quanters for QAT (reference: python/paddle/quantization/quanters/
+abs_max.py FakeQuanterWithAbsMaxObserver — simulated quantization in the
+forward, straight-through estimator in the backward).
+
+STE lowering: q(x) = x + stop_gradient(fake_quant(x) - x), so the tape
+sees identity for in-range values; the dispatch tape differentiates it
+without a custom VJP."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..nn.layer import Layer
+
+
+def quantize(x, scale, quant_bits=8, quant_axis=-1):
+    """Real int quantization: round(x/scale) clipped to int range."""
+    bound = 2 ** (quant_bits - 1) - 1
+
+    def impl(a, s):
+        if quant_axis >= 0 and np.ndim(s) > 0:
+            shape = [1] * a.ndim
+            shape[quant_axis] = -1
+            s = s.reshape(shape)
+        return jnp.clip(jnp.round(a / s), -bound - 1, bound).astype(jnp.int8)
+
+    return apply_op("quantize_linear", impl, (x, scale), {},
+                    differentiable=False)
+
+
+def dequantize(x, scale, quant_axis=-1):
+    def impl(a, s):
+        if quant_axis >= 0 and np.ndim(s) > 0:
+            shape = [1] * a.ndim
+            shape[quant_axis] = -1
+            s = s.reshape(shape)
+        return a.astype(jnp.float32) * s
+
+    return apply_op("dequantize_linear", impl, (x, scale), {},
+                    differentiable=False)
+
+
+def fake_quant(x, scale, quant_bits=8, quant_axis=-1):
+    """Quantize-dequantize with straight-through gradient."""
+    bound = 2 ** (quant_bits - 1) - 1
+
+    def impl(a, s):
+        if quant_axis >= 0 and np.ndim(s) > 0:
+            shape = [1] * a.ndim
+            shape[quant_axis] = -1
+            s = s.reshape(shape)
+        q = jnp.clip(jnp.round(a / s), -bound - 1, bound) * s
+        return a + jax.lax.stop_gradient(q - a)
+
+    return apply_op("fake_quantize_dequantize", impl, (x, scale), {})
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """QAT activation/weight quanter: tracks absmax (EMA for activations,
+    current for weights) and applies fake quant every forward
+    (quanters/abs_max.py FakeQuanterWithAbsMaxObserverLayer)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9, per_batch=True):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._moving_rate = moving_rate
+        self._per_batch = per_batch
+        self._ema = None
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def scales(self):
+        bound = 2 ** (self._quant_bits - 1) - 1
+        return max(self._ema or 0.0, 1e-9) / bound
+
+    def forward(self, x):
+        # statistics update is an eager/training-time side effect; inside
+        # jit (tracers) or eval the frozen scale is used
+        if self.training and not _is_tracer(x):
+            m = float(np.abs(np.asarray(x.data)).max())
+            self._ema = m if self._ema is None else (
+                self._moving_rate * self._ema
+                + (1 - self._moving_rate) * m)
+        from ..core.tensor import to_tensor
+        scale = to_tensor(np.float32(self.scales()))
+        return fake_quant(x, scale, self._quant_bits)
+
+
+def _is_tracer(x):
+    import jax.core
+    return isinstance(getattr(x, "data", x), jax.core.Tracer)
+
+
+def quanter(name="FakeQuanterWithAbsMax", **kwargs):
+    """Factory helper mirroring paddle.quantization.quanter registry."""
+    table = {"FakeQuanterWithAbsMax": FakeQuanterWithAbsMax}
+    cls = table[name]
+    return lambda: cls(**kwargs)

@@ -69,6 +69,9 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
     [B, T] or [T]; output [B, n_fft//2+1, n_frames] complex (onesided)."""
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    if win_length > n_fft:
+        raise ValueError(f"win_length ({win_length}) must be <= n_fft "
+                         f"({n_fft})")
     w = _window_array(window, win_length)
     if win_length < n_fft:  # center-pad window to n_fft
         pad = (n_fft - win_length) // 2
@@ -103,6 +106,9 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
     signal.py:334)."""
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    if win_length > n_fft:
+        raise ValueError(f"win_length ({win_length}) must be <= n_fft "
+                         f"({n_fft})")
     w = _window_array(window, win_length)
     if win_length < n_fft:
         pad = (n_fft - win_length) // 2

@@ -73,33 +73,52 @@ class SubmConv3D(Layer):
                                            is_bias=True)
                      if bias_attr is not False else None)
 
+    def _rulebook(self, idx):
+        """Vectorized rulebook build (the reference kernel's GPU hash-table
+        pass, here ravel+searchsorted), cached by the coordinate structure —
+        static point-cloud structures pay the host cost once."""
+        key = idx.tobytes()
+        cached = getattr(self, "_rulebook_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        nnz = idx.shape[1]
+        # dense ravel of (b, z, y, x) with padded extents so shifted
+        # coordinates never collide across axes
+        ext = idx.max(axis=1) + np.array([1, *self._ks]) + 1
+        def ravel(c):
+            return ((c[0] * ext[1] + c[1]) * ext[2] + c[2]) * ext[3] + c[3]
+        keys = ravel(idx)
+        order = np.argsort(keys)
+        sorted_keys = keys[order]
+        center = np.array([k // 2 for k in self._ks])
+        offs = np.stack(np.meshgrid(*[np.arange(k) for k in self._ks],
+                                    indexing="ij"), -1).reshape(-1, 3)
+        taps_l, src_l, dst_l = [], [], []
+        for t, o in enumerate(offs):
+            shift = o - center
+            src = idx.copy()
+            src[1:4] += shift[:, None]
+            valid = (src[1:4] >= 0).all(axis=0)
+            sk = ravel(src)
+            pos = np.searchsorted(sorted_keys, sk)
+            pos_c = np.clip(pos, 0, nnz - 1)
+            hit = valid & (sorted_keys[pos_c] == sk)
+            dst = np.nonzero(hit)[0]
+            taps_l.append(np.full(len(dst), t, np.int32))
+            src_l.append(order[pos_c[hit]].astype(np.int32))
+            dst_l.append(dst.astype(np.int32))
+        rb = (np.concatenate(taps_l), np.concatenate(src_l),
+              np.concatenate(dst_l))
+        self._rulebook_cache = (key, rb)
+        return rb
+
     def forward(self, x: SparseCooTensor):
         import jax.numpy as jnp
         from ..core.dispatch import apply_op
 
         idx = np.asarray(x.indices().numpy())  # [4, nnz]: b, z, y, x
-        spatial = idx[1:4]
         nnz = idx.shape[1]
-        # rulebook: for each kernel offset, (in_pos, out_pos) pairs
-        coord_key = {}
-        for i in range(nnz):
-            coord_key[(idx[0, i], *spatial[:, i])] = i
-        offs = [(dz, dy, dx)
-                for dz in range(self._ks[0]) for dy in range(self._ks[1])
-                for dx in range(self._ks[2])]
-        center = tuple(k // 2 for k in self._ks)
-        pairs = []  # (tap, in_i, out_i)
-        for t, (dz, dy, dx) in enumerate(offs):
-            sz, sy, sx = dz - center[0], dy - center[1], dx - center[2]
-            for i in range(nnz):
-                src = (idx[0, i], idx[1, i] + sz, idx[2, i] + sy,
-                       idx[3, i] + sx)
-                j = coord_key.get(src)
-                if j is not None:
-                    pairs.append((t, j, i))
-        taps = np.array([p[0] for p in pairs], np.int32)
-        src_i = np.array([p[1] for p in pairs], np.int32)
-        dst_i = np.array([p[2] for p in pairs], np.int32)
+        taps, src_i, dst_i = self._rulebook(idx)
 
         w, b = self.weight, self.bias
 

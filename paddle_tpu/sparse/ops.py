@@ -21,8 +21,11 @@ from .tensor import SparseCooTensor, SparseCsrTensor, _csr_row_ids
 # ---------------------------------------------------------------------------
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None,
-                      stop_gradient=True):
-    """paddle.sparse.sparse_coo_tensor (python/paddle/sparse/creation.py)."""
+                      stop_gradient=None):
+    """paddle.sparse.sparse_coo_tensor (python/paddle/sparse/creation.py).
+    When `values` is already a Tensor its stop_gradient is preserved unless
+    the caller passes one explicitly (the sparse tensor aliases, not copies,
+    the values)."""
     idx = np.asarray(indices.numpy() if isinstance(indices, Tensor)
                      else indices)
     vals = values if isinstance(values, Tensor) else to_tensor(
@@ -32,16 +35,22 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
             if idx.size else (0,) * idx.shape[0]
         shape = sparse_shape + tuple(vals.shape[1:])
     t = SparseCooTensor(idx, vals, shape)
-    t.stop_gradient = stop_gradient
+    if stop_gradient is not None:
+        t.stop_gradient = stop_gradient
+    elif not isinstance(values, Tensor):
+        t.stop_gradient = True
     return t
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
-                      stop_gradient=True):
+                      stop_gradient=None):
     vals = values if isinstance(values, Tensor) else to_tensor(
         np.asarray(values), dtype=dtype)
     t = SparseCsrTensor(crows, cols, vals, shape)
-    t.stop_gradient = stop_gradient
+    if stop_gradient is not None:
+        t.stop_gradient = stop_gradient
+    elif not isinstance(values, Tensor):
+        t.stop_gradient = True
     return t
 
 
@@ -324,19 +333,38 @@ def matmul(sp, dense):
 def masked_matmul(x, y, mask):
     """dense @ dense sampled at a sparse mask's coordinates (reference:
     sparse/gpu/masked_matmul_kernel.cu, the SDDMM primitive). Returns a
-    sparse tensor with the mask's structure."""
+    sparse tensor with the mask's structure. Supports 2D, and batched 3D
+    with a batched CSR mask."""
+    batched = len(mask.shape) == 3
     if getattr(mask, "is_sparse_csr", False):
-        crows, cols = mask.crows().data, mask.cols().data
-        nnz = mask.nnz
-        rows = _csr_row_ids(crows, nnz)
+        cols = mask.cols().data
+        if batched:
+            crows_np, nnz_per, offsets = _batch_csr_layout(mask)
+            rows_parts = [
+                _csr_row_ids(jnp.asarray(crows_np[i]), int(nnz_per[i]))
+                for i in range(mask.shape[0])]
+        else:
+            rows = _csr_row_ids(mask.crows().data, mask.nnz)
         make = lambda v: SparseCsrTensor(mask.crows(), mask.cols(), v,  # noqa: E731
                                          mask.shape)
     else:
+        if batched:
+            raise NotImplementedError(
+                "batched masked_matmul needs a CSR mask")
         idx = mask.indices().data
         rows, cols = idx[0], idx[1]
         make = lambda v: SparseCooTensor(mask.indices(), v, mask.shape)  # noqa: E731
 
     def impl(a, b):
+        if batched:
+            parts = []
+            for i in range(mask.shape[0]):
+                seg = slice(int(offsets[i]), int(offsets[i + 1]))
+                parts.append(jnp.einsum(
+                    "nk,nk->n", jnp.take(a[i], rows_parts[i], axis=0),
+                    jnp.take(b[i].T, cols[seg], axis=0),
+                    preferred_element_type=jnp.float32))
+            return jnp.concatenate(parts).astype(a.dtype)
         return jnp.einsum("nk,nk->n", jnp.take(a, rows, axis=0),
                           jnp.take(b.T, cols, axis=0),
                           preferred_element_type=jnp.float32).astype(a.dtype)

@@ -1,0 +1,83 @@
+"""Inference predictor tests (reference test model:
+test/inference/inference_api_test + zero-copy predictor tests)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, jit, inference
+
+
+class SmallNet(nn.Layer):
+    def __init__(self, din=8, dout=4):
+        super().__init__()
+        self._init_args = {"din": din, "dout": dout}
+        self.fc1 = nn.Linear(din, 16)
+        self.fc2 = nn.Linear(16, dout)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def _save_model(tmp_path):
+    paddle.seed(11)
+    net = SmallNet()
+    net.eval()
+    prefix = str(tmp_path / "model" / "infer")
+    jit.save(net, prefix)
+    return net, prefix
+
+
+class TestPredictor:
+    def test_run_matches_eager(self, tmp_path):
+        net, prefix = _save_model(tmp_path)
+        x = np.random.default_rng(0).standard_normal((4, 8)).astype(
+            np.float32)
+        ref = net(paddle.to_tensor(x)).numpy()
+
+        cfg = inference.Config(prefix)
+        pred = inference.create_predictor(cfg)
+        out = pred.run([x])
+        np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-5)
+
+    def test_io_handles(self, tmp_path):
+        net, prefix = _save_model(tmp_path)
+        x = np.random.default_rng(1).standard_normal((2, 8)).astype(
+            np.float32)
+        pred = inference.create_predictor(inference.Config(prefix))
+        h = pred.get_input_handle("x0")
+        h.copy_from_cpu(x)
+        pred.run()
+        names = pred.get_output_names()
+        assert names == ["out0"]
+        out = pred.get_output_handle("out0").copy_to_cpu()
+        np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_compile_cache_by_shape(self, tmp_path):
+        _, prefix = _save_model(tmp_path)
+        pred = inference.create_predictor(inference.Config(prefix))
+        pred.run([np.zeros((2, 8), np.float32)])
+        pred.run([np.zeros((2, 8), np.float32)])
+        assert len(pred._compiled) == 1
+        pred.run([np.zeros((6, 8), np.float32)])
+        assert len(pred._compiled) == 2
+
+    def test_bf16_precision_mode(self, tmp_path):
+        net, prefix = _save_model(tmp_path)
+        cfg = inference.Config(prefix)
+        cfg.enable_tpu(inference.PrecisionType.Bfloat16)
+        pred = inference.create_predictor(cfg)
+        x = np.random.default_rng(2).standard_normal((4, 8)).astype(
+            np.float32)
+        out = pred.run([x])[0]
+        ref = net(paddle.to_tensor(x)).numpy()
+        # bf16 round-trip: coarse agreement
+        assert np.abs(out.astype(np.float32) - ref).max() < 0.15
+        assert str(out.dtype) == "bfloat16"
+
+    def test_clone_independent(self, tmp_path):
+        _, prefix = _save_model(tmp_path)
+        pred = inference.create_predictor(inference.Config(prefix))
+        c = pred.clone()
+        out1 = pred.run([np.ones((1, 8), np.float32)])
+        out2 = c.run([np.ones((1, 8), np.float32)])
+        np.testing.assert_allclose(out1[0], out2[0], rtol=1e-6)

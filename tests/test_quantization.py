@@ -1,0 +1,149 @@
+"""Quantization tests (reference test model: test/quantization/ —
+observer scale checks, QAT wrap + train, PTQ calibrate/convert)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.quantization import (
+    QuantConfig, AbsmaxObserver, EMAObserver, PercentileObserver,
+    AbsmaxChannelWiseObserver, FakeQuanterWithAbsMax, fake_quant, quantize,
+    dequantize, QAT, PTQ, QuantedLinear, InferQuantedLinear)
+
+
+class TestObservers:
+    def test_absmax_scale(self):
+        obs = AbsmaxObserver(quant_bits=8)
+        obs(paddle.to_tensor(np.array([1.0, -12.7, 3.0], np.float32)))
+        obs(paddle.to_tensor(np.array([5.0], np.float32)))
+        np.testing.assert_allclose(obs.scales(), 12.7 / 127, rtol=1e-6)
+
+    def test_ema_moves_toward_batch_max(self):
+        obs = EMAObserver(momentum=0.5)
+        obs(paddle.to_tensor(np.array([10.0], np.float32)))
+        obs(paddle.to_tensor(np.array([20.0], np.float32)))
+        np.testing.assert_allclose(obs.scales(), 15.0 / 127, rtol=1e-6)
+
+    def test_percentile_clips_outliers(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal(10000).astype(np.float32)
+        x[0] = 1000.0  # outlier
+        obs = PercentileObserver(percentile=99.0)
+        obs(paddle.to_tensor(x))
+        assert obs.scales() < 100.0 / 127  # outlier excluded
+
+    def test_channel_wise(self):
+        w = np.array([[1.0, -2.0], [30.0, 4.0]], np.float32)
+        obs = AbsmaxChannelWiseObserver(quant_axis=0)
+        obs(paddle.to_tensor(w))
+        np.testing.assert_allclose(obs.scales(),
+                                   np.array([2.0, 30.0]) / 127, rtol=1e-6)
+
+
+class TestQuantizeOps:
+    def test_quant_dequant_roundtrip(self):
+        x = paddle.to_tensor(np.array([0.5, -1.0, 0.25], np.float32))
+        scale = paddle.to_tensor(np.float32(1.0 / 127))
+        q = quantize(x, scale)
+        assert q.numpy().dtype == np.int8
+        back = dequantize(q, scale).numpy()
+        np.testing.assert_allclose(back, [0.5, -1.0, 0.25], atol=1e-2)
+
+    def test_fake_quant_rounds(self):
+        x = paddle.to_tensor(np.array([0.30, -0.52], np.float32))
+        scale = paddle.to_tensor(np.float32(0.1))
+        out = fake_quant(x, scale).numpy()
+        np.testing.assert_allclose(out, [0.3, -0.5], atol=1e-6)
+
+    def test_ste_gradient_identity(self):
+        x = paddle.to_tensor(np.array([0.33, -0.77], np.float32),
+                             stop_gradient=False)
+        scale = paddle.to_tensor(np.float32(0.1))
+        fake_quant(x, scale).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+
+class TestQATFlow:
+    def _model(self):
+        paddle.seed(7)
+        return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+
+    def test_quantize_wraps_linears(self):
+        cfg = QuantConfig(
+            activation=lambda: FakeQuanterWithAbsMax(),
+            weight=lambda: FakeQuanterWithAbsMax())
+        q = QAT(cfg).quantize(self._model())
+        kinds = [type(m).__name__ for m in q._sub_layers.values()]
+        assert kinds.count("QuantedLinear") == 2
+
+    def test_qat_trains(self):
+        cfg = QuantConfig(activation=lambda: FakeQuanterWithAbsMax(),
+                          weight=lambda: FakeQuanterWithAbsMax())
+        model = QAT(cfg).quantize(self._model())
+        model.train()
+        opt = optimizer.Adam(parameters=model.parameters(),
+                             learning_rate=1e-2)
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(rng.standard_normal((32, 8)).astype(np.float32))
+        y = paddle.to_tensor(rng.standard_normal((32, 2)).astype(np.float32))
+        l0 = None
+        for i in range(25):
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if i == 0:
+                l0 = float(loss.numpy())
+        assert float(loss.numpy()) < l0
+
+    def test_qat_convert_produces_int8(self):
+        cfg = QuantConfig(weight=lambda: FakeQuanterWithAbsMax())
+        qat = QAT(cfg)
+        model = qat.quantize(self._model())
+        conv = qat.convert(model)
+        lin = conv._sub_layers["0"]
+        assert isinstance(lin, InferQuantedLinear)
+        assert lin.qweight.numpy().dtype == np.int8
+
+    def test_per_layer_config_survives_deepcopy(self):
+        model = self._model()
+        cfg = QuantConfig()
+        cfg.add_layer_config(model._sub_layers["0"],
+                             weight=lambda: FakeQuanterWithAbsMax())
+        q = QAT(cfg).quantize(model)  # default inplace=False deepcopies
+        assert type(q._sub_layers["0"]).__name__ == "QuantedLinear"
+        assert type(q._sub_layers["2"]).__name__ == "Linear"
+
+    def test_type_config_selective(self):
+        cfg = QuantConfig()
+        cfg.add_type_config(nn.Linear,
+                            weight=lambda: FakeQuanterWithAbsMax())
+        model = nn.Sequential(nn.Linear(4, 4), nn.Conv2D(1, 1, 3))
+        q = QAT(cfg).quantize(model)
+        assert type(q._sub_layers["0"]).__name__ == "QuantedLinear"
+        assert type(q._sub_layers["1"]).__name__ == "Conv2D"  # untouched
+
+
+class TestPTQFlow:
+    def test_ptq_calibrate_convert_close_to_fp(self):
+        paddle.seed(3)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        rng = np.random.default_rng(2)
+        calib = [paddle.to_tensor(rng.standard_normal(
+            (16, 8)).astype(np.float32)) for _ in range(4)]
+        ref_out = model(calib[0]).numpy()
+
+        cfg = QuantConfig(activation=lambda: AbsmaxObserver(),
+                          weight=lambda: AbsmaxObserver())
+        ptq = PTQ(cfg)
+        qmodel = ptq.quantize(model)
+        for batch in calib:
+            qmodel(batch)
+        converted = ptq.convert(qmodel)
+        out = converted(calib[0]).numpy()
+        # int8 weight-only quantization: small relative error vs fp32
+        rel = np.abs(out - ref_out).max() / (np.abs(ref_out).max() + 1e-9)
+        assert rel < 0.05, rel
+        lin = converted._sub_layers["0"]
+        assert isinstance(lin, InferQuantedLinear)

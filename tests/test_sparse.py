@@ -186,6 +186,35 @@ class TestSoftmaxAttention:
         np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
 
 
+class TestCreationSemantics:
+    def test_values_tensor_stop_gradient_preserved(self):
+        idx, vals = _rand_coo()
+        v = paddle.to_tensor(vals, stop_gradient=False)
+        sp = sparse.sparse_coo_tensor(idx, v, (4, 5))
+        assert v.stop_gradient is False  # aliasing must not freeze caller
+        assert sp.stop_gradient is False
+        sp2 = sparse.sparse_coo_tensor(idx, v, (4, 5), stop_gradient=True)
+        assert v.stop_gradient is True  # explicit request is honored
+
+
+class TestBatchedMaskedMatmul:
+    def test_batched_csr_mask(self):
+        rng = np.random.default_rng(21)
+        a = paddle.to_tensor(rng.standard_normal((2, 2, 3)).astype(
+            np.float32))
+        b = paddle.to_tensor(rng.standard_normal((2, 3, 2)).astype(
+            np.float32))
+        # batch 0: one entry (0,1); batch 1: two entries (0,0) and (1,1)
+        crows = [0, 1, 1, 0, 1, 2]
+        cols = [1, 0, 1]
+        mask = sparse.sparse_csr_tensor(crows, cols,
+                                        np.ones(3, np.float32), (2, 2, 2))
+        out = sparse.masked_matmul(a, b, mask).values().numpy()
+        full = a.numpy() @ b.numpy()
+        ref = np.array([full[0, 0, 1], full[1, 0, 0], full[1, 1, 1]])
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
 class TestBatchedCsr:
     def test_nonuniform_batch_to_dense(self):
         # batch 0 has 1 entry, batch 1 has 2 — per-batch nnz from crows
