@@ -132,6 +132,13 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _only_leaves=None):
                     "grad can be implicitly created only for scalar outputs; "
                     f"got shape {list(t.data.shape)}")
             seed = jnp.ones_like(t.data)
+        # hooks fire for roots too (torch/paddle semantics: a tensor's
+        # hooks run whenever its gradient is computed, and a backward root
+        # receives the seed as its gradient)
+        for hook in t._hooks:
+            out = hook(t._wrap_grad(seed))
+            if out is not None:
+                seed = out.data if isinstance(out, Tensor) else out
         if t._node is None:
             if not t.stop_gradient and (_only_leaves is None or id(t) in _only_leaves):
                 t._deposit_grad(seed)

@@ -16,6 +16,11 @@ from .hybrid_optimizer import HybridParallelOptimizer, HybridParallelClipGrad
 from . import recompute as _recompute_mod
 from .recompute import recompute, recompute_sequential
 from .elastic import ElasticManager, ElasticStatus
+from .pipeline_parallel import (PipelineLayer, LayerDesc, SharedLayerDesc,
+                                PipelineParallel, ZeroBubblePipelineParallel,
+                                WeightGradStore, split_weight_grad)
+from .pipeline_schedule import (pipeline_1f1b, pipeline_interleaved,
+                                stack_stage_params)
 from .context_parallel import (ring_attention, ulysses_attention,
                                split_sequence, SegmentParallel)
 

@@ -7,7 +7,10 @@ mesh axis. Eager mode runs micro-batches with gradient accumulation (the
 semantics of pipelined training — identical numerics to 1F1B); the
 overlapped schedule itself belongs to the traced path, where the stage loop
 is a shard_map over the pipe axis with ppermute transfers
-(paddle_tpu.models.pipeline_schedule, used by dryrun_multichip/bench)."""
+(paddle_tpu.distributed.fleet.pipeline_schedule — compiled 1F1B and
+interleaved VPP runners, exercised by dryrun_multichip)."""
+import contextlib
+
 import numpy as np
 
 from ...core.tensor import Tensor
@@ -84,6 +87,13 @@ class PipelineParallel(nn.Layer):
     def forward(self, *args, **kwargs):
         return self._sub_layers["_layers"](*args, **kwargs)
 
+    # template hooks for schedule subclasses (zero-bubble overrides both)
+    def _backward_context(self):
+        return contextlib.nullcontext()
+
+    def _before_step(self):
+        pass
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """Micro-batch loop (reference train_batch pipeline_parallel.py:940)."""
         x, y = data
@@ -93,18 +103,20 @@ class PipelineParallel(nn.Layer):
         total = None
         net = self._sub_layers["_layers"]
         loss_fn = getattr(net, "_loss_fn", None)
-        for i in range(0, bsz, micro):
-            xb = x[i:i + micro]
-            yb = y[i:i + micro]
-            out = net(xb)
-            loss = loss_fn(out, yb) if loss_fn is not None else out.mean()
-            scaled = loss * (micro / bsz)
-            if scaler is not None:
-                scaler.scale(scaled).backward()
-            else:
-                scaled.backward()
-            total = float(scaled.item()) if total is None \
-                else total + float(scaled.item())
+        with self._backward_context():
+            for i in range(0, bsz, micro):
+                xb = x[i:i + micro]
+                yb = y[i:i + micro]
+                out = net(xb)
+                loss = loss_fn(out, yb) if loss_fn is not None else out.mean()
+                scaled = loss * (micro / bsz)
+                if scaler is not None:
+                    scaler.scale(scaled).backward()
+                else:
+                    scaled.backward()
+                total = float(scaled.item()) if total is None \
+                    else total + float(scaled.item())
+        self._before_step()
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()
@@ -123,3 +135,109 @@ class PipelineParallel(nn.Layer):
         if compute_loss and loss_fn is not None:
             return loss_fn(out, y)
         return out
+
+
+class WeightGradStore:
+    """Deferred weight-gradient queue (reference:
+    passes/pipeline_scheduler_pass/pipeline_zero_bubble.py WeightGradStore
+    — the B step computes only activation grads; W-grad matmuls are queued
+    and drained into the pipeline bubble)."""
+
+    _queue = []
+
+    @classmethod
+    def put(cls, fn):
+        cls._queue.append(fn)
+
+    @classmethod
+    def size(cls):
+        return len(cls._queue)
+
+    @classmethod
+    def flush(cls):
+        q, cls._queue = cls._queue, []
+        for fn in q:
+            fn()
+
+    @classmethod
+    def clear(cls):
+        cls._queue = []
+
+
+@contextlib.contextmanager
+def split_weight_grad():
+    """While active, F.linear records only the dX path in the tape; the
+    dW = x^T·g (and db) matmuls are queued on WeightGradStore, to be
+    flushed later (reference split_matmul_grad_to_matmul — only
+    matmul-class ops are split, exactly as here)."""
+    import jax.numpy as jnp
+    from ...core.dispatch import apply_op
+    from ...nn.functional import common as F_common
+    from ...nn import functional as F_ns
+
+    orig = F_common.linear
+
+    def zb_linear(x, weight, bias=None):
+        w_arr = weight.data
+        diff_any = (not x.stop_gradient) or (
+            bias is not None and not bias.stop_gradient)
+        if not diff_any:
+            # no cotangent will ever flow through y's tape edge, so the
+            # deferred-dW hook could never fire — use the joint path
+            return orig(x, weight, bias)
+        if weight.stop_gradient or weight._node is not None:
+            # split only LEAF weights: a derived weight (cast/transpose/
+            # fake-quant temporary) must keep its derivation on the tape,
+            # else the deferred dW lands on the temporary and the real
+            # parameter never sees it
+            return orig(x, weight, bias)
+
+        # weight stays OFF the tape (w_arr is a closed-over array); x and
+        # bias record normally so the node exists and dL/dy reaches the
+        # output's hooks
+        if bias is None:
+            y = apply_op("linear_zb_dx",
+                         lambda a: jnp.matmul(a, w_arr), (x,), {})
+        else:
+            y = apply_op("linear_zb_dx",
+                         lambda a, b: jnp.matmul(a, w_arr) + b,
+                         (x, bias), {})
+        x_saved = x.data
+
+        def capture(g):
+            g_arr = g.data
+
+            def dw():
+                weight._deposit_grad(
+                    jnp.einsum("...i,...o->io", x_saved, g_arr))
+
+            if not weight.stop_gradient:
+                WeightGradStore.put(dw)
+            return None  # leave the flowing cotangent untouched
+
+        y.register_hook(capture)
+        return y
+
+    F_common.linear = zb_linear
+    F_ns.linear = zb_linear
+    try:
+        yield
+    finally:
+        F_common.linear = orig
+        F_ns.linear = orig
+
+
+class ZeroBubblePipelineParallel(PipelineParallel):
+    """Eager zero-bubble schedule (reference pipeline_zero_bubble.py:62
+    ZBH1): per microbatch run F then B (activation grads only, via
+    split_weight_grad); the deferred W matmuls drain after the last B —
+    the work that fills the reference's pipeline bubble. Numerics are
+    identical to the standard schedule (verified by the grad-equality
+    test); only the micro-loop hooks differ from PipelineParallel."""
+
+    def _backward_context(self):
+        WeightGradStore.clear()
+        return split_weight_grad()
+
+    def _before_step(self):
+        WeightGradStore.flush()     # W step: fills the bubble

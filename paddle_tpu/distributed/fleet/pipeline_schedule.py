@@ -1,0 +1,172 @@
+"""Compiled pipeline-parallel schedules over the `pipe` mesh axis.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py:684 (1F1B), :1308
+(interleaved VPP), passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62
+(zero-bubble) — there, host-driven loops issuing NCCL p2p per microbatch.
+
+TPU-native design: the whole schedule is ONE compiled XLA program.
+Each pipe-axis device holds its stage's (stacked) parameters; a lax.scan
+over ticks moves activations between ring neighbours with lax.ppermute,
+and microbatches stream through. The backward pipeline never has to be
+written by hand: jax.grad transposes the scan+ppermute program, which IS
+the reverse schedule (ppermute transposes to the opposite shift), and
+XLA's latency-hiding scheduler overlaps the transfers. The zero-bubble
+dX/dW split lives in the eager schedule (pipeline_parallel's
+WeightGradStore); in the compiled path XLA already floats weight-grad
+matmuls into the bubbles.
+
+Layout contract: stage parameters are stacked on a leading axis sharded
+over the pipe axis — size n_stages (1F1B) or n_stages*v_chunks ordered by
+global stage id g = chunk*S + stage (interleaved). Microbatches are
+[n_micro, micro_bsz, ...], replicated; outputs likewise.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _collect(outs, is_owner, axis):
+    """Replicate `outs` from the owning stage: mask + psum (ppermute can't
+    broadcast — duplicate sources are not a permutation)."""
+    return lax.psum(jnp.where(is_owner, outs, jnp.zeros_like(outs)), axis)
+
+
+def pipeline_1f1b(stage_fn, mesh, axis="pipe", checkpoint_stages=True):
+    """Build a compiled GPipe-class pipeline runner (fill-drain schedule;
+    with jax.grad the transposed program realizes 1F1B's compute order
+    under XLA scheduling).
+
+    stage_fn(stage_params, x) -> y : one stage's forward on one microbatch
+    (same signature for every stage — the homogeneous transformer-block
+    contract the reference's uniform segmentation also assumes).
+
+    Returns run(stacked_params, microbatches) -> outputs where
+    stacked_params has leading axis n_stages (sharded over `axis`) and
+    microbatches is [n_micro, micro_bsz, ...] (replicated); outputs is the
+    LAST stage's [n_micro, ...], replicated.
+    """
+    jm = mesh.jax_mesh
+    n_stages = mesh.get_dim_size(axis)
+
+    def runner(stacked_params, micro):
+        def local(params, xs):
+            params = jax.tree_util.tree_map(lambda a: a[0], params)
+            n_micro = xs.shape[0]
+            sid = lax.axis_index(axis)
+            total = n_micro + n_stages - 1
+            fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
+
+            def tick(carry, t):
+                state, outs = carry
+                inject = xs[jnp.clip(t, 0, n_micro - 1)]
+                x_in = jnp.where(sid == 0, inject, state)
+                y = fn(params, x_in)
+                m = t - (n_stages - 1)
+                write = (sid == n_stages - 1) & (m >= 0)
+                outs = lax.cond(
+                    write,
+                    lambda o: lax.dynamic_update_index_in_dim(
+                        o, y, jnp.clip(m, 0, n_micro - 1), 0),
+                    lambda o: o, outs)
+                state = lax.ppermute(
+                    y, axis, [(i, (i + 1) % n_stages)
+                              for i in range(n_stages)])
+                return (state, outs), None
+
+            state0 = jnp.zeros_like(xs[0])
+            outs0 = jnp.zeros_like(xs)
+            (_, outs), _ = lax.scan(tick, (state0, outs0),
+                                    jnp.arange(total))
+            return _collect(outs, sid == n_stages - 1, axis)
+
+        return shard_map(
+            local, mesh=jm,
+            in_specs=(P(axis), P()),
+            out_specs=P(),
+            check_vma=False)(stacked_params, micro)
+
+    return runner
+
+
+def pipeline_interleaved(stage_fn, mesh, v_chunks, axis="pipe",
+                         checkpoint_stages=True):
+    """Circular / interleaved virtual-pipeline schedule (reference VPP).
+
+    Each device owns v_chunks chunks: global stage g = chunk*S + device.
+    Per-device iteration n processes microbatch m = (n % S) + S*(n//(S*V))
+    on chunk c = (n // S) % V — microbatches stream in groups of S through
+    all V laps before the next group enters, which keeps every device busy
+    after fill and cuts the bubble fraction to (S-1)/(n_micro*V).
+
+    The ring dataflow needs no special wrap handling: device d+1 consumes
+    at global tick t+1 what device d produced at tick t, including the
+    S-1 -> 0 wrap between laps; device 0 overrides its input with a fresh
+    microbatch exactly when its current chunk is 0.
+    """
+    jm = mesh.jax_mesh
+    n_stages = mesh.get_dim_size(axis)
+
+    def runner(stacked_params, micro):
+        def local(params, xs):
+            # params: [v_chunks, ...] — this device's chunk stack
+            n_micro = xs.shape[0]
+            sid = lax.axis_index(axis)
+            S, V = n_stages, v_chunks
+            local_iters = ((n_micro + S - 1) // S) * S * V
+            total = local_iters + S - 1
+            fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
+
+            def tick(carry, t):
+                state, outs = carry
+                n = t - sid                       # this device's local iter
+                nc = jnp.clip(n, 0, local_iters - 1)
+                m = (nc % S) + S * (nc // (S * V))
+                c = (nc // S) % V
+                p_c = jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_index_in_dim(a, c, 0,
+                                                       keepdims=False),
+                    params)
+                inject = xs[jnp.clip(m, 0, n_micro - 1)]
+                x_in = jnp.where((sid == 0) & (c == 0), inject, state)
+                y = fn(p_c, x_in)
+                write = ((sid == S - 1) & (c == V - 1) & (n >= 0)
+                         & (n < local_iters) & (m < n_micro))
+                outs = lax.cond(
+                    write,
+                    lambda o: lax.dynamic_update_index_in_dim(
+                        o, y, jnp.clip(m, 0, n_micro - 1), 0),
+                    lambda o: o, outs)
+                state = lax.ppermute(
+                    y, axis, [(i, (i + 1) % S) for i in range(S)])
+                return (state, outs), None
+
+            state0 = jnp.zeros_like(xs[0])
+            outs0 = jnp.zeros_like(xs)
+            (_, outs), _ = lax.scan(tick, (state0, outs0),
+                                    jnp.arange(total))
+            return _collect(outs, sid == S - 1, axis)
+
+        def arrange(a):
+            # [S*V, ...] in global-stage order (g = c*S + d) -> row-block
+            # layout where device d's block holds its V chunks in order
+            S, V = n_stages, v_chunks
+            rest = a.shape[1:]
+            return a.reshape(V, S, *rest).swapaxes(0, 1).reshape(
+                S * V, *rest)
+
+        arranged = jax.tree_util.tree_map(arrange, stacked_params)
+        return shard_map(
+            local, mesh=jm,
+            in_specs=(P(axis), P()),
+            out_specs=P(),
+            check_vma=False)(arranged, micro)
+
+    return runner
+
+
+def stack_stage_params(per_stage_params):
+    """Helper: list of per-stage pytrees (same structure/shapes) -> stacked
+    pytree with leading stage axis, ready to shard over the pipe axis."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *per_stage_params)
