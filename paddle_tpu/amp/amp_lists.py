@@ -7,7 +7,7 @@ pinned to fp32 (reductions, exp/log chains, losses, norms).
 
 WHITE_LIST = {
     "matmul", "mm", "bmm", "addmm", "mv", "inner", "outer", "einsum",
-    "linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "linear", "linear_zb_dx", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
     "conv2d_transpose", "conv3d_transpose", "flash_attention",
     "scaled_dot_product_attention", "fused_rotary_position_embedding",
     "fused_gemm_epilogue",
@@ -25,7 +25,8 @@ BLACK_LIST = {
 }
 
 # OD ("default") mode: only explicitly white ops are cast down
-_OD_WHITE = {"matmul", "mm", "bmm", "conv2d", "linear", "flash_attention"}
+_OD_WHITE = {"matmul", "mm", "bmm", "conv2d", "linear", "linear_zb_dx",
+             "flash_attention"}
 
 
 def _get_lists(level):

@@ -137,39 +137,72 @@ class PipelineParallel(nn.Layer):
         return out
 
 
+import threading as _threading
+
+_tls = _threading.local()
+
+
 class WeightGradStore:
     """Deferred weight-gradient queue (reference:
     passes/pipeline_scheduler_pass/pipeline_zero_bubble.py WeightGradStore
     — the B step computes only activation grads; W-grad matmuls are queued
-    and drained into the pipeline bubble)."""
+    and drained into the pipeline bubble).
 
-    _queue = []
+    The default queue is per-thread so concurrent schedules can't drop
+    each other's gradients; a ZeroBubblePipelineParallel additionally owns
+    a private store instance."""
 
-    @classmethod
-    def put(cls, fn):
-        cls._queue.append(fn)
+    def __init__(self):
+        self._q = []
 
-    @classmethod
-    def size(cls):
-        return len(cls._queue)
+    # -- instance API ------------------------------------------------------
+    def _put(self, fn):
+        self._q.append(fn)
 
-    @classmethod
-    def flush(cls):
-        q, cls._queue = cls._queue, []
+    def _size(self):
+        return len(self._q)
+
+    def _flush(self):
+        q, self._q = self._q, []
         for fn in q:
             fn()
 
+    def _clear(self):
+        self._q = []
+
+    # -- class-level API over the per-thread default store (reference's
+    # module-global usage pattern) ----------------------------------------
+    @classmethod
+    def _default(cls):
+        store = getattr(_tls, "wgs", None)
+        if store is None:
+            store = _tls.wgs = cls()
+        return store
+
+    @classmethod
+    def put(cls, fn):
+        cls._default()._put(fn)
+
+    @classmethod
+    def size(cls):
+        return cls._default()._size()
+
+    @classmethod
+    def flush(cls):
+        cls._default()._flush()
+
     @classmethod
     def clear(cls):
-        cls._queue = []
+        cls._default()._clear()
 
 
 @contextlib.contextmanager
-def split_weight_grad():
+def split_weight_grad(store=None):
     """While active, F.linear records only the dX path in the tape; the
-    dW = x^T·g (and db) matmuls are queued on WeightGradStore, to be
-    flushed later (reference split_matmul_grad_to_matmul — only
-    matmul-class ops are split, exactly as here)."""
+    dW = x^T·g (and db) matmuls are queued on `store` (default: the
+    per-thread WeightGradStore), to be flushed later (reference
+    split_matmul_grad_to_matmul — only matmul-class ops are split,
+    exactly as here)."""
     import jax.numpy as jnp
     from ...core.dispatch import apply_op
     from ...nn.functional import common as F_common
@@ -212,7 +245,10 @@ def split_weight_grad():
                     jnp.einsum("...i,...o->io", x_saved, g_arr))
 
             if not weight.stop_gradient:
-                WeightGradStore.put(dw)
+                if store is None:
+                    WeightGradStore.put(dw)
+                else:
+                    store._put(dw)
             return None  # leave the flowing cotangent untouched
 
         y.register_hook(capture)
@@ -236,8 +272,12 @@ class ZeroBubblePipelineParallel(PipelineParallel):
     test); only the micro-loop hooks differ from PipelineParallel."""
 
     def _backward_context(self):
-        WeightGradStore.clear()
-        return split_weight_grad()
+        # private store: concurrent models/threads cannot drop or steal
+        # each other's deferred gradients
+        if not hasattr(self, "_wgs"):
+            self._wgs = WeightGradStore()
+        self._wgs._clear()
+        return split_weight_grad(store=self._wgs)
 
     def _before_step(self):
-        WeightGradStore.flush()     # W step: fills the bubble
+        self._wgs._flush()     # W step: fills the bubble

@@ -1,2 +1,356 @@
-"""Fused-op python bindings land here (reference: python/paddle/incubate/
-nn/functional/). Populated by the fused/Pallas tier."""
+"""Fused-op python bindings (reference: python/paddle/incubate/nn/
+functional/ — fused_multi_head_attention, fused_feedforward,
+fused_rotary_position_embedding, masked_multihead_attention,
+block_multihead_attention; kernels in paddle/phi/kernels/fusion/gpu/,
+SURVEY.md §2.9).
+
+On TPU the "fusion" is either a Pallas kernel (attention family) or a
+jnp composition XLA fuses on its own (rope/bias_act/dropout_add — the MXU
+epilogue fusions the reference hand-writes in CUDA)."""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import apply_op
+from ....nn.functional.rope import fused_rotary_position_embedding  # noqa: F401
+
+NEG_INF_F = -1e30
+
+__all__ = [
+    "fused_multi_head_attention", "fused_feedforward", "fused_bias_act",
+    "fused_dropout_add", "fused_bias_dropout_residual_layer_norm",
+    "fused_rotary_position_embedding", "masked_multihead_attention",
+    "block_multihead_attention", "fused_linear_param_grad_add",
+    "flashmask_attention",
+]
+
+
+def _ln(h, eps, scale=None, bias=None):
+    mu = h.mean(-1, keepdims=True)
+    var = ((h - mu) ** 2).mean(-1, keepdims=True)
+    out = (h - mu) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, num_heads=None):
+    """Reference fused_attention_kernel.cu semantics: [pre-LN] -> QKV proj
+    -> MHA -> out proj -> residual add [-> post-LN]. One traced graph —
+    XLA fuses what the CUDA megakernel fuses by hand."""
+    mask_arr = attn_mask.data if attn_mask is not None else None
+    from ....core import random as _random
+
+    def impl(xa, qkvw, lw, *rest):
+        it = iter(rest)
+        cache = next(it) if cache_kv is not None else None
+        plns = next(it) if pre_ln_scale is not None else None
+        plnb = next(it) if pre_ln_bias is not None else None
+        qb = next(it) if qkv_bias is not None else None
+        lb = next(it) if linear_bias is not None else None
+        lns = next(it) if ln_scale is not None else None
+        lnb = next(it) if ln_bias is not None else None
+
+        h = _ln(xa, pre_ln_epsilon, plns, plnb) if pre_layer_norm else xa
+        b, s, dm = h.shape
+        # qkv_weight: [3, num_heads, head_dim, dim] (reference layout)
+        nh, hd = qkvw.shape[1], qkvw.shape[2]
+        qkv = jnp.einsum("bsd,tnhd->tbsnh", h, qkvw,
+                         preferred_element_type=jnp.float32).astype(h.dtype)
+        if qb is not None:
+            qkv = qkv + qb.reshape(3, 1, 1, nh, hd)
+        q, k, v = qkv[0], qkv[1], qkv[2]          # [B, S, H, hd]
+        new_cache = None
+        if cache is not None:
+            # decode: attend over cached K/V ++ current chunk and return
+            # the extended cache (reference CacheKV branch)
+            k = jnp.concatenate([cache[0], k], axis=1)
+            v = jnp.concatenate([cache[1], v], axis=1)
+            new_cache = jnp.stack([k, v])
+        scale = 1.0 / math.sqrt(hd)
+        logits = jnp.einsum("bsnh,btnh->bnst", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        if mask_arr is not None:
+            logits = logits + mask_arr.astype(logits.dtype)
+        p = jax.nn.softmax(logits, axis=-1)
+        if training and attn_dropout_rate > 0.0:
+            keep = jax.random.bernoulli(_random.next_key(),
+                                        1.0 - attn_dropout_rate, p.shape)
+            p = jnp.where(keep, p / (1.0 - attn_dropout_rate), 0.0)
+        ctx = jnp.einsum("bnst,btnh->bsnh", p,
+                         v.astype(jnp.float32)).astype(h.dtype)
+        out = jnp.einsum("bse,ed->bsd", ctx.reshape(b, s, nh * hd), lw)
+        if lb is not None:
+            out = out + lb
+        if training and dropout_rate > 0.0:
+            keep = jax.random.bernoulli(_random.next_key(),
+                                        1.0 - dropout_rate, out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout_rate), 0.0)
+        out = xa + out                             # residual
+        if not pre_layer_norm:
+            out = _ln(out, ln_epsilon, lns, lnb)
+        return out if new_cache is None else (out, new_cache)
+
+    args = [x, qkv_weight, linear_weight]
+    for t in (cache_kv, pre_ln_scale, pre_ln_bias, qkv_bias, linear_bias,
+              ln_scale, ln_bias):
+        if t is not None:
+            args.append(t)
+    return apply_op("fused_multi_head_attention", impl, tuple(args), {})
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True):
+    """Reference fused_feedforward_kernel.cu: [pre-LN] -> FC1 -> act ->
+    FC2 -> residual [-> post-LN]."""
+    def impl(xa, w1, w2, *rest):
+        it = iter(rest)
+        b1 = next(it) if linear1_bias is not None else None
+        b2 = next(it) if linear2_bias is not None else None
+        s1 = next(it) if ln1_scale is not None else None
+        sb1 = next(it) if ln1_bias is not None else None
+        s2 = next(it) if ln2_scale is not None else None
+        sb2 = next(it) if ln2_bias is not None else None
+
+        from ....core import random as _random
+
+        def _drop(t, rate):
+            if not training or rate <= 0.0:
+                return t
+            keep = jax.random.bernoulli(_random.next_key(), 1.0 - rate,
+                                        t.shape)
+            return jnp.where(keep, t / (1.0 - rate), 0.0)
+
+        h = _ln(xa, ln1_epsilon, s1, sb1) if pre_layer_norm else xa
+        h = jnp.einsum("...d,de->...e", h, w1)
+        if b1 is not None:
+            h = h + b1
+        act = {"relu": jax.nn.relu,
+               "gelu": lambda t: jax.nn.gelu(t, approximate=False),
+               "silu": jax.nn.silu}[activation]
+        h = _drop(act(h), dropout1_rate)
+        h = jnp.einsum("...e,ed->...d", h, w2)
+        if b2 is not None:
+            h = h + b2
+        out = xa + _drop(h, dropout2_rate)
+        if not pre_layer_norm:
+            out = _ln(out, ln2_epsilon, s2, sb2)
+        return out
+
+    args = [x, linear1_weight, linear2_weight]
+    for t in (linear1_bias, linear2_bias, ln1_scale, ln1_bias, ln2_scale,
+              ln2_bias):
+        if t is not None:
+            args.append(t)
+    return apply_op("fused_feedforward", impl, tuple(args), {})
+
+
+def fused_bias_act(x, bias=None, act_method="gelu"):
+    """Reference fused_bias_act_kernel.cu (plain and gated activations)."""
+    def impl(xa, *rest):
+        h = xa + rest[0] if rest else xa
+        if act_method in ("geglu", "swiglu"):
+            a, b = jnp.split(h, 2, axis=-1)
+            base = jax.nn.gelu if act_method == "geglu" else jax.nn.silu
+            return base(a) * b
+        act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+               "silu": jax.nn.silu}[act_method]
+        return act(h)
+
+    args = (x,) if bias is None else (x, bias)
+    return apply_op("fused_bias_act", impl, args, {})
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
+    """Reference fused_dropout_add_kernel.cu: dropout(x) + y."""
+    from ....core import random as _random
+
+    def impl(xa, ya):
+        if mode == "downscale_in_infer":
+            # train: drop without rescale; infer: scale by (1-p)
+            if not training:
+                return xa * (1.0 - p) + ya
+            if p == 0.0:
+                return xa + ya
+            keep = jax.random.bernoulli(_random.next_key(), 1.0 - p,
+                                        xa.shape)
+            return jnp.where(keep, xa, 0.0) + ya
+        if not training or p == 0.0:
+            return xa + ya
+        keep = jax.random.bernoulli(_random.next_key(), 1.0 - p, xa.shape)
+        return jnp.where(keep, xa / (1.0 - p), 0.0) + ya
+
+    return apply_op("fused_dropout_add", impl, (x, y), {})
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.0, ln_epsilon=1e-5,
+                                           training=True):
+    """Reference fused_bias_dropout_residual_layer_norm_kernel.cu."""
+    from ....core import random as _random
+
+    def impl(xa, res, *rest):
+        it = iter(rest)
+        b = next(it) if bias is not None else None
+        s = next(it) if ln_scale is not None else None
+        lb = next(it) if ln_bias is not None else None
+        h = xa if b is None else xa + b
+        if training and dropout_rate > 0.0:
+            keep = jax.random.bernoulli(_random.next_key(),
+                                        1.0 - dropout_rate, h.shape)
+            h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0)
+        return _ln(h + res, ln_epsilon, s, lb)
+
+    args = [x, residual]
+    for t in (bias, ln_scale, ln_bias):
+        if t is not None:
+            args.append(t)
+    return apply_op("fused_bias_dropout_residual_layer_norm", impl,
+                    tuple(args), {})
+
+
+def fused_linear_param_grad_add(x, dout, dweight=None, dbias=None,
+                                multi_precision=True, has_bias=True):
+    """Reference fused_linear_param_grad_add_kernel.cu: dW += x^T·dout
+    (and db += sum(dout)) fused into gradient accumulation — the building
+    block sharding/auto-parallel use for param-grad accumulation."""
+    def impl(xa, doa, *rest):
+        it = iter(rest)
+        dw = next(it) if dweight is not None else None
+        db = next(it) if dbias is not None else None
+        # accumulate in f32 always (MXU-native); emit f32 master grads
+        # under multi_precision, else the incoming grad dtype
+        out_t = jnp.float32 if multi_precision else doa.dtype
+        dW = jnp.einsum("...i,...o->io", xa.astype(jnp.float32),
+                        doa.astype(jnp.float32))
+        if dw is not None:
+            dW = dw.astype(jnp.float32) + dW
+        outs = [dW.astype(out_t)]
+        if has_bias:
+            red = tuple(range(doa.ndim - 1))
+            dB = doa.astype(jnp.float32).sum(axis=red)
+            if db is not None:
+                dB = db.astype(jnp.float32) + dB
+            outs.append(dB.astype(out_t))
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    args = [x, dout]
+    for t in (dweight, dbias):
+        if t is not None:
+            args.append(t)
+    return apply_op("fused_linear_param_grad_add", impl, tuple(args), {},
+                    differentiable=False)
+
+
+def masked_multihead_attention(x, cache_kv, seq_lens, src_mask=None,
+                               **kwargs):
+    """Decode-step MHA over a contiguous KV cache (reference
+    masked_multihead_attention_kernel.cu). x: [B, 3*H*D] fused qkv of the
+    new token; cache_kv: [2, B, H, S_max, D]; seq_lens: [B] current
+    lengths; src_mask (optional): additive logits bias broadcastable to
+    [B, H, S_max] (e.g. -inf at excluded slots, or ALiBi biases).
+    Returns (out [B, H*D], updated cache_kv)."""
+    def impl(xa, cache, lens, *rest):
+        two, b, h, smax, d = cache.shape
+        qkv = xa.reshape(b, 3, h, d)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        bidx = jnp.arange(b)
+        kc = cache[0].at[bidx, :, lens].set(k_new)
+        vc = cache[1].at[bidx, :, lens].set(v_new)
+        scale = 1.0 / math.sqrt(d)
+        s = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                       kc.astype(jnp.float32)) * scale
+        if rest:
+            s = s + rest[0].reshape(b, -1, smax).astype(s.dtype)
+        pos = jnp.arange(smax)[None, None, :]
+        s = jnp.where(pos <= lens[:, None, None], s, NEG_INF_F)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", p,
+                         vc.astype(jnp.float32)).astype(xa.dtype)
+        return out.reshape(b, h * d), jnp.stack([kc, vc])
+
+    args = (x, cache_kv, seq_lens)
+    if src_mask is not None:
+        args = args + (src_mask,)
+    return apply_op("masked_multihead_attention", impl, args, {},
+                    differentiable=False)
+
+
+def block_multihead_attention(qkv, k_cache, v_cache, block_tables,
+                              context_lens, scale=None):
+    """Paged-cache decode attention (reference
+    block_multi_head_attention_kernel.cu). qkv: [B, 3, H, D] for the new
+    token; caches [KVH, num_blocks, block_size, D] (KVH == H or a divisor
+    for GQA — the kv slice of qkv uses heads [0:KVH]). Appends the token,
+    then attends via the Pallas paged kernel. Returns
+    (out [B, H, D], k_cache, v_cache)."""
+    from ....ops.pallas.paged_attention import (paged_attention,
+                                               update_paged_kv_cache)
+
+    def impl(qkv_a, kc, vc, tables, lens):
+        kvh = kc.shape[0]
+        q, k_new, v_new = qkv_a[:, 0], qkv_a[:, 1], qkv_a[:, 2]
+        if q.shape[1] != kvh:
+            k_new = k_new[:, :kvh]
+            v_new = v_new[:, :kvh]
+        kc, vc = update_paged_kv_cache(kc, vc, k_new, v_new, tables, lens)
+        out = paged_attention(q, kc, vc, tables, lens + 1, scale=scale)
+        return out, kc, vc
+
+    return apply_op("block_multihead_attention", impl,
+                    (qkv, k_cache, v_cache, block_tables, context_lens),
+                    {}, differentiable=False)
+
+
+def flashmask_attention(query, key, value, startend_row_indices,
+                        causal=True):
+    """FlashMask sparse-interval attention (reference
+    flash_attention.py:1299) — Pallas kernel on TPU (or interpret mode),
+    dense-mask XLA fallback elsewhere. Layout [B, S, H, D]."""
+    from ....ops.pallas import flash_attention as _fa
+    from ....ops.pallas.flashmask import flashmask_attention_bshd
+
+    on_tpu = jax.devices()[0].platform == "tpu" or _fa._INTERPRET
+
+    def impl(q, k, v, idx):
+        if on_tpu:
+            return flashmask_attention_bshd(q, k, v, idx, causal=causal)
+        # dense fallback: materialize the interval mask
+        b, s, hq, d = q.shape
+        sr = idx[..., 0]
+        er = idx[..., 1] if idx.shape[-1] > 1 else jnp.full_like(sr, s)
+        if sr.shape[1] != hq:
+            sr = jnp.repeat(sr, hq // sr.shape[1], axis=1)
+            er = jnp.repeat(er, hq // er.shape[1], axis=1)
+        rows = jnp.arange(s)[:, None]
+        cols = jnp.arange(s)[None, :]
+        allowed = jnp.ones((s, s), bool) if not causal else rows >= cols
+        allowed = allowed[None, None] & ~(
+            (rows[None, None] >= sr[:, :, None, :])
+            & (rows[None, None] < er[:, :, None, :]))
+        logits = jnp.einsum("bshd,bthd->bhst", q, k,
+                            preferred_element_type=jnp.float32) \
+            / math.sqrt(d)
+        logits = jnp.where(allowed, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        p = jnp.where(allowed.any(-1, keepdims=True), p, 0.0)
+        return jnp.einsum("bhst,bthd->bshd", p,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+    return apply_op("flashmask_attention", impl,
+                    (query, key, value, startend_row_indices), {})

@@ -1,0 +1,348 @@
+"""FlashMask attention: flash attention with column-wise masked row
+intervals, as a Pallas TPU kernel.
+
+Reference: python/paddle/nn/functional/flash_attention.py:1299
+(flashmask_attention) — the long-context sparse-mask attention where each
+key column j carries a [start_j, end_j) row interval that is MASKED OUT
+(on top of the causal mask). startend_row_indices [B, KVH, S, 1] means
+end = seq_len (mask everything at/below start_j); [..., 2] gives both.
+This expresses document masking, sliding windows, causal-document masks
+etc. in O(S) mask storage instead of O(S^2).
+
+Kernel structure mirrors ops/pallas/flash_attention.py (online softmax
+fwd; two-pass bwd over the saved logsumexp); the interval mask is applied
+per key block from two [block_k] vectors streamed through VMEM, and key
+blocks that the interval fully masks for every query row in the block are
+skipped entirely (the flashmask speedup).
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, LANES,
+                              LSE_LANES, NEG_INF, _interpret_mode,
+                              _pick_block)
+
+SUBLANES = 8  # int32 mask vectors ride one (8, 128) tile per key block
+
+
+def _mask_block(s, q_start, k_start, block_q, block_k, seq_len, causal,
+                start_row, end_row):
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32,
+                                              (block_q, block_k), 0)
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32,
+                                              (block_q, block_k), 1)
+    allowed = cols < seq_len
+    if causal:
+        allowed &= rows >= cols
+    # interval [start_j, end_j) is masked out
+    sr = start_row[None, :]
+    er = end_row[None, :]
+    allowed &= ~((rows >= sr) & (rows < er))
+    return jnp.where(allowed, s, NEG_INF)
+
+
+def _block_live(q_start, block_q, start_row, end_row, causal, k_start,
+                block_k, seq_len):
+    """Can any (row, col) in this tile be unmasked? The tile is dead iff
+    every row lies inside every valid column's masked interval:
+    rows_lo >= max(start_j) and rows_hi < min(end_j). Padded lanes (cols
+    >= seq_len) are excluded from the extremes so they can't fake
+    liveness decisions."""
+    cols = k_start + jax.lax.iota(jnp.int32, block_k)
+    valid = cols < seq_len
+    start_max = jnp.max(jnp.where(valid, start_row, 0))
+    end_min = jnp.min(jnp.where(valid, end_row, jnp.iinfo(jnp.int32).max))
+    rows_lo = q_start
+    rows_hi = q_start + block_q - 1
+    dead = (rows_lo >= start_max) & (rows_hi < end_min)
+    live = jnp.logical_not(dead)
+    if causal:
+        live &= k_start <= rows_hi
+    return live
+
+
+def _fm_fwd_kernel(q_ref, k_ref, v_ref, sr_ref, er_ref, o_ref, lse_ref,
+                   acc, m_scr, l_scr, *, scale, causal, block_q, block_k,
+                   seq_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    start_row = sr_ref[0, 0]       # [BK] (sublane-broadcast tile)
+    end_row = er_ref[0, 0]         # [BK]
+
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = _mask_block(s, q_start, k_start, block_q, block_k, seq_len,
+                        causal, start_row, end_row)
+        m_prev = m_scr[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = corr * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc[...] = acc[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    live = _block_live(q_start, block_q, start_row, end_row, causal,
+                       k_start, block_k, seq_len)
+    pl.when(live)(_update)
+
+    @pl.when(ki == nk - 1)
+    def _final():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc[...] / l).astype(o_ref.dtype)
+        lse = m_scr[:, :1] + jnp.log(jnp.where(l_scr[:, :1] == 0.0, 1.0,
+                                               l_scr[:, :1]))
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _fm_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, sr_ref,
+                      er_ref, dq_ref, dq_acc, *, scale, causal, block_q,
+                      block_k, seq_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    start_row = sr_ref[0, 0]
+    end_row = er_ref[0, 0]
+
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, :1]
+        delta = jnp.sum(do * o, axis=1, keepdims=True)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = _mask_block(s, q_start, k_start, block_q, block_k, seq_len,
+                        causal, start_row, end_row)
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    live = _block_live(q_start, block_q, start_row, end_row, causal,
+                       k_start, block_k, seq_len)
+    pl.when(live)(_update)
+
+    @pl.when(ki == nk - 1)
+    def _final():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _fm_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, sr_ref,
+                       er_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
+                       causal, block_q, block_k, seq_len):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    start_row = sr_ref[0, 0]
+    end_row = er_ref[0, 0]
+
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, :1]
+        delta = jnp.sum(do * o, axis=1, keepdims=True)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = _mask_block(s, q_start, k_start, block_q, block_k, seq_len,
+                        causal, start_row, end_row)
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - lse), 0.0)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    live = _block_live(q_start, block_q, start_row, end_row, causal,
+                       k_start, block_k, seq_len)
+    pl.when(live)(_update)
+
+    @pl.when(qi == nq - 1)
+    def _final():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _specs(block_q, block_k, d):
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    mspec = pl.BlockSpec((1, SUBLANES, block_k), lambda b, i, j: (b, 0, j))
+    lspec = pl.BlockSpec((1, block_q, LSE_LANES), lambda b, i, j: (b, i, 0))
+    return qspec, kspec, mspec, lspec
+
+
+def _fm_fwd(q, k, v, sr, er, scale, causal, block_q, block_k):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
+    qspec, kspec, mspec, lspec = _specs(block_q, block_k, d)
+    return pl.pallas_call(
+        functools.partial(_fm_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=sk),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec, kspec, mspec, mspec],
+        out_specs=[qspec, lspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, sq, LSE_LANES), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, LANES), jnp.float32),
+                        pltpu.VMEM((block_q, LANES), jnp.float32)],
+        interpret=_interpret_mode(),
+    )(q, k, v, sr, er)
+
+
+def _fm_bwd(q, k, v, o, lse, do, sr, er, scale, causal, block_q, block_k):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
+    qspec, kspec, mspec, lspec = _specs(block_q, block_k, d)
+    dq = pl.pallas_call(
+        functools.partial(_fm_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=sk),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, qspec, lspec, mspec, mspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret_mode(),
+    )(q, k, v, o, do, lse, sr, er)
+
+    qspec_t = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kspec_t = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    mspec_t = pl.BlockSpec((1, SUBLANES, block_k),
+                           lambda b, j, i: (b, 0, j))
+    lspec_t = pl.BlockSpec((1, block_q, LSE_LANES),
+                           lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_fm_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=sk),
+        grid=(bh, nk, nq),
+        in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, qspec_t, lspec_t,
+                  mspec_t, mspec_t],
+        out_specs=[kspec_t, kspec_t],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=_interpret_mode(),
+    )(q, k, v, o, do, lse, sr, er)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flashmask(q, k, v, sr, er, scale, causal, block_q, block_k):
+    o, _ = _fm_fwd(q, k, v, sr, er, scale, causal, block_q, block_k)
+    return o
+
+
+def _fm_vjp_fwd(q, k, v, sr, er, scale, causal, block_q, block_k):
+    o, lse = _fm_fwd(q, k, v, sr, er, scale, causal, block_q, block_k)
+    return o, (q, k, v, sr, er, o, lse)
+
+
+def _fm_vjp_bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, sr, er, o, lse = res
+    dq, dk, dv = _fm_bwd(q, k, v, o, lse, do, sr, er, scale, causal,
+                         block_q, block_k)
+    return dq, dk, dv, None, None
+
+
+_flashmask.defvjp(_fm_vjp_fwd, _fm_vjp_bwd)
+
+
+def flashmask_attention_bshd(q, k, v, startend_row_indices, causal=True,
+                             scale=None, block_q=DEFAULT_BLOCK_Q,
+                             block_k=DEFAULT_BLOCK_K):
+    """paddle flashmask_attention parity. q/k/v: [B, S, H, D];
+    startend_row_indices: [B, KVH, S, 1] (start; end = seq_len) or
+    [B, KVH, S, 2] (start, end) — the masked row interval per key column.
+    KVH may be 1 (shared mask) or the kv head count."""
+    b, sq, hq, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if hk != hq:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    idx = startend_row_indices
+    if idx.shape[-1] == 1:
+        sr = idx[..., 0]
+        er = jnp.full_like(sr, sq)
+    else:
+        sr = idx[..., 0]
+        er = idx[..., 1]
+    mh = sr.shape[1]
+    if mh != hq:                       # broadcast mask heads to q heads
+        sr = jnp.repeat(sr, hq // mh, axis=1)
+        er = jnp.repeat(er, hq // mh, axis=1)
+    qf = jnp.swapaxes(q, 1, 2).reshape(b * hq, sq, d)
+    kf = jnp.swapaxes(k, 1, 2).reshape(b * hq, sk, d)
+    vf = jnp.swapaxes(v, 1, 2).reshape(b * hq, sk, d)
+    # TPU tiling: stream the per-column vectors as (8, block_k) tiles
+    srf = jnp.broadcast_to(sr.reshape(b * hq, 1, sk).astype(jnp.int32),
+                           (b * hq, SUBLANES, sk))
+    erf = jnp.broadcast_to(er.reshape(b * hq, 1, sk).astype(jnp.int32),
+                           (b * hq, SUBLANES, sk))
+    o = _flashmask(qf, kf, vf, srf, erf, float(scale), bool(causal),
+                   block_q, block_k)
+    return jnp.swapaxes(o.reshape(b, hq, sq, d), 1, 2)

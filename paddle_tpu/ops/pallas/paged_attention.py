@@ -1,0 +1,142 @@
+"""Paged KV-cache decode attention as a Pallas TPU kernel.
+
+Reference: paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu
+(paged/block KV cache) and masked_multihead_attention_kernel.cu (decode
+attention) behind python/paddle/incubate/nn/functional
+block_multihead_attention (SURVEY.md §2.9).
+
+TPU-native shape: the KV cache lives in HBM as fixed-size blocks
+[KVH, num_blocks, block_size, D]; each sequence owns a list of block ids
+(block_tables [B, max_blocks]). The kernel grid is (batch, kv_head,
+block); the block table is a scalar-prefetch operand so each grid step's
+BlockSpec index_map can look up WHICH cache block to DMA next — the
+gather never touches the host. One decode query group (the GQA query
+heads of one kv head) rides VMEM the whole time with f32 online-softmax
+scratch.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import LANES, NEG_INF, _interpret_mode
+
+
+def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc, *, block_size, scale):
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc[...] = jnp.zeros_like(acc)
+
+    ctx_len = lens_ref[b]
+
+    @pl.when(i * block_size < ctx_len)
+    def _update():
+        q = q_ref[0, 0].astype(jnp.float32)          # [G, D]
+        k = k_ref[0, 0].astype(jnp.float32)          # [BS, D]
+        v = v_ref[0, 0].astype(jnp.float32)          # [BS, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [G, BS]
+        pos = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < ctx_len, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[...] = jnp.broadcast_to(
+            corr * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        acc[...] = acc[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(i == nb - 1)
+    def _final():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[...] / l).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
+                    scale=None):
+    """Decode-step attention over a paged KV cache.
+
+    q:            [B, H, D] — one query token per sequence
+    k/v_cache:    [KVH, num_blocks, block_size, D]
+    block_tables: [B, max_blocks_per_seq] int32 cache-block ids
+    context_lens: [B] int32 valid cache length per sequence
+    returns       [B, H, D]
+    """
+    b, h, d = q.shape
+    kvh, nblocks, block_size, _ = k_cache.shape
+    g = h // kvh
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    max_nb = block_tables.shape[1]
+    qg = q.reshape(b, kvh, g, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kvh, max_nb),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d),
+                         lambda bb, hh, ii, tables, lens: (bb, hh, 0, 0)),
+            pl.BlockSpec((1, 1, block_size, d),
+                         lambda bb, hh, ii, tables, lens:
+                         (hh, tables[bb, ii], 0, 0)),
+            pl.BlockSpec((1, 1, block_size, d),
+                         lambda bb, hh, ii, tables, lens:
+                         (hh, tables[bb, ii], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, g, d), lambda bb, hh, ii, tables, lens: (bb, hh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, LANES), jnp.float32),
+            pltpu.VMEM((g, LANES), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_size=block_size,
+                          scale=float(scale)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
+        interpret=_interpret_mode(),
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+      qg, k_cache, v_cache)
+    return out.reshape(b, h, d)
+
+
+def update_paged_kv_cache(k_cache, v_cache, k_new, v_new, block_tables,
+                          context_lens):
+    """Append one decode step's K/V ([B, KVH, D]) into the paged cache at
+    position context_lens (the slot the new token occupies). Returns the
+    updated caches. Pure scatter — XLA keeps it in-place under jit when
+    the caches are donated."""
+    kvh, nb, bs, d = k_cache.shape
+    b = k_new.shape[0]
+    blk_idx = context_lens // bs                      # [B]
+    blk_ids = jnp.take_along_axis(
+        block_tables, blk_idx[:, None], axis=1)[:, 0]  # [B]
+    offs = context_lens % bs                          # [B]
+
+    def upd(cache, new):
+        # scatter [B, KVH, D] into [KVH, NB, BS, D] at (h, blk_ids[b], offs[b])
+        hidx = jnp.arange(kvh)
+        bidx = jnp.arange(b)
+        return cache.at[hidx[None, :], blk_ids[:, None], offs[:, None]].set(
+            new[bidx[:, None], hidx[None, :]])
+
+    return upd(k_cache, k_new), upd(v_cache, v_new)

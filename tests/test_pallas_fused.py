@@ -1,0 +1,278 @@
+"""Pallas kernel + fused-op tests (interpret mode on CPU — the reference
+pattern of testing device kernels without the device, SURVEY.md §4).
+
+Numerics checked against dense numpy/jnp references, including gradients
+for the differentiable kernels."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas import flash_attention as fa
+from paddle_tpu.ops.pallas import flashmask as fm
+from paddle_tpu.ops.pallas import paged_attention as pa
+from paddle_tpu.incubate.nn import functional as FI
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    old = fa._INTERPRET
+    fa._INTERPRET = True
+    yield
+    fa._INTERPRET = old
+
+
+def _dense_flashmask_ref(q, k, v, sr, er, causal):
+    # q,k,v: [B,S,H,D]; sr/er: [B,H,S]
+    b, s, h, d = q.shape
+    qt = np.swapaxes(q, 1, 2).astype(np.float64)
+    kt = np.swapaxes(k, 1, 2).astype(np.float64)
+    vt = np.swapaxes(v, 1, 2).astype(np.float64)
+    logits = qt @ np.swapaxes(kt, -1, -2) / np.sqrt(d)
+    rows = np.arange(s)[:, None]
+    cols = np.arange(s)[None, :]
+    for bi in range(b):
+        for hi in range(h):
+            allowed = np.ones((s, s), bool)
+            if causal:
+                allowed &= rows >= cols
+            interval = (rows >= sr[bi, hi][None, :]) & \
+                (rows < er[bi, hi][None, :])
+            allowed &= ~interval
+            logits[bi, hi] = np.where(allowed, logits[bi, hi], -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / np.maximum(p.sum(-1, keepdims=True), 1e-30)
+    out = p @ vt
+    # fully-masked rows produce zeros (flash kernel contract)
+    dead = (logits <= -1e29).all(-1)
+    out = np.where(dead[..., None], 0.0, out)
+    return np.swapaxes(out, 1, 2).astype(np.float32)
+
+
+class TestFlashMask:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, causal):
+        rng = np.random.default_rng(0)
+        B, S, H, D = 2, 32, 2, 8
+        q, k, v = (rng.standard_normal((B, S, H, D)).astype(np.float32)
+                   for _ in range(3))
+        # document mask: two docs [0,20) and [20,32): key col j of doc 1
+        # masks rows >= 20 is wrong way; flashmask LT doc mask: col j in
+        # doc A masks rows outside doc A below it -> start = doc end
+        starts = np.where(np.arange(S) < 20, 20, S)
+        sr = np.tile(starts[None, None, :], (B, H, 1)).astype(np.int32)
+        er = np.full_like(sr, S)
+        idx = np.stack([sr, er], axis=-1)
+        out = fm.flashmask_attention_bshd(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(idx), causal=causal, block_q=8, block_k=8)
+        ref = _dense_flashmask_ref(q, k, v, sr, er, causal)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3,
+                                   atol=2e-3)
+
+    def test_single_index_means_mask_below_start(self):
+        rng = np.random.default_rng(1)
+        B, S, H, D = 1, 16, 1, 8
+        q, k, v = (rng.standard_normal((B, S, H, D)).astype(np.float32)
+                   for _ in range(3))
+        start = rng.integers(1, S, size=S).astype(np.int32)
+        idx = np.tile(start[None, None, :, None], (B, H, 1, 1))
+        out = fm.flashmask_attention_bshd(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(idx), causal=True, block_q=8, block_k=8)
+        sr = np.tile(start[None, None, :], (B, H, 1))
+        er = np.full_like(sr, S)
+        ref = _dense_flashmask_ref(q, k, v, sr, er, True)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3,
+                                   atol=2e-3)
+
+    def test_gradients_match_dense(self):
+        rng = np.random.default_rng(2)
+        B, S, H, D = 1, 16, 1, 8
+        q, k, v = (rng.standard_normal((B, S, H, D)).astype(np.float32)
+                   for _ in range(3))
+        start = np.where(np.arange(S) < 8, 8, S)
+        idx = np.tile(start[None, None, :, None], (B, H, 1, 1)).astype(
+            np.int32)
+
+        def loss_kernel(q_, k_, v_):
+            o = fm.flashmask_attention_bshd(q_, k_, v_, jnp.asarray(idx),
+                                            causal=True, block_q=8,
+                                            block_k=8)
+            return (o ** 2).sum()
+
+        def loss_dense(q_, k_, v_):
+            s = jnp.einsum("bshd,bthd->bhst", q_, k_) / np.sqrt(D)
+            rows = jnp.arange(S)[:, None]
+            cols = jnp.arange(S)[None, :]
+            allowed = (rows >= cols) & ~(
+                (rows >= jnp.asarray(start)[None, :]) & (rows < S))
+            s = jnp.where(allowed[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhst,bthd->bshd", p, v_)
+            return (o ** 2).sum()
+
+        args = tuple(map(jnp.asarray, (q, k, v)))
+        g1 = jax.grad(loss_kernel, argnums=(0, 1, 2))(*args)
+        g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(*args)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-3)
+
+
+class TestPagedAttention:
+    def _setup(self, B=3, H=4, KVH=2, D=8, BS=8, NB=10, max_nb=4, seed=3):
+        rng = np.random.default_rng(seed)
+        q = rng.standard_normal((B, H, D)).astype(np.float32)
+        k_cache = rng.standard_normal((KVH, NB, BS, D)).astype(np.float32)
+        v_cache = rng.standard_normal((KVH, NB, BS, D)).astype(np.float32)
+        # distinct random blocks per sequence
+        tables = np.stack([rng.choice(NB, max_nb, replace=False)
+                           for _ in range(B)]).astype(np.int32)
+        lens = rng.integers(1, max_nb * BS, size=B).astype(np.int32)
+        return q, k_cache, v_cache, tables, lens
+
+    def _dense_ref(self, q, kc, vc, tables, lens):
+        B, H, D = q.shape
+        KVH, NB, BS, _ = kc.shape
+        G = H // KVH
+        out = np.zeros_like(q)
+        for b in range(B):
+            ks = np.concatenate([kc[:, t] for t in tables[b]], axis=1)
+            vs = np.concatenate([vc[:, t] for t in tables[b]], axis=1)
+            for h in range(H):
+                kv_h = h // G
+                s = ks[kv_h, :lens[b]] @ q[b, h] / np.sqrt(D)
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[b, h] = p @ vs[kv_h, :lens[b]]
+        return out
+
+    def test_matches_dense(self):
+        q, kc, vc, tables, lens = self._setup()
+        out = pa.paged_attention(jnp.asarray(q), jnp.asarray(kc),
+                                 jnp.asarray(vc), jnp.asarray(tables),
+                                 jnp.asarray(lens))
+        ref = self._dense_ref(q, kc, vc, tables, lens)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3,
+                                   atol=2e-3)
+
+    def test_cache_update_then_attend(self):
+        q, kc, vc, tables, lens = self._setup(seed=4)
+        B, H, D = q.shape
+        KVH = kc.shape[0]
+        rng = np.random.default_rng(5)
+        k_new = rng.standard_normal((B, KVH, D)).astype(np.float32)
+        v_new = rng.standard_normal((B, KVH, D)).astype(np.float32)
+        kc2, vc2 = pa.update_paged_kv_cache(
+            jnp.asarray(kc), jnp.asarray(vc), jnp.asarray(k_new),
+            jnp.asarray(v_new), jnp.asarray(tables), jnp.asarray(lens))
+        kc2, vc2 = np.asarray(kc2), np.asarray(vc2)
+        for b in range(B):
+            blk = tables[b, lens[b] // kc.shape[2]]
+            off = lens[b] % kc.shape[2]
+            np.testing.assert_allclose(kc2[:, blk, off], k_new[b])
+            np.testing.assert_allclose(vc2[:, blk, off], v_new[b])
+        out = pa.paged_attention(jnp.asarray(q), jnp.asarray(kc2),
+                                 jnp.asarray(vc2), jnp.asarray(tables),
+                                 jnp.asarray(lens + 1))
+        ref = self._dense_ref(q, kc2, vc2, tables, lens + 1)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3,
+                                   atol=2e-3)
+
+
+class TestFusedOps:
+    def test_masked_multihead_attention_decode(self):
+        rng = np.random.default_rng(6)
+        B, H, SMAX, D = 2, 2, 8, 4
+        cache = rng.standard_normal((2, B, H, SMAX, D)).astype(np.float32)
+        lens = np.array([3, 5], np.int32)
+        x = rng.standard_normal((B, 3 * H * D)).astype(np.float32)
+        out, new_cache = FI.masked_multihead_attention(
+            paddle.to_tensor(x), paddle.to_tensor(cache),
+            paddle.to_tensor(lens))
+        out = out.numpy()
+        nc = new_cache.numpy()
+        qkv = x.reshape(B, 3, H, D)
+        for b in range(B):
+            for h in range(H):
+                ks = np.concatenate([cache[0, b, h, :lens[b]],
+                                     qkv[b, 1, h][None]], 0)
+                vs = np.concatenate([cache[1, b, h, :lens[b]],
+                                     qkv[b, 2, h][None]], 0)
+                s = ks @ qkv[b, 0, h] / np.sqrt(D)
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                np.testing.assert_allclose(
+                    out[b, h * D:(h + 1) * D], p @ vs, rtol=1e-4,
+                    atol=1e-4)
+                np.testing.assert_allclose(nc[0, b, h, lens[b]],
+                                           qkv[b, 1, h], rtol=1e-6)
+
+    def test_fused_feedforward_matches_composition(self):
+        rng = np.random.default_rng(7)
+        x = paddle.to_tensor(rng.standard_normal((2, 4, 8)).astype(
+            np.float32))
+        w1 = paddle.to_tensor(rng.standard_normal((8, 16)).astype(
+            np.float32))
+        w2 = paddle.to_tensor(rng.standard_normal((16, 8)).astype(
+            np.float32))
+        out = FI.fused_feedforward(x, w1, w2, pre_layer_norm=True,
+                                   dropout1_rate=0.0, dropout2_rate=0.0,
+                                   activation="gelu").numpy()
+        h = x.numpy()
+        mu, var = h.mean(-1, keepdims=True), h.var(-1, keepdims=True)
+        hn = (h - mu) / np.sqrt(var + 1e-5)
+        import scipy.special as sp
+        act = hn @ w1.numpy()
+        act = 0.5 * act * (1 + sp.erf(act / np.sqrt(2)))
+        ref = h + act @ w2.numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+    def test_fused_bias_act_swiglu(self):
+        rng = np.random.default_rng(8)
+        x = paddle.to_tensor(rng.standard_normal((4, 16)).astype(
+            np.float32))
+        out = FI.fused_bias_act(x, act_method="swiglu").numpy()
+        a, b = np.split(x.numpy(), 2, axis=-1)
+        ref = (a / (1 + np.exp(-a))) * b
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_linear_param_grad_add_accumulates(self):
+        rng = np.random.default_rng(9)
+        x = paddle.to_tensor(rng.standard_normal((4, 3)).astype(np.float32))
+        dout = paddle.to_tensor(rng.standard_normal((4, 2)).astype(
+            np.float32))
+        dw0 = paddle.to_tensor(np.ones((3, 2), np.float32))
+        db0 = paddle.to_tensor(np.ones((2,), np.float32))
+        dw, db = FI.fused_linear_param_grad_add(x, dout, dw0, db0)
+        np.testing.assert_allclose(
+            dw.numpy(), 1.0 + x.numpy().T @ dout.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(db.numpy(),
+                                   1.0 + dout.numpy().sum(0), rtol=1e-5)
+
+    def test_fused_mha_matches_sdpa(self):
+        rng = np.random.default_rng(10)
+        B, S, NH, HD = 2, 4, 2, 4
+        DM = NH * HD
+        x = paddle.to_tensor(rng.standard_normal((B, S, DM)).astype(
+            np.float32))
+        qkvw = paddle.to_tensor(rng.standard_normal(
+            (3, NH, HD, DM)).astype(np.float32) * 0.2)
+        lw = paddle.to_tensor(rng.standard_normal((DM, DM)).astype(
+            np.float32) * 0.2)
+        out = FI.fused_multi_head_attention(
+            x, qkvw, lw, pre_layer_norm=True).numpy()
+        # reference composition
+        h = x.numpy()
+        mu, var = h.mean(-1, keepdims=True), h.var(-1, keepdims=True)
+        hn = (h - mu) / np.sqrt(var + 1e-5)
+        qkv = np.einsum("bsd,tnhd->tbsnh", hn, qkvw.numpy())
+        q, k, v = qkv
+        logits = np.einsum("bsnh,btnh->bnst", q, k) / np.sqrt(HD)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ctx = np.einsum("bnst,btnh->bsnh", p, v).reshape(B, S, DM)
+        ref = h + ctx @ lw.numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
