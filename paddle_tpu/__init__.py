@@ -50,6 +50,10 @@ from . import fft
 from . import signal
 from . import quantization
 from . import inference
+from . import geometric
+from . import audio
+from . import text
+from . import onnx
 from .hapi import Model, summary
 from .framework import save, load, set_default_dtype, get_default_dtype
 from .utils.flags import set_flags, get_flags

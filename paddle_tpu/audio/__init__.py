@@ -1,0 +1,8 @@
+"""paddle.audio parity (reference: python/paddle/audio/ — functional
+mel/dct utilities and feature Layers: Spectrogram, MelSpectrogram,
+LogMelSpectrogram, MFCC). Composed from paddle_tpu.signal.stft — the
+whole pipeline is one XLA graph."""
+from . import functional
+from . import features
+
+__all__ = ["functional", "features"]

@@ -1,0 +1,83 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py
+— Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from .. import signal as _signal
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self._n_fft = n_fft
+        self._hop = hop_length or n_fft // 4
+        self._win_length = win_length or n_fft
+        self._window = F.get_window(window, self._win_length)
+        self._power = power
+        self._center = center
+        self._pad_mode = pad_mode
+
+    def forward(self, x):
+        spec = _signal.stft(x, self._n_fft, self._hop, self._win_length,
+                            window=self._window, center=self._center,
+                            pad_mode=self._pad_mode)
+        return (spec.abs() ** self._power).astype("float32")
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode)
+        self._fbank = F.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)          # [..., freq, time]
+        from .. import ops
+        return ops.matmul(self._fbank, spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                   window, power, center, pad_mode, n_mels,
+                                   f_min, f_max, htk, norm, dtype)
+        self._ref, self._amin, self._top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        return F.power_to_db(self._mel(x), self._ref, self._amin,
+                             self._top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                         window, power, center, pad_mode,
+                                         n_mels, f_min, f_max, htk, norm,
+                                         ref_value, amin, top_db, dtype)
+        self._dct = F.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        from .. import ops
+        logmel = self._logmel(x)             # [..., n_mels, time]
+        return ops.matmul(self._dct.t(), logmel)

@@ -55,6 +55,12 @@ def _check_nan_inf(name, out):
 
 _amp_hook = None  # installed by paddle_tpu.amp; signature (name, args, kwargs) -> (args, kwargs)
 _op_tracer = None  # installed by paddle_tpu.profiler; signature (name) -> ctx manager
+_static_recorder = None  # installed by paddle_tpu.static.program_guard
+
+
+def set_static_recorder(r):
+    global _static_recorder
+    _static_recorder = r
 
 # ops allowed to consume Partial-placement DTensors (they implement the
 # pending reduction); everything else must reshard first
@@ -113,7 +119,11 @@ def _apply_op_inner(name, impl, args, kwargs, differentiable=True):
             _check_nan_inf(name, out)
         if _flags.benchmark_mode:
             _block_on(out)
-        return _wrap(name, out, node=None)
+        wrapped = _wrap(name, out, node=None)
+        if _static_recorder is not None:
+            _static_recorder(name, impl, treedef, leaves, tensor_idx,
+                             wrapped)
+        return wrapped
 
     diff_idx = [i for i in tensor_idx if not leaves[i].stop_gradient]
     parents = [leaves[i] for i in diff_idx]
@@ -134,7 +144,10 @@ def _apply_op_inner(name, impl, args, kwargs, differentiable=True):
     outs = list(out) if multi else [out]
     node = GradNode(name, vjp_fn, parents,
                     [(o.shape, o.dtype) for o in outs])
-    return _wrap(name, out, node=node)
+    wrapped = _wrap(name, out, node=node)
+    if _static_recorder is not None:
+        _static_recorder(name, impl, treedef, leaves, tensor_idx, wrapped)
+    return wrapped
 
 
 def _wrap(name, out, node):

@@ -29,6 +29,7 @@ from . import auto_parallel
 
 from . import launch
 from . import auto_tuner
+from . import rpc
 
 
 def _spawn_worker(func, args, rank, nprocs, port):

@@ -227,13 +227,16 @@ def split_weight_grad(store=None):
 
         # weight stays OFF the tape (w_arr is a closed-over array); x and
         # bias record normally so the node exists and dL/dy reaches the
-        # output's hooks
+        # output's hooks. The weight follows the (possibly AMP-cast) input
+        # dtype so the matmul hits the MXU in bf16 like the standard path.
+        def _mm(a):
+            w = w_arr.astype(a.dtype) if w_arr.dtype != a.dtype else w_arr
+            return jnp.matmul(a, w)
+
         if bias is None:
-            y = apply_op("linear_zb_dx",
-                         lambda a: jnp.matmul(a, w_arr), (x,), {})
+            y = apply_op("linear_zb_dx", _mm, (x,), {})
         else:
-            y = apply_op("linear_zb_dx",
-                         lambda a, b: jnp.matmul(a, w_arr) + b,
+            y = apply_op("linear_zb_dx", lambda a, b: _mm(a) + b,
                          (x, bias), {})
         x_saved = x.data
 
@@ -242,7 +245,9 @@ def split_weight_grad(store=None):
 
             def dw():
                 weight._deposit_grad(
-                    jnp.einsum("...i,...o->io", x_saved, g_arr))
+                    jnp.einsum("...i,...o->io", x_saved, g_arr,
+                               preferred_element_type=jnp.float32).astype(
+                                   weight.data.dtype))
 
             if not weight.stop_gradient:
                 if store is None:

@@ -1,0 +1,150 @@
+"""paddle.distributed.rpc parity (reference: python/paddle/distributed/rpc/
+over brpc, SURVEY.md §2.8 RPC row).
+
+TPU-native stack: discovery rides the launcher's TCPStore; the transport is
+multiprocessing.connection (authenticated length-prefixed pickle over TCP)
+— a host-side control plane, never on the device path."""
+import os
+import pickle
+import threading
+from multiprocessing.connection import Listener, Client
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_current_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+_AUTH = b"paddle_tpu_rpc"
+_state = {}
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip, port):
+        self.name, self.rank, self.ip, self.port = name, rank, ip, port
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank})"
+
+
+def _serve_loop(listener):
+    while not _state.get("stopping"):
+        try:
+            conn = listener.accept()
+        except (OSError, EOFError):
+            return
+        t = threading.Thread(target=_serve_conn, args=(conn,), daemon=True)
+        t.start()
+
+
+def _serve_conn(conn):
+    try:
+        while True:
+            try:
+                fn, args, kwargs = conn.recv()
+            except (EOFError, OSError):
+                return
+            try:
+                result = fn(*args, **kwargs)
+                conn.send(("ok", result))
+            except Exception as e:  # propagate remote exceptions
+                conn.send(("err", e))
+    finally:
+        conn.close()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start serving and register this worker (reference rpc.init_rpc).
+    Rendezvous: master_endpoint (or PADDLE_MASTER) hosts the TCPStore."""
+    from ... import native
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world_size = world_size or int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                                  "1"))
+    ep = master_endpoint or os.environ.get("PADDLE_MASTER",
+                                           "127.0.0.1:8765")
+    host, _, port = ep.partition(":")
+    store = native.TCPStore(host=host, port=int(port), is_master=(rank == 0))
+    listener = Listener(("0.0.0.0", 0), authkey=_AUTH)
+    my_port = listener.address[1]
+    import socket
+    my_ip = socket.gethostbyname(socket.gethostname()) \
+        if host not in ("127.0.0.1", "localhost") else "127.0.0.1"
+    store.set(f"rpc/{rank}", f"{name}|{my_ip}|{my_port}")
+    serve = threading.Thread(target=_serve_loop, args=(listener,),
+                             daemon=True)
+    serve.start()
+    infos = {}
+    for r in range(world_size):
+        val = store.get(f"rpc/{r}").decode()
+        n, ip, p = val.split("|")
+        infos[n] = WorkerInfo(n, r, ip, int(p))
+    _state.update({"store": store, "listener": listener, "serve": serve,
+                   "name": name, "rank": rank, "world_size": world_size,
+                   "infos": infos, "conns": {}, "stopping": False})
+    store.barrier("rpc_init", world_size)
+
+
+def _conn_to(to):
+    info = _state["infos"][to]
+    conns = _state["conns"]
+    if to not in conns:
+        conns[to] = Client((info.ip, info.port), authkey=_AUTH)
+    return conns[to]
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
+    conn = _conn_to(to)
+    conn.send((fn, tuple(args or ()), dict(kwargs or {})))
+    status, payload = conn.recv()
+    if status == "err":
+        raise payload
+    return payload
+
+
+class _Future:
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._exc = None
+
+    def wait(self, timeout=None):
+        self._event.wait(timeout)
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
+    fut = _Future()
+
+    def run():
+        try:
+            fut._value = rpc_sync(to, fn, args, kwargs, timeout)
+        except Exception as e:
+            fut._exc = e
+        finally:
+            fut._event.set()
+
+    threading.Thread(target=run, daemon=True).start()
+    return fut
+
+
+def get_current_worker_info():
+    return _state["infos"][_state["name"]]
+
+
+def get_all_worker_infos():
+    return list(_state["infos"].values())
+
+
+def shutdown():
+    if not _state:
+        return
+    _state["store"].barrier("rpc_shutdown", _state["world_size"])
+    _state["stopping"] = True
+    for c in _state["conns"].values():
+        c.close()
+    try:
+        _state["listener"].close()
+    except OSError:
+        pass
+    _state["store"].close()
+    _state.clear()

@@ -1,0 +1,140 @@
+"""paddle.geometric parity (reference: python/paddle/geometric/ — graph
+message passing send_u_recv/send_ue_recv/send_uv, segment reductions,
+neighbor sampling; kernels paddle/phi/kernels/gpu/graph_*.cu).
+
+TPU lowering: message passing is gather + segment reduction — XLA-native,
+static shapes (edge lists are fixed-size arrays)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "sample_neighbors", "reindex_graph",
+]
+
+_REDUCES = {"sum", "mean", "max", "min"}
+
+
+def _segment(values, ids, n, pool):
+    if pool == "sum":
+        return jax.ops.segment_sum(values, ids, num_segments=n)
+    if pool == "mean":
+        s = jax.ops.segment_sum(values, ids, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones(ids.shape, values.dtype), ids,
+                                num_segments=n)
+        return s / jnp.maximum(c, 1.0).reshape(
+            (-1,) + (1,) * (values.ndim - 1))
+    if pool == "max":
+        out = jax.ops.segment_max(values, ids, num_segments=n)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    out = jax.ops.segment_min(values, ids, num_segments=n)
+    return jnp.where(jnp.isfinite(out), out, 0.0)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None):
+    """Gather x at src, reduce onto dst (reference geometric/message_passing
+    send_u_recv)."""
+    if reduce_op not in _REDUCES:
+        raise ValueError(f"reduce_op must be one of {_REDUCES}")
+    n = out_size
+
+    def impl(xa, src, dst):
+        m = n if n is not None else xa.shape[0]
+        return _segment(jnp.take(xa, src, axis=0), dst, m, reduce_op)
+
+    return apply_op("graph_send_u_recv", impl, (x, src_index, dst_index),
+                    {})
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None):
+    """Node+edge message passing (send_ue_recv): combine gathered node
+    features with edge features then reduce."""
+    comb = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}[message_op]
+    n = out_size
+
+    def impl(xa, ya, src, dst):
+        m = n if n is not None else xa.shape[0]
+        msg = comb(jnp.take(xa, src, axis=0), ya)
+        return _segment(msg, dst, m, reduce_op)
+
+    return apply_op("graph_send_ue_recv", impl,
+                    (x, y, src_index, dst_index), {})
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add"):
+    """Edge-wise message from both endpoints (send_uv): no reduction."""
+    comb = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}[message_op]
+
+    def impl(xa, ya, src, dst):
+        return comb(jnp.take(xa, src, axis=0), jnp.take(ya, dst, axis=0))
+
+    return apply_op("graph_send_uv", impl, (x, y, src_index, dst_index), {})
+
+
+def _make_segment(name):
+    def op(data, segment_ids):
+        def impl(d, ids):
+            n = int(jnp.max(ids)) + 1 if not isinstance(
+                ids, jax.core.Tracer) else d.shape[0]
+            return _segment(d, ids, n, name)
+        return apply_op(f"segment_{name}", impl, (data, segment_ids), {})
+    op.__name__ = f"segment_{name}"
+    return op
+
+
+segment_sum = _make_segment("sum")
+segment_mean = _make_segment("mean")
+segment_max = _make_segment("max")
+segment_min = _make_segment("min")
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None):
+    """Uniform neighbor sampling from a CSC graph (reference
+    geometric/sampling/neighbors.py). Host-side structure op (sampling is
+    data-dependent — the eager boundary, like sparse structure ops)."""
+    row_np = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    colptr_np = np.asarray(colptr.numpy() if isinstance(colptr, Tensor)
+                           else colptr)
+    nodes = np.asarray(input_nodes.numpy()
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    rng = np.random.default_rng()
+    out_neighbors, out_counts = [], []
+    for nid in nodes.reshape(-1):
+        lo, hi = int(colptr_np[nid]), int(colptr_np[nid + 1])
+        neigh = row_np[lo:hi]
+        if sample_size > 0 and len(neigh) > sample_size:
+            neigh = rng.choice(neigh, sample_size, replace=False)
+        out_neighbors.append(neigh)
+        out_counts.append(len(neigh))
+    from ..core.tensor import to_tensor
+    return (to_tensor(np.concatenate(out_neighbors).astype(np.int64)
+                      if out_neighbors else np.zeros(0, np.int64)),
+            to_tensor(np.asarray(out_counts, np.int64)))
+
+
+def reindex_graph(x, neighbors, count):
+    """Compact node ids (reference geometric/reindex.py): maps x ++ unique
+    new neighbors to [0, n)."""
+    x_np = np.asarray(x.numpy() if isinstance(x, Tensor) else x).reshape(-1)
+    nb = np.asarray(neighbors.numpy() if isinstance(neighbors, Tensor)
+                    else neighbors).reshape(-1)
+    ids = {int(v): i for i, v in enumerate(x_np)}
+    order = list(x_np)
+    for v in nb:
+        if int(v) not in ids:
+            ids[int(v)] = len(order)
+            order.append(v)
+    from ..core.tensor import to_tensor
+    reindexed = np.asarray([ids[int(v)] for v in nb], np.int64)
+    return (to_tensor(reindexed),
+            to_tensor(np.asarray(order, np.int64)),
+            to_tensor(np.asarray(np.arange(len(x_np)), np.int64)))

@@ -1,2 +1,111 @@
-"""2:4 structured sparsity (reference: python/paddle/incubate/asp/).
-Populated by the asp milestone."""
+"""2:4 structured sparsity — ASP (reference: python/paddle/incubate/asp/
+— calculate_density, create_mask m4n2 patterns, prune_model, decorate).
+
+TPU note: the MXU has no 2:4 sparse mode (that is an Ampere tensor-core
+feature), so ASP here is the *training-method* parity: masks are computed
+the same way and enforced through the optimizer step, giving models that
+deploy efficiently on hardware that does have structured sparsity."""
+import numpy as np
+
+from ...core.tensor import Tensor, to_tensor
+
+__all__ = ["calculate_density", "create_mask", "check_mask_1d",
+           "check_mask_2d", "prune_model", "decorate", "reset_excluded_layers",
+           "set_excluded_layers"]
+
+import weakref
+
+_excluded = set()
+_pruned_models = []  # weakrefs of every prune_model target
+
+
+def calculate_density(x):
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def create_mask(tensor, func_name="mask_1d", n=2, m=4):
+    """Best-n-of-m mask along the last axis (reference create_mask
+    mask_1d/mask_2d_best). Keeps the n largest |values| in every group of
+    m."""
+    arr = np.asarray(tensor.numpy() if isinstance(tensor, Tensor)
+                     else tensor)
+    flat = arr.reshape(-1, arr.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    padded = np.pad(np.abs(flat), ((0, 0), (0, pad)))
+    groups = padded.reshape(flat.shape[0], -1, m)
+    order = np.argsort(-groups, axis=-1)
+    mask_g = np.zeros_like(groups, dtype=bool)
+    np.put_along_axis(mask_g, order[..., :n], True, axis=-1)
+    mask = mask_g.reshape(flat.shape[0], -1)[:, :cols].reshape(arr.shape)
+    return to_tensor(mask.astype(arr.dtype))
+
+
+def check_mask_1d(mat, n=2, m=4):
+    arr = np.asarray(mat.numpy() if isinstance(mat, Tensor) else mat)
+    flat = arr.reshape(-1)
+    pad = (-flat.size) % m
+    groups = np.pad(flat != 0, (0, pad)).reshape(-1, m)
+    return bool((groups.sum(axis=1) <= n).all())
+
+
+def check_mask_2d(mat, n=2, m=4):
+    return check_mask_1d(mat, n, m)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _prunable(name, p):
+    return p.data.ndim >= 2 and name not in _excluded \
+        and not any(name.endswith(sfx) for sfx in ("bias",))
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every prunable parameter; returns name->mask
+    (reference prune_model). Masks are also stashed on the model for the
+    decorated optimizer to re-apply after each step."""
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = create_mask(p, mask_algo, n, m)
+        p.set_value(np.asarray(p.numpy()) * np.asarray(mask.numpy()))
+        masks[name] = mask
+    model._asp_masks = masks
+    _pruned_models.append(weakref.ref(model))
+    return masks
+
+
+def decorate(optimizer, model=None):
+    """Wrap optimizer.step to re-apply the sparsity masks after every
+    update (reference ASPOptimizer/OptimizerWithSparsityGuarantee).
+    Without an explicit `model`, every model previously passed to
+    prune_model is re-masked — decorate(optimizer) alone must guarantee
+    sparsity, as the reference's does."""
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        if model is not None:
+            models = [model]
+        else:
+            models = [m for m in (r() for r in _pruned_models)
+                      if m is not None]
+        for mdl in models:
+            masks = getattr(mdl, "_asp_masks", None)
+            if not masks:
+                continue
+            for name, p in mdl.named_parameters():
+                msk = masks.get(name)
+                if msk is not None:
+                    p.data = p.data * msk.data
+
+    optimizer.step = step
+    return optimizer

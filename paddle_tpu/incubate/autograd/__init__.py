@@ -1,2 +1,6 @@
-"""Higher-order autodiff extras (reference: python/paddle/incubate/autograd/).
-Populated with jacobian/hessian."""
+"""Higher-order autodiff extras (reference: python/paddle/incubate/
+autograd/ — jacobian/hessian/jvp/vjp re-exported from the functional
+autograd surface, which lowers to jax.jacfwd/jacrev/jvp/vjp)."""
+from ...autograd.functional import (jacobian, hessian, vjp, jvp)  # noqa: F401
+
+__all__ = ["jacobian", "hessian", "vjp", "jvp"]

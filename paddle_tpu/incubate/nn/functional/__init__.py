@@ -48,12 +48,12 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     """Reference fused_attention_kernel.cu semantics: [pre-LN] -> QKV proj
     -> MHA -> out proj -> residual add [-> post-LN]. One traced graph —
     XLA fuses what the CUDA megakernel fuses by hand."""
-    mask_arr = attn_mask.data if attn_mask is not None else None
     from ....core import random as _random
 
     def impl(xa, qkvw, lw, *rest):
         it = iter(rest)
         cache = next(it) if cache_kv is not None else None
+        mask_arr = next(it) if attn_mask is not None else None
         plns = next(it) if pre_ln_scale is not None else None
         plnb = next(it) if pre_ln_bias is not None else None
         qb = next(it) if qkv_bias is not None else None
@@ -102,8 +102,8 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         return out if new_cache is None else (out, new_cache)
 
     args = [x, qkv_weight, linear_weight]
-    for t in (cache_kv, pre_ln_scale, pre_ln_bias, qkv_bias, linear_bias,
-              ln_scale, ln_bias):
+    for t in (cache_kv, attn_mask, pre_ln_scale, pre_ln_bias, qkv_bias,
+              linear_bias, ln_scale, ln_bias):
         if t is not None:
             args.append(t)
     return apply_op("fused_multi_head_attention", impl, tuple(args), {})
@@ -332,6 +332,10 @@ def flashmask_attention(query, key, value, startend_row_indices,
             return flashmask_attention_bshd(q, k, v, idx, causal=causal)
         # dense fallback: materialize the interval mask
         b, s, hq, d = q.shape
+        if k.shape[2] != hq:  # GQA: broadcast kv heads like the kernel path
+            rep = hq // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         sr = idx[..., 0]
         er = idx[..., 1] if idx.shape[-1] > 1 else jnp.full_like(sr, s)
         if sr.shape[1] != hq:

@@ -1,0 +1,105 @@
+"""Incubating optimizers (reference: python/paddle/incubate/optimizer/ —
+LookAhead, ModelAverage)."""
+import jax.numpy as jnp
+
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """Lookahead wrapper (reference incubate/optimizer/lookahead.py):
+    k fast steps with the inner optimizer, then a slow interpolation
+    toward the fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        super().__init__(inner_optimizer.get_lr(),
+                         inner_optimizer._parameter_list, None, None,
+                         False, name)
+        self.alpha = alpha
+        self.k = k
+        self._slow = {}
+        self._steps = 0
+
+    def step(self):
+        self.inner.step()
+        self._steps += 1
+        if self._steps % self.k:
+            return
+        for p in self._parameter_list:
+            slow = self._slow.get(id(p))
+            if slow is None:
+                slow = self._slow[id(p)] = p.data.astype(jnp.float32)
+                continue
+            slow = slow + self.alpha * (p.data.astype(jnp.float32) - slow)
+            self._slow[id(p)] = slow
+            p.data = slow.astype(p.data.dtype)
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+
+class ModelAverage(Optimizer):
+    """Running parameter average for evaluation (reference
+    incubate/optimizer/modelaverage.py): apply()/restore() swap averaged
+    weights in and out."""
+
+    def __init__(self, inner_optimizer_or_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if isinstance(inner_optimizer_or_rate, Optimizer):
+            inner = inner_optimizer_or_rate
+            params = inner._parameter_list
+            self.inner = inner
+        else:
+            self.inner = None
+            params = parameters
+        super().__init__(0.0, params, None, None, False, name)
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        # reference windowing: accumulate into `sum`; when the window
+        # exceeds max_average_window, roll it into (old_sum, old_num) and
+        # restart — apply() averages over sum+old_sum (>= min window)
+        self._sum = {id(p): jnp.zeros(p.data.shape, jnp.float32)
+                     for p in self._parameter_list}
+        self._old_sum = {id(p): jnp.zeros(p.data.shape, jnp.float32)
+                         for p in self._parameter_list}
+        self._count = 0
+        self._old_count = 0
+        self._backup = None
+
+    def step(self):
+        if self.inner is not None:
+            self.inner.step()
+        for p in self._parameter_list:
+            self._sum[id(p)] = self._sum[id(p)] + p.data.astype(jnp.float32)
+        self._count += 1
+        if self._count >= self._max_w and self._count >= self._min_w:
+            self._old_sum = dict(self._sum)
+            self._old_count = self._count
+            self._sum = {k: jnp.zeros_like(v) for k, v in self._sum.items()}
+            self._count = 0
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p.data for p in self._parameter_list}
+        total = self._count + self._old_count
+        if not total:
+            return
+        for p in self._parameter_list:
+            avg = (self._sum[id(p)] + self._old_sum[id(p)]) / total
+            p.data = avg.astype(p.data.dtype)
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._parameter_list:
+                p.data = self._backup[id(p)]
+            self._backup = None
+
+    def clear_grad(self, set_to_zero=False):
+        if self.inner is not None:
+            self.inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad

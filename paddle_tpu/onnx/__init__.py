@@ -1,0 +1,24 @@
+"""paddle.onnx parity surface (reference: python/paddle/onnx/export —
+delegates to the external paddle2onnx package).
+
+On this stack the deployment IR is StableHLO, not ONNX: export() lowers
+the model through the jit tracer and writes <path>.stablehlo next to the
+jit.save artifacts (the portable compiler-facing program every XLA-based
+runtime consumes). If a true ONNX file is required, convert the StableHLO
+externally (e.g. onnx-mlir / ivy) — this environment vendors no converter,
+exactly like the reference, which also needs the separate paddle2onnx
+package."""
+import os
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=None, **configs):
+    from ..jit import save as jit_save
+    if input_spec is None:
+        raise ValueError("onnx.export needs input_spec to trace the model")
+    jit_save(layer, path, input_spec=input_spec)
+    hlo = path + ".stablehlo"
+    if os.path.exists(hlo):
+        return hlo
+    raise RuntimeError("export failed: no StableHLO artifact was produced")

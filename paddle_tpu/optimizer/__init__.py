@@ -1,5 +1,6 @@
 """paddle.optimizer surface (reference: python/paddle/optimizer/)."""
 from .optimizer import Optimizer
 from .optimizers import (SGD, Momentum, Adam, AdamW, Adagrad, RMSProp,
-                         Adadelta, Adamax, Lamb)
+                         Adadelta, Adamax, Lamb, NAdam, RAdam, Rprop, ASGD,
+                         LarsMomentum, LBFGS)
 from . import lr

@@ -305,3 +305,237 @@ class Lamb(Optimizer):
             jnp.float32(self._epsilon), jnp.float32(self._step_count),
             jnp.float32(wd))
         self._write_back(p, st, new)
+
+
+@jax.jit
+def _nadam_update(p, g, m, v, lr, b1, b2, eps, t):
+    g = g.astype(m.dtype)
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * (g * g)
+    mhat = m2 / (1 - b1 ** (t + 1))
+    vhat = v2 / (1 - b2 ** t)
+    nes = b1 * mhat + (1 - b1) * g / (1 - b1 ** t)
+    return p - lr * nes / (jnp.sqrt(vhat) + eps), m2, v2
+
+
+@jax.jit
+def _radam_update(p, g, m, v, lr, b1, b2, eps, t):
+    g = g.astype(m.dtype)
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * (g * g)
+    mhat = m2 / (1 - b1 ** t)
+    rho_inf = 2.0 / (1 - b2) - 1.0
+    rho_t = rho_inf - 2.0 * t * (b2 ** t) / (1 - b2 ** t)
+    r_num = (rho_t - 4) * (rho_t - 2) * rho_inf
+    r_den = (rho_inf - 4) * (rho_inf - 2) * rho_t
+    rect = jnp.sqrt(jnp.maximum(r_num / r_den, 0.0))
+    vhat = jnp.sqrt(v2 / (1 - b2 ** t)) + eps
+    adaptive = p - lr * rect * mhat / vhat
+    plain = p - lr * mhat
+    return jnp.where(rho_t > 5.0, adaptive, plain), m2, v2
+
+
+@jax.jit
+def _rprop_update(p, g, prev_g, step_size, lr_min, lr_max, eta_n, eta_p):
+    sign = jnp.sign(g * prev_g)
+    factor = jnp.where(sign > 0, eta_p, jnp.where(sign < 0, eta_n, 1.0))
+    step2 = jnp.clip(step_size * factor, lr_min, lr_max)
+    g_eff = jnp.where(sign < 0, 0.0, g)  # no step on sign flip
+    return p - jnp.sign(g_eff) * step2, g_eff, step2
+
+
+@jax.jit
+def _asgd_update(p, g, avg, lr, t, t0):
+    p2 = p - lr * g
+    # running average once past t0 (reference ASGD averaging semantics)
+    avg2 = jnp.where(t >= t0, avg + (p2 - avg) / jnp.maximum(t - t0 + 1, 1),
+                     p2)
+    return p2, avg2
+
+
+@jax.jit
+def _lars_update(p, g, vel, lr, mu, lars_coeff, wd, eps):
+    p_norm = jnp.sqrt(jnp.sum(p * p))
+    g_norm = jnp.sqrt(jnp.sum(g * g))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lars_coeff * p_norm / (g_norm + wd * p_norm + eps), 1.0)
+    v2 = mu * vel + local_lr * lr * (g + wd * p)
+    return p - v2, v2
+
+
+class NAdam(Optimizer):
+    """Adam with Nesterov momentum (reference python/paddle/optimizer/nadam.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_state(self, p):
+        return {"moment1": jnp.zeros(p.data.shape, jnp.float32),
+                "moment2": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _apply_one(self, p, g, st, lr):
+        g = self._l2(p, g, st)
+        base = st.get("master", p.data.astype(jnp.float32))
+        new, st["moment1"], st["moment2"] = _nadam_update(
+            base, g, st["moment1"], st["moment2"], jnp.float32(lr),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._epsilon), jnp.float32(self._step_count))
+        self._write_back(p, st, new)
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference python/paddle/optimizer/radam.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_state(self, p):
+        return {"moment1": jnp.zeros(p.data.shape, jnp.float32),
+                "moment2": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _apply_one(self, p, g, st, lr):
+        g = self._l2(p, g, st)
+        base = st.get("master", p.data.astype(jnp.float32))
+        new, st["moment1"], st["moment2"] = _radam_update(
+            base, g, st["moment1"], st["moment2"], jnp.float32(lr),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._epsilon), jnp.float32(self._step_count))
+        self._write_back(p, st, new)
+
+
+class Rprop(Optimizer):
+    """Resilient backprop — full-batch sign-based steps (reference
+    python/paddle/optimizer/rprop.py)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _create_state(self, p):
+        return {"prev_grad": jnp.zeros(p.data.shape, jnp.float32),
+                "step_size": jnp.full(p.data.shape, float(self.get_lr()),
+                                      jnp.float32)}
+
+    def _apply_one(self, p, g, st, lr):
+        base = st.get("master", p.data.astype(jnp.float32))
+        g = g.astype(jnp.float32)
+        new, st["prev_grad"], st["step_size"] = _rprop_update(
+            base, g, st["prev_grad"], st["step_size"],
+            jnp.float32(self._lr_range[0]), jnp.float32(self._lr_range[1]),
+            jnp.float32(self._etas[0]), jnp.float32(self._etas[1]))
+        self._write_back(p, st, new)
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference python/paddle/optimizer/asgd.py): plain SGD
+    steps plus a running parameter average exposed for evaluation."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None, t0=0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._t0 = t0
+
+    def _create_state(self, p):
+        return {"averaged": p.data.astype(jnp.float32)}
+
+    def _apply_one(self, p, g, st, lr):
+        g = self._l2(p, g, st).astype(jnp.float32)
+        base = st.get("master", p.data.astype(jnp.float32))
+        new, st["averaged"] = _asgd_update(
+            base, g, st["averaged"], jnp.float32(lr),
+            jnp.float32(self._step_count), jnp.float32(self._t0))
+        self._write_back(p, st, new)
+
+    def averaged_parameters(self):
+        return {id(p): self._state(p)["averaged"]
+                for p in self._parameter_list}
+
+
+class LarsMomentum(Optimizer):
+    """LARS: layer-wise adaptive rate scaling for large-batch training
+    (reference python/paddle/incubate/optimizer lars_momentum /
+    paddle/phi/kernels lars_momentum_kernel)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 multi_precision=False, name=None, epsilon=1e-9):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._epsilon = epsilon
+
+    def _create_state(self, p):
+        return {"velocity": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _apply_one(self, p, g, st, lr):
+        base = st.get("master", p.data.astype(jnp.float32))
+        g = g.astype(jnp.float32)
+        new, st["velocity"] = _lars_update(
+            base, g, st["velocity"], jnp.float32(lr),
+            jnp.float32(self._momentum), jnp.float32(self._lars_coeff),
+            jnp.float32(self._lars_wd), jnp.float32(self._epsilon))
+        self._write_back(p, st, new)
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (reference python/paddle/optimizer/lbfgs.py).
+    Stores (s, y) curvature pairs per parameter and applies the two-loop
+    recursion; step() uses the current grads (call backward first), with a
+    fixed learning-rate step (no line search — reference default
+    line_search_fn=None behaves the same)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=1, history_size=10,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None, tolerance_grad=1e-7,
+                 tolerance_change=1e-9, line_search_fn=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._hist = history_size
+
+    def _create_state(self, p):
+        return {"s": [], "y": [], "prev_p": None, "prev_g": None}
+
+    def _apply_one(self, p, g, st, lr):
+        g = self._l2(p, g, st).astype(jnp.float32)
+        base = st.get("master", p.data.astype(jnp.float32))
+        if st["prev_p"] is not None:
+            s = base - st["prev_p"]
+            y = g - st["prev_g"]
+            if float(jnp.vdot(s, y)) > 1e-10:
+                st["s"].append(s)
+                st["y"].append(y)
+                if len(st["s"]) > self._hist:
+                    st["s"].pop(0)
+                    st["y"].pop(0)
+        q = g
+        alphas = []
+        for s, y in zip(reversed(st["s"]), reversed(st["y"])):
+            rho = 1.0 / jnp.vdot(y, s)
+            a = rho * jnp.vdot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if st["s"]:
+            s_l, y_l = st["s"][-1], st["y"][-1]
+            q = q * (jnp.vdot(s_l, y_l) / jnp.vdot(y_l, y_l))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + (a - b) * s
+        st["prev_p"], st["prev_g"] = base, g
+        self._write_back(p, st, base - lr * q)

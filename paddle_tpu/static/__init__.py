@@ -1,8 +1,15 @@
-"""paddle.static parity shims. On this stack there is no separate static
-graph runtime — the traced path (paddle_tpu.jit) IS the static path, with
-StableHLO standing in for the Program proto (SURVEY.md §7). These helpers
-keep `import paddle.static`-style code importable."""
+"""paddle.static parity. Two surfaces:
+
+- Program-style: Program / program_guard / data / Executor — a recorded
+  dataflow slice replayed as one jit-compiled XLA program
+  (static/program.py; the reference ProgramDesc + StandaloneExecutor roles).
+- Trace-style: to_static/save/load re-exported from paddle_tpu.jit — on
+  this stack the traced path IS the static path, with StableHLO standing
+  in for the Program proto (SURVEY.md §7).
+"""
 from ..jit import to_static, save, load  # noqa: F401
+from .program import (Program, program_guard, data, Executor,  # noqa: F401
+                      default_main_program, default_startup_program)
 
 _static_mode = False
 
@@ -18,10 +25,15 @@ def InputSpec(shape=None, dtype="float32", name=None):
     return _Spec()
 
 
-def default_main_program():
-    raise NotImplementedError(
-        "program-style static graph is replaced by paddle_tpu.jit.to_static "
-        "(trace -> StableHLO -> XLA)")
+class CPUPlace:
+    pass
 
 
-default_startup_program = default_main_program
+class CUDAPlace:
+    def __init__(self, _id=0):
+        pass
+
+
+class TPUPlace:
+    def __init__(self, _id=0):
+        pass

@@ -1,0 +1,206 @@
+"""Program-style static graph over the eager dispatch.
+
+Reference: paddle.static Program/Executor (python/paddle/base/
+framework.py:5890 Program, executor.py:1237 Executor) — there, a protobuf
+ProgramDesc interpreted by the C++ StandaloneExecutor. Here a Program is a
+recorded dataflow slice: under program_guard every dispatched op whose
+inputs are graph-connected (reachable from a `static.data` placeholder) is
+recorded; Executor.run replays the recorded op list as ONE jit-compiled
+XLA program with the feeds as inputs (the PIR->kernel-lowering->interpreter
+pipeline collapsing into jax.jit).
+
+Ops not connected to a placeholder (e.g. parameter initializers) run
+eagerly and are NOT recorded — the startup-program split falls out of the
+dataflow rule instead of needing a second Program.
+"""
+import weakref
+
+import jax
+import numpy as np
+
+from ..core import dispatch as _dispatch
+from ..core.tensor import Tensor
+
+
+class _OpRecord:
+    __slots__ = ("impl", "treedef", "plain", "tensor_slots", "out_ids",
+                 "name")
+
+    def __init__(self, name, impl, treedef, plain, tensor_slots, out_ids):
+        self.name = name
+        self.impl = impl
+        self.treedef = treedef
+        self.plain = plain                  # template incl. constants
+        self.tensor_slots = tensor_slots    # [(leaf_idx, weakref(Tensor))]
+        self.out_ids = out_ids
+
+
+class Program:
+    """Recorded op list + feed/fetch bookkeeping (Program/Block roles)."""
+
+    def __init__(self):
+        self.ops = []
+        self.feed_vars = {}      # name -> placeholder Tensor
+        self._connected = set()  # tensor ids reachable from placeholders
+        self._compiled = {}
+
+    # -- recording --------------------------------------------------------
+    def _register_placeholder(self, name, t):
+        self.feed_vars[name] = t
+        self._connected.add(id(t))
+
+    def _record(self, name, impl, treedef, leaves, tensor_idx, outs):
+        if not any(id(leaves[i]) in self._connected for i in tensor_idx):
+            return  # initializer-style op: eager only
+        slots = [(i, weakref.ref(leaves[i])) for i in tensor_idx]
+        plain = [l.data if isinstance(l, Tensor) else l for l in leaves]
+        out_list = outs if isinstance(outs, (tuple, list)) else [outs]
+        out_ids = [id(o) for o in out_list]
+        for o in out_list:
+            self._connected.add(id(o))
+            o.persistable = True  # keep fetchable tensors alive
+        self.ops.append(_OpRecord(name, impl, treedef, plain, slots,
+                                  out_ids))
+        self._compiled.clear()
+
+    # -- replay -----------------------------------------------------------
+    def _external_inputs(self):
+        """Tensors read by the program that it does not produce (feeds +
+        parameters/constants). Parameters are passed as runtime inputs to
+        the jitted replay — jit would otherwise bake their trace-time
+        values in as constants and never see optimizer updates."""
+        produced = set()
+        externals = []
+        seen = set()
+        for rec in self.ops:
+            for i, tref in rec.tensor_slots:
+                t = tref()
+                if t is None:
+                    raise RuntimeError(
+                        f"program op '{rec.name}' lost an input tensor "
+                        "(garbage collected); keep references to "
+                        "intermediate vars or rebuild the program")
+                if id(t) not in produced and id(t) not in seen:
+                    seen.add(id(t))
+                    externals.append(t)
+            produced.update(rec.out_ids)
+        return externals
+
+    def _build_fn(self, fetch_ids, external_ids):
+        records = list(self.ops)
+
+        def fn(external_arrays):
+            env = dict(zip(external_ids, external_arrays))
+            from jax.tree_util import tree_unflatten
+            for rec in records:
+                plain = list(rec.plain)
+                for i, tref in rec.tensor_slots:
+                    t = tref()
+                    plain[i] = env[id(t)]
+                a, k = tree_unflatten(rec.treedef, plain)
+                out = rec.impl(*a, **k)
+                outs = out if isinstance(out, (tuple, list)) else [out]
+                for oid, oarr in zip(rec.out_ids, outs):
+                    env[oid] = oarr
+            missing = [fid for fid in fetch_ids if fid not in env]
+            if missing:
+                raise KeyError(
+                    "fetch target was not produced by this program (was it "
+                    "computed under program_guard?)")
+            return tuple(env[fid] for fid in fetch_ids)
+
+        return fn
+
+    def run(self, feed, fetch_list):
+        feed_names = sorted(feed.keys())
+        fetch_ids = tuple(id(t) for t in fetch_list)
+        externals = self._external_inputs()
+        external_ids = tuple(id(t) for t in externals)
+        key = (tuple(feed_names),
+               tuple((np.shape(feed[n]), str(np.asarray(feed[n]).dtype))
+                     for n in feed_names),
+               fetch_ids, external_ids, len(self.ops))
+        if key not in self._compiled:
+            self._compiled[key] = jax.jit(self._build_fn(fetch_ids,
+                                                         external_ids))
+        feed_by_id = {id(self.feed_vars[n]): np.asarray(feed[n])
+                      for n in feed_names}
+        arrays = [feed_by_id.get(id(t), t.data) for t in externals]
+        missing_feeds = [n for n in self.feed_vars
+                         if n not in feed and
+                         id(self.feed_vars[n]) in external_ids]
+        if missing_feeds:
+            raise KeyError(f"missing feeds: {missing_feeds}")
+        outs = self._compiled[key](arrays)
+        return [np.asarray(o) for o in outs]
+
+    def global_block(self):
+        return self
+
+    def all_ops(self):
+        return [r.name for r in self.ops]
+
+
+_default_main = Program()
+_guard_stack = []
+
+
+def default_main_program():
+    return _guard_stack[-1] if _guard_stack else _default_main
+
+
+def default_startup_program():
+    # the dataflow rule makes a separate startup program unnecessary; kept
+    # for API parity
+    return default_main_program()
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self._prog = main_program
+
+    def __enter__(self):
+        _guard_stack.append(self._prog)
+        _dispatch.set_static_recorder(_make_recorder(self._prog))
+        return self._prog
+
+    def __exit__(self, *exc):
+        _guard_stack.pop()
+        if _guard_stack:
+            _dispatch.set_static_recorder(_make_recorder(_guard_stack[-1]))
+        else:
+            _dispatch.set_static_recorder(None)
+
+
+def _make_recorder(prog):
+    def recorder(name, impl, treedef, leaves, tensor_idx, outs):
+        prog._record(name, impl, treedef, leaves, tensor_idx, outs)
+    return recorder
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference static.data): a concrete zeros tensor
+    registered as a feed var; None/-1 dims default to 1 for tracing."""
+    from ..core.tensor import to_tensor
+    from ..core.dtypes import convert_dtype
+    shape = [1 if (s is None or s < 0) else int(s) for s in shape]
+    t = to_tensor(np.zeros(shape, dtype=np.dtype(convert_dtype(dtype))))
+    t.name = name
+    prog = default_main_program()
+    prog._register_placeholder(name, t)
+    return t
+
+
+class Executor:
+    """paddle.static.Executor parity (executor.py:1237): run(program,
+    feed, fetch_list) compiles + executes the recorded program."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None):
+        prog = program or default_main_program()
+        return prog.run(feed or {}, fetch_list or [])
+
+    def close(self):
+        pass

@@ -1,0 +1,72 @@
+"""paddle.text parity (reference: python/paddle/text/ — ViterbiDecoder +
+dataset loaders). Datasets require downloads (zero-egress here), so the
+decoder is the functional surface; dataset classes accept a local
+data_file path like the reference's cached mode."""
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..nn.layer import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True):
+    """CRF Viterbi decode (reference text/viterbi_decode.py, kernel
+    phi/kernels/gpu/viterbi_decode_kernel.cu). potentials [B, T, N],
+    transition_params [N, N], lengths [B]. Returns (scores [B],
+    paths [B, T]) — XLA-native via lax.scan over time."""
+    def impl(pot, trans, lens):
+        b, t, n = pot.shape
+        if include_bos_eos_tag:
+            # reference semantics: start/stop tags are the last two rows
+            start_idx, stop_idx = n - 2, n - 1
+            init = pot[:, 0] + trans[start_idx][None, :]
+        else:
+            init = pot[:, 0]
+
+        def step(carry, xs):
+            alpha = carry
+            emit, tmask = xs              # [B, N], [B]
+            scores = alpha[:, :, None] + trans[None]   # [B, N_from, N_to]
+            best_prev = jnp.argmax(scores, axis=1)     # [B, N]
+            alpha_new = jnp.max(scores, axis=1) + emit
+            alpha_new = jnp.where(tmask[:, None], alpha_new, alpha)
+            best_prev = jnp.where(tmask[:, None], best_prev, -1)
+            return alpha_new, best_prev
+
+        emits = jnp.moveaxis(pot[:, 1:], 1, 0)         # [T-1, B, N]
+        steps = jnp.arange(1, t)[:, None] < lens[None, :]  # [T-1, B]
+        alpha, history = jax.lax.scan(step, init, (emits, steps))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, stop_idx][None, :]
+        scores = jnp.max(alpha, axis=1)
+        last_tag = jnp.argmax(alpha, axis=1)           # [B]
+
+        def back(carry, hist):
+            tag = carry
+            prev = jnp.take_along_axis(hist, tag[:, None], axis=1)[:, 0]
+            tag_new = jnp.where(prev >= 0, prev, tag)
+            # emit the tag at position t+1; carry walks back to position t
+            return tag_new, tag
+
+        tag0, path_rev = jax.lax.scan(back, last_tag, history, reverse=True)
+        paths = jnp.concatenate([tag0[:, None],
+                                 jnp.moveaxis(path_rev, 0, 1)], axis=1)
+        return scores, paths.astype(jnp.int64)
+
+    return apply_op("viterbi_decode", impl,
+                    (potentials, transition_params, lengths), {},
+                    differentiable=False)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self._include = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self._include)

@@ -1,0 +1,336 @@
+"""Breadth-parity tests: optimizers 10-15, geometric, audio, text
+(viterbi), custom C++ ops (cpp_extension), static Program/Executor, rpc,
+onnx export, ASP sparsity, LookAhead/ModelAverage."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestNewOptimizers:
+    def _fit(self, opt_cls, iters=60, **kw):
+        paddle.seed(0)
+        w = paddle.to_tensor(np.array([2.0, -3.0], np.float32),
+                             stop_gradient=False)
+        target = np.array([0.5, 1.0], np.float32)
+        opt = opt_cls(parameters=[w], **kw)
+        for _ in range(iters):
+            loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return w.numpy(), target
+
+    @pytest.mark.parametrize("cls,kw", [
+        (optimizer.NAdam, {"learning_rate": 0.1}),
+        (optimizer.RAdam, {"learning_rate": 0.3, "iters": 200}),
+        (optimizer.Rprop, {"learning_rate": 0.05}),
+        (optimizer.ASGD, {"learning_rate": 0.05}),
+        (optimizer.LarsMomentum, {"learning_rate": 0.5, "lars_coeff": 0.1}),
+        (optimizer.LBFGS, {"learning_rate": 0.5}),
+    ])
+    def test_converges(self, cls, kw):
+        got, target = self._fit(cls, **kw)
+        np.testing.assert_allclose(got, target, atol=0.3)
+
+    def test_asgd_average(self):
+        w = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        opt = optimizer.ASGD(learning_rate=0.1, parameters=[w])
+        for _ in range(5):
+            (w ** 2).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        avg = opt.averaged_parameters()[id(w)]
+        assert np.isfinite(np.asarray(avg)).all()
+
+    def test_lookahead(self):
+        from paddle_tpu.incubate import LookAhead
+        w = paddle.to_tensor(np.array([4.0], np.float32),
+                             stop_gradient=False)
+        inner = optimizer.SGD(learning_rate=0.1, parameters=[w])
+        opt = LookAhead(inner, alpha=0.5, k=2)
+        for _ in range(30):
+            (w ** 2).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        assert abs(float(w.numpy()[0])) < 1.0
+
+
+class TestGeometric:
+    def test_send_u_recv(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0], [4.0]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+        dst = paddle.to_tensor(np.array([1, 2, 0, 2], np.int32))
+        out = paddle.geometric.send_u_recv(x, src, dst, "sum").numpy()
+        np.testing.assert_allclose(out, [[4.0], [1.0], [3.0]])
+        out = paddle.geometric.send_u_recv(x, src, dst, "max").numpy()
+        np.testing.assert_allclose(out, [[4.0], [1.0], [2.0]])
+
+    def test_send_ue_recv_and_uv(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+        e = paddle.to_tensor(np.array([[10.0], [20.0]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1], np.int32))
+        dst = paddle.to_tensor(np.array([1, 0], np.int32))
+        out = paddle.geometric.send_ue_recv(x, e, src, dst, "add",
+                                            "sum").numpy()
+        np.testing.assert_allclose(out, [[22.0], [11.0]])
+        uv = paddle.geometric.send_uv(x, x, src, dst, "mul").numpy()
+        np.testing.assert_allclose(uv, [[2.0], [2.0]])
+
+    def test_segment_ops_grad(self):
+        data = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(6, 1),
+                                stop_gradient=False)
+        ids = paddle.to_tensor(np.array([0, 0, 1, 1, 1, 2], np.int32))
+        out = paddle.geometric.segment_mean(data, ids)
+        np.testing.assert_allclose(out.numpy().ravel(), [0.5, 3.0, 5.0])
+        out.sum().backward()
+        np.testing.assert_allclose(
+            data.grad.numpy().ravel(),
+            [0.5, 0.5, 1 / 3, 1 / 3, 1 / 3, 1.0], rtol=1e-5)
+
+    def test_sample_neighbors(self):
+        # CSC: node0 <- {1,2}, node1 <- {2}, node2 <- {}
+        row = paddle.to_tensor(np.array([1, 2, 2], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 2, 3, 3], np.int64))
+        nbr, cnt = paddle.geometric.sample_neighbors(
+            row, colptr, paddle.to_tensor(np.array([0, 1, 2], np.int64)))
+        assert list(cnt.numpy()) == [2, 1, 0]
+        assert set(nbr.numpy()[:2]) == {1, 2}
+
+
+class TestAudio:
+    def test_fbank_matrix_shape_and_norm(self):
+        fb = paddle.audio.functional.compute_fbank_matrix(
+            sr=16000, n_fft=512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all() and fb.sum() > 0
+
+    def test_mel_roundtrip(self):
+        f = paddle.audio.functional.mel_to_hz(
+            paddle.audio.functional.hz_to_mel(440.0))
+        np.testing.assert_allclose(f, 440.0, rtol=1e-6)
+
+    def test_mfcc_pipeline(self):
+        x = paddle.to_tensor(np.sin(
+            np.arange(4000) * 0.05).astype(np.float32)[None])
+        mfcc = paddle.audio.features.MFCC(sr=8000, n_mfcc=13, n_fft=256,
+                                          n_mels=32)
+        out = mfcc(x)
+        assert out.shape[0] == 1 and out.shape[1] == 13
+        assert np.isfinite(out.numpy()).all()
+
+    def test_spectrogram_matches_stft_power(self):
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            512).astype(np.float32))
+        spec = paddle.audio.features.Spectrogram(n_fft=128, hop_length=64,
+                                                 window="hann")(x).numpy()
+        w = paddle.audio.functional.get_window("hann", 128)
+        ref = np.abs(paddle.signal.stft(x, 128, 64,
+                                        window=w).numpy()) ** 2
+        np.testing.assert_allclose(spec, ref, rtol=1e-4, atol=1e-4)
+
+
+class TestViterbi:
+    def test_matches_bruteforce(self):
+        rng = np.random.default_rng(1)
+        B, T, N = 2, 5, 4  # last two tags are BOS/EOS when include=True
+        pot = rng.standard_normal((B, T, N)).astype(np.float32)
+        trans = rng.standard_normal((N, N)).astype(np.float32)
+        lens = np.array([5, 3], np.int64)
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=False)
+        # brute force
+        import itertools
+        for b in range(B):
+            best, best_path = -1e30, None
+            L = lens[b]
+            for seq in itertools.product(range(N), repeat=int(L)):
+                s = pot[b, 0, seq[0]]
+                for t in range(1, L):
+                    s += trans[seq[t - 1], seq[t]] + pot[b, t, seq[t]]
+                if s > best:
+                    best, best_path = s, seq
+            np.testing.assert_allclose(scores.numpy()[b], best, rtol=1e-4)
+            assert tuple(paths.numpy()[b][:L]) == best_path
+
+
+CUSTOM_OP_SRC = r"""
+#include "paddle_tpu_ext.h"
+#include <cmath>
+
+static int64_t numel(const PTTensor* t) {
+  int64_t n = 1;
+  for (int i = 0; i < t->ndim; ++i) n *= t->dims[i];
+  return n;
+}
+
+extern "C" void leaky_relu_fwd(const PTTensor* ins, int n_in,
+                               PTTensor* outs, int n_out) {
+  const float* x = (const float*)ins[0].data;
+  float* y = (float*)outs[0].data;
+  int64_t n = numel(&ins[0]);
+  for (int64_t i = 0; i < n; ++i) y[i] = x[i] > 0 ? x[i] : 0.1f * x[i];
+}
+
+extern "C" void leaky_relu_bwd(const PTTensor* ins, int n_in,
+                               PTTensor* outs, int n_out) {
+  // ins: (x, grad_out); outs: (grad_x)
+  const float* x = (const float*)ins[0].data;
+  const float* g = (const float*)ins[1].data;
+  float* gx = (float*)outs[0].data;
+  int64_t n = numel(&ins[0]);
+  for (int64_t i = 0; i < n; ++i) gx[i] = x[i] > 0 ? g[i] : 0.1f * g[i];
+}
+"""
+
+
+class TestCppExtension:
+    @pytest.fixture(scope="class")
+    def op(self, tmp_path_factory):
+        from paddle_tpu.utils import cpp_extension
+        d = tmp_path_factory.mktemp("ext")
+        src = d / "leaky.cc"
+        src.write_text(CUSTOM_OP_SRC)
+        mod = cpp_extension.load("leaky_ext", [str(src)],
+                                 build_directory=str(d))
+        return mod.custom_op("leaky_relu_fwd",
+                             out_shapes_fn=lambda s: [s],
+                             backward_symbol="leaky_relu_bwd")
+
+    def test_forward(self, op):
+        x = paddle.to_tensor(np.array([-2.0, 3.0], np.float32))
+        np.testing.assert_allclose(op(x).numpy(), [-0.2, 3.0], rtol=1e-6)
+
+    def test_backward(self, op):
+        x = paddle.to_tensor(np.array([-2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        op(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.1, 1.0], rtol=1e-6)
+
+
+class TestStaticProgram:
+    def test_program_build_and_run(self):
+        from paddle_tpu import static
+        paddle.seed(3)
+        lin = nn.Linear(4, 2)  # params created eagerly outside
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4], "float32")
+            y = lin(x)
+            out = paddle.nn.functional.relu(y)
+        exe = static.Executor()
+        feed = np.random.default_rng(0).standard_normal((3, 4)).astype(
+            np.float32)
+        got = exe.run(prog, feed={"x": feed}, fetch_list=[out])[0]
+        ref = np.maximum(feed @ lin.weight.numpy() + lin.bias.numpy(), 0)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_program_sees_param_updates(self):
+        from paddle_tpu import static
+        lin = nn.Linear(2, 1)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [1, 2], "float32")
+            out = lin(x)
+        exe = static.Executor()
+        feed = np.ones((1, 2), np.float32)
+        before = exe.run(prog, {"x": feed}, [out])[0]
+        with paddle.no_grad():
+            lin.bias.set_value(lin.bias.numpy() + 5.0)
+        after = exe.run(prog, {"x": feed}, [out])[0]
+        np.testing.assert_allclose(after - before, 5.0, rtol=1e-5)
+
+    def test_initializer_ops_not_recorded(self):
+        from paddle_tpu import static
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 2], "float32")
+            lin = nn.Linear(2, 2)   # init ops run inside the guard
+            y = lin(x)
+        names = prog.all_ops()
+        assert "linear" in names
+        # initializer matmuls/randoms must not be part of the program
+        assert all(not n.startswith("uniform") and "normal" not in n
+                   for n in names), names
+
+
+class TestOnnxExport:
+    def test_export_writes_stablehlo(self, tmp_path):
+        from paddle_tpu import static
+        net = nn.Sequential(nn.Linear(4, 2))
+        net.eval()
+        spec = [static.InputSpec([1, 4], "float32")]
+        out = paddle.onnx.export(net, str(tmp_path / "m"), input_spec=spec)
+        assert out.endswith(".stablehlo") and os.path.exists(out)
+        assert "stablehlo" in open(out).read() or "module" in open(out).read()
+
+
+class TestASP:
+    def test_create_mask_2_of_4(self):
+        from paddle_tpu.incubate import asp
+        w = paddle.to_tensor(np.random.default_rng(5).standard_normal(
+            (8, 16)).astype(np.float32))
+        mask = asp.create_mask(w)
+        assert asp.check_mask_1d(mask)
+        np.testing.assert_allclose(mask.numpy().sum(), 8 * 16 / 2)
+
+    def test_prune_and_decorate_keeps_sparsity(self):
+        from paddle_tpu.incubate import asp
+        paddle.seed(6)
+        model = nn.Sequential(nn.Linear(8, 8))
+        asp.prune_model(model)
+        lin_w = model._sub_layers["0"].weight
+        assert asp.check_mask_1d(lin_w)
+        opt = asp.decorate(
+            optimizer.SGD(learning_rate=0.1,
+                          parameters=model.parameters()), model)
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        (model(x) ** 2).mean().backward()
+        opt.step()
+        assert asp.check_mask_1d(model._sub_layers["0"].weight)
+
+
+RPC_SCRIPT = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import paddle_tpu.distributed.rpc as rpc
+
+def add(a, b):
+    return a + b
+
+rank = int(sys.argv[1])
+rpc.init_rpc(f"worker{{rank}}", rank=rank, world_size=2,
+             master_endpoint=sys.argv[2])
+if rank == 0:
+    r = rpc.rpc_sync("worker1", add, args=(2, 40))
+    assert r == 42, r
+    fut = rpc.rpc_async("worker1", add, args=(1, 1))
+    assert fut.wait(10) == 2
+    print("RPC OK", flush=True)
+rpc.shutdown()
+"""
+
+
+class TestRPC:
+    def test_two_process_rpc(self, tmp_path):
+        from paddle_tpu.distributed.launch.master import free_port
+        port = free_port()
+        script = tmp_path / "rpc_worker.py"
+        script.write_text(RPC_SCRIPT.format(repo=REPO))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        procs = [subprocess.Popen(
+            [sys.executable, str(script), str(r), f"127.0.0.1:{port}"],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True) for r in range(2)]
+        outs = [p.communicate(timeout=90) for p in procs]
+        assert all(p.returncode == 0 for p in procs), outs
+        assert "RPC OK" in outs[0][0]
