@@ -55,6 +55,7 @@ from . import audio
 from . import text
 from . import onnx
 from .hapi import Model, summary
+from .hapi.flops import flops
 from .framework import save, load, set_default_dtype, get_default_dtype
 from .utils.flags import set_flags, get_flags
 

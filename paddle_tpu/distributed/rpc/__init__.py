@@ -86,14 +86,19 @@ def _conn_to(to):
     info = _state["infos"][to]
     conns = _state["conns"]
     if to not in conns:
-        conns[to] = Client((info.ip, info.port), authkey=_AUTH)
+        # one (connection, lock) per peer: multiprocessing.Connection is
+        # not thread-safe and the server replies FIFO, so each
+        # send+recv round-trip must be atomic per connection
+        conns[to] = (Client((info.ip, info.port), authkey=_AUTH),
+                     threading.Lock())
     return conns[to]
 
 
 def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
-    conn = _conn_to(to)
-    conn.send((fn, tuple(args or ()), dict(kwargs or {})))
-    status, payload = conn.recv()
+    conn, lock = _conn_to(to)
+    with lock:
+        conn.send((fn, tuple(args or ()), dict(kwargs or {})))
+        status, payload = conn.recv()
     if status == "err":
         raise payload
     return payload
@@ -140,7 +145,7 @@ def shutdown():
         return
     _state["store"].barrier("rpc_shutdown", _state["world_size"])
     _state["stopping"] = True
-    for c in _state["conns"].values():
+    for c, _lock in _state["conns"].values():
         c.close()
     try:
         _state["listener"].close()

@@ -80,10 +80,18 @@ def send_uv(x, y, src_index, dst_index, message_op="add"):
 
 
 def _make_segment(name):
-    def op(data, segment_ids):
+    def op(data, segment_ids, num_segments=None):
         def impl(d, ids):
-            n = int(jnp.max(ids)) + 1 if not isinstance(
-                ids, jax.core.Tracer) else d.shape[0]
+            if num_segments is not None:
+                n = int(num_segments)
+            elif isinstance(ids, jax.core.Tracer):
+                # XLA needs a static segment count; max(ids)+1 is
+                # data-dependent, so tracing requires it explicitly
+                raise ValueError(
+                    f"segment_{name} under jit/to_static needs "
+                    "num_segments= (static shapes); eager mode infers it")
+            else:
+                n = int(jnp.max(ids)) + 1
             return _segment(d, ids, n, name)
         return apply_op(f"segment_{name}", impl, (data, segment_ids), {})
     op.__name__ = f"segment_{name}"
@@ -106,19 +114,30 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                            else colptr)
     nodes = np.asarray(input_nodes.numpy()
                        if isinstance(input_nodes, Tensor) else input_nodes)
+    eids_np = None
+    if eids is not None:
+        eids_np = np.asarray(eids.numpy() if isinstance(eids, Tensor)
+                             else eids)
     rng = np.random.default_rng()
-    out_neighbors, out_counts = [], []
+    out_neighbors, out_counts, out_eids = [], [], []
     for nid in nodes.reshape(-1):
         lo, hi = int(colptr_np[nid]), int(colptr_np[nid + 1])
-        neigh = row_np[lo:hi]
-        if sample_size > 0 and len(neigh) > sample_size:
-            neigh = rng.choice(neigh, sample_size, replace=False)
-        out_neighbors.append(neigh)
-        out_counts.append(len(neigh))
+        sel = np.arange(lo, hi)
+        if sample_size > 0 and len(sel) > sample_size:
+            sel = rng.choice(sel, sample_size, replace=False)
+        out_neighbors.append(row_np[sel])
+        out_counts.append(len(sel))
+        if return_eids:
+            out_eids.append(eids_np[sel] if eids_np is not None else sel)
     from ..core.tensor import to_tensor
-    return (to_tensor(np.concatenate(out_neighbors).astype(np.int64)
-                      if out_neighbors else np.zeros(0, np.int64)),
-            to_tensor(np.asarray(out_counts, np.int64)))
+    nbr = to_tensor(np.concatenate(out_neighbors).astype(np.int64)
+                    if out_neighbors else np.zeros(0, np.int64))
+    cnt = to_tensor(np.asarray(out_counts, np.int64))
+    if return_eids:
+        e = to_tensor(np.concatenate(out_eids).astype(np.int64)
+                      if out_eids else np.zeros(0, np.int64))
+        return nbr, cnt, e
+    return nbr, cnt
 
 
 def reindex_graph(x, neighbors, count):

@@ -1,0 +1,96 @@
+"""paddle.flops (reference: python/paddle/hapi/dynamic_flops.py — per-layer
+FLOPs via forward hooks + a per-type count table)."""
+import numpy as np
+
+from ..core.tensor import Tensor
+from .. import nn
+
+__all__ = ["flops"]
+
+
+def _numel(shape):
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def _count_linear(layer, x, y):
+    return _numel(x.shape) // x.shape[-1] * layer.weight.shape[0] \
+        * layer.weight.shape[1]
+
+
+def _count_conv(layer, x, y):
+    kernel = _numel(layer.weight.shape[2:])
+    cin = layer.weight.shape[1]
+    return _numel(y.shape) * cin * kernel
+
+
+def _count_norm(layer, x, y):
+    return 2 * _numel(x.shape)
+
+
+def _count_act(layer, x, y):
+    return _numel(x.shape)
+
+
+_TABLE = [
+    (nn.Linear, _count_linear),
+    (nn.Conv1D, _count_conv), (nn.Conv2D, _count_conv),
+    (nn.Conv3D, _count_conv),
+    (nn.BatchNorm1D, _count_norm), (nn.BatchNorm2D, _count_norm),
+    (nn.LayerNorm, _count_norm),
+    (nn.ReLU, _count_act), (nn.GELU, _count_act), (nn.Sigmoid, _count_act),
+]
+
+
+def _counter_for(layer):
+    for cls, fn in _TABLE:
+        if isinstance(layer, cls):
+            return fn
+    return None
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False):
+    """Total forward FLOPs (multiply-accumulate counted as 2 ops matches
+    the reference's convention of 1 MAC -> counted once; we follow the
+    reference: conv/linear counted as MACs)."""
+    custom_ops = custom_ops or {}
+    counts = {}
+    handles = []
+
+    def make_hook(name, layer):
+        def hook(ly, inp, out):
+            x = inp[0] if isinstance(inp, (tuple, list)) else inp
+            fn = custom_ops.get(type(ly)) or _counter_for(ly)
+            if fn is not None and isinstance(x, Tensor):
+                counts[name] = counts.get(name, 0) + int(fn(ly, x, out))
+        return hook
+
+    for name, layer in net.named_sublayers(include_self=True):
+        if not layer._sub_layers:  # leaves only (incl. a leaf root)
+            handles.append(layer.register_forward_post_hook(
+                make_hook(name or type(layer).__name__, layer)))
+
+    import paddle_tpu as paddle
+    if inputs is None:
+        if input_size is None:
+            raise ValueError("flops needs input_size or inputs")
+        inputs = (paddle.to_tensor(
+            np.zeros(input_size, np.float32)),)
+    was_training = net.training
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in handles:
+            h.remove()
+    total = sum(counts.values())
+    if print_detail:
+        for k, v in sorted(counts.items(), key=lambda kv: -kv[1]):
+            print(f"{k:50s} {v:>15,d}")
+        print(f"{'Total':50s} {total:>15,d}")
+    return total

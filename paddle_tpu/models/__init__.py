@@ -11,10 +11,23 @@ from . import gpt
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM
 from . import pretrain
 from .pretrain import make_train_state, make_train_step, llama_sharding_rules
+from . import ernie
+from .ernie import (ErnieConfig, ErnieModel, ErnieForSequenceClassification,
+                    ErnieForMaskedLM, ernie_sharding_rules)
+from . import vit
+from .vit import (VisionTransformer, vit_base_patch16_224,
+                  vit_large_patch16_224, vit_tiny)
+from . import unet
+from .unet import UNet2DConditionModel
 
 __all__ = [
     "llama", "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
     "LlamaPretrainingCriterion", "gpt", "GPTConfig", "GPTModel",
     "GPTForCausalLM", "pretrain", "make_train_state", "make_train_step",
     "llama_sharding_rules",
+    "ernie", "ErnieConfig", "ErnieModel", "ErnieForSequenceClassification",
+    "ErnieForMaskedLM", "ernie_sharding_rules",
+    "vit", "VisionTransformer", "vit_base_patch16_224",
+    "vit_large_patch16_224", "vit_tiny",
+    "unet", "UNet2DConditionModel",
 ]

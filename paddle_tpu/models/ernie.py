@@ -1,0 +1,160 @@
+"""ERNIE model family (BERT-style bidirectional encoder).
+
+Reference capability: ERNIE-3.0 hybrid TP+PP training is BASELINE config
+#3; the reference trains it via fleet + PaddleNLP's ernie modeling. Here
+the encoder is built from this framework's nn blocks (MultiHeadAttention /
+TransformerEncoder post-LN, reference python/paddle/nn/layer/transformer.py
+semantics) with a TP sharding-rule table for the mesh path."""
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+
+
+class ErnieConfig:
+    def __init__(self, vocab_size=40000, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=4,
+                 pad_token_id=0, dtype="float32"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.pad_token_id = pad_token_id
+        self.dtype = dtype
+
+    @classmethod
+    def tiny(cls, **kw):
+        base = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=64, type_vocab_size=2)
+        base.update(kw)
+        return cls(**base)
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        import paddle_tpu as paddle
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = paddle.to_tensor(
+                np.arange(s, dtype=np.int32)[None].repeat(b, 0))
+        if token_type_ids is None:
+            token_type_ids = paddle.to_tensor(
+                np.zeros((b, s), dtype=np.int32))
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class ErnieModel(nn.Layer):
+    """Encoder stack + pooler (BERT architecture, ERNIE weights family)."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self._init_args = {"config": None}  # not jit-reconstructable; ok
+        self.embeddings = ErnieEmbeddings(config)
+        enc_layer = nn.TransformerEncoderLayer(
+            d_model=config.hidden_size, nhead=config.num_attention_heads,
+            dim_feedforward=config.intermediate_size,
+            dropout=config.hidden_dropout_prob,
+            activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            act_dropout=0.0, normalize_before=False)
+        self.encoder = nn.TransformerEncoder(enc_layer,
+                                             config.num_hidden_layers)
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B, S] 1/0 -> additive [B, 1, 1, S]
+            m = (1.0 - attention_mask.astype(self.config.dtype)) * -1e4
+            attention_mask = m.unsqueeze(1).unsqueeze(2)
+        h = self.encoder(h, src_mask=attention_mask)
+        pooled = F.tanh(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, config, num_classes=2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                               attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            loss = F.cross_entropy(logits, labels)
+            return logits, loss
+        return logits
+
+
+class ErnieForMaskedLM(nn.Layer):
+    """MLM head tied to the word embeddings (BERT pretraining objective)."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size)
+        self.bias = self.create_parameter([config.vocab_size], is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, labels=None,
+                attention_mask=None):
+        h, _ = self.ernie(input_ids, token_type_ids,
+                          attention_mask=attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(h)))
+        # tied decoder: h @ E^T
+        logits = F.linear(h, self.ernie.embeddings.word_embeddings
+                          .weight.t()) + self.bias
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]),
+                labels.reshape([-1]), ignore_index=-100)
+            return logits, loss
+        return logits
+
+
+def ernie_sharding_rules():
+    """TP/FSDP rules for the mesh path (pretrain.spec_for_param format):
+    column-parallel QKV/FC1, row-parallel out-proj/FC2, sharded
+    embeddings."""
+    return [
+        ("word_embeddings.weight", ("mp", "fsdp")),
+        ("position_embeddings.weight", (None, None)),
+        ("token_type_embeddings.weight", (None, None)),
+        (".q_proj.weight", ("fsdp", "mp")),
+        (".k_proj.weight", ("fsdp", "mp")),
+        (".v_proj.weight", ("fsdp", "mp")),
+        (".out_proj.weight", ("mp", "fsdp")),
+        (".linear1.weight", ("fsdp", "mp")),
+        (".linear2.weight", ("mp", "fsdp")),
+        ("pooler.weight", (None, "fsdp")),
+        ("classifier.weight", (None, None)),
+    ]

@@ -1,0 +1,173 @@
+"""Conditional diffusion UNet (BASELINE config #5: Stable-Diffusion UNet
+with fused cross-attention ops).
+
+Reference capability: the SD UNet trains/serves through the reference's
+conv + fused attention kernels (fusion/gpu cross-attn tier, SURVEY.md
+§2.9); the architecture itself lives downstream (PPDiffusers). Here a
+UNet2DConditionModel-style network built on this framework's blocks:
+ResBlocks with timestep embedding, self+cross attention transformer
+blocks (flash path), GroupNorm+SiLU, down/up sampling."""
+import math
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+
+
+def timestep_embedding(timesteps, dim, max_period=10000):
+    """Sinusoidal embeddings [B, dim] (DDPM convention)."""
+    import paddle_tpu as paddle
+    half = dim // 2
+    freqs = np.exp(-math.log(max_period)
+                   * np.arange(half, dtype=np.float32) / half)
+    args = timesteps.astype("float32").unsqueeze(-1) * paddle.to_tensor(
+        freqs[None])
+    return paddle.concat([args.cos(), args.sin()], axis=-1)
+
+
+class ResBlock(nn.Layer):
+    def __init__(self, in_ch, out_ch, temb_ch, groups=8):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(groups, in_ch)
+        self.conv1 = nn.Conv2D(in_ch, out_ch, 3, padding=1)
+        self.temb_proj = nn.Linear(temb_ch, out_ch)
+        self.norm2 = nn.GroupNorm(groups, out_ch)
+        self.conv2 = nn.Conv2D(out_ch, out_ch, 3, padding=1)
+        self.skip = (nn.Conv2D(in_ch, out_ch, 1) if in_ch != out_ch
+                     else None)
+
+    def forward(self, x, temb):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = h + self.temb_proj(F.silu(temb)).unsqueeze(-1).unsqueeze(-1)
+        h = self.conv2(F.silu(self.norm2(h)))
+        return h + (self.skip(x) if self.skip is not None else x)
+
+
+class CrossAttnBlock(nn.Layer):
+    """Self-attention + cross-attention + gated MLP over flattened spatial
+    tokens (the SD transformer block; cross-attn keys/values come from the
+    text encoder states)."""
+
+    def __init__(self, channels, context_dim, num_heads=4, groups=8):
+        super().__init__()
+        self.norm = nn.GroupNorm(groups, channels)
+        self.proj_in = nn.Linear(channels, channels)
+        self.ln1 = nn.LayerNorm(channels)
+        self.self_attn = nn.MultiHeadAttention(channels, num_heads)
+        self.ln2 = nn.LayerNorm(channels)
+        self.cross_attn = nn.MultiHeadAttention(channels, num_heads,
+                                                kdim=context_dim,
+                                                vdim=context_dim)
+        self.ln3 = nn.LayerNorm(channels)
+        self.ff1 = nn.Linear(channels, channels * 4)
+        self.ff2 = nn.Linear(channels * 4, channels)
+        self.proj_out = nn.Linear(channels, channels)
+
+    def forward(self, x, context):
+        b, c, h, w = x.shape
+        t = self.norm(x).reshape([b, c, h * w]).transpose([0, 2, 1])
+        t = self.proj_in(t)
+        t = t + self.self_attn(self.ln1(t))
+        t = t + self.cross_attn(self.ln2(t), context, context)
+        t = t + self.ff2(F.gelu(self.ff1(self.ln3(t))))
+        t = self.proj_out(t)
+        return x + t.transpose([0, 2, 1]).reshape([b, c, h, w])
+
+
+class Downsample(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2D(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class Upsample(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2D(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        x = F.interpolate(x, scale_factor=2, mode="nearest")
+        return self.conv(x)
+
+
+class UNet2DConditionModel(nn.Layer):
+    """Down path -> mid (res+cross-attn+res) -> up path with skips."""
+
+    def __init__(self, in_channels=4, out_channels=4, base_channels=64,
+                 channel_mults=(1, 2), num_res_blocks=1, context_dim=128,
+                 num_heads=4, groups=8):
+        super().__init__()
+        temb_ch = base_channels * 4
+        self.base_channels = base_channels
+        self.temb1 = nn.Linear(base_channels, temb_ch)
+        self.temb2 = nn.Linear(temb_ch, temb_ch)
+        self.conv_in = nn.Conv2D(in_channels, base_channels, 3, padding=1)
+
+        chs = [base_channels]
+        ch = base_channels
+        self.down_blocks = nn.LayerList()
+        for i, mult in enumerate(channel_mults):
+            out_ch = base_channels * mult
+            for _ in range(num_res_blocks):
+                self.down_blocks.append(ResBlock(ch, out_ch, temb_ch,
+                                                 groups))
+                ch = out_ch
+                chs.append(ch)
+                self.down_blocks.append(CrossAttnBlock(ch, context_dim,
+                                                       num_heads, groups))
+            if i != len(channel_mults) - 1:
+                self.down_blocks.append(Downsample(ch))
+                chs.append(ch)
+
+        self.mid1 = ResBlock(ch, ch, temb_ch, groups)
+        self.mid_attn = CrossAttnBlock(ch, context_dim, num_heads, groups)
+        self.mid2 = ResBlock(ch, ch, temb_ch, groups)
+
+        self.up_blocks = nn.LayerList()
+        for i, mult in reversed(list(enumerate(channel_mults))):
+            out_ch = base_channels * mult
+            for _ in range(num_res_blocks + 1):
+                self.up_blocks.append(ResBlock(ch + chs.pop(), out_ch,
+                                               temb_ch, groups))
+                ch = out_ch
+                self.up_blocks.append(CrossAttnBlock(ch, context_dim,
+                                                     num_heads, groups))
+            if i != 0:
+                self.up_blocks.append(Upsample(ch))
+
+        self.norm_out = nn.GroupNorm(groups, ch)
+        self.conv_out = nn.Conv2D(ch, out_channels, 3, padding=1)
+
+    def forward(self, sample, timesteps, encoder_hidden_states):
+        temb = timestep_embedding(timesteps, self.base_channels)
+        temb = self.temb2(F.silu(self.temb1(temb)))
+
+        h = self.conv_in(sample)
+        skips = [h]
+        for blk in self.down_blocks:
+            if isinstance(blk, ResBlock):
+                h = blk(h, temb)
+                skips.append(h)
+            elif isinstance(blk, CrossAttnBlock):
+                h = blk(h, encoder_hidden_states)
+            else:
+                h = blk(h)
+                skips.append(h)
+
+        h = self.mid2(self.mid_attn(self.mid1(h, temb),
+                                    encoder_hidden_states), temb)
+
+        import paddle_tpu as paddle
+        for blk in self.up_blocks:
+            if isinstance(blk, ResBlock):
+                h = blk(paddle.concat([h, skips.pop()], axis=1), temb)
+            elif isinstance(blk, CrossAttnBlock):
+                h = blk(h, encoder_hidden_states)
+            else:
+                h = blk(h)
+
+        return self.conv_out(F.silu(self.norm_out(h)))

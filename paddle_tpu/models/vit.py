@@ -1,0 +1,77 @@
+"""Vision Transformer (BASELINE config #4: ViT auto-parallel DP).
+
+The reference's vision zoo is conv-only (SURVEY.md §2.10 — "ViT absent");
+ViT support there lives downstream (PaddleClas). Here it is first-class:
+patch-embed conv + pre-LN transformer encoder + class token, built from
+the same nn blocks as the language models so the mesh/TP paths apply."""
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+
+
+class PatchEmbed(nn.Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = nn.Conv2D(in_chans, embed_dim, kernel_size=patch_size,
+                              stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)                       # [B, E, H/p, W/p]
+        b, e = x.shape[0], x.shape[1]
+        return x.reshape([b, e, -1]).transpose([0, 2, 1])   # [B, N, E]
+
+
+class VisionTransformer(nn.Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3,
+                 num_classes=1000, embed_dim=768, depth=12, num_heads=12,
+                 mlp_ratio=4.0, dropout=0.0, attn_dropout=0.0,
+                 class_num=None):
+        super().__init__()
+        num_classes = class_num or num_classes
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans,
+                                      embed_dim)
+        n = self.patch_embed.num_patches
+        self.cls_token = self.create_parameter([1, 1, embed_dim])
+        self.pos_embed = self.create_parameter([1, n + 1, embed_dim])
+        self.pos_drop = nn.Dropout(dropout)
+        layer = nn.TransformerEncoderLayer(
+            d_model=embed_dim, nhead=num_heads,
+            dim_feedforward=int(embed_dim * mlp_ratio), dropout=dropout,
+            activation="gelu", attn_dropout=attn_dropout,
+            normalize_before=True)
+        self.encoder = nn.TransformerEncoder(layer, depth,
+                                             norm=nn.LayerNorm(embed_dim))
+        self.head = nn.Linear(embed_dim, num_classes)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        x = self.patch_embed(x)                # [B, N, E]
+        b = x.shape[0]
+        cls = paddle.concat(
+            [self.cls_token.expand([b, 1, self.cls_token.shape[-1]]), x],
+            axis=1)
+        h = self.pos_drop(cls + self.pos_embed)
+        h = self.encoder(h)
+        return self.head(h[:, 0])
+
+
+def vit_base_patch16_224(**kwargs):
+    return VisionTransformer(patch_size=16, embed_dim=768, depth=12,
+                             num_heads=12, **kwargs)
+
+
+def vit_large_patch16_224(**kwargs):
+    return VisionTransformer(patch_size=16, embed_dim=1024, depth=24,
+                             num_heads=16, **kwargs)
+
+
+def vit_tiny(**kwargs):
+    kwargs.setdefault("img_size", 32)
+    kwargs.setdefault("patch_size", 8)
+    kwargs.setdefault("embed_dim", 64)
+    kwargs.setdefault("depth", 2)
+    kwargs.setdefault("num_heads", 4)
+    kwargs.setdefault("num_classes", 10)
+    return VisionTransformer(**kwargs)

@@ -13,8 +13,6 @@ Ops not connected to a placeholder (e.g. parameter initializers) run
 eagerly and are NOT recorded — the startup-program split falls out of the
 dataflow rule instead of needing a second Program.
 """
-import weakref
-
 import jax
 import numpy as np
 
@@ -24,15 +22,20 @@ from ..core.tensor import Tensor
 
 class _OpRecord:
     __slots__ = ("impl", "treedef", "plain", "tensor_slots", "out_ids",
-                 "name")
+                 "out_tensors", "name")
 
-    def __init__(self, name, impl, treedef, plain, tensor_slots, out_ids):
+    def __init__(self, name, impl, treedef, plain, tensor_slots, out_ids,
+                 out_tensors):
         self.name = name
         self.impl = impl
         self.treedef = treedef
         self.plain = plain                  # template incl. constants
-        self.tensor_slots = tensor_slots    # [(leaf_idx, weakref(Tensor))]
+        # strong refs: inputs may be unbound intermediates/constants and
+        # outputs must stay alive so ids are stable and replay never sees
+        # a collected tensor
+        self.tensor_slots = tensor_slots    # [(leaf_idx, Tensor)]
         self.out_ids = out_ids
+        self.out_tensors = out_tensors
 
 
 class Program:
@@ -52,15 +55,14 @@ class Program:
     def _record(self, name, impl, treedef, leaves, tensor_idx, outs):
         if not any(id(leaves[i]) in self._connected for i in tensor_idx):
             return  # initializer-style op: eager only
-        slots = [(i, weakref.ref(leaves[i])) for i in tensor_idx]
+        slots = [(i, leaves[i]) for i in tensor_idx]
         plain = [l.data if isinstance(l, Tensor) else l for l in leaves]
         out_list = outs if isinstance(outs, (tuple, list)) else [outs]
         out_ids = [id(o) for o in out_list]
         for o in out_list:
             self._connected.add(id(o))
-            o.persistable = True  # keep fetchable tensors alive
         self.ops.append(_OpRecord(name, impl, treedef, plain, slots,
-                                  out_ids))
+                                  out_ids, list(out_list)))
         self._compiled.clear()
 
     # -- replay -----------------------------------------------------------
@@ -73,13 +75,7 @@ class Program:
         externals = []
         seen = set()
         for rec in self.ops:
-            for i, tref in rec.tensor_slots:
-                t = tref()
-                if t is None:
-                    raise RuntimeError(
-                        f"program op '{rec.name}' lost an input tensor "
-                        "(garbage collected); keep references to "
-                        "intermediate vars or rebuild the program")
+            for i, t in rec.tensor_slots:
                 if id(t) not in produced and id(t) not in seen:
                     seen.add(id(t))
                     externals.append(t)
@@ -94,8 +90,7 @@ class Program:
             from jax.tree_util import tree_unflatten
             for rec in records:
                 plain = list(rec.plain)
-                for i, tref in rec.tensor_slots:
-                    t = tref()
+                for i, t in rec.tensor_slots:
                     plain[i] = env[id(t)]
                 a, k = tree_unflatten(rec.treedef, plain)
                 out = rec.impl(*a, **k)

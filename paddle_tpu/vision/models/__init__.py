@@ -3,5 +3,12 @@ VGG, MobileNet v1-v3, AlexNet...)."""
 from .lenet import LeNet
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, BasicBlock, BottleneckBlock
 from .mobilenet import MobileNetV1, mobilenet_v1
+from .mobilenetv2 import MobileNetV2, mobilenet_v2
+from .mobilenetv3 import (MobileNetV3Large, MobileNetV3Small,
+                          mobilenet_v3_large, mobilenet_v3_small)
 from .alexnet import AlexNet, alexnet
 from .vgg import VGG, vgg11, vgg16
+from .extra import (SqueezeNet, squeezenet1_0, squeezenet1_1,
+                    DenseNet, densenet121, GoogLeNet, googlenet,
+                    ShuffleNetV2, shufflenet_v2_x1_0,
+                    wide_resnet50_2, wide_resnet101_2)

@@ -1,0 +1,277 @@
+"""SqueezeNet, DenseNet, GoogLeNet, ShuffleNetV2, wide-ResNet variants
+(reference: python/paddle/vision/models/{squeezenet,densenet,googlenet,
+shufflenetv2,resnet(wide_)}.py)."""
+import paddle_tpu as paddle
+from ... import nn
+from ...nn import functional as F
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet
+# ---------------------------------------------------------------------------
+
+class Fire(nn.Layer):
+    def __init__(self, in_c, squeeze_c, e1_c, e3_c):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze_c, 1)
+        self.expand1 = nn.Conv2D(squeeze_c, e1_c, 1)
+        self.expand3 = nn.Conv2D(squeeze_c, e3_c, 3, padding=1)
+
+    def forward(self, x):
+        s = F.relu(self.squeeze(x))
+        return paddle.concat([F.relu(self.expand1(s)),
+                              F.relu(self.expand3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.1", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                Fire(96, 16, 64, 64), Fire(128, 16, 64, 64),
+                Fire(128, 32, 128, 128), nn.MaxPool2D(3, stride=2),
+                Fire(256, 32, 128, 128), Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192), Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2), Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                Fire(64, 16, 64, 64), Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                Fire(128, 32, 128, 128), Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                Fire(256, 48, 192, 192), Fire(384, 48, 192, 192),
+                Fire(384, 64, 256, 256), Fire(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return x.flatten(1)
+
+
+def squeezenet1_0(**kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(**kw):
+    return SqueezeNet("1.1", **kw)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet
+# ---------------------------------------------------------------------------
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth, bn_size):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+
+    def forward(self, x):
+        h = self.conv1(F.relu(self.norm1(x)))
+        h = self.conv2(F.relu(self.norm2(h)))
+        return paddle.concat([x, h], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(in_c)
+        self.conv = nn.Conv2D(in_c, out_c, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(F.relu(self.norm(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, growth_rate=32, bn_size=4,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        cfgs = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+                169: (6, 12, 32, 32), 201: (6, 12, 48, 32)}
+        block_cfg = cfgs[layers]
+        num_init = 2 * growth_rate
+        feats = [nn.Conv2D(3, num_init, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(num_init), nn.ReLU(),
+                 nn.MaxPool2D(3, stride=2, padding=1)]
+        ch = num_init
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth_rate, bn_size))
+                ch += growth_rate
+            if i != len(block_cfg) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.features(x)).flatten(1)
+        return self.classifier(x)
+
+
+def densenet121(**kw):
+    return DenseNet(121, **kw)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet (Inception v1)
+# ---------------------------------------------------------------------------
+
+class Inception(nn.Layer):
+    def __init__(self, in_c, c1, c2, c3, c4):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(in_c, c1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(nn.Conv2D(in_c, c2[0], 1), nn.ReLU(),
+                                nn.Conv2D(c2[0], c2[1], 3, padding=1),
+                                nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(in_c, c3[0], 1), nn.ReLU(),
+                                nn.Conv2D(c3[0], c3[1], 5, padding=2),
+                                nn.ReLU())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                nn.Conv2D(in_c, c4, 1), nn.ReLU())
+
+    def forward(self, x):
+        return paddle.concat([self.b1(x), self.b2(x), self.b3(x),
+                              self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.blocks = nn.Sequential(
+            Inception(192, 64, (96, 128), (16, 32), 32),
+            Inception(256, 128, (128, 192), (32, 96), 64),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            Inception(480, 192, (96, 208), (16, 48), 64),
+            Inception(512, 160, (112, 224), (24, 64), 64),
+            Inception(512, 128, (128, 256), (24, 64), 64),
+            Inception(512, 112, (144, 288), (32, 64), 64),
+            Inception(528, 256, (160, 320), (32, 128), 128),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            Inception(832, 256, (160, 320), (32, 128), 128),
+            Inception(832, 384, (192, 384), (48, 128), 128))
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.2)
+        self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        x = self.dropout(self.pool(x).flatten(1))
+        return self.fc(x)
+
+
+def googlenet(**kw):
+    return GoogLeNet(**kw)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2
+# ---------------------------------------------------------------------------
+
+def _channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = x.reshape([b, groups, c // groups, h, w])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([b, c, h, w])
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c,
+                          bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU())
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), nn.ReLU(),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), nn.ReLU())
+
+    def forward(self, x):
+        if self.stride == 2:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        stage_out = {0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+                     1.5: (176, 352, 704, 1024),
+                     2.0: (244, 488, 976, 2048)}[scale]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 24, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(24), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        in_c = 24
+        stages = []
+        for out_c, repeat in zip(stage_out[:3], (4, 8, 4)):
+            stages.append(_ShuffleUnit(in_c, out_c, 2))
+            for _ in range(repeat - 1):
+                stages.append(_ShuffleUnit(out_c, out_c, 1))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.tail = nn.Sequential(
+            nn.Conv2D(in_c, stage_out[3], 1, bias_attr=False),
+            nn.BatchNorm2D(stage_out[3]), nn.ReLU())
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(stage_out[3], num_classes)
+
+    def forward(self, x):
+        x = self.tail(self.stages(self.stem(x)))
+        return self.fc(self.pool(x).flatten(1))
+
+
+def shufflenet_v2_x1_0(**kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Wide ResNet
+# ---------------------------------------------------------------------------
+
+def wide_resnet50_2(**kw):
+    from .resnet import ResNet, BottleneckBlock
+    return ResNet(BottleneckBlock, 50, width=128, **kw)
+
+
+def wide_resnet101_2(**kw):
+    from .resnet import ResNet, BottleneckBlock
+    return ResNet(BottleneckBlock, 101, width=128, **kw)

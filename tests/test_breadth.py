@@ -105,6 +105,27 @@ class TestGeometric:
         assert list(cnt.numpy()) == [2, 1, 0]
         assert set(nbr.numpy()[:2]) == {1, 2}
 
+    def test_sample_neighbors_return_eids(self):
+        row = paddle.to_tensor(np.array([1, 2, 2], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 2, 3, 3], np.int64))
+        eids = paddle.to_tensor(np.array([10, 11, 12], np.int64))
+        nbr, cnt, e = paddle.geometric.sample_neighbors(
+            row, colptr, paddle.to_tensor(np.array([0, 1], np.int64)),
+            eids=eids, return_eids=True)
+        assert list(cnt.numpy()) == [2, 1]
+        assert set(e.numpy()[:2]) == {10, 11} and e.numpy()[2] == 12
+
+    def test_segment_needs_static_count_under_jit(self):
+        data = paddle.to_tensor(np.ones((4, 1), np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1, 1], np.int32))
+        out = paddle.geometric.segment_sum(data, ids, num_segments=2)
+        assert out.shape == [2, 1]
+        import jax
+        with pytest.raises(Exception):
+            jax.jit(lambda d, i: paddle.geometric.segment_sum(
+                paddle.to_tensor(d), paddle.to_tensor(i)).data)(
+                    data.numpy(), ids.numpy())
+
 
 class TestAudio:
     def test_fbank_matrix_shape_and_norm(self):
@@ -248,6 +269,25 @@ class TestStaticProgram:
             lin.bias.set_value(lin.bias.numpy() + 5.0)
         after = exe.run(prog, {"x": feed}, [out])[0]
         np.testing.assert_allclose(after - before, 5.0, rtol=1e-5)
+
+    def test_unbound_intermediates_survive(self):
+        # nested expression, no variables bound, no grad graph: records
+        # must hold the intermediates alive for replay
+        from paddle_tpu import static
+        paddle.seed(4)
+        lin = nn.Linear(3, 3)
+        prog = static.Program()
+        with paddle.no_grad(), static.program_guard(prog):
+            x = static.data("x", [2, 3], "float32")
+            out = paddle.nn.functional.relu(lin(x) * 2.0)
+        import gc
+        gc.collect()
+        feed = np.random.default_rng(1).standard_normal((2, 3)).astype(
+            np.float32)
+        got = static.Executor().run(prog, {"x": feed}, [out])[0]
+        ref = np.maximum((feed @ lin.weight.numpy() + lin.bias.numpy())
+                         * 2.0, 0)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
 
     def test_initializer_ops_not_recorded(self):
         from paddle_tpu import static
