@@ -10,9 +10,10 @@ backward is the standard two-pass flash backward (dq pass gridded over query
 blocks; dkv pass gridded over key blocks) using the saved logsumexp; the
 softmax-grad correction term delta = rowsum(do*o) is recomputed in-kernel.
 
-The saved logsumexp is materialized as [BH, S, 8] f32 (one sublane tile —
-the minimum the TPU tiling constraints allow; a 128-lane-broadcast residual
-would cost 16x more HBM, 128MB/layer at 7B shapes). In-kernel running
+The saved logsumexp is materialized as [BH, 8, S] f32 — the sequence dim
+rides the 128-lane axis, so the (8,128) tiling pads nothing. (The earlier
+[BH, S, 8] layout tiled 8 lanes up to 128: a 16x HBM expansion, 256MB/layer
+at 2k-seq shapes, visible in XLA's allocation dumps.) In-kernel running
 max/denominator scratch stays lane-broadcast [block_q, 128] for VPU-friendly
 shapes.
 
@@ -27,8 +28,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 512   # measured on v5e: (512, 1024) is ~3.4x faster than
-DEFAULT_BLOCK_K = 1024  # (128, 128) fwd+bwd and beats the stock jax kernel
+DEFAULT_BLOCK_Q = 1024  # measured on v5e: (1024, 2048) is ~25% faster than
+DEFAULT_BLOCK_K = 2048  # (512, 1024) on the 2k-seq llama step, which itself
+                        # was ~3.4x over (128, 128) and beat the stock kernel
 LANES = 128
 LSE_LANES = 8  # one f32 sublane tile: smallest legal trailing dim
 NEG_INF = -1e30
@@ -109,7 +111,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         o_ref[0] = (acc[...] / l).astype(o_ref.dtype)
         lse = m_scr[:, :1] + jnp.log(jnp.where(l_scr[:, :1] == 0.0, 1.0,
                                                l_scr[:, :1]))
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        # lse_ref block is [LSE_SUBLANES, block_q]: broadcast across sublanes
+        lse_ref[0] = jnp.broadcast_to(lse[:, 0][None, :], lse_ref.shape[1:])
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
@@ -134,11 +137,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LSE_LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, LSE_LANES, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, LSE_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, LSE_LANES, sq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -173,7 +176,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         v = v_ref[0].astype(jnp.float32)
         o = o_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0, :, :1]                      # [BQ, 1]
+        lse = lse_ref[0, 0][:, None]                 # [BQ, 1]
         delta = jnp.sum(do * o, axis=1, keepdims=True)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -228,7 +231,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         v = v_ref[0].astype(jnp.float32)
         o = o_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0, :, :1]                      # [BQ, 1]
+        lse = lse_ref[0, 0][:, None]                 # [BQ, 1]
         delta = jnp.sum(do * o, axis=1, keepdims=True)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -267,6 +270,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
+    # the backward kernels stream ~3x the operands of the forward (q, k, v,
+    # o, do + accumulators), so large forward tiles blow the scoped-VMEM
+    # budget; clamp to the measured-safe backward tile sizes
+    block_q = min(block_q, 512)
+    block_k = min(block_k, 1024)
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = _pick_block(sq, block_q)
@@ -284,7 +292,7 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LSE_LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, LSE_LANES, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -302,7 +310,7 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LSE_LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, LSE_LANES, block_q), lambda b, j, i: (b, 0, i)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),

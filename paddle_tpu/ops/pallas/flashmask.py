@@ -116,7 +116,8 @@ def _fm_fwd_kernel(q_ref, k_ref, v_ref, sr_ref, er_ref, o_ref, lse_ref,
         o_ref[0] = (acc[...] / l).astype(o_ref.dtype)
         lse = m_scr[:, :1] + jnp.log(jnp.where(l_scr[:, :1] == 0.0, 1.0,
                                                l_scr[:, :1]))
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        # [LSE_SUBLANES, block_q] tile: seq on lanes, no padding expansion
+        lse_ref[0] = jnp.broadcast_to(lse[:, 0][None, :], lse_ref.shape[1:])
 
 
 def _fm_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, sr_ref,
@@ -141,7 +142,7 @@ def _fm_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, sr_ref,
         v = v_ref[0].astype(jnp.float32)
         o = o_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0, :, :1]
+        lse = lse_ref[0, 0][:, None]
         delta = jnp.sum(do * o, axis=1, keepdims=True)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -189,7 +190,7 @@ def _fm_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, sr_ref,
         v = v_ref[0].astype(jnp.float32)
         o = o_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0, :, :1]
+        lse = lse_ref[0, 0][:, None]
         delta = jnp.sum(do * o, axis=1, keepdims=True)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -222,7 +223,7 @@ def _specs(block_q, block_k, d):
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
     mspec = pl.BlockSpec((1, SUBLANES, block_k), lambda b, i, j: (b, 0, j))
-    lspec = pl.BlockSpec((1, block_q, LSE_LANES), lambda b, i, j: (b, i, 0))
+    lspec = pl.BlockSpec((1, LSE_LANES, block_q), lambda b, i, j: (b, 0, i))
     return qspec, kspec, mspec, lspec
 
 
@@ -240,7 +241,7 @@ def _fm_fwd(q, k, v, sr, er, scale, causal, block_q, block_k):
         in_specs=[qspec, kspec, kspec, mspec, mspec],
         out_specs=[qspec, lspec],
         out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-                   jax.ShapeDtypeStruct((bh, sq, LSE_LANES), jnp.float32)],
+                   jax.ShapeDtypeStruct((bh, LSE_LANES, sq), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
                         pltpu.VMEM((block_q, LANES), jnp.float32),
                         pltpu.VMEM((block_q, LANES), jnp.float32)],
@@ -249,6 +250,10 @@ def _fm_fwd(q, k, v, sr, er, scale, causal, block_q, block_k):
 
 
 def _fm_bwd(q, k, v, o, lse, do, sr, er, scale, causal, block_q, block_k):
+    # backward streams even more operands than flash's (adds the sr/er mask
+    # rows) — clamp to the safe backward tile sizes (see _flash_bwd)
+    block_q = min(block_q, 512)
+    block_k = min(block_k, 1024)
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = _pick_block(sq, block_q)
@@ -270,8 +275,8 @@ def _fm_bwd(q, k, v, o, lse, do, sr, er, scale, causal, block_q, block_k):
     kspec_t = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
     mspec_t = pl.BlockSpec((1, SUBLANES, block_k),
                            lambda b, j, i: (b, 0, j))
-    lspec_t = pl.BlockSpec((1, block_q, LSE_LANES),
-                           lambda b, j, i: (b, i, 0))
+    lspec_t = pl.BlockSpec((1, LSE_LANES, block_q),
+                           lambda b, j, i: (b, 0, i))
     dk, dv = pl.pallas_call(
         functools.partial(_fm_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_len=sk),
