@@ -12,4 +12,4 @@ from ..mesh import ProcessMesh, get_mesh, set_mesh
 from ..placement import Shard, Replicate, Partial
 from .api import (ShardingStage1, ShardingStage2, ShardingStage3,
                   shard_optimizer, shard_dataloader, to_static, DistModel,
-                  Strategy)
+                  Strategy, Engine)

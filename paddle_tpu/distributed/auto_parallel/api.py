@@ -175,3 +175,103 @@ def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
               metrics=None):
     """dist.to_static (api.py:2952)."""
     return DistModel(layer, loader, loss, optimizer, strategy, metrics)
+
+
+class Engine:
+    """Auto-parallel training driver (reference: auto_parallel/static/
+    engine.py — Engine(model, loss, optimizer, metrics).fit/evaluate/
+    predict). The reference's static pass pipeline (mix2dist -> sharding
+    propagation -> partition -> reshard insertion, engine.py:669) collapses
+    into jitting the functional step over the mesh: GSPMD propagates the
+    DTensor shardings and inserts every collective."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics is not None else [])
+        self._strategy = strategy or Strategy()
+        self._dist_model = None
+
+    def _ensure(self):
+        if self._dist_model is None:
+            self._dist_model = DistModel(self._model, None, self._loss,
+                                         self._optimizer, self._strategy)
+        return self._dist_model
+
+    def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None,
+            log_freq=10, verbose=1):
+        """Train over a DataLoader/iterable of (inputs..., label) batches."""
+        dm = self._ensure()
+        dm.train()
+        pending = []   # device-side losses: sync only at log points / end,
+        history = {"loss": []}  # keeping async dispatch pipelined
+        for epoch in range(epochs):
+            for step, batch in enumerate(train_data):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                batch = batch if isinstance(batch, (tuple, list)) else (batch,)
+                loss = dm(*batch)
+                pending.append(loss)
+                if verbose and step % log_freq == 0:
+                    print(f"epoch {epoch} step {step}: loss "
+                          f"{float(loss.numpy()):.5f}")
+        history["loss"] = [float(l.numpy()) for l in pending]
+        return history
+
+    def evaluate(self, eval_data, batch_size=None, steps=None, verbose=0):
+        from ...core import autograd as _ag
+        dm = self._ensure()
+        dm.eval()
+        for m in self._metrics:
+            m.reset()
+        total, count = 0.0, 0
+        for step, batch in enumerate(eval_data):
+            if steps is not None and step >= steps:
+                break
+            batch = batch if isinstance(batch, (tuple, list)) else (batch,)
+            *inputs, label = batch
+            with _ag._GradModeGuard(False):
+                out = dm(*inputs)
+            if self._loss is not None:
+                total += float(self._loss(out, label).numpy())
+                count += 1
+            for m in self._metrics:
+                m.update(m.compute(out, label))
+        result = {"loss": total / max(count, 1)}
+        for m in self._metrics:
+            names, vals = m.name(), m.accumulate()
+            if isinstance(names, (list, tuple)):   # multi-topk metrics
+                for nm, v in zip(names, vals):
+                    result[nm] = v
+            else:
+                result[names] = vals
+        return result
+
+    def predict(self, test_data, steps=None):
+        from ...core import autograd as _ag
+        dm = self._ensure()
+        dm.eval()
+        outs = []
+        for step, batch in enumerate(test_data):
+            if steps is not None and step >= steps:
+                break
+            batch = batch if isinstance(batch, (tuple, list)) else (batch,)
+            with _ag._GradModeGuard(False):
+                outs.append(dm(*batch))
+        return outs
+
+    # reference-parity accessors
+    @property
+    def main_program(self):
+        return None
+
+    def save(self, path, training=True):
+        from ...framework import save as fw_save
+        fw_save(self._model.state_dict(), path + ".pdparams")
+
+    def load(self, path):
+        from ...framework import load as fw_load
+        self._model.set_state_dict(fw_load(path + ".pdparams"))

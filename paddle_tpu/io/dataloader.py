@@ -13,6 +13,46 @@ import threading
 
 import numpy as np
 
+
+def _np_collate(batch):
+    """Worker-side collate: numpy-only (workers never import jax; the main
+    process converts ndarrays to device tensors at yield time)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, collections.abc.Mapping):
+        return {k: _np_collate([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, collections.abc.Sequence):
+        return [_np_collate(list(col)) for col in zip(*batch)]
+    raise TypeError(f"cannot collate {type(sample)}")
+
+
+class _SpawnUnavailable(Exception):
+    pass
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate, init_fn, wid):
+    """Process-worker loop (reference: io/dataloader/worker.py — fetch
+    sample indices, collate, ship the batch back over the queue)."""
+    if init_fn is not None:
+        init_fn(wid)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            return
+        seq, indices = item
+        try:
+            batch = collate([dataset[i] for i in indices])
+            data_queue.put((seq, batch, None))
+        except Exception as e:
+            data_queue.put((seq, None, e))
+
 from ..core.tensor import Tensor
 from .dataset import IterableDataset
 from .sampler import BatchSampler
@@ -44,10 +84,14 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=False, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_process_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
+        self._custom_collate = collate_fn is not None
         self.num_workers = num_workers
+        self.worker_init_fn = worker_init_fn
+        self.use_process_workers = use_process_workers
+        self.timeout = timeout
         self.prefetch_factor = max(prefetch_factor, 2)
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
@@ -80,10 +124,119 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
+    @staticmethod
+    def _to_tensor_tree(b):
+        if isinstance(b, np.ndarray):
+            return Tensor(b)
+        if isinstance(b, dict):
+            return {k: DataLoader._to_tensor_tree(v) for k, v in b.items()}
+        if isinstance(b, list) and b and isinstance(
+                b[0], (np.ndarray, dict, list)):
+            return [DataLoader._to_tensor_tree(v) for v in b]
+        return b
+
+    def _start_process_workers(self):
+        """Spawn the worker pool; raises _SpawnUnavailable only during
+        startup (unpicklable dataset), so the thread fallback can never
+        replay batches that process workers already yielded.
+
+        NOTE (spawn contract, same as the reference's/PyTorch's): the
+        launching script must be import-safe (`if __name__ == "__main__"`),
+        and a custom collate_fn runs IN the worker and must return
+        picklable numpy/python data (workers never touch jax)."""
+        import multiprocessing as mp
+        ctx = mp.get_context("spawn")  # fork after jax init is unsafe
+        collate = self.collate_fn if self._custom_collate else _np_collate
+        index_queues = [ctx.Queue() for _ in range(self.num_workers)]
+        data_queue = ctx.Queue()
+        procs = [ctx.Process(
+            target=_worker_loop,
+            args=(self.dataset, index_queues[w], data_queue, collate,
+                  self.worker_init_fn, w), daemon=True)
+            for w in range(self.num_workers)]
+        try:
+            for p in procs:
+                p.start()
+        except (RuntimeError, TypeError, AttributeError, OSError,
+                ImportError) as e:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            raise _SpawnUnavailable(str(e))
+        return procs, index_queues, data_queue
+
+    def _queue_get(self, data_queue, procs):
+        """Liveness-checked read: a dead worker raises instead of hanging
+        the trainer forever; self.timeout (when > 0) bounds the total wait
+        per batch (reference DataLoader timeout semantics)."""
+        import time
+        deadline = (time.monotonic() + self.timeout) if self.timeout else None
+        while True:
+            try:
+                return data_queue.get(timeout=5)
+            except queue.Empty:
+                dead = [p for p in procs if not p.is_alive()]
+                if dead:
+                    raise RuntimeError(
+                        f"DataLoader worker (pid {dead[0].pid}) died "
+                        f"unexpectedly (exit {dead[0].exitcode})")
+                if deadline is not None and time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self.timeout}s")
+
+    def _iter_process_workers(self, procs, index_queues, data_queue):
+        """True multiprocess workers (reference dataloader_iter.py:368).
+        Batch order is preserved with a sequence-number reorder buffer."""
+        try:
+            batches = list(self.batch_sampler)
+            n = len(batches)
+            inflight_cap = self.num_workers * self.prefetch_factor
+            sent = 0
+            done = {}
+            next_out = 0
+            while sent < min(inflight_cap, n):
+                index_queues[sent % self.num_workers].put(
+                    (sent, batches[sent]))
+                sent += 1
+            while next_out < n:
+                while next_out not in done:
+                    seq, batch, err = self._queue_get(data_queue, procs)
+                    if err is not None:
+                        raise err
+                    done[seq] = batch
+                    if sent < n:
+                        index_queues[sent % self.num_workers].put(
+                            (sent, batches[sent]))
+                        sent += 1
+                b = done.pop(next_out)
+                next_out += 1
+                yield (self._to_tensor_tree(b) if not self._custom_collate
+                       else b)
+        finally:
+            for iq in index_queues:
+                try:
+                    iq.put_nowait(None)
+                except Exception:
+                    pass
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
+
     def __iter__(self):
         if self.num_workers == 0:
             yield from self._batches()
             return
+        if (self.use_process_workers and not self._iterable
+                and self.num_workers > 0):
+            try:
+                handles = self._start_process_workers()
+            except _SpawnUnavailable:
+                pass  # unpicklable dataset etc.: thread prefetch below
+            else:
+                # startup succeeded: from here errors propagate (no replay)
+                yield from self._iter_process_workers(*handles)
+                return
         # background-thread prefetch (role of the reference's worker pool +
         # shared-memory queue, dataloader_iter.py:368)
         q = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)

@@ -422,3 +422,31 @@ class TestM5ReviewRegressions:
         batch = next(iter(sdl))
         assert isinstance(batch, dict)
         assert batch["input"].placements is not None
+
+
+class TestAutoParallelEngine:
+    def test_fit_evaluate_predict(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer
+        import paddle_tpu.distributed as dist
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        loss = nn.CrossEntropyLoss()
+        opt = optimizer.Adam(parameters=model.parameters(),
+                             learning_rate=1e-2)
+        from paddle_tpu.metric import Accuracy
+        eng = dist.auto_parallel.Engine(model, loss, opt,
+                                        metrics=Accuracy())
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((32, 4)).astype(np.float32)
+        Y = (X.sum(1) > 0).astype(np.int64)
+        data = [(paddle.to_tensor(X[i:i + 8]),
+                 paddle.to_tensor(Y[i:i + 8])) for i in range(0, 32, 8)]
+        hist = eng.fit(data, epochs=6, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+        res = eng.evaluate(data)
+        assert res["acc"] > 0.7
+        preds = eng.predict([(paddle.to_tensor(X[:8]),)])
+        assert preds[0].shape == [8, 2]

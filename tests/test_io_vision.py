@@ -195,3 +195,52 @@ class TestSaveLoad:
         assert back["a"].numpy()[0] == 1.0
         arrs = paddle.load(path, return_numpy=True)
         assert isinstance(arrs["a"], np.ndarray)
+
+
+class _SquareDataset:
+    """Module-level so spawn workers can pickle it."""
+    def __init__(self, n=32):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        import numpy as _np
+        return _np.full((3,), i * i, dtype=_np.float32), i
+
+
+class TestProcessWorkers:
+    def test_order_and_values(self):
+        import numpy as np
+        from paddle_tpu.io import DataLoader
+        ds = _SquareDataset(20)
+        dl = DataLoader(ds, batch_size=4, num_workers=2, shuffle=False,
+                        use_process_workers=True)
+        batches = list(dl)
+        assert len(batches) == 5
+        xs, ys = batches[0]
+        np.testing.assert_allclose(ys.numpy(), [0, 1, 2, 3])
+        np.testing.assert_allclose(xs.numpy()[:, 0], [0, 1, 4, 9])
+        # order preserved across all batches
+        all_ys = np.concatenate([b[1].numpy() for b in batches])
+        np.testing.assert_allclose(all_ys, np.arange(20))
+
+    def test_worker_exception_propagates(self):
+        import pytest
+        from paddle_tpu.io import DataLoader
+
+        dl = DataLoader(_BrokenDataset(), batch_size=2, num_workers=2,
+                        use_process_workers=True)
+        with pytest.raises(Exception):
+            list(dl)
+
+
+class _BrokenDataset:
+    def __len__(self):
+        return 6
+
+    def __getitem__(self, i):
+        if i == 3:
+            raise ValueError("bad sample")
+        return i
