@@ -28,6 +28,7 @@ from . import ops
 from .ops import OP_TABLE
 
 from . import linalg
+from . import ops as tensor  # paddle.tensor namespace alias
 
 # framework-level namespaces are imported lazily below to keep import cheap
 from . import nn

@@ -206,19 +206,21 @@ class Engine:
         """Train over a DataLoader/iterable of (inputs..., label) batches."""
         dm = self._ensure()
         dm.train()
-        pending = []   # device-side losses: sync only at log points / end,
-        history = {"loss": []}  # keeping async dispatch pipelined
+        pending = []   # device-side losses, drained at every log point so
+        history = {"loss": []}  # the buffer stays bounded by log_freq
         for epoch in range(epochs):
             for step, batch in enumerate(train_data):
                 if steps_per_epoch is not None and step >= steps_per_epoch:
                     break
                 batch = batch if isinstance(batch, (tuple, list)) else (batch,)
-                loss = dm(*batch)
-                pending.append(loss)
-                if verbose and step % log_freq == 0:
-                    print(f"epoch {epoch} step {step}: loss "
-                          f"{float(loss.numpy()):.5f}")
-        history["loss"] = [float(l.numpy()) for l in pending]
+                pending.append(dm(*batch))
+                if step % log_freq == 0:
+                    history["loss"].extend(float(l.numpy()) for l in pending)
+                    pending.clear()
+                    if verbose:
+                        print(f"epoch {epoch} step {step}: loss "
+                              f"{history['loss'][-1]:.5f}")
+        history["loss"].extend(float(l.numpy()) for l in pending)
         return history
 
     def evaluate(self, eval_data, batch_size=None, steps=None, verbose=0):

@@ -8,6 +8,7 @@ to numpy and converted to device tensors at yield time, so a jit'd train step
 overlaps H2D with compute via jax's async dispatch.
 """
 import collections.abc
+import pickle
 import queue
 import threading
 
@@ -158,7 +159,7 @@ class DataLoader:
             for p in procs:
                 p.start()
         except (RuntimeError, TypeError, AttributeError, OSError,
-                ImportError) as e:
+                ImportError, pickle.PickleError) as e:
             for p in procs:
                 if p.is_alive():
                     p.terminate()

@@ -9,14 +9,13 @@ from .layers.common import (
     Identity, Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
     Embedding, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
     PixelShuffle, PixelUnshuffle, Pad1D, Pad2D, Pad3D, CosineSimilarity,
-    PairwiseDistance, Unfold,
+    PairwiseDistance, Unfold, ZeroPad2D, Bilinear, Fold,
 )
 from .layers.conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose
 from .layers.norm import (
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
     LayerNorm, RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D,
-    InstanceNorm3D, LocalResponseNorm,
-)
+    InstanceNorm3D, LocalResponseNorm, SpectralNorm)
 from .layers.pooling import (
     MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
@@ -31,8 +30,7 @@ from .layers.container import Sequential, LayerList, ParameterList, LayerDict
 from .layers.loss import (
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     SmoothL1Loss, HuberLoss, KLDivLoss, MarginRankingLoss,
-    CosineEmbeddingLoss, TripletMarginLoss,
-)
+    CosineEmbeddingLoss, TripletMarginLoss, CTCLoss)
 from .layers.transformer import (
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,

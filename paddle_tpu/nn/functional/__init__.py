@@ -10,7 +10,11 @@ from .common import (
     normalize, cosine_similarity, pairwise_distance, interpolate, upsample,
     pixel_shuffle, pixel_unshuffle, unfold, label_smooth,
 )
-from .conv import conv1d, conv2d, conv3d, conv2d_transpose
+from .conv import (conv1d, conv2d, conv3d, conv1d_transpose,
+                   conv2d_transpose, conv3d_transpose)
+from .extra import (bilinear, pdist, feature_alpha_dropout, channel_shuffle,
+                    affine_grid, grid_sample, fold, sequence_mask,
+                    temporal_shift, gumbel_softmax, npair_loss, ctc_loss)
 from .pooling import (
     max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_max_pool2d,

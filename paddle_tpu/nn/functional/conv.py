@@ -80,56 +80,85 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
                      output_size=None, data_format="NCHW"):
     """Transposed conv. paddle weight layout: [in, out//groups, kh, kw]."""
-    strides = _pair(stride, 2)
-    dilations = _pair(dilation, 2)
-    opad = _pair(output_padding, 2)
+    if data_format != "NCHW":
+        raise NotImplementedError("conv2d_transpose NHWC")
     if isinstance(padding, str):
         raise NotImplementedError("string padding for conv_transpose")
-    pads = _padding(padding, 2)
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 2,
+                              "conv2d_transpose", output_size=output_size)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, n, name, output_size=None):
+    """Generic transposed conv over n spatial dims: conv_general_dilated
+    with lhs_dilation. output_size (when given) resolves the stride
+    ambiguity by deriving the extra high-side padding, with validation."""
+    strides = _pair(stride, n)
+    dilations = _pair(dilation, n)
+    opad = _pair(output_padding, n)
+    pads = _padding(padding, n)
     if output_size is not None:
-        # derive the extra high-side padding that realizes the requested
-        # output (reference: ConvTranspose output_size semantics)
         x_arr = x.data if hasattr(x, "data") else x
         w_arr = weight.data if hasattr(weight, "data") else weight
-        osz = _pair(output_size, 2)
+        osz = _pair(output_size, n)
         opad = tuple(
             osz[i] - ((x_arr.shape[2 + i] - 1) * strides[i]
                       - pads[i][0] - pads[i][1]
                       + dilations[i] * (w_arr.shape[2 + i] - 1) + 1)
-            for i in range(2))
+            for i in range(n))
         if any(p < 0 or p >= strides[i] for i, p in enumerate(opad)):
             raise ValueError(
-                f"output_size {list(osz)} not reachable with stride {strides}")
+                f"output_size {list(osz)} not reachable with "
+                f"stride {strides}")
+    spatial = "DHW"[3 - n:]
+    fmt = "NC" + spatial
+    wfmt = "OI" + spatial
 
     def impl(a, w, *maybe_b):
-        # express as gradient of conv: use conv_general_dilated with lhs_dilation
-        kh, kw = w.shape[2], w.shape[3]
-        # flip spatial dims and swap in/out channels -> [out, in, kh, kw]
-        w_t = jnp.flip(w, axis=(2, 3))
-        w_t = jnp.swapaxes(w_t, 0, 1)  # [out//groups? ...]
+        ks = w.shape[2:]
+        axes = tuple(range(2, 2 + n))
         if groups > 1:
-            # [in, out/g, kh, kw] -> split in into g groups
             ci = a.shape[1]
-            w_g = w.reshape(groups, ci // groups, w.shape[1], kh, kw)
-            w_g = jnp.flip(w_g, axis=(3, 4))
+            w_g = w.reshape((groups, ci // groups, w.shape[1]) + ks)
+            w_g = jnp.flip(w_g, axis=tuple(range(3, 3 + n)))
             w_t = jnp.swapaxes(w_g, 1, 2).reshape(
-                groups * w.shape[1], ci // groups, kh, kw)
-        pad_h = dilations[0] * (kh - 1) - pads[0][0]
-        pad_h2 = dilations[0] * (kh - 1) - pads[0][1] + opad[0]
-        pad_w = dilations[1] * (kw - 1) - pads[1][0]
-        pad_w2 = dilations[1] * (kw - 1) - pads[1][1] + opad[1]
+                (groups * w.shape[1], ci // groups) + ks)
+        else:
+            w_t = jnp.swapaxes(jnp.flip(w, axis=axes), 0, 1)
+        pad_pairs = [
+            (dilations[i] * (ks[i] - 1) - pads[i][0],
+             dilations[i] * (ks[i] - 1) - pads[i][1] + opad[i])
+            for i in range(n)]
         dn = jax.lax.conv_dimension_numbers(a.shape, w_t.shape,
-                                            ("NCHW", "OIHW", "NCHW"))
+                                            (fmt, wfmt, fmt))
         out = jax.lax.conv_general_dilated(
-            a, w_t, window_strides=(1, 1),
-            padding=[(pad_h, pad_h2), (pad_w, pad_w2)],
+            a, w_t, window_strides=(1,) * n, padding=pad_pairs,
             lhs_dilation=strides, rhs_dilation=dilations,
             dimension_numbers=dn, feature_group_count=groups)
         if maybe_b:
-            out = out + maybe_b[0].reshape(1, -1, 1, 1)
+            out = out + maybe_b[0].reshape((1, -1) + (1,) * n)
         return out
 
-    if data_format != "NCHW":
-        raise NotImplementedError("conv2d_transpose NHWC")
     args = (x, weight) if bias is None else (x, weight, bias)
-    return apply_op("conv2d_transpose", impl, args, {})
+    return apply_op(name, impl, args, {})
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCL"):
+    if data_format != "NCL":
+        raise NotImplementedError("conv1d_transpose NLC")
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 1,
+                              "conv1d_transpose", output_size=output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCDHW"):
+    if data_format != "NCDHW":
+        raise NotImplementedError("conv3d_transpose NDHWC")
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 3,
+                              "conv3d_transpose", output_size=output_size)

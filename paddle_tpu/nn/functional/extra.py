@@ -1,0 +1,310 @@
+"""Functional-surface completion ops (reference: assorted
+python/paddle/nn/functional/ modules — vision warps, CTC, sequence utils,
+sampling-based activations)."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply_op
+from ...core import random as _random
+
+
+def bilinear(x1, x2, weight, bias=None):
+    """out[b, o] = x1[b] @ W[o] @ x2[b] (reference functional/common.py
+    bilinear; W: [out, in1, in2])."""
+    def impl(a, b, w, *mb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b,
+                         preferred_element_type=jnp.float32).astype(a.dtype)
+        if mb:
+            out = out + mb[0]
+        return out
+
+    args = (x1, x2, weight) if bias is None else (x1, x2, weight, bias)
+    return apply_op("bilinear", impl, args, {})
+
+
+def pdist(x, p=2.0):
+    """Condensed pairwise distance vector (reference functional/distance.py
+    pdist): upper-triangle of cdist(x, x)."""
+    def impl(a):
+        n = a.shape[0]
+        d = a[:, None, :] - a[None, :, :]
+        if p == 2.0:
+            m = jnp.sqrt(jnp.maximum((d * d).sum(-1), 0.0))
+        else:
+            m = (jnp.abs(d) ** p).sum(-1) ** (1.0 / p)
+        iu, ju = jnp.triu_indices(n, k=1)
+        return m[iu, ju]
+
+    return apply_op("pdist", impl, (x,), {})
+
+
+def feature_alpha_dropout(x, p=0.5, training=True):
+    """Alpha dropout over whole channel maps (reference alpha_dropout
+    family): keeps SELU self-normalizing statistics."""
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def impl(a):
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(_random.next_key(), 1.0 - p, shape)
+        q = 1.0 - p
+        an = 1.0 / math.sqrt(q + alpha_p ** 2 * q * p)
+        bn = -an * p * alpha_p
+        return (jnp.where(keep, a, alpha_p) * an + bn).astype(a.dtype)
+
+    return apply_op("feature_alpha_dropout", impl, (x,), {})
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    """Reference functional/vision.py channel_shuffle."""
+    def impl(a):
+        if data_format == "NCHW":
+            b, c, h, w = a.shape
+            return a.reshape(b, groups, c // groups, h, w).swapaxes(
+                1, 2).reshape(b, c, h, w)
+        b, h, w, c = a.shape
+        return a.reshape(b, h, w, groups, c // groups).swapaxes(
+            3, 4).reshape(b, h, w, c)
+
+    return apply_op("channel_shuffle", impl, (x,), {})
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """2D affine sampling grid [N, H, W, 2] (reference functional/vision.py
+    affine_grid; theta [N, 2, 3])."""
+    n, c, h, w = [int(s) for s in out_shape]
+
+    def impl(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H,W,3]
+        return jnp.einsum("nij,hwj->nhwi", th, base).astype(th.dtype)
+
+    return apply_op("affine_grid", impl, (theta,), {})
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Sample NCHW input at normalized grid coords [N, H', W', 2]
+    (reference functional/vision.py grid_sample; kernel
+    grid_sample_kernel.cu). Gather-based bilinear/nearest."""
+    def impl(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(img, yy, xx):
+            # img [C,H,W]; yy/xx [H',W'] float
+            if mode == "nearest":
+                yi = jnp.clip(jnp.round(yy), 0, h - 1).astype(jnp.int32)
+                xi = jnp.clip(jnp.round(xx), 0, w - 1).astype(jnp.int32)
+                out = img[:, yi, xi]
+                if padding_mode == "zeros":
+                    inb = (yy >= -0.5) & (yy <= h - 0.5) & \
+                        (xx >= -0.5) & (xx <= w - 0.5)
+                    out = out * inb[None]
+                return out
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy1, wx1 = yy - y0, xx - x0
+
+            def tap(yi, xi, wgt):
+                if padding_mode == "border":
+                    yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+                    xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+                    return img[:, yc, xc] * wgt[None]
+                inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+                yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+                xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+                return img[:, yc, xc] * (wgt * inb)[None]
+
+            return (tap(y0, x0, (1 - wy1) * (1 - wx1))
+                    + tap(y0, x0 + 1, (1 - wy1) * wx1)
+                    + tap(y0 + 1, x0, wy1 * (1 - wx1))
+                    + tap(y0 + 1, x0 + 1, wy1 * wx1))
+
+        return jax.vmap(sample)(a, fy, fx)
+
+    return apply_op("grid_sample", impl, (x, grid), {})
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im — inverse of unfold (reference functional/common.py fold).
+    x: [N, C*kh*kw, L] -> [N, C, H, W] with overlapping patches summed."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+
+    def impl(a):
+        n, ckk, L = a.shape
+        c = ckk // (kh * kw)
+        nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        cols = a.reshape(n, c, kh, kw, nh, nw)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                ys = i * dh
+                xs = j * dw
+                out = out.at[:, :, ys:ys + nh * sh:sh,
+                             xs:xs + nw * sw:sw].add(cols[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+
+    return apply_op("fold", impl, (x,), {})
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    """[..., maxlen] mask of positions < length (reference
+    functional/sequence.py sequence_mask)."""
+    from ...core.dtypes import convert_dtype
+    dt = convert_dtype(dtype)
+
+    def impl(l):
+        m = maxlen
+        if m is None:
+            if isinstance(l, jax.core.Tracer):
+                raise ValueError("sequence_mask under jit needs maxlen=")
+            m = int(jnp.max(l))
+        pos = jnp.arange(m)
+        return (pos < l[..., None]).astype(dt)
+
+    return apply_op("sequence_mask", impl, (lengths,), {},
+                    differentiable=False)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """TSM temporal shift (reference functional/vision.py temporal_shift,
+    kernel temporal_shift_kernel.cu): shift a channel slice one step
+    forward/backward along the segment axis."""
+    def impl(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [v[:, 1:, :fold_c], jnp.zeros_like(v[:, :1, :fold_c])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold_c:2 * fold_c]),
+             v[:, :-1, fold_c:2 * fold_c]], axis=1)
+        rest = v[:, :, 2 * fold_c:]
+        return jnp.concatenate([left, right, rest],
+                               axis=2).reshape(nt, c, h, w)
+
+    return apply_op("temporal_shift", impl, (x,), {})
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    """Reference functional/activation.py gumbel_softmax (straight-through
+    when hard=True)."""
+    def impl(a):
+        g = jax.random.gumbel(_random.next_key(), a.shape, jnp.float32)
+        y = jax.nn.softmax((a.astype(jnp.float32) + g) / temperature,
+                           axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis)
+            onehot = jax.nn.one_hot(idx, y.shape[axis], axis=axis,
+                                    dtype=y.dtype)
+            # straight-through: forward one-hot, backward soft
+            y = onehot - jax.lax.stop_gradient(y) + y
+        return y.astype(a.dtype)
+
+    return apply_op("gumbel_softmax", impl, (x,), {})
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Reference functional/loss.py npair_loss."""
+    def impl(an, po, lab):
+        reg = l2_reg * ((an * an).sum(-1).mean()
+                        + (po * po).sum(-1).mean()) * 0.25
+        sim = an @ po.T
+        same = (lab[:, None] == lab[None, :]).astype(jnp.float32)
+        same = same / jnp.maximum(same.sum(-1, keepdims=True), 1.0)
+        logp = jax.nn.log_softmax(sim, axis=-1)
+        return reg + (-(same * logp).sum(-1)).mean()
+
+    return apply_op("npair_loss", impl, (anchor, positive, labels), {})
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Connectionist temporal classification loss (reference
+    functional/loss.py ctc_loss over warpctc). Log-space alpha recursion as
+    a lax.scan over time — XLA-native, static shapes.
+
+    log_probs: [T, B, C] (paddle layout, logits accepted — log_softmax is
+    applied); labels: [B, L] int; returns per-batch or reduced loss."""
+    def impl(lp, lab, ilen, llen):
+        t_max, b, c = lp.shape
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        l_max = lab.shape[1]
+        s = 2 * l_max + 1
+        # extended label sequence: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((b, s), blank, dtype=lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        NEG = -1e30
+
+        # allowed skip transition: ext[s] != ext[s-2] (and ext[s] != blank)
+        ext_prev2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)),
+                            constant_values=blank)
+        can_skip = (ext != blank) & (ext != ext_prev2)
+
+        alpha0 = jnp.full((b, s), NEG)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(b), ext[:, 0]])
+        has1 = l_max > 0
+        if has1:
+            alpha0 = alpha0.at[:, 1].set(lp[0, jnp.arange(b), ext[:, 1]])
+
+        def step(alpha, lp_t):
+            a1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)),
+                         constant_values=NEG)
+            a2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)),
+                         constant_values=NEG)
+            a2 = jnp.where(can_skip, a2, NEG)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=-1)
+            return merged + emit, merged + emit
+
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T,B,S]
+
+        # gather alpha at t = ilen-1, positions 2*llen and 2*llen-1
+        tidx = jnp.clip(ilen - 1, 0, t_max - 1)
+        at_end = alphas[tidx, jnp.arange(b)]          # [B, S]
+        p_last = jnp.take_along_axis(
+            at_end, jnp.clip(2 * llen, 0, s - 1)[:, None], axis=-1)[:, 0]
+        p_prev = jnp.take_along_axis(
+            at_end, jnp.clip(2 * llen - 1, 0, s - 1)[:, None],
+            axis=-1)[:, 0]
+        p_prev = jnp.where(llen > 0, p_prev, NEG)
+        nll = -jnp.logaddexp(p_last, p_prev)
+        if norm_by_times:
+            nll = nll / jnp.maximum(ilen.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            return (nll / jnp.maximum(llen.astype(jnp.float32), 1.0)).mean()
+        if reduction == "sum":
+            return nll.sum()
+        return nll
+
+    return apply_op("ctc_loss", impl,
+                    (log_probs, labels, input_lengths, label_lengths), {})

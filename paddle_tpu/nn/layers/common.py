@@ -203,3 +203,52 @@ class Unfold(Layer):
     def forward(self, x):
         return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
                         self.dilations)
+
+
+class ZeroPad2D(Layer):
+    """Reference nn/layer/common.py ZeroPad2D."""
+
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self._padding = padding
+        self._data_format = data_format
+
+    def forward(self, x):
+        from ... import ops
+        p = self._padding
+        if isinstance(p, int):
+            p = [p, p, p, p]
+        return ops.pad(x, [0, 0, 0, 0, p[2], p[3], p[0], p[1]]
+                       if self._data_format == "NCHW" else
+                       [0, 0, p[2], p[3], p[0], p[1], 0, 0])
+
+
+class Bilinear(Layer):
+    """Reference nn/layer/common.py Bilinear: x1 W x2 + b."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features], attr=weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([out_features], attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x1, x2):
+        from .. import functional as F
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class Fold(Layer):
+    """Reference nn/layer/common.py Fold (col2im)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings,
+                      dilations)
+
+    def forward(self, x):
+        from .. import functional as F
+        return F.fold(x, *self._args)

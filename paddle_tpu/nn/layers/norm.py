@@ -179,3 +179,57 @@ class LocalResponseNorm(Layer):
 
     def forward(self, x):
         return F.local_response_norm(x, self.size, self.alpha, self.beta, self.k)
+
+
+class SpectralNorm(Layer):
+    """Power-iteration spectral normalization of a weight tensor
+    (reference nn/layer/norm.py SpectralNorm): forward returns
+    W / sigma(W), updating the u/v estimates in train mode."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        import numpy as _np
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = epsilon
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        self.weight_u = self.create_parameter([h], dtype=dtype)
+        self.weight_v = self.create_parameter([w], dtype=dtype)
+        with __import__("paddle_tpu").no_grad():
+            self.weight_u.set_value(
+                _np.random.default_rng(0).standard_normal(h).astype(dtype))
+            self.weight_v.set_value(
+                _np.random.default_rng(1).standard_normal(w).astype(dtype))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        import jax.numpy as jnp
+        from ...core.dispatch import apply_op
+        dim, eps, iters = self._dim, self._eps, self._power_iters
+        training = self.training
+        u0, v0 = self.weight_u.data, self.weight_v.data
+
+        def impl(w):
+            m = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            u, v = u0, v0
+            # iterate in eval too (the estimate must exist even with fresh
+            # u/v); only the buffer write-back below is train-gated
+            for _ in range(iters):
+                v = m.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = m @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ (m @ v)
+            return w / sigma, u, v
+
+        out, u_new, v_new = apply_op("spectral_norm", impl, (weight,), {})
+        if training:
+            self.weight_u.data = u_new.data
+            self.weight_v.data = v_new.data
+        return out

@@ -191,3 +191,39 @@ def histogram(x, bins=100, min=0, max=0):
 def bincount(x, weights=None, minlength=0):
     return jnp.bincount(x, weights=_arr(weights) if weights is not None else None,
                         minlength=minlength)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(_arr(x), offset=offset, axis1=axis1, axis2=axis2)
+
+
+def vander(x, n=None, increasing=False):
+    return jnp.vander(_arr(x), N=n, increasing=increasing)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
+    """Batched pairwise distances [..., M, N] (reference tensor/linalg.py
+    cdist). Euclidean path uses the matmul identity (MXU-friendly)."""
+    a, b = _arr(x), _arr(y)
+    if p == 2.0 and compute_mode.startswith("use_mm"):
+        a2 = (a * a).sum(-1)[..., :, None]
+        b2 = (b * b).sum(-1)[..., None, :]
+        ab = jnp.einsum("...md,...nd->...mn", a, b,
+                        preferred_element_type=jnp.float32).astype(a.dtype)
+        return jnp.sqrt(jnp.maximum(a2 + b2 - 2 * ab, 0.0))
+    d = a[..., :, None, :] - b[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.maximum((d * d).sum(-1), 0.0))
+    return (jnp.abs(d) ** p).sum(-1) ** (1.0 / p)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    a = _arr(x)
+    w = _arr(weights) if weights is not None else None
+    rng = None
+    if ranges is not None:
+        flat = list(ranges)
+        rng = [(flat[2 * i], flat[2 * i + 1]) for i in range(a.shape[1])]
+    hist, edges = jnp.histogramdd(a, bins=bins, range=rng, density=density,
+                                  weights=w)
+    return hist, list(edges)

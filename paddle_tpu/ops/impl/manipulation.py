@@ -328,3 +328,57 @@ def one_hot(x, num_classes):
 
 def tolist_shape(x):
     return list(x.shape)
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    """Uneven split allowed (reference tensor/manipulation tensor_split)."""
+    if isinstance(num_or_indices, int):
+        return jnp.array_split(_arr(x), num_or_indices, axis=axis)
+    return jnp.split(_arr(x), list(num_or_indices), axis=axis)
+
+
+def hsplit(x, num_or_indices):
+    a = _arr(x)
+    axis = 0 if a.ndim == 1 else 1
+    return tensor_split(a, num_or_indices, axis=axis)
+
+
+def vsplit(x, num_or_indices):
+    return tensor_split(_arr(x), num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices):
+    return tensor_split(_arr(x), num_or_indices, axis=2)
+
+
+def hstack(xs):
+    return jnp.hstack([_arr(v) for v in xs])
+
+
+def vstack(xs):
+    return jnp.vstack([_arr(v) for v in xs])
+
+
+def dstack(xs):
+    return jnp.dstack([_arr(v) for v in xs])
+
+
+def column_stack(xs):
+    return jnp.column_stack([_arr(v) for v in xs])
+
+
+def row_stack(xs):
+    return jnp.vstack([_arr(v) for v in xs])
+
+
+def block_diag(inputs):
+    arrs = [jnp.atleast_2d(_arr(v)) for v in inputs]
+    r = sum(a.shape[0] for a in arrs)
+    c = sum(a.shape[1] for a in arrs)
+    out = jnp.zeros((r, c), arrs[0].dtype)
+    ro, co = 0, 0
+    for a in arrs:
+        out = out.at[ro:ro + a.shape[0], co:co + a.shape[1]].set(a)
+        ro += a.shape[0]
+        co += a.shape[1]
+    return out

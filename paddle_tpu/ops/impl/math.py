@@ -352,3 +352,36 @@ def bitwise_left_shift(x, y):
 
 def bitwise_right_shift(x, y):
     return jnp.right_shift(x, _arr(y))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    """Reference tensor/math.py trapezoid."""
+    ya = _arr(y)
+    if x is not None:
+        return jnp.trapezoid(ya, x=_arr(x), axis=axis)
+    return jnp.trapezoid(ya, dx=1.0 if dx is None else dx, axis=axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    ya = _arr(y)
+    ya = jnp.moveaxis(ya, axis, -1)
+    avg = (ya[..., 1:] + ya[..., :-1]) / 2.0
+    if x is not None:
+        xa = jnp.moveaxis(_arr(x), axis, -1) if _arr(x).ndim == ya.ndim \
+            else _arr(x)
+        d = jnp.diff(xa, axis=-1)
+        out = jnp.cumsum(avg * d, axis=-1)
+    else:
+        out = jnp.cumsum(avg * (1.0 if dx is None else dx), axis=-1)
+    return jnp.moveaxis(out, -1, axis)
+
+
+def renorm(x, p, axis, max_norm):
+    """Clip each sub-tensor along `axis` to max p-norm (reference renorm)."""
+    a = _arr(x)
+    moved = jnp.moveaxis(a, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = (jnp.abs(flat) ** p).sum(-1) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    out = flat * scale[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)

@@ -1,0 +1,212 @@
+"""API-surface parity tests: the probe list (common paddle APIs a
+reference user expects) plus numerics for the completion batch
+(ctc_loss vs brute force, grid_sample warps, fold/unfold, transposed
+convs, new tensor ops)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+TOP = """zeros ones full arange linspace eye rand randn randint to_tensor
+concat stack split chunk squeeze unsqueeze reshape transpose flatten tile
+gather gather_nd scatter scatter_nd masked_select where nonzero topk sort
+argsort argmax unique matmul bmm einsum norm mean sum cumsum clip
+diag diagonal tril triu kron cross outer vander trapezoid
+cumulative_trapezoid renorm cdist histogramdd tensor_split hsplit vsplit
+dsplit column_stack row_stack hstack vstack dstack block_diag
+atleast_1d atleast_2d moveaxis swapaxes rot90 take tensordot""".split()
+
+FNS = """relu gelu silu softmax conv1d conv2d conv3d conv1d_transpose
+conv2d_transpose conv3d_transpose linear bilinear embedding one_hot
+cosine_similarity pairwise_distance pdist dropout alpha_dropout
+feature_alpha_dropout batch_norm layer_norm group_norm rms_norm
+cross_entropy mse_loss kl_div ctc_loss sigmoid_focal_loss
+pad interpolate pixel_shuffle channel_shuffle grid_sample affine_grid
+unfold fold sequence_mask temporal_shift gumbel_softmax npair_loss
+scaled_dot_product_attention flash_attention""".split()
+
+LAYERS = """Linear Conv2D Conv2DTranspose Embedding LayerNorm BatchNorm2D
+GroupNorm RMSNorm SpectralNorm LSTM GRU MultiHeadAttention Transformer
+Dropout MaxPool2D AdaptiveAvgPool2D ReLU GELU CrossEntropyLoss MSELoss
+CTCLoss Sequential LayerList Identity Flatten Unfold Fold ZeroPad2D
+Bilinear""".split()
+
+
+class TestSurface:
+    def test_top_level(self):
+        missing = [n for n in TOP if not hasattr(paddle, n)]
+        assert not missing, missing
+
+    def test_functional(self):
+        missing = [n for n in FNS if not hasattr(F, n)]
+        assert not missing, missing
+
+    def test_layers(self):
+        missing = [n for n in LAYERS if not hasattr(nn, n)]
+        assert not missing, missing
+
+    def test_tensor_namespace_alias(self):
+        assert hasattr(paddle.tensor, "matmul")
+
+
+class TestCTC:
+    def test_matches_bruteforce(self):
+        rng = np.random.default_rng(0)
+        T, B, C = 4, 2, 3
+        logits = rng.standard_normal((T, B, C)).astype(np.float32)
+        labels = np.array([[1, 2], [2, 0]], np.int32)  # second: len 1
+        ilen = np.array([4, 3], np.int32)
+        llen = np.array([2, 1], np.int32)
+        loss = F.ctc_loss(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels),
+                          paddle.to_tensor(ilen), paddle.to_tensor(llen),
+                          blank=0, reduction="none").numpy()
+
+        # brute force: sum over all alignments collapsing to the label
+        def collapse(path):
+            out = []
+            prev = None
+            for p in path:
+                if p != prev and p != 0:
+                    out.append(p)
+                prev = p
+            return out
+
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        for b in range(B):
+            tgt = list(labels[b][:llen[b]])
+            tot = -np.inf
+            for path in itertools.product(range(C), repeat=int(ilen[b])):
+                if collapse(path) == tgt:
+                    lp = sum(logp[t, b, path[t]] for t in range(ilen[b]))
+                    tot = np.logaddexp(tot, lp)
+            np.testing.assert_allclose(loss[b], -tot, rtol=1e-4, atol=1e-4)
+
+    def test_ctc_grad_flows(self):
+        rng = np.random.default_rng(1)
+        logits = paddle.to_tensor(rng.standard_normal(
+            (6, 2, 5)).astype(np.float32), stop_gradient=False)
+        loss = F.ctc_loss(logits,
+                          paddle.to_tensor(np.array([[1, 2], [3, 4]],
+                                                    np.int32)),
+                          paddle.to_tensor(np.array([6, 6], np.int32)),
+                          paddle.to_tensor(np.array([2, 2], np.int32)))
+        loss.backward()
+        g = logits.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+    def test_ctc_layer(self):
+        rng = np.random.default_rng(2)
+        crit = nn.CTCLoss(blank=0)
+        out = crit(paddle.to_tensor(rng.standard_normal(
+            (5, 1, 4)).astype(np.float32)),
+            paddle.to_tensor(np.array([[1, 2]], np.int32)),
+            paddle.to_tensor(np.array([5], np.int32)),
+            paddle.to_tensor(np.array([2], np.int32)))
+        assert np.isfinite(float(out.numpy()))
+
+
+class TestWarps:
+    def test_grid_sample_translation(self):
+        # shift right by one pixel via the grid (align_corners)
+        x = np.zeros((1, 1, 1, 4), np.float32)
+        x[0, 0, 0] = [1, 2, 3, 4]
+        theta = np.array([[[1.0, 0.0, 2.0 / 3.0], [0.0, 1.0, 0.0]]],
+                         np.float32)  # x' = x + 2/(W-1)
+        g = F.affine_grid(paddle.to_tensor(theta), (1, 1, 1, 4))
+        out = F.grid_sample(paddle.to_tensor(x), g).numpy()
+        np.testing.assert_allclose(out[0, 0, 0], [2, 3, 4, 0], atol=1e-5)
+
+    def test_grid_sample_border_padding(self):
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 1, 4)
+        theta = np.array([[[1.0, 0.0, 10.0], [0.0, 1.0, 0.0]]], np.float32)
+        g = F.affine_grid(paddle.to_tensor(theta), (1, 1, 1, 4))
+        out = F.grid_sample(paddle.to_tensor(x), g,
+                            padding_mode="border").numpy()
+        np.testing.assert_allclose(out[0, 0, 0], [3, 3, 3, 3], atol=1e-5)
+
+    def test_conv1d_transpose_inverts_shape(self):
+        rng = np.random.default_rng(3)
+        x = paddle.to_tensor(rng.standard_normal((2, 3, 10)).astype(
+            np.float32))
+        w = paddle.to_tensor(rng.standard_normal((3, 4, 5)).astype(
+            np.float32))
+        down = F.conv1d(x, paddle.to_tensor(rng.standard_normal(
+            (3, 3, 5)).astype(np.float32)), stride=2, padding=2)
+        up = F.conv1d_transpose(down, w, stride=2, padding=2)
+        assert up.shape[2] in (9, 10)  # stride-2 ambiguity w/o output_padding
+
+    def test_conv3d_transpose_grad(self):
+        rng = np.random.default_rng(4)
+        x = paddle.to_tensor(rng.standard_normal((1, 2, 3, 3, 3)).astype(
+            np.float32), stop_gradient=False)
+        w = paddle.to_tensor(rng.standard_normal((2, 2, 2, 2, 2)).astype(
+            np.float32), stop_gradient=False)
+        F.conv3d_transpose(x, w, stride=2).sum().backward()
+        assert x.grad is not None and w.grad is not None
+
+
+class TestNewTensorOps:
+    def test_splits_and_stacks(self):
+        x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(4, 6))
+        parts = paddle.tensor_split(x, [2, 5], axis=1)
+        assert [p.shape for p in parts] == [[4, 2], [4, 3], [4, 1]]
+        hs = paddle.hsplit(x, 3)
+        assert all(p.shape == [4, 2] for p in hs)
+        back = paddle.hstack(hs)
+        np.testing.assert_allclose(back.numpy(), x.numpy())
+        cs = paddle.column_stack([paddle.to_tensor(np.ones(3, np.float32)),
+                                  paddle.to_tensor(np.zeros(3, np.float32))])
+        assert cs.shape == [3, 2]
+
+    def test_cdist_matches_bruteforce(self):
+        rng = np.random.default_rng(5)
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        b = rng.standard_normal((5, 4)).astype(np.float32)
+        out = paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+        ref = np.sqrt(((a[:, None] - b[None]) ** 2).sum(-1))
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_renorm_caps_norms(self):
+        x = paddle.to_tensor(np.random.default_rng(6).standard_normal(
+            (4, 8)).astype(np.float32) * 10)
+        out = paddle.renorm(x, 2.0, 0, 1.0).numpy()
+        assert (np.linalg.norm(out, axis=1) < 1.0 + 1e-4).all()
+
+    def test_cumulative_trapezoid(self):
+        y = np.array([1.0, 2.0, 3.0], np.float32)
+        out = paddle.cumulative_trapezoid(paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(out, [1.5, 4.0])
+
+    def test_spectral_norm_scales_weight(self):
+        import paddle_tpu as paddle
+        sn = nn.SpectralNorm([6, 4], power_iters=5)
+        sn.train()
+        w = paddle.to_tensor(np.random.default_rng(7).standard_normal(
+            (6, 4)).astype(np.float32) * 3)
+        for _ in range(10):  # power iteration converges
+            out = sn(w)
+        top = np.linalg.svd(out.numpy(), compute_uv=False)[0]
+        np.testing.assert_allclose(top, 1.0, rtol=1e-2)
+
+    def test_conv_transpose_output_size(self):
+        rng = np.random.default_rng(8)
+        x = paddle.to_tensor(rng.standard_normal((1, 3, 5)).astype(
+            np.float32))
+        w = paddle.to_tensor(rng.standard_normal((3, 2, 3)).astype(
+            np.float32))
+        out = F.conv1d_transpose(x, w, stride=2, padding=1, output_size=10)
+        assert out.shape == [1, 2, 10]
+        with pytest.raises(ValueError):
+            F.conv1d_transpose(x, w, stride=2, padding=1, output_size=30)
+
+    def test_cdist_donot_use_mm_is_accurate(self):
+        a = paddle.to_tensor(np.array([[1e4, 1.0]], np.float32))
+        b = paddle.to_tensor(np.array([[1e4, 1.001]], np.float32))
+        out = paddle.cdist(a, b,
+                           compute_mode="donot_use_mm_for_euclid_dist")
+        np.testing.assert_allclose(float(out.numpy()), 0.001, rtol=1e-2)
