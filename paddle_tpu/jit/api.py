@@ -135,8 +135,28 @@ class StaticFunction:
         return impl, out_box, call_tensors
 
     def __call__(self, *args, **kwargs):
-        impl, out_box, call_tensors = self._prepare(args, kwargs)
-        out = apply_op(f"to_static[{self._name}]", impl, call_tensors, {})
+        import jax.errors as _jerr
+        try:
+            impl, out_box, call_tensors = self._prepare(args, kwargs)
+            out = apply_op(f"to_static[{self._name}]", impl, call_tensors,
+                           {})
+        except _jerr.TracerBoolConversionError:
+            # data-dependent Python control flow broke the trace: rewrite
+            # the function through the dy2static AST pass (if -> lax.cond,
+            # while -> lax.while_loop) and retrace — the reference's
+            # program_translator does the same conversion up-front
+            if getattr(self._fn, "__dy2static__", False):
+                raise
+            from .dy2static.transformer import convert_callable
+            converted = convert_callable(self._fn)
+            if converted is self._fn or not getattr(converted,
+                                                    "__dy2static__", False):
+                raise
+            self._fn = converted
+            self._cache.clear()
+            impl, out_box, call_tensors = self._prepare(args, kwargs)
+            out = apply_op(f"to_static[{self._name}]", impl, call_tensors,
+                           {})
         out_leaves = list(out) if isinstance(out, tuple) else [out]
         treedef = out_box.get("treedef")
         if treedef is None:

@@ -1,0 +1,29 @@
+"""dy2static: Python control flow -> compiler control flow.
+
+Reference: python/paddle/jit/dy2static/ (AST transformer,
+program_translator.py) + the SOT bytecode VM (python/paddle/jit/sot/).
+The reference rewrites `if`/`while` on tensors into its cond/while ops;
+here they become lax.cond / lax.while_loop so the whole function stays
+jittable with data-dependent branches.
+
+Two pieces:
+- convert_operators: runtime dispatchers (convert_ifelse, convert_while_loop,
+  convert_logical_*) — tensor predicates go to lax, Python predicates stay
+  Python (the reference's convert_operators.py contract).
+- transformer: ast-level rewrite of a function's source so `if`/`while`
+  statements on tensor predicates call the dispatchers with
+  branch-as-function form.
+
+`paddle.jit.to_static` applies the transform automatically when tracing
+fails to see a branch (or when the user opts in via full_graph=False-style
+usage); `convert_to_static(fn)` exposes the rewrite directly.
+"""
+from .convert_operators import (convert_ifelse, convert_while_loop,
+                                set_max_loop_iters,
+                                convert_logical_and, convert_logical_or,
+                                convert_logical_not, convert_len)
+from .transformer import convert_to_static, convert_callable
+
+__all__ = ["convert_ifelse", "convert_while_loop", "convert_logical_and",
+           "convert_logical_or", "convert_logical_not", "convert_len",
+           "convert_to_static", "convert_callable", "set_max_loop_iters"]

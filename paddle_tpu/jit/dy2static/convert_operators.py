@@ -1,0 +1,167 @@
+"""Runtime conversion operators (reference: python/paddle/jit/dy2static/
+convert_operators.py — convert_ifelse, convert_while_loop, logical ops).
+
+Each dispatcher checks whether the predicate is a TRACED value (jax
+tracer under jit/to_static). Traced predicates lower to
+lax.cond/lax.while_loop — compiled, data-dependent, no host sync; concrete
+predicates (eager Tensors or Python values) run plain Python, which keeps
+eager tape semantics exact and costs nothing."""
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+# reverse-mode differentiation cannot flow through lax.while_loop; with a
+# user-declared iteration bound the loop lowers to a masked lax.scan
+# instead, which IS differentiable (set via set_max_loop_iters)
+MAX_LOOP_ITERS = None
+
+
+def set_max_loop_iters(n):
+    """Declare an upper bound for converted tensor `while` loops. With a
+    bound, loops lower to a masked lax.scan (reverse-differentiable, fixed
+    cost of `n` iterations); without one they use lax.while_loop (cheaper,
+    forward-only)."""
+    global MAX_LOOP_ITERS
+    MAX_LOOP_ITERS = n
+
+
+def _arr(x):
+    return x.data if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    return isinstance(_arr(x), jax.core.Tracer)
+
+
+def _to_tree(vals):
+    return tuple(_arr(v) if isinstance(v, Tensor) else jnp.asarray(v)
+                 for v in vals)
+
+
+def _from_tree(arrs):
+    return tuple(Tensor(a, stop_gradient=True) for a in arrs)
+
+
+def _scalar_bool(pred):
+    c = _arr(pred)
+    if getattr(c, "ndim", 0):
+        c = c.reshape(())
+    return c.astype(bool) if hasattr(c, "astype") else bool(c)
+
+
+def convert_ifelse(pred, true_fn, false_fn, get_args, set_args,
+                   return_name_ids=None):
+    """`if` statement dispatcher. true_fn/false_fn are closures over the
+    function's locals; get_args/set_args move the live variables in and
+    out (the reference's convert_ifelse contract).
+
+    Traced predicate: both branches run under lax.cond on the carried
+    variable tuple, so each variable's shape/dtype must match across
+    branches — the same constraint the reference's static cond op has."""
+    if not _is_traced(pred):
+        if bool(_arr(pred)) if isinstance(pred, Tensor) else pred:
+            true_fn()
+        else:
+            false_fn()
+        return
+
+    # variables created inside the branches carry a None placeholder: they
+    # are outputs only (both branches must define them); pre-existing
+    # variables ride the lax.cond operand
+    init = list(get_args())
+    carry_idx = [i for i, v in enumerate(init) if v is not None]
+
+    def branch(fn):
+        def run(arrs):
+            vals = list(init)
+            for j, i in enumerate(carry_idx):
+                vals[i] = Tensor(arrs[j], stop_gradient=True)
+            set_args(tuple(vals))
+            fn()
+            out = get_args()
+            if any(v is None for v in out):
+                raise ValueError(
+                    "dy2static: a variable assigned in only one branch of "
+                    "a tensor `if` was left undefined by the other branch "
+                    "— define it in both (static cond needs matching "
+                    "outputs)")
+            return _to_tree(out)
+        return run
+
+    out = jax.lax.cond(_scalar_bool(pred), branch(true_fn),
+                       branch(false_fn),
+                       _to_tree([init[i] for i in carry_idx]))
+    set_args(_from_tree(out))
+
+
+def convert_while_loop(cond_fn, body_fn, get_args, set_args):
+    """`while` statement dispatcher (reference convert_while_loop). Loop
+    variables are whatever get_args returns; traced-predicate loops lower
+    to lax.while_loop (carried shapes must be loop-invariant)."""
+    probe = cond_fn()
+    if not _is_traced(probe):
+        while (bool(_arr(probe)) if isinstance(probe, Tensor) else probe):
+            body_fn()
+            probe = cond_fn()
+        return
+
+    if any(v is None for v in get_args()):
+        raise ValueError(
+            "dy2static: a tensor `while` loop variable is used before "
+            "assignment — initialize every carried variable before the "
+            "loop (static while needs typed loop state)")
+
+    def cond(arrs):
+        set_args(_from_tree(arrs))
+        return _scalar_bool(cond_fn())
+
+    def body(arrs):
+        set_args(_from_tree(arrs))
+        body_fn()
+        return _to_tree(get_args())
+
+    if MAX_LOOP_ITERS is not None:
+        def scan_body(arrs, _):
+            keep = cond(arrs)
+            new = body(arrs)
+            merged = tuple(jnp.where(keep, n, o)
+                           for n, o in zip(new, arrs))
+            return merged, None
+
+        out, _ = jax.lax.scan(scan_body, _to_tree(get_args()),
+                              None, length=int(MAX_LOOP_ITERS))
+    else:
+        out = jax.lax.while_loop(cond, body, _to_tree(get_args()))
+    set_args(_from_tree(out))
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    """`a and b` with tensor operands -> logical_and without short-circuit
+    (reference convert_logical_and; rhs stays lazy on the Python path)."""
+    lhs = lhs_fn()
+    if not isinstance(lhs, Tensor) and not isinstance(lhs, jax.core.Tracer):
+        return lhs and rhs_fn()
+    rhs = rhs_fn()
+    return Tensor(jnp.logical_and(_arr(lhs), _arr(rhs)),
+                  stop_gradient=True)
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if not isinstance(lhs, Tensor) and not isinstance(lhs, jax.core.Tracer):
+        return lhs or rhs_fn()
+    rhs = rhs_fn()
+    return Tensor(jnp.logical_or(_arr(lhs), _arr(rhs)), stop_gradient=True)
+
+
+def convert_logical_not(x):
+    if not isinstance(x, Tensor) and not isinstance(x, jax.core.Tracer):
+        return not x
+    return Tensor(jnp.logical_not(_arr(x)), stop_gradient=True)
+
+
+def convert_len(x):
+    if isinstance(x, Tensor):
+        return x.shape[0]
+    return len(x)

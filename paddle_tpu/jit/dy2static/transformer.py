@@ -1,0 +1,278 @@
+"""AST transformer: rewrite `if`/`while`/boolean ops on tensors into
+convert_* dispatcher calls (reference: python/paddle/jit/dy2static/
+transformers/ — ifelse_transformer.py, loop_transformer.py,
+logical_transformer.py, collapsed here into one pass).
+
+Supported subset (the common model-code shapes):
+- `if`/`elif`/`else` whose branches assign variables (no return/break
+  inside a tensor-predicate branch);
+- `while` loops with loop-invariant carried shapes (no break/continue);
+- `and`/`or`/`not` over tensors (lowered without short-circuit);
+- `len(tensor)`.
+Statements containing return/break/continue are left untouched: they keep
+exact Python semantics eagerly, and under jit produce jax's standard
+concretization error pointing at the offending line — the same "graph
+break" behavior the reference's SOT falls back on.
+"""
+import ast
+import functools
+import inspect
+import textwrap
+
+
+def _lambda0(body_expr):
+    lam = ast.parse("lambda: 0", mode="eval").body
+    lam.body = body_expr
+    return lam
+
+
+def _assigned_names(node):
+    """Names bound by Store/AugAssign/For-targets inside `node`."""
+    names = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, n):
+            if isinstance(n.ctx, (ast.Store, ast.Del)):
+                names.add(n.id)
+            self.generic_visit(n)
+
+        def visit_FunctionDef(self, n):  # don't descend into nested defs
+            names.add(n.name)
+
+        def visit_Lambda(self, n):
+            pass
+
+    for stmt in (node if isinstance(node, list) else [node]):
+        V().visit(stmt)
+    return names
+
+
+def _contains(node_list, *types):
+    """True if any of `types` appears in the statements WITHOUT descending
+    into nested function/lambda scopes (a return inside a nested def is
+    that def's return, not this block's)."""
+    hits = []
+
+    class V(ast.NodeVisitor):
+        def generic_visit(self, n):
+            if isinstance(n, types):
+                hits.append(n)
+            super().generic_visit(n)
+
+        def visit_FunctionDef(self, n):
+            pass
+
+        def visit_AsyncFunctionDef(self, n):
+            pass
+
+        def visit_Lambda(self, n):
+            pass
+
+    for stmt in node_list:
+        V().visit(stmt)
+    return bool(hits)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._counter = 0
+        self._known = set()      # names bound so far in the current scope
+
+    def _uid(self):
+        self._counter += 1
+        return self._counter
+
+    # -- scope bookkeeping ------------------------------------------------
+    def visit_FunctionDef(self, node):
+        outer = self._known
+        self._known = {a.arg for a in node.args.args + node.args.kwonlyargs}
+        if node.args.vararg:
+            self._known.add(node.args.vararg.arg)
+        if node.args.kwarg:
+            self._known.add(node.args.kwarg.arg)
+        node.body = self._visit_block(node.body)
+        self._known = outer
+        return node
+
+    def _visit_block(self, stmts):
+        out = []
+        for stmt in stmts:
+            new = self.visit(stmt)
+            if isinstance(new, list):
+                out.extend(new)
+            else:
+                out.append(new)
+            self._known |= _assigned_names(stmt)
+        return out
+
+    # -- statements -------------------------------------------------------
+    def visit_If(self, node):
+        known_before = set(self._known)
+        # carried variables come from the ORIGINAL branches — transformed
+        # bodies contain generated __dy2st_* helper defs that must not
+        # become branch outputs
+        orig_targets = (_assigned_names(node.body)
+                        | _assigned_names(node.orelse))
+        node.test = self.visit(node.test)
+        node.body = self._visit_block(node.body)
+        node.orelse = self._visit_block(node.orelse)
+        self._known = known_before
+        if _contains(node.body + node.orelse, ast.Return, ast.Break,
+                     ast.Continue, ast.Yield):
+            return node  # python semantics (graph break under jit)
+        targets = sorted(t for t in orig_targets
+                         if not t.startswith("__dy2st"))
+        if not targets:
+            return node
+        uid = self._uid()
+        created = [t for t in targets if t not in self._known]
+        pre = [ast.parse(f"{t} = None").body[0] for t in created]
+        tuple_src = ", ".join(targets) + ("," if len(targets) == 1 else "")
+        tf = ast.parse(f"def __dy2st_true_{uid}():\n    pass").body[0]
+        tf.body = [ast.Nonlocal(names=list(targets))] + node.body
+        ff = ast.parse(f"def __dy2st_false_{uid}():\n    pass").body[0]
+        ff.body = [ast.Nonlocal(names=list(targets))] + (node.orelse
+                                                         or [ast.Pass()])
+        helpers = ast.parse(textwrap.dedent(f"""
+            def __dy2st_get_{uid}():
+                return ({tuple_src})
+            def __dy2st_set_{uid}(__vals):
+                nonlocal {', '.join(targets)}
+                ({tuple_src}) = __vals
+            __dy2st.convert_ifelse(__dy2st_pred_{uid},
+                                   __dy2st_true_{uid}, __dy2st_false_{uid},
+                                   __dy2st_get_{uid}, __dy2st_set_{uid})
+        """)).body
+        pred_assign = ast.Assign(
+            targets=[ast.Name(id=f"__dy2st_pred_{uid}", ctx=ast.Store())],
+            value=node.test)
+        return pre + [pred_assign, tf, ff] + helpers
+
+    def visit_While(self, node):
+        known_before = set(self._known)
+        orig_targets = _assigned_names(node.body)
+        node.test = self.visit(node.test)
+        node.body = self._visit_block(node.body)
+        self._known = known_before
+        if node.orelse or _contains(node.body, ast.Return, ast.Break,
+                                    ast.Continue, ast.Yield):
+            return node
+        targets = sorted(t for t in orig_targets
+                         if not t.startswith("__dy2st"))
+        if not targets:
+            return node
+        uid = self._uid()
+        created = [t for t in targets if t not in self._known]
+        pre = [ast.parse(f"{t} = None").body[0] for t in created]
+        tuple_src = ", ".join(targets) + ("," if len(targets) == 1 else "")
+        body_fn = ast.parse(f"def __dy2st_body_{uid}():\n    pass").body[0]
+        body_fn.body = [ast.Nonlocal(names=list(targets))] + node.body
+        cond_fn = ast.parse(f"def __dy2st_cond_{uid}():\n    pass").body[0]
+        cond_fn.body = [ast.Return(value=node.test)]
+        helpers = ast.parse(textwrap.dedent(f"""
+            def __dy2st_get_{uid}():
+                return ({tuple_src})
+            def __dy2st_set_{uid}(__vals):
+                nonlocal {', '.join(targets)}
+                ({tuple_src}) = __vals
+            __dy2st.convert_while_loop(__dy2st_cond_{uid},
+                                       __dy2st_body_{uid},
+                                       __dy2st_get_{uid},
+                                       __dy2st_set_{uid})
+        """)).body
+        return pre + [cond_fn, body_fn] + helpers
+
+    # -- expressions ------------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        name = ("convert_logical_and" if isinstance(node.op, ast.And)
+                else "convert_logical_or")
+        out = node.values[0]
+        for rhs in node.values[1:]:
+            out = ast.Call(
+                func=ast.Attribute(value=ast.Name(id="__dy2st",
+                                                  ctx=ast.Load()),
+                                   attr=name, ctx=ast.Load()),
+                args=[_lambda0(out), _lambda0(rhs)], keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(
+                func=ast.Attribute(value=ast.Name(id="__dy2st",
+                                                  ctx=ast.Load()),
+                                   attr="convert_logical_not",
+                                   ctx=ast.Load()),
+                args=[node.operand], keywords=[])
+        return node
+
+
+def convert_to_static(fn):
+    """Rewrite `fn`'s source so tensor control flow lowers to lax ops;
+    returns the rewritten function (reference: program_translator's AST
+    path). Closures are carried over via the rebuilt function's closure."""
+    try:
+        source = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn  # no source (builtins, exec'd): leave as-is
+    tree = ast.parse(source)
+    fdef = tree.body[0]
+    if not isinstance(fdef, ast.FunctionDef):
+        return fn  # lambdas / exec'd defs: no rewritable source statement
+    fdef.decorator_list = []  # strip @to_static-style decorators
+    _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(tree)
+
+    from . import convert_operators as _ops_mod
+    glb = dict(fn.__globals__)
+    glb["__dy2st"] = _ops_mod
+
+    if fn.__closure__:
+        # rebuild with the original closure: wrap in a factory that
+        # redeclares the freevars
+        free = fn.__code__.co_freevars
+        factory_src = "def __dy2st_factory({}):\n".format(", ".join(free))
+        factory_src += textwrap.indent(ast.unparse(tree), "    ")
+        factory_src += f"\n    return {fdef.name}"
+        fglb = dict(glb)
+        exec(compile(factory_src, f"<dy2static {fn.__qualname__}>",
+                     "exec"), fglb)
+        new_fn = fglb["__dy2st_factory"](
+            *[c.cell_contents for c in fn.__closure__])
+    else:
+        code = compile(tree, f"<dy2static {fn.__qualname__}>", "exec")
+        exec(code, glb)
+        new_fn = glb[fdef.name]
+    functools.update_wrapper(new_fn, fn)
+    new_fn.__dy2static__ = True
+    return new_fn
+
+
+def convert_callable(obj):
+    """convert_to_static generalized over the things to_static accepts:
+    plain functions, bound methods (rewrites __func__ and rebinds), and
+    nn.Layer instances (rewrites the class's forward)."""
+    import types
+
+    if inspect.isfunction(obj):
+        return convert_to_static(obj)
+    if inspect.ismethod(obj):
+        new = convert_to_static(obj.__func__)
+        if not getattr(new, "__dy2static__", False):
+            return obj
+        bound = types.MethodType(new, obj.__self__)
+        return bound
+    fwd = getattr(type(obj), "forward", None)
+    if fwd is not None:
+        new = convert_to_static(fwd)
+        if not getattr(new, "__dy2static__", False):
+            return obj
+
+        def wrapper(*args, **kwargs):
+            return new(obj, *args, **kwargs)
+
+        wrapper.__dy2static__ = True
+        wrapper.__name__ = getattr(obj, "__class__", type(obj)).__name__
+        return wrapper
+    return obj

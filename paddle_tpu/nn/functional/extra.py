@@ -27,15 +27,12 @@ def bilinear(x1, x2, weight, bias=None):
 
 def pdist(x, p=2.0):
     """Condensed pairwise distance vector (reference functional/distance.py
-    pdist): upper-triangle of cdist(x, x)."""
+    pdist): upper-triangle of cdist(x, x) — one distance kernel, reused."""
+    from ...ops.impl.linalg import cdist as _cdist_impl
+
     def impl(a):
-        n = a.shape[0]
-        d = a[:, None, :] - a[None, :, :]
-        if p == 2.0:
-            m = jnp.sqrt(jnp.maximum((d * d).sum(-1), 0.0))
-        else:
-            m = (jnp.abs(d) ** p).sum(-1) ** (1.0 / p)
-        iu, ju = jnp.triu_indices(n, k=1)
+        m = _cdist_impl(a, a, p=p, compute_mode="donot_use_mm")
+        iu, ju = jnp.triu_indices(a.shape[0], k=1)
         return m[iu, ju]
 
     return apply_op("pdist", impl, (x,), {})
@@ -198,6 +195,8 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
     kernel temporal_shift_kernel.cu): shift a channel slice one step
     forward/backward along the segment axis."""
     def impl(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
         nt, c, h, w = a.shape
         n = nt // seg_num
         v = a.reshape(n, seg_num, c, h, w)
@@ -208,8 +207,11 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
             [jnp.zeros_like(v[:, :1, fold_c:2 * fold_c]),
              v[:, :-1, fold_c:2 * fold_c]], axis=1)
         rest = v[:, :, 2 * fold_c:]
-        return jnp.concatenate([left, right, rest],
-                               axis=2).reshape(nt, c, h, w)
+        out = jnp.concatenate([left, right, rest],
+                              axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
 
     return apply_op("temporal_shift", impl, (x,), {})
 

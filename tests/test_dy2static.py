@@ -1,0 +1,204 @@
+"""dy2static tests (reference test model: test/dygraph_to_static/ — the
+same function run eagerly and converted must agree, across branches and
+data-dependent loop counts; auto-conversion inside to_static)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit.dy2static import convert_to_static, convert_callable
+
+
+def branchy(x):
+    if x.sum() > 0:
+        y = x * 2.0
+    else:
+        y = x - 1.0
+    return y.sum()
+
+
+def loopy(x, n):
+    s = x.sum()
+    i = paddle.to_tensor(0)
+    while i < n:
+        s = s * 1.5
+        i = i + 1
+    return s
+
+
+def logical(a, b):
+    if a.sum() > 0 and b.sum() > 0:
+        out = paddle.to_tensor(1.0)
+    else:
+        out = paddle.to_tensor(0.0)
+    return out
+
+
+def nested(x):
+    if x.sum() > 0:
+        if x.max() > 5:
+            r = x * 10.0
+        else:
+            r = x * 2.0
+    else:
+        r = -x
+    return r.sum()
+
+
+class CtrlNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if h.sum() > 0:
+            out = h * 2.0
+        else:
+            out = h * -1.0
+        return out.sum()
+
+
+def _t(arr):
+    return paddle.to_tensor(np.asarray(arr, np.float32))
+
+
+class TestEagerEquivalence:
+    @pytest.mark.parametrize("x", [[1.0, 2.0], [-1.0, -2.0]])
+    def test_if(self, x):
+        g = convert_to_static(branchy)
+        assert g.__dy2static__
+        np.testing.assert_allclose(float(g(_t(x)).numpy()),
+                                   float(branchy(_t(x)).numpy()))
+
+    def test_while(self):
+        g = convert_to_static(loopy)
+        for n in (0, 1, 4):
+            np.testing.assert_allclose(
+                float(g(_t([1.0, 2.0]), paddle.to_tensor(n)).numpy()),
+                float(loopy(_t([1.0, 2.0]), paddle.to_tensor(n)).numpy()),
+                rtol=1e-6)
+
+    def test_nested_if(self):
+        g = convert_to_static(nested)
+        for x in ([1.0, 7.0], [1.0, 2.0], [-3.0, -1.0]):
+            np.testing.assert_allclose(float(g(_t(x)).numpy()),
+                                       float(nested(_t(x)).numpy()))
+
+    def test_logical(self):
+        g = convert_to_static(logical)
+        assert float(g(_t([1.0]), _t([1.0])).numpy()) == 1.0
+        assert float(g(_t([-1.0]), _t([1.0])).numpy()) == 0.0
+
+
+class TestTraced:
+    def test_if_both_branches_one_compile(self):
+        g = paddle.jit.to_static(convert_to_static(branchy))
+        pos = float(g(_t([1.0, 2.0])).numpy())
+        neg = float(g(_t([-1.0, -2.0])).numpy())
+        np.testing.assert_allclose(pos, 6.0)
+        np.testing.assert_allclose(neg, -5.0)
+
+    def test_while_data_dependent_trip_count(self):
+        g = paddle.jit.to_static(convert_to_static(loopy))
+        for n in (1, 3, 6):
+            got = float(g(_t([1.0, 2.0]), paddle.to_tensor(n)).numpy())
+            np.testing.assert_allclose(got, 3.0 * 1.5 ** n, rtol=1e-5)
+
+    def test_auto_conversion_in_to_static(self):
+        # plain to_static on a branchy fn: first call trips the tracer,
+        # auto-converts, and succeeds
+        g = paddle.jit.to_static(branchy)
+        np.testing.assert_allclose(float(g(_t([1.0, 2.0])).numpy()), 6.0)
+        np.testing.assert_allclose(float(g(_t([-1.0, -2.0])).numpy()), -5.0)
+
+    def test_auto_conversion_layer(self):
+        paddle.seed(0)
+        net = CtrlNet()
+        eager_pos = float(net(_t([[1.0, 2.0, 3.0, 4.0]])).numpy())
+        g = paddle.jit.to_static(net)
+        got = float(g(_t([[1.0, 2.0, 3.0, 4.0]])).numpy())
+        np.testing.assert_allclose(got, eager_pos, rtol=1e-5)
+
+    def test_layer_params_still_train_through_conversion(self):
+        paddle.seed(1)
+        from paddle_tpu import optimizer
+        net = CtrlNet()
+        g = paddle.jit.to_static(net)
+        opt = optimizer.SGD(learning_rate=0.001,
+                            parameters=net.parameters())
+        x = _t(np.random.default_rng(0).standard_normal((4, 4)))
+        w0 = net.fc.weight.numpy().copy()
+        losses = []
+        for i in range(5):
+            loss = g(x) ** 2
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert net.fc.weight.grad is None  # cleared
+        assert np.abs(net.fc.weight.numpy() - w0).max() > 1e-6
+        assert losses[-1] != losses[0]  # gradients flowed through lax.cond
+
+
+class TestGuardrails:
+    def test_return_in_branch_left_python(self):
+        def early(x):
+            if x.sum() > 0:
+                return x * 2.0
+            return -x
+        g = convert_to_static(early)
+        # statement untouched: eager works with python semantics
+        np.testing.assert_allclose(g(_t([1.0])).numpy(), [2.0])
+
+    def test_undefined_branch_var_raises_under_jit(self):
+        def bad(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                z = x - 1.0  # y undefined here
+            return x.sum()
+        g = convert_to_static(bad)
+        import jax
+        with pytest.raises(Exception):
+            jax.jit(lambda a: g(paddle.to_tensor(a)).data)(
+                np.array([1.0], np.float32))
+
+
+class TestReviewRegressions:
+    def test_nested_if_under_jit(self):
+        g = paddle.jit.to_static(convert_to_static(nested))
+        for x in ([1.0, 7.0], [1.0, 2.0], [-3.0, -1.0]):
+            np.testing.assert_allclose(float(g(_t(x)).numpy()),
+                                       float(nested(_t(x)).numpy()),
+                                       rtol=1e-5)
+
+    def test_while_backward_with_bounded_scan(self):
+        from paddle_tpu.jit.dy2static import set_max_loop_iters
+        set_max_loop_iters(8)
+        try:
+            g = paddle.jit.to_static(convert_to_static(loopy))
+            x = _t([1.0, 2.0])
+            x.stop_gradient = False
+            out = g(x, paddle.to_tensor(3))
+            np.testing.assert_allclose(float(out.numpy()),
+                                       3.0 * 1.5 ** 3, rtol=1e-5)
+            out.backward()
+            np.testing.assert_allclose(x.grad.numpy(),
+                                       [1.5 ** 3, 1.5 ** 3], rtol=1e-5)
+        finally:
+            set_max_loop_iters(None)
+
+    def test_lambda_bails_to_original_error(self):
+        lam = lambda x: x * 2.0 if x.sum() > 0 else -x  # noqa: E731
+        g = convert_to_static(lam)
+        assert not getattr(g, "__dy2static__", False)
+
+    def test_temporal_shift_nhwc(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 4, 2, 2)).astype(np.float32)  # NCHW
+        nchw = nn.functional.temporal_shift(_t(x), 2).numpy()
+        nhwc = nn.functional.temporal_shift(
+            _t(x.transpose(0, 2, 3, 1)), 2, data_format="NHWC").numpy()
+        np.testing.assert_allclose(nhwc.transpose(0, 3, 1, 2), nchw,
+                                   rtol=1e-6)
