@@ -1,1 +1,6 @@
+"""paddle.incubate.nn (reference: python/paddle/incubate/nn/ — fused layer
+classes + functional bindings)."""
 from . import functional  # noqa: F401
+from .layers import (FusedMultiHeadAttention, FusedFeedForward,  # noqa: F401
+                     FusedTransformerEncoderLayer,
+                     FusedBiasDropoutResidualLayerNorm)

@@ -99,7 +99,9 @@ class StaticFunction:
         # self.training) -> they must be part of the compile-cache key
         mode_sig = tuple(l.training for layer in self._layers
                          for _, l in layer.named_sublayers(include_self=True))
-        key = (treedef, tensor_idx, len(state), const_sig, mode_sig)
+        from .dy2static import convert_operators as _cop
+        key = (treedef, tensor_idx, len(state), const_sig, mode_sig,
+               _cop.MAX_LOOP_ITERS)
         cached = self._cache.get(key)
         if cached is None:
             fn = self._fn
@@ -149,8 +151,7 @@ class StaticFunction:
                 raise
             from .dy2static.transformer import convert_callable
             converted = convert_callable(self._fn)
-            if converted is self._fn or not getattr(converted,
-                                                    "__dy2static__", False):
+            if not getattr(converted, "__dy2static__", False):
                 raise
             self._fn = converted
             self._cache.clear()

@@ -17,6 +17,36 @@ from ...core.tensor import Tensor
 MAX_LOOP_ITERS = None
 
 
+class _UndefinedVar:
+    """Placeholder for a variable created inside a converted branch before
+    any branch assigned it (the reference's UndefinedVar). Any use raises
+    with the Python error the user would have gotten un-converted."""
+
+    def __init__(self, name="<branch-local>"):
+        self._name = name
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            f"local variable {self._name!r} referenced before assignment "
+            "(it is only assigned in one branch of a converted `if`)")
+
+    __getattr__ = _raise
+    __call__ = _raise
+    __bool__ = _raise
+    __add__ = __radd__ = __mul__ = __sub__ = _raise
+    __getitem__ = _raise
+
+    def __repr__(self):
+        return f"<undefined {self._name}>"
+
+
+UNDEF = _UndefinedVar()
+
+
+def _is_placeholder(v):
+    return v is None or isinstance(v, _UndefinedVar)
+
+
 def set_max_loop_iters(n):
     """Declare an upper bound for converted tensor `while` loops. With a
     bound, loops lower to a masked lax.scan (reverse-differentiable, fixed
@@ -34,13 +64,21 @@ def _is_traced(x):
     return isinstance(_arr(x), jax.core.Tracer)
 
 
-def _to_tree(vals):
-    return tuple(_arr(v) if isinstance(v, Tensor) else jnp.asarray(v)
-                 for v in vals)
+def _pack(vals):
+    """Flatten a tuple of carried variables — each may be a Tensor or a
+    pytree of Tensors (lists/dicts built in a branch) — into arrays."""
+    import jax.tree_util as jtu
+    leaves, treedef = jtu.tree_flatten(
+        list(vals), is_leaf=lambda x: isinstance(x, Tensor))
+    arrs = tuple(_arr(l) if isinstance(l, Tensor) else jnp.asarray(l)
+                 for l in leaves)
+    return arrs, treedef
 
 
-def _from_tree(arrs):
-    return tuple(Tensor(a, stop_gradient=True) for a in arrs)
+def _unpack(arrs, treedef):
+    import jax.tree_util as jtu
+    return tuple(jtu.tree_unflatten(
+        treedef, [Tensor(a, stop_gradient=True) for a in arrs]))
 
 
 def _scalar_bool(pred):
@@ -70,29 +108,33 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args,
     # are outputs only (both branches must define them); pre-existing
     # variables ride the lax.cond operand
     init = list(get_args())
-    carry_idx = [i for i, v in enumerate(init) if v is not None]
+    carry_idx = [i for i, v in enumerate(init) if not _is_placeholder(v)]
+
+    carry_init, carry_def = _pack([init[i] for i in carry_idx])
+    out_box = {}
 
     def branch(fn):
         def run(arrs):
             vals = list(init)
+            restored = _unpack(arrs, carry_def)
             for j, i in enumerate(carry_idx):
-                vals[i] = Tensor(arrs[j], stop_gradient=True)
+                vals[i] = restored[j]
             set_args(tuple(vals))
             fn()
             out = get_args()
-            if any(v is None for v in out):
+            if any(_is_placeholder(v) for v in out):
                 raise ValueError(
                     "dy2static: a variable assigned in only one branch of "
                     "a tensor `if` was left undefined by the other branch "
                     "— define it in both (static cond needs matching "
                     "outputs)")
-            return _to_tree(out)
+            arrs_out, out_box["treedef"] = _pack(out)
+            return arrs_out
         return run
 
     out = jax.lax.cond(_scalar_bool(pred), branch(true_fn),
-                       branch(false_fn),
-                       _to_tree([init[i] for i in carry_idx]))
-    set_args(_from_tree(out))
+                       branch(false_fn), carry_init)
+    set_args(_unpack(out, out_box["treedef"]))
 
 
 def convert_while_loop(cond_fn, body_fn, get_args, set_args):
@@ -106,20 +148,23 @@ def convert_while_loop(cond_fn, body_fn, get_args, set_args):
             probe = cond_fn()
         return
 
-    if any(v is None for v in get_args()):
+    if any(_is_placeholder(v) for v in get_args()):
         raise ValueError(
             "dy2static: a tensor `while` loop variable is used before "
             "assignment — initialize every carried variable before the "
             "loop (static while needs typed loop state)")
 
+    _, carry_def = _pack(get_args())
+
     def cond(arrs):
-        set_args(_from_tree(arrs))
+        set_args(_unpack(arrs, carry_def))
         return _scalar_bool(cond_fn())
 
     def body(arrs):
-        set_args(_from_tree(arrs))
+        set_args(_unpack(arrs, carry_def))
         body_fn()
-        return _to_tree(get_args())
+        arrs_out, _ = _pack(get_args())
+        return arrs_out
 
     if MAX_LOOP_ITERS is not None:
         def scan_body(arrs, _):
@@ -129,11 +174,11 @@ def convert_while_loop(cond_fn, body_fn, get_args, set_args):
                            for n, o in zip(new, arrs))
             return merged, None
 
-        out, _ = jax.lax.scan(scan_body, _to_tree(get_args()),
+        out, _ = jax.lax.scan(scan_body, _pack(get_args())[0],
                               None, length=int(MAX_LOOP_ITERS))
     else:
-        out = jax.lax.while_loop(cond, body, _to_tree(get_args()))
-    set_args(_from_tree(out))
+        out = jax.lax.while_loop(cond, body, _pack(get_args())[0])
+    set_args(_unpack(out, carry_def))
 
 
 def convert_logical_and(lhs_fn, rhs_fn):

@@ -42,8 +42,57 @@ def _assigned_names(node):
         def visit_Lambda(self, n):
             pass
 
+        def visit_ListComp(self, n):
+            pass
+
+        def visit_SetComp(self, n):
+            pass
+
+        def visit_DictComp(self, n):
+            pass
+
+        def visit_GeneratorExp(self, n):
+            pass
+
     for stmt in (node if isinstance(node, list) else [node]):
         V().visit(stmt)
+    return names
+
+
+def _has_external_stores(node_list):
+    """True if the statements assign through attributes/subscripts
+    (obj.x = .., d[k] = ..) — side effects that must not run under
+    lax.cond tracing of BOTH branches; such statements stay Python."""
+    found = []
+
+    class V(ast.NodeVisitor):
+        def visit_Attribute(self, n):
+            if isinstance(n.ctx, (ast.Store, ast.Del)):
+                found.append(n)
+            self.generic_visit(n)
+
+        def visit_Subscript(self, n):
+            if isinstance(n.ctx, (ast.Store, ast.Del)):
+                found.append(n)
+            self.generic_visit(n)
+
+        def visit_FunctionDef(self, n):
+            pass
+
+        def visit_Lambda(self, n):
+            pass
+
+    for stmt in node_list:
+        V().visit(stmt)
+    return bool(found)
+
+
+def _loaded_names(nodes):
+    names = set()
+    for stmt in (nodes if isinstance(nodes, list) else [nodes]):
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                names.add(sub.id)
     return names
 
 
@@ -77,6 +126,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self._counter = 0
         self._known = set()      # names bound so far in the current scope
+        self._loads_after = set()  # names read after the current statement
+        self._loop_loads = []    # loads of enclosing loop bodies
 
     def _uid(self):
         self._counter += 1
@@ -96,14 +147,31 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def _visit_block(self, stmts):
         out = []
-        for stmt in stmts:
+        outer_after = self._loads_after
+        for idx, stmt in enumerate(stmts):
+            # liveness horizon: the rest of this block, whatever the outer
+            # context reads later, and (conservatively) every enclosing
+            # loop body — names dead past this point need not be carried
+            self._loads_after = (_loaded_names(stmts[idx + 1:])
+                                 | outer_after
+                                 | set().union(*self._loop_loads)
+                                 if self._loop_loads else
+                                 _loaded_names(stmts[idx + 1:])
+                                 | outer_after)
             new = self.visit(stmt)
             if isinstance(new, list):
                 out.extend(new)
             else:
                 out.append(new)
             self._known |= _assigned_names(stmt)
+        self._loads_after = outer_after
         return out
+
+    def visit_For(self, node):
+        self._loop_loads.append(_loaded_names(node.body))
+        self.generic_visit(node)
+        self._loop_loads.pop()
+        return node
 
     # -- statements -------------------------------------------------------
     def visit_If(self, node):
@@ -118,15 +186,19 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         node.orelse = self._visit_block(node.orelse)
         self._known = known_before
         if _contains(node.body + node.orelse, ast.Return, ast.Break,
-                     ast.Continue, ast.Yield):
+                     ast.Continue, ast.Yield) \
+                or _has_external_stores(node.body + node.orelse):
             return node  # python semantics (graph break under jit)
+        live = self._loads_after | self._known
         targets = sorted(t for t in orig_targets
-                         if not t.startswith("__dy2st"))
+                         if not t.startswith("__dy2st")
+                         and (t in live))
         if not targets:
             return node
         uid = self._uid()
         created = [t for t in targets if t not in self._known]
-        pre = [ast.parse(f"{t} = None").body[0] for t in created]
+        pre = [ast.parse(f"{t} = __dy2st._UndefinedVar({t!r})").body[0]
+               for t in created]
         tuple_src = ", ".join(targets) + ("," if len(targets) == 1 else "")
         tf = ast.parse(f"def __dy2st_true_{uid}():\n    pass").body[0]
         tf.body = [ast.Nonlocal(names=list(targets))] + node.body
@@ -155,15 +227,19 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         node.body = self._visit_block(node.body)
         self._known = known_before
         if node.orelse or _contains(node.body, ast.Return, ast.Break,
-                                    ast.Continue, ast.Yield):
+                                    ast.Continue, ast.Yield) \
+                or _has_external_stores(node.body):
             return node
+        # while: every assigned name is loop-carried (read next iteration
+        # through the cond/body closures), keep them all
         targets = sorted(t for t in orig_targets
                          if not t.startswith("__dy2st"))
         if not targets:
             return node
         uid = self._uid()
         created = [t for t in targets if t not in self._known]
-        pre = [ast.parse(f"{t} = None").body[0] for t in created]
+        pre = [ast.parse(f"{t} = __dy2st._UndefinedVar({t!r})").body[0]
+               for t in created]
         tuple_src = ", ".join(targets) + ("," if len(targets) == 1 else "")
         body_fn = ast.parse(f"def __dy2st_body_{uid}():\n    pass").body[0]
         body_fn.body = [ast.Nonlocal(names=list(targets))] + node.body
@@ -268,11 +344,9 @@ def convert_callable(obj):
         new = convert_to_static(fwd)
         if not getattr(new, "__dy2static__", False):
             return obj
-
-        def wrapper(*args, **kwargs):
-            return new(obj, *args, **kwargs)
-
-        wrapper.__dy2static__ = True
-        wrapper.__name__ = getattr(obj, "__class__", type(obj)).__name__
-        return wrapper
+        # bind the converted forward on the INSTANCE so Layer.__call__
+        # (and its pre/post forward hooks) keep running
+        obj.forward = types.MethodType(new, obj)
+        obj.__dy2static__ = True
+        return obj
     return obj

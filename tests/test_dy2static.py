@@ -202,3 +202,59 @@ class TestReviewRegressions:
             _t(x.transpose(0, 2, 3, 1)), 2, data_format="NHWC").numpy()
         np.testing.assert_allclose(nhwc.transpose(0, 3, 1, 2), nchw,
                                    rtol=1e-6)
+
+    def test_side_effect_branches_left_python(self):
+        class Counter:
+            hits = 0
+            misses = 0
+
+        def f(x, c):
+            if x.sum() > 0:
+                y = x * 2.0
+                c.hits = c.hits + 1
+            else:
+                y = -x
+                c.misses = c.misses + 1
+            return y
+
+        g = convert_to_static(f)
+        c = Counter()
+        g(_t([1.0]), c)
+        assert (c.hits, c.misses) == (1, 0)  # only one branch ran
+
+    def test_comprehension_in_branch(self):
+        def f(x):
+            if x.sum() > 0:
+                parts = [x * float(i) for i in range(1, 3)]
+                y = parts[0] + parts[1]
+            else:
+                y = -x
+            return y
+
+        g = paddle.jit.to_static(convert_to_static(f))
+        np.testing.assert_allclose(g(_t([2.0])).numpy(), [6.0], rtol=1e-6)
+        np.testing.assert_allclose(g(_t([-2.0])).numpy(), [2.0], rtol=1e-6)
+
+    def test_layer_hooks_survive_conversion(self):
+        paddle.seed(3)
+        net = CtrlNet()
+        calls = []
+        net.register_forward_pre_hook(
+            lambda layer, inputs: calls.append(1))
+        g = paddle.jit.to_static(net)
+        g(_t([[1.0, 2.0, 3.0, 4.0]]))
+        g(_t([[1.0, 2.0, 3.0, 4.0]]))
+        assert len(calls) >= 2
+
+    def test_undefined_var_raises_eagerly(self):
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                z = -x  # y undefined on this path
+            return y
+
+        g = convert_to_static(f)
+        np.testing.assert_allclose(g(_t([1.0])).numpy(), [2.0])
+        with pytest.raises(UnboundLocalError):
+            g(_t([-1.0])).numpy()

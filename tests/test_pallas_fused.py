@@ -276,3 +276,47 @@ class TestFusedOps:
         ctx = np.einsum("bnst,btnh->bsnh", p, v).reshape(B, S, DM)
         ref = h + ctx @ lw.numpy()
         np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+
+class TestFusedLayers:
+    def test_fused_encoder_layer(self):
+        from paddle_tpu.incubate.nn import (FusedMultiHeadAttention,
+                                            FusedFeedForward,
+                                            FusedTransformerEncoderLayer,
+                                            FusedBiasDropoutResidualLayerNorm)
+        paddle.seed(0)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((2, 6, 16)).astype(
+            np.float32))
+        for layer in (FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                              attn_dropout_rate=0.0),
+                      FusedFeedForward(16, 32, dropout_rate=0.0),
+                      FusedTransformerEncoderLayer(16, 4, 32,
+                                                   dropout_rate=0.0)):
+            layer.eval()
+            out = layer(x)
+            assert out.shape == [2, 6, 16]
+            assert np.isfinite(out.numpy()).all()
+        b = FusedBiasDropoutResidualLayerNorm(16, dropout_rate=0.0)
+        b.eval()
+        assert b(x, x).shape == [2, 6, 16]
+
+    def test_fused_encoder_trains(self):
+        from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+        from paddle_tpu import optimizer
+        paddle.seed(1)
+        enc = FusedTransformerEncoderLayer(8, 2, 16, dropout_rate=0.0)
+        enc.train()
+        opt = optimizer.Adam(parameters=enc.parameters(),
+                             learning_rate=1e-3)
+        x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (2, 4, 8)).astype(np.float32))
+        l0 = None
+        for i in range(5):
+            loss = (enc(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if i == 0:
+                l0 = float(loss.numpy())
+        assert float(loss.numpy()) < l0
