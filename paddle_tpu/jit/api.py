@@ -142,7 +142,7 @@ class StaticFunction:
             impl, out_box, call_tensors = self._prepare(args, kwargs)
             out = apply_op(f"to_static[{self._name}]", impl, call_tensors,
                            {})
-        except _jerr.TracerBoolConversionError:
+        except _jerr.ConcretizationTypeError:
             # data-dependent Python control flow broke the trace: rewrite
             # the function through the dy2static AST pass (if -> lax.cond,
             # while -> lax.while_loop) and retrace — the reference's

@@ -169,9 +169,81 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def visit_For(self, node):
         self._loop_loads.append(_loaded_names(node.body))
-        self.generic_visit(node)
-        self._loop_loads.pop()
-        return node
+        try:
+            lowered = self._try_lower_range_for(node)
+            if lowered is not None:
+                return lowered
+            self.generic_visit(node)
+            return node
+        finally:
+            self._loop_loads.pop()
+
+    def _try_lower_range_for(self, node):
+        """`for i in range(...)` (positive literal step or default) lowers
+        to the while transform, so a tensor-valued bound becomes a
+        lax.while_loop instead of a trace-time concretization error
+        (reference loop_transformer's for-range path)."""
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3
+                and isinstance(node.target, ast.Name)
+                and not node.orelse
+                and not _contains(node.body, ast.Return, ast.Break,
+                                  ast.Continue, ast.Yield,
+                                  ast.YieldFrom)):
+            return None
+        step = None
+        if len(it.args) == 3:
+            s = it.args[2]
+            if not (isinstance(s, ast.Constant)
+                    and isinstance(s.value, int) and s.value > 0):
+                return None  # non-literal/negative step: leave Python
+            step = s.value
+        uid = self._uid()
+        tgt = node.target.id
+        if len(it.args) == 1:
+            start = ast.Constant(value=0)
+            stop = it.args[0]
+        else:
+            start, stop = it.args[0], it.args[1]
+        # faithful desugaring: a hidden counter drives the loop and the
+        # target is (re)assigned at the top of each iteration — body
+        # reassignments of the target don't change the trip count and the
+        # post-loop value matches Python (last iterate). One documented
+        # divergence: the target is pre-bound to `start` so the traced
+        # while carry is typed, so an empty range leaves it at `start`
+        # instead of unbound
+        stop_name = f"_jst_stop_{uid}"
+        ctr_name = f"_jst_ctr_{uid}"
+        assigns = [
+            ast.Assign(targets=[ast.Name(id=stop_name, ctx=ast.Store())],
+                       value=stop),
+            ast.Assign(targets=[ast.Name(id=ctr_name, ctx=ast.Store())],
+                       value=start),
+        ]
+        assigns.append(ast.Assign(
+            targets=[ast.Name(id=tgt, ctx=ast.Store())],
+            value=ast.Name(id=ctr_name, ctx=ast.Load())))
+        set_tgt = ast.Assign(
+            targets=[ast.Name(id=tgt, ctx=ast.Store())],
+            value=ast.Name(id=ctr_name, ctx=ast.Load()))
+        incr = ast.AugAssign(
+            target=ast.Name(id=ctr_name, ctx=ast.Store()), op=ast.Add(),
+            value=ast.Constant(value=step or 1))
+        while_node = ast.While(
+            test=ast.Compare(left=ast.Name(id=ctr_name, ctx=ast.Load()),
+                             ops=[ast.Lt()],
+                             comparators=[ast.Name(id=stop_name,
+                                                   ctx=ast.Load())]),
+            body=[set_tgt] + list(node.body) + [incr], orelse=[])
+        out = []
+        for stmt in assigns:
+            out.append(stmt)
+            self._known |= _assigned_names(stmt)
+        lowered = self.visit(while_node)
+        out.extend(lowered if isinstance(lowered, list) else [lowered])
+        return out
 
     # -- statements -------------------------------------------------------
     def visit_If(self, node):
