@@ -58,7 +58,38 @@ from . import onnx
 from .hapi import Model, summary
 from .hapi.flops import flops
 from .framework import save, load, set_default_dtype, get_default_dtype
+from .framework.compat import *  # noqa: F401,F403 — dtype/Place/dlpack surface
+from .framework.compat import batch  # shadowed-by-design helper
 from .utils.flags import set_flags, get_flags
+from .nn import ParamAttr
+from .nn.functional import pdist
+from .distributed.parallel import DataParallel
+
+# paddle.bool is the dtype (shadows the builtin inside this namespace only,
+# matching the reference's paddle.bool)
+globals()["bool"] = bool_
+
+
+# top-level forms of the random in-place fills (paddle.normal_(x, ...) ==
+# x.normal_(...))
+def normal_(x, mean=0.0, std=1.0):
+    return x.normal_(mean, std)
+
+
+def log_normal_(x, mean=1.0, std=2.0):
+    return x.log_normal_(mean, std)
+
+
+def bernoulli_(x, p=0.5):
+    return x.bernoulli_(p)
+
+
+def cauchy_(x, loc=0, scale=1):
+    return x.cauchy_(loc, scale)
+
+
+def geometric_(x, probs):
+    return x.geometric_(probs)
 
 import jax as _jax
 

@@ -224,6 +224,55 @@ class Tensor:
         self._data = jnp.zeros_like(self._data)
         return self
 
+    # -- random in-place fills (reference: paddle.Tensor.uniform_/normal_/
+    # bernoulli_/cauchy_/geometric_/log_normal_/exponential_) -----------
+    def _fill_random(self, sampler):
+        from . import random as _rng
+        self._data = sampler(_rng.next_key()).astype(self._data.dtype)
+        return self
+
+    def uniform_(self, min=-1.0, max=1.0, seed=0):
+        return self._fill_random(lambda k: jax.random.uniform(
+            k, self._data.shape, jnp.float32, min, max))
+
+    def normal_(self, mean=0.0, std=1.0):
+        return self._fill_random(lambda k: jax.random.normal(
+            k, self._data.shape) * std + mean)
+
+    def log_normal_(self, mean=1.0, std=2.0):
+        return self._fill_random(lambda k: jnp.exp(jax.random.normal(
+            k, self._data.shape) * std + mean))
+
+    def bernoulli_(self, p=0.5):
+        p = p._data if isinstance(p, Tensor) else p
+        return self._fill_random(lambda k: jax.random.bernoulli(
+            k, p, self._data.shape))
+
+    def cauchy_(self, loc=0, scale=1):
+        return self._fill_random(lambda k: loc + scale * jax.random.cauchy(
+            k, self._data.shape))
+
+    def geometric_(self, probs):
+        probs = probs._data if isinstance(probs, Tensor) else probs
+        return self._fill_random(lambda k: jax.random.geometric(
+            k, probs, self._data.shape))
+
+    def exponential_(self, lam=1.0):
+        return self._fill_random(lambda k: jax.random.exponential(
+            k, self._data.shape) / lam)
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def is_floating_point(self):
+        return jnp.issubdtype(self._data.dtype, jnp.floating)
+
+    def is_complex(self):
+        return jnp.issubdtype(self._data.dtype, jnp.complexfloating)
+
+    def is_integer(self):
+        return jnp.issubdtype(self._data.dtype, jnp.integer)
+
     def __getitem__(self, idx):
         idx = _unwrap_index(idx)
         return apply_op("getitem", lambda x: x[idx], (self,), {})

@@ -33,7 +33,13 @@ __all__ = ["llama_sharding_rules", "gpt_sharding_rules", "spec_for_param",
 # fallback the reference applies for non-divisible shards).
 def llama_sharding_rules():
     return [
-        (r".*embed_tokens\.weight$",        ("mp", "fsdp")),   # [V, H] vocab-parallel
+        # [V, H]: vocab over fsdp, hidden over mp. NOT ("mp","fsdp"): that
+        # makes the gather output hidden-sharded over fsdp, and resharding
+        # that axis into the combined ("dp","fsdp") batch tile is a cross-dim
+        # move XLA's SPMD partitioner full-rematerializes (replicate+slice).
+        # With hidden over mp the fixups are a plain mp all-gather + dp/fsdp
+        # dynamic-slice, both native collectives.
+        (r".*embed_tokens\.weight$",        ("fsdp", "mp")),
         (r".*(q_proj|k_proj|v_proj|gate_proj|up_proj)\.weight$",
                                             ("fsdp", "mp")),   # column-parallel [in, out]
         (r".*(o_proj|down_proj)\.weight$",  ("mp", "fsdp")),   # row-parallel [in, out]

@@ -201,3 +201,211 @@ def adaptive_avg_pool1d(x, output_size):
         return jnp.stack([a[:, :, b0:b1].mean(axis=2) for (b0, b1) in bounds],
                          axis=-1)
     return apply_op("adaptive_avg_pool1d", impl, (x,), {})
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    od, oh, ow = _tup(output_size, 3)
+
+    def impl(a):
+        n, c, d, h, w = a.shape
+        if d % od == 0 and h % oh == 0 and w % ow == 0:
+            out = a.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+            return out.mean(axis=(3, 5, 7))
+        ds = [(int(np.floor(i * d / od)), int(np.ceil((i + 1) * d / od)))
+              for i in range(od)]
+        hs = [(int(np.floor(i * h / oh)), int(np.ceil((i + 1) * h / oh)))
+              for i in range(oh)]
+        ws = [(int(np.floor(j * w / ow)), int(np.ceil((j + 1) * w / ow)))
+              for j in range(ow)]
+        return jnp.stack([
+            jnp.stack([
+                jnp.stack([a[:, :, d0:d1, h0:h1, w0:w1].mean(axis=(2, 3, 4))
+                           for (w0, w1) in ws], axis=-1)
+                for (h0, h1) in hs], axis=-2)
+            for (d0, d1) in ds], axis=-3)
+    return apply_op("adaptive_avg_pool3d", impl, (x,), {})
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    o = output_size if isinstance(output_size, int) else output_size[0]
+
+    def impl(a):
+        n, c, l = a.shape
+        if l % o == 0:
+            return a.reshape(n, c, o, l // o).max(axis=3)
+        bounds = [(int(np.floor(i * l / o)), int(np.ceil((i + 1) * l / o)))
+                  for i in range(o)]
+        return jnp.stack([a[:, :, b0:b1].max(axis=2) for (b0, b1) in bounds],
+                         axis=-1)
+    out = apply_op("adaptive_max_pool1d", impl, (x,), {})
+    if return_mask:
+        def mask_impl(a):
+            n, c, l = a.shape
+            bounds = [(int(np.floor(i * l / o)), int(np.ceil((i + 1) * l / o)))
+                      for i in range(o)]
+            return jnp.stack([a[:, :, b0:b1].argmax(axis=2) + b0
+                              for (b0, b1) in bounds], axis=-1).astype(jnp.int32)
+        return out, apply_op("adaptive_max_pool1d_mask", mask_impl, (x,), {},
+                             differentiable=False)
+    return out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False,
+                        data_format="NCDHW"):
+    od, oh, ow = _tup(output_size, 3)
+
+    def impl(a):
+        n, c, d, h, w = a.shape
+        if d % od == 0 and h % oh == 0 and w % ow == 0:
+            out = a.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+            return out.max(axis=(3, 5, 7))
+        ds = [(int(np.floor(i * d / od)), int(np.ceil((i + 1) * d / od)))
+              for i in range(od)]
+        hs = [(int(np.floor(i * h / oh)), int(np.ceil((i + 1) * h / oh)))
+              for i in range(oh)]
+        ws = [(int(np.floor(j * w / ow)), int(np.ceil((j + 1) * w / ow)))
+              for j in range(ow)]
+        return jnp.stack([
+            jnp.stack([
+                jnp.stack([a[:, :, d0:d1, h0:h1, w0:w1].max(axis=(2, 3, 4))
+                           for (w0, w1) in ws], axis=-1)
+                for (h0, h1) in hs], axis=-2)
+            for (d0, d1) in ds], axis=-3)
+    return apply_op("adaptive_max_pool3d", impl, (x,), {})
+
+
+def _lp_pool_nd(x, norm_type, kernel_size, stride, padding, ceil_mode,
+                spatial, name):
+    """L-p norm pooling: (sum |x|^p)^(1/p) over windows (reference
+    lp_pool kernels)."""
+    p = float(norm_type)
+    if stride is None:
+        stride = kernel_size
+
+    def impl(a):
+        powed = jnp.abs(a) ** p
+        k, s, sp_pads = _resolve_pads(kernel_size, stride, padding, ceil_mode,
+                                      a.shape[2:])
+        summed = jax.lax.reduce_window(
+            powed, 0.0, jax.lax.add, (1, 1) + k, (1, 1) + s,
+            [(0, 0), (0, 0)] + sp_pads)
+        return summed ** (1.0 / p)
+    return apply_op(name, impl, (x,), {})
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL"):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = stride if stride is None or isinstance(stride, int) else stride[0]
+    pd = padding if isinstance(padding, int) else padding[0]
+    return _lp_pool_nd(x, norm_type, k, s, pd, ceil_mode, 1, "lp_pool1d")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW"):
+    return _lp_pool_nd(x, norm_type, kernel_size, stride, padding, ceil_mode,
+                       2, "lp_pool2d")
+
+
+def _max_unpool_nd(x, indices, spatial, kernel_size, stride=None, padding=0,
+                   output_size=None, name="max_unpool"):
+    """Scatter pooled values back to pre-pool positions using the flat
+    spatial indices produced by max_pool*(return_mask=True) (reference
+    max_unpool kernels)."""
+    if stride is None:
+        stride = kernel_size
+
+    def impl(a, idx):
+        lead = a.shape[:2]
+        in_sizes = a.shape[2:]
+        if output_size is not None:
+            out_sizes = tuple(output_size)[-spatial:]
+        else:
+            k = _tup(kernel_size, spatial)
+            s = _tup(stride, spatial)
+            p = _tup(padding, spatial)
+            out_sizes = tuple((in_sizes[i] - 1) * s[i] - 2 * p[i] + k[i]
+                              for i in range(spatial))
+        flat_out = int(np.prod(out_sizes))
+        nflat = int(np.prod(lead))
+        av = a.reshape(nflat, -1)
+        iv = idx.reshape(nflat, -1).astype(jnp.int32)
+        out = jnp.zeros((nflat, flat_out), a.dtype)
+        out = jax.vmap(lambda o, i, v: o.at[i].set(v))(out, iv, av)
+        return out.reshape(lead + out_sizes)
+    return apply_op(name, impl, (x, indices), {})
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None):
+    return _max_unpool_nd(x, indices, 1, kernel_size, stride, padding,
+                          output_size, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None):
+    return _max_unpool_nd(x, indices, 2, kernel_size, stride, padding,
+                          output_size, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None):
+    return _max_unpool_nd(x, indices, 3, kernel_size, stride, padding,
+                          output_size, "max_unpool3d")
+
+
+def _fractional_starts(in_size, out_size, k, u):
+    """Pseudo-random window starts for fractional pooling (Graham 2014,
+    the reference's fractional_max_pool kernels): alpha = in/out steps,
+    jittered by u in [0,1)."""
+    alpha = (in_size - k) / max(out_size - 1, 1)
+    starts = [int(np.floor(alpha * (i + u))) for i in range(out_size)]
+    starts = [min(s, in_size - k) for s in starts]
+    if out_size > 0:
+        starts[0] = 0
+    return starts
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False):
+    oh, ow = _tup(output_size, 2)
+
+    def impl(a):
+        n, c, h, w = a.shape
+        kh = kernel_size if isinstance(kernel_size, int) else \
+            (kernel_size[0] if kernel_size else h // oh + 1)
+        kw = kernel_size if isinstance(kernel_size, int) else \
+            (kernel_size[1] if kernel_size else w // ow + 1)
+        u = float(random_u) if random_u is not None else 0.5
+        rs = _fractional_starts(h, oh, kh, u)
+        cs = _fractional_starts(w, ow, kw, u)
+        return jnp.stack([
+            jnp.stack([a[:, :, r:r + kh, cc:cc + kw].max(axis=(2, 3))
+                       for cc in cs], axis=-1)
+            for r in rs], axis=-2)
+    return apply_op("fractional_max_pool2d", impl, (x,), {})
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False):
+    od, oh, ow = _tup(output_size, 3)
+
+    def impl(a):
+        n, c, d, h, w = a.shape
+        if kernel_size is None:
+            kd, kh, kw = d // od + 1, h // oh + 1, w // ow + 1
+        elif isinstance(kernel_size, int):
+            kd = kh = kw = kernel_size
+        else:
+            kd, kh, kw = kernel_size
+        u = float(random_u) if random_u is not None else 0.5
+        dsl = _fractional_starts(d, od, kd, u)
+        rs = _fractional_starts(h, oh, kh, u)
+        cs = _fractional_starts(w, ow, kw, u)
+        return jnp.stack([
+            jnp.stack([
+                jnp.stack([a[:, :, dd:dd + kd, r:r + kh, cc:cc + kw]
+                           .max(axis=(2, 3, 4)) for cc in cs], axis=-1)
+                for r in rs], axis=-2)
+            for dd in dsl], axis=-3)
+    return apply_op("fractional_max_pool3d", impl, (x,), {})

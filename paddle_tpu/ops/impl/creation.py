@@ -178,3 +178,54 @@ def _i64():
     """Index dtype: int64 when x64 is on, else canonical int32 (silent)."""
     import jax
     return jnp.int64 if jax.config.x64_enabled else jnp.int32
+
+
+# -- API-surface completion batch ------------------------------------------
+def randint_like(x, low=0, high=None, dtype=None, key=None):
+    a = x.data if hasattr(x, "data") else x
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_key(key), a.shape, low, high,
+                              convert_dtype(dtype) or a.dtype)
+
+
+def binomial(count, prob, key=None):
+    """Samples ~ Binomial(count, prob) (reference binomial op)."""
+    c = count.data if hasattr(count, "data") else count
+    p = prob.data if hasattr(prob, "data") else prob
+    return jax.random.binomial(_key(key), jnp.asarray(c, jnp.float32),
+                               jnp.asarray(p, jnp.float32)).astype(_i64())
+
+
+def poisson(x, key=None):
+    lam = x.data if hasattr(x, "data") else x
+    return jax.random.poisson(_key(key), lam).astype(
+        lam.dtype if jnp.issubdtype(jnp.asarray(lam).dtype, jnp.floating)
+        else jnp.float32)
+
+
+def standard_gamma(x, key=None):
+    alpha = x.data if hasattr(x, "data") else x
+    return jax.random.gamma(_key(key), alpha)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, key=None):
+    mean = mean.data if hasattr(mean, "data") else mean
+    std = std.data if hasattr(std, "data") else std
+    if shape is None:
+        shape = jnp.broadcast_shapes(jnp.shape(mean), jnp.shape(std))
+    return jnp.exp(jax.random.normal(_key(key), _shape(shape)) * std + mean)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    r, c = jnp.tril_indices(int(row), k=int(offset), m=int(col))
+    return jnp.stack([r, c]).astype(convert_dtype(dtype) or _i64())
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    r, c = jnp.triu_indices(int(row), k=int(offset), m=int(col))
+    return jnp.stack([r, c]).astype(convert_dtype(dtype) or _i64())

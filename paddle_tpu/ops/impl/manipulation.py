@@ -382,3 +382,104 @@ def block_diag(inputs):
         ro += a.shape[0]
         co += a.shape[1]
     return out
+
+
+# -- API-surface completion batch ------------------------------------------
+def clone(x):
+    a = _arr(x)
+    return a + jnp.zeros((), a.dtype) if jnp.issubdtype(a.dtype, jnp.number) \
+        else jnp.asarray(a).copy()
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    """Batched diagonal construction (reference diag_embed)."""
+    a = _arr(input)
+    n = a.shape[-1] + abs(int(offset))
+    out_ndim = a.ndim + 1
+    d1 = dim1 % out_ndim
+    d2 = dim2 % out_ndim
+    base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    rng = jnp.arange(a.shape[-1])
+    rows = rng + max(-int(offset), 0)
+    cols = rng + max(int(offset), 0)
+    base = base.at[..., rows, cols].set(a)
+    return jnp.moveaxis(base, (out_ndim - 2, out_ndim - 1), (d1, d2))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides):
+    """Write `value` into strided slices of x (reference slice_scatter)."""
+    a, v = _arr(x), _arr(value)
+    idx = [jnp.s_[:]] * a.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[int(ax)] = jnp.s_[int(st):int(en):int(sd)]
+    return a.at[tuple(idx)].set(v)
+
+
+def select_scatter(x, values, axis, index):
+    a, v = _arr(x), _arr(values)
+    idx = [jnp.s_[:]] * a.ndim
+    idx[int(axis)] = int(index)
+    return a.at[tuple(idx)].set(v)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    a, v = _arr(x), _arr(y)
+    moved = jnp.moveaxis(a, (int(axis1), int(axis2)), (-2, -1))
+    h, w = moved.shape[-2:]
+    off = int(offset)
+    rows = jnp.arange(max(0, -off), max(0, -off) + v.shape[-1])
+    cols = rows + off
+    moved = moved.at[..., rows, cols].set(v)
+    return jnp.moveaxis(moved, (-2, -1), (int(axis1), int(axis2)))
+
+
+def index_fill(x, index, axis, value):
+    a = _arr(x)
+    idx = _arr(index)
+    val = _arr(value) if hasattr(value, "data") else value
+    moved = jnp.moveaxis(a, int(axis), 0)
+    moved = moved.at[idx].set(val)
+    return jnp.moveaxis(moved, 0, int(axis))
+
+
+def unflatten(x, axis, shape):
+    a = _arr(x)
+    ax = int(axis) % a.ndim
+    shape = tuple(int(s) for s in (shape.tolist() if hasattr(shape, "tolist")
+                                   else shape))
+    return a.reshape(a.shape[:ax] + shape + a.shape[ax + 1:])
+
+
+def as_strided(x, shape, stride, offset=0):
+    """Strided view materialized via gather — x is indexed flat with
+    sum(idx*stride)+offset (reference as_strided; on TPU a copy, XLA has no
+    aliasing views)."""
+    a = jnp.ravel(_arr(x))
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+    idx = jnp.zeros(shape, jnp.int32)
+    for d, (sz, st) in enumerate(zip(shape, stride)):
+        r = jnp.arange(sz, dtype=jnp.int32).reshape(
+            (1,) * d + (sz,) + (1,) * (len(shape) - d - 1))
+        idx = idx + r * st
+    return a[idx + int(offset)]
+
+
+def unfold(x, axis, size, step):
+    """Sliding windows along one axis (Tensor.unfold — distinct from
+    F.unfold/im2col)."""
+    a = _arr(x)
+    ax = int(axis) % a.ndim
+    n = (a.shape[ax] - int(size)) // int(step) + 1
+    starts = jnp.arange(n, dtype=jnp.int32) * int(step)
+    win = jnp.arange(int(size), dtype=jnp.int32)
+    gather_idx = starts[:, None] + win[None, :]          # [n, size]
+    moved = jnp.moveaxis(a, ax, 0)
+    out = moved[gather_idx]                               # [n, size, ...rest]
+    out = jnp.moveaxis(out, (0, 1), (ax, a.ndim))
+    return out
+
+
+def matrix_transpose(x):
+    a = _arr(x)
+    return jnp.swapaxes(a, -1, -2)

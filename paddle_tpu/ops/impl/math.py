@@ -385,3 +385,162 @@ def renorm(x, p, axis, max_norm):
     scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
     out = flat * scale[:, None]
     return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+# -- API-surface completion batch (reference paddle/tensor/math.py etc.) ----
+def logit(x, eps=None):
+    """log(x / (1-x)); eps clamps x into [eps, 1-eps] (reference logit)."""
+    a = _arr(x)
+    if eps is not None:
+        a = jnp.clip(a, eps, 1.0 - eps)
+    return jnp.log(a) - jnp.log1p(-a)
+
+
+def sinc(x):
+    return jnp.sinc(_arr(x))
+
+
+def gammainc(x, y):
+    """Regularized lower incomplete gamma P(x, y) (paddle.gammainc)."""
+    return jax.scipy.special.gammainc(_arr(x), _arr(y))
+
+
+def gammaincc(x, y):
+    """Regularized upper incomplete gamma Q(x, y)."""
+    return jax.scipy.special.gammaincc(_arr(x), _arr(y))
+
+
+def multigammaln(x, p):
+    """Log multivariate gamma (reference multigammaln)."""
+    a = _arr(x)
+    p = int(p)
+    j = jnp.arange(1, p + 1, dtype=a.dtype if jnp.issubdtype(
+        jnp.asarray(a).dtype, jnp.floating) else jnp.float32)
+    const = 0.25 * p * (p - 1) * jnp.log(jnp.pi).astype(j.dtype)
+    return const + jax.scipy.special.gammaln(
+        a[..., None] + (1.0 - j) / 2.0).sum(-1)
+
+
+def isin(x, test_x, assume_unique=False, invert=False):
+    return jnp.isin(_arr(x), _arr(test_x), invert=invert)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    if hasattr(q, "data"):
+        q = _arr(q)
+    return jnp.nanquantile(_arr(x), q, axis=axis, keepdims=keepdim,
+                           method=interpolation)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0):
+    a = jnp.ravel(_arr(input)).astype(jnp.float32)
+    lo, hi = float(min), float(max)
+    if lo == 0.0 and hi == 0.0:
+        lo, hi = a.min(), a.max()
+        same = lo == hi
+        lo, hi = jnp.where(same, lo - 1.0, lo), jnp.where(same, hi + 1.0, hi)
+    return jnp.linspace(lo, hi, int(bins) + 1)
+
+
+def multiplex(inputs, index):
+    """Row-wise select across a list of tensors by per-row index
+    (reference multiplex op)."""
+    stacked = jnp.stack([_arr(t) for t in inputs], 0)   # [K, B, ...]
+    idx = jnp.reshape(_arr(index), (-1,))
+    return jnp.take_along_axis(
+        stacked, idx[None, :].reshape((1, -1) + (1,) * (stacked.ndim - 2)),
+        axis=0)[0]
+
+
+def reduce_as(x, target):
+    """Sum-reduce x to target's shape (reference reduce_as)."""
+    a, t = _arr(x), _arr(target)
+    if a.shape == t.shape:
+        return a
+    # right-align shapes; sum axes where target dim is 1 or absent
+    extra = a.ndim - t.ndim
+    axes = list(range(extra))
+    for i, td in enumerate(t.shape):
+        if td == 1 and a.shape[extra + i] != 1:
+            axes.append(extra + i)
+    out = jnp.sum(a, axis=tuple(axes), keepdims=False)
+    return out.reshape(t.shape)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Map global ids to shard-local ids (reference shard_index op — the
+    vocab-parallel embedding helper)."""
+    a = _arr(input)
+    size = (int(index_num) + int(nshards) - 1) // int(nshards)
+    lo = size * int(shard_id)
+    in_shard = (a >= lo) & (a < lo + size)
+    return jnp.where(in_shard, a - lo, ignore_value)
+
+
+def add_n(inputs):
+    if hasattr(inputs, "data"):
+        return _arr(inputs)
+    out = _arr(inputs[0])
+    for t in inputs[1:]:
+        out = out + _arr(t)
+    return out
+
+
+def sgn(x):
+    """Sign for real, unit phasor for complex (reference sgn)."""
+    a = _arr(x)
+    if jnp.issubdtype(a.dtype, jnp.complexfloating):
+        mag = jnp.abs(a)
+        return jnp.where(mag == 0, 0.0 + 0.0j, a / jnp.where(mag == 0, 1.0, mag))
+    return jnp.sign(a)
+
+
+def signbit(x):
+    return jnp.signbit(_arr(x))
+
+
+def frexp(x):
+    m, e = jnp.frexp(_arr(x))
+    return m, e
+
+
+def polar(abs, angle):
+    """Construct complex from magnitude+phase (reference polar)."""
+    r, t = _arr(abs), _arr(angle)
+    return jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t))
+
+
+def vecdot(x, y, axis=-1):
+    a, b = _arr(x), _arr(y)
+    if jnp.issubdtype(a.dtype, jnp.complexfloating):
+        a = jnp.conj(a)
+    return jnp.sum(a * b, axis=axis)
+
+
+def positive(x):
+    a = _arr(x)
+    if a.dtype == jnp.bool_:
+        raise TypeError("positive does not support bool tensors")
+    return a
+
+
+def combinations(x, r=2, with_replacement=False):
+    """All r-combinations of a 1-D tensor (reference combinations)."""
+    import itertools
+    a = _arr(x)
+    n = a.shape[0]
+    gen = (itertools.combinations_with_replacement if with_replacement
+           else itertools.combinations)
+    idx = list(gen(range(n), int(r)))
+    if not idx:
+        return jnp.zeros((0, int(r)), a.dtype)
+    return a[jnp.asarray(idx, jnp.int32)]
+
+
+def cartesian_prod(x):
+    """Cartesian product of 1-D tensors (reference cartesian_prod)."""
+    arrs = [_arr(t) for t in x]
+    if len(arrs) == 1:
+        return arrs[0]
+    grids = jnp.meshgrid(*arrs, indexing="ij")
+    return jnp.stack([g.ravel() for g in grids], axis=-1)

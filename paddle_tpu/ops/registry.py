@@ -101,8 +101,9 @@ def load_registry():
                 for alias in info.aliases:
                     setattr(Tensor, alias, _make_method(fn))
             if info.inplace:
-                setattr(Tensor, name + "_", _make_inplace_method(fn))
-                namespace[name + "_"] = getattr(Tensor, name + "_")
+                for nm in [name] + list(info.aliases):
+                    setattr(Tensor, nm + "_", _make_inplace_method(fn))
+                    namespace[nm + "_"] = getattr(Tensor, nm + "_")
     _attach_dunders(namespace)
     return namespace
 
