@@ -1,4 +1,4 @@
 """paddle.autograd surface (reference: python/paddle/autograd/)."""
 from ..core.autograd import backward, grad, no_grad, enable_grad
-from .py_layer import PyLayer, PyLayerContext
+from .py_layer import PyLayer, PyLayerContext, saved_tensors_hooks
 from .functional import jacobian, hessian, vjp, jvp

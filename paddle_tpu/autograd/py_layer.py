@@ -11,15 +11,51 @@ from ..core import autograd as ag
 from ..core.autograd import GradNode
 
 
+class _SavedTensorsHooks:
+    """Active (pack, unpack) pair for saved_tensors_hooks."""
+    pack = None
+    unpack = None
+
+
+class saved_tensors_hooks:
+    """Intercept tensors saved for backward (reference
+    autograd.saved_tensors_hooks): pack runs at save time (e.g. offload to
+    host / cast down), unpack at first backward use. Applies to PyLayer
+    save_for_backward; tape residuals from jax.vjp are managed by XLA and
+    never surface as framework tensors."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        self._prev = (_SavedTensorsHooks.pack, _SavedTensorsHooks.unpack)
+        _SavedTensorsHooks.pack = self.pack_hook
+        _SavedTensorsHooks.unpack = self.unpack_hook
+        return self
+
+    def __exit__(self, *exc):
+        _SavedTensorsHooks.pack, _SavedTensorsHooks.unpack = self._prev
+        return False
+
+
 class PyLayerContext:
     def __init__(self):
         self._saved = []
+        self._packed = False
         self.not_inplace_tensors = ()
 
     def save_for_backward(self, *tensors):
-        self._saved = list(tensors)
+        if _SavedTensorsHooks.pack is not None:
+            self._saved = [_SavedTensorsHooks.pack(t) for t in tensors]
+            self._packed = True
+            self._unpack = _SavedTensorsHooks.unpack
+        else:
+            self._saved = list(tensors)
 
     def saved_tensor(self):
+        if self._packed and self._unpack is not None:
+            return [self._unpack(t) for t in self._saved]
         return list(self._saved)
 
 

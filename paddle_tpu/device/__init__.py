@@ -102,3 +102,152 @@ class _DeviceNS:
 
 tpu = _DeviceNS()
 cuda = _DeviceNS()  # source-compat shim: code written for paddle.device.cuda
+
+
+# -- source-compat surface (reference python/paddle/device/__init__.py) ----
+def get_cudnn_version():
+    """None: no cuDNN in this stack (XLA owns conv algorithms)."""
+    return None
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    """False: paddle's CINN is absent by design — XLA fills its role
+    (SURVEY.md §2.6 note). Code gating on this flag expects CINN-specific
+    build_strategy knobs, which don't exist here."""
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def is_compiled_with_custom_device(device_type=None):
+    """PJRT is the plugin ABI; 'tpu' is the built-in custom device."""
+    return device_type in (None, "tpu")
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return [p for p in get_all_device_type() if p not in ("cpu", "gpu")]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device()
+            if not d.startswith(("cpu", "gpu"))]
+
+
+class XPUPlace(Place):
+    def __init__(self, idx=0):
+        super().__init__("tpu", idx)
+
+
+class IPUPlace(Place):
+    def __init__(self, idx=0):
+        super().__init__("tpu", idx)
+
+
+class Stream:
+    """Stream handle (reference paddle.device.Stream). PJRT serializes
+    per-device execution on internal streams; this object keeps the API and
+    ordering semantics (record/wait are barriers)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        synchronize()
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+
+class Event:
+    """Event handle (reference paddle.device.Event): record captures a point
+    in the dispatch order; synchronize blocks until prior work completes."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self.device = device
+        self._t = None
+
+    def record(self, stream=None):
+        import time as _time
+        jax.effects_barrier()
+        self._t = _time.perf_counter()
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        jax.effects_barrier()
+
+    def elapsed_time(self, end_event):
+        if self._t is None or end_event._t is None:
+            return 0.0
+        return (end_event._t - self._t) * 1000.0
+
+
+_current_stream = {}
+
+
+def current_stream(device=None):
+    key = str(device)
+    if key not in _current_stream:
+        _current_stream[key] = Stream(device)
+    return _current_stream[key]
+
+
+def set_stream(stream):
+    _current_stream[str(stream.device)] = stream
+    return stream
+
+
+class stream_guard:
+    """Context manager pinning a stream (reference stream_guard)."""
+
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        self._prev = current_stream(self.stream.device)
+        set_stream(self.stream)
+        return self.stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False
+
+
+def synchronize(device=None):
+    jax.effects_barrier()

@@ -184,11 +184,13 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
         init = getattr(attr, "initializer", None)
     if init is None:
         init = I.Constant(0.0) if is_bias else I.XavierNormal()
-    data = jnp.zeros(tuple(int(s) for s in shape), convert_dtype(dtype))
-    p = Parameter(data, trainable=True, name=name)
-    if not LazyGuard._active:
-        init(p)
-    return p
+    shape = tuple(int(s) for s in shape)
+    d = convert_dtype(dtype)
+    if LazyGuard._active:
+        data = jnp.zeros(shape, d)
+    else:
+        data = init(shape, d)
+    return Parameter(data, trainable=True, name=name)
 
 
 def tolist(x):

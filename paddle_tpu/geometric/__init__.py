@@ -157,3 +157,59 @@ def reindex_graph(x, neighbors, count):
     return (to_tensor(reindexed),
             to_tensor(np.asarray(order, np.int64)),
             to_tensor(np.asarray(np.arange(len(x_np)), np.int64)))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False):
+    """Weighted neighbor sampling (reference
+    geometric/sampling/neighbors.py weighted variant): neighbors drawn
+    without replacement, probability proportional to edge weight."""
+    row_np = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    col_np = np.asarray(colptr.numpy() if isinstance(colptr, Tensor) else colptr)
+    w_np = np.asarray(edge_weight.numpy() if isinstance(edge_weight, Tensor)
+                      else edge_weight).astype(np.float64)
+    nodes = np.asarray(input_nodes.numpy() if isinstance(input_nodes, Tensor)
+                       else input_nodes).reshape(-1)
+    eid_np = np.asarray(eids.numpy() if isinstance(eids, Tensor) else eids) \
+        if eids is not None else None
+    rng = np.random.default_rng()
+    out_nbr, out_cnt, out_eids = [], [], []
+    for n in nodes:
+        lo, hi = int(col_np[n]), int(col_np[n + 1])
+        cand = row_np[lo:hi]
+        wts = w_np[lo:hi]
+        k = len(cand) if sample_size < 0 else min(sample_size, len(cand))
+        if len(cand) == 0 or k == 0:
+            out_cnt.append(0)
+            continue
+        p = wts / wts.sum() if wts.sum() > 0 else None
+        sel = rng.choice(len(cand), size=k, replace=False, p=p)
+        out_nbr.append(cand[sel])
+        out_cnt.append(k)
+        if eid_np is not None:
+            out_eids.append(eid_np[lo:hi][sel])
+    nbrs = np.concatenate(out_nbr) if out_nbr else np.zeros((0,), row_np.dtype)
+    res = (Tensor(nbrs), Tensor(np.asarray(out_cnt, np.int32)))
+    if return_eids and eid_np is not None:
+        res = res + (Tensor(np.concatenate(out_eids) if out_eids
+                            else np.zeros((0,), eid_np.dtype)),)
+    return res
+
+
+def reindex_heter_graph(x, neighbors, count):
+    """reindex_graph over per-edge-type neighbor lists (reference
+    reindex_heter_graph): one shared node numbering, per-type edges."""
+    x_np = np.asarray(x.numpy() if isinstance(x, Tensor) else x).reshape(-1)
+    nbr_list = [np.asarray(n.numpy() if isinstance(n, Tensor) else n).reshape(-1)
+                for n in neighbors]
+    cat = np.concatenate([x_np] + nbr_list)
+    # paddle semantics: ids numbered by first appearance (x first)
+    first_idx = {v: i for i, v in enumerate(dict.fromkeys(cat.tolist()))}
+    remap = np.asarray([first_idx[v] for v in cat.tolist()], np.int64)
+    off = len(x_np)
+    outs = []
+    for n in nbr_list:
+        outs.append(Tensor(remap[off:off + len(n)]))
+        off += len(n)
+    order = np.asarray(list(dict.fromkeys(cat.tolist())), x_np.dtype)
+    return outs, Tensor(order)

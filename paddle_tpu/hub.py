@@ -1,0 +1,59 @@
+"""paddle.hub (reference: python/paddle/hub.py): load models from a local
+repo dir (github/gitee sources need egress, so only the 'local' source is
+live; remote sources raise with a clear message)."""
+import os
+import sys
+import importlib
+
+__all__ = ["list", "help", "load"]
+
+_HUB_CONF = "hubconf.py"
+
+
+def _load_entry_file(repo_dir):
+    conf = os.path.join(repo_dir, _HUB_CONF)
+    if not os.path.exists(conf):
+        raise FileNotFoundError(f"no {_HUB_CONF} in {repo_dir}")
+    sys.path.insert(0, repo_dir)
+    try:
+        spec = importlib.util.spec_from_file_location("hubconf", conf)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    finally:
+        sys.path.remove(repo_dir)
+
+
+def _check_source(source):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source '{source}' needs network egress; this environment "
+            "supports source='local' (a directory containing hubconf.py)")
+
+
+def list(repo_dir, source="local", force_reload=False):
+    """List callable entrypoints exposed by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_entry_file(repo_dir)
+    return [n for n, f in vars(mod).items()
+            if callable(f) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    """Docstring of one entrypoint."""
+    _check_source(source)
+    mod = _load_entry_file(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"model '{model}' not found in {repo_dir}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Instantiate an entrypoint."""
+    _check_source(source)
+    mod = _load_entry_file(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"model '{model}' not found in {repo_dir}")
+    return fn(**kwargs)

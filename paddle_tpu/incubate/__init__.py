@@ -6,3 +6,90 @@ from . import autograd  # noqa: F401
 from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+# reference-parity aliases: segment/graph ops + fused softmax-mask live at
+# paddle.incubate.* too (python/paddle/incubate/__init__.py)
+from ..geometric import (  # noqa: F401
+    segment_sum, segment_mean, segment_max, segment_min,
+    send_u_recv as graph_send_recv, reindex_graph as graph_reindex,
+    sample_neighbors as graph_sample_neighbors,
+)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       return_eids=False, **kw):
+    """Multi-hop sampling by chaining sample_neighbors (reference
+    graph_khop_sampler): returns (edge_src, edge_dst, sample_index,
+    reindex_nodes) — reindexed sampled subgraph."""
+    import numpy as np
+    from ..geometric import sample_neighbors
+    from ..core.tensor import Tensor
+    from .. import ops
+    nodes = input_nodes
+    srcs, dsts = [], []
+    for k in sample_sizes:
+        out, counts = sample_neighbors(row, colptr, nodes, sample_size=k)
+        # each sampled neighbor's dst is the node it was drawn for,
+        # repeated per-count
+        n_np = np.asarray(nodes.numpy() if isinstance(nodes, Tensor)
+                          else nodes).reshape(-1)
+        c_np = np.asarray(counts.numpy() if isinstance(counts, Tensor)
+                          else counts).reshape(-1)
+        dsts.append(Tensor(np.repeat(n_np, c_np)))
+        srcs.append(out)
+        nodes = out
+    edge_src = ops.concat(srcs)
+    edge_dst = ops.concat(dsts)
+    seeds = input_nodes if isinstance(input_nodes, Tensor) \
+        else Tensor(np.asarray(input_nodes))
+    (edge_src_r, edge_dst_r, sample_index), _ = _khop_reindex(
+        seeds, edge_src, edge_dst)
+    return edge_src_r, edge_dst_r, sample_index, seeds
+
+
+def _khop_reindex(seeds, edge_src, edge_dst):
+    import numpy as np
+    from ..core.tensor import Tensor
+    s = np.asarray(seeds.numpy()).reshape(-1)
+    es = np.asarray(edge_src.numpy()).reshape(-1)
+    ed = np.asarray(edge_dst.numpy()).reshape(-1)
+    order = list(dict.fromkeys(np.concatenate([s, es, ed]).tolist()))
+    remap = {v: i for i, v in enumerate(order)}
+    esr = np.asarray([remap[v] for v in es.tolist()], np.int64)
+    edr = np.asarray([remap[v] for v in ed.tolist()], np.int64)
+    sample_index = Tensor(np.asarray(order, s.dtype))
+    return (Tensor(esr), Tensor(edr), sample_index), None
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a value as a loss for IPU-style pipelines; on this stack it is
+    reduction only (reference incubate.identity_loss)."""
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("sum", 1):
+        return x.sum()
+    return x.mean()
+
+
+def softmax_mask_fuse(x, mask):
+    """softmax(x + mask) fused by XLA (reference fused_softmax_mask op)."""
+    from ..nn import functional as F
+    return F.softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax (reference fused_softmax_mask_upper_triangle):
+    masks strictly-upper triangle before softmax."""
+    import jax.numpy as jnp
+    from ..core.dispatch import apply_op
+    import jax
+
+    def impl(a):
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask, a, jnp.finfo(jnp.float32).min)
+        return jax.nn.softmax(logits.astype(jnp.float32), -1).astype(a.dtype)
+    return apply_op("softmax_mask_fuse_upper_triangle", impl, (x,), {})
+
+
+from . import inference  # noqa: F401

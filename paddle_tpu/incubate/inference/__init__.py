@@ -1,0 +1,2 @@
+"""paddle.incubate.inference (reference exposes inference utilities here)."""
+from ...inference import Config, Predictor, create_predictor  # noqa: F401

@@ -182,3 +182,111 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class DataType:
+    """IO dtype enum (reference paddle.inference.DataType)."""
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+    INT64 = "int64"
+    INT32 = "int32"
+    INT8 = "int8"
+    UINT8 = "uint8"
+    BOOL = "bool"
+
+
+class PlaceType:
+    """IO placement enum (reference paddle.inference.PlaceType)."""
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+    TPU = 3
+
+
+Tensor = _IOHandle  # reference exposes the IO handle type as inference.Tensor
+
+
+class PredictorPool:
+    """Fixed-size predictor pool (reference PredictorPool): each entry is a
+    clone sharing the compiled executables."""
+
+    def __init__(self, config, size=1):
+        self._preds = [Predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx):
+        return self._preds[idx]
+
+
+class XpuConfig:
+    """Accelerator sub-config placeholder (reference XpuConfig); TPU memory
+    is managed by PJRT so fields are recorded but not enforced."""
+
+    def __init__(self):
+        self.device_id = 0
+        self.l3_size = 0
+
+
+def get_version():
+    from .. import __version__
+    return __version__
+
+
+def get_num_bytes_of_data_type(dtype):
+    import numpy as np
+    return np.dtype({"bfloat16": "uint16"}.get(dtype, dtype)).itemsize
+
+
+def get_trt_compile_version():
+    """No TensorRT on TPU — the XLA compiler fills that role."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision,
+                               backend=None, keep_io_types=True,
+                               black_list=None):
+    """Re-save a jit.save artifact with params cast to the target precision
+    (reference convert_to_mixed_precision pass)."""
+    import pickle
+    import numpy as np
+    import ml_dtypes
+    import os
+    if isinstance(mixed_precision, str):
+        key = mixed_precision.lower()
+    else:  # PrecisionType enum/string constants
+        key = str(mixed_precision).lower()
+    target = {"float16": np.float16, "half": np.float16,
+              "precisiontype.half": np.float16,
+              "bfloat16": ml_dtypes.bfloat16}.get(key, ml_dtypes.bfloat16)
+    with open(params_file, "rb") as f:
+        state = pickle.load(f)
+
+    def cast(v):
+        a = np.asarray(v)
+        return a.astype(target) if a.dtype == np.float32 else a
+    state = {k: cast(v) for k, v in state.items()}
+    os.makedirs(os.path.dirname(mixed_params_file) or ".", exist_ok=True)
+    with open(mixed_params_file, "wb") as f:
+        pickle.dump(state, f)
+    if os.path.exists(model_file) and model_file != mixed_model_file:
+        import shutil
+        shutil.copy(model_file, mixed_model_file)
+
+
+def _get_phi_kernel_name(op_name):
+    """Kernel-name mapping probe (reference _get_phi_kernel_name); ops here
+    map 1:1 to registry names."""
+    return op_name
+
+
+__all__ += ["DataType", "PlaceType", "Tensor", "PredictorPool", "XpuConfig",
+            "get_version", "get_num_bytes_of_data_type",
+            "get_trt_compile_version", "get_trt_runtime_version",
+            "convert_to_mixed_precision", "_get_phi_kernel_name"]

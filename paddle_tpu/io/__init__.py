@@ -1,6 +1,7 @@
 """paddle.io surface (reference: python/paddle/io/)."""
 from .dataset import (Dataset, IterableDataset, TensorDataset, ConcatDataset,
-                      ChainDataset, Subset, random_split)
+                      ChainDataset, Subset, random_split, ComposeDataset,
+                      get_worker_info)
 from .sampler import (Sampler, SequenceSampler, RandomSampler,
                       SubsetRandomSampler, WeightedRandomSampler, BatchSampler,
                       DistributedBatchSampler)

@@ -41,6 +41,8 @@ class _SpawnUnavailable(Exception):
 def _worker_loop(dataset, index_queue, data_queue, collate, init_fn, wid):
     """Process-worker loop (reference: io/dataloader/worker.py — fetch
     sample indices, collate, ship the batch back over the queue)."""
+    from . import dataset as _ds
+    _ds._worker_info = _ds._WorkerInfo(wid, -1, dataset)
     if init_fn is not None:
         init_fn(wid)
     while True:

@@ -91,3 +91,40 @@ def random_split(dataset, lengths, generator=None):
         out.append(Subset(dataset, perm[offset:offset + l].tolist()))
         offset += l
     return out
+
+
+class ComposeDataset(Dataset):
+    """Zip map-style datasets: sample i concatenates the fields of each
+    dataset's sample i (reference ComposeDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert self.datasets, "ComposeDataset needs at least one dataset"
+        self._len = min(len(d) for d in self.datasets)
+
+    def __len__(self):
+        return self._len
+
+    def __getitem__(self, idx):
+        sample = []
+        for d in self.datasets:
+            item = d[idx]
+            sample.extend(item if isinstance(item, (tuple, list)) else [item])
+        return tuple(sample)
+
+
+class _WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a DataLoader worker: (id, num_workers, dataset); None in the
+    main process (reference get_worker_info)."""
+    return _worker_info

@@ -11,9 +11,13 @@ single compiled XLA program, and `loss.backward()` flows through it via the
 same jax.vjp mechanism every op uses (so eager code around compiled regions
 keeps working, the moral equivalent of the reference's graph-break fallback).
 """
-from .api import to_static, not_to_static, TracedLayer
+from .api import (to_static, not_to_static, TracedLayer, ignore_module,
+                  enable_to_static, set_code_level, set_verbosity)
 from .functional import state_arrays, functional_call, pure_call
 from .io import save, load
+from .io import LoadedProgram as TranslatedLayer
 
 __all__ = ["to_static", "not_to_static", "save", "load", "state_arrays",
-           "functional_call", "pure_call", "TracedLayer"]
+           "functional_call", "pure_call", "TracedLayer", "ignore_module",
+           "enable_to_static", "set_code_level", "set_verbosity",
+           "TranslatedLayer"]

@@ -137,6 +137,8 @@ class StaticFunction:
         return impl, out_box, call_tensors
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            return self._fn(*args, **kwargs)
         import jax.errors as _jerr
         try:
             impl, out_box, call_tensors = self._prepare(args, kwargs)
@@ -200,3 +202,39 @@ class TracedLayer:
 
     def __call__(self, *args):
         return self._static_fn(*args)
+
+
+# -- source-compat helpers (reference: python/paddle/jit/api.py,
+#    sot/utils/envs.py logging knobs) --------------------------------------
+_ignored_modules = set()
+_to_static_enabled = True
+
+
+def ignore_module(modules):
+    """Never convert functions from these modules in dy2static (reference
+    jit.ignore_module)."""
+    if not isinstance(modules, (list, tuple, set)):
+        modules = [modules]
+    for m in modules:
+        _ignored_modules.add(getattr(m, "__name__", str(m)))
+
+
+def enable_to_static(flag):
+    """Globally toggle to_static conversion (reference enable_to_static):
+    when off, to_static-wrapped callables run eagerly."""
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Dump transformed code at the given verbosity (reference
+    jit.set_code_level); wires to the dy2static transformer's debug flag."""
+    from . import dy2static
+    dy2static._code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """dy2static logging verbosity (reference jit.set_verbosity)."""
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level > 0 else logging.WARNING)

@@ -27,3 +27,11 @@ from .transformer import convert_to_static, convert_callable
 __all__ = ["convert_ifelse", "convert_while_loop", "convert_logical_and",
            "convert_logical_or", "convert_logical_not", "convert_len",
            "convert_to_static", "convert_callable", "set_max_loop_iters"]
+
+
+_code_level = 0
+
+
+def dy2static_code_level():
+    """Read the jit.set_code_level knob (0 = silent)."""
+    return _code_level

@@ -360,6 +360,9 @@ def convert_to_static(fn):
     """Rewrite `fn`'s source so tensor control flow lowers to lax ops;
     returns the rewritten function (reference: program_translator's AST
     path). Closures are carried over via the rebuilt function's closure."""
+    from ..api import _ignored_modules
+    if getattr(fn, "__module__", None) in _ignored_modules:
+        return fn  # user opted this module out via jit.ignore_module
     try:
         source = textwrap.dedent(inspect.getsource(fn))
     except (OSError, TypeError):
@@ -371,6 +374,10 @@ def convert_to_static(fn):
     fdef.decorator_list = []  # strip @to_static-style decorators
     _ControlFlowTransformer().visit(tree)
     ast.fix_missing_locations(tree)
+    from . import dy2static_code_level
+    if dy2static_code_level() > 0:
+        print(f"# dy2static transformed: {fn.__qualname__}\n"
+              + ast.unparse(tree))
 
     from . import convert_operators as _ops_mod
     glb = dict(fn.__globals__)

@@ -6,6 +6,9 @@ from .ops import (  # noqa: F401
     det, slogdet, cholesky, cholesky_solve, qr, svd, eig, eigh, eigvals,
     eigvalsh, solve, triangular_solve, lstsq, lu, kron, corrcoef, cov,
     histogram, bincount,
+    cholesky_inverse, cond, svdvals, matrix_exp, householder_product,
+    ormqr, lu_unpack, pca_lowrank, svd_lowrank, vecdot, matrix_transpose,
+    diagonal,
 )
 
 inv = inverse
@@ -20,3 +23,36 @@ def _multi_dot(tensors):
 
 
 multi_dot = _multi_dot
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="bfloat16", activation_type=None):
+    """FP8xFP8 -> half GEMM (reference fusion/fp8_gemm cutlass kernels).
+    TPU path: cast to float8_e4m3fn storage, accumulate on the MXU, emit
+    bf16/fp16 — XLA lowers float8 dot natively on hardware that has it.
+    """
+    import jax.numpy as jnp
+    import ml_dtypes
+    from .core.dispatch import apply_op
+
+    def impl(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        a8 = a.astype(ml_dtypes.float8_e4m3fn)
+        b8 = b.astype(ml_dtypes.float8_e4m3fn)
+        out = jnp.matmul(a8, b8, preferred_element_type=jnp.float32) * scale
+        if rest:
+            out = out + rest[0]
+        if activation_type in ("gelu",):
+            import jax
+            out = jax.nn.gelu(out)
+        elif activation_type in ("relu",):
+            out = jnp.maximum(out, 0)
+        from .core.dtypes import convert_dtype
+        return out.astype(convert_dtype(output_dtype))
+
+    args = (x, y) if bias is None else (x, y, bias)
+    return apply_op("fp8_fp8_half_gemm_fused", impl, args, {})

@@ -35,4 +35,18 @@ from .layers.transformer import (
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,
 )
-from .layers.rnn import LSTM, GRU, SimpleRNN, LSTMCell, GRUCell
+from .layers.rnn import (LSTM, GRU, SimpleRNN, LSTMCell, GRUCell,
+                         RNNCellBase, SimpleRNNCell, RNN, BiRNN)
+from .layers.conv import Conv1DTranspose, Conv3DTranspose
+from .layers.decode import BeamSearchDecoder, dynamic_decode
+from .layers.extra_layers import (
+    Silu, Softmax2D, ChannelShuffle, Unflatten, FeatureAlphaDropout,
+    ParameterDict, ZeroPad1D, ZeroPad3D,
+    AdaptiveAvgPool3D, AdaptiveMaxPool1D, AdaptiveMaxPool3D,
+    MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
+    FractionalMaxPool2D, FractionalMaxPool3D, LPPool1D, LPPool2D,
+    PoissonNLLLoss, SoftMarginLoss, MultiLabelSoftMarginLoss,
+    MultiMarginLoss, HingeEmbeddingLoss, GaussianNLLLoss,
+    TripletMarginWithDistanceLoss, RNNTLoss, HSigmoidLoss,
+    AdaptiveLogSoftmaxWithLoss,
+)

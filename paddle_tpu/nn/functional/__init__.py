@@ -4,6 +4,7 @@ from .activation import (
     hardtanh, hardshrink, softshrink, tanhshrink, thresholded_relu, leaky_relu,
     elu, selu, celu, mish, softplus, softsign, tanh, softmax, log_softmax,
     log_sigmoid, glu, prelu, maxout, rrelu,
+    elu_, hardtanh_, leaky_relu_, softmax_, tanh_, thresholded_relu_,
 )
 from .common import (
     linear, dropout, dropout2d, dropout3d, alpha_dropout, embedding, one_hot,
@@ -14,10 +15,14 @@ from .conv import (conv1d, conv2d, conv3d, conv1d_transpose,
                    conv2d_transpose, conv3d_transpose)
 from .extra import (bilinear, pdist, feature_alpha_dropout, channel_shuffle,
                     affine_grid, grid_sample, fold, sequence_mask,
-                    temporal_shift, gumbel_softmax, npair_loss, ctc_loss)
+                    temporal_shift, gumbel_softmax, npair_loss, ctc_loss,
+                    gather_tree, class_center_sample, zeropad2d)
 from .pooling import (
     max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_max_pool2d,
+    adaptive_avg_pool3d, adaptive_max_pool1d, adaptive_max_pool3d,
+    lp_pool1d, lp_pool2d, max_unpool1d, max_unpool2d, max_unpool3d,
+    fractional_max_pool2d, fractional_max_pool3d,
 )
 from .norm import (
     batch_norm, layer_norm, rms_norm, group_norm, instance_norm,
@@ -29,10 +34,15 @@ from .loss import (
     binary_cross_entropy_with_logits, kl_div, margin_ranking_loss,
     hinge_embedding_loss, cosine_embedding_loss, triplet_margin_loss,
     sigmoid_focal_loss, log_loss, square_error_cost,
+    dice_loss, soft_margin_loss, multi_label_soft_margin_loss,
+    multi_margin_loss, poisson_nll_loss, gaussian_nll_loss,
+    triplet_margin_with_distance_loss, hsigmoid_loss, margin_cross_entropy,
+    rnnt_loss, adaptive_log_softmax_with_loss,
 )
 from .attention import (
     flash_attention, scaled_dot_product_attention, flashmask_attention,
-    flash_attn_unpadded,
+    flash_attn_unpadded, flash_attn_qkvpacked, flash_attn_varlen_qkvpacked,
+    sparse_attention,
 )
 from .rope import (
     rotary_embedding_cos_sin, apply_rotary_pos_emb,

@@ -169,3 +169,21 @@ def rrelu(x, lower=0.125, upper=0.3333333, training=True):
         return _op("rrelu", impl, x)
     mid = (lower + upper) / 2.0
     return leaky_relu(x, mid)
+
+
+def _inplace(base):
+    def fn(x, *args, **kwargs):
+        out = base(x, *args, **kwargs)
+        x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+        x.stop_gradient = out.stop_gradient and x.stop_gradient
+        return x
+    fn.__name__ = base.__name__ + "_"
+    return fn
+
+
+elu_ = _inplace(elu)
+hardtanh_ = _inplace(hardtanh)
+leaky_relu_ = _inplace(leaky_relu)
+softmax_ = _inplace(softmax)
+tanh_ = _inplace(tanh)
+thresholded_relu_ = _inplace(thresholded_relu)

@@ -158,3 +158,70 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     out = apply_op("flash_attn_unpadded", impl,
                    (query, key, value, cu_seqlens_q, cu_seqlens_k), {})
     return out, None
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         fixed_seed_offset=None, training=True):
+    """Packed-QKV flash attention (reference flash_attn_qkvpacked):
+    qkv [B, S, 3 + 2*(G-1)... ] — paddle layout [B, S, 3, H, D] for MHA;
+    unpacks and dispatches to flash_attention."""
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                                max_seqlen_k, scale=None, dropout=0.0,
+                                causal=False, return_softmax=False,
+                                training=True):
+    """Packed var-len form (reference flash_attn_varlen_qkvpacked):
+    qkv [total_tokens, 3, H, D]."""
+    q = qkv[:, 0]
+    k = qkv[:, 1]
+    v = qkv[:, 2]
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale=scale,
+                               dropout=dropout, causal=causal,
+                               return_softmax=return_softmax,
+                               training=training)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None):
+    """Block/CSR-sparse attention (reference sparse_attention op): per-row
+    allowed key columns given in CSR form. TPU-native path: scatter the CSR
+    pattern into a dense boolean mask and run the fused softmax path — XLA
+    handles the [S, S] mask well below ~16k; beyond that use
+    flashmask_attention (interval masks) which the Pallas tier consumes
+    directly."""
+    def impl(q, k, v, off, cols, *masks):
+        b, h, s, d = q.shape
+        # build mask by scattering: for each row r, cols[off[r]:off[r+1]]
+        dense = jnp.zeros((b, h, s, s), bool)
+        offs = off.reshape(b, h, s + 1)
+        colv = cols.reshape(b, h, -1)
+        pos = jnp.arange(colv.shape[-1])
+        # row of entry i = #rows whose end-offset is <= i
+        rows = (pos[None, None, :, None]
+                >= offs[:, :, None, 1:]).sum(-1)      # [B,H,nnz]
+        valid = pos[None, None] < offs[..., -1:]
+        bidx = jnp.arange(b)[:, None, None]
+        hidx = jnp.arange(h)[None, :, None]
+        # padding entries are pointed out of bounds and dropped — writing
+        # False at a clamped (0,0) could clobber a real allowed pair
+        dense = dense.at[bidx, hidx,
+                         jnp.where(valid, rows, s),
+                         jnp.where(valid, colv, s)].set(True, mode="drop")
+        import math as _m
+        logits = jnp.einsum("bhsd,bhtd->bhst", q, k) / _m.sqrt(d)
+        if masks and masks[0] is not None:
+            logits = logits + masks[0]
+        logits = jnp.where(dense, logits, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+        return jnp.einsum("bhst,bhtd->bhsd", probs, v)
+    args = (query, key, value, sparse_csr_offset, sparse_csr_columns)
+    if attn_mask is not None:
+        args = args + (attn_mask,)
+    return apply_op("sparse_attention", impl, args, {})

@@ -310,3 +310,61 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     return apply_op("ctc_loss", impl,
                     (log_probs, labels, input_lengths, label_lengths), {})
+
+
+def gather_tree(ids, parents):
+    """Beam-search ancestry backtrace (reference gather_tree op): walk from
+    the last step back through parent pointers, emitting full sequences.
+    ids/parents: [T, B, beam]. Reverse lax.scan — no host loop."""
+    def impl(idv, par):
+        t, b, k = idv.shape
+        last_beams = jnp.broadcast_to(jnp.arange(k), (b, k))
+
+        def back(beams, xs):
+            step_ids, step_parents = xs
+            tok = jnp.take_along_axis(step_ids, beams, axis=-1)
+            prev = jnp.take_along_axis(step_parents, beams, axis=-1)
+            return prev, tok
+
+        _, toks = jax.lax.scan(back, last_beams, (idv, par), reverse=True)
+        return toks
+    return apply_op("gather_tree", impl, (ids, parents), {},
+                    differentiable=False)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Partial-FC class-center sampling (reference class_center_sample op):
+    keep all positive classes, pad with negative classes up to num_samples;
+    returns (remapped_label, sampled_class_indices). Static-shape TPU
+    design: the sampled set is always exactly num_samples long (padded with
+    extra negatives), so downstream matmuls have fixed shapes."""
+    import numpy as np
+    from ...core import random as _rng
+
+    def impl(y):
+        flat = y.reshape(-1)
+        pos = jnp.zeros((num_classes,), bool).at[flat].set(True)
+        # rank classes: positives first (stable), then shuffled negatives
+        noise = jax.random.uniform(_rng.next_key(), (num_classes,))
+        keyv = jnp.where(pos, -1.0, noise)
+        order = jnp.argsort(keyv)                    # positives lead
+        sampled = order[:num_samples]
+        # remap: class c -> its position in `sampled` (positives guaranteed in)
+        inv = jnp.full((num_classes,), -1, jnp.int32)
+        inv = inv.at[sampled].set(jnp.arange(num_samples, dtype=jnp.int32))
+        return inv[flat].reshape(y.shape), sampled
+    return apply_op("class_center_sample", impl, (label,), {},
+                    differentiable=False)
+
+
+def zeropad2d(x, padding, data_format="NCHW"):
+    """Zero-pad H/W (reference zeropad2d): padding = [left, right, top,
+    bottom]."""
+    l, r, t, b = (padding if not hasattr(padding, "tolist")
+                  else padding.tolist())
+
+    def impl(a):
+        if data_format == "NCHW":
+            return jnp.pad(a, [(0, 0), (0, 0), (t, b), (l, r)])
+        return jnp.pad(a, [(0, 0), (t, b), (l, r), (0, 0)])
+    return apply_op("zeropad2d", impl, (x,), {})

@@ -262,3 +262,248 @@ def square_error_cost(input, label):
     def impl(a, b):
         return (a - b) ** 2
     return apply_op("square_error_cost", impl, (input, label), {})
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """1 - 2*|X∩Y| / (|X|+|Y|) over the last (class-prob) dim (reference
+    dice_loss)."""
+    def impl(p, y):
+        yoh = jax.nn.one_hot(jnp.squeeze(y, -1), p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * yoh, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(yoh, axis=red)
+        return jnp.mean(1.0 - 2.0 * inter / (union + epsilon))
+    return apply_op("dice_loss", impl, (input, label), {})
+
+
+def soft_margin_loss(input, label, reduction="mean"):
+    def impl(z, y):
+        return _reduce(jnp.log1p(jnp.exp(-y.astype(z.dtype) * z)), reduction)
+    return apply_op("soft_margin_loss", impl, (input, label), {})
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean"):
+    def impl(z, y, *w):
+        loss = -(y * jax.nn.log_sigmoid(z)
+                 + (1 - y) * jax.nn.log_sigmoid(-z))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss.mean(-1), reduction)
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply_op("multi_label_soft_margin_loss", impl, args, {})
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    def impl(z, y, *w):
+        n, c = z.shape
+        gold = jnp.take_along_axis(z, y[:, None], axis=1)
+        m = jnp.maximum(margin - gold + z, 0.0) ** p
+        if w:
+            m = m * w[0][y][:, None]
+        m = m.at[jnp.arange(n), y].set(0.0)
+        return _reduce(m.sum(-1) / c, reduction)
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply_op("multi_margin_loss", impl, args, {})
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean"):
+    def impl(z, y):
+        if log_input:
+            loss = jnp.exp(z) - y * z
+        else:
+            loss = z - y * jnp.log(z + epsilon)
+        if full:
+            stirling = y * jnp.log(y + (y <= 1)) - y + \
+                0.5 * jnp.log(2 * jnp.pi * jnp.maximum(y, 1.0))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply_op("poisson_nll_loss", impl, (input, label), {})
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    def impl(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(loss, reduction)
+    return apply_op("gaussian_nll_loss", impl, (input, label, variance), {})
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean"):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    d_ap = distance_function(input, positive)
+    d_an = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        from ... import ops as _ops
+        d_an = _ops.minimum(d_an, d_pn)
+    from ... import ops as _ops
+    loss = (d_ap - d_an + margin).clip(min=0.0)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False):
+    """Hierarchical sigmoid over the default complete binary tree (reference
+    hsigmoid_loss / phi hsigmoid kernels). Each class's root-to-leaf path is
+    decoded from its index; loss = -sum log sigmoid(code * (w·x + b))."""
+    import numpy as np
+
+    def impl(x, y, w, *rest):
+        b = rest[0] if rest else None
+        if path_table is not None:
+            raise NotImplementedError(
+                "custom-tree hsigmoid: pass dense path tensors instead")
+        n_inner = int(num_classes) - 1  # inner nodes of a complete tree
+        depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+        # leaf id -> path of inner-node ids + left/right codes, computed by
+        # walking the class index through the heap layout (host-side ints)
+        codes = ((y[..., None] + n_inner + 1) //
+                 (2 ** jnp.arange(depth, 0, -1))) - 1   # ancestor heap ids
+        valid = codes >= 0
+        node = jnp.clip(codes, 0, n_inner - 1)
+        child = ((y[..., None] + n_inner + 1) //
+                 (2 ** (jnp.arange(depth, 0, -1) - 1)))
+        sign = jnp.where(child % 2 == 0, 1.0, -1.0)  # left child => code +1
+        logits = jnp.einsum("bd,bpd->bp", x, w[node])
+        if b is not None:
+            logits = logits + jnp.squeeze(b, -1)[node]
+        loss = -jax.nn.log_sigmoid(sign * logits)
+        return jnp.sum(jnp.where(valid, loss, 0.0), axis=-1, keepdims=True).mean()
+    args = (input, label, weight) if bias is None else (input, label, weight, bias)
+    return apply_op("hsigmoid_loss", impl, args, {})
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-class margin softmax (reference margin_cross_entropy op:
+    cos(m1*theta + m2) - m3 on the gold logit, then scaled CE). The model-
+    parallel variant shards classes over `group`'s mp axis via GSPMD instead
+    of the reference's c_softmax allreduce pair."""
+    def impl(z, y):
+        theta = jnp.arccos(jnp.clip(z, -1.0 + 1e-7, 1.0 - 1e-7))
+        gold = jnp.cos(margin1 * theta + margin2) - margin3
+        yoh = jax.nn.one_hot(y, z.shape[-1], dtype=z.dtype)
+        adj = jnp.where(yoh > 0, gold, z) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -jnp.sum(yoh * logp, axis=-1, keepdims=True)
+        if reduction == "mean":
+            loss = loss.mean()
+        elif reduction == "sum":
+            loss = loss.sum()
+        if return_softmax:
+            return loss, jnp.exp(logp)
+        return loss
+    return apply_op("margin_cross_entropy", impl, (logits, label), {})
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean"):
+    """RNN-Transducer loss via the log-space alpha recursion (Graves 2012),
+    scanned over T (reference rnnt_loss wraps warprnnt; here the DP is XLA
+    lax.scan — TPU-friendly, batched).
+
+    input: [B, T, U+1, V] log-probs (pre log_softmax accepted), label [B, U].
+    """
+    def impl(logits, y, t_len, u_len):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        b, tmax, up1, v = logp.shape
+        umax = up1 - 1
+        blank_lp = logp[..., blank]                       # [B, T, U+1]
+        ylp = jnp.take_along_axis(
+            logp[:, :, :umax, :],
+            jnp.broadcast_to(y[:, None, :, None], (b, tmax, umax, 1)),
+            axis=-1)[..., 0]                              # [B, T, U]
+        neg_inf = jnp.float32(-1e30)
+
+        def t_step(alpha_prev, xs):
+            blank_t, y_t, t = xs                          # [B,U+1], [B,U]
+            # alpha[t, u] = logaddexp(alpha[t-1, u] + blank[t-1, u],
+            #                         alpha[t, u-1] + y[t, u-1])
+            from_left = alpha_prev + blank_t              # emit blank: t-1 -> t
+            def u_step(carry, xs_u):
+                fl, yl = xs_u                             # [B], [B]
+                val = jnp.logaddexp(fl, carry + yl)
+                return val, val
+            first = from_left[:, 0]
+            _, rest = jax.lax.scan(
+                u_step, first,
+                (from_left[:, 1:].T, y_t.T))
+            alpha_t = jnp.concatenate([first[:, None], rest.T], axis=1)
+            return alpha_t, alpha_t
+
+        # alpha[0, u] = cumsum of label emissions at t=0
+        alpha0 = jnp.concatenate(
+            [jnp.zeros((b, 1), jnp.float32),
+             jnp.cumsum(ylp[:, 0, :], axis=-1)], axis=1)
+        ts = jnp.arange(1, tmax)
+        _, alphas = jax.lax.scan(
+            t_step, alpha0,
+            (blank_lp[:, :-1].transpose(1, 0, 2)[: tmax - 1],
+             ylp.transpose(1, 0, 2)[1:tmax] if tmax > 1 else
+             jnp.zeros((0, b, umax)), ts))
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, U+1]
+        # final: alpha[t_len-1, u_len] + blank[t_len-1, u_len]
+        tl = jnp.clip(t_len - 1, 0, tmax - 1)
+        ul = jnp.clip(u_len, 0, umax)
+        a_fin = alphas[tl, jnp.arange(b), ul]
+        lp_fin = blank_lp[jnp.arange(b), tl, ul]
+        nll = -(a_fin + lp_fin)
+        if reduction == "mean":
+            return nll.mean()
+        if reduction == "sum":
+            return nll.sum()
+        return nll
+    return apply_op("rnnt_loss", impl,
+                    (input, label, input_lengths, label_lengths), {})
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None):
+    """Adaptive softmax (Grave et al. 2017; reference
+    adaptive_log_softmax_with_loss): head = shortlist + one logit per tail
+    cluster; each tail cluster projects down then predicts within-cluster.
+    Returns (output=per-sample log-prob of the gold class, loss=-mean)."""
+    def impl(x, y, hw, *rest):
+        if head_bias is not None:
+            hb, tails = rest[0], rest[1:]
+        else:
+            hb, tails = None, rest
+        n_clusters = len(cutoffs)
+        shortlist = cutoffs[0] if n_clusters else hw.shape[1]
+        head_logits = x @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_lp = jax.nn.log_softmax(head_logits, axis=-1)
+        # gold in shortlist: direct lookup (clamped gather, masked later)
+        out = jnp.take_along_axis(
+            head_lp, jnp.clip(y, 0, shortlist - 1)[:, None], axis=1)[:, 0]
+        lo = shortlist
+        for ci in range(len(tails) // 2):
+            proj, w = tails[2 * ci], tails[2 * ci + 1]
+            hi = cutoffs[ci + 1] if ci + 1 < len(cutoffs) else lo + w.shape[1]
+            cluster_lp = jax.nn.log_softmax((x @ proj) @ w, axis=-1)
+            in_c = (y >= lo) & (y < hi)
+            rel = jnp.clip(y - lo, 0, w.shape[1] - 1)
+            val = head_lp[:, shortlist + ci] + \
+                jnp.take_along_axis(cluster_lp, rel[:, None], axis=1)[:, 0]
+            out = jnp.where(in_c, val, out)
+            lo = hi
+        return out, -out.mean()
+    tails_flat = [t for pair in tail_weights for t in pair]
+    args = (input, label, head_weight) + \
+        ((head_bias,) if head_bias is not None else ()) + tuple(tails_flat)
+    return apply_op("adaptive_log_softmax_with_loss", impl, args, {})

@@ -271,7 +271,34 @@ def adaptive_max_pool3d(x, output_size, return_mask=False,
                            for (w0, w1) in ws], axis=-1)
                 for (h0, h1) in hs], axis=-2)
             for (d0, d1) in ds], axis=-3)
-    return apply_op("adaptive_max_pool3d", impl, (x,), {})
+    out = apply_op("adaptive_max_pool3d", impl, (x,), {})
+    if return_mask:
+        def mask_impl(a):
+            n, c, d, h, w = a.shape
+            ds = [(int(np.floor(i * d / od)), int(np.ceil((i + 1) * d / od)))
+                  for i in range(od)]
+            hs = [(int(np.floor(i * h / oh)), int(np.ceil((i + 1) * h / oh)))
+                  for i in range(oh)]
+            ws = [(int(np.floor(j * w / ow)), int(np.ceil((j + 1) * w / ow)))
+                  for j in range(ow)]
+
+            def region_idx(d0, d1, h0, h1, w0, w1):
+                r = a[:, :, d0:d1, h0:h1, w0:w1].reshape(n, c, -1)
+                flat = jnp.argmax(r, axis=-1)
+                rd, rh, rw = d1 - d0, h1 - h0, w1 - w0
+                di = flat // (rh * rw) + d0
+                hi = (flat // rw) % rh + h0
+                wi = flat % rw + w0
+                return (di * h + hi) * w + wi
+            return jnp.stack([
+                jnp.stack([
+                    jnp.stack([region_idx(d0, d1, h0, h1, w0, w1)
+                               for (w0, w1) in ws], axis=-1)
+                    for (h0, h1) in hs], axis=-2)
+                for (d0, d1) in ds], axis=-3).astype(jnp.int32)
+        return out, apply_op("adaptive_max_pool3d_mask", mask_impl, (x,), {},
+                             differentiable=False)
+    return out
 
 
 def _lp_pool_nd(x, norm_type, kernel_size, stride, padding, ceil_mode,
@@ -383,7 +410,28 @@ def fractional_max_pool2d(x, output_size, kernel_size=None,
             jnp.stack([a[:, :, r:r + kh, cc:cc + kw].max(axis=(2, 3))
                        for cc in cs], axis=-1)
             for r in rs], axis=-2)
-    return apply_op("fractional_max_pool2d", impl, (x,), {})
+    out = apply_op("fractional_max_pool2d", impl, (x,), {})
+    if return_mask:
+        def mask_impl(a):
+            n, c, h, w = a.shape
+            kh = kernel_size if isinstance(kernel_size, int) else \
+                (kernel_size[0] if kernel_size else h // oh + 1)
+            kw = kernel_size if isinstance(kernel_size, int) else \
+                (kernel_size[1] if kernel_size else w // ow + 1)
+            u = float(random_u) if random_u is not None else 0.5
+            rs = _fractional_starts(h, oh, kh, u)
+            cs = _fractional_starts(w, ow, kw, u)
+
+            def region_idx(r, cc):
+                reg = a[:, :, r:r + kh, cc:cc + kw].reshape(n, c, -1)
+                flat = jnp.argmax(reg, axis=-1)
+                return (flat // kw + r) * w + (flat % kw + cc)
+            return jnp.stack([
+                jnp.stack([region_idx(r, cc) for cc in cs], axis=-1)
+                for r in rs], axis=-2).astype(jnp.int32)
+        return out, apply_op("fractional_max_pool2d_mask", mask_impl, (x,),
+                             {}, differentiable=False)
+    return out
 
 
 def fractional_max_pool3d(x, output_size, kernel_size=None,
@@ -408,4 +456,34 @@ def fractional_max_pool3d(x, output_size, kernel_size=None,
                            .max(axis=(2, 3, 4)) for cc in cs], axis=-1)
                 for r in rs], axis=-2)
             for dd in dsl], axis=-3)
-    return apply_op("fractional_max_pool3d", impl, (x,), {})
+    out = apply_op("fractional_max_pool3d", impl, (x,), {})
+    if return_mask:
+        def mask_impl(a):
+            n, c, d, h, w = a.shape
+            if kernel_size is None:
+                kd, kh, kw = d // od + 1, h // oh + 1, w // ow + 1
+            elif isinstance(kernel_size, int):
+                kd = kh = kw = kernel_size
+            else:
+                kd, kh, kw = kernel_size
+            u = float(random_u) if random_u is not None else 0.5
+            dsl = _fractional_starts(d, od, kd, u)
+            rs = _fractional_starts(h, oh, kh, u)
+            cs = _fractional_starts(w, ow, kw, u)
+
+            def region_idx(dd, r, cc):
+                reg = a[:, :, dd:dd + kd, r:r + kh, cc:cc + kw].reshape(
+                    n, c, -1)
+                flat = jnp.argmax(reg, axis=-1)
+                di = flat // (kh * kw) + dd
+                hi = (flat // kw) % kh + r
+                wi = flat % kw + cc
+                return (di * h + hi) * w + wi
+            return jnp.stack([
+                jnp.stack([
+                    jnp.stack([region_idx(dd, r, cc) for cc in cs], axis=-1)
+                    for r in rs], axis=-2)
+                for dd in dsl], axis=-3).astype(jnp.int32)
+        return out, apply_op("fractional_max_pool3d_mask", mask_impl, (x,),
+                             {}, differentiable=False)
+    return out

@@ -165,3 +165,83 @@ xavier_uniform_ = XavierUniform
 kaiming_normal_ = KaimingNormal
 kaiming_uniform_ = KaimingUniform
 set_global_initializer = None  # placeholder for parity; rarely used
+
+
+def calculate_gain(nonlinearity, param=None):
+    """Recommended init gain per nonlinearity (reference calculate_gain)."""
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3.0,
+        "relu": math.sqrt(2.0), "selu": 3.0 / 4.0,
+    }
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity in gains:
+        return gains[nonlinearity]
+    raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference
+    nn.initializer.Bilinear): weight[c_in, c_out, kh, kw] gets the bilinear
+    interpolation stencil."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        _, _, kh, kw = shape
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        og = np.ogrid[:kh, :kw]
+        filt = (1 - abs(og[0] / f_h - c_h)) * (1 - abs(og[1] / f_w - c_w))
+        w = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                w[i, j] = filt
+        import jax.numpy as jnp
+        return jnp.asarray(w, dtype)
+
+
+class Orthogonal(Initializer):
+    """Orthogonal init (reference nn.initializer.Orthogonal)."""
+
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        from ..core import random as _rng
+        import jax
+        import jax.numpy as jnp
+        rows = shape[0]
+        cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        flat = jax.random.normal(_rng.next_key(), (max(rows, cols),
+                                                   min(rows, cols)))
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference nn.initializer.Dirac)."""
+
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        import jax.numpy as jnp
+        w = np.zeros(shape, np.float32)
+        out_c, in_c = shape[0], shape[1]
+        per = out_c // self.groups
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(min(per, in_c)):
+                w[(g * per + i, i) + tuple(centers)] = 1.0
+        return jnp.asarray(w, dtype)

@@ -112,3 +112,59 @@ class Conv2DTranspose(Layer):
                                   self.padding, self.output_padding,
                                   self.dilation, self.groups,
                                   output_size, self.data_format)
+
+
+class Conv1DTranspose(Layer):
+    """Weight layout [in_channels, out_channels/groups, k] (paddle)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__()
+        self.in_channels, self.out_channels = in_channels, out_channels
+        self.kernel_size = _ntuple(kernel_size, 1)
+        self.stride, self.padding = stride, padding
+        self.output_padding, self.dilation, self.groups = \
+            output_padding, dilation, groups
+        self.data_format = data_format
+        fan_in = in_channels * self.kernel_size[0]
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, *self.kernel_size],
+            attr=weight_attr, default_initializer=I.KaimingUniform(fan_in=fan_in))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.dilation, self.groups, output_size,
+                                  self.data_format)
+
+
+class Conv3DTranspose(Layer):
+    """Weight layout [in_channels, out_channels/groups, kd, kh, kw]."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        self.in_channels, self.out_channels = in_channels, out_channels
+        self.kernel_size = _ntuple(kernel_size, 3)
+        self.stride, self.padding = stride, padding
+        self.output_padding, self.dilation, self.groups = \
+            output_padding, dilation, groups
+        self.data_format = data_format
+        fan_in = in_channels
+        for k in self.kernel_size:
+            fan_in *= k
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, *self.kernel_size],
+            attr=weight_attr, default_initializer=I.KaimingUniform(fan_in=fan_in))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.dilation, self.groups, output_size,
+                                  self.data_format)

@@ -1,0 +1,141 @@
+"""Seq2seq decoding (reference: python/paddle/nn/decode.py —
+BeamSearchDecoder + dynamic_decode). TPU-native notes: the per-step state is
+kept as stacked beam tensors [B, beam, ...] so every step is batched matmuls;
+the ancestry backtrace is F.gather_tree (a lax.scan)."""
+import numpy as np
+
+from ..layer import Layer
+from .. import functional as F
+
+
+class Decoder:
+    """Decoding protocol: initialize / step / finalize (reference Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over a cell (reference BeamSearchDecoder): expands each
+    batch item to `beam_size` hypotheses, scores with log-softmax of the
+    output layer, and keeps the top beams each step."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] by repeating each row (reference
+        helper of the same name)."""
+        from ... import ops
+        reps = [1] * (x.ndim + 1)
+        reps[1] = beam_size
+        return ops.tile(x.unsqueeze(1), reps).reshape([-1, *x.shape[1:]])
+
+    def _merge(self, x):
+        return x.reshape([-1, *x.shape[2:]])
+
+    def _split(self, x, batch):
+        return x.reshape([batch, self.beam_size, *x.shape[1:]])
+
+    def initialize(self, inits):
+        from ... import ops
+        cell_states = inits
+        some = cell_states[0] if isinstance(cell_states, (list, tuple)) \
+            else cell_states
+        batch = some.shape[0]
+        exp = lambda t: self.tile_beam_merge_with_batch(t, self.beam_size)
+        if isinstance(cell_states, (list, tuple)):
+            cell_states = type(cell_states)(exp(s) for s in cell_states)
+        else:
+            cell_states = exp(cell_states)
+        ids = ops.full([batch, self.beam_size], self.start_token,
+                       dtype="int64")
+        # only beam 0 is live initially (others at -inf so the first top-k
+        # doesn't pick duplicate roots)
+        neg = np.full((batch, self.beam_size), -1e9, np.float32)
+        neg[:, 0] = 0.0
+        scores = ops.assign(neg)
+        finished = ops.zeros([batch, self.beam_size], dtype="bool")
+        return ids, (cell_states, scores, finished)
+
+    def step(self, time, inputs, states):
+        from ... import ops
+        cell_states, scores, finished = states
+        batch = scores.shape[0]
+        tok = inputs.reshape([-1])
+        emb = self.embedding_fn(tok) if self.embedding_fn is not None else tok
+        cell_out, new_states = self.cell(emb, cell_states)
+        logits = self.output_fn(cell_out) if self.output_fn is not None \
+            else cell_out
+        logp = F.log_softmax(logits, axis=-1)              # [B*beam, V]
+        v = logp.shape[-1]
+        logp = self._split(logp, batch)                    # [B, beam, V]
+        # finished beams only extend with end_token at score 0
+        fin = finished.unsqueeze(-1).astype("float32")
+        mask = np.full((1, 1, v), -1e9, np.float32)
+        mask[0, 0, self.end_token] = 0.0
+        logp = logp * (1 - fin) + ops.assign(mask) * fin
+        total = scores.unsqueeze(-1) + logp                # [B, beam, V]
+        flat = total.reshape([batch, -1])
+        top_scores, top_idx = flat.topk(self.beam_size, axis=-1)
+        parent = (top_idx // v).astype("int64")            # [B, beam]
+        token = (top_idx % v).astype("int64")
+        # gather parent cell states
+        offs = ops.arange(0, batch, dtype="int64").unsqueeze(-1) * self.beam_size
+        flat_parent = (parent + offs).reshape([-1])
+
+        def pick(s):
+            return s[flat_parent]
+        if isinstance(new_states, (list, tuple)):
+            new_states = type(new_states)(pick(s) for s in new_states)
+        else:
+            new_states = pick(new_states)
+        new_finished = finished.reshape([batch * self.beam_size])[
+            flat_parent].reshape([batch, self.beam_size])
+        new_finished = ops.logical_or(
+            new_finished, ops.equal(token, ops.full_like(token, self.end_token)))
+        return (token, parent, top_scores), \
+            (new_states, top_scores, new_finished), token, new_finished
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Run a Decoder until all beams finish or max_step_num (reference
+    dynamic_decode). Returns (ids [B, beam, T] backtraced, scores)."""
+    from ... import ops
+    inputs, states = decoder.initialize(inits)
+    step_tokens, step_parents = [], []
+    scores = None
+    max_steps = max_step_num or 32
+    for t in range(max_steps):
+        (token, parent, scores), states, next_inputs, finished = \
+            decoder.step(t, inputs, states)
+        step_tokens.append(token)
+        step_parents.append(parent)
+        inputs = next_inputs
+        if bool(finished.all()):
+            break
+    ids = ops.stack(step_tokens, axis=0)       # [T, B, beam]
+    parents = ops.stack(step_parents, axis=0)
+    traced = F.gather_tree(ids, parents)       # [T, B, beam]
+    if not output_time_major:
+        traced = traced.transpose([1, 2, 0])   # [B, beam, T]
+    out = (traced, scores)
+    if return_length:
+        seq_len = (traced != decoder.end_token).astype("int64").sum(-1)
+        out = out + (seq_len,)
+    return out

@@ -258,3 +258,112 @@ class SimpleRNN(_RNNBase):
         self.MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
         super().__init__(input_size, hidden_size, num_layers, direction,
                          time_major, dropout, **kwargs)
+
+
+class RNNCellBase(Layer):
+    """Cell-protocol base (reference RNNCellBase): a cell maps
+    (input [B, C], states) -> (output, new_states) and exposes
+    get_initial_states."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        hs = getattr(self, "hidden_size", None)
+        from ... import ops
+        if getattr(self, "MODE", "") == "LSTM" or isinstance(self, LSTMCell):
+            return (ops.full([b, hs], init_value),
+                    ops.full([b, hs], init_value))
+        return ops.full([b, hs], init_value)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh) (reference SimpleRNNCell)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        std = 1.0 / np.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr, default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        from ... import ops
+        if states is None:
+            states = ops.zeros([inputs.shape[0], self.hidden_size])
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def impl(x, h, wih, whh, bih, bhh):
+            return act(x @ wih.T + bih + h @ whh.T + bhh)
+        h2 = apply_op("simple_rnn_cell", impl,
+                      (inputs, states, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh), {})
+        return h2, h2
+
+
+class RNN(Layer):
+    """Wrap any cell into a recurrence over time (reference RNN wrapper).
+    Dygraph runs the Python loop; under to_static the loop unrolls at trace
+    time (fixed T), which XLA then schedules — the LSTM/GRU classes use the
+    fused lax.scan path instead."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import ops
+        t_axis = 0 if self.time_major else 1
+        t_len = inputs.shape[t_axis]
+        states = initial_states
+        if states is None:
+            states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=1 if self.time_major else 0)
+        steps = range(t_len - 1, -1, -1) if self.is_reverse else range(t_len)
+        outs = [None] * t_len
+        for t in steps:
+            xt = inputs[:, t] if t_axis == 1 else inputs[t]
+            out, states = self.cell(xt, states)
+            outs[t] = out
+        stacked = ops.stack(outs, axis=t_axis)
+        return stacked, states
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, outputs concatenated (reference BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import ops
+        s_fw = s_bw = None
+        if initial_states is not None:
+            s_fw, s_bw = initial_states
+        o_fw, s_fw = self.rnn_fw(inputs, s_fw, sequence_length)
+        o_bw, s_bw = self.rnn_bw(inputs, s_bw, sequence_length)
+        return ops.concat([o_fw, o_bw], axis=-1), (s_fw, s_bw)
+
+
+# LSTMCell/GRUCell predate RNNCellBase in this module; give them the cell
+# protocol so RNN/BiRNN/BeamSearchDecoder accept them
+LSTMCell.get_initial_states = RNNCellBase.get_initial_states
+GRUCell.get_initial_states = RNNCellBase.get_initial_states

@@ -227,3 +227,138 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
     hist, edges = jnp.histogramdd(a, bins=bins, range=rng, density=density,
                                   weights=w)
     return hist, list(edges)
+
+
+# -- API-surface completion batch ------------------------------------------
+def cholesky_inverse(x, upper=False):
+    """inv(A) from its Cholesky factor (reference cholesky_inverse)."""
+    a = _arr(x)
+    eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+    inv_f = jax.scipy.linalg.solve_triangular(a, eye, lower=not upper)
+    return inv_f.T @ inv_f if not upper else inv_f @ inv_f.T
+
+
+def cond(x, p=None):
+    """Condition number (reference linalg.cond): ratio of singular values
+    for p in (None, 2, -2); norm ratio otherwise."""
+    a = _arr(x)
+    if p is None or p == 2 or p == -2:
+        s = jnp.linalg.svd(a, compute_uv=False)
+        if p == -2:
+            return s[..., -1] / s[..., 0]
+        return s[..., 0] / s[..., -1]
+    na = jnp.linalg.norm(a, ord=p, axis=(-2, -1))
+    nia = jnp.linalg.norm(jnp.linalg.inv(a), ord=p, axis=(-2, -1))
+    return na * nia
+
+
+def svdvals(x):
+    return jnp.linalg.svd(_arr(x), compute_uv=False)
+
+
+def matrix_exp(x):
+    a = _arr(x)
+    if a.ndim == 2:
+        return jax.scipy.linalg.expm(a)
+    flat = a.reshape((-1,) + a.shape[-2:])
+    out = jax.vmap(jax.scipy.linalg.expm)(flat)
+    return out.reshape(a.shape)
+
+
+def householder_product(x, tau):
+    """Q from Householder reflectors (LAPACK orgqr; reference
+    householder_product): Q = H_1 H_2 ... H_k with
+    H_i = I - tau_i v_i v_i^T."""
+    a, t = _arr(x), _arr(tau)
+
+    def one(mat, taus):
+        m, n = mat.shape
+        k = taus.shape[0]
+        q = jnp.eye(m, n, dtype=mat.dtype)
+
+        def body(i, q):
+            idx = k - 1 - i
+            v = jnp.where(jnp.arange(m) > idx, mat[:, idx], 0.0)
+            v = v.at[idx].set(1.0)
+            # zero reflector columns beyond k
+            w = taus[idx] * (v @ q)
+            return q - jnp.outer(v, w)
+        return jax.lax.fori_loop(0, k, body, q)
+
+    if a.ndim == 2:
+        return one(a, t)
+    flat_a = a.reshape((-1,) + a.shape[-2:])
+    flat_t = t.reshape((-1,) + t.shape[-1:])
+    out = jax.vmap(one)(flat_a, flat_t)
+    return out.reshape(a.shape[:-2] + out.shape[-2:])
+
+
+def ormqr(x, tau, y, left=True, transpose=False):
+    """Multiply y by Q (from Householder reflectors of x): Q@y, Qᵀ@y, y@Q,
+    y@Qᵀ (reference ormqr)."""
+    q = householder_product(_arr(x), _arr(tau))
+    other = _arr(y)
+    q = jnp.swapaxes(q, -1, -2) if transpose else q
+    return q @ other if left else other @ q
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+    """Split packed LU into (P, L, U) (reference lu_unpack)."""
+    a = _arr(lu_data)
+    piv = _arr(lu_pivots)
+    m, n = a.shape[-2], a.shape[-1]
+    k = min(m, n)
+    lower = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+    upper = jnp.triu(a[..., :k, :])
+
+    def perm_one(pv):
+        perm = jnp.arange(m)
+
+        def body(i, p):
+            j = pv[i] - 1  # pivots are 1-based (LAPACK convention)
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+        perm = jax.lax.fori_loop(0, pv.shape[0], body, perm)
+        return jnp.eye(m, dtype=a.dtype)[perm].T
+
+    if piv.ndim == 1:
+        p = perm_one(piv)
+    else:
+        flat = piv.reshape((-1, piv.shape[-1]))
+        p = jax.vmap(perm_one)(flat).reshape(piv.shape[:-1] + (m, m))
+    return p, lower, upper
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    """Randomized low-rank PCA (Halko et al.; reference pca_lowrank):
+    returns (U, S, V) with q components."""
+    a = _arr(x).astype(jnp.float32)
+    m, n = a.shape[-2], a.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        a = a - a.mean(axis=-2, keepdims=True)
+    return svd_lowrank(a, q=q, niter=niter)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None):
+    """Randomized truncated SVD via subspace iteration (reference
+    svd_lowrank). Static shapes + matmuls only — TPU-friendly."""
+    from ...core import random as _rng
+    a = _arr(x)
+    if M is not None:
+        a = a - _arr(M)
+    m, n = a.shape[-2], a.shape[-1]
+    k = min(q, m, n)
+    g = jax.random.normal(_rng.next_key(), a.shape[:-2] + (n, k), a.dtype)
+    y = a @ g
+    qmat, _ = jnp.linalg.qr(y)
+    for _ in range(niter):
+        # QR after each application keeps the basis orthonormal (plain
+        # power iteration squares the condition number and loses rank)
+        z, _ = jnp.linalg.qr(jnp.swapaxes(a, -1, -2) @ qmat)
+        qmat, _ = jnp.linalg.qr(a @ z)
+    b = jnp.swapaxes(qmat, -1, -2) @ a
+    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ u_b
+    return u, s, jnp.swapaxes(vt, -1, -2)

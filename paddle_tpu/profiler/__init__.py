@@ -27,6 +27,18 @@ __all__ = [
 ]
 
 
+class SortedKeys(enum.Enum):
+    """Summary-table sort keys (reference profiler.SortedKeys)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
 class ProfilerState(enum.Enum):
     CLOSED = 0
     READY = 1

@@ -15,3 +15,5 @@ __all__ = [
     "quanter", "QAT", "PTQ", "QuantedLinear", "QuantedConv2D",
     "InferQuantedLinear",
 ]
+
+from .quanters import BaseQuanter  # noqa: F401,E402

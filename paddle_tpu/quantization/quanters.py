@@ -55,7 +55,27 @@ def fake_quant(x, scale, quant_bits=8, quant_axis=-1):
     return apply_op("fake_quantize_dequantize", impl, (x, scale), {})
 
 
-class FakeQuanterWithAbsMax(Layer):
+class BaseQuanter(Layer):
+    """Quanter ABC (reference quantization.base_quanter.BaseQuanter):
+    a Layer that fake-quantizes its input and reports scales/bits."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+    def quant_axis(self):
+        return None
+
+    def bit_length(self):
+        return 8
+
+
+class FakeQuanterWithAbsMax(BaseQuanter):
     """QAT activation/weight quanter: tracks absmax (EMA for activations,
     current for weights) and applies fake quant every forward
     (quanters/abs_max.py FakeQuanterWithAbsMaxObserverLayer)."""

@@ -1,1 +1,112 @@
-"""Placeholder — populated in a later milestone this round."""
+"""paddle.utils (reference: python/paddle/utils/__init__.py — deprecated
+decorator, run_check, require_version, try_import, cpp_extension)."""
+import functools
+import importlib
+import warnings
+
+from . import cpp_extension  # noqa: F401
+from . import flags  # noqa: F401
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import",
+           "unique_name"]
+
+
+def deprecated(update_to="", since="", reason="", level=1):
+    """Mark an API deprecated (reference utils.deprecated): warns at
+    level 1, raises at level 2."""
+    def decorator(fn):
+        msg = f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use '{update_to}' instead"
+        if reason:
+            msg += f". Reason: {reason}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            if level >= 1:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return decorator
+
+
+def run_check():
+    """Verify the install works end-to-end (reference paddle.utils.run_check:
+    runs a tiny model on the available devices and reports)."""
+    import numpy as np
+    import jax
+    from .. import nn, optimizer, to_tensor
+
+    model = nn.Linear(4, 2)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    x = to_tensor(np.ones((2, 4), np.float32))
+    loss = (model(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed successfully! "
+          f"(device: {dev.platform}:{dev.id}, kind: {dev.device_kind})")
+    return True
+
+
+def require_version(min_version, max_version=None):
+    """Check the framework version is within range (reference
+    require_version)."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True
+
+
+def try_import(module_name, err_msg=None):
+    """Import or raise a friendly error (reference try_import)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or
+                          f"'{module_name}' is required; it is not bundled "
+                          f"with this environment")
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self._ids = {}
+
+    def __call__(self, key="tmp"):
+        self._ids[key] = self._ids.get(key, -1) + 1
+        return f"{key}_{self._ids[key]}"
+
+
+class unique_name:
+    """paddle.utils.unique_name namespace."""
+    _gen = _UniqueNameGenerator()
+
+    @staticmethod
+    def generate(key="tmp"):
+        return unique_name._gen(key)
+
+    @staticmethod
+    def guard(new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            old = unique_name._gen
+            unique_name._gen = _UniqueNameGenerator()
+            try:
+                yield
+            finally:
+                unique_name._gen = old
+        return _guard()
