@@ -55,6 +55,7 @@ from . import geometric
 from . import audio
 from . import text
 from . import onnx
+from . import hub
 from .hapi import Model, summary
 from .hapi.flops import flops
 from .framework import save, load, set_default_dtype, get_default_dtype
@@ -120,5 +121,26 @@ def synchronize():
     _jax.effects_barrier()
 
 
-disable_static = lambda place=None: None  # dygraph is the default mode
-enable_static = None  # bound in paddle_tpu.static
+def enable_static():
+    """Enter static-graph mode (reference paddle.enable_static): ops on
+    feed-connected tensors are recorded into the default Program for
+    Executor.run replay (static.program recorder)."""
+    from . import static as _static
+    from .static import program as _prog
+    from .core import dispatch as _dispatch
+    _static._static_mode = True
+    _dispatch.set_static_recorder(
+        _prog._make_recorder(_prog.default_main_program()))
+
+
+def disable_static(place=None):
+    """Back to dygraph (the default mode)."""
+    from . import static as _static
+    from .core import dispatch as _dispatch
+    _static._static_mode = False
+    _dispatch.set_static_recorder(None)
+
+
+def in_dynamic_mode():
+    from . import static as _static
+    return not _static._static_mode

@@ -4,5 +4,9 @@ LogMelSpectrogram, MFCC). Composed from paddle_tpu.signal.stft — the
 whole pipeline is one XLA graph."""
 from . import functional
 from . import features
+from . import backends
+from . import datasets
+from .backends import load, save, info
 
-__all__ = ["functional", "features"]
+__all__ = ["functional", "features", "backends", "datasets", "load",
+           "save", "info"]

@@ -1,0 +1,96 @@
+"""Audio IO backends (reference: python/paddle/audio/backends/ — wave_backend
+default, soundfile optional). This environment has the stdlib `wave` module;
+load/save/info cover PCM WAV, which is what the reference's default backend
+supports (wave_backend.py)."""
+import wave as _wave
+
+import numpy as np
+
+__all__ = ["list_available_backends", "get_current_backend", "set_backend",
+           "load", "save", "info", "AudioInfo"]
+
+_current = "wave_backend"
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return _current
+
+
+def set_backend(backend_name):
+    global _current
+    if backend_name not in list_available_backends():
+        raise ValueError(f"backend {backend_name} unavailable; have "
+                         f"{list_available_backends()}")
+    _current = backend_name
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_frames = self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample})")
+
+
+def info(filepath):
+    """WAV header info (reference audio.info)."""
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Load PCM WAV -> (Tensor [C, T] float32 in [-1, 1], sample_rate)
+    (reference audio.load)."""
+    from ..core.tensor import Tensor
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n = f.getnframes()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(count)
+    dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dt).reshape(-1, ch)
+    if width == 1:
+        wav = (data.astype(np.float32) - 128.0) / 128.0
+    else:
+        wav = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    if not normalize:
+        wav = data.astype(np.float32)
+    out = wav.T if channels_first else wav
+    return Tensor(np.ascontiguousarray(out)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_S", bits_per_sample=16):
+    """Save float waveform to PCM WAV (reference audio.save)."""
+    arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src,
+                     np.float32)
+    if channels_first:
+        arr = arr.T  # -> [T, C]
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    width = bits_per_sample // 8
+    peak = float(2 ** (bits_per_sample - 1) - 1)
+    data = np.clip(arr, -1.0, 1.0) * peak
+    dt = {2: np.int16, 4: np.int32}[width]
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(width)
+        f.setframerate(int(sample_rate))
+        f.writeframes(data.astype(dt).tobytes())

@@ -1,0 +1,103 @@
+"""Audio datasets (reference: python/paddle/audio/datasets/ — TESS, ESC50;
+both download archives there). Zero-egress environment: datasets read a
+local directory laid out like the reference archive; `mode='synthetic'`
+generates deterministic waveforms so pipelines are testable offline."""
+import os
+
+import numpy as np
+
+from ..io import Dataset
+from . import features
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _AudioClassifyDataset(Dataset):
+    sample_rate = 16000
+    duration = 1.0
+    n_classes = 2
+
+    def __init__(self, mode="train", feat_type="raw", data_dir=None,
+                 archive=None, split=1, seed=0, n_samples=64, **feat_kwargs):
+        self.mode = mode
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        self._files = []
+        self._labels = []
+        if data_dir and os.path.isdir(data_dir):
+            self._index_local(data_dir)
+        else:
+            self._synthesize(seed, n_samples)
+
+    def _index_local(self, data_dir):
+        for root, _, files in os.walk(data_dir):
+            for fn in sorted(files):
+                if fn.endswith(".wav"):
+                    self._files.append(os.path.join(root, fn))
+                    self._labels.append(self._label_of(fn))
+
+    def _label_of(self, filename):
+        return 0
+
+    def _synthesize(self, seed, n):
+        rng = np.random.default_rng(seed)
+        t = np.arange(int(self.sample_rate * self.duration)) / self.sample_rate
+        self._waves = []
+        for i in range(n):
+            label = i % self.n_classes
+            freq = 200.0 + 100.0 * label + rng.uniform(-10, 10)
+            wav = 0.5 * np.sin(2 * np.pi * freq * t).astype(np.float32)
+            self._waves.append(wav)
+            self._labels.append(label)
+
+    def _waveform(self, idx):
+        if self._files:
+            from .backends import load
+            wav, _ = load(self._files[idx])
+            return np.asarray(wav.numpy())[0]
+        return self._waves[idx]
+
+    def __getitem__(self, idx):
+        wav = self._waveform(idx)
+        label = self._labels[idx]
+        if self.feat_type == "raw":
+            return wav, label
+        from ..core.tensor import Tensor
+        x = Tensor(wav[None])
+        feat_cls = {"spectrogram": features.Spectrogram,
+                    "melspectrogram": features.MelSpectrogram,
+                    "logmelspectrogram": features.LogMelSpectrogram,
+                    "mfcc": features.MFCC}[self.feat_type]
+        feat = feat_cls(sr=self.sample_rate, **self.feat_kwargs) \
+            if self.feat_type == "mfcc" else feat_cls(**self.feat_kwargs)
+        return np.asarray(feat(x).numpy())[0], label
+
+    def __len__(self):
+        return len(self._labels)
+
+
+class TESS(_AudioClassifyDataset):
+    """Toronto Emotional Speech Set (reference audio/datasets/tess.py):
+    7 emotion classes."""
+    n_classes = 7
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def _label_of(self, filename):
+        for i, lab in enumerate(self.label_list):
+            if lab in filename.lower():
+                return i
+        return 0
+
+
+class ESC50(_AudioClassifyDataset):
+    """ESC-50 environmental sounds (reference audio/datasets/esc50.py):
+    50 classes, 5 folds."""
+    n_classes = 50
+    sample_rate = 44100
+    duration = 0.25  # synthetic mode keeps tensors small
+
+    def _label_of(self, filename):
+        try:
+            return int(os.path.splitext(filename)[0].split("-")[-1])
+        except ValueError:
+            return 0

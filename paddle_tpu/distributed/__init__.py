@@ -68,3 +68,41 @@ def spawn(func, args=(), nprocs=-1, join=True, **kwargs):
     bad = [p.exitcode for p in procs if p.exitcode != 0]
     if bad:
         raise RuntimeError(f"spawn: worker exit codes {bad}")
+
+# -- reference-parity completion (python/paddle/distributed/__init__.py) --
+from .collective import (gather, scatter_object_list, alltoall,  # noqa: F401,E402
+                         alltoall_single, split)
+from .parallel import (ParallelMode, is_initialized,  # noqa: F401,E402
+                       destroy_process_group, is_available, get_backend,
+                       DataParallel)
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401,E402
+from .auto_parallel.api import (Strategy, DistModel, to_static,  # noqa: F401,E402
+                                shard_optimizer, shard_dataloader,
+                                ShardingStage1, ShardingStage2,
+                                ShardingStage3, DistAttr, LocalLayer,
+                                shard_scaler)
+from .parallelize import (parallelize, ColWiseParallel,  # noqa: F401,E402
+                          RowWiseParallel, SequenceParallelBegin,
+                          SequenceParallelEnd, SequenceParallelEnable,
+                          SequenceParallelDisable, PrepareLayerInput,
+                          PrepareLayerOutput, SplitPoint, to_distributed)
+from .ps_compat import (InMemoryDataset, QueueDataset,  # noqa: F401,E402
+                        CountFilterEntry, ShowClickEntry, ProbabilityEntry)
+from . import io  # noqa: F401,E402
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-collectives bootstrap (reference gloo_* trio over the gloo HTTP
+    store). The XLA CPU backend plays gloo's role here; rendezvous state
+    lives in the TCPStore."""
+    from .parallel import init_parallel_env
+    init_parallel_env()
+
+
+def gloo_barrier():
+    from .collective import barrier
+    barrier()
+
+
+def gloo_release():
+    """Release bootstrap resources (no persistent gloo context here)."""

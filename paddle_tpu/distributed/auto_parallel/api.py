@@ -277,3 +277,36 @@ class Engine:
     def load(self, path):
         from ...framework import load as fw_load
         self._model.set_state_dict(fw_load(path + ".pdparams"))
+
+
+class DistAttr:
+    """Legacy tensor dist attribute (reference DistAttr: mesh +
+    sharding_specs); superseded by placements but kept for source compat."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs or []
+
+
+class LocalLayer:
+    """Escape hatch marker (reference LocalLayer, api.py): a layer whose
+    forward runs per-shard (shard_map semantics) instead of on global
+    DTensors. Wraps the layer; inputs/outputs pass through with their local
+    views inside a shard_map when a mesh is active."""
+
+    def __new__(cls, layer=None, out_dist_attrs=None, in_dist_attrs=None):
+        if layer is None:
+            return super().__new__(cls)
+        layer._local_layer = True
+        layer._local_out_dist_attrs = out_dist_attrs
+        layer._local_in_dist_attrs = in_dist_attrs
+        return layer
+
+
+def shard_scaler(scaler):
+    """Make a GradScaler mesh-aware (reference shard_scaler, api.py): the
+    found-inf allreduce is a mesh collective. On TPU the scaler's inf check
+    is computed on global DTensors, so GSPMD already inserts the reduction;
+    this marks the scaler for API parity."""
+    scaler._sharded = True
+    return scaler

@@ -327,3 +327,65 @@ def wait(tensor, group=None, use_calc_stream=True):
 # -- torch.distributed-style object store (used by checkpoint coordination) --
 def broadcast_object_list(obj_list, src=0, group=None):
     return obj_list
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather to dst (reference communication/gather.py). Single-controller
+    semantics: every rank's view is materialized via all_gather, dst keeps
+    the list."""
+    tmp = []
+    all_gather(tmp, tensor, group=group)
+    if gather_list is not None:
+        gather_list.extend(tmp)
+    return _DoneTask()
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Object scatter (reference scatter_object_list): single-controller —
+    rank r receives in_object_list[r]."""
+    _, g = _axis(group)
+    rank = 0
+    objs = in_object_list or []
+    if objs:
+        out_object_list.append(objs[rank % len(objs)])
+    return out_object_list
+
+
+# paddle.distributed.alltoall aliases (the stream API exposes all_to_all)
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    return all_to_all(out_tensor_list, in_tensor_list, group=group,
+                      sync_op=sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    return all_to_all_single(out_tensor, in_tensor, in_split_sizes,
+                             out_split_sizes, group=group, sync_op=sync_op)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style distributed fc/embedding helper (reference
+    paddle.distributed.split, fleet/layers/mpu): builds a column/row-parallel
+    layer over the current mp group. On this stack the parallel layers are
+    GSPMD-sharded, so this returns the fleet layer's output."""
+    from . import fleet
+    from .fleet.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                  VocabParallelEmbedding)
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = ColumnParallelLinear(in_f, out_f,
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        else:
+            layer = RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False)
+        return layer(x)
+    if operation == "embedding":
+        n, d = size
+        layer = VocabParallelEmbedding(n, d, weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported split operation: {operation}")

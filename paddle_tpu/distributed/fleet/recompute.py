@@ -25,6 +25,21 @@ def recompute(function, *args, **kwargs):
     tensor arguments."""
     kwargs.pop("preserve_rng_state", None)  # jax keys are functional; nothing to stash
     kwargs.pop("use_reentrant", None)
+    # selective rematerialization: a named jax.checkpoint policy ("dots",
+    # "dots_saveable", "nothing_saveable", ...) keeps GEMM outputs resident
+    # and recomputes only the cheap elementwise tail — the reference's
+    # recompute always drops everything (recompute.py:128); on TPU the
+    # selective policy is usually the better FLOPs/HBM trade
+    policy_name = kwargs.pop("policy", None)
+    policy = None
+    if policy_name:
+        policy = getattr(jax.checkpoint_policies, {
+            "dots": "checkpoint_dots",
+            "dots_saveable": "dots_saveable",
+            "dots_with_no_batch_dims": "dots_with_no_batch_dims_saveable",
+            "nothing": "nothing_saveable",
+            "everything": "everything_saveable",
+        }.get(policy_name, policy_name))
 
     if isinstance(function, Layer):
         params = {n: p for n, p in function.named_parameters()
@@ -33,7 +48,7 @@ def recompute(function, *args, **kwargs):
         def impl(pdict, *arrs):
             def inner(pd, *aa):
                 return pure_call(function, pd, None, *aa, **kwargs)
-            return jax.checkpoint(inner)(pdict, *arrs)
+            return jax.checkpoint(inner, policy=policy)(pdict, *arrs)
 
         return apply_op("recompute", impl, (params, *args), {})
 
@@ -45,7 +60,7 @@ def recompute(function, *args, **kwargs):
             return jax.tree_util.tree_map(
                 lambda t: t.data if isinstance(t, Tensor) else t, out,
                 is_leaf=lambda t: isinstance(t, Tensor))
-        return jax.checkpoint(inner)(*arrs)
+        return jax.checkpoint(inner, policy=policy)(*arrs)
 
     return apply_op("recompute", impl, args, {})
 

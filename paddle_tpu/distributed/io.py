@@ -1,0 +1,35 @@
+"""paddle.distributed.io (reference: python/paddle/distributed/io.py —
+save/load persistables for distributed training; the PS path saves per-server
+shards). Delegates to framework save/load with rank-aware paths."""
+import os
+
+from ..framework import save as _save, load as _load
+from .parallel import get_rank
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var):
+    return getattr(var, "persistable", True)
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """Save program persistables (rank 0 writes; other ranks hold replicas
+    in SPMD so writing once is the dedup the reference does across PS
+    shards)."""
+    state = {}
+    if main_program is not None and hasattr(main_program, "state_dict"):
+        state = main_program.state_dict()
+    if get_rank() == 0:
+        os.makedirs(dirname, exist_ok=True)
+        _save(state, os.path.join(dirname, filename or "persistables"))
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    path = os.path.join(dirname, filename or "persistables")
+    state = _load(path)
+    if main_program is not None and hasattr(main_program, "set_state_dict"):
+        main_program.set_state_dict(state)
+    return state

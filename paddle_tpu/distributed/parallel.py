@@ -17,7 +17,12 @@ from .mesh import ProcessMesh, set_mesh, get_mesh
 _parallel_env = {"initialized": False}
 
 
+_initialized = False
+
+
 def init_parallel_env():
+    global _initialized
+    _initialized = True
     """Reference parallel.py:978. Reads the same env contract
     (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER) when present to
     bootstrap multi-host jax.distributed; on a single host it just builds the
@@ -117,3 +122,41 @@ class DataParallel(nn.Layer):
 
     def apply_collective_grads(self):
         pass  # no-op: XLA already reduced the grads
+
+
+class ParallelMode:
+    """Parallelism kind enum (reference base/topology.py:61)."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+def is_initialized():
+    """True once init_parallel_env ran (reference is_initialized)."""
+    return _initialized
+
+
+def destroy_process_group(group=None):
+    """Tear down groups (reference destroy_process_group). Collectives here
+    are compiler ops over the mesh, so this clears the Group registry."""
+    global _initialized
+    from . import collective
+    if group is not None:
+        collective._group_registry.pop(getattr(group, "id", group), None)
+    else:
+        collective._group_registry.clear()
+        _initialized = False
+
+
+def is_available():
+    """Distributed is always available: XLA collectives need no extra
+    runtime (reference is_available checks the NCCL build)."""
+    return True
+
+
+def get_backend(group=None):
+    """The single backend is XLA's collectives over ICI/DCN (the
+    ProcessGroupXLA of SURVEY.md §2.7)."""
+    return "xla"

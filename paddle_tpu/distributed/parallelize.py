@@ -1,0 +1,200 @@
+"""Intermediate-level parallelization plans (reference:
+python/paddle/distributed/auto_parallel/intermediate/ — parallelize,
+ColWiseParallel/RowWiseParallel, sequence-parallel plan markers, SplitPoint,
+and the high-level to_distributed, api.py:255 high_level_api.py).
+
+TPU-native mechanism: each plan annotates parameters with DTensor placements
+(Shard/Replicate over the mesh's 'mp' axis); GSPMD then inserts the identity/
+allreduce pairs the reference implements as PyLayers (mp_ops.py:40-356).
+Pipeline SplitPoint records stage boundaries consumed by
+fleet.pipeline_parallel.
+"""
+import re
+import enum
+
+from .mesh import ProcessMesh, get_mesh
+from .placement import Shard, Replicate
+from .dtensor import shard_tensor, is_dist_tensor, _set_meta
+
+
+def _shard_param_inplace(layer, pname, mesh, placements):
+    """Re-place a parameter without changing its identity (optimizers and
+    the layer's parameter slot keep pointing at the same object —
+    the reference mutates EagerParamBase dist_attr the same way)."""
+    p = getattr(layer, pname, None)
+    if p is None or is_dist_tensor(p):
+        return
+    sharded = shard_tensor(p, mesh, placements, stop_gradient=p.stop_gradient)
+    p._data = sharded._data
+    _set_meta(p, mesh, placements)
+
+__all__ = [
+    "parallelize", "ColWiseParallel", "RowWiseParallel",
+    "SequenceParallelBegin", "SequenceParallelEnd", "SequenceParallelEnable",
+    "SequenceParallelDisable", "PrepareLayerInput", "PrepareLayerOutput",
+    "SplitPoint", "to_distributed",
+]
+
+
+class _Plan:
+    """Base marker: applied to one sublayer by parallelize()."""
+
+    def apply(self, layer, mesh, axis):
+        raise NotImplementedError
+
+
+class ColWiseParallel(_Plan):
+    """Column-parallel: weight [in, out] sharded on out over the TP axis;
+    bias sharded the same way (reference ColWiseParallel)."""
+
+    def __init__(self, gather_output=False):
+        self.gather_output = gather_output
+
+    def apply(self, layer, mesh, axis):
+        dim = mesh.dim_names.index(axis)
+        w = getattr(layer, "weight", None)
+        if w is not None:
+            placements = [Replicate()] * mesh.ndim
+            placements[dim] = Shard(w.ndim - 1)
+            _shard_param_inplace(layer, "weight", mesh, placements)
+        if getattr(layer, "bias", None) is not None:
+            placements = [Replicate()] * mesh.ndim
+            placements[dim] = Shard(0)
+            _shard_param_inplace(layer, "bias", mesh, placements)
+
+
+class RowWiseParallel(_Plan):
+    """Row-parallel: weight [in, out] sharded on in; bias replicated
+    (reference RowWiseParallel)."""
+
+    def __init__(self, is_input_parallel=True):
+        self.is_input_parallel = is_input_parallel
+
+    def apply(self, layer, mesh, axis):
+        dim = mesh.dim_names.index(axis)
+        if getattr(layer, "weight", None) is not None:
+            placements = [Replicate()] * mesh.ndim
+            placements[dim] = Shard(0)
+            _shard_param_inplace(layer, "weight", mesh, placements)
+
+
+class _SPMarker(_Plan):
+    def apply(self, layer, mesh, axis):
+        setattr(layer, "_sp_plan", type(self).__name__)
+
+
+class SequenceParallelBegin(_SPMarker):
+    """Mark where activations switch to sequence-sharded layout."""
+
+
+class SequenceParallelEnd(_SPMarker):
+    """Mark where activations return to batch-sharded layout."""
+
+
+class SequenceParallelEnable(_SPMarker):
+    """Run this layer in sequence-parallel regime."""
+
+
+class SequenceParallelDisable(_SPMarker):
+    def __init__(self, need_transpose=True):
+        self.need_transpose = need_transpose
+
+
+class PrepareLayerInput(_Plan):
+    """Wrap a layer with an input-preparation fn (reference
+    PrepareLayerInput): fn receives (layer, inputs) pre-forward."""
+
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh, axis):
+        if self.fn is not None:
+            layer.register_forward_pre_hook(
+                lambda lyr, inputs: self.fn(inputs))
+
+
+class PrepareLayerOutput(_Plan):
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh, axis):
+        if self.fn is not None:
+            layer.register_forward_post_hook(
+                lambda lyr, inputs, outputs: self.fn(outputs))
+
+
+class SplitPoint(enum.Enum):
+    """Pipeline stage boundary position (reference SplitPoint)."""
+    BEGINNING = 0
+    END = 1
+
+
+def _match_sublayers(model, pattern):
+    out = []
+    regex = re.compile(pattern.replace("*", ".*") + "$")
+    for name, sub in model.named_sublayers():
+        if regex.match(name):
+            out.append((name, sub))
+    return out
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """Apply dp/mp/pp configs to a model (reference parallelize,
+    auto_parallel/intermediate/parallelize.py): config keys
+    'mp_config' {'parallelize_plan': {name-pattern: plan}}, 'pp_config'
+    {'split_spec': {name: SplitPoint}}, 'dp_config' {'sharding_level'}."""
+    mesh = mesh or get_mesh()
+    config = config or {}
+    mp_cfg = config.get("mp_config") or {}
+    axis = mp_cfg.get("axis", "mp")
+    plan_map = mp_cfg.get("parallelize_plan") or {}
+    for pattern, plan in plan_map.items():
+        plans = plan if isinstance(plan, (list, tuple)) else [plan]
+        for name, sub in _match_sublayers(model, pattern):
+            for pl in plans:
+                pl.apply(sub, mesh, axis)
+    pp_cfg = config.get("pp_config") or {}
+    split_spec = pp_cfg.get("split_spec")
+    if split_spec:
+        # record boundaries; fleet.PipelineLayer consumes this attribute
+        model._pp_split_spec = split_spec
+    dp_cfg = config.get("dp_config") or {}
+    level = dp_cfg.get("sharding_level", 0)
+    if optimizer is not None and level:
+        from .auto_parallel.api import shard_optimizer
+        optimizer = shard_optimizer(optimizer)
+    return (model, optimizer) if optimizer is not None else model
+
+
+def to_distributed(model, optimizer, dataloader, device_num=None, node_num=1,
+                   config=None):
+    """High-level one-call distribution (reference to_distributed,
+    high_level_api.py:255): picks a mesh over the visible devices, applies a
+    generic TP plan to recognizable layers (Linear/Embedding), and shards
+    the dataloader over dp."""
+    import jax
+    from .auto_parallel.api import shard_dataloader
+    n = device_num or len(jax.devices())
+    mp = 1
+    for cand in (8, 4, 2):
+        if n % cand == 0 and cand <= n:
+            mp = cand
+            break
+    dp = n // mp
+    import numpy as np
+    mesh = ProcessMesh(np.arange(n).reshape(dp, mp), dim_names=["dp", "mp"])
+    # generic plan: column-parallel then row-parallel pairs per block when
+    # the structure is recognizable; otherwise replicate
+    plan = {}
+    for name, sub in model.named_sublayers():
+        lname = name.lower()
+        if lname.endswith(("q_proj", "k_proj", "v_proj", "gate_proj",
+                           "up_proj", "linear1", "qkv_proj")):
+            plan[name] = ColWiseParallel()
+        elif lname.endswith(("o_proj", "down_proj", "linear2", "out_proj")):
+            plan[name] = RowWiseParallel()
+    parallelize(model, mesh=mesh,
+                config={"mp_config": {"parallelize_plan": plan}})
+    loader = shard_dataloader(dataloader, meshes=[mesh], shard_dims="dp") \
+        if dataloader is not None else None
+    return model, optimizer, loader

@@ -1,0 +1,106 @@
+"""Parameter-server data-plane compat (reference:
+python/paddle/distributed/__init__.py re-exports fleet dataset types —
+InMemoryDataset/QueueDataset backed by paddle/fluid/framework/data_feed.cc,
+sparse-table entry configs from ps/table/). The PS data pipeline here is
+host-side Python feeding the TPU step; these classes keep the config surface
+so PS-style training scripts load."""
+import numpy as np
+
+__all__ = ["InMemoryDataset", "QueueDataset", "CountFilterEntry",
+           "ShowClickEntry", "ProbabilityEntry"]
+
+
+class _DatasetBase:
+    def __init__(self):
+        self._pipe_command = None
+        self._use_var = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._filelist = []
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command="cat", input_type=0, fs_name="", fs_ugi="",
+             **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._use_var = use_var or []
+        self._pipe_command = pipe_command
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def _iter_lines(self):
+        import subprocess
+        for path in self._filelist:
+            if self._pipe_command and self._pipe_command != "cat":
+                out = subprocess.run(
+                    self._pipe_command, shell=True, stdin=open(path, "rb"),
+                    capture_output=True, check=True).stdout
+                for line in out.decode().splitlines():
+                    yield line
+            else:
+                with open(path) as f:
+                    yield from f
+
+
+class InMemoryDataset(_DatasetBase):
+    """Loads all samples to host memory, supports shuffle before training
+    (reference InMemoryDataset: load_into_memory + local/global_shuffle)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = []
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_lines())
+
+    def local_shuffle(self):
+        np.random.default_rng().shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._samples = []
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def __iter__(self):
+        return iter(self._samples)
+
+
+class QueueDataset(_DatasetBase):
+    """Streaming dataset: iterates files without materializing
+    (reference QueueDataset)."""
+
+    def __iter__(self):
+        return self._iter_lines()
+
+
+class CountFilterEntry:
+    """Sparse-table admission rule: embed only after `count` touches
+    (reference ps/table accessor entry configs)."""
+
+    def __init__(self, count=1):
+        self._count = count
+
+    def __str__(self):
+        return f"count_filter_entry:{self._count}"
+
+
+class ShowClickEntry:
+    def __init__(self, show_name, click_name):
+        self._show = show_name
+        self._click = click_name
+
+    def __str__(self):
+        return f"show_click_entry:{self._show}:{self._click}"
+
+
+class ProbabilityEntry:
+    def __init__(self, probability=1.0):
+        self._prob = probability
+
+    def __str__(self):
+        return f"probability_entry:{self._prob}"

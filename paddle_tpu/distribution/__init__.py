@@ -10,7 +10,7 @@ from .continuous import (Normal, LogNormal, Uniform, Laplace, Gumbel, Cauchy,
                          ContinuousBernoulli)
 from .discrete import (Bernoulli, Geometric, Binomial, Categorical,
                        Multinomial, Poisson)
-from .multivariate import Dirichlet, MultivariateNormal
+from .multivariate import Dirichlet, MultivariateNormal, LKJCholesky
 from .wrappers import Independent, TransformedDistribution
 from .transform import (Transform, AffineTransform, ExpTransform,
                         PowerTransform, SigmoidTransform, TanhTransform,

@@ -7,6 +7,7 @@ import jax.numpy as jnp
 from jax.scipy import special as jsp
 
 from .distribution import Distribution, ExponentialFamily, _arr
+from .continuous import _bcast
 from ..core.tensor import Tensor
 
 _LOG_2PI = math.log(2.0 * math.pi)
@@ -145,3 +146,59 @@ class MultivariateNormal(Distribution):
             maha = jnp.sum(y ** 2, axis=-1)
             return Tensor(hld2 - hld1 + 0.5 * (tr + maha - k))
         return super().kl_divergence(other)
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices (reference
+    distribution/lkj_cholesky.py; Lewandowski-Kurowicka-Joe 2009). Sampling
+    via the onion method; log_prob from the diagonal-power density."""
+
+    def __init__(self, dim=2, concentration=1.0,
+                 sample_method="onion", name=None):
+        self.dim = int(dim)
+        (self.concentration,), shape = _bcast(concentration)
+        self.sample_method = sample_method
+        super().__init__(batch_shape=shape,
+                         event_shape=(self.dim, self.dim))
+
+    def _sample(self, key, shape):
+        import jax
+        d = self.dim
+        eta = self.concentration
+        full = shape + self._batch_shape
+
+        def one(k):
+            # onion method: build row by row; row i direction uniform on
+            # sphere scaled by sqrt(beta sample). Each row consumes TWO
+            # independent subkeys (beta radius + normal direction).
+            ks = jax.random.split(k, 2 * d)
+            L = jnp.zeros((d, d))
+            L = L.at[0, 0].set(1.0)
+            for i in range(1, d):
+                b = eta + (d - 1 - i) / 2.0
+                y = jax.random.beta(ks[2 * i], i / 2.0, b)
+                u = jax.random.normal(ks[2 * i + 1], (i,))
+                u = u / jnp.linalg.norm(u)
+                L = L.at[i, :i].set(jnp.sqrt(y) * u)
+                L = L.at[i, i].set(jnp.sqrt(1.0 - y))
+            return L
+
+        import numpy as np
+        n = int(np.prod(full)) if full else 1
+        keys = jax.random.split(key, max(n, 1))
+        flat = jnp.stack([one(keys[i]) for i in range(n)])
+        return flat.reshape(tuple(full) + (d, d)) if full else flat[0]
+
+    def _log_prob(self, value):
+        d = self.dim
+        eta = self.concentration
+        diag = jnp.diagonal(value, axis1=-2, axis2=-1)[..., 1:]
+        orders = jnp.arange(d - 1, 0, -1) + 2.0 * (eta - 1.0)
+        unnorm = jnp.sum(orders * jnp.log(diag), axis=-1)
+        # normalizer (Stan reference): sum of log-beta terms
+        i = jnp.arange(1, d)
+        alpha = eta + (d - 1 - i) / 2.0
+        lognorm = jnp.sum(i * jnp.log(jnp.pi) / 2.0
+                          + jsp.gammaln(alpha)
+                          - jsp.gammaln(alpha + i / 2.0))
+        return unnorm - lognorm

@@ -182,7 +182,13 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
         if len(cand) == 0 or k == 0:
             out_cnt.append(0)
             continue
-        p = wts / wts.sum() if wts.sum() > 0 else None
+        if wts.sum() > 0:
+            p = wts / wts.sum()
+            # without replacement k is capped by the number of non-zero
+            # weight neighbors (choice raises otherwise)
+            k = min(k, int((wts > 0).sum()))
+        else:
+            p = None
         sel = rng.choice(len(cand), size=k, replace=False, p=p)
         out_nbr.append(cand[sel])
         out_cnt.append(k)

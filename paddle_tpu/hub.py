@@ -57,3 +57,22 @@ def load(repo_dir, model, source="local", force_reload=False, **kwargs):
     if fn is None:
         raise ValueError(f"model '{model}' not found in {repo_dir}")
     return fn(**kwargs)
+
+
+def load_state_dict_from_url(url, model_dir=None, check_hash=False,
+                             file_name=None, map_location=None):
+    """Reference downloads a checkpoint; zero-egress here — loads from a
+    local path or a file already in model_dir."""
+    import os
+    from .framework import load as _load
+    if os.path.exists(url):
+        return _load(url)
+    cand = os.path.join(model_dir or ".", file_name or os.path.basename(url))
+    if os.path.exists(cand):
+        return _load(cand)
+    raise RuntimeError(
+        "load_state_dict_from_url needs network egress; place the file at "
+        f"'{cand}' and pass that path instead")
+
+
+__all__.append("load_state_dict_from_url")

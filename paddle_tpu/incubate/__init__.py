@@ -17,18 +17,26 @@ from ..geometric import (  # noqa: F401
 
 
 def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
-                       return_eids=False, **kw):
+                       sorted_eids=None, return_eids=False, **kw):
     """Multi-hop sampling by chaining sample_neighbors (reference
     graph_khop_sampler): returns (edge_src, edge_dst, sample_index,
-    reindex_nodes) — reindexed sampled subgraph."""
+    reindex_nodes[, edge_eids]) — the reindexed sampled subgraph.
+    sample_index holds the original node ids in new-id order."""
     import numpy as np
     from ..geometric import sample_neighbors
     from ..core.tensor import Tensor
     from .. import ops
     nodes = input_nodes
-    srcs, dsts = [], []
+    srcs, dsts, eids = [], [], []
     for k in sample_sizes:
-        out, counts = sample_neighbors(row, colptr, nodes, sample_size=k)
+        res = sample_neighbors(row, colptr, nodes, sample_size=k,
+                               eids=sorted_eids,
+                               return_eids=sorted_eids is not None)
+        if sorted_eids is not None:
+            out, counts, eid = res
+            eids.append(eid)
+        else:
+            out, counts = res
         # each sampled neighbor's dst is the node it was drawn for,
         # repeated per-count
         n_np = np.asarray(nodes.numpy() if isinstance(nodes, Tensor)
@@ -44,7 +52,15 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
         else Tensor(np.asarray(input_nodes))
     (edge_src_r, edge_dst_r, sample_index), _ = _khop_reindex(
         seeds, edge_src, edge_dst)
-    return edge_src_r, edge_dst_r, sample_index, seeds
+    # reindex_nodes: the new (compacted) ids of the seed nodes
+    reindex_nodes = Tensor(np.arange(len(np.asarray(seeds.numpy()).reshape(-1)),
+                                     dtype=np.int64))
+    out = (edge_src_r, edge_dst_r, sample_index, reindex_nodes)
+    if return_eids:
+        if not eids:
+            raise ValueError("return_eids=True requires sorted_eids")
+        out = out + (ops.concat(eids),)
+    return out
 
 
 def _khop_reindex(seeds, edge_src, edge_dst):

@@ -173,8 +173,13 @@ class LlamaModel(nn.Layer):
         for layer in self.layers:
             if self.config.recompute and self.training:
                 from ..distributed.fleet.recompute import recompute as _rc
+                # config.recompute may name a selective policy (see
+                # fleet.recompute): True = drop everything (reference
+                # semantics), "dots_saveable" = keep GEMM outputs
+                pol = (self.config.recompute
+                       if isinstance(self.config.recompute, str) else None)
                 hidden_states = _rc(layer, hidden_states,
-                                    position_ids, attn_mask)
+                                    position_ids, attn_mask, policy=pol)
             else:
                 hidden_states = layer(hidden_states, position_ids, attn_mask)
             hidden_states = sharding_constraint(

@@ -206,42 +206,3 @@ class Bilinear(Initializer):
         return jnp.asarray(w, dtype)
 
 
-class Orthogonal(Initializer):
-    """Orthogonal init (reference nn.initializer.Orthogonal)."""
-
-    def __init__(self, gain=1.0):
-        self.gain = gain
-
-    def __call__(self, shape, dtype):
-        import numpy as np
-        from ..core import random as _rng
-        import jax
-        import jax.numpy as jnp
-        rows = shape[0]
-        cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
-        flat = jax.random.normal(_rng.next_key(), (max(rows, cols),
-                                                   min(rows, cols)))
-        q, r = jnp.linalg.qr(flat)
-        q = q * jnp.sign(jnp.diagonal(r))
-        if rows < cols:
-            q = q.T
-        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
-
-
-class Dirac(Initializer):
-    """Identity-preserving conv init (reference nn.initializer.Dirac)."""
-
-    def __init__(self, groups=1):
-        self.groups = groups
-
-    def __call__(self, shape, dtype):
-        import numpy as np
-        import jax.numpy as jnp
-        w = np.zeros(shape, np.float32)
-        out_c, in_c = shape[0], shape[1]
-        per = out_c // self.groups
-        centers = [s // 2 for s in shape[2:]]
-        for g in range(self.groups):
-            for i in range(min(per, in_c)):
-                w[(g * per + i, i) + tuple(centers)] = 1.0
-        return jnp.asarray(w, dtype)

@@ -9,6 +9,8 @@ from .ops import (
     attention, cast,
     abs, sin, tan, asin, atan, sinh, tanh, asinh, atanh, sqrt, square,
     log1p, expm1, relu, relu6, leaky_relu, neg, sign,
+    pow, deg2rad, rad2deg, isnan, mv, addmm, mask_as, transpose, reshape,
+    sum, slice, pca_lowrank,
 )
 from . import nn
 

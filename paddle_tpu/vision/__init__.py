@@ -4,3 +4,33 @@ from . import transforms
 from . import models
 from . import ops
 from .models import LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, MobileNetV1, AlexNet, VGG
+
+_image_backend = "numpy"
+
+
+def set_image_backend(backend):
+    """Select the image-decode backend (reference set_image_backend:
+    pil|cv2; here numpy|pil — PIL used when available)."""
+    global _image_backend
+    if backend not in ("numpy", "pil", "cv2"):
+        raise ValueError(f"unknown image backend {backend}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file (reference image_load). npy arrays load natively;
+    JPEG/PNG via PIL when present."""
+    import numpy as np
+    b = backend or _image_backend
+    if str(path).endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+        return Image.open(path)
+    except ImportError:
+        raise RuntimeError("image_load for encoded formats needs Pillow; "
+                           "save arrays as .npy in this environment")

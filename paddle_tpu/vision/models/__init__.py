@@ -1,14 +1,21 @@
 """Vision models (reference: python/paddle/vision/models/ — LeNet, ResNet,
 VGG, MobileNet v1-v3, AlexNet...)."""
 from .lenet import LeNet
-from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, BasicBlock, BottleneckBlock
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152, resnext50_32x4d, resnext50_64x4d,
+                     resnext101_32x4d, resnext101_64x4d, resnext152_32x4d,
+                     resnext152_64x4d, BasicBlock, BottleneckBlock)
 from .mobilenet import MobileNetV1, mobilenet_v1
 from .mobilenetv2 import MobileNetV2, mobilenet_v2
 from .mobilenetv3 import (MobileNetV3Large, MobileNetV3Small,
                           mobilenet_v3_large, mobilenet_v3_small)
 from .alexnet import AlexNet, alexnet
-from .vgg import VGG, vgg11, vgg16
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .extra import (SqueezeNet, squeezenet1_0, squeezenet1_1,
-                    DenseNet, densenet121, GoogLeNet, googlenet,
-                    ShuffleNetV2, shufflenet_v2_x1_0,
-                    wide_resnet50_2, wide_resnet101_2)
+                    DenseNet, densenet121, densenet161, densenet169,
+                    densenet201, densenet264, GoogLeNet, googlenet,
+                    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+                    shufflenet_v2_x0_5, shufflenet_v2_x1_0,
+                    shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+                    shufflenet_v2_swish, wide_resnet50_2, wide_resnet101_2)
+from .inception import InceptionV3, inception_v3

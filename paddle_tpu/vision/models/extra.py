@@ -98,7 +98,8 @@ class DenseNet(nn.Layer):
                  num_classes=1000, with_pool=True):
         super().__init__()
         cfgs = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
-                169: (6, 12, 32, 32), 201: (6, 12, 48, 32)}
+                169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+                264: (6, 12, 64, 48)}
         block_cfg = cfgs[layers]
         num_init = 2 * growth_rate
         feats = [nn.Conv2D(3, num_init, 7, stride=2, padding=3,
@@ -233,7 +234,8 @@ class _ShuffleUnit(nn.Layer):
 class ShuffleNetV2(nn.Layer):
     def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
         super().__init__()
-        stage_out = {0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+        stage_out = {0.25: (24, 48, 96, 512), 0.33: (32, 64, 128, 512),
+                     0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
                      1.5: (176, 352, 704, 1024),
                      2.0: (244, 488, 976, 2048)}[scale]
         self.stem = nn.Sequential(
@@ -275,3 +277,55 @@ def wide_resnet50_2(**kw):
 def wide_resnet101_2(**kw):
     from .resnet import ResNet, BottleneckBlock
     return ResNet(BottleneckBlock, 101, width=128, **kw)
+
+
+def densenet161(**kw):
+    return DenseNet(161, growth_rate=48, **kw)
+
+
+def densenet169(**kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(**kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(**kw):
+    return DenseNet(264, **kw)
+
+
+def shufflenet_v2_x0_25(**kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_33(**kw):
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_x0_5(**kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_5(**kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(**kw):
+    return ShuffleNetV2(2.0, **kw)
+
+
+def shufflenet_v2_swish(**kw):
+    """ShuffleNetV2 1.0x with swish activations (reference
+    shufflenet_v2_swish): same trunk, ReLU swapped for Swish."""
+    from ... import nn as _nn
+    net = ShuffleNetV2(1.0, **kw)
+
+    def swap(layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, _nn.ReLU):
+                layer._sub_layers[name] = _nn.Swish()
+            else:
+                swap(sub)
+    swap(net)
+    return net

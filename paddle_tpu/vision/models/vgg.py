@@ -3,8 +3,12 @@ from ... import nn
 
 _CFGS = {
     "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
     "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
           512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
 }
 
 
@@ -44,3 +48,11 @@ def vgg11(pretrained=False, batch_norm=False, **kwargs):
 
 def vgg16(pretrained=False, batch_norm=False, **kwargs):
     return VGG(_make_features(_CFGS["D"], batch_norm), **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    return VGG(_make_features(_CFGS["B"], batch_norm), **kwargs)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kwargs):
+    return VGG(_make_features(_CFGS["E"], batch_norm), **kwargs)

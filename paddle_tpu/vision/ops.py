@@ -290,3 +290,475 @@ class DeformConv2D:
                                      deformable_groups, groups, mask)
 
         return _DeformConv2D()
+
+
+class RoIAlign(object):
+    """Layer form of roi_align (reference vision.ops.RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool(object):
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Position-sensitive RoI pooling (reference psroi_pool, R-FCN):
+    input channels C = out_c * oh * ow; bin (i, j) of each RoI averages the
+    (i*ow+j)-th channel group over that bin's spatial extent."""
+    import jax.numpy as jnp
+    from ..core.dispatch import apply_op
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+
+    def impl(feat, rois):
+        n, c, h, w = feat.shape
+        out_c = c // (oh * ow)
+        outs = []
+        for r in range(rois.shape[0]):
+            x1, y1, x2, y2 = [rois[r, k] * spatial_scale for k in range(4)]
+            rh = jnp.maximum(y2 - y1, 1e-3) / oh
+            rw = jnp.maximum(x2 - x1, 1e-3) / ow
+            bins = []
+            for i in range(oh):
+                row = []
+                for j in range(ow):
+                    ys = jnp.clip(jnp.floor(y1 + i * rh), 0, h - 1).astype(int)
+                    ye = jnp.clip(jnp.ceil(y1 + (i + 1) * rh), 1, h).astype(int)
+                    xs = jnp.clip(jnp.floor(x1 + j * rw), 0, w - 1).astype(int)
+                    xe = jnp.clip(jnp.ceil(x1 + (j + 1) * rw), 1, w).astype(int)
+                    grp = feat[0, (i * ow + j) * out_c:(i * ow + j + 1) * out_c]
+                    # dynamic_slice-free: mask-weighted mean over the bin
+                    yy = jnp.arange(h)[:, None]
+                    xx = jnp.arange(w)[None, :]
+                    m = ((yy >= ys) & (yy < ye) & (xx >= xs) & (xx < xe))
+                    s = jnp.where(m[None], grp, 0.0).sum((1, 2))
+                    cnt = jnp.maximum(m.sum(), 1)
+                    row.append(s / cnt)
+                bins.append(jnp.stack(row, -1))
+            outs.append(jnp.stack(bins, -2))
+        return jnp.stack(outs)
+    return apply_op("psroi_pool", impl, (x, boxes), {})
+
+
+class PSRoIPool(object):
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False):
+    """SSD prior/anchor boxes (reference prior_box op): one set of default
+    boxes per feature-map cell."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    feat = input.shape
+    img = image.shape
+    fh, fw = feat[2], feat[3]
+    ih, iw = img[2], img[3]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    variances = []
+    for i in range(fh):
+        for j in range(fw):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                if min_max_aspect_ratios_order:
+                    cell.append((cx, cy, ms, ms))
+                    if max_sizes:
+                        big = np.sqrt(ms * max_sizes[k])
+                        cell.append((cx, cy, big, big))
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        cell.append((cx, cy, ms * np.sqrt(ar), ms / np.sqrt(ar)))
+                else:
+                    for ar in ars:
+                        cell.append((cx, cy, ms * np.sqrt(ar), ms / np.sqrt(ar)))
+                    if max_sizes:
+                        big = np.sqrt(ms * max_sizes[k])
+                        cell.append((cx, cy, big, big))
+            for (ccx, ccy, bw, bh) in cell:
+                boxes.append(((ccx - bw / 2) / iw, (ccy - bh / 2) / ih,
+                              (ccx + bw / 2) / iw, (ccy + bh / 2) / ih))
+                variances.append(variance)
+    b = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
+    if clip:
+        b = np.clip(b, 0.0, 1.0)
+    v = np.asarray(variances, np.float32).reshape(fh, fw, -1, 4)
+    return Tensor(b), Tensor(v)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0):
+    """Encode/decode boxes against priors (reference box_coder op)."""
+    import jax.numpy as jnp
+    from ..core.dispatch import apply_op
+
+    def impl(pb, pbv, tb):
+        pw = pb[:, 2] - pb[:, 0] + (0.0 if box_normalized else 1.0)
+        ph = pb[:, 3] - pb[:, 1] + (0.0 if box_normalized else 1.0)
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + (0.0 if box_normalized else 1.0)
+            th = tb[:, 3] - tb[:, 1] + (0.0 if box_normalized else 1.0)
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            ox = (tcx[:, None] - pcx[None]) / pw[None]
+            oy = (tcy[:, None] - pcy[None]) / ph[None]
+            ow = jnp.log(tw[:, None] / pw[None])
+            oh = jnp.log(th[:, None] / ph[None])
+            out = jnp.stack([ox, oy, ow, oh], -1)
+            if pbv is not None:
+                out = out / pbv[None]
+            return out
+        # decode: target [N, M, 4] offsets against priors
+        deltas = tb
+        if pbv is not None:
+            deltas = deltas * (pbv[None] if pbv.ndim == 2 else pbv)
+        dcx = pcx + deltas[..., 0] * pw
+        dcy = pcy + deltas[..., 1] * ph
+        dw = pw * jnp.exp(deltas[..., 2])
+        dh = ph * jnp.exp(deltas[..., 3])
+        return jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                          dcx + dw * 0.5 - (0.0 if box_normalized else 1.0),
+                          dcy + dh * 0.5 - (0.0 if box_normalized else 1.0)],
+                         -1)
+    args = (prior_box, prior_box_var, target_box) \
+        if prior_box_var is not None else (prior_box, target_box)
+    if prior_box_var is None:
+        return apply_op("box_coder", lambda pb, tb: impl(pb, None, tb),
+                        args, {})
+    return apply_op("box_coder", impl, args, {})
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output to boxes+scores (reference yolo_box op)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.dispatch import apply_op
+    na = len(anchors) // 2
+
+    def impl(xa, imsz):
+        n, c, h, w = xa.shape
+        attrs = 5 + class_num
+        xa = xa.reshape(n, na, attrs, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        bx = (jax.nn.sigmoid(xa[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gx) / w
+        by = (jax.nn.sigmoid(xa[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gy) / h
+        aw = jnp.asarray(anchors[0::2], jnp.float32).reshape(1, na, 1, 1)
+        ah = jnp.asarray(anchors[1::2], jnp.float32).reshape(1, na, 1, 1)
+        in_w = downsample_ratio * w
+        in_h = downsample_ratio * h
+        bw = jnp.exp(xa[:, :, 2]) * aw / in_w
+        bh = jnp.exp(xa[:, :, 3]) * ah / in_h
+        conf = jax.nn.sigmoid(xa[:, :, 4])
+        probs = jax.nn.sigmoid(xa[:, :, 5:]) * conf[:, :, None]
+        ih = imsz[:, 0].astype(jnp.float32).reshape(n, 1, 1, 1)
+        iw = imsz[:, 1].astype(jnp.float32).reshape(n, 1, 1, 1)
+        x1 = (bx - bw / 2) * iw
+        y1 = (by - bh / 2) * ih
+        x2 = (bx + bw / 2) * iw
+        y2 = (by + bh / 2) * ih
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, iw - 1)
+            y1 = jnp.clip(y1, 0, ih - 1)
+            x2 = jnp.clip(x2, 0, iw - 1)
+            y2 = jnp.clip(y2, 0, ih - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+        scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+        keep = (conf.reshape(n, -1) >= conf_thresh)[..., None]
+        return boxes * keep, scores * keep
+    return apply_op("yolo_box", impl, (x, img_size), {})
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0):
+    """YOLOv3 training loss (reference yolo_loss op): coordinate +
+    objectness + class terms per anchor cell; targets assigned by best-IoU
+    anchor per gt."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.dispatch import apply_op
+    na = len(anchor_mask)
+
+    def impl(xa, gtb, gtl):
+        n, c, h, w = xa.shape
+        attrs = 5 + class_num
+        pred = xa.reshape(n, na, attrs, h, w)
+        in_w = downsample_ratio * w
+        in_h = downsample_ratio * h
+        masked = [(anchors[2 * m], anchors[2 * m + 1]) for m in anchor_mask]
+        loss = jnp.zeros((n,), jnp.float32)
+        for b in range(gtb.shape[1]):
+            bx, by, bw, bh = [gtb[:, b, k] for k in range(4)]  # normalized cx,cy,w,h
+            has = (bw > 0) & (bh > 0)
+            gi = jnp.clip((bx * w).astype(int), 0, w - 1)
+            gj = jnp.clip((by * h).astype(int), 0, h - 1)
+            ious = jnp.stack([
+                jnp.minimum(bw * in_w, aw) * jnp.minimum(bh * in_h, ah) /
+                jnp.maximum(bw * in_w * bh * in_h + aw * ah -
+                            jnp.minimum(bw * in_w, aw) * jnp.minimum(bh * in_h, ah), 1e-6)
+                for aw, ah in masked], 1)
+            best = jnp.argmax(ious, 1)
+            bidx = jnp.arange(n)
+            px = jax.nn.sigmoid(pred[bidx, best, 0, gj, gi])
+            py = jax.nn.sigmoid(pred[bidx, best, 1, gj, gi])
+            tx = bx * w - gi
+            ty = by * h - gj
+            aw = jnp.asarray([a[0] for a in masked], jnp.float32)[best]
+            ah = jnp.asarray([a[1] for a in masked], jnp.float32)[best]
+            pw = pred[bidx, best, 2, gj, gi]
+            ph = pred[bidx, best, 3, gj, gi]
+            tw = jnp.log(jnp.maximum(bw * in_w / aw, 1e-6))
+            th = jnp.log(jnp.maximum(bh * in_h / ah, 1e-6))
+            obj = pred[bidx, best, 4, gj, gi]
+            cls_logits = pred[bidx, best, 5:, gj, gi]
+            tcls = jax.nn.one_hot(gtl[:, b], class_num)
+            if use_label_smooth:
+                # paddle yolo_loss smoothing: positives 1-1/C, negatives 1/C
+                delta = 1.0 / class_num
+                tcls = tcls * (1 - delta) + (1 - tcls) * delta
+            term = ((px - tx) ** 2 + (py - ty) ** 2
+                    + (pw - tw) ** 2 + (ph - th) ** 2
+                    + jnp.maximum(obj, 0) - obj + jnp.log1p(jnp.exp(-jnp.abs(obj)))
+                    + (jnp.maximum(cls_logits, 0) - cls_logits * tcls
+                       + jnp.log1p(jnp.exp(-jnp.abs(cls_logits)))).sum(-1))
+            loss = loss + jnp.where(has, term, 0.0)
+        return loss
+    return apply_op("yolo_loss", impl, (x, gt_box, gt_label), {})
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True):
+    """Matrix NMS (reference matrix_nms op, SOLOv2): soft decay of scores by
+    pairwise IoU — fully parallel, no sequential suppression (TPU-friendly
+    by construction)."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    bb = np.asarray(bboxes.numpy() if isinstance(bboxes, Tensor) else bboxes)
+    sc = np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores)
+    outs, idxs, nums = [], [], []
+    for n in range(bb.shape[0]):
+        cand = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            keep = np.nonzero(s >= score_threshold)[0]
+            for i in keep:
+                cand.append((s[i], c, i))
+        cand.sort(reverse=True)
+        cand = cand[:nms_top_k]
+        if not cand:
+            nums.append(0)
+            continue
+        svals = np.asarray([x[0] for x in cand], np.float32)
+        cls = np.asarray([x[1] for x in cand])
+        box = np.asarray([bb[n, x[2]] for x in cand], np.float32)
+        area = np.maximum(box[:, 2] - box[:, 0], 0) * \
+            np.maximum(box[:, 3] - box[:, 1], 0)
+        x1 = np.maximum(box[:, None, 0], box[None, :, 0])
+        y1 = np.maximum(box[:, None, 1], box[None, :, 1])
+        x2 = np.minimum(box[:, None, 2], box[None, :, 2])
+        y2 = np.minimum(box[:, None, 3], box[None, :, 3])
+        inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+        iou = inter / np.maximum(area[:, None] + area[None] - inter, 1e-9)
+        same = cls[:, None] == cls[None]
+        iou = np.triu(iou * same, 1)  # only higher-scored peers decay
+        iou_cmax = iou.max(0)
+        if use_gaussian:
+            decay = np.exp(-(iou ** 2 - iou_cmax[None] ** 2) / gaussian_sigma).min(0)
+        else:
+            decay = ((1 - iou) / np.maximum(1 - iou_cmax[None], 1e-9)).min(0)
+        final = svals * decay
+        sel = final >= post_threshold
+        order = np.argsort(-final[sel])[:keep_top_k]
+        rows = np.nonzero(sel)[0][order]
+        out = np.concatenate([cls[rows, None].astype(np.float32),
+                              final[rows, None], box[rows]], 1)
+        outs.append(out)
+        idxs.append(np.asarray([cand[r][2] for r in rows], np.int64))
+        nums.append(len(rows))
+    out_t = Tensor(np.concatenate(outs) if outs
+                   else np.zeros((0, 6), np.float32))
+    res = (out_t,)
+    if return_index:
+        res = res + (Tensor(np.concatenate(idxs) if idxs
+                            else np.zeros((0,), np.int64)),)
+    if return_rois_num:
+        res = res + (Tensor(np.asarray(nums, np.int32)),)
+    return res if len(res) > 1 else res[0]
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None):
+    """Assign RoIs to FPN levels by scale (reference
+    distribute_fpn_proposals op): level = floor(refer + log2(sqrt(area)/
+    refer_scale))."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    rois = np.asarray(fpn_rois.numpy() if isinstance(fpn_rois, Tensor)
+                      else fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    ws = np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+    hs = np.maximum(rois[:, 3] - rois[:, 1] + off, 0)
+    scale = np.sqrt(ws * hs)
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-8))
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    multi, restore = [], []
+    order = []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        multi.append(Tensor(rois[idx]))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros((0,), int)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    nums = [Tensor(np.asarray([len(m)], np.int32)) for m in multi]
+    return multi, Tensor(restore.astype(np.int32)), nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False):
+    """RPN proposal generation (reference generate_proposals op): decode
+    anchors with deltas, clip, filter small, NMS."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    sc = np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores)
+    bd = np.asarray(bbox_deltas.numpy() if isinstance(bbox_deltas, Tensor)
+                    else bbox_deltas)
+    an = np.asarray(anchors.numpy() if isinstance(anchors, Tensor)
+                    else anchors).reshape(-1, 4)
+    var = np.asarray(variances.numpy() if isinstance(variances, Tensor)
+                     else variances).reshape(-1, 4)
+    imgs = np.asarray(img_size.numpy() if isinstance(img_size, Tensor)
+                      else img_size)
+    n = sc.shape[0]
+    all_rois, all_nums, all_scores = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = bd[b].transpose(1, 2, 0).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s_k = s[order]
+        d_k = d[order]
+        a_k = an[order % len(an)] if len(an) != len(s) else an[order]
+        v_k = var[order % len(var)] if len(var) != len(s) else var[order]
+        aw = a_k[:, 2] - a_k[:, 0] + off
+        ah = a_k[:, 3] - a_k[:, 1] + off
+        acx = a_k[:, 0] + aw / 2
+        acy = a_k[:, 1] + ah / 2
+        cx = acx + d_k[:, 0] * v_k[:, 0] * aw
+        cy = acy + d_k[:, 1] * v_k[:, 1] * ah
+        wd = aw * np.exp(np.minimum(d_k[:, 2] * v_k[:, 2], 10))
+        hd = ah * np.exp(np.minimum(d_k[:, 3] * v_k[:, 3], 10))
+        boxes = np.stack([cx - wd / 2, cy - hd / 2,
+                          cx + wd / 2 - off, cy + hd / 2 - off], 1)
+        ih, iw = imgs[b, 0], imgs[b, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] >= min_size) &
+                (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s_k = boxes[keep], s_k[keep]
+        # greedy NMS
+        sel = []
+        idx = np.argsort(-s_k)
+        area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        while len(idx) and len(sel) < post_nms_top_n:
+            i = idx[0]
+            sel.append(i)
+            if len(idx) == 1:
+                break
+            rest = idx[1:]
+            xx1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+            yy1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+            xx2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+            yy2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+            inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+            iou = inter / np.maximum(area[i] + area[rest] - inter, 1e-9)
+            idx = rest[iou <= nms_thresh]
+        all_rois.append(boxes[sel])
+        all_scores.append(s_k[sel])
+        all_nums.append(len(sel))
+    rois = Tensor(np.concatenate(all_rois) if all_rois
+                  else np.zeros((0, 4), np.float32))
+    rscores = Tensor(np.concatenate(all_scores) if all_scores
+                     else np.zeros((0,), np.float32))
+    if return_rois_num:
+        return rois, rscores, Tensor(np.asarray(all_nums, np.int32))
+    return rois, rscores
+
+
+def read_file(filename):
+    """Read raw file bytes as a uint8 tensor (reference read_file op)."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(np.frombuffer(data, np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged"):
+    """Decode a JPEG byte tensor to CHW uint8 (reference decode_jpeg,
+    nvjpeg-backed there; PIL/pure-python here, host-side IO op)."""
+    import io
+    import numpy as np
+    from ..core.tensor import Tensor
+    data = bytes(np.asarray(x.numpy() if isinstance(x, Tensor) else x,
+                            np.uint8))
+    try:
+        from PIL import Image
+    except ImportError:
+        raise RuntimeError("decode_jpeg needs Pillow; not bundled in this "
+                           "environment — use vision.image_load on arrays")
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)

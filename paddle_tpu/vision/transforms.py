@@ -129,3 +129,415 @@ class Pad:
         pads = [(0, 0), (p, p), (p, p)] if isinstance(p, int) else \
             [(0, 0), (p[1], p[3]), (p[0], p[2])]
         return np.pad(np.asarray(img), pads, constant_values=self.fill)
+
+
+# -- functional transforms (reference: vision/transforms/functional.py) ----
+def _chw(img):
+    """Normalize input to CHW float32 numpy."""
+    arr = np.asarray(img)
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32)
+    if arr.ndim == 2:
+        arr = arr[None]
+    elif arr.ndim == 3 and arr.shape[-1] in (1, 3, 4) \
+            and arr.shape[0] not in (1, 3, 4):
+        arr = arr.transpose(2, 0, 1)
+    return arr.astype(np.float32)
+
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def hflip(img):
+    return _chw(img)[:, :, ::-1].copy()
+
+
+def vflip(img):
+    return _chw(img)[:, ::-1, :].copy()
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(_chw(img))
+
+
+def crop(img, top, left, height, width):
+    return _chw(img)[:, top:top + height, left:left + width].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(_chw(img))
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _chw(img)
+    if isinstance(padding, int):
+        l = r = t = b = padding
+    elif len(padding) == 2:
+        l, t = padding
+        r, b = padding
+    else:
+        l, t, r, b = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, [(0, 0), (t, b), (l, r)], mode=mode, **kw)
+
+
+def adjust_brightness(img, brightness_factor):
+    return np.clip(_chw(img) * brightness_factor, 0,
+                   255.0 if np.asarray(img).dtype == np.uint8 else None)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _chw(img)
+    mean = arr.mean()
+    return mean + contrast_factor * (arr - mean)
+
+
+def _rgb_to_hsv(arr):
+    r, g, b = arr[0], arr[1], arr[2]
+    maxc = np.maximum(np.maximum(r, g), b)
+    minc = np.minimum(np.minimum(r, g), b)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-8), 0.0)
+    rc = np.where(delta > 0, (maxc - r) / np.maximum(delta, 1e-8), 0.0)
+    gc = np.where(delta > 0, (maxc - g) / np.maximum(delta, 1e-8), 0.0)
+    bc = np.where(delta > 0, (maxc - b) / np.maximum(delta, 1e-8), 0.0)
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    return np.stack([h, s, v])
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[0], hsv[1], hsv[2]
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    conds = [i == k for k in range(6)]
+    r = np.select(conds, [v, q, p, p, t, v])
+    g = np.select(conds, [t, v, v, q, p, p])
+    b = np.select(conds, [p, p, t, v, v, q])
+    return np.stack([r, g, b])
+
+
+def adjust_hue(img, hue_factor):
+    arr = _chw(img)
+    scale = 255.0 if arr.max() > 1.5 else 1.0
+    hsv = _rgb_to_hsv(arr / scale)
+    hsv[0] = (hsv[0] + hue_factor) % 1.0
+    return _hsv_to_rgb(hsv) * scale
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _chw(img)
+    gray = arr.mean(axis=0, keepdims=True)
+    return gray + saturation_factor * (arr - gray)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _chw(img)
+    if arr.shape[0] >= 3:
+        gray = (0.299 * arr[0] + 0.587 * arr[1] + 0.114 * arr[2])[None]
+    else:
+        gray = arr[:1]
+    return np.repeat(gray, num_output_channels, axis=0)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(
+        _chw(img) if data_format == "CHW" else np.asarray(img))
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = _chw(img) if not inplace else np.asarray(img)
+    out = arr if inplace else arr.copy()
+    out[:, i:i + h, j:j + w] = v
+    return out
+
+
+def _inverse_warp(arr, matrix, fill=0.0):
+    """Apply the INVERSE 3x3 homography to sample: out(x) = in(M^-1 x),
+    bilinear."""
+    c, h, w = arr.shape
+    inv = np.linalg.inv(matrix)
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones]).reshape(3, -1).astype(np.float64)
+    src = inv @ coords
+    sx = src[0] / src[2]
+    sy = src[1] / src[2]
+    x0 = np.floor(sx).astype(np.int64)
+    y0 = np.floor(sy).astype(np.int64)
+    fx = (sx - x0).astype(np.float32)
+    fy = (sy - y0).astype(np.float32)
+
+    def sample(yi, xi):
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = np.clip(yi, 0, h - 1)
+        xc = np.clip(xi, 0, w - 1)
+        vals = arr[:, yc, xc]
+        return np.where(valid[None], vals, fill)
+
+    out = (sample(y0, x0) * (1 - fx) * (1 - fy)
+           + sample(y0, x0 + 1) * fx * (1 - fy)
+           + sample(y0 + 1, x0) * (1 - fx) * fy
+           + sample(y0 + 1, x0 + 1) * fx * fy)
+    return out.reshape(c, h, w).astype(np.float32)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    cx, cy = center
+    rot = np.deg2rad(angle)
+    sx, sy = [np.deg2rad(s) for s in (shear if isinstance(shear, (list, tuple))
+                                      else (shear, 0.0))]
+    a = np.cos(rot - sy) / max(np.cos(sy), 1e-8)
+    b = -np.cos(rot - sy) * np.tan(sx) / max(np.cos(sy), 1e-8) - np.sin(rot)
+    c_ = np.sin(rot - sy) / max(np.cos(sy), 1e-8)
+    d = -np.sin(rot - sy) * np.tan(sx) / max(np.cos(sy), 1e-8) + np.cos(rot)
+    m = np.array([[a * scale, b * scale, 0.0],
+                  [c_ * scale, d * scale, 0.0],
+                  [0.0, 0.0, 1.0]])
+    pre = np.array([[1, 0, cx + translate[0]], [0, 1, cy + translate[1]],
+                    [0, 0, 1.0]])
+    post = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1.0]])
+    return pre @ m @ post
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="bilinear", fill=0, center=None):
+    arr = _chw(img)
+    _, h, w = arr.shape
+    ctr = center or ((w - 1) / 2, (h - 1) / 2)
+    m = _affine_matrix(angle, translate, scale, shear, ctr)
+    return _inverse_warp(arr, m, fill)
+
+
+def rotate(img, angle, interpolation="bilinear", expand=False, center=None,
+           fill=0):
+    return affine(img, angle=angle, center=center, fill=fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="bilinear",
+                fill=0):
+    """Warp by the homography mapping startpoints -> endpoints (reference
+    perspective)."""
+    arr = _chw(img)
+    A = []
+    bvec = []
+    for (x, y), (u, v) in zip(startpoints, endpoints):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        bvec.append(u)
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        bvec.append(v)
+    coeffs = np.linalg.solve(np.asarray(A, np.float64),
+                             np.asarray(bvec, np.float64))
+    m = np.append(coeffs, 1.0).reshape(3, 3)
+    return _inverse_warp(arr, m, fill)
+
+
+# -- class transforms built on the functionals -----------------------------
+class BaseTransform:
+    """Transform protocol (reference BaseTransform): _apply_image plus
+    optional keys routing."""
+
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if self.keys is None or not isinstance(inputs, (tuple, list)):
+            return self._apply_image(inputs)
+        out = []
+        for key, item in zip(self.keys, inputs):
+            out.append(self._apply_image(item) if key == "image" else item)
+        return tuple(out)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+
+    def _apply_image(self, img):
+        arr = _chw(img)
+        _, h, w = arr.shape
+        rng = np.random.default_rng()
+        for _ in range(10):
+            area = h * w * rng.uniform(*self.scale)
+            ar = np.exp(rng.uniform(np.log(self.ratio[0]),
+                                    np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(area * ar)))
+            ch = int(round(np.sqrt(area / ar)))
+            if cw <= w and ch <= h:
+                top = rng.integers(0, h - ch + 1)
+                left = rng.integers(0, w - cw + 1)
+                return resize(crop(arr, top, left, ch, cw), self.size)
+        return resize(center_crop(arr, (min(h, w), min(h, w))), self.size)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _chw(img)
+        f = np.random.default_rng().uniform(max(0, 1 - self.value),
+                                            1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _chw(img)
+        f = np.random.default_rng().uniform(max(0, 1 - self.value),
+                                            1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _chw(img)
+        f = np.random.default_rng().uniform(-self.value, self.value)
+        return adjust_hue(img, f)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        order = np.random.default_rng().permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i](img)
+        return img
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="bilinear", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) else degrees
+        self.translate, self.scale_rng, self.shear = translate, scale, shear
+        self.fill, self.center = fill, center
+
+    def _apply_image(self, img):
+        rng = np.random.default_rng()
+        arr = _chw(img)
+        _, h, w = arr.shape
+        angle = rng.uniform(*self.degrees)
+        tr = (0, 0)
+        if self.translate is not None:
+            tr = (rng.uniform(-self.translate[0], self.translate[0]) * w,
+                  rng.uniform(-self.translate[1], self.translate[1]) * h)
+        sc = rng.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            s = self.shear if not np.isscalar(self.shear) \
+                else (-self.shear, self.shear)
+            sh = (rng.uniform(*s[:2]), rng.uniform(*s[2:]) if len(s) > 2 else 0.0)
+        return affine(arr, angle, tr, sc, sh, fill=self.fill,
+                      center=self.center)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="bilinear", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) else degrees
+        self.center, self.fill = center, fill
+
+    def _apply_image(self, img):
+        angle = np.random.default_rng().uniform(*self.degrees)
+        return rotate(img, angle, center=self.center, fill=self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="bilinear", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob, self.distortion_scale = prob, distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        rng = np.random.default_rng()
+        arr = _chw(img)
+        if rng.uniform() > self.prob:
+            return arr
+        _, h, w = arr.shape
+        d = self.distortion_scale
+        half_h, half_w = int(h * d / 2), int(w * d / 2)
+        tl = (rng.integers(0, half_w + 1), rng.integers(0, half_h + 1))
+        tr = (w - 1 - rng.integers(0, half_w + 1), rng.integers(0, half_h + 1))
+        br = (w - 1 - rng.integers(0, half_w + 1),
+              h - 1 - rng.integers(0, half_h + 1))
+        bl = (rng.integers(0, half_w + 1), h - 1 - rng.integers(0, half_h + 1))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return perspective(arr, start, [tl, tr, br, bl], fill=self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        rng = np.random.default_rng()
+        arr = _chw(img)
+        if rng.uniform() > self.prob:
+            return arr
+        _, h, w = arr.shape
+        for _ in range(10):
+            area = h * w * rng.uniform(*self.scale)
+            ar = np.exp(rng.uniform(np.log(self.ratio[0]),
+                                    np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(area / ar)))
+            ew = int(round(np.sqrt(area * ar)))
+            if eh < h and ew < w:
+                i = rng.integers(0, h - eh + 1)
+                j = rng.integers(0, w - ew + 1)
+                return erase(arr, i, j, eh, ew, self.value,
+                             inplace=self.inplace)
+        return arr
