@@ -29,9 +29,15 @@ def main():
             num_hidden_layers=24, num_attention_heads=16,
             num_key_value_heads=16, max_position_embeddings=2048,
             dtype="bfloat16")
-        # measured on this chip: bs8 w/o fused_lm_loss gives the best MFU
-        # (0.53); fused chunked LM loss frees ~2GB and fits bs12 but its
-        # backward recompute costs more than the batch gain at this size
+        # measured on this chip (v5e, 16GB): bs8 w/o fused_lm_loss gives the
+        # best MFU (0.53). The round-2 tuning matrix confirmed the plateau:
+        #   bs10 34.9k, bs12+fused 34.5k, bs16 rc=full 28.0k,
+        #   bs32 rc=full+fused 27.7k, bs8 rc=dots_saveable 31.0k,
+        #   bs4 seq4096 29.1k, fused qkv+ffn projections 35.9k,
+        #   XLA attention == Pallas flash at S=2048 (36.4k)
+        # vs bs8 baseline 36.3-36.7k. Bigger batches force remat (explicit
+        # or XLA-implicit) whose FLOPs exceed the batching gain; CE is
+        # already fully fused (~2ms of a 452ms step).
         batch, seq, iters, warmup = 8, 2048, 20, 3
     else:  # CPU smoke so the driver always gets a line
         cfg = LlamaConfig.tiny(dtype="float32")

@@ -141,6 +141,4 @@ def disable_static(place=None):
     _dispatch.set_static_recorder(None)
 
 
-def in_dynamic_mode():
-    from . import static as _static
-    return not _static._static_mode
+# in_dynamic_mode comes from framework.compat (star import above)

@@ -66,12 +66,15 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
         raw = f.readframes(count)
     dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
     data = np.frombuffer(raw, dtype=dt).reshape(-1, ch)
-    if width == 1:
-        wav = (data.astype(np.float32) - 128.0) / 128.0
+    if normalize:
+        if width == 1:
+            wav = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            wav = data.astype(np.float32) / float(2 ** (8 * width - 1))
     else:
-        wav = data.astype(np.float32) / float(2 ** (8 * width - 1))
-    if not normalize:
-        wav = data.astype(np.float32)
+        # raw sample values; 8-bit WAV is unsigned so center it to keep the
+        # zero point consistent across widths
+        wav = data.astype(np.float32) - (128.0 if width == 1 else 0.0)
     out = wav.T if channels_first else wav
     return Tensor(np.ascontiguousarray(out)), sr
 

@@ -57,6 +57,20 @@ class _AudioClassifyDataset(Dataset):
             return np.asarray(wav.numpy())[0]
         return self._waves[idx]
 
+    def _extractor(self):
+        # built once — the mel filterbank/DCT matrices and the compiled
+        # STFT pipeline are shared by every sample
+        if getattr(self, "_feat", None) is None:
+            feat_cls = {"spectrogram": features.Spectrogram,
+                        "melspectrogram": features.MelSpectrogram,
+                        "logmelspectrogram": features.LogMelSpectrogram,
+                        "mfcc": features.MFCC}[self.feat_type]
+            kwargs = dict(self.feat_kwargs)
+            if self.feat_type != "spectrogram":
+                kwargs.setdefault("sr", self.sample_rate)
+            self._feat = feat_cls(**kwargs)
+        return self._feat
+
     def __getitem__(self, idx):
         wav = self._waveform(idx)
         label = self._labels[idx]
@@ -64,13 +78,7 @@ class _AudioClassifyDataset(Dataset):
             return wav, label
         from ..core.tensor import Tensor
         x = Tensor(wav[None])
-        feat_cls = {"spectrogram": features.Spectrogram,
-                    "melspectrogram": features.MelSpectrogram,
-                    "logmelspectrogram": features.LogMelSpectrogram,
-                    "mfcc": features.MFCC}[self.feat_type]
-        feat = feat_cls(sr=self.sample_rate, **self.feat_kwargs) \
-            if self.feat_type == "mfcc" else feat_cls(**self.feat_kwargs)
-        return np.asarray(feat(x).numpy())[0], label
+        return np.asarray(self._extractor()(x).numpy())[0], label
 
     def __len__(self):
         return len(self._labels)

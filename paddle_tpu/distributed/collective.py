@@ -342,13 +342,12 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0,
                         group=None):
-    """Object scatter (reference scatter_object_list): single-controller —
-    rank r receives in_object_list[r]."""
-    _, g = _axis(group)
-    rank = 0
+    """Object scatter (reference scatter_object_list). Single-controller
+    semantics: this process IS rank 0 of the driving program, so it keeps
+    slice 0; per-shard routing happens in SPMD compute, not host objects."""
     objs = in_object_list or []
     if objs:
-        out_object_list.append(objs[rank % len(objs)])
+        out_object_list.append(objs[0])
     return out_object_list
 
 

@@ -17,12 +17,7 @@ from .mesh import ProcessMesh, set_mesh, get_mesh
 _parallel_env = {"initialized": False}
 
 
-_initialized = False
-
-
 def init_parallel_env():
-    global _initialized
-    _initialized = True
     """Reference parallel.py:978. Reads the same env contract
     (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER) when present to
     bootstrap multi-host jax.distributed; on a single host it just builds the
@@ -135,19 +130,18 @@ class ParallelMode:
 
 def is_initialized():
     """True once init_parallel_env ran (reference is_initialized)."""
-    return _initialized
+    return _parallel_env["initialized"]
 
 
 def destroy_process_group(group=None):
     """Tear down groups (reference destroy_process_group). Collectives here
     are compiler ops over the mesh, so this clears the Group registry."""
-    global _initialized
     from . import collective
     if group is not None:
         collective._group_registry.pop(getattr(group, "id", group), None)
     else:
         collective._group_registry.clear()
-        _initialized = False
+        _parallel_env["initialized"] = False
 
 
 def is_available():

@@ -190,15 +190,19 @@ class LKJCholesky(Distribution):
         return flat.reshape(tuple(full) + (d, d)) if full else flat[0]
 
     def _log_prob(self, value):
+        """Density w.r.t. Lebesgue measure on the strictly-lower rows.
+        Row r (0-indexed, 1..d-1) contributes L_rr^(2(eta-1) + d-1-r); the
+        normalizer comes from the onion factorization: each row's radius
+        y=|w|^2 ~ Beta(r/2, eta+(d-1-r)/2) with a uniform sphere direction."""
         d = self.dim
         eta = self.concentration
         diag = jnp.diagonal(value, axis1=-2, axis2=-1)[..., 1:]
-        orders = jnp.arange(d - 1, 0, -1) + 2.0 * (eta - 1.0)
+        # exponent for row r=1..d-1: 2*(eta-1) + (d-1-r)
+        orders = jnp.arange(d - 2, -1, -1) + 2.0 * (eta - 1.0)
         unnorm = jnp.sum(orders * jnp.log(diag), axis=-1)
-        # normalizer (Stan reference): sum of log-beta terms
-        i = jnp.arange(1, d)
-        alpha = eta + (d - 1 - i) / 2.0
-        lognorm = jnp.sum(i * jnp.log(jnp.pi) / 2.0
-                          + jsp.gammaln(alpha)
-                          - jsp.gammaln(alpha + i / 2.0))
+        r = jnp.arange(1, d)
+        b = eta + (d - 1 - r) / 2.0
+        lognorm = jnp.sum(r * jnp.log(jnp.pi) / 2.0
+                          + jsp.gammaln(b)
+                          - jsp.gammaln(b + r / 2.0))
         return unnorm - lognorm

@@ -87,19 +87,39 @@ class LlamaAttention(nn.Layer):
         self.head_dim = config.head_dim
         kv_out = self.num_kv_heads * self.head_dim
         attr = _normal_attr(config)
-        self.q_proj = nn.Linear(h, h, weight_attr=attr, bias_attr=False)
-        self.k_proj = nn.Linear(h, kv_out, weight_attr=attr, bias_attr=False)
-        self.v_proj = nn.Linear(h, kv_out, weight_attr=attr, bias_attr=False)
+        self.fuse_qkv = getattr(config, "fuse_attention_qkv", False)
+        if self.fuse_qkv:
+            # one [h, h + 2*kv] GEMM instead of three (reference
+            # fuse_attention_qkv option of the fleet llama) — fewer, larger
+            # MXU launches
+            self.qkv_proj = nn.Linear(h, h + 2 * kv_out, weight_attr=attr,
+                                      bias_attr=False)
+        else:
+            self.q_proj = nn.Linear(h, h, weight_attr=attr, bias_attr=False)
+            self.k_proj = nn.Linear(h, kv_out, weight_attr=attr,
+                                    bias_attr=False)
+            self.v_proj = nn.Linear(h, kv_out, weight_attr=attr,
+                                    bias_attr=False)
         self.o_proj = nn.Linear(h, h, weight_attr=attr, bias_attr=False)
 
     def forward(self, hidden_states, position_ids=None, attn_mask=None):
         b, s = hidden_states.shape[0], hidden_states.shape[1]
-        q = self.q_proj(hidden_states).reshape([b, s, self.num_heads,
+        h = self.num_heads * self.head_dim
+        kv_out = self.num_kv_heads * self.head_dim
+        if self.fuse_qkv:
+            qkv = self.qkv_proj(hidden_states)
+            q = qkv[:, :, :h].reshape([b, s, self.num_heads, self.head_dim])
+            k = qkv[:, :, h:h + kv_out].reshape([b, s, self.num_kv_heads,
+                                                 self.head_dim])
+            v = qkv[:, :, h + kv_out:].reshape([b, s, self.num_kv_heads,
                                                 self.head_dim])
-        k = self.k_proj(hidden_states).reshape([b, s, self.num_kv_heads,
-                                                self.head_dim])
-        v = self.v_proj(hidden_states).reshape([b, s, self.num_kv_heads,
-                                                self.head_dim])
+        else:
+            q = self.q_proj(hidden_states).reshape([b, s, self.num_heads,
+                                                    self.head_dim])
+            k = self.k_proj(hidden_states).reshape([b, s, self.num_kv_heads,
+                                                    self.head_dim])
+            v = self.v_proj(hidden_states).reshape([b, s, self.num_kv_heads,
+                                                    self.head_dim])
         q, k, v = F.fused_rotary_position_embedding(
             q, k, v, position_ids=position_ids,
             use_neox_rotary_style=True, rotary_emb_base=self.config.rope_theta)
@@ -118,12 +138,24 @@ class LlamaMLP(nn.Layer):
     def __init__(self, config):
         super().__init__()
         h, im = config.hidden_size, config.intermediate_size
+        self.im = im
         attr = _normal_attr(config)
-        self.gate_proj = nn.Linear(h, im, weight_attr=attr, bias_attr=False)
-        self.up_proj = nn.Linear(h, im, weight_attr=attr, bias_attr=False)
+        self.fuse_ffn = getattr(config, "fuse_attention_ffn", False)
+        if self.fuse_ffn:
+            # gate+up in one [h, 2*im] GEMM (reference fuse_attention_ffn)
+            self.gate_up_fused_proj = nn.Linear(h, 2 * im, weight_attr=attr,
+                                                bias_attr=False)
+        else:
+            self.gate_proj = nn.Linear(h, im, weight_attr=attr,
+                                       bias_attr=False)
+            self.up_proj = nn.Linear(h, im, weight_attr=attr, bias_attr=False)
         self.down_proj = nn.Linear(im, h, weight_attr=attr, bias_attr=False)
 
     def forward(self, x):
+        if self.fuse_ffn:
+            gu = self.gate_up_fused_proj(x)
+            gate, up = gu[..., :self.im], gu[..., self.im:]
+            return self.down_proj(F.silu(gate) * up)
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
 
 

@@ -40,7 +40,8 @@ def llama_sharding_rules():
         # With hidden over mp the fixups are a plain mp all-gather + dp/fsdp
         # dynamic-slice, both native collectives.
         (r".*embed_tokens\.weight$",        ("fsdp", "mp")),
-        (r".*(q_proj|k_proj|v_proj|gate_proj|up_proj)\.weight$",
+        (r".*(q_proj|k_proj|v_proj|gate_proj|up_proj|qkv_proj|"
+         r"gate_up_fused_proj)\.weight$",
                                             ("fsdp", "mp")),   # column-parallel [in, out]
         (r".*(o_proj|down_proj)\.weight$",  ("mp", "fsdp")),   # row-parallel [in, out]
         (r".*lm_head\.weight$",             ("fsdp", "mp")),

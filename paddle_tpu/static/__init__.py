@@ -68,32 +68,20 @@ def xpu_places(device_ids=None):
 
 def device_guard(device=None):
     """Pin subsequent ops to a device (reference device_guard). Placement
-    under XLA is sharding-driven; the guard is recorded for source compat."""
+    under XLA is sharding-driven; the guard is accepted for source compat."""
     import contextlib
-
-    @contextlib.contextmanager
-    def _guard():
-        yield
-    return _guard()
+    return contextlib.nullcontext()
 
 
 def name_scope(prefix=None):
     """Name scope for ops recorded under it (reference name_scope)."""
     import contextlib
-
-    @contextlib.contextmanager
-    def _guard():
-        yield
-    return _guard()
+    return contextlib.nullcontext()
 
 
 def ipu_shard_guard(index=-1, stage=-1):
     import contextlib
-
-    @contextlib.contextmanager
-    def _guard():
-        yield
-    return _guard()
+    return contextlib.nullcontext()
 
 
 def set_ipu_shard(call_func, index=-1, stage=-1):

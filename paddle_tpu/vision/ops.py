@@ -323,11 +323,22 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
     oh, ow = (output_size, output_size) if isinstance(output_size, int) \
         else output_size
 
+    import numpy as np
+    # roi -> image assignment from boxes_num (host-side structure, like the
+    # reference's rois_num attr)
+    if boxes_num is not None:
+        bn = np.asarray(boxes_num.numpy() if hasattr(boxes_num, "numpy")
+                        else boxes_num).reshape(-1)
+        roi_img = np.repeat(np.arange(len(bn)), bn)
+    else:
+        roi_img = None
+
     def impl(feat, rois):
         n, c, h, w = feat.shape
         out_c = c // (oh * ow)
         outs = []
         for r in range(rois.shape[0]):
+            img = int(roi_img[r]) if roi_img is not None else 0
             x1, y1, x2, y2 = [rois[r, k] * spatial_scale for k in range(4)]
             rh = jnp.maximum(y2 - y1, 1e-3) / oh
             rw = jnp.maximum(x2 - x1, 1e-3) / ow
@@ -339,7 +350,8 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
                     ye = jnp.clip(jnp.ceil(y1 + (i + 1) * rh), 1, h).astype(int)
                     xs = jnp.clip(jnp.floor(x1 + j * rw), 0, w - 1).astype(int)
                     xe = jnp.clip(jnp.ceil(x1 + (j + 1) * rw), 1, w).astype(int)
-                    grp = feat[0, (i * ow + j) * out_c:(i * ow + j + 1) * out_c]
+                    grp = feat[img,
+                               (i * ow + j) * out_c:(i * ow + j + 1) * out_c]
                     # dynamic_slice-free: mask-weighted mean over the bin
                     yy = jnp.arange(h)[:, None]
                     xx = jnp.arange(w)[None, :]
