@@ -114,3 +114,69 @@ def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
         raise FloatingPointError(
             f"{num_nan} nan and {num_inf} inf in {op_type}:{var_name}")
     return Tensor(jnp.asarray(num_nan)), Tensor(jnp.asarray(num_inf))
+
+
+# -- run comparison (reference python/paddle/amp/accuracy_compare.py) ------
+class _RunDump:
+    """Capture per-op output stats of a run for later comparison."""
+
+    def __init__(self):
+        self.records = []  # (op_name, mean, absmax, has_nan, has_inf, dtype)
+
+    def _listener(self, name, n_inputs, outs):
+        import numpy as np
+        from ..core.dispatch import iter_float_outputs
+        for data in iter_float_outputs(outs):
+            arr = np.asarray(data, np.float32)
+            self.records.append((name, float(arr.mean()),
+                                 float(np.abs(arr).max()),
+                                 bool(np.isnan(arr).any()),
+                                 bool(np.isinf(arr).any()),
+                                 str(np.dtype(data.dtype))))
+
+
+def collect_run_stats():
+    """Context manager recording per-op output statistics of everything
+    executed inside (the dump side of accuracy_compare)."""
+    import contextlib
+    from ..core import dispatch as _dispatch
+
+    @contextlib.contextmanager
+    def _ctx():
+        dump = _RunDump()
+        with _dispatch.listener_scope(dump._listener):
+            yield dump
+    return _ctx()
+
+
+def compare_accuracy(dump_fp32, dump_amp, output_filename=None,
+                     loss_scale=1.0, dump_all_tensors=False):
+    """Diff two run dumps op-by-op (reference amp/accuracy_compare.py
+    excel report; here a list of row dicts + optional tsv). Rows pair the
+    i-th op of each run — runs must execute the same program, which is the
+    reference's workflow too."""
+    rows = []
+    n = min(len(dump_fp32.records), len(dump_amp.records))
+    for i in range(n):
+        f32 = dump_fp32.records[i]
+        amp = dump_amp.records[i]
+        rel = abs(f32[1] - amp[1]) / (abs(f32[1]) + 1e-12)
+        # flag on absmax drift — means of near-zero-centered tensors make
+        # relative mean noise meaningless
+        rel_absmax = abs(f32[2] - amp[2]) / (abs(f32[2]) + 1e-12)
+        rows.append({
+            "op": f32[0], "fp32_mean": f32[1], "amp_mean": amp[1],
+            "fp32_absmax": f32[2], "amp_absmax": amp[2],
+            "mean_rel_diff": rel, "absmax_rel_diff": rel_absmax,
+            "amp_nan": amp[3], "amp_inf": amp[4],
+            "fp32_dtype": f32[5], "amp_dtype": amp[5],
+            "flag": "NAN/INF" if (amp[3] or amp[4]) else
+                    ("LARGE_DIFF" if rel_absmax > 0.1 else ""),
+        })
+    if output_filename:
+        with open(output_filename, "w") as f:
+            cols = list(rows[0].keys()) if rows else []
+            f.write("\t".join(cols) + "\n")
+            for r in rows:
+                f.write("\t".join(str(r[c]) for c in cols) + "\n")
+    return rows

@@ -56,11 +56,55 @@ def _check_nan_inf(name, out):
 _amp_hook = None  # installed by paddle_tpu.amp; signature (name, args, kwargs) -> (args, kwargs)
 _op_tracer = None  # installed by paddle_tpu.profiler; signature (name) -> ctx manager
 _static_recorder = None  # installed by paddle_tpu.static.program_guard
+_op_listeners = []  # lightweight observers (SOT statement-IR capture)
 
 
 def set_static_recorder(r):
     global _static_recorder
     _static_recorder = r
+
+
+def add_op_listener(fn):
+    """Register fn(name, n_inputs, outs) called after every dispatched op
+    (works under tracing too — the SOT plane records its StatementIR here)."""
+    _op_listeners.append(fn)
+    return fn
+
+
+def remove_op_listener(fn):
+    if fn in _op_listeners:
+        _op_listeners.remove(fn)
+
+
+def listener_scope(fn):
+    """Context manager form of add/remove_op_listener."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ctx():
+        add_op_listener(fn)
+        try:
+            yield
+        finally:
+            remove_op_listener(fn)
+    return _ctx()
+
+
+def iter_float_outputs(outs):
+    """Yield concrete floating/complex output arrays from a listener's
+    `outs` (skips tracers and non-float dtypes; bf16/fp8 are numpy 'V'-kind
+    so the check goes through jnp)."""
+    import jax
+    import jax.numpy as jnp
+    outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+    for o in outs:
+        data = getattr(o, "data", o)
+        if isinstance(data, jax.core.Tracer) or not hasattr(data, "dtype"):
+            continue
+        if not (jnp.issubdtype(data.dtype, jnp.floating)
+                or jnp.issubdtype(data.dtype, jnp.complexfloating)):
+            continue
+        yield data
 
 # ops allowed to consume Partial-placement DTensors (they implement the
 # pending reduction); everything else must reshard first
@@ -123,6 +167,8 @@ def _apply_op_inner(name, impl, args, kwargs, differentiable=True):
         if _static_recorder is not None:
             _static_recorder(name, impl, treedef, leaves, tensor_idx,
                              wrapped)
+        for _l in _op_listeners:
+            _l(name, len(tensor_idx), wrapped)
         return wrapped
 
     diff_idx = [i for i in tensor_idx if not leaves[i].stop_gradient]
@@ -147,6 +193,8 @@ def _apply_op_inner(name, impl, args, kwargs, differentiable=True):
     wrapped = _wrap(name, out, node=node)
     if _static_recorder is not None:
         _static_recorder(name, impl, treedef, leaves, tensor_idx, wrapped)
+    for _l in _op_listeners:
+        _l(name, len(tensor_idx), wrapped)
     return wrapped
 
 

@@ -106,3 +106,7 @@ def gloo_barrier():
 
 def gloo_release():
     """Release bootstrap resources (no persistent gloo context here)."""
+
+from .comm_watchdog import (enable_comm_watchdog,  # noqa: F401,E402
+                            disable_comm_watchdog, comm_task_manager,
+                            CommTask, CommTaskManager)

@@ -1,0 +1,89 @@
+"""Alignment tool for distributed-vs-serial debugging (reference:
+python/paddle/distributed/auto_parallel/static/auto_align_tool.py —
+save aligned intermediates from a serial run and a distributed run, then
+diff them to locate the first diverging op/layer).
+
+TPU workflow: wrap each run in `AutoAlignTool.collect()` (dispatch-listener
+capture of per-op output tensors or stats), `save()` to a directory, then
+`AutoAlignTool.diff(dir_a, dir_b)` reports the first op whose outputs
+diverge beyond tolerance. Works for eager and for global-view SPMD runs
+(global arrays compare directly — the mesh is invisible to the diff)."""
+import contextlib
+import json
+import os
+
+import numpy as np
+
+__all__ = ["AutoAlignTool"]
+
+
+class AutoAlignTool:
+    def __init__(self, level=1, step=None):
+        # level 0: stats only; level 1: full tensors (reference levels)
+        self.level = level
+        self.records = []
+
+    def _listener(self, name, n_inputs, outs):
+        from ...core.dispatch import iter_float_outputs
+        for data in iter_float_outputs(outs):
+            arr = np.asarray(data, np.float32)
+            if self.level >= 1:
+                self.records.append((name, arr.copy()))
+            else:
+                self.records.append((name, np.asarray(
+                    [arr.mean(), np.abs(arr).max()], np.float32)))
+
+    @contextlib.contextmanager
+    def collect(self):
+        from ...core import dispatch as _dispatch
+        with _dispatch.listener_scope(self._listener):
+            yield self
+
+    def save(self, save_dir, rank=0):
+        os.makedirs(save_dir, exist_ok=True)
+        meta = []
+        arrays = {}
+        for i, (name, arr) in enumerate(self.records):
+            key = f"t{i}"
+            meta.append({"idx": i, "op": name, "shape": list(arr.shape)})
+            arrays[key] = arr
+        np.savez_compressed(os.path.join(save_dir, f"align_{rank}.npz"),
+                            **arrays)
+        with open(os.path.join(save_dir, f"align_{rank}.json"), "w") as f:
+            json.dump({"level": self.level, "ops": meta}, f)
+
+    @staticmethod
+    def load(save_dir, rank=0):
+        with open(os.path.join(save_dir, f"align_{rank}.json")) as f:
+            meta = json.load(f)
+        data = np.load(os.path.join(save_dir, f"align_{rank}.npz"))
+        return meta, data
+
+    @staticmethod
+    def diff(dir_a, dir_b, rank=0, rtol=1e-4, atol=1e-5):
+        """Compare two saved runs; returns (aligned, report) where report
+        lists the first divergence and per-op max abs diff."""
+        meta_a, data_a = AutoAlignTool.load(dir_a, rank)
+        meta_b, data_b = AutoAlignTool.load(dir_b, rank)
+        n = min(len(meta_a["ops"]), len(meta_b["ops"]))
+        report = []
+        aligned = True
+        for i in range(n):
+            oa, ob = meta_a["ops"][i], meta_b["ops"][i]
+            a = data_a[f"t{i}"]
+            b = data_b[f"t{i}"]
+            entry = {"idx": i, "op_a": oa["op"], "op_b": ob["op"]}
+            if oa["op"] != ob["op"] or a.shape != b.shape:
+                entry["status"] = "STRUCTURE_MISMATCH"
+                report.append(entry)
+                aligned = False
+                break
+            d = float(np.abs(a - b).max()) if a.size else 0.0
+            entry["max_abs_diff"] = d
+            ok = np.allclose(a, b, rtol=rtol, atol=atol)
+            entry["status"] = "OK" if ok else "DIVERGED"
+            report.append(entry)
+            if not ok:
+                aligned = False
+                break
+        return aligned, report

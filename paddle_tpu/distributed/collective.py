@@ -388,3 +388,38 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
         layer = VocabParallelEmbedding(n, d, weight_attr=weight_attr)
         return layer(x)
     raise ValueError(f"unsupported split operation: {operation}")
+
+
+# -- watchdog instrumentation (reference: every ProcessGroup task is
+#    tracked by CommTaskManager when FLAGS_enable_async_trace is on) ------
+from . import comm_watchdog as _watchdog  # noqa: E402
+
+
+def _watched(fn):
+    import functools
+    import inspect
+    try:
+        params = list(inspect.signature(fn).parameters)
+        group_pos = params.index("group")
+    except (ValueError, TypeError):
+        group_pos = None
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not _watchdog.is_enabled():
+            return fn(*args, **kwargs)
+        group = kwargs.get("group")
+        if group is None and group_pos is not None and len(args) > group_pos:
+            group = args[group_pos]  # positionally-passed group
+        with _watchdog.task_scope(fn.__name__, group):
+            return fn(*args, **kwargs)
+    wrapper.__wrapped_collective__ = fn
+    return wrapper
+
+
+for _n in ("all_reduce", "all_gather", "reduce_scatter", "broadcast",
+           "reduce", "scatter", "gather", "all_to_all", "all_to_all_single",
+           "alltoall", "alltoall_single", "send", "recv", "isend", "irecv",
+           "barrier"):
+    if _n in globals():
+        globals()[_n] = _watched(globals()[_n])

@@ -1,0 +1,195 @@
+"""Collective watchdog (reference: CommTask/CommTaskManager —
+paddle/phi/core/distributed/comm_task_manager.h:37 + nccl_comm_task.cc:
+per-collective start/end events, async timeout polling, task dump for hang
+post-mortems; enabled by FLAGS_enable_async_trace).
+
+TPU mapping: collectives execute inside XLA programs, so per-kernel NCCL
+events don't exist — the observable boundary is the host-side dispatch of
+each eager collective (collective.py wraps every call in start_task/
+end_task). A daemon thread polls outstanding tasks; one that stays
+incomplete past `timeout` means the underlying program is blocked (a peer
+died or a DCN link stalled) and triggers the hang report: outstanding task
+table + per-group sequence numbers (mismatched sequence numbers across
+hosts are the classic desync signature the reference dumps)."""
+import json
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger("paddle_tpu.distributed.watchdog")
+
+__all__ = ["CommTask", "CommTaskManager", "enable_comm_watchdog",
+           "disable_comm_watchdog", "comm_task_manager"]
+
+
+class CommTask:
+    __slots__ = ("task_id", "op", "group", "seq", "start", "end", "nbytes",
+                 "reported")
+
+    def __init__(self, task_id, op, group, seq, nbytes=0):
+        self.task_id = task_id
+        self.op = op
+        self.group = group
+        self.seq = seq
+        self.start = time.monotonic()
+        self.end = None
+        self.nbytes = nbytes
+        self.reported = False
+
+    @property
+    def done(self):
+        return self.end is not None
+
+    @property
+    def elapsed(self):
+        return (self.end or time.monotonic()) - self.start
+
+    def as_dict(self):
+        return {"task_id": self.task_id, "op": self.op,
+                "group": str(self.group), "seq": self.seq,
+                "elapsed_s": round(self.elapsed, 3), "done": self.done,
+                "nbytes": self.nbytes}
+
+
+class CommTaskManager:
+    """Tracks in-flight collectives; a daemon poller flags hangs."""
+
+    def __init__(self, timeout=1800.0, poll_interval=10.0, dump_dir=None):
+        self.timeout = timeout
+        self.poll_interval = poll_interval
+        self.dump_dir = dump_dir or os.environ.get(
+            "PADDLE_COMM_DUMP_DIR", "/tmp/paddle_tpu_comm_dump")
+        self._tasks = {}
+        self._seq = {}           # group name -> sequence counter
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+        self._hang_hooks = []
+        self.hang_detected = False
+
+    # -- task lifecycle (called from collective.py) --------------------
+    def start_task(self, op, group=None, nbytes=0):
+        gname = getattr(group, "axis_name", None) or str(group)
+        with self._lock:
+            self._next_id += 1
+            seq = self._seq.get(gname, 0) + 1
+            self._seq[gname] = seq
+            t = CommTask(self._next_id, op, gname, seq, nbytes)
+            self._tasks[t.task_id] = t
+        return t
+
+    def end_task(self, task):
+        task.end = time.monotonic()
+        with self._lock:
+            self._tasks.pop(task.task_id, None)
+
+    # -- watchdog ------------------------------------------------------
+    def register_hang_hook(self, fn):
+        """fn(list-of-task-dicts) runs when a hang is detected."""
+        self._hang_hooks.append(fn)
+
+    def outstanding(self):
+        with self._lock:
+            return [t.as_dict() for t in self._tasks.values()]
+
+    def group_sequences(self):
+        with self._lock:
+            return dict(self._seq)
+
+    def _poll(self):
+        while not self._stop.wait(self.poll_interval):
+            now = time.monotonic()
+            with self._lock:
+                hung = [t for t in self._tasks.values()
+                        if now - t.start > self.timeout and not t.reported]
+                for t in hung:
+                    t.reported = True  # one report per task
+            if hung:
+                self.hang_detected = True
+                self._dump(hung)
+
+    def _dump(self, hung):
+        report = {
+            "time": time.time(),
+            "hung_tasks": [t.as_dict() for t in hung],
+            "outstanding": self.outstanding(),
+            "group_sequences": self.group_sequences(),
+        }
+        log.error("comm watchdog: %d collective(s) exceeded %.0fs timeout: %s",
+                  len(hung), self.timeout,
+                  ", ".join(f"{t.op}@{t.group}#{t.seq}" for t in hung))
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            path = os.path.join(self.dump_dir,
+                                f"comm_hang_{int(time.time())}.json")
+            with open(path, "w") as f:
+                json.dump(report, f, indent=2)
+            log.error("comm watchdog: task dump written to %s", path)
+        except OSError:
+            pass
+        for fn in self._hang_hooks:
+            try:
+                fn(report)
+            except Exception:
+                pass
+
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._poll, daemon=True,
+                                            name="comm-watchdog")
+            self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+comm_task_manager = CommTaskManager()
+_enabled = False
+
+
+def enable_comm_watchdog(timeout=None, poll_interval=None):
+    """Turn on hang detection (reference FLAGS_enable_async_trace)."""
+    global _enabled
+    if timeout is not None:
+        comm_task_manager.timeout = timeout
+    if poll_interval is not None:
+        comm_task_manager.poll_interval = poll_interval
+    comm_task_manager.start()
+    _enabled = True
+
+
+def disable_comm_watchdog():
+    global _enabled
+    comm_task_manager.stop()
+    _enabled = False
+
+
+def is_enabled():
+    return _enabled
+
+
+class task_scope:
+    """Context manager wrapping one collective dispatch."""
+
+    def __init__(self, op, group=None, nbytes=0):
+        self.op = op
+        self.group = group
+        self.nbytes = nbytes
+        self._task = None
+
+    def __enter__(self):
+        if _enabled:
+            self._task = comm_task_manager.start_task(self.op, self.group,
+                                                      self.nbytes)
+        return self._task
+
+    def __exit__(self, *exc):
+        if self._task is not None:
+            comm_task_manager.end_task(self._task)
+        return False

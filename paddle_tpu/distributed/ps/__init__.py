@@ -1,0 +1,297 @@
+"""Parameter-server stack (reference: paddle/fluid/distributed/ps/ — brpc
+client/server (brpc_ps_client.cc/brpc_ps_server.cc), dense + sparse tables
+with admission entries (ps/table/), python wrappers
+python/paddle/distributed/ps/ and fleet/runtime/the_one_ps.py).
+
+Scaled TPU-native design: the PS serves the *sparse/host* side of training
+(giant embedding tables that do not fit — or do not belong — in HBM), while
+dense compute stays in the SPMD mesh program. Transport is a length-prefixed
+pickle protocol over TCP sockets (role of brpc); tables live in server
+processes/threads:
+
+- DenseTable: flat fp32 parameter block, pull-all/push-grad (SGD applied
+  server-side, like the reference's dense optimizer tables).
+- SparseTable: id -> embedding row, created on first touch subject to an
+  admission entry (CountFilterEntry/ProbabilityEntry from ps_compat),
+  pulled by id batch, pushed with per-id gradients.
+
+`PsService` threads a server in-process for tests/single-host; multi-host
+deployments run `python -m paddle_tpu.distributed.ps.server`.
+"""
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["DenseTable", "SparseTable", "PsServer", "PsClient", "PsService"]
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class DenseTable:
+    """Flat dense parameter block with a server-side SGD step (reference
+    dense table + dense optimizer accessor)."""
+
+    def __init__(self, table_id, size, lr=0.01, init=None):
+        self.table_id = table_id
+        self.data = np.zeros((size,), np.float32) if init is None \
+            else np.asarray(init, np.float32).reshape(-1).copy()
+        self.lr = lr
+        self._lock = threading.Lock()
+
+    def pull(self):
+        with self._lock:
+            return self.data.copy()
+
+    def push_grad(self, grad):
+        with self._lock:
+            self.data -= self.lr * np.asarray(grad, np.float32).reshape(-1)
+
+    def set(self, values):
+        with self._lock:
+            self.data[:] = np.asarray(values, np.float32).reshape(-1)
+
+
+class SparseTable:
+    """id -> row embedding table with admission control (reference sparse
+    table; entry configs ps/table accessor)."""
+
+    def __init__(self, table_id, emb_dim, lr=0.01, entry=None,
+                 initializer=None, seed=0):
+        self.table_id = table_id
+        self.emb_dim = emb_dim
+        self.lr = lr
+        self.entry = entry  # CountFilterEntry-style: ._count threshold
+        self.rows = {}
+        self._touch = {}
+        self._rng = np.random.default_rng(seed)
+        self._init = initializer or (
+            lambda: (self._rng.standard_normal(emb_dim) * 0.01).astype(
+                np.float32))
+        self._lock = threading.Lock()
+
+    def _admit(self, key):
+        thresh = getattr(self.entry, "_count", 1) if self.entry else 1
+        cnt = self._touch.get(key, 0) + 1
+        self._touch[key] = cnt
+        return cnt >= thresh
+
+    def pull(self, ids):
+        out = np.zeros((len(ids), self.emb_dim), np.float32)
+        with self._lock:
+            for i, key in enumerate(ids):
+                key = int(key)
+                row = self.rows.get(key)
+                if row is None and self._admit(key):
+                    row = self._init()
+                    self.rows[key] = row
+                if row is not None:
+                    out[i] = row
+        return out
+
+    def push_grad(self, ids, grads):
+        grads = np.asarray(grads, np.float32)
+        with self._lock:
+            for i, key in enumerate(ids):
+                key = int(key)
+                row = self.rows.get(key)
+                if row is not None:
+                    row -= self.lr * grads[i]
+
+    def size(self):
+        with self._lock:
+            return len(self.rows)
+
+
+class PsServer:
+    """Socket server hosting tables (reference brpc_ps_server.cc role)."""
+
+    def __init__(self, host="127.0.0.1", port=0, barrier_world_size=1):
+        self.tables = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._threads = []
+        self._barrier_count = 0
+        self._barrier_world = barrier_world_size
+        self._barrier_cond = threading.Condition()
+
+    def add_dense_table(self, table_id, size, lr=0.01, init=None):
+        self.tables[table_id] = DenseTable(table_id, size, lr, init)
+
+    def add_sparse_table(self, table_id, emb_dim, lr=0.01, entry=None):
+        self.tables[table_id] = SparseTable(table_id, emb_dim, lr, entry)
+
+    def _handle(self, conn):
+        try:
+            while not self._stop.is_set():
+                try:
+                    req = _recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                op = req["op"]
+                if op == "shutdown":
+                    _send_msg(conn, {"ok": True})
+                    self._stop.set()
+                    return
+                try:
+                    _send_msg(conn, self._dispatch(req))
+                except Exception as e:  # table errors go back to the client
+                    _send_msg(conn, {"ok": False, "error": repr(e)})
+        finally:
+            conn.close()
+
+    def _dispatch(self, req):
+        op = req["op"]
+        if op == "ping":
+            return {"ok": True, "tables": sorted(self.tables)}
+        if op == "barrier":
+            # real rendezvous: block until barrier_world_size participants
+            # arrive (each connection is handled by its own thread)
+            with self._barrier_cond:
+                self._barrier_count += 1
+                arrived = self._barrier_count
+                gen = (arrived - 1) // self._barrier_world
+                target = (gen + 1) * self._barrier_world
+                while (self._barrier_count < target
+                       and not self._stop.is_set()):
+                    self._barrier_cond.wait(timeout=0.5)
+                self._barrier_cond.notify_all()
+                return {"ok": True, "count": arrived}
+        t = self.tables[req["table"]]
+        if op == "pull_dense":
+            return {"ok": True, "values": t.pull()}
+        if op == "push_dense_grad":
+            t.push_grad(req["grad"])
+            return {"ok": True}
+        if op == "set_dense":
+            t.set(req["values"])
+            return {"ok": True}
+        if op == "pull_sparse":
+            return {"ok": True, "values": t.pull(req["ids"])}
+        if op == "push_sparse_grad":
+            t.push_grad(req["ids"], req["grads"])
+            return {"ok": True}
+        if op == "table_size":
+            return {"ok": True, "size": t.size()}
+        raise ValueError(f"unknown op {op}")
+
+    def serve_forever(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            th = threading.Thread(target=self._handle, args=(conn,),
+                                  daemon=True)
+            th.start()
+            self._threads.append(th)
+        self._sock.close()
+
+    def stop(self):
+        self._stop.set()
+
+
+class PsClient:
+    """Worker-side client (reference brpc_ps_client.cc role)."""
+
+    def __init__(self, host, port):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.connect((host, port))
+        self._lock = threading.Lock()
+
+    def _call(self, **req):
+        with self._lock:
+            _send_msg(self._sock, req)
+            resp = _recv_msg(self._sock)
+        if not resp.get("ok"):
+            raise RuntimeError(f"ps error: {resp.get('error')}")
+        return resp
+
+    def ping(self):
+        return self._call(op="ping")["tables"]
+
+    def pull_dense(self, table):
+        return self._call(op="pull_dense", table=table)["values"]
+
+    def push_dense_grad(self, table, grad):
+        self._call(op="push_dense_grad", table=table,
+                   grad=np.asarray(grad, np.float32))
+
+    def set_dense(self, table, values):
+        self._call(op="set_dense", table=table,
+                   values=np.asarray(values, np.float32))
+
+    def pull_sparse(self, table, ids):
+        return self._call(op="pull_sparse", table=table,
+                          ids=[int(i) for i in np.asarray(ids).reshape(-1)])[
+            "values"]
+
+    def push_sparse_grad(self, table, ids, grads):
+        self._call(op="push_sparse_grad", table=table,
+                   ids=[int(i) for i in np.asarray(ids).reshape(-1)],
+                   grads=np.asarray(grads, np.float32))
+
+    def sparse_table_size(self, table):
+        return self._call(op="table_size", table=table)["size"]
+
+    def barrier(self):
+        self._call(op="barrier")
+
+    def shutdown_server(self):
+        try:
+            self._call(op="shutdown")
+        except Exception:
+            pass
+
+    def close(self):
+        self._sock.close()
+
+
+class PsService:
+    """In-process PS for single-host training and tests (the_one_ps.py's
+    role of wiring server + workers)."""
+
+    def __init__(self):
+        self.server = PsServer()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.server.host, self.server.port
+
+    def client(self):
+        return PsClient(self.server.host, self.server.port)
+
+    def stop(self):
+        self.server.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

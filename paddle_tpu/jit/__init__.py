@@ -16,8 +16,10 @@ from .api import (to_static, not_to_static, TracedLayer, ignore_module,
 from .functional import state_arrays, functional_call, pure_call
 from .io import save, load
 from .io import LoadedProgram as TranslatedLayer
+from . import sot
+from .sot import symbolic_translate
 
 __all__ = ["to_static", "not_to_static", "save", "load", "state_arrays",
            "functional_call", "pure_call", "TracedLayer", "ignore_module",
            "enable_to_static", "set_code_level", "set_verbosity",
-           "TranslatedLayer"]
+           "TranslatedLayer", "sot", "symbolic_translate"]

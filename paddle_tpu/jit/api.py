@@ -52,7 +52,9 @@ def _collect_layers(fn):
 def _const_key(leaf):
     try:
         hash(leaf)
-        return leaf
+        # include the type: 2 == 2.0 == True hash-equal but trace to
+        # different programs
+        return (type(leaf).__name__, leaf)
     except TypeError:
         return (type(leaf).__name__, id(leaf))
 

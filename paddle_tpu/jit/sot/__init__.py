@@ -1,0 +1,33 @@
+"""SOT — symbolic translation of Python functions into compiled XLA programs.
+
+Reference plane (SURVEY.md §2.5): python/paddle/jit/sot/ — a CPython
+bytecode VM (opcode_executor.py) driven by a C eval-frame hook
+(sot/eval_frame.c), building StatementIR, guarded per code object, with
+graph breaks falling back to eager execution; entry `symbolic_translate`
+(translate.py:37).
+
+TPU-native redesign (scaled to what XLA's compilation model needs):
+
+- **eval-frame hook (C)**: native/src/eval_frame.c installs the PEP 523
+  evaluator and intercepts marked code objects (entry counters, skip list,
+  re-entrancy latch). Body redirection rides the translated callable —
+  capture on this stack is whole-function because XLA has no mid-frame
+  resume; a bytecode-level resume would re-enter the same jit anyway.
+- **opcode analysis**: opcode_analysis.py statically scans the bytecode for
+  constructs that force a graph break (host IO, .numpy()/.item() escapes,
+  generators) — the role of the VM's per-opcode support table, decided
+  before tracing rather than during it.
+- **guards**: guards.py builds a hashable guard key from the call's
+  (structure, shapes, dtypes, static scalars, closure constants) — the
+  guard-cache role of sot/opcode_translator/executor/guard.py. A dict
+  lookup on the key replaces the reference's chained lambda guards.
+- **StatementIR**: statement_ir.py records the dispatched op sequence via
+  the dispatch listener during the tracing call (the observable program,
+  inspectable as sir(); compilation itself is jax.jit over the same trace).
+- **graph breaks**: any capture failure (concretization, side effects,
+  unsupported op) falls back to eager for that call; repeated breaks pin
+  the function to eager (the VM's fallback-to-CPython semantics).
+"""
+from .translate import symbolic_translate, SotFunction, sot_stats
+
+__all__ = ["symbolic_translate", "SotFunction", "sot_stats"]

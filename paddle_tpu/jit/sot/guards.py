@@ -1,0 +1,57 @@
+"""Guard keys for the SOT cache (role of the reference's
+sot/opcode_translator/executor/guard.py chained guards).
+
+A compiled entry is valid for a call iff the call's guard key equals the
+entry's key. The key packs, per argument leaf:
+- Tensor -> ("T", shape, dtype, stop_gradient)
+- ndarray -> ("A", shape, dtype)
+- scalar/str/bool/None -> the value itself (static, baked into the trace)
+- other -> its type (structure-only guard)
+plus the closure's cell values (scalars only) and the global names the
+bytecode reads that resolve to scalars. One dict lookup on the key replaces
+the reference's per-guard lambda chain — and stays O(1) as variants grow.
+"""
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def _leaf_key(x):
+    if isinstance(x, Tensor):
+        return ("T", tuple(x.shape), str(np.dtype(x.dtype)), x.stop_gradient)
+    if isinstance(x, np.ndarray):
+        return ("A", x.shape, str(x.dtype))
+    if isinstance(x, (bool, int, float, str, bytes, type(None))):
+        # type() in the key: 2 == 2.0 == True hash-equal, but each traces a
+        # differently-typed program
+        return (type(x).__name__, x)
+    if isinstance(x, (list, tuple)):
+        return (type(x).__name__,) + tuple(_leaf_key(v) for v in x)
+    if isinstance(x, dict):
+        return ("D",) + tuple(sorted((k, _leaf_key(v)) for k, v in x.items()))
+    return ("O", type(x).__name__)
+
+
+def build_guard_key(fn, args, kwargs, watched_globals=()):
+    parts = [tuple(_leaf_key(a) for a in args),
+             tuple(sorted((k, _leaf_key(v)) for k, v in kwargs.items()))]
+    closure = getattr(fn, "__closure__", None)
+    if closure:
+        cells = []
+        for cell in closure:
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                cells.append(("empty",))
+                continue
+            if isinstance(v, (bool, int, float, str, type(None))):
+                cells.append(v)
+            else:
+                cells.append(("cell", type(v).__name__))
+        parts.append(tuple(cells))
+    if watched_globals:
+        g = fn.__globals__
+        parts.append(tuple(
+            (n, g[n]) for n in watched_globals
+            if isinstance(g.get(n), (bool, int, float, str))))
+    return tuple(parts)

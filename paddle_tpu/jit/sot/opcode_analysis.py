@@ -1,0 +1,76 @@
+"""Static bytecode analysis for the SOT plane (role of the reference's
+per-opcode support lattice in sot/opcode_translator/executor/opcode_executor.py,
+decided up-front instead of during simulation).
+
+`analyze(code)` walks the instruction stream (and nested code consts) and
+reports:
+- break_reasons: constructs that can never be captured into one XLA program
+  (host IO, tensor->host escapes, generator protocol)
+- warn_reasons: constructs that often break capture but may be fine
+  (data-dependent branching is only a break if the predicate is a tracer —
+  known at trace time, not statically)
+"""
+import dis
+
+# calls that force results onto the host — capturing across them is
+# impossible, the reference VM graph-breaks on the same set
+_HOST_ESCAPE_CALLS = {
+    "numpy", "item", "tolist", "print", "input", "breakpoint",
+    "__dlpack__", "cpu", "save", "open",
+}
+
+_GENERATOR_OPS = {"YIELD_VALUE", "RETURN_GENERATOR", "SEND"}
+
+
+class Analysis:
+    __slots__ = ("break_reasons", "warn_reasons", "tensor_branches",
+                 "calls", "loads")
+
+    def __init__(self):
+        self.break_reasons = []
+        self.warn_reasons = []
+        self.tensor_branches = 0
+        self.calls = []
+        self.loads = []
+
+    @property
+    def must_break(self):
+        return bool(self.break_reasons)
+
+
+def analyze(code, _depth=0):
+    out = Analysis()
+    _scan(code, out, _depth)
+    return out
+
+
+def _scan(code, out, depth):
+    if depth > 4:
+        return
+    for ins in dis.get_instructions(code):
+        op = ins.opname
+        if op in _GENERATOR_OPS:
+            out.break_reasons.append(f"generator protocol ({op})")
+        elif op in ("LOAD_ATTR", "LOAD_METHOD"):
+            name = ins.argval if isinstance(ins.argval, str) else \
+                (ins.argval[1] if isinstance(ins.argval, tuple) else None)
+            out.loads.append(name)
+            if name in _HOST_ESCAPE_CALLS:
+                out.warn_reasons.append(f"host-escape attr '{name}'")
+        elif op in ("LOAD_GLOBAL", "LOAD_NAME"):
+            name = ins.argval if isinstance(ins.argval, str) else \
+                (ins.argval[1] if isinstance(ins.argval, tuple) else None)
+            out.loads.append(name)
+            if name in ("print", "input", "breakpoint", "open"):
+                out.break_reasons.append(f"host IO call '{name}'")
+        elif op.startswith("POP_JUMP_IF") or op in ("JUMP_IF_TRUE_OR_POP",
+                                                    "JUMP_IF_FALSE_OR_POP"):
+            # data-dependence only known at trace time; count for telemetry
+            out.tensor_branches += 1
+        elif op in ("CALL", "CALL_FUNCTION_EX"):
+            out.calls.append(ins.offset)
+        elif op == "IMPORT_NAME":
+            out.warn_reasons.append(f"import inside function ('{ins.argval}')")
+    for const in code.co_consts:
+        if hasattr(const, "co_code"):
+            _scan(const, out, depth + 1)

@@ -1,0 +1,57 @@
+"""StatementIR: the recorded op sequence of a captured function (role of
+the reference's sot/symbolic/statement_ir.py). Recorded through the
+dispatch listener during the tracing call — one Statement per dispatched
+op, with output shapes/dtypes from abstract values."""
+
+
+class Statement:
+    __slots__ = ("name", "n_inputs", "out_shapes", "out_dtypes")
+
+    def __init__(self, name, n_inputs, outs):
+        self.name = name
+        self.n_inputs = n_inputs
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        self.out_shapes = tuple(tuple(getattr(o, "shape", ())) for o in outs)
+        self.out_dtypes = tuple(str(getattr(o, "dtype", "?")) for o in outs)
+
+    def __repr__(self):
+        shapes = ", ".join(f"{s}:{d}" for s, d in
+                           zip(self.out_shapes, self.out_dtypes))
+        return f"{self.name} -> [{shapes}]"
+
+
+class StatementIR:
+    def __init__(self, name):
+        self.name = name
+        self.statements = []
+
+    def append(self, name, n_inputs, outs):
+        self.statements.append(Statement(name, n_inputs, outs))
+
+    def __len__(self):
+        return len(self.statements)
+
+    def __iter__(self):
+        return iter(self.statements)
+
+    def __repr__(self):
+        body = "\n  ".join(repr(s) for s in self.statements)
+        return f"StatementIR[{self.name}] {{\n  {body}\n}}"
+
+
+class SIRRecorder:
+    """Context manager wiring the dispatch listener to a StatementIR."""
+
+    def __init__(self, name):
+        self.sir = StatementIR(name)
+
+    def __enter__(self):
+        from ...core import dispatch as _dispatch
+        self._fn = lambda name, n, outs: self.sir.append(name, n, outs)
+        _dispatch.add_op_listener(self._fn)
+        return self.sir
+
+    def __exit__(self, *exc):
+        from ...core import dispatch as _dispatch
+        _dispatch.remove_op_listener(self._fn)
+        return False

@@ -1,0 +1,172 @@
+"""symbolic_translate — the SOT entry point (reference
+python/paddle/jit/sot/translate.py:37).
+
+Call path per invocation of a translated function:
+1. the C eval-frame hook (if built) has the function's code marked — it
+   counts the entry and enforces the skip list;
+2. guard key built from the live arguments (guards.py) → cache lookup;
+3. hit: run the compiled XLA callable;
+4. miss: capture — trace the function once under the SIR recorder and
+   jax.jit (via jit.api.StaticFunction, which itself chains the AST
+   dy2static rewrite on concretization failures — SOT then AST, the same
+   two-tier design as the reference);
+5. capture failure = graph break: execute eagerly, record the reason;
+   MAX_BREAKS consecutive breaks pin the function to eager.
+"""
+import logging
+
+from ..api import StaticFunction
+from .guards import build_guard_key
+from .opcode_analysis import analyze
+from .statement_ir import SIRRecorder
+
+log = logging.getLogger("paddle_tpu.jit.sot")
+
+MAX_BREAKS = 3
+
+_hook_mod = None
+_hook_ready = False
+_registry = {}  # id of code object -> SotFunction (hook callback lookup)
+
+_stats = {"translations": 0, "cache_hits": 0, "graph_breaks": 0,
+          "eager_pins": 0}
+
+
+def sot_stats():
+    out = dict(_stats)
+    hook = _ensure_hook()
+    if hook is not None:
+        out["frame_hook"] = hook.stats()
+    return out
+
+
+def _ensure_hook():
+    global _hook_mod, _hook_ready
+    if not _hook_ready:
+        _hook_ready = True
+        try:
+            from ...native import build_eval_frame_ext
+            _hook_mod = build_eval_frame_ext()
+            if _hook_mod is not None:
+                _hook_mod.install(_frame_callback)
+        except Exception:
+            _hook_mod = None
+    return _hook_mod
+
+
+def _frame_callback(code, name):
+    """Runs inside the C hook for marked code objects: entry accounting
+    (the heavy lifting happens in SotFunction.__call__)."""
+    sf = _registry.get(id(code))
+    if sf is not None:
+        sf._frame_entries += 1
+    return None
+
+
+class SotFunction:
+    """Guard-cached, graph-breaking compiled wrapper over one function."""
+
+    def __init__(self, fn, train=None, build_strategy=None):
+        self._fn = fn
+        self._name = getattr(fn, "__name__", type(fn).__name__)
+        self._cache = {}          # guard key -> StaticFunction
+        self._sirs = {}           # guard key -> StatementIR (first trace)
+        self._breaks = 0
+        self._eager_pinned = False
+        self._frame_entries = 0
+        code = getattr(fn, "__code__", None)
+        self.analysis = analyze(code) if code is not None else None
+        if self.analysis is not None and self.analysis.must_break:
+            # statically uncapturable (host IO / generators): never try
+            self._eager_pinned = True
+            _stats["eager_pins"] += 1
+            log.info("sot[%s]: pinned to eager: %s", self._name,
+                     self.analysis.break_reasons)
+        elif code is not None:
+            hook = _ensure_hook()
+            if hook is not None:
+                hook.mark_code(code)
+                _registry[id(code)] = self
+
+    # -- public --------------------------------------------------------
+    @property
+    def graph_break_count(self):
+        return self._breaks
+
+    def statement_ir(self, key=None):
+        """The recorded op sequence for one compiled variant (latest by
+        default)."""
+        if not self._sirs:
+            return None
+        if key is None:
+            key = next(reversed(self._sirs))
+        return self._sirs[key]
+
+    def __call__(self, *args, **kwargs):
+        if self._eager_pinned:
+            return self._fn(*args, **kwargs)
+        try:
+            key = build_guard_key(self._fn, args, kwargs)
+        except Exception:
+            return self._graph_break("unguardable arguments", args, kwargs)
+        entry = self._cache.get(key)
+        if entry is not None:
+            _stats["cache_hits"] += 1
+            return entry(*args, **kwargs)
+        # capture
+        try:
+            entry = StaticFunction(self._fn)
+            with SIRRecorder(self._name) as sir:
+                out = entry(*args, **kwargs)
+            self._cache[key] = entry
+            self._sirs[key] = sir
+            self._breaks = 0
+            _stats["translations"] += 1
+            return out
+        except Exception as e:  # noqa: BLE001 — any capture failure breaks
+            return self._graph_break(f"{type(e).__name__}: {e}", args, kwargs)
+
+    def _graph_break(self, reason, args, kwargs):
+        self._breaks += 1
+        _stats["graph_breaks"] += 1
+        log.info("sot[%s]: graph break (%d/%d): %.200s", self._name,
+                 self._breaks, MAX_BREAKS, reason)
+        if self._breaks >= MAX_BREAKS:
+            self._eager_pinned = True
+            _stats["eager_pins"] += 1
+        return self._fn(*args, **kwargs)
+
+    def __get__(self, obj, objtype=None):
+        # descriptor protocol: @symbolic_translate on a method binds self
+        if obj is None:
+            return self
+        import functools
+        return functools.partial(self, obj)
+
+    def __del__(self):
+        # unhook dynamically-created functions so the C-side marked set and
+        # the registry don't grow without bound
+        code = getattr(self._fn, "__code__", None)
+        if code is not None:
+            _registry.pop(id(code), None)
+            if _hook_mod is not None:
+                try:
+                    _hook_mod.unmark_code(code)
+                except Exception:
+                    pass
+
+
+def symbolic_translate(fn=None, train=None, build_strategy=None, **kwargs):
+    """Translate a callable (reference translate.py:37); usable as a
+    decorator or a call."""
+    def wrap(f):
+        import functools
+        sf = SotFunction(f, train=train, build_strategy=build_strategy)
+        functools.update_wrapper(sf, f,
+                                 assigned=("__name__", "__doc__",
+                                           "__qualname__", "__module__"),
+                                 updated=())
+        return sf
+    if fn is not None:
+        return wrap(fn)
+    return wrap

@@ -254,3 +254,48 @@ class NativeQueue:
         if h and LIB is not None:
             LIB.pt_queue_close(h)
             LIB.pt_queue_destroy(h)
+
+
+def build_eval_frame_ext():
+    """Build (cached) and import the `_pt_eval_frame` CPython extension —
+    the PEP 523 eval-frame hook (src/eval_frame.c; role of the reference's
+    sot/eval_frame.c). Returns the module or None when no toolchain."""
+    import importlib.util
+    import sysconfig
+    src = os.path.join(_SRC, "eval_frame.c")
+    if not os.path.exists(src):
+        return None
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_BUILD, f"_pt_eval_frame_{tag}.so")
+    if not os.path.exists(out):
+        os.makedirs(_BUILD, exist_ok=True)
+        inc = sysconfig.get_paths()["include"]
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD)
+        os.close(fd)
+        cmd = ["g++", "-x", "c", "-O2", "-fPIC", "-shared",
+               f"-I{inc}", src, "-o", tmp]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, timeout=120)
+            if proc.returncode != 0:
+                import logging
+                logging.getLogger("paddle_tpu.native").debug(
+                    "eval_frame.c build failed:\n%s",
+                    proc.stderr.decode(errors="replace"))
+                os.unlink(tmp)
+                return None
+            os.rename(tmp, out)
+        except (OSError, subprocess.SubprocessError) as e:
+            import logging
+            logging.getLogger("paddle_tpu.native").debug(
+                "eval_frame.c build error: %r", e)
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            return None
+    spec = importlib.util.spec_from_file_location("_pt_eval_frame", out)
+    try:
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None

@@ -1,0 +1,155 @@
+/* PEP 523 eval-frame hook for the SOT capture plane.
+ *
+ * Role parity: the reference installs a custom frame evaluator to intercept
+ * marked functions before CPython executes them (its sot/eval_frame.c).
+ * Here the hook intercepts frames whose code object was registered via
+ * mark_code(), invokes the Python-side callback (which records the entry,
+ * bumps guard-cache stats, and may trigger re-translation), then continues
+ * with the default evaluator. Redirection of the BODY is done by the
+ * translator swapping func.__code__ with a shim at registration time — a
+ * deliberate robustness choice: replacing the in-flight _PyInterpreterFrame
+ * in 3.12 requires private frame-lifecycle calls, while the code-swap shim
+ * achieves the same function-level capture the XLA backend needs (capture is
+ * whole-function; mid-frame resume has no XLA analogue).
+ *
+ * Build: CPython extension module `_pt_eval_frame` (see native.build_ext).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#if PY_VERSION_HEX >= 0x030b0000
+#define Py_BUILD_CORE
+#include <internal/pycore_frame.h>
+#undef Py_BUILD_CORE
+#endif
+
+static PyObject *g_callback = NULL;     /* Python callable or NULL */
+static PyObject *g_marked = NULL;       /* set of code objects */
+static Py_ssize_t g_hits = 0;           /* marked-frame interceptions */
+static Py_ssize_t g_total = 0;          /* all frames seen by the hook */
+static int g_installed = 0;
+
+/* thread-local re-entrancy latch: the callback itself runs Python frames */
+static Py_tss_t g_in_callback = Py_tss_NEEDS_INIT;
+
+static PyObject *
+custom_eval(PyThreadState *tstate, struct _PyInterpreterFrame *frame,
+            int throw_flag)
+{
+    g_total++;
+    if (g_callback != NULL && g_marked != NULL && !throw_flag &&
+        PyThread_tss_get(&g_in_callback) == NULL) {
+        PyCodeObject *code = frame->f_code;
+        int contains = PySet_Contains(g_marked, (PyObject *)code);
+        if (contains > 0) {
+            g_hits++;
+            PyThread_tss_set(&g_in_callback, (void *)1);
+            PyObject *res = PyObject_CallFunction(
+                g_callback, "OO", (PyObject *)code,
+                code->co_name ? code->co_name : Py_None);
+            PyThread_tss_set(&g_in_callback, NULL);
+            if (res == NULL) {
+                /* never return NULL without evaluating: the pushed frame is
+                 * cleared inside _PyEval_EvalFrameDefault — bailing here
+                 * would leak it. Callback errors are observational only. */
+                PyErr_WriteUnraisable(g_callback);
+            }
+            else {
+                Py_DECREF(res);
+            }
+        }
+        else if (contains < 0) {
+            PyErr_Clear();
+        }
+    }
+    return _PyEval_EvalFrameDefault(tstate, frame, throw_flag);
+}
+
+static PyObject *
+py_install(PyObject *self, PyObject *args)
+{
+    PyObject *cb;
+    if (!PyArg_ParseTuple(args, "O", &cb))
+        return NULL;
+    if (cb == Py_None) {
+        Py_CLEAR(g_callback);
+        if (g_installed) {
+            _PyInterpreterState_SetEvalFrameFunc(PyInterpreterState_Get(),
+                                                 _PyEval_EvalFrameDefault);
+            g_installed = 0;
+        }
+        Py_RETURN_NONE;
+    }
+    if (!PyCallable_Check(cb)) {
+        PyErr_SetString(PyExc_TypeError, "callback must be callable or None");
+        return NULL;
+    }
+    Py_INCREF(cb);
+    Py_XSETREF(g_callback, cb);
+    if (g_marked == NULL)
+        g_marked = PySet_New(NULL);
+    if (!g_installed) {
+        _PyInterpreterState_SetEvalFrameFunc(PyInterpreterState_Get(),
+                                             custom_eval);
+        g_installed = 1;
+    }
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+py_mark_code(PyObject *self, PyObject *args)
+{
+    PyObject *code;
+    if (!PyArg_ParseTuple(args, "O", &code))
+        return NULL;
+    if (!PyCode_Check(code)) {
+        PyErr_SetString(PyExc_TypeError, "expected a code object");
+        return NULL;
+    }
+    if (g_marked == NULL)
+        g_marked = PySet_New(NULL);
+    if (PySet_Add(g_marked, code) < 0)
+        return NULL;
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+py_unmark_code(PyObject *self, PyObject *args)
+{
+    PyObject *code;
+    if (!PyArg_ParseTuple(args, "O", &code))
+        return NULL;
+    if (g_marked != NULL)
+        (void)PySet_Discard(g_marked, code);
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+py_stats(PyObject *self, PyObject *noargs)
+{
+    return Py_BuildValue("{s:n,s:n,s:i}", "marked_hits", g_hits,
+                         "frames_seen", g_total, "installed", g_installed);
+}
+
+static PyMethodDef methods[] = {
+    {"install", py_install, METH_VARARGS,
+     "install(callback|None): set/remove the eval-frame hook"},
+    {"mark_code", py_mark_code, METH_VARARGS,
+     "register a code object for interception"},
+    {"unmark_code", py_unmark_code, METH_VARARGS, "deregister"},
+    {"stats", py_stats, METH_NOARGS, "hook counters"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_pt_eval_frame",
+    "PEP 523 eval-frame hook (SOT capture plane)", -1, methods,
+};
+
+PyMODINIT_FUNC
+PyInit__pt_eval_frame(void)
+{
+    if (PyThread_tss_create(&g_in_callback) != 0)
+        return NULL;
+    return PyModule_Create(&moduledef);
+}

@@ -1,0 +1,95 @@
+"""Parameter-server stack tests (reference test pattern: PS trainers push
+grads and pull params against table servers; SURVEY §2.8 PS row)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import PsService
+from paddle_tpu.distributed import CountFilterEntry
+
+
+class TestDenseTable:
+    def test_pull_push_sgd(self):
+        svc = PsService()
+        svc.server.add_dense_table(0, size=8, lr=0.5)
+        svc.start()
+        try:
+            c = svc.client()
+            c.set_dense(0, np.ones(8, np.float32))
+            np.testing.assert_allclose(c.pull_dense(0), 1.0)
+            c.push_dense_grad(0, np.full(8, 2.0, np.float32))
+            np.testing.assert_allclose(c.pull_dense(0), 0.0)  # 1 - 0.5*2
+            c.close()
+        finally:
+            svc.stop()
+
+
+class TestSparseTable:
+    def test_lazy_init_and_update(self):
+        svc = PsService()
+        svc.server.add_sparse_table(1, emb_dim=4, lr=1.0)
+        svc.start()
+        try:
+            c = svc.client()
+            rows = c.pull_sparse(1, [3, 7, 3])
+            assert rows.shape == (3, 4)
+            np.testing.assert_allclose(rows[0], rows[2])  # same id, same row
+            assert c.sparse_table_size(1) == 2
+            before = c.pull_sparse(1, [3])[0]
+            c.push_sparse_grad(1, [3], np.ones((1, 4), np.float32))
+            after = c.pull_sparse(1, [3])[0]
+            np.testing.assert_allclose(after, before - 1.0, atol=1e-6)
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_admission_entry(self):
+        svc = PsService()
+        svc.server.add_sparse_table(2, emb_dim=4,
+                                    entry=CountFilterEntry(count=2))
+        svc.start()
+        try:
+            c = svc.client()
+            first = c.pull_sparse(2, [11])
+            np.testing.assert_allclose(first, 0.0)   # not admitted yet
+            c.pull_sparse(2, [11])                   # second touch admits
+            assert c.sparse_table_size(2) == 1
+            c.close()
+        finally:
+            svc.stop()
+
+
+class TestWorkerFlow:
+    def test_embedding_training_round_trip(self):
+        """Worker pattern: pull rows -> local fwd/bwd on device -> push
+        per-id grads — the sparse half of a PS training step."""
+        svc = PsService()
+        svc.server.add_sparse_table(0, emb_dim=8, lr=0.1)
+        svc.start()
+        try:
+            c = svc.client()
+            ids = np.array([0, 1, 2, 1], np.int64)
+            for _ in range(3):
+                rows = c.pull_sparse(0, ids)
+                emb = paddle.to_tensor(rows)
+                emb.stop_gradient = False
+                loss = (emb ** 2).sum()
+                loss.backward()
+                c.push_sparse_grad(0, ids, emb.grad.numpy())
+            # rows decay toward zero under x^2 loss
+            final = c.pull_sparse(0, [0, 1, 2])
+            assert np.abs(final).max() < 0.01
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_multiple_clients_barrier(self):
+        svc = PsService()
+        svc.start()
+        try:
+            c1, c2 = svc.client(), svc.client()
+            c1.barrier()
+            c2.barrier()
+            assert svc.server._barrier_count == 2
+            c1.close(); c2.close()
+        finally:
+            svc.stop()

@@ -1,0 +1,174 @@
+"""SOT capture plane tests (reference test strategy: test/sot/ exercises
+translation, guards, and fallback; here scaled to the function-level design
+— SURVEY.md §2.5)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import symbolic_translate
+from paddle_tpu.jit.sot import SotFunction, sot_stats
+from paddle_tpu.jit.sot.opcode_analysis import analyze
+from paddle_tpu.jit.sot.guards import build_guard_key
+
+
+class TestGuards:
+    def test_key_distinguishes_shape_dtype_scalar(self):
+        def f(x, s):
+            return x * s
+        a = paddle.randn([4])
+        b = paddle.randn([8])
+        k1 = build_guard_key(f, (a, 2.0), {})
+        k2 = build_guard_key(f, (a, 2.0), {})
+        k3 = build_guard_key(f, (b, 2.0), {})
+        k4 = build_guard_key(f, (a, 3.0), {})
+        assert k1 == k2
+        assert k1 != k3 and k1 != k4
+
+    def test_closure_cells_guarded(self):
+        mult = 2.0
+
+        def f(x):
+            return x * mult
+        k1 = build_guard_key(f, (paddle.randn([2]),), {})
+        mult = 3.0
+
+        def g(x):
+            return x * mult
+        k2 = build_guard_key(g, (paddle.randn([2]),), {})
+        assert k1 != k2
+
+
+class TestOpcodeAnalysis:
+    def test_print_is_static_break(self):
+        def f(x):
+            print(x)
+            return x
+        assert analyze(f.__code__).must_break
+
+    def test_generator_is_static_break(self):
+        def f(x):
+            yield x
+        assert analyze(f.__code__).must_break
+
+    def test_clean_tensor_code_passes(self):
+        def f(x):
+            return (x * 2).sum()
+        assert not analyze(f.__code__).must_break
+
+    def test_nested_code_scanned(self):
+        def f(x):
+            def inner(y):
+                print(y)
+            return x
+        assert analyze(f.__code__).must_break
+
+
+class TestTranslate:
+    def test_trace_count_and_cache(self):
+        traces = {"n": 0}
+
+        @symbolic_translate
+        def f(x, s):
+            traces["n"] += 1
+            return (x * s).sum()
+
+        x = paddle.randn([4])
+        r1 = float(f(x, 2.0))
+        r2 = float(f(x, 2.0))
+        assert traces["n"] == 1
+        f(x, 3.0)
+        assert traces["n"] == 2
+        f(paddle.randn([2, 2]), 2.0)
+        assert traces["n"] == 3
+        np.testing.assert_allclose(r1, r2)
+
+    def test_numerics_match_eager(self, rng):
+        def body(x):
+            return paddle.nn.functional.gelu(x @ x.t()).mean()
+        sf = symbolic_translate(body)
+        x = paddle.to_tensor(rng.standard_normal((5, 5)).astype(np.float32))
+        np.testing.assert_allclose(float(sf(x)), float(body(x)), rtol=1e-5)
+
+    def test_statement_ir_records_ops(self):
+        @symbolic_translate
+        def f(x):
+            return (x + 1) * 2
+
+        f(paddle.randn([3]))
+        sir = f.statement_ir()
+        names = [s.name for s in sir]
+        assert "add" in names and "multiply" in names
+
+    def test_graph_break_falls_back_eager(self):
+        @symbolic_translate
+        def f(x):
+            v = float(x.sum().numpy())  # host escape at trace time
+            return x * v
+
+        out = f(paddle.ones([3]))
+        np.testing.assert_allclose(out.numpy(), 3.0)
+        assert f.graph_break_count >= 1
+
+    def test_static_pin_on_host_io(self):
+        @symbolic_translate
+        def f(x):
+            print("io")
+            return x + 1
+
+        assert f._eager_pinned
+        np.testing.assert_allclose(f(paddle.ones([2])).numpy(), 2.0)
+
+    def test_autograd_through_translation(self):
+        @symbolic_translate
+        def f(x):
+            return (x ** 2).sum()
+
+        x = paddle.randn([4])
+        x.stop_gradient = False
+        f(x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy(), rtol=1e-5)
+
+    def test_control_flow_chains_to_ast_tier(self):
+        @symbolic_translate
+        def f(x):
+            if x.sum() > 0:          # tensor predicate -> AST tier converts
+                return x * 2
+            return x - 1
+
+        pos = f(paddle.ones([3]))
+        neg = f(paddle.full([3], -1.0))
+        np.testing.assert_allclose(pos.numpy(), 2.0)
+        np.testing.assert_allclose(neg.numpy(), -2.0)
+
+    def test_stats_shape(self):
+        s = sot_stats()
+        assert "translations" in s and "graph_breaks" in s
+
+
+class TestEvalFrameHook:
+    def test_hook_intercepts_marked_code(self):
+        from paddle_tpu.native import build_eval_frame_ext
+        m = build_eval_frame_ext()
+        if m is None:
+            pytest.skip("no toolchain for the eval-frame extension")
+        seen = []
+
+        def target(a):
+            return a + 1
+
+        def cb(code, name):
+            seen.append(str(name))
+
+        m.mark_code(target.__code__)
+        prev_installed = m.stats()["installed"]
+        m.install(cb)
+        try:
+            assert target(1) == 2
+        finally:
+            m.unmark_code(target.__code__)
+            if not prev_installed:
+                m.install(None)
+            else:
+                from paddle_tpu.jit.sot import translate as _t
+                m.install(_t._frame_callback)
+        assert "target" in seen

@@ -1,0 +1,112 @@
+"""Comm watchdog (SURVEY §5.2 CommTaskManager role), auto-align tool, and
+amp accuracy comparison."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+
+
+class TestCommWatchdog:
+    def test_hang_detection_and_dump(self, tmp_path):
+        mgr = dist.CommTaskManager(timeout=0.15, poll_interval=0.05,
+                                   dump_dir=str(tmp_path))
+        reports = []
+        mgr.register_hang_hook(lambda r: reports.append(r))
+        mgr.start()
+        t = mgr.start_task("all_reduce", None)
+        time.sleep(0.4)
+        mgr.stop()
+        assert mgr.hang_detected
+        assert len(reports) == 1  # one report per task, not per poll
+        assert reports[0]["hung_tasks"][0]["op"] == "all_reduce"
+        assert any(f.endswith(".json") for f in os.listdir(tmp_path))
+        mgr.end_task(t)
+        assert mgr.outstanding() == []
+
+    def test_completed_tasks_not_flagged(self, tmp_path):
+        mgr = dist.CommTaskManager(timeout=0.2, poll_interval=0.05,
+                                   dump_dir=str(tmp_path))
+        mgr.start()
+        t = mgr.start_task("broadcast", None)
+        mgr.end_task(t)
+        time.sleep(0.3)
+        mgr.stop()
+        assert not mgr.hang_detected
+
+    def test_watched_collective_roundtrip(self):
+        dist.enable_comm_watchdog(timeout=600, poll_interval=60)
+        try:
+            x = paddle.ones([4])
+            dist.all_reduce(x)
+            assert dist.comm_task_manager.outstanding() == []
+            seqs = dist.comm_task_manager.group_sequences()
+            assert sum(seqs.values()) >= 1
+        finally:
+            dist.disable_comm_watchdog()
+
+
+class TestAutoAlign:
+    def test_identical_runs_align(self, tmp_path):
+        from paddle_tpu.distributed.auto_parallel.auto_align_tool import \
+            AutoAlignTool
+        paddle.seed(3)
+        m = nn.Linear(4, 4)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+
+        def run(d):
+            t = AutoAlignTool()
+            with t.collect():
+                (m(x) * 2).sum()
+            t.save(str(d))
+        run(tmp_path / "a")
+        run(tmp_path / "b")
+        ok, rep = AutoAlignTool.diff(str(tmp_path / "a"), str(tmp_path / "b"))
+        assert ok and all(r["status"] == "OK" for r in rep)
+
+    def test_divergence_located_at_first_bad_op(self, tmp_path):
+        from paddle_tpu.distributed.auto_parallel.auto_align_tool import \
+            AutoAlignTool
+        paddle.seed(3)
+        m = nn.Linear(4, 4)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        t1 = AutoAlignTool()
+        with t1.collect():
+            (m(x) * 2).sum()
+        t1.save(str(tmp_path / "a"))
+        m.weight.set_value(np.asarray(m.weight.numpy()) + 1.0)
+        t2 = AutoAlignTool()
+        with t2.collect():
+            (m(x) * 2).sum()
+        t2.save(str(tmp_path / "b"))
+        ok, rep = AutoAlignTool.diff(str(tmp_path / "a"), str(tmp_path / "b"))
+        assert not ok
+        assert rep[-1]["status"] == "DIVERGED"
+        assert rep[-1]["op_a"] == "linear"  # diverges at the first op
+
+
+class TestAccuracyCompare:
+    def test_bf16_vs_fp32_rows(self, tmp_path):
+        from paddle_tpu.amp.debugging import (collect_run_stats,
+                                              compare_accuracy)
+
+        def run(cast):
+            with collect_run_stats() as dump:
+                w = paddle.to_tensor(
+                    np.random.default_rng(0).standard_normal(
+                        (8, 8)).astype(np.float32))
+                if cast:
+                    w = w.astype("bfloat16")
+                (w @ w).sum()
+            return dump
+
+        out = str(tmp_path / "report.tsv")
+        rows = compare_accuracy(run(False), run(True), output_filename=out)
+        assert len(rows) >= 2
+        assert rows[0]["op"] in ("matmul", "cast")
+        assert os.path.exists(out)
+        assert not any(r["flag"] == "NAN/INF" for r in rows)

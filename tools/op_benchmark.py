@@ -1,0 +1,104 @@
+"""Op micro-benchmark gate (role of the reference's tools/ci_op_benchmark.sh
++ check_op_benchmark_result.py: time changed ops, compare against a stored
+baseline, flag regressions).
+
+Usage:
+  python tools/op_benchmark.py --save baseline.json          # record
+  python tools/op_benchmark.py --check baseline.json [-t 1.3] # gate
+
+Times a representative op set (elementwise, matmul, reduction, gather,
+softmax, conv, attention) on the available backend. Each case runs under
+jax.jit with a host sync per repetition batch.
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _cases():
+    import jax
+    import jax.numpy as jnp
+    key = jax.random.PRNGKey(0)
+    a2 = jax.random.normal(key, (4096, 4096), jnp.bfloat16)
+    v = jax.random.normal(key, (1 << 22,), jnp.float32)
+    idx = jax.random.randint(key, (1 << 18,), 0, 1 << 22)
+    img = jax.random.normal(key, (8, 64, 64, 64), jnp.float32)
+    ker = jax.random.normal(key, (3, 3, 64, 64), jnp.float32)
+    qkv = jax.random.normal(key, (4, 1024, 8, 64), jnp.bfloat16)
+
+    def conv(x, k):
+        return jax.lax.conv_general_dilated(
+            x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def attn(q):
+        s = jnp.einsum("bshd,bthd->bhst", q, q) / 8.0
+        p = jax.nn.softmax(s.astype(jnp.float32), -1).astype(q.dtype)
+        return jnp.einsum("bhst,bthd->bshd", p, q)
+
+    return {
+        "add": (lambda x: x + x, (v,)),
+        "mul_chain": (lambda x: ((x * 2 + 1) * x - x) * 0.5, (v,)),
+        "matmul_bf16_4k": (lambda x: x @ x, (a2,)),
+        "reduce_sum": (lambda x: x.sum(), (v,)),
+        "softmax_4k": (lambda x: jax.nn.softmax(x, -1), (a2,)),
+        "gather_256k": (lambda x, i: x[i], (v, idx)),
+        "conv2d_64c": (conv, (img, ker)),
+        "sdpa_1k": (attn, (qkv,)),
+    }
+
+
+def run_benchmarks(repeat=20, warmup=3):
+    import jax
+    out = {}
+    for name, (fn, args) in _cases().items():
+        import jax.numpy as jnp
+
+        def sync(r):
+            np.asarray(jnp.ravel(jax.tree_util.tree_leaves(r)[0])[:1])
+        jitted = jax.jit(fn)
+        sync(jitted(*args))
+        t0 = time.perf_counter()
+        for _ in range(repeat):
+            r = jitted(*args)
+        sync(r)
+        dt = (time.perf_counter() - t0) / repeat
+        out[name] = dt * 1e6  # us
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--save", metavar="FILE")
+    ap.add_argument("--check", metavar="FILE")
+    ap.add_argument("-t", "--threshold", type=float, default=1.3,
+                    help="max allowed slowdown factor vs baseline")
+    args = ap.parse_args()
+    times = run_benchmarks()
+    for k, v in times.items():
+        print(f"{k:20s} {v:10.1f} us")
+    if args.save:
+        with open(args.save, "w") as f:
+            json.dump(times, f, indent=2)
+        print(f"baseline saved to {args.save}")
+    if args.check:
+        with open(args.check) as f:
+            base = json.load(f)
+        failures = []
+        for k, v in times.items():
+            b = base.get(k)
+            if b and v > b * args.threshold:
+                failures.append(f"{k}: {v:.1f}us vs baseline {b:.1f}us "
+                                f"({v / b:.2f}x)")
+        if failures:
+            print("OP BENCHMARK REGRESSIONS:")
+            for f_ in failures:
+                print("  " + f_)
+            sys.exit(1)
+        print(f"all ops within {args.threshold}x of baseline")
+
+
+if __name__ == "__main__":
+    main()
