@@ -142,3 +142,9 @@ def disable_static(place=None):
 
 
 # in_dynamic_mode comes from framework.compat (star import above)
+
+
+# late-bound Tensor methods that need linalg/signal modules loaded
+from .core.tensor import _attach_extra_methods as _aem
+_aem()
+del _aem

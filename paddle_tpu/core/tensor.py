@@ -361,3 +361,64 @@ class Parameter(Tensor):
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     """paddle.to_tensor equivalent."""
     return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+# -- reference Tensor-method completion (python/paddle/tensor/__init__.py
+#    tensor_method_func tail: module-level fns patched as methods) ---------
+def _attach_extra_methods():
+    """Attach methods whose implementations live outside the op registry
+    (linalg composites, signal transforms, framework helpers)."""
+    from .. import linalg as _linalg
+    from .. import signal as _signal
+
+    Tensor.multi_dot = lambda self, *others: (
+        _linalg.multi_dot([self, *others]) if others else self)
+    Tensor.stft = lambda self, *a, **k: _signal.stft(self, *a, **k)
+    Tensor.istft = lambda self, *a, **k: _signal.istft(self, *a, **k)
+    Tensor.is_tensor = lambda self: True
+    Tensor.rank = lambda self: self.ndim
+
+    def broadcast_shape(self, y_shape):
+        from ..framework.compat import broadcast_shape as _bs
+        return _bs(self.shape, y_shape)
+    Tensor.broadcast_shape = broadcast_shape
+
+    def create_tensor(self, dtype=None, name=None, persistable=False):
+        import jax.numpy as jnp
+        from .dtypes import convert_dtype
+        return Tensor(jnp.zeros((), convert_dtype(dtype) or self.dtype))
+    Tensor.create_tensor = create_tensor
+
+    def create_parameter(self, shape, dtype=None, **kw):
+        from ..framework.compat import create_parameter as _cp
+        return _cp(shape, dtype or "float32", **kw)
+    Tensor.create_parameter = create_parameter
+
+    def set_(self, source=None, shape=None):
+        """Rebind this tensor's storage to `source`'s (reference
+        Tensor.set_)."""
+        if source is not None:
+            self._data = source._data if isinstance(source, Tensor) \
+                else source
+            if shape is not None:
+                self._data = self._data.reshape(
+                    tuple(int(s) for s in shape))
+        return self
+    Tensor.set_ = set_
+
+    def resize_(self, shape):
+        """Reshape in place, growing/shrinking storage as needed
+        (reference Tensor.resize_)."""
+        import numpy as np
+        import jax.numpy as jnp
+        shape = tuple(int(s) for s in shape)
+        n_new = int(np.prod(shape)) if shape else 1
+        flat = jnp.ravel(self._data)
+        if n_new <= flat.shape[0]:
+            self._data = flat[:n_new].reshape(shape)
+        else:
+            pad = jnp.zeros((n_new - flat.shape[0],), flat.dtype)
+            self._data = jnp.concatenate([flat, pad]).reshape(shape)
+        return self
+    Tensor.resize_ = resize_
+

@@ -174,3 +174,37 @@ def _i64():
     """Index dtype: int64 when x64 is on, else canonical int32 (silent)."""
     import jax
     return jnp.int64 if jax.config.x64_enabled else jnp.int32
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, key=None):
+    """Nucleus sampling (reference top_p_sampling op): per row, sample from
+    the smallest probability mass >= p. Static-shape TPU design: sort once,
+    mask the tail, renormalize, sample via Gumbel-argmax on the masked
+    logits."""
+    from ...core import random as _random
+    a = _arr(x)
+    p = _arr(ps)
+    probs = a / jnp.maximum(a.sum(-1, keepdims=True), 1e-30) \
+        if jnp.issubdtype(a.dtype, jnp.floating) else a
+    sort_idx = jnp.argsort(-probs, axis=-1)
+    sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    # keep tokens while cumulative mass (exclusive) < p — always >= 1 token
+    keep_sorted = (cum - sorted_p) < jnp.reshape(p, (-1, 1))
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(a.shape[0])[:, None], sort_idx].set(keep_sorted)
+    masked = jnp.where(keep, probs, 0.0)
+    logits = jnp.log(jnp.maximum(masked, 1e-30))
+    if key is not None:
+        kkey = key
+    elif seed is not None and seed >= 0:
+        kkey = jax.random.PRNGKey(int(seed))  # reproducible seeded draws
+    elif topp_seed is not None:
+        kkey = jax.random.PRNGKey(int(_arr(topp_seed).reshape(-1)[0]))
+    else:
+        kkey = _random.next_key()
+    g = jax.random.gumbel(kkey, a.shape)
+    ids = jnp.argmax(logits + g, axis=-1).astype(_i64())
+    out_p = jnp.take_along_axis(probs, ids[:, None], axis=-1)
+    return out_p, ids[:, None]

@@ -263,3 +263,42 @@ class TestParallelizePlans:
         loss = (out ** 2).sum()
         loss.backward()
         assert model[0].weight.grad is not None
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_tensor_method_parity():
+    """Every name in the reference's tensor_method_func list is a Tensor
+    attribute here (the ~400 patched methods of python/paddle/tensor)."""
+    src = open(REF + "tensor/__init__.py").read()
+    names = []
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "tensor_method_func":
+                    names = ast.literal_eval(node.value)
+    missing = [n for n in names if not hasattr(paddle.Tensor, n)]
+    assert not missing, f"Tensor missing {len(missing)}: {missing}"
+
+
+class TestNewTensorMethods:
+    def test_top_p_sampling_nucleus(self):
+        probs = paddle.to_tensor(
+            np.array([[0.6, 0.25, 0.1, 0.05]], np.float32))
+        for _ in range(5):
+            _, ids = paddle.top_p_sampling(
+                probs, paddle.to_tensor(np.array([0.5], np.float32)))
+            assert int(ids.numpy()[0, 0]) == 0  # only token 0 in the nucleus
+
+    def test_resize_set_(self):
+        t = paddle.arange(6).astype("float32")
+        t.resize_([2, 4])
+        assert t.shape == [2, 4]
+        assert float(t.numpy()[1, 2]) == 0.0  # grown region zero-filled
+        s = paddle.zeros([2, 2])
+        s.set_(paddle.ones([2, 2]))
+        assert float(s.sum()) == 4.0
+
+    def test_inplace_trig_methods(self):
+        x = paddle.to_tensor(np.array([0.3], np.float32))
+        x.asin_()
+        np.testing.assert_allclose(x.numpy(), np.arcsin(0.3), rtol=1e-6)
