@@ -39,6 +39,11 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, spatial,
         dn_str = ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW" else ("NDHWC", "OIDHW", "NDHWC")
 
     def impl(a, w, *maybe_b):
+        if a.dtype != w.dtype:
+            # promote like matmul does — lax.conv requires equal dtypes
+            # (mixed fp32 activations / bf16 weights is the common amp case)
+            ct = jnp.result_type(a.dtype, w.dtype)
+            a, w = a.astype(ct), w.astype(ct)
         dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, dn_str)
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=strides, padding=pad,

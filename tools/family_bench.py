@@ -1,0 +1,94 @@
+"""Measure BASELINE configs 3-5 on the real chip: ERNIE MLM train step,
+ViT-L train step, conditional UNet train step (jitted fwd+bwd+sgd)."""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from paddle_tpu.jit.functional import state_arrays, pure_call
+
+
+def train_step_fn(model, loss_of_out, bf16=True):
+    params, buffers = state_arrays(model)
+    model.train()
+
+    def loss_fn(p, *inputs):
+        if bf16:
+            p = {n: (v.astype(jnp.bfloat16)
+                     if v.dtype == jnp.float32 and v.ndim >= 2 else v)
+                 for n, v in p.items()}
+            inputs = tuple(x.astype(jnp.bfloat16)
+                           if x.dtype == jnp.float32 else x for x in inputs)
+        out = pure_call(model, p, buffers, *inputs)
+        return loss_of_out(out, *inputs).astype(jnp.float32)
+
+    @jax.jit
+    def step(p, *inputs):
+        loss, g = jax.value_and_grad(loss_fn)(p, *inputs)
+        newp = {n: (p[n] - 1e-3 * g[n].astype(p[n].dtype)) for n in p}
+        return newp, loss
+    return params, step
+
+
+def bench(name, params, step, inputs, per_step_items, unit, iters=10, warmup=2):
+    for _ in range(warmup):
+        params, loss = step(params, *inputs)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, loss = step(params, *inputs)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name}: {per_step_items/dt:,.0f} {unit}  (step {dt*1000:.0f} ms, loss {float(loss):.3f})", flush=True)
+
+
+import sys
+which = sys.argv[1]
+
+if which == "ernie":
+    # ERNIE-base-ish MLM (config 3 scaled to one v5e chip)
+    from paddle_tpu.models.ernie import ErnieConfig, ErnieForMaskedLM
+    import paddle_tpu.nn.functional as F
+    cfg = ErnieConfig(vocab_size=40000, hidden_size=768,
+                      num_hidden_layers=12, num_attention_heads=12,
+                      intermediate_size=3072, max_position_embeddings=512)
+    model = ErnieForMaskedLM(cfg)
+    B, S = 32, 512
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 40000, (B, S)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 40000, (B, S)), jnp.int32)
+
+    def loss_of(out, *_):
+        logits = out if not isinstance(out, tuple) else out[0]
+        v = logits.shape[-1]
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32).reshape(-1, v))
+        return -jnp.take_along_axis(lp, labels.reshape(-1, 1), 1).mean()
+    params, step = train_step_fn(model, loss_of)
+    bench("ernie_base_mlm_tokens_per_sec", params, step, (ids,), B * S, "tokens/s")
+
+elif which == "vit":
+    from paddle_tpu.models.vit import vit_large_patch16_224
+    model = vit_large_patch16_224(num_classes=1000)
+    B = 32
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.standard_normal((B, 3, 224, 224)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 1000, (B,)), jnp.int32)
+
+    def loss_of(out, *_):
+        lp = jax.nn.log_softmax(out.astype(jnp.float32))
+        return -jnp.take_along_axis(lp, labels[:, None], 1).mean()
+    params, step = train_step_fn(model, loss_of)
+    bench("vit_large_images_per_sec", params, step, (imgs,), B, "images/s")
+
+elif which == "unet":
+    from paddle_tpu.models.unet import UNet2DConditionModel
+    model = UNet2DConditionModel(in_channels=4, out_channels=4,
+                                 base_channels=192, context_dim=768)
+    B = 8
+    rng = np.random.default_rng(0)
+    lat = jnp.asarray(rng.standard_normal((B, 4, 64, 64)), jnp.float32)
+    t = jnp.asarray(rng.integers(0, 1000, (B,)), jnp.int32)
+    ctx = jnp.asarray(rng.standard_normal((B, 77, 768)), jnp.float32)
+
+    def loss_of(out, *_):
+        return (out.astype(jnp.float32) ** 2).mean()
+    params, step = train_step_fn(model, loss_of, bf16=False)
+    bench("sd_unet_samples_per_sec", params, step, (lat, t, ctx), B, "samples/s")
