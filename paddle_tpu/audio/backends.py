@@ -64,8 +64,18 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
         f.setpos(frame_offset)
         count = n - frame_offset if num_frames < 0 else num_frames
         raw = f.readframes(count)
-    dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
-    data = np.frombuffer(raw, dtype=dt).reshape(-1, ch)
+    if width == 3:
+        # 24-bit PCM: widen each 3-byte little-endian frame to int32
+        b = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 3)
+        data = ((b[:, 0].astype(np.int32)) | (b[:, 1].astype(np.int32) << 8)
+                | (b[:, 2].astype(np.int8).astype(np.int32) << 16))
+        data = data.reshape(-1, ch)
+    else:
+        try:
+            dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+        except KeyError:
+            raise ValueError(f"unsupported WAV sample width {width} bytes")
+        data = np.frombuffer(raw, dtype=dt).reshape(-1, ch)
     if normalize:
         if width == 1:
             wav = (data.astype(np.float32) - 128.0) / 128.0
@@ -91,9 +101,23 @@ def save(filepath, src, sample_rate, channels_first=True,
     width = bits_per_sample // 8
     peak = float(2 ** (bits_per_sample - 1) - 1)
     data = np.clip(arr, -1.0, 1.0) * peak
-    dt = {2: np.int16, 4: np.int32}[width]
+    if width == 3:
+        ints = data.astype(np.int32)
+        frames = np.empty((ints.size, 3), np.uint8)
+        flat = ints.reshape(-1)
+        frames[:, 0] = flat & 0xFF
+        frames[:, 1] = (flat >> 8) & 0xFF
+        frames[:, 2] = (flat >> 16) & 0xFF
+        payload = frames.tobytes()
+    else:
+        try:
+            dt = {2: np.int16, 4: np.int32}[width]
+        except KeyError:
+            raise ValueError(
+                f"unsupported bits_per_sample {bits_per_sample}")
+        payload = data.astype(dt).tobytes()
     with _wave.open(filepath, "wb") as f:
         f.setnchannels(arr.shape[1])
         f.setsampwidth(width)
         f.setframerate(int(sample_rate))
-        f.writeframes(data.astype(dt).tobytes())
+        f.writeframes(payload)

@@ -64,28 +64,44 @@ class TapeRef:
     inplace ops rebind the Python Tensor object to a new node (the reference
     tracks this with inplace version counters on TensorWrapper,
     paddle/fluid/eager/tensor_wrapper.h:39); the recorded edge must keep
-    pointing at the producing node as of the forward call."""
+    pointing at the producing node as of the forward call. `data` snapshots
+    the forward-time value so the create_graph re-derivation uses the value
+    the op actually saw even if the Python object was later rebound."""
 
-    __slots__ = ("tensor", "node", "out_idx")
+    __slots__ = ("tensor", "node", "out_idx", "data")
 
     def __init__(self, tensor):
         self.tensor = tensor
         self.node = tensor._node
         self.out_idx = tensor._out_idx
+        self.data = tensor._data
 
 
 class GradNode:
     """One recorded op. `vjp_fn` maps output cotangents -> input cotangents
-    for the *differentiable* inputs (`parents`, in order)."""
+    for the *differentiable* inputs (`parents`, in order).
 
-    __slots__ = ("name", "vjp_fn", "parents", "out_avals", "n_outputs")
+    When `impl`/`treedef`/`plain`/`diff_idx` are present (every registry op
+    records them via dispatch), the node can also *re-derive* its grads as
+    dispatched ops — that is the create_graph=True path (reference: generated
+    double/triple-grad nodes, paddle/fluid/eager/backward.cc:490): the vjp is
+    re-executed through apply_op so the grad computation itself lands on the
+    tape and supports another backward."""
 
-    def __init__(self, name, vjp_fn, parents, out_avals):
+    __slots__ = ("name", "vjp_fn", "parents", "out_avals", "n_outputs",
+                 "impl", "treedef", "plain", "diff_idx")
+
+    def __init__(self, name, vjp_fn, parents, out_avals,
+                 impl=None, treedef=None, plain=None, diff_idx=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.parents = [TapeRef(p) for p in parents]  # strong refs keep graph alive
         self.out_avals = out_avals      # list[(shape, dtype)]
         self.n_outputs = len(out_avals)
+        self.impl = impl
+        self.treedef = treedef
+        self.plain = plain
+        self.diff_idx = diff_idx
 
     def __repr__(self):
         return f"<GradNode {self.name} n_out={self.n_outputs}>"
@@ -104,14 +120,78 @@ def _accumulate(a, b):
     return a + b
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False, _only_leaves=None):
+def _node_grad_traced(node, couts):
+    """Re-derive one node's input grads as a *dispatched* op, so the grad
+    computation is itself recorded on the tape (create_graph=True;
+    reference: generated double/triple-grad nodes,
+    paddle/fluid/eager/backward.cc:490 + eager_gen.py prim_white_list).
+    `couts` holds Tensors for inexact outputs and raw float0 arrays for
+    integer outputs. Returns one grad per parent: Tensors for inexact
+    parents, float0 arrays otherwise."""
+    from .tensor import Tensor
+    from .dispatch import apply_op
+
+    if node.impl is None:
+        raise RuntimeError(
+            f"create_graph=True through '{node.name}' is not supported: the "
+            "node records no re-derivable forward (PyLayer/custom ops are "
+            "once-differentiable)")
+    impl, treedef, plain, diff_idx = (node.impl, node.treedef, node.plain,
+                                      node.diff_idx)
+    n = len(node.parents)
+    prim_in = []
+    for ref in node.parents:
+        t = ref.tensor
+        if t._node is ref.node and t._out_idx == ref.out_idx and t._data is ref.data:
+            prim_in.append(t)
+        else:  # rebound since forward: reconstruct the forward-time view
+            w = Tensor(ref.data, stop_gradient=t.stop_gradient)
+            w._node = ref.node
+            w._out_idx = ref.out_idx
+            prim_in.append(w)
+    inexact = [jnp.issubdtype(jnp.result_type(ref.data), jnp.inexact)
+               for ref in node.parents]
+    if not any(inexact):  # nothing differentiable flows: all grads are float0
+        return [np.zeros(jnp.shape(ref.data), jax.dtypes.float0)
+                for ref in node.parents]
+
+    def grad_impl(*vals):
+        prim, cts = vals[:n], vals[n:]
+
+        def fwd(*darrs):
+            nl = list(plain)
+            for j, i in enumerate(diff_idx):
+                nl[i] = darrs[j]
+            a, k = jax.tree_util.tree_unflatten(treedef, nl)
+            return impl(*a, **k)
+
+        _, vjp_fn = jax.vjp(fwd, *prim)
+        gs = vjp_fn(tuple(cts) if node.n_outputs > 1 else cts[0])
+        traced = [g for g, ok in zip(gs, inexact) if ok]
+        return tuple(traced) if len(traced) > 1 else traced[0]
+
+    out = apply_op(node.name + "_grad", grad_impl, (*prim_in, *couts), {})
+    outs = list(out) if isinstance(out, (tuple, list)) else [out]
+    result, it = [], iter(outs)
+    for ref, ok in zip(node.parents, inexact):
+        if ok:
+            result.append(next(it))
+        else:
+            result.append(np.zeros(jnp.shape(ref.data), jax.dtypes.float0))
+    return result
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False,
+             create_graph=False, _only_leaves=None):
     """Run reverse-mode accumulation from `tensors` (list or single Tensor).
 
     Mirrors egr::Backward (paddle/fluid/eager/backward.cc:473): seeds the
     output cotangents, walks nodes in reverse topological order, deposits
     into leaf `.grad`, honors per-tensor hooks, frees the graph unless
-    retain_graph.
-    """
+    retain_graph. With create_graph=True every node's grads are computed by
+    dispatched ops (_node_grad_traced), so the produced grads carry tape
+    nodes and support a further backward()/grad() call — arbitrary-order
+    differentiation on the eager tape."""
     from .tensor import Tensor  # cycle
 
     if isinstance(tensors, Tensor):
@@ -120,25 +200,37 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _only_leaves=None):
         grad_tensors = [None] * len(tensors)
     elif isinstance(grad_tensors, Tensor):
         grad_tensors = [grad_tensors]
+    if create_graph:
+        retain_graph = True
+
+    def as_ct(v):
+        # canonical cotangent form for the mode: Tensors when building the
+        # grad graph, raw arrays otherwise (float0 always stays raw)
+        if isinstance(v, Tensor):
+            return v if create_graph else v.data
+        if not create_graph or getattr(v, "dtype", None) == jax.dtypes.float0:
+            return v
+        return Tensor(v, stop_gradient=True)
 
     # (node, out_idx) -> cotangent
     cotangents = {}
     roots = []
     for t, g in zip(tensors, grad_tensors):
-        seed = g.data if isinstance(g, Tensor) else g
+        seed = g
         if seed is None:
             if t.data.size != 1:
                 raise RuntimeError(
                     "grad can be implicitly created only for scalar outputs; "
                     f"got shape {list(t.data.shape)}")
             seed = jnp.ones_like(t.data)
+        seed = as_ct(seed)
         # hooks fire for roots too (torch/paddle semantics: a tensor's
         # hooks run whenever its gradient is computed, and a backward root
         # receives the seed as its gradient)
         for hook in t._hooks:
             out = hook(t._wrap_grad(seed))
             if out is not None:
-                seed = out.data if isinstance(out, Tensor) else out
+                seed = as_ct(out)
         if t._node is None:
             if not t.stop_gradient and (_only_leaves is None or id(t) in _only_leaves):
                 t._deposit_grad(seed)
@@ -171,14 +263,20 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _only_leaves=None):
         couts = []
         for i, (shape, dtype) in enumerate(node.out_avals):
             c = cotangents.pop((id(node), i), None)
-            couts.append(c if c is not None else _zero_cotangent(shape, dtype))
-        in_grads = node.vjp_fn(tuple(couts) if node.n_outputs > 1 else couts[0])
+            if c is None:
+                c = as_ct(_zero_cotangent(shape, dtype))
+            couts.append(c)
+        if create_graph:
+            in_grads = _node_grad_traced(node, couts)
+        else:
+            in_grads = node.vjp_fn(
+                tuple(couts) if node.n_outputs > 1 else couts[0])
         for ref, g in zip(node.parents, in_grads):
             t = ref.tensor
             for hook in t._hooks:
                 out = hook(t._wrap_grad(g))
                 if out is not None:
-                    g = out.data if isinstance(out, Tensor) else out
+                    g = as_ct(out)
             if ref.node is None or t._retain_grad:
                 if not t.stop_gradient and (_only_leaves is None or id(t) in _only_leaves):
                     t._deposit_grad(g)
@@ -188,6 +286,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _only_leaves=None):
         if not retain_graph:
             node.vjp_fn = None
             node.parents = []
+            node.impl = node.treedef = node.plain = node.diff_idx = None
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
@@ -196,10 +295,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     returns grads of `outputs` w.r.t. `inputs` without touching `.grad`.
 
     Implemented by running the tape walk while capturing cotangents for
-    `inputs`. create_graph (higher order) is supported by re-tracing through
-    `jax.vjp` of the functionalized subgraph — currently limited to
-    create_graph=False on the tape path; use jit/functional API for
-    higher-order.
+    `inputs`. With create_graph=True the walk re-derives every node's grads
+    through dispatch (_node_grad_traced) so the returned grads are
+    themselves differentiable — double/triple grad on the tape.
     """
     from .tensor import Tensor
 
@@ -207,12 +305,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True on the eager tape is not supported yet; "
-            "use paddle_tpu.incubate.autograd (functional jax.grad) instead")
     if retain_graph is None:
-        retain_graph = False
+        retain_graph = bool(create_graph)
 
     # stash and restore .grad of the input leaves, run backward capturing
     # grads ONLY for `inputs` (other leaves' .grad stays untouched)
@@ -223,6 +317,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         t.stop_gradient = False
     try:
         backward(outputs, grad_tensors=grad_outputs, retain_graph=retain_graph,
+                 create_graph=create_graph,
                  _only_leaves={id(t) for t in inputs})
         result = []
         for t in inputs:

@@ -189,7 +189,9 @@ def _apply_op_inner(name, impl, args, kwargs, differentiable=True):
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
     node = GradNode(name, vjp_fn, parents,
-                    [(o.shape, o.dtype) for o in outs])
+                    [(o.shape, o.dtype) for o in outs],
+                    impl=impl, treedef=treedef, plain=plain,
+                    diff_idx=diff_idx)
     wrapped = _wrap(name, out, node=node)
     if _static_recorder is not None:
         _static_recorder(name, impl, treedef, leaves, tensor_idx, wrapped)

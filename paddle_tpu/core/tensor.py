@@ -138,9 +138,10 @@ class Tensor:
         return np.asarray(self._data).tolist()
 
     # -- autograd -------------------------------------------------------
-    def backward(self, grad_tensor=None, retain_graph=False):
+    def backward(self, grad_tensor=None, retain_graph=False,
+                 create_graph=False):
         ag.backward(self, grad_tensors=None if grad_tensor is None else [grad_tensor],
-                    retain_graph=retain_graph)
+                    retain_graph=retain_graph, create_graph=create_graph)
 
     def detach(self):
         t = Tensor(self._data, stop_gradient=True)
@@ -180,13 +181,18 @@ class Tensor:
     def _deposit_grad(self, g):
         if getattr(g, "dtype", None) == jax.dtypes.float0:
             return
+        if isinstance(g, Tensor):
+            # create_graph path: keep the grad's tape node so the deposited
+            # .grad supports another backward (gradient-penalty training)
+            self.grad = g if self.grad is None else self.grad + g
+            return
         if self.grad is None:
             self.grad = Tensor(g, stop_gradient=True)
         else:
             self.grad = Tensor(self.grad._data + g, stop_gradient=True)
 
     def _wrap_grad(self, g):
-        return Tensor(g, stop_gradient=True)
+        return g if isinstance(g, Tensor) else Tensor(g, stop_gradient=True)
 
     # -- dtype / device -------------------------------------------------
     def astype(self, dtype):
@@ -226,14 +232,15 @@ class Tensor:
 
     # -- random in-place fills (reference: paddle.Tensor.uniform_/normal_/
     # bernoulli_/cauchy_/geometric_/log_normal_/exponential_) -----------
-    def _fill_random(self, sampler):
+    def _fill_random(self, sampler, seed=0):
         from . import random as _rng
-        self._data = sampler(_rng.next_key()).astype(self._data.dtype)
+        key = jax.random.PRNGKey(seed) if seed else _rng.next_key()
+        self._data = sampler(key).astype(self._data.dtype)
         return self
 
     def uniform_(self, min=-1.0, max=1.0, seed=0):
         return self._fill_random(lambda k: jax.random.uniform(
-            k, self._data.shape, jnp.float32, min, max))
+            k, self._data.shape, jnp.float32, min, max), seed=seed)
 
     def normal_(self, mean=0.0, std=1.0):
         return self._fill_random(lambda k: jax.random.normal(

@@ -427,6 +427,13 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
             logp[:, :, :umax, :],
             jnp.broadcast_to(y[:, None, :, None], (b, tmax, umax, 1)),
             axis=-1)[..., 0]                              # [B, T, U]
+        if fastemit_lambda:
+            # FastEmit (arXiv:2010.11148): scale the gradient flowing through
+            # the label-emission path by (1 + lambda) without changing the
+            # loss value — same effect as the reference kernel's in-gradient
+            # scaling (warprnnt fastemit_lambda).
+            lam = jnp.asarray(fastemit_lambda, ylp.dtype)
+            ylp = (1.0 + lam) * ylp - jax.lax.stop_gradient(lam * ylp)
         neg_inf = jnp.float32(-1e30)
 
         def t_step(alpha_prev, xs):

@@ -56,17 +56,31 @@ def _make_method(fn):
     return method
 
 
-def _make_inplace_method(fn):
+def _make_inplace_method(fn, target=0, target_name=None):
     """Trailing-underscore inplace variant (paddle add_/clip_/...): runs the
-    op, then rebinds this tensor to the op output — autograd-correct inplace,
-    same contract as the reference's inplace ops + version counter."""
+    op, then rebinds the target tensor to the op output — autograd-correct
+    inplace, same contract as the reference's inplace ops + version counter.
+    `target` is the positional index of the argument that receives the
+    result (reference where_ writes into x, not condition — yaml `inplace: 1`);
+    `target_name` resolves it when passed by keyword."""
     def method(self, *args, **kwargs):
         out = fn(self, *args, **kwargs)
-        self._data = out._data
-        self._node = out._node
-        self._out_idx = out._out_idx
-        self.stop_gradient = out.stop_gradient and self.stop_gradient
-        return self
+        if target == 0:
+            tgt = self
+        elif len(args) >= target:
+            tgt = args[target - 1]
+        else:
+            tgt = kwargs.get(target_name)
+        if not isinstance(tgt, Tensor):
+            raise ValueError(
+                f"{fn.__name__}_ writes its result into argument "
+                f"{target_name or target}, which must be a Tensor; got "
+                f"{type(tgt).__name__}")
+        tgt._data = out._data
+        tgt._node = out._node
+        tgt._out_idx = out._out_idx
+        tgt.stop_gradient = out.stop_gradient and tgt.stop_gradient
+        return tgt
     method.__name__ = fn.__name__ + "_"
     return method
 
@@ -101,8 +115,15 @@ def load_registry():
                 for alias in info.aliases:
                     setattr(Tensor, alias, _make_method(fn))
             if info.inplace:
+                tgt = 0 if info.inplace is True else int(info.inplace)
+                tname = None
+                if tgt:
+                    import inspect
+                    sig_params = list(inspect.signature(info.impl).parameters)
+                    tname = sig_params[tgt] if tgt < len(sig_params) else None
                 for nm in [name] + list(info.aliases):
-                    setattr(Tensor, nm + "_", _make_inplace_method(fn))
+                    setattr(Tensor, nm + "_",
+                            _make_inplace_method(fn, tgt, tname))
                     namespace[nm + "_"] = getattr(Tensor, nm + "_")
     _attach_dunders(namespace)
     return namespace

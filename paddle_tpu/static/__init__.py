@@ -211,8 +211,34 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     """Build grads for a recorded program (reference append_backward).
     Dygraph-first stack: runs loss.backward() and returns (param, grad)
     pairs — the static-program grads the reference would insert as ops."""
-    loss.backward(retain_graph=True)
-    params = parameter_list or []
+    from ..core import autograd as _ag
+    if parameter_list is None:
+        # reference default: all trainable params reachable from the loss —
+        # here that is the tape's leaf tensors with stop_gradient=False
+        seen, params, param_ids = set(), [], set()
+        stack = [loss._node] if loss._node else []
+        while stack:
+            node = stack.pop()
+            if node is None or id(node) in seen:
+                continue
+            seen.add(id(node))
+            for ref in node.parents:
+                t = ref.tensor
+                if ref.node is None:
+                    if not t.stop_gradient and id(t) not in param_ids:
+                        params.append(t)
+                        param_ids.add(id(t))
+                else:
+                    stack.append(ref.node)
+    else:
+        params = list(parameter_list)
+    if no_grad_set:
+        drop = {id(t) for t in no_grad_set}
+        params = [p for p in params if id(p) not in drop]
+    # deposit grads only into the selected params (no_grad_set tensors get
+    # no gradient at all, matching the reference semantics)
+    _ag.backward(loss, retain_graph=True,
+                 _only_leaves={id(p) for p in params})
     out = []
     for p in params:
         if getattr(p, "grad", None) is not None:

@@ -150,3 +150,96 @@ def test_zero_out_degree_multi_roots():
     z = x * 3
     paddle.core.autograd.backward([y.sum(), z.sum()])
     np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+# -- higher-order gradients on the tape (reference: egr::Grad create_graph,
+# paddle/fluid/eager/backward.cc:490; test/autograd/) ----------------------
+
+def test_double_grad_tanh():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core import autograd as ag
+    x = paddle.to_tensor([0.3, -0.7, 1.2], stop_gradient=False)
+    y = paddle.tanh(x).sum()
+    gx, = ag.grad([y], [x], create_graph=True)
+    assert gx._node is not None  # grad carries a tape node
+    g2, = ag.grad([gx.sum()], [x])
+    ref = jax.grad(lambda v: jax.grad(lambda u: jnp.tanh(u).sum())(v).sum())(
+        x.numpy())
+    np.testing.assert_allclose(g2.numpy(), ref, atol=1e-5)
+
+
+def test_double_grad_matmul():
+    import jax
+    rng = np.random.default_rng(0)
+    a_np = rng.standard_normal((3, 4), dtype=np.float32)
+    b_np = rng.standard_normal((4, 2), dtype=np.float32)
+    from paddle_tpu.core import autograd as ag
+    A = paddle.to_tensor(a_np, stop_gradient=False)
+    B = paddle.to_tensor(b_np, stop_gradient=False)
+    out = (paddle.matmul(A, B) ** 2).sum()
+    gA, = ag.grad([out], [A], create_graph=True)
+    g2A, = ag.grad([(gA ** 2).sum()], [A])
+    f = lambda a, b: ((a @ b) ** 2).sum()
+    ref = jax.grad(lambda a: (jax.grad(f)(a, b_np) ** 2).sum())(a_np)
+    np.testing.assert_allclose(g2A.numpy(), ref, atol=1e-4)
+
+
+def test_triple_grad():
+    from paddle_tpu.core import autograd as ag
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = (x ** 4).sum()
+    g1, = ag.grad([y], [x], create_graph=True)
+    g2, = ag.grad([g1.sum()], [x], create_graph=True)
+    g3, = ag.grad([g2.sum()], [x])
+    np.testing.assert_allclose(g3.numpy(), [24 * 1.5], atol=1e-4)
+
+
+def test_backward_create_graph_deposits_graph_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    y.backward(create_graph=True)
+    assert x.grad._node is not None
+    # second-order through the deposited .grad
+    from paddle_tpu.core import autograd as ag
+    g2, = ag.grad([x.grad.sum()], [x])
+    np.testing.assert_allclose(g2.numpy(), [12.0], atol=1e-5)  # d2 x^3 = 6x
+
+
+def test_gradient_penalty_training_step():
+    """WGAN-GP style: loss includes the norm of an input gradient."""
+    from paddle_tpu.core import autograd as ag
+    rng = np.random.default_rng(1)
+    w = paddle.to_tensor(rng.standard_normal((4, 1), dtype=np.float32),
+                         stop_gradient=False)
+    x = paddle.to_tensor(rng.standard_normal((8, 4), dtype=np.float32),
+                         stop_gradient=False)
+    score = paddle.matmul(x, w).sum()
+    gx, = ag.grad([score], [x], create_graph=True)
+    gp = ((gx.norm(p=2, axis=1) - 1.0) ** 2).mean()
+    gp.backward()
+    assert w.grad is not None
+    assert np.isfinite(w.grad.numpy()).all()
+    # analytic: score grad wrt x rows = w^T, so gp = (||w|| - 1)^2 and
+    # d gp / d w = 2 (||w|| - 1) * w / ||w||
+    wn = np.linalg.norm(w.numpy())
+    ref = 2 * (wn - 1.0) * w.numpy() / wn
+    np.testing.assert_allclose(w.grad.numpy(), ref, atol=1e-4)
+
+
+def test_where_inplace_targets_x():
+    cond = paddle.to_tensor(np.array([True, False, True]))
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([9.0, 9.0, 9.0])
+    r = paddle.where_(cond, x, y)
+    assert r is x
+    np.testing.assert_allclose(x.numpy(), [1.0, 9.0, 3.0])
+    assert cond.numpy().dtype == np.bool_
+
+
+def test_uniform_seed_reproducible():
+    a = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    b = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    a.uniform_(seed=42)
+    b.uniform_(seed=42)
+    np.testing.assert_allclose(a.numpy(), b.numpy())
