@@ -56,12 +56,23 @@ def _check_nan_inf(name, out):
 _amp_hook = None  # installed by paddle_tpu.amp; signature (name, args, kwargs) -> (args, kwargs)
 _op_tracer = None  # installed by paddle_tpu.profiler; signature (name) -> ctx manager
 _static_recorder = None  # installed by paddle_tpu.static.program_guard
+_sir_recorder = None  # installed by the SOT opcode executor during capture
 _op_listeners = []  # lightweight observers (SOT statement-IR capture)
 
 
 def set_static_recorder(r):
     global _static_recorder
     _static_recorder = r
+
+
+def set_sir_recorder(r):
+    """Install the SOT capture hook (rich form: name, impl, treedef, leaves,
+    tensor_idx, wrapped — enough to rebuild the op inside a compiled
+    segment). Returns the previous hook so nested captures can restore it."""
+    global _sir_recorder
+    prev = _sir_recorder
+    _sir_recorder = r
+    return prev
 
 
 def add_op_listener(fn):
@@ -167,6 +178,8 @@ def _apply_op_inner(name, impl, args, kwargs, differentiable=True):
         if _static_recorder is not None:
             _static_recorder(name, impl, treedef, leaves, tensor_idx,
                              wrapped)
+        if _sir_recorder is not None:
+            _sir_recorder(name, impl, treedef, leaves, tensor_idx, wrapped)
         for _l in _op_listeners:
             _l(name, len(tensor_idx), wrapped)
         return wrapped
@@ -195,6 +208,8 @@ def _apply_op_inner(name, impl, args, kwargs, differentiable=True):
     wrapped = _wrap(name, out, node=node)
     if _static_recorder is not None:
         _static_recorder(name, impl, treedef, leaves, tensor_idx, wrapped)
+    if _sir_recorder is not None:
+        _sir_recorder(name, impl, treedef, leaves, tensor_idx, wrapped)
     for _l in _op_listeners:
         _l(name, len(tensor_idx), wrapped)
     return wrapped

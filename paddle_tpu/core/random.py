@@ -38,3 +38,15 @@ def get_rng_state():
 
 def set_rng_state(key):
     _state.key = key
+
+
+def fresh_key_tensor():
+    """A PRNG subkey wrapped as a Tensor input leaf. Random ops that take
+    their key as an *argument* (instead of drawing inside the impl) stay
+    fresh under every capture tier: eager draws per call, jit traces the key
+    as an input, and the SOT replay recognizes the marker and re-draws
+    (executor._input_locator -> ("rng",))."""
+    from .tensor import Tensor
+    t = Tensor(next_key())
+    t._is_rng_key = True
+    return t

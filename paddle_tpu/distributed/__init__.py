@@ -20,7 +20,7 @@ from .collective import (ReduceOp, Group, new_group, get_group, all_reduce,
                          broadcast_object_list)
 from .parallel import (init_parallel_env, get_rank, get_world_size,
                        ParallelEnv, DataParallel)
-from .spmd_rules import RULE_TABLE, get_rule, register_rule
+from .spmd_rules import RULE_TABLE, get_rule, register_rule, infer_spmd
 from .constraint import sharding_constraint, current_mesh
 from . import fleet
 from . import checkpoint

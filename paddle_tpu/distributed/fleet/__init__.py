@@ -19,7 +19,8 @@ from .elastic import ElasticManager, ElasticStatus
 from .pipeline_parallel import (PipelineLayer, LayerDesc, SharedLayerDesc,
                                 PipelineParallel, ZeroBubblePipelineParallel,
                                 WeightGradStore, split_weight_grad)
-from .pipeline_schedule import (pipeline_1f1b, pipeline_interleaved,
+from .pipeline_schedule import (pipeline_1f1b, pipeline_gpipe,
+                                pipeline_interleaved,
                                 stack_stage_params)
 from .context_parallel import (ring_attention, ulysses_attention,
                                split_sequence, SegmentParallel)

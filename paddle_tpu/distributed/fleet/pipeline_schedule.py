@@ -32,20 +32,12 @@ def _collect(outs, is_owner, axis):
     return lax.psum(jnp.where(is_owner, outs, jnp.zeros_like(outs)), axis)
 
 
-def pipeline_1f1b(stage_fn, mesh, axis="pipe", checkpoint_stages=True):
-    """Build a compiled GPipe-class pipeline runner (fill-drain schedule;
-    with jax.grad the transposed program realizes 1F1B's compute order
-    under XLA scheduling).
-
-    stage_fn(stage_params, x) -> y : one stage's forward on one microbatch
-    (same signature for every stage — the homogeneous transformer-block
-    contract the reference's uniform segmentation also assumes).
-
-    Returns run(stacked_params, microbatches) -> outputs where
-    stacked_params has leading axis n_stages (sharded over `axis`) and
-    microbatches is [n_micro, micro_bsz, ...] (replicated); outputs is the
-    LAST stage's [n_micro, ...], replicated.
-    """
+def pipeline_gpipe(stage_fn, mesh, axis="pipe", checkpoint_stages=True):
+    """Compiled GPipe fill-drain runner: jax.grad transposes the scan into
+    the reverse schedule. Memory note: the transposed program stashes one
+    stage input per tick — O(n_micro) live activations per device (bounded
+    only by per-stage rematerialization). Use pipeline_1f1b for the
+    depth-bounded schedule."""
     jm = mesh.jax_mesh
     n_stages = mesh.get_dim_size(axis)
 
@@ -86,6 +78,104 @@ def pipeline_1f1b(stage_fn, mesh, axis="pipe", checkpoint_stages=True):
             out_specs=P(),
             check_vma=False)(stacked_params, micro)
 
+    return runner
+
+
+def pipeline_1f1b(stage_fn, mesh, axis="pipe", checkpoint_stages=True):
+    """Compiled 1F1B with an EXPLICIT backward schedule and depth-bounded
+    activation memory (reference 1F1B: fleet/meta_parallel/
+    pipeline_parallel.py:684; its entire point is that each device keeps at
+    most O(pipeline_depth) microbatch activations live, not O(n_micro)).
+
+    Mechanism (custom_vjp):
+    - forward: fill-drain scan that saves NOTHING (no residual stash).
+    - backward: one combined scan re-running the forward stream and, 2(S-1)
+      ticks behind it, the backward stream — the 1F1B interleave. Stage
+      inputs wait in a circular buffer of 2S microbatch slots (lifetime of
+      micro m at device sid is 2(S-1-sid) ticks), so peak live activations
+      are O(S) regardless of n_micro — the 1F1B memory bound, at the
+      standard rematerialisation price of one extra forward.
+    - cotangents ride the reverse ring (ppermute -1) while recomputed
+      activations ride the forward ring (ppermute +1), which is exactly the
+      steady-state 1F1B dataflow; weight grads accumulate into a carry.
+
+    stage_fn(stage_params, x) -> y, same signature for every stage.
+    run(stacked_params [S,...] sharded over `axis`, micro [n_micro, ...])
+    -> last stage outputs [n_micro, ...], replicated.
+    """
+    jm = mesh.jax_mesh
+    S = mesh.get_dim_size(axis)
+    fwd_runner = pipeline_gpipe(stage_fn, mesh, axis,
+                                checkpoint_stages=False)
+
+    @jax.custom_vjp
+    def runner(stacked_params, micro):
+        return fwd_runner(stacked_params, micro)
+
+    def runner_fwd(stacked_params, micro):
+        return fwd_runner(stacked_params, micro), (stacked_params, micro)
+
+    def runner_bwd(res, gouts):
+        stacked_params, micro = res
+
+        def local(params_stacked, xs, gy):
+            params = jax.tree_util.tree_map(lambda a: a[0], params_stacked)
+            n_micro = xs.shape[0]
+            sid = lax.axis_index(axis)
+            B = 2 * S                      # circular stage-input buffer
+            T = n_micro + 2 * S - 2
+
+            dp0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+            def tick(carry, t):
+                fstate, bstate, xbuf, dp, dxs = carry
+                # ---- forward recompute stream (micro mf = t - sid) ----
+                mf = t - sid
+                af = (mf >= 0) & (mf < n_micro)
+                x_in = jnp.where(sid == 0, xs[jnp.clip(mf, 0, n_micro - 1)],
+                                 fstate)
+                y = stage_fn(params, x_in)
+                xbuf = lax.dynamic_update_index_in_dim(
+                    xbuf, x_in, t % B, 0)
+                fstate = lax.ppermute(
+                    y, axis, [(i, (i + 1) % S) for i in range(S)])
+                # ---- backward stream (micro mb, 2(S-1-sid) ticks later) --
+                mb = t - (2 * S - 2 - sid)
+                ab = (mb >= 0) & (mb < n_micro)
+                mbc = jnp.clip(mb, 0, n_micro - 1)
+                cot_in = jnp.where(sid == S - 1, gy[mbc], bstate)
+                x_saved = xbuf[(sid + mbc) % B]
+                _, vjp = jax.vjp(stage_fn, params, x_saved)
+                dpi, dxi = vjp(cot_in)
+                dp = jax.tree_util.tree_map(
+                    lambda acc, g: acc + jnp.where(ab, g, jnp.zeros_like(g)),
+                    dp, dpi)
+                dxs = lax.cond(
+                    ab & (sid == 0),
+                    lambda d: lax.dynamic_update_index_in_dim(
+                        d, dxi, mbc, 0),
+                    lambda d: d, dxs)
+                bstate = lax.ppermute(
+                    dxi, axis, [((i + 1) % S, i) for i in range(S)])
+                return (fstate, bstate, xbuf, dp, dxs), None
+
+            z = jnp.zeros_like(xs[0])
+            xbuf0 = jnp.zeros((B,) + xs.shape[1:], xs.dtype)
+            dxs0 = jnp.zeros_like(xs)
+            (_, _, _, dp, dxs), _ = lax.scan(
+                tick, (z, z, xbuf0, dp0, dxs0), jnp.arange(T))
+            # dparams back to stacked layout; dxs valid only at stage 0
+            dp_stacked = jax.tree_util.tree_map(lambda a: a[None], dp)
+            dxs = _collect(dxs, sid == 0, axis)
+            return dp_stacked, dxs
+
+        return shard_map(
+            local, mesh=jm,
+            in_specs=(P(axis), P(), P()),
+            out_specs=(P(axis), P()),
+            check_vma=False)(stacked_params, micro, gouts)
+
+    runner.defvjp(runner_fwd, runner_bwd)
     return runner
 
 

@@ -22,7 +22,8 @@ __all__ = [
     "fused_dropout_add", "fused_bias_dropout_residual_layer_norm",
     "fused_rotary_position_embedding", "masked_multihead_attention",
     "block_multihead_attention", "fused_linear_param_grad_add",
-    "flashmask_attention",
+    "flashmask_attention", "fused_multi_transformer",
+    "fused_multi_transformer_int8",
 ]
 
 
@@ -358,3 +359,246 @@ def flashmask_attention(query, key, value, startend_row_indices,
 
     return apply_op("flashmask_attention", impl,
                     (query, key, value, startend_row_indices), {})
+
+
+def _rms(h, eps, scale=None):
+    out = h * jax.lax.rsqrt((h * h).mean(-1, keepdims=True) + eps)
+    return out * scale if scale is not None else out
+
+
+def _apply_rope_pair(q, k, cos, sin, neox):
+    """q/k: [B, S, H, D]; cos/sin broadcastable [B, S, 1, D]."""
+    if neox:
+        half = q.shape[-1] // 2
+
+        def rot(t):
+            return jnp.concatenate([-t[..., half:], t[..., :half]], axis=-1)
+    else:
+        def rot(t):
+            t2 = t.reshape(*t.shape[:-1], -1, 2)
+            r = jnp.stack([-t2[..., 1], t2[..., 0]], axis=-1)
+            return r.reshape(t.shape)
+    return q * cos + rot(q) * sin, k * cos + rot(k) * sin
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, residual_alpha=1.0, cache_kvs=None, beam_offset=None,
+        pre_caches=None, seq_lens=None, rotary_embs=None, time_step=None,
+        attn_mask=None, dropout_rate=0.0, rotary_emb_dims=0,
+        activation="gelu", training=False, mode="upscale_in_train",
+        trans_qkvw=True, ring_id=-1, norm_type="layernorm",
+        use_neox_rotary_style=False, gqa_group_size=-1, name=None,
+        _dequant=None):
+    """Whole-decoder-stack fused transformer (reference
+    fused_multi_transformer op: python/paddle/incubate/nn/functional/
+    fused_transformer.py:1053 over
+    paddle/phi/kernels/fusion/gpu/fused_multi_transformer_kernel.cu).
+
+    One call runs EVERY decoder layer: [LN → QKV proj (+rope) → cached
+    attention → out proj + residual → LN → FFN → residual] × n_layers.
+    On TPU the per-layer chain is a jnp composition XLA fuses into the
+    matmuls (the epilogue fusions the CUDA kernel hand-writes); decode
+    attention over the contiguous [2, B, H, S_max, D] cache is a masked
+    einsum the TPU executes from VMEM. The paged-cache serving path is
+    `block_multihead_attention` (Pallas decode kernel,
+    ops/pallas/paged_attention.py).
+
+    Shapes (trans_qkvw=True, the reference default):
+    x [B, S, E]; qkv_weight [3, H, D, E]; linear_weight [H*D, E];
+    ffn1_weight [E, F] (or [E, 2F] for *glu activations); ffn2 [F, E];
+    cache_kvs: list of [2, B, H, S_max, D] per layer, updated in place;
+    rotary_embs [2, B, 1, S_rope, D] (cos, sin); time_step: scalar int
+    tensor = current decode position (decode mode when given).
+
+    Returns the output hidden states [B, S, E]; caches are updated
+    in place (dygraph reference semantics).
+    """
+    from ....core.tensor import Tensor
+
+    if gqa_group_size not in (-1, 0, None):
+        raise NotImplementedError(
+            "fused_multi_transformer: gqa_group_size packing is not "
+            "implemented; use block_multihead_attention for GQA decode")
+    if pre_caches is not None or beam_offset is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer: pre_caches/beam_offset unsupported")
+    n_layers = len(qkv_weights)
+    caches_in = cache_kvs if cache_kvs is not None else []
+    dq = _dequant or (lambda w, kind, li: w)
+
+    def impl(xa, lns, lnb, qkvw, qkvb, linw, linb, flns, flnb, f1w, f1b,
+             f2w, f2b, caches, rotary, tstep, mask, slens):
+        b, s, e = xa.shape
+        norm = (lambda h, sc, bi: _rms(h, epsilon, sc)) \
+            if norm_type == "rmsnorm" else \
+            (lambda h, sc, bi: _ln(h, epsilon, sc, bi))
+        h = xa
+        new_caches = []
+        for li in range(n_layers):
+            resid = h
+            z = norm(h, lns[li], lnb[li] if lnb else None) \
+                if pre_layer_norm else h
+            w = dq(qkvw[li], "qkv", li)
+            if not trans_qkvw:
+                # [E, 3, H, D] layout -> [3, H, D, E]
+                w = jnp.transpose(w, (1, 2, 3, 0))
+            nh, hd = w.shape[1], w.shape[2]
+            qkv = jnp.einsum("bse,thde->bsthd", z.astype(w.dtype), w)
+            if qkvb and qkvb[li] is not None:
+                qkv = qkv + qkvb[li][None, None]
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D]
+            if rotary is not None:
+                cos = rotary[0][:, 0][:, :, None, :]    # [B, S_rope, 1, D]
+                sin = rotary[1][:, 0][:, :, None, :]
+                if tstep is not None:
+                    pos = jnp.asarray(tstep).reshape(())
+                    cos = jax.lax.dynamic_slice_in_dim(cos, pos, 1, 1)
+                    sin = jax.lax.dynamic_slice_in_dim(sin, pos, 1, 1)
+                else:
+                    cos, sin = cos[:, :s], sin[:, :s]
+                q, k = _apply_rope_pair(q, k, cos, sin,
+                                        use_neox_rotary_style)
+            scale = 1.0 / math.sqrt(hd)
+            if tstep is not None and caches:
+                # decode: append the new token, attend over the valid cache
+                cache = caches[li]                     # [2, B, H, S_max, D]
+                t = jnp.asarray(tstep).reshape(())
+                smax = cache.shape[3]
+                if slens is not None:
+                    # ragged batch: per-sequence append at slot lens[b]
+                    # (reference seq_lens contract, as in
+                    # masked_multihead_attention); caller advances seq_lens
+                    ln = jnp.asarray(slens).reshape(-1)
+                    bidx = jnp.arange(b)
+                    kc = cache[0].at[bidx, :, ln].set(k[:, 0])
+                    vc = cache[1].at[bidx, :, ln].set(v[:, 0])
+                    posm = (jnp.arange(smax)[None, None, None, :]
+                            <= ln[:, None, None, None])
+                else:
+                    kc = jax.lax.dynamic_update_slice_in_dim(
+                        cache[0], k.transpose(0, 2, 1, 3), t, axis=2)
+                    vc = jax.lax.dynamic_update_slice_in_dim(
+                        cache[1], v.transpose(0, 2, 1, 3), t, axis=2)
+                    posm = jnp.arange(smax)[None, None, None, :] <= t
+                logits = jnp.einsum(
+                    "bshd,bhtd->bhst", q.astype(jnp.float32),
+                    kc.astype(jnp.float32)) * scale    # [B,H,1,S_max]
+                if mask is not None:
+                    logits = logits + mask.astype(logits.dtype)
+                logits = jnp.where(posm, logits, NEG_INF_F)
+                p = jax.nn.softmax(logits, axis=-1)
+                ctx = jnp.einsum("bhst,bhtd->bshd", p,
+                                 vc.astype(jnp.float32)).astype(xa.dtype)
+                new_caches.append(jnp.stack([kc, vc]))
+            else:
+                # context/prefill: causal attention, fill cache [0:S]
+                logits = jnp.einsum(
+                    "bshd,bthd->bhst", q.astype(jnp.float32),
+                    k.astype(jnp.float32)) * scale
+                causal = jnp.tril(jnp.ones((s, s), bool))[None, None]
+                if slens is not None:
+                    # padded batch: keys at/after each row's true length
+                    # must not contribute (reference seq_lens semantics)
+                    valid = (jnp.arange(s)[None, :]
+                             < jnp.asarray(slens).reshape(-1, 1))
+                    causal = causal & valid[:, None, None, :]
+                if mask is not None:
+                    logits = logits + mask.astype(logits.dtype)
+                logits = jnp.where(causal, logits, NEG_INF_F)
+                p = jax.nn.softmax(logits, axis=-1)
+                ctx = jnp.einsum("bhst,bthd->bshd", p,
+                                 v.astype(jnp.float32)).astype(xa.dtype)
+                if caches:
+                    cache = caches[li]
+                    kc = jax.lax.dynamic_update_slice_in_dim(
+                        cache[0], k.transpose(0, 2, 1, 3), 0, axis=2)
+                    vc = jax.lax.dynamic_update_slice_in_dim(
+                        cache[1], v.transpose(0, 2, 1, 3), 0, axis=2)
+                    new_caches.append(jnp.stack([kc, vc]))
+            attn = ctx.reshape(b, s, nh * hd) @ dq(linw[li], "lin", li)
+            if linb and linb[li] is not None:
+                attn = attn + linb[li]
+            if training and dropout_rate:
+                from ....core import random as _rng
+                keep = jax.random.bernoulli(
+                    _rng.next_key(), 1.0 - dropout_rate, attn.shape)
+                attn = jnp.where(keep, attn / (1.0 - dropout_rate), 0.0) \
+                    if mode == "upscale_in_train" else \
+                    jnp.where(keep, attn, 0.0)
+            h = resid * residual_alpha + attn
+            if not pre_layer_norm:
+                h = norm(h, lns[li], lnb[li] if lnb else None)
+            resid2 = h
+            z2 = norm(h, flns[li], flnb[li] if flnb else None) \
+                if pre_layer_norm else h
+            f1 = z2 @ dq(f1w[li], "f1", li)
+            if f1b and f1b[li] is not None:
+                f1 = f1 + f1b[li]
+            if activation.endswith("glu"):
+                a, g = jnp.split(f1, 2, axis=-1)
+                act = jax.nn.silu if activation == "swiglu" else jax.nn.gelu
+                f1 = act(a) * g
+            elif activation == "relu":
+                f1 = jax.nn.relu(f1)
+            else:
+                f1 = jax.nn.gelu(f1)
+            f2 = f1 @ dq(f2w[li], "f2", li)
+            if f2b and f2b[li] is not None:
+                f2 = f2 + f2b[li]
+            h = resid2 * residual_alpha + f2
+            if not pre_layer_norm:
+                h = norm(h, flns[li], flnb[li] if flnb else None)
+        return tuple([h] + new_caches)
+
+    out = apply_op(
+        "fused_multi_transformer", impl,
+        (x, list(ln_scales), list(ln_biases or []), list(qkv_weights),
+         list(qkv_biases or []), list(linear_weights),
+         list(linear_biases or []), list(ffn_ln_scales),
+         list(ffn_ln_biases or []), list(ffn1_weights),
+         list(ffn1_biases or []), list(ffn2_weights), list(ffn2_biases or []),
+         list(caches_in), rotary_embs, time_step, attn_mask, seq_lens),
+        {}, differentiable=bool(training) and not caches_in)
+    outs = out if isinstance(out, tuple) else (out,)
+    h = outs[0]
+    # dygraph reference semantics: caches mutate in place
+    for cache_t, new_t in zip(caches_in, outs[1:]):
+        if isinstance(cache_t, Tensor):
+            cache_t._data = new_t._data
+    return h
+
+
+def fused_multi_transformer_int8(
+        x, ln_scales, ln_biases, qkv_weights, qkv_scales, qkv_biases,
+        linear_weights, linear_scales, linear_biases, ffn_ln_scales,
+        ffn_ln_biases, ffn1_weights, ffn1_scales, ffn1_biases, ffn2_weights,
+        ffn2_scales, ffn2_biases, **kwargs):
+    """Weight-only-int8 variant (role of the reference's
+    fused_multi_transformer_int8_kernel.cu): weights are int8 with
+    per-output-channel scales; dequantisation happens inside the op, where
+    XLA fuses the int8→bf16 convert+scale into the matmul's operand load —
+    the TPU analogue of the CUDA kernel's dequant epilogue.
+
+    Weight lists hold int8 tensors shaped as in fused_multi_transformer;
+    each *_scales list holds the matching per-channel scale (last dim of
+    the weight's output axis)."""
+    from ....core.tensor import Tensor as _T
+    scales = {"qkv": list(qkv_scales), "lin": list(linear_scales),
+              "f1": list(ffn1_scales), "f2": list(ffn2_scales)}
+
+    def dq(w, kind, li):
+        sc = scales[kind][li]
+        sc = sc.data if isinstance(sc, _T) else jnp.asarray(sc)
+        if kind == "qkv":
+            # [3, H, D, E] int8, scale per (3, H, D) output channel
+            s3 = sc.reshape(w.shape[0], w.shape[1], w.shape[2], 1)
+            return w.astype(jnp.float32) * s3
+        return w.astype(jnp.float32) * sc[None, :]
+
+    return fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, _dequant=dq, **kwargs)

@@ -286,7 +286,105 @@ def _get_phi_kernel_name(op_name):
     return op_name
 
 
+__all__ += ["FusedMultiTransformerEngine"]
 __all__ += ["DataType", "PlaceType", "Tensor", "PredictorPool", "XpuConfig",
             "get_version", "get_num_bytes_of_data_type",
             "get_trt_compile_version", "get_trt_runtime_version",
             "convert_to_mixed_precision", "_get_phi_kernel_name"]
+
+
+class FusedMultiTransformerEngine:
+    """Serving engine over the fused_multi_transformer op (role of the
+    reference's fused_multi_transformer-based inference stack:
+    AnalysisPredictor + fused decoder passes). Holds per-layer weight lists
+    + embedding/lm_head, compiles ONE prefill program and ONE decode-step
+    program (caches donated, so XLA updates them in place in HBM), and
+    serves greedy generation.
+
+    weights: dict with keys matching fused_multi_transformer's list args
+    (ln_scales, qkv_weights, ...), plus 'embedding' [V, E] and 'lm_head'
+    [E, V]. All values may be paddle Tensors or jax arrays.
+    """
+
+    def __init__(self, weights, num_heads, head_dim, max_seq_len=2048,
+                 norm_type="layernorm", activation="gelu",
+                 use_neox_rotary_style=False, dtype="bfloat16"):
+        import jax
+        import jax.numpy as jnp
+        from ..incubate.nn.functional import fused_multi_transformer
+
+        def arr(v):
+            from ..core.tensor import Tensor as _T
+            a = v.data if isinstance(v, _T) else jnp.asarray(v)
+            return a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) \
+                else a
+
+        self._w = {k: ([arr(x) for x in v] if isinstance(v, (list, tuple))
+                       else arr(v)) for k, v in weights.items()}
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.max_seq_len = max_seq_len
+        self._dtype = dtype
+        self._n_layers = len(self._w["qkv_weights"])
+        kw = dict(norm_type=norm_type, activation=activation,
+                  use_neox_rotary_style=use_neox_rotary_style)
+
+        def lists(w):
+            def g(name):
+                return w.get(name) or None
+            return (w["ln_scales"], g("ln_biases"), w["qkv_weights"],
+                    g("qkv_biases"), w["linear_weights"], g("linear_biases"),
+                    w["ffn_ln_scales"], g("ffn_ln_biases"), w["ffn1_weights"],
+                    g("ffn1_biases"), w["ffn2_weights"], g("ffn2_biases"))
+
+        def prefill(w, caches, ids):
+            h = w["embedding"][ids]
+            from ..core.tensor import Tensor
+            cts = [Tensor(c) for c in caches]
+            out = fused_multi_transformer(
+                Tensor(h), *lists(w), cache_kvs=cts,
+                rotary_embs=w.get("rotary_embs"), **kw)
+            logits = out.data[:, -1] @ w["lm_head"]
+            return jnp.argmax(logits, -1), [c.data for c in cts]
+
+        def step(w, caches, tok, t):
+            h = w["embedding"][tok][:, None]
+            from ..core.tensor import Tensor
+            cts = [Tensor(c) for c in caches]
+            out = fused_multi_transformer(
+                Tensor(h), *lists(w), cache_kvs=cts,
+                time_step=Tensor(t), rotary_embs=w.get("rotary_embs"), **kw)
+            logits = out.data[:, 0] @ w["lm_head"]
+            return jnp.argmax(logits, -1), [c.data for c in cts]
+
+        import jax
+        self._prefill = jax.jit(prefill, donate_argnums=(1,))
+        self._step = jax.jit(step, donate_argnums=(1,))
+
+    def new_caches(self, batch_size, dtype=None):
+        import jax.numpy as jnp
+        dtype = dtype or self._dtype
+        kvh = self._w["qkv_weights"][0].shape[1]
+        return [jnp.zeros((2, batch_size, kvh, self.max_seq_len,
+                           self.head_dim), dtype)
+                for _ in range(self._n_layers)]
+
+    def generate(self, input_ids, max_new_tokens=32):
+        """Greedy generation. input_ids: [B, S] int array. Returns [B, N]."""
+        import numpy as np
+        import jax.numpy as jnp
+        ids = jnp.asarray(input_ids, jnp.int32)
+        b, s = ids.shape
+        if s + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_seq_len ({self.max_seq_len}); raise max_seq_len or "
+                "shorten the request")
+        caches = self.new_caches(b)
+        tok, caches = self._prefill(self._w, caches, ids)
+        outs = [tok]
+        for i in range(max_new_tokens - 1):
+            tok, caches = self._step(self._w, caches, tok,
+                                     jnp.asarray(s + i, jnp.int32))
+            outs.append(tok)
+        return np.stack([np.asarray(t) for t in outs], axis=1)

@@ -29,7 +29,10 @@ def _leaf_key(x):
         return (type(x).__name__,) + tuple(_leaf_key(v) for v in x)
     if isinstance(x, dict):
         return ("D",) + tuple(sorted((k, _leaf_key(v)) for k, v in x.items()))
-    return ("O", type(x).__name__)
+    # opaque object: identity guard — a different instance must not reuse a
+    # plan whose tensor inputs were located through the first instance's
+    # attributes (layer params are fetched by object reference)
+    return ("O", type(x).__name__, id(x))
 
 
 def build_guard_key(fn, args, kwargs, watched_globals=()):

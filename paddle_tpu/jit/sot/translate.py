@@ -1,35 +1,44 @@
 """symbolic_translate — the SOT entry point (reference
 python/paddle/jit/sot/translate.py:37).
 
-Call path per invocation of a translated function:
-1. the C eval-frame hook (if built) has the function's code marked — it
-   counts the entry and enforces the skip list;
-2. guard key built from the live arguments (guards.py) → cache lookup;
-3. hit: run the compiled XLA callable;
-4. miss: capture — trace the function once under the SIR recorder and
-   jax.jit (via jit.api.StaticFunction, which itself chains the AST
-   dy2static rewrite on concretization failures — SOT then AST, the same
-   two-tier design as the reference);
-5. capture failure = graph break: execute eagerly, record the reason;
-   MAX_BREAKS consecutive breaks pin the function to eager.
+Three tiers, chosen per code object:
+
+1. **Opcode-executor tier** (executor.py — the real SOT): bytecode-level
+   capture with mid-function graph breaks. A function containing a host
+   escape (`print(t.item())`) still gets its prefix and suffix compiled as
+   two XLA segments; break regions re-execute concretely every call, so
+   Python side effects keep Python semantics. Guards cover argument
+   structure plus every global / closure cell / object attribute / dict item
+   the captured path read — mutating any of them invalidates the plan.
+2. **Legacy whole-function tier** for code the interpreter cannot simulate
+   (try/with exception tables, unsupported opcodes): jax.jit via
+   StaticFunction, chaining to the AST dy2static rewrite on concretization
+   failures; MAX_BREAKS failures pin to eager.
+3. **Eager pin** for statically-uncapturable code (generator protocol).
+
+The C eval-frame hook (native/src/eval_frame.c) provides per-code entry
+accounting and the skip list; capture itself is driven by this wrapper, not
+by frame redirection.
 """
 import logging
 
 from ..api import StaticFunction
 from .guards import build_guard_key
 from .opcode_analysis import analyze
-from .statement_ir import SIRRecorder
+from .statement_ir import SIRRecorder, StatementIR
 
 log = logging.getLogger("paddle_tpu.jit.sot")
 
 MAX_BREAKS = 3
+MAX_PLANS_PER_KEY = 4
 
 _hook_mod = None
 _hook_ready = False
 _registry = {}  # id of code object -> SotFunction (hook callback lookup)
 
 _stats = {"translations": 0, "cache_hits": 0, "graph_breaks": 0,
-          "eager_pins": 0}
+          "graph_breaks_mid": 0, "eager_pins": 0, "divergences": 0,
+          "capture_bailouts": 0}
 
 
 def sot_stats():
@@ -55,8 +64,7 @@ def _ensure_hook():
 
 
 def _frame_callback(code, name):
-    """Runs inside the C hook for marked code objects: entry accounting
-    (the heavy lifting happens in SotFunction.__call__)."""
+    """Runs inside the C hook for marked code objects: entry accounting."""
     sf = _registry.get(id(code))
     if sf is not None:
         sf._frame_entries += 1
@@ -69,51 +77,142 @@ class SotFunction:
     def __init__(self, fn, train=None, build_strategy=None):
         self._fn = fn
         self._name = getattr(fn, "__name__", type(fn).__name__)
-        self._cache = {}          # guard key -> StaticFunction
-        self._sirs = {}           # guard key -> StatementIR (first trace)
+        self._plans = {}          # arg_key -> [Plan] (opcode tier)
+        self._cache = {}          # guard key -> StaticFunction (legacy tier)
+        self._sirs = {}           # guard key -> StatementIR (legacy tier)
         self._breaks = 0
         self._eager_pinned = False
         self._frame_entries = 0
+        self._tier = "legacy"
         code = getattr(fn, "__code__", None)
         self.analysis = analyze(code) if code is not None else None
-        if self.analysis is not None and self.analysis.must_break:
-            # statically uncapturable (host IO / generators): never try
-            self._eager_pinned = True
-            _stats["eager_pins"] += 1
-            log.info("sot[%s]: pinned to eager: %s", self._name,
-                     self.analysis.break_reasons)
-        elif code is not None:
+        if code is None:
+            self._tier = "legacy"
+        else:
+            gen = any("generator" in r for r in
+                      (self.analysis.break_reasons if self.analysis else []))
+            if gen:
+                # statically uncapturable: the call itself IS the escape
+                self._eager_pinned = True
+                self._tier = "eager"
+                _stats["eager_pins"] += 1
+            else:
+                from .executor import code_supported
+                ok, why = code_supported(code)
+                if ok:
+                    self._tier = "opcode"
+                else:
+                    self._tier = "legacy"
+                    log.info("sot[%s]: legacy whole-function tier (%s)",
+                             self._name, why)
             hook = _ensure_hook()
             if hook is not None:
                 hook.mark_code(code)
                 _registry[id(code)] = self
 
+    @staticmethod
+    def _stats_bump(key):
+        _stats[key] = _stats.get(key, 0) + 1
+
     # -- public --------------------------------------------------------
     @property
     def graph_break_count(self):
-        return self._breaks
+        return self._breaks + _0(self._plan_break_count())
+
+    def _plan_break_count(self):
+        n = 0
+        for plans in self._plans.values():
+            for p in plans:
+                n += p.n_breaks
+        return n
+
+    @property
+    def plans(self):
+        return [p for ps in self._plans.values() for p in ps]
 
     def statement_ir(self, key=None):
-        """The recorded op sequence for one compiled variant (latest by
-        default)."""
+        """The recorded op sequence (latest plan/variant by default)."""
+        if self._tier == "opcode" and self._plans:
+            plans = self._plans[key] if key in self._plans else \
+                next(reversed(self._plans.values()))
+            plan = plans[-1]
+            sir = StatementIR(self._name)
+            for seg in plan.segments:
+                for st in seg.stmts:
+                    sir.statements.append(_StmtView(st))
+            return sir
         if not self._sirs:
             return None
         if key is None:
             key = next(reversed(self._sirs))
         return self._sirs[key]
 
+    def flush_cache(self):
+        self._plans.clear()
+        self._cache.clear()
+
     def __call__(self, *args, **kwargs):
         if self._eager_pinned:
             return self._fn(*args, **kwargs)
+        if self._tier == "opcode":
+            return self._call_opcode(args, kwargs)
+        return self._call_legacy(args, kwargs)
+
+    # -- opcode-executor tier -------------------------------------------
+    def _call_opcode(self, args, kwargs):
+        import types as _types
+        from .executor import Executor, Plan
         try:
-            key = build_guard_key(self._fn, args, kwargs)
+            arg_key = build_guard_key(self._fn, args, kwargs)
+            if isinstance(self._fn, _types.MethodType):
+                arg_key = (arg_key, ("self", id(self._fn.__self__)))
+        except Exception:
+            arg_key = None
+        if arg_key is not None:
+            for plan in self._plans.get(arg_key, ()):
+                if plan.valid and plan.guards_ok():
+                    _stats["cache_hits"] += 1
+                    ex = Executor(self, self._fn, args, kwargs, plan=plan)
+                    return ex.run_replay()
+        # capture
+        plan = Plan(self._name, arg_key) if arg_key is not None else None
+        ex = Executor(self, self._fn, args, kwargs, plan=plan, capture=True)
+        try:
+            result, plan = ex.run_capture()
+        except Exception:
+            if getattr(ex, "side_effects", False):
+                raise  # break regions already ran; re-running would double
+            _stats["capture_bailouts"] += 1
+            self._breaks += 1
+            _stats["graph_breaks"] += 1
+            if self._breaks >= MAX_BREAKS:
+                self._eager_pinned = True
+                _stats["eager_pins"] += 1
+            return self._fn(*args, **kwargs)
+        if plan is not None and plan.valid and plan.segments:
+            bucket = self._plans.setdefault(arg_key, [])
+            bucket.append(plan)
+            # bound the variant cache: a guard that fails every call (e.g. a
+            # per-step counter attribute) would otherwise accumulate one
+            # plan per call (reference SOT has the same cache-size limit)
+            if len(bucket) > MAX_PLANS_PER_KEY:
+                del bucket[0]
+            _stats["translations"] += 1
+        return result
+
+    # -- legacy whole-function tier -------------------------------------
+    def _call_legacy(self, args, kwargs):
+        watched = tuple(n for n in (self.analysis.loads if self.analysis
+                                    else ()) if isinstance(n, str))
+        try:
+            key = build_guard_key(self._fn, args, kwargs,
+                                  watched_globals=watched)
         except Exception:
             return self._graph_break("unguardable arguments", args, kwargs)
         entry = self._cache.get(key)
         if entry is not None:
             _stats["cache_hits"] += 1
             return entry(*args, **kwargs)
-        # capture
         try:
             entry = StaticFunction(self._fn)
             with SIRRecorder(self._name) as sir:
@@ -154,6 +253,24 @@ class SotFunction:
                     _hook_mod.unmark_code(code)
                 except Exception:
                     pass
+
+
+class _StmtView:
+    """StatementIR-compatible view of an executor Stmt."""
+    __slots__ = ("name", "n_inputs", "out_shapes", "out_dtypes")
+
+    def __init__(self, st):
+        self.name = st.name
+        self.n_inputs = sum(1 for (k, _) in st.leaves if k == "sym")
+        self.out_shapes = ()
+        self.out_dtypes = ()
+
+    def __repr__(self):
+        return f"{self.name}(sot)"
+
+
+def _0(x):
+    return x or 0
 
 
 def symbolic_translate(fn=None, train=None, build_strategy=None, **kwargs):

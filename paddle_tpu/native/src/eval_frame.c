@@ -1,16 +1,16 @@
-/* PEP 523 eval-frame hook for the SOT capture plane.
+/* PEP 523 eval-frame hook: entry accounting for the SOT plane.
  *
- * Role parity: the reference installs a custom frame evaluator to intercept
- * marked functions before CPython executes them (its sot/eval_frame.c).
- * Here the hook intercepts frames whose code object was registered via
- * mark_code(), invokes the Python-side callback (which records the entry,
- * bumps guard-cache stats, and may trigger re-translation), then continues
- * with the default evaluator. Redirection of the BODY is done by the
- * translator swapping func.__code__ with a shim at registration time — a
- * deliberate robustness choice: replacing the in-flight _PyInterpreterFrame
- * in 3.12 requires private frame-lifecycle calls, while the code-swap shim
- * achieves the same function-level capture the XLA backend needs (capture is
- * whole-function; mid-frame resume has no XLA analogue).
+ * Role parity note (honest scope): the reference's sot/eval_frame.c is the
+ * capture entry point — it redirects marked frames into the opcode
+ * translator. In this build, capture is driven by the `symbolic_translate`
+ * wrapper + the bytecode interpreter (paddle_tpu/jit/sot/executor.py), which
+ * simulates marked functions itself and therefore needs no frame
+ * redirection. This hook provides the remaining frame-evaluator duties:
+ * per-code entry accounting for marked code objects (sot_stats telemetry),
+ * the skip list (unmark_code), a re-entrancy latch so the callback cannot
+ * recurse, and survival across callback errors without frame leaks.
+ * Un-decorated callees are NOT intercepted — they execute eagerly unless
+ * the interpreter reached them through a captured call site.
  *
  * Build: CPython extension module `_pt_eval_frame` (see native.build_ext).
  */

@@ -24,8 +24,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
             return _op("dropout_scale", lambda a: a * (1.0 - p), x)
         return x
 
-    def impl(a):
-        key = _random.next_key()
+    def impl(a, key):
         shape = list(a.shape)
         if axis is not None:
             axes = [axis] if isinstance(axis, int) else list(axis)
@@ -34,7 +33,9 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
         if mode == "upscale_in_train":
             return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
         return jnp.where(keep, a, jnp.zeros((), a.dtype))
-    return _op("dropout", impl, x)
+    # key as an input leaf: fresh per call in eager and under SOT replay
+    # (the whole-function jit tier still bakes the trace-time key)
+    return _op("dropout", impl, x, _random.fresh_key_tensor())
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW"):

@@ -320,3 +320,105 @@ class TestFusedLayers:
             if i == 0:
                 l0 = float(loss.numpy())
         assert float(loss.numpy()) < l0
+
+
+class TestFusedMultiTransformer:
+    """Reference fused_multi_transformer (whole-decoder-stack inference op,
+    paddle/phi/kernels/fusion/gpu/fused_multi_transformer_kernel.cu)."""
+
+    @staticmethod
+    def _weights(rng, L, E, H, D, F):
+        T = paddle.to_tensor
+
+        def mk(*shape, scale=0.1):
+            return T((rng.standard_normal(shape) * scale).astype(np.float32))
+
+        return dict(
+            ln_scales=[mk(E, scale=1.0) for _ in range(L)],
+            ln_biases=[T(np.zeros(E, np.float32)) for _ in range(L)],
+            qkv_weights=[mk(3, H, D, E) for _ in range(L)],
+            qkv_biases=[mk(3, H, D) for _ in range(L)],
+            linear_weights=[mk(H * D, E) for _ in range(L)],
+            linear_biases=[mk(E) for _ in range(L)],
+            ffn_ln_scales=[mk(E, scale=1.0) for _ in range(L)],
+            ffn_ln_biases=[T(np.zeros(E, np.float32)) for _ in range(L)],
+            ffn1_weights=[mk(E, F) for _ in range(L)],
+            ffn1_biases=[mk(F) for _ in range(L)],
+            ffn2_weights=[mk(F, E) for _ in range(L)],
+            ffn2_biases=[mk(E) for _ in range(L)])
+
+    def test_decode_matches_prefill(self):
+        import jax
+        from paddle_tpu.incubate.nn.functional import fused_multi_transformer
+        with jax.default_matmul_precision("float32"):
+            rng = np.random.default_rng(0)
+            B, S, E, H, D, F, SMAX, L = 2, 5, 32, 4, 8, 64, 16, 2
+            w = self._weights(rng, L, E, H, D, F)
+            T = paddle.to_tensor
+            x = T(rng.standard_normal((B, S, E)).astype(np.float32))
+            xt = T(rng.standard_normal((B, 1, E)).astype(np.float32))
+            caches = [T(np.zeros((2, B, H, SMAX, D), np.float32))
+                      for _ in range(L)]
+            fused_multi_transformer(x, cache_kvs=caches, **w)
+            assert not np.allclose(caches[0].numpy()[:, :, :, :S], 0)
+            o2 = fused_multi_transformer(
+                xt, cache_kvs=caches, time_step=T(np.array(S, np.int32)), **w)
+            caches2 = [T(np.zeros((2, B, H, SMAX, D), np.float32))
+                       for _ in range(L)]
+            xfull = T(np.concatenate([x.numpy(), xt.numpy()], axis=1))
+            ofull = fused_multi_transformer(xfull, cache_kvs=caches2, **w)
+            np.testing.assert_allclose(ofull.numpy()[:, -1], o2.numpy()[:, 0],
+                                       atol=2e-5)
+
+    def test_int8_weight_only_tracks_fp32(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_multi_transformer, fused_multi_transformer_int8)
+        rng = np.random.default_rng(1)
+        B, S, E, H, D, F, SMAX, L = 2, 4, 32, 4, 8, 64, 8, 1
+        w = self._weights(rng, L, E, H, D, F)
+        T = paddle.to_tensor
+        x = T(rng.standard_normal((B, S, E)).astype(np.float32))
+        ref = fused_multi_transformer(x, **w)
+
+        def q_last(ws):  # per-out-channel int8 over the last dim=output
+            w8s, scs = [], []
+            for t in ws:
+                a = t.numpy()
+                sc = np.abs(a).max(axis=0) / 127.0 + 1e-9
+                w8s.append(T(np.round(a / sc[None]).astype(np.int8)))
+                scs.append(T(sc.astype(np.float32)))
+            return w8s, scs
+
+        qkv8, qkvsc = [], []
+        for t in w["qkv_weights"]:
+            a = t.numpy()
+            sc = np.abs(a).max(axis=-1) / 127.0 + 1e-9
+            qkv8.append(T(np.round(a / sc[..., None]).astype(np.int8)))
+            qkvsc.append(T(sc.astype(np.float32)))
+        lin8, linsc = q_last(w["linear_weights"])
+        f18, f1sc = q_last(w["ffn1_weights"])
+        f28, f2sc = q_last(w["ffn2_weights"])
+        o8 = fused_multi_transformer_int8(
+            x, w["ln_scales"], w["ln_biases"], qkv8, qkvsc,
+            w["qkv_biases"], lin8, linsc, w["linear_biases"],
+            w["ffn_ln_scales"], w["ffn_ln_biases"], f18, f1sc,
+            w["ffn1_biases"], f28, f2sc, w["ffn2_biases"])
+        rel = np.abs(o8.numpy() - ref.numpy()).max() / \
+            (np.abs(ref.numpy()).max() + 1e-9)
+        assert rel < 0.1, rel
+
+    def test_serving_engine_greedy_deterministic(self):
+        from paddle_tpu.inference import FusedMultiTransformerEngine
+        rng = np.random.default_rng(2)
+        E, H, D, F, L, V = 32, 4, 8, 64, 2, 50
+        w = {k: [t.numpy() for t in v]
+             for k, v in self._weights(rng, L, E, H, D, F).items()}
+        w["embedding"] = rng.standard_normal((V, E)).astype(np.float32)
+        w["lm_head"] = (rng.standard_normal((E, V)) * 0.1).astype(np.float32)
+        eng = FusedMultiTransformerEngine(w, num_heads=H, head_dim=D,
+                                          max_seq_len=64, dtype="float32")
+        ids = rng.integers(0, V, (2, 7)).astype(np.int32)
+        out = eng.generate(ids, max_new_tokens=8)
+        assert out.shape == (2, 8)
+        np.testing.assert_array_equal(out, eng.generate(ids,
+                                                        max_new_tokens=8))

@@ -65,21 +65,18 @@ class TestOpcodeAnalysis:
 
 class TestTranslate:
     def test_trace_count_and_cache(self):
-        traces = {"n": 0}
-
         @symbolic_translate
         def f(x, s):
-            traces["n"] += 1
             return (x * s).sum()
 
         x = paddle.randn([4])
         r1 = float(f(x, 2.0))
         r2 = float(f(x, 2.0))
-        assert traces["n"] == 1
+        assert len(f.plans) == 1          # second call replays, no re-trace
         f(x, 3.0)
-        assert traces["n"] == 2
+        assert len(f.plans) == 2          # new scalar guard -> new variant
         f(paddle.randn([2, 2]), 2.0)
-        assert traces["n"] == 3
+        assert len(f.plans) == 3          # new shape -> new variant
         np.testing.assert_allclose(r1, r2)
 
     def test_numerics_match_eager(self, rng):
@@ -99,24 +96,84 @@ class TestTranslate:
         names = [s.name for s in sir]
         assert "add" in names and "multiply" in names
 
-    def test_graph_break_falls_back_eager(self):
+    def test_graph_break_on_host_escape(self):
         @symbolic_translate
         def f(x):
-            v = float(x.sum().numpy())  # host escape at trace time
+            v = float(x.sum().numpy())  # host escape mid-function
             return x * v
 
         out = f(paddle.ones([3]))
         np.testing.assert_allclose(out.numpy(), 3.0)
         assert f.graph_break_count >= 1
+        # replay stays correct (the escape re-executes per call)
+        out2 = f(paddle.full([3], 2.0))
+        np.testing.assert_allclose(out2.numpy(), 12.0)
 
-    def test_static_pin_on_host_io(self):
+    def test_host_io_breaks_but_still_compiles(self):
+        """print() no longer pins the whole function to eager: the opcode
+        tier compiles around it (reference SOT break-and-resume)."""
+        lines = []
+
         @symbolic_translate
         def f(x):
-            print("io")
-            return x + 1
+            y = x * 3
+            lines.append("io")  # container mutation: break region
+            return y + 1
 
-        assert f._eager_pinned
-        np.testing.assert_allclose(f(paddle.ones([2])).numpy(), 2.0)
+        assert not f._eager_pinned
+        np.testing.assert_allclose(f(paddle.ones([2])).numpy(), 4.0)
+        np.testing.assert_allclose(f(paddle.ones([2])).numpy(), 4.0)
+        assert lines == ["io", "io"]  # side effect re-executes per call
+        assert len(f.plans) == 1 and len(f.plans[0].segments) >= 1
+
+    def test_mid_function_break_two_segments(self):
+        """VERDICT round-2 done-criterion: a function with print(t.item())
+        mid-body executes its prefix and suffix as two compiled subgraphs."""
+        @symbolic_translate
+        def f(x):
+            a = (x * 2).sum()
+            print("mid", a.item())        # host escape between subgraphs
+            b = x + a
+            return (b * b).sum()
+
+        x = paddle.ones([3])
+        o1 = float(f(x))
+        o2 = float(f(x))                  # replay path
+        np.testing.assert_allclose(o1, o2, rtol=1e-6)
+        np.testing.assert_allclose(o1, 147.0)  # a=6, b=7 -> 3*49
+        plan = f.plans[0]
+        assert len(plan.segments) == 2
+        assert all(s.n_ops >= 1 for s in plan.segments)
+
+    def test_global_mutation_invalidates_cache(self):
+        """VERDICT round-2 done-criterion: mutating a module-level global
+        invalidates the compiled plan."""
+        import tests.test_sot as me
+        me._SOT_G = 2.0
+
+        @symbolic_translate
+        def f(x):
+            return (x * me._SOT_G).sum()
+
+        x = paddle.ones([4])
+        np.testing.assert_allclose(float(f(x)), 8.0)
+        float(f(x))  # replay
+        me._SOT_G = 3.0
+        np.testing.assert_allclose(float(f(x)), 12.0)
+
+    def test_closure_object_attr_guard(self):
+        class Cfg:
+            mult = 2.0
+        cfg = Cfg()
+
+        @symbolic_translate
+        def f(x):
+            return (x * cfg.mult).sum()
+
+        x = paddle.ones([4])
+        np.testing.assert_allclose(float(f(x)), 8.0)
+        cfg.mult = 5.0
+        np.testing.assert_allclose(float(f(x)), 20.0)
 
     def test_autograd_through_translation(self):
         @symbolic_translate
@@ -172,3 +229,95 @@ class TestEvalFrameHook:
                 from paddle_tpu.jit.sot import translate as _t
                 m.install(_t._frame_callback)
         assert "target" in seen
+
+
+class TestOpcodeExecutorIntegration:
+    def test_dropout_fresh_mask_across_replays(self):
+        import paddle_tpu.nn.functional as F
+
+        @symbolic_translate
+        def drop(x):
+            return F.dropout(x, p=0.5, training=True)
+
+        x = paddle.ones([1000])
+        m1 = drop(x).numpy()
+        m2 = drop(x).numpy()  # replay draws a fresh PRNG key (("rng",) locator)
+        assert not np.allclose(m1, m2)
+
+    def test_layer_forward_replay_sees_param_updates(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+
+        class MLP(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.l1 = nn.Linear(8, 16)
+                self.l2 = nn.Linear(16, 4)
+
+            def forward(self, x):
+                return self.l2(F.relu(self.l1(x)))
+
+        m = MLP()
+        fwd = symbolic_translate(m.forward)
+        x = paddle.randn([4, 8])
+        np.testing.assert_allclose(fwd(x).numpy(), m.forward(x).numpy(),
+                                   atol=1e-5)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        loss = (fwd(x) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        # replay reads the mutated param arrays, not stale captures
+        np.testing.assert_allclose(fwd(x).numpy(), m.forward(x).numpy(),
+                                   atol=1e-5)
+
+    def test_two_instances_do_not_share_plans(self):
+        import paddle_tpu.nn as nn
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.lin(x)
+
+        a, b = M(), M()
+        fa = symbolic_translate(a.forward)
+        fb = symbolic_translate(b.forward)
+        x = paddle.randn([2, 4])
+        ra = fa(x).numpy()
+        rb = fb(x).numpy()
+        np.testing.assert_allclose(ra, a.forward(x).numpy(), atol=1e-5)
+        np.testing.assert_allclose(rb, b.forward(x).numpy(), atol=1e-5)
+
+    def test_loop_unroll_and_grad_through_segments(self):
+        @symbolic_translate
+        def loopfn(x, n):
+            acc = x
+            for i in range(n):
+                acc = acc * 2 + i
+            return acc.sum()
+
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        v1 = float(loopfn(x, 3))
+        v2 = float(loopfn(x, 3))
+        np.testing.assert_allclose(v1, v2, rtol=1e-6)
+        x2 = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        loopfn(x2, 3).backward()
+        np.testing.assert_allclose(x2.grad.numpy(), 8.0)
+
+    def test_divergent_branch_falls_back_correct(self):
+        @symbolic_translate
+        def branchy(x):
+            s = x.sum()
+            if s > 0:
+                return (x * 2).sum()
+            return (x - 1).sum()
+
+        np.testing.assert_allclose(float(branchy(paddle.ones([3]))), 6.0)
+        np.testing.assert_allclose(float(branchy(paddle.ones([3]))), 6.0)
+        # same guards, other branch at replay: divergence -> concrete path
+        np.testing.assert_allclose(float(branchy(paddle.full([3], -1.0))),
+                                   -6.0)
