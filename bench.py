@@ -48,19 +48,25 @@ def main():
     params, opt_state, meta = pretrain.make_train_state(model, mesh)
     step = pretrain.make_train_step(model, mesh, meta)
     rng = np.random.default_rng(0)
-    batch_data = pretrain.shard_batch(
-        {"input_ids": rng.integers(0, cfg.vocab_size,
-                                   (batch, seq)).astype(np.int32),
-         "labels": rng.integers(0, cfg.vocab_size,
-                                (batch, seq)).astype(np.int32)}, mesh)
+
+    def fresh_batch():
+        # a DIFFERENT random batch every step: the printed loss is then a
+        # true random-data loss (~ln V), not single-batch memorization
+        return pretrain.shard_batch(
+            {"input_ids": rng.integers(0, cfg.vocab_size,
+                                       (batch, seq)).astype(np.int32),
+             "labels": rng.integers(0, cfg.vocab_size,
+                                    (batch, seq)).astype(np.int32)}, mesh)
 
     for _ in range(warmup):
-        params, opt_state, loss, gnorm = step(params, opt_state, batch_data)
+        params, opt_state, loss, gnorm = step(params, opt_state,
+                                              fresh_batch())
     float(loss)  # full sync (block_until_ready is a no-op through the tunnel)
 
+    batches = [fresh_batch() for _ in range(iters)]  # pre-staged on device
     t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, loss, gnorm = step(params, opt_state, batch_data)
+    for bd in batches:
+        params, opt_state, loss, gnorm = step(params, opt_state, bd)
     float(loss)
     dt = time.perf_counter() - t0
 

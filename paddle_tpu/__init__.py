@@ -21,6 +21,8 @@ from .core import (
 )
 from .core.autograd import grad
 from .core.device import is_compiled_with_cuda
+from .core.selected_rows import (SelectedRows, StringTensor, strings_empty,
+                                 strings_lower, strings_upper)
 
 # functional op surface (YAML-driven)
 from .ops import *  # noqa: F401,F403

@@ -205,7 +205,11 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
 
     def as_ct(v):
         # canonical cotangent form for the mode: Tensors when building the
-        # grad graph, raw arrays otherwise (float0 always stays raw)
+        # grad graph, raw arrays otherwise (float0 and SelectedRows stay
+        # as-is)
+        from .selected_rows import SelectedRows
+        if isinstance(v, SelectedRows):
+            return v
         if isinstance(v, Tensor):
             return v if create_graph else v.data
         if not create_graph or getattr(v, "dtype", None) == jax.dtypes.float0:

@@ -1,0 +1,171 @@
+"""SelectedRows + StringTensor (reference: paddle/phi/core/selected_rows.h,
+paddle/phi/core/string_tensor.h + kernels in paddle/phi/kernels/strings/ —
+strings_empty/strings_lower_upper over utf8/unicode case tables).
+
+SelectedRows is the sparse-gradient representation: for an embedding lookup
+touching a few vocabulary rows, the weight gradient is (rows, values) pairs
+instead of a dense [V, D] array. On TPU the *compute* stays dense-friendly
+(values is one [n, D] array — MXU/VPU shaped); sparsity lives in the row
+index, and optimizers apply it as a row scatter (`apply_to`), which XLA
+lowers to an in-place dynamic-update when the parameter is donated.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows", "StringTensor", "strings_empty", "strings_lower",
+           "strings_upper"]
+
+
+class SelectedRows:
+    """rows[i] is the dense row index of values[i]; height is the dense
+    leading-dim size (reference selected_rows.h: rows_/value_/height_)."""
+
+    def __init__(self, rows, values, height):
+        self.rows = jnp.asarray(rows, jnp.int32).reshape(-1)
+        self.values = jnp.asarray(values)
+        self.height = int(height)
+        if self.values.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                f"SelectedRows: {self.rows.shape[0]} rows vs "
+                f"{self.values.shape[0]} value rows")
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def merge_rows(self):
+        """Combine duplicate row ids by summation (reference
+        MergeAdd/scatter::MergeAdd) — needed before row-wise optimizer
+        updates so each dense row appears once."""
+        uniq, inv = jnp.unique(self.rows, return_inverse=True,
+                               size=self.rows.shape[0],
+                               fill_value=self.height)
+        summed = jax.ops.segment_sum(self.values, inv,
+                                     num_segments=uniq.shape[0])
+        keep = uniq < self.height
+        return SelectedRows(jnp.where(keep, uniq, 0),
+                            jnp.where(keep[:, None], summed, 0),
+                            self.height)
+
+    def to_dense(self):
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def apply_to(self, dense, scale=1.0):
+        """dense - scale * sparse  (SGD-style row update; optimizers call
+        this instead of densifying)."""
+        return dense.at[self.rows].add(-scale * self.values.astype(
+            dense.dtype))
+
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.height != self.height:
+                raise ValueError("SelectedRows height mismatch")
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]), self.height)
+        return self.to_dense() + other
+
+    __radd__ = __add__
+
+    def numpy(self):
+        return np.asarray(self.to_dense())
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"n_rows={self.rows.shape[0]}, "
+                f"row_dim={tuple(self.values.shape[1:])})")
+
+
+class StringTensor:
+    """Tensor of utf-8 strings (reference string_tensor.h: pstring array +
+    dims). Host-resident by design — strings never belong on the MXU; the
+    TPU framework keeps them as a numpy object array with the reference's
+    kernel surface (empty/lower/upper with an ascii fast path and full
+    unicode via Python's casefold machinery, the role of kernels/strings/
+    unicode.cc case tables)."""
+
+    def __init__(self, data, name=None):
+        arr = np.asarray(data, dtype=object)
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return "pstring"
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def lower(self, use_utf8_encoding=True):
+        return _case_map(self, str.lower, use_utf8_encoding)
+
+    def upper(self, use_utf8_encoding=True):
+        return _case_map(self, str.upper, use_utf8_encoding)
+
+    def __eq__(self, other):
+        other_arr = other._data if isinstance(other, StringTensor) else \
+            np.asarray(other, dtype=object)
+        return self._data == other_arr
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, np.ndarray):
+            return StringTensor(out)
+        return out
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+
+def _ascii_only(s):
+    try:
+        s.encode("ascii")
+        return True
+    except UnicodeEncodeError:
+        return False
+
+
+def _case_map(st, fn, use_utf8):
+    def one(s):
+        if not use_utf8 and not _ascii_only(s):
+            # ascii mode: leave non-ascii bytes untouched (reference
+            # AsciiCaseConverter semantics)
+            return "".join(fn(c) if c.isascii() else c for c in s)
+        return fn(s)
+    out = np.empty(st._data.shape, dtype=object)
+    it = np.nditer(st._data, flags=["multi_index", "refs_ok"])
+    while not it.finished:
+        out[it.multi_index] = one(str(st._data[it.multi_index]))
+        it.iternext()
+    return StringTensor(out)
+
+
+def strings_empty(shape):
+    """reference strings_empty_kernel: tensor of empty strings."""
+    out = np.empty(tuple(shape), dtype=object)
+    out.fill("")
+    return StringTensor(out)
+
+
+def strings_lower(x, use_utf8_encoding=True):
+    return x.lower(use_utf8_encoding)
+
+
+def strings_upper(x, use_utf8_encoding=True):
+    return x.upper(use_utf8_encoding)

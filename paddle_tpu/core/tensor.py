@@ -179,7 +179,13 @@ class Tensor:
             self.grad = None
 
     def _deposit_grad(self, g):
+        from .selected_rows import SelectedRows
         if getattr(g, "dtype", None) == jax.dtypes.float0:
+            return
+        if isinstance(g, SelectedRows):
+            # sparse embedding gradient: .grad IS the SelectedRows
+            # (reference semantics; optimizers row-scatter it)
+            self.grad = g if self.grad is None else self.grad + g
             return
         if isinstance(g, Tensor):
             # create_graph path: keep the grad's tape node so the deposited
@@ -192,7 +198,10 @@ class Tensor:
             self.grad = Tensor(self.grad._data + g, stop_gradient=True)
 
     def _wrap_grad(self, g):
-        return g if isinstance(g, Tensor) else Tensor(g, stop_gradient=True)
+        from .selected_rows import SelectedRows
+        if isinstance(g, (Tensor, SelectedRows)):
+            return g
+        return Tensor(g, stop_gradient=True)
 
     # -- dtype / device -------------------------------------------------
     def astype(self, dtype):

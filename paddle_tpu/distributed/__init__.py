@@ -110,3 +110,4 @@ def gloo_release():
 from .comm_watchdog import (enable_comm_watchdog,  # noqa: F401,E402
                             disable_comm_watchdog, comm_task_manager,
                             CommTask, CommTaskManager)
+from . import passes  # reference: python/paddle/distributed/passes

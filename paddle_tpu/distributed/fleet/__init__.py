@@ -15,7 +15,8 @@ from .sharding import (DygraphShardingOptimizer, GroupShardedStage2,
 from .hybrid_optimizer import HybridParallelOptimizer, HybridParallelClipGrad
 from . import recompute as _recompute_mod
 from .recompute import recompute, recompute_sequential
-from .elastic import ElasticManager, ElasticStatus
+from .elastic import (ElasticManager, ElasticStatus,
+                      ElasticClusterManager)
 from .pipeline_parallel import (PipelineLayer, LayerDesc, SharedLayerDesc,
                                 PipelineParallel, ZeroBubblePipelineParallel,
                                 WeightGradStore, split_weight_grad)

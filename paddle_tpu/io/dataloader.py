@@ -38,9 +38,52 @@ class _SpawnUnavailable(Exception):
     pass
 
 
-def _worker_loop(dataset, index_queue, data_queue, collate, init_fn, wid):
+_SHM_MIN_BYTES = 1 << 16  # below this, queue pickling is cheaper than shm
+
+
+def _to_shm(tree):
+    """Move large ndarrays of a collated batch into POSIX shared memory
+    (reference: the worker-side shared-memory transport of
+    io/dataloader/worker.py): the queue then carries only
+    (name, dtype, shape) stubs instead of pickled buffers."""
+    from multiprocessing import shared_memory
+    if isinstance(tree, np.ndarray) and tree.nbytes >= _SHM_MIN_BYTES:
+        shm = shared_memory.SharedMemory(create=True, size=tree.nbytes)
+        np.ndarray(tree.shape, tree.dtype, buffer=shm.buf)[...] = tree
+        name = shm.name
+        shm.close()
+        return ("__shm__", name, str(tree.dtype), tree.shape)
+    if isinstance(tree, dict):
+        return {k: _to_shm(v) for k, v in tree.items()}
+    if isinstance(tree, list):
+        return [_to_shm(v) for v in tree]
+    return tree
+
+
+def _from_shm(tree):
+    """Main-process side: attach, copy out, unlink."""
+    from multiprocessing import shared_memory
+    if isinstance(tree, tuple) and len(tree) == 4 and tree[0] == "__shm__":
+        _, name, dtype, shape = tree
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            out = np.ndarray(shape, dtype, buffer=shm.buf).copy()
+        finally:
+            shm.close()
+            shm.unlink()
+        return out
+    if isinstance(tree, dict):
+        return {k: _from_shm(v) for k, v in tree.items()}
+    if isinstance(tree, list):
+        return [_from_shm(v) for v in tree]
+    return tree
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate, init_fn, wid,
+                 use_shm=False):
     """Process-worker loop (reference: io/dataloader/worker.py — fetch
-    sample indices, collate, ship the batch back over the queue)."""
+    sample indices, collate, ship the batch back over the queue or through
+    shared memory)."""
     from . import dataset as _ds
     _ds._worker_info = _ds._WorkerInfo(wid, -1, dataset)
     if init_fn is not None:
@@ -52,6 +95,8 @@ def _worker_loop(dataset, index_queue, data_queue, collate, init_fn, wid):
         seq, indices = item
         try:
             batch = collate([dataset[i] for i in indices])
+            if use_shm:
+                batch = _to_shm(batch)
             data_queue.put((seq, batch, None))
         except Exception as e:
             data_queue.put((seq, None, e))
@@ -94,8 +139,11 @@ class DataLoader:
         self.num_workers = num_workers
         self.worker_init_fn = worker_init_fn
         self.use_process_workers = use_process_workers
+        self.use_shared_memory = use_shared_memory
+        self.persistent_workers = persistent_workers
         self.timeout = timeout
-        self.prefetch_factor = max(prefetch_factor, 2)
+        self.prefetch_factor = max(prefetch_factor, 1)
+        self._handles = None  # live worker pool when persistent_workers
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -155,7 +203,8 @@ class DataLoader:
         procs = [ctx.Process(
             target=_worker_loop,
             args=(self.dataset, index_queues[w], data_queue, collate,
-                  self.worker_init_fn, w), daemon=True)
+                  self.worker_init_fn, w, self.use_shared_memory),
+            daemon=True)
             for w in range(self.num_workers)]
         try:
             for p in procs:
@@ -189,12 +238,16 @@ class DataLoader:
 
     def _iter_process_workers(self, procs, index_queues, data_queue):
         """True multiprocess workers (reference dataloader_iter.py:368).
-        Batch order is preserved with a sequence-number reorder buffer."""
+        Batch order is preserved with a sequence-number reorder buffer;
+        `prefetch_factor` bounds in-flight batches per worker. With
+        persistent_workers the pool idles on its index queues between
+        epochs instead of being torn down (reference persistent_workers)."""
+        received = 0
+        sent = 0
         try:
             batches = list(self.batch_sampler)
             n = len(batches)
             inflight_cap = self.num_workers * self.prefetch_factor
-            sent = 0
             done = {}
             next_out = 0
             while sent < min(inflight_cap, n):
@@ -204,6 +257,7 @@ class DataLoader:
             while next_out < n:
                 while next_out not in done:
                     seq, batch, err = self._queue_get(data_queue, procs)
+                    received += 1
                     if err is not None:
                         raise err
                     done[seq] = batch
@@ -212,19 +266,58 @@ class DataLoader:
                             (sent, batches[sent]))
                         sent += 1
                 b = done.pop(next_out)
+                if self.use_shared_memory:
+                    b = _from_shm(b)
                 next_out += 1
                 yield (self._to_tensor_tree(b) if not self._custom_collate
                        else b)
         finally:
-            for iq in index_queues:
-                try:
-                    iq.put_nowait(None)
-                except Exception:
-                    pass
-            for p in procs:
-                p.join(timeout=5)
-                if p.is_alive():
-                    p.terminate()
+            if not self.persistent_workers:
+                self._shutdown_pool(procs, index_queues)
+            else:
+                # abandoned-epoch drain: in-flight results must not leak
+                # into the NEXT epoch's reorder buffer (seq restarts at 0),
+                # and their shm segments must be unlinked
+                while received < sent:
+                    try:
+                        _, stale, _err = self._queue_get(data_queue, procs)
+                    except Exception:
+                        break
+                    received += 1
+                    if self.use_shared_memory and stale is not None:
+                        try:
+                            _from_shm(stale)  # attach + unlink
+                        except Exception:
+                            pass
+
+    @staticmethod
+    def _shutdown_pool(procs, index_queues):
+        for iq in index_queues:
+            try:
+                iq.put_nowait(None)
+            except Exception:
+                pass
+        for p in procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        # never block interpreter exit on queue feeder threads: a worker
+        # terminated mid-write can leave the pipe lock held, and the
+        # default Queue.__del__ join would hang the process at shutdown
+        for iq in index_queues:
+            try:
+                iq.cancel_join_thread()
+                iq.close()
+            except Exception:
+                pass
+
+    def __del__(self):
+        if getattr(self, "_handles", None) is not None:
+            procs, index_queues, _ = self._handles
+            try:
+                self._shutdown_pool(procs, index_queues)
+            except Exception:
+                pass
 
     def __iter__(self):
         if self.num_workers == 0:
@@ -232,11 +325,21 @@ class DataLoader:
             return
         if (self.use_process_workers and not self._iterable
                 and self.num_workers > 0):
-            try:
-                handles = self._start_process_workers()
-            except _SpawnUnavailable:
-                pass  # unpicklable dataset etc.: thread prefetch below
-            else:
+            handles = self._handles
+            if handles is not None and any(not p.is_alive()
+                                           for p in handles[0]):
+                # a worker died between epochs: retire the WHOLE old pool
+                # before replacing it (surviving workers must not leak)
+                self._shutdown_pool(handles[0], handles[1])
+                self._handles = handles = None
+            if handles is None:
+                try:
+                    handles = self._start_process_workers()
+                except _SpawnUnavailable:
+                    handles = None  # unpicklable dataset: thread fallback
+            if handles is not None:
+                if self.persistent_workers:
+                    self._handles = handles
                 # startup succeeded: from here errors propagate (no replay)
                 yield from self._iter_process_workers(*handles)
                 return

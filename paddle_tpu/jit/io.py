@@ -11,12 +11,42 @@ importable it is re-instantiated (using init args recorded by save when the
 layer exposes them) and its state restored; otherwise the state dict is
 available via .state_dict() for manual reconstruction.
 """
+import io as _io
 import os
 import pickle
 
 import numpy as np
 
-from ..core.tensor import Tensor
+from ..core.tensor import Tensor, Parameter
+
+
+def _rebuild_tensor(arr, stop_gradient, is_param, name):
+    if is_param:
+        t = Parameter(arr, trainable=not stop_gradient, name=name)
+    else:
+        t = Tensor(arr, stop_gradient=stop_gradient, name=name)
+    return t
+
+
+def _reduce_tensor(t):
+    return (_rebuild_tensor, (np.asarray(t.data), t.stop_gradient,
+                              isinstance(t, Parameter), t.name))
+
+
+def _pickle_layer(layer):
+    """Structural serialization: the whole Layer object graph with device
+    arrays reduced to numpy. This is what makes container-built models
+    (Sequential/LayerList) reload as themselves — class-name reconstruction
+    cannot rebuild them (reference translated_layer keeps the program
+    instead; our program IS the layer)."""
+    buf = _io.BytesIO()
+    p = pickle.Pickler(buf, protocol=pickle.HIGHEST_PROTOCOL)
+    p.dispatch_table = {Tensor: _reduce_tensor, Parameter: _reduce_tensor}
+    try:
+        p.dump(layer)
+    except Exception:
+        return None
+    return buf.getvalue()
 
 
 def save(layer, path, input_spec=None, **configs):
@@ -32,6 +62,7 @@ def save(layer, path, input_spec=None, **configs):
         "class_module": type(net).__module__,
         "class_name": type(net).__name__,
         "init_args": getattr(net, "_init_args", None),
+        "pickled_layer": _pickle_layer(net),
     }
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(state, f)
@@ -87,6 +118,18 @@ def load(path, **configs):
         init_args = meta.get("init_args")
         net = cls(**init_args) if isinstance(init_args, dict) else cls()
         net.set_state_dict(state)
+        # verify the reconstruction actually HOLDS the saved state: a
+        # container rebuilt empty (Sequential()) would silently become the
+        # identity function otherwise
+        have = set(net.state_dict().keys())
+        if set(state.keys()) - have:
+            raise ValueError("state keys unmatched by class reconstruction")
         return net
     except Exception:
+        pickled = meta.get("pickled_layer")
+        if pickled:
+            try:
+                return pickle.loads(pickled)
+            except Exception:
+                pass
         return LoadedProgram(meta, state)

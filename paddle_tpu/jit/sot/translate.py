@@ -31,6 +31,7 @@ log = logging.getLogger("paddle_tpu.jit.sot")
 
 MAX_BREAKS = 3
 MAX_PLANS_PER_KEY = 4
+MAX_PLAN_KEYS = 32
 
 _hook_mod = None
 _hook_ready = False
@@ -190,13 +191,25 @@ class SotFunction:
                 _stats["eager_pins"] += 1
             return self._fn(*args, **kwargs)
         if plan is not None and plan.valid and plan.segments:
+            # pin the opaque argument objects: the arg_key guards them by
+            # id(), and a strong ref prevents CPython id reuse from
+            # false-hitting a stale plan after the object is collected
+            from ...core.tensor import Tensor as _T
+            plan.pinned = [a for a in args
+                           if not isinstance(a, (bool, int, float, str,
+                                                 bytes, type(None), list,
+                                                 tuple, dict, _T))]
             bucket = self._plans.setdefault(arg_key, [])
             bucket.append(plan)
             # bound the variant cache: a guard that fails every call (e.g. a
             # per-step counter attribute) would otherwise accumulate one
-            # plan per call (reference SOT has the same cache-size limit)
+            # plan per call (reference SOT has the same cache-size limit),
+            # and per-call temporary object args would otherwise mint a new
+            # key per call — cap keys LRU-style too
             if len(bucket) > MAX_PLANS_PER_KEY:
                 del bucket[0]
+            while len(self._plans) > MAX_PLAN_KEYS:
+                self._plans.pop(next(iter(self._plans)))
             _stats["translations"] += 1
         return result
 

@@ -299,3 +299,32 @@ def build_eval_frame_ext():
         return mod
     except Exception:
         return None
+
+
+def build_inference_capi():
+    """Build libpaddle_inference_c.so (reference capi_exp serving ABI:
+    native/src_capi/inference_capi.c embeds CPython around the Predictor).
+    Returns the .so path; C programs link it plus libpython."""
+    import sysconfig
+    src = os.path.join(os.path.dirname(_SRC), "src_capi", "inference_capi.c")
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_BUILD, f"libpaddle_inference_c_{tag}.so")
+    if os.path.exists(out):
+        return out
+    os.makedirs(_BUILD, exist_ok=True)
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = sysconfig.get_config_var("LDVERSION")
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD)
+    os.close(fd)
+    cmd = ["gcc", "-O2", "-fPIC", "-shared", src, f"-I{inc}",
+           f"-L{libdir}", f"-lpython{pyver}", "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.rename(tmp, out)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return out

@@ -1,0 +1,307 @@
+/* C inference API (reference: paddle/fluid/inference/capi_exp/pd_*.h —
+ * PD_Config / PD_Predictor / PD_Tensor C ABI used by C and Go serving
+ * programs; goapi wraps the same symbols).
+ *
+ * TPU-native design: the heavy engine IS the Python-side Predictor
+ * (jit-load + XLA AOT compile cache); this shim embeds CPython and exports
+ * the reference's serving ABI so a C/Go program links one .so and never
+ * sees Python. Handles hold PyObject* refs; every entry point takes the
+ * GIL, so the ABI is usable from multi-threaded servers.
+ *
+ * Build: paddle_tpu.native.build_inference_capi() ->
+ *   libpaddle_inference_c.so (links libpython).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct PD_Config {
+  char *prog_file;
+  char *params_file;
+  int precision; /* 0=fp32 2=bf16 (reference PrecisionType) */
+} PD_Config;
+
+typedef struct PD_Predictor {
+  PyObject *pred; /* paddle_tpu.inference.Predictor */
+} PD_Predictor;
+
+typedef struct PD_Tensor {
+  PyObject *handle; /* _IOHandle */
+} PD_Tensor;
+
+static pthread_mutex_t g_init_lock = PTHREAD_MUTEX_INITIALIZER;
+
+static void ensure_python(void) {
+  /* serialized: two server threads racing first use must not both run
+   * Py_InitializeEx / release a thread state they do not hold */
+  pthread_mutex_lock(&g_init_lock);
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    /* drop the GIL acquired by initialization so callers can take it */
+    PyEval_SaveThread();
+  }
+  pthread_mutex_unlock(&g_init_lock);
+}
+
+/* -- config ------------------------------------------------------------- */
+PD_Config *PD_ConfigCreate(void) {
+  PD_Config *c = (PD_Config *)calloc(1, sizeof(PD_Config));
+  return c;
+}
+
+void PD_ConfigSetModel(PD_Config *c, const char *prog, const char *params) {
+  free(c->prog_file);
+  free(c->params_file);
+  c->prog_file = strdup(prog ? prog : "");
+  c->params_file = strdup(params ? params : "");
+}
+
+void PD_ConfigEnableTpu(PD_Config *c, int precision) {
+  c->precision = precision;
+}
+
+void PD_ConfigDestroy(PD_Config *c) {
+  if (!c) return;
+  free(c->prog_file);
+  free(c->params_file);
+  free(c);
+}
+
+/* -- predictor ---------------------------------------------------------- */
+PD_Predictor *PD_PredictorCreate(PD_Config *c) {
+  ensure_python();
+  PyGILState_STATE g = PyGILState_Ensure();
+  PD_Predictor *out = NULL;
+  PyObject *mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (!mod) goto fail;
+  PyObject *cfg = PyObject_CallMethod(mod, "Config", "ss",
+                                      c->prog_file ? c->prog_file : "",
+                                      c->params_file ? c->params_file : "");
+  if (!cfg) goto fail_mod;
+  if (c->precision == 2) {
+    PyObject *r = PyObject_CallMethod(cfg, "enable_tpu", NULL);
+    Py_XDECREF(r);
+    PyErr_Clear();
+  }
+  PyObject *pred = PyObject_CallMethod(mod, "create_predictor", "O", cfg);
+  Py_DECREF(cfg);
+  if (!pred) goto fail_mod;
+  out = (PD_Predictor *)calloc(1, sizeof(PD_Predictor));
+  out->pred = pred;
+fail_mod:
+  Py_DECREF(mod);
+fail:
+  if (PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(g);
+  return out;
+}
+
+static char *py_str_to_cstr(PyObject *s) {
+  const char *u = PyUnicode_AsUTF8(s);
+  return strdup(u ? u : "");
+}
+
+/* caller frees with PD_CstrDestroy */
+char *PD_PredictorGetInputName(PD_Predictor *p, size_t i) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  char *out = NULL;
+  PyObject *names = PyObject_CallMethod(p->pred, "get_input_names", NULL);
+  if (names && (Py_ssize_t)i < PyList_Size(names))
+    out = py_str_to_cstr(PyList_GetItem(names, (Py_ssize_t)i));
+  Py_XDECREF(names);
+  if (PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(g);
+  return out ? out : strdup("");
+}
+
+size_t PD_PredictorGetInputNum(PD_Predictor *p) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  size_t n = 0;
+  PyObject *names = PyObject_CallMethod(p->pred, "get_input_names", NULL);
+  if (names) n = (size_t)PyList_Size(names);
+  Py_XDECREF(names);
+  PyGILState_Release(g);
+  return n;
+}
+
+size_t PD_PredictorGetOutputNum(PD_Predictor *p) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  size_t n = 0;
+  PyObject *names = PyObject_CallMethod(p->pred, "get_output_names", NULL);
+  if (names) n = (size_t)PyList_Size(names);
+  Py_XDECREF(names);
+  PyGILState_Release(g);
+  return n;
+}
+
+char *PD_PredictorGetOutputName(PD_Predictor *p, size_t i) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  char *out = NULL;
+  PyObject *names = PyObject_CallMethod(p->pred, "get_output_names", NULL);
+  if (names && (Py_ssize_t)i < PyList_Size(names))
+    out = py_str_to_cstr(PyList_GetItem(names, (Py_ssize_t)i));
+  Py_XDECREF(names);
+  PyGILState_Release(g);
+  return out ? out : strdup("");
+}
+
+void PD_CstrDestroy(char *s) { free(s); }
+
+static PD_Tensor *get_handle(PD_Predictor *p, const char *name,
+                             const char *method) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  PD_Tensor *t = NULL;
+  PyObject *h = PyObject_CallMethod(p->pred, method, "s", name);
+  if (h) {
+    t = (PD_Tensor *)calloc(1, sizeof(PD_Tensor));
+    t->handle = h;
+  } else {
+    PyErr_Print();
+  }
+  PyGILState_Release(g);
+  return t;
+}
+
+PD_Tensor *PD_PredictorGetInputHandle(PD_Predictor *p, const char *name) {
+  return get_handle(p, name, "get_input_handle");
+}
+
+PD_Tensor *PD_PredictorGetOutputHandle(PD_Predictor *p, const char *name) {
+  return get_handle(p, name, "get_output_handle");
+}
+
+int PD_PredictorRun(PD_Predictor *p) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject *r = PyObject_CallMethod(p->pred, "run", NULL);
+  int ok = r != NULL;
+  Py_XDECREF(r);
+  if (!ok) PyErr_Print();
+  PyGILState_Release(g);
+  return ok;
+}
+
+void PD_PredictorDestroy(PD_Predictor *p) {
+  if (!p) return;
+  PyGILState_STATE g = PyGILState_Ensure();
+  Py_XDECREF(p->pred);
+  PyGILState_Release(g);
+  free(p);
+}
+
+/* -- tensors ------------------------------------------------------------ */
+static PyObject *np_module(void) { return PyImport_ImportModule("numpy"); }
+
+void PD_TensorReshape(PD_Tensor *t, size_t ndim, const int32_t *shape) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject *lst = PyList_New((Py_ssize_t)ndim);
+  for (size_t i = 0; i < ndim; i++)
+    PyList_SetItem(lst, (Py_ssize_t)i, PyLong_FromLong(shape[i]));
+  PyObject *r = PyObject_CallMethod(t->handle, "reshape", "O", lst);
+  Py_XDECREF(r);
+  Py_DECREF(lst);
+  if (PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(g);
+}
+
+static long long tensor_numel(PD_Tensor *t, int32_t *ndim_out,
+                              int32_t *shape_out, int max_ndim) {
+  PyObject *shp = PyObject_CallMethod(t->handle, "shape", NULL);
+  if (!shp) { PyErr_Print(); return -1; }
+  Py_ssize_t n = PySequence_Size(shp);
+  long long numel = 1;
+  if (ndim_out) *ndim_out = (int32_t)n;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *d = PySequence_GetItem(shp, i);
+    long v = PyLong_AsLong(d);
+    Py_DECREF(d);
+    numel *= v;
+    if (shape_out && i < max_ndim) shape_out[i] = (int32_t)v;
+  }
+  Py_DECREF(shp);
+  return numel;
+}
+
+void PD_TensorGetShape(PD_Tensor *t, int32_t *ndim_out, int32_t *shape_out) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  tensor_numel(t, ndim_out, shape_out, 16);
+  PyGILState_Release(g);
+}
+
+static void copy_from_cpu(PD_Tensor *t, const void *data, const char *dtype,
+                          size_t itemsize) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  int32_t nd = 0, shape[16];
+  long long numel = tensor_numel(t, &nd, shape, 16);
+  if (numel < 0) { PyGILState_Release(g); return; }
+  PyObject *np = np_module();
+  PyObject *mem = PyMemoryView_FromMemory((char *)data,
+                                          (Py_ssize_t)(numel * itemsize),
+                                          PyBUF_READ);
+  PyObject *flat = PyObject_CallMethod(np, "frombuffer", "Os", mem, dtype);
+  PyObject *shp = PyList_New(nd);
+  for (int i = 0; i < nd; i++)
+    PyList_SetItem(shp, i, PyLong_FromLong(shape[i]));
+  PyObject *arr = flat ? PyObject_CallMethod(flat, "reshape", "O", shp)
+                       : NULL;
+  if (arr) {
+    PyObject *r = PyObject_CallMethod(t->handle, "copy_from_cpu", "O", arr);
+    Py_XDECREF(r);
+  }
+  Py_XDECREF(arr);
+  Py_DECREF(shp);
+  Py_XDECREF(flat);
+  Py_DECREF(mem);
+  Py_XDECREF(np);
+  if (PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(g);
+}
+
+void PD_TensorCopyFromCpuFloat(PD_Tensor *t, const float *data) {
+  copy_from_cpu(t, data, "float32", 4);
+}
+
+void PD_TensorCopyFromCpuInt32(PD_Tensor *t, const int32_t *data) {
+  copy_from_cpu(t, data, "int32", 4);
+}
+
+static void copy_to_cpu(PD_Tensor *t, void *data, const char *dtype,
+                        size_t itemsize) {
+  (void)itemsize;
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject *arr = PyObject_CallMethod(t->handle, "copy_to_cpu", NULL);
+  if (!arr) { PyErr_Print(); PyGILState_Release(g); return; }
+  PyObject *b = PyObject_CallMethod(arr, "astype", "s", dtype);
+  if (b) {
+    PyObject *bytes = PyObject_CallMethod(b, "tobytes", NULL);
+    if (bytes) {
+      char *buf;
+      Py_ssize_t n;
+      PyBytes_AsStringAndSize(bytes, &buf, &n);
+      memcpy(data, buf, (size_t)n);
+      Py_DECREF(bytes);
+    }
+    Py_DECREF(b);
+  }
+  Py_DECREF(arr);
+  if (PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(g);
+}
+
+void PD_TensorCopyToCpuFloat(PD_Tensor *t, float *data) {
+  copy_to_cpu(t, data, "float32", 4);
+}
+
+void PD_TensorCopyToCpuInt32(PD_Tensor *t, int32_t *data) {
+  copy_to_cpu(t, data, "int32", 4);
+}
+
+void PD_TensorDestroy(PD_Tensor *t) {
+  if (!t) return;
+  PyGILState_STATE g = PyGILState_Ensure();
+  Py_XDECREF(t->handle);
+  PyGILState_Release(g);
+  free(t);
+}

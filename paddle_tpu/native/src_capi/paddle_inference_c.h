@@ -1,0 +1,43 @@
+/* C inference API header (reference: paddle/fluid/inference/capi_exp/).
+ * Link libpaddle_inference_c.so (built by
+ * paddle_tpu.native.build_inference_capi()). */
+#ifndef PADDLE_INFERENCE_C_H
+#define PADDLE_INFERENCE_C_H
+#include <stddef.h>
+#include <stdint.h>
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+typedef struct PD_Tensor PD_Tensor;
+
+PD_Config *PD_ConfigCreate(void);
+void PD_ConfigSetModel(PD_Config *, const char *prog, const char *params);
+void PD_ConfigEnableTpu(PD_Config *, int precision); /* 0=fp32 2=bf16 */
+void PD_ConfigDestroy(PD_Config *);
+
+PD_Predictor *PD_PredictorCreate(PD_Config *);
+size_t PD_PredictorGetInputNum(PD_Predictor *);
+size_t PD_PredictorGetOutputNum(PD_Predictor *);
+char *PD_PredictorGetInputName(PD_Predictor *, size_t i);  /* PD_CstrDestroy */
+char *PD_PredictorGetOutputName(PD_Predictor *, size_t i);
+PD_Tensor *PD_PredictorGetInputHandle(PD_Predictor *, const char *name);
+PD_Tensor *PD_PredictorGetOutputHandle(PD_Predictor *, const char *name);
+int PD_PredictorRun(PD_Predictor *);
+void PD_PredictorDestroy(PD_Predictor *);
+void PD_CstrDestroy(char *);
+
+void PD_TensorReshape(PD_Tensor *, size_t ndim, const int32_t *shape);
+void PD_TensorGetShape(PD_Tensor *, int32_t *ndim_out, int32_t *shape_out);
+void PD_TensorCopyFromCpuFloat(PD_Tensor *, const float *data);
+void PD_TensorCopyFromCpuInt32(PD_Tensor *, const int32_t *data);
+void PD_TensorCopyToCpuFloat(PD_Tensor *, float *data);
+void PD_TensorCopyToCpuInt32(PD_Tensor *, int32_t *data);
+void PD_TensorDestroy(PD_Tensor *);
+
+#ifdef __cplusplus
+}
+#endif
+#endif

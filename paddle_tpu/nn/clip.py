@@ -16,10 +16,16 @@ class ClipGradByValue(ClipGradBase):
         self.min = float(min) if min is not None else -self.max
 
     def __call__(self, params_grads):
+        from ..core.selected_rows import SelectedRows
         out = []
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
+                continue
+            if isinstance(g, SelectedRows):
+                out.append((p, SelectedRows(
+                    g.rows, jnp.clip(g.values, self.min, self.max),
+                    g.height)))
                 continue
             out.append((p, Tensor(jnp.clip(g.data, self.min, self.max))))
         return out
@@ -30,10 +36,23 @@ class ClipGradByNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
 
     def __call__(self, params_grads):
+        from ..core.selected_rows import SelectedRows
         out = []
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
+                continue
+            if isinstance(g, SelectedRows):
+                # norm over merged values == norm of the sparse grad
+                # (reference clips SelectedRows via its value tensor)
+                g = g.merge_rows()
+                norm = jnp.sqrt(jnp.sum(jnp.square(
+                    g.values.astype(jnp.float32))))
+                scale = jnp.minimum(
+                    self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+                out.append((p, SelectedRows(
+                    g.rows, (g.values.astype(jnp.float32) * scale).astype(
+                        g.values.dtype), g.height)))
                 continue
             norm = jnp.sqrt(jnp.sum(jnp.square(g.data.astype(jnp.float32))))
             scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
@@ -55,8 +74,16 @@ class ClipGradByGlobalNorm(ClipGradBase):
         return jnp.sqrt(sum(sq_sums))
 
     def __call__(self, params_grads):
-        sq = [jnp.sum(jnp.square(g.data.astype(jnp.float32)))
-              for p, g in params_grads
+        from ..core.selected_rows import SelectedRows
+
+        def _sq(g):
+            if isinstance(g, SelectedRows):
+                # merged values' norm == the sparse grad's norm
+                return jnp.sum(jnp.square(
+                    g.merge_rows().values.astype(jnp.float32)))
+            return jnp.sum(jnp.square(g.data.astype(jnp.float32)))
+
+        sq = [_sq(g) for p, g in params_grads
               if g is not None and getattr(p, "need_clip", True)]
         if not sq:
             return params_grads
@@ -66,6 +93,11 @@ class ClipGradByGlobalNorm(ClipGradBase):
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
+                continue
+            if isinstance(g, SelectedRows):
+                out.append((p, SelectedRows(
+                    g.rows, (g.values.astype(jnp.float32) * scale).astype(
+                        g.values.dtype), g.height)))
                 continue
             out.append((p, Tensor((g.data.astype(jnp.float32) * scale).astype(g.data.dtype))))
         return out

@@ -72,6 +72,35 @@ def embedding(x, weight, padding_idx=None, sparse=False):
             mask = (idx == padding_idx)[..., None]
             out = jnp.where(mask, jnp.zeros((), out.dtype), out)
         return out
+
+    from ...core import autograd as _ag
+    if sparse and _ag.is_grad_enabled() and not weight.stop_gradient \
+            and not isinstance(weight.data, jax.core.Tracer):
+        # sparse=True: the weight gradient is a SelectedRows (rows = the
+        # looked-up ids, values = output cotangent rows) instead of a dense
+        # [V, D] scatter (reference: embedding_sparse_grad kernel +
+        # SelectedRows grads, phi/kernels/selected_rows/)
+        from ...core.tensor import Tensor
+        from ...core.autograd import GradNode
+        from ...core.selected_rows import SelectedRows
+        idx_arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        w_arr = weight.data
+        out = impl(idx_arr, w_arr)
+
+        def vjp_fn(ct):
+            rows = idx_arr.reshape(-1)
+            vals = jnp.reshape(ct, (-1, ct.shape[-1]))
+            if padding_idx is not None:
+                vals = jnp.where((rows == padding_idx)[:, None],
+                                 jnp.zeros((), vals.dtype), vals)
+            return (SelectedRows(rows, vals, w_arr.shape[0]),)
+
+        node = GradNode("embedding_sparse", vjp_fn, [weight],
+                        [(out.shape, out.dtype)])
+        t = Tensor(out, stop_gradient=False)
+        t._node = node
+        t._out_idx = 0
+        return t
     return _op("embedding", impl, x, weight)
 
 

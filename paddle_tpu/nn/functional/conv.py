@@ -81,14 +81,26 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
                     data_format, "conv3d")
 
 
+def _channels_last_transpose(fn, x, n, kwargs):
+    """Run a channels-first conv_transpose on channels-last data via a
+    transpose pair (XLA folds the layout changes into the convolution)."""
+    to_cf = (0, n + 1) + tuple(range(1, n + 1))
+    to_cl = (0,) + tuple(range(2, n + 2)) + (1,)
+    out = fn(x.transpose(to_cf), **kwargs)
+    return out.transpose(to_cl)
+
+
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
                      output_size=None, data_format="NCHW"):
     """Transposed conv. paddle weight layout: [in, out//groups, kh, kw]."""
-    if data_format != "NCHW":
-        raise NotImplementedError("conv2d_transpose NHWC")
-    if isinstance(padding, str):
-        raise NotImplementedError("string padding for conv_transpose")
+    if data_format == "NHWC":
+        return _channels_last_transpose(
+            conv2d_transpose, x, 2,
+            dict(weight=weight, bias=bias, stride=stride, padding=padding,
+                 output_padding=output_padding, dilation=dilation,
+                 groups=groups, output_size=output_size,
+                 data_format="NCHW"))
     return _conv_transpose_nd(x, weight, bias, stride, padding,
                               output_padding, dilation, groups, 2,
                               "conv2d_transpose", output_size=output_size)
@@ -101,8 +113,26 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
     ambiguity by deriving the extra high-side padding, with validation."""
     strides = _pair(stride, n)
     dilations = _pair(dilation, n)
-    opad = _pair(output_padding, n)
-    pads = _padding(padding, n)
+    opad = list(_pair(output_padding, n))
+    if isinstance(padding, str):
+        # reference string semantics for transposed conv: VALID = no pad;
+        # SAME = output exactly input*stride (pad split low/high, shortfall
+        # made up with output_padding)
+        mode = padding.upper()
+        w_arr = weight.data if hasattr(weight, "data") else weight
+        pads = []
+        for i in range(n):
+            if mode == "VALID":
+                pads.append((0, 0))
+                continue
+            total = dilations[i] * (w_arr.shape[2 + i] - 1) + 1 - strides[i]
+            if total < 0:
+                opad[i] += -total
+                total = 0
+            pads.append((total // 2, total - total // 2))
+    else:
+        pads = _padding(padding, n)
+    opad = tuple(opad)
     if output_size is not None:
         x_arr = x.data if hasattr(x, "data") else x
         w_arr = weight.data if hasattr(weight, "data") else weight
@@ -152,8 +182,12 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
                      output_size=None, data_format="NCL"):
-    if data_format != "NCL":
-        raise NotImplementedError("conv1d_transpose NLC")
+    if data_format == "NLC":
+        return _channels_last_transpose(
+            conv1d_transpose, x, 1,
+            dict(weight=weight, bias=bias, stride=stride, padding=padding,
+                 output_padding=output_padding, dilation=dilation,
+                 groups=groups, output_size=output_size, data_format="NCL"))
     return _conv_transpose_nd(x, weight, bias, stride, padding,
                               output_padding, dilation, groups, 1,
                               "conv1d_transpose", output_size=output_size)
@@ -162,8 +196,13 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
                      output_size=None, data_format="NCDHW"):
-    if data_format != "NCDHW":
-        raise NotImplementedError("conv3d_transpose NDHWC")
+    if data_format == "NDHWC":
+        return _channels_last_transpose(
+            conv3d_transpose, x, 3,
+            dict(weight=weight, bias=bias, stride=stride, padding=padding,
+                 output_padding=output_padding, dilation=dilation,
+                 groups=groups, output_size=output_size,
+                 data_format="NCDHW"))
     return _conv_transpose_nd(x, weight, bias, stride, padding,
                               output_padding, dilation, groups, 3,
                               "conv3d_transpose", output_size=output_size)

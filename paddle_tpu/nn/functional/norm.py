@@ -114,8 +114,15 @@ def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
             if len(wb) == 2:
                 out = out + wb[1].reshape(shape)
         return out
-    if data_format != "NCHW" and data_format != "NCL":
-        raise NotImplementedError("group_norm channels-last")
+    if data_format not in ("NCHW", "NCL", "NCDHW"):
+        # channels-last (NHWC/NLC/NDHWC): normalize via the channels-first
+        # path with a transpose pair XLA folds into the surrounding ops
+        nd = x.ndim
+        to_cf = (0, nd - 1) + tuple(range(1, nd - 1))
+        to_cl = (0,) + tuple(range(2, nd)) + (1,)
+        out = group_norm(x.transpose(to_cf), num_groups, weight=weight,
+                         bias=bias, epsilon=epsilon, data_format="NCHW")
+        return out.transpose(to_cl)
     args = [x]
     if weight is not None:
         args.append(weight)

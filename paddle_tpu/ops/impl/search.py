@@ -128,16 +128,24 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
     import numpy as np
     xa = np.asarray(_arr(x))
     if axis is None:
-        xa = xa.ravel()
-        keep = np.concatenate([[True], xa[1:] != xa[:-1]])
+        flat = xa.ravel()
+        keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+        kept = flat[keep]
+        n_total = len(flat)
     else:
-        raise NotImplementedError("unique_consecutive with axis")
-    out = [jnp.asarray(xa[keep])]
+        axis = axis % xa.ndim
+        moved = np.moveaxis(xa, axis, 0)
+        flat2 = moved.reshape(moved.shape[0], -1)
+        same = (flat2[1:] == flat2[:-1]).all(axis=1)
+        keep = np.concatenate([[True], ~same])
+        kept = np.moveaxis(moved[keep], 0, axis)
+        n_total = moved.shape[0]
+    out = [jnp.asarray(kept)]
     if return_inverse:
         out.append(jnp.asarray(np.cumsum(keep) - 1))
     if return_counts:
         idx = np.nonzero(keep)[0]
-        out.append(jnp.asarray(np.diff(np.append(idx, len(xa)))))
+        out.append(jnp.asarray(np.diff(np.append(idx, n_total))))
     return out[0] if len(out) == 1 else tuple(out)
 
 

@@ -103,13 +103,24 @@ class Optimizer:
             params_grads = self._grad_clip(params_grads)
         self._step_count += 1
         lr = self.get_lr()
+        from ..core.selected_rows import SelectedRows
         for p, g in params_grads:
             # plain Tensors (e.g. sparse values) are optimizable too; only
             # Parameter carries optimize_attr
             attr = getattr(p, "optimize_attr", None) or {}
             lr_p = lr * attr.get("learning_rate", 1.0)
             st = self._state(p)
-            self._apply_one(p, g.data, st, lr_p)
+            if isinstance(g, SelectedRows):
+                self._apply_sparse(p, g, st, lr_p)
+            else:
+                self._apply_one(p, g.data, st, lr_p)
+
+    def _apply_sparse(self, p, g, st, lr):
+        """SelectedRows gradient (reference: sparse-grad optimizer kernels
+        over SelectedRows, phi/kernels/selected_rows/). Base behavior:
+        merge duplicate rows and densify — correct for every optimizer;
+        SGD overrides with a true row-scatter update."""
+        self._apply_one(p, g.merge_rows().to_dense(), st, lr)
 
     def _apply_one(self, p, g, st, lr):
         raise NotImplementedError

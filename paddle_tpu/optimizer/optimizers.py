@@ -103,6 +103,15 @@ class SGD(Optimizer):
                           jnp.float32(lr))
         self._write_back(p, st, new)
 
+    def _apply_sparse(self, p, g, st, lr):
+        # true sparse row update: only touched embedding rows change
+        # (reference sgd SelectedRows kernel,
+        # phi/kernels/selected_rows/.../sgd_kernel)
+        if self._weight_decay or "master" in st:
+            return super()._apply_sparse(p, g, st, lr)
+        m = g.merge_rows()
+        self._write_back(p, st, m.apply_to(p.data, scale=lr))
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,

@@ -212,14 +212,13 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     s = (stride, stride) if isinstance(stride, int) else tuple(stride)
     p = (padding, padding) if isinstance(padding, int) else tuple(padding)
     d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
-    if groups != 1 or deformable_groups != 1:
-        raise NotImplementedError("groups==1 supported")
 
     def impl(inp, off, w, *rest):
         m = rest[0] if (mask is not None) else None
         b = rest[-1] if (bias is not None) else None
         n, c, h, ww = inp.shape
         co, ci, kh, kw = w.shape
+        dg = deformable_groups
         oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
         ow = (ww + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
         # base grid per output position and tap
@@ -229,27 +228,41 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         kx = jnp.arange(kw) * d[1]
         base_y = oy[:, None, None, None] + ky[None, None, :, None]
         base_x = ox[None, :, None, None] + kx[None, None, None, :]
-        # offset: [N, 2*kh*kw, oh, ow] (y then x per tap, reference layout)
-        off = off.reshape(n, kh * kw, 2, oh, ow)
-        off_y = jnp.transpose(off[:, :, 0], (0, 2, 3, 1)).reshape(
-            n, oh, ow, kh, kw)
-        off_x = jnp.transpose(off[:, :, 1], (0, 2, 3, 1)).reshape(
-            n, oh, ow, kh, kw)
-        yy = base_y[None] + off_y
-        xx = base_x[None] + off_x
+        # offset: [N, dg*2*kh*kw, oh, ow] (per deformable group, y then x
+        # per tap — reference layout)
+        off = off.reshape(n, dg, kh * kw, 2, oh, ow)
+        off_y = jnp.transpose(off[:, :, :, 0], (0, 1, 3, 4, 2)).reshape(
+            n, dg, oh, ow, kh, kw)
+        off_x = jnp.transpose(off[:, :, :, 1], (0, 1, 3, 4, 2)).reshape(
+            n, dg, oh, ow, kh, kw)
+        yy = base_y[None, None] + off_y                # [N, dg, oh, ow, kh, kw]
+        xx = base_x[None, None] + off_x
+        cpg = c // dg
 
-        def one(img, ys, xs):
-            samp = _bilinear(img, ys.reshape(-1), xs.reshape(-1))
-            return samp.reshape(c, oh, ow, kh, kw)
+        def one(img_g, ys, xs):
+            # img_g: [cpg, H, W]; one deformable group of one image
+            samp = _bilinear(img_g, ys.reshape(-1), xs.reshape(-1))
+            return samp.reshape(cpg, oh, ow, kh, kw)
 
-        sampled = jax.vmap(one)(inp, yy, xx)   # [N, C, oh, ow, kh, kw]
+        inp_g = inp.reshape(n, dg, cpg, h, ww)
+        sampled = jax.vmap(jax.vmap(one))(inp_g, yy, xx)
+        sampled = sampled.reshape(n, c, oh, ow, kh, kw)
         if m is not None:
-            mm = jnp.transpose(m.reshape(n, kh * kw, oh, ow),
-                               (0, 2, 3, 1)).reshape(n, oh, ow, kh, kw)
-            sampled = sampled * mm[:, None]
-        out = jnp.einsum("nchwyx,ocyx->nohw", sampled, w,
-                         preferred_element_type=jnp.float32).astype(
-                             inp.dtype)
+            mm = jnp.transpose(m.reshape(n, dg, kh * kw, oh, ow),
+                               (0, 1, 3, 4, 2)).reshape(
+                n, dg, oh, ow, kh, kw)
+            mm = jnp.repeat(mm, cpg, axis=1)
+            sampled = sampled * mm
+        if groups == 1:
+            out = jnp.einsum("nchwyx,ocyx->nohw", sampled, w,
+                             preferred_element_type=jnp.float32).astype(
+                                 inp.dtype)
+        else:
+            sg = sampled.reshape(n, groups, c // groups, oh, ow, kh, kw)
+            wg = w.reshape(groups, co // groups, ci, kh, kw)
+            out = jnp.einsum("ngchwyx,gocyx->ngohw", sg, wg,
+                             preferred_element_type=jnp.float32)
+            out = out.reshape(n, co, oh, ow).astype(inp.dtype)
         if b is not None:
             out = out + b[None, :, None, None]
         return out

@@ -374,3 +374,78 @@ class TestRPC:
         outs = [p.communicate(timeout=90) for p in procs]
         assert all(p.returncode == 0 for p in procs), outs
         assert "RPC OK" in outs[0][0]
+
+
+class TestEdgeCompletion:
+    """Round-2 verdict weak #9: the NotImplementedError edge list."""
+
+    def test_conv2d_transpose_nhwc_matches_nchw(self):
+        import jax
+        import paddle_tpu.nn.functional as F
+        with jax.default_matmul_precision("float32"):
+            rng = np.random.default_rng(0)
+            x = rng.standard_normal((2, 4, 8, 8)).astype(np.float32)
+            w = rng.standard_normal((4, 6, 3, 3)).astype(np.float32)
+            ref = F.conv2d_transpose(paddle.to_tensor(x),
+                                     paddle.to_tensor(w), stride=2,
+                                     padding=1).numpy()
+            o = F.conv2d_transpose(
+                paddle.to_tensor(np.transpose(x, (0, 2, 3, 1))),
+                paddle.to_tensor(w), stride=2, padding=1,
+                data_format="NHWC").numpy()
+            np.testing.assert_allclose(np.transpose(o, (0, 3, 1, 2)), ref,
+                                       atol=1e-4)
+
+    def test_conv_transpose_string_padding(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(rng.standard_normal((2, 4, 8, 8)).astype(
+            np.float32))
+        w = paddle.to_tensor(rng.standard_normal((4, 6, 3, 3)).astype(
+            np.float32))
+        same = F.conv2d_transpose(x, w, stride=2, padding="SAME")
+        assert tuple(same.shape)[2:] == (16, 16)  # out = in * stride
+        valid = F.conv2d_transpose(x, w, stride=2, padding="VALID")
+        ref = F.conv2d_transpose(x, w, stride=2, padding=0)
+        np.testing.assert_allclose(valid.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_group_norm_channels_last(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((2, 6, 5, 5)).astype(np.float32)
+        wt = paddle.to_tensor(rng.random(6).astype(np.float32))
+        bs = paddle.to_tensor(rng.standard_normal(6).astype(np.float32))
+        r1 = F.group_norm(paddle.to_tensor(x), 3, weight=wt,
+                          bias=bs).numpy()
+        r2 = F.group_norm(paddle.to_tensor(np.transpose(x, (0, 2, 3, 1))),
+                          3, weight=wt, bias=bs,
+                          data_format="NHWC").numpy()
+        np.testing.assert_allclose(np.transpose(r2, (0, 3, 1, 2)), r1,
+                                   atol=1e-5)
+
+    def test_unique_consecutive_axis(self):
+        a = paddle.to_tensor(np.array([[1, 1], [1, 1], [2, 2], [1, 1]]))
+        out, inv, cnt = paddle.unique_consecutive(
+            a, return_inverse=True, return_counts=True, axis=0)
+        np.testing.assert_array_equal(out.numpy(),
+                                      [[1, 1], [2, 2], [1, 1]])
+        np.testing.assert_array_equal(cnt.numpy(), [2, 1, 1])
+
+    def test_deform_conv2d_groups(self):
+        import jax
+        from paddle_tpu.vision.ops import deform_conv2d
+        import paddle_tpu.nn.functional as F
+        with jax.default_matmul_precision("float32"):
+            rng = np.random.default_rng(3)
+            x = rng.standard_normal((2, 8, 9, 9)).astype(np.float32)
+            w = rng.standard_normal((8, 4, 3, 3)).astype(np.float32)
+            ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                           stride=1, padding=1, groups=2).numpy()
+            # zero offsets == plain grouped conv, for dg = 1 and 2
+            for dg in (1, 2):
+                off = np.zeros((2, dg * 2 * 9, 9, 9), np.float32)
+                o = deform_conv2d(paddle.to_tensor(x),
+                                  paddle.to_tensor(off),
+                                  paddle.to_tensor(w), stride=1, padding=1,
+                                  groups=2, deformable_groups=dg).numpy()
+                np.testing.assert_allclose(o, ref, atol=1e-4)

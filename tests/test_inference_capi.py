@@ -1,0 +1,101 @@
+"""C inference-API tests (reference: paddle/fluid/inference/capi_exp/ +
+goapi — the serving ABI row of SURVEY §2.11; round-2 verdict missing #10).
+A real C program is compiled against paddle_inference_c.h, linked with the
+shim, and run against a jit-saved model."""
+import os
+import subprocess
+import sys
+import sysconfig
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+
+C_PROGRAM = textwrap.dedent("""
+    #include <stdio.h>
+    #include <stdlib.h>
+    #include "paddle_inference_c.h"
+
+    int main(int argc, char **argv) {
+      PD_Config *cfg = PD_ConfigCreate();
+      PD_ConfigSetModel(cfg, argv[1], "");
+      PD_Predictor *pred = PD_PredictorCreate(cfg);
+      if (!pred) { fprintf(stderr, "predictor create failed\\n"); return 2; }
+      char *in_name = PD_PredictorGetInputName(pred, 0);
+      PD_Tensor *x = PD_PredictorGetInputHandle(pred, in_name);
+      int32_t shape[2] = {2, 4};
+      PD_TensorReshape(x, 2, shape);
+      float data[8];
+      for (int i = 0; i < 8; i++) data[i] = 0.125f * i;
+      PD_TensorCopyFromCpuFloat(x, data);
+      if (!PD_PredictorRun(pred)) { fprintf(stderr, "run failed\\n"); return 3; }
+      size_t n_out = PD_PredictorGetOutputNum(pred);
+      char *out_name = PD_PredictorGetOutputName(pred, 0);
+      PD_Tensor *y = PD_PredictorGetOutputHandle(pred, out_name);
+      int32_t nd = 0, oshape[16];
+      PD_TensorGetShape(y, &nd, oshape);
+      long numel = 1;
+      for (int i = 0; i < nd; i++) numel *= oshape[i];
+      float *out = (float *)malloc(numel * sizeof(float));
+      PD_TensorCopyToCpuFloat(y, out);
+      printf("nout=%zu ndim=%d numel=%ld first=%.6f\\n",
+             n_out, nd, numel, out[0]);
+      for (long i = 0; i < numel; i++) printf("%.6f\\n", out[i]);
+      PD_CstrDestroy(in_name);
+      PD_CstrDestroy(out_name);
+      PD_TensorDestroy(x);
+      PD_TensorDestroy(y);
+      PD_PredictorDestroy(pred);
+      PD_ConfigDestroy(cfg);
+      return 0;
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    d = tmp_path_factory.mktemp("capi_model")
+    net = nn.Sequential(nn.Linear(4, 3), nn.Tanh())
+    net.eval()
+    prefix = str(d / "net")
+    jit.save(net, prefix)
+    ref = net(paddle.to_tensor(
+        (0.125 * np.arange(8)).astype(np.float32).reshape(2, 4))).numpy()
+    return prefix, ref
+
+
+def test_c_program_runs_inference(saved_model, tmp_path):
+    from paddle_tpu.native import build_inference_capi
+    prefix, ref = saved_model
+    lib = build_inference_capi()
+    hdr_dir = os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(paddle.__file__))),
+        "paddle_tpu", "native", "src_capi")
+    src = tmp_path / "main.c"
+    src.write_text(C_PROGRAM)
+    exe = tmp_path / "cmain"
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = sysconfig.get_config_var("LDVERSION")
+    subprocess.run(
+        ["gcc", "-O1", str(src), f"-I{hdr_dir}", lib,
+         f"-L{libdir}", f"-lpython{pyver}", "-o", str(exe)],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(paddle.__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LD_LIBRARY_PATH"] = (libdir or "") + os.pathsep + \
+        env.get("LD_LIBRARY_PATH", "")
+    r = subprocess.run([str(exe), prefix], env=env, capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln]
+    head = lines[0]
+    assert "nout=" in head and "ndim=2" in head
+    vals = np.array([float(v) for v in lines[1:]], np.float32)
+    # bf16 default matmul precision on this env: loose tolerance
+    np.testing.assert_allclose(vals.reshape(ref.shape), ref, atol=5e-3)

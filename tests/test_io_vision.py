@@ -244,3 +244,89 @@ class _BrokenDataset:
         if i == 3:
             raise ValueError("bad sample")
         return i
+
+
+class _BigRowDataset:
+    """Module-level so spawn workers can pickle it; rows big enough to take
+    the shared-memory path (>= 64KB per collated batch)."""
+    def __init__(self, n=16, dim=32768):
+        self.n = n
+        self.dim = dim
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        import numpy as _np
+        return _np.full((self.dim,), float(i), dtype=_np.float32), i
+
+
+class _SlowDataset:
+    """Simulates per-sample decode cost so workers can win on wall-clock."""
+    def __init__(self, n=48, cost=0.01):
+        self.n = n
+        self.cost = cost
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        import time as _t
+        import numpy as _np
+        _t.sleep(self.cost)
+        return _np.full((8,), float(i), dtype=_np.float32)
+
+
+class TestWorkerParity:
+    """Round-2 verdict #9: shared-memory transport, prefetch control,
+    persistent workers, and a throughput check vs in-process loading
+    (reference io/dataloader/dataloader_iter.py:154,368 + worker.py)."""
+
+    def test_shared_memory_transport_values(self):
+        import numpy as np
+        from paddle_tpu.io import DataLoader
+        ds = _BigRowDataset(8)
+        dl = DataLoader(ds, batch_size=2, num_workers=2, shuffle=False,
+                        use_process_workers=True, use_shared_memory=True)
+        batches = list(dl)
+        assert len(batches) == 4
+        xs, ys = batches[0]
+        np.testing.assert_allclose(ys.numpy(), [0, 1])
+        np.testing.assert_allclose(xs.numpy()[:, 0], [0.0, 1.0])
+        all_ys = np.concatenate([b[1].numpy() for b in batches])
+        np.testing.assert_allclose(all_ys, np.arange(8))
+
+    def test_persistent_workers_reuse_pool(self):
+        import numpy as np
+        from paddle_tpu.io import DataLoader
+        ds = _SquareDataset(12)
+        dl = DataLoader(ds, batch_size=4, num_workers=2, shuffle=False,
+                        use_process_workers=True, persistent_workers=True)
+        first = list(dl)
+        pool = dl._handles
+        assert pool is not None and all(p.is_alive() for p in pool[0])
+        second = list(dl)          # same pool serves the second epoch
+        assert dl._handles is pool
+        np.testing.assert_allclose(
+            np.concatenate([b[1].numpy() for b in second]), np.arange(12))
+        dl._shutdown_pool(pool[0], pool[1])
+        dl._handles = None
+
+    def test_workers_beat_inprocess_on_slow_dataset(self):
+        import time
+        from paddle_tpu.io import DataLoader
+        ds = _SlowDataset(n=48, cost=0.01)
+        t0 = time.perf_counter()
+        list(DataLoader(ds, batch_size=4, num_workers=0))
+        seq = time.perf_counter() - t0
+        dl = DataLoader(ds, batch_size=4, num_workers=4,
+                        use_process_workers=True, persistent_workers=True,
+                        prefetch_factor=2)
+        list(dl)                       # warm the pool (spawn cost excluded)
+        t0 = time.perf_counter()
+        list(dl)
+        par = time.perf_counter() - t0
+        pool = dl._handles
+        dl._shutdown_pool(pool[0], pool[1])
+        dl._handles = None
+        assert par < seq * 0.7, (par, seq)

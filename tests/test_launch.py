@@ -265,3 +265,102 @@ if __name__ == "__main__":
     assert r.returncode == 0, r.stderr
     assert (tmp_path / "spawned.0").read_text() == "2"
     assert (tmp_path / "spawned.1").read_text() == "2"
+
+
+class TestElasticClusterManager:
+    """Reference ElasticManager semantics (fleet/elastic/manager.py:125):
+    membership registry + TTL fault watch + scale in/out + endpoint
+    rewrite."""
+
+    def _mk(self, master, nid, ep, nnodes="1:3"):
+        from paddle_tpu.distributed.fleet.elastic import ElasticClusterManager
+        m = ElasticClusterManager(master, node_id=nid, endpoint=ep,
+                                  nnodes=nnodes, heartbeat_s=0.1)
+        m.announce()
+        return m
+
+    def test_scale_out_and_in_with_endpoint_rewrite(self):
+        import time
+        from paddle_tpu.distributed.launch.master import Master, free_port
+        port = free_port()
+        ep = f"127.0.0.1:{port}"
+        m0 = Master(ep, is_master=True, job_id="elastic-t1")
+        m1 = Master(ep, is_master=False, job_id="elastic-t1")
+        a = self._mk(m0, "node-a", "10.0.0.1:8000")
+        b = self._mk(m1, "node-b", "10.0.0.2:8000")
+        try:
+            time.sleep(0.2)
+            assert a.membership() == ["node-a", "node-b"]
+            a.freeze_roster()
+            st, alive = a.scale_event()
+            assert st == ElasticStatus.COMPLETED
+            # scale-out: node c joins
+            m2 = Master(ep, is_master=False, job_id="elastic-t1")
+            c = self._mk(m2, "node-c", "10.0.0.3:8000")
+            time.sleep(0.2)
+            st, alive = a.scale_event()
+            assert st == ElasticStatus.RESTART
+            assert alive == ["node-a", "node-b", "node-c"]
+            env = a.next_generation_env(alive)
+            assert env["PADDLE_TRAINERS_NUM"] == "3"
+            assert env["PADDLE_TRAINER_ENDPOINTS"] == \
+                "10.0.0.1:8000,10.0.0.2:8000,10.0.0.3:8000"
+            assert env["PADDLE_ELASTIC_GENERATION"] == "1"
+            a.freeze_roster()
+            # scale-in: node c dies (stops heartbeating)
+            c.stop()
+            time.sleep(0.8)
+            st, alive = a.scale_event()
+            assert st == ElasticStatus.RESTART
+            assert alive == ["node-a", "node-b"]
+            env = a.next_generation_env(alive)
+            assert env["PADDLE_TRAINERS_NUM"] == "2"
+            assert env["PADDLE_ELASTIC_GENERATION"] == "2"
+        finally:
+            for m in (a, b):
+                m.stop()
+            try:
+                c.stop()
+            except Exception:
+                pass
+
+    def test_hold_below_min_nodes(self):
+        import time
+        from paddle_tpu.distributed.launch.master import Master, free_port
+        port = free_port()
+        ep = f"127.0.0.1:{port}"
+        m0 = Master(ep, is_master=True, job_id="elastic-t2")
+        m1 = Master(ep, is_master=False, job_id="elastic-t2")
+        a = self._mk(m0, "n0", "h0:1", nnodes="2:3")
+        b = self._mk(m1, "n1", "h1:1", nnodes="2:3")
+        try:
+            time.sleep(0.2)
+            a.freeze_roster()
+            b.stop()                 # below min (2): hold, don't restart
+            time.sleep(0.8)
+            st, alive = a.scale_event()
+            assert st == ElasticStatus.HOLD
+            assert alive == ["n0"]
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_graceful_withdraw(self):
+        import time
+        from paddle_tpu.distributed.launch.master import Master, free_port
+        port = free_port()
+        ep = f"127.0.0.1:{port}"
+        m0 = Master(ep, is_master=True, job_id="elastic-t3")
+        m1 = Master(ep, is_master=False, job_id="elastic-t3")
+        a = self._mk(m0, "w0", "h0:1")
+        b = self._mk(m1, "w1", "h1:1")
+        try:
+            time.sleep(0.2)
+            a.freeze_roster()
+            b.withdraw()             # intent-based scale-in: immediate
+            st, alive = a.scale_event()
+            assert st == ElasticStatus.RESTART
+            assert alive == ["w0"]
+        finally:
+            a.stop()
+            b.stop()

@@ -1,6 +1,7 @@
 """Parameter-server stack tests (reference test pattern: PS trainers push
 grads and pull params against table servers; SURVEY §2.8 PS row)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.distributed.ps import PsService
@@ -93,3 +94,43 @@ class TestWorkerFlow:
             c1.close(); c2.close()
         finally:
             svc.stop()
+
+
+def test_transport_rejects_bad_secret():
+    """Round-2 verdict: PS transport hardening — HMAC handshake + codec
+    that cannot execute code."""
+    from paddle_tpu.distributed.ps import PsService, PsClient
+    svc = PsService()
+    host, port = svc.start()
+    try:
+        with pytest.raises((RuntimeError, ConnectionError, OSError)):
+            bad = PsClient(host, port, secret="wrong-secret")
+            bad.ping()   # server drops the connection on handshake failure
+    finally:
+        svc.stop()
+
+
+def test_codec_roundtrip_no_pickle():
+    from paddle_tpu.distributed.ps import _encode, _decode
+    import numpy as np
+    msg = {"op": "pull_sparse", "ids": [1, 2, 3],
+           "grads": np.arange(6, dtype=np.float32).reshape(2, 3),
+           "nested": {"a": True, "b": None, "c": 1.5}}
+    out = _decode(_encode(msg))
+    assert out["op"] == "pull_sparse" and out["ids"] == [1, 2, 3]
+    np.testing.assert_array_equal(out["grads"],
+                                  np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert out["nested"]["a"] is True and out["nested"]["b"] is None
+    assert b"pickle" not in _encode(msg)  # structural sanity
+
+
+def test_codec_rejects_weird_dtype():
+    from paddle_tpu.distributed.ps import _decode, _encode
+    import numpy as np
+    import json, struct
+    # hand-craft a payload claiming dtype 'object'
+    head = json.dumps({"__nd__": 0, "d": "object", "s": [1]}).encode()
+    payload = struct.pack("<I", len(head)) + head + \
+        struct.pack("<Q", 8) + b"\\x00" * 8
+    with pytest.raises(ValueError):
+        _decode(payload)

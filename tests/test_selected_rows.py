@@ -1,0 +1,106 @@
+"""SelectedRows + StringTensor tests (reference: phi/core/selected_rows.h
+sparse-grad semantics + phi/kernels/strings/ lower/upper; round-2 verdict
+missing #9)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import SelectedRows, StringTensor, strings_empty
+
+
+class TestSelectedRows:
+    def test_sparse_embedding_grad_matches_dense(self):
+        V, D = 100, 8
+        rng = np.random.default_rng(0)
+        w = paddle.to_tensor(rng.standard_normal((V, D)).astype(np.float32),
+                             stop_gradient=False)
+        ids = paddle.to_tensor(np.array([[3, 7], [3, 50]], np.int64))
+        (F.embedding(ids, w, sparse=True) ** 2).sum().backward()
+        g = w.grad
+        assert isinstance(g, SelectedRows)
+        w2 = paddle.to_tensor(w.numpy(), stop_gradient=False)
+        (F.embedding(ids, w2, sparse=False) ** 2).sum().backward()
+        np.testing.assert_allclose(g.numpy(), w2.grad.numpy(), atol=1e-5)
+
+    def test_sgd_row_sparse_update_touches_only_rows(self):
+        w = paddle.to_tensor(np.ones((10, 4), np.float32),
+                             stop_gradient=False)
+        ids = paddle.to_tensor(np.array([1, 2], np.int64))
+        F.embedding(ids, w, sparse=True).sum().backward()
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        opt.step()
+        changed = np.abs(w.numpy() - 1.0).sum(axis=1) > 0
+        assert set(np.nonzero(changed)[0]) == {1, 2}
+
+    def test_adam_sparse_densify_path(self):
+        w = paddle.to_tensor(np.ones((10, 4), np.float32),
+                             stop_gradient=False)
+        F.embedding(paddle.to_tensor(np.array([5], np.int64)), w,
+                    sparse=True).sum().backward()
+        paddle.optimizer.Adam(learning_rate=0.1, parameters=[w]).step()
+        assert not np.allclose(w.numpy()[5], 1.0)
+
+    def test_merge_rows_sums_duplicates(self):
+        sr = SelectedRows([1, 1, 3], np.ones((3, 2), np.float32), height=5)
+        d = np.asarray(sr.merge_rows().to_dense())
+        np.testing.assert_allclose(d[1], 2.0)
+        np.testing.assert_allclose(d[3], 1.0)
+
+    def test_padding_idx_rows_zeroed(self):
+        w = paddle.to_tensor(np.ones((6, 3), np.float32),
+                             stop_gradient=False)
+        ids = paddle.to_tensor(np.array([0, 2], np.int64))
+        F.embedding(ids, w, padding_idx=0, sparse=True).sum().backward()
+        d = w.grad.numpy()
+        np.testing.assert_allclose(d[0], 0.0)   # padding row gets no grad
+        np.testing.assert_allclose(d[2], 1.0)
+
+    def test_accumulation_across_backwards(self):
+        w = paddle.to_tensor(np.ones((5, 2), np.float32),
+                             stop_gradient=False)
+        ids = paddle.to_tensor(np.array([1], np.int64))
+        F.embedding(ids, w, sparse=True).sum().backward()
+        F.embedding(ids, w, sparse=True).sum().backward()
+        np.testing.assert_allclose(w.grad.numpy()[1], 2.0)
+
+
+class TestStringTensor:
+    def test_lower_upper_unicode(self):
+        st = StringTensor([["Hello", "WORLD"], ["Grüße", "ok"]])
+        assert st.lower().tolist() == [["hello", "world"], ["grüße", "ok"]]
+        assert st.upper().tolist()[1][0] == "GRÜSSE"
+
+    def test_ascii_mode_leaves_nonascii(self):
+        assert StringTensor(["aé"]).upper(
+            use_utf8_encoding=False).tolist() == ["Aé"]
+
+    def test_empty_and_shape(self):
+        e = strings_empty([2, 3])
+        assert e.shape == [2, 3] and e.dtype == "pstring"
+        assert e.tolist() == [["", "", ""], ["", "", ""]]
+
+
+class TestSelectedRowsClip:
+    def test_global_norm_clip_with_sparse_grad(self):
+        w = paddle.to_tensor(np.ones((10, 4), np.float32),
+                             stop_gradient=False)
+        ids = paddle.to_tensor(np.array([1, 2], np.int64))
+        (F.embedding(ids, w, sparse=True) * 100.0).sum().backward()
+        opt = paddle.optimizer.SGD(
+            learning_rate=1.0, parameters=[w],
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+        opt.step()   # must not crash; update magnitude bounded by clip
+        delta = np.abs(w.numpy() - 1.0)
+        assert delta.max() > 0
+        assert np.sqrt((delta ** 2).sum()) <= 1.01
+
+    def test_value_clip_with_sparse_grad(self):
+        w = paddle.to_tensor(np.ones((6, 2), np.float32),
+                             stop_gradient=False)
+        (F.embedding(paddle.to_tensor(np.array([3], np.int64)), w,
+                     sparse=True) * 50.0).sum().backward()
+        opt = paddle.optimizer.SGD(
+            learning_rate=1.0, parameters=[w],
+            grad_clip=paddle.nn.ClipGradByValue(0.5))
+        opt.step()
+        np.testing.assert_allclose(w.numpy()[3], 0.5, atol=1e-6)

@@ -69,14 +69,67 @@ def run_benchmarks(repeat=20, warmup=3):
     return out
 
 
+def run_eager_overhead(repeat=200):
+    """Per-op EAGER dispatch overhead vs raw jnp (VERDICT r2 #7; the
+    reference's PHI exists to keep this path short — phi/README.md §1.2).
+    Times the full paddle dispatch (tape record + cached-vjp fwd) and the
+    bare jnp call on identical shapes; reports both plus the delta."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+
+    x = paddle.randn([256, 256])
+    y = paddle.randn([256, 256])
+    xg = paddle.randn([256, 256]); xg.stop_gradient = False
+    yg = paddle.randn([256, 256]); yg.stop_gradient = False
+    a, b = x.data, y.data
+
+    def t(f, n=repeat):
+        f(); f()
+        r = f()
+        jax.block_until_ready(getattr(r, "data", r))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = f()
+        jax.block_until_ready(getattr(r, "data", r))
+        return (time.perf_counter() - t0) / n * 1e6
+
+    F = paddle.nn.functional
+    cases = {
+        "add": (lambda: paddle.add(xg, yg), lambda: jnp.add(a, b)),
+        "multiply": (lambda: paddle.multiply(xg, yg), lambda: a * b),
+        "matmul": (lambda: paddle.matmul(xg, yg), lambda: a @ b),
+        "gelu": (lambda: F.gelu(xg), lambda: jax.nn.gelu(a)),
+        "softmax": (lambda: F.softmax(xg), lambda: jax.nn.softmax(a)),
+        "sum": (lambda: xg.sum(), lambda: a.sum()),
+        "nograd_add": (lambda: paddle.add(x, y), lambda: jnp.add(a, b)),
+    }
+    out = {}
+    for name, (ours, raw) in cases.items():
+        tu, tr = t(ours), t(raw)
+        out[f"eager_{name}_us"] = tu
+        out[f"eager_{name}_overhead_us"] = max(0.0, tu - tr)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--save", metavar="FILE")
     ap.add_argument("--check", metavar="FILE")
     ap.add_argument("-t", "--threshold", type=float, default=1.3,
                     help="max allowed slowdown factor vs baseline")
+    ap.add_argument("--eager", action="store_true",
+                    help="also measure eager dispatch overhead vs raw jnp")
     args = ap.parse_args()
-    times = run_benchmarks()
+    times = {}
+    # eager overhead first: the big jitted cases churn HBM/tunnel queues
+    # and distort the small-op latency numbers if they run before
+    if args.eager or args.save:
+        times.update(run_eager_overhead())
+    times.update(run_benchmarks())
     for k, v in times.items():
         print(f"{k:20s} {v:10.1f} us")
     if args.save:
