@@ -233,17 +233,58 @@ def _apply_op_inner(name, impl, args, kwargs, differentiable=True):
 
 _VJP_CACHE = {}
 _VJP_CACHE_MAX = 1024
+_VJP_UNCACHEABLE = object()  # negative-cache marker: this sig failed to
+                             # trace once (RNG draw, dynamic shapes, ...);
+                             # don't pay a failing jit trace on every call
 
 
-def _impl_draws_rng(code, depth=0):
+def _cache_put(sig, entry):
+    if len(_VJP_CACHE) >= _VJP_CACHE_MAX:
+        _VJP_CACHE.pop(next(iter(_VJP_CACHE)))
+    _VJP_CACHE[sig] = entry
+
+
+_RNG_SCAN_CACHE = {}  # code object -> bool (the walk is pure in `code`)
+
+
+def _impl_draws_rng_cached(impl):
+    code = getattr(impl, "__code__", None)
+    if code is None:
+        return False
+    hit = _RNG_SCAN_CACHE.get(code)
+    if hit is None:
+        hit = _impl_draws_rng(code, getattr(impl, "__globals__", None))
+        _RNG_SCAN_CACHE[code] = hit
+    return hit
+
+
+def _impl_draws_rng(code, globs=None, depth=0, seen=None):
+    """True if `code` (or a nested/called function, one level of module
+    globals deep) draws from the global RNG chain. The callee walk matters:
+    an impl calling a module-level helper that draws (`flash_attention` →
+    `_sdpa_ref` pre-round-4) is invisible to a co_names scan of the impl
+    alone. Belt-and-braces with random.TracedRngError, which makes any
+    miss loud instead of state-corrupting."""
     if code is None or depth > 3:
         return False
+    if seen is None:
+        seen = set()
+    if code in seen:
+        return False
+    seen.add(code)
     names = code.co_names
     if "next_key" in names or "fresh_key_tensor" in names:
         return True
     for c in code.co_consts:
-        if hasattr(c, "co_code") and _impl_draws_rng(c, depth + 1):
+        if hasattr(c, "co_code") and _impl_draws_rng(c, globs, depth + 1, seen):
             return True
+    if globs is not None:
+        for n in names:
+            g = globs.get(n)
+            gcode = getattr(g, "__code__", None)
+            if gcode is not None and _impl_draws_rng(
+                    gcode, getattr(g, "__globals__", None), depth + 1, seen):
+                return True
     return False
 
 
@@ -280,10 +321,15 @@ def _vjp_sig(name, impl, treedef, plain, diff_idx, diff_arrays):
         else:
             return None
     avals = tuple((a.shape, str(a.dtype)) for a in diff_arrays)
+    # key by the tuple itself, NOT its hash: dict equality then resolves
+    # hash collisions (e.g. hash(-1) == hash(-2) for axis closure cells)
+    # instead of silently serving the wrong compiled executable
+    sig = (name, code, cells, treedef, tuple(consts), avals)
     try:
-        return hash((name, code, cells, treedef, tuple(consts), avals))
+        hash(sig)
     except TypeError:
         return None
+    return sig
 
 
 def _vjp_with_cache(name, impl, fn, treedef, plain, diff_idx, diff_arrays):
@@ -299,9 +345,11 @@ def _vjp_with_cache(name, impl, fn, treedef, plain, diff_idx, diff_arrays):
     # as inputs of the cached executable so values stay correct
     aux_idx = [i for i, leaf in enumerate(plain)
                if i not in diff_idx and isinstance(leaf, jax.Array)]
-    if _impl_draws_rng(getattr(impl, "__code__", None)):
+    if _impl_draws_rng_cached(impl):
         return jax.vjp(fn, *diff_arrays)
     entry = _VJP_CACHE.get(sig)
+    if entry is _VJP_UNCACHEABLE:
+        return jax.vjp(fn, *diff_arrays)
     if entry is None:
 
         def make_fn(aux_vals, darrs):
@@ -325,11 +373,22 @@ def _vjp_with_cache(name, impl, fn, treedef, plain, diff_idx, diff_arrays):
             bwd_j = jax.jit(bwd)
             aux_vals = tuple(plain[i] for i in aux_idx)
             out = fwd_j(aux_vals, diff_arrays)
-        except Exception:
+        except Exception as e:
+            # TracedRngError and trace-structure failures surface here
+            # BEFORE any global state was mutated (next_key raises
+            # pre-assignment). Negative-cache only *persistent* failure
+            # classes; a transient runtime failure (e.g. device OOM during
+            # compile) must not disable caching for the process lifetime.
+            from .random import TracedRngError
+            import jax.errors as _jerr
+            if isinstance(e, (TracedRngError, TypeError,
+                              _jerr.TracerArrayConversionError,
+                              _jerr.ConcretizationTypeError,
+                              _jerr.UnexpectedTracerError,
+                              _jerr.TracerBoolConversionError)):
+                _cache_put(sig, _VJP_UNCACHEABLE)
             return jax.vjp(fn, *diff_arrays)
-        if len(_VJP_CACHE) >= _VJP_CACHE_MAX:
-            _VJP_CACHE.pop(next(iter(_VJP_CACHE)))
-        _VJP_CACHE[sig] = (fwd_j, bwd_j)
+        _cache_put(sig, (fwd_j, bwd_j))
     else:
         fwd_j, bwd_j = entry
         aux_vals = tuple(plain[i] for i in aux_idx)

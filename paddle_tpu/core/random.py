@@ -25,10 +25,30 @@ def seed(s: int):
     return _state.key
 
 
+class TracedRngError(RuntimeError):
+    """Raised when the global RNG chain would be advanced under an active
+    jax trace. Storing a tracer into `_state.key` poisons every later RNG
+    consumer with UnexpectedTracerError (global corruption, not a local
+    failure). Ops that need randomness under a trace must take their key as
+    an input (`fresh_key_tensor()` drawn *outside* the impl) — the philox
+    (seed, offset)-as-data discipline of the reference generator
+    (paddle/phi/core/generator.h:32)."""
+
+
 def next_key():
-    """Split one subkey off the global chain (host-side eager use)."""
+    """Split one subkey off the global chain (host-side eager use).
+
+    Refuses to run under a jax trace: the new chain head would be a tracer
+    (see TracedRngError). The eager vjp cache catches this error and falls
+    back to the uncached path before any state is mutated."""
     key = _get()
-    _state.key, sub = jax.random.split(key)
+    new_key, sub = jax.random.split(key)
+    if isinstance(new_key, jax.core.Tracer):
+        raise TracedRngError(
+            "next_key() called under an active jax trace; pass the key as "
+            "an op input (core.random.fresh_key_tensor()) instead of "
+            "drawing inside the kernel impl")
+    _state.key = new_key
     return sub
 
 
@@ -37,6 +57,9 @@ def get_rng_state():
 
 
 def set_rng_state(key):
+    if isinstance(key, jax.core.Tracer):
+        raise TracedRngError("set_rng_state() got a tracer; the global RNG "
+                             "chain must stay concrete")
     _state.key = key
 
 
@@ -45,8 +68,20 @@ def fresh_key_tensor():
     their key as an *argument* (instead of drawing inside the impl) stay
     fresh under every capture tier: eager draws per call, jit traces the key
     as an input, and the SOT replay recognizes the marker and re-draws
-    (executor._input_locator -> ("rng",))."""
+    (executor._input_locator -> ("rng",)).
+
+    Trace-tolerant: under an active jax trace (whole-function to_static
+    tier) the chain is NOT advanced — the key is derived by fold_in of a
+    host-side counter, so the traced program bakes a fixed key (documented
+    limitation of that tier) while the global chain stays concrete."""
     from .tensor import Tensor
-    t = Tensor(next_key())
+    key = _get()
+    new_key, sub = jax.random.split(key)
+    if isinstance(new_key, jax.core.Tracer):
+        _state.trace_draws = getattr(_state, "trace_draws", 0) + 1
+        sub = jax.random.fold_in(key, _state.trace_draws)
+    else:
+        _state.key = new_key
+    t = Tensor(sub)
     t._is_rng_key = True
     return t

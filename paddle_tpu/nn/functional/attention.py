@@ -27,9 +27,16 @@ def _flash_available():
 
 
 def _sdpa_ref(q, k, v, mask=None, dropout=0.0, causal=False, scale=None,
-              training=True):
+              rng_key=None):
     """Reference attention in pure XLA ops, [B, S, H, D] layout (paddle's
-    flash_attention layout)."""
+    flash_attention layout).
+
+    Dropout requires `rng_key` (a PRNG key array passed in as an *input*,
+    never drawn inside this function). Keeping the impl RNG-free is the
+    philox-offset discipline (reference paddle/phi/core/generator.h:32):
+    the eager vjp cache rematerialises the forward inside its jitted
+    backward, and a key passed as an input replays identically there, while
+    an internal draw would leak a tracer into the global key chain."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     if k.shape[2] != q.shape[2]:  # GQA: broadcast KV head groups
@@ -54,8 +61,8 @@ def _sdpa_ref(q, k, v, mask=None, dropout=0.0, causal=False, scale=None,
         else:
             logits = logits + mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits, axis=-1)
-    if dropout > 0.0 and training:
-        keep = jax.random.bernoulli(_random.next_key(), 1.0 - dropout, probs.shape)
+    if dropout > 0.0 and rng_key is not None:
+        keep = jax.random.bernoulli(rng_key, 1.0 - dropout, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
     out = jnp.einsum("bhst,bhtd->bhsd", probs,
                      vt.astype(jnp.float32)).astype(q.dtype)
@@ -80,9 +87,16 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
         except Exception:
             pass  # fall through to reference path
 
+    if dropout > 0.0 and training:
+        def impl(q, k, v, rk):
+            return _sdpa_ref(q, k, v, dropout=dropout, causal=causal,
+                             rng_key=rk)
+        out = apply_op("flash_attention_ref", impl,
+                       (query, key, value, _random.fresh_key_tensor()), {})
+        return out, None
+
     def impl(q, k, v):
-        return _sdpa_ref(q, k, v, dropout=dropout, causal=causal,
-                         training=training)
+        return _sdpa_ref(q, k, v, causal=causal)
     out = apply_op("flash_attention_ref", impl, (query, key, value), {})
     return out, None
 
@@ -96,9 +110,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  causal=is_causal, training=training)
         return out
 
+    if dropout_p > 0.0 and training:
+        def impl(q, k, v, m, rk):
+            return _sdpa_ref(q, k, v, mask=m, dropout=dropout_p,
+                             causal=is_causal, rng_key=rk)
+        return apply_op("sdpa", impl, (query, key, value, attn_mask,
+                                       _random.fresh_key_tensor()), {})
+
     def impl(q, k, v, m):
-        return _sdpa_ref(q, k, v, mask=m, dropout=dropout_p, causal=is_causal,
-                         training=training)
+        return _sdpa_ref(q, k, v, mask=m, causal=is_causal)
     return apply_op("sdpa", impl, (query, key, value, attn_mask), {})
 
 
@@ -113,7 +133,7 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
         out, _ = flash_attention(query, key, value, dropout=dropout, causal=causal)
         return out
 
-    def impl(q, k, v, idx):
+    def impl(q, k, v, idx, *rk):
         s = q.shape[1]
         rows = jnp.arange(s)[:, None]  # query row index
         # LTS convention: column c masks query rows r >= start[c]
@@ -122,9 +142,12 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
         if causal:
             cm = jnp.tril(jnp.ones((s, s), dtype=bool))
             keep = jnp.logical_and(keep, cm)
-        return _sdpa_ref(q, k, v, mask=keep, dropout=dropout, causal=False)
-    return apply_op("flashmask_attention", impl,
-                    (query, key, value, startend_row_indices), {})
+        return _sdpa_ref(q, k, v, mask=keep, dropout=dropout, causal=False,
+                         rng_key=rk[0] if rk else None)
+    args = (query, key, value, startend_row_indices)
+    if dropout > 0.0:
+        args = args + (_random.fresh_key_tensor(),)
+    return apply_op("flashmask_attention", impl, args, {})
 
 
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
@@ -134,7 +157,7 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     [total_tokens, H, D] with cumulative sequence offsets. XLA wants static
     shapes, so this builds a segment mask over the packed layout — the
     idiomatic TPU equivalent of varlen flash (segment-ids pattern)."""
-    def impl(q, k, v, cu_q, cu_k):
+    def impl(q, k, v, cu_q, cu_k, *rk):
         total_q = q.shape[0]
         total_k = k.shape[0]
         pos_q = jnp.arange(total_q)
@@ -151,12 +174,14 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         logits = jnp.einsum("shd,thd->hst", q, k) * sc
         logits = jnp.where(mask[None], logits, jnp.finfo(logits.dtype).min)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
-        if dropout > 0.0 and training:
-            keep = jax.random.bernoulli(_random.next_key(), 1.0 - dropout, probs.shape)
+        if rk:
+            keep = jax.random.bernoulli(rk[0], 1.0 - dropout, probs.shape)
             probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
         return jnp.einsum("hst,thd->shd", probs, v)
-    out = apply_op("flash_attn_unpadded", impl,
-                   (query, key, value, cu_seqlens_q, cu_seqlens_k), {})
+    args = (query, key, value, cu_seqlens_q, cu_seqlens_k)
+    if dropout > 0.0 and training:
+        args = args + (_random.fresh_key_tensor(),)
+    out = apply_op("flash_attn_unpadded", impl, args, {})
     return out, None
 
 

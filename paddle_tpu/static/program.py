@@ -120,7 +120,16 @@ class Program:
                                                          external_ids))
         feed_by_id = {id(self.feed_vars[n]): np.asarray(feed[n])
                       for n in feed_names}
-        arrays = [feed_by_id.get(id(t), t.data) for t in externals]
+        # RNG-key externals (fresh_key_tensor marker) are re-drawn per run:
+        # replaying the record-time key would freeze every dropout mask to
+        # one fixed pattern across training steps
+        from ..core import random as _random
+        arrays = [
+            _random.next_key() if getattr(t, "_is_rng_key", False)
+            and id(t) not in feed_by_id
+            else feed_by_id.get(id(t), t.data)
+            for t in externals
+        ]
         missing_feeds = [n for n in self.feed_vars
                          if n not in feed and
                          id(self.feed_vars[n]) in external_ids]
