@@ -177,13 +177,26 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True):
-    """paddle.jit.to_static parity (python/paddle/jit/api.py:197)."""
+              backend=None, full_graph=False):
+    """paddle.jit.to_static parity (python/paddle/jit/api.py:197).
+
+    Default (full_graph=False) routes through the SOT opcode tier
+    (reference: api.py:197 -> sot/translate.py:37): bytecode-level capture
+    with mid-function graph breaks, chaining to the whole-function
+    StaticFunction tier and the AST rewrite for code the interpreter
+    cannot simulate. full_graph=True forces the whole-function tier
+    (reference AST/full-graph semantics: one XLA program or failure)."""
     def decorate(fn):
         if fn in _NOT_TO_STATIC:
             return fn
-        return StaticFunction(fn, input_spec, build_strategy, backend,
-                              full_graph)
+        if full_graph:
+            return StaticFunction(fn, input_spec, build_strategy, backend,
+                                  True)
+        from .sot.translate import SotFunction
+        target = fn.__call__ if isinstance(fn, Layer) else fn
+        sf = SotFunction(target, build_strategy=build_strategy)
+        sf._origin = fn
+        return sf
     if function is not None:
         return decorate(function)
     return decorate

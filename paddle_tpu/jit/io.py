@@ -51,8 +51,9 @@ def _pickle_layer(layer):
 
 def save(layer, path, input_spec=None, **configs):
     from .api import StaticFunction, to_static
+    from .sot.translate import SotFunction
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    sf = layer if isinstance(layer, StaticFunction) else None
+    sf = layer if isinstance(layer, (StaticFunction, SotFunction)) else None
     net = sf._layers[0] if sf and sf._layers else layer
     state = {}
     if hasattr(net, "state_dict"):

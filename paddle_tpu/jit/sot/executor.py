@@ -88,14 +88,19 @@ SUPPORTED_OPS = {
     "POP_JUMP_IF_TRUE", "POP_JUMP_IF_FALSE", "POP_JUMP_IF_NONE",
     "POP_JUMP_IF_NOT_NONE",
     "MAKE_FUNCTION", "RETURN_GENERATOR",
+    # exception machinery (CPython 3.12 zero-cost exceptions): protected
+    # ranges run as break regions (concrete), handlers dispatch via the
+    # exception table — see _dispatch_exception
+    "PUSH_EXC_INFO", "POP_EXCEPT", "RERAISE", "CHECK_EXC_MATCH",
+    "RAISE_VARARGS", "BEFORE_WITH", "WITH_EXCEPT_START",
+    "LOAD_ASSERTION_ERROR",
 }
 
 
 def code_supported(code):
     """Pre-flight: can the interpreter simulate this code object at all?
-    (Unsupported opcode or exception table => legacy whole-function tier.)"""
-    if code.co_exceptiontable:
-        return False, "exception table (try/with)"
+    (Unsupported opcode => legacy whole-function tier.) Exception tables
+    are supported since round 4: try/with bodies become break regions."""
     for ins in dis.get_instructions(code):
         if ins.opname not in SUPPORTED_OPS:
             return False, f"opcode {ins.opname}"
@@ -138,6 +143,11 @@ def _python_fn_foldable(fn):
     if code is None:
         return False
     try:
+        # a call to a mutating method (x.append(...)) is a side effect the
+        # opcode scan below cannot see as a STORE — any reference to such
+        # a name disqualifies folding (replay would skip the mutation)
+        if any(n in _MUTATING_METHODS for n in code.co_names):
+            return False
         for ins in dis.get_instructions(code):
             if ins.opname in _IMPURE_CODE_OPS:
                 return False
@@ -147,6 +157,14 @@ def _python_fn_foldable(fn):
     except Exception:
         return False
     return True
+
+
+# dy2static control-flow dispatchers: with a concrete predicate they run
+# ONE data-dependent branch/loop concretely — folding them would bake the
+# capture-time direction into the plan with no guard on the predicate
+_CONTROL_FLOW_HELPERS = {"convert_ifelse", "convert_while_loop",
+                         "convert_logical_and", "convert_logical_or",
+                         "convert_for_range"}
 
 
 def classify_call(callee, args, kwargs):
@@ -159,6 +177,9 @@ def classify_call(callee, args, kwargs):
         return "break"  # inner SOT manages its own plan + break regions
     if isinstance(callee, StaticFunction):
         return "fold"   # single dispatched super-op, pure
+    if getattr(callee, "__name__", "") in _CONTROL_FLOW_HELPERS and \
+            "convert_operators" in (getattr(callee, "__module__", "") or ""):
+        return "break"
     if isinstance(callee, (staticmethod, classmethod)):
         callee = callee.__func__
 
@@ -401,6 +422,17 @@ class Executor:
         self.capture = capture
         self.instrs = list(dis.get_instructions(self.code))
         self.off2idx = {ins.offset: i for i, ins in enumerate(self.instrs)}
+        # exception table (CPython 3.12 zero-cost exceptions): protected
+        # ranges + cold handler tails form the "concrete zone" — capture
+        # treats them as break regions (an XLA segment cannot raise/catch)
+        try:
+            self.etable = (dis._parse_exception_table(self.code)
+                           if self.code.co_exceptiontable else [])
+        except Exception:
+            self.etable = []
+        self._exc_zone = self._compute_exc_zone()
+        self._in_exc_zone = False
+        self.cur_exc = None        # the "active exception" (sys.exc_info)
         # frame state
         self.locals = {}
         self.stack = []
@@ -414,11 +446,13 @@ class Executor:
             self.sym_keep = []        # strong refs to arrays (id stability)
             self.provenance = {}      # id(array) -> locator (tensors)
             self.obj_provenance = {}  # id(object) -> locator (mutables)
+            self.obj_keep = []        # strong refs (id stability)
             self.open_snapshot = None  # (locals copy, stack copy) at seg open
             self._next_sym = [0]
         # replay state
         self.replay_idx = 0           # next segment index expected
         self.side_effects = False     # a break op has executed this call
+        self._open_cells = {}         # cell snapshot at current segment open
 
     # -- frame setup ----------------------------------------------------
     def _bind_args(self):
@@ -467,6 +501,29 @@ class Executor:
         for name, cell in zip(free, closure):
             self.cells[name] = cell
 
+    def _compute_exc_zone(self):
+        """Offsets that must execute concretely because exceptions can be
+        raised to / handled at them: the union of protected [start, end)
+        ranges plus, for handler targets outside any range, the cold tail
+        [target, end-of-code) (3.12 places cleanup blocks after the last
+        return, so the tail over-approximation never swallows hot code)."""
+        if not self.etable:
+            return frozenset()
+        zone = set()
+        for ins in self.instrs:
+            for en in self.etable:
+                if en.start <= ins.offset < en.end:
+                    zone.add(ins.offset)
+                    break
+        cold_starts = [en.target for en in self.etable
+                       if en.target not in zone]
+        if cold_starts:
+            first = min(cold_starts)
+            for ins in self.instrs:
+                if ins.offset >= first:
+                    zone.add(ins.offset)
+        return frozenset(zone)
+
     # -- capture helpers ------------------------------------------------
     def _new_sym(self):
         self._next_sym[0] += 1
@@ -474,7 +531,13 @@ class Executor:
 
     def _open_segment(self, offset):
         self.seg = Segment(offset)
-        self.open_snapshot = (dict(self.locals), list(self.stack))
+        cells = {}
+        for k, cell in self.cells.items():
+            try:
+                cells[k] = cell.cell_contents
+            except ValueError:
+                pass
+        self.open_snapshot = (dict(self.locals), list(self.stack), cells)
 
     def _close_segment(self, offset):
         """Close the open segment at `offset` (the break/return point) and
@@ -491,7 +554,20 @@ class Executor:
             locals_tpl = {k: self._tpl(v, seg, memo)
                           for k, v in self.locals.items()}
             stack_tpl = [self._tpl(v, seg, memo) for v in self.stack]
-            seg.close_tpl = (locals_tpl, stack_tpl)
+            # frame-local cells (MAKE_CELL vars): their contents are frame
+            # state too — replay recreates the cells so LOAD/STORE_DEREF
+            # and reconstructed closures (see "mkfunc") share one store.
+            # co_freevars cells belong to fn's own closure (live, shared
+            # with the outside world) and are never restored from template.
+            cells_tpl = {}
+            for k, cell in self.cells.items():
+                if k in self.code.co_freevars:
+                    continue
+                try:
+                    cells_tpl[k] = self._tpl(cell.cell_contents, seg, memo)
+                except ValueError:
+                    cells_tpl[k] = ("emptycell",)
+            seg.close_tpl = (locals_tpl, stack_tpl, cells_tpl)
         except NoReplay as e:
             log.info("sot[%s]: plan not replayable (%s)", plan.name, e)
             plan.valid = False
@@ -541,6 +617,17 @@ class Executor:
             name = getattr(v, "__name__", None)
             if owner is not None and name is not None:
                 return ("method", self._tpl(owner, seg, memo), name)
+        if isinstance(v, types.FunctionType) and v.__closure__:
+            # a closure made in THIS frame (MAKE_FUNCTION over our cells):
+            # reconstruct at replay over the replay executor's cells, so
+            # the rebuilt function and LOAD/STORE_DEREF share state.
+            # Closures over foreign cells fall through to ("const", v).
+            own_cells = {id(c): n for n, c in self.cells.items()}
+            if any(id(c) in own_cells for c in v.__closure__):
+                spec = tuple(("n", own_cells[id(c)]) if id(c) in own_cells
+                             else ("c", c) for c in v.__closure__)
+                return ("mkfunc", v.__code__, v.__globals__, v.__name__,
+                        v.__defaults__, spec, v.__kwdefaults__)
         if isinstance(v, (types.FunctionType, types.BuiltinFunctionType,
                           type, types.ModuleType)):
             return ("const", v)
@@ -577,7 +664,7 @@ class Executor:
         """Find `v` by identity in the segment-open snapshot."""
         if self.open_snapshot is None:
             return None
-        loc, stk = self.open_snapshot
+        loc, stk, opencells = self.open_snapshot
         for k, x in loc.items():
             if _u(x) is v:
                 return ("local", k)
@@ -590,12 +677,15 @@ class Executor:
             p = self._containerpath(_u(x), v)
             if p is not None:
                 return ("stack", i) + p
-        for k, cell in self.cells.items():
-            try:
-                if cell.cell_contents is v:
-                    return ("deref", k)
-            except ValueError:
-                pass
+        # cell contents AT SEGMENT OPEN: replay re-resolves against its own
+        # open-time cell snapshot (a live ("deref") read would race the
+        # restore of the very cells being rebuilt)
+        for k, x in opencells.items():
+            if _u(x) is v:
+                return ("opencell", k)
+            p = self._containerpath(_u(x), v)
+            if p is not None:
+                return ("opencell", k) + p
         if v is None:
             return None
         return None
@@ -638,8 +728,19 @@ class Executor:
                 if sym not in seg.avail:
                     # external to this segment (an arg, or a value produced
                     # by an earlier segment/break region): becomes an input
+                    try:
+                        loc = self._input_locator(leaf)
+                    except NoReplay as e:
+                        # unlocatable input: the CALL must still execute —
+                        # only the plan is lost, never the computation
+                        if self.plan is not None:
+                            self.plan.valid = False
+                        self.seg = None
+                        log.info("sot[%s]: plan not replayable (%s)",
+                                 self.plan.name if self.plan else "?", e)
+                        return
                     seg.input_syms.append(sym)
-                    seg.input_locators.append(self._input_locator(leaf))
+                    seg.input_locators.append(loc)
                     seg.avail.add(sym)
                 tpl.append(("sym", sym))
             else:
@@ -664,7 +765,17 @@ class Executor:
         prov = self.provenance.get(id(t._data))
         if prov is not None:
             return prov
-        return ("ref", t)  # persistent-object assumption (layer params)
+        # last resort: a strong reference is only sound for persistent
+        # objects whose identity IS their role — layer Parameters (and
+        # buffers registered on layers). A transient tensor produced
+        # outside the snapshot (module-level cache, folded-helper output)
+        # would replay with stale capture-time values: refuse the plan.
+        from ...core.tensor import Parameter
+        if isinstance(t, Parameter) or getattr(t, "_is_layer_buffer", False):
+            return ("ref", t)
+        raise NoReplay(
+            f"input tensor {tuple(t.shape)} has no replayable locator "
+            "(not an argument, not a recorded read, not a Parameter/buffer)")
 
     def _fetch(self, locator, open_loc, open_stk):
         kind = locator[0]
@@ -677,6 +788,9 @@ class Executor:
         elif kind == "deref":
             v = self.cells[locator[1]].cell_contents
             rest = locator[2:]
+        elif kind == "opencell":
+            v = self._open_cells[locator[1]]
+            rest = locator[2:]
         elif kind == "attr":
             v = getattr(locator[1], locator[2])
             rest = locator[3:]
@@ -688,6 +802,9 @@ class Executor:
         elif kind == "rng":
             from ...core import random as _random
             return _random.fresh_key_tensor()
+        elif kind == "mkcall":
+            # re-invoke a folded scalar-arg constructor (e.g. no_grad())
+            return locator[1](*locator[2], **dict(locator[3]))
         else:
             raise LookupError(kind)
         while rest:
@@ -751,6 +868,12 @@ class Executor:
         from ...core.dispatch import apply_op
         seg = self.plan.segments[seg_i]
         open_loc, open_stk = dict(self.locals), list(self.stack)
+        self._open_cells = {}
+        for k, cell in self.cells.items():
+            try:
+                self._open_cells[k] = cell.cell_contents
+            except ValueError:
+                pass
         try:
             inputs = [self._fetch(loc, open_loc, open_stk)
                       for loc in seg.input_locators]
@@ -764,9 +887,20 @@ class Executor:
         outs = apply_op(f"sot[{self.plan.name}]#{seg_i}", seg.compiled(),
                         tuple(in_tensors), {})
         outs = outs if isinstance(outs, (tuple, list)) else (outs,)
-        # restore the frame as it stood when the segment closed
+        # restore the frame as it stood when the segment closed; cells
+        # first so reconstructed closures ("mkfunc") see their contents
         memo = {}
-        locals_tpl, stack_tpl = seg.close_tpl
+        locals_tpl, stack_tpl, cells_tpl = seg.close_tpl
+        for k, t in cells_tpl.items():
+            cell = self.cells.setdefault(k, types.CellType())
+            if t == ("emptycell",):
+                try:
+                    del cell.cell_contents
+                except ValueError:
+                    pass
+            else:
+                cell.cell_contents = self._inst(t, outs, open_loc,
+                                                open_stk, memo)
         self.locals = {k: self._inst(t, outs, open_loc, open_stk, memo)
                        for k, t in locals_tpl.items()}
         self.stack = [self._inst(t, outs, open_loc, open_stk, memo)
@@ -815,6 +949,14 @@ class Executor:
             v = getattr(owner, tpl[2])
         elif kind == "openref":
             v = self._fetch(tpl[1], open_loc, open_stk)
+        elif kind == "mkfunc":
+            code, globs, name, defaults, spec, kwdefaults = tpl[1:]
+            closure = tuple(
+                self.cells.setdefault(n, types.CellType()) if k == "n"
+                else n for k, n in spec)
+            v = types.FunctionType(code, globs, name, defaults, closure)
+            if kwdefaults:
+                v.__kwdefaults__ = kwdefaults
         else:
             raise LookupError(kind)
         memo[key] = v
@@ -837,15 +979,64 @@ class Executor:
                 seg_i = self.plan.next_segment_at(ins.offset, self.replay_idx)
                 if seg_i is not None:
                     return _PAUSED
+            if mode == "capture" and self._exc_zone:
+                in_zone = ins.offset in self._exc_zone
+                if in_zone and not self._in_exc_zone:
+                    self._in_exc_zone = True
+                    self._break_here(ins, "exception-protected region")
+                elif not in_zone and self._in_exc_zone:
+                    self._in_exc_zone = False
+                    self._resume_segment_after(ins.offset)
             op = ins.opname
             handler = getattr(self, "_op_" + op, None)
             if handler is None:
                 raise RuntimeError(f"sot executor: unhandled opcode {op}")
-            jump = handler(ins, mode)
+            try:
+                jump = handler(ins, mode)
+            except NoReplay:
+                raise
+            except Exception as e:
+                # consult the exception table: a covered offset jumps to
+                # its handler with the stack trimmed (3.12 semantics);
+                # an uncovered offset propagates out of the frame
+                jump = self._dispatch_exception(e, ins.offset, mode)
             if jump is _RETURN:
                 return self._retval
             i = self.off2idx[jump] if jump is not None else i + 1
         raise RuntimeError("sot executor: fell off the end of the bytecode")
+
+    def _dispatch_exception(self, exc, offset, mode):
+        """CPython 3.12 exception dispatch: find the innermost exception-
+        table entry covering `offset`; trim the stack to its depth, push
+        (lasti?, exception), jump to the handler. Returns the handler's
+        offset, or re-raises if no entry covers the raise site."""
+        entry = None
+        for en in self.etable:
+            if en.start <= offset < en.end:
+                entry = en
+                break
+        if entry is None:
+            raise exc
+        if mode == "capture":
+            seg = self.seg
+            if seg is not None and seg.n_ops > 0 and self.plan is not None:
+                # ops already recorded into an open segment preceded the
+                # raise; a compiled segment cannot reproduce the exception
+                # path, so this call's plan is unreplayable
+                self.plan.valid = False
+            self.seg = None
+            self.side_effects = True
+            self._in_exc_zone = True  # handler offsets are zone members
+        if exc.__traceback__ is None:
+            try:
+                raise exc
+            except Exception:
+                pass  # attach a traceback for WITH_EXCEPT_START/__exit__
+        del self.stack[entry.depth:]
+        if entry.lasti:
+            self.stack.append(offset)
+        self.stack.append(exc)
+        return entry.target
 
     # -- break orchestration --------------------------------------------
     def _break_here(self, ins, reason):
@@ -1446,12 +1637,40 @@ class Executor:
         args_u = [_u(a) for a in args]
         kwargs_u = {k: _u(v) for k, v in kwargs.items()}
         any_taint = _tainted(callee, *args, *kwargs.values())
+        if self.capture and verdict == "fold":
+            # folding a Layer-bound call hides every attribute read inside
+            # it from the guard system; the one read that routinely changes
+            # between calls is `training` (net.train()/net.eval()) — guard
+            # it for the whole subtree so a mode flip invalidates the plan
+            owner = getattr(callee_u, "__self__", None)
+            from ...nn.layer import Layer as _Layer
+            if isinstance(owner, _Layer):
+                for _, sub in owner.named_sublayers(include_self=True):
+                    self._guard_read("attr", sub, "training", sub.training)
         out = callee_u(*args_u, **kwargs_u)
         if verdict == "break":
             if not isinstance(out, Tensor):
                 out = _Taint(out)
         elif any_taint and not isinstance(out, Tensor):
             out = _Taint(out)
+        elif (self.capture and out is not None
+              and not isinstance(out, Tensor) and not _guardable(out)
+              and not isinstance(out, (list, tuple, dict, set, frozenset,
+                                       bytearray, np.ndarray,
+                                       types.FunctionType,
+                                       types.BuiltinFunctionType,
+                                       types.MethodType, type,
+                                       types.ModuleType))
+              and all(_guardable(a) for a in args_u)
+              and all(_guardable(v) for v in kwargs_u.values())):
+            # opaque object from a folded call with scalar args (e.g. a
+            # context-manager instance like no_grad()): replayable by
+            # re-invoking the constructor — lets segment close-templates
+            # reference it instead of invalidating the plan
+            self.obj_provenance.setdefault(
+                id(out), ("mkcall", callee_u, tuple(args_u),
+                          tuple(kwargs_u.items())))
+            self.obj_keep.append(out)
         self.stack.append(out)
         if verdict == "break":
             self._resume_segment_after(self.instrs[self._cur_idx + 1].offset)
@@ -1503,6 +1722,65 @@ class Executor:
         if self.stack and self.stack[-1] is NULL:
             self.stack.pop()
         return self._exec_call(ins, verdict, c, args, kwargs)
+
+    # ---------------- exception opcodes (CPython 3.12) ------------------
+    # These always run concretely: every reachable offset is inside the
+    # exception concrete zone (capture broke the segment on entry).
+
+    def _op_PUSH_EXC_INFO(self, ins, mode):
+        exc = self.stack.pop()
+        self.stack.append(self.cur_exc)
+        self.cur_exc = _u(exc)
+        self.stack.append(exc)
+
+    def _op_POP_EXCEPT(self, ins, mode):
+        self.cur_exc = _u(self.stack.pop())
+
+    def _op_CHECK_EXC_MATCH(self, ins, mode):
+        typ = _u(self.stack.pop())
+        exc = _u(self.stack[-1])
+        self.stack.append(isinstance(exc, typ))
+
+    def _op_RERAISE(self, ins, mode):
+        # oparg > 0 means a lasti slot sits below TOS; it stays on the
+        # stack (the dispatcher's depth-trim discards it, as in ceval)
+        raise _u(self.stack.pop())
+
+    def _op_RAISE_VARARGS(self, ins, mode):
+        argc = ins.arg
+        if argc == 0:
+            if self.cur_exc is None:
+                raise RuntimeError("No active exception to re-raise")
+            raise self.cur_exc
+        cause = _u(self.stack.pop()) if argc == 2 else None
+        exc = _u(self.stack.pop())
+        if isinstance(exc, type) and issubclass(exc, BaseException):
+            exc = exc()
+        if argc == 2:
+            if isinstance(cause, type) and issubclass(cause, BaseException):
+                cause = cause()
+            exc.__cause__ = cause
+        raise exc
+
+    def _op_LOAD_ASSERTION_ERROR(self, ins, mode):
+        self.stack.append(AssertionError)
+
+    def _op_BEFORE_WITH(self, ins, mode):
+        # __enter__/__exit__ are host side effects: break region
+        if mode == "capture":
+            self._break_here(ins, "with (context manager)")
+        mgr = _u(self.stack.pop())
+        exit_m = mgr.__exit__
+        res = mgr.__enter__()
+        self.stack.append(exit_m)
+        self.stack.append(res)
+        if mode == "capture":
+            self._resume_segment_after(self.instrs[self._cur_idx + 1].offset)
+
+    def _op_WITH_EXCEPT_START(self, ins, mode):
+        exc = _u(self.stack[-1])
+        exit_fn = _u(self.stack[-4])
+        self.stack.append(exit_fn(type(exc), exc, exc.__traceback__))
 
 
 _RETURN = object()

@@ -152,6 +152,30 @@ class SotFunction:
         self._plans.clear()
         self._cache.clear()
 
+    # -- StaticFunction-compatible surface (jit.save / concrete_program) --
+    @property
+    def _layers(self):
+        from ..api import _collect_layers
+        return _collect_layers(getattr(self, "_origin", self._fn))
+
+    @property
+    def layers(self):
+        return self._layers
+
+    def _whole_fn(self):
+        """A whole-function StaticFunction over the same callable (used for
+        StableHLO lowering, which needs ONE program, not segments)."""
+        sf = getattr(self, "_whole", None)
+        if sf is None:
+            sf = self._whole = StaticFunction(
+                getattr(self, "_origin", self._fn))
+        return sf
+
+    def concrete_program(self, *args, **kwargs):
+        """Lowered StableHLO for this signature via the whole-function tier
+        (a segmented plan has no single program to dump)."""
+        return self._whole_fn().concrete_program(*args, **kwargs)
+
     def __call__(self, *args, **kwargs):
         if self._eager_pinned:
             return self._fn(*args, **kwargs)
@@ -190,6 +214,15 @@ class SotFunction:
                 self._eager_pinned = True
                 _stats["eager_pins"] += 1
             return self._fn(*args, **kwargs)
+        if plan is not None and plan.valid and not plan.segments:
+            # capture found nothing compilable (e.g. the whole body sits in
+            # an exception-protected zone): re-capturing every call is pure
+            # overhead — count it as a break and eventually pin to eager
+            self._breaks += 1
+            _stats["graph_breaks"] += 1
+            if self._breaks >= MAX_BREAKS:
+                self._eager_pinned = True
+                _stats["eager_pins"] += 1
         if plan is not None and plan.valid and plan.segments:
             # pin the opaque argument objects: the arg_key guards them by
             # id(), and a strong ref prevents CPython id reuse from

@@ -71,6 +71,13 @@ class Layer:
         return sublayer
 
     def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None:
+            # persistent-identity marker: the SOT replay may hold a strong
+            # ref to a buffer (like a Parameter) — see _input_locator
+            try:
+                tensor._is_layer_buffer = True
+            except AttributeError:
+                pass
         self._buffers[name] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(name)

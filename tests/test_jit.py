@@ -285,3 +285,99 @@ class RangeDatasetForLeak:
 
     def __len__(self):
         return 100
+
+
+class TestSotDefaultToStatic:
+    """Round-4 verdict #2: paddle.jit.to_static routes through the SOT
+    opcode tier by default (reference python/paddle/jit/api.py:197 ->
+    sot/translate.py:37), with full_graph=True forcing the whole-function
+    tier."""
+
+    def test_default_is_opcode_tier(self):
+        from paddle_tpu.jit.sot.translate import SotFunction
+
+        @jit.to_static
+        def f(x):
+            return x * 2.0 + 1.0
+
+        assert isinstance(f, SotFunction)
+        assert f._tier == "opcode"
+        np.testing.assert_allclose(f(paddle.ones([3])).numpy(), [3, 3, 3])
+
+    def test_full_graph_true_is_whole_function(self):
+        from paddle_tpu.jit.api import StaticFunction
+
+        sf = jit.to_static(lambda x: x + 1, full_graph=True)
+        assert isinstance(sf, StaticFunction)
+
+    def test_mid_body_escape_two_segments(self):
+        # the verdict's done-criterion: a mid-body host escape produces TWO
+        # compiled segments, not a whole-function eager fallback
+        @jit.to_static
+        def f(x):
+            y = x * 2.0
+            v = float(y.sum().item())   # host escape -> graph break
+            z = y + v
+            return z * 3.0
+
+        x = paddle.ones([4])
+        r1 = f(x)
+        r2 = f(x)
+        np.testing.assert_allclose(r1.numpy(), r2.numpy())
+        np.testing.assert_allclose(r1.numpy(), [30.0] * 4)
+        plans = [p for ps in f._plans.values() for p in ps]
+        assert plans and len(plans[0].segments) == 2
+
+    def test_try_except_capture(self):
+        # exception tables no longer bail the code object to the legacy
+        # tier: the try body is a break region, prefix/suffix compile
+        @jit.to_static
+        def f(x):
+            a = x * 2.0
+            try:
+                b = float(a.sum().item())
+            except ValueError:
+                b = 0.0
+            return a + b
+
+        assert f._tier == "opcode"
+        x = paddle.ones([2])
+        np.testing.assert_allclose(f(x).numpy(), [6.0, 6.0])
+        np.testing.assert_allclose(f(x).numpy(), [6.0, 6.0])
+        plans = [p for ps in f._plans.values() for p in ps]
+        assert plans and len(plans[0].segments) >= 1
+
+    def test_exception_taken_path(self):
+        @jit.to_static
+        def f(x, flag):
+            try:
+                if flag:
+                    raise ValueError("x")
+                y = x + 1.0
+            except ValueError:
+                y = x - 1.0
+            return y
+
+        x = paddle.ones([2])
+        np.testing.assert_allclose(f(x, False).numpy(), [2.0, 2.0])
+        np.testing.assert_allclose(f(x, True).numpy(), [0.0, 0.0])
+
+    def test_layer_through_sot_matches_eager(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.rand([5, 4])
+        eager = net(x).numpy()
+        sf = jit.to_static(net)
+        np.testing.assert_allclose(sf(x).numpy(), eager, rtol=1e-5)
+        np.testing.assert_allclose(sf(x).numpy(), eager, rtol=1e-5)
+
+    def test_sot_stats_show_opcode_captures(self):
+        from paddle_tpu.jit.sot import sot_stats
+        before = sot_stats()["translations"]
+
+        @jit.to_static
+        def f(x):
+            return x.sum()
+
+        f(paddle.ones([3]))
+        assert sot_stats()["translations"] > before
