@@ -418,19 +418,22 @@ def fused_multi_transformer(
     """
     from ....core.tensor import Tensor
 
-    if gqa_group_size not in (-1, 0, None):
+    if beam_offset is not None:
         raise NotImplementedError(
-            "fused_multi_transformer: gqa_group_size packing is not "
-            "implemented; use block_multihead_attention for GQA decode")
-    if pre_caches is not None or beam_offset is not None:
+            "fused_multi_transformer: beam_offset unsupported")
+    if pre_caches is not None and time_step is not None:
         raise NotImplementedError(
-            "fused_multi_transformer: pre_caches/beam_offset unsupported")
+            "fused_multi_transformer: pre_caches apply to the context/"
+            "prefill phase; at decode time the prefix already lives in "
+            "cache_kvs (run prefill with pre_caches first)")
+    G = gqa_group_size if gqa_group_size and gqa_group_size > 0 else 0
     n_layers = len(qkv_weights)
     caches_in = cache_kvs if cache_kvs is not None else []
+    pre_in = pre_caches if pre_caches is not None else []
     dq = _dequant or (lambda w, kind, li: w)
 
     def impl(xa, lns, lnb, qkvw, qkvb, linw, linb, flns, flnb, f1w, f1b,
-             f2w, f2b, caches, rotary, tstep, mask, slens):
+             f2w, f2b, caches, pres, rotary, tstep, mask, slens):
         b, s, e = xa.shape
         norm = (lambda h, sc, bi: _rms(h, epsilon, sc)) \
             if norm_type == "rmsnorm" else \
@@ -442,14 +445,29 @@ def fused_multi_transformer(
             z = norm(h, lns[li], lnb[li] if lnb else None) \
                 if pre_layer_norm else h
             w = dq(qkvw[li], "qkv", li)
-            if not trans_qkvw:
-                # [E, 3, H, D] layout -> [3, H, D, E]
-                w = jnp.transpose(w, (1, 2, 3, 0))
-            nh, hd = w.shape[1], w.shape[2]
-            qkv = jnp.einsum("bse,thde->bsthd", z.astype(w.dtype), w)
-            if qkvb and qkvb[li] is not None:
-                qkv = qkv + qkvb[li][None, None]
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D]
+            if G:
+                # GQA packing (reference fused_transformer.py:1009 /
+                # infermeta/fusion.cc gqa branch): weight [H + 2G, D, E]
+                # — H query heads, then G key heads, then G value heads
+                if not trans_qkvw:
+                    w = jnp.transpose(w, (1, 2, 0))      # [E,H+2G,D] packed
+                ht, hd = w.shape[0], w.shape[1]
+                nh = ht - 2 * G
+                qkv = jnp.einsum("bse,hde->bshd", z.astype(w.dtype), w)
+                if qkvb and qkvb[li] is not None:
+                    qkv = qkv + qkvb[li][None, None]
+                q = qkv[:, :, :nh]                       # [B,S,H,D]
+                k = qkv[:, :, nh:nh + G]                 # [B,S,G,D]
+                v = qkv[:, :, nh + G:]
+            else:
+                if not trans_qkvw:
+                    # [E, 3, H, D] layout -> [3, H, D, E]
+                    w = jnp.transpose(w, (1, 2, 3, 0))
+                nh, hd = w.shape[1], w.shape[2]
+                qkv = jnp.einsum("bse,thde->bsthd", z.astype(w.dtype), w)
+                if qkvb and qkvb[li] is not None:
+                    qkv = qkv + qkvb[li][None, None]
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
             if rotary is not None:
                 cos = rotary[0][:, 0][:, :, None, :]    # [B, S_rope, 1, D]
                 sin = rotary[1][:, 0][:, :, None, :]
@@ -462,9 +480,14 @@ def fused_multi_transformer(
                 q, k = _apply_rope_pair(q, k, cos, sin,
                                         use_neox_rotary_style)
             scale = 1.0 / math.sqrt(hd)
+            # grouped-attention geometry: kv heads g, queries-per-group r
+            # (r == 1 and g == nh for MHA; the einsums below serve both —
+            # no jnp.repeat materialisation of KV on the decode hot path)
+            g_eff = G or nh
+            r = nh // g_eff
             if tstep is not None and caches:
                 # decode: append the new token, attend over the valid cache
-                cache = caches[li]                     # [2, B, H, S_max, D]
+                cache = caches[li]                 # [2, B, g, S_max, D]
                 t = jnp.asarray(tstep).reshape(())
                 smax = cache.shape[3]
                 if slens is not None:
@@ -475,48 +498,71 @@ def fused_multi_transformer(
                     bidx = jnp.arange(b)
                     kc = cache[0].at[bidx, :, ln].set(k[:, 0])
                     vc = cache[1].at[bidx, :, ln].set(v[:, 0])
-                    posm = (jnp.arange(smax)[None, None, None, :]
-                            <= ln[:, None, None, None])
+                    posm = (jnp.arange(smax)[None, None, None, None, :]
+                            <= ln[:, None, None, None, None])
                 else:
                     kc = jax.lax.dynamic_update_slice_in_dim(
                         cache[0], k.transpose(0, 2, 1, 3), t, axis=2)
                     vc = jax.lax.dynamic_update_slice_in_dim(
                         cache[1], v.transpose(0, 2, 1, 3), t, axis=2)
-                    posm = jnp.arange(smax)[None, None, None, :] <= t
+                    posm = jnp.arange(smax)[None, None, None, None, :] <= t
+                qg = q.reshape(b, s, g_eff, r, hd)
                 logits = jnp.einsum(
-                    "bshd,bhtd->bhst", q.astype(jnp.float32),
-                    kc.astype(jnp.float32)) * scale    # [B,H,1,S_max]
+                    "bsgrd,bgtd->bgrst", qg.astype(jnp.float32),
+                    kc.astype(jnp.float32)) * scale   # [B,g,r,1,S_max]
                 if mask is not None:
-                    logits = logits + mask.astype(logits.dtype)
+                    logits = logits + mask[:, :, None].astype(logits.dtype)
                 logits = jnp.where(posm, logits, NEG_INF_F)
                 p = jax.nn.softmax(logits, axis=-1)
-                ctx = jnp.einsum("bhst,bhtd->bshd", p,
-                                 vc.astype(jnp.float32)).astype(xa.dtype)
+                ctx = jnp.einsum("bgrst,bgtd->bsgrd", p,
+                                 vc.astype(jnp.float32)
+                                 ).reshape(b, s, nh, hd).astype(xa.dtype)
                 new_caches.append(jnp.stack([kc, vc]))
             else:
-                # context/prefill: causal attention, fill cache [0:S]
+                # context/prefill: causal attention, fill cache [0:S];
+                # pre_caches (prompt-prefix KV, reference pre_caches arg)
+                # prepend their keys — every new row attends to the whole
+                # prefix plus the causal part of the new tokens
+                kk, vv = k, v
+                s_pre = 0
+                if pres:
+                    pk, pv = pres[li][0], pres[li][1]  # [B, g, S_pre, D]
+                    s_pre = pk.shape[2]
+                    kk = jnp.concatenate(
+                        [pk.transpose(0, 2, 1, 3), k], axis=1)
+                    vv = jnp.concatenate(
+                        [pv.transpose(0, 2, 1, 3), v], axis=1)
+                qg = q.reshape(b, s, g_eff, r, hd)
                 logits = jnp.einsum(
-                    "bshd,bthd->bhst", q.astype(jnp.float32),
-                    k.astype(jnp.float32)) * scale
-                causal = jnp.tril(jnp.ones((s, s), bool))[None, None]
+                    "bsgrd,btgd->bgrst", qg.astype(jnp.float32),
+                    kk.astype(jnp.float32)) * scale   # [B,g,r,S,S_pre+S]
+                causal = jnp.tril(jnp.ones((s, s), bool))
+                if s_pre:
+                    causal = jnp.concatenate(
+                        [jnp.ones((s, s_pre), bool), causal], axis=1)
+                causal = causal[None, None, None]
                 if slens is not None:
                     # padded batch: keys at/after each row's true length
                     # must not contribute (reference seq_lens semantics)
                     valid = (jnp.arange(s)[None, :]
                              < jnp.asarray(slens).reshape(-1, 1))
-                    causal = causal & valid[:, None, None, :]
+                    if s_pre:
+                        valid = jnp.concatenate(
+                            [jnp.ones((b, s_pre), bool), valid], axis=1)
+                    causal = causal & valid[:, None, None, None, :]
                 if mask is not None:
-                    logits = logits + mask.astype(logits.dtype)
+                    logits = logits + mask[:, :, None].astype(logits.dtype)
                 logits = jnp.where(causal, logits, NEG_INF_F)
                 p = jax.nn.softmax(logits, axis=-1)
-                ctx = jnp.einsum("bhst,bthd->bshd", p,
-                                 v.astype(jnp.float32)).astype(xa.dtype)
+                ctx = jnp.einsum("bgrst,btgd->bsgrd", p,
+                                 vv.astype(jnp.float32)
+                                 ).reshape(b, s, nh, hd).astype(xa.dtype)
                 if caches:
                     cache = caches[li]
                     kc = jax.lax.dynamic_update_slice_in_dim(
-                        cache[0], k.transpose(0, 2, 1, 3), 0, axis=2)
+                        cache[0], kk.transpose(0, 2, 1, 3), 0, axis=2)
                     vc = jax.lax.dynamic_update_slice_in_dim(
-                        cache[1], v.transpose(0, 2, 1, 3), 0, axis=2)
+                        cache[1], vv.transpose(0, 2, 1, 3), 0, axis=2)
                     new_caches.append(jnp.stack([kc, vc]))
             attn = ctx.reshape(b, s, nh * hd) @ dq(linw[li], "lin", li)
             if linb and linb[li] is not None:
@@ -560,7 +606,8 @@ def fused_multi_transformer(
          list(linear_biases or []), list(ffn_ln_scales),
          list(ffn_ln_biases or []), list(ffn1_weights),
          list(ffn1_biases or []), list(ffn2_weights), list(ffn2_biases or []),
-         list(caches_in), rotary_embs, time_step, attn_mask, seq_lens),
+         list(caches_in), list(pre_in), rotary_embs, time_step, attn_mask,
+         seq_lens),
         {}, differentiable=bool(training) and not caches_in)
     outs = out if isinstance(out, tuple) else (out,)
     h = outs[0]

@@ -316,7 +316,8 @@ class FusedMultiTransformerEngine:
 
     def __init__(self, weights, num_heads, head_dim, max_seq_len=2048,
                  norm_type="layernorm", activation="gelu",
-                 use_neox_rotary_style=False, dtype="bfloat16"):
+                 use_neox_rotary_style=False, dtype="bfloat16",
+                 gqa_group_size=-1):
         import jax
         import jax.numpy as jnp
         from ..incubate.nn.functional import fused_multi_transformer
@@ -334,8 +335,13 @@ class FusedMultiTransformerEngine:
         self.max_seq_len = max_seq_len
         self._dtype = dtype
         self._n_layers = len(self._w["qkv_weights"])
+        # GQA (reference fused_transformer.py:1009): kv heads < q heads;
+        # the cache is allocated at the kv-head count
+        self._gqa = gqa_group_size if gqa_group_size and gqa_group_size > 0 \
+            else 0
         kw = dict(norm_type=norm_type, activation=activation,
-                  use_neox_rotary_style=use_neox_rotary_style)
+                  use_neox_rotary_style=use_neox_rotary_style,
+                  gqa_group_size=gqa_group_size)
 
         def lists(w):
             def g(name):
@@ -365,14 +371,29 @@ class FusedMultiTransformerEngine:
             logits = out.data[:, 0] @ w["lm_head"]
             return jnp.argmax(logits, -1), [c.data for c in cts]
 
+        def steps(w, caches, tok, t0, n):
+            # whole decode loop as ONE device program (lax.scan): a
+            # per-token jit call pays a host->device dispatch round trip
+            # each step — through a tunnel that RTT dwarfs the step itself
+            def body(carry, i):
+                tk, cs = carry
+                tk2, cs2 = step(w, cs, tk, t0 + i)
+                return (tk2, cs2), tk2
+
+            (_, caches_f), toks = jax.lax.scan(
+                body, (tok, caches), jnp.arange(n))
+            return toks, caches_f  # toks [n, B]
+
         import jax
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
         self._step = jax.jit(step, donate_argnums=(1,))
+        self._steps = jax.jit(steps, static_argnums=(4,),
+                              donate_argnums=(1,))
 
     def new_caches(self, batch_size, dtype=None):
         import jax.numpy as jnp
         dtype = dtype or self._dtype
-        kvh = self._w["qkv_weights"][0].shape[1]
+        kvh = self._gqa or self._w["qkv_weights"][0].shape[1]
         return [jnp.zeros((2, batch_size, kvh, self.max_seq_len,
                            self.head_dim), dtype)
                 for _ in range(self._n_layers)]
@@ -390,9 +411,19 @@ class FusedMultiTransformerEngine:
                 "shorten the request")
         caches = self.new_caches(b)
         tok, caches = self._prefill(self._w, caches, ids)
-        outs = [tok]
-        for i in range(max_new_tokens - 1):
-            tok, caches = self._step(self._w, caches, tok,
-                                     jnp.asarray(s + i, jnp.int32))
-            outs.append(tok)
-        return np.stack([np.asarray(t) for t in outs], axis=1)
+        if max_new_tokens == 1:
+            return np.asarray(tok)[:, None]
+        # bucket the scanned step count to powers of two so varying request
+        # lengths reuse a handful of compiled decode programs instead of
+        # recompiling the whole stack per distinct n (overshoot tokens are
+        # computed then dropped; the cache slots they touched are beyond
+        # the returned horizon and rewritten by any later decode)
+        need = max_new_tokens - 1
+        bucket = 1
+        while bucket < need:
+            bucket *= 2
+        bucket = min(bucket, self.max_seq_len - s)
+        toks, caches = self._steps(self._w, caches, tok,
+                                   jnp.asarray(s, jnp.int32), bucket)
+        return np.concatenate([np.asarray(tok)[:, None],
+                               np.asarray(toks).T[:, :need]], axis=1)
