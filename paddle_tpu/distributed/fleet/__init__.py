@@ -21,7 +21,7 @@ from .pipeline_parallel import (PipelineLayer, LayerDesc, SharedLayerDesc,
                                 PipelineParallel, ZeroBubblePipelineParallel,
                                 WeightGradStore, split_weight_grad)
 from .pipeline_schedule import (pipeline_1f1b, pipeline_gpipe,
-                                pipeline_interleaved,
+                                pipeline_interleaved, pipeline_zero_bubble,
                                 stack_stage_params)
 from .context_parallel import (ring_attention, ulysses_attention,
                                split_sequence, SegmentParallel)

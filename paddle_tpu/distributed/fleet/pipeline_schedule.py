@@ -18,7 +18,13 @@ matmuls into the bubbles.
 Layout contract: stage parameters are stacked on a leading axis sharded
 over the pipe axis — size n_stages (1F1B) or n_stages*v_chunks ordered by
 global stage id g = chunk*S + stage (interleaved). Microbatches are
-[n_micro, micro_bsz, ...], replicated; outputs likewise.
+[n_micro, micro_bsz, ...], replicated over pipe; outputs likewise.
+
+Hybrid composition: shard_map is manual ONLY over the pipe axis
+(axis_names={axis} — jax partial-auto mode), so stage params/activations
+may carry dp/fsdp/mp/sp GSPMD shardings and XLA partitions the per-stage
+compute over the remaining mesh axes (reference: 3D hybrid
+dp x mp x pp, test_parallel_api_with_llama_3d.py).
 """
 import jax
 import jax.numpy as jnp
@@ -76,6 +82,7 @@ def pipeline_gpipe(stage_fn, mesh, axis="pipe", checkpoint_stages=True):
             local, mesh=jm,
             in_specs=(P(axis), P()),
             out_specs=P(),
+            axis_names=frozenset({axis}),
             check_vma=False)(stacked_params, micro)
 
     return runner
@@ -173,17 +180,38 @@ def pipeline_1f1b(stage_fn, mesh, axis="pipe", checkpoint_stages=True):
             local, mesh=jm,
             in_specs=(P(axis), P(), P()),
             out_specs=(P(axis), P()),
+            axis_names=frozenset({axis}),
             check_vma=False)(stacked_params, micro, gouts)
 
     runner.defvjp(runner_fwd, runner_bwd)
     return runner
 
 
+def _vpp_decode(u, S, V):
+    """Invert the backward-tick equation for the interleaved schedule.
+
+    Forward of (micro m, chunk c) runs on its device at local iter
+    n(m, c) = (m % S) + S*c + S*V*(m // S); its backward lands on the same
+    device at global tick t with u = t - (S-1) - (S*V-1) + sid equal to
+    n(m, V-1) - c*S = S*V*(m//S) + S*(V-1-c) + (m % S). Decompose u into
+    (m, c); u uniquely identifies them (one backward op per device-tick).
+    """
+    import jax.numpy as jnp
+    q = u // (S * V)
+    w = u % (S * V)
+    c = (V - 1) - (w // S)
+    rem = w % S
+    m = S * q + rem
+    return m, c
+
+
 def pipeline_interleaved(stage_fn, mesh, v_chunks, axis="pipe",
                          checkpoint_stages=True):
-    """Circular / interleaved virtual-pipeline schedule (reference VPP).
+    """Circular / interleaved virtual-pipeline schedule (reference VPP,
+    fleet/meta_parallel/pipeline_parallel.py:1308) with an EXPLICIT
+    depth-bounded backward (round-4 verdict #6).
 
-    Each device owns v_chunks chunks: global stage g = chunk*S + device.
+    Forward: each device owns v_chunks chunks: global stage g = c*S + sid.
     Per-device iteration n processes microbatch m = (n % S) + S*(n//(S*V))
     on chunk c = (n // S) % V — microbatches stream in groups of S through
     all V laps before the next group enters, which keeps every device busy
@@ -193,11 +221,33 @@ def pipeline_interleaved(stage_fn, mesh, v_chunks, axis="pipe",
     at global tick t+1 what device d produced at tick t, including the
     S-1 -> 0 wrap between laps; device 0 overrides its input with a fresh
     microbatch exactly when its current chunk is 0.
+
+    Backward (custom_vjp, mirroring pipeline_1f1b): one combined scan
+    re-runs the forward stream and, behind it, the backward stream; the
+    saved stage input of global stage g lives exactly 2(S·V - 1 - g)
+    ticks, so a circular buffer of 2·S·V slots bounds live activations at
+    O(S·V) — the generalized 1F1B depth bound — regardless of n_micro.
+    (Before round 4 this schedule used the scan transpose: O(n_micro)
+    stashed activations.)
     """
     jm = mesh.jax_mesh
     n_stages = mesh.get_dim_size(axis)
 
-    def runner(stacked_params, micro):
+    def arrange(a):
+        # [S*V, ...] in global-stage order (g = c*S + d) -> row-block
+        # layout where device d's block holds its V chunks in order
+        S, V = n_stages, v_chunks
+        rest = a.shape[1:]
+        return a.reshape(V, S, *rest).swapaxes(0, 1).reshape(
+            S * V, *rest)
+
+    def unarrange(a):
+        S, V = n_stages, v_chunks
+        rest = a.shape[1:]
+        return a.reshape(S, V, *rest).swapaxes(0, 1).reshape(
+            S * V, *rest)
+
+    def fwd_runner(stacked_params, micro):
         def local(params, xs):
             # params: [v_chunks, ...] — this device's chunk stack
             n_micro = xs.shape[0]
@@ -237,21 +287,221 @@ def pipeline_interleaved(stage_fn, mesh, v_chunks, axis="pipe",
                                     jnp.arange(total))
             return _collect(outs, sid == S - 1, axis)
 
-        def arrange(a):
-            # [S*V, ...] in global-stage order (g = c*S + d) -> row-block
-            # layout where device d's block holds its V chunks in order
-            S, V = n_stages, v_chunks
-            rest = a.shape[1:]
-            return a.reshape(V, S, *rest).swapaxes(0, 1).reshape(
-                S * V, *rest)
-
         arranged = jax.tree_util.tree_map(arrange, stacked_params)
         return shard_map(
             local, mesh=jm,
             in_specs=(P(axis), P()),
             out_specs=P(),
+            axis_names=frozenset({axis}),
             check_vma=False)(arranged, micro)
 
+    @jax.custom_vjp
+    def runner(stacked_params, micro):
+        return fwd_runner(stacked_params, micro)
+
+    def runner_fwd(stacked_params, micro):
+        return fwd_runner(stacked_params, micro), (stacked_params, micro)
+
+    def runner_bwd(res, gouts):
+        stacked_params, micro = res
+        S, V = n_stages, v_chunks
+        SV = S * V
+
+        def local(params, xs, gy):
+            # params: [V, ...] this device's chunk stack
+            n_micro = xs.shape[0]
+            sid = lax.axis_index(axis)
+            B = 2 * SV                    # circular stage-input buffer
+            local_iters = ((n_micro + S - 1) // S) * S * V
+            # last backward tick: (m=n_micro-1, c=0) at sid 0
+            n_last = ((n_micro - 1) % S) + S * (V - 1) \
+                + SV * ((n_micro - 1) // S)
+            T = n_last + S - 1 + SV - 1 + 1
+
+            def idx_chunk(tree, c):
+                return jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_index_in_dim(a, c, 0,
+                                                       keepdims=False),
+                    tree)
+
+            dp0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+            def tick(carry, t):
+                fstate, bstate, xbuf, dp, dxs = carry
+                # ---- forward recompute stream --------------------------
+                n = t - sid
+                nc = jnp.clip(n, 0, local_iters - 1)
+                mf = (nc % S) + S * (nc // (S * V))
+                cf = (nc // S) % V
+                x_in = jnp.where((sid == 0) & (cf == 0),
+                                 xs[jnp.clip(mf, 0, n_micro - 1)], fstate)
+                xbuf = lax.dynamic_update_index_in_dim(
+                    xbuf, x_in, t % B, 0)
+                y = stage_fn(idx_chunk(params, cf), x_in)
+                fstate = lax.ppermute(
+                    y, axis, [(i, (i + 1) % S) for i in range(S)])
+                # ---- backward stream (2(SV-1-g) ticks behind) ----------
+                u = t - (S - 1) - (SV - 1) + sid
+                mb, cb = _vpp_decode(jnp.maximum(u, 0), S, V)
+                ab = (u >= 0) & (mb < n_micro)
+                mbc = jnp.clip(mb, 0, n_micro - 1)
+                cbc = jnp.clip(cb, 0, V - 1)
+                tf = (mbc % S) + S * cbc + SV * (mbc // S) + sid
+                x_saved = xbuf[tf % B]
+                p_cb = idx_chunk(params, cbc)
+                cot_in = jnp.where((sid == S - 1) & (cbc == V - 1),
+                                   gy[mbc], bstate)
+                _, vjp = jax.vjp(stage_fn, p_cb, x_saved)
+                dpi, dxi = vjp(cot_in)
+
+                def acc(a, g):
+                    cur = lax.dynamic_index_in_dim(a, cbc, 0,
+                                                   keepdims=False)
+                    return lax.dynamic_update_index_in_dim(
+                        a, cur + jnp.where(ab, g, jnp.zeros_like(g)),
+                        cbc, 0)
+
+                dp = jax.tree_util.tree_map(acc, dp, dpi)
+                dxs = lax.cond(
+                    ab & (sid == 0) & (cbc == 0),
+                    lambda d: lax.dynamic_update_index_in_dim(
+                        d, dxi, mbc, 0),
+                    lambda d: d, dxs)
+                bstate = lax.ppermute(
+                    dxi, axis, [((i + 1) % S, i) for i in range(S)])
+                return (fstate, bstate, xbuf, dp, dxs), None
+
+            z = jnp.zeros_like(xs[0])
+            xbuf0 = jnp.zeros((B,) + xs.shape[1:], xs.dtype)
+            dxs0 = jnp.zeros_like(xs)
+            (_, _, _, dp, dxs), _ = lax.scan(
+                tick, (z, z, xbuf0, dp0, dxs0), jnp.arange(T))
+            dxs = _collect(dxs, sid == 0, axis)
+            return dp, dxs
+
+        arranged = jax.tree_util.tree_map(arrange, stacked_params)
+        dp_blocks, dxs = shard_map(
+            local, mesh=jm,
+            in_specs=(P(axis), P(), P()),
+            out_specs=(P(axis), P()),
+            axis_names=frozenset({axis}),
+            check_vma=False)(arranged, micro, gouts)
+        return jax.tree_util.tree_map(unarrange, dp_blocks), dxs
+
+    runner.defvjp(runner_fwd, runner_bwd)
+    return runner
+
+
+def pipeline_zero_bubble(stage_fn, mesh, axis="pipe"):
+    """Compiled zero-bubble 1F1B (reference ZB-H1,
+    passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62): the
+    backward is split into the input-grad half B (on the cotangent
+    critical path — computed promptly, rides the reverse ring) and the
+    weight-grad half W (no downstream consumer — deferred LAG=S ticks
+    into the drain bubbles via a pending-pair queue).
+
+    SPMD note: the schedule runs as one masked scan (all devices execute
+    every tick), so the win is schedule-level, not mask-level: the W
+    matmul executed at tick t depends only on state from tick t-S, which
+    frees XLA's latency-hiding scheduler to overlap it with tick t's
+    ppermute transfers, and the tail ticks (forward/B streams masked off)
+    carry the queued W work — the reference's bubble-filling, expressed
+    compiler-side. Activation memory stays depth-bounded: the 2S-slot
+    1F1B input buffer plus an S+1-slot W queue, both O(S).
+    """
+    jm = mesh.jax_mesh
+    S = mesh.get_dim_size(axis)
+    fwd_runner = pipeline_gpipe(stage_fn, mesh, axis,
+                                checkpoint_stages=False)
+
+    @jax.custom_vjp
+    def runner(stacked_params, micro):
+        return fwd_runner(stacked_params, micro)
+
+    def runner_fwd(stacked_params, micro):
+        return fwd_runner(stacked_params, micro), (stacked_params, micro)
+
+    def runner_bwd(res, gouts):
+        stacked_params, micro = res
+
+        def local(params_stacked, xs, gy):
+            params = jax.tree_util.tree_map(lambda a: a[0], params_stacked)
+            n_micro = xs.shape[0]
+            sid = lax.axis_index(axis)
+            B = 2 * S
+            LAG = S                       # W deferred into the next bubble
+            WB = LAG + 1
+            T = n_micro + 2 * S - 2 + LAG
+
+            dp0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+            def tick(carry, t):
+                (fstate, bstate, xbuf, wxbuf, wcbuf, wmask, dp,
+                 dxs) = carry
+                # ---- forward recompute stream (as 1F1B) ---------------
+                mf = t - sid
+                x_in = jnp.where(sid == 0, xs[jnp.clip(mf, 0, n_micro - 1)],
+                                 fstate)
+                y = stage_fn(params, x_in)
+                xbuf = lax.dynamic_update_index_in_dim(
+                    xbuf, x_in, t % B, 0)
+                fstate = lax.ppermute(
+                    y, axis, [(i, (i + 1) % S) for i in range(S)])
+                # ---- B: input-grad stream (prompt) --------------------
+                mb = t - (2 * S - 2 - sid)
+                ab = (mb >= 0) & (mb < n_micro)
+                mbc = jnp.clip(mb, 0, n_micro - 1)
+                cot_in = jnp.where(sid == S - 1, gy[mbc], bstate)
+                x_saved = xbuf[(sid + mbc) % B]
+                _, vjp_x = jax.vjp(lambda xx: stage_fn(params, xx), x_saved)
+                (dxi,) = vjp_x(cot_in)
+                dxs = lax.cond(
+                    ab & (sid == 0),
+                    lambda d: lax.dynamic_update_index_in_dim(
+                        d, dxi, mbc, 0),
+                    lambda d: d, dxs)
+                bstate = lax.ppermute(
+                    dxi, axis, [((i + 1) % S, i) for i in range(S)])
+                # ---- W: weight-grad stream (deferred LAG ticks) -------
+                wxbuf = lax.dynamic_update_index_in_dim(
+                    wxbuf, x_saved, t % WB, 0)
+                wcbuf = lax.dynamic_update_index_in_dim(
+                    wcbuf, cot_in, t % WB, 0)
+                wmask = wmask.at[t % WB].set(ab)
+                tw = t - LAG
+                aw = (tw >= 0) & wmask[tw % WB]
+                xw = wxbuf[tw % WB]
+                cw = wcbuf[tw % WB]
+                _, vjp_w = jax.vjp(lambda pp_: stage_fn(pp_, xw), params)
+                (dpi,) = vjp_w(cw)
+                dp = jax.tree_util.tree_map(
+                    lambda acc, g: acc + jnp.where(aw, g,
+                                                   jnp.zeros_like(g)),
+                    dp, dpi)
+                return (fstate, bstate, xbuf, wxbuf, wcbuf, wmask, dp,
+                        dxs), None
+
+            z = jnp.zeros_like(xs[0])
+            xbuf0 = jnp.zeros((B,) + xs.shape[1:], xs.dtype)
+            wxbuf0 = jnp.zeros((WB,) + xs.shape[1:], xs.dtype)
+            wcbuf0 = jnp.zeros((WB,) + xs.shape[1:], xs.dtype)
+            wmask0 = jnp.zeros((WB,), bool)
+            dxs0 = jnp.zeros_like(xs)
+            (_, _, _, _, _, _, dp, dxs), _ = lax.scan(
+                tick, (z, z, xbuf0, wxbuf0, wcbuf0, wmask0, dp0, dxs0),
+                jnp.arange(T))
+            dp_stacked = jax.tree_util.tree_map(lambda a: a[None], dp)
+            dxs = _collect(dxs, sid == 0, axis)
+            return dp_stacked, dxs
+
+        return shard_map(
+            local, mesh=jm,
+            in_specs=(P(axis), P(), P()),
+            out_specs=(P(axis), P()),
+            axis_names=frozenset({axis}),
+            check_vma=False)(stacked_params, micro, gouts)
+
+    runner.defvjp(runner_fwd, runner_bwd)
     return runner
 
 

@@ -310,3 +310,141 @@ class Test1F1BMemoryBound:
         for a, b in zip(jax.tree_util.tree_leaves(gp),
                         jax.tree_util.tree_leaves(gref)):
             np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+class TestInterleavedExplicitBackward:
+    """Round-4 verdict #6: the interleaved VPP schedule has a custom_vjp
+    depth-bounded backward (2*S*V circular buffer) instead of the scan
+    transpose's O(n_micro) stash."""
+
+    @pytest.mark.parametrize("S,V,n_micro", [(2, 2, 4), (2, 3, 6), (4, 2, 8)])
+    def test_grad_matches_sequential(self, S, V, n_micro):
+        from paddle_tpu.distributed.fleet.pipeline_schedule import (
+            pipeline_interleaved)
+        rng = np.random.default_rng(11)
+        per_stage = _make_params(rng, S * V)
+        stacked = stack_stage_params(per_stage)
+        micro = jnp.asarray(
+            rng.standard_normal((n_micro, 2, D)).astype(np.float32))
+        mesh = _pipe_mesh(S)
+        run = pipeline_interleaved(_stage_fn, mesh, v_chunks=V)
+
+        def loss(p, x):
+            return (run(p, x) ** 2).sum()
+
+        def ref_loss(p, x):
+            per = [jax.tree_util.tree_map(lambda a: a[g], p)
+                   for g in range(S * V)]
+            return (_sequential(per, x) ** 2).sum()
+
+        np.testing.assert_allclose(float(loss(stacked, micro)),
+                                   float(ref_loss(stacked, micro)),
+                                   rtol=1e-4)
+        g = jax.jit(jax.grad(loss))(stacked, micro)
+        gref = jax.grad(ref_loss)(stacked, micro)
+        for a, b in zip(jax.tree_util.tree_leaves(g),
+                        jax.tree_util.tree_leaves(gref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_micro_grad_matches(self):
+        from paddle_tpu.distributed.fleet.pipeline_schedule import (
+            pipeline_interleaved)
+        S, V, n_micro = 2, 2, 4
+        rng = np.random.default_rng(12)
+        per_stage = _make_params(rng, S * V)
+        stacked = stack_stage_params(per_stage)
+        micro = jnp.asarray(
+            rng.standard_normal((n_micro, 2, D)).astype(np.float32))
+        mesh = _pipe_mesh(S)
+        run = pipeline_interleaved(_stage_fn, mesh, v_chunks=V)
+        g = jax.grad(lambda x: (run(stacked, x) ** 2).sum())(micro)
+
+        def ref(x):
+            per = [jax.tree_util.tree_map(lambda a: a[i], stacked)
+                   for i in range(S * V)]
+            return (_sequential(per, x) ** 2).sum()
+
+        gref = jax.grad(ref)(micro)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
+                                   rtol=2e-3, atol=2e-4)
+
+
+class TestCompiledZeroBubble:
+    """Round-4 verdict #6: compiled zero-bubble — dX prompt on the reverse
+    ring, dW deferred LAG ticks (reference pipeline_zero_bubble.py:62)."""
+
+    @pytest.mark.parametrize("S,n_micro", [(2, 4), (4, 8)])
+    def test_grads_match_1f1b(self, S, n_micro):
+        from paddle_tpu.distributed.fleet.pipeline_schedule import (
+            pipeline_1f1b, pipeline_zero_bubble)
+        rng = np.random.default_rng(13)
+        stacked = stack_stage_params(_make_params(rng, S))
+        micro = jnp.asarray(
+            rng.standard_normal((n_micro, 2, D)).astype(np.float32))
+        mesh = _pipe_mesh(S)
+        g_zb = jax.jit(jax.grad(lambda p: (
+            pipeline_zero_bubble(_stage_fn, mesh)(p, micro) ** 2).sum()))(
+                stacked)
+        g_ref = jax.jit(jax.grad(lambda p: (
+            pipeline_1f1b(_stage_fn, mesh)(p, micro) ** 2).sum()))(stacked)
+        for a, b in zip(jax.tree_util.tree_leaves(g_zb),
+                        jax.tree_util.tree_leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+
+class TestScheduleMemoryBounds:
+    """Extension of Test1F1BMemoryBound to the round-4 schedules: the
+    interleaved explicit backward and zero-bubble must also grow only
+    ~one micro-sized IO buffer per added microbatch."""
+
+    H = 256
+
+    def _temp_bytes(self, build, mesh, stacked, n_micro):
+        def big_stage(p, x):
+            return jnp.tanh(x @ p["w"]) + x
+
+        run = build(big_stage, mesh)
+        micro = jnp.zeros((n_micro, 2, self.H), jnp.float32)
+        c = jax.jit(jax.grad(lambda p, x: (run(p, x) ** 2).sum())).lower(
+            stacked, micro).compile()
+        ma = c.memory_analysis()
+        if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+            pytest.skip("no memory analysis on this backend")
+        return ma.temp_size_in_bytes
+
+    def _growth(self, build, mesh, stacked):
+        n1, n2 = 8, 32
+        return (self._temp_bytes(build, mesh, stacked, n2)
+                - self._temp_bytes(build, mesh, stacked, n1)) / (n2 - n1)
+
+    def test_interleaved_depth_bounded(self):
+        from paddle_tpu.distributed.fleet.pipeline_schedule import (
+            pipeline_interleaved)
+        S, V = 2, 2
+        mesh = _pipe_mesh(S)
+        rng = np.random.default_rng(0)
+        stacked = stack_stage_params(
+            [{"w": jnp.asarray(0.1 * rng.standard_normal(
+                (self.H, self.H)).astype(np.float32))}
+             for _ in range(S * V)])
+        micro_bytes = 2 * self.H * 4
+        growth = self._growth(
+            lambda fn, m: pipeline_interleaved(fn, m, v_chunks=V),
+            mesh, stacked)
+        assert growth <= 1.5 * micro_bytes, (growth, micro_bytes)
+
+    def test_zero_bubble_depth_bounded(self):
+        from paddle_tpu.distributed.fleet.pipeline_schedule import (
+            pipeline_zero_bubble)
+        S = 4
+        mesh = _pipe_mesh(S)
+        rng = np.random.default_rng(0)
+        stacked = stack_stage_params(
+            [{"w": jnp.asarray(0.1 * rng.standard_normal(
+                (self.H, self.H)).astype(np.float32))}
+             for _ in range(S)])
+        micro_bytes = 2 * self.H * 4
+        growth = self._growth(pipeline_zero_bubble, mesh, stacked)
+        assert growth <= 1.5 * micro_bytes, (growth, micro_bytes)
