@@ -48,8 +48,11 @@ class SelectedRows:
         summed = jax.ops.segment_sum(self.values, inv,
                                      num_segments=uniq.shape[0])
         keep = uniq < self.height
+        # mask must broadcast over ANY value rank (1D scalar rows, >2D
+        # grads like [n, d1, d2]) — keep[:, None] only fits 2D
+        kmask = keep.reshape((-1,) + (1,) * (summed.ndim - 1))
         return SelectedRows(jnp.where(keep, uniq, 0),
-                            jnp.where(keep[:, None], summed, 0),
+                            jnp.where(kmask, summed, 0),
                             self.height)
 
     def to_dense(self):

@@ -13,3 +13,4 @@ from ..placement import Shard, Replicate, Partial
 from .api import (ShardingStage1, ShardingStage2, ShardingStage3,
                   shard_optimizer, shard_dataloader, to_static, DistModel,
                   Strategy, Engine)
+from .planner import Plan, CostModel, Planner

@@ -127,13 +127,45 @@ class DistModel:
     inputs and let XLA place every collective."""
 
     def __init__(self, layer, loader, loss=None, optimizer=None,
-                 strategy=None, metrics=None):
+                 strategy=None, metrics=None, plan=None):
         self.network = layer
         self._loader = loader
         self._loss = loss
         self._optimizer = optimizer
         self._mode = "train"
         self._train_fn = None
+        # plan step (reference Engine._build -> plan -> partition,
+        # static/engine.py:1058,669): with an active mesh, derive the
+        # sharding plan from the parameter inventory (no user markers) and
+        # partition the layer's params per the plan
+        self._plan = plan
+        mesh = get_mesh()
+        user_marked = layer is not None and any(
+            _get_meta(p) is not None for _, p in layer.named_parameters())
+        if mesh is not None and layer is not None and \
+                (plan is not None or not user_marked):
+            # reference semantics: the Engine plans only unannotated
+            # programs — explicit shard_tensor markers win over auto-plan
+            try:
+                if self._plan is None:
+                    from .planner import Planner
+                    cfg = getattr(layer, "config", None)
+                    axes = {nm: mesh.get_dim_size(nm)
+                            for nm in mesh.dim_names}
+                    self._plan = Planner(layer).plan(
+                        axes,
+                        hidden=getattr(cfg, "hidden_size", None),
+                        n_layers=getattr(cfg, "num_hidden_layers", None),
+                        seq=getattr(cfg, "max_position_embeddings", 1024)
+                        or 1024)
+                self._plan.shard_layer(layer, mesh)
+            except Exception as e:  # planning is best-effort off-mesh
+                import warnings
+                warnings.warn(f"auto-parallel planning skipped: {e!r}")
+
+    @property
+    def plan(self):
+        return self._plan
 
     def train(self):
         self._mode = "train"
@@ -147,18 +179,21 @@ class DistModel:
         if self._mode == "train":
             from ...jit import to_static
             if self._train_fn is None:
-                network, loss = self.network, self._loss
+                network, loss_fn = self.network, self._loss
 
                 def fwd(*a):
                     out = network(*a[:-1])
-                    return loss(out, a[-1])
+                    return loss_fn(out, a[-1])
+                # NB: fwd closes over loss_fn; the result below must NOT
+                # reuse that name — the SOT tier re-executes fwd's Python,
+                # so clobbering the closure cell corrupts later calls
                 self._train_fn = to_static(fwd)
-            loss = self._train_fn(*args)
-            loss.backward()
+            loss_val = self._train_fn(*args)
+            loss_val.backward()
             if self._optimizer is not None:
                 self._optimizer.step()
                 self._optimizer.clear_grad()
-            return loss
+            return loss_val
         return self.network(*args)
 
     def state_dict(self, *a, **k):

@@ -122,7 +122,18 @@ def _recv_msg(sock):
 # -- shared-secret handshake -------------------------------------------------
 
 def _default_secret():
-    return os.environ.get("PADDLE_PS_SECRET", "")
+    """PADDLE_PS_SECRET, or a random per-process secret. HMAC with an
+    empty key is computable by any peer — an unset env var must not
+    silently disable the handshake; clients of a bare PsServer must be
+    handed server.secret out of band (PsService already does this)."""
+    s = os.environ.get("PADDLE_PS_SECRET", "")
+    if not s:
+        import warnings
+        warnings.warn(
+            "PADDLE_PS_SECRET is unset; generating a random per-process "
+            "secret — distribute it to clients via PsServer.secret")
+        s = _secrets.token_hex(16)
+    return s
 
 
 def _server_handshake(conn, secret):
@@ -328,10 +339,18 @@ class PsClient:
     """Worker-side client (reference brpc_ps_client.cc role)."""
 
     def __init__(self, host, port, secret=None):
+        if secret is None:
+            # a client-side random fallback could never match the server's
+            # secret; require the real one (env var or PsServer.secret)
+            secret = os.environ.get("PADDLE_PS_SECRET", "")
+            if not secret:
+                raise ValueError(
+                    "PsClient needs the server's shared secret: set "
+                    "PADDLE_PS_SECRET on both sides or pass "
+                    "secret=server.secret")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.connect((host, port))
-        _client_handshake(self._sock,
-                          _default_secret() if secret is None else secret)
+        _client_handshake(self._sock, secret)
         self._lock = threading.Lock()
 
     def _call(self, **req):

@@ -74,7 +74,16 @@ def embedding(x, weight, padding_idx=None, sparse=False):
         return out
 
     from ...core import autograd as _ag
-    if sparse and _ag.is_grad_enabled() and not weight.stop_gradient \
+    from ...core import dispatch as _dispatch
+    # the SelectedRows fast path bypasses apply_op (its vjp returns a
+    # sparse object the dispatch vjp contract can't express), which makes
+    # the op invisible to graph capture — under an active SOT/static
+    # recorder that means a stale pinned output on replay. Capture planes
+    # therefore get the dense path (correct, just dense grads).
+    capture_active = (_dispatch._sir_recorder is not None
+                      or _dispatch._static_recorder is not None)
+    if sparse and not capture_active and _ag.is_grad_enabled() \
+            and not weight.stop_gradient \
             and not isinstance(weight.data, jax.core.Tracer):
         # sparse=True: the weight gradient is a SelectedRows (rows = the
         # looked-up ids, values = output cotangent rows) instead of a dense
@@ -100,6 +109,8 @@ def embedding(x, weight, padding_idx=None, sparse=False):
         t = Tensor(out, stop_gradient=False)
         t._node = node
         t._out_idx = 0
+        for _l in list(_dispatch._op_listeners):
+            _l("embedding_sparse", 2, t)
         return t
     return _op("embedding", impl, x, weight)
 

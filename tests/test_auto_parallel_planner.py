@@ -1,0 +1,124 @@
+"""Static auto-parallel planner tests (round-4 verdict #5; reference
+pipeline auto_parallel/static/engine.py:669,1058 build->plan->partition,
+cost model under static/cost/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.auto_parallel.planner import (
+    Plan, CostModel, Planner, classify_param, STRATEGIES)
+
+
+def _llama():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      dtype="float32")
+    return LlamaForCausalLM(cfg)
+
+
+class TestClassify:
+    def test_roles(self):
+        assert classify_param("llama.layers.0.self_attn.q_proj.weight",
+                              (64, 64)) == "col"
+        assert classify_param("llama.layers.0.self_attn.o_proj.weight",
+                              (64, 64)) == "row"
+        assert classify_param("llama.embed_tokens.weight", (256, 64)) == \
+            "embed"
+        assert classify_param("lm_head.weight", (64, 256)) == "head"
+        assert classify_param("llama.norm.weight", (64,)) == "small"
+
+
+class TestPlanner:
+    def test_picks_dp_when_memory_ample(self):
+        """On a dp-only mesh with plenty of HBM the cheapest-comm feasible
+        strategy is plain DP (grad allreduce only)."""
+        model = _llama()
+        p = Planner(model, cost_model=CostModel(hbm_bytes=1e12))
+        plan = p.plan({"dp": 8}, hidden=64, n_layers=2, seq=64)
+        assert plan.strategy == "dp"
+        # dp plan replicates every param
+        assert all(all(s is None for s in spec)
+                   for spec in plan.placements.values())
+
+    def test_picks_sharded_when_memory_tight(self):
+        """With a tight budget, replication is infeasible and the planner
+        must pick a param-sharding strategy — a DIFFERENT choice than the
+        ample-memory case (>=2 strategies exercised, verdict done-bar)."""
+        model = _llama()
+        inv = [(n, tuple(p.shape), str(p.dtype))
+               for n, p in model.named_parameters()]
+        total = sum(int(np.prod(s)) * 4 for _, s, _ in inv)
+        # budget below the replicated footprint (params + 3x fp32 opt)
+        cm = CostModel(hbm_bytes=total * 2.5)
+        plan = Planner(model, cost_model=cm).plan(
+            {"dp": 1, "fsdp": 4, "mp": 2}, hidden=64, n_layers=2, seq=64)
+        assert plan.strategy in ("fsdp", "mp", "mp_fsdp")
+        assert any(any(s is not None for s in spec)
+                   for spec in plan.placements.values())
+        # the cost report carries every candidate for inspection
+        assert set(plan.cost["candidates"]) == set(STRATEGIES)
+
+    def test_infeasible_raises(self):
+        model = _llama()
+        with pytest.raises(MemoryError):
+            Planner(model, cost_model=CostModel(hbm_bytes=1)).plan(
+                {"dp": 2}, hidden=64, n_layers=2)
+
+    def test_col_row_specs_on_mp(self):
+        model = _llama()
+        plan = Planner(model, cost_model=CostModel(hbm_bytes=1e12)).plan(
+            {"mp": 2}, hidden=64, n_layers=2, candidates=["mp"])
+        q = plan.placements["llama.layers.0.self_attn.q_proj.weight"]
+        o = plan.placements["llama.layers.0.self_attn.o_proj.weight"]
+        assert q == (None, "mp")      # column-parallel: split outputs
+        assert o == ("mp", None)      # row-parallel: split inputs
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = _llama()
+        plan = Planner(model, cost_model=CostModel(hbm_bytes=1e12)).plan(
+            {"mp": 2, "dp": 4}, hidden=64, n_layers=2)
+        path = str(tmp_path / "plan.json")
+        plan.save(path)
+        loaded = Plan.load(path)
+        assert loaded.strategy == plan.strategy
+        assert loaded.placements == plan.placements
+        assert loaded.mesh_axes == plan.mesh_axes
+
+
+class TestDistModelPlanning:
+    def test_to_static_plans_and_trains_without_markers(self):
+        """dist.to_static on an unmarked model under an active mesh derives
+        a plan, partitions the params, and a train step runs (reference
+        test_to_static-class behavior)."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.mesh import ProcessMesh, set_mesh
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4),
+                           dim_names=["dp", "mp"])
+        set_mesh(mesh)
+        try:
+            model = _llama()
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=model.parameters())
+
+            def loss_fn(logits, labels):
+                v = logits.shape[-1]
+                return paddle.nn.functional.cross_entropy(
+                    logits.reshape([-1, v]), labels.reshape([-1]))
+
+            dm = dist.to_static(model, None, loss_fn, opt)
+            assert dm.plan is not None
+            # some parameter actually got a sharded placement or the plan
+            # is explicit about full replication (dp)
+            assert dm.plan.strategy in STRATEGIES
+            rng = np.random.default_rng(0)
+            x = paddle.to_tensor(rng.integers(0, 256, (4, 16)).astype(
+                np.int64))
+            y = paddle.to_tensor(rng.integers(0, 256, (4, 16)).astype(
+                np.int64))
+            dm.train()
+            loss = dm(x, y)
+            assert np.isfinite(float(loss.numpy()))
+        finally:
+            set_mesh(None)
