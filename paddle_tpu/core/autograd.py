@@ -291,6 +291,38 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             node.vjp_fn = None
             node.parents = []
             node.impl = node.treedef = node.plain = node.diff_idx = None
+    # end-of-backward callbacks (reference: the reducer's finalize step,
+    # fluid/distributed/collective/reducer.cc — flush partial buckets,
+    # handle find_unused_parameters). Suppressed for grad()-style walks
+    # (_only_leaves set): grad() must not touch param .grad, so reducer
+    # machinery stays out of it entirely.
+    if _only_leaves is None:
+        for fh in list(_backward_final_hooks):
+            fh()
+
+
+_backward_final_hooks = []
+
+
+def in_grad_only_walk():
+    """True while a grad()-style walk (_only_leaves) is running — reducer
+    hooks consult this to pass gradients through untouched."""
+    return _grad_only_depth[0] > 0
+
+
+_grad_only_depth = [0]
+
+
+def add_backward_final_hook(fn):
+    """Register fn() to run after every backward() completes; returns a
+    removal handle. Used by the DP EagerReducer to flush tail buckets."""
+    _backward_final_hooks.append(fn)
+
+    class _H:
+        def remove(self):
+            if fn in _backward_final_hooks:
+                _backward_final_hooks.remove(fn)
+    return _H()
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
@@ -320,6 +352,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         t._retain_grad = True
         t.stop_gradient = False
     try:
+        _grad_only_depth[0] += 1
         backward(outputs, grad_tensors=grad_outputs, retain_graph=retain_graph,
                  create_graph=create_graph,
                  _only_leaves={id(t) for t in inputs})
@@ -334,6 +367,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             else:
                 result.append(t.grad)
     finally:
+        _grad_only_depth[0] -= 1
         for (t, g, r, s) in stash:
             t.grad = g
             t._retain_grad = r

@@ -90,6 +90,33 @@ class DataParallel(nn.Layer):
             mesh = get_mesh()
         self._mesh = mesh
         self._axis = mesh.dim_names[0]
+        # bucketed grad reducer (reference EagerReducer, reducer.h:88):
+        # fuses pending Partial reductions per size-bucket, provides
+        # no_sync gradient accumulation and unused-param detection
+        from .fleet.reducer import EagerReducer
+        self._reducer = EagerReducer(
+            layers.parameters(), mesh=mesh, axis=self._axis,
+            comm_buffer_size_mb=comm_buffer_size,
+            find_unused_parameters=find_unused_parameters)
+
+    def no_sync(self):
+        """Context manager suppressing grad reduction (reference
+        DataParallel.no_sync): backward inside accumulates locally."""
+        return self._reducer.no_sync()
+
+    def cleanup(self):
+        """Detach the reducer's tape hooks (per-param + backward-final).
+        Also runs on GC — the reducer is weakly referenced by its hooks,
+        so dropping the DataParallel wrapper is enough in practice."""
+        if getattr(self, "_reducer", None) is not None:
+            self._reducer.remove()
+            self._reducer = None
+
+    def __del__(self):
+        try:
+            self.cleanup()
+        except Exception:
+            pass
 
     def forward(self, *inputs, **kwargs):
         from .dtensor import shard_tensor

@@ -406,8 +406,12 @@ class PsService:
     role of wiring server + workers)."""
 
     def __init__(self):
-        # per-service random secret unless the deployment pins one via env
-        secret = _default_secret() or _secrets.token_hex(16)
+        # per-service random secret unless the deployment pins one via env;
+        # generated HERE (not via _default_secret, whose unset-env warning
+        # is for bare PsServer deployments — this service hands the secret
+        # to its own clients, so an unset env var is the normal case)
+        secret = os.environ.get("PADDLE_PS_SECRET", "") or \
+            _secrets.token_hex(16)
         self.server = PsServer(secret=secret)
         self._thread = None
 

@@ -37,13 +37,35 @@ def _sq():
                  np.float32))
 
 
-def _tiny(name, ann=None):
-    """Synthesize one argument value from a parameter name."""
+_INT_SQ = [[1, 2, 0], [2, 1, 2], [0, 1, 1]]
+
+
+def _tiny(name, ann=None, flavor="float"):
+    """Synthesize one argument value from a parameter name. `flavor`
+    selects the dtype family for tensor-valued args (the retry ladder in
+    _invoke walks float -> int -> bool for dtype-constrained ops)."""
     n = name.lower()
     if n in ("tensors", "xs", "ys"):
         return [_sq(), _sq()]
+    if n in ("mask", "condition", "cond"):
+        import numpy as _np
+        return paddle.to_tensor(_np.array(
+            [[True, False, True]] * 3))
+    if n in ("repeats", "repeat"):
+        return 2
+    if n in ("stride", "strides"):
+        return [3, 1]
+    if n in ("indices", "index", "ids", "idx") and flavor == "alongaxis":
+        import numpy as _np
+        return paddle.to_tensor(_np.array(_INT_SQ, _np.int64))
     if n in ("x", "input", "a", "tensor", "t", "value", "y", "other", "b",
              "z", "inputs", "grad", "out", "weight", "vec", "arr", "obj"):
+        if flavor == "int" or flavor == "alongaxis" and n == "value":
+            import numpy as _np
+            return paddle.to_tensor(_np.array(_INT_SQ, _np.int32))
+        if flavor == "bool":
+            import numpy as _np
+            return paddle.to_tensor(_np.array(_INT_SQ, _np.int32) > 0)
         return _sq()
     if n in ("label", "labels", "target", "tgt"):
         return paddle.to_tensor(np.array([1, 0], np.int64))
@@ -74,10 +96,63 @@ def _tiny(name, ann=None):
         return 0.5
     if n in ("perm",):
         return [1, 0]
+    if flavor == "int":
+        import numpy as _np
+        return paddle.to_tensor(_np.array(_INT_SQ, _np.int32))
     return _sq()
 
 
-def _synthesize_call(fn, bound_self=None):
+# per-callable synthesis overrides where generic name rules can't work
+# (shape contracts, value ranges); keyed by callable __name__
+_ARG_OVERRIDES = {
+    "view": {"shape_or_dtype": [9], "shape": [9]},
+    "view_as": {"other": "SQ"},
+    "unflatten": {"axis": 0, "shape": [1, 3]},
+    "as_strided": {"shape": [2, 2], "stride": [3, 1]},
+    "unfold": {"axis": 0, "size": 2, "step": 1},
+    "repeat_interleave": {"repeats": 2},
+    "moveaxis": {"source": 0, "destination": 1},
+    "stft": {"n_fft": 4},
+    "lu_unpack": {"y": "INTVEC"},
+    "bucketize": {"sorted_sequence": "SORTED"},
+    "vander": {"n": 3},
+    "select_scatter": {"values": "ROW", "axis": 0, "index": 0},
+    "diagonal_scatter": {"y": "DIAG"},
+    "reshape": {"shape": [9]},
+    "reshape_": {"shape": [9]},
+    "expand": {"shape": [3, 3]},
+    "broadcast_to": {"shape": [3, 3]},
+    "broadcast_shape": {"x_shape": [3, 3], "y_shape": [3, 3]},
+    "split": {"num_or_sections": 3},
+    "tensor_split": {"num_or_indices": 3},
+    "chunk": {"chunks": 3},
+    "hsplit": {"num_or_indices": 3},
+    "vsplit": {"num_or_indices": 3},
+    "roll": {"shifts": 1},
+    "slice": {"axes": [0], "starts": [0], "ends": [2]},
+    "strided_slice": {"axes": [0], "starts": [0], "ends": [2],
+                      "strides": [1]},
+    "index_add": {"index": "IDX3", "axis": 0},
+    "index_add_": {"index": "IDX3", "axis": 0},
+    "renorm": {"p": 2.0, "axis": 0, "max_norm": 1.0},
+    "renorm_": {"p": 2.0, "axis": 0, "max_norm": 1.0},
+    "reduce_as": {"target": "ROW"},
+}
+
+_SPECIALS = {
+    "SQ": lambda: _sq(),
+    "ROW": lambda: paddle.to_tensor(
+        np.array([0.1, 0.2, 0.3], np.float32)),
+    "DIAG": lambda: paddle.to_tensor(
+        np.array([0.1, 0.2, 0.3], np.float32)),
+    "SORTED": lambda: paddle.to_tensor(
+        np.array([0.0, 0.5, 1.0], np.float32)),
+    "INTVEC": lambda: paddle.to_tensor(np.array([1, 2, 3], np.int32)),
+    "IDX3": lambda: paddle.to_tensor(np.array([0, 1, 2], np.int64)),
+}
+
+
+def _synthesize_call(fn, bound_self=None, flavor="float"):
     """Build (args, kwargs) for fn from its signature; raises ValueError
     when the signature cannot be introspected. Registry-generated wrappers
     hide the real signature behind *args — introspect the bound impl."""
@@ -109,28 +184,47 @@ def _synthesize_call(fn, bound_self=None):
             break
         if p.default is not inspect.Parameter.empty:
             break  # defaults from here on
-        args.append(_tiny(p.name, p.annotation))
+        ov = _ARG_OVERRIDES.get(name, {})
+        if p.name in ov:
+            v = ov[p.name]
+            args.append(_SPECIALS[v]() if isinstance(v, str) and
+                        v in _SPECIALS else v)
+        else:
+            args.append(_tiny(p.name, p.annotation, flavor))
     return args, {}
 
 
-def _invoke(fn, bound_self=None):
-    """-> outcome string: 'ok' | 'skip' | 'notimpl' | 'error'."""
-    try:
-        args, kwargs = _synthesize_call(fn)
-    except ValueError:
-        return "skip"
-    try:
-        fn(*args, **kwargs)
-        return "ok"
-    except NotImplementedError:
-        return "notimpl"
-    except (TypeError, ValueError, AttributeError, IndexError, KeyError,
-            RuntimeError, ZeroDivisionError, OverflowError, OSError,
-            AssertionError, StopIteration):
-        # arg synthesis missed the contract — not evidence of a stub
-        return "error"
-    except Exception:
-        return "error"
+def _invoke(fn, bound_self=None, receiver=None):
+    """-> outcome string: 'ok' | 'skip' | 'notimpl' | 'error'.
+
+    Walks a dtype-flavor ladder (float -> int -> bool -> along-axis int
+    indices): dtype-constrained ops (bitwise, shifts, gather-scatter)
+    execute with the flavor their contract wants. `receiver` rebinds the
+    method to a FRESH tensor per attempt so inplace ops cannot corrupt
+    later attempts."""
+    name = getattr(fn, "__name__", "")
+    last = "skip"
+    for flavor in ("float", "int", "bool", "alongaxis"):
+        target = fn
+        if receiver is not None:
+            base = receiver(flavor)
+            target = getattr(base, name, fn)
+        try:
+            args, kwargs = _synthesize_call(target, flavor=flavor)
+        except ValueError:
+            return "skip"
+        try:
+            target(*args, **kwargs)
+            return "ok"
+        except NotImplementedError:
+            return "notimpl"
+        except (TypeError, ValueError, AttributeError, IndexError, KeyError,
+                RuntimeError, ZeroDivisionError, OverflowError, OSError,
+                AssertionError, StopIteration):
+            last = "error"
+        except Exception:
+            last = "error"
+    return last
 
 
 def _reference_method_names():
@@ -147,7 +241,18 @@ def _reference_method_names():
 def test_tensor_methods_execute_not_just_exist():
     names = _reference_method_names()
     assert names, "reference method list not found"
-    t = _sq()
+
+    def receiver(flavor):
+        # a FRESH tensor per attempt: inplace methods (add_, bitwise_or_,
+        # reshape_) otherwise corrupt the shared receiver and poison every
+        # later method's invocation (the pre-round-4 sweep did exactly
+        # that, capping the measured ok-rate at ~0.62)
+        if flavor == "int":
+            return paddle.to_tensor(np.array(_INT_SQ, np.int32))
+        if flavor == "bool":
+            return paddle.to_tensor(np.array(_INT_SQ, np.int32) > 0)
+        return _sq()
+
     outcomes = {}
     notimpl = []
     for n in names:
@@ -155,11 +260,11 @@ def test_tensor_methods_execute_not_just_exist():
         if m is None:
             outcomes[n] = "missing"
             continue
-        bound = getattr(t, n)
+        bound = getattr(_sq(), n)
         if not callable(bound):
             outcomes[n] = "ok"  # property surface
             continue
-        outcomes[n] = _invoke(bound)
+        outcomes[n] = _invoke(bound, receiver=receiver)
         if outcomes[n] == "notimpl":
             notimpl.append(n)
     counts = {}
@@ -169,9 +274,9 @@ def test_tensor_methods_execute_not_just_exist():
     assert not notimpl, (
         f"Tensor methods raising NotImplementedError (stubs): {notimpl}")
     assert counts.get("missing", 0) == 0
-    # behavior coverage floor: the majority of the 394-method surface must
-    # actually execute with generic tiny args
-    assert ok_rate >= 0.55, (ok_rate, counts)
+    # behavior coverage floor (round-4 verdict #8): measured 0.96 with the
+    # fresh-receiver + dtype-flavor harness; gate at 0.85
+    assert ok_rate >= 0.85, (ok_rate, counts)
 
 
 def test_top_level_callables_no_stubs():
@@ -193,7 +298,8 @@ def test_top_level_callables_no_stubs():
                 outcomes[r] = outcomes.get(r, 0) + 1
     assert not notimpl, f"top-level stubs: {notimpl}"
     total = sum(outcomes.values())
-    assert outcomes["ok"] / max(1, total) >= 0.4, outcomes
+    # measured 0.91 with the flavor ladder; gate at 0.7 (verdict #8)
+    assert outcomes["ok"] / max(1, total) >= 0.7, outcomes
 
 
 def test_nn_functional_no_stubs():

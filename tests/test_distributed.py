@@ -450,3 +450,118 @@ class TestAutoParallelEngine:
         assert res["acc"] > 0.7
         preds = eng.predict([(paddle.to_tensor(X[:8]),)])
         assert preds[0].shape == [8, 2]
+
+
+class TestEagerReducer:
+    """Round-4 verdict #10: bucketed DP gradient reducer (reference
+    EagerReducer, fluid/distributed/collective/reducer.h:88)."""
+
+    def _mesh(self):
+        from paddle_tpu.distributed.mesh import ProcessMesh
+        return ProcessMesh(np.arange(8), dim_names=["dp"])
+
+    def test_bucketed_fused_reduction_counts(self):
+        """Many params + tiny buffer -> multiple buckets; each bucket's
+        pending Partial grads materialize in ONE fused reduction, so comm
+        calls == n_buckets, not n_params."""
+        from paddle_tpu.distributed.fleet.reducer import EagerReducer
+        from paddle_tpu.distributed.dtensor import shard_tensor
+        from paddle_tpu.distributed.placement import Partial
+        mesh = self._mesh()
+        params = [nn.Linear(16, 16).weight for _ in range(6)]
+        for p in params:
+            p.stop_gradient = False
+        # 16*16*4 = 1KB per param; 2.5KB buffer -> 2 params per bucket
+        red = EagerReducer(params, mesh=mesh, axis="dp",
+                           comm_buffer_size_mb=2.5 / 1024)
+        try:
+            assert len(red.buckets) == 3
+            rng = np.random.default_rng(0)
+            gvals = {}
+            # fire hooks in reverse param order (autograd order); the
+            # reducer owns every deposit (hooks return float0)
+            for p in reversed(params):
+                g = rng.standard_normal((16, 16)).astype(np.float32)
+                gvals[id(p)] = g
+                pg = shard_tensor(paddle.to_tensor(g), mesh, [Partial()])
+                red._grad_ready(p, red._bucket_of[id(p)], pg)
+            red._on_backward_end()
+            assert red.stats["allreduce_calls"] == 3  # one per bucket
+            # values: sum-materialized partial == the original grad
+            for p in params:
+                np.testing.assert_allclose(p.grad.numpy(), gvals[id(p)],
+                                           rtol=1e-6)
+        finally:
+            red.remove()
+
+    def test_flush_overlaps_remaining_backward(self):
+        """The first bucket's fused reduce is DISPATCHED before later
+        params' grads arrive (events interleave with hook firings)."""
+        from paddle_tpu.distributed.fleet.reducer import EagerReducer
+        from paddle_tpu.distributed.dtensor import shard_tensor
+        from paddle_tpu.distributed.placement import Partial
+        mesh = self._mesh()
+        params = [nn.Linear(16, 16).weight for _ in range(4)]
+        red = EagerReducer(params, mesh=mesh, axis="dp",
+                           comm_buffer_size_mb=2.5 / 1024)
+        try:
+            fired = []
+            rng = np.random.default_rng(1)
+            for i, p in enumerate(reversed(params)):
+                g = rng.standard_normal((16, 16)).astype(np.float32)
+                pg = shard_tensor(paddle.to_tensor(g), mesh, [Partial()])
+                red._grad_ready(p, red._bucket_of[id(p)], pg)
+                fired.append(i)
+                if i == 1:
+                    # after 2 of 4 hooks: bucket 0 already reduced while
+                    # params 2,3 still owe their grads
+                    assert ("allreduce", 0) in red.stats["events"]
+            red._on_backward_end()
+            assert red.stats["allreduce_calls"] == 2
+        finally:
+            red.remove()
+
+    def test_no_sync_accumulates_then_reduces(self, hcg):
+        from paddle_tpu.distributed.parallel import DataParallel
+        net = nn.Linear(8, 4)
+        dp = DataParallel(net)
+        x = paddle.ones([8, 8])
+        with dp.no_sync():
+            (dp(x).sum()).backward()
+        g1w = net.weight.grad.numpy().copy()
+        g1b = net.bias.grad.numpy().copy()
+        (dp(x).sum()).backward()   # sync step: reduces accumulated + new
+        # EVERY param must accumulate to exactly 2x (round-4 review: the
+        # overwrite bug passed on weight while tripling bias)
+        np.testing.assert_allclose(net.weight.grad.numpy(), 2 * g1w,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(net.bias.grad.numpy(), 2 * g1b,
+                                   rtol=1e-5)
+        # a third plain backward keeps accumulating
+        (dp(x).sum()).backward()
+        np.testing.assert_allclose(net.weight.grad.numpy(), 3 * g1w,
+                                   rtol=1e-5)
+        dp.cleanup()
+
+    def test_find_unused_parameters(self, hcg):
+        from paddle_tpu.distributed.fleet.reducer import EagerReducer
+        used = nn.Linear(4, 4)
+        unused = nn.Linear(4, 4)
+        red = EagerReducer(list(used.parameters()) +
+                           list(unused.parameters()),
+                           mesh=self._mesh(), axis="dp",
+                           find_unused_parameters=True)
+        try:
+            x = paddle.ones([2, 4])
+            used(x).sum().backward()
+            assert len(red.stats["unused"]) == 2  # unused weight + bias
+            # grad() walks must not touch .grad through the reducer
+            from paddle_tpu.core import autograd as _ag
+            xx = paddle.ones([2, 4])
+            xx.stop_gradient = False
+            for pp in used.parameters():
+                pp.grad = None
+            _ag.grad(used(xx).sum(), [xx])
+            assert all(pp.grad is None for pp in used.parameters())
+        finally:
+            red.remove()
