@@ -52,12 +52,18 @@ class Controller:
     # -- env contract (reference: collective.py builds PADDLE_* per rank) --
     def _worker_env(self, rank, peers, generation):
         env = dict(os.environ)
-        coord_host = self.endpoint.split(":")[0]
+        if self.endpoint.startswith("file://"):
+            # external-store rendezvous: workers address the shared root
+            # directly — there is no host:port to synthesize
+            master = self.endpoint
+        else:
+            coord_host = self.endpoint.split(":")[0]
+            master = f"{coord_host}:{peers[0]['coord_port']}"
         env.update({
             "PADDLE_TRAINER_ID": str(rank),
             "PADDLE_TRAINERS_NUM": str(self.args.nnodes),
             "PADDLE_NNODES": str(self.args.nnodes),
-            "PADDLE_MASTER": f"{coord_host}:{peers[0]['coord_port']}",
+            "PADDLE_MASTER": master,
             "PADDLE_JOB_ID": self.args.job_id,
             "PADDLE_RESTART_GENERATION": str(generation),
             "PADDLE_LOCAL_SIZE": str(len(peers)),

@@ -29,9 +29,19 @@ class Master:
     HEARTBEAT_KEY = "{job}/hb/{rank}"
 
     def __init__(self, endpoint, is_master, job_id="default", timeout_s=300):
+        self.job = job_id
+        if endpoint.startswith("file://"):
+            # external-store tier (reference ETCDMaster,
+            # launch/controllers/master.py:186): rendezvous state lives on
+            # a shared filesystem, so it survives the loss of ANY node —
+            # master included; a restarted node reopens the same root
+            from .filestore import FileStore
+            self.host, self.port = endpoint, 0
+            self.store = FileStore(endpoint[len("file://"):],
+                                   timeout_s=timeout_s)
+            return
         host, _, port = endpoint.partition(":")
         self.host, self.port = host, int(port)
-        self.job = job_id
         if is_master:
             try:
                 self.store = native.TCPStore(host=host, port=self.port,

@@ -26,6 +26,11 @@ def init_parallel_env():
         return
     master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ENDPOINT")
     nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+    if master and master.startswith("file://"):
+        # file-store rendezvous endpoints have no host:port for the jax
+        # coordination service; multi-host jax.distributed needs an
+        # explicit MASTER_ENDPOINT in that deployment
+        master = os.environ.get("MASTER_ENDPOINT")
     if master and nnodes > 1 and jax.process_count() == 1:
         try:
             jax.distributed.initialize(

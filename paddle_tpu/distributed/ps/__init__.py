@@ -32,7 +32,8 @@ import threading
 
 import numpy as np
 
-__all__ = ["DenseTable", "SparseTable", "PsServer", "PsClient", "PsService"]
+__all__ = ["DenseTable", "SparseTable", "SsdSparseTable", "PsServer",
+           "PsClient", "PsService"]
 
 # -- safe wire codec (no pickle: deserialization cannot run code) -----------
 
@@ -234,6 +235,137 @@ class SparseTable:
     def size(self):
         with self._lock:
             return len(self.rows)
+
+
+class SsdSparseTable(SparseTable):
+    """Disk-backed sparse table (reference: the SSD tier of
+    paddle/fluid/distributed/ps/table/ssd_sparse_table.cc and the
+    HeterPS cache hierarchy, paddle/fluid/framework/fleet/heter_ps/ —
+    hot rows in memory, cold rows on SSD).
+
+    Mechanism: an in-memory hot dict bounded at `cache_rows`; on
+    overflow, least-recently-used rows spill to an append-only value log
+    on disk with an in-memory {id -> file offset} index. A pull of a
+    cold id promotes it back (read at offset), possibly evicting others.
+    The log compacts when dead bytes exceed half the file (rewrite live
+    rows). Thread-safe under the table lock like the in-memory tables."""
+
+    def __init__(self, table_id, emb_dim, path, lr=0.01, entry=None,
+                 initializer=None, seed=0, cache_rows=100_000):
+        super().__init__(table_id, emb_dim, lr=lr, entry=entry,
+                         initializer=initializer, seed=seed)
+        self.cache_rows = int(cache_rows)
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._log = open(path, "a+b")
+        self._offsets = {}           # id -> offset of the LIVE disk copy
+        self._dead_bytes = 0
+        self._lru = {}               # id -> tick (monotonic access order)
+        self._tick = 0
+        self._row_bytes = 4 * emb_dim
+
+    # -- spill/promote (called under self._lock) --------------------------
+    def _note(self, key):
+        self._tick += 1
+        self._lru[key] = self._tick
+
+    def _spill_cold(self):
+        overflow = len(self.rows) - self.cache_rows
+        if overflow <= 0:
+            return
+        import heapq
+        victims = heapq.nsmallest(overflow, self.rows,
+                                  key=lambda k: self._lru.get(k, 0))
+        self._log.seek(0, 2)
+        for victim in victims:
+            row = self.rows.pop(victim)
+            off = self._log.tell()
+            self._log.write(row.astype(np.float32).tobytes())
+            if victim in self._offsets:
+                self._dead_bytes += self._row_bytes
+            self._offsets[victim] = off
+            self._lru.pop(victim, None)
+        if self._dead_bytes > max(self._row_bytes * 64,
+                                  self._log_size() // 2):
+            self._compact()
+
+    def _log_size(self):
+        self._log.seek(0, 2)
+        return self._log.tell()
+
+    def _load(self, key):
+        off = self._offsets.get(key)
+        if off is None:
+            return None
+        self._log.seek(off)
+        buf = self._log.read(self._row_bytes)
+        return np.frombuffer(buf, np.float32).copy()
+
+    def _compact(self):
+        """Rewrite only live rows (reference ssd table compaction).
+        Streams row-by-row into a temp log then atomically replaces the
+        old one — a crash mid-compaction leaves the original log (and the
+        old offsets) fully intact, and memory stays O(1) rows."""
+        tmp_path = self.path + ".compact"
+        new_offsets = {}
+        with open(tmp_path, "wb") as f:
+            for key, off in self._offsets.items():
+                self._log.seek(off)
+                new_offsets[key] = f.tell()
+                f.write(self._log.read(self._row_bytes))
+            f.flush()
+            os.fsync(f.fileno())
+        self._log.close()
+        os.replace(tmp_path, self.path)
+        self._offsets = new_offsets
+        self._log = open(self.path, "a+b")
+        self._dead_bytes = 0
+
+    # -- table API --------------------------------------------------------
+    def pull(self, ids):
+        out = np.zeros((len(ids), self.emb_dim), np.float32)
+        with self._lock:
+            for i, key in enumerate(ids):
+                key = int(key)
+                row = self.rows.get(key)
+                if row is None:
+                    row = self._load(key)     # promote from SSD
+                    if row is not None:
+                        self.rows[key] = row
+                        self._offsets.pop(key, None)
+                        self._dead_bytes += self._row_bytes
+                if row is None and self._admit(key):
+                    row = self._init()
+                    self.rows[key] = row
+                if row is not None:
+                    self._note(key)
+                    out[i] = row
+            self._spill_cold()
+        return out
+
+    def push_grad(self, ids, grads):
+        grads = np.asarray(grads, np.float32)
+        with self._lock:
+            for i, key in enumerate(ids):
+                key = int(key)
+                row = self.rows.get(key)
+                if row is None:
+                    row = self._load(key)
+                    if row is not None:
+                        self.rows[key] = row
+                        self._offsets.pop(key, None)
+                        self._dead_bytes += self._row_bytes
+                if row is not None:
+                    row -= self.lr * grads[i]
+                    self._note(key)
+            self._spill_cold()
+
+    def size(self):
+        with self._lock:
+            return len(self.rows) + len(self._offsets)
+
+    def close(self):
+        self._log.close()
 
 
 class PsServer:

@@ -6,3 +6,11 @@ globals().update(_ns)
 OP_TABLE = _registry.OP_TABLE
 
 __all__ = sorted(_ns.keys())
+
+# TensorArray surface (reference python/paddle/tensor/array.py; core type
+# paddle/phi/core/tensor_array.h)
+from .array import (TensorArray, create_array, array_write, array_read,
+                    array_length, tensor_array_to_tensor)
+
+__all__ += ["TensorArray", "create_array", "array_write", "array_read",
+            "array_length", "tensor_array_to_tensor"]

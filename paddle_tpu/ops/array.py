@@ -1,0 +1,99 @@
+"""TensorArray (reference: paddle/phi/core/tensor_array.h — a dynamic
+array of tensors used by control-flow ops; python surface
+python/paddle/tensor/array.py: create_array / array_write / array_read /
+array_length, plus tensor_array_to_tensor).
+
+TPU-native position: the reference needs a runtime TensorArray type
+because its static graph executes while_loops writing per-step outputs
+into a DENSE_TENSOR_ARRAY variable. Here the traced path lowers loops to
+lax.scan whose stacked outputs ARE the array (no runtime type needed),
+so the eager surface keeps the reference's dygraph semantics: a python
+list (with index validation), and a thin TensorArray class for core
+parity. Under SOT capture, list mutation classifies as a break op, so
+arrays behave identically in compiled functions.
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["TensorArray", "create_array", "array_write", "array_read",
+           "array_length", "tensor_array_to_tensor"]
+
+
+class TensorArray(list):
+    """List-of-tensors with the reference core type's name (dygraph
+    TensorArray IS a list in the reference too; the class exists so
+    isinstance checks and repr match)."""
+
+    def __repr__(self):
+        return f"TensorArray(len={len(self)})"
+
+
+def _as_index(i):
+    if isinstance(i, Tensor):
+        if int(jnp.size(i.data)) != 1:
+            raise ValueError("array index must be a 0-D/[1] tensor")
+        return int(i.item() if hasattr(i, "item") else i.data.reshape(()))
+    return int(i)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """paddle.tensor.create_array parity: a new (optionally pre-filled)
+    array. dtype is kept for API parity (the list holds tensors of any
+    dtype, as in the reference's dygraph mode)."""
+    arr = TensorArray()
+    if initialized_list is not None:
+        for v in initialized_list:
+            arr.append(v if isinstance(v, Tensor) else Tensor(jnp.asarray(v)))
+    return arr
+
+def array_write(x, i, array=None):
+    """Write x at position i (i <= len extends by one — reference dygraph
+    contract); returns the array."""
+    idx = _as_index(i)
+    if array is None:
+        array = create_array()
+    if not isinstance(array, list):
+        raise TypeError("'array' must be a list/TensorArray in dygraph mode")
+    if idx > len(array):
+        raise ValueError(
+            f"index {idx} out of range for array of length {len(array)} "
+            "(array_write may extend by at most one)")
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    """Read position i."""
+    if not isinstance(array, list):
+        raise TypeError("'array' must be a list/TensorArray in dygraph mode")
+    idx = _as_index(i)
+    if idx >= len(array):
+        raise ValueError(f"index {idx} out of range (len {len(array)})")
+    return array[idx]
+
+
+def array_length(array):
+    if not isinstance(array, list):
+        raise TypeError("'array' must be a list/TensorArray in dygraph mode")
+    return Tensor(jnp.asarray(len(array), jnp.int64))
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False):
+    """Reference paddle.tensor_array_to_tensor: fuse the array into one
+    tensor by concat (default) or stack along `axis`; also returns the
+    per-element sizes along that axis (the reference's OutIndex)."""
+    if not isinstance(input, (list, tuple)) or not input:
+        raise ValueError("tensor_array_to_tensor needs a non-empty array")
+    arrs = [t.data if isinstance(t, Tensor) else jnp.asarray(t)
+            for t in input]
+    if use_stack:
+        out = jnp.stack(arrs, axis=axis)
+        sizes = jnp.asarray([1] * len(arrs), jnp.int32)
+    else:
+        out = jnp.concatenate(arrs, axis=axis)
+        sizes = jnp.asarray([a.shape[axis] for a in arrs], jnp.int32)
+    return Tensor(out), Tensor(sizes)

@@ -134,3 +134,42 @@ def test_codec_rejects_weird_dtype():
         struct.pack("<Q", 8) + b"\\x00" * 8
     with pytest.raises(ValueError):
         _decode(payload)
+
+
+class TestSsdSparseTable:
+    """SSD parameter-server tier (reference ssd_sparse_table.cc /
+    HeterPS cache hierarchy — round-4 missing #8)."""
+
+    def test_spill_promote_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.ps import SsdSparseTable
+        t = SsdSparseTable(0, emb_dim=4, path=str(tmp_path / "t0.log"),
+                           lr=0.1, cache_rows=8, seed=1)
+        ids = list(range(32))            # 4x the cache capacity
+        first = t.pull(ids)              # creates 32 rows, spills 24
+        assert len(t.rows) <= 8
+        assert t.size() == 32
+        again = t.pull(ids)              # promotes every row back through
+        np.testing.assert_allclose(again, first)
+
+    def test_push_updates_cold_rows(self, tmp_path):
+        from paddle_tpu.distributed.ps import SsdSparseTable
+        t = SsdSparseTable(0, emb_dim=2, path=str(tmp_path / "t1.log"),
+                           lr=1.0, cache_rows=2, seed=2)
+        base = t.pull([1, 2, 3, 4]).copy()   # row 1,2 now cold
+        g = np.ones((1, 2), np.float32)
+        t.push_grad([1], g)                  # cold row: promoted, updated
+        out = t.pull([1])
+        np.testing.assert_allclose(out[0], base[0] - 1.0, rtol=1e-6)
+
+    def test_compaction_keeps_live_values(self, tmp_path):
+        from paddle_tpu.distributed.ps import SsdSparseTable
+        t = SsdSparseTable(0, emb_dim=2, path=str(tmp_path / "t2.log"),
+                           lr=0.0, cache_rows=2, seed=3)
+        ids = list(range(12))
+        ref = t.pull(ids).copy()
+        # churn: repeated pulls force spill/promote cycles -> dead bytes
+        for _ in range(6):
+            for i in ids:
+                t.pull([i])
+        np.testing.assert_allclose(t.pull(ids), ref)
+        assert t.size() == 12

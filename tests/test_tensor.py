@@ -115,3 +115,61 @@ def test_dunder_scalar_mix():
     assert (2 ** t).numpy()[0] == 4.0
     assert (-t).numpy()[0] == -2.0
     assert abs(paddle.to_tensor([-2.0])).numpy()[0] == 2.0
+
+
+class TestTensorArray:
+    """TensorArray surface (reference python/paddle/tensor/array.py; core
+    type paddle/phi/core/tensor_array.h — round-4 missing #7)."""
+
+    def test_write_read_length(self):
+        arr = paddle.tensor.create_array(dtype="float32")
+        x = paddle.full([1, 3], 5.0)
+        i = paddle.zeros([1], dtype="int32")
+        arr = paddle.tensor.array_write(x, i, array=arr)
+        item = paddle.tensor.array_read(arr, i)
+        np.testing.assert_allclose(item.numpy(), np.full((1, 3), 5.0))
+        assert int(paddle.tensor.array_length(arr)) == 1
+        # extend-by-one append at i == len
+        arr = paddle.tensor.array_write(x * 2, paddle.to_tensor([1]), arr)
+        assert int(paddle.tensor.array_length(arr)) == 2
+        # overwrite in place
+        paddle.tensor.array_write(x * 3, paddle.to_tensor([0]), arr)
+        np.testing.assert_allclose(
+            paddle.tensor.array_read(arr, paddle.to_tensor([0])).numpy(),
+            np.full((1, 3), 15.0))
+
+    def test_write_index_validation(self):
+        arr = paddle.tensor.create_array()
+        with pytest.raises(ValueError):
+            paddle.tensor.array_write(paddle.ones([2]),
+                                      paddle.to_tensor([3]), arr)
+
+    def test_tensor_array_to_tensor_concat_and_stack(self):
+        a = paddle.ones([2, 2])
+        b = paddle.ones([2, 3]) * 2
+        arr = paddle.tensor.create_array(initialized_list=[a, b])
+        out, idx = paddle.tensor_array_to_tensor(arr, axis=1)
+        assert list(out.shape) == [2, 5]
+        np.testing.assert_array_equal(idx.numpy(), [2, 3])
+        c = paddle.ones([2, 2]) * 3
+        out2, _ = paddle.tensor_array_to_tensor(
+            paddle.tensor.create_array(initialized_list=[a, c]),
+            axis=0, use_stack=True)
+        assert list(out2.shape) == [2, 2, 2]
+
+    def test_array_in_sot_function(self):
+        # list mutation is a break op under the opcode tier: arrays keep
+        # python semantics inside to_static functions
+        @paddle.jit.to_static
+        def f(x):
+            arr = paddle.tensor.create_array()
+            paddle.tensor.array_write(x, paddle.to_tensor([0]), arr)
+            paddle.tensor.array_write(x + 1, paddle.to_tensor([1]), arr)
+            out, _ = paddle.tensor_array_to_tensor(arr, axis=0)
+            return out
+
+        x = paddle.ones([2, 2])
+        r1 = f(x)
+        r2 = f(x)
+        assert list(r1.shape) == [4, 2]
+        np.testing.assert_allclose(r1.numpy(), r2.numpy())

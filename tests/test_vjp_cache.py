@@ -138,3 +138,26 @@ def test_uncacheable_sig_negative_cached():
     # or it was negative-cached; in both cases results are correct
     assert np.allclose(y.numpy(), np.sin(x.numpy()), atol=1e-6)
     assert after >= before
+
+
+def test_value_dependent_shape_op_through_cache():
+    """A nonzero-class op (output shape depends on input VALUES) must stay
+    correct through the cache: the jitted trace fails (data-dependent
+    shape), the sig is negative-cached, and every call takes the direct
+    path — two different masks give two different (correct) results."""
+    import jax.numpy as jnp
+
+    def impl(a, m):
+        idx = jnp.nonzero(m)[0]       # data-dependent output shape
+        return a[idx] * 2.0
+
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32))
+    x.stop_gradient = False
+    m1 = paddle.to_tensor(np.array([1, 0, 1, 0, 1, 0], np.int32))
+    m2 = paddle.to_tensor(np.array([1, 1, 1, 1, 0, 0], np.int32))
+    y1 = _dispatch.apply_op("nonzero_gather", impl, (x, m1), {})
+    y2 = _dispatch.apply_op("nonzero_gather", impl, (x, m2), {})
+    np.testing.assert_allclose(y1.numpy(), [0.0, 4.0, 8.0])
+    np.testing.assert_allclose(y2.numpy(), [0.0, 2.0, 4.0, 6.0])
+    y2.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2, 2, 0, 0])
