@@ -162,3 +162,52 @@ def _attach_dunders(ns):
     setattr(Tensor, "__invert__", _make_method(ns["bitwise_not"]))
     # keep identity hash alongside __eq__ returning tensors
     Tensor.__hash__ = lambda self: id(self)
+
+
+# -- extern op catalog -------------------------------------------------------
+# ops/yaml/extern_ops.yaml lists every public op whose implementation lives
+# outside ops/impl (nn.functional, vision, sparse, fused tier, geometric,
+# fft/signal/linalg). Together with ops.yaml this makes the YAML layer the
+# single authoritative op inventory (reference ops.yaml role, SURVEY §2.2);
+# tests/test_ops.py gates the catalog both ways (listed <-> exists).
+
+def load_extern_catalog():
+    """-> {qualified_name: (module_path, op_name)} from extern_ops.yaml."""
+    import os
+    import yaml
+    path = os.path.join(os.path.dirname(__file__), "yaml", "extern_ops.yaml")
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    catalog = {}
+    for section, spec in (doc or {}).items():
+        module = spec["module"]
+        for name in spec["ops"]:
+            catalog[f"{section}.{name}"] = (module, name)
+    return catalog
+
+
+def extern_catalog_diff():
+    """Validate the catalog against the live modules. Returns
+    (missing, unlisted): names listed but absent, and public callables
+    present but not cataloged. Both empty = single source of truth holds."""
+    import importlib
+    import inspect
+    catalog = load_extern_catalog()
+    by_module = {}
+    for qual, (module, name) in catalog.items():
+        by_module.setdefault(module, set()).add(name)
+    missing, unlisted = [], []
+    for module, names in by_module.items():
+        m = importlib.import_module(module)
+        for n in names:
+            fn = getattr(m, n, None)
+            if fn is None or not callable(fn):
+                missing.append(f"{module}.{n}")
+        public = {n for n in dir(m) if not n.startswith("_")
+                  and callable(getattr(m, n))
+                  and not inspect.isclass(getattr(m, n))
+                  and getattr(getattr(m, n), "__module__",
+                              "").startswith("paddle_tpu")}
+        for n in sorted(public - names):
+            unlisted.append(f"{module}.{n}")
+    return missing, unlisted

@@ -8,6 +8,7 @@
   in for the Program proto (SURVEY.md §7).
 """
 from ..jit import to_static, save, load  # noqa: F401
+from . import nn  # noqa: F401  (reference paddle.static.nn namespace)
 from .program import (Program, program_guard, data, Executor,  # noqa: F401
                       default_main_program, default_startup_program)
 

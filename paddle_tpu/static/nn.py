@@ -1,0 +1,478 @@
+"""paddle.static.nn (reference python/paddle/static/nn/__init__.py):
+control-flow ops + parameter-creating layer functions for the static
+facade.
+
+TPU-native notes:
+- cond / while_loop / case / switch_case dispatch through apply_op with a
+  lax.cond / lax.while_loop impl, so a Program records ONE control-flow
+  op carrying BOTH branches (closing the "no control flow in recorded
+  programs" gap: replay with different feeds takes the right branch on
+  device). With concrete eager inputs the lax ops still execute directly.
+- layer-style functions (fc, conv2d, batch_norm, ...) create Parameters
+  through the unified default initializer machinery and delegate the math
+  to nn.functional — the reference's append-op-into-program becomes
+  "record the dispatched functional op".
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core.dispatch import apply_op
+from .. import nn as _nn
+from ..nn import functional as F
+from ..nn.initializer import Constant, XavierNormal
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "fc", "embedding",
+           "sparse_embedding", "conv2d", "conv3d", "conv2d_transpose",
+           "conv3d_transpose", "batch_norm", "layer_norm", "group_norm",
+           "instance_norm", "spectral_norm", "data_norm", "prelu",
+           "bilinear_tensor_product", "py_func", "static_pylayer",
+           "sequence_softmax", "deform_conv2d", "nce", "row_conv",
+           "sequence_conv", "sequence_pool", "sequence_first_step",
+           "sequence_last_step", "sequence_expand"]
+
+
+# -- control flow -----------------------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Reference static.nn.cond: run true_fn/false_fn by pred.
+
+    Static-graph semantics: BOTH branches' ops execute (and record into
+    the active Program — dataflow nodes for each side), then one recorded
+    select op picks per `pred`. Replay with a different feed takes the
+    other branch's values — the reference's build-both-blocks contract,
+    lowered to the select XLA prefers over divergent control flow."""
+    t = true_fn() if true_fn is not None else None
+    f = false_fn() if false_fn is not None else None
+    is_leaf = lambda x: isinstance(x, Tensor)  # noqa: E731
+    tl, tdef = jax.tree_util.tree_flatten(t, is_leaf=is_leaf)
+    fl, fdef = jax.tree_util.tree_flatten(f, is_leaf=is_leaf)
+    if len(tl) != len(fl):
+        raise ValueError("cond branches must return matching structures")
+    n = len(tl)
+
+    def impl(p, *arrs):
+        pb = jnp.asarray(p).reshape(()).astype(bool)
+        outs = tuple(jnp.where(pb, a, b)
+                     for a, b in zip(arrs[:n], arrs[n:]))
+        return outs if len(outs) != 1 else outs[0]
+
+    out = apply_op("cond", impl, (pred,) + tuple(tl) + tuple(fl), {})
+    leaves = list(out) if isinstance(out, tuple) else [out]
+    return jax.tree_util.tree_unflatten(tdef, leaves)
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    """Reference static.nn.while_loop over lax.while_loop: loop_vars must
+    keep shape/dtype across iterations (the static-graph contract)."""
+    vars_in = [v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+               for v in loop_vars]
+
+    def impl(*arrs):
+        def c(vs):
+            r = cond_fn(*[Tensor(v) for v in vs])
+            r = r.data if isinstance(r, Tensor) else jnp.asarray(r)
+            return r.reshape(()).astype(bool)
+
+        def b(vs):
+            outs = body(*[Tensor(v) for v in vs])
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            return tuple(o.data if isinstance(o, Tensor) else jnp.asarray(o)
+                         for o in outs)
+
+        return jax.lax.while_loop(c, b, tuple(arrs))
+
+    out = apply_op("while_loop", impl, tuple(vars_in), {})
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Reference static.nn.case: first true pred wins (nested cond)."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+
+    def build(pairs):
+        (p, fn), rest = pairs[0], pairs[1:]
+        if not rest:
+            if default is None:
+                return fn()
+            return cond(p, fn, default)
+        return cond(p, fn, lambda: build(rest))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Reference static.nn.switch_case over lax.switch."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    # evaluate every branch (ops record as dataflow), then select — the
+    # same build-all-blocks static contract as cond above
+    branch_leaves = []
+    per = None
+    rdef = None
+    for f in fns + ([default] if default is not None else []):
+        r = f()
+        rl, rd = jax.tree_util.tree_flatten(
+            r, is_leaf=lambda x: isinstance(x, Tensor))
+        rdef = rdef or rd
+        if per is None:
+            per = len(rl)
+        elif len(rl) != per:
+            raise ValueError("switch_case branches must return matching "
+                             "structures")
+        branch_leaves.extend(rl)
+    nb = len(fns) + (1 if default is not None else 0)
+
+    def impl(idx, *arrs):
+        ia = jnp.asarray(idx).reshape(()).astype(jnp.int32)
+        # reference semantics: an unmatched index without a default takes
+        # the LAST (highest-key) branch
+        pos = jnp.asarray(nb - 1, jnp.int32)
+        for j, k in enumerate(keys):
+            pos = jnp.where(ia == k, jnp.int32(j), pos)
+        stacked = [jnp.stack([arrs[b * per + i] for b in range(nb)])
+                   for i in range(per)]
+        outs = tuple(s[pos] for s in stacked)
+        return outs if len(outs) != 1 else outs[0]
+
+    out = apply_op("switch_case", impl,
+                   (branch_index,) + tuple(branch_leaves), {})
+    leaves = list(out) if isinstance(out, tuple) else [out]
+    return jax.tree_util.tree_unflatten(rdef, leaves)
+
+
+# -- parameter-creating layer functions -------------------------------------
+
+def _param(shape, attr=None, default_init=None, dtype="float32"):
+    init = None
+    if attr is not None and getattr(attr, "initializer", None) is not None:
+        init = attr.initializer
+    init = init or default_init or XavierNormal()
+    arr = init(shape, dtype)
+    data = arr.data if isinstance(arr, Tensor) else jnp.asarray(arr)
+    return Parameter(data)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Reference static.nn.fc: flatten trailing dims, linear, optional
+    activation."""
+    xs = list(x.shape)
+    in_f = int(np.prod(xs[num_flatten_dims:]))
+    w = _param([in_f, size], weight_attr)
+    b = None if bias_attr is False else _param(
+        [size], bias_attr, default_init=Constant(0.0))
+    h = x.reshape(xs[:num_flatten_dims] + [in_f])
+    out = F.linear(h, w, b)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    w = _param(list(size), param_attr, dtype=dtype)
+    return F.embedding(input, w, padding_idx=padding_idx, sparse=is_sparse)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, param_attr=None, dtype="float32", **kw):
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def _conv(x, num_filters, filter_size, dims, stride=1, padding=0,
+          dilation=1, groups=1, param_attr=None, bias_attr=None,
+          transpose=False):
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * dims
+    cin = x.shape[1]
+    if transpose:
+        wshape = [cin, num_filters // groups] + list(ks)
+    else:
+        wshape = [num_filters, cin // groups] + list(ks)
+    w = _param(wshape, param_attr)
+    b = None if bias_attr is False else _param(
+        [num_filters], bias_attr, default_init=Constant(0.0))
+    f = {(2, False): F.conv2d, (3, False): F.conv3d,
+         (2, True): F.conv2d_transpose, (3, True): F.conv3d_transpose}[
+        (dims, transpose)]
+    return f(x, w, bias=b, stride=stride, padding=padding,
+             dilation=dilation, groups=groups)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
+           **kw):
+    out = _conv(input, num_filters, filter_size, 2, stride, padding,
+                dilation, groups, param_attr, bias_attr)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
+           **kw):
+    out = _conv(input, num_filters, filter_size, 3, stride, padding,
+                dilation, groups, param_attr, bias_attr)
+    return getattr(F, act)(out) if act else out
+
+
+def _transpose_filter_size(input, dims, filter_size, output_size, stride,
+                           padding):
+    """Reference contract: exactly one of filter_size/output_size given;
+    k = out - (in - 1)*stride + 2*pad (per spatial dim)."""
+    if filter_size is not None:
+        return filter_size
+    if output_size is None:
+        raise ValueError("conv transpose needs filter_size or output_size")
+    outs = output_size if isinstance(output_size, (list, tuple)) \
+        else [output_size] * dims
+    st = stride if isinstance(stride, (list, tuple)) else [stride] * dims
+    pd = padding if isinstance(padding, (list, tuple)) else [padding] * dims
+    ins = list(input.shape[2:])
+    return [int(o - (i - 1) * s + 2 * p)
+            for o, i, s, p in zip(outs, ins, st, pd)]
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     **kw):
+    ks = _transpose_filter_size(input, 2, filter_size, output_size, stride,
+                                padding)
+    out = _conv(input, num_filters, ks, 2, stride, padding,
+                dilation, groups, param_attr, bias_attr, transpose=True)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     **kw):
+    ks = _transpose_filter_size(input, 3, filter_size, output_size, stride,
+                                padding)
+    out = _conv(input, num_filters, ks, 3, stride, padding,
+                dilation, groups, param_attr, bias_attr, transpose=True)
+    return getattr(F, act)(out) if act else out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, **kw):
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    bn = _nn.BatchNorm(c, momentum=momentum, epsilon=epsilon,
+                       data_layout=data_layout)
+    if is_test:
+        bn.eval()
+    out = bn(input)
+    return getattr(F, act)(out) if act else out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = list(input.shape[begin_norm_axis:])
+    w = _param(shape, param_attr, default_init=Constant(1.0)) \
+        if scale else None
+    b = _param(shape, bias_attr, default_init=Constant(0.0)) if shift \
+        else None
+    out = F.layer_norm(input, shape, weight=w, bias=b, epsilon=epsilon)
+    return getattr(F, act)(out) if act else out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    gn = _nn.GroupNorm(groups, c, epsilon=epsilon)
+    out = gn(input)
+    return getattr(F, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    return _nn.InstanceNorm2D(input.shape[1], epsilon=epsilon)(input)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    return _nn.SpectralNorm(list(weight.shape), dim=dim,
+                            power_iters=power_iters, eps=eps)(weight)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Reference data_norm: normalize by accumulated batch statistics;
+    eager facade normalizes with the current batch stats."""
+    mean = input.mean(axis=0, keepdim=True)
+    var = ((input - mean) ** 2).mean(axis=0, keepdim=True)
+    out = (input - mean) / (var + epsilon).sqrt()
+    return getattr(F, act)(out) if act else out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    n = {"all": 1, "channel": x.shape[1],
+         "element": int(np.prod(x.shape[1:]))}[mode]
+    from ..nn.initializer import Constant
+    w = _param([n], param_attr, default_init=Constant(0.25))
+    return F.prelu(x, w)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    w = _param([size, x.shape[-1], y.shape[-1]], param_attr)
+    b = None if bias_attr is False else _param(
+        [size], bias_attr, default_init=Constant(0.0))
+    out = F.bilinear(x, y, w, b)
+    return getattr(F, act)(out) if act else out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference static.nn.py_func: host-python op. Eager facade: call it."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    r = func(*xs)
+    return r if r is not None else out
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """Reference static_pylayer: custom fwd/bwd pair (PyLayer in static).
+    With backward_fn=None the forward runs on the tape directly (real
+    autodiff gradients) — an identity-gradient substitute would be
+    silently wrong for any non-identity forward."""
+    if backward_fn is None:
+        return forward_fn(*inputs)
+    from ..autograd.py_layer import PyLayer
+
+    class _L(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            out = forward_fn(*args)
+            ctx.save_for_backward(*args)
+            return out
+
+        @staticmethod
+        def backward(ctx, *grads):
+            return backward_fn(*grads)
+
+    return _L.apply(*inputs)
+
+
+# -- sequence ops (LoD-free facades: operate on padded [B, T, ...]) ---------
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    return F.softmax(input, axis=-1)
+
+
+def sequence_pool(input, pool_type="average", is_test=False, pad_value=0.0):
+    pt = pool_type.lower()
+    if pt in ("average", "avg"):
+        return input.mean(axis=1)
+    if pt == "sum":
+        return input.sum(axis=1)
+    if pt == "max":
+        return input.max(axis=1)
+    if pt == "first":
+        return input[:, 0]
+    if pt == "last":
+        return input[:, -1]
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+def sequence_first_step(input):
+    return input[:, 0]
+
+
+def sequence_last_step(input):
+    return input[:, -1]
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    reps = y.shape[1] if y.ndim > 1 else 1
+    return x.unsqueeze(1).expand([x.shape[0], reps] + list(x.shape[1:]))
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """1-D sequence convolution over padded [B, T, C]."""
+    c = input.shape[-1]
+    w = _param([num_filters, c, filter_size], param_attr)
+    b = None if bias_attr is False else _param(
+        [num_filters], bias_attr, default_init=Constant(0.0))
+    h = input.transpose([0, 2, 1])            # [B, C, T]
+    out = F.conv1d(h, w, bias=b, stride=filter_stride,
+                   padding=filter_size // 2 if padding else 0)
+    out = out.transpose([0, 2, 1])
+    return getattr(F, act)(out) if act else out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference row_conv op)."""
+    c = input.shape[-1]
+    k = future_context_size + 1
+    w = _param([k, c], param_attr)
+
+    def impl(x, wt):
+        b, t, ch = x.shape
+        pad = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+        out = jnp.zeros_like(x)
+        for i in range(k):
+            out = out + pad[:, i:i + t] * wt[i][None, None]
+        return out
+
+    out = apply_op("row_conv", impl, (input, w), {})
+    return getattr(F, act)(out) if act else out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference nce op): logistic loss
+    over the true class + sampled negatives."""
+    from ..core import random as _rng
+    del sample_weight, custom_dist  # facade: uniform sampler
+    dim = input.shape[-1]
+    w = _param([num_total_classes, dim], param_attr)
+    b = None if bias_attr is False else _param(
+        [num_total_classes], bias_attr, default_init=Constant(0.0))
+    k = num_neg_samples or 5
+
+    def impl(x, lab, wt, rngkey, *bias):
+        bsz = x.shape[0]
+        neg = jax.random.randint(rngkey, (bsz, k), 0, num_total_classes)
+        ids = jnp.concatenate([lab.reshape(-1, 1), neg], axis=1)  # [B,1+k]
+        logits = jnp.einsum("bd,bkd->bk", x, wt[ids])
+        if bias:
+            logits = logits + bias[0][ids]
+        labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
+        p = jax.nn.log_sigmoid(logits)
+        q = jax.nn.log_sigmoid(-logits)
+        loss = -(labels * p + (1 - labels) * q).sum(-1, keepdims=True)
+        return loss
+
+    key = _rng.fresh_key_tensor() if not seed else Tensor(
+        jax.random.PRNGKey(seed))
+    args = (input, label, w, key) + (() if b is None else (b,))
+    return apply_op("nce", impl, args, {})
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import deform_conv2d as _dc
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    w = _param([num_filters, input.shape[1] // groups] + list(ks),
+               param_attr)
+    b = None if bias_attr is False else _param([num_filters], bias_attr)
+    return _dc(input, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)

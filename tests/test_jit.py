@@ -400,3 +400,61 @@ def test_full_graph_object_attr_mutation_not_stale():
     np.testing.assert_allclose(g(x, c).numpy(), [2, 2, 2])
     c.scale = 7.0
     np.testing.assert_allclose(g(x, c).numpy(), [7, 7, 7])
+
+
+class TestStaticNN:
+    """paddle.static.nn surface (reference python/paddle/static/nn):
+    control flow recorded as single ops + parameter-creating layers."""
+
+    def test_cond_records_both_branches(self):
+        from paddle_tpu import static
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2], "float32")
+            out = static.nn.cond(x.sum() > 0,
+                                 lambda: x * 2.0, lambda: x - 1.0)
+        exe = static.Executor()
+        r1 = exe.run(prog, feed={"x": np.array([1.0, 1.0], np.float32)},
+                     fetch_list=[out])
+        r2 = exe.run(prog, feed={"x": np.array([-1.0, -1.0], np.float32)},
+                     fetch_list=[out])
+        np.testing.assert_allclose(r1[0], [2.0, 2.0])
+        np.testing.assert_allclose(r2[0], [-2.0, -2.0])  # other branch!
+
+    def test_while_loop(self):
+        from paddle_tpu import static
+        i = paddle.to_tensor(0)
+        s = paddle.to_tensor(0)
+        iv, sv = static.nn.while_loop(
+            lambda i, s: i < 5, lambda i, s: [i + 1, s + i], [i, s])
+        assert int(iv) == 5 and int(sv) == 10
+
+    def test_case_and_switch_case(self):
+        from paddle_tpu import static
+        x = paddle.to_tensor([3.0])
+        r = static.nn.case(
+            [(x.sum() < 0, lambda: x - 1.0), (x.sum() > 2, lambda: x * 10)],
+            default=lambda: x)
+        np.testing.assert_allclose(r.numpy(), [30.0])
+        idx = paddle.to_tensor(1)
+        r2 = static.nn.switch_case(
+            idx, {0: lambda: x, 1: lambda: x + 1, 2: lambda: x + 2})
+        np.testing.assert_allclose(r2.numpy(), [4.0])
+        r3 = static.nn.switch_case(paddle.to_tensor(9),
+                                   {0: lambda: x}, default=lambda: x * 0)
+        np.testing.assert_allclose(r3.numpy(), [0.0])
+
+    def test_fc_embedding_layers(self):
+        from paddle_tpu import static
+        paddle.seed(0)
+        x = paddle.rand([4, 8])
+        y = static.nn.fc(x, 16, activation="relu")
+        assert list(y.shape) == [4, 16] and float(y.min()) >= 0
+        ids = paddle.to_tensor(np.array([[1, 2]], np.int64))
+        e = static.nn.embedding(ids, (10, 4))
+        assert list(e.shape) == [1, 2, 4]
+        img = paddle.rand([2, 3, 8, 8])
+        c = static.nn.conv2d(img, 4, 3, padding=1)
+        assert list(c.shape) == [2, 4, 8, 8]
+        ln = static.nn.layer_norm(x)
+        assert list(ln.shape) == [4, 8]

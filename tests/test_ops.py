@@ -293,3 +293,17 @@ class TestGrads:
             e = paddle.exp(t - paddle.max(t))
             return (e / paddle.sum(e)) * paddle.to_tensor([1.0, 2.0, 3.0, 4.0])
         check_grad(f, [x])
+
+
+def test_extern_catalog_single_source_of_truth():
+    """ops/yaml/extern_ops.yaml + ops.yaml = the authoritative op inventory
+    (round-4 closure of the §2.2 'registry bypass' gap): every cataloged
+    extern op exists, and every public op in a cataloged module is listed —
+    adding an op without cataloging it fails here."""
+    from paddle_tpu.ops.registry import extern_catalog_diff, \
+        load_extern_catalog
+    catalog = load_extern_catalog()
+    assert len(catalog) >= 300, len(catalog)
+    missing, unlisted = extern_catalog_diff()
+    assert not missing, f"cataloged but absent: {missing}"
+    assert not unlisted, f"public but uncataloged: {unlisted}"
