@@ -79,27 +79,92 @@ def _from_shm(tree):
     return tree
 
 
+_RING_CAPACITY = 32 << 20
+
+
+def _ring_bytes(tree):
+    """Total shm-eligible payload of a batch."""
+    if isinstance(tree, np.ndarray) and tree.nbytes >= _SHM_MIN_BYTES:
+        return tree.nbytes
+    if isinstance(tree, dict):
+        return sum(_ring_bytes(v) for v in tree.values())
+    if isinstance(tree, list):
+        return sum(_ring_bytes(v) for v in tree)
+    return 0
+
+
+def _to_ring(tree, ring, count):
+    """Serialize large ndarrays into the worker's native shm ring
+    (native/src/shm_ring.cc — the fixed mapped-once transport replacing a
+    per-batch SharedMemory segment; reference data_loader.cc role).
+    `count` is a 1-item list tracking pushed records, so an error mid-batch
+    can tell the consumer exactly how many orphans to drain."""
+    if isinstance(tree, np.ndarray) and tree.nbytes >= _SHM_MIN_BYTES:
+        # generous timeout: the consumer drains at queue-receipt, which
+        # can lag by prefetch depth under load — blocking here is normal
+        ring.push(np.ascontiguousarray(tree).tobytes(), timeout_ms=60_000)
+        count[0] += 1
+        return ("__ring__", str(tree.dtype), tree.shape)
+    if isinstance(tree, dict):
+        return {k: _to_ring(v, ring, count) for k, v in tree.items()}
+    if isinstance(tree, list):
+        return [_to_ring(v, ring, count) for v in tree]
+    return tree
+
+
+def _from_ring(tree, ring):
+    """Main-process side: pop records in push order (per-worker FIFO)."""
+    if isinstance(tree, tuple) and len(tree) == 3 and tree[0] == "__ring__":
+        _, dtype, shape = tree
+        buf = ring.pop(timeout_ms=60_000)
+        if buf is None:
+            raise RuntimeError("DataLoader ring transport timed out")
+        return np.frombuffer(buf, dtype=dtype).reshape(shape).copy()
+    if isinstance(tree, dict):
+        return {k: _from_ring(v, ring) for k, v in tree.items()}
+    if isinstance(tree, list):
+        return [_from_ring(v, ring) for v in tree]
+    return tree
+
+
 def _worker_loop(dataset, index_queue, data_queue, collate, init_fn, wid,
-                 use_shm=False):
+                 use_shm=False, ring_name=None):
     """Process-worker loop (reference: io/dataloader/worker.py — fetch
-    sample indices, collate, ship the batch back over the queue or through
-    shared memory)."""
+    sample indices, collate, ship the batch back over the queue, through
+    per-batch shared memory, or through the native shm ring)."""
     from . import dataset as _ds
     _ds._worker_info = _ds._WorkerInfo(wid, -1, dataset)
     if init_fn is not None:
         init_fn(wid)
+    ring = None
+    if ring_name is not None:
+        try:
+            from ..native import ShmRing
+            ring = ShmRing.attach(ring_name)
+        except Exception:
+            ring = None
     while True:
         item = index_queue.get()
         if item is None:
             return
         seq, indices = item
+        pushed = [0]
         try:
             batch = collate([dataset[i] for i in indices])
-            if use_shm:
+            # batches too big for the ring (whole batch > half the ring,
+            # or any single record near capacity) go through per-batch
+            # SharedMemory segments — same stubs, the consumer handles
+            # both kinds in one materialize pass
+            if ring is not None and                     _ring_bytes(batch) <= _RING_CAPACITY // 2:
+                batch = _to_ring(batch, ring, pushed)
+            elif use_shm:
                 batch = _to_shm(batch)
             data_queue.put((seq, batch, None))
         except Exception as e:
-            data_queue.put((seq, None, e))
+            # resync stub: the consumer drains exactly the records this
+            # batch managed to push before failing (keeps the per-worker
+            # FIFO aligned for persistent pools)
+            data_queue.put((seq, ("__ring_drain__", pushed[0]), e))
 
 from ..core.tensor import Tensor
 from .dataset import IterableDataset
@@ -200,10 +265,35 @@ class DataLoader:
         collate = self.collate_fn if self._custom_collate else _np_collate
         index_queues = [ctx.Queue() for _ in range(self.num_workers)]
         data_queue = ctx.Queue()
+        # native ring transport: one mapped-once SPSC ring per worker
+        # (falls back to per-batch SharedMemory segments when the native
+        # lib is unavailable)
+        self._rings = None
+        ring_names = [None] * self.num_workers
+        if self.use_shared_memory:
+            created = []
+            try:
+                import os as _os
+                from ..native import ShmRing
+                names = [f"/pt_dl_{_os.getpid()}_{id(self) & 0xffffff}_{w}"
+                         for w in range(self.num_workers)]
+                for nm in names:
+                    created.append(ShmRing.create(nm, _RING_CAPACITY))
+                self._rings = created
+                ring_names = names
+            except Exception:
+                for r in created:   # partial failure must not leak shm
+                    try:
+                        r.close()
+                        r.free()
+                    except Exception:
+                        pass
+                self._rings = None
         procs = [ctx.Process(
             target=_worker_loop,
             args=(self.dataset, index_queues[w], data_queue, collate,
-                  self.worker_init_fn, w, self.use_shared_memory),
+                  self.worker_init_fn, w, self.use_shared_memory,
+                  ring_names[w]),
             daemon=True)
             for w in range(self.num_workers)]
         try:
@@ -259,34 +349,49 @@ class DataLoader:
                     seq, batch, err = self._queue_get(data_queue, procs)
                     received += 1
                     if err is not None:
+                        self._drain_ring_orphans(seq, batch)
                         raise err
+                    if self._rings is not None and batch is not None:
+                        # seq was dealt round-robin: worker = seq % W
+                        batch = _from_ring(
+                            batch, self._rings[seq % self.num_workers])
+                    if self.use_shared_memory and batch is not None:
+                        batch = _from_shm(batch)  # whole-batch fallback
                     done[seq] = batch
                     if sent < n:
                         index_queues[sent % self.num_workers].put(
                             (sent, batches[sent]))
                         sent += 1
                 b = done.pop(next_out)
-                if self.use_shared_memory:
-                    b = _from_shm(b)
                 next_out += 1
                 yield (self._to_tensor_tree(b) if not self._custom_collate
                        else b)
         finally:
             if not self.persistent_workers:
                 self._shutdown_pool(procs, index_queues)
+                self._free_rings()
             else:
                 # abandoned-epoch drain: in-flight results must not leak
                 # into the NEXT epoch's reorder buffer (seq restarts at 0),
                 # and their shm segments must be unlinked
                 while received < sent:
                     try:
-                        _, stale, _err = self._queue_get(data_queue, procs)
+                        sseq, stale, _err = self._queue_get(data_queue,
+                                                            procs)
                     except Exception:
                         break
                     received += 1
-                    if self.use_shared_memory and stale is not None:
+                    if stale is not None and self.use_shared_memory:
                         try:
-                            _from_shm(stale)  # attach + unlink
+                            if isinstance(stale, tuple) and stale and \
+                                    stale[0] == "__ring_drain__":
+                                self._drain_ring_orphans(sseq, stale)
+                            elif self._rings is not None:
+                                _from_ring(stale, self._rings[
+                                    sseq % self.num_workers])
+                                _from_shm(stale)
+                            else:
+                                _from_shm(stale)  # attach + unlink
                         except Exception:
                             pass
 
@@ -311,6 +416,30 @@ class DataLoader:
             except Exception:
                 pass
 
+    def _drain_ring_orphans(self, seq, stub):
+        """Pop records a failed batch left in its worker's ring (the
+        worker reports how many via the __ring_drain__ stub)."""
+        if (self._rings is None or not isinstance(stub, tuple) or not stub
+                or stub[0] != "__ring_drain__"):
+            return
+        ring = self._rings[seq % self.num_workers]
+        for _ in range(int(stub[1])):
+            try:
+                ring.pop(timeout_ms=1000)
+            except Exception:
+                break
+
+    def _free_rings(self):
+        rings = getattr(self, "_rings", None)
+        if rings:
+            for r in rings:
+                try:
+                    r.close()
+                    r.free()
+                except Exception:
+                    pass
+        self._rings = None
+
     def __del__(self):
         if getattr(self, "_handles", None) is not None:
             procs, index_queues, _ = self._handles
@@ -318,6 +447,10 @@ class DataLoader:
                 self._shutdown_pool(procs, index_queues)
             except Exception:
                 pass
+        try:
+            self._free_rings()
+        except Exception:
+            pass
 
     def __iter__(self):
         if self.num_workers == 0:

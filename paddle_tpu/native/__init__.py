@@ -45,7 +45,7 @@ def _build_lib():
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD)
     os.close(fd)
     cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
-           *srcs, "-o", tmp]
+           *srcs, "-o", tmp, "-lrt"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.rename(tmp, out)  # atomic: concurrent builders race benignly
@@ -106,6 +106,15 @@ def _bind(lib):
                                    c.c_long]),
         "pt_queue_size": (c.c_long, [c.c_void_p]),
         "pt_queue_close": (None, [c.c_void_p]),
+        # cross-process shm ring (dataloader worker transport)
+        "pt_ring_create": (c.c_void_p, [c.c_char_p, c.c_long]),
+        "pt_ring_attach": (c.c_void_p, [c.c_char_p]),
+        "pt_ring_push": (c.c_int, [c.c_void_p, c.c_char_p, c.c_long,
+                                   c.c_long]),
+        "pt_ring_pop": (c.c_long, [c.c_void_p, c.c_char_p, c.c_long,
+                                   c.c_long, c.POINTER(c.c_long)]),
+        "pt_ring_close": (None, [c.c_void_p]),
+        "pt_ring_free": (None, [c.c_void_p, c.c_int]),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)
@@ -328,3 +337,69 @@ def build_inference_capi():
             os.unlink(tmp)
         raise
     return out
+
+
+class ShmRing:
+    """Cross-process SPSC byte-record ring over POSIX shm (shm_ring.cc;
+    reference: the DataLoader shared-memory transport,
+    paddle/fluid/imperative/data_loader.cc). One ring per producer.
+
+    create(name, capacity) in the consumer; attach(name) in the worker;
+    push(bytes) / pop() -> bytes | None (timeout) | raises EOFError when
+    closed and drained."""
+
+    def __init__(self, handle, name, owner):
+        self._h = handle
+        self.name = name
+        self._owner = owner
+
+    @classmethod
+    def create(cls, name, capacity=8 << 20):
+        if not AVAILABLE:
+            raise RuntimeError("native lib unavailable")
+        h = LIB.pt_ring_create(name.encode(), capacity)
+        if not h:
+            raise OSError(f"shm ring create failed: {name}")
+        return cls(h, name, owner=True)
+
+    @classmethod
+    def attach(cls, name):
+        if not AVAILABLE:
+            raise RuntimeError("native lib unavailable")
+        h = LIB.pt_ring_attach(name.encode())
+        if not h:
+            raise OSError(f"shm ring attach failed: {name}")
+        return cls(h, name, owner=False)
+
+    def push(self, data, timeout_ms=10_000):
+        r = LIB.pt_ring_push(self._h, bytes(data), len(data), timeout_ms)
+        if r == -1:
+            raise TimeoutError("shm ring push timeout")
+        if r == -2:
+            raise EOFError("shm ring closed")
+        if r == -3:
+            raise ValueError("record larger than the ring capacity")
+        return True
+
+    def pop(self, timeout_ms=10_000, _bufcap=1 << 20):
+        import ctypes as c
+        while True:
+            buf = c.create_string_buffer(_bufcap)
+            need = c.c_long(0)
+            n = LIB.pt_ring_pop(self._h, buf, _bufcap, timeout_ms,
+                                c.byref(need))
+            if n >= 0:
+                return buf.raw[:n]
+            if n == -4:
+                _bufcap = max(need.value, _bufcap * 2)
+                continue
+            if n == -2:
+                raise EOFError("shm ring closed and drained")
+            return None  # timeout
+
+    def close(self):
+        LIB.pt_ring_close(self._h)
+
+    def free(self):
+        LIB.pt_ring_free(self._h, 1 if self._owner else 0)
+        self._h = None

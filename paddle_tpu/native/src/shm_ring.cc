@@ -1,0 +1,192 @@
+// Cross-process SPSC ring buffer over POSIX shared memory.
+//
+// Reference analogue: the shared-memory transport of the DataLoader
+// worker pipeline (paddle/fluid/imperative/data_loader.cc — workers hand
+// decoded batches to the trainer through shm without per-batch allocation;
+// the reference allocates per-tensor shm segments, here a fixed ring is
+// mapped ONCE and batches stream through it).
+//
+// Design: one ring per worker (SPSC — single producer, single consumer),
+// lock-free via acquire/release atomics on head/tail byte counters. A
+// record is [u64 len][payload]; records may physically wrap — reads and
+// writes are modular two-segment memcpys, so there are no wrap markers,
+// no alignment slivers, and any record up to capacity-8 bytes fits
+// whenever that much space is free (no livelock corner cases). Blocking
+// push/pop poll with short sleeps (portable across processes; no
+// robust-mutex machinery needed for SPSC).
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Header {
+  uint64_t data_cap;            // payload area bytes
+  std::atomic<uint64_t> head;   // total bytes consumed
+  std::atomic<uint64_t> tail;   // total bytes produced
+  std::atomic<uint32_t> closed;
+};
+
+struct Ring {
+  Header* h;
+  char* data;
+  size_t map_bytes;
+  char name[256];
+  int owner;
+};
+
+inline void sleep_us(long us) {
+  struct timespec ts;
+  ts.tv_sec = us / 1000000;
+  ts.tv_nsec = (us % 1000000) * 1000;
+  nanosleep(&ts, nullptr);
+}
+
+inline double now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1e3 + ts.tv_nsec / 1e6;
+}
+
+// modular two-segment copy: byte offset `at` is a running counter
+inline void ring_write(Ring* r, uint64_t at, const void* src, uint64_t n) {
+  uint64_t cap = r->h->data_cap;
+  uint64_t pos = at % cap;
+  uint64_t first = n < cap - pos ? n : cap - pos;
+  std::memcpy(r->data + pos, src, (size_t)first);
+  if (n > first) {
+    std::memcpy(r->data, reinterpret_cast<const char*>(src) + first,
+                (size_t)(n - first));
+  }
+}
+
+inline void ring_read(Ring* r, uint64_t at, void* dst, uint64_t n) {
+  uint64_t cap = r->h->data_cap;
+  uint64_t pos = at % cap;
+  uint64_t first = n < cap - pos ? n : cap - pos;
+  std::memcpy(dst, r->data + pos, (size_t)first);
+  if (n > first) {
+    std::memcpy(reinterpret_cast<char*>(dst) + first, r->data,
+                (size_t)(n - first));
+  }
+}
+
+Ring* map_ring(const char* name, long capacity, bool create) {
+  int flags = create ? (O_RDWR | O_CREAT | O_EXCL) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0) return nullptr;
+  size_t total = sizeof(Header) + (create ? (size_t)capacity : 0);
+  if (create) {
+    if (ftruncate(fd, (off_t)total) != 0) {
+      close(fd);
+      shm_unlink(name);
+      return nullptr;
+    }
+  } else {
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(Header)) {
+      close(fd);
+      return nullptr;
+    }
+    total = (size_t)st.st_size;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Ring* r = new Ring();
+  r->h = reinterpret_cast<Header*>(mem);
+  r->data = reinterpret_cast<char*>(mem) + sizeof(Header);
+  r->map_bytes = total;
+  r->owner = create ? 1 : 0;
+  snprintf(r->name, sizeof(r->name), "%s", name);
+  if (create) {
+    r->h->data_cap = (uint64_t)capacity;
+    r->h->head.store(0);
+    r->h->tail.store(0);
+    r->h->closed.store(0);
+  }
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_ring_create(const char* name, long capacity) {
+  if (capacity < (long)(2 * sizeof(uint64_t) + 64)) return nullptr;
+  return map_ring(name, capacity, true);
+}
+
+void* pt_ring_attach(const char* name) { return map_ring(name, 0, false); }
+
+// 0 = ok; -1 = timeout; -2 = closed; -3 = record larger than the ring
+int pt_ring_push(void* rp, const char* buf, long n, long timeout_ms) {
+  Ring* r = static_cast<Ring*>(rp);
+  Header* h = r->h;
+  uint64_t cap = h->data_cap;
+  uint64_t need = sizeof(uint64_t) + (uint64_t)n;
+  if (need > cap) return -3;
+  double deadline = now_ms() + timeout_ms;
+  for (;;) {
+    if (h->closed.load(std::memory_order_acquire)) return -2;
+    uint64_t head = h->head.load(std::memory_order_acquire);
+    uint64_t tail = h->tail.load(std::memory_order_relaxed);
+    if (tail - head + need <= cap) {
+      uint64_t n64 = (uint64_t)n;
+      ring_write(r, tail, &n64, sizeof(uint64_t));
+      ring_write(r, tail + sizeof(uint64_t), buf, (uint64_t)n);
+      h->tail.store(tail + need, std::memory_order_release);
+      return 0;
+    }
+    if (timeout_ms >= 0 && now_ms() > deadline) return -1;
+    sleep_us(100);
+  }
+}
+
+// >=0 = record size (copied into buf); -1 = timeout; -2 = closed and
+// drained; -4 = buf too small (size returned via *need_out)
+long pt_ring_pop(void* rp, char* buf, long bufcap, long timeout_ms,
+                 long* need_out) {
+  Ring* r = static_cast<Ring*>(rp);
+  Header* h = r->h;
+  double deadline = now_ms() + timeout_ms;
+  for (;;) {
+    uint64_t head = h->head.load(std::memory_order_relaxed);
+    uint64_t tail = h->tail.load(std::memory_order_acquire);
+    if (tail != head) {
+      uint64_t len;
+      ring_read(r, head, &len, sizeof(uint64_t));
+      if ((long)len > bufcap) {
+        if (need_out) *need_out = (long)len;
+        return -4;
+      }
+      ring_read(r, head + sizeof(uint64_t), buf, len);
+      h->head.store(head + sizeof(uint64_t) + len,
+                    std::memory_order_release);
+      return (long)len;
+    }
+    if (h->closed.load(std::memory_order_acquire)) return -2;
+    if (timeout_ms >= 0 && now_ms() > deadline) return -1;
+    sleep_us(100);
+  }
+}
+
+void pt_ring_close(void* rp) {
+  static_cast<Ring*>(rp)->h->closed.store(1, std::memory_order_release);
+}
+
+void pt_ring_free(void* rp, int unlink) {
+  Ring* r = static_cast<Ring*>(rp);
+  if (unlink) shm_unlink(r->name);
+  munmap(r->h, r->map_bytes);
+  delete r;
+}
+
+}  // extern "C"

@@ -472,7 +472,8 @@ def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
         else [filter_size, filter_size]
     w = _param([num_filters, input.shape[1] // groups] + list(ks),
                param_attr)
-    b = None if bias_attr is False else _param([num_filters], bias_attr)
+    b = None if bias_attr is False else _param(
+        [num_filters], bias_attr, default_init=Constant(0.0))
     return _dc(input, offset, w, bias=b, stride=stride, padding=padding,
                dilation=dilation, deformable_groups=deformable_groups,
                groups=groups, mask=mask)

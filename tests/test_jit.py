@@ -458,3 +458,39 @@ class TestStaticNN:
         assert list(c.shape) == [2, 4, 8, 8]
         ln = static.nn.layer_norm(x)
         assert list(ln.shape) == [4, 8]
+
+
+class TestModelZooUnderSotDefault:
+    """Round-4 verdict #2 done-criterion: model-zoo forwards run under the
+    DEFAULT to_static (opcode tier), match eager, and replay from cache."""
+
+    def test_lenet_and_llama_capture(self):
+        from paddle_tpu.jit.sot import sot_stats
+        paddle.seed(0)
+        from paddle_tpu.vision.models import LeNet
+        x = paddle.rand([2, 1, 28, 28])
+        net = LeNet()
+        net.eval()
+        eager = net(x).numpy()
+        before = sot_stats()["translations"]
+        sf = jit.to_static(net)
+        np.testing.assert_allclose(sf(x).numpy(), eager, rtol=2e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(sf(x).numpy(), eager, rtol=2e-5,
+                                   atol=1e-5)
+        assert sf._tier == "opcode"
+        plans = [p for ps in sf._plans.values() for p in ps]
+        assert plans and plans[0].valid and len(plans[0].segments) >= 1
+        assert sot_stats()["translations"] > before
+
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig.tiny(dtype="float32")
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids = paddle.to_tensor(np.random.default_rng(0).integers(
+            0, 128, (2, 16)).astype(np.int64))
+        ref = m(ids).numpy()
+        sfm = jit.to_static(m)
+        np.testing.assert_allclose(sfm(ids).numpy(), ref, rtol=2e-4,
+                                   atol=2e-4)
+        assert sfm._tier == "opcode"
