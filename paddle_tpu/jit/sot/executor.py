@@ -94,6 +94,7 @@ SUPPORTED_OPS = {
     "PUSH_EXC_INFO", "POP_EXCEPT", "RERAISE", "CHECK_EXC_MATCH",
     "RAISE_VARARGS", "BEFORE_WITH", "WITH_EXCEPT_START",
     "LOAD_ASSERTION_ERROR",
+    "LOAD_SUPER_ATTR",
 }
 
 
@@ -1205,26 +1206,22 @@ class Executor:
     _TENSOR_ESCAPE_ATTRS = {"item", "numpy", "tolist", "__dlpack__", "cpu",
                             "__array__"}
 
-    def _op_LOAD_ATTR(self, ins, mode):
-        is_method = bool(ins.arg & 1)
-        name = ins.argval
-        obj = self.stack.pop()
-        tainted = _tainted(obj)
-        obj_v = _u(obj)
-        if isinstance(obj_v, Tensor) and name in self._TENSOR_ESCAPE_ATTRS:
-            # host escape: resolving the bound method is fine; the CALL
-            # handler breaks. Mark the method so CALL recognizes it.
-            pass
-        v = getattr(obj_v, name)
-        if self.capture and not tainted and not isinstance(obj_v, Tensor) \
+    def _finish_attr_load(self, guard_ref, name, v, tainted, is_method):
+        """Shared tail of LOAD_ATTR / LOAD_SUPER_ATTR: guard the read,
+        record provenance, propagate taint, push per the method bit.
+        `guard_ref` must be a persistent object (instance or owner class)
+        a replay-time re-fetch can run against."""
+        if self.capture and not tainted and guard_ref is not None \
+                and not isinstance(guard_ref, Tensor) \
                 and not isinstance(v, types.ModuleType):
             if _guardable(v):
-                self._guard_read("attr", obj_v, name, v)
+                self._guard_read("attr", guard_ref, name, v)
         if self.capture and isinstance(v, Tensor):
-            self.provenance.setdefault(id(v._data), ("attr", obj_v, name))
+            self.provenance.setdefault(id(v._data),
+                                       ("attr", guard_ref, name))
         elif self.capture and not tainted and not _guardable(v) and \
-                not callable(v):
-            self.obj_provenance.setdefault(id(v), ("attr", obj_v, name))
+                not callable(v) and guard_ref is not None:
+            self.obj_provenance.setdefault(id(v), ("attr", guard_ref, name))
         if tainted and not isinstance(v, (types.MethodType,
                                           types.BuiltinMethodType)):
             v = _Taint(v)
@@ -1240,6 +1237,45 @@ class Executor:
             return None
         self.stack.append(v)
         return None
+
+    def _op_LOAD_ATTR(self, ins, mode):
+        is_method = bool(ins.arg & 1)
+        name = ins.argval
+        obj = self.stack.pop()
+        tainted = _tainted(obj)
+        obj_v = _u(obj)
+        if isinstance(obj_v, Tensor) and name in self._TENSOR_ESCAPE_ATTRS:
+            # host escape: resolving the bound method is fine; the CALL
+            # handler breaks. Mark the method so CALL recognizes it.
+            pass
+        v = getattr(obj_v, name)
+        return self._finish_attr_load(obj_v, name, v, tainted, is_method)
+
+    def _op_LOAD_SUPER_ATTR(self, ins, mode):
+        # super().name — stack: [super, __class__, self]
+        self_t = self.stack.pop()
+        cls_t = self.stack.pop()
+        sup_t = self.stack.pop()
+        tainted = _tainted(self_t, cls_t, sup_t)
+        self_obj, cls, sup = _u(self_t), _u(cls_t), _u(sup_t)
+        # honor a shadowed `super` global (CPython's unspecialized path
+        # CALLS the loaded value; using builtins.super unconditionally
+        # would silently diverge from eager execution)
+        sobj = sup(cls, self_obj) if callable(sup) else super(cls, self_obj)
+        name = ins.argval
+        v = getattr(sobj, name)
+        # guard against the MRO owner that actually defines the name — the
+        # transient super object cannot anchor a replay-time re-fetch, the
+        # defining class can (and a class-attr mutation then trips it)
+        owner = None
+        m = type(self_obj).__mro__ if self_obj is not None else ()
+        if cls in m:
+            for k in m[m.index(cls) + 1:]:
+                if name in getattr(k, "__dict__", {}):
+                    owner = k
+                    break
+        return self._finish_attr_load(owner, name, v, tainted,
+                                      bool(ins.arg & 1))
 
     def _op_STORE_ATTR(self, ins, mode):
         # mutation of an object: always a break region (close pre-pop)

@@ -321,3 +321,55 @@ class TestOpcodeExecutorIntegration:
         # same guards, other branch at replay: divergence -> concrete path
         np.testing.assert_allclose(float(branchy(paddle.full([3], -1.0))),
                                    -6.0)
+
+
+def test_super_call_in_forward():
+    """LOAD_SUPER_ATTR (super().forward pattern, common in Layer
+    subclasses) captures on the opcode tier."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    class Base(nn.Layer):
+        def forward(self, x):
+            return x * 2.0
+
+    class Child(Base):
+        def forward(self, x):
+            return super().forward(x) + 1.0
+
+    net = Child()
+    sf = paddle.jit.to_static(net)
+    x = paddle.ones([3])
+    np.testing.assert_allclose(sf(x).numpy(), [3, 3, 3])
+    np.testing.assert_allclose(sf(x).numpy(), [3, 3, 3])
+    assert sf._tier == "opcode"
+    plans = [p for ps in sf._plans.values() for p in ps]
+    assert plans and plans[0].valid
+
+
+def test_super_attr_read_guarded():
+    """A scalar read through super() (interpreted directly, not folded)
+    installs a guard on the MRO owner class: mutating the class attribute
+    invalidates the plan instead of replaying the stale constant."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    class GBase(nn.Layer):
+        scale = 2.0
+
+        def forward(self, x):
+            return x
+
+    class GChild(GBase):
+        def forward(self, x):
+            return x * super().scale
+
+    net = GChild()
+    sf = paddle.jit.to_static(net.forward)  # bound method: interpreted
+    x = paddle.ones([2])
+    np.testing.assert_allclose(sf(x).numpy(), [2, 2])
+    plans = [p for ps in sf._plans.values() for p in ps]
+    assert any(g.kind == "attr" and g.name == "scale"
+               for g in plans[0].guards)
+    GBase.scale = 5.0
+    np.testing.assert_allclose(sf(x).numpy(), [5, 5])
