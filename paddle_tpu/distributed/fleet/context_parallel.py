@@ -139,8 +139,14 @@ def ring_attention(query, key, value, causal=True, scale=None, mesh=None,
         spec = P(None, axis, None, None)
         fn = functools.partial(_ring_attention_local, axis_name=axis,
                                causal=causal, scale=scale)
-        return shard_map(fn, mesh=jm, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+        # manual ONLY over the ring axis (partial-auto): batch/head dims
+        # keep their dp/fsdp/mp GSPMD shardings inside a hybrid step.
+        # jax requires a jit context for partial-manual shard_map; the
+        # jit nests inline under an outer trace
+        sm = shard_map(fn, mesh=jm, in_specs=(spec, spec, spec),
+                       out_specs=spec, axis_names=frozenset({axis}),
+                       check_vma=False)
+        return jax.jit(sm)(q, k, v)
     return apply_op("ring_attention", impl, (query, key, value), {})
 
 
@@ -182,8 +188,10 @@ def ulysses_attention(query, key, value, causal=True, scale=None, mesh=None,
         spec = P(None, axis, None, None)
         fn = functools.partial(_ulysses_local, axis_name=axis, causal=causal,
                                scale=scale, p=p)
-        return shard_map(fn, mesh=jm, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+        sm = shard_map(fn, mesh=jm, in_specs=(spec, spec, spec),
+                       out_specs=spec, axis_names=frozenset({axis}),
+                       check_vma=False)
+        return jax.jit(sm)(q, k, v)
     return apply_op("ulysses_attention", impl, (query, key, value), {})
 
 

@@ -102,6 +102,20 @@ class LlamaAttention(nn.Layer):
                                     bias_attr=False)
         self.o_proj = nn.Linear(h, h, weight_attr=attr, bias_attr=False)
 
+    def _context_parallel_axis(self):
+        """The active ring axis when config.context_parallel is on and the
+        global mesh carries it with degree > 1; None otherwise."""
+        cp = getattr(self.config, "context_parallel", False)
+        if not cp:
+            return None
+        from ..distributed.mesh import get_mesh
+        axis = cp if isinstance(cp, str) else "sp"
+        mesh = get_mesh()
+        if mesh is not None and axis in mesh.dim_names \
+                and mesh.get_dim_size(axis) > 1:
+            return axis
+        return None
+
     def forward(self, hidden_states, position_ids=None, attn_mask=None):
         b, s = hidden_states.shape[0], hidden_states.shape[1]
         h = self.num_heads * self.head_dim
@@ -123,7 +137,17 @@ class LlamaAttention(nn.Layer):
         q, k, v = F.fused_rotary_position_embedding(
             q, k, v, position_ids=position_ids,
             use_neox_rotary_style=True, rotary_emb_base=self.config.rope_theta)
-        if attn_mask is None:
+        cp_axis = self._context_parallel_axis()
+        if cp_axis is not None and attn_mask is None:
+            # context parallelism (long-context first-class, SURVEY §5.7
+            # capability upgrade — absent from the reference core): the
+            # sequence dim is sharded over the cp axis and K/V blocks
+            # rotate the ICI ring with an online-softmax accumulator
+            from ..distributed.fleet.context_parallel import ring_attention
+            from ..distributed.mesh import get_mesh
+            out = ring_attention(q, k, v, causal=True, mesh=get_mesh(),
+                                 axis_name=cp_axis)
+        elif attn_mask is None:
             out, _ = F.flash_attention(q, k, v, causal=True)
         else:
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,

@@ -197,13 +197,19 @@ def make_train_step(model, mesh, meta, donate=True):
 
     def run(params, opt_state, batch):
         # jit traces lazily at the first call — force training mode for the
-        # duration so recompute/dropout gates see training=True at trace time
+        # duration so recompute/dropout gates see training=True at trace
+        # time, and expose the mesh as the global ProcessMesh so mesh-aware
+        # layers (context-parallel ring attention) resolve their axis
+        from ..distributed.mesh import ProcessMesh, get_mesh, set_mesh
         was_training = model.training
         model.train()
+        prev_mesh = get_mesh()
+        set_mesh(ProcessMesh(mesh))
         try:
             with mesh:
                 return jitted(params, opt_state, batch)
         finally:
+            set_mesh(prev_mesh)
             if not was_training:
                 model.eval()
 
