@@ -124,6 +124,32 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+@functools.lru_cache(maxsize=64)
+def _ring_jitted(jm, axis, causal, scale):
+    """One jitted partial-manual shard_map per (mesh, axis, causal, scale):
+    eager callers reuse the compiled executable per shape instead of
+    retracing every call (the jit cache lives on this wrapper). Manual
+    ONLY over the ring axis — batch/head dims keep their dp/fsdp/mp GSPMD
+    shardings inside a hybrid step; jax requires a jit context for
+    partial-manual shard_map, and the jit nests inline under outer traces."""
+    spec = P(None, axis, None, None)
+    fn = functools.partial(_ring_attention_local, axis_name=axis,
+                           causal=causal, scale=scale)
+    return jax.jit(shard_map(fn, mesh=jm, in_specs=(spec, spec, spec),
+                             out_specs=spec, axis_names=frozenset({axis}),
+                             check_vma=False))
+
+
+@functools.lru_cache(maxsize=64)
+def _ulysses_jitted(jm, axis, causal, scale, p):
+    spec = P(None, axis, None, None)
+    fn = functools.partial(_ulysses_local, axis_name=axis, causal=causal,
+                           scale=scale, p=p)
+    return jax.jit(shard_map(fn, mesh=jm, in_specs=(spec, spec, spec),
+                             out_specs=spec, axis_names=frozenset({axis}),
+                             check_vma=False))
+
+
 def ring_attention(query, key, value, causal=True, scale=None, mesh=None,
                    axis_name=None):
     """Ring attention over the `sep` (context) mesh axis.
@@ -136,17 +162,7 @@ def ring_attention(query, key, value, causal=True, scale=None, mesh=None,
     jm = mesh.jax_mesh
 
     def impl(q, k, v):
-        spec = P(None, axis, None, None)
-        fn = functools.partial(_ring_attention_local, axis_name=axis,
-                               causal=causal, scale=scale)
-        # manual ONLY over the ring axis (partial-auto): batch/head dims
-        # keep their dp/fsdp/mp GSPMD shardings inside a hybrid step.
-        # jax requires a jit context for partial-manual shard_map; the
-        # jit nests inline under an outer trace
-        sm = shard_map(fn, mesh=jm, in_specs=(spec, spec, spec),
-                       out_specs=spec, axis_names=frozenset({axis}),
-                       check_vma=False)
-        return jax.jit(sm)(q, k, v)
+        return _ring_jitted(jm, axis, causal, scale)(q, k, v)
     return apply_op("ring_attention", impl, (query, key, value), {})
 
 
@@ -185,13 +201,7 @@ def ulysses_attention(query, key, value, causal=True, scale=None, mesh=None,
             "head counts smaller than the ring")
 
     def impl(q, k, v):
-        spec = P(None, axis, None, None)
-        fn = functools.partial(_ulysses_local, axis_name=axis, causal=causal,
-                               scale=scale, p=p)
-        sm = shard_map(fn, mesh=jm, in_specs=(spec, spec, spec),
-                       out_specs=spec, axis_names=frozenset({axis}),
-                       check_vma=False)
-        return jax.jit(sm)(q, k, v)
+        return _ulysses_jitted(jm, axis, causal, scale, p)(q, k, v)
     return apply_op("ulysses_attention", impl, (query, key, value), {})
 
 

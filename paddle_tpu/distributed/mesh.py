@@ -17,6 +17,15 @@ class ProcessMesh:
             self._shape = mesh.shape
             self._dim_names = list(mesh.dim_names)
             self._process_ids = list(mesh.process_ids)
+            self._jax_mesh = None
+        elif isinstance(mesh, Mesh):
+            # wrap an existing jax Mesh (np.asarray on one would collapse
+            # to a 0-d object array: shape=[], no dim_names — a silently
+            # degenerate mesh)
+            self._shape = [mesh.shape[n] for n in mesh.axis_names]
+            self._dim_names = list(mesh.axis_names)
+            self._process_ids = [d.id for d in mesh.devices.ravel()]
+            self._jax_mesh = mesh
         else:
             arr = np.asarray(mesh)
             self._shape = list(arr.shape)
@@ -24,7 +33,7 @@ class ProcessMesh:
             if dim_names is None:
                 dim_names = [f"d{i}" for i in range(arr.ndim)]
             self._dim_names = list(dim_names)
-        self._jax_mesh = None
+            self._jax_mesh = None
 
     @property
     def shape(self):

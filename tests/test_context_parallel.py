@@ -133,16 +133,34 @@ class TestLlamaContextParallel:
                         np.int32),
                     "labels": rng.integers(0, 128, (4, 64)).astype(np.int32)}
         losses = {}
-        for cp in (False, True):
-            paddle.seed(123)
-            cfg = LlamaConfig(**base, context_parallel=cp)
-            model = LlamaForCausalLM(cfg)
-            mesh = pretrain.make_mesh(8, dp=2, fsdp=1, mp=2, sp=2)
-            params, opt_state, meta = pretrain.make_train_state(model, mesh)
-            step = pretrain.make_train_step(model, mesh, meta)
-            batch = pretrain.shard_batch(dict(batch_np), mesh)
-            _, _, loss, gnorm = step(params, opt_state, batch)
-            losses[cp] = (float(loss), float(gnorm))
+        from paddle_tpu.distributed.fleet import context_parallel as CP
+        calls = {"ring": 0}
+        orig = CP.ring_attention
+
+        def counting_ring(*a, **k):
+            calls["ring"] += 1
+            return orig(*a, **k)
+
+        import paddle_tpu.models.llama  # noqa: F401 (imports by module path)
+        CP.ring_attention = counting_ring
+        try:
+            for cp in (False, True):
+                paddle.seed(123)
+                cfg = LlamaConfig(**base, context_parallel=cp)
+                model = LlamaForCausalLM(cfg)
+                mesh = pretrain.make_mesh(8, dp=2, fsdp=1, mp=2, sp=2)
+                params, opt_state, meta = pretrain.make_train_state(model,
+                                                                    mesh)
+                step = pretrain.make_train_step(model, mesh, meta)
+                batch = pretrain.shard_batch(dict(batch_np), mesh)
+                _, _, loss, gnorm = step(params, opt_state, batch)
+                losses[cp] = (float(loss), float(gnorm))
+        finally:
+            CP.ring_attention = orig
+        # the ring branch must have actually RUN for the cp config (the
+        # review caught a degenerate global mesh silently disabling CP —
+        # this assertion makes that class of regression loud)
+        assert calls["ring"] >= cfg.num_hidden_layers, calls
         # same init, same batch: ring attention must reproduce the flash
         # path's loss AND gradient norm (fwd+bwd correctness through the
         # ppermute ring inside the hybrid step)
