@@ -25,6 +25,9 @@ from .pipeline_schedule import (pipeline_1f1b, pipeline_gpipe,
                                 stack_stage_params)
 from .context_parallel import (ring_attention, ulysses_attention,
                                split_sequence, SegmentParallel)
+from .log_util import (logger, get_logger, set_log_level,
+                       get_log_level_code, get_log_level_name,
+                       get_sync_logger, layer_to_str)
 
 
 class DistributedStrategy:

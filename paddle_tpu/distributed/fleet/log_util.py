@@ -1,0 +1,75 @@
+"""Rank-aware logging tier (reference:
+python/paddle/distributed/fleet/utils/log_util.py — rank-prefixed logger,
+set_log_level, and the pipeline-timeline sync logger; SURVEY §5.5).
+
+Single-controller note: one python process drives all local devices, so
+"rank" here is the host process index (jax.process_index) — the per-rank
+workerlog.N files of the launcher carry the per-worker streams, and this
+module carries the in-process rank prefix + level control.
+"""
+import logging
+import sys
+
+__all__ = ["logger", "get_logger", "set_log_level", "get_log_level_code",
+           "get_log_level_name", "get_sync_logger", "layer_to_str"]
+
+
+class _RankFilter(logging.Filter):
+    def filter(self, record):
+        try:
+            import jax
+            record.rank = jax.process_index()
+            record.world = jax.process_count()
+        except Exception:
+            record.rank, record.world = 0, 1
+        return True
+
+
+def get_logger(level="INFO", name="paddle_tpu.fleet"):
+    lg = logging.getLogger(name)
+    if not any(isinstance(f, _RankFilter) for f in lg.filters):
+        lg.addFilter(_RankFilter())
+    if not lg.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s [rank %(rank)s/%(world)s] %(levelname)s "
+            "%(name)s: %(message)s"))
+        lg.addHandler(h)
+        lg.propagate = False
+    if isinstance(level, str):
+        lg.setLevel(level.upper())
+    else:
+        lg.setLevel(level)
+    return lg
+
+
+logger = get_logger("INFO")
+
+
+def set_log_level(level):
+    """fleet.set_log_level (reference log_util.set_log_level)."""
+    assert isinstance(level, (str, int)), "level must be str or int"
+    logger.setLevel(level.upper() if isinstance(level, str) else level)
+
+
+def get_log_level_code():
+    return logger.getEffectiveLevel()
+
+
+def get_log_level_name():
+    return logging.getLevelName(get_log_level_code())
+
+
+def get_sync_logger():
+    """Pipeline-timeline logger (reference pipeline_parallel.py:700
+    get_sync_logger): a separate channel for schedule stamps so the
+    per-stage timeline can be grepped out of mixed logs."""
+    return get_logger("INFO", "paddle_tpu.fleet.sync")
+
+
+def layer_to_str(base, *args, **kwargs):
+    """Reference log_util.layer_to_str: render a layer construction call
+    for topology dumps."""
+    parts = [repr(a) for a in args]
+    parts += [f"{k}={v!r}" for k, v in kwargs.items()]
+    return f"{base}({', '.join(parts)})"

@@ -80,8 +80,11 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     if _flash_available() and dropout == 0.0 and not return_softmax:
         from ...ops.pallas import flash_attention as pallas_flash
         try:
+            bq, bk = pallas_flash.tuned_blocks(query, key, value, causal)
+
             def impl(q, k, v):
-                return pallas_flash.flash_attention_bshd(q, k, v, causal=causal)
+                return pallas_flash.flash_attention_bshd(
+                    q, k, v, causal=causal, block_q=bq, block_k=bk)
             out = apply_op("flash_attention", impl, (query, key, value), {})
             return out, None
         except Exception:

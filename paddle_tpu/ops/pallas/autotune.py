@@ -1,0 +1,105 @@
+"""Kernel autotune layer (reference: paddle/phi/kernels/autotune/ —
+cache + gpu_timer: time candidate algorithms once per key, remember the
+winner across the process AND across runs).
+
+TPU-native shape: XLA autotunes its own fusions; what's left to tune are
+the PALLAS grid parameters (flash-attention block sizes, paged-KV block
+shapes). The tuner times candidate configs on the live inputs the first
+time a (kernel, shape-class) key is seen in EAGER mode, then serves the
+winner from an in-memory + on-disk JSON cache (write-through, atomic
+replace). Under a trace, timing is impossible — the cached winner (or the
+measured default) is used.
+
+Enable with FLAGS_use_autotune (reference flag of the same name); the
+cache path follows FLAGS_autotune_cache_file or
+~/.cache/paddle_tpu/autotune.json.
+"""
+import json
+import os
+import time
+
+__all__ = ["autotune", "cache_stats", "clear_cache"]
+
+_mem = None
+_stats = {"hits": 0, "misses": 0, "tuned": 0}
+
+
+def _cache_path():
+    return os.environ.get(
+        "PADDLE_TPU_AUTOTUNE_CACHE",
+        os.path.expanduser("~/.cache/paddle_tpu/autotune.json"))
+
+
+def _load():
+    global _mem
+    if _mem is None:
+        try:
+            with open(_cache_path()) as f:
+                _mem = json.load(f)
+        except Exception:
+            _mem = {}
+    return _mem
+
+
+def _save():
+    path = _cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(_mem, f)
+        os.replace(tmp, path)
+    except Exception:
+        pass  # cache is an optimization; never fail the op
+
+
+def cache_stats():
+    return dict(_stats, entries=len(_load()))
+
+
+def clear_cache():
+    global _mem
+    _mem = {}
+    try:
+        os.unlink(_cache_path())
+    except FileNotFoundError:
+        pass
+
+
+def autotune(key, candidates, run, reps=3):
+    """Return the best candidate for `key`.
+
+    `run(candidate)` executes the kernel with that config and returns a
+    value to block on (jax array). Timing: one warmup (compile) + `reps`
+    timed calls per candidate. The winner persists in the JSON cache keyed
+    by `key` (a string). A candidate that raises is skipped (e.g. a block
+    shape the kernel rejects)."""
+    import jax
+    cache = _load()
+    key = str(key)
+    hit = cache.get(key)
+    if hit is not None:
+        _stats["hits"] += 1
+        # stored as a list (JSON); candidates are tuples
+        hit = tuple(hit) if isinstance(hit, list) else hit
+        return hit
+    _stats["misses"] += 1
+    best, best_t = None, None
+    for cand in candidates:
+        try:
+            jax.block_until_ready(run(cand))  # warmup/compile
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = run(cand)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / reps
+        except Exception:
+            continue
+        if best_t is None or dt < best_t:
+            best, best_t = cand, dt
+    if best is None:
+        raise RuntimeError(f"autotune: every candidate failed for {key}")
+    _stats["tuned"] += 1
+    cache[key] = list(best) if isinstance(best, tuple) else best
+    _save()
+    return best

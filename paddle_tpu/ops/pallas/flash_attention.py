@@ -381,3 +381,37 @@ def flash_attention_bshd(q, k, v, causal=True, scale=None,
         jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
         causal=causal, scale=scale, block_q=block_q, block_k=block_k)
     return jnp.swapaxes(o, 1, 2)
+
+
+def tuned_blocks(q, k, v, causal=True):
+    """(block_q, block_k) for this shape class: the autotuned winner when
+    FLAGS_use_autotune is on and inputs are concrete (eager), the
+    persisted winner if one exists, the measured defaults otherwise
+    (reference: phi/kernels/autotune cache keyed per shape/dtype)."""
+    from ...utils import flags as _flags
+    import jax as _jax
+    b, s, h, d = q.shape
+    defaults = (_pick_block(s, DEFAULT_BLOCK_Q),
+                _pick_block(s, DEFAULT_BLOCK_K))
+    if not _flags.use_autotune:
+        return defaults
+    from . import autotune as _at
+    key = f"flash_bshd:s{s}:h{h}:d{d}:{q.dtype}:causal={int(bool(causal))}"
+    cached = _at._load().get(key)
+    if cached is not None:
+        return tuple(cached)
+    arrs = [getattr(x, "data", x) for x in (q, k, v)]
+    if any(isinstance(a, _jax.core.Tracer) for a in arrs):
+        return defaults  # cannot time under a trace
+    cands = []
+    for bq in (256, 512, 1024, 2048):
+        for bk in (256, 512, 1024, 2048):
+            if bq <= max(s, 256) and bk <= max(s, 256):
+                cands.append((_pick_block(s, bq), _pick_block(s, bk)))
+    cands = sorted(set(cands))
+
+    def run(c):
+        return flash_attention_bshd(arrs[0], arrs[1], arrs[2], causal=causal,
+                                    block_q=c[0], block_k=c[1])
+
+    return _at.autotune(key, cands, run)

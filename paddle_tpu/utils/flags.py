@@ -49,12 +49,17 @@ _DEFS = [
      "Collective watchdog tracing (comm_task_manager.h analogue)."),
     ("stop_check_timeout", "900",
      "Seconds a rank waits at bootstrap barriers before declaring a hang."),
+    ("use_autotune", "false",
+     "Autotune Pallas kernel grid parameters (reference FLAGS_use_autotune "
+     "+ phi/kernels/autotune cache): time candidates once per shape class, "
+     "persist winners in ~/.cache/paddle_tpu/autotune.json."),
 ]
 
 # hot-path mirrors (read by core.dispatch every op)
 check_nan_inf = False
 check_nan_inf_level = 0
 benchmark_mode = False
+use_autotune = False
 
 
 def _define_all():
@@ -97,10 +102,11 @@ def _coerce(v):
 
 
 def _refresh_mirrors():
-    global check_nan_inf, check_nan_inf_level, benchmark_mode
+    global check_nan_inf, check_nan_inf_level, benchmark_mode, use_autotune
     check_nan_inf = bool(_coerce(_get_raw("check_nan_inf")))
     check_nan_inf_level = int(_coerce(_get_raw("check_nan_inf_level")) or 0)
     benchmark_mode = bool(_coerce(_get_raw("benchmark")))
+    use_autotune = bool(_coerce(_get_raw("use_autotune")))
 
 
 def set_flags(flags):

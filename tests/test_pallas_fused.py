@@ -555,3 +555,58 @@ class TestFusedMultiTransformerGQA:
         assert out1.shape == (2, 6)
         np.testing.assert_array_equal(out1, out2)
         assert eng.new_caches(2)[0].shape == (2, 2, G, 32, D)
+
+
+class TestKernelAutotune:
+    """Kernel autotune layer (reference paddle/phi/kernels/autotune/ —
+    round-4 closure of the §2.9 'autotune partial' row)."""
+
+    def test_autotune_picks_and_caches(self, tmp_path, monkeypatch):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas import autotune as AT
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "at.json"))
+        AT.clear_cache()
+        calls = []
+
+        def run(c):
+            calls.append(c)
+            import time
+            if c == "slow":
+                time.sleep(0.02)
+            return jnp.zeros(())
+
+        best = AT.autotune("k1", ["slow", "fast"], run, reps=1)
+        assert best == "fast"
+        n = len(calls)
+        # second lookup: served from cache, run not called again
+        assert AT.autotune("k1", ["slow", "fast"], run) == "fast"
+        assert len(calls) == n
+        # cache survives a fresh in-memory state (disk roundtrip)
+        AT._mem = None
+        assert AT.autotune("k1", ["slow", "fast"], run) == "fast"
+        assert len(calls) == n
+
+    def test_failing_candidates_skipped(self, tmp_path, monkeypatch):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas import autotune as AT
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "at2.json"))
+        AT.clear_cache()
+
+        def run(c):
+            if c[0] == 0:
+                raise ValueError("bad block")
+            return jnp.zeros(())
+
+        assert AT.autotune("k2", [(0, 1), (2, 2)], run, reps=1) == (2, 2)
+        import pytest as _pt
+        with _pt.raises(RuntimeError):
+            AT.autotune("k3", [(0, 1)], run, reps=1)
+
+    def test_tuned_blocks_defaults_without_flag(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.ops.pallas.flash_attention import tuned_blocks
+        q = paddle.randn([1, 512, 4, 64])
+        bq, bk = tuned_blocks(q, q, q, causal=True)
+        assert bq >= 256 and bk >= 256  # defaults clamped to the sequence
