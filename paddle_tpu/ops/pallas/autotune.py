@@ -75,6 +75,15 @@ def autotune(key, candidates, run, reps=3):
     by `key` (a string). A candidate that raises is skipped (e.g. a block
     shape the kernel rejects)."""
     import jax
+    import numpy as np
+
+    def sync(x):
+        # a real host readback: block_until_ready is a no-op through the
+        # remote-device tunnel, which made async dispatch time (~constant)
+        # masquerade as kernel time and crowned garbage winners
+        leaf = jax.tree_util.tree_leaves(x)[0]
+        np.asarray(leaf.ravel()[:1] if hasattr(leaf, "ravel") else leaf)
+
     cache = _load()
     key = str(key)
     hit = cache.get(key)
@@ -87,11 +96,11 @@ def autotune(key, candidates, run, reps=3):
     best, best_t = None, None
     for cand in candidates:
         try:
-            jax.block_until_ready(run(cand))  # warmup/compile
+            sync(run(cand))  # warmup/compile
             t0 = time.perf_counter()
             for _ in range(reps):
                 out = run(cand)
-            jax.block_until_ready(out)
+            sync(out)
             dt = (time.perf_counter() - t0) / reps
         except Exception:
             continue

@@ -411,7 +411,11 @@ def tuned_blocks(q, k, v, causal=True):
     cands = sorted(set(cands))
 
     def run(c):
-        return flash_attention_bshd(arrs[0], arrs[1], arrs[2], causal=causal,
-                                    block_q=c[0], block_k=c[1])
+        # time the COMPILED kernel (scalar readback): an eager run would
+        # mostly time per-op dispatch, which through a device tunnel
+        # dwarfs the kernel and crowns arbitrary winners
+        f = _jax.jit(lambda a, b, cv: flash_attention_bshd(
+            a, b, cv, causal=causal, block_q=c[0], block_k=c[1]).sum())
+        return f(arrs[0], arrs[1], arrs[2])
 
-    return _at.autotune(key, cands, run)
+    return _at.autotune(key, cands, run, reps=10)
