@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ....core.dispatch import apply_op
+from ....core import random as _random
 from ....nn.functional.rope import fused_rotary_position_embedding  # noqa: F401
 
 NEG_INF_F = -1e30
@@ -61,6 +62,7 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         lb = next(it) if linear_bias is not None else None
         lns = next(it) if ln_scale is not None else None
         lnb = next(it) if ln_bias is not None else None
+        kit = iter(list(it))  # trailing args are the dropout keys
 
         h = _ln(xa, pre_ln_epsilon, plns, plnb) if pre_layer_norm else xa
         b, s, dm = h.shape
@@ -85,7 +87,7 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
             logits = logits + mask_arr.astype(logits.dtype)
         p = jax.nn.softmax(logits, axis=-1)
         if training and attn_dropout_rate > 0.0:
-            keep = jax.random.bernoulli(_random.next_key(),
+            keep = jax.random.bernoulli(next(kit),
                                         1.0 - attn_dropout_rate, p.shape)
             p = jnp.where(keep, p / (1.0 - attn_dropout_rate), 0.0)
         ctx = jnp.einsum("bnst,btnh->bsnh", p,
@@ -94,7 +96,7 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         if lb is not None:
             out = out + lb
         if training and dropout_rate > 0.0:
-            keep = jax.random.bernoulli(_random.next_key(),
+            keep = jax.random.bernoulli(next(kit),
                                         1.0 - dropout_rate, out.shape)
             out = jnp.where(keep, out / (1.0 - dropout_rate), 0.0)
         out = xa + out                             # residual
@@ -102,11 +104,17 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
             out = _ln(out, ln_epsilon, lns, lnb)
         return out if new_cache is None else (out, new_cache)
 
+    # dropout keys ride as INPUT leaves (philox-as-data discipline,
+    # core/random.py): the op stays vjp-cacheable and every capture tier
+    # re-draws per call
+    n_keys = int(training and attn_dropout_rate > 0.0) + \
+        int(training and dropout_rate > 0.0)
     args = [x, qkv_weight, linear_weight]
     for t in (cache_kv, attn_mask, pre_ln_scale, pre_ln_bias, qkv_bias,
               linear_bias, ln_scale, ln_bias):
         if t is not None:
             args.append(t)
+    args += [_random.fresh_key_tensor() for _ in range(n_keys)]
     return apply_op("fused_multi_head_attention", impl, tuple(args), {})
 
 
@@ -127,13 +135,12 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         s2 = next(it) if ln2_scale is not None else None
         sb2 = next(it) if ln2_bias is not None else None
 
-        from ....core import random as _random
+        kit = iter(list(it))  # trailing args are the dropout keys
 
         def _drop(t, rate):
             if not training or rate <= 0.0:
                 return t
-            keep = jax.random.bernoulli(_random.next_key(), 1.0 - rate,
-                                        t.shape)
+            keep = jax.random.bernoulli(next(kit), 1.0 - rate, t.shape)
             return jnp.where(keep, t / (1.0 - rate), 0.0)
 
         h = _ln(xa, ln1_epsilon, s1, sb1) if pre_layer_norm else xa
@@ -157,6 +164,9 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
               ln2_bias):
         if t is not None:
             args.append(t)
+    n_keys = int(training and dropout1_rate > 0.0) + \
+        int(training and dropout2_rate > 0.0)
+    args += [_random.fresh_key_tensor() for _ in range(n_keys)]
     return apply_op("fused_feedforward", impl, tuple(args), {})
 
 
@@ -180,22 +190,24 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
     """Reference fused_dropout_add_kernel.cu: dropout(x) + y."""
     from ....core import random as _random
 
-    def impl(xa, ya):
+    def impl(xa, ya, *rk):
         if mode == "downscale_in_infer":
             # train: drop without rescale; infer: scale by (1-p)
             if not training:
                 return xa * (1.0 - p) + ya
             if p == 0.0:
                 return xa + ya
-            keep = jax.random.bernoulli(_random.next_key(), 1.0 - p,
-                                        xa.shape)
+            keep = jax.random.bernoulli(rk[0], 1.0 - p, xa.shape)
             return jnp.where(keep, xa, 0.0) + ya
         if not training or p == 0.0:
             return xa + ya
-        keep = jax.random.bernoulli(_random.next_key(), 1.0 - p, xa.shape)
+        keep = jax.random.bernoulli(rk[0], 1.0 - p, xa.shape)
         return jnp.where(keep, xa / (1.0 - p), 0.0) + ya
 
-    return apply_op("fused_dropout_add", impl, (x, y), {})
+    args = (x, y)
+    if training and p > 0.0:
+        args = args + (_random.fresh_key_tensor(),)
+    return apply_op("fused_dropout_add", impl, args, {})
 
 
 def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
@@ -212,7 +224,7 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
         lb = next(it) if ln_bias is not None else None
         h = xa if b is None else xa + b
         if training and dropout_rate > 0.0:
-            keep = jax.random.bernoulli(_random.next_key(),
+            keep = jax.random.bernoulli(next(it),
                                         1.0 - dropout_rate, h.shape)
             h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0)
         return _ln(h + res, ln_epsilon, s, lb)
@@ -221,6 +233,8 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
     for t in (bias, ln_scale, ln_bias):
         if t is not None:
             args.append(t)
+    if training and dropout_rate > 0.0:
+        args.append(_random.fresh_key_tensor())
     return apply_op("fused_bias_dropout_residual_layer_norm", impl,
                     tuple(args), {})
 

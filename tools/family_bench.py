@@ -1,6 +1,9 @@
 """Measure BASELINE configs 3-5 on the real chip: ERNIE MLM train step,
 ViT-L train step, conditional UNet train step (jitted fwd+bwd+sgd)."""
 import time
+import os
+import sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np
 import jax, jax.numpy as jnp
 from paddle_tpu.jit.functional import state_arrays, pure_call
