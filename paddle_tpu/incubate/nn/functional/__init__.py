@@ -50,8 +50,6 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     """Reference fused_attention_kernel.cu semantics: [pre-LN] -> QKV proj
     -> MHA -> out proj -> residual add [-> post-LN]. One traced graph —
     XLA fuses what the CUDA megakernel fuses by hand."""
-    from ....core import random as _random
-
     def impl(xa, qkvw, lw, *rest):
         it = iter(rest)
         cache = next(it) if cache_kv is not None else None
@@ -62,7 +60,7 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         lb = next(it) if linear_bias is not None else None
         lns = next(it) if ln_scale is not None else None
         lnb = next(it) if ln_bias is not None else None
-        kit = iter(list(it))  # trailing args are the dropout keys
+        kit = it  # trailing args are the dropout keys
 
         h = _ln(xa, pre_ln_epsilon, plns, plnb) if pre_layer_norm else xa
         b, s, dm = h.shape
@@ -135,7 +133,7 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         s2 = next(it) if ln2_scale is not None else None
         sb2 = next(it) if ln2_bias is not None else None
 
-        kit = iter(list(it))  # trailing args are the dropout keys
+        kit = it  # trailing args are the dropout keys
 
         def _drop(t, rate):
             if not training or rate <= 0.0:
@@ -188,8 +186,6 @@ def fused_bias_act(x, bias=None, act_method="gelu"):
 
 def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
     """Reference fused_dropout_add_kernel.cu: dropout(x) + y."""
-    from ....core import random as _random
-
     def impl(xa, ya, *rk):
         if mode == "downscale_in_infer":
             # train: drop without rescale; infer: scale by (1-p)
@@ -215,8 +211,6 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
                                            dropout_rate=0.0, ln_epsilon=1e-5,
                                            training=True):
     """Reference fused_bias_dropout_residual_layer_norm_kernel.cu."""
-    from ....core import random as _random
-
     def impl(xa, res, *rest):
         it = iter(rest)
         b = next(it) if bias is not None else None
@@ -447,7 +441,7 @@ def fused_multi_transformer(
     dq = _dequant or (lambda w, kind, li: w)
 
     def impl(xa, lns, lnb, qkvw, qkvb, linw, linb, flns, flnb, f1w, f1b,
-             f2w, f2b, caches, pres, rotary, tstep, mask, slens):
+             f2w, f2b, caches, pres, rotary, tstep, mask, slens, dkeys):
         b, s, e = xa.shape
         norm = (lambda h, sc, bi: _rms(h, epsilon, sc)) \
             if norm_type == "rmsnorm" else \
@@ -582,9 +576,8 @@ def fused_multi_transformer(
             if linb and linb[li] is not None:
                 attn = attn + linb[li]
             if training and dropout_rate:
-                from ....core import random as _rng
                 keep = jax.random.bernoulli(
-                    _rng.next_key(), 1.0 - dropout_rate, attn.shape)
+                    dkeys[li], 1.0 - dropout_rate, attn.shape)
                 attn = jnp.where(keep, attn / (1.0 - dropout_rate), 0.0) \
                     if mode == "upscale_in_train" else \
                     jnp.where(keep, attn, 0.0)
@@ -621,7 +614,11 @@ def fused_multi_transformer(
          list(ffn_ln_biases or []), list(ffn1_weights),
          list(ffn1_biases or []), list(ffn2_weights), list(ffn2_biases or []),
          list(caches_in), list(pre_in), rotary_embs, time_step, attn_mask,
-         seq_lens),
+         seq_lens,
+         # per-layer dropout keys as input leaves (vjp-cacheable +
+         # trace-safe, like the other fused ops)
+         [_random.fresh_key_tensor() for _ in range(n_layers)]
+         if training and dropout_rate else []),
         {}, differentiable=bool(training) and not caches_in)
     outs = out if isinstance(out, tuple) else (out,)
     h = outs[0]
