@@ -89,15 +89,20 @@ class GradNode:
     tape and supports another backward."""
 
     __slots__ = ("name", "vjp_fn", "parents", "out_avals", "n_outputs",
-                 "impl", "treedef", "plain", "diff_idx")
+                 "impl", "treedef", "plain", "diff_idx", "multi_out")
 
     def __init__(self, name, vjp_fn, parents, out_avals,
-                 impl=None, treedef=None, plain=None, diff_idx=None):
+                 impl=None, treedef=None, plain=None, diff_idx=None,
+                 multi_out=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.parents = [TapeRef(p) for p in parents]  # strong refs keep graph alive
         self.out_avals = out_avals      # list[(shape, dtype)]
         self.n_outputs = len(out_avals)
+        # a 1-element TUPLE output must receive a 1-tuple cotangent — the
+        # vjp structure follows the impl's return tree, not the count
+        self.multi_out = (self.n_outputs > 1 if multi_out is None
+                          else bool(multi_out))
         self.impl = impl
         self.treedef = treedef
         self.plain = plain
@@ -166,7 +171,7 @@ def _node_grad_traced(node, couts):
             return impl(*a, **k)
 
         _, vjp_fn = jax.vjp(fwd, *prim)
-        gs = vjp_fn(tuple(cts) if node.n_outputs > 1 else cts[0])
+        gs = vjp_fn(tuple(cts) if node.multi_out else cts[0])
         traced = [g for g, ok in zip(gs, inexact) if ok]
         return tuple(traced) if len(traced) > 1 else traced[0]
 
@@ -274,7 +279,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             in_grads = _node_grad_traced(node, couts)
         else:
             in_grads = node.vjp_fn(
-                tuple(couts) if node.n_outputs > 1 else couts[0])
+                tuple(couts) if node.multi_out else couts[0])
         for ref, g in zip(node.parents, in_grads):
             t = ref.tensor
             for hook in t._hooks:

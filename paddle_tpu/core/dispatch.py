@@ -207,7 +207,7 @@ def _apply_op_inner(name, impl, args, kwargs, differentiable=True):
     node = GradNode(name, vjp_fn, parents,
                     [(o.shape, o.dtype) for o in outs],
                     impl=impl, treedef=treedef, plain=plain,
-                    diff_idx=diff_idx)
+                    diff_idx=diff_idx, multi_out=multi)
     wrapped = _wrap(name, out, node=node)
     if _static_recorder is not None:
         _static_recorder(name, impl, treedef, leaves, tensor_idx, wrapped)
