@@ -132,6 +132,11 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
     return fut
 
 
+def get_worker_info(name):
+    """Reference rpc.get_worker_info(name): WorkerInfo by worker name."""
+    return _state["infos"][name]
+
+
 def get_current_worker_info():
     return _state["infos"][_state["name"]]
 
