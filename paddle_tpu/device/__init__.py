@@ -17,6 +17,7 @@ __all__ = [
     "set_device", "get_device", "device_count", "is_compiled_with_tpu",
     "is_compiled_with_cuda", "memory_allocated", "max_memory_allocated",
     "memory_reserved", "reset_max_memory_allocated", "host_memory_stats",
+    "record_donation", "donation_stats", "reset_donation_stats",
     "tpu", "cuda",
 ]
 
@@ -251,3 +252,49 @@ class stream_guard:
 
 def synchronize(device=None):
     jax.effects_barrier()
+
+
+# -- donation bookkeeping ----------------------------------------------------
+# Reference role: AllocatorFacade's stats + the buffer-reuse accounting the
+# reference keeps per allocation (SURVEY §2.1 — on TPU the HBM arena is
+# PJRT's, so what remains OURS to track is buffer DONATION: which jitted
+# calls hand their argument buffers back for reuse, and how many bytes
+# that recycles per step).
+
+_donation = {"calls": 0, "donated_bytes": 0, "by_site": {}}
+
+
+def record_donation(site, *trees):
+    """Account one donating call: `trees` are the donated pytrees (their
+    buffers are consumed by the call). Called by framework donation sites
+    (pretrain train step, serving engine caches); user code with its own
+    donate_argnums may call it too."""
+    import jax
+    import numpy as np
+    nbytes = 0
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            sz = getattr(leaf, "nbytes", None)
+            if sz is None and hasattr(leaf, "shape"):
+                sz = int(np.prod(leaf.shape)) * \
+                    np.dtype(leaf.dtype).itemsize
+            nbytes += int(sz or 0)
+    _donation["calls"] += 1
+    _donation["donated_bytes"] += nbytes
+    site_d = _donation["by_site"].setdefault(
+        str(site), {"calls": 0, "bytes": 0})
+    site_d["calls"] += 1
+    site_d["bytes"] += nbytes
+    return nbytes
+
+
+def donation_stats():
+    """{calls, donated_bytes, by_site} since start/reset: how much HBM the
+    donating call sites recycle instead of re-allocating."""
+    out = dict(_donation)
+    out["by_site"] = {k: dict(v) for k, v in _donation["by_site"].items()}
+    return out
+
+
+def reset_donation_stats():
+    _donation.update({"calls": 0, "donated_bytes": 0, "by_site": {}})

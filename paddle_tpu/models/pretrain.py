@@ -206,6 +206,9 @@ def make_train_step(model, mesh, meta, donate=True):
         prev_mesh = get_mesh()
         set_mesh(ProcessMesh(mesh))
         try:
+            if donate:
+                from ..device import record_donation
+                record_donation("pretrain.train_step", params, opt_state)
             with mesh:
                 return jitted(params, opt_state, batch)
         finally:

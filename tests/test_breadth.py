@@ -449,3 +449,44 @@ class TestEdgeCompletion:
                                   paddle.to_tensor(w), stride=1, padding=1,
                                   groups=2, deformable_groups=dg).numpy()
                 np.testing.assert_allclose(o, ref, atol=1e-4)
+
+
+class TestDonationBookkeeping:
+    """Donation bookkeeping API (round-4 closure of the §2.1 allocator
+    'stats + donation only, no bookkeeping API' note): donating call
+    sites account the HBM bytes they recycle."""
+
+    def test_record_and_stats(self):
+        from paddle_tpu import device
+        device.reset_donation_stats()
+        import jax.numpy as jnp
+        n = device.record_donation("site_a", {"w": jnp.zeros((4, 4),
+                                                            jnp.float32)})
+        assert n == 64
+        device.record_donation("site_a", [jnp.zeros(8, jnp.float32)])
+        st = device.donation_stats()
+        assert st["calls"] == 2
+        assert st["donated_bytes"] == 64 + 32
+        assert st["by_site"]["site_a"]["calls"] == 2
+        device.reset_donation_stats()
+        assert device.donation_stats()["calls"] == 0
+
+    def test_pretrain_step_accounts(self):
+        import numpy as np
+        from paddle_tpu import device
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, pretrain
+        device.reset_donation_stats()
+        cfg = LlamaConfig.tiny(dtype="float32")
+        m = LlamaForCausalLM(cfg)
+        mesh = pretrain.make_mesh(1, dp=1, fsdp=1, mp=1, sp=1)
+        params, opt_state, meta = pretrain.make_train_state(m, mesh)
+        step = pretrain.make_train_step(m, mesh, meta)
+        rng = np.random.default_rng(0)
+        b = pretrain.shard_batch(
+            {"input_ids": rng.integers(0, 128, (2, 16)).astype(np.int32),
+             "labels": rng.integers(0, 128, (2, 16)).astype(np.int32)}, mesh)
+        step(params, opt_state, b)
+        st = device.donation_stats()
+        assert st["calls"] == 1 and st["donated_bytes"] > 0
+        assert "pretrain.train_step" in st["by_site"]
+        device.reset_donation_stats()
