@@ -351,7 +351,25 @@ class FusedMultiTransformerEngine:
                     w["ffn_ln_scales"], g("ffn_ln_biases"), w["ffn1_weights"],
                     g("ffn1_biases"), w["ffn2_weights"], g("ffn2_biases"))
 
-        def prefill(w, caches, ids):
+        def select(logits, temp, topp, key):
+            """Greedy when temp<=0, else temperature + nucleus (top-p)
+            sampling (reference top_p_sampling op semantics) — all traced,
+            so the whole sampled decode stays one device program."""
+            import jax
+            greedy = jnp.argmax(logits, -1)
+            lg = logits.astype(jnp.float32) / jnp.maximum(temp, 1e-6)
+            sl = jnp.flip(jnp.sort(lg, -1), -1)
+            ps = jax.nn.softmax(sl, -1)
+            csum = jnp.cumsum(ps, -1)
+            # last sorted index whose PRECEDING mass is still < top_p
+            k_idx = jnp.sum((csum - ps) < topp, -1) - 1
+            thresh = jnp.take_along_axis(
+                sl, jnp.maximum(k_idx, 0)[..., None], -1)
+            filt = jnp.where(lg >= thresh, lg, -jnp.inf)
+            samp = jax.random.categorical(key, filt, -1)
+            return jnp.where(temp <= 0.0, greedy, samp)
+
+        def prefill(w, caches, ids, temp, topp, key):
             h = w["embedding"][ids]
             from ..core.tensor import Tensor
             cts = [Tensor(c) for c in caches]
@@ -359,9 +377,9 @@ class FusedMultiTransformerEngine:
                 Tensor(h), *lists(w), cache_kvs=cts,
                 rotary_embs=w.get("rotary_embs"), **kw)
             logits = out.data[:, -1] @ w["lm_head"]
-            return jnp.argmax(logits, -1), [c.data for c in cts]
+            return select(logits, temp, topp, key), [c.data for c in cts]
 
-        def step(w, caches, tok, t):
+        def step(w, caches, tok, t, temp, topp, key):
             h = w["embedding"][tok][:, None]
             from ..core.tensor import Tensor
             cts = [Tensor(c) for c in caches]
@@ -369,15 +387,18 @@ class FusedMultiTransformerEngine:
                 Tensor(h), *lists(w), cache_kvs=cts,
                 time_step=Tensor(t), rotary_embs=w.get("rotary_embs"), **kw)
             logits = out.data[:, 0] @ w["lm_head"]
-            return jnp.argmax(logits, -1), [c.data for c in cts]
+            return select(logits, temp, topp, key), [c.data for c in cts]
 
-        def steps(w, caches, tok, t0, n):
+        def steps(w, caches, tok, t0, n, temp, topp, key):
             # whole decode loop as ONE device program (lax.scan): a
             # per-token jit call pays a host->device dispatch round trip
             # each step — through a tunnel that RTT dwarfs the step itself
+            import jax
+
             def body(carry, i):
                 tk, cs = carry
-                tk2, cs2 = step(w, cs, tk, t0 + i)
+                tk2, cs2 = step(w, cs, tk, t0 + i, temp, topp,
+                                jax.random.fold_in(key, i))
                 return (tk2, cs2), tk2
 
             (_, caches_f), toks = jax.lax.scan(
@@ -398,10 +419,22 @@ class FusedMultiTransformerEngine:
                            self.head_dim), dtype)
                 for _ in range(self._n_layers)]
 
-    def generate(self, input_ids, max_new_tokens=32):
-        """Greedy generation. input_ids: [B, S] int array. Returns [B, N]."""
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_p=1.0, seed=None):
+        """Generation: greedy by default; temperature>0 enables
+        temperature + nucleus sampling (reference top_p_sampling
+        semantics), seeded for reproducibility. input_ids: [B, S] int
+        array. Returns [B, N]."""
         import numpy as np
+        import jax
         import jax.numpy as jnp
+        if seed is None:
+            from ..core import random as _rng
+            key = _rng.next_key()
+        else:
+            key = jax.random.PRNGKey(int(seed))
+        temp = jnp.float32(temperature)
+        topp = jnp.float32(top_p)
         ids = jnp.asarray(input_ids, jnp.int32)
         b, s = ids.shape
         if s + max_new_tokens > self.max_seq_len:
@@ -410,7 +443,8 @@ class FusedMultiTransformerEngine:
                 f"max_seq_len ({self.max_seq_len}); raise max_seq_len or "
                 "shorten the request")
         caches = self.new_caches(b)
-        tok, caches = self._prefill(self._w, caches, ids)
+        kp, kd = jax.random.split(key)
+        tok, caches = self._prefill(self._w, caches, ids, temp, topp, kp)
         if max_new_tokens == 1:
             return np.asarray(tok)[:, None]
         # bucket the scanned step count to powers of two so varying request
@@ -424,6 +458,7 @@ class FusedMultiTransformerEngine:
             bucket *= 2
         bucket = min(bucket, self.max_seq_len - s)
         toks, caches = self._steps(self._w, caches, tok,
-                                   jnp.asarray(s, jnp.int32), bucket)
+                                   jnp.asarray(s, jnp.int32), bucket,
+                                   temp, topp, kd)
         return np.concatenate([np.asarray(tok)[:, None],
                                np.asarray(toks).T[:, :need]], axis=1)

@@ -81,3 +81,48 @@ class TestPredictor:
         out1 = pred.run([np.ones((1, 8), np.float32)])
         out2 = c.run([np.ones((1, 8), np.float32)])
         np.testing.assert_allclose(out1[0], out2[0], rtol=1e-6)
+
+
+def test_engine_sampling_modes():
+    """Temperature + nucleus sampling in the serving engine (reference
+    top_p_sampling semantics): greedy default stays deterministic; seeded
+    sampling is reproducible; different seeds diverge."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import FusedMultiTransformerEngine
+    rng = np.random.default_rng(7)
+    V, E, H, D, F, L = 64, 32, 4, 8, 64, 1
+
+    def mk(*shape):
+        return (rng.standard_normal(shape) * 0.1).astype(np.float32)
+
+    w = dict(
+        ln_scales=[np.ones(E, np.float32)],
+        qkv_weights=[mk(3, H, D, E)],
+        linear_weights=[mk(H * D, E)],
+        ffn_ln_scales=[np.ones(E, np.float32)],
+        ffn1_weights=[mk(E, F)], ffn2_weights=[mk(F, E)],
+        embedding=mk(V, E), lm_head=mk(E, V))
+    eng = FusedMultiTransformerEngine(w, num_heads=H, head_dim=D,
+                                      max_seq_len=32, dtype="float32")
+    ids = np.array([[1, 2, 3]], np.int32)
+    g1 = eng.generate(ids, max_new_tokens=8)
+    g2 = eng.generate(ids, max_new_tokens=8)
+    np.testing.assert_array_equal(g1, g2)          # greedy deterministic
+    s1 = eng.generate(ids, max_new_tokens=8, temperature=1.0, top_p=0.9,
+                      seed=0)
+    s2 = eng.generate(ids, max_new_tokens=8, temperature=1.0, top_p=0.9,
+                      seed=0)
+    np.testing.assert_array_equal(s1, s2)          # seeded reproducible
+    diverged = False
+    for sd in range(1, 6):
+        s3 = eng.generate(ids, max_new_tokens=8, temperature=1.0,
+                          top_p=0.9, seed=sd)
+        if not np.array_equal(s3, s1):
+            diverged = True
+            break
+    assert diverged                                 # sampling is random
+    # top_p -> 0 collapses to (near-)greedy: the top-1 token survives
+    s4 = eng.generate(ids, max_new_tokens=8, temperature=1.0, top_p=1e-6,
+                      seed=3)
+    np.testing.assert_array_equal(s4, g1)
