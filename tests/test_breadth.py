@@ -371,7 +371,9 @@ class TestRPC:
             [sys.executable, str(script), str(r), f"127.0.0.1:{port}"],
             env=env, cwd=REPO, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True) for r in range(2)]
-        outs = [p.communicate(timeout=90) for p in procs]
+        # generous timeout: under a fully-loaded host (parallel suite
+        # runs) the two interpreters can take minutes just to import jax
+        outs = [p.communicate(timeout=300) for p in procs]
         assert all(p.returncode == 0 for p in procs), outs
         assert "RPC OK" in outs[0][0]
 
