@@ -52,8 +52,13 @@ def llama_sharding_rules():
 
 def gpt_sharding_rules():
     return [
-        (r".*word_embeddings\.weight$",     ("mp", "fsdp")),
-        (r".*position_embeddings\.weight$", (None, "fsdp")),
+        # same rationale as the llama embed rule above: hidden over mp
+        # keeps the gather output's fixups native collectives; hidden over
+        # fsdp forced involuntary full-remat reshards against the
+        # (dp, fsdp) batch tile (observed on the [1, S, H] position-embed
+        # broadcast path)
+        (r".*word_embeddings\.weight$",     ("fsdp", "mp")),
+        (r".*position_embeddings\.weight$", (None, "mp")),
         (r".*(qkv_proj|linear1)\.weight$",  ("fsdp", "mp")),
         (r".*(out_proj|linear2)\.weight$",  ("mp", "fsdp")),
         (r".*(qkv_proj|linear1)\.bias$",    ("mp",)),
@@ -180,8 +185,11 @@ def make_train_step(model, mesh, meta, donate=True):
             params = {n: (p.astype(jnp.bfloat16)
                           if p.dtype == jnp.float32 and p.ndim >= 2 else p)
                       for n, p in params.items()}
+        # keyword call: model families differ in positional signatures
+        # (llama: (ids, position_ids, attn_mask, labels); gpt:
+        # (ids, position_ids, labels)) — `labels=` is the shared contract
         out = pure_call(model, params, buffers, batch["input_ids"],
-                        None, None, batch["labels"])
+                        labels=batch["labels"])
         _, loss = out
         return loss.astype(jnp.float32)
 
