@@ -23,7 +23,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..jit.functional import state_arrays, pure_call
 
-__all__ = ["llama_sharding_rules", "gpt_sharding_rules", "spec_for_param",
+__all__ = ["llama_sharding_rules", "gpt_sharding_rules",
+           "ernie_sharding_rules", "spec_for_param",
            "make_train_state", "make_train_step", "make_mesh"]
 
 
@@ -63,6 +64,20 @@ def gpt_sharding_rules():
         (r".*(out_proj|linear2)\.weight$",  ("mp", "fsdp")),
         (r".*(qkv_proj|linear1)\.bias$",    ("mp",)),
         (r".*",                             (None,)),
+    ]
+
+
+def ernie_sharding_rules():
+    """TP plan for the BERT/ERNIE encoder family (q/k/v/linear1 column-
+    parallel, out_proj/linear2 row-parallel; embeddings hidden-over-mp per
+    the llama embed-rule rationale)."""
+    return [
+        (r".*word_embeddings\.weight$",      ("fsdp", "mp")),
+        (r".*(position|token_type)_embeddings\.weight$", (None, "mp")),
+        (r".*(q_proj|k_proj|v_proj|linear1)\.weight$",   ("fsdp", "mp")),
+        (r".*(out_proj|linear2)\.weight$",   ("mp", "fsdp")),
+        (r".*(q_proj|k_proj|v_proj|linear1)\.bias$",     ("mp",)),
+        (r".*",                              (None,)),
     ]
 
 

@@ -590,3 +590,23 @@ class TestModelFamilySharding:
              "labels": rng.integers(0, 128, (4, 32)).astype(np.int32)}, mesh)
         _, _, loss, g = step(params, opt_state, b)
         assert np.isfinite(float(loss)) and np.isfinite(float(g))
+
+    def test_ernie_sharded_step(self):
+        import numpy as np
+        from paddle_tpu.models import pretrain
+        from paddle_tpu.models.ernie import ErnieConfig, ErnieForMaskedLM
+        cfg = ErnieConfig(vocab_size=128, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=128,
+                          max_position_embeddings=64)
+        m = ErnieForMaskedLM(cfg)
+        mesh = pretrain.make_mesh(8, dp=2, fsdp=2, mp=2, sp=1)
+        params, opt_state, meta = pretrain.make_train_state(
+            m, mesh, rules=pretrain.ernie_sharding_rules())
+        step = pretrain.make_train_step(m, mesh, meta)
+        rng = np.random.default_rng(0)
+        b = pretrain.shard_batch(
+            {"input_ids": rng.integers(0, 128, (4, 32)).astype(np.int32),
+             "labels": rng.integers(0, 128, (4, 32)).astype(np.int32)}, mesh)
+        _, _, loss, g = step(params, opt_state, b)
+        assert np.isfinite(float(loss)) and np.isfinite(float(g))
