@@ -142,19 +142,9 @@ class ErnieForMaskedLM(nn.Layer):
 
 
 def ernie_sharding_rules():
-    """TP/FSDP rules for the mesh path (pretrain.spec_for_param format):
-    column-parallel QKV/FC1, row-parallel out-proj/FC2, sharded
-    embeddings."""
-    return [
-        ("word_embeddings.weight", ("mp", "fsdp")),
-        ("position_embeddings.weight", (None, None)),
-        ("token_type_embeddings.weight", (None, None)),
-        (".q_proj.weight", ("fsdp", "mp")),
-        (".k_proj.weight", ("fsdp", "mp")),
-        (".v_proj.weight", ("fsdp", "mp")),
-        (".out_proj.weight", ("mp", "fsdp")),
-        (".linear1.weight", ("fsdp", "mp")),
-        (".linear2.weight", ("mp", "fsdp")),
-        ("pooler.weight", (None, "fsdp")),
-        ("classifier.weight", (None, None)),
-    ]
+    """TP/FSDP rules for the mesh path — delegates to the canonical table
+    in models.pretrain (this module used to carry its own variant whose
+    unanchored patterns never matched full parameter names under
+    spec_for_param's re.match, silently replicating everything)."""
+    from .pretrain import ernie_sharding_rules as _rules
+    return _rules()

@@ -595,10 +595,7 @@ class TestModelFamilySharding:
         import numpy as np
         from paddle_tpu.models import pretrain
         from paddle_tpu.models.ernie import ErnieConfig, ErnieForMaskedLM
-        cfg = ErnieConfig(vocab_size=128, hidden_size=64,
-                          num_hidden_layers=2, num_attention_heads=4,
-                          intermediate_size=128,
-                          max_position_embeddings=64)
+        cfg = ErnieConfig.tiny()
         m = ErnieForMaskedLM(cfg)
         mesh = pretrain.make_mesh(8, dp=2, fsdp=2, mp=2, sp=1)
         params, opt_state, meta = pretrain.make_train_state(
