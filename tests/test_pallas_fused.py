@@ -610,3 +610,54 @@ class TestKernelAutotune:
         q = paddle.randn([1, 512, 4, 64])
         bq, bk = tuned_blocks(q, q, q, causal=True)
         assert bq >= 256 and bk >= 256  # defaults clamped to the sequence
+
+
+class TestFusedMultiTransformerInt4:
+    """Weight-only int4 tier (capability upgrade over the reference's
+    int8 kernel: half the weight HBM)."""
+
+    def test_pack_roundtrip(self):
+        from paddle_tpu.incubate.nn.functional import (quantize_int4,
+                                                       _unpack_int4)
+        rng = np.random.default_rng(0)
+        w = rng.standard_normal((8, 16)).astype(np.float32)
+        p, sc = quantize_int4(w, axis=0)
+        assert p.shape == (4, 16) and p.dtype == np.int8
+        rec = np.asarray(_unpack_int4(jnp.asarray(p), axis=0),
+                         np.float32) * np.asarray(sc)
+        assert np.abs(rec - w).max() / np.abs(w).max() < 0.15
+
+    def test_int4_tracks_fp32(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_multi_transformer, fused_multi_transformer_int4,
+            quantize_int4)
+        rng = np.random.default_rng(1)
+        B, S, E, H, D, F, L = 2, 4, 32, 4, 8, 64, 1
+        w = TestFusedMultiTransformer._weights(rng, L, E, H, D, F)
+        T = paddle.to_tensor
+        x = T(rng.standard_normal((B, S, E)).astype(np.float32))
+        ref = fused_multi_transformer(x, **w)
+
+        def q(ws, axis):
+            packed, scs = [], []
+            for t in ws:
+                p, s = quantize_int4(t.numpy(), axis=axis)
+                packed.append(T(p))
+                scs.append(T(s))
+            return packed, scs
+
+        qkv4, qkvsc = q(w["qkv_weights"], -1)
+        lin4, linsc = q(w["linear_weights"], 0)
+        f14, f1sc = q(w["ffn1_weights"], 0)
+        f24, f2sc = q(w["ffn2_weights"], 0)
+        o4 = fused_multi_transformer_int4(
+            x, w["ln_scales"], w["ln_biases"], qkv4, qkvsc,
+            w["qkv_biases"], lin4, linsc, w["linear_biases"],
+            w["ffn_ln_scales"], w["ffn_ln_biases"], f14, f1sc,
+            w["ffn1_biases"], f24, f2sc, w["ffn2_biases"])
+        rel = np.abs(o4.numpy() - ref.numpy()).max() / \
+            (np.abs(ref.numpy()).max() + 1e-9)
+        assert rel < 0.25, rel  # int4: coarser than int8's 0.1 bound
+        # the packed weights really are half-size
+        assert qkv4[0].numpy().nbytes * 2 == \
+            w["qkv_weights"][0].numpy().astype(np.int8).nbytes
