@@ -317,7 +317,7 @@ class FusedMultiTransformerEngine:
     def __init__(self, weights, num_heads, head_dim, max_seq_len=2048,
                  norm_type="layernorm", activation="gelu",
                  use_neox_rotary_style=False, dtype="bfloat16",
-                 gqa_group_size=-1):
+                 gqa_group_size=-1, weight_quant=None):
         import jax
         import jax.numpy as jnp
         from ..incubate.nn.functional import fused_multi_transformer
@@ -342,6 +342,52 @@ class FusedMultiTransformerEngine:
         kw = dict(norm_type=norm_type, activation=activation,
                   use_neox_rotary_style=use_neox_rotary_style,
                   gqa_group_size=gqa_group_size)
+        # weight-only quantized serving: pack the matmul weights at load
+        # (int4 = half the int8 tier's weight HBM) and dequantize inside
+        # the op, fused into the operand load
+        self.weight_quant = weight_quant
+        if weight_quant in ("int4", "int8"):
+            import numpy as _np
+            from ..incubate.nn.functional import quantize_int4, _unpack_int4
+            qscales = {}
+
+            def _quant(kind, ws, axis):
+                packed, scs = [], []
+                for t in ws:
+                    a = _np.asarray(t, _np.float32)
+                    if weight_quant == "int4":
+                        pk, sc = quantize_int4(a, axis=axis)
+                    else:
+                        m = _np.moveaxis(a, axis, -1)
+                        sc = _np.abs(m).max(-1, keepdims=True) / 127.0 + 1e-9
+                        pk = _np.clip(_np.round(m / sc), -127, 127
+                                      ).astype(_np.int8)
+                        pk = _np.moveaxis(pk, -1, axis)
+                        sc = _np.moveaxis(sc, -1, axis)
+                    packed.append(jnp.asarray(pk))
+                    scs.append(jnp.asarray(sc))
+                qscales[kind] = scs
+                return packed
+
+            self._w["qkv_weights"] = _quant("qkv", self._w["qkv_weights"],
+                                            -1)
+            self._w["linear_weights"] = _quant("lin",
+                                               self._w["linear_weights"], 0)
+            self._w["ffn1_weights"] = _quant("f1", self._w["ffn1_weights"],
+                                             0)
+            self._w["ffn2_weights"] = _quant("f2", self._w["ffn2_weights"],
+                                             0)
+            cdt = dtype
+
+            def dq(w, kind, li):
+                sc = qscales[kind][li]
+                if weight_quant == "int4":
+                    full = _unpack_int4(w, axis=-1 if kind == "qkv" else 0)
+                else:
+                    full = w
+                return (full.astype(jnp.float32) * sc).astype(cdt)
+
+            kw["_dequant"] = dq
 
         def lists(w):
             def g(name):
