@@ -480,7 +480,15 @@ def fused_multi_transformer(
             if rotary is not None:
                 cos = rotary[0][:, 0][:, :, None, :]    # [B, S_rope, 1, D]
                 sin = rotary[1][:, 0][:, :, None, :]
-                if tstep is not None:
+                if tstep is not None and slens is not None:
+                    # ragged decode: each sequence sits at its OWN position
+                    # (its current length), not a shared time step
+                    ln = jnp.asarray(slens).reshape(-1)
+                    bidx = jnp.arange(cos.shape[0]) \
+                        if cos.shape[0] > 1 else jnp.zeros_like(ln)
+                    cos = cos[bidx, ln][:, None]        # [B, 1, 1, D]
+                    sin = sin[bidx, ln][:, None]
+                elif tstep is not None:
                     pos = jnp.asarray(tstep).reshape(())
                     cos = jax.lax.dynamic_slice_in_dim(cos, pos, 1, 1)
                     sin = jax.lax.dynamic_slice_in_dim(sin, pos, 1, 1)

@@ -415,40 +415,51 @@ class FusedMultiTransformerEngine:
             samp = jax.random.categorical(key, filt, -1)
             return jnp.where(temp <= 0.0, greedy, samp)
 
-        def prefill(w, caches, ids, temp, topp, key):
+        def prefill(w, caches, ids, temp, topp, key, lens=None):
             h = w["embedding"][ids]
             from ..core.tensor import Tensor
             cts = [Tensor(c) for c in caches]
             out = fused_multi_transformer(
                 Tensor(h), *lists(w), cache_kvs=cts,
+                seq_lens=None if lens is None else Tensor(lens),
                 rotary_embs=w.get("rotary_embs"), **kw)
-            logits = out.data[:, -1] @ w["lm_head"]
+            if lens is None:
+                logits = out.data[:, -1] @ w["lm_head"]
+            else:
+                # ragged prompts: each row's LAST VALID hidden state
+                bidx = jnp.arange(out.data.shape[0])
+                logits = out.data[bidx, lens - 1] @ w["lm_head"]
             return select(logits, temp, topp, key), [c.data for c in cts]
 
-        def step(w, caches, tok, t, temp, topp, key):
+        def step(w, caches, tok, t, temp, topp, key, lens=None):
             h = w["embedding"][tok][:, None]
             from ..core.tensor import Tensor
             cts = [Tensor(c) for c in caches]
             out = fused_multi_transformer(
                 Tensor(h), *lists(w), cache_kvs=cts,
-                time_step=Tensor(t), rotary_embs=w.get("rotary_embs"), **kw)
+                time_step=Tensor(t),
+                seq_lens=None if lens is None else Tensor(lens),
+                rotary_embs=w.get("rotary_embs"), **kw)
             logits = out.data[:, 0] @ w["lm_head"]
             return select(logits, temp, topp, key), [c.data for c in cts]
 
-        def steps(w, caches, tok, t0, n, temp, topp, key):
+        def steps(w, caches, tok, t0, n, temp, topp, key, lens0=None):
             # whole decode loop as ONE device program (lax.scan): a
             # per-token jit call pays a host->device dispatch round trip
-            # each step — through a tunnel that RTT dwarfs the step itself
+            # each step — through a tunnel that RTT dwarfs the step itself.
+            # Ragged mode: per-sequence lengths ride the carry and advance
+            # each step (the op's seq_lens contract)
             import jax
 
             def body(carry, i):
-                tk, cs = carry
+                tk, cs, ln = carry
                 tk2, cs2 = step(w, cs, tk, t0 + i, temp, topp,
-                                jax.random.fold_in(key, i))
-                return (tk2, cs2), tk2
+                                jax.random.fold_in(key, i), lens=ln)
+                ln2 = None if ln is None else ln + 1
+                return (tk2, cs2, ln2), tk2
 
-            (_, caches_f), toks = jax.lax.scan(
-                body, (tok, caches), jnp.arange(n))
+            (_, caches_f, _), toks = jax.lax.scan(
+                body, (tok, caches, lens0), jnp.arange(n))
             return toks, caches_f  # toks [n, B]
 
         import jax
@@ -466,7 +477,7 @@ class FusedMultiTransformerEngine:
                 for _ in range(self._n_layers)]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_p=1.0, seed=None):
+                 top_p=1.0, seed=None, prompt_lens=None):
         """Generation: greedy by default; temperature>0 enables
         temperature + nucleus sampling (reference top_p_sampling
         semantics), seeded for reproducibility. input_ids: [B, S] int
@@ -490,7 +501,10 @@ class FusedMultiTransformerEngine:
                 "shorten the request")
         caches = self.new_caches(b)
         kp, kd = jax.random.split(key)
-        tok, caches = self._prefill(self._w, caches, ids, temp, topp, kp)
+        lens = None if prompt_lens is None else \
+            jnp.asarray(prompt_lens, jnp.int32)
+        tok, caches = self._prefill(self._w, caches, ids, temp, topp, kp,
+                                    lens)
         if max_new_tokens == 1:
             return np.asarray(tok)[:, None]
         # bucket the scanned step count to powers of two so varying request
@@ -505,6 +519,7 @@ class FusedMultiTransformerEngine:
         bucket = min(bucket, self.max_seq_len - s)
         toks, caches = self._steps(self._w, caches, tok,
                                    jnp.asarray(s, jnp.int32), bucket,
-                                   temp, topp, kd)
+                                   temp, topp, kd,
+                                   None if lens is None else lens)
         return np.concatenate([np.asarray(tok)[:, None],
                                np.asarray(toks).T[:, :need]], axis=1)

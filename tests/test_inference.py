@@ -160,3 +160,48 @@ def test_engine_int4_serving():
     np.testing.assert_array_equal(g_q4, q4.generate(ids, max_new_tokens=6))
     # packed weights at half the int8 footprint
     assert q4._w["ffn1_weights"][0].nbytes * 2 == E * F
+
+
+def test_engine_ragged_prompts():
+    """Ragged-batch serving: per-sequence prompt lengths (the op's
+    seq_lens contract — each row prefills over its true length, decodes
+    at its own rotary position/cache slot). A padded ragged batch must
+    reproduce each prompt's unpadded single-sequence generation."""
+    import numpy as np
+    from paddle_tpu.inference import FusedMultiTransformerEngine
+    rng = np.random.default_rng(3)
+    V, E, H, D, F, L = 64, 32, 4, 8, 64, 2
+
+    def mk(*shape):
+        return (rng.standard_normal(shape) * 0.1).astype(np.float32)
+
+    # rotary so positions actually matter
+    smax = 32
+    pos = np.arange(smax)[:, None]
+    inv = 1.0 / (10000 ** (np.arange(0, D, 2) / D))
+    ang = pos * inv[None, :]
+    cs = np.repeat(np.cos(ang), 2, axis=-1)[None, None]
+    sn = np.repeat(np.sin(ang), 2, axis=-1)[None, None]
+    rotary = np.stack([cs, sn]).astype(np.float32)  # [2,1,1,S,D]
+    w = dict(
+        ln_scales=[np.ones(E, np.float32) for _ in range(L)],
+        qkv_weights=[mk(3, H, D, E) for _ in range(L)],
+        linear_weights=[mk(H * D, E) for _ in range(L)],
+        ffn_ln_scales=[np.ones(E, np.float32) for _ in range(L)],
+        ffn1_weights=[mk(E, F) for _ in range(L)],
+        ffn2_weights=[mk(F, E) for _ in range(L)],
+        embedding=mk(V, E), lm_head=mk(E, V),
+        rotary_embs=rotary)
+    eng = FusedMultiTransformerEngine(w, num_heads=H, head_dim=D,
+                                      max_seq_len=smax, dtype="float32")
+    p1 = [1, 2, 3, 4, 5]
+    p2 = [9, 8]
+    padded = np.zeros((2, 5), np.int32)
+    padded[0, :5] = p1
+    padded[1, :2] = p2
+    out = eng.generate(padded, max_new_tokens=6,
+                       prompt_lens=np.array([5, 2], np.int32))
+    ref1 = eng.generate(np.array([p1], np.int32), max_new_tokens=6)
+    ref2 = eng.generate(np.array([p2], np.int32), max_new_tokens=6)
+    np.testing.assert_array_equal(out[0], ref1[0])
+    np.testing.assert_array_equal(out[1], ref2[0])
