@@ -481,7 +481,14 @@ class FusedMultiTransformerEngine:
         """Generation: greedy by default; temperature>0 enables
         temperature + nucleus sampling (reference top_p_sampling
         semantics), seeded for reproducibility. input_ids: [B, S] int
-        array. Returns [B, N]."""
+        array. Returns [B, N].
+
+        prompt_lens (optional [B] ints): ragged-batch mode — input_ids is
+        RIGHT-padded to a common width and each row's true prompt length
+        is given here; every row prefills over its own length and decodes
+        at its own cache slot / rotary position, reproducing its unpadded
+        single-sequence generation exactly. Each length must satisfy
+        0 < len <= input_ids.shape[1]."""
         import numpy as np
         import jax
         import jax.numpy as jnp
@@ -501,8 +508,17 @@ class FusedMultiTransformerEngine:
                 "shorten the request")
         caches = self.new_caches(b)
         kp, kd = jax.random.split(key)
-        lens = None if prompt_lens is None else \
-            jnp.asarray(prompt_lens, jnp.int32)
+        lens = None
+        if prompt_lens is not None:
+            lens_np = np.asarray(prompt_lens)
+            if lens_np.shape != (b,):
+                raise ValueError(
+                    f"prompt_lens must be shape [{b}], got {lens_np.shape}")
+            if (lens_np <= 0).any() or (lens_np > s).any():
+                raise ValueError(
+                    f"prompt_lens must be in (0, {s}] (the padded width); "
+                    f"got {lens_np.tolist()}")
+            lens = jnp.asarray(lens_np, jnp.int32)
         tok, caches = self._prefill(self._w, caches, ids, temp, topp, kp,
                                     lens)
         if max_new_tokens == 1:
@@ -519,7 +535,6 @@ class FusedMultiTransformerEngine:
         bucket = min(bucket, self.max_seq_len - s)
         toks, caches = self._steps(self._w, caches, tok,
                                    jnp.asarray(s, jnp.int32), bucket,
-                                   temp, topp, kd,
-                                   None if lens is None else lens)
+                                   temp, topp, kd, lens)
         return np.concatenate([np.asarray(tok)[:, None],
                                np.asarray(toks).T[:, :need]], axis=1)
