@@ -25,7 +25,8 @@ from .metadata import Metadata, LocalTensorMetadata, LocalTensorIndex
 from ...core.tensor import Tensor
 from ..dtensor import is_dist_tensor, _get_meta
 
-__all__ = ["save_state_dict", "load_state_dict", "Metadata",
+__all__ = ["save_state_dict", "async_save_state_dict", "load_state_dict",
+           "Metadata",
            "LocalTensorMetadata", "LocalTensorIndex"]
 
 
@@ -109,6 +110,60 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
         # store; single-controller jax sees every addressable shard already
         with open(os.path.join(path, "0.metadata"), "wb") as f:
             pickle.dump(meta, f)
+
+
+class AsyncSaveHandle:
+    """Future for an in-flight async checkpoint save."""
+
+    def __init__(self, thread, errbox):
+        self._t = thread
+        self._e = errbox
+
+    def done(self):
+        return not self._t.is_alive()
+
+    def wait(self, timeout=None):
+        self._t.join(timeout)
+        if self._t.is_alive():
+            raise TimeoutError("async checkpoint save still running")
+        if self._e:
+            raise self._e[0]
+
+
+def async_save_state_dict(state_dict, path, process_group=None,
+                          coordinator_rank=0):
+    """Non-blocking save_state_dict (the async-checkpoint tier the
+    reference trends toward): device arrays are SNAPSHOTTED to host
+    synchronously (so training may mutate/donate them immediately), then
+    the serialization + file IO runs on a background thread. Returns an
+    AsyncSaveHandle; call .wait() before relying on the files (e.g.
+    before the next save to the same path)."""
+    import threading
+
+    # host snapshot NOW: after this, donation/mutation of the live arrays
+    # cannot corrupt the checkpoint
+    def snap(v):
+        if isinstance(v, Tensor):
+            t = Tensor(jnp.asarray(np.asarray(v.data)))
+            if is_dist_tensor(v):
+                t._dist_meta = v._dist_meta
+            return t
+        if isinstance(v, (jax.Array, np.ndarray)):
+            return np.asarray(v)
+        return v
+
+    snapped = {k: snap(v) for k, v in _flatten(state_dict).items()}
+    errbox = []
+
+    def run():
+        try:
+            save_state_dict(snapped, path, process_group, coordinator_rank)
+        except BaseException as e:  # surfaced by handle.wait()
+            errbox.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return AsyncSaveHandle(t, errbox)
 
 
 class _ShardReader:

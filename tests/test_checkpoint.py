@@ -129,3 +129,38 @@ def test_nested_state_dict(tmp_path):
     ckpt.load_state_dict(sd2, str(tmp_path))
     np.testing.assert_allclose(np.asarray(sd2["model"]["w"].data), 1.0)
     assert sd2["meta"]["epoch"] == 7
+
+
+class TestAsyncSave:
+    """Async checkpoint save: snapshot-now, write-in-background."""
+
+    def test_async_roundtrip_and_mutation_safety(self, tmp_path):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import checkpoint as ckpt
+        w = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        sd = {"w": w, "step": 7}
+        h = ckpt.async_save_state_dict(sd, str(tmp_path / "ck"))
+        # mutate immediately after the call returns: the snapshot must
+        # have been taken synchronously
+        w.set_value(paddle.zeros([3, 4]))
+        h.wait(timeout=60)
+        assert h.done()
+        target = {"w": paddle.zeros([3, 4])}
+        out = ckpt.load_state_dict(target, str(tmp_path / "ck"))
+        loaded = target["w"].numpy()
+        np.testing.assert_array_equal(
+            loaded, np.arange(12, dtype=np.float32).reshape(3, 4))
+
+    def test_async_error_surfaces_on_wait(self, tmp_path):
+        import pytest
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import checkpoint as ckpt
+        from paddle_tpu.distributed import shard_tensor, Partial
+        from paddle_tpu.distributed.mesh import ProcessMesh
+        import numpy as np
+        mesh = ProcessMesh(np.arange(8), dim_names=["dp"])
+        p = shard_tensor(paddle.ones([4]), mesh, [Partial()])
+        h = ckpt.async_save_state_dict({"p": p}, str(tmp_path / "bad"))
+        with pytest.raises(ValueError, match="Partial"):
+            h.wait(timeout=60)
