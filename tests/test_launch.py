@@ -107,6 +107,33 @@ class TestLauncher:
                     ranks.add(txt.split("hello from ")[1].split("/")[0])
         assert ranks == {"0", "1"}
 
+    def test_two_node_loopback_filestore(self, tmp_path):
+        """Same rendezvous over the file:// external store (ETCDMaster
+        tier): no TCP master process — state lives on the shared
+        filesystem, so either node could be lost and restarted."""
+        script = tmp_path / "worker.py"
+        script.write_text(WORKER_OK)
+        ep = f"file://{tmp_path}/rdzv"
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+        procs = []
+        for i in range(2):
+            cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                   "--nnodes", "2", "--master", ep,
+                   "--rank", str(i),
+                   "--log_dir", str(tmp_path / f"flogs{i}"), str(script)]
+            procs.append(subprocess.Popen(cmd, cwd=REPO, env=env,
+                                          stdout=subprocess.PIPE,
+                                          stderr=subprocess.PIPE, text=True))
+        outs = [p.communicate(timeout=120) for p in procs]
+        assert all(p.returncode == 0 for p in procs), outs
+        ranks = set()
+        for i in range(2):
+            for f in (tmp_path / f"flogs{i}").iterdir():
+                txt = f.read_text()
+                if "hello from" in txt:
+                    ranks.add(txt.split("hello from ")[1].split("/")[0])
+        assert ranks == {"0", "1"}
+
 
 @requires_native
 class TestMultiNodeRestart:
