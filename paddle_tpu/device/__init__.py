@@ -79,30 +79,9 @@ def host_memory_stats(device_id=0):
     return {"current": 0, "peak": 0, "total_alloc": 0, "num_allocs": 0}
 
 
-class _DeviceNS:
-    """paddle.device.cuda-style sub-namespace, device-agnostic."""
-    memory_allocated = staticmethod(memory_allocated)
-    max_memory_allocated = staticmethod(max_memory_allocated)
-    memory_reserved = staticmethod(memory_reserved)
-    max_memory_reserved = staticmethod(memory_reserved)
-    reset_max_memory_allocated = staticmethod(reset_max_memory_allocated)
-
-    @staticmethod
-    def device_count():
-        return device_count()
-
-    @staticmethod
-    def synchronize(device_id=None):
-        # XLA dispatch is async. PJRT executes computations per device in
-        # enqueue order, so blocking on a fresh trivial computation committed
-        # to the device drains everything enqueued before it.
-        d = _dev(device_id)
-        x = jax.device_put(jax.numpy.zeros((), jax.numpy.float32), d)
-        jax.block_until_ready(jax.jit(lambda v: v + 1)(x))
-
-
-tpu = _DeviceNS()
-cuda = _DeviceNS()  # source-compat shim: code written for paddle.device.cuda
+# `device.tpu` / `device.cuda` / `device.xpu` are real submodules
+# (reference python/paddle/device/cuda/ is a package); imported at the end
+# of this file once the names they re-export exist.
 
 
 # -- source-compat surface (reference python/paddle/device/__init__.py) ----
@@ -298,3 +277,6 @@ def donation_stats():
 
 def reset_donation_stats():
     _donation.update({"calls": 0, "donated_bytes": 0, "by_site": {}})
+
+
+from . import cuda, tpu, xpu  # noqa: E402,F401  (submodule namespaces)

@@ -1,0 +1,80 @@
+"""paddle.device.cuda source-compat namespace, served by the TPU runtime.
+
+Reference: python/paddle/device/cuda/__init__.py — Stream/Event handles,
+synchronize, and the per-device memory-stats API backed by
+paddle/phi/core/memory/stats.cc. Here every call maps onto the one PJRT
+device arena (SURVEY.md §2.1: the AllocatorFacade role shrinks to stats):
+code written against ``paddle.device.cuda`` runs unchanged on the TPU
+backend, the way the reference's XPU backend re-serves the same surface.
+"""
+import jax
+
+from . import (  # noqa: F401
+    Stream, Event, current_stream, stream_guard, set_stream,
+    synchronize, device_count,
+    memory_allocated, max_memory_allocated, memory_reserved,
+    reset_max_memory_allocated, _dev, _stats,
+)
+
+__all__ = [
+    "Stream", "Event", "current_stream", "synchronize", "device_count",
+    "empty_cache", "max_memory_allocated", "max_memory_reserved",
+    "memory_allocated", "memory_reserved", "stream_guard",
+    "get_device_properties", "get_device_name", "get_device_capability",
+    "reset_max_memory_allocated", "reset_max_memory_reserved",
+]
+
+
+def max_memory_reserved(device_id=None):
+    """Peak bytes the arena has reserved from the device (PJRT
+    peak_bytes_in_use; reservation == use under PJRT's arena)."""
+    s = _stats(device_id)
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def reset_max_memory_reserved(device_id=None):
+    from . import reset_max_memory_allocated as _r
+    return _r(device_id)
+
+
+def empty_cache():
+    """Release cached device blocks (reference: allocator Release()).
+
+    PJRT owns the arena and frees buffers when their last reference drops;
+    forcing a host GC drops dead jax.Array handles now, which is the
+    releasable portion of the cache."""
+    import gc
+    gc.collect()
+
+
+class _DeviceProperties:
+    def __init__(self, d):
+        self.name = getattr(d, "device_kind", str(d))
+        self.major = 0
+        self.minor = 0
+        try:
+            self.total_memory = int((d.memory_stats() or {}).get(
+                "bytes_limit", 0))
+        except Exception:
+            self.total_memory = 0
+        self.multi_processor_count = 1
+
+    def __repr__(self):
+        return (f"_DeviceProperties(name='{self.name}', "
+                f"total_memory={self.total_memory})")
+
+
+def get_device_properties(device=None):
+    idx = device if isinstance(device, int) else None
+    return _DeviceProperties(_dev(idx))
+
+
+def get_device_name(device=None):
+    return get_device_properties(device).name
+
+
+def get_device_capability(device=None):
+    """(major, minor): no CUDA compute capability on this backend; returns
+    (0, 0) so feature probes take their generic path."""
+    p = get_device_properties(device)
+    return (p.major, p.minor)

@@ -1,0 +1,41 @@
+"""paddle.device.tpu — the native device namespace (the role
+paddle.device.cuda plays in the reference, re-served for the TPU arena)."""
+import jax
+
+from . import (  # noqa: F401
+    Stream, Event, current_stream, stream_guard, set_stream, device_count,
+    memory_allocated, max_memory_allocated, memory_reserved,
+    reset_max_memory_allocated, _dev, _stats,
+)
+
+__all__ = [
+    "Stream", "Event", "current_stream", "synchronize", "device_count",
+    "empty_cache", "max_memory_allocated", "max_memory_reserved",
+    "memory_allocated", "memory_reserved", "stream_guard",
+    "reset_max_memory_allocated", "reset_max_memory_reserved",
+]
+
+
+def synchronize(device_id=None):
+    """Drain the device queue. XLA dispatch is async; PJRT executes
+    computations per device in enqueue order, so blocking on a fresh
+    trivial computation committed to the device drains everything
+    enqueued before it."""
+    d = _dev(device_id)
+    x = jax.device_put(jax.numpy.zeros((), jax.numpy.float32), d)
+    jax.block_until_ready(jax.jit(lambda v: v + 1)(x))
+
+
+def max_memory_reserved(device_id=None):
+    s = _stats(device_id)
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def reset_max_memory_reserved(device_id=None):
+    from . import reset_max_memory_allocated as _r
+    return _r(device_id)
+
+
+def empty_cache():
+    import gc
+    gc.collect()

@@ -1,0 +1,11 @@
+"""paddle.distributed.communication parity package.
+
+Reference: python/paddle/distributed/communication/ — the dygraph
+collective wrappers plus the `stream` sub-namespace whose functions take
+``sync_op``/``use_calc_stream``. On TPU the calc/comm stream split is
+PJRT's concern (collectives are compiler ops in traced code, eager
+resharding otherwise — SURVEY.md §2.7 TPU note), so both namespaces share
+one implementation in ``paddle_tpu.distributed.collective``."""
+from . import stream  # noqa: F401
+
+__all__ = ["stream"]

@@ -1,0 +1,67 @@
+"""paddle.distributed.communication.stream parity.
+
+Reference: python/paddle/distributed/communication/stream/all_reduce.py:39-55
+and siblings — each collective with explicit ``sync_op`` /
+``use_calc_stream`` control. The reference offloads async collectives to a
+per-ProcessGroup comm stream and syncs with events; under PJRT there is one
+device queue and collectives are ordered by enqueue, so ``use_calc_stream``
+only selects whether we return a completed-task handle (the semantics user
+code observes: ``task.wait()`` must be legal)."""
+import functools
+
+from .. import collective as _c
+
+__all__ = [
+    "all_gather", "all_reduce", "alltoall", "alltoall_single", "broadcast",
+    "reduce", "reduce_scatter", "recv", "scatter", "send", "gather",
+]
+
+
+class _Task:
+    """Task handle (reference ProcessGroup Task API, process_group.h:130):
+    work is already ordered by the device queue when this returns."""
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _stream_variant(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, sync_op=True, use_calc_stream=False, **kwargs):
+        fn(*args, sync_op=True, **kwargs)
+        return None if use_calc_stream else _Task()
+    return wrapper
+
+
+all_reduce = _stream_variant(_c.all_reduce)
+broadcast = _stream_variant(_c.broadcast)
+reduce = _stream_variant(_c.reduce)
+scatter = _stream_variant(_c.scatter)
+gather = _stream_variant(_c.gather)
+reduce_scatter = _stream_variant(_c.reduce_scatter)
+send = _stream_variant(_c.send)
+recv = _stream_variant(_c.recv)
+
+
+@functools.wraps(_c.all_gather)
+def all_gather(tensor_or_tensor_list, tensor, sync_op=True,
+               use_calc_stream=False, **kwargs):
+    _c.all_gather(tensor_or_tensor_list, tensor, sync_op=True, **kwargs)
+    return None if use_calc_stream else _Task()
+
+
+def alltoall(out_tensor_or_list, in_tensor_or_list, group=None, sync_op=True,
+             use_calc_stream=False):
+    _c.alltoall(out_tensor_or_list, in_tensor_or_list, group=group)
+    return None if use_calc_stream else _Task()
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    _c.alltoall_single(out_tensor, in_tensor, in_split_sizes,
+                       out_split_sizes, group=group)
+    return None if use_calc_stream else _Task()

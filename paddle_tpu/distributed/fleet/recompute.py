@@ -15,7 +15,7 @@ from ...core.tensor import Tensor
 from ...nn.layer import Layer
 from ...jit.functional import pure_call
 
-__all__ = ["recompute", "recompute_sequential"]
+__all__ = ["recompute", "recompute_sequential", "recompute_hybrid"]
 
 
 def recompute(function, *args, **kwargs):
@@ -32,7 +32,9 @@ def recompute(function, *args, **kwargs):
     # selective policy is usually the better FLOPs/HBM trade
     policy_name = kwargs.pop("policy", None)
     policy = None
-    if policy_name:
+    if callable(policy_name):
+        policy = policy_name  # a jax.checkpoint_policies callable directly
+    elif policy_name:
         policy = getattr(jax.checkpoint_policies, {
             "dots": "checkpoint_dots",
             "dots_saveable": "dots_saveable",
@@ -96,3 +98,28 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
                         *(out if isinstance(out, tuple) else (out,)),
                         **kwargs)
     return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Hybrid-parallel recompute (reference incubate/distributed/fleet/
+    recompute_hybrid.py): recompute whose stashed activations can be
+    offloaded to host ('offload') or partitioned across the model-parallel
+    group ('partition') instead of kept whole in device memory.
+
+    TPU-native mapping: `offload=True` -> jax's offloadable remat policy
+    (saved residuals pinned to host memory space when the runtime supports
+    it; falls back to full recompute, which also frees the HBM);
+    `partition=True` is subsumed by GSPMD — saved residuals inherit the
+    sharding of the values they were computed from, so under a model-parallel
+    mesh they are already partitioned, not replicated."""
+    ctx = ctx or {}
+    if ctx.get("offload", False):
+        try:
+            policy = jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=[],
+                offload_src="device", offload_dst="pinned_host")
+            kwargs.setdefault("policy", policy)
+        except Exception:
+            kwargs.setdefault("policy", "nothing")
+    return recompute(function, *args, **kwargs)

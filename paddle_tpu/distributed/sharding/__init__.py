@@ -1,0 +1,32 @@
+"""paddle.distributed.sharding parity package.
+
+Reference: python/paddle/distributed/sharding/group_sharded.py:50 —
+`group_sharded_parallel(model, optimizer, level)` wraps a dygraph model in
+ZeRO stage 1/2/3 ('os' / 'os_g' / 'p_g_os'), and `save_group_sharded_model`
+persists the unwrapped model (+ optimizer shard) for later single-process
+load. The stages themselves live in fleet/sharding.py; the traced-mode
+equivalent is FSDP-in-pjit (SURVEY.md §7 hard-parts note)."""
+import os
+
+from ..fleet.sharding import (  # noqa: F401
+    group_sharded_parallel, GroupShardedStage2, GroupShardedStage3,
+    DygraphShardingOptimizer,
+)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Save a group-sharded model (reference group_sharded.py:149 shape:
+    model state to `output/model.pdmodel`, optimizer shard to
+    `output/model.pdopt`). Wrappers are unwrapped so the checkpoint loads
+    into a plain Layer."""
+    from ...framework import save as _save
+
+    os.makedirs(output, exist_ok=True)
+    inner = getattr(model, "_layer", None) or getattr(model, "layer", model)
+    _save(inner.state_dict(), os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        opt = getattr(optimizer, "_optim", optimizer)
+        state = opt.state_dict() if hasattr(opt, "state_dict") else {}
+        _save(state, os.path.join(output, "model.pdopt"))

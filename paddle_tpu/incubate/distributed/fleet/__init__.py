@@ -1,0 +1,8 @@
+"""paddle.incubate.distributed.fleet parity (reference
+python/paddle/incubate/distributed/fleet/__init__.py: the recompute
+entry points staged under incubate)."""
+from ....distributed.fleet.recompute import (  # noqa: F401
+    recompute_sequential, recompute_hybrid,
+)
+
+__all__ = ["recompute_sequential", "recompute_hybrid"]

@@ -1,10 +1,12 @@
 """Incubating optimizers (reference: python/paddle/incubate/optimizer/ —
-LookAhead, ModelAverage)."""
+LookAhead, ModelAverage, LBFGS, and the functional bfgs/lbfgs minimizers)."""
 import jax.numpy as jnp
 
-from ..optimizer.optimizer import Optimizer
+from ...optimizer.optimizer import Optimizer
+from ...optimizer.optimizers import LBFGS  # noqa: F401
+from . import functional  # noqa: F401
 
-__all__ = ["LookAhead", "ModelAverage"]
+__all__ = ["LookAhead", "ModelAverage", "LBFGS", "functional"]
 
 
 class LookAhead(Optimizer):

@@ -1,0 +1,209 @@
+"""Functional quasi-Newton minimizers (reference:
+python/paddle/incubate/optimizer/functional/{bfgs,lbfgs}.py —
+minimize_bfgs / minimize_lbfgs returning
+(is_converge, num_func_calls, position, objective_value,
+objective_gradient)).
+
+TPU-native: the whole minimization is ONE `lax.while_loop` program — the
+objective's value-and-grad, the line search, and the (inverse-Hessian |
+two-loop-recursion) update all trace into a single XLA computation, instead
+of the reference's per-iteration op dispatch. Static shapes throughout:
+L-BFGS history lives in fixed `(history_size, n)` buffers with a rolling
+index, so the compiled program is iteration-count independent.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _pure_objective(objective_func):
+    def f(x):
+        out = objective_func(Tensor(x))
+        out = out.data if isinstance(out, Tensor) else jnp.asarray(out)
+        return out.reshape(())
+    return f
+
+
+def _line_search(f, xk, fk, gk, pk, max_ls, alpha0):
+    """Backtracking line search with the Armijo sufficient-decrease rule
+    (the decrease half of strong-Wolfe; curvature is enforced by the
+    rho>0 guard in the update). Returns (alpha, f_new, g_new, n_evals)."""
+    c1 = 1e-4
+    gtp = jnp.vdot(gk, pk)
+
+    def cond(state):
+        alpha, fv, _, it, done = state
+        return jnp.logical_and(it < max_ls, jnp.logical_not(done))
+
+    def body(state):
+        alpha, _, _, it, _ = state
+        fv, gv = jax.value_and_grad(f)(xk + alpha * pk)
+        ok = fv <= fk + c1 * alpha * gtp
+        # keep the accepted alpha; otherwise halve and try again
+        next_alpha = jnp.where(ok, alpha, alpha * 0.5)
+        return (next_alpha, fv, gv, it + 1, ok)
+
+    f0, g0 = jax.value_and_grad(f)(xk + alpha0 * pk)
+    ok0 = f0 <= fk + c1 * alpha0 * gtp
+    alpha, fv, gv, evals, done = jax.lax.while_loop(
+        cond, body, (jnp.where(ok0, alpha0, alpha0 * 0.5), f0, g0,
+                     jnp.asarray(1), ok0))
+    return alpha, fv, gv, evals, done
+
+
+def _prep(initial_position, dtype):
+    x0 = initial_position.data if isinstance(initial_position, Tensor) \
+        else jnp.asarray(initial_position)
+    return x0.astype(dtype).reshape(-1), x0.shape
+
+
+@partial(jax.jit, static_argnums=(0, 2, 6))
+def _bfgs_impl(f, x0, max_iters, tol_grad, tol_change, h0, max_ls, alpha0):
+    n = x0.shape[0]
+    f0, g0 = jax.value_and_grad(f)(x0)
+
+    def cond(s):
+        k, x, fv, g, H, calls, conv = s
+        return jnp.logical_and(k < max_iters, jnp.logical_not(conv))
+
+    def body(s):
+        k, x, fv, g, H, calls, _ = s
+        p = -(H @ g)
+        alpha, f1, g1, evals, ls_ok = _line_search(
+            f, x, fv, g, p, max_ls, alpha0)
+        sk = alpha * p
+        x1 = x + sk
+        yk = g1 - g
+        sy = jnp.vdot(sk, yk)
+        rho = jnp.where(sy > 1e-10, 1.0 / jnp.where(sy > 1e-10, sy, 1.0), 0.0)
+        eye = jnp.eye(n, dtype=x.dtype)
+        # standard first-iteration scaling H <- (s.y / y.y) I before the
+        # update: makes the initial inverse-Hessian magnitude match the
+        # local curvature so unit steps are accepted
+        yy = jnp.vdot(yk, yk)
+        Hs = jnp.where(jnp.logical_and(k == 0, sy > 1e-10),
+                       (sy / jnp.where(yy > 0, yy, 1.0)) * eye, H)
+        V = eye - rho * jnp.outer(sk, yk)
+        H1 = jnp.where(rho > 0,
+                       V @ Hs @ V.T + rho * jnp.outer(sk, sk), H)
+        conv = jnp.logical_or(
+            jnp.max(jnp.abs(g1)) < tol_grad,
+            jnp.max(jnp.abs(sk)) < tol_change)
+        return (k + 1, x1, f1, g1, H1, calls + evals, conv)
+
+    k, x, fv, g, H, calls, conv = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0), x0, f0, g0, h0, jnp.asarray(1),
+                     jnp.max(jnp.abs(g0)) < tol_grad))
+    return conv, calls, x, fv, g
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe", max_line_search_iters=50,
+                  initial_step_length=1.0, dtype="float32", name=None):
+    """Minimize `objective_func` from `initial_position` with BFGS.
+    Returns (is_converge, num_func_calls, position, objective_value,
+    objective_gradient) — the reference bfgs.py contract."""
+    x0, shape = _prep(initial_position, dtype)
+    f = _pure_objective(
+        lambda t: objective_func(Tensor(t.data.reshape(shape))))
+    n = x0.shape[0]
+    if initial_inverse_hessian_estimate is not None:
+        h0 = initial_inverse_hessian_estimate
+        h0 = (h0.data if isinstance(h0, Tensor) else jnp.asarray(h0))
+        h0 = h0.astype(x0.dtype)
+    else:
+        h0 = jnp.eye(n, dtype=x0.dtype)
+    conv, calls, x, fv, g = _bfgs_impl(
+        f, x0, int(max_iters), float(tolerance_grad),
+        float(tolerance_change), h0, int(max_line_search_iters),
+        float(initial_step_length))
+    return (Tensor(conv), Tensor(calls), Tensor(x.reshape(shape)),
+            Tensor(fv), Tensor(g.reshape(shape)))
+
+
+@partial(jax.jit, static_argnums=(0, 2, 5, 6))
+def _lbfgs_impl(f, x0, max_iters, tol_grad, tol_change, m, max_ls, alpha0):
+    n = x0.shape[0]
+    f0, g0 = jax.value_and_grad(f)(x0)
+    S = jnp.zeros((m, n), dtype=x0.dtype)
+    Y = jnp.zeros((m, n), dtype=x0.dtype)
+    R = jnp.zeros((m,), dtype=x0.dtype)  # rho_i; 0 marks an empty slot
+
+    def direction(g, S, Y, R, gamma, k):
+        """Two-loop recursion over the rolling history in age order
+        (newest first on the backward pass, oldest first forward); empty
+        slots have rho==0 so their contribution vanishes."""
+        def bwd(j, carry):
+            q, a = carry
+            i = jnp.mod(k - 1 - j, m)  # newest -> oldest
+            ai = R[i] * jnp.vdot(S[i], q)
+            return (q - ai * Y[i], a.at[i].set(ai))
+
+        q, a = jax.lax.fori_loop(
+            0, m, bwd, (g, jnp.zeros((m,), dtype=g.dtype)))
+        r = gamma * q
+
+        def fwd(j, r):
+            i = jnp.mod(k - m + j, m)  # oldest -> newest
+            bi = R[i] * jnp.vdot(Y[i], r)
+            return r + S[i] * (a[i] - bi)
+
+        return -jax.lax.fori_loop(0, m, fwd, r)
+
+    def cond(s):
+        k, x, fv, g, S, Y, R, gamma, calls, conv = s
+        return jnp.logical_and(k < max_iters, jnp.logical_not(conv))
+
+    def body(s):
+        k, x, fv, g, S, Y, R, gamma, calls, _ = s
+        p = direction(g, S, Y, R, gamma, k)
+        alpha, f1, g1, evals, ls_ok = _line_search(
+            f, x, fv, g, p, max_ls, alpha0)
+        sk = alpha * p
+        x1 = x + sk
+        yk = g1 - g
+        sy = jnp.vdot(sk, yk)
+        good = sy > 1e-10
+        slot = k % m  # rolling history window
+        S1 = jnp.where(good, S.at[slot].set(sk), S)
+        Y1 = jnp.where(good, Y.at[slot].set(yk), Y)
+        R1 = jnp.where(good,
+                       R.at[slot].set(1.0 / jnp.where(good, sy, 1.0)), R)
+        gamma1 = jnp.where(good, sy / jnp.vdot(yk, yk), gamma)
+        conv = jnp.logical_or(
+            jnp.max(jnp.abs(g1)) < tol_grad,
+            jnp.max(jnp.abs(sk)) < tol_change)
+        return (k + 1, x1, f1, g1, S1, Y1, R1, gamma1,
+                calls + evals, conv)
+
+    s0 = (jnp.asarray(0), x0, f0, g0, S, Y, R,
+          jnp.asarray(1.0, dtype=x0.dtype), jnp.asarray(1),
+          jnp.max(jnp.abs(g0)) < tol_grad)
+    k, x, fv, g, S, Y, R, gamma, calls, conv = jax.lax.while_loop(
+        cond, body, s0)
+    return conv, calls, x, fv, g
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-7, tolerance_change=1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", max_line_search_iters=50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    """Limited-memory BFGS with a fixed `(history_size, n)` rolling window
+    (reference lbfgs.py contract; same return tuple as minimize_bfgs)."""
+    x0, shape = _prep(initial_position, dtype)
+    f = _pure_objective(
+        lambda t: objective_func(Tensor(t.data.reshape(shape))))
+    conv, calls, x, fv, g = _lbfgs_impl(
+        f, x0, int(max_iters), float(tolerance_grad),
+        float(tolerance_change), int(min(history_size, max(1, max_iters))),
+        int(max_line_search_iters), float(initial_step_length))
+    return (Tensor(conv), Tensor(calls), Tensor(x.reshape(shape)),
+            Tensor(fv), Tensor(g.reshape(shape)))
