@@ -11,12 +11,27 @@ from ...core.tensor import Tensor, to_tensor
 
 __all__ = ["calculate_density", "create_mask", "check_mask_1d",
            "check_mask_2d", "prune_model", "decorate", "reset_excluded_layers",
-           "set_excluded_layers"]
+           "set_excluded_layers", "add_supported_layer"]
 
 import weakref
 
 _excluded = set()
 _pruned_models = []  # weakrefs of every prune_model target
+_supported_layer_types = set()  # extra layer classes opted into pruning
+_custom_pruning = {}  # layer-type name -> pruning func
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Opt a layer type into ASP pruning (reference
+    incubate/asp/supported_layer_list.py add_supported_layer): `layer` is a
+    Layer subclass or its type name; `pruning_func(weight_np, m, n,
+    mask_algo, param_name) -> (pruned_np, mask_np)` overrides the default
+    n:m masking for that type's parameters."""
+    name = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", str(layer))
+    _supported_layer_types.add(name)
+    if pruning_func is not None:
+        _custom_pruning[name] = pruning_func
 
 
 def calculate_density(x):
@@ -71,9 +86,23 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     """Apply n:m masks to every prunable parameter; returns name->mask
     (reference prune_model). Masks are also stashed on the model for the
     decorated optimizer to re-apply after each step."""
+    # map param name -> owning sublayer type, for custom pruning funcs
+    # registered via add_supported_layer
+    owner_type = {}
+    for lname, sub in model.named_sublayers():
+        for pname, _ in sub.named_parameters(include_sublayers=False):
+            owner_type[f"{lname}.{pname}" if lname else pname] = \
+                type(sub).__name__
     masks = {}
     for name, p in model.named_parameters():
         if not _prunable(name, p):
+            continue
+        custom = _custom_pruning.get(owner_type.get(name))
+        if custom is not None:
+            pruned, mask_np = custom(np.asarray(p.numpy()), m, n,
+                                     mask_algo, name)
+            p.set_value(np.asarray(pruned))
+            masks[name] = to_tensor(np.asarray(mask_np))
             continue
         mask = create_mask(p, mask_algo, n, m)
         p.set_value(np.asarray(p.numpy()) * np.asarray(mask.numpy()))

@@ -1,6 +1,105 @@
 """Higher-order autodiff extras (reference: python/paddle/incubate/
-autograd/ — jacobian/hessian/jvp/vjp re-exported from the functional
-autograd surface, which lowers to jax.jacfwd/jacrev/jvp/vjp)."""
-from ...autograd.functional import (jacobian, hessian, vjp, jvp)  # noqa: F401
+autograd/__init__.py — vjp/jvp, the lazy Jacobian/Hessian views, the
+functional forward_grad/grad, and the prim-decomposition switches).
 
-__all__ = ["jacobian", "hessian", "vjp", "jvp"]
+TPU-native: jacobian/hessian lower to jax.jacrev/jax.hessian; forward_grad
+is forward-mode (jax.jvp over the functionalized relation);
+enable_prim/disable_prim only record a preference — under XLA every op is
+ALWAYS decomposed to primitives at trace time (the reference needs the
+switch because its eager kernels are monolithic; here 'prim' is
+structurally always on)."""
+import jax
+import jax.numpy as jnp
+
+from ...autograd.functional import (  # noqa: F401
+    jacobian, hessian, vjp, jvp, _functionalize,
+)
+from ...core.autograd import grad as _tape_grad
+from ...core.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "jacobian", "hessian",
+           "enable_prim", "disable_prim", "prim_enabled", "forward_grad",
+           "grad"]
+
+_prim_enabled = [False]
+
+
+def enable_prim():
+    """Record the prim preference (reference: switch grads to composite
+    primitive rules so the compiler sees only primitives). Decomposition is
+    structural here — every op traces to XLA primitives unconditionally —
+    so the flag exists for source compatibility and introspection."""
+    _prim_enabled[0] = True
+
+
+def disable_prim():
+    _prim_enabled[0] = False
+
+
+def prim_enabled():
+    return _prim_enabled[0]
+
+
+class Jacobian:
+    """Lazy Jacobian view (reference incubate/autograd/functional.py
+    Jacobian class): materializes on first indexing; `J[:]` is the full
+    matrix. Rows follow the flattened output, columns the flattened
+    input."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func, self._xs, self._is_batched = func, xs, is_batched
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is None:
+            self._mat = jacobian(self._func, self._xs,
+                                 is_batched=self._is_batched)
+        return self._mat
+
+    @property
+    def shape(self):
+        return self._materialize().shape
+
+    def __getitem__(self, idx):
+        return self._materialize()[idx]
+
+    def numpy(self):
+        return self._materialize().numpy()
+
+
+class Hessian(Jacobian):
+    """Lazy Hessian view (reference Hessian class): symmetric (n, n) for a
+    scalar objective."""
+
+    def _materialize(self):
+        if self._mat is None:
+            self._mat = hessian(self._func, self._xs,
+                                is_batched=self._is_batched)
+        return self._mat
+
+
+def forward_grad(func, xs, tangents=None):
+    """Forward-mode derivative of `func` at `xs` seeded with `tangents`
+    (default: ones). The reference routes this through its primitive
+    forward-AD rules; here it is jax.jvp directly."""
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    f = _functionalize(func)
+    primals = tuple(x.data for x in xs_l)
+    if tangents is None:
+        tans = tuple(jnp.ones_like(p) for p in primals)
+    else:
+        t_l = tangents if isinstance(tangents, (list, tuple)) else [tangents]
+        tans = tuple(t.data if isinstance(t, Tensor) else jnp.asarray(t)
+                     for t in t_l)
+    _, jv = jax.jvp(f, primals, tans)
+    if isinstance(jv, tuple):
+        out = tuple(Tensor(a) for a in jv)
+        return out if len(out) > 1 else out[0]
+    return Tensor(jv)
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """Reference incubate.autograd.grad: tape grad with create_graph
+    semantics so the result composes into further differentiation."""
+    return _tape_grad(outputs, inputs, grad_outputs=grad_outputs,
+                      create_graph=True, allow_unused=True)
