@@ -3,4 +3,5 @@ classes + functional bindings)."""
 from . import functional  # noqa: F401
 from .layers import (FusedMultiHeadAttention, FusedFeedForward,  # noqa: F401
                      FusedTransformerEncoderLayer,
-                     FusedBiasDropoutResidualLayerNorm)
+                     FusedBiasDropoutResidualLayerNorm,
+                     FusedLinear, FusedDropoutAdd, FusedMultiTransformer)

@@ -159,3 +159,157 @@ class FusedBiasDropoutResidualLayerNorm(Layer):
             x, residual, bias=self.bias, ln_scale=self.ln_scale,
             ln_bias=self.ln_bias, dropout_rate=self._dropout_rate,
             ln_epsilon=self._epsilon, training=self.training)
+
+
+class FusedLinear(Layer):
+    """Linear through the gemm-epilogue path (reference
+    incubate/nn/layer/fused_linear.py:83): bias-add fuses into the matmul
+    (XLA does on TPU what cublasLt epilogues do on GPU)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        shape = [out_features, in_features] if transpose_weight \
+            else [in_features, out_features]
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr, default_initializer=I.XavierUniform())
+        self.bias = self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True)
+        self.transpose_weight = transpose_weight
+
+    def forward(self, x):
+        return F.fused_linear(x, self.weight, self.bias,
+                              self.transpose_weight)
+
+
+class FusedDropoutAdd(Layer):
+    """dropout(x) + y in one region (reference
+    incubate/nn/layer/fused_dropout_add.py; kernel
+    fused_dropout_add_kernel.cu)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return F.fused_dropout_add(x, y, p=self.p, training=self.training,
+                                   mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+def _layer_list(attrs, n, make):
+    """Per-layer parameter list following the reference's attr-list
+    convention (a list of attrs fixes num_layers)."""
+    return [make(attrs[i] if isinstance(attrs, (list, tuple)) else attrs, i)
+            for i in range(n)]
+
+
+class FusedMultiTransformer(Layer):
+    """Whole decoder stack as ONE op (reference
+    incubate/nn/layer/fused_transformer.py:1071 over
+    fused_multi_transformer_kernel.cu): n_layers × [LN → QKV(+rope) →
+    cached attention → out-proj+residual → LN → FFN → residual], serving
+    the same parameter layout; execution is the functional
+    fused_multi_transformer (XLA-fused chain, GQA/int8/int4 variants in
+    the serving engine)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, ln_scale_attrs=None,
+                 ln_bias_attrs=None, qkv_weight_attrs=None,
+                 qkv_bias_attrs=None, linear_weight_attrs=None,
+                 linear_bias_attrs=None, ffn_ln_scale_attrs=None,
+                 ffn_ln_bias_attrs=None, ffn1_weight_attrs=None,
+                 ffn1_bias_attrs=None, ffn2_weight_attrs=None,
+                 ffn2_bias_attrs=None, epsilon=1e-5, residual_alpha=1.0,
+                 num_layers=-1, nranks=1, trans_qkvw=True, ring_id=-1,
+                 name=None, norm_type="layernorm",
+                 use_neox_rotary_style=False, gqa_group_size=-1):
+        super().__init__()
+        assert embed_dim > 0 and num_heads > 0 and dim_feedforward > 0
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) \
+                if isinstance(qkv_weight_attrs, (list, tuple)) else 1
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        self._residual_alpha = residual_alpha
+        self._trans_qkvw = trans_qkvw
+        self._norm_type = norm_type
+        self._use_neox_rotary_style = use_neox_rotary_style
+        self._gqa_group_size = gqa_group_size
+        self._dropout_rate = dropout_rate
+        self.activation = activation
+        self.num_layers = num_layers
+        kv_heads = gqa_group_size if gqa_group_size > 0 else num_heads
+        qkv_rows = num_heads + 2 * kv_heads
+
+        def plist(name_, attrs, shape, init=None, bias=False):
+            ps = _layer_list(
+                attrs, num_layers,
+                lambda a, i: self.create_parameter(
+                    shape, attr=a, is_bias=bias,
+                    default_initializer=init or I.XavierUniform()))
+            for i, p_ in enumerate(ps):
+                setattr(self, f"{name_}_{i}", p_)
+            return ps
+
+        hd = self.head_dim
+        self.ln_scales = plist("ln_scale", ln_scale_attrs, [embed_dim],
+                               I.Constant(1.0))
+        self.ln_biases = plist("ln_bias", ln_bias_attrs, [embed_dim],
+                               bias=True)
+        # reference layout (trans_qkvw=True): [qkv_rows, head_dim, E];
+        # split as [3, H, D, E] for MHA or GQA-packed rows
+        self.qkv_weights = plist(
+            "qkv_weight", qkv_weight_attrs,
+            [3, num_heads, hd, embed_dim] if kv_heads == num_heads
+            else [qkv_rows, hd, embed_dim])
+        # bias layout matches the functional's [3, H, D] (MHA) /
+        # [H + 2G, D] (GQA-packed) broadcast
+        self.qkv_biases = plist(
+            "qkv_bias", qkv_bias_attrs,
+            [3, num_heads, hd] if kv_heads == num_heads
+            else [qkv_rows, hd], bias=True)
+        self.linear_weights = plist(
+            "linear_weight", linear_weight_attrs,
+            [num_heads * hd, embed_dim])
+        self.linear_biases = plist("linear_bias", linear_bias_attrs,
+                                   [embed_dim], bias=True)
+        self.ffn_ln_scales = plist("ffn_ln_scale", ffn_ln_scale_attrs,
+                                   [embed_dim], I.Constant(1.0))
+        self.ffn_ln_biases = plist("ffn_ln_bias", ffn_ln_bias_attrs,
+                                   [embed_dim], bias=True)
+        ffn1_cols = dim_feedforward * (2 if "glu" in activation else 1)
+        self.ffn1_weights = plist("ffn1_weight", ffn1_weight_attrs,
+                                  [embed_dim, ffn1_cols])
+        self.ffn1_biases = plist("ffn1_bias", ffn1_bias_attrs,
+                                 [ffn1_cols], bias=True)
+        self.ffn2_weights = plist("ffn2_weight", ffn2_weight_attrs,
+                                  [dim_feedforward, embed_dim])
+        self.ffn2_biases = plist("ffn2_bias", ffn2_bias_attrs,
+                                 [embed_dim], bias=True)
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None):
+        return F.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=self.normalize_before, epsilon=self._epsilon,
+            residual_alpha=self._residual_alpha, cache_kvs=caches,
+            pre_caches=pre_caches, seq_lens=seq_lens,
+            rotary_embs=rotary_embs, time_step=time_step,
+            attn_mask=attn_mask, dropout_rate=self._dropout_rate,
+            rotary_emb_dims=rotary_emb_dims, activation=self.activation,
+            training=self.training, trans_qkvw=self._trans_qkvw,
+            norm_type=self._norm_type,
+            use_neox_rotary_style=self._use_neox_rotary_style,
+            gqa_group_size=self._gqa_group_size)

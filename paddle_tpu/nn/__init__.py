@@ -2,6 +2,7 @@
 from .layer import Layer, ParamAttr
 from . import functional
 from . import initializer
+from . import quant
 from .clip import (ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
                    clip_grad_norm_)
 

@@ -1,0 +1,183 @@
+"""paddle.nn.quant parity — weight-only / llm.int8 quantized linear tier.
+
+Reference: python/paddle/nn/quant/quantized_linear.py (weight_quantize :64,
+weight_dequantize :131, weight_only_linear :191, llm_int8_linear :285) and
+stub.py:29. The reference dispatches to cutlass mixed-precision GEMM
+kernels gated on SM arch; here the int->bf16 dequant is expressed next to
+the matmul and XLA fuses it into the MXU operand load (same design as the
+fused_multi_transformer int8/int4 serving tier,
+paddle_tpu/incubate/nn/functional). int4 packs two nibbles per int8 byte
+along the in-features axis — half the weight HBM of int8 — reusing the
+serving tier's pack format.
+
+Layout contract (matches the reference): `weight_quantize` takes the
+[in, out] float weight and returns ([out, in] int8, scale); the quantized
+weight is transposed. `weight_only_linear` consumes that layout.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from .layer import Layer
+
+__all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
+           "weight_quantize", "weight_dequantize"]
+
+_VALID_GROUPS = (-1, 64, 128)
+
+
+def _unwrap(t):
+    return t.data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """Per-out-channel (or grouped) absmax quantization.
+
+    Returns (quantized int8 [out, in] — int4 packed to [out, in//2] —,
+    scale float32 [out] or [in//group, out])."""
+    assert group_size in _VALID_GROUPS, group_size
+    w = np.asarray(_unwrap(x), dtype=np.float32)  # [in, out]
+    qmax = 7.0 if algo == "weight_only_int4" else 127.0
+    if group_size == -1:
+        scale = np.maximum(np.abs(w).max(axis=0), 1e-8) / qmax  # [out]
+        q = np.clip(np.round(w / scale[None, :]), -qmax - 1, qmax)
+    else:
+        in_f, out_f = w.shape
+        assert in_f % group_size == 0, (in_f, group_size)
+        g = w.reshape(in_f // group_size, group_size, out_f)
+        scale = np.maximum(np.abs(g).max(axis=1), 1e-8) / qmax  # [in/g, out]
+        q = np.clip(np.round(g / scale[:, None, :]), -qmax - 1, qmax)
+        q = q.reshape(in_f, out_f)
+    q = q.astype(np.int8).T  # [out, in]
+    if algo == "weight_only_int4":
+        lo = q[:, 0::2]
+        hi = q[:, 1::2]
+        q = (((hi.astype(np.uint8) & 0x0F) << 4) |
+             (lo.astype(np.uint8) & 0x0F)).astype(np.int8)  # [out, in//2]
+    return Tensor(jnp.asarray(q)), Tensor(jnp.asarray(
+        scale.astype(np.float32)))
+
+
+def _unpack_int4_np(q):
+    """[out, in//2] packed nibbles -> [out, in] int8 in [-8, 7]."""
+    u = q.astype(jnp.uint8)
+    lo = (u & 0x0F).astype(jnp.int8)
+    hi = (u >> 4).astype(jnp.int8)
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    out = jnp.stack([lo, hi], axis=-1)  # [out, in//2, 2]
+    return out.reshape(q.shape[0], q.shape[1] * 2)
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8",
+                      out_dtype="float16", group_size=-1):
+    """Inverse of weight_quantize: int8/int4-packed [out, in(/2)] + scale ->
+    float [in, out] (transposed back, reference weight_dequantize :131)."""
+    assert group_size in _VALID_GROUPS, group_size
+
+    def impl(q, s):
+        qq = _unpack_int4_np(q) if algo == "weight_only_int4" else q
+        w = qq.astype(jnp.float32).T  # [in, out]
+        if group_size == -1:
+            w = w * s[None, :]
+        else:
+            in_f = w.shape[0]
+            w = w.reshape(in_f // group_size, group_size, -1) * s[:, None, :]
+            w = w.reshape(in_f, -1)
+        return w.astype(out_dtype)
+
+    return apply_op("weight_dequantize", impl, (x, scale), {})
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """y = x @ W^T + b with W stored int8 (or packed int4) [out, in] and
+    per-out-channel (or grouped) scales. The dequant sits inside the traced
+    computation so XLA fuses it with the GEMM (reference: cutlass
+    mixed-gemm, weight_only_linear :191)."""
+    assert group_size in _VALID_GROUPS, group_size
+
+    def impl(xv, w, *rest):
+        it = iter(rest)
+        s = next(it) if weight_scale is not None else None
+        b = next(it) if bias is not None else None
+        wq = _unpack_int4_np(w) if str(weight_dtype) == "int4" else w
+        cdt = xv.dtype if xv.dtype in (jnp.bfloat16, jnp.float16) \
+            else jnp.float32
+        if s is None:
+            wf = wq.astype(cdt)
+            y = xv @ wf.T.astype(cdt)
+        elif group_size == -1:
+            # scale per out channel: apply after the matmul (cheapest)
+            y = (xv @ wq.T.astype(cdt)) * s.astype(cdt)[None, :]
+        else:
+            in_f = wq.shape[1]
+            wf = (wq.astype(jnp.float32).T.reshape(
+                in_f // group_size, group_size, -1) *
+                s[:, None, :]).reshape(in_f, -1)
+            y = xv @ wf.astype(cdt)
+        if b is not None:
+            y = y + b.astype(y.dtype)
+        return y.astype(xv.dtype)
+
+    args = [x, weight]
+    if weight_scale is not None:
+        args.append(weight_scale)
+    if bias is not None:
+        args.append(bias)
+    return apply_op("weight_only_linear", impl, tuple(args), {})
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
+    """LLM.int8() outlier-decomposed linear (reference llm_int8_linear
+    :285): input features whose |x| exceeds `threshold` run against the
+    dequantized fp weight; the rest run int8. Static shapes: the outlier
+    set is a mask, both branches are dense, and XLA fuses the select —
+    dynamic outlier gathers would break TPU tiling."""
+
+    def impl(xv, w, *rest):
+        it = iter(rest)
+        s = next(it) if weight_scale is not None else None
+        b = next(it) if bias is not None else None
+        cdt = xv.dtype if xv.dtype in (jnp.bfloat16, jnp.float16) \
+            else jnp.float32
+        amax = jnp.max(jnp.abs(xv.astype(jnp.float32)),
+                       axis=tuple(range(xv.ndim - 1)))  # per in-feature
+        outlier = amax > threshold  # [in]
+        x_reg = jnp.where(outlier[None, :], 0, xv)
+        x_out = xv - x_reg
+        y = x_reg @ w.T.astype(cdt)
+        if s is not None:
+            y = y * s.astype(cdt)[None, :]
+            w_fp = w.astype(jnp.float32) * s[:, None]
+        else:
+            w_fp = w.astype(jnp.float32)
+        y = y + (x_out.astype(jnp.float32) @ w_fp.T).astype(y.dtype)
+        if b is not None:
+            y = y + b.astype(y.dtype)
+        return y.astype(xv.dtype)
+
+    args = [x, weight]
+    if weight_scale is not None:
+        args.append(weight_scale)
+    if bias is not None:
+        args.append(bias)
+    return apply_op("llm_int8_linear", impl, tuple(args), {})
+
+
+class Stub(Layer):
+    """Placeholder layer replaced by an observer/quanter when a
+    quantization config is applied (reference nn/quant/stub.py:29): call it
+    in forward ahead of a functional op so PTQ/QAT can observe that
+    activation. Until replaced, it is identity."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer_factory = observer
+
+    def forward(self, x):
+        return x
+
+    def extra_repr(self):
+        return f"observer={self._observer_factory}"

@@ -2,8 +2,10 @@
 PTQ/QAT framework with observers and quanters; SURVEY.md §2.10)."""
 from .config import QuantConfig
 from .observers import (BaseObserver, AbsmaxObserver, EMAObserver,
-                        PercentileObserver, AbsmaxChannelWiseObserver)
-from .quanters import (FakeQuanterWithAbsMax, fake_quant, quantize,
+                        PercentileObserver, AbsmaxChannelWiseObserver,
+                        GroupWiseWeightObserver)
+from .quanters import (FakeQuanterWithAbsMax, FakeQuanterWithAbsMaxObserver,
+                       fake_quant, quantize,
                        dequantize, quanter)
 from .qat import (QAT, PTQ, QuantedLinear, QuantedConv2D,
                   InferQuantedLinear)
@@ -11,6 +13,7 @@ from .qat import (QAT, PTQ, QuantedLinear, QuantedConv2D,
 __all__ = [
     "QuantConfig", "BaseObserver", "AbsmaxObserver", "EMAObserver",
     "PercentileObserver", "AbsmaxChannelWiseObserver",
+    "GroupWiseWeightObserver", "FakeQuanterWithAbsMaxObserver",
     "FakeQuanterWithAbsMax", "fake_quant", "quantize", "dequantize",
     "quanter", "QAT", "PTQ", "QuantedLinear", "QuantedConv2D",
     "InferQuantedLinear",

@@ -126,3 +126,34 @@ class AbsmaxChannelWiseObserver(BaseObserver):
 
     def scales(self):
         return np.maximum(self._absmax, 1e-9) / self._qbound()
+
+
+class GroupWiseWeightObserver(AbsmaxChannelWiseObserver):
+    """Group-wise absmax weight observer (reference
+    quantization/observers/groupwise.py): channels along `quant_axis` are
+    split into groups of `group_size`; one scale per group — the statistics
+    tier behind group-quantized weight_only_linear (nn/quant.py
+    group_size=64/128)."""
+
+    def __init__(self, quant_bits=8, quant_axis=0, group_size=128):
+        super().__init__(quant_bits, quant_axis)
+        self._group_size = group_size
+
+    def group_size(self):
+        return self._group_size
+
+    def _observe(self, x):
+        a = np.abs(np.asarray(x.numpy()))
+        if a.ndim < 2:
+            return super()._observe(x)
+        # group along quant_axis: [n_groups, group_size, rest...] absmax
+        a = np.moveaxis(a, self._axis, 0)
+        n = a.shape[0]
+        g = self._group_size
+        pad = (-n) % g
+        if pad:
+            a = np.concatenate([a, np.zeros((pad,) + a.shape[1:])], 0)
+        m = a.reshape(-1, g, *a.shape[1:]).max(axis=tuple(
+            range(1, a.ndim + 1)))
+        self._absmax = m if self._absmax is None else np.maximum(
+            self._absmax, m)

@@ -117,3 +117,14 @@ def quanter(name="FakeQuanterWithAbsMax", **kwargs):
     table = {"FakeQuanterWithAbsMax": FakeQuanterWithAbsMax}
     cls = table[name]
     return lambda: cls(**kwargs)
+
+
+class FakeQuanterWithAbsMaxObserver(FakeQuanterWithAbsMax):
+    """Reference quanters/abs_max.py FakeQuanterWithAbsMaxObserver — the
+    factory-named moving-average absmax quanter. Same mechanism as
+    FakeQuanterWithAbsMax; kept as its own class so configs addressing the
+    reference name map 1:1."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, dtype="float32",
+                 name=None):
+        super().__init__(quant_bits=quant_bits, moving_rate=moving_rate)
