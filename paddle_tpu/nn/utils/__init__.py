@@ -1,0 +1,179 @@
+"""paddle.nn.utils parity (reference python/paddle/nn/utils/__init__.py:
+weight_norm / remove_weight_norm / spectral_norm hooks, the
+parameters↔vector flatteners, and the in-place grad clippers).
+
+Re-parametrizations are forward-pre-hooks: each forward recomputes the
+effective weight from the decomposed parameters, which XLA folds into the
+consuming matmul under jit (the reference mutates layer.weight per step via
+its own hook machinery)."""
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, Parameter
+from ..clip import clip_grad_norm_  # noqa: F401
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_except(w, dim):
+    """L2 norm over every axis except `dim` (dim=None: all axes)."""
+    if dim is None:
+        return (w * w).sum().sqrt()
+    axes = [i for i in range(len(w.shape)) if i != dim]
+    keep = (w * w).sum(axis=axes, keepdim=True)
+    return keep.sqrt()
+
+
+class _WeightNormHook:
+    """weight = g * v / ||v|| (reference nn/utils/weight_norm_hook.py):
+    the layer's `weight` parameter splits into `weight_g` (magnitude) and
+    `weight_v` (direction); recombined each forward."""
+
+    def __init__(self, layer, name, dim):
+        self.name = name
+        self.dim = dim
+        w = getattr(layer, name)
+        g = Parameter(_norm_except(w, dim).data)
+        v = Parameter(w.data)
+        v.stop_gradient = w.stop_gradient
+        g.stop_gradient = w.stop_gradient
+        # remove the plain parameter; register the decomposition
+        del layer._parameters[name]
+        layer.add_parameter(name + "_g", g)
+        layer.add_parameter(name + "_v", v)
+        self._compute(layer)
+
+    def _compute(self, layer):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        w = v * (g / _norm_except(v, self.dim))
+        object.__setattr__(layer, self.name, w)
+
+    def __call__(self, layer, inputs):
+        self._compute(layer)
+        return None
+
+
+class _SpectralNormHook:
+    """weight / sigma_max via power iteration (reference
+    nn/utils/spectral_norm_hook.py): u/v vectors persist as buffers, one
+    iteration per forward while training."""
+
+    def __init__(self, layer, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.dim = dim
+        self.n_power_iterations = n_power_iterations
+        self.eps = eps
+        w = getattr(layer, name)
+        mat = self._as_matrix(np.asarray(w.numpy()))
+        rng = np.random.default_rng(0)
+        u = rng.normal(size=(mat.shape[0],)).astype(mat.dtype)
+        v = rng.normal(size=(mat.shape[1],)).astype(mat.dtype)
+        self._orig = Parameter(w.data)
+        self._orig.stop_gradient = w.stop_gradient
+        del layer._parameters[name]
+        layer.add_parameter(name + "_orig", self._orig)
+        self._u = u / max(np.linalg.norm(u), eps)
+        self._v = v / max(np.linalg.norm(v), eps)
+        self._compute(layer)
+
+    def _as_matrix(self, w):
+        if self.dim != 0:
+            w = np.moveaxis(w, self.dim, 0)
+        return w.reshape(w.shape[0], -1)
+
+    def _compute(self, layer):
+        orig = getattr(layer, self.name + "_orig")
+        w_np = self._as_matrix(np.asarray(orig.numpy()))
+        u, v = self._u, self._v
+        for _ in range(self.n_power_iterations if layer.training else 0):
+            v = w_np.T @ u
+            v = v / max(np.linalg.norm(v), self.eps)
+            u = w_np @ v
+            u = u / max(np.linalg.norm(u), self.eps)
+        self._u, self._v = u, v
+        sigma = float(u @ (w_np @ v))
+        w = orig / max(abs(sigma), self.eps) if sigma >= 0 else \
+            orig / min(-abs(sigma), -self.eps)
+        object.__setattr__(layer, self.name, w)
+
+    def __call__(self, layer, inputs):
+        self._compute(layer)
+        return None
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Apply weight normalization to `layer.name` (reference weight_norm)."""
+    hook = _WeightNormHook(layer, name, dim)
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (hook, handle)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a plain `weight` parameter."""
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"weight_norm of '{name}' not found on {layer}")
+    hook, handle = hooks.pop(name)
+    hook._compute(layer)
+    w = getattr(layer, name)
+    folded = Parameter(w.data)
+    folded.stop_gradient = getattr(layer, name + "_v").stop_gradient
+    handle.remove()
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    # drop the hook-computed instance attribute: it would shadow the
+    # re-registered Parameter (instance __dict__ wins over __getattr__)
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, folded)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Apply spectral normalization to `layer.name` (reference
+    spectral_norm): weight / largest-singular-value estimate."""
+    if dim is None:
+        dim = 0
+    hook = _SpectralNormHook(layer, name, n_power_iterations, eps, dim)
+    handle = layer.register_forward_pre_hook(hook)
+    layer._spectral_norm_hooks = getattr(layer, "_spectral_norm_hooks", {})
+    layer._spectral_norm_hooks[name] = (hook, handle)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten a parameter list into one 1-D tensor (reference
+    parameters_to_vector)."""
+    ps = list(parameters)
+    if not ps:
+        return Tensor(jnp.zeros((0,)))
+    return Tensor(jnp.concatenate([p.data.reshape(-1) for p in ps]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Write slices of `vec` back into the parameter tensors in order."""
+    data = vec.data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p.set_value(np.asarray(data[off:off + n]).reshape(p.shape))
+        off += n
+    if off != data.shape[0]:
+        raise ValueError(
+            f"vector has {data.shape[0]} elements; parameters take {off}")
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place clamp of every .grad to [-clip_value, clip_value]
+    (reference clip_grad_value_)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    cv = float(clip_value)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(p.grad.data, -cv, cv))
