@@ -1,0 +1,156 @@
+"""paddle.sparse.nn layers (reference: python/paddle/sparse/nn/layer/ —
+activation, norm, conv, pooling; functional siblings in ./functional.py)."""
+import numpy as np
+
+from ...nn.layer import Layer
+from .. import ops
+from . import functional  # noqa: F401
+from . import functional as F
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D",
+           "MaxPool3D"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return ops.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return ops.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return ops.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return ops.softmax(x, self._axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the dense feature dim of a COO tensor's values
+    (reference sparse/nn/layer/norm.py:34 — normalizes nnz x channels)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        super().__init__()
+        from ...nn.layers.norm import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon)
+
+    def forward(self, x):
+        return x.with_values(self._bn(x.values()))
+
+
+class SyncBatchNorm(Layer):
+    """Cross-replica BatchNorm over COO values (reference
+    sparse/nn/layer/norm.py SyncBatchNorm): under a mesh the batch stats
+    reduce over the data axis (dense SyncBatchNorm machinery reused on the
+    nnz x channels view)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        from ...nn.layers.norm import SyncBatchNorm as _Dense
+        self._bn = _Dense(num_features, momentum=momentum, epsilon=epsilon,
+                          weight_attr=weight_attr, bias_attr=bias_attr)
+
+    def forward(self, x):
+        return x.with_values(self._bn(x.values()))
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Swap sparse BatchNorm sublayers for SyncBatchNorm (reference
+        classmethod)."""
+        if isinstance(layer, BatchNorm):
+            out = cls(layer._bn.num_features)
+            out._bn.weight.set_value(np.asarray(layer._bn.weight.numpy()))
+            out._bn.bias.set_value(np.asarray(layer._bn.bias.numpy()))
+            return out
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class _SparseConvNd(Layer):
+    _nd = 3
+    _subm = False
+    _fn = staticmethod(F.conv3d)
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        from ...nn.initializer import XavierUniform
+        nd = self._nd
+        ks = (kernel_size,) * nd if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._ks = ks
+        self._stride = stride
+        self._padding = padding
+        # reference weight layout: [*kernel, Cin, Cout]
+        self.weight = self.create_parameter(
+            shape=[*ks, in_channels, out_channels], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = (self.create_parameter(shape=[out_channels],
+                                           attr=bias_attr, is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        return type(self)._fn(x, self.weight, self.bias,
+                              stride=self._stride, padding=self._padding)
+
+
+class Conv3D(_SparseConvNd):
+    """Sparse 3-D conv layer (reference sparse/nn/layer/conv.py Conv3D)."""
+    _nd = 3
+    _fn = staticmethod(F.conv3d)
+
+
+class Conv2D(_SparseConvNd):
+    """Sparse 2-D conv layer (reference Conv2D)."""
+    _nd = 2
+    _fn = staticmethod(F.conv2d)
+
+
+class SubmConv3D(_SparseConvNd):
+    """Submanifold sparse 3-D conv (reference SubmConv3D; output structure
+    == input structure, rulebook cached by coordinate hash)."""
+    _nd = 3
+    _subm = True
+    _fn = staticmethod(F.subm_conv3d)
+
+
+class SubmConv2D(_SparseConvNd):
+    """Submanifold sparse 2-D conv (reference SubmConv2D)."""
+    _nd = 2
+    _subm = True
+    _fn = staticmethod(F.subm_conv2d)
+
+
+class MaxPool3D(Layer):
+    """Sparse max-pool layer (reference sparse/nn/layer/pooling.py)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self._ks = kernel_size
+        self._stride = stride
+        self._padding = padding
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._ks, self._stride, self._padding)

@@ -1,0 +1,237 @@
+"""paddle.sparse.nn.functional parity (reference:
+python/paddle/sparse/nn/functional/ — conv2d/3d, subm_conv2d/3d (+_igemm),
+max_pool3d, activations, sparse attention; kernels
+paddle/phi/kernels/sparse/gpu/conv_kernel.cu, pool kernels).
+
+TPU design: sparse convolution = rulebook (host-side coordinate matching,
+the reference kernel's GPU hash-table pass) + gather-matmul-scatter on
+device. The rulebook depends only on the coordinate STRUCTURE, which for
+point-cloud workloads is static across many steps — it is cached by
+structure hash, so steady-state cost is the device einsum/scatter that XLA
+tiles onto the MXU. The *_igemm variants are the same math (the reference's
+implicit-gemm is a CUDA scheduling choice; XLA owns scheduling here)."""
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ..tensor import SparseCooTensor
+from .. import ops as _ops
+
+__all__ = ["conv2d", "conv3d", "subm_conv2d", "subm_conv2d_igemm",
+           "subm_conv3d", "subm_conv3d_igemm", "max_pool3d", "relu",
+           "relu6", "leaky_relu", "softmax", "attention"]
+
+relu = _ops.relu
+relu6 = _ops.relu6
+leaky_relu = _ops.leaky_relu
+softmax = _ops.softmax
+attention = _ops.attention
+
+_structure_cache = {}  # (idx-bytes, geometry) -> rulebook / out structure
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def _taps(ks):
+    """Kernel offsets [prod(ks), ndim] in row-major tap order."""
+    grids = np.meshgrid(*[np.arange(k) for k in ks], indexing="ij")
+    return np.stack(grids, -1).reshape(-1, len(ks))
+
+
+def _subm_rulebook(idx, ks):
+    """Submanifold matching: output structure == input structure; for each
+    tap, pair input points whose shifted coordinate is also a point.
+    idx: [1+ndim, nnz] (batch + spatial). Returns (taps, src, dst)."""
+    nd = idx.shape[0] - 1
+    key = (idx.tobytes(), ("subm",) + tuple(ks))
+    hit_c = _structure_cache.get(key)
+    if hit_c is not None:
+        return hit_c
+    nnz = idx.shape[1]
+    ext = idx.max(axis=1) + np.array([1, *ks]) + 1
+
+    def ravel(c):
+        out = c[0]
+        for d in range(1, nd + 1):
+            out = out * ext[d] + c[d]
+        return out
+
+    keys = ravel(idx)
+    order = np.argsort(keys)
+    sorted_keys = keys[order]
+    center = np.array([k // 2 for k in ks])
+    taps_l, src_l, dst_l = [], [], []
+    for t, o in enumerate(_taps(ks)):
+        src = idx.copy()
+        src[1:] += (o - center)[:, None]
+        valid = (src[1:] >= 0).all(axis=0)
+        sk = ravel(src)
+        pos = np.clip(np.searchsorted(sorted_keys, sk), 0, nnz - 1)
+        hit = valid & (sorted_keys[pos] == sk)
+        dst = np.nonzero(hit)[0]
+        taps_l.append(np.full(len(dst), t, np.int32))
+        src_l.append(order[pos[hit]].astype(np.int32))
+        dst_l.append(dst.astype(np.int32))
+    rb = (np.concatenate(taps_l), np.concatenate(src_l),
+          np.concatenate(dst_l))
+    _structure_cache[key] = rb
+    return rb
+
+
+def _conv_structure(idx, spatial, ks, stride, padding):
+    """Non-submanifold structure: every (input point, tap) lands on output
+    coordinate (in + pad - tap) / stride when divisible and in range.
+    Returns (out_idx [1+nd, out_nnz], out_spatial, taps, src, dst)."""
+    nd = idx.shape[0] - 1
+    key = (idx.tobytes(),
+           ("conv",) + tuple(ks) + tuple(stride) + tuple(padding)
+           + tuple(spatial))
+    hit_c = _structure_cache.get(key)
+    if hit_c is not None:
+        return hit_c
+    out_spatial = tuple(
+        (spatial[d] + 2 * padding[d] - ks[d]) // stride[d] + 1
+        for d in range(nd))
+    taps = _taps(ks)
+    nnz = idx.shape[1]
+    b = np.repeat(idx[0], len(taps))
+    src = np.tile(np.arange(nnz, dtype=np.int64), (len(taps), 1)).T.reshape(-1)
+    tap_id = np.tile(np.arange(len(taps), dtype=np.int64), nnz)
+    num = (idx[1:].T[:, None, :] + np.array(padding)[None, None, :]
+           - taps[None, :, :])  # [nnz, taps, nd]
+    st = np.array(stride)[None, None, :]
+    ok = (num % st == 0).all(-1) & (num >= 0).all(-1)
+    out_c = num // st
+    ok &= (out_c < np.array(out_spatial)[None, None, :]).all(-1)
+    ok = ok.reshape(-1)
+    out_c = out_c.reshape(-1, nd)[ok]
+    b, src, tap_id = b[ok], src[ok], tap_id[ok]
+    # unique output coordinates -> compact output indices
+    full = np.concatenate([b[:, None], out_c], axis=1)  # [pairs, 1+nd]
+    uniq, dst = np.unique(full, axis=0, return_inverse=True)
+    res = (uniq.T.astype(np.int32), out_spatial,
+           tap_id.astype(np.int32), src.astype(np.int32),
+           dst.astype(np.int32))
+    _structure_cache[key] = res
+    return res
+
+
+def _apply_rulebook(x, weight, bias, taps, src, dst, out_nnz, name):
+    def impl(values, w, *maybe_bias):
+        gathered = jnp.take(values, src, axis=0)
+        wk = jnp.take(w, taps, axis=0)          # [pairs, Cin, Cout]
+        contrib = jnp.einsum("pc,pcd->pd", gathered, wk)
+        out = jnp.zeros((out_nnz, w.shape[-1]), contrib.dtype)
+        out = out.at[dst].add(contrib)
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+
+    args = (x.values(), weight) + ((bias,) if bias is not None else ())
+    return apply_op(name, impl, args, {})
+
+
+def _sparse_conv(x, weight, bias, stride, padding, subm, nd, name):
+    """x: COO [N, *spatial, Cin] (coords [1+nd, nnz], values [nnz, Cin]);
+    weight [prod(ks), Cin, Cout] or the reference's [*ks, Cin, Cout]."""
+    wshape = list(weight.shape)
+    if len(wshape) == nd + 2:  # [*ks, Cin, Cout] reference layout
+        ks = tuple(int(s) for s in wshape[:nd])
+        weight = weight.reshape([int(np.prod(ks))] + wshape[nd:])
+    elif len(wshape) == 3:     # flat [prod(ks), Cin, Cout]
+        k = round(wshape[0] ** (1.0 / nd))
+        if k ** nd != wshape[0]:
+            raise ValueError(
+                f"flat sparse-conv weight {wshape} is not a cubic kernel")
+        ks = (k,) * nd
+    else:
+        raise ValueError(f"weight must be [*kernel, Cin, Cout]; got {wshape}")
+    stride = _tup(stride, nd)
+    padding = _tup(padding, nd)
+    idx = np.asarray(x.indices().numpy())
+    spatial = tuple(x.shape[1:1 + nd])
+    cout = int(weight.shape[-1])
+    if subm:
+        taps, src, dst = _subm_rulebook(idx, ks)
+        vals = _apply_rulebook(x, weight, bias, taps, src, dst,
+                               idx.shape[1], name)
+        return x.with_values(vals)
+    out_idx, out_spatial, taps, src, dst = _conv_structure(
+        idx, spatial, ks, stride, padding)
+    vals = _apply_rulebook(x, weight, bias, taps, src, dst,
+                           out_idx.shape[1], name)
+    out_shape = [x.shape[0], *out_spatial, cout]
+    return SparseCooTensor(out_idx, vals, out_shape, coalesced=True)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse 3-D convolution (reference sparse/nn/functional/conv.py
+    conv3d); weight [kD, kH, kW, Cin, Cout]."""
+    if dilation not in (1, (1, 1, 1)) or groups != 1:
+        raise NotImplementedError("sparse conv3d: dilation/groups == 1 only")
+    return _sparse_conv(x, weight, bias, stride, padding, False, 3,
+                        "sparse_conv3d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    """Sparse 2-D convolution; weight [kH, kW, Cin, Cout]."""
+    if dilation not in (1, (1, 1)) or groups != 1:
+        raise NotImplementedError("sparse conv2d: dilation/groups == 1 only")
+    return _sparse_conv(x, weight, bias, stride, padding, False, 2,
+                        "sparse_conv2d")
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold sparse 3-D conv: output structure == input structure
+    (reference subm_conv3d)."""
+    return _sparse_conv(x, weight, bias, stride, padding, True, 3,
+                        "sparse_subm_conv3d")
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    """Submanifold sparse 2-D conv."""
+    return _sparse_conv(x, weight, bias, stride, padding, True, 2,
+                        "sparse_subm_conv2d")
+
+
+def subm_conv3d_igemm(*args, **kwargs):
+    """Reference's implicit-gemm algorithmic variant: same math; scheduling
+    belongs to XLA on TPU, so this is subm_conv3d."""
+    return subm_conv3d(*args, **kwargs)
+
+
+def subm_conv2d_igemm(*args, **kwargs):
+    """See subm_conv3d_igemm."""
+    return subm_conv2d(*args, **kwargs)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """Sparse max pooling over COO voxels (reference
+    sparse/nn/functional/pooling.py max_pool3d): output structure from the
+    conv geometry; values max-reduced per output site."""
+    nd = 3
+    ks = _tup(kernel_size, nd)
+    stride = _tup(stride if stride is not None else kernel_size, nd)
+    padding = _tup(padding, nd)
+    idx = np.asarray(x.indices().numpy())
+    spatial = tuple(x.shape[1:1 + nd])
+    out_idx, out_spatial, taps, src, dst = _conv_structure(
+        idx, spatial, ks, stride, padding)
+    out_nnz = out_idx.shape[1]
+
+    def impl(values):
+        gathered = jnp.take(values, src, axis=0)
+        neg = jnp.asarray(-jnp.inf, dtype=values.dtype)
+        out = jnp.full((out_nnz, values.shape[-1]), neg, values.dtype)
+        return out.at[dst].max(gathered)
+
+    vals = apply_op("sparse_max_pool3d", impl, (x.values(),), {})
+    out_shape = [x.shape[0], *out_spatial, x.shape[-1]]
+    return SparseCooTensor(out_idx, vals, out_shape, coalesced=True)
