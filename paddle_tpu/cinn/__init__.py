@@ -1,0 +1,15 @@
+"""paddle.cinn parity namespace.
+
+Reference: python/paddle/cinn/ — the python frontend of the CINN JIT
+compiler (SURVEY.md §2.6). On TPU, XLA fills CINN's entire role
+(fusion + codegen below the graph level); this namespace keeps the
+reference's compile-entry shape and serves it with the XLA pipeline:
+`cinn.compiler.compile` traces to StableHLO and AOT-compiles,
+`cinn.runtime.Module` wraps the compiled executable, and the
+auto_schedule cost model is the measured-samples regressor the
+auto-tuner uses."""
+from . import compiler, runtime, auto_schedule  # noqa: F401
+
+__all__ = ["compiler", "runtime", "auto_schedule"]
+
+is_compiled_with_cinn = lambda: False  # noqa: E731  (paddle flag shape)

@@ -1,0 +1,5 @@
+"""paddle.cinn.auto_schedule parity — cost-model tier (the schedule search
+itself is XLA's autotuning on TPU)."""
+from . import cost_model  # noqa: F401
+
+__all__ = ["cost_model"]

@@ -1,0 +1,111 @@
+"""paddle.cinn.auto_schedule.cost_model parity (reference
+python/paddle/cinn/auto_schedule/cost_model/ — CostModel over xgboost,
+used by schedule search to rank candidates from measured samples).
+
+TPU stand-in: schedule search belongs to XLA's own autotuner; what remains
+useful is the measured-samples regressor the distributed auto-tuner
+(distributed/auto_tuner) feeds — served here with a least-squares
+polynomial model, with XgbCostModel delegating to xgboost when that
+package exists (it is not baked into this image)."""
+import enum
+import pickle
+
+import numpy as np
+
+__all__ = ["CostModel", "CostModelType", "XgbCostModel"]
+
+
+class CostModelType(enum.Enum):
+    XGB = 1
+    LSQ = 2
+
+
+class _LsqModel:
+    """Ridge-regularized least squares on [x, x^2, 1] features — monotone
+    cost curves (time vs tile/size knobs) fit well enough to rank."""
+
+    def __init__(self):
+        self._w = None
+
+    @staticmethod
+    def _feats(xs):
+        x = np.asarray(xs, dtype=np.float64)
+        if x.ndim == 1:
+            x = x[:, None]
+        return np.concatenate([x, x * x, np.ones((x.shape[0], 1))], axis=1)
+
+    def train(self, samples, labels):
+        A = self._feats(samples)
+        y = np.asarray(labels, dtype=np.float64)
+        lam = 1e-6 * np.eye(A.shape[1])
+        self._w = np.linalg.solve(A.T @ A + lam, A.T @ y)
+
+    def predict(self, samples):
+        if self._w is None:
+            raise RuntimeError("cost model is not trained")
+        return (self._feats(samples) @ self._w).tolist()
+
+    def save(self, path):
+        with open(path, "wb") as f:
+            pickle.dump(self._w, f)
+
+    def load(self, path):
+        with open(path, "rb") as f:
+            self._w = pickle.load(f)
+
+
+class XgbCostModel:
+    """xgboost-backed regressor (reference xgb_cost_model.py:19). xgboost
+    is not baked into this image; constructing this class without it
+    raises with the least-squares alternative named."""
+
+    def __init__(self):
+        try:
+            import xgboost  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "xgboost is unavailable in this environment; use "
+                "CostModel(CostModelType.LSQ)") from e
+        import xgboost as xgb
+        self._xgb = xgb
+        self._booster = None
+
+    def train(self, samples, labels):
+        d = self._xgb.DMatrix(np.asarray(samples), np.asarray(labels))
+        self._booster = self._xgb.train({"max_depth": 6}, d, 100)
+
+    def predict(self, samples):
+        d = self._xgb.DMatrix(np.asarray(samples))
+        return self._booster.predict(d).tolist()
+
+    def save(self, path):
+        self._booster.save_model(path)
+
+    def load(self, path):
+        self._booster = self._xgb.Booster()
+        self._booster.load_model(path)
+
+
+class CostModel:
+    """Reference cost_model.py:24 facade: train/predict/save/load over the
+    selected backend."""
+
+    def __init__(self, model_type=CostModelType.LSQ):
+        if model_type == CostModelType.XGB:
+            self.model = XgbCostModel()
+        elif model_type == CostModelType.LSQ:
+            self.model = _LsqModel()
+        else:
+            raise ValueError("Illegal CostModelType")
+
+    def train(self, samples, labels):
+        return self.model.train(samples, labels)
+
+    def predict(self, samples):
+        return self.model.predict(samples)
+
+    def save(self, path):
+        return self.model.save(path)
+
+    def load(self, path):
+        return self.model.load(path)

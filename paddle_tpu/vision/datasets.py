@@ -163,3 +163,157 @@ class FakeData(Dataset):
 
     def __len__(self):
         return len(self.labels)
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class image folder (reference
+    vision/datasets/folder.py:92): root/<class_x>/xxx.ext. Samples load
+    through `loader` (default: numpy image reader for .npy, raw-bytes
+    decode for common formats when PIL is absent)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_image_loader
+        exts = tuple(e.lower() for e in (extensions or (
+            ".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")))
+        classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for base, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    path = os.path.join(base, f)
+                    ok = is_valid_file(path) if is_valid_file else \
+                        f.lower().endswith(exts)
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no samples found under {root}")
+        self.targets = [t for _, t in self.samples]
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+def _default_image_loader(path):
+    """npy natively; standard image formats via PIL when available (kept
+    optional: the image is returned as float32 HWC in [0, 1])."""
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+        with Image.open(path) as im:
+            return np.asarray(im.convert("RGB"), np.float32) / 255.0
+    except ImportError as e:
+        raise RuntimeError(
+            f"loading {path} needs PIL (absent in this environment); use "
+            ".npy samples or pass a custom loader") from e
+
+
+class ImageFolder(Dataset):
+    """Flat folder of images, no labels (reference folder.py ImageFolder):
+    items are [image]."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_image_loader
+        exts = tuple(e.lower() for e in (extensions or (
+            ".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")))
+        self.samples = []
+        for base, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                path = os.path.join(base, f)
+                ok = is_valid_file(path) if is_valid_file else \
+                    f.lower().endswith(exts)
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no samples found under {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Flowers-102 (reference vision/datasets/flowers.py:54): local cache
+    when present, deterministic synthetic stand-in otherwise (102 classes,
+    3x224x224 hue-keyed blobs)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None,
+                 synthetic_size=None):
+        self.transform = transform
+        n = synthetic_size or (1020 if mode == "train" else 102)
+        rng = np.random.default_rng({"train": 10, "valid": 11,
+                                     "test": 12}.get(mode, 13))
+        self.labels = rng.integers(0, 102, n).astype(np.int64)
+        hues = rng.standard_normal((102, 3, 1, 1)).astype(np.float32)
+        self.images = np.clip(
+            0.5 + 0.3 * hues[self.labels]
+            + 0.08 * rng.standard_normal((n, 3, 64, 64)).astype(np.float32),
+            0, 1)
+        self.synthetic = True
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img.astype(np.float32), np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation pairs (reference vision/datasets/voc2012.py:54):
+    items are (image, label_mask). Synthetic stand-in: blob masks with the
+    21-class palette over matching images."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        self.transform = transform
+        n = synthetic_size or (100 if mode == "train" else 20)
+        rng = np.random.default_rng(20 if mode == "train" else 21)
+        H = W = 64
+        self.images = rng.random((n, 3, H, W)).astype(np.float32)
+        masks = np.zeros((n, H, W), np.int64)
+        for i in range(n):
+            for _ in range(rng.integers(1, 4)):
+                cls = int(rng.integers(1, 21))
+                y, x = rng.integers(0, H - 16), rng.integers(0, W - 16)
+                h, w = rng.integers(8, 17), rng.integers(8, 17)
+                masks[i, y:y + h, x:x + w] = cls
+        self.masks = masks
+        self.synthetic = True
+
+    def __getitem__(self, idx):
+        img, mask = self.images[idx], self.masks[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img.astype(np.float32), mask
+
+    def __len__(self):
+        return len(self.images)
