@@ -28,6 +28,17 @@ from .context_parallel import (ring_attention, ulysses_attention,
 from .log_util import (logger, get_logger, set_log_level,
                        get_log_level_code, get_log_level_name,
                        get_sync_logger, layer_to_str)
+from .base import (Role, UserDefinedRoleMaker, PaddleCloudRoleMaker,
+                   UtilBase, DataGenerator, MultiSlotDataGenerator,
+                   MultiSlotStringDataGenerator, Fleet)
+from . import utils
+from . import metrics
+from . import base as data_generator  # reference fleet.data_generator home
+
+__all__ = ["CommunicateTopology", "UtilBase", "HybridCommunicateGroup",
+           "MultiSlotStringDataGenerator", "UserDefinedRoleMaker",
+           "DistributedStrategy", "Role", "MultiSlotDataGenerator",
+           "PaddleCloudRoleMaker", "Fleet"]
 
 
 class DistributedStrategy:

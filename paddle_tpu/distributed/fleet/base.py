@@ -1,0 +1,344 @@
+"""Fleet base tier: Role / RoleMakers / UtilBase / DataGenerators / the
+Fleet facade class.
+
+Reference: python/paddle/distributed/fleet/base/role_maker.py (env-driven
+cluster roles), base/util_factory.py (UtilBase), data_generator/
+data_generator.py (the MultiSlot text protocol feeding the PS datafeed),
+fleet.py:218 (Fleet singleton whose methods the module functions proxy).
+
+On TPU the collective path has one role (worker); the PS role split stays
+meaningful for the parameter-server tier (distributed/ps)."""
+import os
+import sys
+
+import numpy as np
+
+__all__ = ["Role", "UserDefinedRoleMaker", "PaddleCloudRoleMaker",
+           "UtilBase", "DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator", "Fleet"]
+
+
+class Role:
+    """Reference role_maker.Role: process roles in a fleet job."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class _RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_num = 1
+        self._server_endpoints = []
+        self._worker_endpoints = []
+
+    # -- the surface fleet.init consumes --------------------------------
+    def worker_index(self):
+        return self._current_id if self._role == Role.WORKER else -1
+
+    def server_index(self):
+        return self._current_id if self._role == Role.SERVER else -1
+
+    def worker_num(self):
+        return self._worker_num
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+    def role_id(self):
+        return self._current_id
+
+
+class UserDefinedRoleMaker(_RoleMakerBase):
+    """Explicitly configured role (reference role_maker.py
+    UserDefinedRoleMaker): no env reading; the caller states id/role/size."""
+
+    def __init__(self, is_collective=False, init_gloo=False, current_id=0,
+                 role=Role.WORKER, worker_num=1, server_endpoints=None,
+                 worker_endpoints=None, **kwargs):
+        super().__init__()
+        self._current_id = int(current_id)
+        self._role = role
+        self._worker_num = int(worker_num)
+        self._server_endpoints = list(server_endpoints or [])
+        self._worker_endpoints = list(worker_endpoints or [])
+        self._is_collective = is_collective
+
+
+class PaddleCloudRoleMaker(_RoleMakerBase):
+    """Env-contract role maker (reference role_maker.py
+    PaddleCloudRoleMaker): PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+    TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST /
+    PADDLE_TRAINER_ENDPOINTS — the same env the launcher sets."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        env = os.environ
+        self._current_id = int(env.get("PADDLE_TRAINER_ID", 0))
+        self._worker_num = int(env.get("PADDLE_TRAINERS_NUM", 1))
+        role = env.get("TRAINING_ROLE", "TRAINER").upper()
+        self._role = Role.SERVER if role == "PSERVER" else Role.WORKER
+        self._server_endpoints = [
+            e for e in env.get("PADDLE_PSERVERS_IP_PORT_LIST", "").split(",")
+            if e]
+        self._worker_endpoints = [
+            e for e in env.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+            if e]
+        if self._role == Role.SERVER:
+            port = env.get("PADDLE_PORT", "")
+            ip = env.get("POD_IP", "")
+            me = f"{ip}:{port}"
+            if me in self._server_endpoints:
+                self._current_id = self._server_endpoints.index(me)
+
+
+class UtilBase:
+    """Cross-worker utilities (reference base/util_factory.py UtilBase):
+    small-object collectives + file sharding + rank-gated printing."""
+
+    def __init__(self, role_maker=None):
+        self.role_maker = role_maker
+
+    def _nranks(self):
+        from . import worker_num
+        return worker_num()
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        from .. import collective as c
+        from ...core.tensor import to_tensor
+        arr = np.asarray(input)
+        t = to_tensor(arr)
+        op = {"sum": c.ReduceOp.SUM, "max": c.ReduceOp.MAX,
+              "min": c.ReduceOp.MIN}[mode]
+        c.all_reduce(t, op=op)
+        out = np.asarray(t.numpy())
+        return out if arr.ndim else out.reshape(())
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective as c
+        c.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from .. import collective as c
+        objs = [None]
+        c.all_gather_object(objs, input)
+        return objs
+
+    def get_file_shard(self, files):
+        """Contiguous shard of `files` for this worker (reference
+        get_file_shard: remainder spread over the first ranks)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file need to be read.")
+        rm = self.role_maker
+        trainer_id = rm.worker_index() if rm else 0
+        trainers = rm.worker_num() if rm else 1
+        base = len(files) // trainers
+        rem = len(files) % trainers
+        blocks = [base + (1 if i < rem else 0) for i in range(trainers)]
+        start = sum(blocks[:trainer_id])
+        return files[start:start + blocks[trainer_id]]
+
+    def print_on_rank(self, message, rank_id):
+        rm = self.role_maker
+        me = rm.worker_index() if rm else 0
+        if me == rank_id:
+            print(message)
+
+
+class DataGenerator:
+    """Text-protocol sample generator (reference data_generator.py): user
+    overrides generate_sample(line); run_from_stdin streams
+    stdin -> parsed samples -> slot-protocol lines on stdout, the format
+    the PS datafeed (distributed/ps_compat) consumes."""
+
+    def __init__(self):
+        self.batch_size_ = 32
+        self._proto_info = None
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "generate_sample() must be overridden: return a zero-arg "
+            "iterator over [(slot_name, [feasign, ...]), ...]")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for sample in samples:
+                yield sample
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+    def run_from_stdin(self):
+        for out_line in self._process_lines(sys.stdin):
+            sys.stdout.write(out_line)
+
+    def run_from_memory(self, lines):
+        """Non-POSIX-pipe variant used by tests: returns the emitted
+        protocol lines for an iterable of input lines."""
+        return list(self._process_lines(lines))
+
+    def _process_lines(self, lines):
+        batch = []
+        for line in lines:
+            it = self.generate_sample(line)
+            for parsed in it():
+                if parsed is None:
+                    continue
+                batch.append(parsed)
+                if len(batch) == self.batch_size_:
+                    for sample in self.generate_batch(batch)():
+                        yield self._gen_str(sample)
+                    batch = []
+        if batch:
+            for sample in self.generate_batch(batch)():
+                yield self._gen_str(sample)
+
+
+def _check_slots(line):
+    if isinstance(line, zip):
+        line = list(line)
+    if not isinstance(line, (list, tuple)):
+        raise ValueError(
+            "the output of generate_sample() must be list or tuple of "
+            "(name, [feasign, ...]) pairs")
+    return line
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """`<num> <id>...` per slot, numeric feasigns; tracks per-slot dtype
+    (float promotes the slot) like the reference proto_info."""
+
+    def _gen_str(self, line):
+        line = _check_slots(line)
+        if self._proto_info is None:
+            self._proto_info = []
+            for name, elements in line:
+                if not isinstance(name, str):
+                    raise ValueError(f"name {name!r} must be str")
+                if not isinstance(elements, list) or not elements:
+                    raise ValueError(
+                        f"slot {name}: elements must be a non-empty list")
+                dtype = "float" if any(
+                    isinstance(e, float) for e in elements) else "uint64"
+                self._proto_info.append((name, dtype))
+        else:
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    f"the complete field set changed: {len(line)} slots vs "
+                    f"{len(self._proto_info)} at first sample")
+            for i, (name, elements) in enumerate(line):
+                if any(isinstance(e, float) for e in elements) and \
+                        self._proto_info[i][1] != "float":
+                    self._proto_info[i] = (self._proto_info[i][0], "float")
+        parts = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String feasigns, no dtype tracking (reference
+    MultiSlotStringDataGenerator: fastest path, caller guarantees
+    formatting)."""
+
+    def _gen_str(self, line):
+        line = _check_slots(line)
+        parts = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+
+class Fleet:
+    """The Fleet facade (reference fleet.py:218): module-level fleet
+    functions are this object's methods; `fleet` in paddle.distributed is
+    one shared instance. Construct another to scope a different role
+    maker/strategy."""
+
+    def __init__(self):
+        self._role_maker = None
+        self._util = UtilBase()
+
+    # init + info proxy onto the module functions (shared topology state)
+    def init(self, role_maker=None, is_collective=False, strategy=None,
+             log_level=None):
+        from . import init as _init
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        self._util.role_maker = self._role_maker
+        return _init(role_maker=role_maker, is_collective=is_collective,
+                     strategy=strategy, log_level=log_level)
+
+    @property
+    def util(self):
+        return self._util
+
+    def distributed_model(self, model):
+        from . import distributed_model as f
+        return f(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from . import distributed_optimizer as f
+        return f(optimizer, strategy)
+
+    def worker_num(self):
+        from . import worker_num as f
+        return f()
+
+    def worker_index(self):
+        from . import worker_index as f
+        return f()
+
+    def is_first_worker(self):
+        from . import is_first_worker as f
+        return f()
+
+    def barrier_worker(self):
+        from . import barrier_worker as f
+        return f()
+
+    def is_worker(self):
+        return self._role_maker.is_worker() if self._role_maker else True
+
+    def is_server(self):
+        return self._role_maker.is_server() if self._role_maker else False
+
+    def get_hybrid_communicate_group(self):
+        from . import get_hybrid_communicate_group as f
+        return f()
+
+    def stop_worker(self):
+        pass
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from ...static import save_inference_model
+        return save_inference_model(dirname, feeded_var_names, target_vars,
+                                    executor, program=main_program)

@@ -1,0 +1,84 @@
+"""Distributed training metrics (reference
+python/paddle/distributed/fleet/metrics/metric.py — cross-trainer
+sum/max/min/auc/mae/rmse/acc: each reduces local numpy stats over the
+worker communicator).
+
+Here reduction rides the collective layer (XLA collectives in SPMD,
+identity in single-process); inputs may be numpy arrays, python scalars,
+or Tensors."""
+import numpy as np
+
+from .. import collective as _c
+from ...core.tensor import Tensor, to_tensor
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]
+
+
+def _reduce(arr, op):
+    t = to_tensor(np.asarray(arr, dtype=np.float64).copy())
+    _c.all_reduce(t, op=op)
+    return np.asarray(t.numpy())
+
+
+def sum(input, scope=None, util=None):
+    """Global elementwise sum of a stat array (reference metric.sum)."""
+    a = np.asarray(input.numpy() if isinstance(input, Tensor) else input)
+    out = _reduce(a, _c.ReduceOp.SUM)
+    return float(out) if out.ndim == 0 else out
+
+
+def max(input, scope=None, util=None):
+    a = np.asarray(input.numpy() if isinstance(input, Tensor) else input)
+    out = _reduce(a, _c.ReduceOp.MAX)
+    return float(out) if out.ndim == 0 else out
+
+
+def min(input, scope=None, util=None):
+    a = np.asarray(input.numpy() if isinstance(input, Tensor) else input)
+    out = _reduce(a, _c.ReduceOp.MIN)
+    return float(out) if out.ndim == 0 else out
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Distributed AUC from per-rank positive/negative score histograms
+    (reference metric.auc: reduce histograms, then trapezoid)."""
+    pos = _reduce(np.asarray(
+        stat_pos.numpy() if isinstance(stat_pos, Tensor) else stat_pos,
+        dtype=np.float64), _c.ReduceOp.SUM)
+    neg = _reduce(np.asarray(
+        stat_neg.numpy() if isinstance(stat_neg, Tensor) else stat_neg,
+        dtype=np.float64), _c.ReduceOp.SUM)
+    # walk buckets high->low accumulating TP/FP (trapezoidal area)
+    area = 0.0
+    tp = fp = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_tp = tp + pos[i]
+        new_fp = fp + neg[i]
+        area += (new_fp - fp) * (tp + new_tp) / 2.0
+        tp, fp = new_tp, new_fp
+    if tp == 0 or fp == 0:
+        return 0.5
+    return float(area / (tp * fp))
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    """Global mean absolute error from (sum |err|, instance count)."""
+    e = sum(abserr)
+    n = sum(total_ins_num)
+    return float(e) / np.maximum(float(n), 1.0)
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    e = sum(sqrerr)
+    n = sum(total_ins_num)
+    return float(e) / np.maximum(float(n), 1.0)
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(np.sqrt(mse(sqrerr, total_ins_num)))
+
+
+def acc(correct, total, scope=None, util=None):
+    c = sum(correct)
+    t = sum(total)
+    return float(c) / np.maximum(float(t), 1.0)

@@ -1,0 +1,42 @@
+"""paddle.distributed.fleet.utils parity (reference
+python/paddle/distributed/fleet/utils/__init__.py — LocalFS, HDFSClient,
+recompute, DistributedInfer; helpers: timer_helper,
+sequence_parallel_utils (served by fleet/sp_layers.py), log_util
+(fleet/log_util.py), pp ckpt adaptor (distributed/checkpoint))."""
+from .fs import LocalFS, HDFSClient  # noqa: F401
+from ..recompute import recompute  # noqa: F401
+from . import timer_helper  # noqa: F401
+from .timer_helper import get_timers, set_timers  # noqa: F401
+
+__all__ = ["LocalFS", "recompute", "DistributedInfer", "HDFSClient"]
+
+
+class DistributedInfer:
+    """PS-era distributed inference helper (reference
+    utils/ps_util.py DistributedInfer): swaps sparse-table lookups for
+    local embedding queries at inference. With the TPU PS tier, tables
+    pull through distributed/ps worker clients; for the common (pure
+    collective) case the main program runs unchanged."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+        self._inited = False
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        if self._inited:
+            return
+        if self._startup is not None:
+            exe.run(self._startup)
+        if dirname:
+            # load persistables saved by the trainer
+            from ....framework import load as _load
+            import os
+            path = os.path.join(dirname, "model.pdparams")
+            if os.path.exists(path):
+                self._params = _load(path)
+        self._inited = True
+
+    def get_dist_infer_program(self):
+        return self._main

@@ -1,0 +1,204 @@
+"""Filesystem abstraction (reference
+python/paddle/distributed/fleet/utils/fs.py — FS base, LocalFS,
+HDFSClient over `hadoop fs` shell-outs). Checkpoint/IO code takes an FS
+object so local disk and HDFS interchange."""
+import os
+import shutil
+import subprocess
+
+__all__ = ["LocalFS", "HDFSClient", "FSFileExistsError",
+           "FSFileNotExistsError"]
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class _FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+
+class LocalFS(_FS):
+    """Local-disk FS (reference fs.py LocalFS)."""
+
+    def ls_dir(self, fs_path):
+        """(dirs, files) directly under fs_path."""
+        if not self.is_exist(fs_path):
+            return ([], [])
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return (dirs, files)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if self.is_file(fs_path):
+            os.remove(fs_path)
+        elif self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if self.is_exist(dst_path):
+            if not overwrite:
+                raise FSFileExistsError(dst_path)
+            self.delete(dst_path)
+        os.rename(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    # upload/download are identity on a shared local disk
+    def upload(self, local_path, fs_path, multi_processes=1, overwrite=False):
+        if os.path.abspath(local_path) != os.path.abspath(fs_path):
+            shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path, multi_processes=1,
+                 overwrite=False):
+        if os.path.abspath(local_path) != os.path.abspath(fs_path):
+            shutil.copy(fs_path, local_path)
+
+
+class HDFSClient(_FS):
+    """HDFS via `hadoop fs` shell-outs (reference fs.py HDFSClient). The
+    hadoop binary is not in this image; construction succeeds (so configs
+    parse) and the first command raises with a clear message if the
+    binary is absent."""
+
+    def __init__(self, hadoop_home, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._hadoop_home = hadoop_home
+        self._configs = configs or {}
+        pre = [os.path.join(hadoop_home, "bin", "hadoop"), "fs"]
+        for k, v in self._configs.items():
+            pre += ["-D", f"{k}={v}"]
+        self._cmd_prefix = pre
+
+    def _run(self, *args):
+        cmd = self._cmd_prefix + list(args)
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=300)
+        except FileNotFoundError as e:
+            raise RuntimeError(
+                f"hadoop binary not found under {self._hadoop_home} "
+                "(HDFS is unavailable in this environment)") from e
+        return out.returncode, out.stdout
+
+    def ls_dir(self, fs_path):
+        code, out = self._run("-ls", fs_path)
+        if code != 0:
+            return ([], [])
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = parts[-1]
+            (dirs if parts[0].startswith("d") else files).append(
+                os.path.basename(name))
+        return (dirs, files)
+
+    def is_exist(self, fs_path):
+        code, _ = self._run("-test", "-e", fs_path)
+        return code == 0
+
+    def is_file(self, fs_path):
+        code, _ = self._run("-test", "-f", fs_path)
+        return code == 0
+
+    def is_dir(self, fs_path):
+        code, _ = self._run("-test", "-d", fs_path)
+        return code == 0
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", fs_path)
+
+    def need_upload_download(self):
+        return True
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        self._run("-touchz", fs_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=True):
+        if test_exists and not self.is_exist(fs_src_path):
+            raise FSFileNotExistsError(fs_src_path)
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def upload(self, local_path, fs_path, multi_processes=1,
+               overwrite=False):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path, multi_processes=1,
+                 overwrite=False):
+        self._run("-get", fs_path, local_path)

@@ -1,0 +1,73 @@
+"""Per-stage training timers (reference
+python/paddle/distributed/fleet/utils/timer_helper.py — named start/stop
+timers with rank-aware logging, used by the pipeline schedules)."""
+import time
+
+__all__ = ["get_timers", "set_timers"]
+
+_GLOBAL_TIMERS = None
+
+
+class _Timer:
+    def __init__(self, name):
+        self.name = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = 0.0
+
+    def start(self):
+        assert not self.started_, f"timer {self.name} already started"
+        self.start_time = time.perf_counter()
+        self.started_ = True
+
+    def stop(self):
+        assert self.started_, f"timer {self.name} is not started"
+        self.elapsed_ += time.perf_counter() - self.start_time
+        self.started_ = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset=True):
+        started = self.started_
+        if started:
+            self.stop()
+        e = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return e
+
+
+class _Timers:
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names=None, normalizer=1.0, reset=True):
+        assert normalizer > 0.0
+        names = names if names is not None else list(self.timers)
+        fields = []
+        for name in names:
+            if name in self.timers:
+                e = self.timers[name].elapsed(reset=reset) * 1000.0
+                fields.append(f"{name}: {e / normalizer:.2f}")
+        from ..log_util import logger
+        logger.info("time (ms) | " + " | ".join(fields))
+
+
+def get_timers():
+    return _GLOBAL_TIMERS
+
+
+def set_timers():
+    global _GLOBAL_TIMERS
+    if _GLOBAL_TIMERS is None:
+        _GLOBAL_TIMERS = _Timers()
+    return _GLOBAL_TIMERS
