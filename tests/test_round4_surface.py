@@ -1,0 +1,551 @@
+"""Functional tests for the round-4 sub-surface completion batch:
+quantized linear tier (nn.quant), fused functional additions, BFGS/L-BFGS
+minimizers, nn.utils reparametrizations, sparse conv/pool, fleet base
+tier (role makers / data generators / fs / metrics), tensorrt converter,
+cinn + cost_model shims, incubate.autograd views. Reference anchors cited
+per test."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _rel_err(a, b):
+    a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+    return np.abs(a - b).max() / max(1.0, np.abs(b).max())
+
+
+class TestQuantizedLinear:
+    """nn/quant.py vs reference quantized_linear.py:64,191,285."""
+
+    def test_int8_round_trip_and_linear(self, rng):
+        w = rng.normal(size=(32, 16)).astype(np.float32)
+        x = rng.normal(size=(4, 32)).astype(np.float32)
+        q, s = paddle.nn.quant.weight_quantize(paddle.to_tensor(w))
+        assert list(q.shape) == [16, 32] and list(s.shape) == [16]
+        y = paddle.nn.quant.weight_only_linear(
+            paddle.to_tensor(x), q, weight_scale=s)
+        assert _rel_err(y.numpy(), x @ w) < 2e-2
+        wd = paddle.nn.quant.weight_dequantize(q, s, out_dtype="float32")
+        assert _rel_err(wd.numpy(), w) < 2e-2
+
+    def test_int4_packs_half(self, rng):
+        w = rng.normal(size=(32, 16)).astype(np.float32)
+        q, s = paddle.nn.quant.weight_quantize(
+            paddle.to_tensor(w), algo="weight_only_int4")
+        assert list(q.shape) == [16, 16]  # nibbles packed along in-features
+        y = paddle.nn.quant.weight_only_linear(
+            paddle.to_tensor(rng.normal(size=(4, 32)).astype(np.float32)),
+            q, weight_scale=s, weight_dtype="int4")
+        assert list(y.shape) == [4, 16]
+
+    def test_grouped_scales(self, rng):
+        w = rng.normal(size=(128, 8)).astype(np.float32)
+        x = rng.normal(size=(2, 128)).astype(np.float32)
+        q, s = paddle.nn.quant.weight_quantize(
+            paddle.to_tensor(w), group_size=64)
+        assert list(s.shape) == [2, 8]
+        y = paddle.nn.quant.weight_only_linear(
+            paddle.to_tensor(x), q, weight_scale=s, group_size=64)
+        assert _rel_err(y.numpy(), x @ w) < 2e-2
+
+    def test_llm_int8_outlier_decomposition(self, rng):
+        w = rng.normal(size=(64, 8)).astype(np.float32)
+        x = rng.normal(size=(4, 64)).astype(np.float32)
+        x[:, 5] = 30.0  # outlier feature must run in fp
+        q, s = paddle.nn.quant.weight_quantize(
+            paddle.to_tensor(w), algo="llm.int8")
+        y = paddle.nn.quant.llm_int8_linear(
+            paddle.to_tensor(x), q, weight_scale=s, threshold=6.0)
+        assert _rel_err(y.numpy(), x @ w) < 2e-2
+
+
+class TestFusedFunctionalAdditions:
+    """incubate/nn/functional vs reference fused_matmul_bias.py:31,
+    fused_rms_norm.py:59, fused_layer_norm.py:61, swiglu.py:26,
+    fused_moe.py:20."""
+
+    def test_fused_matmul_bias_grad(self, rng):
+        import paddle_tpu.incubate.nn.functional as F
+        x = paddle.to_tensor(rng.normal(size=(3, 8)).astype(np.float32))
+        w = paddle.to_tensor(rng.normal(size=(8, 4)).astype(np.float32))
+        b = paddle.to_tensor(np.zeros(4, np.float32))
+        x.stop_gradient = False
+        w.stop_gradient = False
+        y = F.fused_matmul_bias(x, w, b)
+        assert _rel_err(y.numpy(),
+                        np.asarray(x.numpy()) @ np.asarray(w.numpy())) < 2e-2
+        y.sum().backward()
+        assert x.grad is not None and w.grad is not None
+
+    def test_fused_linear_activation(self, rng):
+        import paddle_tpu.incubate.nn.functional as F
+        x = rng.normal(size=(3, 8)).astype(np.float32)
+        w = rng.normal(size=(8, 4)).astype(np.float32)
+        b = rng.normal(size=(4,)).astype(np.float32)
+        y = F.fused_linear_activation(
+            paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b),
+            activation="relu")
+        assert _rel_err(y.numpy(), np.maximum(x @ w + b, 0)) < 2e-2
+
+    def test_swiglu_matches_silu_product(self, rng):
+        import paddle_tpu.incubate.nn.functional as F
+        x = rng.normal(size=(5, 8)).astype(np.float32)
+        out = F.swiglu(paddle.to_tensor(x))
+        a, b = x[:, :4], x[:, 4:]
+        ref = a / (1 + np.exp(-a)) * b
+        assert _rel_err(out.numpy(), ref) < 1e-3
+        out2 = F.swiglu(paddle.to_tensor(a), paddle.to_tensor(b))
+        assert _rel_err(out2.numpy(), ref) < 1e-3
+
+    def test_fused_rms_norm_with_residual(self, rng):
+        import paddle_tpu.incubate.nn.functional as F
+        x = rng.normal(size=(2, 6, 8)).astype(np.float32)
+        res = rng.normal(size=(2, 6, 8)).astype(np.float32)
+        g = rng.normal(size=(8,)).astype(np.float32)
+        out, res_out = F.fused_rms_norm(
+            paddle.to_tensor(x), paddle.to_tensor(g), None, 1e-6, 2,
+            residual=paddle.to_tensor(res))
+        h = x + res
+        ref = h / np.sqrt((h * h).mean(-1, keepdims=True) + 1e-6) * g
+        assert _rel_err(out.numpy(), ref) < 1e-3
+        assert _rel_err(res_out.numpy(), h) < 1e-5
+
+    def test_fused_layer_norm_sum_only(self, rng):
+        import paddle_tpu.incubate.nn.functional as F
+        x = rng.normal(size=(2, 8)).astype(np.float32)
+        res = rng.normal(size=(2, 8)).astype(np.float32)
+        out, res_out = F.fused_layer_norm(
+            paddle.to_tensor(x), None, None, 1e-5, residual_alpha=2.0,
+            residual=paddle.to_tensor(res))
+        assert _rel_err(out.numpy(), x + 2.0 * res) < 1e-5
+
+    def test_fused_moe_matches_loop(self, rng):
+        import paddle_tpu.incubate.nn.functional as F
+        B, S, D, E, Ff, K = 2, 4, 8, 4, 6, 2
+        x = rng.normal(size=(B, S, D)).astype(np.float32)
+        gw = rng.normal(size=(D, E)).astype(np.float32)
+        w1 = (rng.normal(size=(E, D, Ff)) * 0.3).astype(np.float32)
+        w2 = (rng.normal(size=(E, Ff, D)) * 0.3).astype(np.float32)
+        out = F.fused_moe(paddle.to_tensor(x), paddle.to_tensor(gw),
+                          paddle.to_tensor(w1), paddle.to_tensor(w2),
+                          moe_topk=K)
+        # per-token loop reference (gelu FFN: w2's input dim == Ff, so the
+        # functional takes the non-GLU branch; tanh-approx gelu below)
+        toks = x.reshape(-1, D)
+        logits = toks @ gw
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = np.zeros_like(toks)
+        for t in range(toks.shape[0]):
+            top = np.argsort(-p[t])[:K]
+            wsum = p[t][top].sum()
+            for e in top:
+                h = toks[t] @ w1[e]
+                h = 0.5 * h * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                           * (h + 0.044715 * h ** 3)))
+                ref[t] += (p[t][e] / wsum) * (h @ w2[e])
+        assert _rel_err(out.numpy().reshape(-1, D), ref) < 5e-2
+
+    def test_varlen_attention_masks_padding(self, rng):
+        import paddle_tpu.incubate.nn.functional as F
+        q = rng.normal(size=(2, 2, 6, 4)).astype(np.float32)
+        sl = np.array([[3], [6]], np.int32)
+        out = F.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(sl), paddle.to_tensor(sl), causal=True)
+        o = np.asarray(out.numpy())
+        assert np.abs(o[0, :, 3:]).max() == 0.0  # padded queries zeroed
+        assert np.abs(o[1]).max() > 0.0
+
+    def test_blha_get_max_len(self):
+        import paddle_tpu.incubate.nn.functional as F
+        me, md = F.blha_get_max_len(
+            paddle.to_tensor(np.array([3, 9, 1], np.int32)),
+            paddle.to_tensor(np.array([4, 0, 7], np.int32)),
+            paddle.ones([3]))
+        assert int(me.numpy()[0]) == 9 and int(md.numpy()[0]) == 7
+
+    def test_fused_multi_transformer_layer(self, rng):
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        m = FusedMultiTransformer(32, 4, 64, num_layers=2)
+        h = paddle.to_tensor(rng.normal(size=(2, 5, 32)).astype(np.float32))
+        out = m(h)
+        assert list(out.shape) == [2, 5, 32]
+        assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+class TestMinimizers:
+    """incubate/optimizer/functional vs reference bfgs.py/lbfgs.py."""
+
+    @staticmethod
+    def _rosen(x):
+        a = x[1:] - x[:-1] * x[:-1]
+        b = 1.0 - x[:-1]
+        return 100.0 * (a * a).sum() + (b * b).sum()
+
+    def test_lbfgs_converges(self):
+        from paddle_tpu.incubate.optimizer.functional import minimize_lbfgs
+        conv, calls, x, fv, g = minimize_lbfgs(
+            self._rosen, paddle.to_tensor(np.zeros(4, np.float32)),
+            max_iters=200)
+        assert float(fv.numpy()) < 1e-4
+        np.testing.assert_allclose(np.asarray(x.numpy()), 1.0, atol=1e-2)
+
+    def test_bfgs_finds_minimum(self):
+        from paddle_tpu.incubate.optimizer.functional import minimize_bfgs
+        conv, calls, x, fv, g = minimize_bfgs(
+            self._rosen, paddle.to_tensor(np.zeros(4, np.float32)),
+            max_iters=200)
+        assert float(fv.numpy()) < 1e-3
+        assert int(calls.numpy()) > 0
+
+    def test_lbfgs_quadratic_exact(self):
+        from paddle_tpu.incubate.optimizer.functional import minimize_lbfgs
+        target = np.array([1.0, -2.0, 3.0], np.float32)
+
+        def f(x):
+            d = x - paddle.to_tensor(target)
+            return (d * d).sum()
+
+        _, _, x, fv, _ = minimize_lbfgs(
+            f, paddle.to_tensor(np.zeros(3, np.float32)), max_iters=50)
+        np.testing.assert_allclose(np.asarray(x.numpy()), target, atol=1e-4)
+
+
+class TestNNUtils:
+    """nn/utils vs reference weight_norm_hook.py/spectral_norm_hook.py."""
+
+    def test_weight_norm_preserves_forward_and_grads(self, rng):
+        from paddle_tpu.nn.utils import weight_norm, remove_weight_norm
+        lin = nn.Linear(6, 4)
+        w0 = np.asarray(lin.weight.numpy()).copy()
+        weight_norm(lin, "weight", dim=1)
+        names = [n for n, _ in lin.named_parameters()]
+        assert "weight_g" in names and "weight_v" in names
+        x = paddle.to_tensor(rng.normal(size=(3, 6)).astype(np.float32))
+        y = lin(x)
+        ref = np.asarray(x.numpy()) @ w0 + np.asarray(lin.bias.numpy())
+        assert _rel_err(y.numpy(), ref) < 2e-2
+        y.sum().backward()
+        assert lin.weight_g.grad is not None
+        remove_weight_norm(lin, "weight")
+        assert _rel_err(lin(x).numpy(), ref) < 2e-2
+        assert "weight" in [n for n, _ in lin.named_parameters()]
+
+    def test_spectral_norm_caps_singular_value(self, rng):
+        from paddle_tpu.nn.utils import spectral_norm
+        lin = nn.Linear(8, 8)
+        lin.weight.set_value(
+            (rng.normal(size=(8, 8)) * 3).astype(np.float32))
+        spectral_norm(lin, "weight", n_power_iterations=20)
+        lin.train()
+        lin(paddle.to_tensor(np.zeros((1, 8), np.float32)))
+        sv = np.linalg.svd(np.asarray(lin.weight.numpy()),
+                           compute_uv=False)[0]
+        assert sv == pytest.approx(1.0, rel=0.2)
+
+    def test_vector_round_trip(self, rng):
+        from paddle_tpu.nn.utils import (parameters_to_vector,
+                                         vector_to_parameters)
+        lin = nn.Linear(5, 3)
+        v = parameters_to_vector(lin.parameters())
+        assert int(v.shape[0]) == 5 * 3 + 3
+        vector_to_parameters(v * 0 + 2.0, lin.parameters())
+        assert np.allclose(np.asarray(lin.weight.numpy()), 2.0)
+
+    def test_clip_grad_value(self):
+        from paddle_tpu.nn.utils import clip_grad_value_
+        t = paddle.to_tensor(np.full(3, 4.0, np.float32))
+        t.stop_gradient = False
+        (t * t).sum().backward()
+        clip_grad_value_(t, 1.5)
+        assert np.allclose(np.asarray(t.grad.numpy()), 1.5)
+
+
+class TestSparseNN:
+    """sparse/nn package vs reference sparse/nn/ conv+pool."""
+
+    def test_conv2d_matches_dense(self, rng):
+        from paddle_tpu import sparse
+        H = W = 5
+        k, Cin, Cout = 3, 2, 3
+        dense = np.zeros((1, H, W, Cin), np.float32)
+        pts = [(1, 1), (2, 3), (4, 0)]
+        for y, x in pts:
+            dense[0, y, x] = rng.normal(size=Cin)
+        idx = np.array([[0, y, x] for (y, x) in pts], np.int32).T
+        vals = np.stack([dense[0, y, x] for (y, x) in pts])
+        sp = sparse.sparse_coo_tensor(idx, vals, (1, H, W, Cin))
+        w = rng.normal(size=(k, k, Cin, Cout)).astype(np.float32)
+        out = sparse.nn.functional.conv2d(
+            sp, paddle.to_tensor(w), stride=1, padding=1)
+        ref = np.zeros((1, H, W, Cout), np.float32)
+        for oy in range(H):
+            for ox in range(W):
+                for ty in range(k):
+                    for tx in range(k):
+                        iy, ix = oy - 1 + ty, ox - 1 + tx
+                        if 0 <= iy < H and 0 <= ix < W:
+                            ref[0, oy, ox] += dense[0, iy, ix] @ w[ty, tx]
+        oidx = np.asarray(out.indices().numpy())
+        ovals = np.asarray(out.values().numpy())
+        for i in range(oidx.shape[1]):
+            b, y, x = oidx[:, i]
+            np.testing.assert_allclose(ovals[i], ref[b, y, x], atol=1e-4)
+
+    def test_subm_conv2d_keeps_structure(self, rng):
+        from paddle_tpu import sparse
+        idx = np.stack([np.zeros(3, np.int32),
+                        np.array([0, 1, 2], np.int32),
+                        np.array([0, 1, 0], np.int32)])
+        vals = rng.normal(size=(3, 2)).astype(np.float32)
+        sp = sparse.sparse_coo_tensor(idx, vals, (1, 4, 4, 2))
+        conv = sparse.nn.SubmConv2D(2, 2, kernel_size=1, bias_attr=False)
+        with paddle.no_grad():
+            conv.weight.set_value(np.eye(2, dtype=np.float32)[None, None])
+        out = conv(sp)
+        np.testing.assert_allclose(out.values().numpy(), vals, atol=1e-5)
+        assert out.shape == sp.shape
+
+    def test_max_pool3d(self):
+        from paddle_tpu import sparse
+        idx = np.stack([np.zeros(3, np.int32),
+                        np.array([0, 1, 3], np.int32),
+                        np.array([0, 0, 2], np.int32),
+                        np.array([0, 1, 3], np.int32)])
+        vals = np.array([[1.0], [5.0], [2.0]], np.float32)
+        sp = sparse.sparse_coo_tensor(idx, vals, (1, 4, 4, 4, 1))
+        out = sparse.nn.MaxPool3D(kernel_size=2, stride=2)(sp)
+        assert out.shape == [1, 2, 2, 2, 1]
+        np.testing.assert_allclose(
+            sorted(np.asarray(out.values().numpy()).ravel()), [2.0, 5.0])
+
+    def test_conv3d_layer_runs(self, rng):
+        from paddle_tpu import sparse
+        idx = np.stack([np.zeros(4, np.int32), *(
+            rng.integers(0, 4, (3, 4)).astype(np.int32))])
+        vals = rng.normal(size=(4, 2)).astype(np.float32)
+        sp = sparse.sparse_coo_tensor(idx, vals, (1, 4, 4, 4, 2))
+        conv = sparse.nn.Conv3D(2, 5, kernel_size=3, padding=1)
+        out = conv(sp)
+        assert out.shape[-1] == 5
+
+
+class TestFleetBase:
+    """fleet base tier vs reference role_maker.py / util_factory.py /
+    data_generator.py / metrics/metric.py / utils/fs.py."""
+
+    def test_role_makers(self, monkeypatch):
+        from paddle_tpu.distributed import fleet
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+        rm = fleet.PaddleCloudRoleMaker(is_collective=True)
+        assert rm.worker_index() == 2 and rm.worker_num() == 4
+        assert rm.is_worker() and not rm.is_first_worker()
+        urm = fleet.UserDefinedRoleMaker(
+            current_id=0, role=fleet.Role.SERVER, worker_num=2,
+            server_endpoints=["127.0.0.1:1"])
+        assert urm.is_server() and urm.server_num() == 1
+
+    def test_util_file_shard(self):
+        from paddle_tpu.distributed import fleet
+        urm = fleet.UserDefinedRoleMaker(current_id=1, worker_num=3)
+        util = fleet.UtilBase(urm)
+        files = [f"f{i}" for i in range(8)]  # 3,3,2 split
+        assert util.get_file_shard(files) == ["f3", "f4", "f5"]
+        with pytest.raises(TypeError):
+            util.get_file_shard("not-a-list")
+
+    def test_multi_slot_generators(self):
+        from paddle_tpu.distributed import fleet
+
+        class G(fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    ws = [int(v) for v in line.split()]
+                    yield ("words", ws), ("label", [1])
+                return it
+
+        out = G().run_from_memory(["1 2 3", "7 8"])
+        assert out == ["3 1 2 3 1 1\n", "2 7 8 1 1\n"]
+
+        class S(fleet.MultiSlotStringDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    yield ("q", line.split()),
+                return it
+
+        assert S().run_from_memory(["a b"]) == ["2 a b\n"]
+
+    def test_fleet_facade(self):
+        from paddle_tpu.distributed import fleet
+        fl = fleet.Fleet()
+        fl.init(is_collective=True)
+        assert fl.worker_num() >= 1 and fl.is_first_worker() in (True, False)
+        assert fl.util.get_file_shard(["a"]) in (["a"], [])
+
+    def test_local_fs(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+        fs = LocalFS()
+        d = str(tmp_path)
+        fs.mkdirs(os.path.join(d, "sub"))
+        fs.touch(os.path.join(d, "a.txt"))
+        dirs, files = fs.ls_dir(d)
+        assert dirs == ["sub"] and files == ["a.txt"]
+        fs.mv(os.path.join(d, "a.txt"), os.path.join(d, "b.txt"))
+        assert fs.is_file(os.path.join(d, "b.txt"))
+        assert fs.list_dirs(d) == ["sub"]
+        assert not fs.need_upload_download()
+        fs.delete(os.path.join(d, "sub"))
+        assert not fs.is_exist(os.path.join(d, "sub"))
+
+    def test_metrics(self):
+        from paddle_tpu.distributed.fleet import metrics as M
+        assert M.sum(np.array(3.0)) == 3.0
+        assert M.acc(np.array(8.0), np.array(10.0)) == pytest.approx(0.8)
+        pos = np.zeros(10); neg = np.zeros(10)
+        pos[7] = 50; neg[2] = 50
+        assert M.auc(pos, neg) == pytest.approx(1.0)
+        assert M.auc(np.ones(10), np.ones(10)) == pytest.approx(0.5)
+        assert M.rmse(np.array(40.0), np.array(10.0)) == pytest.approx(2.0)
+
+    def test_timer_helper(self):
+        from paddle_tpu.distributed.fleet.utils import set_timers
+        t = set_timers()
+        t("step").start(); t("step").stop()
+        assert t("step").elapsed(reset=True) >= 0.0
+
+
+class TestConverters:
+    """tensorrt / cinn / cost_model shims."""
+
+    def test_tensorrt_convert(self, tmp_path):
+        import paddle_tpu.tensorrt as trt
+        model = nn.Sequential(nn.Linear(8, 4), nn.ReLU())
+        model.eval()
+        prefix = os.path.join(str(tmp_path), "m")
+        paddle.jit.save(model, prefix,
+                        input_spec=[paddle.zeros([4, 8])])
+        cfg = trt.TensorRTConfig(
+            inputs=[trt.Input((1, 8), (4, 8))],
+            precision_mode=trt.PrecisionMode.FP32)
+        prog = trt.convert(prefix, cfg)
+        out = prog([np.ones((4, 8), np.float32)])
+        assert out[0].shape == (4, 4)
+
+    def test_cinn_compile(self):
+        import paddle_tpu.cinn as cinn
+        m = cinn.compiler.compile(lambda x: (x * x).sum(),
+                                  np.ones((4,), np.float32))
+        assert float(m(np.ones(4, np.float32))) == pytest.approx(4.0)
+        assert "module" in m.ir()
+
+    def test_cost_models(self):
+        from paddle_tpu.cinn.auto_schedule.cost_model import (
+            CostModel, CostModelType)
+        m = CostModel(CostModelType.LSQ)
+        xs = np.arange(10, dtype=np.float64)
+        m.train(xs, 2 * xs + 3)
+        assert m.predict([4.0])[0] == pytest.approx(11.0, abs=1e-3)
+
+    def test_profile_measure(self):
+        import paddle_tpu.cost_model as cm
+        c = cm.CostModel()
+        sp, mp = c.build_program()
+        out = c.profile_measure(sp, mp)
+        assert out["time"] > 0
+
+
+class TestIncubateAutograd:
+    def test_jacobian_hessian_views(self):
+        import paddle_tpu.incubate.autograd as ia
+        f = lambda x: (x * x).sum()  # noqa: E731
+        x = paddle.to_tensor(np.arange(3, dtype=np.float32))
+        J = ia.Jacobian(f, x)
+        np.testing.assert_allclose(np.asarray(J[:]), [0, 2, 4], atol=1e-5)
+        H = ia.Hessian(f, x)
+        np.testing.assert_allclose(np.diag(np.asarray(H[:])), 2.0,
+                                   atol=1e-5)
+
+    def test_forward_grad_matches_jvp(self):
+        import paddle_tpu.incubate.autograd as ia
+        f = lambda x: (x * x * x).sum()  # noqa: E731
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out = ia.forward_grad(f, x)
+        # d/de sum((x+e)³) at e=0 with tangent ones = 3x²·1 summed
+        assert float(out.numpy()) == pytest.approx(15.0, rel=1e-4)
+
+    def test_grad_composes(self):
+        import paddle_tpu.incubate.autograd as ia
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        y = (x * x * x).sum()
+        (g,) = ia.grad(y, [x])
+        (g2,) = ia.grad(g.sum(), [x])
+        assert float(np.asarray(g.numpy()).ravel()[0]) == \
+            pytest.approx(12.0, rel=1e-4)
+        assert float(np.asarray(g2.numpy()).ravel()[0]) == \
+            pytest.approx(12.0, rel=1e-4)
+
+    def test_prim_flags(self):
+        import paddle_tpu.incubate.autograd as ia
+        ia.enable_prim()
+        assert ia.prim_enabled()
+        ia.disable_prim()
+        assert not ia.prim_enabled()
+
+
+class TestDeviceAndStream:
+    def test_device_cuda_namespace(self):
+        from paddle_tpu.device import cuda
+        assert cuda.get_device_name()
+        assert cuda.get_device_capability() == (0, 0)
+        assert cuda.max_memory_reserved() >= 0
+        cuda.empty_cache()
+        cuda.synchronize()
+
+    def test_stream_collectives_return_task(self):
+        from paddle_tpu.distributed.communication import stream
+        t = paddle.to_tensor(np.ones(4, np.float32))
+        task = stream.all_reduce(t)
+        assert task.wait() and task.is_completed()
+        assert stream.all_reduce(t, use_calc_stream=True) is None
+
+    def test_recompute_hybrid(self):
+        from paddle_tpu.incubate.distributed.fleet import recompute_hybrid
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        x.stop_gradient = False
+        y = recompute_hybrid({"offload": False}, lambda a: a * a, x)
+        y.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), 2.0)
+
+
+class TestDatasets:
+    def test_dataset_folder(self, tmp_path):
+        from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+        for c in ("cat", "dog"):
+            os.makedirs(os.path.join(str(tmp_path), c))
+            for i in range(2):
+                np.save(os.path.join(str(tmp_path), c, f"{i}.npy"),
+                        np.zeros((4, 4, 3), np.float32))
+        df = DatasetFolder(str(tmp_path))
+        assert len(df) == 4 and df.classes == ["cat", "dog"]
+        img, label = df[3]
+        assert img.shape == (4, 4, 3) and label == 1
+        imf = ImageFolder(str(tmp_path))
+        assert len(imf) == 4 and imf[0][0].shape == (4, 4, 3)
+
+    def test_flowers_voc(self):
+        from paddle_tpu.vision.datasets import Flowers, VOC2012
+        f = Flowers(mode="test")
+        img, label = f[0]
+        assert img.shape == (3, 64, 64) and 0 <= label < 102
+        v = VOC2012(mode="test")
+        img, mask = v[0]
+        assert img.shape == (3, 64, 64) and mask.shape == (64, 64)
+        assert mask.max() <= 20

@@ -31,6 +31,37 @@ MODS = {
     "onnx": "paddle_tpu.onnx", "inference": "paddle_tpu.inference",
     "quantization": "paddle_tpu.quantization",
     "profiler": "paddle_tpu.profiler", "incubate": "paddle_tpu.incubate",
+    # round-4 sub-surface completion batch
+    "device/cuda": "paddle_tpu.device.cuda",
+    "device/xpu": "paddle_tpu.device.xpu",
+    "distributed/communication/stream":
+        "paddle_tpu.distributed.communication.stream",
+    "distributed/fleet": "paddle_tpu.distributed.fleet",
+    "distributed/fleet/utils": "paddle_tpu.distributed.fleet.utils",
+    "distributed/sharding": "paddle_tpu.distributed.sharding",
+    "incubate/asp": "paddle_tpu.incubate.asp",
+    "incubate/autograd": "paddle_tpu.incubate.autograd",
+    "incubate/distributed/fleet": "paddle_tpu.incubate.distributed.fleet",
+    "incubate/nn": "paddle_tpu.incubate.nn",
+    "incubate/nn/functional": "paddle_tpu.incubate.nn.functional",
+    "incubate/optimizer": "paddle_tpu.incubate.optimizer",
+    "incubate/optimizer/functional":
+        "paddle_tpu.incubate.optimizer.functional",
+    "nn/quant": "paddle_tpu.nn.quant",
+    "nn/utils": "paddle_tpu.nn.utils",
+    "quantization/observers": "paddle_tpu.quantization.observers",
+    "quantization/quanters": "paddle_tpu.quantization.quanters",
+    "sparse/nn": "paddle_tpu.sparse.nn",
+    "sparse/nn/functional": "paddle_tpu.sparse.nn.functional",
+    "tensorrt": "paddle_tpu.tensorrt",
+    "vision/datasets": "paddle_tpu.vision.datasets",
+    "audio/features": "paddle_tpu.audio.features",
+    "audio/datasets": "paddle_tpu.audio.datasets",
+    "cinn/compiler": "paddle_tpu.cinn.compiler",
+    "cinn/runtime": "paddle_tpu.cinn.runtime",
+    "cinn/auto_schedule/cost_model":
+        "paddle_tpu.cinn.auto_schedule.cost_model",
+    "cost_model": "paddle_tpu.cost_model",
 }
 
 
