@@ -1,0 +1,87 @@
+"""Main-grad mixed precision (reference
+python/paddle/distributed/fleet/utils/mix_precision_utils.py —
+MixPrecisionLayer :36 hooks every parameter so gradients accumulate into
+an fp32 `main_grad` instead of the low-precision `.grad`;
+MixPrecisionOptimizer :97 steps from main_grad).
+
+This is the hybrid-parallel O2 pattern: grads cross DP/sharding comms in
+bf16/fp16 but accumulate and apply in fp32."""
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....nn.layer import Layer
+
+__all__ = ["MixPrecisionLayer", "MixPrecisionOptimizer"]
+
+
+class MixPrecisionLayer(Layer):
+    def __init__(self, layers, dtype="float16"):
+        super().__init__()
+        self._layers = layers
+        self._dtype = dtype
+        for param in layers.parameters():
+            if getattr(param, "stop_gradient", False):
+                continue
+            param.main_grad = None
+            param.register_hook(self._main_grad_hook(param))
+
+    @staticmethod
+    def _main_grad_hook(param):
+        def hook(grad):
+            g32 = grad.data.astype(jnp.float32)
+            if param.main_grad is None:
+                param.main_grad = Tensor(g32)
+            else:
+                param.main_grad = Tensor(param.main_grad.data + g32)
+            return grad
+        return hook
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, *args, **kwargs):
+        return self._layers.parameters(*args, **kwargs)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)
+
+
+class MixPrecisionOptimizer:
+    """Steps the inner optimizer from each param's fp32 main_grad
+    (reference MixPrecisionOptimizer: swaps .grad for main_grad around
+    step, then clears main_grad)."""
+
+    def __init__(self, optimizer):
+        self._inner_opt = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def _params(self):
+        return [p for p in getattr(self._inner_opt, "_parameter_list", [])
+                or []]
+
+    def step(self):
+        stash = []
+        for p in self._params():
+            mg = getattr(p, "main_grad", None)
+            if mg is not None:
+                stash.append((p, p.grad))
+                p.grad = Tensor(mg.data.astype(p.data.dtype))
+        try:
+            self._inner_opt.step()
+        finally:
+            for p, old in stash:
+                p.grad = old
+                p.main_grad = None
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._params():
+            p.main_grad = None
+        self._inner_opt.clear_grad()

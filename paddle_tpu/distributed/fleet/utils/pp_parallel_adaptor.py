@@ -1,0 +1,194 @@
+"""Pipeline-parallel checkpoint conversion (reference
+python/paddle/distributed/fleet/utils/pp_parallel_adaptor.py —
+PipeLineModelAdaptor :82 rewrites a checkpoint saved under one
+(pp, vpp) configuration into another, re-segmenting transformer layers
+and renaming their local indices; SURVEY.md §5.4 names this the
+hybrid-parallel ckpt conversion tool).
+
+Checkpoint layout: `{root}/mp_{i:02d}_sharding_{j:02d}_pp_{k:02d}/
+model.pdparams`, parameters named `layers.<local_idx>.<suffix>` within
+each pp rank (the PipelineLayer state-dict contract). Conversion maps
+local→global layer indices in the source segmentation (vpp
+round-robin-aware), then re-segments globally for the destination."""
+import os
+
+import numpy as np
+
+from ....framework import save as _save, load as _load
+
+__all__ = ["ParallelConfig", "PipeLineModelAdaptor", "adaptor_arguments",
+           "parse_args"]
+
+
+class ParallelConfig:
+    def __init__(self, mp, pp, vpp=1, sharding=1):
+        self.mp = int(mp)
+        self.pp = int(pp)
+        self.vpp = int(vpp)
+        self.sharding = int(sharding)
+
+    def rank_dir(self, mp_rank, sharding_rank, pp_rank):
+        return (f"mp_{mp_rank:02d}_sharding_{sharding_rank:02d}"
+                f"_pp_{pp_rank:02d}")
+
+    def __repr__(self):
+        return (f"ParallelConfig(mp={self.mp}, pp={self.pp}, "
+                f"vpp={self.vpp}, sharding={self.sharding})")
+
+
+def _chunks(n_layers, pp, vpp):
+    """Global layer index of each (pp_rank, chunk, slot): the vpp
+    round-robin layout — pp rank r owns chunks [r, r+pp, r+2*pp, ...],
+    each of size n_layers // (pp*vpp)."""
+    per = n_layers // (pp * vpp)
+    assert per * pp * vpp == n_layers, \
+        f"{n_layers} layers do not split into pp={pp} x vpp={vpp}"
+    owner = {}
+    for r in range(pp):
+        local = 0
+        for c in range(vpp):
+            chunk_id = c * pp + r
+            for s in range(per):
+                owner[(r, local)] = chunk_id * per + s
+                local += 1
+    return owner
+
+
+class PipeLineModelAdaptor:
+    def __init__(self, src_parallel_config, dst_parallel_config,
+                 transformer_layer_num=None, segment_method="layer"):
+        self._src = src_parallel_config
+        self._dst = dst_parallel_config
+        if self._src.mp != self._dst.mp or \
+                self._src.sharding != self._dst.sharding:
+            raise ValueError(
+                "pp adaptor converts the pp/vpp axes; mp and sharding "
+                f"degrees must match ({self._src} vs {self._dst})")
+        self._layer_num = transformer_layer_num
+        self._segment_method = segment_method
+
+    # -- introspection (reference peek_model) ----------------------------
+    def peek_model(self, model_dir):
+        """List (rank_dir, sorted param names) per sub checkpoint."""
+        out = []
+        for d in sorted(os.listdir(model_dir)):
+            path = os.path.join(model_dir, d, "model.pdparams")
+            if os.path.exists(path):
+                out.append((d, sorted(_load(path).keys())))
+        return out
+
+    # -- conversion ------------------------------------------------------
+    def extract_layers(self, state_dicts):
+        """Per-pp-rank state dicts -> {global_layer_idx: {suffix: array}}
+        + passthrough params (embeddings/head, kept on their rank's
+        position: rank 0 prefix, last rank suffix)."""
+        src_owner = None
+        layers = {}
+        extras_first, extras_last = {}, {}
+        n_ranks = len(state_dicts)
+        # count layers to build the ownership map
+        per_rank_counts = []
+        for sd in state_dicts:
+            idxs = {self._local_idx(k) for k in sd if
+                    self._local_idx(k) is not None}
+            per_rank_counts.append(len(idxs))
+        n_layers = sum(per_rank_counts)
+        src_owner = _chunks(n_layers, self._src.pp, self._src.vpp)
+        for r, sd in enumerate(state_dicts):
+            for k, v in sd.items():
+                li = self._local_idx(k)
+                if li is None:
+                    (extras_first if r == 0 else extras_last)[k] = v
+                    continue
+                g = src_owner[(r, li)]
+                suffix = k.split(".", 2)[2]
+                layers.setdefault(g, {})[suffix] = v
+        return n_layers, layers, extras_first, extras_last
+
+    @staticmethod
+    def _local_idx(key):
+        parts = key.split(".")
+        if len(parts) >= 3 and parts[0] == "layers" and parts[1].isdigit():
+            return int(parts[1])
+        return None
+
+    def segment_layers(self, n_layers, layers, extras_first, extras_last):
+        """Re-segment globals for the destination config; returns one state
+        dict per dst pp rank with renamed local indices (the reference
+        LayerReNamingManager role)."""
+        dst_owner = _chunks(n_layers, self._dst.pp, self._dst.vpp)
+        by_rank = [dict() for _ in range(self._dst.pp)]
+        inverse = {}  # (rank) -> ordered globals
+        for (r, local), g in sorted(dst_owner.items()):
+            inverse.setdefault(r, []).append((local, g))
+        for r, pairs in inverse.items():
+            for local, g in pairs:
+                for suffix, v in layers[g].items():
+                    by_rank[r][f"layers.{local}.{suffix}"] = v
+        by_rank[0].update(extras_first)
+        by_rank[-1].update(extras_last)
+        return by_rank
+
+    def apply(self, src_model_path, dst_model_path):
+        """Convert every (mp, sharding) slice (reference apply :95)."""
+        for i in range(self._src.mp):
+            for j in range(self._src.sharding):
+                dicts = []
+                for k in range(self._src.pp):
+                    path = os.path.join(
+                        src_model_path, self._src.rank_dir(i, j, k),
+                        "model.pdparams")
+                    dicts.append(_load(path))
+                n_layers, layers, ef, el = self.extract_layers(dicts)
+                if self._layer_num is not None and \
+                        n_layers != self._layer_num:
+                    raise ValueError(
+                        f"checkpoint holds {n_layers} transformer layers, "
+                        f"expected {self._layer_num}")
+                out = self.segment_layers(n_layers, layers, ef, el)
+                for k, sd in enumerate(out):
+                    d = os.path.join(dst_model_path,
+                                     self._dst.rank_dir(i, j, k))
+                    os.makedirs(d, exist_ok=True)
+                    _save(sd, os.path.join(d, "model.pdparams"))
+
+    def sort_layers(self, names):
+        """Stable sort of layer param names by global index (reference
+        sort_layers)."""
+        def prio(name):
+            li = self._local_idx(name)
+            return (0, li, name) if li is not None else (1, -1, name)
+        return sorted(names, key=prio)
+
+
+def adaptor_arguments(parser):
+    """Register the CLI flags (reference main block)."""
+    parser.add_argument("--src_path", required=True)
+    parser.add_argument("--dst_path", required=True)
+    parser.add_argument("--src_mp", type=int, default=1)
+    parser.add_argument("--src_pp", type=int, required=True)
+    parser.add_argument("--src_vp", type=int, default=1)
+    parser.add_argument("--dst_mp", type=int, default=1)
+    parser.add_argument("--dst_pp", type=int, required=True)
+    parser.add_argument("--dst_vp", type=int, default=1)
+    parser.add_argument("--sharding", type=int, default=1)
+    parser.add_argument("--layer_num", type=int, default=None)
+    return parser
+
+
+def parse_args(argv=None):
+    import argparse
+    return adaptor_arguments(argparse.ArgumentParser()).parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    adaptor = PipeLineModelAdaptor(
+        ParallelConfig(args.src_mp, args.src_pp, args.src_vp, args.sharding),
+        ParallelConfig(args.dst_mp, args.dst_pp, args.dst_vp, args.sharding),
+        transformer_layer_num=args.layer_num)
+    adaptor.apply(args.src_path, args.dst_path)
+
+
+if __name__ == "__main__":
+    main()

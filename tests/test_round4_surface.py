@@ -549,3 +549,80 @@ class TestDatasets:
         img, mask = v[0]
         assert img.shape == (3, 64, 64) and mask.shape == (64, 64)
         assert mask.max() <= 20
+
+
+class TestFleetUtilsHelpers:
+    """pp_parallel_adaptor (SURVEY §5.4 ckpt conversion tool) +
+    mix_precision_utils (main_grad O2 pattern)."""
+
+    def test_pp_adaptor_resegment(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils.pp_parallel_adaptor import (
+            ParallelConfig, PipeLineModelAdaptor)
+        from paddle_tpu.framework import save, load
+        src = os.path.join(str(tmp_path), "src")
+        dst = os.path.join(str(tmp_path), "dst")
+        c_src = ParallelConfig(mp=1, pp=2, vpp=1)
+        c_dst = ParallelConfig(mp=1, pp=4, vpp=1)
+        for r in range(2):
+            sd = {}
+            if r == 0:
+                sd["embed.weight"] = np.zeros((2, 2), np.float32)
+            if r == 1:
+                sd["head.weight"] = np.zeros((2, 2), np.float32)
+            for local in range(4):
+                sd[f"layers.{local}.w"] = np.full((2,), float(r * 4 + local))
+            os.makedirs(os.path.join(src, c_src.rank_dir(0, 0, r)))
+            save(sd, os.path.join(src, c_src.rank_dir(0, 0, r),
+                                  "model.pdparams"))
+        PipeLineModelAdaptor(c_src, c_dst, transformer_layer_num=8).apply(
+            src, dst)
+        for r in range(4):
+            sd = load(os.path.join(dst, c_dst.rank_dir(0, 0, r),
+                                   "model.pdparams"))
+            vals = sorted(float(np.asarray(v)[0]) for k, v in sd.items()
+                          if k.startswith("layers"))
+            assert vals == [2.0 * r, 2.0 * r + 1]
+        first = load(os.path.join(dst, c_dst.rank_dir(0, 0, 0),
+                                  "model.pdparams"))
+        last = load(os.path.join(dst, c_dst.rank_dir(0, 0, 3),
+                                 "model.pdparams"))
+        assert "embed.weight" in first and "head.weight" in last
+
+    def test_pp_adaptor_vpp_unroll(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils.pp_parallel_adaptor import (
+            ParallelConfig, PipeLineModelAdaptor, _chunks)
+        from paddle_tpu.framework import save, load
+        src = os.path.join(str(tmp_path), "s")
+        dst = os.path.join(str(tmp_path), "d")
+        c1, c2 = ParallelConfig(1, 2, vpp=2), ParallelConfig(1, 4, vpp=1)
+        own = _chunks(8, 2, 2)
+        for r in range(2):
+            sd = {f"layers.{local}.w":
+                  np.full((2,), float(own[(r, local)]))
+                  for local in range(4)}
+            os.makedirs(os.path.join(src, c1.rank_dir(0, 0, r)))
+            save(sd, os.path.join(src, c1.rank_dir(0, 0, r),
+                                  "model.pdparams"))
+        PipeLineModelAdaptor(c1, c2).apply(src, dst)
+        for r in range(4):
+            sd = load(os.path.join(dst, c2.rank_dir(0, 0, r),
+                                   "model.pdparams"))
+            assert sorted(float(np.asarray(v)[0]) for v in sd.values()) == \
+                [2.0 * r, 2.0 * r + 1]
+
+    def test_mix_precision_main_grad(self):
+        from paddle_tpu.distributed.fleet.utils.mix_precision_utils import (
+            MixPrecisionLayer, MixPrecisionOptimizer)
+        lin = nn.Linear(4, 2)
+        wrapped = MixPrecisionLayer(lin, dtype="float32")
+        opt = MixPrecisionOptimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin.parameters()))
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        (wrapped(x) ** 2).sum().backward()
+        assert lin.weight.main_grad is not None
+        assert str(lin.weight.main_grad.dtype).endswith("float32")
+        w0 = np.asarray(lin.weight.numpy()).copy()
+        opt.step()
+        assert not np.allclose(w0, np.asarray(lin.weight.numpy()))
+        assert lin.weight.main_grad is None
