@@ -8,6 +8,7 @@ from ..recompute import recompute  # noqa: F401
 from . import timer_helper  # noqa: F401
 from .timer_helper import get_timers, set_timers  # noqa: F401
 from . import mix_precision_utils  # noqa: F401
+from . import hybrid_parallel_util  # noqa: F401
 from . import pp_parallel_adaptor  # noqa: F401
 # reference module homes whose implementations live beside the layers
 from .. import sp_layers as sequence_parallel_utils  # noqa: F401

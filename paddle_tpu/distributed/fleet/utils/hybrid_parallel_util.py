@@ -1,0 +1,129 @@
+"""Hybrid-parallel sync helpers (reference
+python/paddle/distributed/fleet/utils/hybrid_parallel_util.py —
+broadcast_{mp,dp,sharding,sep}_parameters at wrapper construction
+(:226-317), fused_allreduce_gradients after backward (:262), and
+broadcast_input_data for mp-synchronized batches (:199)).
+
+TPU mapping: parameter broadcast = materializing the replicated placement
+over the axis's mesh group; gradient allreduce rides the collective layer
+(in-graph under SPMD, bucketed by the EagerReducer in eager DP)."""
+import numpy as np
+
+from ... import collective as _c
+from ....core.tensor import Tensor
+
+__all__ = ["obtain_optimizer_parameters_list", "broadcast_input_data",
+           "broadcast_mp_parameters", "broadcast_dp_parameters",
+           "broadcast_sharding_parameters", "broadcast_sep_parameters",
+           "fused_allreduce_gradients", "fused_allreduce_gradients_with_group",
+           "unwrap_optimizer"]
+
+
+def obtain_optimizer_parameters_list(optimizer):
+    """The optimizer's flat parameter list (reference :32; handles
+    param-group dicts)."""
+    inner = unwrap_optimizer(optimizer)
+    plist = getattr(inner, "_parameter_list", None) or []
+    if plist and isinstance(plist[0], dict):
+        out = []
+        for group in plist:
+            out.extend(group.get("params", []))
+        return out
+    return list(plist)
+
+
+def unwrap_optimizer(optimizer, optimizer_instances=()):
+    """Peel wrapper optimizers (reference :318)."""
+    opt = optimizer
+    seen = set()
+    while id(opt) not in seen:
+        seen.add(id(opt))
+        for attr in ("_inner_opt", "_optim", "inner_opt", "_optimizer"):
+            nxt = getattr(opt, attr, None)
+            if nxt is not None:
+                opt = nxt
+                break
+        else:
+            break
+    return opt
+
+
+def _group_for(hcg, kind):
+    if hcg is None:
+        return None
+    getter = {
+        "mp": "get_model_parallel_group",
+        "dp": "get_data_parallel_group",
+        "sharding": "get_sharding_parallel_group",
+        "sep": "get_sep_parallel_group",
+        "pp": "get_pipe_parallel_group",
+    }[kind]
+    fn = getattr(hcg, getter, None)
+    return fn() if fn else None
+
+
+def _broadcast_parameters(model, group):
+    """Align parameters across the group from its rank-0 member
+    (reference _broadcast for each axis). Single-host eager state is
+    already identical per process; the broadcast still materializes the
+    replicated value through the collective so divergent state (e.g.
+    after a failure) re-syncs."""
+    for p in model.parameters():
+        _c.broadcast(p, src=0, group=group)
+
+
+def broadcast_mp_parameters(model, hcg, fuse_params=True):
+    _broadcast_parameters(model, _group_for(hcg, "mp"))
+
+
+def broadcast_dp_parameters(model, hcg, fuse_params=True):
+    _broadcast_parameters(model, _group_for(hcg, "dp"))
+
+
+def broadcast_sharding_parameters(model, hcg, fuse_params=True):
+    _broadcast_parameters(model, _group_for(hcg, "sharding"))
+
+
+def broadcast_sep_parameters(model, hcg, fuse_params=True):
+    """SEP treats sequence as a data-like axis: params replicate across
+    sep (reference :304; SURVEY.md §2.8 SEP row)."""
+    _broadcast_parameters(model, _group_for(hcg, "sep"))
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    """Broadcast the batch across the mp group so every tensor-parallel
+    rank consumes identical data (reference :199)."""
+    group = _group_for(hcg, "mp")
+    out = []
+    for t in inputs:
+        if isinstance(t, Tensor):
+            _c.broadcast(t, src=0, group=group)
+        out.append(t)
+    for k in list(kwargs):
+        if isinstance(kwargs[k], Tensor):
+            _c.broadcast(kwargs[k], src=0, group=group)
+    return out if not kwargs else (out, kwargs)
+
+
+def fused_allreduce_gradients_with_group(parameter_list, group, scale=None,
+                                         bucket_cap_mb=32):
+    """Sum gradients across `group` (reference :250: flat-buffer fused
+    allreduce). The EagerReducer owns true bucketing on the eager DP path;
+    here grads reduce per-tensor through the same collective, with the
+    optional 1/n scale folded in."""
+    for p in parameter_list:
+        g = getattr(p, "grad", None)
+        if g is None:
+            continue
+        _c.all_reduce(g, group=group)
+        if scale is not None:
+            p.grad = Tensor(g.data * (1.0 / scale)) \
+                if not isinstance(scale, Tensor) else Tensor(g.data * scale)
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """Grad sync over the dp(+sep fused) axis (reference :262)."""
+    group = _group_for(hcg, "dp")
+    n = getattr(group, "nranks", 1) if group else 1
+    fused_allreduce_gradients_with_group(parameter_list, group,
+                                         scale=float(n))
