@@ -241,3 +241,56 @@ class CustomOpModule:
 
 def get_build_directory():
     return os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions")
+
+
+class CppExtension:
+    """Extension descriptor (reference utils/cpp_extension/cpp_extension.py
+    CppExtension — a setuptools.Extension configured for paddle headers).
+    Holds sources + flags for `setup` to build with the same toolchain as
+    `load`."""
+
+    def __init__(self, sources, *args, name=None, extra_compile_args=None,
+                 include_dirs=None, **kwargs):
+        self.sources = list(sources)
+        self.name = name
+        extra = extra_compile_args or []
+        if isinstance(extra, dict):  # reference accepts {'cxx': [...]}
+            extra = extra.get("cxx", [])
+        self.extra_compile_args = list(extra)
+        self.include_dirs = list(include_dirs or [])
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    """Source-compat alias (reference CUDAExtension): there is no CUDA
+    toolchain on this backend — .cu sources are rejected, C++ sources
+    build exactly like CppExtension (the TPU compute path is XLA/Pallas;
+    custom native ops are host-side C++)."""
+    cu = [s for s in sources if s.endswith((".cu", ".cuh"))]
+    if cu:
+        raise RuntimeError(
+            f"CUDAExtension: CUDA sources {cu} cannot build on the TPU "
+            "backend; implement device code as Pallas kernels and keep "
+            "C++ for host-side ops (use CppExtension)")
+    return CppExtension(sources, *args, **kwargs)
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Offline build entry (reference cpp_extension.setup): builds each
+    extension now and registers an importable module under the build
+    directory (the reference delegates to setuptools' build_ext with its
+    paddle-specific compiler wrapper; here the `load` pipeline IS the
+    compiler wrapper, so setup = eager load + import registration)."""
+    import sys
+
+    exts = ext_modules or []
+    if isinstance(exts, CppExtension):
+        exts = [exts]
+    mods = []
+    for i, ext in enumerate(exts):
+        mod_name = ext.name or name or f"custom_ext_{i}"
+        module = load(mod_name, ext.sources,
+                      extra_cxx_cflags=ext.extra_compile_args +
+                      [f"-I{d}" for d in ext.include_dirs])
+        sys.modules[mod_name] = module
+        mods.append(module)
+    return mods if len(mods) != 1 else mods[0]
