@@ -102,17 +102,27 @@ def main():
     # serving leg: decode tokens/s on the flagship (GQA) config through
     # FusedMultiTransformerEngine (round-4 verdict #3) — reported in the
     # unit string so the driver still sees ONE JSON line
-    decode_tps = None
+    decode_tps = decode_tps_int8 = None
     try:
         decode_tps = _serving_decode_tps(on_tpu)
     except Exception as e:
         print(f"# serving bench skipped: {e!r}", file=sys.stderr)
+    if on_tpu:
+        # weight-only-int8 leg: decode is HBM-bound, so halving weight
+        # bytes should show up directly in tokens/s
+        try:
+            decode_tps_int8 = _serving_decode_tps(on_tpu,
+                                                  weight_quant="int8")
+        except Exception as e:
+            print(f"# int8 serving bench skipped: {e!r}", file=sys.stderr)
 
     unit = (f"tokens/s ({'tpu' if on_tpu else 'cpu-smoke'}, "
             f"{n_params/1e6:.0f}M params, bs{batch}x{seq}, "
             f"mfu={mfu:.3f}, loss={float(loss):.3f}"
             + (f", serve_decode={decode_tps:.0f}tok/s"
-               if decode_tps else "") + ")")
+               if decode_tps else "")
+            + (f", serve_decode_int8={decode_tps_int8:.0f}tok/s"
+               if decode_tps_int8 else "") + ")")
     print(json.dumps({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
@@ -136,9 +146,10 @@ def main():
     return 0
 
 
-def _serving_decode_tps(on_tpu):
+def _serving_decode_tps(on_tpu, weight_quant=None):
     """Greedy-decode throughput of the __graft_entry__ flagship shape class
-    (GQA: q heads > kv heads) via FusedMultiTransformerEngine."""
+    (GQA: q heads > kv heads) via FusedMultiTransformerEngine; with
+    weight_quant='int8'/'int4' the weight-only quantized serving tier."""
     import time
     import numpy as np
     from paddle_tpu.inference import FusedMultiTransformerEngine
@@ -166,7 +177,8 @@ def _serving_decode_tps(on_tpu):
         embedding=mk(V, E), lm_head=mk(E, V))
     eng = FusedMultiTransformerEngine(
         w, num_heads=H, head_dim=D, max_seq_len=SMAX, dtype=dtype,
-        norm_type="rmsnorm", activation="swiglu", gqa_group_size=G)
+        norm_type="rmsnorm", activation="swiglu", gqa_group_size=G,
+        weight_quant=weight_quant)
     ids = rng.integers(0, V, (B, 16)).astype(np.int32)
     # warm with the SAME n: the scanned decode specializes on step count
     eng.generate(ids, max_new_tokens=NEW)

@@ -310,15 +310,42 @@ class Profiler:
         self._recording = True
         self._step_begin = time.perf_counter()
         _dispatch.set_op_tracer(_op_tracer_ctx)
+        # device-activity leg (SURVEY §5.1: the reference consumes CUPTI
+        # activity records via cuda_tracer.cc; on TPU the XLA/PJRT
+        # profiler is that source). The captured xplane protos land in a
+        # TensorBoard-loadable plugin dir exposed as `device_trace_dir`.
+        if any(t is not ProfilerTarget.CPU for t in self.targets):
+            import tempfile
+            try:
+                import jax
+                self._jax_trace_dir = tempfile.mkdtemp(
+                    prefix="paddle_tpu_xprof_")
+                jax.profiler.start_trace(self._jax_trace_dir)
+            except Exception:
+                self._jax_trace_dir = None
 
     def _stop_recording(self, return_trace):
         self._recording = False
         _dispatch.set_op_tracer(None)
+        if self._jax_trace_dir is not None:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                self._jax_trace_dir = None
         self._events = list(_tracer.events)  # snapshot before clearing so
         self._summary = build_summary(self._events)  # export() after stop works
         _tracer.clear()
         if return_trace and self._on_trace_ready is not None:
             self._on_trace_ready(self)
+
+    @property
+    def device_trace_dir(self):
+        """Directory holding the XLA profiler capture of the last recorded
+        window (xplane protos; load in TensorBoard's profile plugin or
+        with xprof tooling) — None when only CPU was targeted or capture
+        failed."""
+        return self._jax_trace_dir
 
     # -- export ----------------------------------------------------------
     def _export_chrome(self, path):
