@@ -1,0 +1,111 @@
+"""On-hardware validation of the Pallas kernel tier (SURVEY §2.9).
+
+CI runs these kernels in interpret mode on the virtual CPU mesh
+(tests/test_pallas_fused.py); this script compiles them for the REAL
+attached TPU and checks numerics against dense references — the check the
+reference performs with its accuracy_check pass (SURVEY §5.2) when CINN
+kernels go live.
+
+Run: python tools/tpu_kernel_check.py   (exits non-zero on mismatch)
+"""
+import math
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    plat = jax.devices()[0].platform
+    print(f"# platform: {plat}")
+    if plat != "tpu":
+        print("# no TPU attached; kernels would run in interpret mode — "
+              "use pytest tests/test_pallas_fused.py for that path")
+        return 0
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_bhsd
+    from paddle_tpu.ops.pallas.flashmask import flashmask_attention_bshd
+    from paddle_tpu.ops.pallas.paged_attention import paged_attention
+
+    rng = np.random.default_rng(0)
+    failures = 0
+
+    def check(name, err, tol):
+        nonlocal failures
+        ok = err < tol
+        print(f"{name}: max_err={err:.5f} tol={tol} "
+              f"{'OK' if ok else 'FAIL'}")
+        failures += 0 if ok else 1
+
+    # -- flash attention fwd + grads (bf16) ------------------------------
+    B, H, S, D = 2, 4, 512, 64
+    q, k, v = [jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+               for _ in range(3)]
+
+    def ref(q, k, v):
+        s = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / math.sqrt(D)
+        m = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(m[None, None], s, -1e30)
+        return jnp.einsum("bhst,bhtd->bhsd", jax.nn.softmax(s, -1),
+                          v.astype(jnp.float32))
+
+    out = flash_attention_bhsd(q, k, v, causal=True)
+    r = ref(q, k, v)
+    check("flash_fwd", float(jnp.abs(out.astype(jnp.float32) - r).max()),
+          2e-2)
+
+    gf = jax.grad(lambda *a: (flash_attention_bhsd(
+        *a, causal=True).astype(jnp.float32) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: (ref(*a) ** 2).sum(), argnums=(0, 1, 2))(
+        q, k, v)
+    for nm, a, b in zip("qkv", gf, gr):
+        check(f"flash_d{nm}", float(jnp.abs(
+            a.astype(jnp.float32) - b.astype(jnp.float32)).max()), 0.25)
+
+    # -- flashmask degenerate-to-causal ----------------------------------
+    qs, ks, vs = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    se = jnp.full((B, 1, S, 1), S, jnp.int32)
+    om = jnp.swapaxes(flashmask_attention_bshd(
+        qs, ks, vs, startend_row_indices=se, causal=True), 1, 2)
+    check("flashmask", float(jnp.abs(om.astype(jnp.float32) - r).max()),
+          2e-2)
+
+    # -- paged decode attention ------------------------------------------
+    B, H, KVH, D = 4, 8, 4, 64
+    nblocks, bs = 16, 32
+    q1 = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((KVH, nblocks, bs, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((KVH, nblocks, bs, D)), jnp.float32)
+    tables = jnp.asarray(rng.permutation(nblocks)[:B * 4].reshape(B, 4),
+                         jnp.int32)
+    lens = jnp.asarray([100, 64, 33, 128], jnp.int32)
+    out = paged_attention(q1, kc, vc, tables, lens)
+    refp = np.zeros((B, H, D), np.float32)
+    qn, kn, vn = map(np.asarray, (q1, kc, vc))
+    tb, ln = np.asarray(tables), np.asarray(lens)
+    for b in range(B):
+        keys = np.concatenate([kn[:, tb[b, i]] for i in range(4)],
+                              axis=1)[:, :ln[b]]
+        vals = np.concatenate([vn[:, tb[b, i]] for i in range(4)],
+                              axis=1)[:, :ln[b]]
+        for h in range(H):
+            kv = h // (H // KVH)
+            s = (qn[b, h] @ keys[kv].T) / math.sqrt(D)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            refp[b, h] = p @ vals[kv]
+    check("paged_decode", float(np.abs(np.asarray(out) - refp).max()), 2e-2)
+
+    print(f"# {'ALL OK' if failures == 0 else f'{failures} FAILURES'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
