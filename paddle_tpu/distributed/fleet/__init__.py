@@ -33,7 +33,7 @@ from .base import (Role, UserDefinedRoleMaker, PaddleCloudRoleMaker,
                    MultiSlotStringDataGenerator, Fleet)
 from . import utils
 from . import metrics
-from . import base as data_generator  # reference fleet.data_generator home
+from . import data_generator
 
 __all__ = ["CommunicateTopology", "UtilBase", "HybridCommunicateGroup",
            "MultiSlotStringDataGenerator", "UserDefinedRoleMaker",

@@ -1,0 +1,9 @@
+"""paddle.distributed.fleet.data_generator import home (reference
+python/paddle/distributed/fleet/data_generator/data_generator.py): the
+MultiSlot text-protocol generators; implementations in fleet/base.py."""
+from .base import (  # noqa: F401
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
