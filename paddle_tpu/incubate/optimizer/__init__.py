@@ -4,9 +4,11 @@ import jax.numpy as jnp
 
 from ...optimizer.optimizer import Optimizer
 from ...optimizer.optimizers import LBFGS  # noqa: F401
+from ...optimizer.optimizers import Lamb as _Lamb
 from . import functional  # noqa: F401
 
-__all__ = ["LookAhead", "ModelAverage", "LBFGS", "functional"]
+__all__ = ["LookAhead", "ModelAverage", "LBFGS", "DistributedFusedLamb",
+           "functional"]
 
 
 class LookAhead(Optimizer):
@@ -105,3 +107,78 @@ class ModelAverage(Optimizer):
             self.inner.clear_grad(set_to_zero)
 
     clear_gradients = clear_grad
+
+
+class DistributedFusedLamb(_Lamb):
+    """Distributed LAMB (reference
+    python/paddle/incubate/optimizer/distributed_fused_lamb.py:120 over the
+    distributed_fused_lamb CUDA kernels, SURVEY §2.9): LAMB whose gradient
+    sync, clipping, and trust-ratio math run as one fused step across the
+    data-parallel group.
+
+    TPU mapping: the CUDA kernel's flat-buffer fusion is XLA's job — each
+    step here is jitted LAMB math; the distributed part is the dp-group
+    all-reduce (+1/n scaling per is_grad_scaled_by_nranks) executed before
+    or after clipping per `clip_after_allreduce`, and
+    `gradient_accumulation_steps` micro-batch accumulation. Sharded
+    optimizer states belong to the traced pretrain path
+    (models/pretrain.py shards moments over the fsdp axis)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 clip_after_allreduce=True, is_grad_scaled_by_nranks=True,
+                 alignment=128, use_master_param_norm=True,
+                 gradient_accumulation_steps=1, use_master_acc_grad=True,
+                 nproc_per_node=None, use_hierarchical_allreduce=False,
+                 name=None):
+        super().__init__(
+            learning_rate=learning_rate, lamb_weight_decay=lamb_weight_decay,
+            beta1=beta1, beta2=beta2, epsilon=epsilon, parameters=parameters,
+            grad_clip=grad_clip if clip_after_allreduce else None,
+            exclude_from_weight_decay_fn=exclude_from_weight_decay_fn,
+            multi_precision=use_master_param_norm)
+        self._pre_clip = None if clip_after_allreduce else grad_clip
+        self._scaled_by_nranks = is_grad_scaled_by_nranks
+        self._acc_steps = int(gradient_accumulation_steps)
+        self._acc_count = 0
+        self._acc = {}
+
+    def _dp_group(self):
+        from ...distributed.fleet import get_hcg
+        hcg = get_hcg()
+        if hcg is None:
+            return None
+        g = hcg.get_data_parallel_group()
+        return g if getattr(g, "nranks", 1) > 1 else None
+
+    def step(self):
+        from ...core.tensor import Tensor as _T
+
+        params = [p for p in self._parameter_list
+                  if getattr(p, "grad", None) is not None]
+        # micro-batch accumulation (reference gradient_accumulation_steps)
+        if self._acc_steps > 1:
+            self._acc_count += 1
+            for p in params:
+                a = self._acc.get(id(p))
+                g32 = p.grad.data.astype(jnp.float32)
+                self._acc[id(p)] = g32 if a is None else a + g32
+                p.grad = None
+            if self._acc_count < self._acc_steps:
+                return
+            for p in params:
+                p.grad = _T((self._acc.pop(id(p), 0.0)
+                             / self._acc_steps).astype(p.data.dtype))
+            self._acc_count = 0
+        if self._pre_clip is not None:
+            self._pre_clip(params)
+        group = self._dp_group()
+        if group is not None:
+            from ...distributed import collective as _c
+            n = group.nranks
+            for p in params:
+                _c.all_reduce(p.grad, group=group)
+                if self._scaled_by_nranks:
+                    p.grad = _T(p.grad.data / n)
+        return super().step()
