@@ -139,7 +139,7 @@ class UtilBase:
 
     def all_gather(self, input, comm_world="worker"):
         from .. import collective as c
-        objs = [None]
+        objs = []  # all_gather_object appends one entry per rank
         c.all_gather_object(objs, input)
         return objs
 

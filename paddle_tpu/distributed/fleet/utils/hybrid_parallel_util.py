@@ -117,8 +117,10 @@ def fused_allreduce_gradients_with_group(parameter_list, group, scale=None,
             continue
         _c.all_reduce(g, group=group)
         if scale is not None:
-            p.grad = Tensor(g.data * (1.0 / scale)) \
-                if not isinstance(scale, Tensor) else Tensor(g.data * scale)
+            # scale is always a DIVISOR (reference semantics: grads are
+            # averaged by the group size), whether given as float or Tensor
+            s = scale.data if isinstance(scale, Tensor) else float(scale)
+            p.grad = Tensor(g.data / s)
 
 
 def fused_allreduce_gradients(parameter_list, hcg):

@@ -926,10 +926,26 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
     dense one-hot combine weight — MXU-friendly static shapes, zero
     dynamic gathers; token routing resolves to the [tokens, experts]
     combine matrix (the same design as incubate/distributed/models/moe)."""
+    if quant_method not in (None, "None", "none", "weight_only_int8"):
+        raise NotImplementedError(
+            f"fused_moe: quant_method={quant_method!r} unsupported "
+            "(weight-only int8 via ffn*_scale, or float weights)")
+
     def impl(xv, gw, w1, w2, *rest):
         it = iter(rest)
         b1 = next(it) if ffn1_bias is not None else None
         b2 = next(it) if ffn2_bias is not None else None
+        s1 = next(it) if ffn1_scale is not None else None
+        s2 = next(it) if ffn2_scale is not None else None
+        # weight-only dequant (reference ffn*_scale contract: one scale per
+        # expert per out-channel); the cast+scale fuses into the einsum's
+        # operand load like nn/quant.weight_only_linear
+        if s1 is not None:
+            w1 = w1.astype(jnp.float32) * s1.reshape(
+                s1.shape[0], 1, -1).astype(jnp.float32)
+        if s2 is not None:
+            w2 = w2.astype(jnp.float32) * s2.reshape(
+                s2.shape[0], 1, -1).astype(jnp.float32)
         B, S, D = xv.shape
         E = w1.shape[0]
         tokens = xv.reshape(B * S, D)
@@ -959,7 +975,7 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
         return out.reshape(B, S, D).astype(xv.dtype)
 
     args = [x, gate_weight, ffn1_weight, ffn2_weight]
-    for t in (ffn1_bias, ffn2_bias):
+    for t in (ffn1_bias, ffn2_bias, ffn1_scale, ffn2_scale):
         if t is not None:
             args.append(t)
     return apply_op("fused_moe", impl, tuple(args), {})

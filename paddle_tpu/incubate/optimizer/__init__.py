@@ -172,7 +172,11 @@ class DistributedFusedLamb(_Lamb):
                              / self._acc_steps).astype(p.data.dtype))
             self._acc_count = 0
         if self._pre_clip is not None:
-            self._pre_clip(params)
+            # clip objects take and return (param, grad) pairs (the
+            # Optimizer.step contract); write the clipped grads back
+            pairs = self._pre_clip([(p, p.grad) for p in params])
+            for p, g in pairs:
+                p.grad = g
         group = self._dp_group()
         if group is not None:
             from ...distributed import collective as _c

@@ -37,21 +37,22 @@ def _line_search(f, xk, fk, gk, pk, max_ls, alpha0):
     gtp = jnp.vdot(gk, pk)
 
     def cond(state):
-        alpha, fv, _, it, done = state
+        alpha_try, alpha_eval, fv, _, it, done = state
         return jnp.logical_and(it < max_ls, jnp.logical_not(done))
 
     def body(state):
-        alpha, _, _, it, _ = state
-        fv, gv = jax.value_and_grad(f)(xk + alpha * pk)
-        ok = fv <= fk + c1 * alpha * gtp
-        # keep the accepted alpha; otherwise halve and try again
-        next_alpha = jnp.where(ok, alpha, alpha * 0.5)
-        return (next_alpha, fv, gv, it + 1, ok)
+        alpha_try, _, _, _, it, _ = state
+        fv, gv = jax.value_and_grad(f)(xk + alpha_try * pk)
+        ok = fv <= fk + c1 * alpha_try * gtp
+        # alpha_eval tracks the step f/g were ACTUALLY evaluated at, so an
+        # exhausted search still returns a consistent (alpha, f, g) triple
+        next_alpha = jnp.where(ok, alpha_try, alpha_try * 0.5)
+        return (next_alpha, alpha_try, fv, gv, it + 1, ok)
 
     f0, g0 = jax.value_and_grad(f)(xk + alpha0 * pk)
     ok0 = f0 <= fk + c1 * alpha0 * gtp
-    alpha, fv, gv, evals, done = jax.lax.while_loop(
-        cond, body, (jnp.where(ok0, alpha0, alpha0 * 0.5), f0, g0,
+    _, alpha, fv, gv, evals, done = jax.lax.while_loop(
+        cond, body, (jnp.where(ok0, alpha0, alpha0 * 0.5), alpha0, f0, g0,
                      jnp.asarray(1), ok0))
     return alpha, fv, gv, evals, done
 

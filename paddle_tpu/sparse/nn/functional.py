@@ -27,7 +27,17 @@ leaky_relu = _ops.leaky_relu
 softmax = _ops.softmax
 attention = _ops.attention
 
-_structure_cache = {}  # (idx-bytes, geometry) -> rulebook / out structure
+# (idx-bytes, geometry) -> rulebook / out structure. Bounded FIFO: static
+# point-cloud structures hit forever; per-batch dynamic structures evict
+# instead of growing without bound.
+_STRUCTURE_CACHE_MAX = 64
+_structure_cache = {}
+
+
+def _cache_put(key, value):
+    if len(_structure_cache) >= _STRUCTURE_CACHE_MAX:
+        _structure_cache.pop(next(iter(_structure_cache)))
+    _structure_cache[key] = value
 
 
 def _tup(v, n):
@@ -76,7 +86,7 @@ def _subm_rulebook(idx, ks):
         dst_l.append(dst.astype(np.int32))
     rb = (np.concatenate(taps_l), np.concatenate(src_l),
           np.concatenate(dst_l))
-    _structure_cache[key] = rb
+    _cache_put(key, rb)
     return rb
 
 
@@ -114,7 +124,7 @@ def _conv_structure(idx, spatial, ks, stride, padding):
     res = (uniq.T.astype(np.int32), out_spatial,
            tap_id.astype(np.int32), src.astype(np.int32),
            dst.astype(np.int32))
-    _structure_cache[key] = res
+    _cache_put(key, res)
     return res
 
 

@@ -120,17 +120,29 @@ def convert(model_path, config):
         infer_cfg.set_optim_cache_dir(config.save_model_dir)
     predictor = create_predictor(infer_cfg)
 
-    # warm the per-shape executable cache over each Input's shape triple
-    # (the TRT optimization-profile role)
+    # warm the per-shape executable cache over the Inputs' shape triples
+    # (the TRT optimization-profile role). EVERY input is set for each
+    # run — bucket i of each Input combine positionally (min with min,
+    # optim with optim, max with max), matching how TRT profiles pair.
     names = predictor.get_input_names()
-    for inp, name in zip(config.inputs, names):
-        for arr in inp.generate_input_data():
-            h = predictor.get_input_handle(name)
-            h.copy_from_cpu(arr)
-            try:
-                predictor.run()
-            except Exception:
-                # a bucket shape the program rejects (e.g. fixed-shape
-                # model): skip — the optim shape is tried last
-                continue
+    triples = [inp.generate_input_data() for inp in config.inputs]
+    if len(triples) < len(names):
+        raise ValueError(
+            f"TensorRTConfig.inputs covers {len(triples)} of the model's "
+            f"{len(names)} inputs ({names}); one Input per model input is "
+            "required to warm the shape buckets")
+    warmed = 0
+    last_err = None
+    for bucket in range(3):  # min, optim, max
+        for name, triple in zip(names, triples):
+            predictor.get_input_handle(name).copy_from_cpu(triple[bucket])
+        try:
+            predictor.run()
+            warmed += 1
+        except Exception as e:  # a bucket shape the program rejects
+            last_err = e
+    if warmed == 0:
+        raise RuntimeError(
+            f"tensorrt.convert: no shape bucket compiled; last error: "
+            f"{last_err!r}")
     return _ConvertedProgram(predictor, config)
