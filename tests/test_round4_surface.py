@@ -626,3 +626,54 @@ class TestFleetUtilsHelpers:
         opt.step()
         assert not np.allclose(w0, np.asarray(lin.weight.numpy()))
         assert lin.weight.main_grad is None
+
+
+class TestQuantizedFusedPaths:
+    """int8 legs of the fused tier: fused_moe expert dequant and
+    fused_rms_norm quantized output (reference quant_scale contract)."""
+
+    def test_fused_moe_int8_matches_float(self, rng):
+        import paddle_tpu.incubate.nn.functional as F
+        B, S, D, E, Ff = 1, 3, 8, 4, 6
+        x = rng.normal(size=(B, S, D)).astype(np.float32)
+        gw = rng.normal(size=(D, E)).astype(np.float32)
+        w1 = (rng.normal(size=(E, D, Ff)) * 0.3).astype(np.float32)
+        w2 = (rng.normal(size=(E, Ff, D)) * 0.3).astype(np.float32)
+        ref = F.fused_moe(paddle.to_tensor(x), paddle.to_tensor(gw),
+                          paddle.to_tensor(w1), paddle.to_tensor(w2),
+                          moe_topk=2)
+        s1 = np.abs(w1).max(axis=1) / 127.0 + 1e-9
+        q1 = np.clip(np.round(w1 / s1[:, None, :]), -127, 127).astype(np.int8)
+        s2 = np.abs(w2).max(axis=1) / 127.0 + 1e-9
+        q2 = np.clip(np.round(w2 / s2[:, None, :]), -127, 127).astype(np.int8)
+        out = F.fused_moe(
+            paddle.to_tensor(x), paddle.to_tensor(gw), paddle.to_tensor(q1),
+            paddle.to_tensor(q2),
+            ffn1_scale=paddle.to_tensor(s1.astype(np.float32)),
+            ffn2_scale=paddle.to_tensor(s2.astype(np.float32)),
+            quant_method="weight_only_int8", moe_topk=2)
+        assert _rel_err(out.numpy(), np.asarray(ref.numpy())) < 3e-2
+
+    def test_fused_moe_rejects_unknown_quant(self, rng):
+        import paddle_tpu.incubate.nn.functional as F
+        with pytest.raises(NotImplementedError):
+            F.fused_moe(paddle.ones([1, 2, 4]), paddle.ones([4, 2]),
+                        paddle.ones([2, 4, 4]), paddle.ones([2, 4, 4]),
+                        quant_method="int4")
+
+    def test_fused_rms_norm_int8_output(self, rng):
+        import paddle_tpu.incubate.nn.functional as F
+        x = rng.normal(size=(2, 8)).astype(np.float32)
+        g = np.ones(8, np.float32)
+        out, _ = F.fused_rms_norm(
+            paddle.to_tensor(x), paddle.to_tensor(g), None, 1e-6, 1,
+            quant_scale=0.5, quant_round_type=1, quant_max_bound=127.0,
+            quant_min_bound=-127.0)
+        o = np.asarray(out.numpy())
+        assert o.dtype == np.int8
+        normed = x / np.sqrt((x * x).mean(-1, keepdims=True) + 1e-6)
+        ref = np.clip(np.where(normed * 127 * 0.5 >= 0,
+                               np.floor(normed * 127 * 0.5 + 0.5),
+                               np.ceil(normed * 127 * 0.5 - 0.5)),
+                      -127, 127).astype(np.int8)
+        np.testing.assert_array_equal(o, ref)
