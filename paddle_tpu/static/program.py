@@ -13,6 +13,8 @@ Ops not connected to a placeholder (e.g. parameter initializers) run
 eagerly and are NOT recorded — the startup-program split falls out of the
 dataflow rule instead of needing a second Program.
 """
+import time
+
 import jax
 import numpy as np
 
@@ -46,6 +48,8 @@ class Program:
         self.feed_vars = {}      # name -> placeholder Tensor
         self._connected = set()  # tensor ids reachable from placeholders
         self._compiled = {}
+        self._stats = {"compiles": 0, "compile_time_s": 0.0,
+                       "cache_hits": 0, "runs": 0, "run_time_s": 0.0}
 
     # -- recording --------------------------------------------------------
     def _register_placeholder(self, name, t):
@@ -107,6 +111,7 @@ class Program:
         return fn
 
     def run(self, feed, fetch_list):
+        t_run0 = time.perf_counter()
         feed_names = sorted(feed.keys())
         fetch_ids = tuple(id(t) for t in fetch_list)
         externals = self._external_inputs()
@@ -115,9 +120,6 @@ class Program:
                tuple((np.shape(feed[n]), str(np.asarray(feed[n]).dtype))
                      for n in feed_names),
                fetch_ids, external_ids, len(self.ops))
-        if key not in self._compiled:
-            self._compiled[key] = jax.jit(self._build_fn(fetch_ids,
-                                                         external_ids))
         feed_by_id = {id(self.feed_vars[n]): np.asarray(feed[n])
                       for n in feed_names}
         # RNG-key externals (fresh_key_tensor marker) are re-drawn per run:
@@ -135,8 +137,33 @@ class Program:
                          id(self.feed_vars[n]) in external_ids]
         if missing_feeds:
             raise KeyError(f"missing feeds: {missing_feeds}")
+        if key not in self._compiled:
+            # AOT-compile so trace+XLA time is attributed to compile_time_s
+            # (jax.jit alone is lazy — it would fold the real compile cost
+            # into the first run's wall time)
+            t0 = time.perf_counter()
+            self._compiled[key] = jax.jit(
+                self._build_fn(fetch_ids, external_ids)
+            ).lower(arrays).compile()
+            self._stats["compiles"] += 1
+            self._stats["compile_time_s"] += time.perf_counter() - t0
+            t_run0 = time.perf_counter()  # run time excludes the compile
+        else:
+            self._stats["cache_hits"] += 1
         outs = self._compiled[key](arrays)
-        return [np.asarray(o) for o in outs]
+        res = [np.asarray(o) for o in outs]
+        self._stats["runs"] += 1
+        self._stats["run_time_s"] += time.perf_counter() - t_run0
+        return res
+
+    def statistics(self):
+        """Executor run statistics (the reference's
+        new_executor/executor_statistics.cc role, SURVEY §5.5): compile
+        count/time, executable-cache hits, run count/wall time."""
+        out = dict(self._stats)
+        out["cached_executables"] = len(self._compiled)
+        out["num_ops"] = len(self.ops)
+        return out
 
     def global_block(self):
         return self
@@ -205,6 +232,13 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None):
         prog = program or default_main_program()
         return prog.run(feed or {}, fetch_list or [])
+
+    def statistics(self, program=None):
+        """Per-program executor statistics (executor_statistics.cc role):
+        {compiles, compile_time_s, cache_hits, runs, run_time_s,
+        cached_executables, num_ops}."""
+        prog = program or default_main_program()
+        return prog.statistics()
 
     def close(self):
         pass

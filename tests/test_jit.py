@@ -494,3 +494,31 @@ class TestModelZooUnderSotDefault:
         np.testing.assert_allclose(sfm(ids).numpy(), ref, rtol=2e-4,
                                    atol=2e-4)
         assert sfm._tier == "opcode"
+
+
+def test_executor_statistics():
+    """Executor run statistics (executor_statistics.cc role, SURVEY §5.5):
+    compile count, cache hits, run wall time."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("X", [4, 8], "float32")
+            y = static.nn.fc(x, 4)
+        exe = static.Executor()
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed={"X": np.ones((4, 8), np.float32)},
+                    fetch_list=[y])
+        st = exe.statistics(main)
+    finally:
+        paddle.disable_static()
+    assert st["runs"] == 3
+    assert st["compiles"] == 1 and st["cache_hits"] == 2
+    assert st["cached_executables"] == 1 and st["num_ops"] >= 1
+    assert st["run_time_s"] > 0
