@@ -522,3 +522,49 @@ def test_executor_statistics():
     assert st["compiles"] == 1 and st["cache_hits"] == 2
     assert st["cached_executables"] == 1 and st["num_ops"] >= 1
     assert st["run_time_s"] > 0
+
+
+class TestWholeModelToStatic:
+    """Model-level to_static through the default SOT tier (the reference
+    runs full models under AST & SOT modes, test/dygraph_to_static/)."""
+
+    def test_resnet_through_to_static(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import jit
+        from paddle_tpu.vision.models import resnet18
+
+        net = resnet18(num_classes=10)
+        net.eval()
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (2, 3, 32, 32)).astype(np.float32))
+        eager = net(x)
+        snet = jit.to_static(net)
+        traced = snet(x)
+        np.testing.assert_allclose(np.asarray(traced.numpy()),
+                                   np.asarray(eager.numpy()),
+                                   rtol=2e-3, atol=2e-3)
+        traced2 = snet(x)  # cached second call
+        np.testing.assert_allclose(np.asarray(traced2.numpy()),
+                                   np.asarray(traced.numpy()), rtol=1e-6)
+
+    def test_llama_through_to_static(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import jit
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=48, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=16, dtype="float32")
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        ids = paddle.to_tensor(np.random.default_rng(1).integers(
+            0, 64, (2, 8)).astype(np.int32))
+        eager = model(ids)
+        smodel = jit.to_static(model)
+        traced = smodel(ids)
+        np.testing.assert_allclose(np.asarray(traced.numpy()),
+                                   np.asarray(eager.numpy()),
+                                   rtol=2e-3, atol=2e-3)
