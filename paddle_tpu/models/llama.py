@@ -140,13 +140,23 @@ class LlamaAttention(nn.Layer):
         cp_axis = self._context_parallel_axis()
         if cp_axis is not None and attn_mask is None:
             # context parallelism (long-context first-class, SURVEY §5.7
-            # capability upgrade — absent from the reference core): the
-            # sequence dim is sharded over the cp axis and K/V blocks
-            # rotate the ICI ring with an online-softmax accumulator
-            from ..distributed.fleet.context_parallel import ring_attention
+            # capability upgrade — absent from the reference core).
+            # mode 'ring' (default): K/V blocks rotate the ICI ring with an
+            # online-softmax accumulator — any head count.
+            # mode 'ulysses': alltoall head<->sequence exchange, then
+            # full-sequence local attention over H/p heads — cheaper
+            # collectives when num_heads divides by the cp degree.
+            from ..distributed.fleet.context_parallel import (
+                ring_attention, ulysses_attention)
             from ..distributed.mesh import get_mesh
-            out = ring_attention(q, k, v, causal=True, mesh=get_mesh(),
-                                 axis_name=cp_axis)
+            mode = getattr(self.config, "context_parallel_mode", "ring")
+            if mode not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"context_parallel_mode={mode!r}: expected 'ring' or "
+                    "'ulysses'")
+            attn = ulysses_attention if mode == "ulysses" else ring_attention
+            out = attn(q, k, v, causal=True, mesh=get_mesh(),
+                       axis_name=cp_axis)
         elif attn_mask is None:
             out, _ = F.flash_attention(q, k, v, causal=True)
         else:

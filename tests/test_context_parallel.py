@@ -118,12 +118,15 @@ class TestSepFleetIntegration:
 
 
 class TestLlamaContextParallel:
-    """Round-4: ring-attention CP reachable from the flagship model config
-    (long-context first-class; the reference core has no CP, SURVEY §5.7)."""
+    """Ring and Ulysses CP reachable from the flagship model config
+    (long-context first-class; the reference core has no CP, SURVEY §5.7).
+    Same init + batch: each CP mode must reproduce the flash path's loss
+    AND gradient norm inside the hybrid sharded step."""
 
-    def test_cp_step_matches_flash_step(self):
-        import jax
+    @pytest.mark.parametrize("mode", ["ring", "ulysses"])
+    def test_cp_step_matches_flash_step(self, mode):
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, pretrain
+        from paddle_tpu.distributed.fleet import context_parallel as CP
         base = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
                     num_hidden_layers=2, num_attention_heads=4,
                     num_key_value_heads=2, max_position_embeddings=64,
@@ -132,39 +135,57 @@ class TestLlamaContextParallel:
         batch_np = {"input_ids": rng.integers(0, 128, (4, 64)).astype(
                         np.int32),
                     "labels": rng.integers(0, 128, (4, 64)).astype(np.int32)}
-        losses = {}
-        from paddle_tpu.distributed.fleet import context_parallel as CP
-        calls = {"ring": 0}
-        orig = CP.ring_attention
+        attr = f"{mode}_attention"
+        calls = {"n": 0}
+        orig = getattr(CP, attr)
 
-        def counting_ring(*a, **k):
-            calls["ring"] += 1
+        def counting(*a, **k):
+            calls["n"] += 1
             return orig(*a, **k)
 
-        import paddle_tpu.models.llama  # noqa: F401 (imports by module path)
-        CP.ring_attention = counting_ring
+        losses = {}
+        setattr(CP, attr, counting)
         try:
             for cp in (False, True):
                 paddle.seed(123)
-                cfg = LlamaConfig(**base, context_parallel=cp)
+                cfg = LlamaConfig(**base, context_parallel=cp,
+                                  context_parallel_mode=mode)
                 model = LlamaForCausalLM(cfg)
+                # sp=2 divides num_heads=4 (the ulysses constraint)
                 mesh = pretrain.make_mesh(8, dp=2, fsdp=1, mp=2, sp=2)
-                params, opt_state, meta = pretrain.make_train_state(model,
-                                                                    mesh)
+                params, opt_state, meta = pretrain.make_train_state(
+                    model, mesh)
                 step = pretrain.make_train_step(model, mesh, meta)
                 batch = pretrain.shard_batch(dict(batch_np), mesh)
                 _, _, loss, gnorm = step(params, opt_state, batch)
                 losses[cp] = (float(loss), float(gnorm))
         finally:
-            CP.ring_attention = orig
-        # the ring branch must have actually RUN for the cp config (the
-        # review caught a degenerate global mesh silently disabling CP —
-        # this assertion makes that class of regression loud)
-        assert calls["ring"] >= cfg.num_hidden_layers, calls
-        # same init, same batch: ring attention must reproduce the flash
-        # path's loss AND gradient norm (fwd+bwd correctness through the
-        # ppermute ring inside the hybrid step)
+            setattr(CP, attr, orig)
+        # the CP branch must have actually RUN for the cp config (a
+        # degenerate global mesh silently disabling CP regressed once —
+        # this assertion keeps that loud)
+        assert calls["n"] >= cfg.num_hidden_layers, calls
         np.testing.assert_allclose(losses[True][0], losses[False][0],
                                    rtol=2e-5)
         np.testing.assert_allclose(losses[True][1], losses[False][1],
                                    rtol=2e-4)
+
+    def test_unknown_mode_rejected(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models import pretrain
+        cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                          intermediate_size=128, num_hidden_layers=1,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=32, dtype="float32",
+                          context_parallel=True,
+                          context_parallel_mode="Ulysses")  # typo'd case
+        model = LlamaForCausalLM(cfg)
+        mesh = pretrain.make_mesh(8, dp=2, fsdp=1, mp=2, sp=2)
+        from paddle_tpu.distributed.mesh import ProcessMesh, set_mesh
+        set_mesh(ProcessMesh(mesh))
+        try:
+            with pytest.raises(ValueError, match="context_parallel_mode"):
+                model(paddle.to_tensor(
+                    np.zeros((2, 8), np.int32)))
+        finally:
+            set_mesh(None)
