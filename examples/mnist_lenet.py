@@ -1,0 +1,48 @@
+"""LeNet on MNIST — the minimum end-to-end slice (BASELINE config 1).
+
+Run: python examples/mnist_lenet.py
+Uses the local MNIST cache when present, a deterministic synthetic
+stand-in otherwise (zero-egress environments)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def main():
+    train_ds = MNIST(mode="train")
+    loader = DataLoader(train_ds, batch_size=128, shuffle=True)
+    model = LeNet(num_classes=10)
+    opt = optimizer.Adam(parameters=model.parameters(), learning_rate=1e-3)
+
+    model.train()
+    for epoch in range(2):
+        seen = correct = 0
+        for i, (img, label) in enumerate(loader):
+            img = paddle.to_tensor(np.asarray(img, np.float32))
+            label = paddle.to_tensor(np.asarray(label, np.int64))
+            logits = model(img.reshape([-1, 1, 28, 28]))
+            loss = nn.functional.cross_entropy(logits, label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            pred = np.asarray(logits.numpy()).argmax(-1)
+            correct += int((pred == np.asarray(label.numpy())).sum())
+            seen += len(pred)
+            if i % 50 == 0:
+                print(f"epoch {epoch} step {i}: loss "
+                      f"{float(loss.numpy()):.4f} acc {correct / seen:.3f}")
+            if i >= 150:
+                break
+    print(f"final train accuracy: {correct / seen:.3f}")
+
+
+if __name__ == "__main__":
+    main()
