@@ -22,9 +22,27 @@ __all__ = [
 ]
 
 
+def _device_index(device):
+    """Normalize a device designator — None, int, 'tpu:0'/'gpu:0' string,
+    or a Place-like object — to a local device index (reference
+    paddle.device APIs accept all of these)."""
+    if device is None:
+        return 0
+    if isinstance(device, int):
+        return device
+    if isinstance(device, str):
+        _, _, idx = device.partition(":")
+        return int(idx) if idx else 0
+    for attr in ("index", "get_device_id"):
+        v = getattr(device, attr, None)
+        if v is not None:
+            return int(v() if callable(v) else v)
+    return 0
+
+
 def _dev(device_id=None):
     devs = jax.local_devices()
-    return devs[device_id or 0]
+    return devs[_device_index(device_id)]
 
 
 def _stats(device_id=None):

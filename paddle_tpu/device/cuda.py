@@ -11,10 +11,14 @@ import jax
 
 from . import (  # noqa: F401
     Stream, Event, current_stream, stream_guard, set_stream,
-    synchronize, device_count,
+    device_count,
     memory_allocated, max_memory_allocated, memory_reserved,
     reset_max_memory_allocated, _dev, _stats,
 )
+# The queue-draining synchronize (device_put + block_until_ready), not the
+# package-level effects_barrier one: timing code relies on it waiting for
+# pending pure async dispatch too.
+from .tpu import synchronize  # noqa: F401
 
 __all__ = [
     "Stream", "Event", "current_stream", "synchronize", "device_count",

@@ -1,6 +1,6 @@
 """paddle.device.xpu source-compat namespace (reference
 python/paddle/device/xpu/__init__.py), served by the TPU runtime."""
-from . import synchronize  # noqa: F401
+from .tpu import synchronize  # noqa: F401  (queue-draining version)
 from .cuda import empty_cache  # noqa: F401
 
 __all__ = ["synchronize", "empty_cache"]

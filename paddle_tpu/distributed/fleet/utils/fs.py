@@ -7,7 +7,7 @@ import shutil
 import subprocess
 
 __all__ = ["LocalFS", "HDFSClient", "FSFileExistsError",
-           "FSFileNotExistsError"]
+           "FSFileNotExistsError", "ExecuteError"]
 
 
 class FSFileExistsError(Exception):
@@ -15,6 +15,12 @@ class FSFileExistsError(Exception):
 
 
 class FSFileNotExistsError(Exception):
+    pass
+
+
+class ExecuteError(Exception):
+    """A shelled-out filesystem command exited nonzero (reference fs.py
+    ExecuteError): mutating operations must not report success silently."""
     pass
 
 
@@ -126,20 +132,27 @@ class HDFSClient(_FS):
                  sleep_inter=1000):
         self._hadoop_home = hadoop_home
         self._configs = configs or {}
+        self._time_out_s = max(1.0, time_out / 1000.0)  # reference: ms
         pre = [os.path.join(hadoop_home, "bin", "hadoop"), "fs"]
         for k, v in self._configs.items():
             pre += ["-D", f"{k}={v}"]
         self._cmd_prefix = pre
 
-    def _run(self, *args):
+    def _run(self, *args, check=False):
+        """check=True: raise ExecuteError (with stderr) on nonzero exit —
+        used by every mutating op so failures are never silent."""
         cmd = self._cmd_prefix + list(args)
         try:
             out = subprocess.run(cmd, capture_output=True, text=True,
-                                 timeout=300)
+                                 timeout=self._time_out_s)
         except FileNotFoundError as e:
             raise RuntimeError(
                 f"hadoop binary not found under {self._hadoop_home} "
                 "(HDFS is unavailable in this environment)") from e
+        if check and out.returncode != 0:
+            raise ExecuteError(
+                f"{' '.join(cmd)} exited {out.returncode}: "
+                f"{out.stderr.strip() or out.stdout.strip()}")
         return out.returncode, out.stdout
 
     def ls_dir(self, fs_path):
@@ -169,10 +182,10 @@ class HDFSClient(_FS):
         return code == 0
 
     def mkdirs(self, fs_path):
-        self._run("-mkdir", "-p", fs_path)
+        self._run("-mkdir", "-p", fs_path, check=True)
 
     def delete(self, fs_path):
-        self._run("-rm", "-r", fs_path)
+        self._run("-rm", "-r", fs_path, check=True)
 
     def need_upload_download(self):
         return True
@@ -182,7 +195,7 @@ class HDFSClient(_FS):
             if exist_ok:
                 return
             raise FSFileExistsError(fs_path)
-        self._run("-touchz", fs_path)
+        self._run("-touchz", fs_path, check=True)
 
     def mv(self, fs_src_path, fs_dst_path, overwrite=False,
            test_exists=True):
@@ -190,15 +203,15 @@ class HDFSClient(_FS):
             raise FSFileNotExistsError(fs_src_path)
         if overwrite and self.is_exist(fs_dst_path):
             self.delete(fs_dst_path)
-        self._run("-mv", fs_src_path, fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path, check=True)
 
     def list_dirs(self, fs_path):
         return self.ls_dir(fs_path)[0]
 
     def upload(self, local_path, fs_path, multi_processes=1,
                overwrite=False):
-        self._run("-put", local_path, fs_path)
+        self._run("-put", local_path, fs_path, check=True)
 
     def download(self, fs_path, local_path, multi_processes=1,
                  overwrite=False):
-        self._run("-get", fs_path, local_path)
+        self._run("-get", fs_path, local_path, check=True)

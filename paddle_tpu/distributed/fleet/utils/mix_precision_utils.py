@@ -73,7 +73,12 @@ class MixPrecisionOptimizer:
             mg = getattr(p, "main_grad", None)
             if mg is not None:
                 stash.append((p, p.grad))
-                p.grad = Tensor(mg.data.astype(p.data.dtype))
+                # Step from the fp32 main_grad unchanged: downcasting to the
+                # param dtype would round away the accumulated fp32 precision
+                # (the whole point of the O2 main-grad contract). Optimizers
+                # cast grads to fp32 internally, so a dtype mismatch with the
+                # param is fine.
+                p.grad = Tensor(mg.data)
         try:
             self._inner_opt.step()
         finally:

@@ -23,7 +23,11 @@ class LookAhead(Optimizer):
                          False, name)
         self.alpha = alpha
         self.k = k
-        self._slow = {}
+        # Slow weights snapshot the params AT CONSTRUCTION (reference
+        # lookahead.py), so the first k-boundary performs a real
+        # interpolation rather than a no-op re-snapshot.
+        self._slow = {id(p): p.data.astype(jnp.float32)
+                      for p in self._parameter_list}
         self._steps = 0
 
     def step(self):
@@ -33,8 +37,8 @@ class LookAhead(Optimizer):
             return
         for p in self._parameter_list:
             slow = self._slow.get(id(p))
-            if slow is None:
-                slow = self._slow[id(p)] = p.data.astype(jnp.float32)
+            if slow is None:  # param added after construction
+                self._slow[id(p)] = p.data.astype(jnp.float32)
                 continue
             slow = slow + self.alpha * (p.data.astype(jnp.float32) - slow)
             self._slow[id(p)] = slow

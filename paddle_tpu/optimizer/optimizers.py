@@ -96,11 +96,13 @@ class SGD(Optimizer):
                          multi_precision, name)
 
     def _apply_one(self, p, g, st, lr):
-        g = self._l2(p, g, st)
-        base = st.get("master", p.data)
-        new = _sgd_update(base.astype(jnp.float32) if "master" in st else base,
-                          g.astype(base.dtype) if "master" not in st else g,
-                          jnp.float32(lr))
+        # Update math always in fp32 (like Momentum/Adam): a low-precision
+        # param without master weights still gets the fp32 grad applied at
+        # full precision, rounding only once at the final write-back —
+        # required by the O2 main-grad contract (fleet mix_precision_utils).
+        g = self._l2(p, g, st).astype(jnp.float32)
+        base = st.get("master", p.data.astype(jnp.float32))
+        new = _sgd_update(base, g, jnp.float32(lr))
         self._write_back(p, st, new)
 
     def _apply_sparse(self, p, g, st, lr):

@@ -41,19 +41,22 @@ def _render_digit(label, rng, size=28):
     return np.clip(img, 0.0, 1.0)
 
 
+def _find_cached(subdir, names):
+    """Return full paths for `names` under a paddle-style cache dir
+    (~/.cache/paddle/dataset/<subdir>, ~/.cache/<subdir>, /data/<subdir>),
+    or None when any is missing."""
+    for d in (os.path.expanduser(f"~/.cache/paddle/dataset/{subdir}"),
+              os.path.expanduser(f"~/.cache/{subdir}"), f"/data/{subdir}"):
+        paths = [os.path.join(d, n) for n in names]
+        if all(os.path.exists(p) for p in paths):
+            return paths
+    return None
+
+
 def _find_mnist_files(mode):
     prefix = "train" if mode == "train" else "t10k"
-    candidates = [
-        os.path.expanduser("~/.cache/paddle/dataset/mnist"),
-        os.path.expanduser("~/.cache/mnist"),
-        "/data/mnist",
-    ]
-    for d in candidates:
-        img = os.path.join(d, f"{prefix}-images-idx3-ubyte.gz")
-        lbl = os.path.join(d, f"{prefix}-labels-idx1-ubyte.gz")
-        if os.path.exists(img) and os.path.exists(lbl):
-            return img, lbl
-    return None
+    return _find_cached("mnist", [f"{prefix}-images-idx3-ubyte.gz",
+                                  f"{prefix}-labels-idx1-ubyte.gz"])
 
 
 class MNIST(Dataset):
@@ -258,14 +261,31 @@ class ImageFolder(Dataset):
 
 
 class Flowers(Dataset):
-    """Flowers-102 (reference vision/datasets/flowers.py:54): local cache
-    when present, deterministic synthetic stand-in otherwise (102 classes,
-    3x224x224 hue-keyed blobs)."""
+    """Flowers-102 (reference vision/datasets/flowers.py:54): loads the
+    real 102flowers.tgz + imagelabels.mat + setid.mat when given or cached
+    (same archive layout as the reference loader), deterministic synthetic
+    stand-in otherwise (102 classes, hue-keyed blobs)."""
+
+    MODE_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
 
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode="train", transform=None, download=True, backend=None,
                  synthetic_size=None):
         self.transform = transform
+        explicit = (data_file, label_file, setid_file)
+        if any(explicit) and not all(explicit):
+            raise ValueError(
+                "Flowers needs data_file, label_file AND setid_file when "
+                "any is given explicitly")
+        files = list(explicit) if all(explicit) else _find_cached(
+            "flowers", ["102flowers.tgz", "imagelabels.mat", "setid.mat"])
+        if files:
+            for p in files:
+                if not os.path.exists(p):
+                    raise FileNotFoundError(f"Flowers file not found: {p}")
+            self._load_real(*files, mode=mode)
+            self.synthetic = False
+            return
         n = synthetic_size or (1020 if mode == "train" else 102)
         rng = np.random.default_rng({"train": 10, "valid": 11,
                                      "test": 12}.get(mode, 13))
@@ -277,11 +297,52 @@ class Flowers(Dataset):
             0, 1)
         self.synthetic = True
 
+    def _load_real(self, data_file, label_file, setid_file, mode):
+        # Extract the split's images ONCE at construction: tarfile's
+        # random access into a gzip stream re-decompresses from byte 0 on
+        # every backward seek, which would make a shuffled epoch O(archive)
+        # per sample.
+        import tarfile
+
+        import scipy.io as sio
+        all_labels = sio.loadmat(label_file)["labels"].ravel()  # 1-based cls
+        ids = sio.loadmat(setid_file)[
+            self.MODE_KEY.get(mode, "trnid")].ravel()  # 1-based image ids
+        self._ids = ids.astype(np.int64)
+        self.labels = (all_labels[ids - 1] - 1).astype(np.int64)
+        cache_dir = data_file + ".extracted"
+        wanted = set()
+        with tarfile.open(data_file) as tf:
+            names = set(tf.getnames())
+            member = {}
+            for i in self._ids.tolist():
+                member[i] = (f"jpg/image_{i:05d}.jpg"
+                             if f"jpg/image_{i:05d}.jpg" in names
+                             else f"image_{i:05d}.jpg")
+                wanted.add(member[i])
+            missing = [m for m in sorted(wanted) if not os.path.exists(
+                os.path.join(cache_dir, m))]
+            if missing:
+                os.makedirs(cache_dir, exist_ok=True)
+                tf.extractall(cache_dir, members=[
+                    tf.getmember(m) for m in missing])
+        self._paths = {i: os.path.join(cache_dir, member[i])
+                       for i in self._ids.tolist()}
+
+    def _read_image(self, image_id):
+        from PIL import Image
+        with Image.open(self._paths[int(image_id)]) as im:
+            return np.asarray(im.convert("RGB"),
+                              np.float32).transpose(2, 0, 1) / 255.0
+
     def __getitem__(self, idx):
-        img = self.images[idx]
+        if self.synthetic:
+            img = self.images[idx]
+        else:
+            img = self._read_image(self._ids[idx])
         if self.transform is not None:
             img = self.transform(img)
-        return img.astype(np.float32), np.int64(self.labels[idx])
+        return np.asarray(img, np.float32), np.int64(self.labels[idx])
 
     def __len__(self):
         return len(self.labels)
@@ -289,12 +350,26 @@ class Flowers(Dataset):
 
 class VOC2012(Dataset):
     """VOC2012 segmentation pairs (reference vision/datasets/voc2012.py:54):
-    items are (image, label_mask). Synthetic stand-in: blob masks with the
-    21-class palette over matching images."""
+    items are (image, label_mask). Loads the real VOCtrainval tar when given
+    or cached (ImageSets/Segmentation lists + JPEGImages +
+    SegmentationClass, the reference's layout); synthetic blob-mask
+    stand-in otherwise."""
+
+    MODE_LIST = {"train": "train.txt", "valid": "val.txt",
+                 "test": "val.txt", "trainval": "trainval.txt"}
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=True, backend=None, synthetic_size=None):
         self.transform = transform
+        files = [data_file] if data_file else _find_cached(
+            "voc2012", ["VOCtrainval_11-May-2012.tar"])
+        if files:
+            if not os.path.exists(files[0]):
+                raise FileNotFoundError(f"VOC2012 archive not found: "
+                                        f"{files[0]}")
+            self._load_real(files[0], mode)
+            self.synthetic = False
+            return
         n = synthetic_size or (100 if mode == "train" else 20)
         rng = np.random.default_rng(20 if mode == "train" else 21)
         H = W = 64
@@ -309,11 +384,43 @@ class VOC2012(Dataset):
         self.masks = masks
         self.synthetic = True
 
+    def _load_real(self, data_file, mode):
+        import tarfile
+        self._tar_path = data_file
+        self._tar = None
+        listname = self.MODE_LIST.get(mode, "train.txt")
+        with tarfile.open(data_file) as tf:
+            root = "VOCdevkit/VOC2012"
+            with tf.extractfile(
+                    f"{root}/ImageSets/Segmentation/{listname}") as f:
+                names = [ln.strip() for ln in
+                         f.read().decode().splitlines() if ln.strip()]
+        self._names = names
+        self._root = root
+
+    def _read_pair(self, name):
+        import tarfile
+
+        from PIL import Image
+        if self._tar is None:
+            self._tar = tarfile.open(self._tar_path)
+        with self._tar.extractfile(
+                f"{self._root}/JPEGImages/{name}.jpg") as f:
+            img = np.asarray(Image.open(f).convert("RGB"),
+                             np.float32).transpose(2, 0, 1) / 255.0
+        with self._tar.extractfile(
+                f"{self._root}/SegmentationClass/{name}.png") as f:
+            mask = np.asarray(Image.open(f), np.int64)
+        return img, mask
+
     def __getitem__(self, idx):
-        img, mask = self.images[idx], self.masks[idx]
+        if self.synthetic:
+            img, mask = self.images[idx], self.masks[idx]
+        else:
+            img, mask = self._read_pair(self._names[idx])
         if self.transform is not None:
             img = self.transform(img)
-        return img.astype(np.float32), mask
+        return np.asarray(img, np.float32), mask
 
     def __len__(self):
-        return len(self.images)
+        return len(self.images) if self.synthetic else len(self._names)

@@ -63,6 +63,24 @@ class TestNewOptimizers:
             opt.clear_grad()
         assert abs(float(w.numpy()[0])) < 1.0
 
+    def test_lookahead_first_boundary_interpolates(self):
+        """Slow weights snapshot at construction (reference lookahead.py),
+        so the FIRST k-boundary pulls the fast weights back toward w0."""
+        from paddle_tpu.incubate import LookAhead
+        w = paddle.to_tensor(np.array([4.0], np.float32),
+                             stop_gradient=False)
+        inner = optimizer.SGD(learning_rate=0.1, parameters=[w])
+        opt = LookAhead(inner, alpha=0.5, k=2)
+        fast = 4.0
+        for _ in range(2):  # two fast SGD steps on w^2: w -= 0.1*2w
+            (w ** 2).sum().backward()
+            opt.step()
+            opt.clear_grad()
+            fast *= 0.8
+        # first boundary: slow = w0 + alpha*(fast - w0), and w := slow
+        expected = 4.0 + 0.5 * (fast - 4.0)
+        assert abs(float(w.numpy()[0]) - expected) < 1e-5
+
 
 class TestGeometric:
     def test_send_u_recv(self):

@@ -404,6 +404,25 @@ class TestFleetBase:
         fs.delete(os.path.join(d, "sub"))
         assert not fs.is_exist(os.path.join(d, "sub"))
 
+    def test_hdfs_client_raises_on_failure(self, tmp_path):
+        """Mutating ops must surface nonzero exits (ExecuteError with
+        stderr) and honor the constructor's time_out (ms)."""
+        from paddle_tpu.distributed.fleet.utils.fs import (ExecuteError,
+                                                           HDFSClient)
+        home = tmp_path / "hadoop"
+        (home / "bin").mkdir(parents=True)
+        fake = home / "bin" / "hadoop"
+        fake.write_text("#!/bin/sh\necho 'put: failed' >&2\nexit 255\n")
+        fake.chmod(0o755)
+        cl = HDFSClient(str(home), time_out=2000)
+        assert cl._time_out_s == pytest.approx(2.0)
+        with pytest.raises(ExecuteError, match="put: failed"):
+            cl.upload(str(tmp_path / "x"), "/dst")
+        with pytest.raises(ExecuteError):
+            cl.mkdirs("/some/dir")
+        # non-mutating probes still return False instead of raising
+        assert not cl.is_exist("/whatever")
+
     def test_metrics(self):
         from paddle_tpu.distributed.fleet import metrics as M
         assert M.sum(np.array(3.0)) == 3.0
@@ -550,6 +569,68 @@ class TestDatasets:
         assert img.shape == (3, 64, 64) and mask.shape == (64, 64)
         assert mask.max() <= 20
 
+    def test_flowers_real_archive(self, tmp_path):
+        """Explicit data_file/label_file/setid_file must be honored (real
+        archive layout: jpg/image_%05d.jpg tgz + .mat labels/setid)."""
+        import tarfile
+
+        import scipy.io as sio
+        from PIL import Image
+        from paddle_tpu.vision.datasets import Flowers
+        tgz = str(tmp_path / "102flowers.tgz")
+        with tarfile.open(tgz, "w:gz") as tf:
+            for i in range(1, 5):
+                p = str(tmp_path / f"image_{i:05d}.jpg")
+                Image.fromarray(
+                    np.full((8, 8, 3), i * 20, np.uint8)).save(p)
+                tf.add(p, arcname=f"jpg/image_{i:05d}.jpg")
+        labels = str(tmp_path / "imagelabels.mat")
+        setid = str(tmp_path / "setid.mat")
+        sio.savemat(labels, {"labels": np.array([[5, 6, 7, 8]])})
+        sio.savemat(setid, {"trnid": np.array([[1, 2]]),
+                            "valid": np.array([[3]]),
+                            "tstid": np.array([[4]])})
+        ds = Flowers(data_file=tgz, label_file=labels, setid_file=setid,
+                     mode="test")
+        assert not ds.synthetic and len(ds) == 1
+        img, label = ds[0]
+        assert img.shape == (3, 8, 8) and label == 7  # 1-based 8 -> 0-based
+        assert abs(float(img[0, 0, 0]) - 80 / 255.0) < 1e-5
+        import pytest as _pytest
+        with _pytest.raises(FileNotFoundError):
+            Flowers(data_file=str(tmp_path / "missing.tgz"),
+                    label_file=labels, setid_file=setid)
+        with _pytest.raises(ValueError):
+            Flowers(data_file=tgz)  # partial explicit args
+
+    def test_voc2012_real_archive(self, tmp_path):
+        import tarfile
+
+        from PIL import Image
+        from paddle_tpu.vision.datasets import VOC2012
+        root = tmp_path / "VOCdevkit" / "VOC2012"
+        (root / "ImageSets" / "Segmentation").mkdir(parents=True)
+        (root / "JPEGImages").mkdir()
+        (root / "SegmentationClass").mkdir()
+        (root / "ImageSets" / "Segmentation" / "train.txt").write_text(
+            "img_a\nimg_b\n")
+        (root / "ImageSets" / "Segmentation" / "val.txt").write_text(
+            "img_b\n")
+        for name, v in [("img_a", 30), ("img_b", 60)]:
+            Image.fromarray(np.full((6, 6, 3), v, np.uint8)).save(
+                str(root / "JPEGImages" / f"{name}.jpg"))
+            Image.fromarray(np.full((6, 6), v // 10, np.uint8)).save(
+                str(root / "SegmentationClass" / f"{name}.png"))
+        tar = str(tmp_path / "voc.tar")
+        with tarfile.open(tar, "w") as tf:
+            tf.add(str(tmp_path / "VOCdevkit"), arcname="VOCdevkit")
+        ds = VOC2012(data_file=tar, mode="train")
+        assert not ds.synthetic and len(ds) == 2
+        img, mask = ds[0]
+        assert img.shape == (3, 6, 6) and mask.shape == (6, 6)
+        assert int(mask[0, 0]) == 3
+        assert len(VOC2012(data_file=tar, mode="valid")) == 1
+
 
 class TestFleetUtilsHelpers:
     """pp_parallel_adaptor (SURVEY §5.4 ckpt conversion tool) +
@@ -626,6 +707,56 @@ class TestFleetUtilsHelpers:
         opt.step()
         assert not np.allclose(w0, np.asarray(lin.weight.numpy()))
         assert lin.weight.main_grad is None
+
+    def test_mix_precision_bf16_param_steps_from_fp32_grad(self):
+        """O2 contract: the inner optimizer must see the fp32 main_grad
+        unchanged, not a copy rounded back to the bf16 param dtype."""
+        from paddle_tpu.distributed.fleet.utils.mix_precision_utils import (
+            MixPrecisionLayer, MixPrecisionOptimizer)
+        lin = nn.Linear(4, 2)
+        for p in lin.parameters():
+            p.data = p.data.astype("bfloat16")
+        wrapped = MixPrecisionLayer(lin, dtype="bfloat16")
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=lin.parameters())
+        seen = {}
+        orig_step = inner.step
+
+        def spy_step():
+            seen["grad_dtype"] = str(lin.weight.grad.dtype)
+            return orig_step()
+
+        inner.step = spy_step
+        opt = MixPrecisionOptimizer(inner)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32)).astype("bfloat16")
+        wrapped(x).sum().backward()
+        assert str(lin.weight.main_grad.dtype).endswith("float32")
+        opt.step()
+        assert seen["grad_dtype"].endswith("float32")
+
+    def test_sgd_bf16_param_fp32_update_math(self, monkeypatch):
+        """SGD without master weights must run its update math in fp32 (the
+        fp32 main_grad applied at full precision, one rounding at
+        write-back) — the old path downcast the grad to bf16 first."""
+        import jax.numpy as jnp
+        import paddle_tpu.optimizer.optimizers as O
+        from paddle_tpu.core.tensor import Tensor
+        seen = {}
+        orig = O._sgd_update
+
+        def spy(p, g, lr):
+            seen["p"], seen["g"] = str(p.dtype), str(g.dtype)
+            return orig(p, g, lr)
+
+        monkeypatch.setattr(O, "_sgd_update", spy)
+        w = paddle.to_tensor(np.zeros((1,), np.float32),
+                             stop_gradient=False).astype("bfloat16")
+        opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[w])
+        w.grad = Tensor(jnp.array([257.0], jnp.float32))
+        opt.step()
+        assert seen == {"p": "float32", "g": "float32"}
+        # single final rounding: bf16(-0.5 * 257) == -128 (tie-to-even)
+        assert float(np.asarray(w.numpy(), np.float32)[0]) == -128.0
 
 
 class TestQuantizedFusedPaths:
