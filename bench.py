@@ -30,17 +30,19 @@ def main():
             num_key_value_heads=16, max_position_embeddings=2048,
             dtype="bfloat16", fuse_attention_qkv=True,
             fuse_attention_ffn=True)
-        # measured on this chip (v5e, 16GB). Round-4 re-bisect of the
-        # round-3 0.530 -> 0.521 "regression": the SAME compiled program
-        # spreads 34.8k-35.8k tok/s across same-day runs (tunnel/host
-        # variance ~3%), which brackets both prior rounds' numbers — no
-        # code regression. Round-4 matrix (tok/s, 40-iter runs):
-        #   bs8 plain 35.4k | bs8 fused qkv+ffn 35.8k (best)
-        #   bs8 fused proj+CE 35.5k | bs10 fused proj+CE 33.9k
-        #   bs12 fused CE 34.6k
+        # measured on this chip (v5e, 16GB). Round-5: the device profile
+        # (tools/step_profile.py) showed the step was never memory-bound
+        # (42% aggregate HBM BW) — 39% of device time was the flash
+        # attention custom-calls. Fixing the kernels (bf16 MXU operands
+        # instead of f32 upcasts; 2048x2048 fwd tiles under a raised
+        # scoped-VMEM limit) took the same program 34.8k -> 36.7k tok/s
+        # (MFU 0.503 -> 0.531) same-day. Round-5 matrix (tok/s):
+        #   bs8 fused qkv+ffn 36.7k (best) | bs8 +pallas-CE 36.4k
+        #   bs12 35.1k | bs12 +pallas-CE 34.7k | bs16 +pallas-CE 33.9k
         # step temp memory is 11.2GB + 4.5GB donated args on a 16GB chip:
-        # XLA implicit remat is active and is the binding constraint
-        # (round-2 matrix: every remat-heavier config is slower).
+        # XLA implicit remat is active; remat pressure is why bigger
+        # batches lose even with the blockwise-CE kernel freeing the
+        # [B,S,V] logits (ops/pallas/blockwise_ce.py, fused_lm_loss=True).
         batch, seq, iters, warmup = 8, 2048, 20, 3
     else:  # CPU smoke so the driver always gets a line
         cfg = LlamaConfig.tiny(dtype="float32")

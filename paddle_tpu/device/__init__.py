@@ -201,14 +201,14 @@ class Event:
 
     def record(self, stream=None):
         import time as _time
-        jax.effects_barrier()
+        synchronize()
         self._t = _time.perf_counter()
 
     def query(self):
         return True
 
     def synchronize(self):
-        jax.effects_barrier()
+        synchronize()
 
     def elapsed_time(self, end_event):
         if self._t is None or end_event._t is None:
@@ -248,7 +248,14 @@ class stream_guard:
 
 
 def synchronize(device=None):
-    jax.effects_barrier()
+    """Drain the device queue. XLA dispatch is async; PJRT executes
+    computations per device in enqueue order, so blocking on a fresh
+    trivial computation committed to the device drains everything enqueued
+    before it. (jax.effects_barrier only waits for EFFECTFUL computations
+    and would under-wait pure async dispatch — wrong for timing code.)"""
+    d = _dev(device)
+    x = jax.device_put(jax.numpy.zeros((), jax.numpy.float32), d)
+    jax.block_until_ready(jax.jit(lambda v: v + 1)(x))
 
 
 # -- donation bookkeeping ----------------------------------------------------

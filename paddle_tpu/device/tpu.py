@@ -16,14 +16,8 @@ __all__ = [
 ]
 
 
-def synchronize(device_id=None):
-    """Drain the device queue. XLA dispatch is async; PJRT executes
-    computations per device in enqueue order, so blocking on a fresh
-    trivial computation committed to the device drains everything
-    enqueued before it."""
-    d = _dev(device_id)
-    x = jax.device_put(jax.numpy.zeros((), jax.numpy.float32), d)
-    jax.block_until_ready(jax.jit(lambda v: v + 1)(x))
+# the one queue-draining synchronize lives at the package level; re-export
+from . import synchronize  # noqa: F401,E402
 
 
 def max_memory_reserved(device_id=None):

@@ -149,6 +149,12 @@ class HDFSClient(_FS):
             raise RuntimeError(
                 f"hadoop binary not found under {self._hadoop_home} "
                 "(HDFS is unavailable in this environment)") from e
+        except subprocess.TimeoutExpired as e:
+            # timeouts flow through the same failure channel as nonzero
+            # exits so checkpoint code catching ExecuteError sees both
+            raise ExecuteError(
+                f"{' '.join(cmd)} timed out after {self._time_out_s:.0f}s"
+            ) from e
         if check and out.returncode != 0:
             raise ExecuteError(
                 f"{' '.join(cmd)} exited {out.returncode}: "

@@ -306,15 +306,34 @@ class LlamaForCausalLM(nn.Layer):
 
 
 def fused_lm_head_loss(hidden_states, weight, labels, ignore_index=-100,
-                       chunk_tokens=1024):
-    """Chunked LM-head + cross-entropy: lax.scan over token chunks with a
-    checkpointed body, so only one chunk's [chunk, V] logits live at a time
-    (fwd AND bwd — the transpose of the scan recomputes per chunk).
-    The reference reaches the same memory profile via its fused
-    softmax-cross-entropy CUDA kernels (c_softmax_with_cross_entropy)."""
+                       chunk_tokens=1024, mode=None):
+    """Fused LM-head + cross-entropy; [B, S, V] logits never materialize.
+
+    mode='pallas' (default on TPU): the blockwise Pallas kernel
+    (ops/pallas/blockwise_ce.py) — one MXU pass per (token, vocab) tile
+    folded into an online logsumexp, custom_vjp backward that recomputes
+    tiles and contracts them in VMEM. mode='scan' (default elsewhere):
+    lax.scan over token chunks with a checkpointed body, one chunk's
+    [chunk, V] logits at a time. The reference reaches the same memory
+    profile via its fused softmax-cross-entropy CUDA kernels
+    (c_softmax_with_cross_entropy_op.cu)."""
     import jax
     import jax.numpy as jnp
     from ..core.dispatch import apply_op
+    from ..ops.pallas import blockwise_ce as _bce
+
+    if mode is None:
+        mode = ("pallas" if jax.devices()[0].platform == "tpu"
+                or _bce._INTERPRET else "scan")
+
+    def impl_pallas(h, w, lab):
+        b, s, hid = h.shape
+        t = b * s
+        loss = _bce.blockwise_lm_head_ce(
+            h.reshape(t, hid), w.astype(h.dtype), lab.reshape(t),
+            ignore_index)
+        cnt = jnp.sum((lab.reshape(t) != ignore_index).astype(jnp.float32))
+        return jnp.sum(loss) / jnp.maximum(cnt, 1.0)
 
     def impl(h, w, lab):
         b, s, hid = h.shape
@@ -345,7 +364,8 @@ def fused_lm_head_loss(hidden_states, weight, labels, ignore_index=-100,
             (hs, ls))
         return tot / jnp.maximum(cnt, 1.0)
 
-    return apply_op("fused_lm_head_loss", impl,
+    return apply_op("fused_lm_head_loss",
+                    impl_pallas if mode == "pallas" else impl,
                     (hidden_states, weight, labels), {})
 
 

@@ -1,0 +1,321 @@
+"""Blockwise LM-head cross-entropy as Pallas TPU kernels.
+
+Reference analogue: the fused softmax/cross-entropy kernel class —
+paddle/fluid/operators/collective/c_softmax_with_cross_entropy_op.cu and
+paddle/phi/kernels/fusion/gpu/fused_softmax_mask_kernel.cu — which exists
+for the same reason: at LLM vocab sizes the [tokens, vocab] logits tensor
+is the largest single HBM consumer of a pretrain step (bs8 x 2048 x 32000
+bf16 = 1 GB per materialization, several per step with softmax + backward).
+
+Design (TPU-first, not a CUDA port): the LM-head projection and the
+cross-entropy are ONE kernel. Hidden states stream through VMEM in
+(block_t x H) tiles, weight columns in (H x block_v) tiles; each grid step
+computes a (block_t x block_v) logits tile on the MXU in f32 and folds it
+into an online logsumexp (running max / scaled sum, exactly flash
+attention's softmax recurrence) plus the gold-label logit gathered by an
+in-tile iota compare. The full logits tensor NEVER exists in HBM — fwd or
+bwd. Backward recomputes logits tiles and contracts them immediately:
+a t-major pass accumulates dh in VMEM scratch, a v-major pass accumulates
+dw, both rounding only on the final write.
+
+Saved residual is one [8, T] f32 logsumexp strip (lane-major layout, same
+trick as flash_attention.py's lse) — 0.5 MB where the naive path saves the
+1 GB logits.
+
+Numerics: logits accumulate in f32 on the MXU (preferred_element_type);
+loss and lse are f32 end to end. bf16 inputs round only where the unfused
+path also rounds (the h @ w multiply itself).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .tuning import cparams as _cparams
+
+LANES = 128
+STRIP = 8          # f32 sublane tile: [STRIP, T] layout for lse/loss strips
+NEG_INF = -1e30
+
+DEFAULT_BLOCK_T = 512
+DEFAULT_BLOCK_V = 2048
+DEFAULT_BWD_BLOCK_V = 1024  # dw keeps an [H, block_v] f32 VMEM accumulator
+
+_INTERPRET = False  # tests flip this to run on CPU
+
+
+def _interpret():
+    return _INTERPRET
+
+
+# ---------------------------------------------------------------------------
+# forward: loss[t] = lse[t] - logit[t, label[t]]  (0 where label == ignore)
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(lab_ref, h_ref, w_ref, loss_ref, lse_ref,
+                m_scr, l_scr, g_scr, *, block_t, block_v, vocab, ignore):
+    vi = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        g_scr[...] = jnp.zeros_like(g_scr)
+
+    h = h_ref[...]                                   # [BT, H] bf16
+    w = w_ref[...]                                   # [H, BV] bf16
+    logits = jax.lax.dot_general(
+        h, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [BT, BV] f32
+    v_start = vi * block_v
+    cols = v_start + jax.lax.broadcasted_iota(jnp.int32,
+                                              (block_t, block_v), 1)
+    if vocab % block_v:
+        logits = jnp.where(cols < vocab, logits, NEG_INF)
+
+    # online logsumexp (flash softmax recurrence over vocab tiles)
+    m_prev = m_scr[:, :1]                            # [BT, 1]
+    m_cur = jnp.max(logits, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = corr * l_scr[:, :1] + jnp.sum(jnp.exp(logits - m_new), axis=1,
+                                          keepdims=True)
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    # gold logit: the label's column, when it falls inside this vocab tile
+    lab = lab_ref[0]                                 # [BT] int32
+    hit = (cols == lab[:, None]).astype(jnp.float32)
+    # masked logits are finite only where cols < vocab; labels < vocab
+    gold_part = jnp.sum(jnp.where(hit > 0, logits, 0.0), axis=1,
+                        keepdims=True)
+    g_scr[...] = g_scr[...] + jnp.broadcast_to(gold_part, g_scr.shape)
+
+    @pl.when(vi == nv - 1)
+    def _final():
+        l = l_scr[:, :1]
+        lse = m_scr[:, :1] + jnp.log(jnp.where(l == 0.0, 1.0, l))
+        keep = lab[:, None] != ignore  # 2-D compare: mosaic can't reshape i1
+        loss = jnp.where(keep, lse - g_scr[:, :1], 0.0)
+        loss_ref[0] = jnp.broadcast_to(loss[:, 0][None, :],
+                                       loss_ref.shape[1:])
+        lse_ref[0] = jnp.broadcast_to(lse[:, 0][None, :],
+                                      lse_ref.shape[1:])
+
+
+def _pad_tokens(h, labels, bt, ignore):
+    """Pad the token axis to a block multiple: padded rows carry
+    ignore_index so they contribute zero loss AND zero dw (Pallas reads of
+    a block past the array edge are undefined — never rely on them)."""
+    t = h.shape[0]
+    pad = -t % bt
+    if pad:
+        h = jnp.pad(h, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad), constant_values=ignore)
+    return h, labels
+
+
+def _ce_fwd(h, w, labels, ignore, block_t, block_v):
+    """h [T, H], w [H, V], labels [T] -> (loss [T] f32, lse [T] f32)."""
+    t0, hid = h.shape
+    bt = min(block_t, t0)
+    h, labels = _pad_tokens(h, labels, bt, ignore)
+    t = h.shape[0]
+    vocab = w.shape[1]
+    nt = t // bt
+    nv = -(-vocab // block_v)
+    lab2 = labels.reshape(1, t)
+    loss8, lse8 = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_t=bt, block_v=block_v,
+                          vocab=vocab, ignore=ignore),
+        grid=(nt, nv),
+        in_specs=[
+            pl.BlockSpec((1, bt), lambda ti, vi: (0, ti)),
+            pl.BlockSpec((bt, hid), lambda ti, vi: (ti, 0)),
+            pl.BlockSpec((hid, block_v), lambda ti, vi: (0, vi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, STRIP, bt), lambda ti, vi: (0, 0, ti)),
+            pl.BlockSpec((1, STRIP, bt), lambda ti, vi: (0, 0, ti)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, STRIP, nt * bt), jnp.float32),
+            jax.ShapeDtypeStruct((1, STRIP, nt * bt), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bt, LANES), jnp.float32),   # running max
+            pltpu.VMEM((bt, LANES), jnp.float32),   # running sumexp
+            pltpu.VMEM((bt, LANES), jnp.float32),   # gold accumulator
+        ],
+        interpret=_interpret(),
+        compiler_params=_cparams(),
+    )(lab2, h, w)
+    return loss8[0, 0, :t0], lse8[0, 0, :t0]
+
+
+# ---------------------------------------------------------------------------
+# backward: dlogits = g[t] * (softmax - onehot(label)); dh = dlogits @ w.T,
+# dw = h.T @ dlogits — two passes with opposite loop majors so each
+# accumulator lives in VMEM across its whole reduction.
+# ---------------------------------------------------------------------------
+
+def _tile_dlogits(h, w, lab, g, lse, vi, block_t, block_v, vocab, ignore):
+    logits = jax.lax.dot_general(
+        h, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [BT, BV]
+    cols = vi * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, 1)
+    p = jnp.exp(logits - lse[:, None])               # softmax tile
+    if vocab % block_v:
+        p = jnp.where(cols < vocab, p, 0.0)
+    hit = (cols == lab[:, None]).astype(jnp.float32)
+    scale = jnp.where(lab[:, None] == ignore, 0.0, g[:, None])
+    return (p - hit) * scale                         # [BT, BV] f32
+
+
+def _dh_kernel(lab_ref, g_ref, lse_ref, h_ref, w_ref, dh_ref, acc, *,
+               block_t, block_v, vocab, ignore):
+    vi = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+
+    w = w_ref[...]
+    if vocab % block_v:
+        # zero the past-the-edge weight columns: the block past V reads
+        # undefined memory, and 0 * NaN would poison the contraction even
+        # though dl is zeroed there
+        wcols = vi * block_v + jax.lax.broadcasted_iota(jnp.int32,
+                                                        w.shape, 1)
+        w = jnp.where(wcols < vocab, w, 0)
+    dl = _tile_dlogits(h_ref[...], w, lab_ref[0],
+                       g_ref[0][0], lse_ref[0][0], vi,
+                       block_t, block_v, vocab, ignore)
+    # dh += dlogits @ w.T  -> contract the vocab axis
+    acc[...] = acc[...] + jax.lax.dot_general(
+        dl, w.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(vi == nv - 1)
+    def _final():
+        dh_ref[...] = acc[...].astype(dh_ref.dtype)
+
+
+def _dw_kernel(lab_ref, g_ref, lse_ref, h_ref, w_ref, dw_ref, acc, *,
+               block_t, block_v, vocab, ignore):
+    ti = pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    @pl.when(ti == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+
+    dl = _tile_dlogits(h_ref[...], w_ref[...], lab_ref[0],
+                       g_ref[0][0], lse_ref[0][0],
+                       pl.program_id(0), block_t, block_v, vocab, ignore)
+    # dw += h.T @ dlogits -> contract the token axis
+    acc[...] = acc[...] + jax.lax.dot_general(
+        h_ref[...].astype(jnp.float32), dl, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ti == nt - 1)
+    def _final():
+        dw_ref[...] = acc[...].astype(dw_ref.dtype)
+
+
+def _pad_strip(x, t):
+    pad = t - x.shape[0]
+    return jnp.pad(x, (0, pad)) if pad else x
+
+
+def _ce_bwd_dh(h, w, labels, g, lse, ignore, block_t, block_v):
+    t0, hid = h.shape
+    bt = min(block_t, t0)
+    h, labels = _pad_tokens(h, labels, bt, ignore)
+    t = h.shape[0]
+    vocab = w.shape[1]
+    nt, nv = t // bt, -(-vocab // block_v)
+    strip = lambda x: _pad_strip(x, t).reshape(1, 1, t)
+    return pl.pallas_call(
+        functools.partial(_dh_kernel, block_t=bt, block_v=block_v,
+                          vocab=vocab, ignore=ignore),
+        grid=(nt, nv),
+        in_specs=[
+            pl.BlockSpec((1, bt), lambda ti, vi: (0, ti)),
+            pl.BlockSpec((1, 1, bt), lambda ti, vi: (0, 0, ti)),
+            pl.BlockSpec((1, 1, bt), lambda ti, vi: (0, 0, ti)),
+            pl.BlockSpec((bt, hid), lambda ti, vi: (ti, 0)),
+            pl.BlockSpec((hid, block_v), lambda ti, vi: (0, vi)),
+        ],
+        out_specs=pl.BlockSpec((bt, hid), lambda ti, vi: (ti, 0)),
+        out_shape=jax.ShapeDtypeStruct((nt * bt, hid), h.dtype),
+        scratch_shapes=[pltpu.VMEM((bt, hid), jnp.float32)],
+        interpret=_interpret(),
+        compiler_params=_cparams(),
+    )(labels.reshape(1, t), strip(g), strip(lse), h, w)[:t0]
+
+
+def _ce_bwd_dw(h, w, labels, g, lse, ignore, block_t, block_v):
+    t0, hid = h.shape
+    bt = min(block_t, t0)
+    h, labels = _pad_tokens(h, labels, bt, ignore)
+    t = h.shape[0]
+    vocab = w.shape[1]
+    nt, nv = t // bt, -(-vocab // block_v)
+    strip = lambda x: _pad_strip(x, t).reshape(1, 1, t)
+    return pl.pallas_call(
+        functools.partial(_dw_kernel, block_t=bt, block_v=block_v,
+                          vocab=vocab, ignore=ignore),
+        grid=(nv, nt),   # vocab-major: dw tile accumulates across tokens
+        in_specs=[
+            pl.BlockSpec((1, bt), lambda vi, ti: (0, ti)),
+            pl.BlockSpec((1, 1, bt), lambda vi, ti: (0, 0, ti)),
+            pl.BlockSpec((1, 1, bt), lambda vi, ti: (0, 0, ti)),
+            pl.BlockSpec((bt, hid), lambda vi, ti: (ti, 0)),
+            pl.BlockSpec((hid, block_v), lambda vi, ti: (0, vi)),
+        ],
+        out_specs=pl.BlockSpec((hid, block_v), lambda vi, ti: (0, vi)),
+        out_shape=jax.ShapeDtypeStruct((hid, nv * block_v), w.dtype),
+        scratch_shapes=[pltpu.VMEM((hid, block_v), jnp.float32)],
+        interpret=_interpret(),
+        compiler_params=_cparams(),
+    )(labels.reshape(1, t), strip(g), strip(lse), h, w)[:, :vocab]
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def blockwise_lm_head_ce(h, w, labels, ignore_index=-100,
+                         block_t=DEFAULT_BLOCK_T, block_v=DEFAULT_BLOCK_V,
+                         bwd_block_v=None):
+    """Per-token cross entropy of the LM head, logits never materialized.
+
+    h [T, H] (bf16/f32), w [H, V], labels [T] int32 -> loss [T] f32.
+    Tokens with label == ignore_index get loss 0 and zero gradient.
+    """
+    loss, _ = _ce_fwd(h, w, labels, ignore_index, block_t, block_v)
+    return loss
+
+
+def _vjp_fwd(h, w, labels, ignore_index, block_t, block_v, bwd_block_v):
+    loss, lse = _ce_fwd(h, w, labels, ignore_index, block_t, block_v)
+    return loss, (h, w, labels, lse)
+
+
+def _vjp_bwd(ignore_index, block_t, block_v, bwd_block_v, res, g):
+    h, w, labels, lse = res
+    g = g.astype(jnp.float32)
+    bv = bwd_block_v or DEFAULT_BWD_BLOCK_V
+    dh = _ce_bwd_dh(h, w, labels, g, lse, ignore_index, block_t, bv)
+    dw = _ce_bwd_dw(h, w, labels, g, lse, ignore_index, block_t, bv)
+    return dh, dw, None
+
+
+blockwise_lm_head_ce.defvjp(_vjp_fwd, _vjp_bwd)

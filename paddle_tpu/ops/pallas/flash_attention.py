@@ -28,9 +28,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 1024  # measured on v5e: (1024, 2048) is ~25% faster than
-DEFAULT_BLOCK_K = 2048  # (512, 1024) on the 2k-seq llama step, which itself
-                        # was ~3.4x over (128, 128) and beat the stock kernel
+from .tuning import cparams as _cparams
+
+DEFAULT_BLOCK_Q = 2048  # round-5 on v5e (bf16 dot operands): fwd device
+DEFAULT_BLOCK_K = 2048  # time 1.63 ms vs 2.2 ms at (1024, 2048); bwd tiles
+                        # are clamped separately in _flash_bwd
 LANES = 128
 LSE_LANES = 8  # one f32 sublane tile: smallest legal trailing dim
 NEG_INF = -1e30
@@ -69,9 +71,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
     k_start = ki * block_k
 
     def _update():
-        q = q_ref[0].astype(jnp.float32)            # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)            # [BK, D]
-        v = v_ref[0].astype(jnp.float32)            # [BK, D]
+        # dots take the NATIVE (bf16) operands with f32 accumulation: an
+        # f32 x f32 MXU pass runs at ~1/4 the bf16 rate on v5e, and this
+        # kernel is matmul-bound. Softmax math stays f32.
+        q = q_ref[0]                       # [BQ, D]
+        k = k_ref[0]                       # [BK, D]
+        v = v_ref[0]                       # [BK, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [BQ, BK]
@@ -93,7 +98,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         corr = jnp.exp(m_prev - m_new)               # [BQ, 1]
         l_new = corr * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
         acc[...] = acc[...] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -112,11 +117,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         lse = m_scr[:, :1] + jnp.log(jnp.where(l_scr[:, :1] == 0.0, 1.0,
                                                l_scr[:, :1]))
         # lse_ref block is [LSE_SUBLANES, block_q]: broadcast across sublanes
-        lse_ref[0] = jnp.broadcast_to(lse[:, 0][None, :], lse_ref.shape[1:])
+        lse_ref[0] = jnp.broadcast_to(lse[:, 0][None, :],
+                                      lse_ref.shape[1:])
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
-    """q,k,v: [BH, S, D] -> (o [BH, S, D], lse [BH, S, LANES])."""
+    """q,k,v: [BH, S, D] -> (o [BH, S, D], lse [BH, LSE_LANES, S])."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = _pick_block(sq, block_q)
@@ -149,6 +155,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, LANES), jnp.float32),
         ],
         interpret=_interpret_mode(),
+        compiler_params=_cparams(),
     )(q, k, v)
     return o, lse
 
@@ -171,12 +178,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
     k_start = ki * block_k
 
     def _update():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # bf16 dot operands / f32 accumulation (see _fwd_kernel note)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         o = o_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]                 # [BQ, 1]
+        lse = lse_ref[0, 0][:, None]              # [BQ, 1]
         delta = jnp.sum(do * o, axis=1, keepdims=True)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -193,11 +201,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
             s = jnp.where(cols < seq_len, s, NEG_INF)
         p = jnp.exp(s - lse)                         # [BQ, BK]
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)      # [BQ, BK]
         ds = p * (dp - delta) * scale
         dq_acc[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -226,12 +234,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     k_start = ki * block_k
 
     def _update():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # bf16 dot operands / f32 accumulation (see _fwd_kernel note)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         o = o_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]                 # [BQ, 1]
+        lse = lse_ref[0, 0][:, None]              # [BQ, 1]
         delta = jnp.sum(do * o, axis=1, keepdims=True)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -248,14 +257,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
             s = jnp.where(cols < seq_len, s, NEG_INF)
         p = jnp.exp(s - lse)                         # [BQ, BK]
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(q.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # [BK, D]
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale                # [BQ, BK]
         dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # [BK, D]
 
     if causal:
@@ -269,12 +278,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
-    # the backward kernels stream ~3x the operands of the forward (q, k, v,
-    # o, do + accumulators), so large forward tiles blow the scoped-VMEM
-    # budget; clamp to the measured-safe backward tile sizes
-    block_q = min(block_q, 512)
-    block_k = min(block_k, 1024)
+def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
+               bwd_block_q=None, bwd_block_k=None):
+    block_q = bwd_block_q or min(block_q, 512)
+    block_k = bwd_block_k or min(block_k, 1024)
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = _pick_block(sq, block_q)
@@ -298,6 +305,7 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret_mode(),
+        compiler_params=_cparams(),
     )(q, k, v, o, do, lse)
 
     dk, dv = pl.pallas_call(
@@ -325,29 +333,33 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_interpret_mode(),
+        compiler_params=_cparams(),
     )(q, k, v, o, do, lse)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
-# custom_vjp wrapper ([BH, S, D] layout)
+# custom_vjp wrapper ([B, S, H, D] native layout)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k,
+           bwd_block_q=None, bwd_block_k=None):
     o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k,
+                   bwd_block_q, bwd_block_k):
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
     return o, (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, res, do):
+def _flash_vjp_bwd(scale, causal, block_q, block_k, bwd_block_q,
+                   bwd_block_k, res, do):
     q, k, v, o, lse = res
     dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, scale, causal,
-                            block_q, block_k)
+                            block_q, block_k, bwd_block_q, bwd_block_k)
     return dq, dk, dv
 
 
@@ -355,8 +367,14 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention_bhsd(q, k, v, causal=True, scale=None,
-                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """q,k,v: [B, H, S, D] (kv heads already matched to q heads)."""
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                         bwd_block_q=None, bwd_block_k=None):
+    """q,k,v: [B, H, S, D] (kv heads already matched to q heads).
+
+    (A round-5 experiment moved the kernels to 4-D [B, H, S, D] blocks with
+    GQA in the index maps; the isolated kernel was equally fast but the
+    surrounding XLA fusions regressed the full pretrain step by ~10%, so
+    the collapsed [BH, S, D] contract stays.)"""
     b, h, s, d = q.shape
     sk = k.shape[2]
     if scale is None:
@@ -364,14 +382,16 @@ def flash_attention_bhsd(q, k, v, causal=True, scale=None,
     qf = q.reshape(b * h, s, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
-    o = _flash(qf, kf, vf, float(scale), bool(causal), block_q, block_k)
+    o = _flash(qf, kf, vf, float(scale), bool(causal), block_q, block_k,
+               bwd_block_q, bwd_block_k)
     return o.reshape(b, h, s, d)
 
 
 def flash_attention_bshd(q, k, v, causal=True, scale=None,
-                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """q,k,v: [B, S, H, D] (paddle flash_attention layout). GQA: kv heads are
-    broadcast up to the query head count."""
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                         bwd_block_q=None, bwd_block_k=None):
+    """q,k,v: [B, S, H, D] (paddle flash_attention layout). GQA: kv heads
+    are broadcast up to the query head count."""
     hq, hk = q.shape[2], k.shape[2]
     if hk != hq:
         rep = hq // hk
@@ -379,7 +399,8 @@ def flash_attention_bshd(q, k, v, causal=True, scale=None,
         v = jnp.repeat(v, rep, axis=2)
     o = flash_attention_bhsd(
         jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
-        causal=causal, scale=scale, block_q=block_q, block_k=block_k)
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        bwd_block_q=bwd_block_q, bwd_block_k=bwd_block_k)
     return jnp.swapaxes(o, 1, 2)
 
 

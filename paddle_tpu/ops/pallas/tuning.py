@@ -1,0 +1,15 @@
+"""Shared Mosaic compiler tuning for the Pallas kernel tier.
+
+One scoped-VMEM budget for every kernel: v5e/v5p carry 128 MiB of
+physical VMEM, but Mosaic's default scoped limit is 16 MiB, which forces
+undersized tiles (measured round 5: the flash backward at 512/1024 tiles
+was the single largest consumer of the pretrain step). A per-chip knob —
+retune HERE, not per kernel, when targeting a part with less VMEM.
+"""
+from jax.experimental.pallas import tpu as pltpu
+
+VMEM_LIMIT = 100 * 1024 * 1024
+
+
+def cparams():
+    return pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT)

@@ -271,6 +271,9 @@ class Flowers(Dataset):
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode="train", transform=None, download=True, backend=None,
                  synthetic_size=None):
+        if mode not in self.MODE_KEY:
+            raise ValueError(f"mode must be one of "
+                             f"{sorted(self.MODE_KEY)}, got {mode!r}")
         self.transform = transform
         explicit = (data_file, label_file, setid_file)
         if any(explicit) and not all(explicit):
@@ -307,7 +310,7 @@ class Flowers(Dataset):
         import scipy.io as sio
         all_labels = sio.loadmat(label_file)["labels"].ravel()  # 1-based cls
         ids = sio.loadmat(setid_file)[
-            self.MODE_KEY.get(mode, "trnid")].ravel()  # 1-based image ids
+            self.MODE_KEY[mode]].ravel()  # 1-based image ids
         self._ids = ids.astype(np.int64)
         self.labels = (all_labels[ids - 1] - 1).astype(np.int64)
         cache_dir = data_file + ".extracted"
@@ -360,6 +363,9 @@ class VOC2012(Dataset):
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=True, backend=None, synthetic_size=None):
+        if mode not in self.MODE_LIST:
+            raise ValueError(f"mode must be one of "
+                             f"{sorted(self.MODE_LIST)}, got {mode!r}")
         self.transform = transform
         files = [data_file] if data_file else _find_cached(
             "voc2012", ["VOCtrainval_11-May-2012.tar"])
@@ -385,32 +391,38 @@ class VOC2012(Dataset):
         self.synthetic = True
 
     def _load_real(self, data_file, mode):
+        # Extract the split's files ONCE at construction (like Flowers): a
+        # lazily-shared TarFile handle would be unpicklable for spawn
+        # DataLoader workers and unsafe under the thread fallback.
         import tarfile
-        self._tar_path = data_file
-        self._tar = None
-        listname = self.MODE_LIST.get(mode, "train.txt")
+        listname = self.MODE_LIST[mode]
+        root = "VOCdevkit/VOC2012"
+        cache_dir = data_file + ".extracted"
         with tarfile.open(data_file) as tf:
-            root = "VOCdevkit/VOC2012"
             with tf.extractfile(
                     f"{root}/ImageSets/Segmentation/{listname}") as f:
                 names = [ln.strip() for ln in
                          f.read().decode().splitlines() if ln.strip()]
+            wanted = [f"{root}/JPEGImages/{n}.jpg" for n in names] + \
+                     [f"{root}/SegmentationClass/{n}.png" for n in names]
+            missing = [m for m in wanted if not os.path.exists(
+                os.path.join(cache_dir, m))]
+            if missing:
+                os.makedirs(cache_dir, exist_ok=True)
+                tf.extractall(cache_dir, members=[
+                    tf.getmember(m) for m in missing])
         self._names = names
-        self._root = root
+        self._dir = os.path.join(cache_dir, root)
 
     def _read_pair(self, name):
-        import tarfile
-
         from PIL import Image
-        if self._tar is None:
-            self._tar = tarfile.open(self._tar_path)
-        with self._tar.extractfile(
-                f"{self._root}/JPEGImages/{name}.jpg") as f:
-            img = np.asarray(Image.open(f).convert("RGB"),
+        with Image.open(os.path.join(self._dir, "JPEGImages",
+                                     f"{name}.jpg")) as im:
+            img = np.asarray(im.convert("RGB"),
                              np.float32).transpose(2, 0, 1) / 255.0
-        with self._tar.extractfile(
-                f"{self._root}/SegmentationClass/{name}.png") as f:
-            mask = np.asarray(Image.open(f), np.int64)
+        with Image.open(os.path.join(self._dir, "SegmentationClass",
+                                     f"{name}.png")) as im:
+            mask = np.asarray(im, np.int64)
         return img, mask
 
     def __getitem__(self, idx):

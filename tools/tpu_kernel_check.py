@@ -103,6 +103,29 @@ def main():
             refp[b, h] = p @ vals[kv]
     check("paged_decode", float(np.abs(np.asarray(out) - refp).max()), 2e-2)
 
+    # -- blockwise LM-head cross entropy (fwd + grads, bf16) -------------
+    from paddle_tpu.ops.pallas.blockwise_ce import blockwise_lm_head_ce
+    T, Hd, V = 1024, 256, 1000
+    hh = jnp.asarray(rng.standard_normal((T, Hd)), jnp.bfloat16)
+    ww = jnp.asarray(rng.standard_normal((Hd, V)) * 0.05, jnp.bfloat16)
+    lab = jnp.asarray(rng.integers(0, V, T), jnp.int32)
+
+    def ce_ref(h, w):
+        logits = jax.lax.dot(h, w, preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, lab[:, None], axis=-1)[:, 0]
+        return (lse - gold).mean()
+
+    lp = blockwise_lm_head_ce(hh, ww, lab, -100, 256, 512, 512).mean()
+    lr = ce_ref(hh, ww)
+    check("blockwise_ce_fwd", abs(float(lp) - float(lr)), 2e-2)
+    gp = jax.grad(lambda h, w: blockwise_lm_head_ce(
+        h, w, lab, -100, 256, 512, 512).mean(), argnums=(0, 1))(hh, ww)
+    gr2 = jax.grad(ce_ref, argnums=(0, 1))(hh, ww)
+    for nm, a, b in zip(("dh", "dw"), gp, gr2):
+        check(f"blockwise_ce_{nm}", float(jnp.abs(
+            a.astype(jnp.float32) - b.astype(jnp.float32)).max()), 2e-2)
+
     print(f"# {'ALL OK' if failures == 0 else f'{failures} FAILURES'}")
     return 1 if failures else 0
 
