@@ -19,17 +19,14 @@ def main():
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
 
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, pretrain
+    from paddle_tpu.models import LlamaForCausalLM, pretrain
 
+    # ~350M-param llama (bf16 compute, fp32 master weights) sized for a
+    # single chip — the SHARED flagship shape (pretrain.flagship_config);
+    # tools/step_profile.py profiles the identical step
+    cfg, batch, seq = pretrain.flagship_config(on_tpu)
     if on_tpu:
-        # ~350M-param llama (bf16 compute, fp32 master weights, per-layer
-        # remat) sized for a single chip
-        cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_hidden_layers=24, num_attention_heads=16,
-            num_key_value_heads=16, max_position_embeddings=2048,
-            dtype="bfloat16", fuse_attention_qkv=True,
-            fuse_attention_ffn=True)
+        iters, warmup = 20, 3
         # measured on this chip (v5e, 16GB). Round-5: the device profile
         # (tools/step_profile.py) showed the step was never memory-bound
         # (42% aggregate HBM BW) — 39% of device time was the flash
@@ -43,10 +40,8 @@ def main():
         # XLA implicit remat is active; remat pressure is why bigger
         # batches lose even with the blockwise-CE kernel freeing the
         # [B,S,V] logits (ops/pallas/blockwise_ce.py, fused_lm_loss=True).
-        batch, seq, iters, warmup = 8, 2048, 20, 3
     else:  # CPU smoke so the driver always gets a line
-        cfg = LlamaConfig.tiny(dtype="float32")
-        batch, seq, iters, warmup = 4, 64, 3, 1
+        iters, warmup = 3, 1
 
     model = LlamaForCausalLM(cfg)
     mesh = pretrain.make_mesh(1, dp=1, fsdp=1, mp=1, sp=1)

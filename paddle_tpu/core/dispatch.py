@@ -170,7 +170,7 @@ def _apply_op_inner(name, impl, args, kwargs, differentiable=True):
 
     if not record:
         a, k = tree_unflatten(treedef, plain)
-        out = impl(*a, **k)
+        out = _canon_out(impl(*a, **k))
         if _flags.check_nan_inf:
             _check_nan_inf(name, out)
         if _flags.benchmark_mode:
@@ -193,7 +193,7 @@ def _apply_op_inner(name, impl, args, kwargs, differentiable=True):
         for j, i in enumerate(diff_idx):
             nl[i] = diff_arrays[j]
         a, k = tree_unflatten(treedef, nl)
-        return impl(*a, **k)
+        return _canon_out(impl(*a, **k))
 
     diff_arrays = tuple(plain[i] for i in diff_idx)
     out, vjp_fn = _vjp_with_cache(name, impl, fn, treedef, plain, diff_idx,
@@ -323,8 +323,13 @@ def _vjp_sig(name, impl, treedef, plain, diff_idx, diff_arrays):
     avals = tuple((a.shape, str(a.dtype)) for a in diff_arrays)
     # key by the tuple itself, NOT its hash: dict equality then resolves
     # hash collisions (e.g. hash(-1) == hash(-2) for axis closure cells)
-    # instead of silently serving the wrong compiled executable
-    sig = (name, code, cells, treedef, tuple(consts), avals)
+    # instead of silently serving the wrong compiled executable.
+    # diff_idx MUST be part of the key: grad w.r.t. x and grad w.r.t. y of
+    # a binary op have identical shapes/consts but transpose different
+    # arguments — without it the cache served d/dx executables for d/dy
+    # (caught by tests/test_op_matrix.py).
+    sig = (name, code, cells, treedef, tuple(consts), avals,
+           tuple(diff_idx))
     try:
         hash(sig)
     except TypeError:
@@ -359,7 +364,7 @@ def _vjp_with_cache(name, impl, fn, treedef, plain, diff_idx, diff_arrays):
             for j, i in enumerate(diff_idx):
                 nl[i] = darrs[j]
             a, k = tree_unflatten(treedef, nl)
-            return impl(*a, **k)
+            return _canon_out(impl(*a, **k))
 
         def fwd(aux_vals, darrs):
             return make_fn(aux_vals, darrs)
@@ -398,6 +403,17 @@ def _vjp_with_cache(name, impl, fn, treedef, plain, diff_idx, diff_arrays):
         return _bwd(_aux, _d, ct)
 
     return out, vjp_fn
+
+
+
+def _canon_out(out):
+    """jnp APIs return NamedTuples (EighResult, QRResult, SlogdetResult...);
+    the tape hands cotangents back as plain tuples and jax.vjp demands the
+    EXACT output pytree — canonicalize tuple subclasses at the op boundary
+    so forward structure and backward cotangent structure always agree."""
+    if isinstance(out, (tuple, list)) and type(out) is not tuple:
+        return tuple(out)
+    return out
 
 
 def _wrap(name, out, node):

@@ -247,15 +247,23 @@ class stream_guard:
         return False
 
 
+@jax.jit
+def _drain_probe(v):
+    return v + 1
+
+
 def synchronize(device=None):
     """Drain the device queue. XLA dispatch is async; PJRT executes
     computations per device in enqueue order, so blocking on a fresh
     trivial computation committed to the device drains everything enqueued
     before it. (jax.effects_barrier only waits for EFFECTFUL computations
-    and would under-wait pure async dispatch — wrong for timing code.)"""
+    and would under-wait pure async dispatch — wrong for timing code.)
+    The probe is a module-level jitted fn: a per-call lambda would retrace
+    and recompile every call (~0.5 s each), poisoning what timing code
+    measures."""
     d = _dev(device)
     x = jax.device_put(jax.numpy.zeros((), jax.numpy.float32), d)
-    jax.block_until_ready(jax.jit(lambda v: v + 1)(x))
+    jax.block_until_ready(_drain_probe(x))
 
 
 # -- donation bookkeeping ----------------------------------------------------

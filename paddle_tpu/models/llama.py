@@ -325,6 +325,10 @@ def fused_lm_head_loss(hidden_states, weight, labels, ignore_index=-100,
     if mode is None:
         mode = ("pallas" if jax.devices()[0].platform == "tpu"
                 or _bce._INTERPRET else "scan")
+    if mode not in ("pallas", "scan"):
+        raise ValueError(
+            f"fused_lm_head_loss mode must be 'pallas' or 'scan', "
+            f"got {mode!r}")
 
     def impl_pallas(h, w, lab):
         b, s, hid = h.shape

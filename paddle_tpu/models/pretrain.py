@@ -25,7 +25,25 @@ from ..jit.functional import state_arrays, pure_call
 
 __all__ = ["llama_sharding_rules", "gpt_sharding_rules",
            "ernie_sharding_rules", "spec_for_param",
-           "make_train_state", "make_train_step", "make_mesh"]
+           "make_train_state", "make_train_step", "make_mesh",
+           "flagship_config"]
+
+
+def flagship_config(on_tpu=True):
+    """The headline benchmark shape: (LlamaConfig, batch, seq).
+
+    bench.py AND tools/step_profile.py build from HERE — the profile
+    evidence must always describe the step being benchmarked; the config
+    has been retuned every round, so a copy would silently drift."""
+    from .llama import LlamaConfig
+    if not on_tpu:  # CPU smoke
+        return LlamaConfig.tiny(dtype="float32"), 4, 64
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_hidden_layers=24, num_attention_heads=16,
+        num_key_value_heads=16, max_position_embeddings=2048,
+        dtype="bfloat16", fuse_attention_qkv=True, fuse_attention_ffn=True)
+    return cfg, 8, 2048
 
 
 # (name-regex, spec-template) — first match wins. Axis names are logical:

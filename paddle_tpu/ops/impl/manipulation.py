@@ -255,7 +255,9 @@ def scatter_nd(index, updates, shape):
 def index_add(x, index, axis, value):
     index = _arr(index)
     value = _arr(value)
-    sl = [slice(None)] * x.ndim
+    # builtins_slice, NOT slice: the `slice` op defined below shadows the
+    # builtin at module scope (caught by tests/test_op_matrix.py)
+    sl = [builtins_slice(None)] * x.ndim
     sl[axis] = index
     return x.at[tuple(sl)].add(value)
 

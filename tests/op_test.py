@@ -47,14 +47,20 @@ def _cotangent_for(out, seed=7):
 
 
 def numeric_grad(op, np_inputs, wrt, eps=1e-3, kwargs=None, ct=None):
-    """Central finite differences of <ct, op(...)> w.r.t. input `wrt`."""
+    """Central finite differences of <ct, op(...)> w.r.t. input `wrt`.
+    Float inputs are perturbed in f64; integer/bool inputs (indices,
+    masks) pass through with their dtype intact."""
     kwargs = kwargs or {}
-    base = [np.array(a, dtype=np.float64) for a in np_inputs]
+    base = [np.array(a, dtype=np.float64)
+            if np.asarray(a).dtype.kind == "f" else np.asarray(a)
+            for a in np_inputs]
 
     def f(x):
         args = list(base)
         args[wrt] = x
-        out = op(*[paddle.to_tensor(a.astype(np.float32)) for a in args],
+        out = op(*[paddle.to_tensor(a.astype(np.float32)
+                                    if a.dtype.kind == "f" else a)
+                   for a in args],
                  **kwargs)
         if isinstance(out, (tuple, list)):
             out = out[0]

@@ -98,19 +98,11 @@ def main():
     import jax
     import numpy as np
 
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, pretrain
+    from paddle_tpu.models import LlamaForCausalLM, pretrain
     on_tpu = jax.devices()[0].platform == "tpu"
-    if on_tpu:
-        cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_hidden_layers=24, num_attention_heads=16,
-            num_key_value_heads=16, max_position_embeddings=2048,
-            dtype="bfloat16", fuse_attention_qkv=True,
-            fuse_attention_ffn=True)
-        batch, seq = 8, 2048
-    else:
-        cfg = LlamaConfig.tiny(dtype="float32")
-        batch, seq = 4, 64
+    # the SAME flagship shape bench.py benchmarks — shared helper so the
+    # profile always describes the headline step
+    cfg, batch, seq = pretrain.flagship_config(on_tpu)
     model = LlamaForCausalLM(cfg)
     mesh = pretrain.make_mesh(1, dp=1, fsdp=1, mp=1, sp=1)
     params, opt_state, meta = pretrain.make_train_state(model, mesh)
