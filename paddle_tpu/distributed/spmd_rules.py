@@ -683,3 +683,143 @@ def fused_decoder_rule(*input_pls, **attrs):
     req = [p if (isinstance(p, Shard) and p.dim == 0) else
            (Replicate() if isinstance(p, Shard) else p) for p in first]
     return ([req] + [list(pl) for pl in input_pls[1:]], [list(req)])
+
+
+# ---------------------------------------------------------------------------
+# MoE dispatch/combine (round-4 verdict #5; reference
+# paddle/phi/infermeta/spmd_rules/moe_gate_dispatch.cc and moe_combine.cc).
+# Original Python re-derivation of the semantics:
+#   dispatch:  x [S, H], gate_logits [S, E] ->
+#              y [E, C, H], combine_weights [S, K], scatter_index [K, S],
+#              expert_offset [E], expert_id [S, K]
+#   combine:   y[i, j] = sum_k x[scatter_index[i, k], j] * cw[i, k]
+# ---------------------------------------------------------------------------
+
+@register_rule("moe_gate_dispatch")
+def moe_gate_dispatch_rule(x_pl, gate_pl, k=None, capacity=None,
+                           use_pad=True, **attrs):
+    """Token axis 's' merges across x/gate_logits; hidden 'h' rides x only;
+    expert 'e' rides gate_logits. The permuted output y [E, C, H] keeps h;
+    its token-capacity dim 'c' is fresh (replicated) — the dispatch scatter
+    crosses tokens, so an s-sharding cannot survive into y."""
+    n = len(x_pl)
+    x_req, g_req = [], []
+    y, cw, sidx, eoff, eid = ([Replicate() for _ in range(n)]
+                              for _ in range(5))
+    for a in range(n):
+        px, pg = x_pl[a], gate_pl[a]
+        s = None
+        if isinstance(px, Shard) and px.dim == 0:
+            s = px
+        elif isinstance(pg, Shard) and pg.dim == 0:
+            s = pg
+        h = px if isinstance(px, Shard) and px.dim == 1 else None
+        e = pg if isinstance(pg, Shard) and pg.dim == 1 else None
+        x_req.append(s or h or Replicate())
+        g_req.append(s or e or Replicate())
+        if s is not None:
+            cw[a], eid[a] = Shard(0), Shard(0)
+            sidx[a] = Shard(1)
+        elif h is not None:
+            y[a] = Shard(2)
+        elif e is not None:
+            y[a] = Shard(0)
+            eoff[a] = Shard(0)
+    return ([x_req, g_req], [y, cw, sidx, eoff, eid])
+
+
+@register_rule("moe_combine")
+def moe_combine_rule(x_pl, cw_pl, sidx_pl, **attrs):
+    """Merge 's' across combine_weights/scatter_index (and the gathered-x
+    row axis conservatively replicates: the gather crosses rows); 'h' from
+    x propagates; the reference forbids k and h sharded together — k
+    yields to h (moe_combine.cc:71)."""
+    n = len(x_pl)
+    y = [Replicate() for _ in range(n)]
+    x_req, cw_req, si_req = [], [], []
+    for a in range(n):
+        px, pc, ps = x_pl[a], cw_pl[a], sidx_pl[a]
+        h = px if isinstance(px, Shard) and px.dim == 1 else None
+        s = None
+        for p in (pc, ps):
+            if isinstance(p, Shard) and p.dim == 0:
+                s = p
+                break
+        kk = None
+        if h is None:
+            for p in (pc, ps):
+                if isinstance(p, Shard) and p.dim == 1:
+                    kk = p
+                    break
+        # x rows are a scatter permutation of tokens: require replicated
+        # rows, keep h
+        x_req.append(h or Replicate())
+        cw_req.append(s or kk or Replicate())
+        si_req.append(s or kk or Replicate())
+        if s is not None:
+            y[a] = Shard(0)
+        elif h is not None:
+            y[a] = Shard(1)
+        elif kk is not None:
+            y[a] = Partial("sum")
+    return ([x_req, cw_req, si_req], [y])
+
+
+# -- reference-parity aliases and small rules (round-5 parity gate) ---------
+
+RULE_TABLE["expand_as"] = RULE_TABLE["expand"]
+RULE_TABLE["c_embedding"] = RULE_TABLE["embedding"]
+RULE_TABLE["cross_entropy_with_softmax"] = RULE_TABLE["cross_entropy"]
+RULE_TABLE["c_softmax_with_cross_entropy"] = RULE_TABLE["cross_entropy"]
+RULE_TABLE["c_softmax_with_multi_label_cross_entropy"] = \
+    RULE_TABLE["cross_entropy"]
+RULE_TABLE["swiglu"] = elementwise_binary_rule
+RULE_TABLE["fused_dropout_add"] = elementwise_binary_rule
+
+
+@register_rule("add_n")
+def add_n_rule(*input_pls, **attrs):
+    """Element-wise N-ary sum: align all inputs on the first sharded
+    placement per mesh axis (reference add_n.cc)."""
+    n = len(input_pls[0])
+    req = []
+    for a in range(n):
+        p = next((pl[a] for pl in input_pls
+                  if isinstance(pl[a], Shard)), Replicate())
+        req.append(p)
+    return ([list(req) for _ in input_pls], [list(req)])
+
+
+@register_rule("squared_l2_norm")
+def squared_l2_norm_rule(x_pl, **attrs):
+    """Full reduction: any sharded input axis yields a Partial(sum) scalar
+    (reference squared_l2_norm.cc — the grad-clip global-norm building
+    block)."""
+    out = [Partial("sum") if isinstance(p, Shard) else Replicate()
+           for p in x_pl]
+    return ([list(x_pl)], [out])
+
+
+@register_rule("numel")
+def numel_rule(x_pl, **attrs):
+    """Scalar metadata: output replicated regardless of input sharding."""
+    return ([list(x_pl)], [[Replicate() for _ in x_pl]])
+
+
+@register_rule("default_data_parallel")
+def default_data_parallel_rule(*input_pls, **attrs):
+    """The reference's fallback rule (default_data_parallel.cc): keep a
+    batch (dim-0) sharding on every tensor, replicate everything else."""
+    def dp_only(pl):
+        return [p if (isinstance(p, Shard) and p.dim == 0)
+                else (Replicate() if isinstance(p, Shard) else p)
+                for p in pl]
+    reqs = [dp_only(pl) for pl in input_pls]
+    return (reqs, [list(reqs[0])])
+
+
+@register_rule("replicated")
+def replicated_rule(*input_pls, **attrs):
+    """The reference's all-replicated fallback (replicated.cc)."""
+    reqs = [[Replicate() for _ in pl] for pl in input_pls]
+    return (reqs, [list(reqs[0])])

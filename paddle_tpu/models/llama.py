@@ -384,3 +384,65 @@ class LlamaPretrainingCriterion(nn.Layer):
         return F.cross_entropy(
             logits.reshape([-1, v]), labels.reshape([-1]),
             reduction="mean")
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel model form (reference: paddlenlp LlamaForCausalLMPipe —
+# the model expressed as a flat PipelineLayer of descs, the form
+# fleet.distributed_model partitions into pp stages)
+# ---------------------------------------------------------------------------
+
+class LlamaEmbeddingPipe(nn.Layer):
+    """First pipeline element: ids -> hidden states (+ dtype cast)."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=_normal_attr(config))
+
+    def forward(self, input_ids):
+        h = self.embed_tokens(input_ids)
+        if self.config.dtype == "bfloat16":
+            h = h.astype("bfloat16")
+        return h
+
+
+class LlamaRMSNormHeadPipe(nn.Layer):
+    """Last pipeline element: final RMSNorm + LM head -> logits."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.norm = nn.RMSNorm(config.hidden_size,
+                               epsilon=config.rms_norm_eps)
+        self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                 weight_attr=_normal_attr(config),
+                                 bias_attr=False)
+
+    def forward(self, hidden_states):
+        return self.lm_head(self.norm(hidden_states))
+
+
+def LlamaForCausalLMPipe(config, num_stages, loss_fn=None):
+    """The llama model in PipelineLayer form (reference bar:
+    test/auto_parallel/hybrid_strategy/test_parallel_api_with_llama_3d.py
+    drives exactly this shape through the fleet API). The homogeneous
+    decoder blocks form the pipelined middle; embedding and norm+head are
+    the (heterogeneous) first/last elements — the compiled mesh trainer
+    runs those replicated outside the pp ring (TPU-first: their FLOPs are
+    negligible and GSPMD still shards them over dp/mp)."""
+    from ..distributed.fleet.pipeline_parallel import (LayerDesc,
+                                                      PipelineLayer)
+    descs = [LayerDesc(LlamaEmbeddingPipe, config)]
+    descs += [LayerDesc(LlamaDecoderLayer, config)
+              for _ in range(config.num_hidden_layers)]
+    descs += [LayerDesc(LlamaRMSNormHeadPipe, config)]
+    pipe = PipelineLayer(
+        layers=descs, num_stages=num_stages,
+        loss_fn=loss_fn or LlamaPretrainingCriterion())
+    # tp/fsdp shardings for the compiled mesh trainer (parameter-name
+    # rules; fleet.distributed_model reads this attribute)
+    from .pretrain import llama_sharding_rules
+    pipe._shard_rules = llama_sharding_rules()
+    return pipe

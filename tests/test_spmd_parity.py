@@ -1,0 +1,120 @@
+"""SPMD-rule parity gate (round-4 verdict #5).
+
+Enumerates the reference's rule files
+(/root/reference/paddle/phi/infermeta/spmd_rules/*.cc) and asserts every
+one maps to a RULE_TABLE entry — under its own name, a documented alias,
+or an explicit waiver (<= 10, each with a reason). Fails when the
+reference grows a rule we silently lack (the eager DTensor path falls
+back to replication on missing rules).
+
+Plus behavior tests for the MoE rules the gate forced in
+(moe_gate_dispatch / moe_combine; reference moe_gate_dispatch.cc,
+moe_combine.cc).
+"""
+import glob
+import os
+
+import pytest
+
+from paddle_tpu.distributed.placement import Partial, Replicate, Shard
+from paddle_tpu.distributed.spmd_rules import RULE_TABLE
+
+REF_DIR = "/root/reference/paddle/phi/infermeta/spmd_rules"
+
+# infra files in that directory that do not define an op rule
+NOT_A_RULE = {"dim_trans", "rules", "utils", "spmd_rule_macro_define"}
+
+# ref-file -> RULE_TABLE name, where the name differs
+ALIASES = {
+    "elementwise": "add",          # per-op elementwise rules
+    "reduction": "sum",            # per-op reduction rules
+}
+
+WAIVERS = {
+    "amp_ops": "check_finite_and_unscale/update_loss_scaling: the amp "
+               "plane syncs the found-inf flag globally (amp/grad_scaler);"
+               " no per-op eager DTensor path exists",
+    "coalesce_tensor": "fused comm buffer for NCCL bucketing; PJRT owns "
+                       "buffers on TPU, the reducer buckets logically "
+                       "(fleet/reducer.py) without this op",
+    "optimizer": "optimizer update placement follows the parameter "
+                 "placement by construction in shard_optimizer "
+                 "(auto_parallel/api.py); no standalone op",
+}
+
+
+def _ref_rule_names():
+    names = set()
+    for f in glob.glob(os.path.join(REF_DIR, "*.cc")):
+        names.add(os.path.basename(f)[:-3])
+    return sorted(names - NOT_A_RULE)
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_DIR),
+                    reason="reference checkout not present")
+def test_every_reference_rule_covered():
+    missing = []
+    for name in _ref_rule_names():
+        target = ALIASES.get(name, name)
+        if name in WAIVERS:
+            continue
+        if target not in RULE_TABLE:
+            missing.append(name)
+    assert not missing, \
+        f"reference spmd rules without a RULE_TABLE entry/waiver: {missing}"
+    assert len(WAIVERS) <= 10
+    assert all(isinstance(v, str) and len(v) > 20 for v in WAIVERS.values())
+
+
+class TestMoERules:
+    """Placement semantics of the two MoE rules over a 2-axis mesh."""
+
+    def test_dispatch_token_sharding(self):
+        rule = RULE_TABLE["moe_gate_dispatch"]
+        # mesh axis 0 shards tokens (dim 0 of x and gate)
+        x = [Shard(0), Replicate()]
+        gate = [Shard(0), Replicate()]
+        (x_req, g_req), (y, cw, sidx, eoff, eid) = rule(x, gate, k=2,
+                                                        capacity=4)
+        assert x_req[0] == Shard(0) and g_req[0] == Shard(0)
+        # the dispatch scatter crosses tokens: y replicates on that axis
+        assert y[0] == Replicate()
+        assert cw[0] == Shard(0) and eid[0] == Shard(0)
+        assert sidx[0] == Shard(1)      # scatter_index is [K, S]
+
+    def test_dispatch_hidden_and_expert_sharding(self):
+        rule = RULE_TABLE["moe_gate_dispatch"]
+        x = [Shard(1), Replicate()]      # hidden sharded on axis 0
+        gate = [Replicate(), Shard(1)]   # experts sharded on axis 1
+        _, (y, cw, sidx, eoff, eid) = rule(x, gate, k=2, capacity=4)
+        assert y[0] == Shard(2)          # y [E, C, H]: h rides along
+        assert y[1] == Shard(0)          # e shards y's expert dim
+        assert eoff[1] == Shard(0)
+
+    def test_combine_token_sharding(self):
+        rule = RULE_TABLE["moe_combine"]
+        x = [Replicate(), Replicate()]
+        cw = [Shard(0), Replicate()]
+        sidx = [Shard(0), Replicate()]
+        (x_req, cw_req, si_req), (y,) = rule(x, cw, sidx)
+        assert y[0] == Shard(0)
+        assert x_req[0] == Replicate()   # gather crosses x rows
+
+    def test_combine_k_yields_to_h(self):
+        rule = RULE_TABLE["moe_combine"]
+        # h sharded on axis 0; k sharded on the same axis must yield
+        # (reference moe_combine.cc:71 forbids k+h together)
+        x = [Shard(1), Replicate()]
+        cw = [Shard(1), Replicate()]
+        sidx = [Replicate(), Replicate()]
+        (x_req, cw_req, si_req), (y,) = rule(x, cw, sidx)
+        assert y[0] == Shard(1)
+        assert cw_req[0] == Replicate()
+
+    def test_combine_k_partial(self):
+        rule = RULE_TABLE["moe_combine"]
+        x = [Replicate()]
+        cw = [Shard(1)]
+        sidx = [Shard(1)]
+        _, (y,) = rule(x, cw, sidx)
+        assert y[0] == Partial("sum")    # summed over the k slices

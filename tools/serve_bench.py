@@ -1,0 +1,211 @@
+"""Device-time serving benchmark (round-4 verdict #3).
+
+The wall-clock serving numbers in earlier rounds measured the axon tunnel
+as much as the chip (one host->device dispatch RTT per decode segment
+dwarfs a 64-step scan), which made the int8 claim unsupportable (+7%
+where the weight-byte ratio predicts ~1.4x). This tool measures DEVICE
+time: it captures an XLA device trace around `generate()` and reads the
+per-program device durations from the "XLA Modules" lane — `jit_steps`
+(the whole decode loop as ONE lax.scan program) and `jit_prefill` appear
+as separate entries, so decode tokens/s excludes the tunnel, the host,
+and the prefill.
+
+Legs:
+  - bf16 / weight-only int8 / weight-only int4 decode at the flagship
+    GQA shape (24L/1024E, 16 q-heads / 8 kv-heads, B=8) via
+    FusedMultiTransformerEngine
+  - paged vs dense decode-step attention at the same shape (op level:
+    the engine serves a dense cache; vLLM-style paged serving uses
+    ops/pallas/paged_attention.py with a block table)
+
+Usage: python tools/serve_bench.py [--json out.json]
+Reference bar: the fused_multi_transformer int8 inference tier,
+paddle/phi/kernels/fusion/gpu/fused_multi_transformer_int8_kernel.cu.
+"""
+import argparse
+import collections
+import glob
+import gzip
+import json
+import math
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _module_device_ms(trace_dir):
+    """{module_name_prefix: total device ms} from the XLA Modules lane."""
+    f = sorted(glob.glob(trace_dir + "/**/*.trace.json.gz",
+                         recursive=True))[-1]
+    with gzip.open(f) as fh:
+        tr = json.load(fh)
+    ev = tr["traceEvents"]
+    tids = {e["tid"]: e["args"]["name"] for e in ev
+            if e.get("ph") == "M" and e.get("name") == "thread_name"
+            and e.get("pid") == 3}
+    out = collections.Counter()
+    for e in ev:
+        if e.get("ph") == "X" and e.get("pid") == 3 \
+                and tids.get(e.get("tid")) == "XLA Modules":
+            name = e["name"].split("(")[0]
+            out[name] += e.get("dur", 0) / 1e3  # us -> ms
+    return dict(out)
+
+
+def _capture(fn):
+    import jax
+    d = tempfile.mkdtemp(prefix="serve_bench_")
+    fn()  # warm/compile outside the trace
+    jax.profiler.start_trace(d)
+    fn()
+    jax.profiler.stop_trace()
+    mods = _module_device_ms(d)
+    shutil.rmtree(d, ignore_errors=True)
+    return mods
+
+
+def decode_leg(weight_quant, B=8, NEW=64):
+    import numpy as np
+
+    from paddle_tpu.inference import FusedMultiTransformerEngine
+
+    rng = np.random.default_rng(0)
+    V, E, H, G, D, L, F = 32000, 1024, 16, 8, 64, 24, 2816
+    SMAX = 512
+
+    def mk(*shape, scale=0.02):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    w = dict(
+        ln_scales=[np.ones(E, np.float32) for _ in range(L)],
+        qkv_weights=[mk(H + 2 * G, D, E) for _ in range(L)],
+        linear_weights=[mk(H * D, E) for _ in range(L)],
+        ffn_ln_scales=[np.ones(E, np.float32) for _ in range(L)],
+        ffn1_weights=[mk(E, 2 * F) for _ in range(L)],
+        ffn2_weights=[mk(F, E) for _ in range(L)],
+        embedding=mk(V, E), lm_head=mk(E, V))
+    eng = FusedMultiTransformerEngine(
+        w, num_heads=H, head_dim=D, max_seq_len=SMAX, dtype="bfloat16",
+        norm_type="rmsnorm", activation="swiglu", gqa_group_size=G,
+        weight_quant=weight_quant)
+    ids = rng.integers(0, V, (B, 16)).astype(np.int32)
+
+    mods = _capture(lambda: eng.generate(ids, max_new_tokens=NEW))
+    # the scanned decode program; bucketing may name it jit_steps
+    decode_ms = sum(v for k, v in mods.items() if "steps" in k)
+    if decode_ms == 0:
+        raise RuntimeError(f"no decode module in trace: {mods}")
+    # NEW is bucketed to a power of two inside generate(); the scan runs
+    # the bucketed count, so rate uses that count
+    n_run = 1 << max(0, (NEW - 1)).bit_length()
+    return {
+        "decode_device_ms": decode_ms,
+        "decode_tokens": B * n_run,
+        "decode_tok_per_s": B * n_run / (decode_ms / 1e3),
+        "prefill_device_ms": sum(v for k, v in mods.items()
+                                 if "prefill" in k),
+    }
+
+
+def paged_vs_dense_leg(B=8, H=16, KVH=8, D=64, ctx=448, iters=32):
+    """Decode-step attention only: dense [KVH, S, D] slice-softmax vs the
+    paged kernel with 64-token blocks (same effective context)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas.paged_attention import paged_attention
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.bfloat16)
+    scale = 1.0 / math.sqrt(D)
+
+    # dense: per-sequence cache [B, KVH, S, D]
+    kd = jnp.asarray(rng.standard_normal((B, KVH, ctx, D)), jnp.bfloat16)
+    vd = jnp.asarray(rng.standard_normal((B, KVH, ctx, D)), jnp.bfloat16)
+
+    def dense(q, k, v):
+        g = H // KVH
+        qg = q.reshape(B, KVH, g, D).astype(jnp.float32)
+        s = jnp.einsum("bkgd,bksd->bkgs", qg, k.astype(jnp.float32))
+        p = jax.nn.softmax(s * scale, axis=-1)
+        return jnp.einsum("bkgs,bksd->bkgd", p,
+                          v.astype(jnp.float32)).reshape(B, H, D)
+
+    block = 64
+    nblk = B * ctx // block
+    kp = jnp.asarray(rng.standard_normal((KVH, nblk, block, D)),
+                     jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((KVH, nblk, block, D)),
+                     jnp.bfloat16)
+    tables = jnp.asarray(
+        rng.permutation(nblk).reshape(B, ctx // block), jnp.int32)
+    lens = jnp.full((B,), ctx, jnp.int32)
+
+    def many(fn, *args):
+        def run(a):
+            def body(c, _):
+                o = fn(*a)
+                return c + o.astype(jnp.float32).sum(), None
+            s, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(iters))
+            return s
+        return jax.jit(run)(args)
+
+    md = _capture(lambda: float(many(dense, q, kd, vd)))
+    mp = _capture(lambda: float(many(
+        lambda q, k, v: paged_attention(q, k, v, tables, lens),
+        q, kp, vp)))
+    dense_ms = sum(v for k, v in md.items() if k.startswith("jit_run"))
+    paged_ms = sum(v for k, v in mp.items() if k.startswith("jit_run"))
+    return {"dense_attn_us_per_step": dense_ms / iters * 1e3,
+            "paged_attn_us_per_step": paged_ms / iters * 1e3,
+            "context": ctx, "block_size": block}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--batches", default="1,8",
+                    help="comma-separated decode batch sizes")
+    ap.add_argument("--skip-paged", action="store_true")
+    args = ap.parse_args()
+    import jax
+    if jax.devices()[0].platform != "tpu":
+        print("# needs the attached TPU (device-time measurement)")
+        return 0
+    out = {}
+    # B=1 is the weight-bound regime where weight-only quant pays (every
+    # step streams the full weights for one token); B=8 amortizes weight
+    # reads 8x, so the weight fraction — and the quant ceiling — shrinks
+    for B in [int(b) for b in args.batches.split(",")]:
+        for quant in [None, "int8", "int4"]:
+            leg = decode_leg(quant, B=B)
+            out[f"decode_b{B}_{quant or 'bf16'}"] = leg
+            print(f"decode[B={B} {quant or 'bf16'}]: "
+                  f"{leg['decode_tok_per_s']:.0f} tok/s device-time "
+                  f"({leg['decode_device_ms']/leg['decode_tokens']*B:.2f} "
+                  f"ms/step; prefill {leg['prefill_device_ms']:.1f} ms)")
+        base = out[f"decode_b{B}_bf16"]["decode_tok_per_s"]
+        for q in ["int8", "int4"]:
+            out[f"b{B}_{q}_speedup_vs_bf16"] = out[
+                f"decode_b{B}_{q}"]["decode_tok_per_s"] / base
+            print(f"  B={B} {q} speedup vs bf16: "
+                  f"{out[f'b{B}_{q}_speedup_vs_bf16']:.2f}x")
+    if not args.skip_paged:
+        pv = paged_vs_dense_leg()
+        out["paged_vs_dense"] = pv
+        print(f"decode-step attention @ctx={pv['context']}: dense "
+              f"{pv['dense_attn_us_per_step']:.0f} us vs paged "
+              f"{pv['paged_attn_us_per_step']:.0f} us per step")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
