@@ -396,8 +396,22 @@ def _unwrap_optimizer(opt):
 
 
 def supported_compiled_optimizer(opt):
-    return type(_unwrap_optimizer(opt)).__name__ in ("SGD", "Adam",
-                                                     "AdamW")
+    """The compiled step reproduces SGD/Adam/AdamW with global-norm (or
+    no) clipping and uniform decay; any configuration it cannot reproduce
+    EXACTLY takes the eager loop instead of silently diverging."""
+    inner = _unwrap_optimizer(opt)
+    if type(inner).__name__ not in ("SGD", "Adam", "AdamW"):
+        return False
+    clip = getattr(inner, "_grad_clip", None)
+    if clip is not None:
+        from ...nn.clip import ClipGradByGlobalNorm
+        if not isinstance(clip, ClipGradByGlobalNorm):
+            return False  # per-tensor / by-value clips: eager only
+    if getattr(inner, "_apply_decay_param_fun", None) is not None:
+        return False      # selective decay: eager only
+    if getattr(inner, "_lr_ratio", None) is not None:
+        return False      # per-param lr: eager only
+    return True
 
 
 def _translate_rules(rules, mesh):

@@ -1098,6 +1098,12 @@ class Executor:
 
     def _op_RETURN_VALUE(self, ins, mode):
         v = self.stack.pop()
+        if self.capture and self.plan is not None and \
+                isinstance(_u(v), types.GeneratorType):
+            # a generator escaping the frame defers its body past capture:
+            # the current (concrete) result is correct, but a replay would
+            # miss the lazily-executed ops — drop the plan, stay eager
+            self.plan.valid = False
         if self.capture and self.seg is not None and self.seg.n_ops > 0:
             self.seg.ends_in_return = True
             self.stack.append(v)  # frame template must include the retval
@@ -1719,9 +1725,17 @@ class Executor:
         vals = self.stack[-n:] if n else []
         maybe_self = self.stack[-n - 1]
         callee_slot = self.stack[-n - 2]
-        callee = maybe_self if callee_slot is NULL else callee_slot
-        args_u = [_u(v) for v in vals]
-        any_taint = _tainted(callee, *vals)
+        if callee_slot is NULL:
+            callee, extra_self = maybe_self, []
+        else:
+            # ceval CALL semantics: when BOTH slots hold values, the
+            # second is PREPENDED as the first positional argument (how a
+            # genexpr receives its '.0' iterator); bound methods reach us
+            # as [method, NULL] and the NULL is dropped
+            callee = callee_slot
+            extra_self = [] if maybe_self is NULL else [maybe_self]
+        args_u = [_u(v) for v in extra_self + vals]
+        any_taint = _tainted(callee, *extra_self, *vals)
         verdict = self._call_verdict(ins, callee, args_u, {}, any_taint)
         if verdict == "break":
             self._break_here(
@@ -1732,7 +1746,7 @@ class Executor:
         self.stack.pop()
         self.stack.pop()
         nkw = len(kwnames)
-        pos = vals[:n - nkw]
+        pos = extra_self + vals[:n - nkw]
         kw = dict(zip(kwnames, vals[n - nkw:]))
         return self._exec_call(ins, verdict, callee, pos, kw)
 

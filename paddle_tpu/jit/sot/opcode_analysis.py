@@ -50,7 +50,18 @@ def _scan(code, out, depth):
     for ins in dis.get_instructions(code):
         op = ins.opname
         if op in _GENERATOR_OPS:
-            out.break_reasons.append(f"generator protocol ({op})")
+            if depth == 0:
+                # the frame ITSELF is a generator: yields suspend the
+                # frame mid-capture — uncapturable
+                out.break_reasons.append(f"generator protocol ({op})")
+            else:
+                # a NESTED generator (local def with yield, genexpr) is
+                # fine: calling it just builds the generator object, and
+                # FOR_ITER executes its body concretely under the op
+                # recorder, so consumption inside this frame captures.
+                # (An ESCAPING generator invalidates the plan at
+                # RETURN_VALUE — executor._op_RETURN_VALUE.)
+                out.warn_reasons.append(f"nested generator ({op})")
         elif op in ("LOAD_ATTR", "LOAD_METHOD"):
             name = ins.argval if isinstance(ins.argval, str) else \
                 (ins.argval[1] if isinstance(ins.argval, tuple) else None)

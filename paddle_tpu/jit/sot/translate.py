@@ -24,7 +24,17 @@ import logging
 
 from ..api import StaticFunction
 from .guards import build_guard_key
+import sys as _sys
+
 from .opcode_analysis import analyze
+
+
+def supported_python():
+    """The opcode tier is validated against CPython 3.12's bytecode; any
+    other version (or a non-CPython interpreter) uses the legacy tier."""
+    import platform
+    return (_sys.version_info[:2] == (3, 12)
+            and platform.python_implementation() == "CPython")
 from .statement_ir import SIRRecorder, StatementIR
 
 log = logging.getLogger("paddle_tpu.jit.sot")
@@ -97,6 +107,13 @@ class SotFunction:
                 self._eager_pinned = True
                 self._tier = "eager"
                 _stats["eager_pins"] += 1
+            elif not supported_python():
+                # the opcode VM simulates CPython 3.12 bytecode (exception
+                # tables, CALL self-slot layout, FOR_ITER sentinel);
+                # other interpreters take the whole-function legacy tier
+                self._tier = "legacy"
+                log.info("sot[%s]: legacy tier (CPython %d.%d; opcode VM "
+                         "targets 3.12)", self._name, *_sys.version_info[:2])
             else:
                 from .executor import code_supported
                 ok, why = code_supported(code)

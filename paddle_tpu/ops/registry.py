@@ -134,7 +134,14 @@ def _attach_dunders(ns):
     these in python/paddle/base/dygraph/math_op_patch.py)."""
     def rev(fn):
         def r(self, other):
-            return fn(Tensor(other) if not isinstance(other, Tensor) else other, self)
+            # python scalars pass through RAW: dispatch folds them as
+            # constants (same jnp weak-type promotion), where an anonymous
+            # Tensor(other) would be an unlocatable SOT-replay input —
+            # sum(gen) starts with int 0 and hit exactly that
+            if isinstance(other, (bool, int, float, complex)):
+                return fn(other, self)
+            return fn(Tensor(other) if not isinstance(other, Tensor)
+                      else other, self)
         return r
 
     binary = {

@@ -98,9 +98,10 @@ def decode_leg(weight_quant, B=8, NEW=64):
     decode_ms = sum(v for k, v in mods.items() if "steps" in k)
     if decode_ms == 0:
         raise RuntimeError(f"no decode module in trace: {mods}")
-    # NEW is bucketed to a power of two inside generate(); the scan runs
-    # the bucketed count, so rate uses that count
-    n_run = 1 << max(0, (NEW - 1)).bit_length()
+    # NEW is bucketed inside generate() to the smallest power of two
+    # >= NEW-1 (the prefill already emitted token 1), clamped to the cache
+    n_run = 1 << max(0, NEW - 2).bit_length() if NEW > 1 else 0
+    n_run = min(n_run, 512 - 16)
     return {
         "decode_device_ms": decode_ms,
         "decode_tokens": B * n_run,
@@ -146,9 +147,13 @@ def paged_vs_dense_leg(B=8, H=16, KVH=8, D=64, ctx=448, iters=32):
     lens = jnp.full((B,), ctx, jnp.int32)
 
     def many(fn, *args):
+        # the q input must DEPEND on the carry or XLA hoists the whole
+        # loop-invariant body out of the scan (measured: iters=1/32/256
+        # all took one kernel time) and us/step under-reports by ~iters
         def run(a):
             def body(c, _):
-                o = fn(*a)
+                qq = a[0] + (c * 0).astype(a[0].dtype)
+                o = fn(qq, *a[1:])
                 return c + o.astype(jnp.float32).sum(), None
             s, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(iters))
             return s
