@@ -162,15 +162,64 @@ def _client_handshake(sock, secret):
     sock.sendall(hmac.new(secret.encode(), nonce, hashlib.sha256).digest())
 
 
-class DenseTable:
-    """Flat dense parameter block with a server-side SGD step (reference
-    dense table + dense optimizer accessor)."""
+class SgdRule:
+    """Server-side SGD update rule (reference ps/table sgd accessor)."""
 
-    def __init__(self, table_id, size, lr=0.01, init=None):
+    def __init__(self, lr=0.01):
+        self.lr = lr
+
+    def make_state(self, shape):
+        return None
+
+    def apply(self, param, grad, state):
+        param -= self.lr * grad
+        return state
+
+
+class AdamRule:
+    """Server-side Adam update rule (reference ps/table adam accessor —
+    sparse tables keep per-ROW moments + step counts, so a hot row's bias
+    correction reflects its own update count)."""
+
+    def __init__(self, lr=0.01, beta1=0.9, beta2=0.999, eps=1e-8):
+        self.lr, self.b1, self.b2, self.eps = lr, beta1, beta2, eps
+
+    def make_state(self, shape):
+        return {"m": np.zeros(shape, np.float32),
+                "v": np.zeros(shape, np.float32), "t": 0}
+
+    def apply(self, param, grad, state):
+        state["t"] += 1
+        m, v, t = state["m"], state["v"], state["t"]
+        m += (1 - self.b1) * (grad - m)
+        v += (1 - self.b2) * (grad * grad - v)
+        mhat = m / (1 - self.b1 ** t)
+        vhat = v / (1 - self.b2 ** t)
+        param -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+        return state
+
+
+def _make_rule(optimizer, lr):
+    if optimizer in (None, "sgd"):
+        return SgdRule(lr)
+    if optimizer == "adam":
+        return AdamRule(lr)
+    if isinstance(optimizer, (SgdRule, AdamRule)):
+        return optimizer
+    raise ValueError(f"unknown server-side optimizer {optimizer!r}")
+
+
+class DenseTable:
+    """Flat dense parameter block with a server-side optimizer step
+    (reference dense table + dense optimizer accessor; sgd or adam)."""
+
+    def __init__(self, table_id, size, lr=0.01, init=None, optimizer="sgd"):
         self.table_id = table_id
         self.data = np.zeros((size,), np.float32) if init is None \
             else np.asarray(init, np.float32).reshape(-1).copy()
         self.lr = lr
+        self._rule = _make_rule(optimizer, lr)
+        self._opt_state = self._rule.make_state(self.data.shape)
         self._lock = threading.Lock()
 
     def pull(self):
@@ -179,7 +228,15 @@ class DenseTable:
 
     def push_grad(self, grad):
         with self._lock:
-            self.data -= self.lr * np.asarray(grad, np.float32).reshape(-1)
+            self._opt_state = self._rule.apply(
+                self.data, np.asarray(grad, np.float32).reshape(-1),
+                self._opt_state)
+
+    def push_delta(self, delta):
+        """Geo-async: apply a raw parameter DELTA (already scaled by the
+        worker's local optimizer; reference GeoCommunicator dense sync)."""
+        with self._lock:
+            self.data += np.asarray(delta, np.float32).reshape(-1)
 
     def set(self, values):
         with self._lock:
@@ -191,12 +248,14 @@ class SparseTable:
     table; entry configs ps/table accessor)."""
 
     def __init__(self, table_id, emb_dim, lr=0.01, entry=None,
-                 initializer=None, seed=0):
+                 initializer=None, seed=0, optimizer="sgd"):
         self.table_id = table_id
         self.emb_dim = emb_dim
         self.lr = lr
         self.entry = entry  # CountFilterEntry-style: ._count threshold
         self.rows = {}
+        self._rule = _make_rule(optimizer, lr)
+        self._opt_states = {}    # row key -> per-row optimizer state
         self._touch = {}
         self._rng = np.random.default_rng(seed)
         self._init = initializer or (
@@ -230,7 +289,29 @@ class SparseTable:
                 key = int(key)
                 row = self.rows.get(key)
                 if row is not None:
-                    row -= self.lr * grads[i]
+                    st = self._opt_states.get(key)
+                    if st is None:
+                        st = self._rule.make_state(row.shape)
+                    self._opt_states[key] = self._rule.apply(
+                        row, grads[i], st)
+
+    def push_delta(self, ids, deltas):
+        """Geo-async row deltas. Row creation goes through the SAME
+        admission filter and initializer as the pull path — geo mode must
+        not become a backdoor past CountFilterEntry, and a freshly
+        admitted row starts from the configured init plus the delta (the
+        worker re-pulls at its next sync, resolving any local drift)."""
+        deltas = np.asarray(deltas, np.float32)
+        with self._lock:
+            for i, key in enumerate(ids):
+                key = int(key)
+                row = self.rows.get(key)
+                if row is None:
+                    if not self._admit(key):
+                        continue
+                    row = self._init()
+                    self.rows[key] = row
+                row += deltas[i]
 
     def size(self):
         with self._lock:
@@ -360,6 +441,30 @@ class SsdSparseTable(SparseTable):
                     self._note(key)
             self._spill_cold()
 
+    def push_delta(self, ids, deltas):
+        """Geo deltas with SSD-aware row materialization: a spilled row is
+        promoted (not clobbered by the raw delta), creation honors
+        admission + init, and touched rows count toward spill pressure."""
+        deltas = np.asarray(deltas, np.float32)
+        with self._lock:
+            for i, key in enumerate(ids):
+                key = int(key)
+                row = self.rows.get(key)
+                if row is None:
+                    row = self._load(key)
+                    if row is not None:
+                        self.rows[key] = row
+                        self._offsets.pop(key, None)
+                        self._dead_bytes += self._row_bytes
+                if row is None:
+                    if not self._admit(key):
+                        continue
+                    row = self._init()
+                    self.rows[key] = row
+                row += deltas[i]
+                self._note(key)
+            self._spill_cold()
+
     def size(self):
         with self._lock:
             return len(self.rows) + len(self._offsets)
@@ -386,11 +491,15 @@ class PsServer:
         self._barrier_world = barrier_world_size
         self._barrier_cond = threading.Condition()
 
-    def add_dense_table(self, table_id, size, lr=0.01, init=None):
-        self.tables[table_id] = DenseTable(table_id, size, lr, init)
+    def add_dense_table(self, table_id, size, lr=0.01, init=None,
+                        optimizer="sgd"):
+        self.tables[table_id] = DenseTable(table_id, size, lr, init,
+                                           optimizer=optimizer)
 
-    def add_sparse_table(self, table_id, emb_dim, lr=0.01, entry=None):
-        self.tables[table_id] = SparseTable(table_id, emb_dim, lr, entry)
+    def add_sparse_table(self, table_id, emb_dim, lr=0.01, entry=None,
+                         optimizer="sgd"):
+        self.tables[table_id] = SparseTable(table_id, emb_dim, lr, entry,
+                                            optimizer=optimizer)
 
     def _handle(self, conn):
         try:
@@ -408,10 +517,14 @@ class PsServer:
                     _send_msg(conn, {"ok": True})
                     self._stop.set()
                     return
+                noack = req.pop("noack", False)
                 try:
-                    _send_msg(conn, self._dispatch(req))
+                    resp = self._dispatch(req)
+                    if not noack:
+                        _send_msg(conn, resp)
                 except Exception as e:  # table errors go back to the client
-                    _send_msg(conn, {"ok": False, "error": repr(e)})
+                    if not noack:
+                        _send_msg(conn, {"ok": False, "error": repr(e)})
         finally:
             conn.close()
 
@@ -437,6 +550,12 @@ class PsServer:
             return {"ok": True, "values": t.pull()}
         if op == "push_dense_grad":
             t.push_grad(req["grad"])
+            return {"ok": True}
+        if op == "push_dense_delta":
+            t.push_delta(req["delta"])
+            return {"ok": True}
+        if op == "push_sparse_delta":
+            t.push_delta(req["ids"], req["deltas"])
             return {"ok": True}
         if op == "set_dense":
             t.set(req["values"])
@@ -499,9 +618,30 @@ class PsClient:
     def pull_dense(self, table):
         return self._call(op="pull_dense", table=table)["values"]
 
-    def push_dense_grad(self, table, grad):
+    def _send_noack(self, **req):
+        """Async push (reference brpc async push_dense/push_sparse: the
+        request is fired without waiting for the server's ack; TCP
+        preserves ordering against later synchronous calls on this
+        connection)."""
+        req["noack"] = True
+        with self._lock:
+            _send_msg(self._sock, req)
+
+    def push_dense_grad(self, table, grad, sync=True):
+        if not sync:
+            self._send_noack(op="push_dense_grad", table=table,
+                            grad=np.asarray(grad, np.float32))
+            return
         self._call(op="push_dense_grad", table=table,
                    grad=np.asarray(grad, np.float32))
+
+    def push_dense_delta(self, table, delta, sync=True):
+        if not sync:
+            self._send_noack(op="push_dense_delta", table=table,
+                            delta=np.asarray(delta, np.float32))
+            return
+        self._call(op="push_dense_delta", table=table,
+                   delta=np.asarray(delta, np.float32))
 
     def set_dense(self, table, values):
         self._call(op="set_dense", table=table,
@@ -512,10 +652,23 @@ class PsClient:
                           ids=[int(i) for i in np.asarray(ids).reshape(-1)])[
             "values"]
 
-    def push_sparse_grad(self, table, ids, grads):
-        self._call(op="push_sparse_grad", table=table,
+    def push_sparse_grad(self, table, ids, grads, sync=True):
+        msg = dict(op="push_sparse_grad", table=table,
                    ids=[int(i) for i in np.asarray(ids).reshape(-1)],
                    grads=np.asarray(grads, np.float32))
+        if not sync:
+            self._send_noack(**msg)
+            return
+        self._call(**msg)
+
+    def push_sparse_delta(self, table, ids, deltas, sync=True):
+        msg = dict(op="push_sparse_delta", table=table,
+                   ids=[int(i) for i in np.asarray(ids).reshape(-1)],
+                   deltas=np.asarray(deltas, np.float32))
+        if not sync:
+            self._send_noack(**msg)
+            return
+        self._call(**msg)
 
     def sparse_table_size(self, table):
         return self._call(op="table_size", table=table)["size"]
@@ -561,3 +714,118 @@ class PsService:
         self.server.stop()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
+
+
+class GeoWorker:
+    """Geo-async training mode (reference: the GeoCommunicator tier of
+    the-one-PS — fleet/runtime/the_one_ps.py geo mode +
+    communicator/geo). Each worker trains a LOCAL copy of its tables at
+    full speed; every `geo_step` optimizer steps it ships the accumulated
+    parameter DELTA (local - base) to the server and pulls the fresh
+    global values, so workers drift at most geo_step steps apart instead
+    of paying a round trip per step.
+
+    Usage per step:
+        emb = gw.pull_sparse(tid, ids)      # local (cached) rows
+        ... compute grads locally ...
+        gw.push_sparse_grad(tid, ids, g)    # local optimizer step
+        gw.tick()                           # maybe geo-sync
+    """
+
+    def __init__(self, client, geo_step=4, lr=0.01, optimizer="sgd"):
+        self.client = client
+        self.geo_step = max(int(geo_step), 1)
+        self._rule_factory = lambda: _make_rule(optimizer, lr)
+        self._dense = {}    # table -> {"local", "base", "rule", "state"}
+        self._sparse = {}   # table -> {"local": {key: row},
+                            #           "base": {key: row}, "states"}
+        self._steps = 0
+
+    # -- dense -----------------------------------------------------------
+    def _dget(self, table):
+        d = self._dense.get(table)
+        if d is None:
+            vals = np.asarray(self.client.pull_dense(table), np.float32)
+            rule = self._rule_factory()
+            d = self._dense[table] = {
+                "local": vals.copy(), "base": vals.copy(), "rule": rule,
+                "state": rule.make_state(vals.shape)}
+        return d
+
+    def pull_dense(self, table):
+        return self._dget(table)["local"].copy()
+
+    def push_dense_grad(self, table, grad):
+        d = self._dget(table)
+        d["state"] = d["rule"].apply(
+            d["local"], np.asarray(grad, np.float32).reshape(-1),
+            d["state"])
+
+    # -- sparse ----------------------------------------------------------
+    def _sget(self, table):
+        s = self._sparse.get(table)
+        if s is None:
+            s = self._sparse[table] = {"local": {}, "base": {},
+                                       "states": {},
+                                       "rule": self._rule_factory()}
+        return s
+
+    def pull_sparse(self, table, ids):
+        s = self._sget(table)
+        ids = [int(i) for i in np.asarray(ids).reshape(-1)]
+        missing = [k for k in dict.fromkeys(ids) if k not in s["local"]]
+        if missing:
+            rows = np.asarray(self.client.pull_sparse(table, missing),
+                              np.float32)
+            for k, row in zip(missing, rows):
+                s["local"][k] = row.copy()
+                s["base"][k] = row.copy()
+        return np.stack([s["local"][k] for k in ids])
+
+    def push_sparse_grad(self, table, ids, grads):
+        s = self._sget(table)
+        grads = np.asarray(grads, np.float32)
+        rule = s["rule"]
+        for i, k in enumerate([int(i) for i in
+                               np.asarray(ids).reshape(-1)]):
+            row = s["local"].get(k)
+            if row is None:
+                continue
+            st = s["states"].get(k)
+            if st is None:
+                st = rule.make_state(row.shape)
+            s["states"][k] = rule.apply(row, grads[i], st)
+
+    # -- the geo sync ----------------------------------------------------
+    def tick(self):
+        """Count one optimizer step; every geo_step steps, push deltas
+        and refresh the local copies from the (merged) global tables."""
+        self._steps += 1
+        if self._steps % self.geo_step:
+            return False
+        self.sync()
+        return True
+
+    def sync(self):
+        for table, d in self._dense.items():
+            delta = d["local"] - d["base"]
+            if not delta.any():
+                continue  # untouched table: skip the no-op round trip
+            self.client.push_dense_delta(table, delta)
+            fresh = np.asarray(self.client.pull_dense(table), np.float32)
+            d["local"] = fresh.copy()
+            d["base"] = fresh.copy()
+        for table, s in self._sparse.items():
+            keys = [k for k in s["local"]
+                    if not np.array_equal(s["local"][k], s["base"][k])]
+            if keys:
+                deltas = np.stack([s["local"][k] - s["base"][k]
+                                   for k in keys])
+                self.client.push_sparse_delta(table, keys, deltas)
+            if s["local"]:
+                allk = list(s["local"])
+                fresh = np.asarray(
+                    self.client.pull_sparse(table, allk), np.float32)
+                for k, row in zip(allk, fresh):
+                    s["local"][k] = row.copy()
+                    s["base"][k] = row.copy()

@@ -1,0 +1,187 @@
+// Package paddle: Go inference API over the C ABI
+// (native/src_capi/paddle_inference_c.h), mirroring the reference's
+// paddle/fluid/inference/goapi (config.go, predictor.go, tensor.go).
+//
+// Build: the shared library comes from the repo's native build
+// (libpaddle_inference_c); point CGO at it:
+//
+//	CGO_CFLAGS="-I${REPO}/paddle_tpu/native/src_capi" \
+//	CGO_LDFLAGS="-L${BUILD} -lpaddle_inference_c" go build ./...
+//
+// STATUS: written against the exercised C ABI (tests/test_inference_capi.py
+// drives the same symbols from a compiled C program), but this image
+// carries no Go toolchain, so the shim itself is compile-checked only by
+// inspection — see PARITY.md "divergences".
+package paddle
+
+/*
+#include <stdint.h>
+#include <stdlib.h>
+#include "paddle_inference_c.h"
+*/
+import "C"
+
+import (
+	"runtime"
+	"unsafe"
+)
+
+// Precision mirrors the reference's PrecisionType for EnableTpu.
+type Precision int32
+
+const (
+	PrecisionFloat32 Precision = 0
+	PrecisionBf16    Precision = 2
+)
+
+// Config wraps PD_Config (reference goapi/config.go Config).
+type Config struct {
+	c *C.PD_Config
+}
+
+func NewConfig() *Config {
+	cfg := &Config{c: C.PD_ConfigCreate()}
+	runtime.SetFinalizer(cfg, func(c *Config) { c.Destroy() })
+	return cfg
+}
+
+// SetModel points at the saved program (StableHLO bundle) + params.
+func (cfg *Config) SetModel(prog, params string) {
+	p := C.CString(prog)
+	q := C.CString(params)
+	defer C.free(unsafe.Pointer(p))
+	defer C.free(unsafe.Pointer(q))
+	C.PD_ConfigSetModel(cfg.c, p, q)
+}
+
+// EnableTpu selects the TPU backend at the given precision (the role of
+// the reference's EnableUseGpu on this stack).
+func (cfg *Config) EnableTpu(precision Precision) {
+	C.PD_ConfigEnableTpu(cfg.c, C.int(precision))
+}
+
+func (cfg *Config) Destroy() {
+	if cfg.c != nil {
+		C.PD_ConfigDestroy(cfg.c)
+		cfg.c = nil
+	}
+}
+
+// Predictor wraps PD_Predictor (reference goapi/predictor.go).
+type Predictor struct {
+	p *C.PD_Predictor
+}
+
+func NewPredictor(cfg *Config) *Predictor {
+	pred := &Predictor{p: C.PD_PredictorCreate(cfg.c)}
+	cfg.c = nil // ownership transferred, as in the C contract
+	runtime.SetFinalizer(pred, func(p *Predictor) { p.Destroy() })
+	return pred
+}
+
+func (p *Predictor) GetInputNum() int {
+	return int(C.PD_PredictorGetInputNum(p.p))
+}
+
+func (p *Predictor) GetOutputNum() int {
+	return int(C.PD_PredictorGetOutputNum(p.p))
+}
+
+func (p *Predictor) GetInputNames() []string {
+	n := p.GetInputNum()
+	names := make([]string, n)
+	for i := 0; i < n; i++ {
+		cs := C.PD_PredictorGetInputName(p.p, C.size_t(i))
+		names[i] = C.GoString(cs)
+		C.PD_CstrDestroy(cs)
+	}
+	return names
+}
+
+func (p *Predictor) GetOutputNames() []string {
+	n := p.GetOutputNum()
+	names := make([]string, n)
+	for i := 0; i < n; i++ {
+		cs := C.PD_PredictorGetOutputName(p.p, C.size_t(i))
+		names[i] = C.GoString(cs)
+		C.PD_CstrDestroy(cs)
+	}
+	return names
+}
+
+func (p *Predictor) GetInputHandle(name string) *Tensor {
+	cs := C.CString(name)
+	defer C.free(unsafe.Pointer(cs))
+	return &Tensor{t: C.PD_PredictorGetInputHandle(p.p, cs)}
+}
+
+func (p *Predictor) GetOutputHandle(name string) *Tensor {
+	cs := C.CString(name)
+	defer C.free(unsafe.Pointer(cs))
+	return &Tensor{t: C.PD_PredictorGetOutputHandle(p.p, cs)}
+}
+
+// Run executes the compiled program; false on failure.
+func (p *Predictor) Run() bool {
+	return C.PD_PredictorRun(p.p) == 0
+}
+
+func (p *Predictor) Destroy() {
+	if p.p != nil {
+		C.PD_PredictorDestroy(p.p)
+		p.p = nil
+	}
+}
+
+// Tensor wraps PD_Tensor (reference goapi/tensor.go); float32 carriers,
+// matching the exercised C ABI surface.
+type Tensor struct {
+	t *C.PD_Tensor
+}
+
+// maxRank mirrors the C ABI: PD_TensorGetShape writes at most 16 dims
+// (inference_capi.c tensor_numel max_ndim).
+const maxRank = 16
+
+func (t *Tensor) Reshape(shape []int32) {
+	if len(shape) == 0 {
+		return
+	}
+	C.PD_TensorReshape(t.t, C.size_t(len(shape)),
+		(*C.int32_t)(unsafe.Pointer(&shape[0])))
+}
+
+func (t *Tensor) Shape() []int32 {
+	var ndim C.int32_t
+	buf := make([]int32, maxRank)
+	C.PD_TensorGetShape(t.t, &ndim,
+		(*C.int32_t)(unsafe.Pointer(&buf[0])))
+	n := int(ndim)
+	if n > maxRank { // ndim_out reports the true rank; writes are clamped
+		n = maxRank
+	}
+	return buf[:n]
+}
+
+func (t *Tensor) CopyFromCpu(data []float32) {
+	if len(data) == 0 {
+		return
+	}
+	C.PD_TensorCopyFromCpuFloat(t.t,
+		(*C.float)(unsafe.Pointer(&data[0])))
+}
+
+func (t *Tensor) CopyToCpu(data []float32) {
+	if len(data) == 0 {
+		return
+	}
+	C.PD_TensorCopyToCpuFloat(t.t,
+		(*C.float)(unsafe.Pointer(&data[0])))
+}
+
+func (t *Tensor) Destroy() {
+	if t.t != nil {
+		C.PD_TensorDestroy(t.t)
+		t.t = nil
+	}
+}

@@ -454,3 +454,77 @@ class TestFileStoreMaster:
         assert not m2.peer_alive(0, ttl_s=0.0)
         assert m2.peer_alive(0, ttl_s=3600)
         m2.close()
+
+
+class TestRealProcessKillElastic:
+    """Round-4 verdict #7: launch REAL workers via
+    `python -m paddle_tpu.distributed.launch`, SIGKILL one worker
+    process, and observe the generation-scoped re-rendezvous + restart
+    complete end to end (reference pattern:
+    test/collective/test_communication_api_base.py:28)."""
+
+    WORKER = """
+import os, sys, time, pathlib
+gen = int(os.environ["PADDLE_RESTART_GENERATION"])
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+root = pathlib.Path(sys.argv[1])
+(root / f"started_g{gen}_r{rank}").write_text(str(os.getpid()))
+if gen == 0:
+    time.sleep(120)   # generation 0 idles until the test kills one worker
+(root / f"done_g{gen}_r{rank}").write_text("1")
+"""
+
+    def _wait_for(self, path, timeout=60):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if path.exists():
+                return True
+            time.sleep(0.2)
+        return False
+
+    def test_sigkill_worker_triggers_generation_restart(self, tmp_path):
+        import signal
+        from paddle_tpu.distributed.launch.master import free_port
+        script = tmp_path / "worker.py"
+        script.write_text(self.WORKER)
+        marks = tmp_path / "marks"
+        marks.mkdir()
+        port = free_port()
+        env = dict(os.environ)
+        env.pop("PYTEST_CURRENT_TEST", None)
+        env["PYTHONPATH"] = "/root/repo" + os.pathsep + \
+            env.get("PYTHONPATH", "")
+
+        def launcher(rank):
+            return subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nnodes", "2", "--rank", str(rank),
+                 "--master", f"127.0.0.1:{port}",
+                 "--job_id", "killtest", "--heartbeat_s", "0.5",
+                 "--max_restart", "2",
+                 "--log_dir", str(tmp_path / f"logs{rank}"),
+                 str(script), str(marks)],
+                env=env, cwd="/root/repo",
+                stdout=open(tmp_path / f"launcher{rank}.log", "wb"),
+                stderr=subprocess.STDOUT)
+
+        procs = [launcher(0), launcher(1)]
+        try:
+            # generation 0: both workers up
+            assert self._wait_for(marks / "started_g0_r0"), "g0 r0 start"
+            assert self._wait_for(marks / "started_g0_r1"), "g0 r1 start"
+            victim_pid = int((marks / "started_g0_r0").read_text())
+            os.kill(victim_pid, signal.SIGKILL)
+            # generation 1: BOTH ranks re-rendezvous and restart
+            assert self._wait_for(marks / "started_g1_r0"), "g1 r0 restart"
+            assert self._wait_for(marks / "started_g1_r1"), "g1 r1 restart"
+            # and the whole job completes cleanly
+            assert self._wait_for(marks / "done_g1_r0"), "g1 r0 done"
+            assert self._wait_for(marks / "done_g1_r1"), "g1 r1 done"
+            for i, p in enumerate(procs):
+                assert p.wait(timeout=60) == 0, \
+                    (tmp_path / f"launcher{i}.log").read_text()[-2000:]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()

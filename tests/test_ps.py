@@ -173,3 +173,163 @@ class TestSsdSparseTable:
                 t.pull([i])
         np.testing.assert_allclose(t.pull(ids), ref)
         assert t.size() == 12
+
+
+class TestServerSideAdam:
+    """Round-4 verdict #8: adam optimizer tables (reference ps/table adam
+    accessor) — dense and per-row sparse moments."""
+
+    def test_dense_adam_converges_where_sgd_stalls(self):
+        from paddle_tpu.distributed.ps import DenseTable
+        # ill-scaled quadratic: sgd with the same lr crawls on the flat dim
+        scales = np.array([100.0, 0.01], np.float32)
+        t_adam = DenseTable(0, 2, lr=0.05, init=[1.0, 1.0],
+                            optimizer="adam")
+        t_sgd = DenseTable(1, 2, lr=0.05, init=[1.0, 1.0])
+        for _ in range(200):
+            t_adam.push_grad(scales * t_adam.pull())
+            t_sgd.push_grad(scales * t_sgd.pull())
+        assert np.abs(t_adam.pull()).max() < 0.05
+        assert abs(t_sgd.pull()[1]) > 0.5  # sgd barely moved the flat dim
+
+    def test_sparse_adam_per_row_state(self):
+        from paddle_tpu.distributed.ps import SparseTable
+        t = SparseTable(0, emb_dim=4, lr=0.05, optimizer="adam")
+        rows = t.pull([7, 8])
+        for _ in range(100):
+            t.push_grad([7], 2.0 * t.pull([7]))  # only row 7 trains
+        assert np.abs(t.pull([7])).max() < 1e-2
+        np.testing.assert_array_equal(t.pull([8])[0], rows[1])
+        # per-row step counts: row 7 has state, row 8 does not
+        assert 7 in t._opt_states and 8 not in t._opt_states
+
+    def test_service_adam_embedding_convergence(self):
+        svc = PsService()
+        svc.server.add_sparse_table(0, emb_dim=8, lr=0.05,
+                                    optimizer="adam")
+        svc.start()
+        try:
+            c = svc.client()
+            ids = np.array([0, 1, 2], np.int64)
+            for _ in range(60):
+                rows = c.pull_sparse(0, ids)
+                c.push_sparse_grad(0, ids, 2.0 * rows)  # d/dx x^2
+            assert np.abs(c.pull_sparse(0, ids)).max() < 0.01
+            c.close()
+        finally:
+            svc.stop()
+
+
+class TestAsyncPush:
+    """Round-4 verdict #8: async (unacked) grad push — the brpc async
+    push_sparse/push_dense pattern; a later synchronous call on the same
+    connection acts as the flush barrier."""
+
+    def test_async_embedding_convergence(self):
+        svc = PsService()
+        svc.server.add_sparse_table(0, emb_dim=8, lr=0.1)
+        svc.server.add_dense_table(1, 4, lr=0.1, init=[1, 1, 1, 1])
+        svc.start()
+        try:
+            c = svc.client()
+            ids = np.array([0, 1, 2, 1], np.int64)
+            for _ in range(40):
+                rows = c.pull_sparse(0, ids)   # sync pull = flush point
+                c.push_sparse_grad(0, ids, 2.0 * rows, sync=False)
+                c.push_dense_grad(1, 2.0 * c.pull_dense(1), sync=False)
+            c.barrier()                        # final flush
+            assert np.abs(c.pull_sparse(0, [0, 1, 2])).max() < 0.01
+            assert np.abs(c.pull_dense(1)).max() < 0.01
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_async_error_does_not_poison_stream(self):
+        svc = PsService()
+        svc.server.add_dense_table(0, 4, lr=0.1)
+        svc.start()
+        try:
+            c = svc.client()
+            # bad table id, unacked: server must swallow the error and
+            # keep the stream aligned for the next synchronous call
+            c.push_dense_grad(99, np.ones(4), sync=False)
+            assert c.pull_dense(0).shape == (4,)
+            c.close()
+        finally:
+            svc.stop()
+
+
+class TestGeoMode:
+    """Round-4 verdict #8: geo-async drift sync (reference
+    GeoCommunicator): workers train local copies, ship deltas every
+    geo_step, and converge on the shared tables."""
+
+    def test_two_workers_converge_on_shared_embedding(self):
+        from paddle_tpu.distributed.ps import GeoWorker
+        svc = PsService()
+        svc.server.add_sparse_table(0, emb_dim=4, lr=0.1)
+        svc.server.add_dense_table(1, 2, lr=0.1, init=[1.0, -1.0])
+        svc.start()
+        try:
+            w1 = GeoWorker(svc.client(), geo_step=4, lr=0.1)
+            w2 = GeoWorker(svc.client(), geo_step=4, lr=0.1)
+            ids = np.array([3, 4], np.int64)
+            for _ in range(60):
+                for w in (w1, w2):
+                    rows = w.pull_sparse(0, ids)
+                    w.push_sparse_grad(0, ids, 2.0 * rows)
+                    w.push_dense_grad(1, 2.0 * w.pull_dense(1))
+                    w.tick()
+            w1.sync(); w2.sync()
+            c = svc.client()
+            assert np.abs(c.pull_sparse(0, ids)).max() < 0.05
+            assert np.abs(c.pull_dense(1)).max() < 0.05
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_drift_bounded_by_geo_step(self):
+        from paddle_tpu.distributed.ps import GeoWorker
+        svc = PsService()
+        svc.server.add_dense_table(0, 1, lr=1.0, init=[0.0])
+        svc.start()
+        try:
+            w = GeoWorker(svc.client(), geo_step=5, lr=1.0)
+            c = svc.client()
+            for i in range(4):   # below geo_step: server untouched
+                w.push_dense_grad(0, np.array([-1.0]))
+                assert not w.tick()
+            assert float(c.pull_dense(0)[0]) == 0.0
+            w.push_dense_grad(0, np.array([-1.0]))
+            assert w.tick()      # 5th step: delta (+5) ships
+            assert float(c.pull_dense(0)[0]) == 5.0
+            c.close()
+        finally:
+            svc.stop()
+
+
+class TestSsdGeoDelta:
+    def test_push_delta_promotes_spilled_rows(self, tmp_path):
+        """Geo delta onto an SSD-spilled row must promote the base from
+        disk (not clobber it with the raw delta) and keep size() exact."""
+        from paddle_tpu.distributed.ps import SsdSparseTable
+        t = SsdSparseTable(0, emb_dim=2, path=str(tmp_path / "ssd"),
+                           lr=0.1, cache_rows=2)
+        base = {k: t.pull([k])[0].copy() for k in (1, 2, 3)}  # 1 spills
+        assert t.size() == 3
+        t.push_delta([1], np.array([[0.5, 0.5]], np.float32))
+        np.testing.assert_allclose(t.pull([1])[0], base[1] + 0.5,
+                                   rtol=1e-6)
+        assert t.size() == 3
+
+    def test_push_delta_respects_admission(self):
+        from paddle_tpu.distributed.ps import SparseTable
+
+        class Entry:
+            _count = 3
+        t = SparseTable(0, emb_dim=2, entry=Entry())
+        t.push_delta([9], np.array([[1.0, 1.0]], np.float32))
+        assert t.size() == 0        # below threshold: not admitted
+        t.push_delta([9], np.array([[1.0, 1.0]], np.float32))
+        t.push_delta([9], np.array([[1.0, 1.0]], np.float32))
+        assert t.size() == 1        # third touch admits, init + delta
