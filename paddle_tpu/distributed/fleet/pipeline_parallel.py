@@ -68,6 +68,9 @@ class PipelineLayer(nn.Layer):
         return x
 
 
+_COMPILED_UNAVAILABLE = object()  # construction failed: use the eager loop
+
+
 class PipelineParallel(nn.Layer):
     """Reference meta_parallel/pipeline_parallel.py. Eager semantics:
     micro-batched gradient accumulation over the full stack (numerically
@@ -88,7 +91,7 @@ class PipelineParallel(nn.Layer):
             if isinstance(cfg, dict) else 1
 
     def forward(self, *args, **kwargs):
-        if self._compiled is not None:
+        if isinstance(self._compiled, CompiledPipelineTrainer):
             self._compiled.sync_to_model()
         return self._sub_layers["_layers"](*args, **kwargs)
 
@@ -107,22 +110,37 @@ class PipelineParallel(nn.Layer):
         if mesh is None or not isinstance(net, PipelineLayer):
             return None
         pp_axis = resolve_axis(mesh, "pp")
-        if pp_axis is None or mesh.get_dim_size(pp_axis) < 2:
+        if pp_axis is None or mesh.get_dim_size(pp_axis) < 2 \
+                or net.get_num_stages() < 2:
+            # a 1-stage PipelineLayer is not a pipeline even under a
+            # pp-capable mesh (e.g. a leftover global mesh from other code)
             return None
         if not supported_compiled_optimizer(optimizer):
             # optimizers without a functional compiled form (Momentum,
             # Lamb, ...) take the eager micro-batch loop
             return None
         if self._compiled is None or self._compiled_opt is not optimizer:
-            if self._compiled is not None:
+            if isinstance(self._compiled, CompiledPipelineTrainer):
                 self._compiled.sync_to_model()  # carry progress over
-            self._compiled = CompiledPipelineTrainer(
-                net, mesh, optimizer=optimizer, strategy=self._strategy,
-                rules=getattr(net, "_shard_rules", None),
-                pp_axis=pp_axis,
-                dp_axis=resolve_axis(mesh, "dp"),
-                n_micro=max(self.accumulate_steps, 1))
-            self._compiled_opt = optimizer
+            try:
+                self._compiled = CompiledPipelineTrainer(
+                    net, mesh, optimizer=optimizer,
+                    strategy=self._strategy,
+                    rules=getattr(net, "_shard_rules", None),
+                    pp_axis=pp_axis,
+                    dp_axis=resolve_axis(mesh, "dp"),
+                    n_micro=max(self.accumulate_steps, 1))
+            except (ValueError, NotImplementedError) as e:
+                # model shape the compiled trainer can't stage
+                # (heterogeneous blocks, indivisible counts): eager loop
+                import logging
+                logging.getLogger("paddle_tpu.fleet").info(
+                    "compiled pipeline unavailable (%s); eager loop", e)
+                self._compiled = _COMPILED_UNAVAILABLE
+            self._compiled_opt = optimizer  # also pins the failure: no
+            # re-construction attempt until a different optimizer arrives
+        if self._compiled is _COMPILED_UNAVAILABLE:
+            return None
         return self._compiled
 
     # template hooks for schedule subclasses (zero-bubble overrides both)
@@ -144,7 +162,7 @@ class PipelineParallel(nn.Layer):
                 if lr_scheduler is not None:
                     lr_scheduler.step()
                 return loss
-        elif self._compiled is not None:
+        elif isinstance(self._compiled, CompiledPipelineTrainer):
             # switching to the eager (scaler) path: surface the compiled
             # progress and drop the trainer so no step is lost either way
             self._compiled.sync_to_model()
@@ -184,7 +202,7 @@ class PipelineParallel(nn.Layer):
     def state_dict(self, *a, **k):
         # the compiled trainer owns the live (trained) arrays; surface
         # them through the module so checkpoints see training progress
-        if self._compiled is not None:
+        if isinstance(self._compiled, CompiledPipelineTrainer):
             self._compiled.sync_to_model()
         return super().state_dict(*a, **k)
 
@@ -197,7 +215,7 @@ class PipelineParallel(nn.Layer):
         return out
 
     def eval_batch(self, data, compute_loss=True):
-        if self._compiled is not None:
+        if isinstance(self._compiled, CompiledPipelineTrainer):
             self._compiled.sync_to_model()
         x, y = data
         net = self._sub_layers["_layers"]

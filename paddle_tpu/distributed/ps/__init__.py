@@ -332,9 +332,11 @@ class SsdSparseTable(SparseTable):
     rows). Thread-safe under the table lock like the in-memory tables."""
 
     def __init__(self, table_id, emb_dim, path, lr=0.01, entry=None,
-                 initializer=None, seed=0, cache_rows=100_000):
+                 initializer=None, seed=0, cache_rows=100_000,
+                 optimizer="sgd"):
         super().__init__(table_id, emb_dim, lr=lr, entry=entry,
-                         initializer=initializer, seed=seed)
+                         initializer=initializer, seed=seed,
+                         optimizer=optimizer)
         self.cache_rows = int(cache_rows)
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -403,24 +405,31 @@ class SsdSparseTable(SparseTable):
         self._dead_bytes = 0
 
     # -- table API --------------------------------------------------------
+    def _materialize(self, key):
+        """Hot row for `key`, promoting from the SSD log when spilled
+        (offset dropped, dead bytes accounted). None when absent in both
+        tiers. Called under self._lock."""
+        row = self.rows.get(key)
+        if row is None:
+            row = self._load(key)
+            if row is not None:
+                self.rows[key] = row
+                self._offsets.pop(key, None)
+                self._dead_bytes += self._row_bytes
+        return row
+
     def pull(self, ids):
         out = np.zeros((len(ids), self.emb_dim), np.float32)
         with self._lock:
             for i, key in enumerate(ids):
                 key = int(key)
-                row = self.rows.get(key)
-                if row is None:
-                    row = self._load(key)     # promote from SSD
-                    if row is not None:
-                        self.rows[key] = row
-                        self._offsets.pop(key, None)
-                        self._dead_bytes += self._row_bytes
+                row = self._materialize(key)
                 if row is None and self._admit(key):
                     row = self._init()
                     self.rows[key] = row
                 if row is not None:
-                    self._note(key)
                     out[i] = row
+                    self._note(key)
             self._spill_cold()
         return out
 
@@ -429,15 +438,13 @@ class SsdSparseTable(SparseTable):
         with self._lock:
             for i, key in enumerate(ids):
                 key = int(key)
-                row = self.rows.get(key)
-                if row is None:
-                    row = self._load(key)
-                    if row is not None:
-                        self.rows[key] = row
-                        self._offsets.pop(key, None)
-                        self._dead_bytes += self._row_bytes
+                row = self._materialize(key)
                 if row is not None:
-                    row -= self.lr * grads[i]
+                    st = self._opt_states.get(key)
+                    if st is None:
+                        st = self._rule.make_state(row.shape)
+                    self._opt_states[key] = self._rule.apply(
+                        row, grads[i], st)
                     self._note(key)
             self._spill_cold()
 
@@ -449,13 +456,7 @@ class SsdSparseTable(SparseTable):
         with self._lock:
             for i, key in enumerate(ids):
                 key = int(key)
-                row = self.rows.get(key)
-                if row is None:
-                    row = self._load(key)
-                    if row is not None:
-                        self.rows[key] = row
-                        self._offsets.pop(key, None)
-                        self._dead_bytes += self._row_bytes
+                row = self._materialize(key)
                 if row is None:
                     if not self._admit(key):
                         continue
@@ -809,9 +810,10 @@ class GeoWorker:
     def sync(self):
         for table, d in self._dense.items():
             delta = d["local"] - d["base"]
-            if not delta.any():
-                continue  # untouched table: skip the no-op round trip
-            self.client.push_dense_delta(table, delta)
+            if delta.any():        # skip only the no-op PUSH; the refresh
+                self.client.push_dense_delta(table, delta)
+            # always re-pull: a read-only worker must still see peers'
+            # updates (matching the sparse branch below)
             fresh = np.asarray(self.client.pull_dense(table), np.float32)
             d["local"] = fresh.copy()
             d["base"] = fresh.copy()

@@ -74,7 +74,8 @@ type Predictor struct {
 
 func NewPredictor(cfg *Config) *Predictor {
 	pred := &Predictor{p: C.PD_PredictorCreate(cfg.c)}
-	cfg.c = nil // ownership transferred, as in the C contract
+	// the C ABI does NOT take ownership of the config (the C test calls
+	// PD_ConfigDestroy after PD_PredictorCreate); cfg's finalizer frees it
 	runtime.SetFinalizer(pred, func(p *Predictor) { p.Destroy() })
 	return pred
 }
@@ -122,8 +123,9 @@ func (p *Predictor) GetOutputHandle(name string) *Tensor {
 }
 
 // Run executes the compiled program; false on failure.
+// (PD_PredictorRun returns 1 on success — inference_capi.c.)
 func (p *Predictor) Run() bool {
-	return C.PD_PredictorRun(p.p) == 0
+	return C.PD_PredictorRun(p.p) != 0
 }
 
 func (p *Predictor) Destroy() {

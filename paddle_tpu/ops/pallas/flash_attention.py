@@ -164,6 +164,84 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 # backward
 # ---------------------------------------------------------------------------
 
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                      dqp_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                      scale, causal, block_q, block_k, seq_len):
+    """Single-pass backward (round 5): s, p and dp are computed ONCE per
+    (k, q) tile and contracted into all three gradients — the two-pass
+    form recomputed s and dp in each pass (7 tile-matmuls + 2 exp sweeps
+    per tile pair; this kernel does 5 + 1). dk/dv accumulate in VMEM
+    scratch across the inner q loop; dq contributions land in a
+    per-k-slice partial buffer [nk, BH, S, D] summed by XLA outside (a
+    cheap reduction beats cross-iteration read-modify-write aliasing)."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _update():
+        # bf16 dot operands / f32 accumulation (see _fwd_kernel note)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        o = o_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]              # [BQ, 1]
+        delta = jnp.sum(do * o, axis=1, keepdims=True)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, block_k), 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        if seq_len % block_k:
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, block_k), 1)
+            s = jnp.where(cols < seq_len, s, NEG_INF)
+        p = jnp.exp(s - lse)                         # [BQ, BK]
+        dp = jax.lax.dot_general(
+            do_ref[0], v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                # [BQ, BK]
+        ds16 = ds.astype(q.dtype)
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(q.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [BK, D]
+        dk_acc[...] += jax.lax.dot_general(
+            ds16, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [BK, D]
+        dqp_ref[0, 0] = jax.lax.dot_general(
+            ds16, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [BQ, D]
+
+    def _skip():
+        # the block buffer is uninitialized memory: a skipped causal tile
+        # must still zero its dq partial slot
+        dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1)(_update)
+        pl.when(k_start > q_start + block_q - 1)(_skip)
+    else:
+        _update()
+
+    @pl.when(qi == nq - 1)
+    def _final():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
                    dq_acc, *, scale, causal, block_q, block_k, seq_len):
     qi = pl.program_id(1)
@@ -280,6 +358,51 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
                bwd_block_q=None, bwd_block_k=None):
+    block_q = bwd_block_q or min(block_q, 1024)
+    block_k = bwd_block_k or min(block_k, 1024)
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+
+    dqp, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=sk),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, LSE_LANES, block_q), lambda b, j, i: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, j, i: (j, b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nk, bh, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret_mode(),
+        compiler_params=_cparams(),
+    )(q, k, v, o, do, lse)
+    dq = dqp.sum(axis=0).astype(q.dtype)
+    return dq, dk, dv
+
+
+def _flash_bwd_twopass(q, k, v, o, lse, do, scale, causal, block_q,
+                       block_k, bwd_block_q=None, bwd_block_k=None):
+    """The pre-round-5 two-pass backward, kept for A/B measurement."""
     block_q = bwd_block_q or min(block_q, 512)
     block_k = bwd_block_k or min(block_k, 1024)
     bh, sq, d = q.shape

@@ -333,3 +333,18 @@ class TestSsdGeoDelta:
         t.push_delta([9], np.array([[1.0, 1.0]], np.float32))
         t.push_delta([9], np.array([[1.0, 1.0]], np.float32))
         assert t.size() == 1        # third touch admits, init + delta
+
+
+def test_ssd_table_server_side_adam(tmp_path):
+    """The SSD tier honors the optimizer rule (round-5 review): adam
+    moments per row, rows spill/promote without losing convergence."""
+    from paddle_tpu.distributed.ps import SsdSparseTable
+    t = SsdSparseTable(0, emb_dim=2, path=str(tmp_path / "ssd"),
+                       lr=0.05, cache_rows=2, optimizer="adam")
+    keys = [1, 2, 3]            # 3 keys, cache 2: constant spill traffic
+    t.pull(keys)
+    for _ in range(120):
+        for k in keys:
+            t.push_grad([k], 2.0 * t.pull([k]))
+    assert np.abs(t.pull(keys)).max() < 0.05
+    assert all(k in t._opt_states for k in keys)
