@@ -345,7 +345,13 @@ class SsdSparseTable(SparseTable):
         self._dead_bytes = 0
         self._lru = {}               # id -> tick (monotonic access order)
         self._tick = 0
-        self._row_bytes = 4 * emb_dim
+        # a log record carries the row PLUS its optimizer state (adam:
+        # m, v, t) so spilling bounds RAM — per-row moments would
+        # otherwise accumulate in _opt_states for every ever-touched id,
+        # and a promoted row would restart its bias-correction count
+        self._state_floats = 0 if isinstance(self._rule, SgdRule) \
+            else 2 * emb_dim + 1
+        self._row_bytes = 4 * (emb_dim + self._state_floats)
 
     # -- spill/promote (called under self._lock) --------------------------
     def _note(self, key):
@@ -363,7 +369,15 @@ class SsdSparseTable(SparseTable):
         for victim in victims:
             row = self.rows.pop(victim)
             off = self._log.tell()
-            self._log.write(row.astype(np.float32).tobytes())
+            rec = row.astype(np.float32)
+            if self._state_floats:
+                st = self._opt_states.pop(victim, None)
+                if st is None:
+                    st = self._rule.make_state(row.shape)
+                rec = np.concatenate(
+                    [rec, st["m"], st["v"],
+                     np.array([st["t"]], np.float32)])
+            self._log.write(rec.tobytes())
             if victim in self._offsets:
                 self._dead_bytes += self._row_bytes
             self._offsets[victim] = off
@@ -377,12 +391,23 @@ class SsdSparseTable(SparseTable):
         return self._log.tell()
 
     def _load(self, key):
+        """Promote a record from the log: returns the row and restores
+        the spilled optimizer state into _opt_states (only when trained:
+        t > 0 — untrained zero-state stays out of the dict)."""
         off = self._offsets.get(key)
         if off is None:
             return None
         self._log.seek(off)
-        buf = self._log.read(self._row_bytes)
-        return np.frombuffer(buf, np.float32).copy()
+        buf = np.frombuffer(self._log.read(self._row_bytes),
+                            np.float32).copy()
+        row = buf[:self.emb_dim]
+        if self._state_floats:
+            d = self.emb_dim
+            t = int(buf[3 * d])
+            if t > 0:
+                self._opt_states[key] = {"m": buf[d:2 * d],
+                                         "v": buf[2 * d:3 * d], "t": t}
+        return row
 
     def _compact(self):
         """Rewrite only live rows (reference ssd table compaction).

@@ -403,7 +403,7 @@ def fused_multi_transformer(
         activation="gelu", training=False, mode="upscale_in_train",
         trans_qkvw=True, ring_id=-1, norm_type="layernorm",
         use_neox_rotary_style=False, gqa_group_size=-1, name=None,
-        _dequant=None):
+        _dequant=None, _mm=None):
     """Whole-decoder-stack fused transformer (reference
     fused_multi_transformer op: python/paddle/incubate/nn/functional/
     fused_transformer.py:1053 over
@@ -443,6 +443,10 @@ def fused_multi_transformer(
     caches_in = cache_kvs if cache_kvs is not None else []
     pre_in = pre_caches if pre_caches is not None else []
     dq = _dequant or (lambda w, kind, li: w)
+    # _mm(z2d, kind, li) -> z2d @ W[kind][li]: when provided (the Pallas
+    # weight-only-quant serving path, ops/pallas/quant_matmul.py), the
+    # four projection matmuls run the in-kernel-dequant GEMM instead of
+    # dequantize-then-einsum — quantized bytes are all that leave HBM
 
     def impl(xa, lns, lnb, qkvw, qkvb, linw, linb, flns, flnb, f1w, f1b,
              f2w, f2b, caches, pres, rotary, tstep, mask, slens, dkeys):
@@ -456,8 +460,22 @@ def fused_multi_transformer(
             resid = h
             z = norm(h, lns[li], lnb[li] if lnb else None) \
                 if pre_layer_norm else h
-            w = dq(qkvw[li], "qkv", li)
-            if G:
+            if _mm is not None and trans_qkvw:
+                qkv = _mm(z.reshape(b * s, e), qkvw[li], "qkv",
+                          li).reshape((b, s) + _mm.qkv_out)
+                if qkvb and qkvb[li] is not None:
+                    qkv = qkv + qkvb[li][None, None]
+                if G:
+                    ht, hd = _mm.qkv_out
+                    nh = ht - 2 * G
+                    q = qkv[:, :, :nh]
+                    k = qkv[:, :, nh:nh + G]
+                    v = qkv[:, :, nh + G:]
+                else:
+                    nh, hd = _mm.qkv_out[1], _mm.qkv_out[2]
+                    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            elif G:
+                w = dq(qkvw[li], "qkv", li)
                 # GQA packing (reference fused_transformer.py:1009 /
                 # infermeta/fusion.cc gqa branch): weight [H + 2G, D, E]
                 # — H query heads, then G key heads, then G value heads
@@ -472,6 +490,7 @@ def fused_multi_transformer(
                 k = qkv[:, :, nh:nh + G]                 # [B,S,G,D]
                 v = qkv[:, :, nh + G:]
             else:
+                w = dq(qkvw[li], "qkv", li)
                 if not trans_qkvw:
                     # [E, 3, H, D] layout -> [3, H, D, E]
                     w = jnp.transpose(w, (1, 2, 3, 0))
@@ -584,7 +603,11 @@ def fused_multi_transformer(
                     vc = jax.lax.dynamic_update_slice_in_dim(
                         cache[1], vv.transpose(0, 2, 1, 3), 0, axis=2)
                     new_caches.append(jnp.stack([kc, vc]))
-            attn = ctx.reshape(b, s, nh * hd) @ dq(linw[li], "lin", li)
+            if _mm is not None:
+                attn = _mm(ctx.reshape(b * s, nh * hd), linw[li],
+                           "lin", li).reshape(b, s, -1)
+            else:
+                attn = ctx.reshape(b, s, nh * hd) @ dq(linw[li], "lin", li)
             if linb and linb[li] is not None:
                 attn = attn + linb[li]
             if training and dropout_rate:
@@ -599,7 +622,11 @@ def fused_multi_transformer(
             resid2 = h
             z2 = norm(h, flns[li], flnb[li] if flnb else None) \
                 if pre_layer_norm else h
-            f1 = z2 @ dq(f1w[li], "f1", li)
+            if _mm is not None:
+                f1 = _mm(z2.reshape(b * s, -1), f1w[li], "f1",
+                         li).reshape(b, s, -1)
+            else:
+                f1 = z2 @ dq(f1w[li], "f1", li)
             if f1b and f1b[li] is not None:
                 f1 = f1 + f1b[li]
             if activation.endswith("glu"):
@@ -610,7 +637,11 @@ def fused_multi_transformer(
                 f1 = jax.nn.relu(f1)
             else:
                 f1 = jax.nn.gelu(f1)
-            f2 = f1 @ dq(f2w[li], "f2", li)
+            if _mm is not None:
+                f2 = _mm(f1.reshape(b * s, -1), f2w[li], "f2",
+                         li).reshape(b, s, -1)
+            else:
+                f2 = f1 @ dq(f2w[li], "f2", li)
             if f2b and f2b[li] is not None:
                 f2 = f2 + f2b[li]
             h = resid2 * residual_alpha + f2

@@ -75,7 +75,10 @@ type Predictor struct {
 func NewPredictor(cfg *Config) *Predictor {
 	pred := &Predictor{p: C.PD_PredictorCreate(cfg.c)}
 	// the C ABI does NOT take ownership of the config (the C test calls
-	// PD_ConfigDestroy after PD_PredictorCreate); cfg's finalizer frees it
+	// PD_ConfigDestroy after PD_PredictorCreate); cfg's finalizer frees
+	// it — KeepAlive stops the GC from running that finalizer while the
+	// C side is still reading cfg.c's strings
+	runtime.KeepAlive(cfg)
 	runtime.SetFinalizer(pred, func(p *Predictor) { p.Destroy() })
 	return pred
 }
