@@ -134,10 +134,26 @@ class LlamaAttention(nn.Layer):
                                                     self.head_dim])
             v = self.v_proj(hidden_states).reshape([b, s, self.num_kv_heads,
                                                     self.head_dim])
-        q, k, v = F.fused_rotary_position_embedding(
-            q, k, v, position_ids=position_ids,
-            use_neox_rotary_style=True, rotary_emb_base=self.config.rope_theta)
+        # rope-in-attention (round-5 capability, default OFF): the kernel
+        # can apply the cos/sin tables itself (rotated q/k never reach
+        # HBM), but the flagship A/B measured it SLOWER (38.3k vs 39.9k
+        # tok/s even with loop-invariant rotations hoisted to scratch) —
+        # the unavoidable in-loop tile rotations cost more than the
+        # ~29 ms/step of elementwise rope fusions they save. Worth
+        # revisiting for shapes with fewer tile revisits.
+        rope_tabs = None
+        fuse_rope = getattr(self.config, "fuse_rope_in_attention", False)
         cp_axis = self._context_parallel_axis()
+        if (fuse_rope and position_ids is None and attn_mask is None
+                and cp_axis is None):
+            from ..nn.functional.rope import rotary_embedding_cos_sin
+            rope_tabs = rotary_embedding_cos_sin(
+                s, self.head_dim, base=self.config.rope_theta)
+        else:
+            q, k, v = F.fused_rotary_position_embedding(
+                q, k, v, position_ids=position_ids,
+                use_neox_rotary_style=True,
+                rotary_emb_base=self.config.rope_theta)
         if cp_axis is not None and attn_mask is None:
             # context parallelism (long-context first-class, SURVEY §5.7
             # capability upgrade — absent from the reference core).
@@ -158,7 +174,12 @@ class LlamaAttention(nn.Layer):
             out = attn(q, k, v, causal=True, mesh=get_mesh(),
                        axis_name=cp_axis)
         elif attn_mask is None:
-            out, _ = F.flash_attention(q, k, v, causal=True)
+            if rope_tabs is not None:
+                out, _ = F.flash_attention(q, k, v, causal=True,
+                                           rope_cos=rope_tabs[0],
+                                           rope_sin=rope_tabs[1])
+            else:
+                out, _ = F.flash_attention(q, k, v, causal=True)
         else:
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                                  is_causal=True)

@@ -70,25 +70,39 @@ def _sdpa_ref(q, k, v, mask=None, dropout=0.0, causal=False, scale=None,
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
-                    return_softmax=False, fixed_seed_offset=None, training=True):
+                    return_softmax=False, fixed_seed_offset=None,
+                    training=True, rope_cos=None, rope_sin=None):
     """paddle.nn.functional.flash_attention.flash_attention parity:
     inputs [batch, seqlen, num_heads, head_dim]; returns (out, softmax|None).
 
     On TPU dispatches to the Pallas flash kernel (M7); elsewhere uses the XLA
     reference path (XLA fuses it reasonably; the Pallas kernel wins at long
-    sequence)."""
+    sequence). rope_cos/rope_sin [S, D/2] (neox): applied to q/k INSIDE the
+    Pallas kernels when available, otherwise rotated before the reference
+    path — either way rotated q/k are an implementation detail."""
     if _flash_available() and dropout == 0.0 and not return_softmax:
         from ...ops.pallas import flash_attention as pallas_flash
         try:
             bq, bk = pallas_flash.tuned_blocks(query, key, value, causal)
 
-            def impl(q, k, v):
+            def impl(q, k, v, rc=None, rs=None):
                 return pallas_flash.flash_attention_bshd(
-                    q, k, v, causal=causal, block_q=bq, block_k=bk)
-            out = apply_op("flash_attention", impl, (query, key, value), {})
+                    q, k, v, causal=causal, block_q=bq, block_k=bk,
+                    rope_cos=rc, rope_sin=rs)
+
+            if rope_cos is None:
+                args = (query, key, value)
+            else:
+                args = (query, key, value, rope_cos, rope_sin)
+            out = apply_op("flash_attention", impl, args, {})
             return out, None
         except Exception:
             pass  # fall through to reference path
+    if rope_cos is not None:
+        # non-kernel path: rotate explicitly (same math, materialized)
+        from .rope import apply_rotary_pos_emb
+        query = apply_rotary_pos_emb(query, rope_cos, rope_sin, True)
+        key = apply_rotary_pos_emb(key, rope_cos, rope_sin, True)
 
     if dropout > 0.0 and training:
         def impl(q, k, v, rk):

@@ -55,8 +55,33 @@ def _pick_block(seq_len, default):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
-                scale, causal, block_q, block_k, seq_len):
+def _rope_fwd(x, cos, sin):
+    """Neox rotation on a [N, D] tile: [x1 c - x2 s, x2 c + x1 s] with
+    cos/sin [N, D/2] (same math/dtype as nn/functional/rope._rotate,
+    computed in the tile's dtype)."""
+    half = x.shape[1] // 2
+    x1, x2 = x[:, :half], x[:, half:]
+    c = cos.astype(x.dtype)
+    s = sin.astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=1)
+
+
+def _rope_bwd(g, cos, sin):
+    """Transpose of _rope_fwd: dx = [g1 c + g2 s, g2 c - g1 s]."""
+    half = g.shape[1] // 2
+    g1, g2 = g[:, :half], g[:, half:]
+    c = cos.astype(g.dtype)
+    s = sin.astype(g.dtype)
+    return jnp.concatenate([g1 * c + g2 * s, g2 * c - g1 * s], axis=1)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
+                scale, causal, block_q, block_k, seq_len, rope):
+    if rope:
+        cq_ref, sq_ref, ck_ref, sk_ref = rest[:4]
+        o_ref, lse_ref, acc, m_scr, l_scr, qrot_scr = rest[4:]
+    else:
+        o_ref, lse_ref, acc, m_scr, l_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -66,6 +91,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         acc[...] = jnp.zeros_like(acc)
         m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
+        if rope:
+            # the q tile is loop-invariant across the k sweep: rotate
+            # ONCE into scratch (the k tile changes per step and must
+            # rotate in-loop)
+            qrot_scr[...] = _rope_fwd(q_ref[0], cq_ref[...], sq_ref[...])
 
     q_start = qi * block_q
     k_start = ki * block_k
@@ -74,9 +104,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         # dots take the NATIVE (bf16) operands with f32 accumulation: an
         # f32 x f32 MXU pass runs at ~1/4 the bf16 rate on v5e, and this
         # kernel is matmul-bound. Softmax math stays f32.
-        q = q_ref[0]                       # [BQ, D]
+        q = qrot_scr[...] if rope else q_ref[0]   # [BQ, D]
         k = k_ref[0]                       # [BK, D]
         v = v_ref[0]                       # [BK, D]
+        if rope:
+            # rope folded into the kernel: rotated q/k never reach HBM
+            k = _rope_fwd(k, ck_ref[...], sk_ref[...])
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [BQ, BK]
@@ -121,10 +154,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
                                       lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
-    """q,k,v: [BH, S, D] -> (o [BH, S, D], lse [BH, LSE_LANES, S])."""
+def _rope_specs(block_q, block_k, d, q_index, k_index):
+    """cos/sin [S, D/2] operand specs: q-row slices then k-row slices;
+    q_index/k_index map the grid coords to the row-block index (the fwd
+    grid is (b, qi, ki), the fused bwd grid (b, ki, qi))."""
+    return [
+        pl.BlockSpec((block_q, d // 2), q_index),
+        pl.BlockSpec((block_q, d // 2), q_index),
+        pl.BlockSpec((block_k, d // 2), k_index),
+        pl.BlockSpec((block_k, d // 2), k_index),
+    ]
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, rope_cos=None,
+               rope_sin=None):
+    """q,k,v: [BH, S, D] -> (o [BH, S, D], lse [BH, LSE_LANES, S]).
+    rope_cos/rope_sin [S, D/2]: neox rotation applied in-kernel."""
     bh, sq, d = q.shape
     sk = k.shape[1]
+    rope = rope_cos is not None
     block_q = _pick_block(sq, block_q)
     block_k = _pick_block(sk, block_k)
     nq = pl.cdiv(sq, block_q)
@@ -132,15 +180,22 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_len=sk)
+        block_q=block_q, block_k=block_k, seq_len=sk, rope=rope)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    operands = [q, k, v]
+    if rope:
+        in_specs += _rope_specs(block_q, block_k, d,
+                                lambda b, i, j: (i, 0),
+                                lambda b, i, j: (j, 0))
+        operands += [rope_cos, rope_sin, rope_cos, rope_sin]
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, LSE_LANES, block_q), lambda b, i, j: (b, 0, i)),
@@ -153,10 +208,10 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, LANES), jnp.float32),
             pltpu.VMEM((block_q, LANES), jnp.float32),
-        ],
+        ] + ([pltpu.VMEM((block_q, d), q.dtype)] if rope else []),
         interpret=_interpret_mode(),
         compiler_params=_cparams(),
-    )(q, k, v)
+    )(*operands)
     return o, lse
 
 
@@ -165,9 +220,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                      dqp_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                      scale, causal, block_q, block_k, seq_len):
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
+                      scale, causal, block_q, block_k, seq_len, rope):
     """Single-pass backward (round 5): s, p and dp are computed ONCE per
     (k, q) tile and contracted into all three gradients — the two-pass
     form recomputed s and dp in each pass (7 tile-matmuls + 2 exp sweeps
@@ -175,6 +229,11 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     scratch across the inner q loop; dq contributions land in a
     per-k-slice partial buffer [nk, BH, S, D] summed by XLA outside (a
     cheap reduction beats cross-iteration read-modify-write aliasing)."""
+    if rope:
+        cq_ref, sq_ref, ck_ref, sk_ref = rest[:4]
+        dqp_ref, dk_ref, dv_ref, dk_acc, dv_acc, krot_scr = rest[4:]
+    else:
+        dqp_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -183,6 +242,9 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
+        if rope:
+            # the k tile is loop-invariant across the q sweep here
+            krot_scr[...] = _rope_fwd(k_ref[0], ck_ref[...], sk_ref[...])
 
     q_start = qi * block_q
     k_start = ki * block_k
@@ -190,7 +252,9 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     def _update():
         # bf16 dot operands / f32 accumulation (see _fwd_kernel note)
         q = q_ref[0]
-        k = k_ref[0]
+        if rope:
+            q = _rope_fwd(q, cq_ref[...], sq_ref[...])
+        k = krot_scr[...] if rope else k_ref[0]
         v = v_ref[0]
         o = o_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
@@ -221,9 +285,13 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dk_acc[...] += jax.lax.dot_general(
             ds16, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # [BK, D]
-        dqp_ref[0, 0] = jax.lax.dot_general(
+        dq_rot = jax.lax.dot_general(
             ds16, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # [BQ, D]
+        if rope:
+            # counter-rotate: grads flow to the UNROTATED q
+            dq_rot = _rope_bwd(dq_rot, cq_ref[...], sq_ref[...])
+        dqp_ref[0, 0] = dq_rot
 
     def _skip():
         # the block buffer is uninitialized memory: a skipped causal tile
@@ -238,7 +306,10 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     @pl.when(qi == nq - 1)
     def _final():
-        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dk_fin = dk_acc[...]
+        if rope:
+            dk_fin = _rope_bwd(dk_fin, ck_ref[...], sk_ref[...])
+        dk_ref[0] = dk_fin.astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
@@ -357,28 +428,39 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
-               bwd_block_q=None, bwd_block_k=None):
+               bwd_block_q=None, bwd_block_k=None, rope_cos=None,
+               rope_sin=None):
     block_q = bwd_block_q or min(block_q, 1024)
     block_k = bwd_block_k or min(block_k, 1024)
     bh, sq, d = q.shape
     sk = k.shape[1]
+    rope = rope_cos is not None
     block_q = _pick_block(sq, block_q)
     block_k = _pick_block(sk, block_k)
     nq = pl.cdiv(sq, block_q)
     nk = pl.cdiv(sk, block_k)
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, LSE_LANES, block_q), lambda b, j, i: (b, 0, i)),
+    ]
+    operands = [q, k, v, o, do, lse]
+    if rope:
+        in_specs += _rope_specs(block_q, block_k, d,
+                                lambda b, j, i: (i, 0),
+                                lambda b, j, i: (j, 0))
+        operands += [rope_cos, rope_sin, rope_cos, rope_sin]
+
     dqp, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=sk),
+                          block_q=block_q, block_k=block_k, seq_len=sk,
+                          rope=rope),
         grid=(bh, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, LSE_LANES, block_q), lambda b, j, i: (b, 0, i)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, j, i: (j, b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -392,17 +474,24 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
-        ],
+        ] + ([pltpu.VMEM((block_k, d), k.dtype)] if rope else []),
         interpret=_interpret_mode(),
         compiler_params=_cparams(),
-    )(q, k, v, o, do, lse)
+    )(*operands)
     dq = dqp.sum(axis=0).astype(q.dtype)
     return dq, dk, dv
 
 
 def _flash_bwd_twopass(q, k, v, o, lse, do, scale, causal, block_q,
-                       block_k, bwd_block_q=None, bwd_block_k=None):
-    """The pre-round-5 two-pass backward, kept for A/B measurement."""
+                       block_k, bwd_block_q=None, bwd_block_k=None,
+                       rope_cos=None, rope_sin=None):
+    """The pre-round-5 two-pass backward, kept for A/B measurement.
+    No rope support: refuse rather than silently compute unrotated
+    gradients (the A/B must be run with fuse_rope_in_attention off)."""
+    if rope_cos is not None:
+        raise NotImplementedError(
+            "_flash_bwd_twopass has no in-kernel rope; A/B with "
+            "fuse_rope_in_attention=False")
     block_q = bwd_block_q or min(block_q, 512)
     block_k = bwd_block_k or min(block_k, 1024)
     bh, sq, d = q.shape
@@ -465,25 +554,28 @@ def _flash_bwd_twopass(q, k, v, o, lse, do, scale, causal, block_q,
 # custom_vjp wrapper ([B, S, H, D] native layout)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, scale, causal, block_q, block_k,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, rope_cos, rope_sin, scale, causal, block_q, block_k,
            bwd_block_q=None, bwd_block_k=None):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                      rope_cos, rope_sin)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k,
-                   bwd_block_q, bwd_block_k):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
-    return o, (q, k, v, o, lse)
+def _flash_vjp_fwd(q, k, v, rope_cos, rope_sin, scale, causal, block_q,
+                   block_k, bwd_block_q, bwd_block_k):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        rope_cos, rope_sin)
+    return o, (q, k, v, rope_cos, rope_sin, o, lse)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, bwd_block_q,
                    bwd_block_k, res, do):
-    q, k, v, o, lse = res
+    q, k, v, rope_cos, rope_sin, o, lse = res
     dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, scale, causal,
-                            block_q, block_k, bwd_block_q, bwd_block_k)
-    return dq, dk, dv
+                            block_q, block_k, bwd_block_q, bwd_block_k,
+                            rope_cos, rope_sin)
+    return dq, dk, dv, None, None  # cos/sin: no grads (fixed tables)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -491,8 +583,12 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention_bhsd(q, k, v, causal=True, scale=None,
                          block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                         bwd_block_q=None, bwd_block_k=None):
+                         bwd_block_q=None, bwd_block_k=None,
+                         rope_cos=None, rope_sin=None):
     """q,k,v: [B, H, S, D] (kv heads already matched to q heads).
+    rope_cos/rope_sin [S, D/2]: neox rotary embedding applied to q and k
+    INSIDE the kernels (fwd rotate, bwd counter-rotate) — the rotated
+    tensors never materialize in HBM.
 
     (A round-5 experiment moved the kernels to 4-D [B, H, S, D] blocks with
     GQA in the index maps; the isolated kernel was equally fast but the
@@ -505,16 +601,18 @@ def flash_attention_bhsd(q, k, v, causal=True, scale=None,
     qf = q.reshape(b * h, s, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
-    o = _flash(qf, kf, vf, float(scale), bool(causal), block_q, block_k,
-               bwd_block_q, bwd_block_k)
+    o = _flash(qf, kf, vf, rope_cos, rope_sin, float(scale), bool(causal),
+               block_q, block_k, bwd_block_q, bwd_block_k)
     return o.reshape(b, h, s, d)
 
 
 def flash_attention_bshd(q, k, v, causal=True, scale=None,
                          block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                         bwd_block_q=None, bwd_block_k=None):
+                         bwd_block_q=None, bwd_block_k=None,
+                         rope_cos=None, rope_sin=None):
     """q,k,v: [B, S, H, D] (paddle flash_attention layout). GQA: kv heads
-    are broadcast up to the query head count."""
+    are broadcast up to the query head count. rope_cos/rope_sin: see
+    flash_attention_bhsd."""
     hq, hk = q.shape[2], k.shape[2]
     if hk != hq:
         rep = hq // hk
@@ -523,7 +621,8 @@ def flash_attention_bshd(q, k, v, causal=True, scale=None,
     o = flash_attention_bhsd(
         jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
         causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-        bwd_block_q=bwd_block_q, bwd_block_k=bwd_block_k)
+        bwd_block_q=bwd_block_q, bwd_block_k=bwd_block_k,
+        rope_cos=rope_cos, rope_sin=rope_sin)
     return jnp.swapaxes(o, 1, 2)
 
 

@@ -661,3 +661,74 @@ class TestFusedMultiTransformerInt4:
         # the packed weights really are half-size
         assert qkv4[0].numpy().nbytes * 2 == \
             w["qkv_weights"][0].numpy().astype(np.int8).nbytes
+
+
+class TestRopeInFlashKernel:
+    """Round-5 opt-in capability: neox rope applied INSIDE the flash
+    kernels (fwd rotate, bwd counter-rotate). Default OFF on the flagship
+    (measured slower: per-tile re-rotation beats the saved HBM traffic —
+    BASELINE.md round-5 notes); correctness is gated here."""
+
+    def test_matches_pre_rotated_reference(self):
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu.ops.pallas.flash_attention as FA
+        from paddle_tpu.nn.functional.rope import (
+            _rotate, rotary_embedding_cos_sin)
+        old = FA._INTERPRET
+        FA._INTERPRET = True
+        try:
+            rng = np.random.default_rng(0)
+            B, S, H, D = 2, 128, 4, 64
+            q = jnp.asarray(rng.normal(size=(B, S, H, D)).astype(np.float32))
+            k = jnp.asarray(rng.normal(size=(B, S, H, D)).astype(np.float32))
+            v = jnp.asarray(rng.normal(size=(B, S, H, D)).astype(np.float32))
+            cos, sin = rotary_embedding_cos_sin(S, D)
+
+            def fused(q, k, v):
+                return FA.flash_attention_bshd(
+                    q, k, v, causal=True, block_q=64, block_k=64,
+                    bwd_block_q=64, bwd_block_k=64,
+                    rope_cos=cos, rope_sin=sin)
+
+            def ref(q, k, v):
+                return FA.flash_attention_bshd(
+                    _rotate(q, cos, sin, True), _rotate(k, cos, sin, True),
+                    v, causal=True, block_q=64, block_k=64,
+                    bwd_block_q=64, bwd_block_k=64)
+
+            np.testing.assert_allclose(
+                np.asarray(fused(q, k, v)), np.asarray(ref(q, k, v)),
+                rtol=1e-5, atol=1e-5)
+            g1 = jax.grad(lambda *a: fused(*a).sum(), argnums=(0, 1, 2))(
+                q, k, v)
+            g2 = jax.grad(lambda *a: ref(*a).sum(), argnums=(0, 1, 2))(
+                q, k, v)
+            for a, b in zip(g1, g2):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=1e-5)
+        finally:
+            FA._INTERPRET = old
+
+    def test_llama_flag_consistent(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.ops.pallas.flash_attention as FA
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        old = FA._INTERPRET
+        FA._INTERPRET = True
+        try:
+            rng = np.random.default_rng(0)
+            ids = paddle.to_tensor(
+                rng.integers(0, 128, (2, 32)).astype(np.int32))
+            outs = {}
+            for fuse in (True, False):
+                paddle.seed(7)
+                cfg = LlamaConfig.tiny(dtype="float32",
+                                       fuse_rope_in_attention=fuse)
+                m = LlamaForCausalLM(cfg)
+                m.eval()
+                outs[fuse] = np.asarray(m(ids).numpy())
+            np.testing.assert_allclose(outs[True], outs[False],
+                                       rtol=1e-5, atol=2e-5)
+        finally:
+            FA._INTERPRET = old
