@@ -148,7 +148,21 @@ def get_all_worker_infos():
 def shutdown():
     if not _state:
         return
-    _state["store"].barrier("rpc_shutdown", _state["world_size"])
+    store, ws = _state["store"], _state["world_size"]
+    store.barrier("rpc_shutdown", ws)
+    # teardown race (seen as a loaded-suite flake): rank 0 OWNS the
+    # TCPStore server — if it tears down right after its own barrier
+    # release, a peer still polling wait(go) sees a dead server and
+    # times out. Ack AFTER the barrier; the owner lingers until every
+    # rank has acked (i.e. has observably passed the barrier).
+    n = store.add("__barrier/rpc_shutdown/ack", 1)
+    if n == ws:
+        store.set("__barrier/rpc_shutdown/ack_go", b"1")
+    if _state.get("rank", 0) == 0:
+        try:
+            store.wait("__barrier/rpc_shutdown/ack_go", 30_000)
+        except (TimeoutError, RuntimeError, OSError):
+            pass  # a peer died after its release: still tear down
     _state["stopping"] = True
     for c, _lock in _state["conns"].values():
         c.close()

@@ -710,12 +710,17 @@ class TestRopeInFlashKernel:
         finally:
             FA._INTERPRET = old
 
-    def test_llama_flag_consistent(self):
+    def test_llama_flag_consistent(self, monkeypatch):
         import paddle_tpu as paddle
         import paddle_tpu.ops.pallas.flash_attention as FA
+        import paddle_tpu.nn.functional.attention as ATT
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
         old = FA._INTERPRET
         FA._INTERPRET = True
+        # force the PALLAS branch on CPU (interpret mode) so the
+        # kernel-path rope-operand plumbing through apply_op is what this
+        # test actually compares against the standard rope path
+        monkeypatch.setattr(ATT, "_flash_available", lambda: True)
         try:
             rng = np.random.default_rng(0)
             ids = paddle.to_tensor(
