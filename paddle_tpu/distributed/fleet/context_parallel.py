@@ -29,7 +29,9 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ...framework.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ...core.dispatch import apply_op

@@ -12,7 +12,7 @@ c_softmax_with_cross_entropy."""
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ...framework.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...core.tensor import Tensor

@@ -28,7 +28,9 @@ dp x mp x pp, test_parallel_api_with_llama_3d.py).
 """
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ...framework.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 

@@ -11,7 +11,7 @@ all_gather^T = psum_scatter, which is exactly the pairing the reference
 hand-codes)."""
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ...framework.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ...core.dispatch import apply_op

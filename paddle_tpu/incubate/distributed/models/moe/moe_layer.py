@@ -19,7 +19,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from .....framework.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.core.dispatch import apply_op

@@ -5,3 +5,6 @@ from .layers import (FusedMultiHeadAttention, FusedFeedForward,  # noqa: F401
                      FusedTransformerEncoderLayer,
                      FusedBiasDropoutResidualLayerNorm,
                      FusedLinear, FusedDropoutAdd, FusedMultiTransformer)
+from .continuous_batching import (BlockAllocator,  # noqa: F401
+                                  GenerationRequest,
+                                  ContinuousBatchingEngine)

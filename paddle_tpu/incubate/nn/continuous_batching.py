@@ -1,0 +1,265 @@
+"""Continuous-batching serving over the paged KV cache.
+
+The vLLM-style serving loop the ROADMAP's "heavy traffic from millions of
+users" regime needs: requests of wildly different lengths share one fixed
+pool of cache blocks; a host-side free-list allocator hands blocks to
+sequences as they grow and reclaims them the step a request finishes, and
+every decode step runs ALL in-flight requests — some still consuming
+their prompt, some mid-generation, some slots idle — as ONE compiled
+program (FusedMultiTransformerEngine._paged_step over the ragged Pallas
+kernel, ops/pallas/paged_attention.py).
+
+Host/device split: the allocator, block tables, lengths, and scheduling
+live on the host (tiny int arrays, zero device round trips beyond the
+step itself); the device program's shape is keyed only by the bucketed
+work-list length, so admission and retirement never trigger recompiles
+past the first few power-of-two buckets.
+
+Reference bar: vLLM's continuous batching scheduler + "Ragged Paged
+Attention" (PAPERS.md); the reference framework's analogue is the
+block_multihead_attention serving stack.
+"""
+import collections
+
+import numpy as np
+
+from ...ops.pallas.paged_attention import (build_ragged_work, default_pack,
+                                           next_pow2)
+
+__all__ = ["BlockAllocator", "GenerationRequest", "ContinuousBatchingEngine"]
+
+
+class BlockAllocator:
+    """Free-list over the paged KV cache's physical blocks.
+
+    Block ids [reserved, num_blocks) are allocatable; ids below `reserved`
+    are parking space (idle batch slots point their table row at block 0
+    so the one compiled step program can write SOMEWHERE harmless)."""
+
+    def __init__(self, num_blocks, reserved=1):
+        if num_blocks <= reserved:
+            raise ValueError(
+                f"need more than {reserved} blocks (got {num_blocks})")
+        self.num_blocks = num_blocks
+        self.reserved = reserved
+        self._free = list(range(num_blocks - 1, reserved - 1, -1))
+        self._free_set = set(self._free)  # O(1) double-free check
+
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    def alloc(self):
+        if not self._free:
+            raise RuntimeError("BlockAllocator: out of cache blocks")
+        b = self._free.pop()
+        self._free_set.discard(b)
+        return b
+
+    def free(self, blocks):
+        for b in blocks:
+            if not (self.reserved <= b < self.num_blocks):
+                raise ValueError(f"freeing out-of-pool block {b}")
+            if b in self._free_set:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+            self._free_set.add(b)
+
+
+class GenerationRequest:
+    """One serving request: prompt ids in, up to max_new_tokens out."""
+
+    _next_id = 0
+
+    def __init__(self, prompt_ids, max_new_tokens, request_id=None):
+        self.prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.max_new_tokens = int(max_new_tokens)
+        if request_id is None:
+            request_id = GenerationRequest._next_id
+            GenerationRequest._next_id += 1
+        self.request_id = request_id
+        # runtime state (owned by the engine)
+        self.blocks = []        # physical cache blocks, in table order
+        self.progress = 0       # prompt tokens consumed so far
+        self.generated = []
+
+    @property
+    def done(self):
+        return len(self.generated) >= self.max_new_tokens
+
+    def total_tokens(self):
+        return len(self.prompt) + self.max_new_tokens
+
+    def blocks_needed(self, block_size):
+        return -(-self.total_tokens() // block_size)
+
+
+class ContinuousBatchingEngine:
+    """Per-step admission / retirement scheduler over a
+    FusedMultiTransformerEngine's paged decode mode.
+
+    Each step():
+      1. retire finished requests (free their blocks — eviction),
+      2. admit queued requests into idle slots (FIFO; a request is only
+         admitted when the free list can cover its WORST-CASE footprint,
+         so no in-flight request can ever starve mid-generation),
+      3. grow each active sequence's block list when its next token
+         crosses a block boundary,
+      4. run one compiled decode step over all slots (prompt-phase slots
+         are fed their next prompt token — decode-style prefill — and
+         decode-phase slots their last sampled token).
+
+    Greedy sampling (temperature 0) by default; temperature/top_p thread
+    straight through to the engine's fused sampler.
+    """
+
+    def __init__(self, engine, num_blocks, block_size, max_batch=8,
+                 temperature=0.0, top_p=1.0, seed=0):
+        import jax
+
+        self.engine = engine
+        self.block_size = int(block_size)
+        self.max_batch = int(max_batch)
+        self.max_blocks = engine.max_seq_len // self.block_size
+        if self.max_blocks < 1:
+            raise ValueError("block_size larger than engine.max_seq_len")
+        self.allocator = BlockAllocator(num_blocks)
+        self.caches = engine.new_paged_caches(num_blocks, self.block_size)
+        self.tables = np.zeros((self.max_batch, self.max_blocks), np.int32)
+        self.lens = np.zeros(self.max_batch, np.int32)
+        self.toks = np.zeros(self.max_batch, np.int32)
+        self.slots = [None] * self.max_batch
+        self.queue = collections.deque()
+        self.finished = {}
+        self._temp = float(temperature)
+        self._topp = float(top_p)
+        self._key = jax.random.PRNGKey(int(seed))
+        self._step_count = 0
+        kvh = self.caches[0].shape[1]
+        num_q = engine.num_heads
+        self._pack = default_pack(self.max_batch, num_q // kvh)
+
+    # -- scheduling ---------------------------------------------------------
+
+    def submit(self, request):
+        # table capacity, NOT max_seq_len: when max_seq_len is not a
+        # block multiple the table floor-divides down and the last
+        # partial block's tokens are unreachable
+        capacity = self.max_blocks * self.block_size
+        if request.total_tokens() > capacity:
+            raise ValueError(
+                f"request {request.request_id}: {request.total_tokens()} "
+                f"tokens exceeds the block-table capacity {capacity} "
+                f"({self.max_blocks} blocks x {self.block_size})")
+        if request.blocks_needed(self.block_size) > \
+                self.allocator.num_blocks - self.allocator.reserved:
+            raise ValueError(
+                f"request {request.request_id} can never fit: needs "
+                f"{request.blocks_needed(self.block_size)} blocks, pool "
+                f"has {self.allocator.num_blocks - self.allocator.reserved}")
+        rid = request.request_id
+        if rid in self.finished or any(
+                r.request_id == rid for r in self.queue) or any(
+                r is not None and r.request_id == rid for r in self.slots):
+            raise ValueError(f"duplicate request_id {rid}")
+        self.queue.append(request)
+
+    @property
+    def num_active(self):
+        return sum(r is not None for r in self.slots)
+
+    def _retire(self):
+        for i, req in enumerate(self.slots):
+            if req is not None and req.done:
+                self.allocator.free(req.blocks)
+                req.blocks = []
+                self.slots[i] = None
+                self.tables[i] = 0
+                self.lens[i] = 0
+                self.toks[i] = 0
+                self.finished[req.request_id] = list(req.generated)
+
+    def _admit(self):
+        # FIFO with worst-case reservation: the head request waits until
+        # its full footprint fits, so admitted requests always finish
+        reserved = sum(
+            r.blocks_needed(self.block_size) - len(r.blocks)
+            for r in self.slots if r is not None)
+        for i in range(self.max_batch):
+            if not self.queue:
+                break
+            if self.slots[i] is not None:
+                continue
+            need = self.queue[0].blocks_needed(self.block_size)
+            if reserved + need > self.allocator.num_free:
+                break
+            req = self.queue.popleft()
+            reserved += need
+            req.blocks = []
+            req.progress = 0
+            req.generated = []
+            self.slots[i] = req
+            self.tables[i] = 0
+            self.lens[i] = 0
+
+    def step(self):
+        """One scheduler tick + one compiled decode step. Returns the
+        number of requests still in flight (active + queued)."""
+        import jax
+
+        self._retire()
+        self._admit()
+        active = [i for i, r in enumerate(self.slots) if r is not None]
+        if not active:
+            return len(self.queue)
+        for i in active:
+            req = self.slots[i]
+            if self.lens[i] % self.block_size == 0:
+                blk = self.allocator.alloc()
+                req.blocks.append(blk)
+                self.tables[i, self.lens[i] // self.block_size] = blk
+            self.toks[i] = req.prompt[req.progress] \
+                if req.progress < len(req.prompt) else req.generated[-1]
+        # every slot attends over lens+1 (the token the step appends) —
+        # idle slots sit parked on reserved block 0 with lens 0, so they
+        # cost exactly ONE work-list entry each and their sampled token
+        # is ignored; a zero-entry row would leave its output tile
+        # unvisited (uninitialised VMEM) when a whole pack group is idle
+        attn_lens = (self.lens + 1).astype(np.int32)
+        work, _, _, pack = build_ragged_work(
+            self.tables, attn_lens, self.block_size, self._pack,
+            bucket_to=next_pow2)
+        self._key, sub = jax.random.split(self._key)
+        toks2, self.caches = self.engine._paged_step(
+            self.engine._w, self.caches, np.asarray(self.toks),
+            np.asarray(self.tables), np.asarray(self.lens), tuple(work),
+            pack, np.float32(self._temp), np.float32(self._topp), sub)
+        toks2 = np.asarray(toks2)
+        for i in active:
+            req = self.slots[i]
+            self.lens[i] += 1
+            if req.progress < len(req.prompt):
+                req.progress += 1
+                if req.progress == len(req.prompt):
+                    req.generated.append(int(toks2[i]))
+            else:
+                req.generated.append(int(toks2[i]))
+        self._step_count += 1
+        return len(self.queue) + self.num_active
+
+    def run(self, max_steps=100000):
+        """Drive step() until every submitted request has finished.
+        Returns {request_id: generated token list}."""
+        steps = 0
+        while self.queue or self.num_active:
+            self.step()
+            self._retire()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError("continuous batching did not converge "
+                                   f"within {max_steps} steps")
+        return dict(self.finished)

@@ -393,6 +393,17 @@ def _apply_rope_pair(q, k, cos, sin, neox):
     return q * cos + rot(q) * sin, k * cos + rot(k) * sin
 
 
+def _ragged_group_q(qkv_weights, gqa_group_size, trans_qkvw):
+    """Queries per kv head, recovered from the packed qkv weight layout
+    (needed to pick the ragged kernel's default pack factor)."""
+    w0 = qkv_weights[0]
+    shape = (w0.data if hasattr(w0, "data") else w0).shape
+    if gqa_group_size and gqa_group_size > 0:
+        ht = shape[0] if trans_qkvw else shape[1]
+        return (ht - 2 * gqa_group_size) // gqa_group_size
+    return 1
+
+
 def fused_multi_transformer(
         x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
         linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
@@ -403,6 +414,7 @@ def fused_multi_transformer(
         activation="gelu", training=False, mode="upscale_in_train",
         trans_qkvw=True, ring_id=-1, norm_type="layernorm",
         use_neox_rotary_style=False, gqa_group_size=-1, name=None,
+        block_tables=None, ragged_work=None, ragged_pack=None,
         _dequant=None, _mm=None):
     """Whole-decoder-stack fused transformer (reference
     fused_multi_transformer op: python/paddle/incubate/nn/functional/
@@ -425,6 +437,17 @@ def fused_multi_transformer(
     rotary_embs [2, B, 1, S_rope, D] (cos, sin); time_step: scalar int
     tensor = current decode position (decode mode when given).
 
+    Paged-cache decode (the continuous-batching serving path): pass
+    `block_tables` [B, max_blocks] plus per-layer caches shaped
+    [2, KVH, num_blocks, block_size, D] and per-sequence `seq_lens`; the
+    attention runs the ragged Pallas kernel
+    (ops/pallas/paged_attention.ragged_paged_attention) after appending
+    the new token at slot seq_lens. `ragged_work` is the host-built
+    flattened work list (`build_ragged_work(tables, seq_lens + 1, ...)`
+    — +1 because attention covers the token just appended); required
+    under jit where seq_lens is traced. Decode-only (x must be [B, 1, E]
+    with time_step set).
+
     Returns the output hidden states [B, S, E]; caches are updated
     in place (dygraph reference semantics).
     """
@@ -438,6 +461,54 @@ def fused_multi_transformer(
             "fused_multi_transformer: pre_caches apply to the context/"
             "prefill phase; at decode time the prefix already lives in "
             "cache_kvs (run prefill with pre_caches first)")
+    if block_tables is not None:
+        if time_step is None or seq_lens is None:
+            raise ValueError(
+                "fused_multi_transformer: the paged-cache path is decode-"
+                "only — pass time_step and per-sequence seq_lens with "
+                "block_tables")
+        if not cache_kvs:
+            raise ValueError(
+                "fused_multi_transformer: block_tables without cache_kvs "
+                "— the paged path needs the per-layer paged caches")
+        xs = (x.data if hasattr(x, "data") else x).shape
+        if len(xs) != 3 or xs[1] != 1:
+            raise ValueError(
+                "fused_multi_transformer: paged decode takes one token "
+                f"per sequence (x [B, 1, E]); got {list(xs)}")
+        if attn_mask is not None:
+            raise NotImplementedError(
+                "fused_multi_transformer: attn_mask unsupported on the "
+                "paged decode path")
+        if ragged_work is None:
+            # eager convenience: build the work list from concrete lens
+            import numpy as _np
+            from ....ops.pallas.paged_attention import (build_ragged_work,
+                                                        default_pack)
+            from ....core.tensor import Tensor as _T
+            lens_c = _np.asarray(
+                seq_lens.data if isinstance(seq_lens, _T) else seq_lens)
+            tbl_c = _np.asarray(
+                block_tables.data if isinstance(block_tables, _T)
+                else block_tables)
+            c0 = cache_kvs[0]
+            bs_ = (c0.data if hasattr(c0, "data") else c0).shape[3]
+            ragged_work = build_ragged_work(
+                tbl_c, lens_c + 1, bs_,
+                ragged_pack or default_pack(
+                    lens_c.shape[0],
+                    _ragged_group_q(qkv_weights, gqa_group_size,
+                                    trans_qkvw)))
+        if len(ragged_work) == 4 and isinstance(ragged_work[0],
+                                                (tuple, list)):
+            # the full build_ragged_work result: the carried pack is
+            # authoritative (the work list's group encoding depends on it)
+            if ragged_pack is not None and ragged_pack != ragged_work[3]:
+                raise ValueError(
+                    f"ragged_pack={ragged_pack} conflicts with the work "
+                    f"list (built with pack={ragged_work[3]})")
+            ragged_pack = ragged_work[3]
+            ragged_work = ragged_work[0]
     G = gqa_group_size if gqa_group_size and gqa_group_size > 0 else 0
     n_layers = len(qkv_weights)
     caches_in = cache_kvs if cache_kvs is not None else []
@@ -449,7 +520,8 @@ def fused_multi_transformer(
     # dequantize-then-einsum — quantized bytes are all that leave HBM
 
     def impl(xa, lns, lnb, qkvw, qkvb, linw, linb, flns, flnb, f1w, f1b,
-             f2w, f2b, caches, pres, rotary, tstep, mask, slens, dkeys):
+             f2w, f2b, caches, pres, rotary, tstep, mask, slens, tables_a,
+             rwork, dkeys):
         b, s, e = xa.shape
         norm = (lambda h, sc, bi: _rms(h, epsilon, sc)) \
             if norm_type == "rmsnorm" else \
@@ -524,7 +596,25 @@ def fused_multi_transformer(
             # no jnp.repeat materialisation of KV on the decode hot path)
             g_eff = G or nh
             r = nh // g_eff
-            if tstep is not None and caches:
+            if tstep is not None and caches and tables_a is not None:
+                # paged decode (continuous batching): append this token
+                # into the block owned by each sequence at slot seq_lens,
+                # then run the ragged Pallas kernel over the flattened
+                # work list — grid cost scales with the sum of ACTUAL
+                # per-sequence KV blocks, not B x max_blocks
+                from ....ops.pallas.paged_attention import (
+                    ragged_paged_attention, update_paged_kv_cache)
+                cache = caches[li]             # [2, KVH, NB, BS, D]
+                ln = jnp.asarray(slens).reshape(-1)
+                kc, vc = update_paged_kv_cache(
+                    cache[0], cache[1], k[:, 0], v[:, 0], tables_a, ln)
+                ctx = ragged_paged_attention(
+                    q[:, 0], kc, vc, tables_a, ln + 1, scale=scale,
+                    work=(tuple(rwork), None, rwork[0].shape[0],
+                          ragged_pack))
+                ctx = ctx[:, None].astype(xa.dtype)   # [B, 1, H, D]
+                new_caches.append(jnp.stack([kc, vc]))
+            elif tstep is not None and caches:
                 # decode: append the new token, attend over the valid cache
                 cache = caches[li]                 # [2, B, g, S_max, D]
                 t = jnp.asarray(tstep).reshape(())
@@ -657,7 +747,8 @@ def fused_multi_transformer(
          list(ffn_ln_biases or []), list(ffn1_weights),
          list(ffn1_biases or []), list(ffn2_weights), list(ffn2_biases or []),
          list(caches_in), list(pre_in), rotary_embs, time_step, attn_mask,
-         seq_lens,
+         seq_lens, block_tables,
+         list(ragged_work) if ragged_work is not None else [],
          # per-layer dropout keys as input leaves (vjp-cacheable +
          # trace-safe, like the other fused ops)
          [_random.fresh_key_tensor() for _ in range(n_layers)]

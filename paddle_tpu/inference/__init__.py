@@ -484,11 +484,36 @@ class FusedMultiTransformerEngine:
                 body, (tok, caches, lens0), jnp.arange(n))
             return toks, caches_f  # toks [n, B]
 
+        def paged_step(w, caches, tok, tables, lens, rwork, rpack, temp,
+                       topp, key):
+            """One continuous-batching decode step over the PAGED cache:
+            tok [B] is each slot's current input token, tables/lens the
+            host allocator's view, rwork the flattened ragged work list
+            (built host-side from lens + 1). Mixed-progress slots — some
+            still consuming their prompt, some deep into decode, some
+            idle — all advance in this ONE compiled program; the work
+            list's static length keys the compile, so bucketing it keeps
+            the program count O(log max_blocks)."""
+            h = w["embedding"][tok][:, None]
+            from ..core.tensor import Tensor
+            cts = [Tensor(c) for c in caches]
+            out = fused_multi_transformer(
+                Tensor(h), *lists(w), cache_kvs=cts,
+                time_step=Tensor(jnp.zeros((), jnp.int32)),
+                seq_lens=Tensor(lens),
+                rotary_embs=w.get("rotary_embs"),
+                block_tables=tables, ragged_work=rwork,
+                ragged_pack=rpack, **kw)
+            logits = out.data[:, 0] @ w["lm_head"]
+            return select(logits, temp, topp, key), [c.data for c in cts]
+
         import jax
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
         self._step = jax.jit(step, donate_argnums=(1,))
         self._steps = jax.jit(steps, static_argnums=(4,),
                               donate_argnums=(1,))
+        self._paged_step = jax.jit(paged_step, static_argnums=(6,),
+                                   donate_argnums=(1,))
 
     def _build_quant_mm(self, weights, dtype):
         """Repack the projection weights into the Pallas kernel's int4
@@ -547,6 +572,18 @@ class FusedMultiTransformerEngine:
         dtype = dtype or self._dtype
         kvh = self._gqa or self._w["qkv_weights"][0].shape[1]
         return [jnp.zeros((2, batch_size, kvh, self.max_seq_len,
+                           self.head_dim), dtype)
+                for _ in range(self._n_layers)]
+
+    def new_paged_caches(self, num_blocks, block_size, dtype=None):
+        """Per-layer paged KV caches [2, KVH, num_blocks, block_size, D]
+        for the continuous-batching serving path
+        (incubate.nn.ContinuousBatchingEngine owns the block allocator
+        that hands slices of these out to requests)."""
+        import jax.numpy as jnp
+        dtype = dtype or self._dtype
+        kvh = self._gqa or self._w["qkv_weights"][0].shape[1]
+        return [jnp.zeros((2, kvh, num_blocks, block_size,
                            self.head_dim), dtype)
                 for _ in range(self._n_layers)]
 

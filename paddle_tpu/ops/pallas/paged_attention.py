@@ -1,4 +1,4 @@
-"""Paged KV-cache decode attention as a Pallas TPU kernel.
+"""Paged KV-cache decode attention as Pallas TPU kernels.
 
 Reference: paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu
 (paged/block KV cache) and masked_multihead_attention_kernel.cu (decode
@@ -7,15 +7,37 @@ block_multihead_attention (SURVEY.md §2.9).
 
 TPU-native shape: the KV cache lives in HBM as fixed-size blocks
 [KVH, num_blocks, block_size, D]; each sequence owns a list of block ids
-(block_tables [B, max_blocks]). The kernel grid is (batch, kv_head,
-block); the block table is a scalar-prefetch operand so each grid step's
-BlockSpec index_map can look up WHICH cache block to DMA next — the
-gather never touches the host. One decode query group (the GQA query
-heads of one kv head) rides VMEM the whole time with f32 online-softmax
-scratch.
+(block_tables [B, max_blocks]).
+
+Two kernels:
+
+* `paged_attention` — the legacy A/B reference. Grid (batch, kv_head,
+  max_blocks): every sequence pays `max_blocks` grid steps even when it
+  owns two blocks, the padding steps DMA cache blocks just to mask them
+  out, and the MXU sees one [G, D] query group per step. Measured ~15x
+  slower than the dense slice-softmax path at B=8/ctx=448 (BASELINE.md
+  round 5).
+
+* `ragged_paged_attention` — the serving kernel ("Ragged Paged
+  Attention", PAPERS.md). The grid is flattened over a scalar-prefetched
+  work list with one entry per ACTUAL cache block (length = sum of
+  per-sequence block counts — no padding-block steps), the GQA query
+  groups of `pack` co-scheduled sequences ride one [pack*G, D] VMEM tile
+  so the MXU multiplies real sublanes, and consecutive KV-block loads are
+  double-buffered by hand (two VMEM slots + DMA semaphores; step t waits
+  slot t%2 after kicking off t+1's copy) so the next block streams from
+  HBM while the current one is in the MXU.
+
+The work list is built host-side (`build_ragged_work`) because the block
+allocator that owns the tables is host code anyway; under `jax.jit` the
+caller passes the arrays in (`work=`) and the list length stays static
+per compile (bucket it — `bucket_to=next_pow2` — so mixed-progress
+serving batches reuse a handful of programs).
 """
 import functools
 import math
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -119,17 +141,353 @@ def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
     return out.reshape(b, h, d)
 
 
+# ---------------------------------------------------------------------------
+# ragged paged attention
+# ---------------------------------------------------------------------------
+
+def next_pow2(n):
+    """Work-list bucketing for serving: compile one program per power of
+    two instead of one per distinct total block count."""
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def build_ragged_work(block_tables, context_lens, block_size, pack,
+                      bucket_to=None):
+    """Flatten (sequence, block) pairs into the ragged kernel's work list.
+
+    Host-side on purpose: the block tables live on the host in the serving
+    allocator, and the list length must be static under jit. Entries are
+    group-major (all blocks of the `pack` co-scheduled sequences of group
+    0, then group 1, ...) so the kernel's accumulators live across exactly
+    one contiguous span per group.
+
+    Returns (arrays, t_real, t_total, pack): seven int32 [t_total] arrays
+    (seq id, group id, row-in-group, cache block id, block position,
+    group-first flag, group-last flag), the number of real entries, the
+    padded length (== t_real unless bucket_to is given), and the
+    (clamped) pack factor the list was built with — the kernel's query
+    packing MUST use the same pack, so pass this whole tuple as
+    `ragged_paged_attention(..., work=...)` and it travels together.
+    Padding entries point their block position past every valid token so
+    the kernel masks them to a no-op.
+
+    A length past the table capacity (max_blocks * block_size) walks only
+    the blocks that exist: this pairs with `update_paged_kv_cache`
+    dropping the write a full row has no slot for — the row attends over
+    its capacity tokens instead of indexing past its table row.
+    """
+    tables = np.asarray(block_tables)
+    lens = np.asarray(context_lens)
+    b = lens.shape[0]
+    pack = max(1, min(int(pack), b))
+    max_nb = tables.shape[1]
+    ws, wg, wr, wblk, wpos, wfirst, wlast = ([] for _ in range(7))
+    for grp in range(-(-b // pack)):
+        start_t = len(ws)
+        for s in range(grp * pack, min((grp + 1) * pack, b)):
+            for j in range(min(-(-int(lens[s]) // block_size), max_nb)):
+                ws.append(s)
+                wg.append(grp)
+                wr.append(s % pack)
+                wblk.append(int(tables[s, j]))
+                wpos.append(j)
+                wfirst.append(0)
+                wlast.append(0)
+        if len(ws) > start_t:
+            wfirst[start_t] = 1
+            wlast[-1] = 1
+    t_real = len(ws)
+    t_total = t_real
+    if bucket_to is not None and t_real > 0:
+        t_total = max(t_real, int(bucket_to(t_real)))
+        last_grp = wg[-1]
+        # sentinel block position far past any representable cache length
+        # (NOT max_nb: an over-capacity len could still reach past that),
+        # int32-safe in the kernel's pos = wpos*block_size + iota
+        pad_pos = (1 << 30) // block_size
+        for _ in range(t_total - t_real):
+            ws.append(0)
+            wg.append(last_grp)  # same q/out block: no pipeline flush
+            wr.append(0)
+            wblk.append(0)
+            wpos.append(pad_pos)  # position >= every len: fully masked
+            wfirst.append(0)
+            wlast.append(0)
+    arrs = tuple(np.asarray(a, np.int32)
+                 for a in (ws, wg, wr, wblk, wpos, wfirst, wlast))
+    return arrs, t_real, t_total, pack
+
+
+def _ragged_kernel(ws, wg, wr, wblk, wpos, wfirst, wlast, lens,
+                   q_ref, k_hbm, v_hbm, o_ref,
+                   kbuf, vbuf, ksem, vsem, m_scr, l_scr, acc,
+                   *, block_size, scale, group_q):
+    hh = pl.program_id(0)
+    t = pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    def kdma(slot, idx):
+        return pltpu.make_async_copy(
+            k_hbm.at[hh, wblk[idx]], kbuf.at[slot], ksem.at[slot])
+
+    def vdma(slot, idx):
+        return pltpu.make_async_copy(
+            v_hbm.at[hh, wblk[idx]], vbuf.at[slot], vsem.at[slot])
+
+    # double buffering: warm slot 0 at t == 0, then start t+1's copy
+    # before waiting on t's — the next KV block is in flight over HBM
+    # while this one multiplies
+    @pl.when(t == 0)
+    def _warmup():
+        kdma(0, 0).start()
+        vdma(0, 0).start()
+
+    @pl.when(t + 1 < nt)
+    def _prefetch_next():
+        kdma((t + 1) % 2, t + 1).start()
+        vdma((t + 1) % 2, t + 1).start()
+
+    @pl.when(wfirst[t] == 1)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc[...] = jnp.zeros_like(acc)
+
+    kdma(t % 2, t).wait()
+    vdma(t % 2, t).wait()
+
+    ctx_len = lens[ws[t]]
+    q = q_ref[0, 0].astype(jnp.float32)              # [pack*G, D]
+    k = kbuf[t % 2].astype(jnp.float32)              # [BS, D]
+    v = vbuf[t % 2].astype(jnp.float32)              # [BS, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # [pack*G, BS]
+    # the packed tile holds `pack` sequences' query groups; only the rows
+    # of THIS work item's sequence may see this KV block — everyone else
+    # is masked to a numerical no-op (p == 0, m/l/acc carried through)
+    row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    pos = wpos[t] * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    mask = ((row >= wr[t] * group_q) & (row < (wr[t] + 1) * group_q)
+            & (pos < ctx_len))
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(
+        m_prev, jnp.max(jnp.where(mask, s, NEG_INF), axis=1, keepdims=True))
+    p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+    corr = jnp.exp(m_prev - m_new)    # masked rows: exp(0) == 1, no-op
+    l_scr[...] = jnp.broadcast_to(
+        corr * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True), l_scr.shape)
+    acc[...] = acc[...] * corr + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(wlast[t] == 1)
+    def _final():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[...] / l).astype(o_ref.dtype)
+
+
+def _pack_queries(q, kvh, g, pack):
+    """[B, H, D] -> [ngroups, KVH, pack*G, D] (+zero rows past B)."""
+    b, h, d = q.shape
+    ngroups = -(-b // pack)
+    qg = q.reshape(b, kvh, g, d)
+    pad = ngroups * pack - b
+    if pad:
+        qg = jnp.concatenate(
+            [qg, jnp.zeros((pad,) + qg.shape[1:], qg.dtype)], 0)
+    return qg.reshape(ngroups, pack, kvh, g, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(ngroups, kvh, pack * g, d)
+
+
+def _unpack_outputs(out, b, h, g, pack):
+    ngroups = out.shape[0]
+    kvh = out.shape[1]
+    d = out.shape[-1]
+    return out.reshape(ngroups, kvh, pack, g, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(ngroups * pack, h, d)[:b]
+
+
+def default_pack(batch, group_q):
+    """Co-schedule enough sequences that the packed query tile fills at
+    least one f32 sublane tile (8 rows) — the MXU minimum."""
+    return max(1, min(batch, -(-8 // group_q)))
+
+
+def ragged_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
+                           scale=None, pack=None, work=None):
+    """Decode-step attention over a paged KV cache, ragged grid.
+
+    q:            [B, H, D] — one query token per sequence
+    k/v_cache:    [KVH, num_blocks, block_size, D]
+    block_tables: [B, max_blocks_per_seq] int32 cache-block ids
+    context_lens: [B] int32 valid cache length per sequence (0 allowed:
+                  the row costs zero grid steps and returns zeros)
+    pack:         co-scheduled sequences per query tile (default: enough
+                  that pack*G >= 8)
+    work:         optional prebuilt `build_ragged_work(...)` result —
+                  required under jit where context_lens is traced;
+                  arrays may be traced values, lengths (and the carried
+                  pack) must be static. The work list's group/row
+                  encoding and the kernel's query packing must agree, so
+                  a pack carried by `work` wins; passing a CONFLICTING
+                  explicit pack raises.
+    returns       [B, H, D]
+    """
+    b, h, d = q.shape
+    kvh, _, block_size, _ = k_cache.shape
+    g = h // kvh
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if work is not None:
+        work_arrs, t_total = work[0], work[2]
+        work_pack = work[3] if len(work) > 3 else None
+        if work_pack is not None:
+            if pack is not None and pack != work_pack:
+                raise ValueError(
+                    f"pack={pack} conflicts with the work list (built "
+                    f"with pack={work_pack})")
+            pack = work_pack
+        elif pack is None:
+            # bare work arrays with no pack anywhere: guessing a default
+            # could silently disagree with the list's group encoding
+            raise ValueError(
+                "a prebuilt work list needs its pack factor — pass the "
+                "full build_ragged_work(...) 4-tuple, or pack= explicitly")
+    if pack is None:
+        pack = default_pack(b, g)
+    pack = max(1, min(pack, b))
+    if work is None:
+        work_arrs, _, t_total, pack = build_ragged_work(
+            block_tables, context_lens, block_size, pack)
+    if t_total == 0:
+        return jnp.zeros_like(q)
+    ngroups = -(-b // pack)
+    pg = pack * g
+    qp = _pack_queries(q, kvh, g, pack)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=8,
+        grid=(kvh, t_total),
+        in_specs=[
+            pl.BlockSpec((1, 1, pg, d),
+                         lambda hh, t, ws, wg, *_: (wg[t], hh, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # K stays in HBM;
+            pl.BlockSpec(memory_space=pltpu.ANY),   # blocks DMA'd by hand
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, pg, d), lambda hh, t, ws, wg, *_: (wg[t], hh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, d), k_cache.dtype),
+            pltpu.VMEM((2, block_size, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((pg, LANES), jnp.float32),
+            pltpu.VMEM((pg, LANES), jnp.float32),
+            pltpu.VMEM((pg, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_ragged_kernel, block_size=block_size,
+                          scale=float(scale), group_q=g),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((ngroups, kvh, pg, d), q.dtype),
+        interpret=_interpret_mode(),
+    )(*[jnp.asarray(a, jnp.int32) for a in work_arrs],
+      jnp.asarray(context_lens, jnp.int32), qp, k_cache, v_cache)
+    out = _unpack_outputs(out, b, h, g, pack)
+    # rows whose group was never visited (len 0) carry uninitialised VMEM
+    return jnp.where(jnp.asarray(context_lens)[:, None, None] > 0, out, 0.0)
+
+
+def ragged_paged_attention_reference(q, k_cache, v_cache, block_tables,
+                                     context_lens, scale=None, pack=None):
+    """Plain-JAX (no Pallas) execution of the ragged algorithm: same work
+    list, same packed tiles, same online-softmax update — each update
+    jitted as one program so XLA applies the same FMA contraction as
+    inside the kernel. On the CPU interpret grid the kernel must match
+    this BIT-EXACTLY; it is also the validation oracle the serving tests
+    diff against."""
+    q = jnp.asarray(q)
+    b, h, d = q.shape
+    kc = jnp.asarray(k_cache)
+    vc = jnp.asarray(v_cache)
+    kvh, _, bs, _ = kc.shape
+    g = h // kvh
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if pack is None:
+        pack = default_pack(b, g)
+    lens = np.asarray(context_lens)
+    (ws, wg, wr, wblk, wpos, wfirst, wlast), _, t_total, pack = \
+        build_ragged_work(block_tables, lens, bs, pack)
+    pg = pack * g
+    qp = _pack_queries(q, kvh, g, pack)
+    ngroups = qp.shape[0]
+
+    @jax.jit
+    def upd(qt, k, v, m, l, acc, wr_t, wpos_t, ctx_len):
+        s = jax.lax.dot_general(
+            qt, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * float(scale)
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        pos = wpos_t * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = ((row >= wr_t * g) & (row < (wr_t + 1) * g)
+                & (pos < ctx_len))
+        m_new = jnp.maximum(m, jnp.max(jnp.where(mask, s, NEG_INF),
+                                       axis=1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m - m_new)
+        l2 = corr * l + jnp.sum(p, axis=1, keepdims=True)
+        acc2 = acc * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l2, acc2
+
+    fin = jax.jit(
+        lambda acc, l: (acc / jnp.where(l == 0.0, 1.0, l)).astype(q.dtype))
+    out = np.zeros((ngroups, kvh, pg, d), q.dtype)
+    for hh in range(kvh):
+        m = l = acc = None
+        for t in range(t_total):
+            if wfirst[t]:
+                m = jnp.full((pg, 1), NEG_INF, jnp.float32)
+                l = jnp.zeros((pg, 1), jnp.float32)
+                acc = jnp.zeros((pg, d), jnp.float32)
+            m, l, acc = upd(qp[wg[t], hh].astype(jnp.float32),
+                            kc[hh, wblk[t]].astype(jnp.float32),
+                            vc[hh, wblk[t]].astype(jnp.float32),
+                            m, l, acc, int(wr[t]), int(wpos[t]),
+                            int(lens[ws[t]]))
+            if wlast[t]:
+                out[wg[t], hh] = np.asarray(fin(acc, l))
+    out = _unpack_outputs(jnp.asarray(out), b, h, g, pack)
+    return jnp.where(jnp.asarray(lens)[:, None, None] > 0, out, 0.0)
+
+
 def update_paged_kv_cache(k_cache, v_cache, k_new, v_new, block_tables,
                           context_lens):
     """Append one decode step's K/V ([B, KVH, D]) into the paged cache at
     position context_lens (the slot the new token occupies). Returns the
     updated caches. Pure scatter — XLA keeps it in-place under jit when
-    the caches are donated."""
+    the caches are donated.
+
+    Boundary contract: a row whose context_lens already equals the table
+    capacity (max_blocks * block_size) has nowhere to append — its write
+    is DROPPED (and the would-be out-of-bounds block-table column read is
+    clamped) instead of aliasing whatever XLA's clamped gather happened
+    to hand back."""
     kvh, nb, bs, d = k_cache.shape
     b = k_new.shape[0]
-    blk_idx = context_lens // bs                      # [B]
+    max_nb = block_tables.shape[1]
+    full = context_lens >= max_nb * bs                # [B] no slot left
+    blk_idx = jnp.minimum(context_lens // bs, max_nb - 1)
     blk_ids = jnp.take_along_axis(
         block_tables, blk_idx[:, None], axis=1)[:, 0]  # [B]
+    # scatter mode="drop": full rows aim past the cache and vanish
+    blk_ids = jnp.where(full, nb, blk_ids)
     offs = context_lens % bs                          # [B]
 
     def upd(cache, new):
@@ -137,6 +495,6 @@ def update_paged_kv_cache(k_cache, v_cache, k_new, v_new, block_tables,
         hidx = jnp.arange(kvh)
         bidx = jnp.arange(b)
         return cache.at[hidx[None, :], blk_ids[:, None], offs[:, None]].set(
-            new[bidx[:, None], hidx[None, :]])
+            new[bidx[:, None], hidx[None, :]], mode="drop")
 
     return upd(k_cache, k_new), upd(v_cache, v_new)

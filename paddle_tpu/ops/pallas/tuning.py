@@ -10,6 +10,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 VMEM_LIMIT = 100 * 1024 * 1024
 
+# jax renamed TPUCompilerParams -> CompilerParams across releases; resolve
+# whichever this jax ships (same contract either way)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 
 def cparams():
-    return pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT)
+    return _CompilerParams(vmem_limit_bytes=VMEM_LIMIT)

@@ -1,0 +1,351 @@
+"""Ragged paged-attention kernel + continuous-batching serving tests
+(interpret mode on CPU — device kernels tested without the device).
+
+Parity ladder:
+  * the kernel must be BIT-EXACT vs the plain-JAX work-list reference
+    (same packed tiles, same online-softmax order, same FMA contraction),
+  * numerically close to an independent dense softmax oracle,
+  * and the serving layer's generations must match the dense engine's
+    `generate()` token for token.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.pallas import flash_attention as fa
+from paddle_tpu.ops.pallas import paged_attention as pa
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    old = fa._INTERPRET
+    fa._INTERPRET = True
+    yield
+    fa._INTERPRET = old
+
+
+def _setup(h, kvh, lens, seed=0, d=32, bs=8, max_nb=6, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    b = len(lens)
+    nblk = b * max_nb + 3
+    q = rng.standard_normal((b, h, d)).astype(dtype)
+    kc = rng.standard_normal((kvh, nblk, bs, d)).astype(dtype)
+    vc = rng.standard_normal((kvh, nblk, bs, d)).astype(dtype)
+    tables = np.stack([rng.choice(nblk, max_nb, replace=False)
+                       for _ in range(b)]).astype(np.int32)
+    return q, kc, vc, tables, np.asarray(lens, np.int32)
+
+
+def _dense_softmax_ref(q, kc, vc, tables, lens):
+    """Independent oracle: gather each sequence's blocks dense, softmax
+    in float64."""
+    b, h, d = q.shape
+    kvh, _, bs, _ = kc.shape
+    g = h // kvh
+    out = np.zeros((b, h, d), np.float32)
+    for bb in range(b):
+        if lens[bb] == 0:
+            continue
+        ks = np.concatenate([kc[:, t] for t in tables[bb]], axis=1)
+        vs = np.concatenate([vc[:, t] for t in tables[bb]], axis=1)
+        for hh in range(h):
+            kvhh = hh // g
+            s = ks[kvhh, :lens[bb]].astype(np.float64) @ \
+                q[bb, hh].astype(np.float64) / np.sqrt(d)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            out[bb, hh] = p @ vs[kvhh, :lens[bb]].astype(np.float64)
+    return out
+
+
+# ragged lengths covering: empty, single token, exact block multiples,
+# table-capacity-full, and odd stragglers
+RAGGED_LENS = [0, 8 * 3, 1, 8 * 6, 13]
+
+HEAD_LAYOUTS = [
+    pytest.param(8, 4, id="gqa2"),   # 2 q heads per kv head
+    pytest.param(8, 2, id="gqa4"),
+    pytest.param(4, 4, id="mha"),
+    pytest.param(4, 1, id="mqa"),
+]
+
+
+class TestRaggedKernel:
+    @pytest.mark.parametrize("h,kvh", HEAD_LAYOUTS)
+    def test_bit_exact_vs_reference(self, h, kvh):
+        q, kc, vc, tables, lens = _setup(h, kvh, RAGGED_LENS)
+        out = pa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+            jnp.asarray(tables), jnp.asarray(lens))
+        ref = pa.ragged_paged_attention_reference(q, kc, vc, tables, lens)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    @pytest.mark.parametrize("h,kvh", HEAD_LAYOUTS)
+    def test_close_to_dense_softmax(self, h, kvh):
+        q, kc, vc, tables, lens = _setup(h, kvh, RAGGED_LENS, seed=1)
+        out = pa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+            jnp.asarray(tables), jnp.asarray(lens))
+        ref = _dense_softmax_ref(q, kc, vc, tables, lens)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3,
+                                   atol=2e-3)
+
+    def test_legacy_kernel_close_to_dense(self):
+        # the A/B reference kernel on a ragged batch: it produces the
+        # same numbers, just over a B x max_blocks grid
+        lens = [1, 8 * 3, 5, 8 * 6, 13]
+        q, kc, vc, tables, lens = _setup(8, 4, lens, seed=2)
+        out = pa.paged_attention(
+            jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+            jnp.asarray(tables), jnp.asarray(lens))
+        ref = _dense_softmax_ref(q, kc, vc, tables, lens)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3,
+                                   atol=2e-3)
+
+    @pytest.mark.parametrize("pack", [1, 2, 3, 5])
+    def test_pack_variants_bit_exact(self, pack):
+        q, kc, vc, tables, lens = _setup(8, 4, RAGGED_LENS, seed=3)
+        out = pa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+            jnp.asarray(tables), jnp.asarray(lens), pack=pack)
+        ref = pa.ragged_paged_attention_reference(
+            q, kc, vc, tables, lens, pack=pack)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_bf16(self):
+        q, kc, vc, tables, lens = _setup(8, 4, RAGGED_LENS, seed=4)
+        to16 = lambda a: jnp.asarray(a, jnp.bfloat16)
+        out = pa.ragged_paged_attention(
+            to16(q), to16(kc), to16(vc), jnp.asarray(tables),
+            jnp.asarray(lens))
+        ref = _dense_softmax_ref(
+            np.asarray(to16(q), np.float32), np.asarray(to16(kc), np.float32),
+            np.asarray(to16(vc), np.float32), tables, lens)
+        np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_grid_scales_with_actual_blocks(self):
+        # THE point of the ragged kernel: grid steps follow the sum of
+        # per-sequence block counts, not B x max_blocks
+        bs, max_nb = 8, 6
+        lens = np.asarray(RAGGED_LENS, np.int32)
+        b = len(lens)
+        tables = np.arange(b * max_nb, dtype=np.int32).reshape(b, max_nb)
+        for pack in (1, 2, 4):
+            work, t_real, t_total, _ = pa.build_ragged_work(
+                tables, lens, bs, pack)
+            expect = sum(-(-int(x) // bs) for x in lens)
+            assert t_real == t_total == expect
+            assert t_real < b * max_nb
+            assert len(work[0]) == t_total
+        # bucketing pads but keeps padded entries inert
+        work, t_real, t_total, _ = pa.build_ragged_work(
+            tables, lens, bs, 2, bucket_to=pa.next_pow2)
+        assert t_total == pa.next_pow2(t_real) >= t_real
+
+    def test_bucketed_work_same_output(self):
+        q, kc, vc, tables, lens = _setup(8, 4, RAGGED_LENS, seed=5)
+        plain = pa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+            jnp.asarray(tables), jnp.asarray(lens), pack=2)
+        work = pa.build_ragged_work(tables, lens, kc.shape[2], 2,
+                                    bucket_to=pa.next_pow2)
+        assert work[2] > work[1]  # really padded
+        bucketed = pa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+            jnp.asarray(tables), jnp.asarray(lens), pack=2, work=work)
+        np.testing.assert_array_equal(np.asarray(plain),
+                                      np.asarray(bucketed))
+        # a pack that disagrees with the work list must refuse, not
+        # silently mis-pack the query tiles
+        with pytest.raises(ValueError):
+            pa.ragged_paged_attention(
+                jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+                jnp.asarray(tables), jnp.asarray(lens), pack=4, work=work)
+
+    def test_full_capacity_row_attends_over_table(self):
+        # a row whose len+1 exceeds the table capacity (the decode step
+        # right at the boundary: update dropped the write) must walk only
+        # the blocks that exist, not index past its table row
+        bs, max_nb = 4, 2
+        tables = np.arange(6, dtype=np.int32).reshape(3, 2)
+        lens = np.asarray([8, 3, 5], np.int32) + 1   # row 0 past capacity
+        (ws, _, _, _, wpos, _, _), t_real, _, _ = pa.build_ragged_work(
+            tables, lens, bs, 2)
+        assert t_real == 2 + 1 + 2                   # row 0 clamped to 2
+        assert max(wpos[ws == 0]) == max_nb - 1
+        q, kc, vc, tables2, _ = _setup(8, 4, [0] * 3, d=16, bs=bs,
+                                       max_nb=max_nb)
+        out = pa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+            jnp.asarray(tables2), jnp.asarray(lens))
+        # equivalent to attending over the capacity tokens
+        ref = _dense_softmax_ref(q, kc, vc, tables2,
+                                 np.minimum(lens, max_nb * bs))
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3,
+                                   atol=2e-3)
+
+    def test_all_empty_batch(self):
+        q, kc, vc, tables, lens = _setup(8, 4, [0, 0, 0])
+        out = pa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+            jnp.asarray(tables), jnp.asarray(lens))
+        np.testing.assert_array_equal(np.asarray(out), np.zeros_like(q))
+
+    def test_under_jit_with_prebuilt_work(self):
+        q, kc, vc, tables, lens = _setup(8, 4, RAGGED_LENS, seed=6)
+        arrs, t_real, t_total, pack = pa.build_ragged_work(
+            tables, lens, kc.shape[2], 2)
+
+        @jax.jit
+        def run(q, kc, vc, tables, lens, arrs):
+            return pa.ragged_paged_attention(
+                q, kc, vc, tables, lens,
+                work=(arrs, t_real, t_total, pack))
+
+        out = run(jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+                  jnp.asarray(tables), jnp.asarray(lens),
+                  tuple(jnp.asarray(a) for a in arrs))
+        ref = pa.ragged_paged_attention_reference(
+            q, kc, vc, tables, lens, pack=2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
+
+
+class TestCacheUpdateBoundary:
+    def _setup(self, lens):
+        rng = np.random.default_rng(7)
+        kvh, nb, bs, d, b, max_nb = 2, 9, 4, 8, 3, 2
+        kc = rng.standard_normal((kvh, nb, bs, d)).astype(np.float32)
+        vc = rng.standard_normal((kvh, nb, bs, d)).astype(np.float32)
+        kn = rng.standard_normal((b, kvh, d)).astype(np.float32)
+        vn = rng.standard_normal((b, kvh, d)).astype(np.float32)
+        tables = np.arange(b * max_nb, dtype=np.int32).reshape(b, max_nb)
+        return kc, vc, kn, vn, tables, np.asarray(lens, np.int32)
+
+    def test_full_row_write_dropped(self):
+        # context_lens == table capacity (max_nb * bs == 8): the old code
+        # read block_tables[:, 2] (one past the end); now the write drops
+        kc, vc, kn, vn, tables, lens = self._setup([8, 3, 8])
+        kc2, vc2 = pa.update_paged_kv_cache(
+            jnp.asarray(kc), jnp.asarray(vc), jnp.asarray(kn),
+            jnp.asarray(vn), jnp.asarray(tables), jnp.asarray(lens))
+        kc2, vc2 = np.asarray(kc2), np.asarray(vc2)
+        # row 1 (len 3) landed at its block 0 (table id 2), offset 3
+        np.testing.assert_array_equal(kc2[:, tables[1, 0], 3], kn[1])
+        np.testing.assert_array_equal(vc2[:, tables[1, 0], 3], vn[1])
+        # full rows 0 and 2 changed NOTHING anywhere else
+        kc_exp, vc_exp = kc.copy(), vc.copy()
+        kc_exp[:, tables[1, 0], 3] = kn[1]
+        vc_exp[:, tables[1, 0], 3] = vn[1]
+        np.testing.assert_array_equal(kc2, kc_exp)
+        np.testing.assert_array_equal(vc2, vc_exp)
+
+    def test_last_slot_still_writable(self):
+        kc, vc, kn, vn, tables, lens = self._setup([7, 7, 7])
+        kc2, vc2 = pa.update_paged_kv_cache(
+            jnp.asarray(kc), jnp.asarray(vc), jnp.asarray(kn),
+            jnp.asarray(vn), jnp.asarray(tables), jnp.asarray(lens))
+        kc2 = np.asarray(kc2)
+        for b in range(3):
+            np.testing.assert_array_equal(kc2[:, tables[b, 1], 3], kn[b])
+
+
+class TestBlockAllocator:
+    def test_free_list_discipline(self):
+        from paddle_tpu.incubate.nn import BlockAllocator
+        al = BlockAllocator(6, reserved=1)
+        assert al.num_free == 5
+        got = [al.alloc() for _ in range(5)]
+        assert sorted(got) == [1, 2, 3, 4, 5]  # block 0 never handed out
+        with pytest.raises(RuntimeError):
+            al.alloc()
+        al.free(got[:3])
+        assert al.num_free == 3
+        with pytest.raises(ValueError):
+            al.free([got[0]])      # double free
+        with pytest.raises(ValueError):
+            al.free([0])           # reserved block
+        with pytest.raises(ValueError):
+            al.free([99])          # out of pool
+
+
+def _tiny_engine(seed=0):
+    from paddle_tpu.inference import FusedMultiTransformerEngine
+    rng = np.random.default_rng(seed)
+    V, E, H, G, D, L, F = 128, 64, 4, 2, 16, 2, 96
+
+    def mk(*shape, scale=0.05):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    w = dict(
+        ln_scales=[np.ones(E, np.float32) for _ in range(L)],
+        qkv_weights=[mk(H + 2 * G, D, E) for _ in range(L)],
+        linear_weights=[mk(H * D, E) for _ in range(L)],
+        ffn_ln_scales=[np.ones(E, np.float32) for _ in range(L)],
+        ffn1_weights=[mk(E, 2 * F) for _ in range(L)],
+        ffn2_weights=[mk(F, E) for _ in range(L)],
+        embedding=mk(V, E), lm_head=mk(E, V))
+    eng = FusedMultiTransformerEngine(
+        w, num_heads=H, head_dim=D, max_seq_len=32, dtype="float32",
+        norm_type="rmsnorm", activation="swiglu", gqa_group_size=G)
+    return eng, V
+
+
+class TestContinuousBatching:
+    def test_admit_retire_no_leaks_and_parity(self):
+        from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                            GenerationRequest)
+        eng, V = _tiny_engine()
+        rng = np.random.default_rng(3)
+        cb = ContinuousBatchingEngine(eng, num_blocks=9, block_size=8,
+                                      max_batch=2)
+        free0 = cb.allocator.num_free
+        # more requests than slots, unequal lengths -> forced queueing,
+        # mixed-progress steps, retirement mid-flight
+        lengths = [(5, 4), (11, 3), (3, 6), (8, 2)]
+        prompts = [rng.integers(1, V, p).astype(np.int32)
+                   for p, _ in lengths]
+        reqs = [GenerationRequest(p, n)
+                for p, (_, n) in zip(prompts, lengths)]
+        for r in reqs:
+            cb.submit(r)
+        out = cb.run()
+        # every request produced exactly max_new_tokens
+        assert {r.request_id: len(out[r.request_id]) for r in reqs} == \
+            {r.request_id: n for r, (_, n) in zip(reqs, lengths)}
+        # no cache-slot leaks: free list back to initial size
+        assert cb.allocator.num_free == free0
+        assert all(r.blocks == [] for r in reqs)
+        # token-for-token parity with the dense-cache engine
+        for r, p, (_, n) in zip(reqs, prompts, lengths):
+            ref = eng.generate(p[None, :], max_new_tokens=n)[0, :n]
+            assert np.asarray(out[r.request_id]).tolist() == ref.tolist()
+
+    def test_submit_rejects_impossible(self):
+        from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                            GenerationRequest)
+        eng, V = _tiny_engine()
+        cb = ContinuousBatchingEngine(eng, num_blocks=3, block_size=8,
+                                      max_batch=2)
+        with pytest.raises(ValueError):  # needs 3 blocks, pool has 2
+            cb.submit(GenerationRequest(np.arange(1, 17), 8))
+        with pytest.raises(ValueError):  # exceeds capacity
+            cb.submit(GenerationRequest(np.arange(1, 30), 8))
+
+    def test_submit_capacity_is_table_not_max_seq_len(self):
+        # max_seq_len 32 with block_size 5 -> 6 blocks = 30 usable
+        # tokens; a 31-token request must be rejected at submit, not
+        # crash the whole batch at the table edge mid-generation
+        from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                            GenerationRequest)
+        eng, V = _tiny_engine()
+        cb = ContinuousBatchingEngine(eng, num_blocks=9, block_size=5,
+                                      max_batch=2)
+        with pytest.raises(ValueError):
+            cb.submit(GenerationRequest(np.arange(1, 27), 6))  # 31 > 30
+        cb.submit(GenerationRequest(np.arange(1, 26), 5))      # 30 fits
+        out = cb.run()
+        assert [len(v) for v in out.values()] == [5]
+        assert cb.allocator.num_free == 8

@@ -1,0 +1,31 @@
+"""Tier-0 collection gate.
+
+A single bad import once silently wiped out 43 of 47 test files (the
+`from jax import shard_map` skew on jax 0.4.x): the suite "ran", reported
+a few dozen passing tests, and nobody saw the 1100+ tests that never
+collected. This gate makes that failure mode loud: if ANY test module
+errors at collection, this test — which always collects as long as this
+file itself imports, which needs nothing beyond pytest — fails with the
+offending module names.
+"""
+import os
+import subprocess
+import sys
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def test_collection_is_error_free():
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", TESTS_DIR, "-q", "--collect-only",
+         "-p", "no:cacheprovider"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if proc.returncode != 0:
+        errors = [ln for ln in proc.stdout.splitlines()
+                  if ln.startswith("ERROR") or "error" in ln.lower()]
+        raise AssertionError(
+            "pytest --collect-only reports collection errors — an "
+            "import-time regression is hiding part of the suite:\n"
+            + "\n".join(errors[-40:]))

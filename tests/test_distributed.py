@@ -161,7 +161,7 @@ class TestCollectives:
     def test_shard_map_collectives(self, mesh2x4):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from paddle_tpu.framework.compat import shard_map
         from jax.sharding import PartitionSpec as P
         jm = mesh2x4.jax_mesh
 

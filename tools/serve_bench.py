@@ -170,16 +170,127 @@ def paged_vs_dense_leg(B=8, H=16, KVH=8, D=64, ctx=448, iters=32):
             "context": ctx, "block_size": block}
 
 
+def ragged_leg(iters=4):
+    """Legacy paged grid vs ragged work-list grid over a RAGGED batch at
+    the round-5 decode-attention shape. Grid-step counts are exact host
+    math (they gate in --check); timings are whole-call wall-clock on
+    EVERY platform (dispatch included), recorded for context only — under
+    CPU interpret they measure the interpreter, not the chip."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.ops.pallas import paged_attention as pa
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        fa._INTERPRET = True
+    B, H, KVH, D, BS = 8, 16, 8, 64, 64
+    max_nb = 7                      # 448-token capacity (round-5 ctx)
+    lens = np.array([448, 64, 192, 27, 448, 1, 320, 100], np.int32)
+    nb = B * max_nb + 1
+    rng = np.random.default_rng(0)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    q = jnp.asarray(rng.standard_normal((B, H, D)), dt)
+    kc = jnp.asarray(rng.standard_normal((KVH, nb, BS, D)), dt)
+    vc = jnp.asarray(rng.standard_normal((KVH, nb, BS, D)), dt)
+    tables = jnp.asarray(
+        rng.permutation(np.arange(1, nb))[:B * max_nb].reshape(B, max_nb),
+        jnp.int32)
+    lens_j = jnp.asarray(lens)
+    pack = pa.default_pack(B, H // KVH)
+    work, t_real, t_total, pack = pa.build_ragged_work(
+        np.asarray(tables), lens, BS, pack)
+    total_blocks = int(sum(-(-int(x) // BS) for x in lens))
+    out = {
+        "shape": {"B": B, "H": H, "KVH": KVH, "D": D, "block_size": BS,
+                  "max_blocks": max_nb},
+        "context_lens": lens.tolist(),
+        "pack": pack,
+        "total_kv_blocks": total_blocks,
+        "work_items": t_real,
+        "legacy_grid_steps": B * KVH * max_nb,
+        "ragged_grid_steps": KVH * t_total,
+        "interpret": not on_tpu,
+    }
+
+    def timed(fn):
+        o = fn()
+        jax.block_until_ready(o)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            o = fn()
+        jax.block_until_ready(o)
+        return (time.perf_counter() - t0) / iters * 1e6, o
+
+    t_legacy, o_l = timed(lambda: pa.paged_attention(
+        q, kc, vc, tables, lens_j))
+    t_ragged, o_r = timed(lambda: pa.ragged_paged_attention(
+        q, kc, vc, tables, lens_j, work=(work, t_real, t_total, pack)))
+    np.testing.assert_allclose(
+        np.asarray(o_l, np.float32), np.asarray(o_r, np.float32),
+        rtol=2e-2, atol=2e-2)
+    out["legacy_call_us"] = t_legacy
+    out["ragged_call_us"] = t_ragged
+    return out
+
+
+GRID_KEYS = ("total_kv_blocks", "work_items", "legacy_grid_steps",
+             "ragged_grid_steps", "pack", "context_lens")
+
+
+def check_ragged(baseline_path):
+    """CI gate: the ragged leg's grid-step accounting must match the
+    committed baseline exactly (these are host-deterministic), and the
+    ragged grid must stay strictly below the legacy B x max_blocks one."""
+    with open(baseline_path) as f:
+        base = json.load(f)["ragged"]
+    cur = ragged_leg(iters=1)
+    bad = [k for k in GRID_KEYS if cur[k] != base[k]]
+    for k in bad:
+        print(f"MISMATCH {k}: current {cur[k]!r} != baseline {base[k]!r}")
+    if cur["ragged_grid_steps"] >= cur["legacy_grid_steps"]:
+        print(f"REGRESSION: ragged grid ({cur['ragged_grid_steps']}) not "
+              f"below legacy grid ({cur['legacy_grid_steps']})")
+        bad.append("ragged_grid_steps")
+    if bad:
+        return 1
+    print(f"ragged leg OK: {cur['ragged_grid_steps']} grid steps vs "
+          f"legacy {cur['legacy_grid_steps']} "
+          f"({cur['total_kv_blocks']} actual KV blocks)")
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", default=None)
     ap.add_argument("--batches", default="1,8",
                     help="comma-separated decode batch sizes")
     ap.add_argument("--skip-paged", action="store_true")
+    ap.add_argument("--ragged", action="store_true",
+                    help="run only the ragged-vs-legacy paged leg "
+                         "(works on CPU via interpret mode)")
+    ap.add_argument("--check", metavar="BASELINE_JSON", default=None,
+                    help="gate the ragged leg against a committed "
+                         "baseline (grid-step accounting must match)")
     args = ap.parse_args()
     import jax
+    if args.check:
+        return check_ragged(args.check)
+    if args.ragged:
+        leg = ragged_leg()
+        print(json.dumps(leg, indent=1))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump({"ragged": leg}, f, indent=1)
+            print(f"wrote {args.json}")
+        return 0
     if jax.devices()[0].platform != "tpu":
-        print("# needs the attached TPU (device-time measurement)")
+        print("# needs the attached TPU (device-time measurement); "
+              "use --ragged / --check for the CPU-runnable ragged leg")
         return 0
     out = {}
     # B=1 is the weight-bound regime where weight-only quant pays (every
@@ -205,6 +316,11 @@ def main():
         print(f"decode-step attention @ctx={pv['context']}: dense "
               f"{pv['dense_attn_us_per_step']:.0f} us vs paged "
               f"{pv['paged_attn_us_per_step']:.0f} us per step")
+        rg = ragged_leg()
+        out["ragged"] = rg
+        print(f"ragged paged leg: {rg['ragged_grid_steps']} grid steps "
+              f"({rg['ragged_call_us']:.0f} us/call) vs legacy "
+              f"{rg['legacy_grid_steps']} ({rg['legacy_call_us']:.0f} us)")
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=1)
