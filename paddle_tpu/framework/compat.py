@@ -21,7 +21,7 @@ __all__ = [
     "create_parameter", "tolist", "set_printoptions",
     "disable_signal_handler", "check_shape", "from_dlpack", "to_dlpack",
     "get_cuda_rng_state", "set_cuda_rng_state", "batch",
-    "resolve_shard_map", "shard_map",
+    "resolve_shard_map", "shard_map", "resolve_compiler_params",
     "inf", "nan", "pi", "e", "newaxis",
 ]
 
@@ -89,6 +89,17 @@ def resolve_shard_map():
 
 
 shard_map = resolve_shard_map()
+
+
+def resolve_compiler_params():
+    """jax renamed `pltpu.TPUCompilerParams` -> `pltpu.CompilerParams`
+    across releases (same contract either way); spelling either one
+    directly binds code to one side of the rename. Every in-tree user
+    routes through here (graftlint GL102 enforces it). Lazy pltpu import:
+    this module is imported before the Pallas tier and must not pull it
+    in at package-import time."""
+    from jax.experimental.pallas import tpu as pltpu
+    return getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
 float8_e4m3fn = ml_dtypes.float8_e4m3fn
 float8_e5m2 = ml_dtypes.float8_e5m2

@@ -227,12 +227,18 @@ def _ragged_kernel(ws, wg, wr, wblk, wpos, wfirst, wlast, lens,
     nt = pl.num_programs(1)
 
     def kdma(slot, idx):
+        # a valid work list only holds live block ids, but the list is
+        # host-built data: clamp both ends before the HBM DMA — an OOB id
+        # (including a -1 free-slot sentinel) doesn't fault on TPU, it
+        # reads whatever block aliases (graftlint GL301)
+        blk = jnp.clip(wblk[idx], 0, k_hbm.shape[1] - 1)
         return pltpu.make_async_copy(
-            k_hbm.at[hh, wblk[idx]], kbuf.at[slot], ksem.at[slot])
+            k_hbm.at[hh, blk], kbuf.at[slot], ksem.at[slot])
 
     def vdma(slot, idx):
+        blk = jnp.clip(wblk[idx], 0, v_hbm.shape[1] - 1)
         return pltpu.make_async_copy(
-            v_hbm.at[hh, wblk[idx]], vbuf.at[slot], vsem.at[slot])
+            v_hbm.at[hh, blk], vbuf.at[slot], vsem.at[slot])
 
     # double buffering: warm slot 0 at t == 0, then start t+1's copy
     # before waiting on t's — the next KV block is in flight over HBM

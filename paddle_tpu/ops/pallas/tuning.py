@@ -6,15 +6,12 @@ undersized tiles (measured round 5: the flash backward at 512/1024 tiles
 was the single largest consumer of the pretrain step). A per-chip knob —
 retune HERE, not per kernel, when targeting a part with less VMEM.
 """
-from jax.experimental.pallas import tpu as pltpu
-
 VMEM_LIMIT = 100 * 1024 * 1024
-
-# jax renamed TPUCompilerParams -> CompilerParams across releases; resolve
-# whichever this jax ships (same contract either way)
-_CompilerParams = getattr(pltpu, "CompilerParams", None) \
-    or pltpu.TPUCompilerParams
 
 
 def cparams():
-    return _CompilerParams(vmem_limit_bytes=VMEM_LIMIT)
+    # function-level import: compat pulls core/, and this module is
+    # reachable from the package __init__ — resolving at call time keeps
+    # the import graph acyclic
+    from ...framework.compat import resolve_compiler_params
+    return resolve_compiler_params()(vmem_limit_bytes=VMEM_LIMIT)

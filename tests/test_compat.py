@@ -1,0 +1,103 @@
+"""framework/compat resolver coverage (ISSUE 2 satellite).
+
+resolve_shard_map and resolve_compiler_params are the two places the
+whole tree routes around jax version skew; a regression in either is a
+collection-killer (PR 1's import skew) or a Pallas-tier AttributeError.
+These tests pin the contract on whichever jax is installed:
+
+* fully-manual shard_map calls pass through and compute correct
+  collectives (with and without the new-style axis_names kwarg);
+* partial-auto calls are REFUSED with a clear NotImplementedError on
+  legacy jax (0.4.x aborts the process otherwise) — on a jax new enough
+  to accept partial-auto natively, the refusal test asserts the native
+  path instead;
+* resolve_compiler_params returns whichever of CompilerParams /
+  TPUCompilerParams this jax ships, constructible with the shared
+  contract kwarg (vmem_limit_bytes).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.framework.compat import (resolve_compiler_params,
+                                         resolve_shard_map)
+
+
+def _mesh(shape, names):
+    n = int(np.prod(shape))
+    devs = np.array(jax.devices()[:n]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def _is_native(sm):
+    # the compat ADAPTER also takes check_vma (it's the translation shim),
+    # so signature probing can't tell the two apart — provenance can
+    return getattr(sm, "__module__", "") != "paddle_tpu.framework.compat"
+
+
+class TestResolveShardMap:
+    def test_resolves_to_callable(self):
+        sm = resolve_shard_map()
+        assert callable(sm)
+
+    def test_fully_manual_passthrough(self):
+        """axis_names covering the whole mesh: runs on every jax."""
+        sm = resolve_shard_map()
+        mesh = _mesh((8,), ("dp",))
+        x = jnp.arange(8.0)
+        out = sm(lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+                 in_specs=P("dp"), out_specs=P(),
+                 axis_names=frozenset({"dp"}), check_vma=False)(x)
+        # local shard is [1]; psum over dp -> 0+1+...+7 == 28, replicated
+        np.testing.assert_allclose(np.asarray(out), [28.0])
+
+    def test_fully_manual_no_axis_names(self):
+        """The classic call shape (no axis_names at all) passes through."""
+        sm = resolve_shard_map()
+        mesh = _mesh((4, 2), ("dp", "mp"))
+        x = jnp.arange(8.0).reshape(4, 2)
+        out = sm(lambda v: jax.lax.psum(v, "mp"), mesh=mesh,
+                 in_specs=P("dp", "mp"), out_specs=P("dp"),
+                 check_vma=False)(x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(x).sum(1, keepdims=True))
+
+    def test_partial_auto_refused_on_legacy_jax(self):
+        """Manual over `dp` only, mesh has (dp, mp): legacy jax must get a
+        clean NotImplementedError (the alternative, feeding it to 0.4.x's
+        experimental shard_map, aborts the whole process)."""
+        sm = resolve_shard_map()
+        mesh = _mesh((4, 2), ("dp", "mp"))
+        if _is_native(sm):
+            # new jax accepts partial-auto natively; nothing to refuse
+            assert sm is getattr(jax, "shard_map", None) or callable(sm)
+            return
+        with pytest.raises(NotImplementedError, match="partial-auto"):
+            sm(lambda v: v, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+               axis_names=frozenset({"dp"}))
+        # the message must name the manual axes, the mesh, and the way out
+        with pytest.raises(NotImplementedError,
+                           match=r"\['dp'\].*needs a newer jax"):
+            sm(lambda v: v, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+               axis_names=frozenset({"dp"}))
+
+
+class TestResolveCompilerParams:
+    def test_resolves_whichever_rename_side_exists(self):
+        from jax.experimental.pallas import tpu as pltpu
+        cp = resolve_compiler_params()
+        expected = getattr(pltpu, "CompilerParams", None) \
+            or getattr(pltpu, "TPUCompilerParams")
+        assert cp is expected
+
+    def test_shared_contract_constructible(self):
+        obj = resolve_compiler_params()(vmem_limit_bytes=1 << 20)
+        assert obj.vmem_limit_bytes == 1 << 20
+
+    def test_pallas_tuning_routes_through_resolver(self):
+        from paddle_tpu.ops.pallas.tuning import VMEM_LIMIT, cparams
+        obj = cparams()
+        assert obj.vmem_limit_bytes == VMEM_LIMIT
+        assert isinstance(obj, resolve_compiler_params())

@@ -1,0 +1,96 @@
+"""Tier-0 graftlint gate (same spirit as test_collection_gate.py).
+
+PR 1 fixed three whole classes of bug by hand — the `from jax import
+shard_map` import skew, the `update_paged_kv_cache` OOB block-table
+write, the crash-prone partial-auto shard_map sites. graftlint encodes
+those hunts as permanent rules; this gate makes a new violation fail CI
+loudly.
+
+Skip-proof by design: nothing in here calls pytest.skip, the analyzer
+import happens INSIDE a test (so a broken tools/graftlint fails with a
+traceback instead of erroring the module out of collection), and the
+subprocess runs assert on exit codes with the linter output in the
+failure message. graftlint is stdlib-ast-only, so these tests cost
+milliseconds, not a jax import.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_lint(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *args],
+        capture_output=True, text=True, timeout=300, cwd=REPO_ROOT)
+
+
+def test_graftlint_imports():
+    # a broken/missing tools/graftlint must FAIL here, never skip
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import tools.graftlint as gl
+    finally:
+        sys.path.remove(REPO_ROOT)
+    assert len(gl.RULES) >= 9, sorted(gl.RULES)
+    families = {r.family for r in gl.RULES.values()}
+    assert families >= {"trace-safety", "shard-map", "pallas-bounds",
+                        "hygiene"}, families
+
+
+def test_tree_is_clean():
+    """The committed tree has zero non-baselined findings."""
+    proc = _run_lint("paddle_tpu/", "tests/", "tools/")
+    assert proc.returncode == 0, (
+        "graftlint found new violations — fix them, add a line-level "
+        "`# graftlint: disable=CODE` with a reason, or (pre-existing "
+        "triaged debt only) regenerate the baseline:\n"
+        + proc.stdout + proc.stderr)
+
+
+def test_selftest_corpus():
+    """Every rule family still catches its known-bad corpus."""
+    proc = _run_lint("--selftest")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_baseline_is_wellformed_and_minimal():
+    path = os.path.join(REPO_ROOT, "tools", "graftlint_baseline.json")
+    data = json.loads(open(path).read())
+    assert data["version"] == 1
+    # the baseline is a triage ledger for the partial-auto shard_map debt,
+    # not a dumping ground: only GL201 may live here (fix anything else)
+    codes = {e["code"] for e in data["findings"]}
+    assert codes <= {"GL201"}, (
+        f"unexpected baselined codes {sorted(codes - {'GL201'})} — the "
+        "baseline only carries the jax-0.4.x partial-auto shard_map "
+        "sites; fix new findings instead of baselining them")
+
+
+def test_introduced_corpus_snippet_fails():
+    """Dropping any known-bad snippet into the package tree turns the run
+    red; the clean corpus file stays green (false-positive tripwire)."""
+    corpus = os.path.join(REPO_ROOT, "tools", "graftlint", "corpus")
+    staging = os.path.join(REPO_ROOT, "paddle_tpu", "_graftlint_gate_tmp")
+    os.makedirs(staging, exist_ok=True)
+    try:
+        for name in sorted(os.listdir(corpus)):
+            if not name.endswith(".py"):
+                continue
+            dst = os.path.join(staging, name)
+            shutil.copyfile(os.path.join(corpus, name), dst)
+            proc = _run_lint(dst)
+            if name == "clean_ok.py":
+                assert proc.returncode == 0, (
+                    f"{name} should lint clean outside the corpus:\n"
+                    + proc.stdout)
+            else:
+                assert proc.returncode != 0, (
+                    f"introducing corpus snippet {name} into paddle_tpu/ "
+                    "did NOT fail the lint run:\n" + proc.stdout)
+            os.remove(dst)
+    finally:
+        shutil.rmtree(staging, ignore_errors=True)

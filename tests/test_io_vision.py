@@ -4,6 +4,10 @@ import os
 import numpy as np
 import pytest
 
+# tier-1 split (BASELINE.md): DataLoader worker-process tests dominate a
+# 2-core box (600s+ alone) — run with `pytest -m slow`
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.io import (DataLoader, Dataset, TensorDataset, ConcatDataset,
                            Subset, random_split, BatchSampler, RandomSampler,

@@ -4,6 +4,9 @@ small configs, forward shapes, training convergence, sharded step)."""
 import numpy as np
 import pytest
 
+# tier-1 split (BASELINE.md): ERNIE/ViT/UNet end-to-end steps, ~87s
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer
 from paddle_tpu.models import (ErnieConfig, ErnieModel,

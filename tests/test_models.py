@@ -3,6 +3,9 @@ test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py, driven
 dp/mp/pp by test_parallel_api_with_llama_*.py)."""
 import numpy as np
 import pytest
+
+# tier-1 split (BASELINE.md): llama family end-to-end steps, ~67s
+pytestmark = pytest.mark.slow
 import jax
 import jax.numpy as jnp
 

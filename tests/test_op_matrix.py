@@ -21,6 +21,9 @@ ops.yaml without either — the reference's "no silent op" bar.
 import numpy as np
 import pytest
 
+# tier-1 split (BASELINE.md): 221-case op matrix, ~115s
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.core.dispatch import apply_op
 from paddle_tpu.ops import registry

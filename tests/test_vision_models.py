@@ -4,6 +4,9 @@ inputs; flops against hand counts)."""
 import numpy as np
 import pytest
 
+# tier-1 split (BASELINE.md): model-zoo forward/backward sweeps, ~160s
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu import nn
 from paddle_tpu.vision import models

@@ -1,0 +1,3 @@
+# tools/ is a package so `python -m tools.graftlint` resolves from the repo
+# root; the standalone scripts in here (serve_bench.py, step_profile.py, ...)
+# still run as plain scripts.

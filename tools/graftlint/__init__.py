@@ -1,0 +1,22 @@
+"""graftlint — framework-aware static analysis for this repo.
+
+Run it:            python -m tools.graftlint paddle_tpu/ tests/ tools/
+Self-test corpus:  python -m tools.graftlint --selftest
+List rules:        python -m tools.graftlint --list-rules
+Suppress a line:   trailing `# graftlint: disable=GL201` (comma list; a
+                   comment anywhere on a multi-line statement's span works)
+Suppress a file:   `# graftlint: disable-file=GL103` on its own line
+Baseline:          tools/graftlint_baseline.json — triaged pre-existing
+                   findings, reported but non-fatal; regenerate with
+                   `python -m tools.graftlint --write-baseline <paths>`
+
+Stdlib-only (ast); safe to run before jax or the package import.
+"""
+from .core import (  # noqa: F401
+    Finding, RULES, run, lint_file, load_baseline, write_baseline,
+    DEFAULT_BASELINE, CORPUS_DIR, REPO_ROOT,
+)
+from . import rules  # noqa: F401  (registers all rule families)
+
+__all__ = ["Finding", "RULES", "run", "lint_file", "load_baseline",
+           "write_baseline", "DEFAULT_BASELINE", "CORPUS_DIR", "REPO_ROOT"]

@@ -1,0 +1,78 @@
+"""CLI: python -m tools.graftlint [paths...] [options].
+
+Exit codes: 0 clean (new findings == 0; baselined findings are reported
+but non-fatal), 1 new findings or parse errors, 2 usage error.
+"""
+import argparse
+import sys
+
+from .core import DEFAULT_BASELINE, RULES, run, write_baseline
+from . import rules  # noqa: F401
+from .selftest import run_selftest
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="framework-aware static analysis (trace safety, "
+                    "shard_map hygiene, Pallas bounds, repo hygiene)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (e.g. paddle_tpu/ "
+                         "tests/ tools/)")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline allowlist JSON (default: "
+                         "tools/graftlint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding as new (ignore baseline)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="triage mode: write all current findings to the "
+                         "baseline file and exit 0")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print baselined findings")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the known-bad corpus through every rule")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code, r in sorted(RULES.items()):
+            print(f"{code}  {r.name:32s} [{r.family}]")
+            print(f"       {r.doc.splitlines()[0] if r.doc else ''}")
+        return 0
+
+    if args.selftest:
+        return 1 if run_selftest() else 0
+
+    if not args.paths:
+        ap.error("no paths given (and neither --selftest nor --list-rules)")
+
+    res = run(args.paths, baseline_path=args.baseline,
+              use_baseline=not args.no_baseline)
+
+    if args.write_baseline:
+        write_baseline(res.new + res.baselined, path=args.baseline)
+        print(f"graftlint: wrote {len(res.new) + len(res.baselined)} "
+              f"findings to {args.baseline}")
+        return 0
+
+    for f in res.parse_errors:
+        print(f"PARSE ERROR {f}")
+    if args.show_baselined:
+        for f in res.baselined:
+            print(f"[baselined] {f.render()}")
+    for f in res.new:
+        print(f.render())
+    status = "FAIL" if (res.new or res.parse_errors) else "OK"
+    print(f"graftlint: {status} — {res.files} files, "
+          f"{len(res.new)} new finding(s), {len(res.baselined)} baselined, "
+          f"{res.suppressed} suppressed"
+          + (f", {len(res.parse_errors)} parse error(s)"
+             if res.parse_errors else ""))
+    return 1 if (res.new or res.parse_errors) else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. `... --list-rules | head`
+        sys.exit(0)

@@ -1,0 +1,264 @@
+"""graftlint core: finding model, rule registry, suppressions, baseline.
+
+Framework-aware static analysis for this repo (stdlib `ast` only — the
+linter must import in a bare CI container, before jax, before anything).
+Three of the four rule families encode bugs PR 1 fixed by hand:
+
+* the `from jax import shard_map` import skew that silently wiped 43 of
+  47 test files off the collection (trace-safety family),
+* the partial-auto `shard_map` call shape jax 0.4.x aborts the process
+  on (shard_map-hygiene family),
+* the `update_paged_kv_cache` out-of-bounds block-table write (Pallas
+  bounds family).
+
+A rule is a function `fn(ctx) -> iterable[Finding]` registered with the
+`@rule(...)` decorator. Rules see one `FileContext` per file: parsed AST,
+source lines, parent links, and per-line suppression sets. Findings that
+carry a `# graftlint: disable=CODE` comment anywhere on the offending
+statement's line span are dropped; findings listed in the committed
+baseline (tools/graftlint_baseline.json) are reported but don't fail the
+run — the baseline is the triage ledger for pre-existing, understood
+debt (today: the partial-auto shard_map sites that need a newer jax).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_BASELINE = REPO_ROOT / "tools" / "graftlint_baseline.json"
+CORPUS_DIR = Path(__file__).resolve().parent / "corpus"
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*graftlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    code: str
+    path: str          # repo-root-relative posix path
+    line: int
+    col: int
+    message: str
+
+    def render(self):
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+    def baseline_key(self):
+        return (self.code, self.path, self.line)
+
+
+@dataclass
+class Rule:
+    code: str
+    name: str
+    family: str        # trace-safety | shard-map | pallas-bounds | hygiene
+    doc: str
+    fn: object
+    applies: object    # fn(ctx) -> bool
+
+
+RULES: dict[str, Rule] = {}
+
+
+def _applies_everywhere(ctx):
+    return True
+
+
+def rule(code, name, family, applies=_applies_everywhere):
+    """Register a rule. `applies(ctx)` scopes it (e.g. Pallas rules only
+    look at kernel files); corpus files always pass the scope check so the
+    self-test corpus exercises every family regardless of layout."""
+
+    def deco(fn):
+        RULES[code] = Rule(code=code, name=name, family=family,
+                           doc=(fn.__doc__ or "").strip(), fn=fn,
+                           applies=applies)
+        return fn
+
+    return deco
+
+
+def in_paddle_tpu(ctx):
+    return ctx.path.startswith("paddle_tpu/") or ctx.in_corpus
+
+
+def in_pallas(ctx):
+    return "pallas" in ctx.path or ctx.in_corpus
+
+
+class FileContext:
+    """Everything a rule needs about one file, parsed once."""
+
+    def __init__(self, path, source, in_corpus=False):
+        self.path = str(path)          # repo-relative posix
+        self.source = source
+        self.lines = source.splitlines()
+        self.in_corpus = in_corpus
+        self.tree = ast.parse(source, filename=self.path)
+        self._parents = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        # per-line and file-level suppressions from comments
+        self.line_suppress = {}
+        self.file_suppress = set()
+        for i, ln in enumerate(self.lines, 1):
+            m = _SUPPRESS_FILE_RE.search(ln)
+            if m:
+                self.file_suppress.update(
+                    c.strip() for c in m.group(1).split(",") if c.strip())
+                continue
+            m = _SUPPRESS_RE.search(ln)
+            if m:
+                self.line_suppress[i] = {
+                    c.strip() for c in m.group(1).split(",") if c.strip()}
+        # names numpy is bound to in this module (`import numpy as np`)
+        self.numpy_aliases = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "numpy" or a.name.startswith("numpy."):
+                        self.numpy_aliases.add(
+                            a.asname or a.name.split(".")[0])
+
+    def parent(self, node):
+        return self._parents.get(node)
+
+    def enclosing_functions(self, node):
+        """Innermost-first chain of FunctionDef/AsyncFunctionDef above node."""
+        out = []
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(cur)
+            cur = self.parent(cur)
+        return out
+
+    def finding(self, code, node, message):
+        return Finding(code=code, path=self.path, line=node.lineno,
+                       col=node.col_offset, message=message)
+
+    def is_suppressed(self, finding, node=None):
+        codes = {finding.code, "all"}
+        if codes & self.file_suppress:
+            return True
+        lo = finding.line
+        hi = getattr(node, "end_lineno", None) or finding.line
+        # a suppression comment anywhere on the offending statement's
+        # physical line span counts (multi-line calls put the comment at
+        # the end)
+        for ln in range(lo, hi + 1):
+            if codes & self.line_suppress.get(ln, set()):
+                return True
+        return False
+
+
+@dataclass
+class RunResult:
+    new: list = field(default_factory=list)
+    baselined: list = field(default_factory=list)
+    suppressed: int = 0
+    files: int = 0
+    parse_errors: list = field(default_factory=list)
+
+    @property
+    def ok(self):
+        return not self.new and not self.parse_errors
+
+
+def iter_py_files(paths):
+    """Expand CLI paths to .py files; the self-test corpus and caches are
+    never linted as part of a tree run (corpus files are intentionally
+    bad — `--selftest` checks them against EXPECTED findings instead)."""
+    seen = set()
+    for p in paths:
+        p = Path(p)
+        candidates = [p] if p.is_file() else sorted(p.rglob("*.py"))
+        for f in candidates:
+            f = f.resolve()
+            if f.suffix != ".py" or f in seen:
+                continue
+            if "__pycache__" in f.parts:
+                continue
+            try:
+                f.relative_to(CORPUS_DIR)
+                continue
+            except ValueError:
+                pass
+            seen.add(f)
+            yield f
+
+
+def relpath(f):
+    f = Path(f).resolve()
+    try:
+        return f.relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return f.as_posix()
+
+
+def lint_file(path, in_corpus=False):
+    """All raw findings for one file (suppressions applied, no baseline)."""
+    source = Path(path).read_text()
+    ctx = FileContext(relpath(path), source, in_corpus=in_corpus)
+    findings, suppressed = [], 0
+    for r in RULES.values():
+        if not r.applies(ctx):
+            continue
+        for item in r.fn(ctx):
+            f, node = item if isinstance(item, tuple) else (item, None)
+            if ctx.is_suppressed(f, node):
+                suppressed += 1
+            else:
+                findings.append(f)
+    return findings, suppressed
+
+
+def load_baseline(path=DEFAULT_BASELINE):
+    path = Path(path)
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text())
+    return {(e["code"], e["path"], e["line"]) for e in data.get("findings", [])}
+
+
+def write_baseline(findings, path=DEFAULT_BASELINE, notes=None):
+    entries = [
+        {"code": f.code, "path": f.path, "line": f.line, "message": f.message}
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.code))
+    ]
+    payload = {
+        "_comment": notes or (
+            "Triaged pre-existing graftlint findings. Entries here are "
+            "reported but do not fail the run. Regenerate with "
+            "`python -m tools.graftlint --write-baseline <paths>`; never "
+            "add new code here instead of fixing it."),
+        "version": 1,
+        "findings": entries,
+    }
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+
+
+def run(paths, baseline_path=DEFAULT_BASELINE, use_baseline=True):
+    from . import rules  # noqa: F401 — registers all rule modules
+    baseline = load_baseline(baseline_path) if use_baseline else set()
+    res = RunResult()
+    for f in iter_py_files(paths):
+        res.files += 1
+        try:
+            findings, nsup = lint_file(f)
+        except SyntaxError as e:
+            res.parse_errors.append(f"{relpath(f)}: {e}")
+            continue
+        res.suppressed += nsup
+        for fd in findings:
+            (res.baselined if fd.baseline_key() in baseline
+             else res.new).append(fd)
+    res.new.sort(key=lambda f: (f.path, f.line, f.code))
+    res.baselined.sort(key=lambda f: (f.path, f.line, f.code))
+    return res

@@ -1,0 +1,43 @@
+# graftlint-corpus-expect: none
+"""False-positive tripwire: the CORRECT spellings of every pattern the
+rules hunt. If any rule fires here, it drifted into noise."""
+import os
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from paddle_tpu.framework.compat import shard_map  # the sanctioned route
+
+GOOD_SPEC = pl.BlockSpec((8, 128), lambda i: (i, 0))
+LEADING_ONE = pl.BlockSpec((1, 256), lambda i: (0, i))
+
+
+def update_paged_kv_cache_fixed(cache, new, block_tables, context_lens,
+                                block_size, max_nb):
+    blk_idx = jnp.minimum(context_lens // block_size, max_nb - 1)
+    blk_ids = jnp.take_along_axis(block_tables, blk_idx[:, None],
+                                  axis=1)[:, 0]
+    nb = cache.shape[1]
+    blk_ids = jnp.where(context_lens >= max_nb * block_size, nb, blk_ids)
+    offs = context_lens % block_size
+    return cache.at[:, blk_ids, offs].set(new, mode="drop")
+
+
+def copy_window_clamped(src_ref, dst_ref, lens_ref, i):
+    start = jnp.minimum(lens_ref[i] * 8, src_ref.shape[0] - 8)
+    dst_ref[...] = src_ref[pl.ds(start, 8)]
+
+
+def fully_manual(fn, jm, specs):
+    # no axis_names/auto: fully-manual shard_map, safe on jax 0.4.x
+    return shard_map(fn, mesh=jm, in_specs=specs, out_specs=specs)
+
+
+def read_env_at_call_time():
+    return os.environ.get("PADDLE_DEBUG", "0")
+
+
+def no_shared_default(x, acc=None):
+    acc = [] if acc is None else acc
+    acc.append(x)
+    return acc

@@ -1,0 +1,13 @@
+# graftlint-corpus-expect: GL102 GL102
+"""Both sides of the TPUCompilerParams -> CompilerParams rename, spelled
+directly: each binds the code to one jax release family and raises
+AttributeError on the other."""
+from jax.experimental.pallas import tpu as pltpu
+
+
+def cparams_new_jax_only():
+    return pltpu.CompilerParams(vmem_limit_bytes=1 << 20)
+
+
+def cparams_old_jax_only():
+    return pltpu.TPUCompilerParams(vmem_limit_bytes=1 << 20)

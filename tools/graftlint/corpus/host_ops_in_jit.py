@@ -1,0 +1,13 @@
+# graftlint-corpus-expect: GL103 GL103 GL103
+"""Host-side operations inside a jitted function: print fires at trace
+time (not per step), np.* constant-folds under the trace, .item() forces
+a blocking device sync (and fails outright on traced values)."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def train_step(x):
+    print("step", x)          # appears once, at trace time
+    y = np.asarray(x)         # constant-folds: frozen at trace time
+    return y * x.item()       # host sync / error under trace

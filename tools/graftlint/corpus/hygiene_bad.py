@@ -1,0 +1,16 @@
+# graftlint-corpus-expect: GL401 GL402 GL403
+"""Repo-hygiene trifecta: import-time env read (config frozen before the
+launcher/test harness can set it), mutable default (one list shared
+across every call), bare except (swallows KeyboardInterrupt and typos
+alike)."""
+import os
+
+_DEBUG = os.environ.get("PADDLE_DEBUG", "0")
+
+
+def accumulate(x, acc=[]):
+    try:
+        acc.append(int(x))
+    except:
+        pass
+    return acc

@@ -1,0 +1,15 @@
+# graftlint-corpus-expect: GL101 GL101 GL101
+"""Reconstruction of the PR 1 import skew: on jax 0.4.x `from jax import
+shard_map` raises ImportError at module import, and any test module that
+(transitively) imports this file drops out of pytest collection without
+failing anything — 43 of 47 test files vanished this way."""
+import jax
+from jax import shard_map                       # noqa: F401
+import jax.experimental.shard_map as xsm        # noqa: F401
+
+
+def run(fn, mesh, specs):
+    # direct attribute use of the experimental module: same skew, spelled
+    # at the call site instead of the import
+    return jax.experimental.shard_map.shard_map(
+        fn, mesh=mesh, in_specs=specs, out_specs=specs)

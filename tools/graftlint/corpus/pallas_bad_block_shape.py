@@ -1,0 +1,8 @@
+# graftlint-corpus-expect: GL302 GL302
+"""Literal block shapes that fight the (8, 128) TPU tile: Mosaic pads
+each block to full tiles, so a 100-lane minor dim ships 128 lanes of
+VMEM and masks 28, and a 12-row second-minor dim pads to 16."""
+from jax.experimental import pallas as pl
+
+BAD_MINOR = pl.BlockSpec((16, 100), lambda i: (i, 0))
+BAD_SECOND_MINOR = pl.BlockSpec((1, 12, 256), lambda i: (i, 0, 0))

@@ -1,0 +1,24 @@
+# graftlint-corpus-expect: GL301 GL301
+"""Reconstruction of the PR 1 `update_paged_kv_cache` out-of-bounds
+write: for a row whose cache is FULL (context_lens == max_blocks *
+block_size), blk_idx equals max_blocks — one past the last block-table
+column — and the unguarded scatter lands in whichever block the clamped
+gather aliases, silently corrupting another sequence's KV cache. The fix
+(paddle_tpu/ops/pallas/paged_attention.py) clamps the column and
+scatters with mode='drop'."""
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def update_paged_kv_cache_oob(cache, new, block_tables, context_lens,
+                              block_size):
+    blk_idx = context_lens // block_size       # == max_nb on a full row
+    blk_ids = jnp.take_along_axis(block_tables, blk_idx[:, None],
+                                  axis=1)[:, 0]
+    offs = jnp.zeros_like(blk_ids)
+    return cache.at[:, blk_ids, offs].set(new)  # unguarded data-fed scatter
+
+
+def copy_window_oob(src_ref, dst_ref, lens_ref, i):
+    start = lens_ref[i] * 8                    # data-fed, never clamped
+    dst_ref[...] = src_ref[pl.ds(start, 8)]

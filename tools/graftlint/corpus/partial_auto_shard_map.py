@@ -1,0 +1,17 @@
+# graftlint-corpus-expect: GL201
+"""A partial-auto shard_map call site: manual over `axis`, auto over the
+rest of the mesh. jax 0.4.x's experimental shard_map aborts the process
+on this shape (Fatal Python error inside XLA), which is why
+framework/compat.resolve_shard_map refuses it with NotImplementedError."""
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.framework.compat import shard_map
+
+
+def run_stage(fn, jm, axis, params, micro):
+    return shard_map(
+        fn, mesh=jm,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        axis_names=frozenset({axis}),
+        check_vma=False)(params, micro)

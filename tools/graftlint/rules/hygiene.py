@@ -1,0 +1,88 @@
+"""Repo hygiene rules (GL4xx) — scoped to `paddle_tpu/` (plus the
+self-test corpus): the shipped package holds a higher bar than tests and
+one-off tools.
+
+GL401 bare `except:` swallows KeyboardInterrupt/SystemExit and every
+typo alike — on a serving hot path that turns a crash into silent wrong
+answers. GL402 mutable default arguments are shared across calls — the
+classic aliasing bug. GL403 `os.environ` reads at import time freeze
+configuration before the launcher/test-harness can set it (this repo's
+conftest must reconfigure XLA *before* the first jax import precisely
+because of this class of bug); read env inside the function that needs
+it, or through utils/flags.
+"""
+import ast
+
+from ..core import rule, in_paddle_tpu
+
+
+@rule("GL401", "bare-except", "hygiene", applies=in_paddle_tpu)
+def bare_except(ctx):
+    """`except:` with no exception type."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield ctx.finding(
+                "GL401", node,
+                "bare `except:` catches KeyboardInterrupt/SystemExit and "
+                "hides typos — catch Exception (or narrower) and keep the "
+                "error visible"), node
+
+
+@rule("GL402", "mutable-default-arg", "hygiene", applies=in_paddle_tpu)
+def mutable_default_arg(ctx):
+    """def f(x=[]) / f(x={}) / f(x=set()): one shared object across calls."""
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        defaults = list(fn.args.defaults) + [
+            d for d in fn.args.kw_defaults if d is not None]
+        for d in defaults:
+            bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call) and isinstance(d.func, ast.Name)
+                and d.func.id in ("list", "dict", "set") and not d.args
+                and not d.keywords)
+            if bad:
+                yield ctx.finding(
+                    "GL402", d,
+                    f"mutable default argument in `{fn.name}`: evaluated "
+                    "once at def time and shared across every call — "
+                    "default to None and materialize inside"), d
+
+
+@rule("GL403", "env-read-at-import", "hygiene", applies=in_paddle_tpu)
+def env_read_at_import(ctx):
+    """os.environ touched at module import time (module or class body,
+    outside any function)."""
+
+    def scan(body):
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # runs at call time, not import time
+            if isinstance(st, ast.ClassDef):
+                yield from scan(st.body)  # class bodies run at import
+                continue
+            for n in _walk_outside_defs(st):
+                if isinstance(n, ast.Attribute) and n.attr == "environ" \
+                        and isinstance(n.value, ast.Name) \
+                        and n.value.id == "os":
+                    yield ctx.finding(
+                        "GL403", n,
+                        "os.environ read at import time freezes config "
+                        "before launchers/tests can set it — read it "
+                        "inside the function that needs it (or through "
+                        "utils/flags)"), st
+
+    yield from scan(ctx.tree.body)
+
+
+def _walk_outside_defs(node):
+    """ast.walk, pruned at function/lambda boundaries."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.append(c)

@@ -1,0 +1,49 @@
+"""shard_map hygiene rules (GL2xx).
+
+GL201 flags the partial-auto call shape: a `shard_map(...)` call that
+passes `axis_names=` (manual over a subset of the mesh axes — the rest
+run on auto) or the legacy `auto=` kwarg. On jax 0.4.x this is not a
+clean failure: feeding partial-auto call sites to experimental shard_map
+aborts the whole process (Fatal Python error inside XLA, observed on the
+ulysses context-parallel path), which is why
+`framework/compat.resolve_shard_map` refuses them with
+NotImplementedError at call time. This rule surfaces the same hazard at
+lint time: every such call site is either dead on 0.4.x (and belongs in
+the baseline with its ROADMAP triage) or about to become a new one.
+"""
+import ast
+
+from ..core import rule
+
+
+def _callee_name(call):
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+@rule("GL201", "partial-auto-shard-map", "shard-map")
+def partial_auto_shard_map(ctx):
+    """shard_map(..., axis_names=...) / shard_map(..., auto=...): manual
+    over a subset of mesh axes, the partial-auto mode jax 0.4.x crashes
+    on."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _callee_name(node) != "shard_map":
+            continue
+        kw = {k.arg for k in node.keywords if k.arg}
+        hit = sorted(kw & {"axis_names", "auto"})
+        if hit:
+            yield ctx.finding(
+                "GL201", node,
+                f"partial-auto shard_map call ({'/'.join(hit)}= declares "
+                "manual axes over a subset of the mesh): jax 0.4.x's "
+                "experimental shard_map aborts the process on this shape, "
+                "so compat.resolve_shard_map refuses it with "
+                "NotImplementedError (see framework/compat.py). Needs a "
+                "newer jax — keep the site baselined with its ROADMAP "
+                "triage, or restructure the call to be fully manual"), node

@@ -1,0 +1,141 @@
+"""Trace-safety rules (GL1xx).
+
+GL101 reconstructs the PR 1 import skew: a single `from jax import
+shard_map` at module scope raised at import time on jax 0.4.x and took
+43 of 47 test files out of the collection — silently. Every shard_map
+user must route through `paddle_tpu.framework.compat.resolve_shard_map`.
+
+GL102 is the same class of version skew for Pallas compiler params: jax
+renamed `pltpu.TPUCompilerParams` -> `pltpu.CompilerParams`; spelling
+either directly binds the code to one side of the rename. Route through
+`framework.compat.resolve_compiler_params`.
+
+GL103 flags host-side operations inside jit-decorated functions: `print`
+traces zero times or once (not per step), `.item()` forces a blocking
+device sync per call, and `np.*` calls silently constant-fold at trace
+time — all three are almost never what the author meant inside a traced
+function.
+"""
+import ast
+
+from ..core import rule
+
+# the one module allowed to touch raw jax shard_map / CompilerParams
+# spellings: it IS the resolver
+COMPAT_MODULE = "paddle_tpu/framework/compat.py"
+
+
+def _attr_chain(node):
+    """Dotted-name string for Attribute/Name chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+@rule("GL101", "raw-shard-map-import", "trace-safety")
+def raw_shard_map_import(ctx):
+    """`from jax import shard_map` (or any direct jax.experimental.shard_map
+    import/use) outside framework/compat.py."""
+    if ctx.path == COMPAT_MODULE:
+        return
+    msg = ("raw jax shard_map import: on jax 0.4.x this raises at import "
+           "time and (if reachable from a test module) silently removes the "
+           "module from collection — route through "
+           "paddle_tpu.framework.compat.resolve_shard_map")
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod in ("jax", "jax.experimental") and any(
+                    a.name == "shard_map" for a in node.names):
+                yield ctx.finding("GL101", node, msg), node
+            elif mod == "jax.experimental.shard_map":
+                yield ctx.finding("GL101", node, msg), node
+        elif isinstance(node, ast.Import):
+            if any(a.name == "jax.experimental.shard_map"
+                   for a in node.names):
+                yield ctx.finding("GL101", node, msg), node
+        elif isinstance(node, ast.Attribute):
+            if _attr_chain(node) == "jax.experimental.shard_map":
+                yield ctx.finding("GL101", node, msg), node
+
+
+@rule("GL102", "compiler-params-direct", "trace-safety")
+def compiler_params_direct(ctx):
+    """Direct `pltpu.CompilerParams` / `pltpu.TPUCompilerParams` attribute
+    access outside the compat resolver."""
+    if ctx.path == COMPAT_MODULE:
+        return
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Attribute)
+                and node.attr in ("CompilerParams", "TPUCompilerParams")):
+            yield ctx.finding(
+                "GL102", node,
+                f"direct pltpu.{node.attr}: jax renamed TPUCompilerParams "
+                "-> CompilerParams across releases; use "
+                "framework.compat.resolve_compiler_params() so either jax "
+                "works"), node
+
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _is_jitish(expr):
+    if isinstance(expr, ast.Name):
+        return expr.id in _JIT_NAMES
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _JIT_NAMES
+    if isinstance(expr, ast.Call):
+        if _is_jitish(expr.func):
+            return True  # @jax.jit(static_argnums=...)
+        f = expr.func
+        is_partial = ((isinstance(f, ast.Name) and f.id == "partial")
+                      or (isinstance(f, ast.Attribute)
+                          and f.attr == "partial"))
+        if is_partial:
+            return any(_is_jitish(a) for a in expr.args)
+    return False
+
+
+@rule("GL103", "host-op-in-jit", "trace-safety")
+def host_op_in_jit(ctx):
+    """print / .item() / numpy calls inside a jax.jit- or pjit-decorated
+    function: print fires at trace time (zero or one time, not per step),
+    .item() forces a device sync, np.* constant-folds under the trace."""
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not any(_is_jitish(d) for d in fn.decorator_list):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "print":
+                yield ctx.finding(
+                    "GL103", node,
+                    f"print() inside jitted `{fn.name}` runs at trace time, "
+                    "not per step — use jax.debug.print for runtime "
+                    "values"), node
+            elif isinstance(f, ast.Attribute) and f.attr == "item" \
+                    and not node.args:
+                yield ctx.finding(
+                    "GL103", node,
+                    f".item() inside jitted `{fn.name}` forces a blocking "
+                    "host sync (and fails on traced values) — keep values "
+                    "on device"), node
+            elif isinstance(f, ast.Attribute):
+                root = f.value
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name) \
+                        and root.id in ctx.numpy_aliases:
+                    yield ctx.finding(
+                        "GL103", node,
+                        f"numpy call `{_attr_chain(f)}` inside jitted "
+                        f"`{fn.name}` constant-folds at trace time — use "
+                        "jnp/lax so it runs per step on device"), node

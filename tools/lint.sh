@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Static-analysis entry point: rule self-test corpus first (a lobotomized
+# rule must not green-light the tree scan), then the tree scan itself.
+# Extra args pass through to the tree scan, e.g.
+#   tools/lint.sh --show-baselined
+#   tools/lint.sh --write-baseline      # triage mode: regenerate baseline
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m tools.graftlint --selftest
+python -m tools.graftlint paddle_tpu/ tests/ tools/ "$@"
