@@ -29,7 +29,14 @@ def main():
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--metrics", action="store_true",
+                    help="count XLA compiles + step time/tokens-per-s "
+                         "and print the metrics snapshot at the end")
     args = ap.parse_args()
+
+    if args.metrics:
+        from paddle_tpu import observability as obs
+        obs.install_compile_watch()
 
     cfg = LlamaConfig(
         vocab_size=2048, hidden_size=256, intermediate_size=688,
@@ -54,6 +61,20 @@ def main():
                                         np.int32)}, mesh)
         params, opt_state, loss, gnorm = step(params, opt_state, batch)
         print(f"step {i}: loss {float(loss):.4f} gnorm {float(gnorm):.3f}")
+
+    if args.metrics:
+        reg = obs.get_registry()
+        snap = reg.snapshot().get("jax_compiles_total", {})
+        backend = sum(
+            c["value"] for name, c in snap.get("children", {}).items()
+            if name.startswith("backend_compile"))
+        print(f"backend compiles: {backend:.0f}")
+        steps_h = reg.get("train_step_seconds")
+        if steps_h is not None and steps_h.count:
+            print(f"step p50 {steps_h.quantile(0.5)*1e3:.1f} ms, "
+                  f"p95 {steps_h.quantile(0.95)*1e3:.1f} ms over "
+                  f"{steps_h.count} steps")
+        print(obs.to_json(indent=1))
 
 
 if __name__ == "__main__":

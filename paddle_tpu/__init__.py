@@ -46,6 +46,7 @@ from . import autograd
 from . import distribution
 from . import hapi
 from . import profiler
+from . import observability
 from . import incubate
 from . import device
 from . import sparse

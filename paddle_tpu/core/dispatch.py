@@ -129,8 +129,13 @@ def set_amp_hook(fn):
 
 
 def set_op_tracer(fn):
+    """Install the per-op range hook; returns the previous hook so a
+    scoped user (the profiler's record window) restores instead of
+    clobbering whatever was installed around it."""
     global _op_tracer
+    prev = _op_tracer
     _op_tracer = fn
+    return prev
 
 
 def apply_op(name, impl, args, kwargs, differentiable=True):

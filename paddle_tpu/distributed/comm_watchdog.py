@@ -17,7 +17,28 @@ import os
 import threading
 import time
 
+from ..observability import get_registry as _registry
+
 log = logging.getLogger("paddle_tpu.distributed.watchdog")
+
+
+def _stall_counter():
+    return _registry().counter(
+        "comm_watchdog_stalls_total",
+        help="collectives that exceeded the watchdog timeout",
+        labels=("op",))
+
+
+def _inflight_gauge():
+    return _registry().gauge(
+        "comm_inflight_collectives",
+        help="eager collectives dispatched but not yet completed")
+
+
+def _collective_seconds():
+    return _registry().histogram(
+        "comm_collective_seconds",
+        help="host wall time of eager collective dispatches")
 
 __all__ = ["CommTask", "CommTaskManager", "enable_comm_watchdog",
            "disable_comm_watchdog", "comm_task_manager"]
@@ -78,12 +99,17 @@ class CommTaskManager:
             self._seq[gname] = seq
             t = CommTask(self._next_id, op, gname, seq, nbytes)
             self._tasks[t.task_id] = t
+            n = len(self._tasks)
+        _inflight_gauge().set(n)
         return t
 
     def end_task(self, task):
         task.end = time.monotonic()
         with self._lock:
             self._tasks.pop(task.task_id, None)
+            n = len(self._tasks)
+        _inflight_gauge().set(n)
+        _collective_seconds().observe(task.elapsed)
 
     # -- watchdog ------------------------------------------------------
     def register_hang_hook(self, fn):
@@ -108,6 +134,9 @@ class CommTaskManager:
                     t.reported = True  # one report per task
             if hung:
                 self.hang_detected = True
+                counter = _stall_counter()
+                for t in hung:
+                    counter.labels(op=t.op).inc()
                 self._dump(hung)
 
     def _dump(self, hung):

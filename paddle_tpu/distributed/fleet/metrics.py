@@ -10,6 +10,7 @@ import numpy as np
 
 from .. import collective as _c
 from ...core.tensor import Tensor, to_tensor
+from ...observability import get_registry as _registry
 
 __all__ = ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]
 
@@ -20,23 +21,38 @@ def _reduce(arr, op):
     return np.asarray(t.numpy())
 
 
+def _publish(kind, value):
+    """Mirror a scalar fleet metric into the observability registry so
+    cross-trainer stats land on the same Prometheus/chrome surface as
+    the serving and compile metrics. Arrays are skipped (gauges hold one
+    scalar); returns the value unchanged either way."""
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return value
+    _registry().gauge("fleet_metric",
+                      help="last reduced cross-trainer stat",
+                      labels=("kind",)).labels(kind=kind).set(v)
+    return value
+
+
 def sum(input, scope=None, util=None):
     """Global elementwise sum of a stat array (reference metric.sum)."""
     a = np.asarray(input.numpy() if isinstance(input, Tensor) else input)
     out = _reduce(a, _c.ReduceOp.SUM)
-    return float(out) if out.ndim == 0 else out
+    return _publish("sum", float(out)) if out.ndim == 0 else out
 
 
 def max(input, scope=None, util=None):
     a = np.asarray(input.numpy() if isinstance(input, Tensor) else input)
     out = _reduce(a, _c.ReduceOp.MAX)
-    return float(out) if out.ndim == 0 else out
+    return _publish("max", float(out)) if out.ndim == 0 else out
 
 
 def min(input, scope=None, util=None):
     a = np.asarray(input.numpy() if isinstance(input, Tensor) else input)
     out = _reduce(a, _c.ReduceOp.MIN)
-    return float(out) if out.ndim == 0 else out
+    return _publish("min", float(out)) if out.ndim == 0 else out
 
 
 def auc(stat_pos, stat_neg, scope=None, util=None):
@@ -57,28 +73,28 @@ def auc(stat_pos, stat_neg, scope=None, util=None):
         area += (new_fp - fp) * (tp + new_tp) / 2.0
         tp, fp = new_tp, new_fp
     if tp == 0 or fp == 0:
-        return 0.5
-    return float(area / (tp * fp))
+        return _publish("auc", 0.5)
+    return _publish("auc", float(area / (tp * fp)))
 
 
 def mae(abserr, total_ins_num, scope=None, util=None):
     """Global mean absolute error from (sum |err|, instance count)."""
     e = sum(abserr)
     n = sum(total_ins_num)
-    return float(e) / np.maximum(float(n), 1.0)
+    return _publish("mae", float(e) / np.maximum(float(n), 1.0))
 
 
 def mse(sqrerr, total_ins_num, scope=None, util=None):
     e = sum(sqrerr)
     n = sum(total_ins_num)
-    return float(e) / np.maximum(float(n), 1.0)
+    return _publish("mse", float(e) / np.maximum(float(n), 1.0))
 
 
 def rmse(sqrerr, total_ins_num, scope=None, util=None):
-    return float(np.sqrt(mse(sqrerr, total_ins_num)))
+    return _publish("rmse", float(np.sqrt(mse(sqrerr, total_ins_num))))
 
 
 def acc(correct, total, scope=None, util=None):
     c = sum(correct)
     t = sum(total)
-    return float(c) / np.maximum(float(t), 1.0)
+    return _publish("acc", float(c) / np.maximum(float(t), 1.0))

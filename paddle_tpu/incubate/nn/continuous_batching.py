@@ -20,9 +20,11 @@ Attention" (PAPERS.md); the reference framework's analogue is the
 block_multihead_attention serving stack.
 """
 import collections
+import time
 
 import numpy as np
 
+from ...observability import instrument as _metrics
 from ...ops.pallas.paged_attention import (build_ragged_work, default_pack,
                                            next_pow2)
 
@@ -44,16 +46,24 @@ class BlockAllocator:
         self.reserved = reserved
         self._free = list(range(num_blocks - 1, reserved - 1, -1))
         self._free_set = set(self._free)  # O(1) double-free check
+        self.high_water = 0     # max blocks ever simultaneously in use
 
     @property
     def num_free(self):
         return len(self._free)
 
+    @property
+    def num_used(self):
+        return (self.num_blocks - self.reserved) - len(self._free)
+
     def alloc(self):
         if not self._free:
+            _metrics.kv_alloc_failures().inc()
             raise RuntimeError("BlockAllocator: out of cache blocks")
         b = self._free.pop()
         self._free_set.discard(b)
+        if self.num_used > self.high_water:
+            self.high_water = self.num_used
         return b
 
     def free(self, blocks):
@@ -86,6 +96,11 @@ class GenerationRequest:
         self.blocks = []        # physical cache blocks, in table order
         self.progress = 0       # prompt tokens consumed so far
         self.generated = []
+        # latency bookkeeping (host monotonic clock; set by the engine)
+        self.submit_time = None
+        self.admit_time = None
+        self.first_token_time = None
+        self._last_token_time = None
 
     @property
     def done(self):
@@ -139,6 +154,13 @@ class ContinuousBatchingEngine:
         self._topp = float(top_p)
         self._key = jax.random.PRNGKey(int(seed))
         self._step_count = 0
+        # padded work-list lengths already compiled for: the work list's
+        # static length keys the decode program, so a length outside this
+        # set means admission just caused an XLA recompile — the exact
+        # event the "no recompiles past the first few buckets" contract
+        # forbids in steady state. Counted per bucket so a test (and a
+        # dashboard) can assert the counter stays flat.
+        self._seen_buckets = set()
         kvh = self.caches[0].shape[1]
         num_q = engine.num_heads
         self._pack = default_pack(self.max_batch, num_q // kvh)
@@ -166,13 +188,16 @@ class ContinuousBatchingEngine:
                 r.request_id == rid for r in self.queue) or any(
                 r is not None and r.request_id == rid for r in self.slots):
             raise ValueError(f"duplicate request_id {rid}")
+        request.submit_time = time.monotonic()
         self.queue.append(request)
+        _metrics.serve_queue_depth().set(len(self.queue))
 
     @property
     def num_active(self):
         return sum(r is not None for r in self.slots)
 
     def _retire(self):
+        retired = 0
         for i, req in enumerate(self.slots):
             if req is not None and req.done:
                 self.allocator.free(req.blocks)
@@ -182,6 +207,17 @@ class ContinuousBatchingEngine:
                 self.lens[i] = 0
                 self.toks[i] = 0
                 self.finished[req.request_id] = list(req.generated)
+                retired += 1
+        if retired:
+            _metrics.serve_requests_total().inc(retired)
+            self._update_pool_gauges()
+
+    def _update_pool_gauges(self):
+        _metrics.kv_blocks_free().set(self.allocator.num_free)
+        _metrics.kv_blocks_used().set(self.allocator.num_used)
+        _metrics.kv_blocks_high_water().set(self.allocator.high_water)
+        _metrics.serve_inflight().set(self.num_active)
+        _metrics.serve_queue_depth().set(len(self.queue))
 
     def _admit(self):
         # FIFO with worst-case reservation: the head request waits until
@@ -202,6 +238,10 @@ class ContinuousBatchingEngine:
             req.blocks = []
             req.progress = 0
             req.generated = []
+            req.admit_time = time.monotonic()
+            if req.submit_time is not None:
+                _metrics.serve_queue_wait().observe(
+                    req.admit_time - req.submit_time)
             self.slots[i] = req
             self.tables[i] = 0
             self.lens[i] = 0
@@ -211,9 +251,11 @@ class ContinuousBatchingEngine:
         number of requests still in flight (active + queued)."""
         import jax
 
+        t_begin = time.monotonic()
         self._retire()
         self._admit()
         active = [i for i, r in enumerate(self.slots) if r is not None]
+        self._update_pool_gauges()
         if not active:
             return len(self.queue)
         for i in active:
@@ -230,26 +272,57 @@ class ContinuousBatchingEngine:
         # is ignored; a zero-entry row would leave its output tile
         # unvisited (uninitialised VMEM) when a whole pack group is idle
         attn_lens = (self.lens + 1).astype(np.int32)
-        work, _, _, pack = build_ragged_work(
+        work, _, t_total, pack = build_ragged_work(
             self.tables, attn_lens, self.block_size, self._pack,
             bucket_to=next_pow2)
+        # the padded work-list length is the ONLY shape the scheduler
+        # varies step to step — a length not seen before keys a fresh
+        # compile of the decode program (host-deterministic, so tests
+        # can assert this counter stays flat after warmup)
+        if t_total not in self._seen_buckets:
+            self._seen_buckets.add(t_total)
+            _metrics.serve_bucket_recompiles().labels(
+                bucket=t_total).inc()
         self._key, sub = jax.random.split(self._key)
         toks2, self.caches = self.engine._paged_step(
             self.engine._w, self.caches, np.asarray(self.toks),
             np.asarray(self.tables), np.asarray(self.lens), tuple(work),
             pack, np.float32(self._temp), np.float32(self._topp), sub)
         toks2 = np.asarray(toks2)
+        t_done = time.monotonic()
+        emitted = 0
         for i in active:
             req = self.slots[i]
             self.lens[i] += 1
             if req.progress < len(req.prompt):
                 req.progress += 1
                 if req.progress == len(req.prompt):
-                    req.generated.append(int(toks2[i]))
+                    self._append_token(req, toks2[i], t_done)
+                    emitted += 1
             else:
-                req.generated.append(int(toks2[i]))
+                self._append_token(req, toks2[i], t_done)
+                emitted += 1
         self._step_count += 1
+        dur = t_done - t_begin
+        _metrics.serve_step_seconds().observe(dur)
+        if emitted:
+            _metrics.serve_tokens_total().inc(emitted)
+            _metrics.serve_tokens_per_s().set(
+                emitted / dur if dur > 0 else 0.0)
         return len(self.queue) + self.num_active
+
+    def _append_token(self, req, tok, now):
+        """Record one generated token + its latency sample: the first
+        token of a request closes its TTFT window (submit -> token),
+        every later one is a time-per-output-token interval."""
+        req.generated.append(int(tok))
+        if req.first_token_time is None:
+            req.first_token_time = now
+            if req.submit_time is not None:
+                _metrics.serve_ttft().observe(now - req.submit_time)
+        elif req._last_token_time is not None:
+            _metrics.serve_tpot().observe(now - req._last_token_time)
+        req._last_token_time = now
 
     def run(self, max_steps=100000):
         """Drive step() until every submitted request has finished.

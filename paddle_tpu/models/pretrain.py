@@ -15,6 +15,7 @@ semantics (dygraph_sharding_optimizer.py:54) for free.
 """
 import re
 import math
+import time
 
 import numpy as np
 import jax
@@ -22,6 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..jit.functional import state_arrays, pure_call
+from ..observability import instrument as _metrics
 
 __all__ = ["llama_sharding_rules", "gpt_sharding_rules",
            "ernie_sharding_rules", "spec_for_param",
@@ -250,8 +252,26 @@ def make_train_step(model, mesh, meta, donate=True):
             if donate:
                 from ..device import record_donation
                 record_donation("pretrain.train_step", params, opt_state)
+            # step-time/throughput telemetry: host wall around the
+            # dispatch. jax dispatch is async, so past the first compiled
+            # call this measures submission latency — once the device is
+            # the bottleneck the queue backpressures and wall time
+            # converges to true step time (steady-state tokens/s is
+            # right; the first few samples are optimistic).
+            ids = batch.get("input_ids") if isinstance(batch, dict) \
+                else None
+            tokens = int(np.prod(ids.shape)) if ids is not None else 0
+            t0 = time.monotonic()
             with mesh:
-                return jitted(params, opt_state, batch)
+                out = jitted(params, opt_state, batch)
+            dur = time.monotonic() - t0
+            _metrics.train_step_seconds().observe(dur)
+            _metrics.train_steps_total().inc()
+            if tokens:
+                _metrics.train_tokens_total().inc(tokens)
+                if dur > 0:
+                    _metrics.train_tokens_per_s().set(tokens / dur)
+            return out
         finally:
             set_mesh(prev_mesh)
             if not was_training:

@@ -1,0 +1,47 @@
+"""paddle_tpu.observability — trace-safe, host-side runtime metrics.
+
+A process-wide registry of counters, gauges, and fixed-bucket histograms
+(metrics.py), three exporters (exporters.py: Prometheus text, JSON
+snapshot, chrome-trace counter events merged into the profiler
+timeline), a jax.monitoring compile watch (compile_watch.py), and the
+standard instrument set for serving/training/dispatch (instrument.py).
+
+Contract: record calls are HOST-SIDE ONLY — never inside a jitted
+function. The runtime guard is the ``float()`` coercion in metrics.py
+(tracers raise at trace time); the static guard is graftlint GL105.
+
+This package is stdlib-only at import time (jax is touched lazily, in
+``compile_watch.install()`` and ``watch_ops()``), so the tier-0 gate
+can selfcheck it in a bare container: tools/metrics_snapshot.py
+--selfcheck.
+
+Quick tour::
+
+    from paddle_tpu import observability as obs
+
+    reg = obs.get_registry()
+    reg.counter("requests_total").inc()
+    reg.gauge("queue_depth").set(3)
+    reg.histogram("ttft_seconds").observe(0.042)
+
+    obs.install_compile_watch()     # count XLA compiles from here on
+    obs.watch_ops()                 # count eager op dispatches
+
+    print(obs.to_prometheus())      # scrape format
+    print(obs.to_json(indent=1))    # snapshot
+    obs.chrome_counter_events()     # merged by Profiler._export_chrome
+"""
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      DEFAULT_LATENCY_BUCKETS, exponential_buckets,
+                      get_registry)
+from .exporters import chrome_counter_events, to_json, to_prometheus
+from .compile_watch import install as install_compile_watch
+from .compile_watch import installed as compile_watch_installed
+from .instrument import watch_ops
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "DEFAULT_LATENCY_BUCKETS", "exponential_buckets", "get_registry",
+    "to_prometheus", "to_json", "chrome_counter_events",
+    "install_compile_watch", "compile_watch_installed", "watch_ops",
+]

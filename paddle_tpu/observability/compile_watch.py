@@ -1,0 +1,93 @@
+"""Compile watch: XLA recompiles as first-class metrics.
+
+Recompiles are the silent killer of the serving contract ("bucketed
+work-list, no recompiles past the first few buckets" —
+incubate/nn/continuous_batching.py): a shape leak turns every admission
+into a multi-second XLA compile and the only symptom is a mysteriously
+slow step. jax already announces every trace/lower/compile through
+``jax.monitoring``; this module turns those announcements into registry
+metrics:
+
+* ``jax_compiles_total{stage=...}`` — counter per pipeline stage
+  (trace / lower / backend_compile), labeled ``fn`` when the running jax
+  passes ``fun_name`` metadata (newer jax; older versions label
+  ``unknown`` — graceful degradation, never a crash).
+* ``jax_compile_seconds{stage=...}`` — wall-time histogram per stage.
+* ``jax_cache_events_total{event=...}`` — compilation-cache hit/miss
+  counters.
+
+``install()`` is idempotent and returns False (a no-op) on jax builds
+without ``jax.monitoring`` — the listener API only exists from jax
+0.4.x on, and this package must degrade to nothing, not an ImportError.
+"""
+from .metrics import get_registry
+
+__all__ = ["install", "installed", "COMPILE_STAGES"]
+
+# suffix of the jax.monitoring duration event -> short stage label
+COMPILE_STAGES = {
+    "jaxpr_trace_duration": "trace",
+    "jaxpr_to_mlir_module_duration": "lower",
+    "backend_compile_duration": "backend_compile",
+}
+
+# compile wall-times span ~100 us (cache hit path) to minutes (big TPU
+# programs): wider-than-latency buckets
+_COMPILE_BUCKETS = tuple(1e-4 * 4.0 ** i for i in range(10))
+
+_installed = False
+
+
+def _stage_of(event):
+    for suffix, stage in COMPILE_STAGES.items():
+        if event.endswith(suffix):
+            return stage
+    return None
+
+
+def _on_duration(event, duration, **kwargs):
+    stage = _stage_of(event)
+    if stage is None:
+        return
+    reg = get_registry()
+    fn = str(kwargs.get("fun_name", "unknown"))
+    reg.counter("jax_compiles_total",
+                help="jax trace/lower/compile invocations",
+                labels=("stage", "fn")).labels(stage=stage, fn=fn).inc()
+    reg.histogram("jax_compile_seconds",
+                  help="jax trace/lower/compile wall time",
+                  labels=("stage",),
+                  buckets=_COMPILE_BUCKETS).labels(stage=stage).observe(
+                      duration)
+
+
+def _on_event(event, **kwargs):
+    if not event.startswith("/jax/compilation_cache/"):
+        return
+    get_registry().counter(
+        "jax_cache_events_total",
+        help="jax compilation-cache events",
+        labels=("event",)).labels(event=event.rsplit("/", 1)[-1]).inc()
+
+
+def install():
+    """Register the jax.monitoring listeners once. Returns True when
+    listening, False when this jax has no monitoring API (no-op)."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:
+        return False
+    if not (hasattr(monitoring, "register_event_duration_secs_listener")
+            and hasattr(monitoring, "register_event_listener")):
+        return False
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    monitoring.register_event_listener(_on_event)
+    _installed = True
+    return True
+
+
+def installed():
+    return _installed

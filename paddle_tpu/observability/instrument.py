@@ -1,0 +1,159 @@
+"""Standard instrument set + op-dispatch counting.
+
+The well-known metric families every instrumented surface shares live
+here as accessor functions, not cached handles: each call re-fetches the
+family through the registry (two dict lookups under the lock — noise
+next to a device step), so ``registry.reset()`` in a test can never
+leave an instrumented module holding an orphaned family.
+
+``watch_ops()`` hooks the eager dispatch choke point
+(core/dispatch.py): every ``apply_op`` already fans out to the
+registered op listeners — under tracing too — so one listener gives
+op-call counters for free, composing with the profiler's op tracer
+instead of fighting it for the single ``set_op_tracer`` slot.
+"""
+from .metrics import DEFAULT_LATENCY_BUCKETS, get_registry
+
+__all__ = [
+    "watch_ops", "serve_ttft", "serve_tpot", "serve_queue_wait",
+    "serve_step_seconds", "serve_tokens_total", "serve_requests_total",
+    "serve_inflight", "serve_queue_depth", "serve_tokens_per_s",
+    "kv_blocks_free", "kv_blocks_used", "kv_blocks_high_water",
+    "kv_alloc_failures", "serve_bucket_recompiles",
+    "train_step_seconds", "train_tokens_total", "train_steps_total",
+    "train_tokens_per_s",
+]
+
+
+# -- serving (continuous-batching engine) --------------------------------
+
+def serve_ttft():
+    return get_registry().histogram(
+        "serve_ttft_seconds",
+        help="submit -> first generated token, per request")
+
+
+def serve_tpot():
+    return get_registry().histogram(
+        "serve_time_per_output_token_seconds",
+        help="interval between consecutive generated tokens, per slot")
+
+
+def serve_queue_wait():
+    return get_registry().histogram(
+        "serve_queue_wait_seconds",
+        help="submit -> admission into a batch slot, per request")
+
+
+def serve_step_seconds():
+    return get_registry().histogram(
+        "serve_step_seconds",
+        help="one scheduler tick + compiled decode step (host wall)")
+
+
+def serve_tokens_total():
+    return get_registry().counter(
+        "serve_tokens_total", help="generated tokens")
+
+
+def serve_requests_total():
+    return get_registry().counter(
+        "serve_requests_finished_total", help="requests retired")
+
+
+def serve_inflight():
+    return get_registry().gauge(
+        "serve_inflight_requests", help="occupied batch slots")
+
+
+def serve_queue_depth():
+    return get_registry().gauge(
+        "serve_queue_depth", help="submitted, not yet admitted")
+
+
+def serve_tokens_per_s():
+    return get_registry().gauge(
+        "serve_tokens_per_s",
+        help="tokens emitted by the last step / its host wall time")
+
+
+def kv_blocks_free():
+    return get_registry().gauge(
+        "kv_blocks_free", help="allocatable cache blocks on the free list")
+
+
+def kv_blocks_used():
+    return get_registry().gauge(
+        "kv_blocks_used", help="cache blocks held by in-flight requests")
+
+
+def kv_blocks_high_water():
+    return get_registry().gauge(
+        "kv_blocks_high_water",
+        help="max cache blocks ever simultaneously in use")
+
+
+def kv_alloc_failures():
+    return get_registry().counter(
+        "kv_alloc_failures_total",
+        help="BlockAllocator.alloc() calls that found an empty free list")
+
+
+def serve_bucket_recompiles():
+    return get_registry().counter(
+        "serve_bucket_recompiles_total",
+        help="first sighting of a padded work-list length (keys one "
+             "XLA compile of the decode step)", labels=("bucket",))
+
+
+# -- training (pretrain loop) --------------------------------------------
+
+def train_step_seconds():
+    return get_registry().histogram(
+        "train_step_seconds",
+        help="pretrain step dispatch wall time (async dispatch: excludes "
+             "device completion unless the caller blocks)")
+
+
+def train_tokens_total():
+    return get_registry().counter(
+        "train_tokens_total", help="tokens entering the train step")
+
+
+def train_steps_total():
+    return get_registry().counter(
+        "train_steps_total", help="train steps dispatched")
+
+
+def train_tokens_per_s():
+    return get_registry().gauge(
+        "train_tokens_per_s",
+        help="batch tokens / host wall of the last dispatched step")
+
+
+# -- op dispatch ----------------------------------------------------------
+
+_op_listener = None
+
+
+def watch_ops(enable=True):
+    """Count every eager op dispatch into ``op_calls_total{op=...}``.
+
+    Rides core.dispatch's op-listener fan-out (fires under tracing too,
+    so traced regions count their trace-time dispatches exactly once —
+    which is what you want to see: a hot per-step count that keeps
+    growing means ops are NOT getting fused into a jitted step)."""
+    global _op_listener
+    from ..core import dispatch
+    if enable:
+        if _op_listener is not None:
+            return
+        def _count(name, n_inputs, outs):
+            get_registry().counter(
+                "op_calls_total", help="eager/traced op dispatches",
+                labels=("op",)).labels(op=name).inc()
+        dispatch.add_op_listener(_count)
+        _op_listener = _count
+    elif _op_listener is not None:
+        dispatch.remove_op_listener(_op_listener)
+        _op_listener = None

@@ -78,6 +78,13 @@ class _HostTracer:
         with self._lock:
             self.events.append((name, etype, ts_us, dur_us, tid))
 
+    def drain(self):
+        """Take-and-clear: uniform snapshot contract with the native
+        tracer, whose ring drain is destructive by construction."""
+        with self._lock:
+            out, self.events = self.events, []
+        return out
+
     def clear(self):
         with self._lock:
             self.events = []
@@ -119,6 +126,11 @@ class _NativeHostTracer:
             out.append((name, TracerEventType(int(etype)), float(ts),
                         float(dur), int(tid)))
         return out
+
+    def drain(self):
+        """Reading the native ring IS the drain (pt_trace_drain empties
+        it); alias so both tracers share one snapshot contract."""
+        return self.events
 
     def clear(self):
         self._n.pt_trace_clear()
@@ -245,6 +257,11 @@ class Profiler:
         self._last_export_path = None
         self._summary = None
         self._events = []  # snapshot of the last recorded window
+        self._drained = []  # events already pulled out of the tracer
+        #                     mid-window (native ring drains destructively)
+        self._window_begin_us = None  # record-window bounds for scoping
+        self._window_end_us = None    # the merged metric counter events
+        self._prev_op_tracer = None
         self._step_begin = None
         self._benchmark = Benchmark()
 
@@ -309,7 +326,9 @@ class Profiler:
     def _start_recording(self):
         self._recording = True
         self._step_begin = time.perf_counter()
-        _dispatch.set_op_tracer(_op_tracer_ctx)
+        self._window_begin_us = self._step_begin * 1e6
+        self._window_end_us = None
+        self._prev_op_tracer = _dispatch.set_op_tracer(_op_tracer_ctx)
         # device-activity leg (SURVEY §5.1: the reference consumes CUPTI
         # activity records via cuda_tracer.cc; on TPU the XLA/PJRT
         # profiler is that source). The captured xplane protos land in a
@@ -324,17 +343,28 @@ class Profiler:
             except Exception:
                 self._jax_trace_dir = None
 
+    def _snapshot_window(self):
+        """Everything recorded in the current window so far: what was
+        already drained out of the tracer (a mid-window export/summary
+        empties the native ring destructively) plus whatever the tracer
+        still holds — snapshot once, reuse everywhere."""
+        self._drained.extend(_tracer.drain())
+        return list(self._drained)
+
     def _stop_recording(self, return_trace):
         self._recording = False
-        _dispatch.set_op_tracer(None)
+        self._window_end_us = time.perf_counter() * 1e6
+        _dispatch.set_op_tracer(self._prev_op_tracer)
+        self._prev_op_tracer = None
         if self._jax_trace_dir is not None:
             try:
                 import jax
                 jax.profiler.stop_trace()
             except Exception:
                 self._jax_trace_dir = None
-        self._events = list(_tracer.events)  # snapshot before clearing so
-        self._summary = build_summary(self._events)  # export() after stop works
+        self._events = self._snapshot_window()  # keep so export() after
+        self._summary = build_summary(self._events)  # stop still works
+        self._drained = []
         _tracer.clear()
         if return_trace and self._on_trace_ready is not None:
             self._on_trace_ready(self)
@@ -349,11 +379,21 @@ class Profiler:
 
     # -- export ----------------------------------------------------------
     def _export_chrome(self, path):
-        source = _tracer.events if self._recording else self._events
+        source = self._snapshot_window() if self._recording \
+            else self._events
         events = [{
             "name": name, "ph": "X", "cat": etype.name,
             "ts": ts, "dur": dur, "pid": os.getpid(), "tid": tid,
         } for name, etype, ts, dur, tid in source]
+        # observability counter samples land in the SAME stream, so
+        # serving gauges / compile counters plot against the host ranges
+        # on one chrome://tracing timeline — scoped to THIS record
+        # window (samples share the perf_counter timebase), not the
+        # whole process-lifetime ring
+        from ..observability import chrome_counter_events
+        events += chrome_counter_events(
+            pid=os.getpid(), since_us=self._window_begin_us,
+            until_us=(None if self._recording else self._window_end_us))
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)
@@ -365,6 +405,7 @@ class Profiler:
                 time_unit="ms"):
         if self._summary is None:
             self._summary = build_summary(
-                _tracer.events if self._recording else self._events)
+                self._snapshot_window() if self._recording
+                else self._events)
         print_summary(self._summary, time_unit=time_unit)
         return self._summary

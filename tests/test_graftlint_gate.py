@@ -35,10 +35,13 @@ def test_graftlint_imports():
         import tools.graftlint as gl
     finally:
         sys.path.remove(REPO_ROOT)
-    assert len(gl.RULES) >= 9, sorted(gl.RULES)
+    assert len(gl.RULES) >= 11, sorted(gl.RULES)
     families = {r.family for r in gl.RULES.values()}
     assert families >= {"trace-safety", "shard-map", "pallas-bounds",
                         "hygiene"}, families
+    # the observability PR's rules: interpret=True literals (GL104),
+    # metrics record calls inside jitted functions (GL105)
+    assert {"GL104", "GL105"} <= set(gl.RULES), sorted(gl.RULES)
 
 
 def test_tree_is_clean():
@@ -68,6 +71,18 @@ def test_baseline_is_wellformed_and_minimal():
         f"unexpected baselined codes {sorted(codes - {'GL201'})} — the "
         "baseline only carries the jax-0.4.x partial-auto shard_map "
         "sites; fix new findings instead of baselining them")
+
+
+def test_metrics_selfcheck():
+    """The observability core's tier-0 selfcheck (tools/lint.sh runs the
+    same command): registry correctness + all three exporters, loadable
+    WITHOUT jax (stdlib-only contract)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "metrics_snapshot.py"),
+         "--selfcheck"],
+        capture_output=True, text=True, timeout=300, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "metrics selfcheck: OK" in proc.stdout, proc.stdout
 
 
 def test_introduced_corpus_snippet_fails():

@@ -141,6 +141,81 @@ def test_profile_step_marker_spans_step():
     assert marks and all(ts > 0 and dur > 0 for _, _, ts, dur, _ in marks)
 
 
+def test_chrome_export_merges_metric_counters(tmp_path):
+    """Observability counter samples ride the chrome export as "ph": "C"
+    events in the SAME stream as the host ranges — one timeline."""
+    from paddle_tpu import observability as obs
+
+    p = Profiler()
+    p.start()
+    paddle.matmul(paddle.randn([8, 8]), paddle.randn([8, 8]))
+    obs.get_registry().gauge("test_merge_gauge").set(7)
+    p.stop()
+    path = str(tmp_path / "merged.json")
+    p.export(path)
+    trace = profiler.load_profiler_result(path)
+    ranges = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    counters = [e for e in trace["traceEvents"]
+                if e["ph"] == "C" and e["name"] == "test_merge_gauge"]
+    assert any(e["name"] == "matmul" for e in ranges)
+    assert counters and counters[-1]["args"]["value"] == 7.0
+    # the export contract (every event carries the full key set) holds
+    # for counter events too
+    assert all({"ph", "ts", "dur", "pid", "tid"} <= set(e)
+               for e in trace["traceEvents"])
+
+
+class _DestructiveTracer:
+    """Native-ring semantics: reading `events` drains the buffer (what
+    _NativeHostTracer does via pt_trace_drain)."""
+
+    def __init__(self):
+        self._ev = []
+
+    def record(self, *e):
+        self._ev.append(e)
+
+    def drain(self):
+        out, self._ev = self._ev, []
+        return out
+
+    @property
+    def events(self):
+        return self.drain()
+
+    def clear(self):
+        self._ev = []
+
+
+def test_mid_recording_export_survives_destructive_drain(tmp_path,
+                                                         monkeypatch):
+    """Regression (native tracer): exporting mid-recording drains the
+    ring; the final stop()/summary must still see those events —
+    snapshot once and reuse."""
+    monkeypatch.setattr(profiler, "_tracer", _DestructiveTracer())
+    p = Profiler()
+    p.start()
+    with RecordEvent("before_export"):
+        pass
+    mid = str(tmp_path / "mid.json")
+    p._export_chrome(mid)                  # destructive drain happens here
+    assert any(e["name"] == "before_export"
+               for e in profiler.load_profiler_result(mid)["traceEvents"])
+    with RecordEvent("after_export"):
+        pass
+    p.stop()
+    names = [e[0] for e in p._events]
+    assert "before_export" in names, "mid-recording export lost the window"
+    assert "after_export" in names
+    assert "before_export" in p._summary.by_name
+    # export-after-stop sees the full window too
+    final = str(tmp_path / "final.json")
+    p.export(final)
+    got = {e["name"]
+           for e in profiler.load_profiler_result(final)["traceEvents"]}
+    assert {"before_export", "after_export"} <= got
+
+
 def test_device_trace_capture(tmp_path):
     """XLA/PJRT device-activity capture (SURVEY §5.1: the CUPTI-activity
     role): targeting TPU engages jax.profiler for the record window and

@@ -41,3 +41,37 @@ def no_shared_default(x, acc=None):
     acc = [] if acc is None else acc
     acc.append(x)
     return acc
+
+
+_INTERPRET = False  # tests flip this
+
+
+def _interpret():
+    return _INTERPRET
+
+
+def kernel_call_routed(kernel, x, out_shape):
+    # the sanctioned interpret-mode spelling: helper, not a literal
+    return pl.pallas_call(kernel, out_shape=out_shape,
+                          interpret=_interpret())(x)
+
+
+def host_side_record(engine_step_seconds):
+    # observability records OUTSIDE jit are exactly what the contract
+    # wants — must not trip GL105
+    from paddle_tpu import observability as obs
+    obs.get_registry().histogram("step_seconds").observe(
+        engine_step_seconds)
+    obs.get_registry().counter("steps_total").inc()
+
+
+import jax  # noqa: E402
+import paddle_tpu.observability  # noqa: E402,F401
+
+
+@jax.jit
+def jitted_non_observability_call(x):
+    # the dotted import above binds the bare name `paddle_tpu`; a
+    # paddle_tpu.* call inside jit that is NOT under .observability must
+    # stay clean (GL105 matches the full dotted prefix, not the root)
+    return paddle_tpu.nn.functional.relu(x)

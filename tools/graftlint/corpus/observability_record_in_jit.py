@@ -1,0 +1,23 @@
+# graftlint-corpus-expect: GL105 GL105 GL105 GL105
+"""Observability record calls inside jitted functions: the registry is
+host-side state, so under jit the record fires exactly once — at trace
+time — and the metric silently stops counting (or the tracer->float
+guard raises). The loss value here is a tracer: .observe(loss) dies at
+trace time; the counter/gauge calls trace once and freeze. The bare
+dotted call only matches the FULL paddle_tpu.observability prefix —
+other paddle_tpu.* calls inside jit must not trip the rule."""
+import jax
+import paddle_tpu.observability
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import get_registry
+
+
+@jax.jit
+def train_step(params, batch):
+    loss = (params * batch).sum()
+    obs.get_registry().counter("steps_total").inc()         # trace-time
+    get_registry().gauge("inflight").set(1)                 # trace-time
+    obs.get_registry().histogram("loss").observe(loss)      # tracer crash
+    paddle_tpu.observability.get_registry().counter("n").inc()  # dotted
+    return loss

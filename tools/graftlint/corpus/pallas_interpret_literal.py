@@ -1,0 +1,30 @@
+# graftlint-corpus-expect: GL104 GL104
+"""The interpret-mode escape hatch left hard-coded (ROADMAP "candidate
+next rule"): a pallas_call carrying a literal interpret=True runs the
+kernel through the interpreter everywhere — including the chip — with
+no symptom beyond being orders of magnitude slow. The sanctioned
+spelling routes through the module's _interpret()/_interpret_mode()
+helper (see clean_ok.py)."""
+import jax
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+def double(x):
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=True,                      # hard-coded debug flag
+    )(x)
+
+
+def double_grid(x):
+    return pl.pallas_call(
+        _kernel,
+        grid=(8,),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=True,                      # and again, with a grid
+    )(x)

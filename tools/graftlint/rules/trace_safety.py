@@ -15,10 +15,23 @@ traces zero times or once (not per step), `.item()` forces a blocking
 device sync per call, and `np.*` calls silently constant-fold at trace
 time — all three are almost never what the author meant inside a traced
 function.
+
+GL104 flags a literal `interpret=True` at a Pallas call site: the
+CPU-debug escape hatch left hard-coded ships an interpreted (100-1000x
+slower) kernel to the chip with zero symptoms beyond slowness. Every
+kernel file routes the flag through a module-level `_interpret()` /
+`_interpret_mode()` helper (ops/pallas/blockwise_ce.py:49) that tests
+flip — a ROADMAP "candidate next rule", now a rule.
+
+GL105 is the static half of the observability host-side-only contract:
+a `paddle_tpu.observability` record call inside a jit-decorated function
+fires at trace time (once, not per step — a counter that silently stops
+counting) or crashes on the tracer coercion. The runtime half is the
+`float()` guard in observability/metrics.py.
 """
 import ast
 
-from ..core import rule
+from ..core import in_pallas, rule
 
 # the one module allowed to touch raw jax shard_map / CompilerParams
 # spellings: it IS the resolver
@@ -139,3 +152,102 @@ def host_op_in_jit(ctx):
                         f"numpy call `{_attr_chain(f)}` inside jitted "
                         f"`{fn.name}` constant-folds at trace time — use "
                         "jnp/lax so it runs per step on device"), node
+
+
+@rule("GL104", "pallas-interpret-literal", "trace-safety",
+      applies=in_pallas)
+def interpret_literal(ctx):
+    """Hard-coded `interpret=True` at a call site — route through the
+    kernel module's `_interpret()`/`_interpret_mode()` helper so tests
+    flip ONE switch and production never ships the interpreter."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "interpret" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True:
+                yield ctx.finding(
+                    "GL104", node,
+                    "literal interpret=True at a Pallas call site: the "
+                    "CPU-debug flag left hard-coded runs the kernel "
+                    "interpreted (orders of magnitude slower) everywhere "
+                    "— route it through the module's _interpret()/"
+                    "_interpret_mode() helper (ops/pallas/"
+                    "blockwise_ce.py:49)"), node
+
+
+def _observability_names(ctx):
+    """Names this module binds to paddle_tpu.observability: module
+    aliases (watch via attribute chains), directly imported symbols
+    (watch via bare calls), and — for a bare dotted import, which binds
+    only `paddle_tpu` — full dotted prefixes (a bare `paddle_tpu` alias
+    would flag every paddle_tpu.* call in the file)."""
+    mod_aliases, symbols, dotted = set(), set(), set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "paddle_tpu.observability" or \
+                        a.name.startswith("paddle_tpu.observability."):
+                    if a.asname:
+                        mod_aliases.add(a.asname)
+                    else:
+                        dotted.add("paddle_tpu.observability")
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            # absolute or relative (`from ...observability import x`)
+            if mod == "paddle_tpu" and any(
+                    a.name == "observability" for a in node.names):
+                for a in node.names:
+                    if a.name == "observability":
+                        mod_aliases.add(a.asname or "observability")
+            elif mod == "paddle_tpu.observability" or mod.endswith(
+                    "observability") and (node.level > 0
+                                          or mod.startswith("paddle_tpu")):
+                for a in node.names:
+                    symbols.add(a.asname or a.name)
+    return mod_aliases, symbols, dotted
+
+
+def _call_root(expr):
+    """Base Name of a call chain: `obs.counter("x").inc()` -> `obs`
+    (peels Attribute and Call layers)."""
+    while True:
+        if isinstance(expr, ast.Attribute):
+            expr = expr.value
+        elif isinstance(expr, ast.Call):
+            expr = expr.func
+        elif isinstance(expr, ast.Name):
+            return expr.id
+        else:
+            return None
+
+
+@rule("GL105", "observability-record-in-jit", "trace-safety")
+def observability_in_jit(ctx):
+    """paddle_tpu.observability calls inside a jit-decorated function:
+    metrics are host-side only — under the trace a record fires once
+    (at trace time) or dies on the tracer->float coercion."""
+    mod_aliases, symbols, dotted = _observability_names(ctx)
+    if not mod_aliases and not symbols and not dotted:
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not any(_is_jitish(d) for d in fn.decorator_list):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            root = _call_root(node.func)
+            hit = root in mod_aliases or root in symbols
+            if not hit and dotted:
+                text = ast.unparse(node.func)
+                hit = any(text.startswith(p + ".") for p in dotted)
+            if hit:
+                yield ctx.finding(
+                    "GL105", node,
+                    f"observability call inside jitted `{fn.name}`: "
+                    "metrics record host-side state — under jit this "
+                    "fires at trace time (not per step) or crashes on "
+                    "the tracer->float guard. Record outside the jitted "
+                    "function (observability/metrics.py contract)"), node

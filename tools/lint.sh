@@ -7,5 +7,6 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+python tools/metrics_snapshot.py --selfcheck
 python -m tools.graftlint --selftest
 python -m tools.graftlint paddle_tpu/ tests/ tools/ "$@"

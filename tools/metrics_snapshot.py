@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Dump the paddle_tpu observability registry — or selfcheck it.
+
+Two jobs:
+
+* ``python tools/metrics_snapshot.py [--format prometheus|json|chrome]``
+  prints the current process-wide registry. Mostly useful embedded
+  (``from tools.metrics_snapshot import dump``) or from a debugger/REPL
+  at the end of a serving/training run — a fresh process has an empty
+  registry.
+* ``python tools/metrics_snapshot.py --selfcheck`` exercises the whole
+  metrics core — registry, concurrency, histogram bucket edges, all
+  three exporters — and exits non-zero on any violation. Wired into
+  tools/lint.sh so the tier-0 gate (tests/test_graftlint_gate.py)
+  catches a broken metrics subsystem before any test imports jax.
+
+The selfcheck must run in a bare container: paddle_tpu/__init__ imports
+jax, so when the package isn't already loaded we load
+paddle_tpu/observability STANDALONE by path (it is stdlib-only by
+contract — that load failing IS a selfcheck failure).
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import threading
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_observability():
+    """The already-imported package when present; otherwise a standalone
+    by-path load that never touches paddle_tpu/__init__ (no jax)."""
+    mod = sys.modules.get("paddle_tpu.observability")
+    if mod is not None:
+        return mod
+    pkg_dir = os.path.join(REPO_ROOT, "paddle_tpu", "observability")
+    spec = importlib.util.spec_from_file_location(
+        "paddle_tpu.observability", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu.observability"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def dump(fmt="json", registry=None, obs=None):
+    """Render the registry in one of the three exporter formats."""
+    obs = obs or _load_observability()
+    registry = registry or obs.get_registry()
+    if fmt == "prometheus":
+        return obs.to_prometheus(registry)
+    if fmt == "json":
+        return obs.to_json(registry, indent=1)
+    if fmt == "chrome":
+        return json.dumps({"traceEvents":
+                           obs.chrome_counter_events(registry)}, indent=1)
+    raise ValueError(f"unknown format {fmt!r}")
+
+
+def selfcheck():
+    """Exercise the metrics core; returns a list of failure strings."""
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    try:
+        obs = _load_observability()
+    except Exception as e:
+        return [f"standalone (pre-jax) observability import failed: {e}"]
+
+    reg = obs.MetricsRegistry()    # private registry: no global pollution
+
+    # counters: monotonic, concurrent-exact
+    c = reg.counter("sc_requests_total", help="selfcheck")
+    threads = [threading.Thread(
+        target=lambda: [c.inc() for _ in range(1000)]) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    check(c.value == 8000, f"concurrent counter lost updates: {c.value}")
+    try:
+        c.inc(-1)
+        check(False, "negative counter increment not rejected")
+    except ValueError:
+        pass
+
+    # gauges: set/inc/dec/set_max, labels
+    g = reg.gauge("sc_depth", labels=("queue",))
+    g.labels(queue="a").set(3)
+    g.labels(queue="a").inc(2)
+    g.labels(queue="a").dec()
+    check(g.labels(queue="a").value == 4.0,
+          f"gauge arithmetic wrong: {g.labels(queue='a').value}")
+    g.labels(queue="a").set_max(2)
+    check(g.labels(queue="a").value == 4.0, "set_max lowered the gauge")
+
+    # histograms: inclusive `le` edges, count/sum, quantiles
+    h = reg.histogram("sc_latency_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.1, 0.5, 1.0, 5.0, 100.0):
+        h.observe(v)
+    child = h.labels()
+    check(child.bucket_counts == [1, 2, 1, 1],
+          f"bucket edges not inclusive-upper: {child.bucket_counts}")
+    check(child.count == 5 and abs(child.sum - 106.6) < 1e-9,
+          f"count/sum wrong: {child.count}/{child.sum}")
+    q50 = h.quantile(0.5)
+    check(q50 is not None and 0.1 <= q50 <= 1.0,
+          f"median {q50} outside its bucket")
+    check(reg.histogram("sc_latency_seconds") is h,
+          "histogram get-or-create returned a different family")
+    try:
+        reg.counter("sc_latency_seconds")
+        check(False, "kind conflict not rejected")
+    except ValueError:
+        pass
+
+    # tracer guard: non-scalars must be rejected loudly
+    try:
+        reg.counter("sc_bad_total").inc(object())
+        check(False, "non-scalar record not rejected")
+    except TypeError:
+        pass
+
+    # exporters
+    prom = obs.to_prometheus(reg)
+    for needle in ("# TYPE sc_requests_total counter",
+                   "# TYPE sc_depth gauge",
+                   "# TYPE sc_latency_seconds histogram",
+                   'sc_latency_seconds_bucket{le="+Inf"} 5',
+                   'sc_depth{queue="a"} 4'):
+        check(needle in prom, f"prometheus output missing {needle!r}")
+    snap = json.loads(obs.to_json(reg))
+    check(set(snap) == {"time", "metrics"}, "json envelope wrong")
+    check(snap["metrics"]["sc_requests_total"]["children"][""]["value"]
+          == 8000, "json snapshot value wrong")
+    ev = obs.chrome_counter_events(reg, pid=1)
+    check(len(ev) > 0, "no chrome counter samples recorded")
+    check(all(e["ph"] == "C" and {"name", "ts", "dur", "pid", "tid",
+                                  "args"} <= set(e) for e in ev),
+          "chrome counter events malformed")
+    return failures
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="dump or selfcheck the observability registry")
+    ap.add_argument("--format", default="json",
+                    choices=["prometheus", "json", "chrome"])
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="exercise the metrics core and exit 0/1 "
+                         "(tier-0 gate; runs without jax)")
+    args = ap.parse_args()
+    if args.selfcheck:
+        failures = selfcheck()
+        if failures:
+            print(f"metrics selfcheck: FAIL ({len(failures)} problems)")
+            for f in failures:
+                print("  " + f)
+            return 1
+        print("metrics selfcheck: OK")
+        return 0
+    print(dump(args.format))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
