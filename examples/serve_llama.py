@@ -25,7 +25,9 @@ def run_continuous(engine, rng, V, args):
     from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
                                         GenerationRequest)
     cb = ContinuousBatchingEngine(engine, num_blocks=33, block_size=16,
-                                  max_batch=args.batch)
+                                  max_batch=args.batch,
+                                  prefill_chunk=args.prefill_chunk,
+                                  token_budget=args.token_budget)
     free0 = cb.allocator.num_free
     lengths = [(5, 12), (23, 8), (3, 30), (17, 17), (9, 5), (40, 11)]
     reqs = [GenerationRequest(rng.integers(1, V, p).astype(np.int32), n)
@@ -54,6 +56,12 @@ def main():
     ap.add_argument("--continuous", action="store_true",
                     help="continuous-batching serving over the paged "
                          "cache (ragged Pallas kernel)")
+    ap.add_argument("--prefill-chunk", type=int, default=64,
+                    help="prompt tokens consumed per slot per step "
+                         "(1 = the old one-token-per-step prefill)")
+    ap.add_argument("--token-budget", type=int, default=None,
+                    help="per-step token budget shared by decode slots "
+                         "(1 token each, mandatory) and prompt chunks")
     args = ap.parse_args()
 
     rng = np.random.default_rng(0)

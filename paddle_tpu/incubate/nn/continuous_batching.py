@@ -4,10 +4,12 @@ The vLLM-style serving loop the ROADMAP's "heavy traffic from millions of
 users" regime needs: requests of wildly different lengths share one fixed
 pool of cache blocks; a host-side free-list allocator hands blocks to
 sequences as they grow and reclaims them the step a request finishes, and
-every decode step runs ALL in-flight requests — some still consuming
-their prompt, some mid-generation, some slots idle — as ONE compiled
-program (FusedMultiTransformerEngine._paged_step over the ragged Pallas
-kernel, ops/pallas/paged_attention.py).
+every step runs ALL in-flight requests — some consuming whole CHUNKS of
+their prompt (Sarathi-style chunked prefill under a per-step token
+budget, so TTFT costs ceil(prompt/chunk) steps instead of prompt steps),
+some mid-generation, some slots idle — as ONE compiled program
+(FusedMultiTransformerEngine._paged_step over the ragged Pallas kernel,
+ops/pallas/paged_attention.py).
 
 Host/device split: the allocator, block tables, lengths, and scheduling
 live on the host (tiny int arrays, zero device round trips beyond the
@@ -91,6 +93,11 @@ class GenerationRequest:
         if request_id is None:
             request_id = GenerationRequest._next_id
             GenerationRequest._next_id += 1
+        elif isinstance(request_id, int) and not isinstance(request_id, bool) \
+                and request_id >= GenerationRequest._next_id:
+            # a user-supplied int id RESERVES the auto counter past it, so
+            # a later auto-assigned id can never silently collide with it
+            GenerationRequest._next_id = request_id + 1
         self.request_id = request_id
         # runtime state (owned by the engine)
         self.blocks = []        # physical cache blocks, in table order
@@ -122,23 +129,41 @@ class ContinuousBatchingEngine:
       2. admit queued requests into idle slots (FIFO; a request is only
          admitted when the free list can cover its WORST-CASE footprint,
          so no in-flight request can ever starve mid-generation),
-      3. grow each active sequence's block list when its next token
-         crosses a block boundary,
-      4. run one compiled decode step over all slots (prompt-phase slots
-         are fed their next prompt token — decode-style prefill — and
-         decode-phase slots their last sampled token).
+      3. fill the per-step TOKEN BUDGET (Sarathi-style chunked prefill):
+         decode-phase slots are mandatory at one token each, then the
+         remaining budget is spent on prompt CHUNKS of up to
+         `prefill_chunk` tokens from prefill-phase slots in slot order —
+         a 512-token prompt costs ceil(512/chunk) steps, not 512,
+      4. grow each active sequence's block list to cover the tokens the
+         step appends (a chunk may cross several block boundaries),
+      5. run one compiled step over all slots: the whole mixed
+         prefill+decode batch advances in ONE program over the ragged
+         Pallas kernel, and each slot samples from its chunk's last
+         valid position.
 
     Greedy sampling (temperature 0) by default; temperature/top_p thread
     straight through to the engine's fused sampler.
+
+    `prefill_chunk=1` reproduces the PR-1 one-token-per-step prefill
+    exactly; `token_budget=None` means unthrottled (every prefill slot
+    gets a full chunk each step). Chunking is token-exact either way.
     """
 
     def __init__(self, engine, num_blocks, block_size, max_batch=8,
-                 temperature=0.0, top_p=1.0, seed=0):
+                 temperature=0.0, top_p=1.0, seed=0, prefill_chunk=64,
+                 token_budget=None):
         import jax
 
         self.engine = engine
         self.block_size = int(block_size)
         self.max_batch = int(max_batch)
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self.token_budget = None if token_budget is None \
+            else int(token_budget)
+        if self.token_budget is not None and self.token_budget < 1:
+            raise ValueError("token_budget must be >= 1")
         self.max_blocks = engine.max_seq_len // self.block_size
         if self.max_blocks < 1:
             raise ValueError("block_size larger than engine.max_seq_len")
@@ -146,10 +171,10 @@ class ContinuousBatchingEngine:
         self.caches = engine.new_paged_caches(num_blocks, self.block_size)
         self.tables = np.zeros((self.max_batch, self.max_blocks), np.int32)
         self.lens = np.zeros(self.max_batch, np.int32)
-        self.toks = np.zeros(self.max_batch, np.int32)
         self.slots = [None] * self.max_batch
         self.queue = collections.deque()
         self.finished = {}
+        self._ids = set()       # queued + active ids: O(1) duplicate check
         self._temp = float(temperature)
         self._topp = float(top_p)
         self._key = jax.random.PRNGKey(int(seed))
@@ -184,12 +209,13 @@ class ContinuousBatchingEngine:
                 f"{request.blocks_needed(self.block_size)} blocks, pool "
                 f"has {self.allocator.num_blocks - self.allocator.reserved}")
         rid = request.request_id
-        if rid in self.finished or any(
-                r.request_id == rid for r in self.queue) or any(
-                r is not None and r.request_id == rid for r in self.slots):
+        # O(1): the live-id set tracks queued + active, `finished` keeps
+        # the retired ones — no linear scan per submit
+        if rid in self._ids or rid in self.finished:
             raise ValueError(f"duplicate request_id {rid}")
         request.submit_time = time.monotonic()
         self.queue.append(request)
+        self._ids.add(rid)
         _metrics.serve_queue_depth().set(len(self.queue))
 
     @property
@@ -205,8 +231,8 @@ class ContinuousBatchingEngine:
                 self.slots[i] = None
                 self.tables[i] = 0
                 self.lens[i] = 0
-                self.toks[i] = 0
                 self.finished[req.request_id] = list(req.generated)
+                self._ids.discard(req.request_id)
                 retired += 1
         if retired:
             _metrics.serve_requests_total().inc(retired)
@@ -246,9 +272,37 @@ class ContinuousBatchingEngine:
             self.tables[i] = 0
             self.lens[i] = 0
 
+    def _schedule_tokens(self, active):
+        """Fill this step's token budget: decode-phase slots are
+        MANDATORY (one token each — a decode can't be deferred without
+        stalling its request and holding its blocks hostage), then the
+        remaining budget is spent on prompt chunks of up to
+        `prefill_chunk` tokens, slot order. A prefill slot the budget
+        can't reach gets 0 tokens and simply stalls this step (it costs
+        zero work-list entries). Returns q_lens [max_batch] int64."""
+        q_lens = np.zeros(self.max_batch, np.int64)
+        used = 0
+        for i in active:
+            req = self.slots[i]
+            if req.progress >= len(req.prompt):
+                q_lens[i] = 1
+                used += 1
+        budget = self.token_budget
+        for i in active:
+            req = self.slots[i]
+            rem = len(req.prompt) - req.progress
+            if rem <= 0:
+                continue
+            room = rem if budget is None else min(rem, max(0, budget - used))
+            take = min(self.prefill_chunk, room)
+            q_lens[i] = take
+            used += take
+        return q_lens
+
     def step(self):
-        """One scheduler tick + one compiled decode step. Returns the
-        number of requests still in flight (active + queued)."""
+        """One scheduler tick + one compiled mixed prefill/decode step.
+        Returns the number of requests still in flight (active +
+        queued)."""
         import jax
 
         t_begin = time.monotonic()
@@ -258,34 +312,49 @@ class ContinuousBatchingEngine:
         self._update_pool_gauges()
         if not active:
             return len(self.queue)
+        q_lens = self._schedule_tokens(active)
         for i in active:
+            # grow the block list to cover every token this step appends
+            # (a prompt chunk may cross several block boundaries);
+            # admission reserved the worst-case footprint, so alloc()
+            # cannot fail here
             req = self.slots[i]
-            if self.lens[i] % self.block_size == 0:
+            end = int(self.lens[i] + q_lens[i])
+            while len(req.blocks) * self.block_size < end:
                 blk = self.allocator.alloc()
                 req.blocks.append(blk)
-                self.tables[i, self.lens[i] // self.block_size] = blk
-            self.toks[i] = req.prompt[req.progress] \
-                if req.progress < len(req.prompt) else req.generated[-1]
-        # every slot attends over lens+1 (the token the step appends) —
-        # idle slots sit parked on reserved block 0 with lens 0, so they
-        # cost exactly ONE work-list entry each and their sampled token
-        # is ignored; a zero-entry row would leave its output tile
-        # unvisited (uninitialised VMEM) when a whole pack group is idle
-        attn_lens = (self.lens + 1).astype(np.int32)
+                self.tables[i, len(req.blocks) - 1] = blk
+        # token slab [B, C]: C is the widest span this step, bucketed to
+        # a power of two (1 for an all-decode step) so slab shapes — and
+        # the programs they key — stay off the per-prompt-length
+        # treadmill. Idle slots and budget-starved prefill slots have
+        # q_len 0: zero slab tokens, zero work entries, output ignored.
+        c = int(next_pow2(int(q_lens.max())))
+        slab = np.zeros((self.max_batch, c), np.int32)
+        for i in active:
+            req = self.slots[i]
+            n = int(q_lens[i])
+            if req.progress < len(req.prompt):
+                slab[i, :n] = req.prompt[req.progress:req.progress + n]
+            elif n:
+                slab[i, 0] = req.generated[-1]
+        q_arr = q_lens.astype(np.int32)
+        attn_lens = (self.lens + q_arr).astype(np.int32)
         work, _, t_total, pack = build_ragged_work(
             self.tables, attn_lens, self.block_size, self._pack,
-            bucket_to=next_pow2)
-        # the padded work-list length is the ONLY shape the scheduler
-        # varies step to step — a length not seen before keys a fresh
-        # compile of the decode program (host-deterministic, so tests
-        # can assert this counter stays flat after warmup)
-        if t_total not in self._seen_buckets:
-            self._seen_buckets.add(t_total)
+            bucket_to=next_pow2, q_lens=q_arr)
+        # the (padded work-list length, slab width) pair is the ONLY
+        # shape the scheduler varies step to step — a pair not seen
+        # before keys a fresh compile of the step program
+        # (host-deterministic, so tests can assert this counter stays
+        # flat after warmup)
+        if (t_total, c) not in self._seen_buckets:
+            self._seen_buckets.add((t_total, c))
             _metrics.serve_bucket_recompiles().labels(
-                bucket=t_total).inc()
+                bucket=f"{t_total}x{c}").inc()
         self._key, sub = jax.random.split(self._key)
         toks2, self.caches = self.engine._paged_step(
-            self.engine._w, self.caches, np.asarray(self.toks),
+            self.engine._w, self.caches, slab, q_arr,
             np.asarray(self.tables), np.asarray(self.lens), tuple(work),
             pack, np.float32(self._temp), np.float32(self._topp), sub)
         toks2 = np.asarray(toks2)
@@ -293,10 +362,15 @@ class ContinuousBatchingEngine:
         emitted = 0
         for i in active:
             req = self.slots[i]
-            self.lens[i] += 1
+            n = int(q_lens[i])
+            if n == 0:
+                continue        # starved prefill slot: stalled this step
+            self.lens[i] += n
             if req.progress < len(req.prompt):
-                req.progress += 1
+                req.progress += n
                 if req.progress == len(req.prompt):
+                    # the chunk ended the prompt: the sample at its last
+                    # valid position is the request's FIRST output token
                     self._append_token(req, toks2[i], t_done)
                     emitted += 1
             else:
@@ -326,13 +400,18 @@ class ContinuousBatchingEngine:
 
     def run(self, max_steps=100000):
         """Drive step() until every submitted request has finished.
-        Returns {request_id: generated token list}."""
+        Returns {request_id: generated token list}.
+
+        step() already retires at the top of every tick, so the loop
+        doesn't re-retire after each step; the one final _retire() flushes
+        the requests the LAST step finished, so `finished` is complete
+        when the queue drains."""
         steps = 0
         while self.queue or self.num_active:
             self.step()
-            self._retire()
             steps += 1
             if steps > max_steps:
                 raise RuntimeError("continuous batching did not converge "
                                    f"within {max_steps} steps")
+        self._retire()
         return dict(self.finished)

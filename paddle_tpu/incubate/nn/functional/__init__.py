@@ -415,7 +415,7 @@ def fused_multi_transformer(
         trans_qkvw=True, ring_id=-1, norm_type="layernorm",
         use_neox_rotary_style=False, gqa_group_size=-1, name=None,
         block_tables=None, ragged_work=None, ragged_pack=None,
-        _dequant=None, _mm=None):
+        chunk_lens=None, _dequant=None, _mm=None):
     """Whole-decoder-stack fused transformer (reference
     fused_multi_transformer op: python/paddle/incubate/nn/functional/
     fused_transformer.py:1053 over
@@ -445,8 +445,15 @@ def fused_multi_transformer(
     the new token at slot seq_lens. `ragged_work` is the host-built
     flattened work list (`build_ragged_work(tables, seq_lens + 1, ...)`
     — +1 because attention covers the token just appended); required
-    under jit where seq_lens is traced. Decode-only (x must be [B, 1, E]
-    with time_step set).
+    under jit where seq_lens is traced. x is [B, 1, E] with time_step
+    set (classic decode), or — CHUNKED PREFILL — [B, C, E] with
+    `chunk_lens` [B] giving how many of each row's C token columns are
+    valid this step: sequence b's chunk_lens[b] tokens append at
+    positions seq_lens[b].. and each attends causally to its own prefix
+    (the work list must then be built with
+    `build_ragged_work(tables, seq_lens + chunk_lens, ...,
+    q_lens=chunk_lens)`). chunk_lens[b] == 0 parks the row: nothing
+    written, nothing attended, output rows zero.
 
     Returns the output hidden states [B, S, E]; caches are updated
     in place (dygraph reference semantics).
@@ -456,6 +463,10 @@ def fused_multi_transformer(
     if beam_offset is not None:
         raise NotImplementedError(
             "fused_multi_transformer: beam_offset unsupported")
+    if chunk_lens is not None and block_tables is None:
+        raise ValueError(
+            "fused_multi_transformer: chunk_lens (chunked prefill) is a "
+            "paged-cache feature — pass block_tables too")
     if pre_caches is not None and time_step is not None:
         raise NotImplementedError(
             "fused_multi_transformer: pre_caches apply to the context/"
@@ -472,10 +483,11 @@ def fused_multi_transformer(
                 "fused_multi_transformer: block_tables without cache_kvs "
                 "— the paged path needs the per-layer paged caches")
         xs = (x.data if hasattr(x, "data") else x).shape
-        if len(xs) != 3 or xs[1] != 1:
+        if len(xs) != 3 or (xs[1] != 1 and chunk_lens is None):
             raise ValueError(
                 "fused_multi_transformer: paged decode takes one token "
-                f"per sequence (x [B, 1, E]); got {list(xs)}")
+                f"per sequence (x [B, 1, E]); got {list(xs)} — a multi-"
+                "token chunk slab needs per-sequence chunk_lens")
         if attn_mask is not None:
             raise NotImplementedError(
                 "fused_multi_transformer: attn_mask unsupported on the "
@@ -493,12 +505,20 @@ def fused_multi_transformer(
                 else block_tables)
             c0 = cache_kvs[0]
             bs_ = (c0.data if hasattr(c0, "data") else c0).shape[3]
+            if chunk_lens is None:
+                qls_c = _np.ones_like(lens_c)
+                qkw = {}
+            else:
+                qls_c = _np.asarray(
+                    chunk_lens.data if isinstance(chunk_lens, _T)
+                    else chunk_lens)
+                qkw = {"q_lens": qls_c}
             ragged_work = build_ragged_work(
-                tbl_c, lens_c + 1, bs_,
+                tbl_c, lens_c + qls_c, bs_,
                 ragged_pack or default_pack(
                     lens_c.shape[0],
                     _ragged_group_q(qkv_weights, gqa_group_size,
-                                    trans_qkvw)))
+                                    trans_qkvw)), **qkw)
         if len(ragged_work) == 4 and isinstance(ragged_work[0],
                                                 (tuple, list)):
             # the full build_ragged_work result: the carried pack is
@@ -520,8 +540,8 @@ def fused_multi_transformer(
     # dequantize-then-einsum — quantized bytes are all that leave HBM
 
     def impl(xa, lns, lnb, qkvw, qkvb, linw, linb, flns, flnb, f1w, f1b,
-             f2w, f2b, caches, pres, rotary, tstep, mask, slens, tables_a,
-             rwork, dkeys):
+             f2w, f2b, caches, pres, rotary, tstep, mask, slens, qlens,
+             tables_a, rwork, dkeys):
         b, s, e = xa.shape
         norm = (lambda h, sc, bi: _rms(h, epsilon, sc)) \
             if norm_type == "rmsnorm" else \
@@ -580,8 +600,18 @@ def fused_multi_transformer(
                     ln = jnp.asarray(slens).reshape(-1)
                     bidx = jnp.arange(cos.shape[0]) \
                         if cos.shape[0] > 1 else jnp.zeros_like(ln)
-                    cos = cos[bidx, ln][:, None]        # [B, 1, 1, D]
-                    sin = sin[bidx, ln][:, None]
+                    if s == 1:
+                        cos = cos[bidx, ln][:, None]    # [B, 1, 1, D]
+                        sin = sin[bidx, ln][:, None]
+                    else:
+                        # chunked prefill: token column j of sequence b
+                        # rotates at position lens[b] + j (clamped into
+                        # the table for the padding columns past qlens)
+                        posr = jnp.minimum(
+                            ln[:, None] + jnp.arange(s)[None, :],
+                            cos.shape[1] - 1)           # [B, C]
+                        cos = cos[bidx[:, None], posr]  # [B, C, 1, D]
+                        sin = sin[bidx[:, None], posr]
                 elif tstep is not None:
                     pos = jnp.asarray(tstep).reshape(())
                     cos = jax.lax.dynamic_slice_in_dim(cos, pos, 1, 1)
@@ -597,22 +627,36 @@ def fused_multi_transformer(
             g_eff = G or nh
             r = nh // g_eff
             if tstep is not None and caches and tables_a is not None:
-                # paged decode (continuous batching): append this token
-                # into the block owned by each sequence at slot seq_lens,
-                # then run the ragged Pallas kernel over the flattened
-                # work list — grid cost scales with the sum of ACTUAL
-                # per-sequence KV blocks, not B x max_blocks
+                # paged decode (continuous batching): append this step's
+                # token (or prompt CHUNK) into the blocks owned by each
+                # sequence starting at slot seq_lens, then run the ragged
+                # Pallas kernel over the flattened work list — grid cost
+                # scales with the sum of ACTUAL per-sequence KV blocks,
+                # not B x max_blocks, and a whole prompt chunk rides one
+                # kernel invocation next to the decode rows
                 from ....ops.pallas.paged_attention import (
-                    ragged_paged_attention, update_paged_kv_cache)
+                    ragged_paged_attention, update_paged_kv_cache,
+                    update_paged_kv_cache_chunk)
                 cache = caches[li]             # [2, KVH, NB, BS, D]
                 ln = jnp.asarray(slens).reshape(-1)
-                kc, vc = update_paged_kv_cache(
-                    cache[0], cache[1], k[:, 0], v[:, 0], tables_a, ln)
-                ctx = ragged_paged_attention(
-                    q[:, 0], kc, vc, tables_a, ln + 1, scale=scale,
-                    work=(tuple(rwork), None, rwork[0].shape[0],
-                          ragged_pack))
-                ctx = ctx[:, None].astype(xa.dtype)   # [B, 1, H, D]
+                if qlens is None:
+                    kc, vc = update_paged_kv_cache(
+                        cache[0], cache[1], k[:, 0], v[:, 0], tables_a,
+                        ln)
+                    ctx = ragged_paged_attention(
+                        q[:, 0], kc, vc, tables_a, ln + 1, scale=scale,
+                        work=(tuple(rwork), None, rwork[0].shape[0],
+                              ragged_pack))
+                    ctx = ctx[:, None].astype(xa.dtype)   # [B, 1, H, D]
+                else:
+                    ql = jnp.asarray(qlens).reshape(-1)
+                    kc, vc = update_paged_kv_cache_chunk(
+                        cache[0], cache[1], k, v, tables_a, ln, ql)
+                    ctx = ragged_paged_attention(
+                        q, kc, vc, tables_a, ln + ql, scale=scale,
+                        work=(tuple(rwork), None, rwork[0].shape[0],
+                              ragged_pack),
+                        q_lens=ql).astype(xa.dtype)       # [B, C, H, D]
                 new_caches.append(jnp.stack([kc, vc]))
             elif tstep is not None and caches:
                 # decode: append the new token, attend over the valid cache
@@ -747,7 +791,7 @@ def fused_multi_transformer(
          list(ffn_ln_biases or []), list(ffn1_weights),
          list(ffn1_biases or []), list(ffn2_weights), list(ffn2_biases or []),
          list(caches_in), list(pre_in), rotary_embs, time_step, attn_mask,
-         seq_lens, block_tables,
+         seq_lens, chunk_lens, block_tables,
          list(ragged_work) if ragged_work is not None else [],
          # per-layer dropout keys as input leaves (vjp-cacheable +
          # trace-safe, like the other fused ops)

@@ -484,27 +484,36 @@ class FusedMultiTransformerEngine:
                 body, (tok, caches, lens0), jnp.arange(n))
             return toks, caches_f  # toks [n, B]
 
-        def paged_step(w, caches, tok, tables, lens, rwork, rpack, temp,
-                       topp, key):
-            """One continuous-batching decode step over the PAGED cache:
-            tok [B] is each slot's current input token, tables/lens the
-            host allocator's view, rwork the flattened ragged work list
-            (built host-side from lens + 1). Mixed-progress slots — some
-            still consuming their prompt, some deep into decode, some
-            idle — all advance in this ONE compiled program; the work
-            list's static length keys the compile, so bucketing it keeps
-            the program count O(log max_blocks)."""
-            h = w["embedding"][tok][:, None]
+        def paged_step(w, caches, toks, qlens, tables, lens, rwork, rpack,
+                       temp, topp, key):
+            """One continuous-batching step over the PAGED cache: toks
+            [B, C] is each slot's token slab for this step — decode
+            slots carry one token in column 0, prefill slots up to C
+            prompt-chunk tokens — and qlens [B] says how many columns
+            are valid per slot (0 parks the slot: nothing written,
+            nothing sampled that matters). tables/lens are the host
+            allocator's view BEFORE the step, rwork the flattened ragged
+            work list (built host-side from lens + qlens with
+            q_lens=qlens). Mixed-progress slots — some consuming whole
+            prompt chunks, some deep into decode, some idle — all
+            advance in this ONE compiled program; the bucketed
+            (work-list length, chunk-width) pair is the only shape that
+            varies step to step, so the program count stays
+            O(log max_blocks * log chunk). Each slot samples from its
+            LAST VALID position (the chunk's final token)."""
+            h = w["embedding"][toks]             # [B, C, E]
             from ..core.tensor import Tensor
             cts = [Tensor(c) for c in caches]
             out = fused_multi_transformer(
                 Tensor(h), *lists(w), cache_kvs=cts,
                 time_step=Tensor(jnp.zeros((), jnp.int32)),
-                seq_lens=Tensor(lens),
+                seq_lens=Tensor(lens), chunk_lens=Tensor(qlens),
                 rotary_embs=w.get("rotary_embs"),
                 block_tables=tables, ragged_work=rwork,
                 ragged_pack=rpack, **kw)
-            logits = out.data[:, 0] @ w["lm_head"]
+            bidx = jnp.arange(out.data.shape[0])
+            last = jnp.maximum(qlens - 1, 0)
+            logits = out.data[bidx, last] @ w["lm_head"]
             return select(logits, temp, topp, key), [c.data for c in cts]
 
         import jax
@@ -512,7 +521,7 @@ class FusedMultiTransformerEngine:
         self._step = jax.jit(step, donate_argnums=(1,))
         self._steps = jax.jit(steps, static_argnums=(4,),
                               donate_argnums=(1,))
-        self._paged_step = jax.jit(paged_step, static_argnums=(6,),
+        self._paged_step = jax.jit(paged_step, static_argnums=(7,),
                                    donate_argnums=(1,))
 
     def _build_quant_mm(self, weights, dtype):

@@ -28,6 +28,15 @@ Two kernels:
   slot t%2 after kicking off t+1's copy) so the next block streams from
   HBM while the current one is in the MXU.
 
+  Each work entry carries its sequence's QUERY SPAN (q_start, q_len):
+  decode sequences span one token, prefill sequences a chunk of up to C
+  prompt tokens — so one kernel invocation serves a MIXED prefill+decode
+  batch, the Sarathi-style chunked-prefill step. The packed tile grows
+  to [pack*C*G, D] (C query positions per sequence) and each query row
+  is causally masked to its own absolute position, so a 512-token prompt
+  costs ceil(512/C) steps at C-row MXU intensity instead of 512 steps
+  at one row.
+
 The work list is built host-side (`build_ragged_work`) because the block
 allocator that owns the tables is host code anyway; under `jax.jit` the
 caller passes the arrays in (`work=`) and the list length stays static
@@ -152,7 +161,7 @@ def next_pow2(n):
 
 
 def build_ragged_work(block_tables, context_lens, block_size, pack,
-                      bucket_to=None):
+                      bucket_to=None, q_lens=None):
     """Flatten (sequence, block) pairs into the ragged kernel's work list.
 
     Host-side on purpose: the block tables live on the host in the serving
@@ -161,15 +170,25 @@ def build_ragged_work(block_tables, context_lens, block_size, pack,
     0, then group 1, ...) so the kernel's accumulators live across exactly
     one contiguous span per group.
 
-    Returns (arrays, t_real, t_total, pack): seven int32 [t_total] arrays
+    Each entry carries its sequence's QUERY SPAN (q_start, q_len): the
+    chunk of trailing context positions that act as queries this step.
+    Decode is q_len == 1 (the default when `q_lens` is omitted: span =
+    the last token); chunked prefill passes `q_lens` [B] with up to
+    `chunk` new tokens per sequence. `context_lens` always counts the
+    TOTAL context including the span, so q_start = len - q_len. A
+    sequence whose q_len is 0 is skipped outright — zero work entries,
+    zero grid steps (its output rows are masked off by the caller).
+
+    Returns (arrays, t_real, t_total, pack): nine int32 [t_total] arrays
     (seq id, group id, row-in-group, cache block id, block position,
-    group-first flag, group-last flag), the number of real entries, the
-    padded length (== t_real unless bucket_to is given), and the
-    (clamped) pack factor the list was built with — the kernel's query
-    packing MUST use the same pack, so pass this whole tuple as
-    `ragged_paged_attention(..., work=...)` and it travels together.
-    Padding entries point their block position past every valid token so
-    the kernel masks them to a no-op.
+    group-first flag, group-last flag, query start, query len), the
+    number of real entries, the padded length (== t_real unless
+    bucket_to is given), and the (clamped) pack factor the list was
+    built with — the kernel's query packing MUST use the same pack, so
+    pass this whole tuple as `ragged_paged_attention(..., work=...)` and
+    it travels together. Padding entries point their block position past
+    every valid token (and carry q_len 0) so the kernel masks them to a
+    no-op.
 
     A length past the table capacity (max_blocks * block_size) walks only
     the blocks that exist: this pairs with `update_paged_kv_cache`
@@ -181,10 +200,22 @@ def build_ragged_work(block_tables, context_lens, block_size, pack,
     b = lens.shape[0]
     pack = max(1, min(int(pack), b))
     max_nb = tables.shape[1]
-    ws, wg, wr, wblk, wpos, wfirst, wlast = ([] for _ in range(7))
+    if q_lens is None:
+        ql_arr = np.ones(b, np.int64)
+    else:
+        ql_arr = np.asarray(q_lens).astype(np.int64).reshape(-1)
+        if ql_arr.shape[0] != b:
+            raise ValueError(
+                f"q_lens must be shape [{b}], got {ql_arr.shape}")
+    ws, wg, wr, wblk, wpos, wfirst, wlast, wqs, wql = (
+        [] for _ in range(9))
     for grp in range(-(-b // pack)):
         start_t = len(ws)
         for s in range(grp * pack, min((grp + 1) * pack, b)):
+            if q_lens is not None and ql_arr[s] <= 0:
+                continue    # no queries this step: costs zero grid steps
+            q_len = int(ql_arr[s])
+            q_start = max(int(lens[s]) - q_len, 0)
             for j in range(min(-(-int(lens[s]) // block_size), max_nb)):
                 ws.append(s)
                 wg.append(grp)
@@ -193,6 +224,8 @@ def build_ragged_work(block_tables, context_lens, block_size, pack,
                 wpos.append(j)
                 wfirst.append(0)
                 wlast.append(0)
+                wqs.append(q_start)
+                wql.append(q_len)
         if len(ws) > start_t:
             wfirst[start_t] = 1
             wlast[-1] = 1
@@ -213,15 +246,17 @@ def build_ragged_work(block_tables, context_lens, block_size, pack,
             wpos.append(pad_pos)  # position >= every len: fully masked
             wfirst.append(0)
             wlast.append(0)
+            wqs.append(0)
+            wql.append(0)        # zero-length span: every row masked
     arrs = tuple(np.asarray(a, np.int32)
-                 for a in (ws, wg, wr, wblk, wpos, wfirst, wlast))
+                 for a in (ws, wg, wr, wblk, wpos, wfirst, wlast, wqs, wql))
     return arrs, t_real, t_total, pack
 
 
-def _ragged_kernel(ws, wg, wr, wblk, wpos, wfirst, wlast, lens,
+def _ragged_kernel(ws, wg, wr, wblk, wpos, wfirst, wlast, wqs, wql,
                    q_ref, k_hbm, v_hbm, o_ref,
                    kbuf, vbuf, ksem, vsem, m_scr, l_scr, acc,
-                   *, block_size, scale, group_q):
+                   *, block_size, scale, group_q, chunk):
     hh = pl.program_id(0)
     t = pl.program_id(1)
     nt = pl.num_programs(1)
@@ -262,21 +297,28 @@ def _ragged_kernel(ws, wg, wr, wblk, wpos, wfirst, wlast, lens,
     kdma(t % 2, t).wait()
     vdma(t % 2, t).wait()
 
-    ctx_len = lens[ws[t]]
-    q = q_ref[0, 0].astype(jnp.float32)              # [pack*G, D]
+    span = chunk * group_q                            # rows per sequence
+    q = q_ref[0, 0].astype(jnp.float32)              # [pack*chunk*G, D]
     k = kbuf[t % 2].astype(jnp.float32)              # [BS, D]
     v = vbuf[t % 2].astype(jnp.float32)              # [BS, D]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale   # [pack*G, BS]
-    # the packed tile holds `pack` sequences' query groups; only the rows
-    # of THIS work item's sequence may see this KV block — everyone else
-    # is masked to a numerical no-op (p == 0, m/l/acc carried through)
+        preferred_element_type=jnp.float32) * scale   # [pack*chunk*G, BS]
+    # the packed tile holds `pack` sequences' query spans (chunk query
+    # positions x G group rows each); only the rows of THIS work item's
+    # sequence may see this KV block — everyone else is masked to a
+    # numerical no-op (p == 0, m/l/acc carried through). Within the
+    # sequence, query position j sits at absolute position q_start + j:
+    # rows past the valid span (j >= q_len) and KV positions a query may
+    # not see yet (pos > q_start + j, the intra-chunk causal boundary —
+    # which also caps at q_start + q_len - 1 == ctx - 1) mask off.
     row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    rel = row - wr[t] * span
+    j = rel // group_q                                # chunk position
     pos = wpos[t] * block_size + jax.lax.broadcasted_iota(
         jnp.int32, s.shape, 1)
-    mask = ((row >= wr[t] * group_q) & (row < (wr[t] + 1) * group_q)
-            & (pos < ctx_len))
+    mask = ((rel >= 0) & (rel < span) & (j < wql[t])
+            & (pos <= wqs[t] + j))
     m_prev = m_scr[:, :1]
     m_new = jnp.maximum(
         m_prev, jnp.max(jnp.where(mask, s, NEG_INF), axis=1, keepdims=True))
@@ -296,24 +338,30 @@ def _ragged_kernel(ws, wg, wr, wblk, wpos, wfirst, wlast, lens,
 
 
 def _pack_queries(q, kvh, g, pack):
-    """[B, H, D] -> [ngroups, KVH, pack*G, D] (+zero rows past B)."""
-    b, h, d = q.shape
+    """[B, C, H, D] -> [ngroups, KVH, pack*C*G, D] (+zero rows past B).
+
+    Row order within a group is sequence-major, then chunk position,
+    then GQA group row — row = (slot*C + j)*G + gr — matching the
+    kernel's rel/j decomposition."""
+    b, c, h, d = q.shape
     ngroups = -(-b // pack)
-    qg = q.reshape(b, kvh, g, d)
+    qg = q.reshape(b, c, kvh, g, d)
     pad = ngroups * pack - b
     if pad:
         qg = jnp.concatenate(
             [qg, jnp.zeros((pad,) + qg.shape[1:], qg.dtype)], 0)
-    return qg.reshape(ngroups, pack, kvh, g, d).transpose(0, 2, 1, 3, 4) \
-        .reshape(ngroups, kvh, pack * g, d)
+    return qg.reshape(ngroups, pack, c, kvh, g, d) \
+        .transpose(0, 3, 1, 2, 4, 5) \
+        .reshape(ngroups, kvh, pack * c * g, d)
 
 
-def _unpack_outputs(out, b, h, g, pack):
+def _unpack_outputs(out, b, c, h, g, pack):
     ngroups = out.shape[0]
     kvh = out.shape[1]
     d = out.shape[-1]
-    return out.reshape(ngroups, kvh, pack, g, d).transpose(0, 2, 1, 3, 4) \
-        .reshape(ngroups * pack, h, d)[:b]
+    return out.reshape(ngroups, kvh, pack, c, g, d) \
+        .transpose(0, 2, 3, 1, 4, 5) \
+        .reshape(ngroups * pack, c, h, d)[:b]
 
 
 def default_pack(batch, group_q):
@@ -323,14 +371,23 @@ def default_pack(batch, group_q):
 
 
 def ragged_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
-                           scale=None, pack=None, work=None):
-    """Decode-step attention over a paged KV cache, ragged grid.
+                           scale=None, pack=None, work=None, q_lens=None):
+    """Mixed decode/prefill attention over a paged KV cache, ragged grid.
 
-    q:            [B, H, D] — one query token per sequence
+    q:            [B, H, D] — one query token per sequence (decode), or
+                  [B, C, H, D] — a chunk of up to C query tokens per
+                  sequence (chunked prefill; rows past q_lens[b] ignored)
     k/v_cache:    [KVH, num_blocks, block_size, D]
     block_tables: [B, max_blocks_per_seq] int32 cache-block ids
-    context_lens: [B] int32 valid cache length per sequence (0 allowed:
-                  the row costs zero grid steps and returns zeros)
+    context_lens: [B] int32 valid cache length per sequence INCLUDING
+                  this call's query span (0 allowed: the row costs zero
+                  grid steps and returns zeros)
+    q_lens:       [B] int32 valid query count per sequence ([B, C, H, D]
+                  mode; None means one query per sequence). Sequence b's
+                  queries sit at positions context_lens[b]-q_lens[b] ..
+                  context_lens[b]-1, each causally masked to its own
+                  prefix. q_len 0 skips the sequence (zero grid steps,
+                  zero output).
     pack:         co-scheduled sequences per query tile (default: enough
                   that pack*G >= 8)
     work:         optional prebuilt `build_ragged_work(...)` result —
@@ -339,10 +396,14 @@ def ragged_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
                   pack) must be static. The work list's group/row
                   encoding and the kernel's query packing must agree, so
                   a pack carried by `work` wins; passing a CONFLICTING
-                  explicit pack raises.
-    returns       [B, H, D]
+                  explicit pack raises. The list's q spans must fit the
+                  slab (q_len <= C) — under jit this cannot be checked.
+    returns       [B, H, D] or [B, C, H, D], matching q
     """
-    b, h, d = q.shape
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    b, c, h, d = q.shape
     kvh, _, block_size, _ = k_cache.shape
     g = h // kvh
     if scale is None:
@@ -367,15 +428,16 @@ def ragged_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
     pack = max(1, min(pack, b))
     if work is None:
         work_arrs, _, t_total, pack = build_ragged_work(
-            block_tables, context_lens, block_size, pack)
+            block_tables, context_lens, block_size, pack, q_lens=q_lens)
     if t_total == 0:
-        return jnp.zeros_like(q)
+        out = jnp.zeros_like(q)
+        return out[:, 0] if squeeze else out
     ngroups = -(-b // pack)
-    pg = pack * g
+    pg = pack * c * g
     qp = _pack_queries(q, kvh, g, pack)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=8,
+        num_scalar_prefetch=9,
         grid=(kvh, t_total),
         in_specs=[
             pl.BlockSpec((1, 1, pg, d),
@@ -397,27 +459,38 @@ def ragged_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
     )
     out = pl.pallas_call(
         functools.partial(_ragged_kernel, block_size=block_size,
-                          scale=float(scale), group_q=g),
+                          scale=float(scale), group_q=g, chunk=c),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((ngroups, kvh, pg, d), q.dtype),
         interpret=_interpret_mode(),
     )(*[jnp.asarray(a, jnp.int32) for a in work_arrs],
-      jnp.asarray(context_lens, jnp.int32), qp, k_cache, v_cache)
-    out = _unpack_outputs(out, b, h, g, pack)
-    # rows whose group was never visited (len 0) carry uninitialised VMEM
-    return jnp.where(jnp.asarray(context_lens)[:, None, None] > 0, out, 0.0)
+      qp, k_cache, v_cache)
+    out = _unpack_outputs(out, b, c, h, g, pack)
+    # rows whose group was never visited (len 0 / q_len 0) carry
+    # uninitialised VMEM — mask every invalid (seq, chunk-pos) row off
+    if q_lens is None:
+        valid = jnp.asarray(context_lens).reshape(-1, 1) > 0     # [B, 1]
+    else:
+        valid = (jnp.arange(c)[None, :]
+                 < jnp.asarray(q_lens).reshape(-1, 1))           # [B, C]
+    out = jnp.where(valid[:, :, None, None], out, 0.0)
+    return out[:, 0] if squeeze else out
 
 
 def ragged_paged_attention_reference(q, k_cache, v_cache, block_tables,
-                                     context_lens, scale=None, pack=None):
+                                     context_lens, scale=None, pack=None,
+                                     q_lens=None):
     """Plain-JAX (no Pallas) execution of the ragged algorithm: same work
-    list, same packed tiles, same online-softmax update — each update
-    jitted as one program so XLA applies the same FMA contraction as
-    inside the kernel. On the CPU interpret grid the kernel must match
-    this BIT-EXACTLY; it is also the validation oracle the serving tests
-    diff against."""
+    list, same packed tiles, same online-softmax update, same query-span
+    masking — each update jitted as one program so XLA applies the same
+    FMA contraction as inside the kernel. On the CPU interpret grid the
+    kernel must match this BIT-EXACTLY; it is also the validation oracle
+    the serving tests diff against."""
     q = jnp.asarray(q)
-    b, h, d = q.shape
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    b, c, h, d = q.shape
     kc = jnp.asarray(k_cache)
     vc = jnp.asarray(v_cache)
     kvh, _, bs, _ = kc.shape
@@ -427,21 +500,24 @@ def ragged_paged_attention_reference(q, k_cache, v_cache, block_tables,
     if pack is None:
         pack = default_pack(b, g)
     lens = np.asarray(context_lens)
-    (ws, wg, wr, wblk, wpos, wfirst, wlast), _, t_total, pack = \
-        build_ragged_work(block_tables, lens, bs, pack)
-    pg = pack * g
+    (ws, wg, wr, wblk, wpos, wfirst, wlast, wqs, wql), _, t_total, pack = \
+        build_ragged_work(block_tables, lens, bs, pack, q_lens=q_lens)
+    span = c * g
+    pg = pack * span
     qp = _pack_queries(q, kvh, g, pack)
     ngroups = qp.shape[0]
 
     @jax.jit
-    def upd(qt, k, v, m, l, acc, wr_t, wpos_t, ctx_len):
+    def upd(qt, k, v, m, l, acc, wr_t, wpos_t, wqs_t, wql_t):
         s = jax.lax.dot_general(
             qt, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * float(scale)
         row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        rel = row - wr_t * span
+        j = rel // g
         pos = wpos_t * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = ((row >= wr_t * g) & (row < (wr_t + 1) * g)
-                & (pos < ctx_len))
+        mask = ((rel >= 0) & (rel < span) & (j < wql_t)
+                & (pos <= wqs_t + j))
         m_new = jnp.maximum(m, jnp.max(jnp.where(mask, s, NEG_INF),
                                        axis=1, keepdims=True))
         p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
@@ -466,11 +542,17 @@ def ragged_paged_attention_reference(q, k_cache, v_cache, block_tables,
                             kc[hh, wblk[t]].astype(jnp.float32),
                             vc[hh, wblk[t]].astype(jnp.float32),
                             m, l, acc, int(wr[t]), int(wpos[t]),
-                            int(lens[ws[t]]))
+                            int(wqs[t]), int(wql[t]))
             if wlast[t]:
                 out[wg[t], hh] = np.asarray(fin(acc, l))
-    out = _unpack_outputs(jnp.asarray(out), b, h, g, pack)
-    return jnp.where(jnp.asarray(lens)[:, None, None] > 0, out, 0.0)
+    out = _unpack_outputs(jnp.asarray(out), b, c, h, g, pack)
+    if q_lens is None:
+        valid = jnp.asarray(lens).reshape(-1, 1) > 0
+    else:
+        valid = (jnp.arange(c)[None, :]
+                 < jnp.asarray(q_lens).reshape(-1, 1))
+    out = jnp.where(valid[:, :, None, None], out, 0.0)
+    return out[:, 0] if squeeze else out
 
 
 def update_paged_kv_cache(k_cache, v_cache, k_new, v_new, block_tables,
@@ -502,5 +584,40 @@ def update_paged_kv_cache(k_cache, v_cache, k_new, v_new, block_tables,
         bidx = jnp.arange(b)
         return cache.at[hidx[None, :], blk_ids[:, None], offs[:, None]].set(
             new[bidx[:, None], hidx[None, :]], mode="drop")
+
+    return upd(k_cache, k_new), upd(v_cache, v_new)
+
+
+def update_paged_kv_cache_chunk(k_cache, v_cache, k_new, v_new,
+                                block_tables, context_lens, valid_counts):
+    """Append a CHUNK of new K/V rows ([B, C, KVH, D]) into the paged
+    cache: sequence b's row j lands at position context_lens[b] + j for
+    j < valid_counts[b]. The chunk may span block boundaries (the caller
+    grew the block table first). Returns the updated caches; pure
+    scatter, in-place under jit when the caches are donated.
+
+    Boundary contract (same as `update_paged_kv_cache`): rows past
+    valid_counts[b] and rows whose position falls at/after the table
+    capacity (max_blocks * block_size) are DROPPED — never aliased onto
+    whatever block a clamped gather would hand back."""
+    kvh, nb, bs, d = k_cache.shape
+    b, c = k_new.shape[0], k_new.shape[1]
+    max_nb = block_tables.shape[1]
+    pos = context_lens.reshape(-1, 1) + jnp.arange(c)[None, :]    # [B, C]
+    valid = ((jnp.arange(c)[None, :] < valid_counts.reshape(-1, 1))
+             & (pos < max_nb * bs))
+    blk_col = jnp.minimum(pos // bs, max_nb - 1)    # clamp the table read
+    blk_ids = jnp.take_along_axis(block_tables, blk_col, axis=1)  # [B, C]
+    # scatter mode="drop": invalid rows aim past the cache and vanish
+    blk_ids = jnp.where(valid, blk_ids, nb)
+    offs = pos % bs                                               # [B, C]
+
+    def upd(cache, new):
+        # scatter [B, C, KVH, D] into [KVH, NB, BS, D] at
+        # (h, blk_ids[b, j], offs[b, j]); positions are distinct per
+        # (b, j) so writes never collide
+        hidx = jnp.arange(kvh)
+        return cache.at[hidx[None, None, :], blk_ids[:, :, None],
+                        offs[:, :, None]].set(new, mode="drop")
 
     return upd(k_cache, k_new), upd(v_cache, v_new)

@@ -171,7 +171,7 @@ class TestRaggedKernel:
         bs, max_nb = 4, 2
         tables = np.arange(6, dtype=np.int32).reshape(3, 2)
         lens = np.asarray([8, 3, 5], np.int32) + 1   # row 0 past capacity
-        (ws, _, _, _, wpos, _, _), t_real, _, _ = pa.build_ragged_work(
+        (ws, _, _, _, wpos, _, _, _, _), t_real, _, _ = pa.build_ragged_work(
             tables, lens, bs, 2)
         assert t_real == 2 + 1 + 2                   # row 0 clamped to 2
         assert max(wpos[ws == 0]) == max_nb - 1

@@ -75,3 +75,13 @@ def jitted_non_observability_call(x):
     # paddle_tpu.* call inside jit that is NOT under .observability must
     # stay clean (GL105 matches the full dotted prefix, not the root)
     return paddle_tpu.nn.functional.relu(x)
+
+
+@jax.jit
+def mxu_dot_with_accumulator(a, b):
+    # the sanctioned MXU spellings: accumulator stated (GL106 clean) —
+    # and a non-dot `.dot`-free einsum must never trip the rule either
+    s = jnp.dot(a, b, preferred_element_type=jnp.float32)
+    s = jax.lax.dot_general(s, b, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return jnp.einsum("ij,jk->ik", s, b)

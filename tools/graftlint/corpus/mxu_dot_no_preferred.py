@@ -1,0 +1,24 @@
+# graftlint-corpus-expect: GL106 GL106
+"""Reconstruction of the MXU-accumulator hazard GL106 hunts: a dot with
+no preferred_element_type accumulates in the operand dtype — bf16 sums
+in bf16, int8 can overflow. One in a (corpus-scoped-as-Pallas) kernel
+body, one inside a jitted function; the third dot spells its accumulator
+and must stay clean."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _attn_kernel(q_ref, k_ref, o_ref):
+    # kernel-file scope: every dot is an MXU dot — bf16 refs accumulate
+    # in bf16 without the kwarg
+    o_ref[...] = lax.dot_general(
+        q_ref[...], k_ref[...], (((1,), (1,)), ((), ())))
+
+
+@jax.jit
+def fused_score(a, b):
+    s = jnp.dot(a, b)          # jitted: lowers to the MXU, bf16-accumulated
+    return lax.dot_general(
+        s, b, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)   # correct spelling: clean

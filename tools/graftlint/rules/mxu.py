@@ -1,0 +1,63 @@
+"""MXU dot hygiene (GL106) — the ROADMAP candidate rule, promoted.
+
+On TPU the MXU natively accumulates matmuls in float32, but a
+`jnp.dot` / `lax.dot_general` without `preferred_element_type` asks XLA
+to accumulate in the OPERAND dtype: a bf16 x bf16 contraction silently
+sums in bf16 (8 mantissa bits — a 512-term softmax*V row loses real
+precision) and an int8 one can overflow. Every MXU dot in this repo
+spells the accumulator out; chunked prefill multiplies whole prompt
+chunks per step, so the new dots it adds are gated from day one.
+
+Scope: every dot in a Pallas kernel file (the MXU is the only reason
+the file exists), plus dots inside jit-decorated functions anywhere
+(they lower to the MXU too). Eager-path dots in plain library code are
+left alone — XLA's eager default is fine off the hot path, and flagging
+them would bury the signal.
+"""
+import ast
+
+from ..core import in_pallas, rule
+from .trace_safety import _attr_chain, _is_jitish
+
+# spellings that are the jax dot (numpy's np.dot has no
+# preferred_element_type and is already GL103 inside jit)
+_DOT_CHAINS = {"jnp.dot", "jax.numpy.dot"}
+
+
+@rule("GL106", "mxu-dot-preferred-element-type", "mxu")
+def mxu_dot_preferred(ctx):
+    """`jnp.dot` / `lax.dot_general` without preferred_element_type in a
+    Pallas kernel file or a jit-decorated function."""
+    pallas_scope = in_pallas(ctx)
+    jit_nodes = set()
+    if not pallas_scope:
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(_is_jitish(d) for d in fn.decorator_list):
+                for n in ast.walk(fn):
+                    jit_nodes.add(id(n))
+        if not jit_nodes:
+            return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        if f.attr == "dot_general":
+            what = _attr_chain(f) or "dot_general"
+        elif f.attr == "dot" and _attr_chain(f) in _DOT_CHAINS:
+            what = _attr_chain(f)
+        else:
+            continue
+        if any(k.arg == "preferred_element_type" for k in node.keywords):
+            continue
+        if not (pallas_scope or id(node) in jit_nodes):
+            continue
+        yield ctx.finding(
+            "GL106", node,
+            f"MXU dot `{what}` without preferred_element_type: the "
+            "accumulator silently takes the operand dtype (bf16 sums in "
+            "bf16, int8 can overflow) — say "
+            "preferred_element_type=jnp.float32 (or the intended "
+            "accumulator) so the MXU accumulates correctly"), node

@@ -238,6 +238,35 @@ def ragged_leg(iters=4):
     return out
 
 
+def _tiny_cpu_engine(rng, max_seq_len):
+    """The CPU-sized serving engine both the --metrics and --prefill legs
+    drive (V=128/E=64/L=2, GQA 4q/2kv). Takes the caller's rng so the
+    weight draws stay at the head of its stream — prompt draws follow
+    from the same generator, keeping committed baselines reproducible."""
+    import numpy as np
+
+    from paddle_tpu.inference import FusedMultiTransformerEngine
+
+    V, E, H, G, D, L, F = 128, 64, 4, 2, 16, 2, 96
+
+    def mk(*shape, scale=0.05):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    w = dict(
+        ln_scales=[np.ones(E, np.float32) for _ in range(L)],
+        qkv_weights=[mk(H + 2 * G, D, E) for _ in range(L)],
+        linear_weights=[mk(H * D, E) for _ in range(L)],
+        ffn_ln_scales=[np.ones(E, np.float32) for _ in range(L)],
+        ffn1_weights=[mk(E, 2 * F) for _ in range(L)],
+        ffn2_weights=[mk(F, E) for _ in range(L)],
+        embedding=mk(V, E), lm_head=mk(E, V))
+    eng = FusedMultiTransformerEngine(
+        w, num_heads=H, head_dim=D, max_seq_len=max_seq_len,
+        dtype="float32", norm_type="rmsnorm", activation="swiglu",
+        gqa_group_size=G)
+    return eng, V
+
+
 def serving_metrics_leg():
     """Continuous-batching serving with the observability layer on: drive
     `ContinuousBatchingEngine.run()` over a ragged request mix (CPU-sized
@@ -255,7 +284,6 @@ def serving_metrics_leg():
     from paddle_tpu import observability as obs
     from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
                                         GenerationRequest)
-    from paddle_tpu.inference import FusedMultiTransformerEngine
     from paddle_tpu.ops.pallas import flash_attention as fa
 
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -264,22 +292,7 @@ def serving_metrics_leg():
     obs.install_compile_watch()
 
     rng = np.random.default_rng(0)
-    V, E, H, G, D, L, F = 128, 64, 4, 2, 16, 2, 96
-
-    def mk(*shape, scale=0.05):
-        return (rng.standard_normal(shape) * scale).astype(np.float32)
-
-    w = dict(
-        ln_scales=[np.ones(E, np.float32) for _ in range(L)],
-        qkv_weights=[mk(H + 2 * G, D, E) for _ in range(L)],
-        linear_weights=[mk(H * D, E) for _ in range(L)],
-        ffn_ln_scales=[np.ones(E, np.float32) for _ in range(L)],
-        ffn1_weights=[mk(E, 2 * F) for _ in range(L)],
-        ffn2_weights=[mk(F, E) for _ in range(L)],
-        embedding=mk(V, E), lm_head=mk(E, V))
-    eng = FusedMultiTransformerEngine(
-        w, num_heads=H, head_dim=D, max_seq_len=32, dtype="float32",
-        norm_type="rmsnorm", activation="swiglu", gqa_group_size=G)
+    eng, V = _tiny_cpu_engine(rng, max_seq_len=32)
     cb = ContinuousBatchingEngine(eng, num_blocks=12, block_size=8,
                                   max_batch=4)
     # ragged mix (prompt len, new tokens): same spread-of-lengths spirit
@@ -341,6 +354,70 @@ def serving_metrics_leg():
     return out
 
 
+def prefill_leg(chunk=64, prompt_lens=(64, 256, 512), block_size=64):
+    """Chunked vs unchunked prefill TTFT: drive the continuous-batching
+    engine with a single P-token prompt and count the steps (and host
+    wall) until its FIRST token lands. Unchunked (prefill_chunk=1, the
+    PR-1 behaviour) pays P compiled steps; chunked pays ceil(P/chunk).
+    Steps-to-first-token is host-deterministic and is the gated claim;
+    wall TTFT is context (off-TPU it times the Pallas interpreter, not
+    the chip). Both variants share one FusedMultiTransformerEngine so
+    the measured pass runs against warm compile caches."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                        GenerationRequest)
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        fa._INTERPRET = True
+    rng = np.random.default_rng(0)
+    eng, V = _tiny_cpu_engine(rng, max_seq_len=max(prompt_lens) * 2)
+    num_blocks = max(prompt_lens) // block_size + 3
+
+    def first_token(prompt, prefill_chunk):
+        cb = ContinuousBatchingEngine(
+            eng, num_blocks=num_blocks, block_size=block_size,
+            max_batch=1, prefill_chunk=prefill_chunk)
+        req = GenerationRequest(prompt, 2)
+        cb.submit(req)
+        t0 = time.monotonic()
+        steps = 0
+        while not req.generated:
+            cb.step()
+            steps += 1
+            if steps > len(prompt) + 4:
+                raise RuntimeError("first token never arrived")
+        return steps, (time.monotonic() - t0) * 1e3, len(cb._seen_buckets)
+
+    out = {"chunk": chunk, "block_size": block_size,
+           "interpret": not on_tpu, "prompts": {}}
+    for p_len in prompt_lens:
+        prompt = rng.integers(1, V, p_len).astype(np.int32)
+        row = {"expected_chunked_steps": -(-p_len // chunk)}
+        for label, pc in (("unchunked", 1), ("chunked", chunk)):
+            first_token(prompt, pc)      # warm the compile caches
+            steps, ttft_ms, buckets = first_token(prompt, pc)
+            row[f"{label}_steps_to_first_token"] = steps
+            row[f"{label}_ttft_ms"] = round(ttft_ms, 1)
+            row[f"{label}_buckets"] = buckets
+        assert row["chunked_steps_to_first_token"] == \
+            row["expected_chunked_steps"], row
+        out["prompts"][str(p_len)] = row
+        print(f"prefill[P={p_len}]: steps-to-first-token "
+              f"{row['unchunked_steps_to_first_token']} unchunked vs "
+              f"{row['chunked_steps_to_first_token']} chunked "
+              f"(chunk={chunk}); TTFT {row['unchunked_ttft_ms']:.0f} ms "
+              f"vs {row['chunked_ttft_ms']:.0f} ms"
+              + (" [interpret: times the interpreter, not the chip]"
+                 if not on_tpu else ""))
+    return out
+
+
 GRID_KEYS = ("total_kv_blocks", "work_items", "legacy_grid_steps",
              "ragged_grid_steps", "pack", "context_lens")
 
@@ -384,11 +461,18 @@ def main():
                          "observability layer on and report p50/p95/p99 "
                          "TTFT / per-token latency from the histograms "
                          "(works on CPU via interpret mode)")
+    ap.add_argument("--prefill", action="store_true",
+                    help="chunked vs unchunked prefill TTFT + steps-to-"
+                         "first-token at prompt lengths 64/256/512 "
+                         "(works on CPU via interpret mode; minutes, "
+                         "the unchunked leg really pays P steps)")
+    ap.add_argument("--chunk", type=int, default=64,
+                    help="prefill chunk size for the --prefill leg")
     args = ap.parse_args()
     import jax
     if args.check:
         return check_ragged(args.check)
-    if args.ragged or args.metrics:
+    if args.ragged or args.metrics or args.prefill:
         out = {}
         if args.ragged:
             out["ragged"] = ragged_leg()
@@ -407,6 +491,11 @@ def main():
                       f"p95 {p['p95']} ms, p99 {p['p99']} ms"
                       + (" (interpret mode: measures the interpreter, "
                          "not the chip)" if sm["interpret"] else ""))
+        if args.prefill:
+            # AFTER the metrics leg: the prefill leg drives the serving
+            # engine too, and the process-wide registry must not count
+            # its steps into the committed metrics snapshot
+            out["prefill"] = prefill_leg(chunk=args.chunk)
         if args.json:
             with open(args.json, "w") as f:
                 json.dump(out, f, indent=1)
