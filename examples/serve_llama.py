@@ -27,7 +27,8 @@ def run_continuous(engine, rng, V, args):
     cb = ContinuousBatchingEngine(engine, num_blocks=33, block_size=16,
                                   max_batch=args.batch,
                                   prefill_chunk=args.prefill_chunk,
-                                  token_budget=args.token_budget)
+                                  token_budget=args.token_budget,
+                                  spec_k=args.spec_k)
     free0 = cb.allocator.num_free
     lengths = [(5, 12), (23, 8), (3, 30), (17, 17), (9, 5), (40, 11)]
     reqs = [GenerationRequest(rng.integers(1, V, p).astype(np.int32), n)
@@ -42,6 +43,10 @@ def run_continuous(engine, rng, V, args):
           f"(prompts {[p for p, _ in lengths]}) -> {tok} tokens in "
           f"{cb._step_count} steps, {dt * 1000:.1f} ms; "
           f"free blocks {cb.allocator.num_free}/{free0}")
+    drafted = sum(r.spec_drafted for r in reqs)
+    if drafted:
+        print(f"  speculative: {sum(r.spec_accepted for r in reqs)}"
+              f"/{drafted} drafts accepted")
     for r, (p, n) in zip(reqs, lengths):
         print(f"  req {r.request_id} (prompt {p:2d}, max_new {n:2d}): "
               f"{out[r.request_id][:8]}")
@@ -62,6 +67,10 @@ def main():
     ap.add_argument("--token-budget", type=int, default=None,
                     help="per-step token budget shared by decode slots "
                          "(1 token each, mandatory) and prompt chunks")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative decode: up to K prompt-lookup "
+                         "draft tokens per decode slot per step "
+                         "(greedy only; 0 disables)")
     args = ap.parse_args()
 
     rng = np.random.default_rng(0)

@@ -7,4 +7,5 @@ from .layers import (FusedMultiHeadAttention, FusedFeedForward,  # noqa: F401
                      FusedLinear, FusedDropoutAdd, FusedMultiTransformer)
 from .continuous_batching import (BlockAllocator,  # noqa: F401
                                   GenerationRequest,
-                                  ContinuousBatchingEngine)
+                                  ContinuousBatchingEngine,
+                                  propose_draft_tokens)

@@ -11,6 +11,20 @@ some mid-generation, some slots idle — as ONE compiled program
 (FusedMultiTransformerEngine._paged_step over the ragged Pallas kernel,
 ops/pallas/paged_attention.py).
 
+Speculative multi-token decode rides the same query-span work list: a
+model-free prompt-lookup proposer (`propose_draft_tokens` — match the
+generated suffix's last n-gram against the prompt + everything emitted
+so far, zero extra model passes) drafts up to `spec_k` continuation
+tokens per decode slot; the scheduler grants those slots a 1+K span as
+OPTIONAL FILLER after the mandatory decode-1 and prefill chunks, the
+compiled step verifies the whole span in one pass (the ragged kernel's
+intra-chunk causal mask makes position j's sample exactly the
+sequential decode's choice), and the host accepts the longest matching
+prefix — token-exact vs non-speculative greedy decoding by
+construction. Rejected suffixes roll back through a paged-KV rewind
+(host block free + `truncate_paged_kv_cache` zeroing), so the cache
+stays bit-identical to a never-speculated one.
+
 Host/device split: the allocator, block tables, lengths, and scheduling
 live on the host (tiny int arrays, zero device round trips beyond the
 step itself); the device program's shape is keyed only by the bucketed
@@ -30,7 +44,35 @@ from ...observability import instrument as _metrics
 from ...ops.pallas.paged_attention import (build_ragged_work, default_pack,
                                            next_pow2)
 
-__all__ = ["BlockAllocator", "GenerationRequest", "ContinuousBatchingEngine"]
+__all__ = ["BlockAllocator", "GenerationRequest", "ContinuousBatchingEngine",
+           "propose_draft_tokens"]
+
+
+def propose_draft_tokens(tokens, max_k, ngram=2):
+    """Prompt-lookup (n-gram) draft proposal — the model-free speculative
+    drafter: match the suffix's last `n` tokens (n = ngram down to 1)
+    against every EARLIER position in `tokens` (prompt + generated), and
+    propose the up-to-`max_k` tokens that followed the MOST RECENT match.
+    Repetitive contexts (code, JSON, extraction, self-repeating greedy
+    loops) hit constantly; zero model passes, zero state to shard.
+
+    Host-side by design: pure python over the request's token list, the
+    same place the scheduler already lives. Returns [] when nothing
+    matches (the slot falls back to plain decode-1)."""
+    if max_k <= 0:
+        return []
+    toks = list(tokens)
+    n_tok = len(toks)
+    for n in range(min(int(ngram), n_tok - 1), 0, -1):
+        suffix = toks[n_tok - n:]
+        # right-to-left: recency beats distance (the generated suffix is
+        # a better predictor than a stale prompt occurrence)
+        for start in range(n_tok - n - 1, -1, -1):
+            if toks[start:start + n] == suffix:
+                cont = toks[start + n:start + n + int(max_k)]
+                if cont:
+                    return cont
+    return []
 
 
 class BlockAllocator:
@@ -103,6 +145,10 @@ class GenerationRequest:
         self.blocks = []        # physical cache blocks, in table order
         self.progress = 0       # prompt tokens consumed so far
         self.generated = []
+        # speculative-decode acceptance bookkeeping (engine-owned):
+        # drafts proposed for / accepted by this request's verification
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         # latency bookkeeping (host monotonic clock; set by the engine)
         self.submit_time = None
         self.admit_time = None
@@ -147,11 +193,30 @@ class ContinuousBatchingEngine:
     `prefill_chunk=1` reproduces the PR-1 one-token-per-step prefill
     exactly; `token_budget=None` means unthrottled (every prefill slot
     gets a full chunk each step). Chunking is token-exact either way.
+
+    `spec_k > 0` turns on speculative multi-token decode (greedy only):
+    each decode slot may be granted up to `spec_k` prompt-lookup draft
+    tokens on top of its mandatory decode-1 — drafts are optional
+    FILLER, granted only after every decode token and prompt chunk fit
+    the budget — and the compiled step verifies the whole 1+K span in
+    one pass. Accepted prefixes emit several tokens per step; rejected
+    suffixes rewind the paged cache (block free + device-side zeroing),
+    so generations stay token-exact vs `spec_k=0` and vs
+    `engine.generate()`.
+
+    `tpot_slo` (seconds, optional) arms the latency-SLO chunk
+    controller: when the rolling mean of decode time-per-output-token
+    exceeds the SLO, `prefill_chunk` shrinks one power-of-two bucket
+    (never below `min_prefill_chunk`) — trading TTFT headroom for
+    decode latency under load, the ROADMAP's "next scheduler lever".
     """
+
+    SLO_WINDOW = 8      # decode-TPOT samples per controller decision
 
     def __init__(self, engine, num_blocks, block_size, max_batch=8,
                  temperature=0.0, top_p=1.0, seed=0, prefill_chunk=64,
-                 token_budget=None):
+                 token_budget=None, spec_k=0, spec_ngram=2,
+                 tpot_slo=None, min_prefill_chunk=64):
         import jax
 
         self.engine = engine
@@ -164,6 +229,29 @@ class ContinuousBatchingEngine:
             else int(token_budget)
         if self.token_budget is not None and self.token_budget < 1:
             raise ValueError("token_budget must be >= 1")
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
+        if self.spec_k and float(temperature) > 0.0:
+            # greedy verification accepts drafts that MATCH the argmax;
+            # sampled decoding needs rejection sampling to stay unbiased
+            # — not implemented, so refuse loudly instead of skewing the
+            # output distribution
+            raise ValueError(
+                "speculative decoding (spec_k > 0) is greedy-only: "
+                "temperature must be 0")
+        self.spec_ngram = int(spec_ngram)
+        if self.spec_k and self.spec_ngram < 1:
+            raise ValueError("spec_ngram must be >= 1")
+        if self.spec_k:
+            # pin the acceptance-length histogram's bucket range to this
+            # engine's spec_k (buckets bind on first creation)
+            _metrics.spec_accept_len(max(8, self.spec_k))
+        self.tpot_slo = None if tpot_slo is None else float(tpot_slo)
+        if self.tpot_slo is not None and self.tpot_slo <= 0:
+            raise ValueError("tpot_slo must be > 0 seconds")
+        self.min_prefill_chunk = int(min_prefill_chunk)
+        self._tpot_window = collections.deque(maxlen=self.SLO_WINDOW)
         self.max_blocks = engine.max_seq_len // self.block_size
         if self.max_blocks < 1:
             raise ValueError("block_size larger than engine.max_seq_len")
@@ -264,6 +352,8 @@ class ContinuousBatchingEngine:
             req.blocks = []
             req.progress = 0
             req.generated = []
+            req.spec_drafted = 0
+            req.spec_accepted = 0
             req.admit_time = time.monotonic()
             if req.submit_time is not None:
                 _metrics.serve_queue_wait().observe(
@@ -277,16 +367,28 @@ class ContinuousBatchingEngine:
         MANDATORY (one token each — a decode can't be deferred without
         stalling its request and holding its blocks hostage), then the
         remaining budget is spent on prompt chunks of up to
-        `prefill_chunk` tokens, slot order. A prefill slot the budget
-        can't reach gets 0 tokens and simply stalls this step (it costs
-        zero work-list entries). Returns q_lens [max_batch] int64."""
+        `prefill_chunk` tokens, slot order, and ONLY THEN — budget
+        permitting — decode slots are topped up with speculative draft
+        spans (up to `spec_k` prompt-lookup tokens each, capped so a
+        fully-accepted span can never overshoot max_new_tokens — which
+        also keeps the step inside the admission reservation's
+        worst-case block footprint). Drafts being last keeps the
+        bucketed (work-list length, chunk-width) compile keys warm:
+        speculation never displaces mandatory work, it only fills slack.
+        A prefill slot the budget can't reach gets 0 tokens and simply
+        stalls this step (it costs zero work-list entries).
+
+        Returns (q_lens [max_batch] int64, drafts {slot: token list})."""
         q_lens = np.zeros(self.max_batch, np.int64)
+        drafts = {}
         used = 0
+        decode_slots = []
         for i in active:
             req = self.slots[i]
             if req.progress >= len(req.prompt):
                 q_lens[i] = 1
                 used += 1
+                decode_slots.append(i)
         budget = self.token_budget
         for i in active:
             req = self.slots[i]
@@ -297,7 +399,24 @@ class ContinuousBatchingEngine:
             take = min(self.prefill_chunk, room)
             q_lens[i] = take
             used += take
-        return q_lens
+        if self.spec_k:
+            for i in decode_slots:
+                req = self.slots[i]
+                # a span of 1+k emits at most k+1 tokens: cap k at
+                # rem_gen-1 so acceptance can never exceed the request
+                rem_gen = req.max_new_tokens - len(req.generated)
+                room = rem_gen - 1 if budget is None \
+                    else min(rem_gen - 1, budget - used)
+                if room <= 0:
+                    continue
+                d = propose_draft_tokens(req.prompt + req.generated,
+                                         min(self.spec_k, room),
+                                         self.spec_ngram)
+                if d:
+                    drafts[i] = d
+                    q_lens[i] += len(d)
+                    used += len(d)
+        return q_lens, drafts
 
     def step(self):
         """One scheduler tick + one compiled mixed prefill/decode step.
@@ -312,7 +431,7 @@ class ContinuousBatchingEngine:
         self._update_pool_gauges()
         if not active:
             return len(self.queue)
-        q_lens = self._schedule_tokens(active)
+        q_lens, drafts = self._schedule_tokens(active)
         for i in active:
             # grow the block list to cover every token this step appends
             # (a prompt chunk may cross several block boundaries);
@@ -337,7 +456,30 @@ class ContinuousBatchingEngine:
             if req.progress < len(req.prompt):
                 slab[i, :n] = req.prompt[req.progress:req.progress + n]
             elif n:
+                # decode: last real token, then the speculative drafts
+                # (if granted) — the step verifies the whole span
                 slab[i, 0] = req.generated[-1]
+                d = drafts.get(i)
+                if d:
+                    slab[i, 1:1 + len(d)] = d
+        # sample-position gather [B, W]: the device projects/samples only
+        # these slab columns, so lm_head cost is bounded by 1 + spec_k
+        # per slot, not the chunk width. Prefill slots read one column
+        # (the chunk-final position), decode slots their whole 1+K span;
+        # padding repeats column 0 (computed, ignored). W is a pure
+        # function of c and the engine-static spec_k, so the (t_total,
+        # c) bucket pair still keys every compile.
+        w_sel = min(c, 1 + self.spec_k)
+        sel = np.zeros((self.max_batch, w_sel), np.int32)
+        for i in active:
+            req = self.slots[i]
+            n = int(q_lens[i])
+            if n == 0:
+                continue
+            if req.progress < len(req.prompt):
+                sel[i, 0] = n - 1
+            else:
+                sel[i, :n] = np.arange(n)
         q_arr = q_lens.astype(np.int32)
         attn_lens = (self.lens + q_arr).astype(np.int32)
         work, _, t_total, pack = build_ragged_work(
@@ -354,28 +496,68 @@ class ContinuousBatchingEngine:
                 bucket=f"{t_total}x{c}").inc()
         self._key, sub = jax.random.split(self._key)
         toks2, self.caches = self.engine._paged_step(
-            self.engine._w, self.caches, slab, q_arr,
+            self.engine._w, self.caches, slab, q_arr, sel,
             np.asarray(self.tables), np.asarray(self.lens), tuple(work),
             pack, np.float32(self._temp), np.float32(self._topp), sub)
-        toks2 = np.asarray(toks2)
+        toks2 = np.asarray(toks2)      # [B, W]: a sample per sel column
         t_done = time.monotonic()
         emitted = 0
+        rewinds = []    # (slot, new_end, old_end): rejected draft spans
         for i in active:
             req = self.slots[i]
             n = int(q_lens[i])
             if n == 0:
                 continue        # starved prefill slot: stalled this step
-            self.lens[i] += n
             if req.progress < len(req.prompt):
+                self.lens[i] += n
                 req.progress += n
                 if req.progress == len(req.prompt):
-                    # the chunk ended the prompt: the sample at its last
-                    # valid position is the request's FIRST output token
-                    self._append_token(req, toks2[i], t_done)
+                    # the chunk ended the prompt: sel column 0 carried
+                    # its last valid position — that sample is the
+                    # request's FIRST output token
+                    self._append_token(req, toks2[i, 0], t_done)
                     emitted += 1
             else:
-                self._append_token(req, toks2[i], t_done)
-                emitted += 1
+                # decode: greedy-verify the drafted span (sel columns
+                # 0..n-1 are slab positions 0..n-1). Column j's sample
+                # is the model's choice after slab column j, so draft
+                # d[a] (at slab column a+1) is accepted iff it EQUALS
+                # sample a; the sample after the last accepted draft is
+                # emitted too (it was computed against a fully-valid
+                # prefix) — a+1 tokens out of one compiled step.
+                d = drafts.get(i, [])
+                k = len(d)               # n == 1 + k
+                span = toks2[i, :n]
+                a = 0
+                while a < k and d[a] == int(span[a]):
+                    a += 1
+                self._append_span(req, span[:a + 1], t_done)
+                emitted += a + 1
+                old_end = int(self.lens[i]) + n
+                new_end = int(self.lens[i]) + a + 1
+                self.lens[i] = new_end
+                if k:
+                    req.spec_drafted += k
+                    req.spec_accepted += a
+                    _metrics.spec_draft_tokens().inc(k)
+                    _metrics.spec_accepted_tokens().inc(a)
+                    _metrics.spec_accept_len().observe(a)
+                if new_end < old_end:
+                    rewinds.append((i, new_end, old_end))
+        if rewinds:
+            # device-side zeroing FIRST (it reads the table rows that
+            # still point at the rejected positions), host block
+            # rollback after; one jitted program covers every slot,
+            # keyed by the same bucketed slab width as the step
+            new_l = self.lens.copy()
+            old_l = self.lens.copy()
+            for i, _, oe in rewinds:
+                old_l[i] = oe
+            self.caches = self.engine._paged_rewind(
+                self.caches, np.asarray(self.tables), new_l, old_l, c)
+            for i, ne, _ in rewinds:
+                self._rewind_blocks(i, ne)
+            self._update_pool_gauges()
         self._step_count += 1
         dur = t_done - t_begin
         _metrics.serve_step_seconds().observe(dur)
@@ -383,7 +565,45 @@ class ContinuousBatchingEngine:
             _metrics.serve_tokens_total().inc(emitted)
             _metrics.serve_tokens_per_s().set(
                 emitted / dur if dur > 0 else 0.0)
+        # set even at 0 (a prefill-bound step emits nothing): a stale
+        # nonzero reading would overstate throughput exactly when the
+        # engine is prompt-bound
+        _metrics.serve_effective_tokens_per_step().set(emitted)
+        self._maybe_shrink_chunk()
         return len(self.queue) + self.num_active
+
+    def _rewind_blocks(self, i, new_end):
+        """Host half of the speculative rewind: shrink slot i's block
+        list to cover `new_end` tokens, freeing (and zeroing out of the
+        table) every block past that — the block-boundary case where a
+        rejection hands cache capacity straight back to the pool. The
+        device half (`truncate_paged_kv_cache`) already zeroed the
+        rejected positions, so a freed-then-reallocated block carries no
+        stale KV."""
+        req = self.slots[i]
+        need = -(-new_end // self.block_size) if new_end > 0 else 0
+        while len(req.blocks) > need:
+            blk = req.blocks.pop()
+            self.tables[i, len(req.blocks)] = 0
+            self.allocator.free([blk])
+
+    def _maybe_shrink_chunk(self):
+        """Latency-SLO chunk controller: when the rolling mean of decode
+        TPOT exceeds the SLO, shrink `prefill_chunk` one power-of-two
+        bucket (256 -> 128 -> 64 -> ... -> min_prefill_chunk) — prefill
+        chunks are the schedulable knob, decode-1 is mandatory. The
+        window clears on every shrink so each decision sees only
+        post-shrink samples (a cooldown, not a ratchet)."""
+        if self.tpot_slo is None or self.prefill_chunk <= \
+                self.min_prefill_chunk:
+            return
+        if len(self._tpot_window) < self.SLO_WINDOW:
+            return
+        if sum(self._tpot_window) / len(self._tpot_window) > self.tpot_slo:
+            self.prefill_chunk = max(self.min_prefill_chunk,
+                                     self.prefill_chunk // 2)
+            _metrics.serve_prefill_chunk().set(self.prefill_chunk)
+            self._tpot_window.clear()
 
     def _append_token(self, req, tok, now):
         """Record one generated token + its latency sample: the first
@@ -396,6 +616,27 @@ class ContinuousBatchingEngine:
                 _metrics.serve_ttft().observe(now - req.submit_time)
         elif req._last_token_time is not None:
             _metrics.serve_tpot().observe(now - req._last_token_time)
+        req._last_token_time = now
+
+    def _append_span(self, req, toks, now):
+        """Record a verified decode span (the mandatory token + accepted
+        drafts) with ONE latency interval: serve_tpot observes the
+        span's effective per-token latency (interval / span length — a
+        per-token loop would flood the histogram with zeros, every
+        accepted draft landing at the same host timestamp), and the SLO
+        controller window gets the FULL interval once, because the
+        controller tracks step latency, which speculation does not
+        shrink."""
+        for t in toks:
+            req.generated.append(int(t))
+        if req.first_token_time is None:
+            req.first_token_time = now
+            if req.submit_time is not None:
+                _metrics.serve_ttft().observe(now - req.submit_time)
+        elif req._last_token_time is not None:
+            interval = now - req._last_token_time
+            _metrics.serve_tpot().observe(interval / len(toks))
+            self._tpot_window.append(interval)
         req._last_token_time = now
 
     def run(self, max_steps=100000):

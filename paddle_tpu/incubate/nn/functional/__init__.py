@@ -29,6 +29,7 @@ __all__ = [
     "fused_matmul_bias", "fused_linear", "fused_linear_activation",
     "fused_moe", "variable_length_memory_efficient_attention",
     "fused_rms_norm", "fused_layer_norm", "blha_get_max_len", "swiglu",
+    "block_kv_cache_rewind",
 ]
 
 
@@ -327,6 +328,27 @@ def block_multihead_attention(qkv, k_cache, v_cache, block_tables,
 
     return apply_op("block_multihead_attention", impl,
                     (qkv, k_cache, v_cache, block_tables, context_lens),
+                    {}, differentiable=False)
+
+
+def block_kv_cache_rewind(k_cache, v_cache, block_tables, new_lens,
+                          old_lens, max_span):
+    """Speculative-decode rewind over the paged KV cache: zero positions
+    new_lens[b] .. old_lens[b]-1 (the KV a rejected draft span appended)
+    so the cache is bit-identical to one that never speculated. Caches
+    [KVH, num_blocks, block_size, D]; new_lens/old_lens [B] int32;
+    `max_span` a static python int bounding the widest rewind. Returns
+    (k_cache, v_cache). The serving engine batches all slots' rewinds
+    into one call of this per step (FusedMultiTransformerEngine's
+    `_paged_rewind` applies it to every layer in one jitted program)."""
+    from ....ops.pallas.paged_attention import truncate_paged_kv_cache
+    span = int(max_span)
+
+    def impl(kc, vc, tables, nl, ol):
+        return truncate_paged_kv_cache(kc, vc, tables, nl, ol, span)
+
+    return apply_op("block_kv_cache_rewind", impl,
+                    (k_cache, v_cache, block_tables, new_lens, old_lens),
                     {}, differentiable=False)
 
 

@@ -484,8 +484,8 @@ class FusedMultiTransformerEngine:
                 body, (tok, caches, lens0), jnp.arange(n))
             return toks, caches_f  # toks [n, B]
 
-        def paged_step(w, caches, toks, qlens, tables, lens, rwork, rpack,
-                       temp, topp, key):
+        def paged_step(w, caches, toks, qlens, sel, tables, lens, rwork,
+                       rpack, temp, topp, key):
             """One continuous-batching step over the PAGED cache: toks
             [B, C] is each slot's token slab for this step — decode
             slots carry one token in column 0, prefill slots up to C
@@ -499,8 +499,17 @@ class FusedMultiTransformerEngine:
             advance in this ONE compiled program; the bucketed
             (work-list length, chunk-width) pair is the only shape that
             varies step to step, so the program count stays
-            O(log max_blocks * log chunk). Each slot samples from its
-            LAST VALID position (the chunk's final token)."""
+            O(log max_blocks * log chunk). Samples only the positions
+            the caller will read — `sel` [B, W] holds per-slot slab
+            column indices (the chunk-final position for prefill slots,
+            the whole 1+K drafted span for speculative verification:
+            column j's sample is the model's next-token choice after
+            slab column j, exactly what greedy acceptance compares
+            drafts against) — and returns [B, W] tokens. W is bounded
+            by 1 + spec_k, NOT the chunk width, so a 256-token prefill
+            chunk still pays for one lm_head position per slot.
+            Padding columns of sel repeat a valid index; their samples
+            are computed and ignored."""
             h = w["embedding"][toks]             # [B, C, E]
             from ..core.tensor import Tensor
             cts = [Tensor(c) for c in caches]
@@ -512,17 +521,33 @@ class FusedMultiTransformerEngine:
                 block_tables=tables, ragged_work=rwork,
                 ragged_pack=rpack, **kw)
             bidx = jnp.arange(out.data.shape[0])
-            last = jnp.maximum(qlens - 1, 0)
-            logits = out.data[bidx, last] @ w["lm_head"]
+            picked = out.data[bidx[:, None], sel]        # [B, W, E]
+            logits = picked @ w["lm_head"]               # [B, W, V]
             return select(logits, temp, topp, key), [c.data for c in cts]
+
+        def paged_rewind(caches, tables, new_lens, old_lens, span):
+            """Roll every layer's paged cache back from old_lens to
+            new_lens (zero the rejected speculative span) in ONE jitted
+            program; `span` is static, the serving engine passes its
+            bucketed slab width so the compile keys stay on the same
+            O(log chunk) treadmill as the step itself."""
+            from ..ops.pallas.paged_attention import truncate_paged_kv_cache
+            out = []
+            for c in caches:
+                kc, vc = truncate_paged_kv_cache(
+                    c[0], c[1], tables, new_lens, old_lens, span)
+                out.append(jnp.stack([kc, vc]))
+            return out
 
         import jax
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
         self._step = jax.jit(step, donate_argnums=(1,))
         self._steps = jax.jit(steps, static_argnums=(4,),
                               donate_argnums=(1,))
-        self._paged_step = jax.jit(paged_step, static_argnums=(7,),
+        self._paged_step = jax.jit(paged_step, static_argnums=(8,),
                                    donate_argnums=(1,))
+        self._paged_rewind = jax.jit(paged_rewind, static_argnums=(4,),
+                                     donate_argnums=(0,))
 
     def _build_quant_mm(self, weights, dtype):
         """Repack the projection weights into the Pallas kernel's int4

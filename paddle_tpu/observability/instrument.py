@@ -20,6 +20,8 @@ __all__ = [
     "serve_inflight", "serve_queue_depth", "serve_tokens_per_s",
     "kv_blocks_free", "kv_blocks_used", "kv_blocks_high_water",
     "kv_alloc_failures", "serve_bucket_recompiles",
+    "spec_draft_tokens", "spec_accepted_tokens", "spec_accept_len",
+    "serve_effective_tokens_per_step", "serve_prefill_chunk",
     "train_step_seconds", "train_tokens_total", "train_steps_total",
     "train_tokens_per_s",
 ]
@@ -104,6 +106,48 @@ def serve_bucket_recompiles():
         "serve_bucket_recompiles_total",
         help="first sighting of a padded work-list length (keys one "
              "XLA compile of the decode step)", labels=("bucket",))
+
+
+# -- speculative decode (prompt-lookup drafts + budgeted verify) ---------
+
+def spec_draft_tokens():
+    return get_registry().counter(
+        "spec_draft_tokens_total",
+        help="prompt-lookup draft tokens handed to the verifier")
+
+
+def spec_accepted_tokens():
+    return get_registry().counter(
+        "spec_accepted_tokens_total",
+        help="draft tokens accepted by greedy verification "
+             "(rate vs spec_draft_tokens_total = acceptance rate)")
+
+
+def spec_accept_len(max_len=8):
+    # acceptance lengths are small ints (0..spec_k); linear buckets so
+    # the histogram reads as a per-length distribution, not latency.
+    # The serving engine pins the bucket range at construction by
+    # calling this with its spec_k (buckets bind on FIRST creation;
+    # later calls return the existing family) — a spec_k=16 engine gets
+    # distinguishable 9..16 lengths instead of one +Inf blob
+    return get_registry().histogram(
+        "serve_spec_accept_len",
+        help="accepted-prefix length per verified draft span",
+        buckets=tuple(float(i) for i in range(int(max_len) + 1)))
+
+
+def serve_effective_tokens_per_step():
+    return get_registry().gauge(
+        "serve_effective_tokens_per_step",
+        help="tokens emitted by the last compiled step (speculation "
+             "pushes this above the decode-slot count)")
+
+
+def serve_prefill_chunk():
+    return get_registry().gauge(
+        "serve_prefill_chunk",
+        help="current prefill chunk size (the TPOT-SLO controller "
+             "shrinks it one pow2 bucket when decode latency degrades)")
 
 
 # -- training (pretrain loop) --------------------------------------------

@@ -31,7 +31,11 @@ Two kernels:
   Each work entry carries its sequence's QUERY SPAN (q_start, q_len):
   decode sequences span one token, prefill sequences a chunk of up to C
   prompt tokens — so one kernel invocation serves a MIXED prefill+decode
-  batch, the Sarathi-style chunked-prefill step. The packed tile grows
+  batch, the Sarathi-style chunked-prefill step. Speculative decode rides
+  the same span: a decode sequence verifying K prompt-lookup drafts asks
+  for a 1+K span (its last real token plus the drafts), pays ONE kernel
+  invocation for all K+1 positions, and the host rolls rejected suffixes
+  back with `truncate_paged_kv_cache`. The packed tile grows
   to [pack*C*G, D] (C query positions per sequence) and each query row
   is causally masked to its own absolute position, so a 512-token prompt
   costs ceil(512/C) steps at C-row MXU intensity instead of 512 steps
@@ -586,6 +590,44 @@ def update_paged_kv_cache(k_cache, v_cache, k_new, v_new, block_tables,
             new[bidx[:, None], hidx[None, :]], mode="drop")
 
     return upd(k_cache, k_new), upd(v_cache, v_new)
+
+
+def truncate_paged_kv_cache(k_cache, v_cache, block_tables, new_lens,
+                            old_lens, max_span):
+    """Rewind a paged cache: ZERO positions new_lens[b] .. old_lens[b]-1
+    of every sequence — the KV a rejected speculative draft span left
+    behind. `max_span` (static python int) bounds old_lens - new_lens, so
+    the scatter keeps a jit-compatible static shape; rows where
+    new_lens == old_lens are a no-op. Returns the updated caches; pure
+    scatter, in-place under jit when the caches are donated.
+
+    Zeroing (rather than just rolling the host length back) keeps the
+    strong invariant the serving tests lean on: a speculated-then-rewound
+    cache is BIT-IDENTICAL to one that never speculated, so token-exact
+    claims never rest on overwrite-before-attend reasoning.
+
+    Boundary contract (same family as `update_paged_kv_cache_chunk`):
+    positions past the span, past old_lens, or at/after the table
+    capacity are DROPPED, never aliased through a clamped gather."""
+    kvh, nb, bs, d = k_cache.shape
+    b = block_tables.shape[0]
+    max_nb = block_tables.shape[1]
+    span = int(max_span)
+    pos = new_lens.reshape(-1, 1) + jnp.arange(span)[None, :]     # [B, S]
+    valid = (pos < old_lens.reshape(-1, 1)) & (pos < max_nb * bs)
+    blk_col = jnp.minimum(pos // bs, max_nb - 1)    # clamp the table read
+    blk_ids = jnp.take_along_axis(block_tables, blk_col, axis=1)  # [B, S]
+    # scatter mode="drop": invalid rows aim past the cache and vanish
+    blk_ids = jnp.where(valid, blk_ids, nb)
+    offs = pos % bs                                               # [B, S]
+
+    def upd(cache):
+        hidx = jnp.arange(kvh)
+        zeros = jnp.zeros((b, span, kvh, d), cache.dtype)
+        return cache.at[hidx[None, None, :], blk_ids[:, :, None],
+                        offs[:, :, None]].set(zeros, mode="drop")
+
+    return upd(k_cache), upd(v_cache)
 
 
 def update_paged_kv_cache_chunk(k_cache, v_cache, k_new, v_new,

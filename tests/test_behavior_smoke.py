@@ -21,6 +21,12 @@ import pytest
 
 import paddle_tpu as paddle
 
+# ~60s of signature-driven surface sweeping: the next-heaviest candidate
+# BASELINE.md "Tier-1 timing split" named for the slow marker if the
+# window tightened again — ISSUE 5's serving tests tightened it. Run
+# with `pytest -m slow` alongside the other heavy integration files.
+pytestmark = pytest.mark.slow
+
 REF = "/root/reference/python/paddle/"
 
 # rows that are stubs BY DESIGN on TPU (documented in README/PARITY):

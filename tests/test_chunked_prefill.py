@@ -189,7 +189,18 @@ class TestChunkedKernel:
         np.testing.assert_array_equal(vc2, vc_exp)
 
 
+_ENGINE_CACHE = {}
+
+
 def _tiny_engine(seed=0, max_seq_len=32):
+    # cached per (seed, max_seq_len): the engine is read-only for the
+    # serving tests (weights fixed, jit caches instance-held), and
+    # rebuilding it per test recompiles every step program — the single
+    # biggest cost of this file (and of test_speculative_decode, which
+    # imports this builder) under CPU interpret mode
+    key = (seed, max_seq_len)
+    if key in _ENGINE_CACHE:
+        return _ENGINE_CACHE[key]
     from paddle_tpu.inference import FusedMultiTransformerEngine
     rng = np.random.default_rng(seed)
     V, E, H, G, D, L, F = 128, 64, 4, 2, 16, 2, 96
@@ -209,6 +220,7 @@ def _tiny_engine(seed=0, max_seq_len=32):
         w, num_heads=H, head_dim=D, max_seq_len=max_seq_len,
         dtype="float32", norm_type="rmsnorm", activation="swiglu",
         gqa_group_size=G)
+    _ENGINE_CACHE[key] = (eng, V)
     return eng, V
 
 
@@ -352,8 +364,9 @@ class TestTokenBudgetScheduler:
         cb.submit(GenerationRequest(rng.integers(1, V, 11), 2))
         cb.step()   # admit both; slot 0 finishes its prompt, slot 1 mid
         assert cb.slots[0].progress == 2 and cb.slots[1].progress == 4
-        q_lens = cb._schedule_tokens([0, 1])
+        q_lens, drafts = cb._schedule_tokens([0, 1])
         assert q_lens.tolist() == [1, 4]    # decode + prompt chunk
+        assert drafts == {}                 # speculation off by default
         attn = (cb.lens + q_lens).astype(np.int32)
         work = pa.build_ragged_work(cb.tables, attn, cb.block_size,
                                     cb._pack, q_lens=q_lens)

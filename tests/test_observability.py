@@ -217,25 +217,13 @@ def test_fleet_metrics_publish_to_registry():
 # -- serving engine --------------------------------------------------------
 
 def _tiny_engine(seed=0):
-    from paddle_tpu.inference import FusedMultiTransformerEngine
-    rng = np.random.default_rng(seed)
-    V, E, H, G, D, L, F = 128, 64, 4, 2, 16, 2, 96
-
-    def mk(*shape, scale=0.05):
-        return (rng.standard_normal(shape) * scale).astype(np.float32)
-
-    w = dict(
-        ln_scales=[np.ones(E, np.float32) for _ in range(L)],
-        qkv_weights=[mk(H + 2 * G, D, E) for _ in range(L)],
-        linear_weights=[mk(H * D, E) for _ in range(L)],
-        ffn_ln_scales=[np.ones(E, np.float32) for _ in range(L)],
-        ffn1_weights=[mk(E, 2 * F) for _ in range(L)],
-        ffn2_weights=[mk(F, E) for _ in range(L)],
-        embedding=mk(V, E), lm_head=mk(E, V))
-    eng = FusedMultiTransformerEngine(
-        w, num_heads=H, head_dim=D, max_seq_len=32, dtype="float32",
-        norm_type="rmsnorm", activation="swiglu", gqa_group_size=G)
-    return eng, V
+    # delegate to the CACHED builder in test_chunked_prefill (identical
+    # weights/config for a given seed): the serving test files share one
+    # engine and one set of compiled step programs instead of paying the
+    # interpret-mode compile bill per file (tier-1 window, BASELINE.md
+    # "Tier-1 timing split" ISSUE 5 update)
+    from test_chunked_prefill import _tiny_engine as _cached
+    return _cached(seed=seed, max_seq_len=32)
 
 
 @pytest.fixture(autouse=True)

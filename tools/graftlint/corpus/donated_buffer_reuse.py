@@ -1,0 +1,35 @@
+# graftlint-corpus-expect: GL107 GL107
+"""Reconstruction of the donated-buffer hazard GL107 hunts: an argument
+listed in donate_argnums is handed to XLA at the call — reading it
+afterwards raises "Array has been deleted" on some platforms and serves
+stale bytes on others. Two dead reads below; the rebind idiom
+(`params, opt = train_step(params, opt)`) and the decorator-donating
+path that rebinds must both stay clean (false-positive tripwires)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+train_step = jax.jit(lambda params, opt: (params, opt),
+                     donate_argnums=(1,))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def scale_state(state, factor):
+    return state * factor
+
+
+def bad_reads_after_donation(params, opt_state):
+    new_params, new_opt = train_step(params, opt_state)
+    stale = opt_state * 2        # GL107: opt_state's buffer is gone
+    return new_params, stale, opt_state   # GL107: and again
+
+
+def good_rebind(params, opt_state):
+    params, opt_state = train_step(params, opt_state)
+    return params, opt_state     # rebound by the call statement: clean
+
+
+def good_decorated(state):
+    state = scale_state(state, jnp.float32(2.0))
+    return state + 1             # rebound: clean

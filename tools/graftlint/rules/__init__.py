@@ -4,12 +4,15 @@ Codes are grouped by family:
   GL1xx  trace safety       (imports that must route through compat,
                              host ops inside jitted functions)
   GL106  MXU dot hygiene    (preferred_element_type on every MXU dot)
+  GL107  buffer donation    (reads of donate_argnums arguments after
+                             the jitted call)
   GL2xx  shard_map hygiene  (partial-auto call shapes)
   GL3xx  Pallas bounds      (unclamped dynamic indexing, tile shapes)
   GL4xx  repo hygiene       (bare except, mutable defaults, import-time env)
 """
 from . import trace_safety    # noqa: F401
 from . import mxu             # noqa: F401
+from . import donation        # noqa: F401
 from . import shard_map_hygiene  # noqa: F401
 from . import pallas_bounds   # noqa: F401
 from . import hygiene         # noqa: F401

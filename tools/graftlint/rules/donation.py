@@ -1,0 +1,146 @@
+"""Buffer-donation rules (GL107) — the ROADMAP candidate rule, promoted.
+
+`jax.jit(fn, donate_argnums=...)` hands the listed arguments' buffers to
+XLA: after the call returns, the donated array is DEAD — jax raises
+"Array has been deleted" on some platforms and silently serves stale
+bytes through a copy on others, so the bug class ships as a
+platform-dependent heisencrash. The serving engines donate their KV
+caches on every compiled step (inference/__init__.py), and the
+speculative-decode rewind donates them again — every new donated call
+site is a fresh chance to read a dead buffer.
+
+The rule is lexical one-step analysis, on purpose (linter, not an
+abstract interpreter): it sees a jit binding with a LITERAL
+donate_argnums in the same file — an assignment (`step = jax.jit(fn,
+donate_argnums=(1,))`, incl. `self._step = ...`) or a decorator
+(`@partial(jax.jit, donate_argnums=(0,))`) — then flags any read of a
+donated call argument on a line after the call and before that name is
+rebound. Rebinding in the call statement itself (`caches = step(w,
+caches)` — the idiom every engine in this repo uses) is clean by
+construction. Loops that read before a later-iteration call are out of
+scope, as are donations whose argnums are computed values.
+"""
+import ast
+
+from ..core import rule
+from .trace_safety import _attr_chain, _is_jitish
+
+
+def _donated_positions(call):
+    """Literal donate_argnums of a jit(...) call, or None."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = set()
+            for e in v.elts:
+                if not (isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)):
+                    return None       # computed element: out of scope
+                out.add(e.value)
+            return out
+        return None                   # computed argnums: out of scope
+    return None
+
+
+def _target_chain(node):
+    """Dotted chain for Name/Attribute assignment targets / call funcs."""
+    return _attr_chain(node) if isinstance(node, (ast.Attribute,
+                                                  ast.Name)) else ""
+
+
+def _donating_bindings(ctx):
+    """{dotted name: donated positions} for every jit-with-donation
+    binding visible in this file."""
+    out = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and _is_jitish(node.value.func):
+            pos = _donated_positions(node.value)
+            if pos:
+                for t in node.targets:
+                    chain = _target_chain(t)
+                    if chain:
+                        out[chain] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # @partial(jax.jit, donate_argnums=...) / @jax.jit(...) on a def
+            for d in node.decorator_list:
+                if isinstance(d, ast.Call) and _is_jitish(d):
+                    pos = _donated_positions(d)
+                    if pos:
+                        out[node.name] = pos
+    return out
+
+
+def _enclosing_stmt(ctx, node):
+    """The statement node containing `node` (climbs to a body member)."""
+    cur = node
+    while True:
+        parent = ctx.parent(cur)
+        if parent is None:
+            return cur
+        if isinstance(parent, (ast.Module, ast.FunctionDef,
+                               ast.AsyncFunctionDef, ast.If, ast.For,
+                               ast.While, ast.With, ast.Try,
+                               ast.ClassDef)):
+            return cur
+        cur = parent
+
+
+@rule("GL107", "donated-buffer-reuse", "donation")
+def donated_buffer_reuse(ctx):
+    """Read of an argument listed in a jit call's donate_argnums after
+    the jitted call: the buffer was handed to XLA and is dead."""
+    bindings = _donating_bindings(ctx)
+    if not bindings:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _target_chain(node.func)
+        donated = bindings.get(chain)
+        if not donated:
+            continue
+        stmt = _enclosing_stmt(ctx, node)
+        scope_chain = ctx.enclosing_functions(node)
+        scope = scope_chain[0] if scope_chain else ctx.tree
+        end = getattr(stmt, "end_lineno", stmt.lineno)
+        for p in donated:
+            if p >= len(node.args):
+                continue
+            arg_chain = _target_chain(node.args[p])
+            if not arg_chain:
+                continue
+            # first rebind at/after the call statement kills the taint
+            # (the call statement's own Store — `caches = step(w,
+            # caches)` — counts: that IS the safe idiom); any Load of
+            # the donated name before a rebind is a dead-buffer read
+            rebind_line = None
+            for n in ast.walk(scope):
+                if isinstance(n, (ast.Name, ast.Attribute)) \
+                        and isinstance(getattr(n, "ctx", None), ast.Store) \
+                        and _target_chain(n) == arg_chain \
+                        and n.lineno >= stmt.lineno:
+                    if rebind_line is None or n.lineno < rebind_line:
+                        rebind_line = n.lineno
+            for n in ast.walk(scope):
+                if not isinstance(n, (ast.Name, ast.Attribute)):
+                    continue
+                if not isinstance(getattr(n, "ctx", None), ast.Load):
+                    continue
+                if _target_chain(n) != arg_chain or n.lineno <= end:
+                    continue
+                if rebind_line is not None and n.lineno >= rebind_line:
+                    continue
+                yield ctx.finding(
+                    "GL107", n,
+                    f"`{arg_chain}` was DONATED to `{chain}` (line "
+                    f"{node.lineno}, donate_argnums position {p}): its "
+                    "buffer now belongs to XLA — reading it here raises "
+                    "\"Array has been deleted\" on some platforms and "
+                    "serves stale bytes on others. Use the jitted "
+                    "call's RESULT (rebind the name, the engine idiom: "
+                    "`caches = step(w, caches)`)"), n

@@ -21,7 +21,8 @@ from . import rules  # noqa: F401
 
 _EXPECT_RE = re.compile(r"#\s*graftlint-corpus-expect:\s*(.+)")
 
-FAMILIES = ("trace-safety", "mxu", "shard-map", "pallas-bounds", "hygiene")
+FAMILIES = ("trace-safety", "mxu", "donation", "shard-map",
+            "pallas-bounds", "hygiene")
 
 
 def corpus_expectations(path):

@@ -418,16 +418,140 @@ def prefill_leg(chunk=64, prompt_lens=(64, 256, 512), block_size=64):
     return out
 
 
+def spec_leg(spec_k=4, new_tokens=24, include_spec=True):
+    """Speculative vs decode-1 continuous batching on a REPETITIVE
+    workload (the prompt-lookup sweet spot: repeated n-grams + the
+    self-repeating loops greedy decoding falls into). Both runs must be
+    token-exact; the speculative one must finish in FEWER compiled
+    steps. Steps, draft/accept counts, and the after-warmup bucket
+    delta are host-deterministic (greedy fp32) and gate in --check;
+    wall time is not measured at all — off-TPU it would time the Pallas
+    interpreter."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                        GenerationRequest)
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        fa._INTERPRET = True
+    rng = np.random.default_rng(0)
+    eng, V = _tiny_cpu_engine(rng, max_seq_len=128)
+    pattern = [7, 23, 41, 11]
+    prompts = [np.asarray(pattern * 8, np.int32),      # 32 tokens
+               np.asarray(pattern * 4, np.int32)]      # 16 tokens
+
+    def run(k):
+        cb = ContinuousBatchingEngine(eng, num_blocks=24, block_size=8,
+                                      max_batch=2, prefill_chunk=8,
+                                      spec_k=k)
+        def submit():
+            reqs = [GenerationRequest(p.copy(), new_tokens)
+                    for p in prompts]
+            for r in reqs:
+                cb.submit(r)
+            return reqs
+        reqs = submit()
+        out = cb.run()
+        steps = cb._step_count
+        warm = set(cb._seen_buckets)
+        reqs2 = submit()                # same workload again: warm replay
+        out2 = cb.run()
+        return {
+            "steps": steps,
+            "tokens": sum(len(out[r.request_id]) for r in reqs),
+            "drafted": sum(r.spec_drafted for r in reqs),
+            "accepted": sum(r.spec_accepted for r in reqs),
+            "new_buckets_after_warmup": len(set(cb._seen_buckets) - warm),
+            "outputs": [out[r.request_id] for r in reqs],
+        }
+
+    s_off = run(0)
+    if not include_spec:
+        # --no-spec: just the decode-1 reference side
+        out = {
+            "interpret": not on_tpu,
+            "prompt_lens": [len(p) for p in prompts],
+            "new_tokens": new_tokens,
+            "tokens_per_run": s_off["tokens"],
+            "steps_nospec": s_off["steps"],
+            "steps_per_token_nospec": round(
+                s_off["steps"] / s_off["tokens"], 4),
+        }
+        print(f"no-spec: {out['steps_nospec']} decode-1 steps for "
+              f"{out['tokens_per_run']} tokens "
+              f"({out['steps_per_token_nospec']} steps/token)")
+        return out
+    s_on = run(spec_k)
+    assert s_on["outputs"] == s_off["outputs"], \
+        "speculative decoding is not token-exact vs decode-1"
+    out = {
+        "interpret": not on_tpu,
+        "spec_k": spec_k,
+        "prompt_lens": [len(p) for p in prompts],
+        "new_tokens": new_tokens,
+        "tokens_per_run": s_on["tokens"],
+        "steps_spec": s_on["steps"],
+        "steps_nospec": s_off["steps"],
+        "steps_per_token_spec": round(s_on["steps"] / s_on["tokens"], 4),
+        "steps_per_token_nospec": round(s_off["steps"] / s_off["tokens"],
+                                        4),
+        "drafted": s_on["drafted"],
+        "accepted": s_on["accepted"],
+        "accept_rate": round(s_on["accepted"] / s_on["drafted"], 4)
+        if s_on["drafted"] else 0.0,
+        "new_buckets_after_warmup": s_on["new_buckets_after_warmup"],
+    }
+    print(f"spec[k={spec_k}]: {out['steps_spec']} steps vs "
+          f"{out['steps_nospec']} decode-1 for {out['tokens_per_run']} "
+          f"tokens ({out['steps_per_token_spec']} vs "
+          f"{out['steps_per_token_nospec']} steps/token); acceptance "
+          f"{out['accepted']}/{out['drafted']} = "
+          f"{out['accept_rate']:.0%}; "
+          f"{out['new_buckets_after_warmup']} new buckets after warmup")
+    return out
+
+
 GRID_KEYS = ("total_kv_blocks", "work_items", "legacy_grid_steps",
              "ragged_grid_steps", "pack", "context_lens")
 
+SPEC_KEYS = ("spec_k", "prompt_lens", "new_tokens", "tokens_per_run",
+             "steps_spec", "steps_nospec", "drafted", "accepted",
+             "new_buckets_after_warmup")
 
-def check_ragged(baseline_path):
+
+def check_spec(base):
+    """CI gate for the speculative leg: the host-deterministic counts
+    must match the committed baseline, speculation must pay (strictly
+    fewer steps than decode-1), and warmup must cover every compile
+    bucket (zero recompiles on replay with speculation ON)."""
+    cur = spec_leg()
+    bad = [k for k in SPEC_KEYS if cur[k] != base[k]]
+    for k in bad:
+        print(f"MISMATCH {k}: current {cur[k]!r} != baseline {base[k]!r}")
+    if cur["steps_spec"] >= cur["steps_nospec"]:
+        print(f"REGRESSION: speculative steps ({cur['steps_spec']}) not "
+              f"below decode-1 ({cur['steps_nospec']})")
+        bad.append("steps_spec")
+    if cur["new_buckets_after_warmup"] != 0:
+        print("REGRESSION: speculation compiled "
+              f"{cur['new_buckets_after_warmup']} fresh buckets after "
+              "warmup")
+        bad.append("new_buckets_after_warmup")
+    if bad:
+        return 1
+    print(f"spec leg OK: {cur['steps_spec']} steps vs decode-1's "
+          f"{cur['steps_nospec']} for {cur['tokens_per_run']} tokens, "
+          f"acceptance {cur['accepted']}/{cur['drafted']}")
+    return 0
+
+
+def check_ragged(base):
     """CI gate: the ragged leg's grid-step accounting must match the
     committed baseline exactly (these are host-deterministic), and the
     ragged grid must stay strictly below the legacy B x max_blocks one."""
-    with open(baseline_path) as f:
-        base = json.load(f)["ragged"]
     cur = ragged_leg(iters=1)
     bad = [k for k in GRID_KEYS if cur[k] != base[k]]
     for k in bad:
@@ -454,8 +578,18 @@ def main():
                     help="run only the ragged-vs-legacy paged leg "
                          "(works on CPU via interpret mode)")
     ap.add_argument("--check", metavar="BASELINE_JSON", default=None,
-                    help="gate the ragged leg against a committed "
-                         "baseline (grid-step accounting must match)")
+                    help="gate against a committed baseline — runs the "
+                         "legs the file carries: 'ragged' (grid-step "
+                         "accounting) and/or 'spec' (speculative steps/"
+                         "token + acceptance + zero-recompile)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative vs decode-1 steps-per-token + "
+                         "acceptance rate on a repetitive workload "
+                         "(works on CPU via interpret mode)")
+    ap.add_argument("--no-spec", action="store_true",
+                    help="run only the decode-1 reference side of the "
+                         "--spec workload (steps-per-token without "
+                         "speculation)")
     ap.add_argument("--metrics", action="store_true",
                     help="drive the continuous-batching engine with the "
                          "observability layer on and report p50/p95/p99 "
@@ -471,12 +605,30 @@ def main():
     args = ap.parse_args()
     import jax
     if args.check:
-        return check_ragged(args.check)
-    if args.ragged or args.metrics or args.prefill:
+        with open(args.check) as f:
+            base = json.load(f)
+        rc = 0
+        ran = False
+        if "ragged" in base:
+            ran = True
+            rc |= check_ragged(base["ragged"])
+        if "spec" in base:
+            ran = True
+            rc |= check_spec(base["spec"])
+        if not ran:
+            print(f"{args.check}: no 'ragged' or 'spec' section to gate")
+            return 1
+        return rc
+    if args.ragged or args.metrics or args.prefill or args.spec \
+            or args.no_spec:
         out = {}
         if args.ragged:
             out["ragged"] = ragged_leg()
             print(json.dumps(out["ragged"], indent=1))
+        if args.spec:
+            out["spec"] = spec_leg()
+        elif args.no_spec:
+            out["no_spec"] = spec_leg(include_spec=False)
         if args.metrics:
             sm = serving_metrics_leg()
             # percentiles live at top level (the committed baseline's
