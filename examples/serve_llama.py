@@ -50,6 +50,20 @@ def run_continuous(engine, rng, V, args):
     for r, (p, n) in zip(reqs, lengths):
         print(f"  req {r.request_id} (prompt {p:2d}, max_new {n:2d}): "
               f"{out[r.request_id][:8]}")
+    if args.trace:
+        from paddle_tpu.observability import tracing
+        path = tracing.write_dump(args.trace, reason="serve_llama",
+                                  requests=len(reqs))
+        print(f"  trace dump -> {path} "
+              "(replay: python tools/request_trace.py " + args.trace + ")")
+        for r in reqs:
+            ex = cb.explain(r.request_id)
+            print(f"  req {r.request_id}: queue_wait "
+                  f"{ex['queue_wait_s'] * 1e3:.2f} ms, ttft "
+                  f"{ex['ttft_s'] * 1e3:.1f} ms, "
+                  f"{len(ex['prefill_chunks'])} prefill chunks, "
+                  f"{ex['decode_steps']} decode steps, "
+                  f"stalls {sum(ex['stalls'].values())}")
 
 
 def main():
@@ -71,6 +85,10 @@ def main():
                     help="speculative decode: up to K prompt-lookup "
                          "draft tokens per decode slot per step "
                          "(greedy only; 0 disables)")
+    ap.add_argument("--trace", metavar="OUT.json", default=None,
+                    help="(--continuous only) dump per-request lifecycle "
+                         "spans + metrics after the run; replay with "
+                         "tools/request_trace.py")
     args = ap.parse_args()
 
     rng = np.random.default_rng(0)

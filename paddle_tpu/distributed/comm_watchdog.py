@@ -18,6 +18,7 @@ import threading
 import time
 
 from ..observability import get_registry as _registry
+from ..observability import tracing as _tracing
 
 log = logging.getLogger("paddle_tpu.distributed.watchdog")
 
@@ -137,6 +138,14 @@ class CommTaskManager:
                 counter = _stall_counter()
                 for t in hung:
                     counter.labels(op=t.op).inc()
+                # the flight recorder captures what the SERVING/TRAINING
+                # side was doing while the collective hung — the span
+                # window plus a metrics snapshot, complementing the
+                # watchdog's own task-table dump below
+                _tracing.get_flight_recorder().trigger(
+                    "comm_watchdog_stall",
+                    ops=",".join(sorted({t.op for t in hung})),
+                    hung=len(hung), timeout_s=self.timeout)
                 self._dump(hung)
 
     def _dump(self, hung):

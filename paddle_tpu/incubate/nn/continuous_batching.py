@@ -41,6 +41,7 @@ import time
 import numpy as np
 
 from ...observability import instrument as _metrics
+from ...observability import tracing as _tracing
 from ...ops.pallas.paged_attention import (build_ragged_work, default_pack,
                                            next_pow2)
 
@@ -154,6 +155,9 @@ class GenerationRequest:
         self.admit_time = None
         self.first_token_time = None
         self._last_token_time = None
+        # span timebase (perf_counter — the tracing/profiler clock, a
+        # DIFFERENT epoch from time.monotonic above)
+        self._submit_pc = None
 
     @property
     def done(self):
@@ -274,6 +278,10 @@ class ContinuousBatchingEngine:
         # forbids in steady state. Counted per bucket so a test (and a
         # dashboard) can assert the counter stays flat.
         self._seen_buckets = set()
+        # declare_warm() flips this: a fresh bucket AFTER that is the
+        # anomaly the flight recorder dumps on (admission recompiled)
+        self._warm = False
+        self._sched_info = {}
         kvh = self.caches[0].shape[1]
         num_q = engine.num_heads
         self._pack = default_pack(self.max_batch, num_q // kvh)
@@ -302,9 +310,13 @@ class ContinuousBatchingEngine:
         if rid in self._ids or rid in self.finished:
             raise ValueError(f"duplicate request_id {rid}")
         request.submit_time = time.monotonic()
+        request._submit_pc = time.perf_counter()
         self.queue.append(request)
         self._ids.add(rid)
         _metrics.serve_queue_depth().set(len(self.queue))
+        _tracing.get_tracer().event(
+            "submit", request=rid, prompt_tokens=len(request.prompt),
+            max_new_tokens=request.max_new_tokens)
 
     @property
     def num_active(self):
@@ -322,6 +334,11 @@ class ContinuousBatchingEngine:
                 self.finished[req.request_id] = list(req.generated)
                 self._ids.discard(req.request_id)
                 retired += 1
+                _tracing.get_tracer().event(
+                    "retire", request=req.request_id,
+                    generated=len(req.generated),
+                    spec_drafted=req.spec_drafted,
+                    spec_accepted=req.spec_accepted)
         if retired:
             _metrics.serve_requests_total().inc(retired)
             self._update_pool_gauges()
@@ -346,6 +363,13 @@ class ContinuousBatchingEngine:
                 continue
             need = self.queue[0].blocks_needed(self.block_size)
             if reserved + need > self.allocator.num_free:
+                # KV starvation: the head request is blocked on pool
+                # capacity, not on a free slot — the queue-wait outlier
+                # the flight recorder's timeline should explain
+                _tracing.get_tracer().event(
+                    "admit_blocked", request=self.queue[0].request_id,
+                    blocks_needed=need, blocks_reserved=reserved,
+                    blocks_free=self.allocator.num_free)
                 break
             req = self.queue.popleft()
             reserved += need
@@ -358,6 +382,12 @@ class ContinuousBatchingEngine:
             if req.submit_time is not None:
                 _metrics.serve_queue_wait().observe(
                     req.admit_time - req.submit_time)
+            adm_pc = time.perf_counter()
+            start_pc = req._submit_pc if req._submit_pc is not None \
+                else adm_pc
+            _tracing.get_tracer().record_span(
+                "queue_wait", start_pc * 1e6, (adm_pc - start_pc) * 1e6,
+                request=req.request_id, blocks_reserved=need)
             self.slots[i] = req
             self.tables[i] = 0
             self.lens[i] = 0
@@ -390,6 +420,7 @@ class ContinuousBatchingEngine:
                 used += 1
                 decode_slots.append(i)
         budget = self.token_budget
+        self._sched_info = {}   # prefill slot -> (requested, granted)
         for i in active:
             req = self.slots[i]
             rem = len(req.prompt) - req.progress
@@ -399,6 +430,9 @@ class ContinuousBatchingEngine:
             take = min(self.prefill_chunk, room)
             q_lens[i] = take
             used += take
+            # requested = what an unthrottled budget would have granted;
+            # the delta IS budget starvation, span-visible per chunk
+            self._sched_info[i] = (min(self.prefill_chunk, rem), take)
         if self.spec_k:
             for i in decode_slots:
                 req = self.slots[i]
@@ -425,6 +459,8 @@ class ContinuousBatchingEngine:
         import jax
 
         t_begin = time.monotonic()
+        pc_begin = time.perf_counter()
+        tr = _tracing.get_tracer()
         self._retire()
         self._admit()
         active = [i for i, r in enumerate(self.slots) if r is not None]
@@ -436,13 +472,26 @@ class ContinuousBatchingEngine:
             # grow the block list to cover every token this step appends
             # (a prompt chunk may cross several block boundaries);
             # admission reserved the worst-case footprint, so alloc()
-            # cannot fail here
+            # cannot fail here — if it DOES (a reservation bug, an
+            # injected fault), that is exactly the anomaly the flight
+            # recorder exists for: dump the timeline, then re-raise
             req = self.slots[i]
             end = int(self.lens[i] + q_lens[i])
-            while len(req.blocks) * self.block_size < end:
-                blk = self.allocator.alloc()
-                req.blocks.append(blk)
-                self.tables[i, len(req.blocks) - 1] = blk
+            try:
+                while len(req.blocks) * self.block_size < end:
+                    blk = self.allocator.alloc()
+                    req.blocks.append(blk)
+                    self.tables[i, len(req.blocks) - 1] = blk
+            except RuntimeError:
+                tr.event("stall_alloc", request=req.request_id,
+                         blocks_held=len(req.blocks),
+                         blocks_free=self.allocator.num_free,
+                         tokens_wanted=int(q_lens[i]))
+                _tracing.get_flight_recorder().trigger(
+                    "kv_alloc_failure", request=req.request_id,
+                    step=self._step_count,
+                    blocks_free=self.allocator.num_free)
+                raise
         # token slab [B, C]: C is the widest span this step, bucketed to
         # a power of two (1 for an all-decode step) so slab shapes — and
         # the programs they key — stay off the per-prompt-length
@@ -494,21 +543,45 @@ class ContinuousBatchingEngine:
             self._seen_buckets.add((t_total, c))
             _metrics.serve_bucket_recompiles().labels(
                 bucket=f"{t_total}x{c}").inc()
+            tr.event("bucket_compile", bucket=f"{t_total}x{c}",
+                     warm=self._warm)
+            if self._warm:
+                # post-warmup recompile: admission leaked a new shape
+                # into the compiled-step keyspace — the silent
+                # multi-second stall PR 3 made a counter, now a dump
+                _tracing.get_flight_recorder().trigger(
+                    "post_warmup_recompile", bucket=f"{t_total}x{c}",
+                    step=self._step_count)
         self._key, sub = jax.random.split(self._key)
+        pc_step = time.perf_counter()
         toks2, self.caches = self.engine._paged_step(
             self.engine._w, self.caches, slab, q_arr, sel,
             np.asarray(self.tables), np.asarray(self.lens), tuple(work),
             pack, np.float32(self._temp), np.float32(self._topp), sub)
         toks2 = np.asarray(toks2)      # [B, W]: a sample per sel column
         t_done = time.monotonic()
+        pc_done = time.perf_counter()
         emitted = 0
         rewinds = []    # (slot, new_end, old_end): rejected draft spans
+        slot_spans = []  # (slot, request_id, span name, args) this step
         for i in active:
             req = self.slots[i]
             n = int(q_lens[i])
             if n == 0:
+                if req.progress < len(req.prompt):
+                    # budget starvation: the prompt wanted a chunk and
+                    # got zero work-list entries this step
+                    tr.event("stall_budget", request=req.request_id,
+                             prompt_remaining=len(req.prompt)
+                             - req.progress,
+                             token_budget=self.token_budget)
                 continue        # starved prefill slot: stalled this step
             if req.progress < len(req.prompt):
+                requested, granted = self._sched_info.get(i, (n, n))
+                slot_spans.append((i, req.request_id, "prefill_chunk",
+                                   {"width": n, "granted": granted,
+                                    "requested": requested,
+                                    "progress": req.progress + n}))
                 self.lens[i] += n
                 req.progress += n
                 if req.progress == len(req.prompt):
@@ -533,6 +606,9 @@ class ContinuousBatchingEngine:
                     a += 1
                 self._append_span(req, span[:a + 1], t_done)
                 emitted += a + 1
+                slot_spans.append((i, req.request_id, "decode",
+                                   {"emitted": a + 1, "drafted": k,
+                                    "accepted": a}))
                 old_end = int(self.lens[i]) + n
                 new_end = int(self.lens[i]) + a + 1
                 self.lens[i] = new_end
@@ -544,6 +620,7 @@ class ContinuousBatchingEngine:
                     _metrics.spec_accept_len().observe(a)
                 if new_end < old_end:
                     rewinds.append((i, new_end, old_end))
+        blocks_freed = {}
         if rewinds:
             # device-side zeroing FIRST (it reads the table rows that
             # still point at the rejected positions), host block
@@ -556,10 +633,25 @@ class ContinuousBatchingEngine:
             self.caches = self.engine._paged_rewind(
                 self.caches, np.asarray(self.tables), new_l, old_l, c)
             for i, ne, _ in rewinds:
-                self._rewind_blocks(i, ne)
+                blocks_freed[i] = self._rewind_blocks(i, ne)
             self._update_pool_gauges()
-        self._step_count += 1
+        # per-request lanes: every slot's work this step as one span
+        # over the compiled-step window (the chunk widths, spec
+        # accounting, and rewind block frees ride as args) — recorded
+        # AFTER the rewind so blocks_freed is known
+        for i, rid, name, args in slot_spans:
+            if blocks_freed.get(i):
+                args["blocks_freed"] = blocks_freed[i]
+            tr.record_span(name, pc_step * 1e6,
+                           (pc_done - pc_step) * 1e6, request=rid, **args)
+        # span BEFORE the increment: its step label must match the
+        # step= the flight-recorder triggers above stamped, so a dump's
+        # context cross-references the right serve_step on the timeline
         dur = t_done - t_begin
+        tr.record_span("serve_step", pc_begin * 1e6,
+                       (pc_done - pc_begin) * 1e6, step=self._step_count,
+                       work=t_total, chunk=c, emitted=emitted)
+        self._step_count += 1
         _metrics.serve_step_seconds().observe(dur)
         if emitted:
             _metrics.serve_tokens_total().inc(emitted)
@@ -579,13 +671,16 @@ class ContinuousBatchingEngine:
         rejection hands cache capacity straight back to the pool. The
         device half (`truncate_paged_kv_cache`) already zeroed the
         rejected positions, so a freed-then-reallocated block carries no
-        stale KV."""
+        stale KV. Returns the number of blocks handed back."""
         req = self.slots[i]
         need = -(-new_end // self.block_size) if new_end > 0 else 0
+        freed = 0
         while len(req.blocks) > need:
             blk = req.blocks.pop()
             self.tables[i, len(req.blocks)] = 0
             self.allocator.free([blk])
+            freed += 1
+        return freed
 
     def _maybe_shrink_chunk(self):
         """Latency-SLO chunk controller: when the rolling mean of decode
@@ -594,15 +689,27 @@ class ContinuousBatchingEngine:
         chunks are the schedulable knob, decode-1 is mandatory. The
         window clears on every shrink so each decision sees only
         post-shrink samples (a cooldown, not a ratchet)."""
-        if self.tpot_slo is None or self.prefill_chunk <= \
-                self.min_prefill_chunk:
+        if self.tpot_slo is None:
             return
         if len(self._tpot_window) < self.SLO_WINDOW:
             return
-        if sum(self._tpot_window) / len(self._tpot_window) > self.tpot_slo:
-            self.prefill_chunk = max(self.min_prefill_chunk,
-                                     self.prefill_chunk // 2)
-            _metrics.serve_prefill_chunk().set(self.prefill_chunk)
+        mean = sum(self._tpot_window) / len(self._tpot_window)
+        if mean > self.tpot_slo:
+            # the breach itself is flight-recorder-worthy even when the
+            # controller has no chunk left to give back
+            _tracing.get_flight_recorder().trigger(
+                "tpot_slo_breach", tpot_mean_s=mean, slo_s=self.tpot_slo,
+                prefill_chunk=self.prefill_chunk)
+            if self.prefill_chunk > self.min_prefill_chunk:
+                self.prefill_chunk = max(self.min_prefill_chunk,
+                                         self.prefill_chunk // 2)
+                _metrics.serve_prefill_chunk().set(self.prefill_chunk)
+            # clear on EVERY breach, not just shrinks: each decision
+            # sees only fresh samples, and a sustained breach at
+            # min_prefill_chunk re-triggers once per full window (plus
+            # the recorder's per-reason cooldown) instead of every step
+            # — spamming flight_trigger events would evict the very
+            # request spans a dump exists to keep
             self._tpot_window.clear()
 
     def _append_token(self, req, tok, now):
@@ -614,6 +721,9 @@ class ContinuousBatchingEngine:
             req.first_token_time = now
             if req.submit_time is not None:
                 _metrics.serve_ttft().observe(now - req.submit_time)
+                _tracing.get_tracer().event(
+                    "first_token", request=req.request_id,
+                    ttft_s=now - req.submit_time)
         elif req._last_token_time is not None:
             _metrics.serve_tpot().observe(now - req._last_token_time)
         req._last_token_time = now
@@ -633,11 +743,31 @@ class ContinuousBatchingEngine:
             req.first_token_time = now
             if req.submit_time is not None:
                 _metrics.serve_ttft().observe(now - req.submit_time)
+                _tracing.get_tracer().event(
+                    "first_token", request=req.request_id,
+                    ttft_s=now - req.submit_time)
         elif req._last_token_time is not None:
             interval = now - req._last_token_time
             _metrics.serve_tpot().observe(interval / len(toks))
             self._tpot_window.append(interval)
         req._last_token_time = now
+
+    def declare_warm(self):
+        """Mark the compile-bucket warmup phase over: from here on, any
+        FIRST SIGHTING of a (work-list length, chunk width) bucket is an
+        anomaly — admission caused a recompile in steady state — and
+        fires the flight recorder (`post_warmup_recompile`). Call after
+        a representative warmup workload (the bench legs do) or once a
+        production deployment has seen its traffic shapes."""
+        self._warm = True
+
+    def explain(self, request_id):
+        """Per-request lifecycle digest from the span ring (TTFT, queue
+        wait, chunk grants, stalls, spec accept rate) — the
+        `request.explain()` view tools/request_trace.py renders from
+        flight dumps, here served live. Spans are a bounded ring: a
+        long-retired request may have aged out."""
+        return _tracing.request_summary(request_id)
 
     def run(self, max_steps=100000):
         """Drive step() until every submitted request has finished.

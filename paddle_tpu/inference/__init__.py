@@ -172,13 +172,20 @@ class Predictor:
         key = tuple((a.shape, str(a.dtype)) for a in arrays)
         if key not in self._compiled:
             params, buffers = state_arrays(self._layer)
+            # deliberate snapshot, NOT a self.* capture (GL108): the
+            # layer is the static module SKELETON — every array it owns
+            # (params AND buffers) flows through jit arguments below; a
+            # live self._layer reference inside the jitted closure
+            # would pin whatever the attribute pointed at when each
+            # shape first compiled
+            layer = self._layer
 
-            def fn(params, *xs):
-                return pure_call(self._layer, params, buffers, *xs)
+            def fn(params, buffers, *xs):
+                return pure_call(layer, params, buffers, *xs)
 
-            self._compiled[key] = (jax.jit(fn), params)
-        fn, params = self._compiled[key]
-        out = fn(params, *arrays)
+            self._compiled[key] = (jax.jit(fn), params, buffers)
+        fn, params, buffers = self._compiled[key]
+        out = fn(params, buffers, *arrays)
         outs = out if isinstance(out, (tuple, list)) else (out,)
         for i, o in enumerate(outs):
             self.get_output_handle(f"out{i}")._array = o
@@ -292,6 +299,29 @@ def _get_phi_kernel_name(op_name):
     """Kernel-name mapping probe (reference _get_phi_kernel_name); ops here
     map 1:1 to registry names."""
     return op_name
+
+
+def _dispatch_span(name, fn):
+    """Host-side span around a compiled program's dispatch (tracing.py
+    ring; perf_counter timebase). jax dispatch is async: the measured
+    interval covers trace/lower/compile (first call per bucket — which
+    is why `paged_step` spans make recompiles visible on the timeline)
+    plus enqueue, NOT device completion. The wrapper is plain host code
+    wrapping the jitted callable, so the record never runs under a
+    tracer (the GL105 contract)."""
+    import time as _time
+
+    from ..observability import tracing as _tracing
+
+    def call(*args, **kwargs):
+        t0 = _time.perf_counter()
+        out = fn(*args, **kwargs)
+        _tracing.get_tracer().record_span(
+            name, t0 * 1e6, (_time.perf_counter() - t0) * 1e6)
+        return out
+
+    call.__wrapped__ = fn
+    return call
 
 
 __all__ += ["FusedMultiTransformerEngine"]
@@ -544,10 +574,16 @@ class FusedMultiTransformerEngine:
         self._step = jax.jit(step, donate_argnums=(1,))
         self._steps = jax.jit(steps, static_argnums=(4,),
                               donate_argnums=(1,))
-        self._paged_step = jax.jit(paged_step, static_argnums=(8,),
-                                   donate_argnums=(1,))
-        self._paged_rewind = jax.jit(paged_rewind, static_argnums=(4,),
-                                     donate_argnums=(0,))
+        # serving-path programs get host-side dispatch spans: the
+        # continuous-batching engine's per-request lanes line up against
+        # these on one chrome timeline (a slow step with a fat
+        # `paged_step` span on its first bucket sighting = compile)
+        self._paged_step = _dispatch_span(
+            "paged_step", jax.jit(paged_step, static_argnums=(8,),
+                                  donate_argnums=(1,)))
+        self._paged_rewind = _dispatch_span(
+            "paged_rewind", jax.jit(paged_rewind, static_argnums=(4,),
+                                    donate_argnums=(0,)))
 
     def _build_quant_mm(self, weights, dtype):
         """Repack the projection weights into the Pallas kernel's int4

@@ -3,8 +3,11 @@
 A process-wide registry of counters, gauges, and fixed-bucket histograms
 (metrics.py), three exporters (exporters.py: Prometheus text, JSON
 snapshot, chrome-trace counter events merged into the profiler
-timeline), a jax.monitoring compile watch (compile_watch.py), and the
-standard instrument set for serving/training/dispatch (instrument.py).
+timeline), a jax.monitoring compile watch (compile_watch.py), the
+standard instrument set for serving/training/dispatch (instrument.py),
+and per-request lifecycle tracing + the anomaly flight recorder
+(tracing.py: bounded span ring, chrome per-request lanes,
+anomaly-triggered dumps of the last N seconds of spans + metrics).
 
 Contract: record calls are HOST-SIDE ONLY — never inside a jitted
 function. The runtime guard is the ``float()`` coercion in metrics.py
@@ -38,10 +41,21 @@ from .exporters import chrome_counter_events, to_json, to_prometheus
 from .compile_watch import install as install_compile_watch
 from .compile_watch import installed as compile_watch_installed
 from .instrument import watch_ops
+# NOTE: `from .tracing import ...` (not `from . import tracing`): the
+# bare-submodule form routes through the ROOT package import and would
+# break the standalone by-path load (tools/metrics_snapshot.py in a
+# bare container, no `paddle_tpu` on the path). The from-import still
+# binds the `tracing` attribute on this package.
+from .tracing import (SpanRecorder, FlightRecorder, get_tracer,
+                      get_flight_recorder, chrome_span_events,
+                      request_summary, load_dump, write_dump)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_LATENCY_BUCKETS", "exponential_buckets", "get_registry",
     "to_prometheus", "to_json", "chrome_counter_events",
     "install_compile_watch", "compile_watch_installed", "watch_ops",
+    "tracing", "SpanRecorder", "FlightRecorder", "get_tracer",
+    "get_flight_recorder", "chrome_span_events", "request_summary",
+    "load_dump", "write_dump",
 ]

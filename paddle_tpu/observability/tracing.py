@@ -1,0 +1,427 @@
+"""Per-request lifecycle tracing + anomaly flight recorder.
+
+PR 3's metrics answer "how is the fleet doing"; this module answers
+"why was THIS request slow". With chunked prefill, token budgets,
+speculative decode, and KV rewind all interleaving on one compiled
+step, a p99 outlier can be queue starvation, a budget-starved prefill,
+a spec-rejection storm, an alloc-failure stall, or a post-warmup
+recompile — aggregates cannot tell those apart; request-scoped spans
+can.
+
+Three pieces, same design constraints as metrics.py (host-side only,
+stdlib-only at import, lock-protected):
+
+* ``SpanRecorder`` — a bounded ring of spans ``(ts_us, dur_us, name,
+  request, args)``. Recording is a deque append under one lock; the
+  ring is sized so "always on" costs nothing measurable next to a
+  serving step, and old spans fall off the back instead of growing
+  memory. The same ``float()`` tracer guard as the metrics registry
+  protects every recorded value: a span recorded under a jax trace
+  raises at trace time (graftlint GL105 enforces the same contract
+  statically, now covering ``tracing.*`` too).
+* chrome export — ``chrome_span_events()`` renders the ring as
+  ``"ph": "X"`` duration events on per-request lanes; the profiler
+  merges them into its host-range + metric-counter stream so one
+  chrome://tracing view shows what every request was doing inside
+  every step.
+* ``FlightRecorder`` — the ring always runs; when an anomaly trigger
+  fires (KV alloc failure, post-warmup bucket recompile, rolling-TPOT
+  SLO breach, comm-watchdog stall) it dumps the last ``window_s``
+  seconds of spans plus a full metrics snapshot to a timestamped JSON
+  file. Disarmed by default (``arm(dir)`` opts in) and rate-limited
+  per reason, so a repeating anomaly produces evidence, not a disk
+  full of identical dumps. ``tools/request_trace.py`` replays a dump
+  as per-request timelines; ``tools/metrics_snapshot.py --selfcheck``
+  validates the schema stdlib-only.
+
+Span timebase is ``time.perf_counter()`` microseconds — the same clock
+the profiler stamps host ranges and the metrics timeline with, so all
+three streams land on one chrome timeline without skew.
+"""
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+
+from .metrics import _host_float, get_registry
+
+__all__ = [
+    "SpanRecorder", "FlightRecorder", "get_tracer", "get_flight_recorder",
+    "span", "event", "chrome_span_events", "request_summary", "load_dump",
+    "write_dump", "DUMP_SCHEMA",
+]
+
+DUMP_SCHEMA = "paddle_tpu.flight_recorder/1"
+
+# chrome tids for span lanes: far away from thread idents (host ranges)
+# and from tid 0 (metric counters) so per-request lanes group cleanly
+_LANE_TID_BASE = 1000000
+
+
+def _clean_value(v, what):
+    """Host-scalar guard for span args: strings/None pass through, bools
+    stay bools, everything else must coerce through float() — a jax
+    tracer fails that coercion, which is the runtime half of the
+    host-side-only contract (static half: graftlint GL105). Integral
+    floats come back as ints so dumps stay readable."""
+    if v is None or isinstance(v, (str, bool)):
+        return v
+    f = _host_float(v, what)
+    return int(f) if f.is_integer() else f
+
+
+class SpanRecorder:
+    """Bounded, lock-protected ring of host-side spans."""
+
+    def __init__(self, capacity=8192):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self._lock = threading.RLock()
+        self._spans = collections.deque(maxlen=self.capacity)
+        self.enabled = True
+        self.recorded_total = 0     # appends ever (ring drops the oldest)
+
+    # -- recording --------------------------------------------------------
+    def record_span(self, name, start_us, dur_us, request=None, **args):
+        """Append one span. `start_us`/`dur_us` are perf_counter
+        microseconds; `request` is the request id the span belongs to
+        (None = engine lane); `args` are small host scalars/strings."""
+        if not self.enabled:
+            return
+        what = f"span {name!r}"
+        start_us = _host_float(start_us, what)
+        dur_us = _host_float(dur_us, what)
+        if request is not None and not isinstance(request, str):
+            request = _clean_value(request, what)
+        if args:
+            args = {k: _clean_value(v, f"{what} arg {k!r}")
+                    for k, v in args.items()}
+        with self._lock:
+            self._spans.append((start_us, dur_us, str(name), request,
+                                args or None))
+            self.recorded_total += 1
+
+    def event(self, name, request=None, **args):
+        """Zero-duration instant (first token, stall, trigger, ...)."""
+        self.record_span(name, time.perf_counter() * 1e6, 0.0,
+                         request=request, **args)
+
+    @contextlib.contextmanager
+    def span(self, name, request=None, **args):
+        """Context manager measuring the enclosed host interval."""
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            self.record_span(name, t0 * 1e6,
+                             (time.perf_counter() - t0) * 1e6,
+                             request=request, **args)
+
+    # -- reading ----------------------------------------------------------
+    def spans(self, since_us=None, until_us=None, request=None):
+        """Snapshot as json-friendly dicts, oldest first. The window
+        keeps any span that OVERLAPS it: `since_us` tests the span's
+        END (a 60s queue_wait that closes inside a 30s flight-recorder
+        window is exactly the outlier evidence the dump exists for),
+        `until_us` its start. `request` filters one lane."""
+        with self._lock:
+            raw = list(self._spans)
+        out = []
+        for ts, dur, name, req, args in raw:
+            if since_us is not None and ts + dur < since_us:
+                continue
+            if until_us is not None and ts > until_us:
+                continue
+            if request is not None and req != request:
+                continue
+            out.append({"name": name, "ts_us": ts, "dur_us": dur,
+                        "request": req, "args": args or {}})
+        return out
+
+    def __len__(self):
+        with self._lock:
+            return len(self._spans)
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+
+
+_tracer = SpanRecorder()
+
+
+def get_tracer():
+    """The process-wide span ring every instrumented surface records
+    into (the serving engine, the paged-step dispatch wrappers, ...)."""
+    return _tracer
+
+
+def span(name, request=None, **args):
+    """`with tracing.span("prefill_chunk", request=rid, width=64):` on
+    the process-wide recorder."""
+    return _tracer.span(name, request=request, **args)
+
+
+def event(name, request=None, **args):
+    _tracer.event(name, request=request, **args)
+
+
+# -- chrome export ---------------------------------------------------------
+
+def chrome_span_events(recorder=None, pid=None, since_us=None,
+                       until_us=None):
+    """The ring as chrome-trace ``"ph": "X"`` duration events, one lane
+    (tid) per request id plus lane 0 for engine-scope spans, with
+    ``"M"`` thread_name metadata naming each lane — merged by
+    Profiler._export_chrome into the host-range + counter stream. Every
+    event carries the full profiler key set (the export contract)."""
+    recorder = recorder or get_tracer()
+    if pid is None:
+        pid = os.getpid()
+    lanes = {}      # request id -> lane tid, by first appearance
+
+    def lane(req):
+        if req is None:
+            return _LANE_TID_BASE
+        t = lanes.get(req)
+        if t is None:
+            t = lanes[req] = _LANE_TID_BASE + 1 + len(lanes)
+        return t
+
+    events = []
+    for s in recorder.spans(since_us=since_us, until_us=until_us):
+        args = dict(s["args"])
+        if s["request"] is not None:
+            args["request"] = s["request"]
+        events.append({"name": s["name"], "ph": "X", "ts": s["ts_us"],
+                       "dur": s["dur_us"], "pid": pid,
+                       "tid": lane(s["request"]), "cat": "request",
+                       "args": args})
+    meta = [{"name": "thread_name", "ph": "M", "ts": 0, "dur": 0,
+             "pid": pid, "tid": _LANE_TID_BASE, "cat": "request",
+             "args": {"name": "serve engine"}}] if events else []
+    for req, tid in lanes.items():
+        meta.append({"name": "thread_name", "ph": "M", "ts": 0, "dur": 0,
+                     "pid": pid, "tid": tid, "cat": "request",
+                     "args": {"name": f"request {req}"}})
+    return meta + events
+
+
+# -- per-request summary ---------------------------------------------------
+
+def request_summary(request, spans=None, recorder=None):
+    """`request.explain()`-style digest of one request's lifecycle from
+    its spans: queue wait, TTFT, chunk grants (granted vs requested),
+    stalls, decode/spec accounting, effective TPOT. Works on live rings
+    and on flight-recorder dumps (pass the dump's `spans` list)."""
+    if spans is None:
+        spans = (recorder or get_tracer()).spans(request=request)
+    else:
+        spans = [s for s in spans if s.get("request") == request]
+    out = {
+        "request": request,
+        "spans": len(spans),
+        "queue_wait_s": None,
+        "ttft_s": None,
+        "tpot_s": None,
+        "prefill_chunks": [],
+        "prompt_tokens": None,
+        "generated_tokens": None,
+        "decode_steps": 0,
+        "stalls": {"budget": 0, "alloc": 0, "admit_blocked": 0},
+        "spec": {"drafted": 0, "accepted": 0, "accept_rate": None,
+                 "rewinds": 0, "blocks_freed": 0},
+        "retired": False,
+    }
+    first_token_us = None
+    last_decode_end_us = None
+    tokens_after_first = 0
+    for s in spans:
+        name, args = s["name"], s.get("args") or {}
+        if name == "submit":
+            out["prompt_tokens"] = args.get("prompt_tokens")
+        elif name == "queue_wait":
+            out["queue_wait_s"] = s["dur_us"] / 1e6
+        elif name == "prefill_chunk":
+            out["prefill_chunks"].append(
+                {"granted": args.get("granted"),
+                 "requested": args.get("requested")})
+        elif name == "first_token":
+            first_token_us = s["ts_us"]
+            out["ttft_s"] = args.get("ttft_s")
+        elif name == "decode":
+            out["decode_steps"] += 1
+            emitted = args.get("emitted", 1) or 0
+            tokens_after_first += emitted
+            last_decode_end_us = s["ts_us"] + s["dur_us"]
+            out["spec"]["drafted"] += args.get("drafted", 0) or 0
+            out["spec"]["accepted"] += args.get("accepted", 0) or 0
+            if (args.get("drafted", 0) or 0) > (args.get("accepted", 0)
+                                                or 0):
+                out["spec"]["rewinds"] += 1
+            out["spec"]["blocks_freed"] += args.get("blocks_freed", 0) or 0
+        elif name == "stall_budget":
+            out["stalls"]["budget"] += 1
+        elif name == "stall_alloc":
+            out["stalls"]["alloc"] += 1
+        elif name == "admit_blocked":
+            out["stalls"]["admit_blocked"] += 1
+        elif name == "retire":
+            out["retired"] = True
+            out["generated_tokens"] = args.get("generated")
+    if out["spec"]["drafted"]:
+        out["spec"]["accept_rate"] = round(
+            out["spec"]["accepted"] / out["spec"]["drafted"], 4)
+    if (first_token_us is not None and last_decode_end_us is not None
+            and tokens_after_first > 0):
+        out["tpot_s"] = ((last_decode_end_us - first_token_us) / 1e6
+                         / tokens_after_first)
+    return out
+
+
+# -- flight recorder -------------------------------------------------------
+
+class FlightRecorder:
+    """Anomaly-triggered dump of the span ring + a metrics snapshot.
+
+    The ring records continuously and cheaply; `trigger(reason, ...)`
+    writes the last `window_s` seconds of spans and the full metrics
+    registry to ``<dir>/flightrec_<reason>_<ms>_<seq>.json`` — but only
+    when armed (`arm(dir)`), and at most once per `min_interval_s` per
+    reason, so a repeating anomaly leaves evidence without flooding the
+    disk. Triggers wired in today: ``kv_alloc_failure`` and
+    ``post_warmup_recompile`` and ``tpot_slo_breach``
+    (incubate/nn/continuous_batching.py), ``comm_watchdog_stall``
+    (distributed/comm_watchdog.py), plus ``manual`` via write_dump()."""
+
+    def __init__(self, recorder=None, window_s=30.0, min_interval_s=2.0):
+        self.recorder = recorder    # None = the process-wide tracer
+        self.window_s = float(window_s)
+        self.min_interval_s = float(min_interval_s)
+        self._lock = threading.Lock()
+        self._dir = None
+        self._last = {}             # reason -> last-dump perf_counter
+        self._seq = 0
+        self.dumps = []             # paths written this process
+
+    @property
+    def armed(self):
+        return self._dir is not None
+
+    def arm(self, out_dir, window_s=None, min_interval_s=None):
+        """Start dumping into `out_dir` (created on first dump)."""
+        with self._lock:
+            self._dir = str(out_dir)
+            if window_s is not None:
+                self.window_s = float(window_s)
+            if min_interval_s is not None:
+                self.min_interval_s = float(min_interval_s)
+        return self
+
+    def disarm(self):
+        with self._lock:
+            self._dir = None
+
+    def trigger(self, reason, request=None, **context):
+        """Record the anomaly; write a dump when armed + off cooldown.
+        Returns the dump path, or None when nothing was written. Always
+        leaves a `flight_trigger` event in the ring (cheap, so even an
+        unarmed process shows the anomaly on its timeline) and counts
+        dumps into flight_recorder_dumps_total{reason}."""
+        rec = self.recorder or get_tracer()
+        rec.event("flight_trigger", request=request, reason=str(reason),
+                  **context)
+        now = time.perf_counter()
+        with self._lock:
+            if self._dir is None:
+                return None
+            last = self._last.get(reason)
+            if last is not None and now - last < self.min_interval_s:
+                return None
+            self._last[reason] = now
+            self._seq += 1
+            seq = self._seq
+            out_dir = self._dir
+        path = os.path.join(
+            out_dir, f"flightrec_{reason}_{int(time.time() * 1000)}_"
+                     f"{seq}.json")
+        self._write(path, reason, rec, request, context,
+                    since_us=(now - self.window_s) * 1e6)
+        with self._lock:
+            self.dumps.append(path)
+        get_registry().counter(
+            "flight_recorder_dumps_total",
+            help="anomaly dumps written by the flight recorder",
+            labels=("reason",)).labels(reason=str(reason)).inc()
+        return path
+
+    def _write(self, path, reason, rec, request, context, since_us=None):
+        spans = rec.spans(since_us=since_us)
+        requests = []
+        for s in spans:
+            if s["request"] is not None and s["request"] not in requests:
+                requests.append(s["request"])
+        payload = {
+            "schema": DUMP_SCHEMA,
+            "time": time.time(),
+            "reason": str(reason),
+            "request": request,
+            "context": {k: _clean_value(v, f"dump context {k!r}")
+                        for k, v in context.items()},
+            "window_s": self.window_s,
+            "requests": requests,
+            "spans": spans,
+            "metrics": get_registry().snapshot(),
+        }
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+        return path
+
+    def dump_to(self, path, reason="manual", request=None, **context):
+        """Unconditional dump to an explicit path (no arming, no
+        cooldown): the whole ring, not just the window — what
+        serve_llama --trace and the bench trace leg write."""
+        rec = self.recorder or get_tracer()
+        return self._write(path, reason, rec, request, context)
+
+
+_flight = FlightRecorder()
+
+
+def get_flight_recorder():
+    """The process-wide flight recorder the serving/distributed anomaly
+    triggers fire into."""
+    return _flight
+
+
+def write_dump(path, reason="manual", request=None, **context):
+    """Dump the process-wide span ring + metrics snapshot to `path`."""
+    return _flight.dump_to(path, reason=reason, request=request, **context)
+
+
+def load_dump(path):
+    """Load + schema-validate a flight-recorder dump (stdlib only — the
+    same loader tools/request_trace.py and the --selfcheck use).
+    Raises ValueError on anything that is not a v1 dump."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or data.get("schema") != DUMP_SCHEMA:
+        raise ValueError(
+            f"{path}: not a {DUMP_SCHEMA} dump "
+            f"(schema={data.get('schema') if isinstance(data, dict) else None!r})")
+    missing = {"time", "reason", "window_s", "requests", "spans",
+               "metrics"} - set(data)
+    if missing:
+        raise ValueError(f"{path}: dump missing keys {sorted(missing)}")
+    if not isinstance(data["spans"], list):
+        raise ValueError(f"{path}: spans is not a list")
+    for i, s in enumerate(data["spans"]):
+        if not {"name", "ts_us", "dur_us", "request", "args"} <= set(s):
+            raise ValueError(f"{path}: span {i} malformed: {sorted(s)}")
+    return data

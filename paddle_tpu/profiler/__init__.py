@@ -391,9 +391,18 @@ class Profiler:
         # window (samples share the perf_counter timebase), not the
         # whole process-lifetime ring
         from ..observability import chrome_counter_events
+        from ..observability.tracing import chrome_span_events
+        until = None if self._recording else self._window_end_us
         events += chrome_counter_events(
             pid=os.getpid(), since_us=self._window_begin_us,
-            until_us=(None if self._recording else self._window_end_us))
+            until_us=until)
+        # ... and so do the request-lifecycle spans: per-request lanes
+        # (queue wait, prefill chunks, decode/spec spans, stalls) next
+        # to the host ranges and metric counters — one view answers
+        # "what was request N doing during the slow step"
+        events += chrome_span_events(
+            pid=os.getpid(), since_us=self._window_begin_us,
+            until_us=until)
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)

@@ -1,0 +1,414 @@
+"""Per-request lifecycle tracing + flight recorder (ISSUE 6).
+
+Covers the span ring itself (bounded wraparound, thread safety, the
+tracer->float guard — the runtime half of the GL105 contract), the
+continuous-batching engine's lifecycle instrumentation (span counts are
+host math: one queue_wait, ceil(P/chunk) prefill chunks, N-1 decode
+spans), and the anomaly triggers: an injected KV alloc failure and a
+forced post-warmup bucket recompile must each produce a flight dump
+that reconstructs the offending request's timeline and loads through
+tools/request_trace.py AND the stdlib-only schema validator."""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import tracing
+
+
+def _tiny_engine(seed=0):
+    # the CACHED serving engine (identical weights/config per seed):
+    # one compile bill for every serving test file in the tier-1 window
+    from test_chunked_prefill import _tiny_engine as _cached
+    return _cached(seed=seed, max_seq_len=32)
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    old = fa._INTERPRET
+    fa._INTERPRET = True
+    yield
+    fa._INTERPRET = old
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Each test sees a fresh process-wide ring and a disarmed flight
+    recorder (other test files' serving runs record spans too)."""
+    obs.get_tracer().clear()
+    obs.get_flight_recorder().disarm()
+    yield
+    obs.get_flight_recorder().disarm()
+
+
+# -- span ring core --------------------------------------------------------
+
+def test_ring_wraparound_bounded():
+    rec = tracing.SpanRecorder(capacity=16)
+    for i in range(100):
+        rec.event("e", request=i % 3, i=i)
+    assert len(rec) == 16
+    assert rec.recorded_total == 100
+    # the ring keeps the NEWEST spans
+    kept = [s["args"]["i"] for s in rec.spans()]
+    assert kept == list(range(84, 100))
+
+
+def test_concurrent_recording_thread_safe():
+    rec = tracing.SpanRecorder(capacity=100000)
+
+    def work(tid):
+        for i in range(1000):
+            rec.event("t", request=tid, i=i)
+
+    threads = [threading.Thread(target=work, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(rec) == 8000 and rec.recorded_total == 8000
+    for tid in range(8):
+        assert len(rec.spans(request=tid)) == 1000
+
+
+def test_window_keeps_overlapping_spans():
+    """The flight-recorder window keeps spans that OVERLAP it: a long
+    queue_wait STARTING before the window but ending inside it is
+    exactly the outlier evidence a dump must carry."""
+    rec = tracing.SpanRecorder()
+    rec.record_span("old_done", 0.0, 10.0)            # ends at 10us
+    rec.record_span("queue_wait", 50.0, 100.0)        # spans 50..150us
+    rec.record_span("recent", 140.0, 5.0)
+    names = [s["name"] for s in rec.spans(since_us=120.0)]
+    assert names == ["queue_wait", "recent"]
+    # until_us still windows on start (profiler export scoping)
+    names = [s["name"] for s in rec.spans(until_us=60.0)]
+    assert names == ["old_done", "queue_wait"]
+
+
+def test_span_context_manager_measures():
+    rec = tracing.SpanRecorder()
+    with rec.span("outer", request="r", width=4):
+        rec.event("inner", request="r")
+    spans = rec.spans(request="r")
+    names = [s["name"] for s in spans]
+    assert names == ["inner", "outer"]     # outer closes (records) last
+    outer = spans[1]
+    assert outer["dur_us"] >= 0 and outer["args"]["width"] == 4
+    # disabled ring records nothing but stays reusable
+    rec.enabled = False
+    rec.event("dropped")
+    assert len(rec) == 2
+    rec.enabled = True
+    rec.event("kept")
+    assert len(rec) == 3
+
+
+def test_record_rejects_tracers_at_trace_time():
+    """Recording a span (or a span ARG) under jit must raise — same
+    host-side-only contract as the metrics registry; graftlint GL105
+    now covers tracing.* statically."""
+    import jax
+    import jax.numpy as jnp
+
+    rec = tracing.SpanRecorder()
+
+    def f(x):
+        rec.event("bad", val=x)
+        return x
+
+    with pytest.raises(TypeError, match="host"):
+        jax.jit(f)(jnp.float32(1.0))
+    assert len(rec) == 0
+
+
+# -- engine lifecycle spans ------------------------------------------------
+
+def _serve(workload, seed=7, ids=None, **engine_kw):
+    from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                        GenerationRequest)
+
+    eng, V = _tiny_engine()
+    rng = np.random.default_rng(seed)
+    kw = dict(num_blocks=12, block_size=8, max_batch=2, prefill_chunk=4)
+    kw.update(engine_kw)
+    cb = ContinuousBatchingEngine(eng, **kw)
+    reqs = [GenerationRequest(rng.integers(1, V, p).astype(np.int32), n,
+                              request_id=None if ids is None else ids[j])
+            for j, (p, n) in enumerate(workload)]
+    for r in reqs:
+        cb.submit(r)
+    out = cb.run()
+    return cb, reqs, out
+
+
+def test_lifecycle_span_counts_are_host_math():
+    """ceil(P/chunk) prefill_chunk spans, exactly one queue_wait /
+    first_token / retire, N-1 decode spans — per request."""
+    workload = [(5, 3), (11, 4)]
+    cb, reqs, out = _serve(workload)
+    tr = obs.get_tracer()
+    for r, (p, n) in zip(reqs, workload):
+        spans = tr.spans(request=r.request_id)
+        counts = {}
+        for s in spans:
+            counts[s["name"]] = counts.get(s["name"], 0) + 1
+        assert counts == {"submit": 1, "queue_wait": 1,
+                          "prefill_chunk": -(-p // 4),
+                          "first_token": 1, "decode": n - 1,
+                          "retire": 1}, (r.request_id, counts)
+        # chunk grants reconstruct the prompt exactly
+        widths = [s["args"]["granted"] for s in spans
+                  if s["name"] == "prefill_chunk"]
+        assert sum(widths) == p
+    # engine lane: one serve_step + one paged_step dispatch per step
+    eng_spans = [s for s in tr.spans() if s["request"] is None]
+    steps = [s for s in eng_spans if s["name"] == "serve_step"]
+    assert len(steps) == cb._step_count
+    assert len([s for s in eng_spans if s["name"] == "paged_step"]) == \
+        cb._step_count
+
+
+def test_explain_digest():
+    workload = [(11, 4)]
+    cb, reqs, out = _serve(workload)
+    ex = cb.explain(reqs[0].request_id)
+    assert ex["retired"] is True
+    assert ex["prompt_tokens"] == 11 and ex["generated_tokens"] == 4
+    assert ex["queue_wait_s"] >= 0 and ex["ttft_s"] > 0
+    assert [c["granted"] for c in ex["prefill_chunks"]] == [4, 4, 3]
+    assert ex["decode_steps"] == 3 and ex["tpot_s"] > 0
+    assert ex["stalls"] == {"budget": 0, "alloc": 0, "admit_blocked": 0}
+
+
+def test_budget_starvation_records_stall_spans():
+    """token_budget=4 with two 8-token prompts: while one slot eats its
+    chunk the other stalls at zero work entries — span-visible."""
+    workload = [(8, 2), (8, 2)]
+    cb, reqs, out = _serve(workload, token_budget=4)
+    tr = obs.get_tracer()
+    stalls = [s for s in tr.spans() if s["name"] == "stall_budget"]
+    assert stalls, "budget starvation left no stall spans"
+    starved = {s["request"] for s in stalls}
+    assert starved <= {r.request_id for r in reqs}
+    # the digest rolls them up
+    ex = cb.explain(sorted(starved)[0])
+    assert ex["stalls"]["budget"] >= 1
+    # granted < requested on at least one starved chunk
+    grants = [(s["args"]["granted"], s["args"]["requested"])
+              for s in tr.spans() if s["name"] == "prefill_chunk"]
+    assert any(g < r for g, r in grants)
+
+
+def test_speculative_decode_spans_carry_accounting():
+    from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                        GenerationRequest)
+
+    eng, V = _tiny_engine()
+    pattern = [7, 23, 41, 11]
+    cb = ContinuousBatchingEngine(eng, num_blocks=12, block_size=8,
+                                  max_batch=1, prefill_chunk=8, spec_k=4)
+    req = GenerationRequest(np.asarray(pattern * 4, np.int32), 12)
+    cb.submit(req)
+    out = cb.run()
+    assert req.spec_drafted > 0
+    tr = obs.get_tracer()
+    decodes = [s for s in tr.spans(request=req.request_id)
+               if s["name"] == "decode"]
+    assert sum(s["args"]["drafted"] for s in decodes) == req.spec_drafted
+    assert sum(s["args"]["accepted"] for s in decodes) == req.spec_accepted
+    assert sum(s["args"]["emitted"] for s in decodes) == 12 - 1
+    ex = cb.explain(req.request_id)
+    assert ex["spec"]["drafted"] == req.spec_drafted
+    assert ex["spec"]["accept_rate"] == pytest.approx(
+        req.spec_accepted / req.spec_drafted)
+
+
+# -- flight recorder triggers ----------------------------------------------
+
+def _load_with_cli(path):
+    """The dump must load through tools/request_trace.py too."""
+    import os
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    try:
+        from tools import request_trace
+    finally:
+        sys.path.remove(repo)
+    dump = tracing.load_dump(path)
+    import io
+    buf = io.StringIO()
+    request_trace.render_dump(dump, out=buf)
+    return dump, buf.getvalue()
+
+
+def test_injected_alloc_failure_dumps_flight_record(tmp_path):
+    """An injected KV alloc failure mid-step produces a dump whose spans
+    reconstruct the failing request's timeline: queue wait, granted
+    chunks, and the stall itself."""
+    from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                        GenerationRequest)
+
+    eng, V = _tiny_engine()
+    rng = np.random.default_rng(3)
+    cb = ContinuousBatchingEngine(eng, num_blocks=12, block_size=8,
+                                  max_batch=2, prefill_chunk=4)
+    req = GenerationRequest(rng.integers(1, V, 9).astype(np.int32), 3,
+                            request_id="victim")
+    cb.submit(req)
+    cb.step()                       # admit + chunk 1 (tokens 1..4)
+    cb.step()                       # chunk 2 (tokens 5..8, block full)
+    obs.get_flight_recorder().arm(tmp_path)
+    cb.allocator._free.clear()      # inject: pool suddenly empty
+    cb.allocator._free_set.clear()
+    with pytest.raises(RuntimeError, match="out of cache blocks"):
+        cb.step()                   # final token crosses the block edge
+    dumps = list(tmp_path.glob("flightrec_kv_alloc_failure_*.json"))
+    assert len(dumps) == 1
+    dump, rendered = _load_with_cli(str(dumps[0]))
+    assert dump["reason"] == "kv_alloc_failure"
+    assert dump["request"] == "victim"
+    names = [s["name"] for s in dump["spans"]
+             if s["request"] == "victim"]
+    # the timeline tells the whole story: submitted, waited, got one
+    # chunk granted, then stalled on allocation
+    for expected in ("submit", "queue_wait", "prefill_chunk",
+                     "stall_alloc"):
+        assert expected in names, (expected, names)
+    digest = tracing.request_summary("victim", spans=dump["spans"])
+    assert digest["stalls"]["alloc"] == 1
+    assert digest["prefill_chunks"] == [{"granted": 4, "requested": 4},
+                                        {"granted": 4, "requested": 4}]
+    assert "victim" in rendered and "stall_alloc" in rendered
+    # metrics snapshot rode along, including the alloc-failure counter
+    fails = dump["metrics"]["kv_alloc_failures_total"]["children"]
+    assert sum(c["value"] for c in fails.values()) >= 1
+
+
+def test_forced_post_warmup_recompile_dumps(tmp_path):
+    """declare_warm() then a workload that keys a fresh (work-list,
+    chunk) bucket: the recompile must produce a dump naming the bucket
+    and containing the offending request's spans."""
+    from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                        GenerationRequest)
+
+    eng, V = _tiny_engine()
+    rng = np.random.default_rng(5)
+    cb = ContinuousBatchingEngine(eng, num_blocks=12, block_size=8,
+                                  max_batch=2, prefill_chunk=4)
+    cb.submit(GenerationRequest(rng.integers(1, V, 5).astype(np.int32),
+                                2, request_id="warm"))
+    cb.run()
+    cb.declare_warm()
+    obs.get_flight_recorder().arm(tmp_path)
+    # two concurrent long prompts -> work list far past anything warmed
+    cb.submit(GenerationRequest(rng.integers(1, V, 23).astype(np.int32),
+                                2, request_id="cold1"))
+    cb.submit(GenerationRequest(rng.integers(1, V, 21).astype(np.int32),
+                                2, request_id="cold2"))
+    cb.run()
+    dumps = list(tmp_path.glob("flightrec_post_warmup_recompile_*.json"))
+    assert dumps, "post-warmup recompile fired no dump"
+    dump = tracing.load_dump(str(dumps[0]))
+    assert dump["context"]["bucket"]      # names the offending bucket
+    assert "cold1" in dump["requests"]
+    counter = obs.get_registry().get("flight_recorder_dumps_total")
+    assert counter.labels(
+        reason="post_warmup_recompile").value >= 1
+
+
+def test_warm_engine_same_workload_never_dumps(tmp_path):
+    """The inverse gate: replaying an already-warmed workload after
+    declare_warm() must write NOTHING (tracing is anomaly-silent in
+    steady state)."""
+    from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                        GenerationRequest)
+
+    eng, V = _tiny_engine()
+    rng = np.random.default_rng(6)
+    prompt = rng.integers(1, V, 9).astype(np.int32)
+    cb = ContinuousBatchingEngine(eng, num_blocks=12, block_size=8,
+                                  max_batch=2, prefill_chunk=4)
+    cb.submit(GenerationRequest(prompt.copy(), 3))
+    cb.run()
+    cb.declare_warm()
+    obs.get_flight_recorder().arm(tmp_path)
+    cb.submit(GenerationRequest(prompt.copy(), 3))
+    cb.run()
+    assert list(tmp_path.glob("flightrec_*.json")) == []
+
+
+def test_tpot_slo_breach_dumps(tmp_path):
+    """An absurdly tight TPOT SLO breaches on real decode intervals and
+    fires the flight recorder (rate-limited to one dump)."""
+    workload = [(5, 12)]
+    obs.get_flight_recorder().arm(tmp_path)
+    cb, reqs, out = _serve(workload, tpot_slo=1e-9)
+    dumps = list(tmp_path.glob("flightrec_tpot_slo_breach_*.json"))
+    assert len(dumps) == 1           # cooldown collapses the storm
+    dump = tracing.load_dump(str(dumps[0]))
+    assert dump["context"]["slo_s"] == pytest.approx(1e-9)
+    assert dump["context"]["tpot_mean_s"] > 0
+
+
+# -- exporters / profiler merge --------------------------------------------
+
+def test_chrome_span_events_per_request_lanes():
+    workload = [(5, 2), (3, 2)]
+    cb, reqs, out = _serve(workload)
+    ev = obs.chrome_span_events(pid=42)
+    xs = [e for e in ev if e["ph"] == "X"]
+    metas = [e for e in ev if e["ph"] == "M"]
+    assert xs and metas
+    # each request got its own lane, engine spans a lane of their own
+    lanes = {e["tid"] for e in xs}
+    assert len(lanes) >= 3
+    lane_names = {e["args"]["name"] for e in metas}
+    assert "serve engine" in lane_names
+    for r in reqs:
+        assert f"request {r.request_id}" in lane_names
+    # profiler export contract: uniform key shape
+    assert all({"name", "ph", "ts", "dur", "pid", "tid", "args"}
+               <= set(e) for e in ev)
+
+
+def test_profiler_export_merges_request_lanes(tmp_path):
+    """One chrome file carries host ranges AND request-lifecycle spans,
+    window-scoped: pre-profiler spans stay out."""
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import Profiler
+
+    obs.get_tracer().event("before_window", request="outside")
+    path = str(tmp_path / "trace.json")
+    with Profiler() as prof:
+        x = paddle.randn([4, 4])
+        paddle.matmul(x, x)
+        _serve([(5, 2)], ids=["profiled"])
+    prof.export(path)
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    cats = {e.get("cat") for e in events}
+    assert "request" in cats          # span lanes made it in
+    names = {e["name"] for e in events if e.get("cat") == "request"}
+    assert "serve_step" in names and "prefill_chunk" in names
+    assert "before_window" not in names   # window scoping
+    lanes = {e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e.get("cat") == "request"}
+    assert "request profiled" in lanes
+
+
+def test_flight_dump_counts_into_registry_exports():
+    """flight_recorder_dumps_total shows up in the Prometheus export
+    like any other family (dashboardable anomaly rate)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        obs.get_flight_recorder().arm(d)
+        assert obs.get_flight_recorder().trigger("test_reason") is not None
+    obs.get_flight_recorder().disarm()
+    assert 'flight_recorder_dumps_total{reason="test_reason"}' \
+        in obs.to_prometheus()

@@ -1,0 +1,66 @@
+# graftlint-corpus-expect: GL108 GL108 GL108
+"""Jitted functions closing over large arrays — the int4
+compile-payload bloat hazard (inference/__init__.py documents the real
+one by hand: packed weights captured by closure would inline ~350 MB of
+constants into the compile payload; they flow as program ARGUMENTS
+instead). Both capture forms: a `self.` attribute from an enclosing
+method's scope, and a module-level array constant. The clean tripwires
+at the bottom pin the false-positive boundary: arrays passed as
+arguments, scalar module config, and un-jitted helpers must not
+trip."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# module-level array constants: a jitted reader inlines these wholesale
+_PACKED_WEIGHTS = np.zeros((4096, 4096), np.int8)
+_SCALES = jnp.ones((4096, 1))
+
+
+class Engine:
+    def __init__(self):
+        self._w = jnp.zeros((1024, 1024))
+
+        @jax.jit
+        def step(x):
+            # GL108: self._w is baked into the program as a constant —
+            # quantizing/reloading self._w later changes NOTHING here
+            return x @ self._w
+
+        def decode(x):
+            # GL108 x2: both module-level arrays captured by closure
+            w = _PACKED_WEIGHTS.astype(jnp.float32) * _SCALES
+            return x @ w
+
+        self._step = step
+        self._decode = jax.jit(decode)
+
+
+# ---- clean tripwires (must raise nothing) -------------------------------
+
+_HIDDEN_DIM = 1024          # scalar config: not an array call
+
+
+@jax.jit
+def good_step(x, w):
+    # arrays as ARGUMENTS — the engines' idiom; the scalar is fine
+    return (x @ w) * (1.0 / _HIDDEN_DIM)
+
+
+def eager_helper(x):
+    # not jitted: eager reads of the module array are ordinary code
+    return x @ _PACKED_WEIGHTS.astype(np.float32)
+
+
+class CleanEngine:
+    def __init__(self):
+        self._w = jnp.zeros((8, 8))
+
+        def apply(w, x):
+            return x @ w            # w is an argument: clean
+
+        self._apply = jax.jit(apply, donate_argnums=(1,))
+
+    def run(self, x):
+        # the CALL reads self._w outside any jitted body: clean
+        return self._apply(self._w, x)

@@ -1,16 +1,24 @@
-# graftlint-corpus-expect: GL105 GL105 GL105 GL105
+# graftlint-corpus-expect: GL105 GL105 GL105 GL105 GL105 GL105
 """Observability record calls inside jitted functions: the registry is
 host-side state, so under jit the record fires exactly once — at trace
 time — and the metric silently stops counting (or the tracer->float
 guard raises). The loss value here is a tracer: .observe(loss) dies at
 trace time; the counter/gauge calls trace once and freeze. The bare
 dotted call only matches the FULL paddle_tpu.observability prefix —
-other paddle_tpu.* calls inside jit must not trip the rule."""
+other paddle_tpu.* calls inside jit must not trip the rule.
+
+The tracing span recorder (observability/tracing.py) is the SAME
+host-side ring contract: a span or flight-recorder call under the
+trace records once and freezes (or dies on the tracer->float guard in
+its arg coercion) — the serving engine records spans strictly outside
+the compiled step for exactly this reason."""
 import jax
 import paddle_tpu.observability
 
 from paddle_tpu import observability as obs
 from paddle_tpu.observability import get_registry
+from paddle_tpu.observability import tracing
+from paddle_tpu.observability.tracing import span
 
 
 @jax.jit
@@ -21,3 +29,12 @@ def train_step(params, batch):
     obs.get_registry().histogram("loss").observe(loss)      # tracer crash
     paddle_tpu.observability.get_registry().counter("n").inc()  # dotted
     return loss
+
+
+@jax.jit
+def decode_step(caches, tok):
+    out = caches[0] * tok
+    with span("decode", tokens=out.sum()):      # submodule import: tracer
+        y = out * 2                             # crash on the arg guard
+    tracing.get_tracer().event("tick")          # module alias: trace-time
+    return y

@@ -24,10 +24,20 @@ kernel file routes the flag through a module-level `_interpret()` /
 flip — a ROADMAP "candidate next rule", now a rule.
 
 GL105 is the static half of the observability host-side-only contract:
-a `paddle_tpu.observability` record call inside a jit-decorated function
-fires at trace time (once, not per step — a counter that silently stops
-counting) or crashes on the tracer coercion. The runtime half is the
-`float()` guard in observability/metrics.py.
+a `paddle_tpu.observability` record call (metrics OR tracing spans)
+inside a jit-decorated function fires at trace time (once, not per step
+— a counter that silently stops counting) or crashes on the tracer
+coercion. The runtime half is the `float()` guard in
+observability/metrics.py, shared by tracing.py.
+
+GL108 reconstructs the int4 compile-payload bloat hazard documented by
+hand in inference/__init__.py: a jitted function that CLOSES OVER a
+large array (a `self.` attribute or a module-level array constant)
+instead of taking it as an argument inlines the whole tensor into the
+compiled program as a constant — ~350 MB of packed weights in the int4
+case — and silently pins the STALE value (a later update to the
+attribute never reaches the compiled program). Arrays flow as
+arguments; closures carry only small config scalars.
 """
 import ast
 
@@ -176,12 +186,26 @@ def interpret_literal(ctx):
                     "blockwise_ce.py:49)"), node
 
 
+def _is_observability_module(mod, level):
+    """True when an ImportFrom module path names the observability
+    package or any of its submodules (tracing, metrics, ...), absolute
+    (`paddle_tpu.observability.tracing`) or relative
+    (`...observability.tracing`). Exact path-segment match, so a
+    user-named `my_observability` module can't trip the rule."""
+    parts = mod.split(".")
+    if "observability" not in parts:
+        return False
+    return level > 0 or parts[0] == "paddle_tpu"
+
+
 def _observability_names(ctx):
-    """Names this module binds to paddle_tpu.observability: module
-    aliases (watch via attribute chains), directly imported symbols
-    (watch via bare calls), and — for a bare dotted import, which binds
-    only `paddle_tpu` — full dotted prefixes (a bare `paddle_tpu` alias
-    would flag every paddle_tpu.* call in the file)."""
+    """Names this module binds to paddle_tpu.observability (the metrics
+    registry AND the tracing span recorder — both are host-side rings):
+    module aliases (watch via attribute chains), directly imported
+    symbols (watch via bare calls), and — for a bare dotted import,
+    which binds only `paddle_tpu` — full dotted prefixes (a bare
+    `paddle_tpu` alias would flag every paddle_tpu.* call in the
+    file)."""
     mod_aliases, symbols, dotted = set(), set(), set()
     for node in ast.walk(ctx.tree):
         if isinstance(node, ast.Import):
@@ -200,9 +224,11 @@ def _observability_names(ctx):
                 for a in node.names:
                     if a.name == "observability":
                         mod_aliases.add(a.asname or "observability")
-            elif mod == "paddle_tpu.observability" or mod.endswith(
-                    "observability") and (node.level > 0
-                                          or mod.startswith("paddle_tpu")):
+            elif _is_observability_module(mod, node.level):
+                # `from ...observability import tracing` binds a module,
+                # `from paddle_tpu.observability.tracing import span` a
+                # function — either way a call rooted at the bound name
+                # records host-side state
                 for a in node.names:
                     symbols.add(a.asname or a.name)
     return mod_aliases, symbols, dotted
@@ -247,7 +273,143 @@ def observability_in_jit(ctx):
                 yield ctx.finding(
                     "GL105", node,
                     f"observability call inside jitted `{fn.name}`: "
-                    "metrics record host-side state — under jit this "
-                    "fires at trace time (not per step) or crashes on "
-                    "the tracer->float guard. Record outside the jitted "
-                    "function (observability/metrics.py contract)"), node
+                    "metrics and tracing spans record host-side state — "
+                    "under jit this fires at trace time (not per step) "
+                    "or crashes on the tracer->float guard. Record "
+                    "outside the jitted function (observability/"
+                    "metrics.py + tracing.py contract)"), node
+
+
+def _jitted_functions(ctx):
+    """Every FunctionDef the file jits: decorator form (`@jax.jit`,
+    `@partial(jax.jit, ...)`) plus call-binding form (`jax.jit(fn, ...)`
+    where `fn` is a function defined in this file — the engines' idiom:
+    `self._step = jax.jit(step, donate_argnums=(1,))`)."""
+    defs = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    jitted = []
+    seen = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and any(_is_jitish(d) for d in node.decorator_list):
+            if id(node) not in seen:
+                seen.add(id(node))
+                jitted.append(node)
+        elif isinstance(node, ast.Call) and _is_jitish(node.func) \
+                and node.args and isinstance(node.args[0], ast.Name):
+            for fn in defs.get(node.args[0].id, ()):
+                if id(fn) not in seen:
+                    seen.add(id(fn))
+                    jitted.append(fn)
+    return jitted
+
+
+def _array_aliases(ctx):
+    """Names bound to numpy OR jax.numpy in this module (`np`, `jnp`,
+    ...) — the constructors whose module-level results are almost
+    certainly arrays."""
+    aliases = set(ctx.numpy_aliases)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("jax.numpy",) and a.asname:
+                    aliases.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "") == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        aliases.add(a.asname or "numpy")
+    return aliases
+
+
+def _module_array_names(ctx):
+    """Module-level `NAME = <call rooted at np/jnp>` bindings: the
+    array constants a jitted function must take as arguments, not close
+    over. Calls only — `DIM = 128` or `SHAPE = (8, 128)` never match."""
+    aliases = _array_aliases(ctx)
+    if not aliases:
+        return set()
+    out = set()
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        if not isinstance(value, ast.Call):
+            continue
+        if _call_root(value.func) not in aliases:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def _local_names(fn):
+    """Names the function binds itself: parameters plus anything
+    assigned/bound in its body (a local shadowing a module-level array
+    is the function's own business)."""
+    a = fn.args
+    names = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                     (ast.Store,
+                                                      ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            names.add(node.name)
+    return names
+
+
+@rule("GL108", "jit-closure-capture", "trace-safety")
+def jit_closure_capture(ctx):
+    """A jitted function closing over a `self.` attribute or a
+    module-level array constant: the array is inlined into the compiled
+    program as a CONSTANT (the int4 compile-payload bloat — ~350 MB of
+    packed weights in the program image) and later updates to the
+    captured value silently never reach the compiled code. Pass arrays
+    as arguments (donate if appropriate)."""
+    module_arrays = _module_array_names(ctx)
+    for fn in _jitted_functions(ctx):
+        locals_ = _local_names(fn)
+        flagged_attrs = set()
+        flagged_names = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" \
+                    and "self" not in locals_ \
+                    and node.attr not in flagged_attrs:
+                flagged_attrs.add(node.attr)
+                yield ctx.finding(
+                    "GL108", node,
+                    f"jitted `{fn.name}` closes over `self.{node.attr}`: "
+                    "a captured array is baked into the compiled program "
+                    "as a constant (compile-payload bloat — the int4 "
+                    "case was ~350 MB) and later updates to the "
+                    "attribute never reach the compiled code — pass it "
+                    "as an argument (inference/__init__.py passes "
+                    "`self._w` as the `w` arg for exactly this "
+                    "reason)"), node
+            elif isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in module_arrays \
+                    and node.id not in locals_ \
+                    and node.id not in flagged_names:
+                flagged_names.add(node.id)
+                yield ctx.finding(
+                    "GL108", node,
+                    f"jitted `{fn.name}` closes over module-level array "
+                    f"`{node.id}`: the array is inlined into the "
+                    "compiled program as a constant (payload bloat + "
+                    "silently stale on rebind) — pass it as an "
+                    "argument"), node

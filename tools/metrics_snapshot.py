@@ -10,9 +10,13 @@ Two jobs:
   registry.
 * ``python tools/metrics_snapshot.py --selfcheck`` exercises the whole
   metrics core — registry, concurrency, histogram bucket edges, all
-  three exporters — and exits non-zero on any violation. Wired into
-  tools/lint.sh so the tier-0 gate (tests/test_graftlint_gate.py)
-  catches a broken metrics subsystem before any test imports jax.
+  three exporters — plus the tracing span ring (wraparound, concurrent
+  recording, the tracer arg guard) and the flight-recorder dump schema
+  (write -> stdlib json load -> ``tracing.load_dump`` validation ->
+  ``request_summary`` replay), and exits non-zero on any violation.
+  Wired into tools/lint.sh so the tier-0 gate
+  (tests/test_graftlint_gate.py) catches a broken metrics/tracing
+  subsystem before any test imports jax.
 
 The selfcheck must run in a bare container: paddle_tpu/__init__ imports
 jax, so when the package isn't already loaded we load
@@ -23,7 +27,9 @@ import argparse
 import importlib.util
 import json
 import os
+import shutil
 import sys
+import tempfile
 import threading
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -143,6 +149,81 @@ def selfcheck():
     check(all(e["ph"] == "C" and {"name", "ts", "dur", "pid", "tid",
                                   "args"} <= set(e) for e in ev),
           "chrome counter events malformed")
+
+    # span recorder: bounded ring, wraparound, concurrent recording
+    tr = obs.tracing.SpanRecorder(capacity=32)
+    for i in range(50):
+        tr.event("warm", request=0, i=i)
+    check(len(tr) == 32 and tr.recorded_total == 50,
+          f"ring wraparound wrong: len={len(tr)} "
+          f"recorded={tr.recorded_total}")
+    threads = [threading.Thread(
+        target=lambda: [tr.event("t", request=1) for _ in range(500)])
+        for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    check(len(tr) == 32 and tr.recorded_total == 50 + 2000,
+          f"concurrent span recording lost appends: "
+          f"{tr.recorded_total}")
+    # recorded AFTER the storm so it survives the bounded ring into
+    # the flight dump below
+    with tr.span("prefill_chunk", request=7, width=4, granted=4):
+        pass
+    got = tr.spans(request=7)
+    check(len(got) == 1 and got[0]["name"] == "prefill_chunk"
+          and got[0]["args"]["width"] == 4 and got[0]["dur_us"] >= 0,
+          f"span record malformed: {got}")
+    try:
+        tr.event("bad", v=object())
+        check(False, "span arg guard let a non-scalar through")
+    except TypeError:
+        pass
+    sev = obs.tracing.chrome_span_events(tr, pid=1)
+    check(any(e["ph"] == "X" for e in sev)
+          and any(e["ph"] == "M" for e in sev),
+          "chrome span events missing X spans or M lane names")
+    check(all({"name", "ph", "ts", "dur", "pid", "tid", "args"}
+              <= set(e) for e in sev), "chrome span events malformed")
+
+    # flight-recorder dump: write, stdlib-load, schema-validate
+    fr = obs.tracing.FlightRecorder(recorder=tr)
+    check(fr.trigger("sc_anomaly") is None,
+          "disarmed flight recorder wrote a dump")
+    d = tempfile.mkdtemp(prefix="sc_flightrec_")
+    try:
+        fr.arm(d, window_s=60.0)
+        path = fr.trigger("sc_anomaly", request=7, step=3)
+        check(path is not None and os.path.exists(path),
+              "armed flight recorder wrote nothing")
+        check(fr.trigger("sc_anomaly") is None,
+              "per-reason cooldown did not rate-limit")
+        dump = obs.tracing.load_dump(path)      # schema validation
+        check(dump["reason"] == "sc_anomaly" and 7 in dump["requests"],
+              f"dump content wrong: reason={dump['reason']} "
+              f"requests={dump['requests']}")
+        check(dump["context"].get("step") == 3,
+              f"dump context lost: {dump['context']}")
+        check(len(dump["spans"]) == len(tr),
+              f"dump spans {len(dump['spans'])} != ring {len(tr)}")
+        check(isinstance(dump["metrics"], dict),
+              "dump metrics snapshot missing")
+        digest = obs.tracing.request_summary(7, spans=dump["spans"])
+        check(digest["prefill_chunks"] == [{"granted": 4,
+                                            "requested": None}],
+              f"request_summary from dump wrong: {digest}")
+        # a truncated/foreign file must be REJECTED, not half-parsed
+        bad = os.path.join(d, "not_a_dump.json")
+        with open(bad, "w") as f:
+            json.dump({"schema": "something/else"}, f)
+        try:
+            obs.tracing.load_dump(bad)
+            check(False, "load_dump accepted a foreign schema")
+        except ValueError:
+            pass
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
     return failures
 
 

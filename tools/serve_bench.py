@@ -514,6 +514,167 @@ def spec_leg(spec_k=4, new_tokens=24, include_spec=True):
     return out
 
 
+def trace_leg(chunk=4, new_tokens=5):
+    """Per-request lifecycle tracing on the fixed ragged workload:
+    tracing must be TOKEN-EXACT-NEUTRAL (same outputs, same step count,
+    zero new compile buckets with the span ring on) and span counts per
+    request are pure host math — ceil(P/chunk) prefill_chunk spans, one
+    queue_wait, new_tokens-1 decode spans — so they gate in --check
+    exactly like the grid-step counts. Wall times (on vs off) are
+    recorded for the BASELINE.md overhead table but NOT gated: off-TPU
+    they time the Pallas interpreter, not the tracer."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                        GenerationRequest)
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        fa._INTERPRET = True
+    rng = np.random.default_rng(0)
+    eng, V = _tiny_cpu_engine(rng, max_seq_len=32)
+    workload = [(5, new_tokens), (11, new_tokens), (3, new_tokens)]
+    prompts = [rng.integers(1, V, p).astype(np.int32) for p, _ in workload]
+    tracer = obs.get_tracer()
+
+    def run(traced):
+        cb = ContinuousBatchingEngine(eng, num_blocks=12, block_size=8,
+                                      max_batch=2, prefill_chunk=chunk)
+        # string request ids: the auto counter is process-global, so
+        # committed span-count keys must not depend on how many
+        # requests OTHER legs created first
+        reqs = [GenerationRequest(p.copy(), n, request_id=f"tr{j}")
+                for j, (p, (_, n)) in enumerate(zip(prompts, workload))]
+        tracer.clear()
+        prev, tracer.enabled = tracer.enabled, traced
+        t0 = time.perf_counter()
+        try:
+            for r in reqs:
+                cb.submit(r)
+            out = cb.run()
+        finally:
+            tracer.enabled = prev
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        counts = {}
+        for r in reqs:
+            per = {}
+            for s in tracer.spans(request=r.request_id):
+                per[s["name"]] = per.get(s["name"], 0) + 1
+            counts[str(r.request_id)] = per
+        return (cb, [out[r.request_id] for r in reqs], cb._step_count,
+                wall_ms, counts)
+
+    cb_w, out_w, steps_w, _, _ = run(traced=True)       # warm compiles
+    warm_buckets = set(cb_w._seen_buckets)
+    cb_on, out_on, steps_on, wall_on, counts = run(traced=True)
+    _, out_off, steps_off, wall_off, counts_off = run(traced=False)
+    assert out_on == out_off, "tracing changed generated tokens"
+    assert counts_off == {str(r): {} for r in counts}, \
+        f"disabled tracer still recorded: {counts_off}"
+    expected = {}
+    for (p_len, n), rid in zip(workload, counts):
+        expected[rid] = {"submit": 1, "queue_wait": 1,
+                         "prefill_chunk": -(-p_len // chunk),
+                         "first_token": 1, "decode": n - 1, "retire": 1}
+    out = {
+        "interpret": not on_tpu,
+        "chunk": chunk,
+        "workload": [list(w) for w in workload],   # json-stable
+        "steps_traced": steps_on,
+        "steps_untraced": steps_off,
+        "new_buckets_after_warmup": len(set(cb_on._seen_buckets)
+                                        - warm_buckets),
+        "span_counts": counts,
+        "expected_span_counts": expected,
+        "wall_ms_traced": round(wall_on, 1),
+        "wall_ms_untraced": round(wall_off, 1),
+        "spans_recorded": sum(sum(c.values()) for c in counts.values()),
+    }
+    # flight-recorder roundtrip on the SAME workload: a forced
+    # post-warmup recompile (wider prompt -> fresh work-list bucket)
+    # must dump, and the dump must load through the schema validator
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="serve_trace_")
+    try:
+        cb_on.declare_warm()
+        obs.get_flight_recorder().arm(d, window_s=120.0)
+        # two concurrent longer prompts push the work list past every
+        # bucket the fixed workload warmed — a guaranteed fresh
+        # (work, chunk) pair, i.e. a post-warmup recompile
+        big = GenerationRequest(rng.integers(1, V, 23).astype(np.int32),
+                                2, request_id="trbig")
+        big2 = GenerationRequest(rng.integers(1, V, 21).astype(np.int32),
+                                 2, request_id="trbig2")
+        cb_on.submit(big)
+        cb_on.submit(big2)
+        cb_on.run()
+        dumps = [f for f in os.listdir(d)
+                 if f.startswith("flightrec_post_warmup_recompile")]
+        # both keys ALWAYS present: a regression that stops the dump
+        # must gate as a MISMATCH, not crash check_trace on a KeyError
+        out["flight_dump_written"] = len(dumps) >= 1
+        out["flight_dump_loads"] = False
+        if dumps:
+            dump = obs.load_dump(os.path.join(d, dumps[0]))
+            out["flight_dump_loads"] = (
+                dump["reason"] == "post_warmup_recompile"
+                and big.request_id in dump["requests"])
+    finally:
+        obs.get_flight_recorder().disarm()
+        shutil.rmtree(d, ignore_errors=True)
+    print(f"trace leg: {steps_on} steps traced vs {steps_off} untraced, "
+          f"{out['spans_recorded']} spans, "
+          f"{out['new_buckets_after_warmup']} new buckets after warmup; "
+          f"wall {wall_on:.0f} vs {wall_off:.0f} ms"
+          + (" [interpret: wall times the interpreter, not the tracer]"
+             if not on_tpu else ""))
+    return out
+
+
+TRACE_KEYS = ("chunk", "workload", "steps_traced", "steps_untraced",
+              "new_buckets_after_warmup", "span_counts",
+              "expected_span_counts", "spans_recorded",
+              "flight_dump_written", "flight_dump_loads")
+
+
+def check_trace(base):
+    """CI gate for the tracing leg: span counts per request are host
+    math (ceil(P/chunk) prefill spans, N-1 decodes), tracing must not
+    change the step count, and the flight-recorder roundtrip must
+    hold — all against the committed baseline."""
+    cur = trace_leg()
+    bad = [k for k in TRACE_KEYS if cur[k] != base[k]]
+    for k in bad:
+        print(f"MISMATCH {k}: current {cur[k]!r} != baseline {base[k]!r}")
+    if cur["steps_traced"] != cur["steps_untraced"]:
+        print(f"REGRESSION: tracing changed the step count "
+              f"({cur['steps_traced']} vs {cur['steps_untraced']})")
+        bad.append("steps_traced")
+    if cur["span_counts"] != cur["expected_span_counts"]:
+        print("REGRESSION: span counts drifted from the host-math "
+              f"expectation: {cur['span_counts']} vs "
+              f"{cur['expected_span_counts']}")
+        bad.append("span_counts")
+    if cur["new_buckets_after_warmup"] != 0:
+        print("REGRESSION: tracing compiled "
+              f"{cur['new_buckets_after_warmup']} fresh buckets after "
+              "warmup")
+        bad.append("new_buckets_after_warmup")
+    if bad:
+        return 1
+    print(f"trace leg OK: {cur['steps_traced']} steps (tracing on == "
+          f"off), {cur['spans_recorded']} spans, span counts exact, "
+          "flight dump loads")
+    return 0
+
+
 GRID_KEYS = ("total_kv_blocks", "work_items", "legacy_grid_steps",
              "ragged_grid_steps", "pack", "context_lens")
 
@@ -600,6 +761,12 @@ def main():
                          "first-token at prompt lengths 64/256/512 "
                          "(works on CPU via interpret mode; minutes, "
                          "the unchunked leg really pays P steps)")
+    ap.add_argument("--trace", action="store_true",
+                    help="per-request lifecycle tracing: span counts "
+                         "per request (ceil(P/chunk) prefill spans), "
+                         "tracing-on vs -off step parity, overhead wall "
+                         "times, and a flight-recorder dump roundtrip "
+                         "(works on CPU via interpret mode)")
     ap.add_argument("--chunk", type=int, default=64,
                     help="prefill chunk size for the --prefill leg")
     args = ap.parse_args()
@@ -615,12 +782,16 @@ def main():
         if "spec" in base:
             ran = True
             rc |= check_spec(base["spec"])
+        if "trace" in base:
+            ran = True
+            rc |= check_trace(base["trace"])
         if not ran:
-            print(f"{args.check}: no 'ragged' or 'spec' section to gate")
+            print(f"{args.check}: no 'ragged'/'spec'/'trace' section "
+                  "to gate")
             return 1
         return rc
     if args.ragged or args.metrics or args.prefill or args.spec \
-            or args.no_spec:
+            or args.no_spec or args.trace:
         out = {}
         if args.ragged:
             out["ragged"] = ragged_leg()
@@ -648,6 +819,9 @@ def main():
             # engine too, and the process-wide registry must not count
             # its steps into the committed metrics snapshot
             out["prefill"] = prefill_leg(chunk=args.chunk)
+        if args.trace:
+            # after --metrics for the same reason as --prefill
+            out["trace"] = trace_leg()
         if args.json:
             with open(args.json, "w") as f:
                 json.dump(out, f, indent=1)
