@@ -178,7 +178,7 @@ def chrome_span_events(recorder=None, pid=None, since_us=None,
     ``"M"`` thread_name metadata naming each lane — merged by
     Profiler._export_chrome into the host-range + counter stream. Every
     event carries the full profiler key set (the export contract)."""
-    recorder = recorder or get_tracer()
+    recorder = recorder if recorder is not None else get_tracer()
     if pid is None:
         pid = os.getpid()
     lanes = {}      # request id -> lane tid, by first appearance
@@ -218,7 +218,8 @@ def request_summary(request, spans=None, recorder=None):
     stalls, decode/spec accounting, effective TPOT. Works on live rings
     and on flight-recorder dumps (pass the dump's `spans` list)."""
     if spans is None:
-        spans = (recorder or get_tracer()).spans(request=request)
+        spans = (recorder if recorder is not None
+                 else get_tracer()).spans(request=request)
     else:
         spans = [s for s in spans if s.get("request") == request]
     out = {
@@ -331,7 +332,9 @@ class FlightRecorder:
         leaves a `flight_trigger` event in the ring (cheap, so even an
         unarmed process shows the anomaly on its timeline) and counts
         dumps into flight_recorder_dumps_total{reason}."""
-        rec = self.recorder or get_tracer()
+        # `or` would skip an EMPTY custom ring (SpanRecorder.__len__)
+        rec = self.recorder if self.recorder is not None \
+            else get_tracer()
         rec.event("flight_trigger", request=request, reason=str(reason),
                   **context)
         now = time.perf_counter()
@@ -348,8 +351,24 @@ class FlightRecorder:
         path = os.path.join(
             out_dir, f"flightrec_{reason}_{int(time.time() * 1000)}_"
                      f"{seq}.json")
-        self._write(path, reason, rec, request, context,
-                    since_us=(now - self.window_s) * 1e6)
+        try:
+            self._write(path, reason, rec, request, context,
+                        since_us=(now - self.window_s) * 1e6)
+        except OSError as e:
+            # A diagnostics dump must never take down the serving step or
+            # the watchdog thread (full disk / unwritable dir). Leave the
+            # failure on the timeline, give the cooldown back so the next
+            # anomaly retries, and count it.
+            rec.event("flight_dump_failed", request=request,
+                      reason=str(reason), error=str(e))
+            with self._lock:
+                if self._last.get(reason) == now:
+                    del self._last[reason]
+            get_registry().counter(
+                "flight_recorder_dump_failures_total",
+                help="anomaly dumps that failed to write",
+                labels=("reason",)).labels(reason=str(reason)).inc()
+            return None
         with self._lock:
             self.dumps.append(path)
         get_registry().counter(
@@ -387,8 +406,13 @@ class FlightRecorder:
         """Unconditional dump to an explicit path (no arming, no
         cooldown): the whole ring, not just the window — what
         serve_llama --trace and the bench trace leg write."""
-        rec = self.recorder or get_tracer()
-        return self._write(path, reason, rec, request, context)
+        # `or` would skip an EMPTY custom ring (SpanRecorder.__len__)
+        rec = self.recorder if self.recorder is not None \
+            else get_tracer()
+        out = self._write(path, reason, rec, request, context)
+        with self._lock:
+            self.dumps.append(out)
+        return out
 
 
 _flight = FlightRecorder()

@@ -4,6 +4,10 @@ The CPU PJRT backend plays the fake-device role of test/custom_runtime/."""
 import numpy as np
 import pytest
 
+# Tier-1 window: this file is heavy on the 2-core CPU box and runs
+# in the `pytest -m slow` tier (split recorded in BASELINE.md).
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import (Shard, Replicate, Partial, ProcessMesh,

@@ -3,6 +3,10 @@ numerics parity is the core gate, SURVEY.md M3)."""
 import numpy as np
 import pytest
 
+# Tier-1 window: this file is heavy on the 2-core CPU box and runs
+# in the `pytest -m slow` tier (split recorded in BASELINE.md).
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu import nn
 import paddle_tpu.jit as jit

@@ -10,6 +10,10 @@ import time
 
 import pytest
 
+# Tier-1 window: this file is heavy on the 2-core CPU box and runs
+# in the `pytest -m slow` tier (split recorded in BASELINE.md).
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu import native
 from paddle_tpu.distributed.auto_tuner import (AutoTuner, TunerConfig,

@@ -8,6 +8,10 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+# Tier-1 window: this file is heavy on the 2-core CPU box and runs
+# in the `pytest -m slow` tier (split recorded in BASELINE.md).
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.ops.pallas import flash_attention as fa
 from paddle_tpu.ops.pallas import flashmask as fm

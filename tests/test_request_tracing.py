@@ -355,6 +355,43 @@ def test_tpot_slo_breach_dumps(tmp_path):
     assert dump["context"]["tpot_mean_s"] > 0
 
 
+def test_trigger_write_failure_does_not_raise(tmp_path):
+    """A dump-write failure (full disk / unwritable dir) must never
+    propagate into the serving step or the watchdog thread: trigger()
+    swallows the OSError, leaves a flight_dump_failed event on the
+    timeline, counts it, and gives the cooldown back so the next
+    anomaly retries instead of being silently suppressed."""
+    rec = tracing.SpanRecorder()
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a file where the dump dir should be")
+    fr = tracing.FlightRecorder(recorder=rec)
+    fr.arm(blocker / "dumps")            # makedirs hits NotADirectoryError
+    assert fr.trigger("kv_alloc_failure", request="victim") is None
+    assert fr.dumps == []
+    names = [s["name"] for s in rec.spans()]
+    assert "flight_dump_failed" in names
+    fails = obs.get_registry().get("flight_recorder_dump_failures_total")
+    assert fails.labels(reason="kv_alloc_failure").value >= 1
+    # the failed attempt must NOT consume the per-reason cooldown
+    fr.arm(tmp_path)
+    path = fr.trigger("kv_alloc_failure", request="victim")
+    assert path is not None and fr.dumps == [path]
+    assert tracing.load_dump(path)["reason"] == "kv_alloc_failure"
+
+
+def test_manual_dump_records_path(tmp_path):
+    """dump_to/write_dump participate in the `dumps` bookkeeping the
+    attribute promises ("paths written this process"), not just
+    trigger()."""
+    rec = tracing.SpanRecorder()
+    rec.event("tick", request="r")
+    fr = tracing.FlightRecorder(recorder=rec)
+    out = str(tmp_path / "manual.json")
+    assert fr.dump_to(out) == out
+    assert fr.dumps == [out]
+    assert tracing.load_dump(out)["reason"] == "manual"
+
+
 # -- exporters / profiler merge --------------------------------------------
 
 def test_chrome_span_events_per_request_lanes():

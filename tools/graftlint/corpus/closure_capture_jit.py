@@ -1,4 +1,4 @@
-# graftlint-corpus-expect: GL108 GL108 GL108
+# graftlint-corpus-expect: GL108 GL108 GL108 GL108
 """Jitted functions closing over large arrays — the int4
 compile-payload bloat hazard (inference/__init__.py documents the real
 one by hand: packed weights captured by closure would inline ~350 MB of
@@ -36,6 +36,16 @@ class Engine:
         self._decode = jax.jit(decode)
 
 
+@jax.jit
+def masked_step(x):
+    def tweak(v):
+        _SCALES = v * 2.0       # nested-scope local: its own business
+        return _SCALES
+    # GL108: the OUTER body still closes over the module-level _SCALES —
+    # the nested function's binding must not mask the capture
+    return tweak(x) + _SCALES
+
+
 # ---- clean tripwires (must raise nothing) -------------------------------
 
 _HIDDEN_DIM = 1024          # scalar config: not an array call
@@ -50,6 +60,16 @@ def good_step(x, w):
 def eager_helper(x):
     # not jitted: eager reads of the module array are ordinary code
     return x @ _PACKED_WEIGHTS.astype(np.float32)
+
+
+@jax.jit
+def shadow_helper_step(x):
+    def project(v):
+        # the nested fn's OWN local shadows the module array: clean —
+        # this read resolves to the local, nothing is captured
+        _PACKED_WEIGHTS = jnp.eye(4)
+        return v @ _PACKED_WEIGHTS
+    return project(x)
 
 
 class CleanEngine:

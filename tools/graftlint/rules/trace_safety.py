@@ -349,23 +349,48 @@ def _module_array_names(ctx):
     return out
 
 
-def _local_names(fn):
-    """Names the function binds itself: parameters plus anything
-    assigned/bound in its body (a local shadowing a module-level array
-    is the function's own business)."""
-    a = fn.args
+def _param_names(a):
     names = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
     if a.vararg:
         names.add(a.vararg.arg)
     if a.kwarg:
         names.add(a.kwarg.arg)
-    for node in ast.walk(fn):
+    return names
+
+
+def _own_scope_walk(fn):
+    """Walk the nodes of `fn`'s OWN lexical scope: everything reachable
+    without crossing into a nested def/lambda body. The nested node
+    itself is yielded (its name binds here, and its decorators/argument
+    defaults evaluate here) — its body is a separate scope."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            stack.extend(getattr(node, "decorator_list", ()))
+            stack.extend(d for d in node.args.defaults if d is not None)
+            stack.extend(d for d in node.args.kw_defaults
+                         if d is not None)
+        else:
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _local_names(fn):
+    """Names the function binds in its OWN scope: parameters plus
+    anything assigned/bound directly in its body (a local shadowing a
+    module-level array is the function's own business). Names bound only
+    inside a nested def/lambda live in that scope and must NOT mask an
+    outer capture — GL108 resolves nested scopes recursively."""
+    names = _param_names(fn.args)
+    for node in _own_scope_walk(fn):
         if isinstance(node, ast.Name) and isinstance(node.ctx,
                                                      (ast.Store,
                                                       ast.Del)):
             names.add(node.id)
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and node is not fn:
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             names.add(node.name)
     return names
 
@@ -380,36 +405,47 @@ def jit_closure_capture(ctx):
     as arguments (donate if appropriate)."""
     module_arrays = _module_array_names(ctx)
     for fn in _jitted_functions(ctx):
-        locals_ = _local_names(fn)
         flagged_attrs = set()
         flagged_names = set()
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Attribute) \
-                    and isinstance(node.value, ast.Name) \
-                    and node.value.id == "self" \
-                    and "self" not in locals_ \
-                    and node.attr not in flagged_attrs:
-                flagged_attrs.add(node.attr)
-                yield ctx.finding(
-                    "GL108", node,
-                    f"jitted `{fn.name}` closes over `self.{node.attr}`: "
-                    "a captured array is baked into the compiled program "
-                    "as a constant (compile-payload bloat — the int4 "
-                    "case was ~350 MB) and later updates to the "
-                    "attribute never reach the compiled code — pass it "
-                    "as an argument (inference/__init__.py passes "
-                    "`self._w` as the `w` arg for exactly this "
-                    "reason)"), node
-            elif isinstance(node, ast.Name) \
-                    and isinstance(node.ctx, ast.Load) \
-                    and node.id in module_arrays \
-                    and node.id not in locals_ \
-                    and node.id not in flagged_names:
-                flagged_names.add(node.id)
-                yield ctx.finding(
-                    "GL108", node,
-                    f"jitted `{fn.name}` closes over module-level array "
-                    f"`{node.id}`: the array is inlined into the "
-                    "compiled program as a constant (payload bloat + "
-                    "silently stale on rebind) — pass it as an "
-                    "argument"), node
+        # (scope, names visible in it) — nested defs/lambdas inherit the
+        # enclosing locals (closure semantics) plus their own bindings,
+        # so an inner local never masks an OUTER capture and an inner
+        # fn's own shadow of a module array is its own business.
+        scopes = [(fn, _local_names(fn))]
+        while scopes:
+            scope, locals_ = scopes.pop()
+            for node in _own_scope_walk(scope):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    scopes.append(
+                        (node, locals_ | _local_names(node)))
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self" \
+                        and "self" not in locals_ \
+                        and node.attr not in flagged_attrs:
+                    flagged_attrs.add(node.attr)
+                    yield ctx.finding(
+                        "GL108", node,
+                        f"jitted `{fn.name}` closes over "
+                        f"`self.{node.attr}`: "
+                        "a captured array is baked into the compiled "
+                        "program as a constant (compile-payload bloat — "
+                        "the int4 case was ~350 MB) and later updates "
+                        "to the attribute never reach the compiled code "
+                        "— pass it as an argument "
+                        "(inference/__init__.py passes `self._w` as "
+                        "the `w` arg for exactly this reason)"), node
+                elif isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.id in module_arrays \
+                        and node.id not in locals_ \
+                        and node.id not in flagged_names:
+                    flagged_names.add(node.id)
+                    yield ctx.finding(
+                        "GL108", node,
+                        f"jitted `{fn.name}` closes over module-level "
+                        f"array `{node.id}`: the array is inlined into "
+                        "the compiled program as a constant (payload "
+                        "bloat + silently stale on rebind) — pass it "
+                        "as an argument"), node
