@@ -28,11 +28,23 @@ def run_continuous(engine, rng, V, args):
                                   max_batch=args.batch,
                                   prefill_chunk=args.prefill_chunk,
                                   token_budget=args.token_budget,
-                                  spec_k=args.spec_k)
+                                  spec_k=args.spec_k,
+                                  prefix_cache=args.prefix_cache)
     free0 = cb.allocator.num_free
     lengths = [(5, 12), (23, 8), (3, 30), (17, 17), (9, 5), (40, 11)]
-    reqs = [GenerationRequest(rng.integers(1, V, p).astype(np.int32), n)
-            for p, n in lengths]
+    if args.prefix_cache:
+        # shared system preamble: every request repeats the same
+        # 48-token prefix — only the FIRST prefills it; the rest map
+        # the cached blocks straight into their block tables
+        preamble = rng.integers(1, V, 48).astype(np.int32)
+        prompts = [np.concatenate([preamble,
+                                   rng.integers(1, V, p).astype(np.int32)])
+                   for p, _ in lengths]
+        lengths = [(len(pr), n) for pr, (_, n) in zip(prompts, lengths)]
+    else:
+        prompts = [rng.integers(1, V, p).astype(np.int32)
+                   for p, _ in lengths]
+    reqs = [GenerationRequest(pr, n) for pr, (_, n) in zip(prompts, lengths)]
     for r in reqs:
         cb.submit(r)
     t0 = time.perf_counter()
@@ -42,11 +54,19 @@ def run_continuous(engine, rng, V, args):
     print(f"continuous batching: {len(reqs)} ragged requests "
           f"(prompts {[p for p, _ in lengths]}) -> {tok} tokens in "
           f"{cb._step_count} steps, {dt * 1000:.1f} ms; "
-          f"free blocks {cb.allocator.num_free}/{free0}")
+          f"free blocks {cb.allocator.num_free}"
+          + (f" + {cb.allocator.num_pooled} pooled" if args.prefix_cache
+             else "")
+          + f"/{free0}")
     drafted = sum(r.spec_drafted for r in reqs)
     if drafted:
         print(f"  speculative: {sum(r.spec_accepted for r in reqs)}"
               f"/{drafted} drafts accepted")
+    if args.prefix_cache:
+        cached = {r.request_id: cb.explain(r.request_id)
+                  ["cached_prefix_tokens"] for r in reqs}
+        print(f"  prefix cache: reused tokens per request {cached} "
+              f"(shared blocks skip their prefill chunks entirely)")
     for r, (p, n) in zip(reqs, lengths):
         print(f"  req {r.request_id} (prompt {p:2d}, max_new {n:2d}): "
               f"{out[r.request_id][:8]}")
@@ -85,6 +105,12 @@ def main():
                     help="speculative decode: up to K prompt-lookup "
                          "draft tokens per decode slot per step "
                          "(greedy only; 0 disables)")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="(--continuous only) content-addressed sharing "
+                         "of full paged-KV blocks across requests: "
+                         "repeated prompt prefixes map cached blocks "
+                         "instead of re-prefilling (copy-on-write on "
+                         "divergence)")
     ap.add_argument("--trace", metavar="OUT.json", default=None,
                     help="(--continuous only) dump per-request lifecycle "
                          "spans + metrics after the run; replay with "

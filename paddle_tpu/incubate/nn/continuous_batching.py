@@ -46,7 +46,20 @@ from ...ops.pallas.paged_attention import (build_ragged_work, default_pack,
                                            next_pow2)
 
 __all__ = ["BlockAllocator", "GenerationRequest", "ContinuousBatchingEngine",
-           "propose_draft_tokens"]
+           "propose_draft_tokens", "block_key"]
+
+
+def block_key(parent, tokens):
+    """Chained content identity of one FULL cache block: structurally
+    `(parent_key, tuple(token ids))`, root parent None. Nested tuples
+    share structure with the parent key (O(1) extra memory per block)
+    and compare by VALUE, so two requests that filled a block with the
+    same tokens after the same prefix get the same key with zero
+    hash-collision risk — the chain makes position implicit, so an
+    identical token window at a different prefix depth gets a different
+    key (its KV really is different: rope positions and attention
+    context differ)."""
+    return (parent, tuple(int(t) for t in tokens))
 
 
 def propose_draft_tokens(tokens, max_k, ngram=2):
@@ -77,11 +90,29 @@ def propose_draft_tokens(tokens, max_k, ngram=2):
 
 
 class BlockAllocator:
-    """Free-list over the paged KV cache's physical blocks.
+    """Refcounted free-list + content-addressed prefix index over the
+    paged KV cache's physical blocks.
 
     Block ids [reserved, num_blocks) are allocatable; ids below `reserved`
     are parking space (idle batch slots point their table row at block 0
-    so the one compiled step program can write SOMEWHERE harmless)."""
+    so the one compiled step program can write SOMEWHERE harmless).
+
+    Every held block carries a refcount: `alloc()` hands out rc=1,
+    `share()`/`acquire()` bump it, `free()` decrements, and the block
+    only leaves a request's hands when rc hits 0. A FULL, immutable
+    block can be `register()`ed under its chained content key
+    (`block_key`) into the hash->block index; a registered block whose
+    refcount drops to 0 parks in an LRU reuse pool instead of the free
+    list — still indexed, resurrectable by `acquire()` — and is only
+    reclaimed (evicted from the index, oldest first) when the free list
+    can't cover an `alloc()`. Allocation fails only when free list AND
+    pool are both empty.
+
+    Invariants (unit-tested directly): freeing a block nobody holds
+    raises instead of corrupting the free list; `num_used` counts
+    PHYSICAL blocks held by requests (pooled blocks are reusable cache,
+    not in use) and is structurally non-negative; `high_water` tracks
+    peak physical use — a block shared by 8 requests counts once."""
 
     def __init__(self, num_blocks, reserved=1):
         if num_blocks <= reserved:
@@ -91,34 +122,134 @@ class BlockAllocator:
         self.reserved = reserved
         self._free = list(range(num_blocks - 1, reserved - 1, -1))
         self._free_set = set(self._free)  # O(1) double-free check
-        self.high_water = 0     # max blocks ever simultaneously in use
+        self._ref = {}          # block -> refcount, held blocks only
+        self._index = {}        # block_key -> physical block (full blocks)
+        self._key_of = {}       # registered block -> its key
+        self._pool = collections.OrderedDict()  # rc==0 but reusable, LRU
+        self.high_water = 0     # max PHYSICAL blocks ever in use at once
+        self.evictions = 0      # pooled blocks reclaimed for fresh allocs
 
     @property
     def num_free(self):
         return len(self._free)
 
     @property
-    def num_used(self):
-        return (self.num_blocks - self.reserved) - len(self._free)
+    def num_pooled(self):
+        return len(self._pool)
 
-    def alloc(self):
-        if not self._free:
-            _metrics.kv_alloc_failures().inc()
-            raise RuntimeError("BlockAllocator: out of cache blocks")
-        b = self._free.pop()
-        self._free_set.discard(b)
+    @property
+    def num_available(self):
+        """Blocks an alloc() can still produce: free list + reclaimable
+        pool — what admission reservations must check against."""
+        return len(self._free) + len(self._pool)
+
+    @property
+    def num_used(self):
+        """PHYSICAL blocks held by requests (rc >= 1). Pooled blocks are
+        cache, not use; a block shared by N requests counts once."""
+        return (self.num_blocks - self.reserved) - len(self._free) \
+            - len(self._pool)
+
+    @property
+    def num_shared(self):
+        """Physical blocks referenced by more than one request."""
+        return sum(1 for rc in self._ref.values() if rc > 1)
+
+    @property
+    def num_registered(self):
+        """Blocks resident in the prefix index (held or pooled)."""
+        return len(self._index)
+
+    def refcount(self, b):
+        return self._ref.get(b, 0)
+
+    def _bump_high_water(self):
         if self.num_used > self.high_water:
             self.high_water = self.num_used
+
+    def alloc(self):
+        if self._free:
+            b = self._free.pop()
+            self._free_set.discard(b)
+        elif self._pool:
+            # reclaim the LRU-oldest reusable prefix block BEFORE
+            # failing: cached history is worth strictly less than a
+            # live request's next token
+            b, key = self._pool.popitem(last=False)
+            del self._index[key]
+            del self._key_of[b]
+            self.evictions += 1
+            _metrics.prefix_cache_evictions().inc()
+        else:
+            _metrics.kv_alloc_failures().inc()
+            raise RuntimeError("BlockAllocator: out of cache blocks")
+        self._ref[b] = 1
+        self._bump_high_water()
         return b
 
     def free(self, blocks):
         for b in blocks:
             if not (self.reserved <= b < self.num_blocks):
                 raise ValueError(f"freeing out-of-pool block {b}")
-            if b in self._free_set:
-                raise ValueError(f"double free of block {b}")
-            self._free.append(b)
-            self._free_set.add(b)
+            rc = self._ref.get(b, 0)
+            if rc < 1:
+                where = ("already on the free list"
+                         if b in self._free_set else
+                         "parked in the reuse pool" if b in self._pool
+                         else "never allocated")
+                raise ValueError(
+                    f"freeing unallocated block {b} ({where})")
+            if rc > 1:
+                self._ref[b] = rc - 1
+                continue
+            del self._ref[b]
+            key = self._key_of.get(b)
+            if key is not None:
+                # registered: park, newest at the LRU tail, still
+                # indexed — acquire() resurrects, alloc() reclaims
+                self._pool[b] = key
+            else:
+                self._free.append(b)
+                self._free_set.add(b)
+
+    def share(self, b):
+        """One more holder of a live block (copy-on-write bookkeeping)."""
+        if self._ref.get(b, 0) < 1:
+            raise ValueError(f"sharing unallocated block {b}")
+        self._ref[b] += 1
+        return b
+
+    def register(self, b, key):
+        """Publish a held, FULL, immutable block under its content key.
+        First writer wins: returns False (no-op) when the key is already
+        indexed by another block or the block already carries a key."""
+        if self._ref.get(b, 0) < 1:
+            raise ValueError(f"registering unallocated block {b}")
+        if key in self._index or b in self._key_of:
+            return False
+        self._index[key] = b
+        self._key_of[b] = key
+        return True
+
+    def lookup(self, key):
+        """Index probe without side effects: block id or None."""
+        return self._index.get(key)
+
+    def acquire(self, key):
+        """Index hit -> the physical block with its refcount bumped
+        (resurrected from the reuse pool when no request holds it);
+        miss -> None."""
+        b = self._index.get(key)
+        if b is None:
+            return None
+        rc = self._ref.get(b, 0)
+        if rc == 0:
+            del self._pool[b]
+            self._ref[b] = 1
+            self._bump_high_water()
+        else:
+            self._ref[b] = rc + 1
+        return b
 
 
 class GenerationRequest:
@@ -150,6 +281,16 @@ class GenerationRequest:
         # drafts proposed for / accepted by this request's verification
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # prefix-cache bookkeeping (engine-owned): prompt tokens whose KV
+        # was MAPPED from shared blocks instead of prefilled, the chain
+        # key after the blocks registered/matched so far, and how many
+        # leading blocks that chain covers
+        self.cached_prefix = 0
+        self._prefix_key = None
+        self._prompt_keys = None    # chained key per full prompt block
+        self._registered = 0
+        self._miss_frontier = -1    # last prompt position a miss counted at
+        self._cow_reserve = 0       # shared blocks this request may yet COW
         # latency bookkeeping (host monotonic clock; set by the engine)
         self.submit_time = None
         self.admit_time = None
@@ -213,6 +354,27 @@ class ContinuousBatchingEngine:
     exceeds the SLO, `prefill_chunk` shrinks one power-of-two bucket
     (never below `min_prefill_chunk`) — trading TTFT headroom for
     decode latency under load, the ROADMAP's "next scheduler lever".
+
+    `prefix_cache=True` turns on automatic prefix caching: every FULL
+    block a request commits (prompt or generated tokens) is published
+    into a content-addressed index (`block_key` chains), and an
+    incoming request's prompt is matched against it block by block —
+    hits map the shared physical block straight into the block table
+    and the scheduler only grants prefill chunks for the uncached
+    suffix, so N requests sharing a system prompt pay ONE chunk sweep
+    over it. Matching re-runs each step while a slot is mid-prefill
+    (wavefront: a follower maps each block the step after its leader
+    registers it) and the scheduler defers a slot whose next block an
+    earlier slot is computing THIS step, so even concurrently-submitted
+    duplicates dedup. Writes into a block other requests still read
+    trigger copy-on-write (`_cow_block`); retired requests' registered
+    blocks park in an LRU reuse pool that serves conversation-resume
+    hits until the free list runs dry. Token-exact by construction:
+    mapped KV is the same KV the request would have computed. Block-
+    table contents are data, not shape — the bucketed (work-list,
+    chunk-width) compile keys are untouched. Default OFF: the committed
+    serving baselines predate the reuse pool's effect on the free-list
+    gauges.
     """
 
     SLO_WINDOW = 8      # decode-TPOT samples per controller decision
@@ -220,7 +382,7 @@ class ContinuousBatchingEngine:
     def __init__(self, engine, num_blocks, block_size, max_batch=8,
                  temperature=0.0, top_p=1.0, seed=0, prefill_chunk=64,
                  token_budget=None, spec_k=0, spec_ngram=2,
-                 tpot_slo=None, min_prefill_chunk=64):
+                 tpot_slo=None, min_prefill_chunk=64, prefix_cache=False):
         import jax
 
         self.engine = engine
@@ -282,6 +444,17 @@ class ContinuousBatchingEngine:
         # anomaly the flight recorder dumps on (admission recompiled)
         self._warm = False
         self._sched_info = {}
+        # automatic prefix caching: content-addressed COW sharing of
+        # full prompt/generation blocks across requests. OFF by default:
+        # the committed serving baselines (step counts, free-pool
+        # gauges) predate the reuse pool and must stay byte-stable.
+        self._prefix_on = bool(prefix_cache)
+        self._pending_stalls = set()
+        # engine-local mirror of the prefix-cache counters (the process
+        # registry aggregates across engines; tests and the bench want
+        # THIS engine's numbers)
+        self.cache_stats = {"hit_blocks": 0, "miss_blocks": 0,
+                            "cow_copies": 0}
         kvh = self.caches[0].shape[1]
         num_q = engine.num_heads
         self._pack = default_pack(self.max_batch, num_q // kvh)
@@ -349,12 +522,22 @@ class ContinuousBatchingEngine:
         _metrics.kv_blocks_high_water().set(self.allocator.high_water)
         _metrics.serve_inflight().set(self.num_active)
         _metrics.serve_queue_depth().set(len(self.queue))
+        if self._prefix_on:
+            _metrics.kv_blocks_shared().set(self.allocator.num_shared)
+            _metrics.kv_blocks_prefix_resident().set(
+                self.allocator.num_registered)
 
     def _admit(self):
         # FIFO with worst-case reservation: the head request waits until
-        # its full footprint fits, so admitted requests always finish
+        # its full footprint fits, so admitted requests always finish.
+        # Matched shared blocks count as held (len(r.blocks)), and a
+        # request that mapped a shared tail block it must still write
+        # into keeps one COW block reserved on top; the pool side is
+        # num_available because alloc() reclaims the LRU reuse pool
+        # before failing.
         reserved = sum(
             r.blocks_needed(self.block_size) - len(r.blocks)
+            + r._cow_reserve
             for r in self.slots if r is not None)
         for i in range(self.max_batch):
             if not self.queue:
@@ -362,14 +545,15 @@ class ContinuousBatchingEngine:
             if self.slots[i] is not None:
                 continue
             need = self.queue[0].blocks_needed(self.block_size)
-            if reserved + need > self.allocator.num_free:
+            if reserved + need > self.allocator.num_available:
                 # KV starvation: the head request is blocked on pool
                 # capacity, not on a free slot — the queue-wait outlier
                 # the flight recorder's timeline should explain
                 _tracing.get_tracer().event(
                     "admit_blocked", request=self.queue[0].request_id,
                     blocks_needed=need, blocks_reserved=reserved,
-                    blocks_free=self.allocator.num_free)
+                    blocks_free=self.allocator.num_free,
+                    blocks_available=self.allocator.num_available)
                 break
             req = self.queue.popleft()
             reserved += need
@@ -378,6 +562,23 @@ class ContinuousBatchingEngine:
             req.generated = []
             req.spec_drafted = 0
             req.spec_accepted = 0
+            req.cached_prefix = 0
+            req._prefix_key = None
+            req._registered = 0
+            if self._prefix_on:
+                # the prompt's chained key ladder is a pure function of
+                # the prompt: hash it ONCE here so the per-step
+                # scheduler dedup and wavefront probes index into it
+                # instead of rehashing up to a chunk of tokens per slot
+                # per step
+                ks, k = [], None
+                bs = self.block_size
+                for b in range(len(req.prompt) // bs):
+                    k = block_key(k, req.prompt[b * bs:(b + 1) * bs])
+                    ks.append(k)
+                req._prompt_keys = ks
+            req._miss_frontier = -1
+            req._cow_reserve = 0
             req.admit_time = time.monotonic()
             if req.submit_time is not None:
                 _metrics.serve_queue_wait().observe(
@@ -391,6 +592,132 @@ class ContinuousBatchingEngine:
             self.slots[i] = req
             self.tables[i] = 0
             self.lens[i] = 0
+
+    # -- automatic prefix caching -------------------------------------------
+
+    def _extend_match(self, i):
+        """Map full prompt blocks already in the prefix index straight
+        into slot i's block table: those tokens' KV exists on some
+        shared physical block, so the scheduler never grants them a
+        prefill chunk. Runs at admission AND every step while the slot
+        is block-aligned mid-prefill — the wavefront case: a follower
+        whose prefix a leader is computing one chunk ahead maps each
+        block the step after the leader registers it, paying zero model
+        passes for the whole shared prefix.
+
+        When the ENTIRE prompt is covered by index hits, the last token
+        is handed back to the prefill scheduler anyway (its forward pass
+        produces the first output token's logits); that one-token write
+        lands INSIDE the shared tail block, which is exactly the
+        copy-on-write trigger `_cow_block` resolves before the step
+        writes. Returns the number of tokens newly mapped."""
+        req = self.slots[i]
+        bs = self.block_size
+        mapped = 0
+        while True:
+            p = req.progress
+            if p % bs != 0 or p + bs > len(req.prompt):
+                break
+            key = req._prompt_keys[p // bs]
+            blk = self.allocator.acquire(key)
+            if blk is None:
+                if p > req._miss_frontier:
+                    # one miss per prompt position per request: the
+                    # wavefront re-probes the same position every step
+                    # until the leader registers it, which is not N
+                    # misses
+                    req._miss_frontier = p
+                    self.cache_stats["miss_blocks"] += 1
+                    _metrics.prefix_cache_misses().inc()
+                break
+            idx = len(req.blocks)
+            req.blocks.append(blk)
+            self.tables[i, idx] = blk
+            req._prefix_key = key
+            req._registered += 1
+            req.progress += bs
+            self.lens[i] += bs
+            mapped += bs
+            self.cache_stats["hit_blocks"] += 1
+            _metrics.prefix_cache_hits().inc()
+        if mapped:
+            if req.progress == len(req.prompt):
+                # whole prompt cached: leave the LAST prompt token to
+                # the scheduler — sampling the first output token needs
+                # its forward pass. progress stays mid-block, so the
+                # write goes through COW on the shared tail block.
+                req.progress -= 1
+                self.lens[i] -= 1
+                mapped -= 1
+                req._cow_reserve = 1
+            req.cached_prefix += mapped
+            _tracing.get_tracer().event(
+                "cache_hit", request=req.request_id, tokens=mapped,
+                total=req.cached_prefix)
+        return mapped
+
+    def _cow_block(self, i, idx):
+        """Copy-on-write: slot i must append into block-table entry
+        `idx` but other holders still read the physical block there —
+        duplicate it (one jitted all-layer copy, keyed once ever) and
+        retarget the slot at the private copy. The old block keeps its
+        index registration and remaining holders; the copy is
+        unregistered (its content is about to diverge)."""
+        req = self.slots[i]
+        old = req.blocks[idx]
+        try:
+            new = self.allocator.alloc()
+        except RuntimeError:
+            # admission reserved the COW footprint (_cow_reserve), so
+            # this alloc cannot fail — if it does (a reservation bug,
+            # an injected fault), dump the timeline like the step's
+            # block-grow guard does, then re-raise
+            _tracing.get_tracer().event(
+                "stall_alloc", request=req.request_id,
+                blocks_held=len(req.blocks),
+                blocks_free=self.allocator.num_free,
+                cow_block_index=idx)
+            _tracing.get_flight_recorder().trigger(
+                "kv_alloc_failure", request=req.request_id,
+                step=self._step_count,
+                blocks_free=self.allocator.num_free)
+            raise
+        self.caches = self.engine._paged_copy(
+            self.caches, np.int32(old), np.int32(new))
+        self.allocator.free([old])      # decref; other holders keep it
+        req.blocks[idx] = new
+        self.tables[i, idx] = new
+        req._cow_reserve = 0
+        self.cache_stats["cow_copies"] += 1
+        _metrics.prefix_cache_cow().inc()
+        _tracing.get_tracer().event(
+            "cow_copy", request=req.request_id, block_index=idx,
+            src_block=old, dst_block=new)
+        return new
+
+    def _register_full_blocks(self, i):
+        """Publish slot i's newly FULL blocks into the prefix index.
+        Runs after the step's accept/rewind settled lens, so every
+        registered block is immutable: its tokens are committed prompt
+        or committed generations (a rejected speculative span can never
+        have been registered). Generated tokens register too — that is
+        the conversation-resume path: a follow-up request whose prompt
+        embeds this reply maps these blocks straight from the index."""
+        req = self.slots[i]
+        bs = self.block_size
+        full = int(self.lens[i]) // bs
+        if full <= req._registered:
+            return
+        # token at position p is seq[p]: the prompt, then every
+        # generated token except the newest (which has not been fed —
+        # and so not appended — yet); lens never covers it
+        seq = req.prompt + req.generated
+        key = req._prefix_key
+        for k in range(req._registered, full):
+            key = block_key(key, seq[k * bs:(k + 1) * bs])
+            self.allocator.register(req.blocks[k], key)
+        req._prefix_key = key
+        req._registered = full
 
     def _schedule_tokens(self, active):
         """Fill this step's token budget: decode-phase slots are
@@ -421,13 +748,38 @@ class ContinuousBatchingEngine:
                 decode_slots.append(i)
         budget = self.token_budget
         self._sched_info = {}   # prefill slot -> (requested, granted)
+        self._pending_stalls = set()
+        pending = set()     # block keys being computed by a slot THIS step
         for i in active:
             req = self.slots[i]
             rem = len(req.prompt) - req.progress
             if rem <= 0:
                 continue
+            keys = []
+            if self._prefix_on:
+                # concurrent-duplicate dedup: the full blocks this
+                # slot's chunk would complete, by content key. If an
+                # earlier slot is already computing this slot's NEXT
+                # block this very step, defer — next step's wavefront
+                # match maps it for free instead of computing it twice.
+                p = req.progress
+                if p % self.block_size == 0:
+                    lo = p // self.block_size
+                    n_full = min(self.prefill_chunk, rem) \
+                        // self.block_size
+                    keys = req._prompt_keys[lo:lo + n_full]
+                if keys and keys[0] in pending:
+                    self._pending_stalls.add(i)
+                    continue
             room = rem if budget is None else min(rem, max(0, budget - used))
             take = min(self.prefill_chunk, room)
+            if keys and take:
+                # publish only the blocks THIS grant completes: a
+                # budget-truncated (or zero) chunk must not claim keys
+                # it will not compute, or a follower would defer on a
+                # block nobody fills this step (a budget stall would be
+                # misreported as cache-pending dedup)
+                pending.update(keys[:take // self.block_size])
             q_lens[i] = take
             used += take
             # requested = what an unthrottled budget would have granted;
@@ -467,6 +819,16 @@ class ContinuousBatchingEngine:
         self._update_pool_gauges()
         if not active:
             return len(self.queue)
+        if self._prefix_on:
+            # admission + wavefront prefix matching: map every full
+            # prompt block the index already holds before the scheduler
+            # spends budget on it (a just-admitted slot matches its
+            # whole resident prefix; a mid-prefill follower picks up
+            # the block its leader registered last step)
+            for i in active:
+                req = self.slots[i]
+                if req.progress < len(req.prompt):
+                    self._extend_match(i)
         q_lens, drafts = self._schedule_tokens(active)
         for i in active:
             # grow the block list to cover every token this step appends
@@ -477,6 +839,21 @@ class ContinuousBatchingEngine:
             # recorder exists for: dump the timeline, then re-raise
             req = self.slots[i]
             end = int(self.lens[i] + q_lens[i])
+            if self._prefix_on and q_lens[i]:
+                # copy-on-write BEFORE the step writes: any existing
+                # block this step's span appends into that other
+                # holders still read gets a private copy (the
+                # whole-prompt-cached tail block is the natural case)
+                lo = int(self.lens[i]) // self.block_size
+                hi = (end - 1) // self.block_size
+                for idx in range(lo, min(hi + 1, len(req.blocks))):
+                    if self.allocator.refcount(req.blocks[idx]) > 1:
+                        self._cow_block(i, idx)
+                # the first write settled every sharing conflict this
+                # request can ever have (it only appends at its tail):
+                # release the admission-side COW reservation even when
+                # the other holder retired first and no copy was needed
+                req._cow_reserve = 0
             try:
                 while len(req.blocks) * self.block_size < end:
                     blk = self.allocator.alloc()
@@ -569,12 +946,21 @@ class ContinuousBatchingEngine:
             n = int(q_lens[i])
             if n == 0:
                 if req.progress < len(req.prompt):
-                    # budget starvation: the prompt wanted a chunk and
-                    # got zero work-list entries this step
-                    tr.event("stall_budget", request=req.request_id,
-                             prompt_remaining=len(req.prompt)
-                             - req.progress,
-                             token_budget=self.token_budget)
+                    if i in self._pending_stalls:
+                        # deferred on purpose: another slot is computing
+                        # this slot's next block THIS step — next step's
+                        # wavefront match maps it for free
+                        tr.event("stall_cache_pending",
+                                 request=req.request_id,
+                                 prompt_remaining=len(req.prompt)
+                                 - req.progress)
+                    else:
+                        # budget starvation: the prompt wanted a chunk
+                        # and got zero work-list entries this step
+                        tr.event("stall_budget", request=req.request_id,
+                                 prompt_remaining=len(req.prompt)
+                                 - req.progress,
+                                 token_budget=self.token_budget)
                 continue        # starved prefill slot: stalled this step
             if req.progress < len(req.prompt):
                 requested, granted = self._sched_info.get(i, (n, n))
@@ -625,16 +1011,50 @@ class ContinuousBatchingEngine:
             # device-side zeroing FIRST (it reads the table rows that
             # still point at the rejected positions), host block
             # rollback after; one jitted program covers every slot,
-            # keyed by the same bucketed slab width as the step
+            # keyed by the same bucketed slab width as the step.
+            #
+            # Shared-block discipline: a rewound position inside a
+            # block other requests still read must be COPIED, never
+            # zeroed — a retained shared block gets a private COW copy
+            # (the copy absorbs the zeroing), and a shared block the
+            # rollback drops from this slot's table is merely
+            # deref'd: its zero-write is retargeted at the reserved
+            # parking block. The engine's append discipline makes both
+            # cases unreachable in normal flow (drafts only ever land
+            # in exclusively-held blocks), but the rewind must stay
+            # safe against ANY sharing topology.
+            ztab = self.tables
+            if self._prefix_on:
+                shared_drops = []
+                for i, ne, oe in rewinds:
+                    req = self.slots[i]
+                    keep = -(-ne // self.block_size) if ne > 0 else 0
+                    lo = ne // self.block_size
+                    hi = (oe - 1) // self.block_size
+                    for idx in range(lo, min(hi + 1, len(req.blocks))):
+                        if self.allocator.refcount(req.blocks[idx]) > 1:
+                            if idx < keep:
+                                self._cow_block(i, idx)
+                            else:
+                                shared_drops.append((i, idx))
+                if shared_drops:
+                    ztab = self.tables.copy()
+                    for i, idx in shared_drops:
+                        ztab[i, idx] = 0
             new_l = self.lens.copy()
             old_l = self.lens.copy()
             for i, _, oe in rewinds:
                 old_l[i] = oe
             self.caches = self.engine._paged_rewind(
-                self.caches, np.asarray(self.tables), new_l, old_l, c)
+                self.caches, np.asarray(ztab), new_l, old_l, c)
             for i, ne, _ in rewinds:
                 blocks_freed[i] = self._rewind_blocks(i, ne)
             self._update_pool_gauges()
+        if self._prefix_on:
+            # AFTER accept/rewind settled lens: every newly-full block
+            # is immutable now, publish it for other requests to map
+            for i in active:
+                self._register_full_blocks(i)
         # per-request lanes: every slot's work this step as one span
         # over the compiled-step window (the chunk widths, spec
         # accounting, and rewind block frees ride as args) — recorded
@@ -671,7 +1091,11 @@ class ContinuousBatchingEngine:
         rejection hands cache capacity straight back to the pool. The
         device half (`truncate_paged_kv_cache`) already zeroed the
         rejected positions, so a freed-then-reallocated block carries no
-        stale KV. Returns the number of blocks handed back."""
+        stale KV (a SHARED dropped block is the exception: its
+        zero-write was retargeted at the parking block, because the
+        remaining holders still read the content — freeing here just
+        drops this slot's reference). Returns the number of blocks
+        handed back."""
         req = self.slots[i]
         need = -(-new_end // self.block_size) if new_end > 0 else 0
         freed = 0

@@ -555,6 +555,21 @@ class FusedMultiTransformerEngine:
             logits = picked @ w["lm_head"]               # [B, W, V]
             return select(logits, temp, topp, key), [c.data for c in cts]
 
+        def paged_copy(caches, src_block, dst_block):
+            """Duplicate one physical cache block across every layer in
+            ONE jitted program — the serving engine's copy-on-write
+            primitive (automatic prefix caching: a request appending
+            into a block other requests still read writes into a
+            private copy instead). Block ids are traced scalars, so one
+            compile covers every (src, dst) pair ever copied."""
+            from ..ops.pallas.paged_attention import copy_paged_kv_block
+            out = []
+            for c in caches:
+                kc, vc = copy_paged_kv_block(c[0], c[1], src_block,
+                                             dst_block)
+                out.append(jnp.stack([kc, vc]))
+            return out
+
         def paged_rewind(caches, tables, new_lens, old_lens, span):
             """Roll every layer's paged cache back from old_lens to
             new_lens (zero the rejected speculative span) in ONE jitted
@@ -584,6 +599,8 @@ class FusedMultiTransformerEngine:
         self._paged_rewind = _dispatch_span(
             "paged_rewind", jax.jit(paged_rewind, static_argnums=(4,),
                                     donate_argnums=(0,)))
+        self._paged_copy = _dispatch_span(
+            "paged_copy", jax.jit(paged_copy, donate_argnums=(0,)))
 
     def _build_quant_mm(self, weights, dtype):
         """Repack the projection weights into the Pallas kernel's int4

@@ -22,6 +22,8 @@ __all__ = [
     "kv_alloc_failures", "serve_bucket_recompiles",
     "spec_draft_tokens", "spec_accepted_tokens", "spec_accept_len",
     "serve_effective_tokens_per_step", "serve_prefill_chunk",
+    "prefix_cache_hits", "prefix_cache_misses", "prefix_cache_evictions",
+    "prefix_cache_cow", "kv_blocks_shared", "kv_blocks_prefix_resident",
     "train_step_seconds", "train_tokens_total", "train_steps_total",
     "train_tokens_per_s",
 ]
@@ -106,6 +108,50 @@ def serve_bucket_recompiles():
         "serve_bucket_recompiles_total",
         help="first sighting of a padded work-list length (keys one "
              "XLA compile of the decode step)", labels=("bucket",))
+
+
+# -- automatic prefix caching (content-addressed paged-KV sharing) -------
+
+def prefix_cache_hits():
+    return get_registry().counter(
+        "serve_prefix_cache_hits_total",
+        help="full prompt blocks mapped from the shared prefix index "
+             "instead of prefilled (each hit skips block_size tokens "
+             "of prefill compute)")
+
+
+def prefix_cache_misses():
+    return get_registry().counter(
+        "serve_prefix_cache_misses_total",
+        help="full prompt blocks probed against the prefix index and "
+             "not found (counted once per prompt position per request)")
+
+
+def prefix_cache_evictions():
+    return get_registry().counter(
+        "serve_prefix_cache_evictions_total",
+        help="pooled prefix blocks reclaimed (LRU-oldest first) because "
+             "the free list could not cover an allocation")
+
+
+def prefix_cache_cow():
+    return get_registry().counter(
+        "serve_prefix_cache_cow_copies_total",
+        help="copy-on-write block duplications: a request appended into "
+             "a physical block other requests still read")
+
+
+def kv_blocks_shared():
+    return get_registry().gauge(
+        "kv_blocks_shared",
+        help="physical cache blocks referenced by more than one request")
+
+
+def kv_blocks_prefix_resident():
+    return get_registry().gauge(
+        "kv_blocks_prefix_resident",
+        help="physical blocks resident in the prefix index (held by "
+             "requests or parked in the LRU reuse pool)")
 
 
 # -- speculative decode (prompt-lookup drafts + budgeted verify) ---------

@@ -232,7 +232,9 @@ def request_summary(request, spans=None, recorder=None):
         "prompt_tokens": None,
         "generated_tokens": None,
         "decode_steps": 0,
-        "stalls": {"budget": 0, "alloc": 0, "admit_blocked": 0},
+        "cached_prefix_tokens": 0,
+        "stalls": {"budget": 0, "alloc": 0, "admit_blocked": 0,
+                   "cache_pending": 0},
         "spec": {"drafted": 0, "accepted": 0, "accept_rate": None,
                  "rewinds": 0, "blocks_freed": 0},
         "retired": False,
@@ -264,10 +266,17 @@ def request_summary(request, spans=None, recorder=None):
                                                 or 0):
                 out["spec"]["rewinds"] += 1
             out["spec"]["blocks_freed"] += args.get("blocks_freed", 0) or 0
+        elif name == "cache_hit":
+            # cumulative in the event args: the last one wins (a prefix
+            # may extend across steps as the wavefront catches up)
+            out["cached_prefix_tokens"] = args.get(
+                "total", out["cached_prefix_tokens"])
         elif name == "stall_budget":
             out["stalls"]["budget"] += 1
         elif name == "stall_alloc":
             out["stalls"]["alloc"] += 1
+        elif name == "stall_cache_pending":
+            out["stalls"]["cache_pending"] += 1
         elif name == "admit_blocked":
             out["stalls"]["admit_blocked"] += 1
         elif name == "retire":

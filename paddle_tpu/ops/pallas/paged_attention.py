@@ -630,6 +630,31 @@ def truncate_paged_kv_cache(k_cache, v_cache, block_tables, new_lens,
     return upd(k_cache), upd(v_cache)
 
 
+def copy_paged_kv_block(k_cache, v_cache, src_block, dst_block):
+    """Duplicate ONE physical cache block: copy every (kv_head, slot, d)
+    row of `src_block` into `dst_block` — the device half of the serving
+    engine's copy-on-write. A request that must append into a block other
+    requests still read gets a private copy first; the shared original
+    stays byte-identical for its remaining readers, so prefix sharing
+    never rests on overwrite-ordering reasoning. Returns the updated
+    caches; pure gather+scatter, in-place under jit when donated.
+
+    Boundary contract (same family as `truncate_paged_kv_cache`): both
+    block ids are data from the host allocator, so the gather side is
+    CLAMPED into the pool and the scatter side uses mode="drop" — an
+    out-of-pool id copies garbage nowhere instead of aliasing another
+    sequence's KV."""
+    nb = k_cache.shape[1]
+    src = jnp.minimum(src_block, nb - 1)           # clamp the gather
+
+    def upd(cache):
+        row = jax.lax.dynamic_index_in_dim(cache, src, axis=1,
+                                           keepdims=False)
+        return cache.at[:, dst_block].set(row, mode="drop")
+
+    return upd(k_cache), upd(v_cache)
+
+
 def update_paged_kv_cache_chunk(k_cache, v_cache, k_new, v_new,
                                 block_tables, context_lens, valid_counts):
     """Append a CHUNK of new K/V rows ([B, C, KVH, D]) into the paged

@@ -181,7 +181,8 @@ def test_explain_digest():
     assert ex["queue_wait_s"] >= 0 and ex["ttft_s"] > 0
     assert [c["granted"] for c in ex["prefill_chunks"]] == [4, 4, 3]
     assert ex["decode_steps"] == 3 and ex["tpot_s"] > 0
-    assert ex["stalls"] == {"budget": 0, "alloc": 0, "admit_blocked": 0}
+    assert ex["stalls"] == {"budget": 0, "alloc": 0, "admit_blocked": 0,
+                            "cache_pending": 0}
 
 
 def test_budget_starvation_records_stall_spans():

@@ -1,0 +1,77 @@
+# graftlint-corpus-expect: GL109 GL109 GL109 GL109 GL109
+"""Host-side device syncs inside the serving hot loop (GL109): a
+float()/int() scalar cast or a loop-invariant np.asarray() of a compiled
+program's result blocks on one device->host transfer PER ITERATION —
+the transfer-per-step analogue of GL103's .item(). The clean idiom is
+ONE bulk np.asarray() and host math on the copy (the tripwires below
+must stay silent)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _decode_step(w, caches, toks):
+    return toks, caches
+
+
+class Server:
+    def __init__(self):
+        self._paged_step = jax.jit(_decode_step)
+        self.w = {}
+        self.caches = []
+        self.lens = np.zeros(8, np.int32)
+
+    def serve_bad_scalar_casts(self, slab, active):
+        out, self.caches = self._paged_step(self.w, self.caches, slab)
+        toks = []
+        for i in active:
+            toks.append(int(out[i, 0]))        # one D2H sync per slot
+        total = 0.0
+        for i in active:
+            total += float(out[i])             # and another per slot
+        return toks, total
+
+    def serve_bad_comprehension_cast(self, slab, active):
+        out, self.caches = self._paged_step(self.w, self.caches, slab)
+        return [int(out[i, 0]) for i in active]  # per-slot D2H sync
+
+    def serve_bad_hoistable_transfer(self, slab, steps):
+        out, self.caches = self._paged_step(self.w, self.caches, slab)
+        emitted = []
+        for _ in range(steps):
+            host = np.asarray(out)             # same transfer every step
+            emitted.append(host[0])
+        return emitted
+
+    def serve_bad_jnp_asarray_launder(self, slab, active):
+        # jnp.asarray does NOT launder: the value stays on device, so
+        # the per-slot casts below still sync every iteration
+        out = jnp.asarray(self._paged_step(self.w, self.caches, slab)[0])
+        return [int(out[i, 0]) for i in active]  # per-slot D2H sync
+
+    # -- clean-idiom tripwires: none of these may flag -------------------
+
+    def serve_clean_bulk_transfer(self, slab, active):
+        out, self.caches = self._paged_step(self.w, self.caches, slab)
+        out = np.asarray(out)                  # ONE bulk transfer
+        return [int(out[i, 0]) for i in active]  # host math on the copy
+
+    def serve_clean_one_line_bulk(self, slab, active):
+        # the one-line spelling of the bulk idiom: the asarray wrapper
+        # means `out` is a HOST copy even though the device call sits
+        # inside the same assignment
+        out = np.asarray(self._paged_step(self.w, self.caches, slab)[0])
+        return [int(out[i, 0]) for i in active]
+
+    def serve_clean_per_step_read(self, slabs):
+        emitted = []
+        for slab in slabs:
+            # the result is produced INSIDE the loop: one bulk read per
+            # step is the unavoidable (and correct) cost of reading it
+            out, self.caches = self._paged_step(self.w, self.caches, slab)
+            emitted.append(np.asarray(out))
+        return emitted
+
+    def serve_clean_host_arrays(self, active):
+        # host-side numpy state never flags, loops or not
+        return [int(self.lens[i]) for i in active]

@@ -38,6 +38,18 @@ compiled program as a constant — ~350 MB of packed weights in the int4
 case — and silently pins the STALE value (a later update to the
 attribute never reaches the compiled program). Arrays flow as
 arguments; closures carry only small config scalars.
+
+GL109 is the transfer-per-step analogue of GL103's `.item()`, on the
+HOST side of the serving hot loop: `float(x)` / `int(x)` / `np.asarray(x)`
+on the result of a compiled device program inside a `for`/`while` loop
+blocks on a device->host transfer EVERY iteration. One scalar cast per
+slot per step turned the PR-1 serving loop into a latency ladder the
+profiler showed as a picket fence of tiny D2H copies. The clean idiom
+(continuous_batching.step): ONE `np.asarray(out)` bulk transfer, then
+free host math — which is also why a whole-array `np.asarray` of a value
+produced INSIDE the same loop never flags, while scalar casts always do
+and a loop-invariant `np.asarray` (result bound outside the loop) flags
+as a hoistable repeated transfer.
 """
 import ast
 
@@ -449,3 +461,137 @@ def jit_closure_capture(ctx):
                         "the compiled program as a constant (payload "
                         "bloat + silently stale on rebind) — pass it "
                         "as an argument"), node
+
+
+def _jit_bound_names(ctx):
+    """Names (plain or `self.`-attribute) this file binds to a compiled
+    program: any assignment whose RHS contains a `jax.jit(...)` /
+    `pjit(...)` call — covers `step = jax.jit(fn)`, `self._paged_step =
+    _dispatch_span("...", jax.jit(fn, ...))`, and decorator-factory
+    wrappers. A CALL of one of these names is a device dispatch."""
+    out = set()
+    for stmt in ast.walk(ctx.tree):
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        if not any(isinstance(n, ast.Call) and _is_jitish(n.func)
+                   for n in ast.walk(value)):
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                out.add(t.attr)
+    return out
+
+
+# the serving engines' compiled-program attribute convention
+# (inference/__init__.py binds them in its own module; a caller file —
+# continuous_batching.py — sees only `self.engine._paged_step(...)`)
+_DEVICE_ATTR_PREFIX = "_paged_"
+
+
+def _is_device_call(node, jit_names):
+    """Call of a compiled program: a jit-bound name from THIS file, or
+    the cross-module `engine._paged_*` serving convention."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in jit_names
+    if isinstance(f, ast.Attribute):
+        return f.attr in jit_names or f.attr.startswith(_DEVICE_ATTR_PREFIX)
+    return False
+
+
+def _root_name(expr):
+    """Base Name of a subscript/attribute chain: `out[i, 0]` -> `out`."""
+    while isinstance(expr, (ast.Subscript, ast.Attribute)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _device_bindings(fn, jit_names, np_aliases):
+    """{name: [assign nodes]} for names bound from a device call in
+    `fn`, minus names laundered host-side via a whole-array
+    `np.asarray(x)` / `np.array(x)` rebind (the clean bulk-transfer
+    idiom clears the name). Only NUMPY's asarray launders —
+    `jnp.asarray` keeps the value on device."""
+    bound = {}
+    cleared = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        if isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Attribute) \
+                and node.value.func.attr in ("asarray", "array") \
+                and isinstance(node.value.func.value, ast.Name) \
+                and node.value.func.value.id in np_aliases:
+            # host-side laundering — checked FIRST so the one-line bulk
+            # idiom `out = np.asarray(self._paged_step(...))` binds a
+            # host copy, not a device value, even though a device call
+            # sits inside the assign
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    cleared.add(t.id)
+        elif any(_is_device_call(n, jit_names)
+                 for n in ast.walk(node.value)):
+            for t in node.targets:
+                names = t.elts if isinstance(t, ast.Tuple) else [t]
+                for el in names:
+                    if isinstance(el, ast.Name):
+                        bound.setdefault(el.id, []).append(node)
+    return {k: v for k, v in bound.items() if k not in cleared}
+
+
+@rule("GL109", "host-sync-in-serve-loop", "trace-safety")
+def host_sync_in_serve_loop(ctx):
+    """float()/int()/np.asarray() on a compiled-program result inside a
+    for/while loop: every iteration blocks on a device->host transfer
+    (the serving-loop analogue of GL103's .item()). Convert ONCE with a
+    bulk np.asarray() and do host math on the copy."""
+    jit_names = _jit_bound_names(ctx)
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        dev = _device_bindings(fn, jit_names, ctx.numpy_aliases)
+        if not dev:
+            continue
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.While, ast.ListComp,
+                                     ast.SetComp, ast.DictComp,
+                                     ast.GeneratorExp)):
+                continue
+            lo = loop.lineno
+            hi = getattr(loop, "end_lineno", lo)
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call) or len(node.args) != 1:
+                    continue
+                f = node.func
+                root = _root_name(node.args[0])
+                if root not in dev:
+                    continue
+                if isinstance(f, ast.Name) and f.id in ("float", "int"):
+                    yield ctx.finding(
+                        "GL109", node,
+                        f"{f.id}() of device result `{root}` inside a "
+                        "loop: one blocking device->host transfer PER "
+                        "ITERATION — np.asarray() the whole array once "
+                        "before the loop and cast from the host copy "
+                        "(continuous_batching.step's toks2 idiom)"), node
+                elif isinstance(f, ast.Attribute) \
+                        and f.attr in ("asarray", "array") \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in ctx.numpy_aliases \
+                        and all(not (lo <= b.lineno <= hi)
+                                for b in dev[root]):
+                    yield ctx.finding(
+                        "GL109", node,
+                        f"np.{f.attr}() of device result `{root}` "
+                        "inside a loop, but the result is produced "
+                        "OUTSIDE it: the same device->host transfer "
+                        "repeats every iteration — hoist the conversion "
+                        "above the loop"), node

@@ -10,3 +10,9 @@ cd "$(dirname "$0")/.."
 python tools/metrics_snapshot.py --selfcheck
 python -m tools.graftlint --selftest
 python -m tools.graftlint paddle_tpu/ tests/ tools/ "$@"
+# prefix-caching serving gate (host-deterministic chunk-sweep /
+# high-water accounting; ~20 s on CPU via interpret mode). Skip with
+# LINT_SKIP_SERVE=1 when iterating on pure static-analysis changes.
+if [ "${LINT_SKIP_SERVE:-0}" != "1" ]; then
+  python tools/serve_bench.py --check tools/serve_prefix.json
+fi

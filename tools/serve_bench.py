@@ -638,6 +638,173 @@ def trace_leg(chunk=4, new_tokens=5):
     return out
 
 
+def prefix_leg(n_requests=8, prefix_len=448, suffix_len=8, chunk=64,
+               block_size=64, new_tokens=4):
+    """Automatic prefix caching: N requests sharing a long prompt prefix
+    (the system-prompt / few-shot-preamble shape). Three shared runs on
+    ONE engine — cold (leader computes, followers wavefront-map), resume
+    (every block served from the LRU reuse pool after the first wave
+    retired), and a warm replay of resume (the zero-new-buckets gate) —
+    against an unshared reference. The gated claims are host math:
+    prefill chunk sweeps over the SHARED portion drop to 1/N (one sweep
+    per unique prefix), KV-pool high-water drops from N*blocks to
+    ~blocks + N*tail, and outputs are token-exact in every mode. Wall
+    time is not measured (off-TPU it times the Pallas interpreter)."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                        GenerationRequest)
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        fa._INTERPRET = True
+    rng = np.random.default_rng(0)
+    eng, V = _tiny_cpu_engine(rng, max_seq_len=512)
+    prefix = rng.integers(1, V, prefix_len).astype(np.int32)
+    prompts = [np.concatenate([prefix,
+                               rng.integers(1, V, suffix_len)
+                               .astype(np.int32)])
+               for _ in range(n_requests)]
+    blocks_per_req = -(-(prefix_len + suffix_len + new_tokens)
+                       // block_size)
+    num_blocks = n_requests * blocks_per_req + 4
+    tracer = obs.get_tracer()
+
+    def submit_and_run(cb, tag):
+        reqs = [GenerationRequest(p.copy(), new_tokens,
+                                  request_id=f"{tag}{j}")
+                for j, p in enumerate(prompts)]
+        tracer.clear()
+        step0 = cb._step_count
+        for r in reqs:
+            cb.submit(r)
+        out = cb.run()
+        # prefill chunk sweeps, split at the shared-prefix boundary:
+        # a chunk whose span starts before prefix_len swept shared
+        # prompt; the rest is each request's unique tail
+        total = on_prefix = 0
+        for s in tracer.spans():
+            if s["name"] != "prefill_chunk":
+                continue
+            total += 1
+            a = s["args"]
+            if a["granted"] and a["progress"] - a["granted"] < prefix_len:
+                on_prefix += 1
+        return {
+            "steps": cb._step_count - step0,
+            "prefill_chunks": total,
+            "prefill_chunks_on_prefix": on_prefix,
+            "cached_prefix_tokens": sum(r.cached_prefix for r in reqs),
+            "outputs": [out[r.request_id] for r in reqs],
+        }
+
+    cb_off = ContinuousBatchingEngine(
+        eng, num_blocks=num_blocks, block_size=block_size,
+        max_batch=n_requests, prefill_chunk=chunk, prefix_cache=False)
+    unshared = submit_and_run(cb_off, "pu")
+    unshared["high_water"] = cb_off.allocator.high_water
+
+    cb = ContinuousBatchingEngine(
+        eng, num_blocks=num_blocks, block_size=block_size,
+        max_batch=n_requests, prefill_chunk=chunk, prefix_cache=True)
+    cold = submit_and_run(cb, "pc")
+    cold["high_water"] = cb.allocator.high_water
+    resume = submit_and_run(cb, "pr")       # conversation-resume: every
+    warm = set(cb._seen_buckets)            # prefix block is pooled now
+    replay = submit_and_run(cb, "pw")
+    new_buckets = len(set(cb._seen_buckets) - warm)
+
+    exact = (cold["outputs"] == unshared["outputs"]
+             and resume["outputs"] == unshared["outputs"]
+             and replay["outputs"] == unshared["outputs"])
+    out = {
+        "interpret": not on_tpu,
+        "n_requests": n_requests,
+        "prefix_len": prefix_len,
+        "suffix_len": suffix_len,
+        "chunk": chunk,
+        "block_size": block_size,
+        "new_tokens": new_tokens,
+        "token_exact_all_modes": exact,
+        "new_buckets_after_warmup": new_buckets,
+        "cache": {"hits": cb.cache_stats["hit_blocks"],
+                  "misses": cb.cache_stats["miss_blocks"],
+                  "cow_copies": cb.cache_stats["cow_copies"],
+                  "pooled_final": cb.allocator.num_pooled,
+                  "evictions": cb.allocator.evictions},
+        "unshared": {k: unshared[k] for k in
+                     ("steps", "prefill_chunks",
+                      "prefill_chunks_on_prefix", "high_water")},
+        "shared_cold": {k: cold[k] for k in
+                        ("steps", "prefill_chunks",
+                         "prefill_chunks_on_prefix",
+                         "cached_prefix_tokens", "high_water")},
+        "shared_resume": {k: resume[k] for k in
+                          ("steps", "prefill_chunks",
+                           "prefill_chunks_on_prefix",
+                           "cached_prefix_tokens")},
+    }
+    print(f"prefix[{n_requests}x{prefix_len}+{suffix_len} chunk={chunk}]: "
+          f"prefix-portion chunk sweeps "
+          f"{unshared['prefill_chunks_on_prefix']} unshared -> "
+          f"{cold['prefill_chunks_on_prefix']} shared -> "
+          f"{resume['prefill_chunks_on_prefix']} resume; "
+          f"high-water {unshared['high_water']} -> {cold['high_water']}; "
+          f"token-exact={exact}, {new_buckets} new buckets after warmup")
+    return out
+
+
+PREFIX_KEYS = ("n_requests", "prefix_len", "suffix_len", "chunk",
+               "block_size", "new_tokens", "token_exact_all_modes",
+               "new_buckets_after_warmup", "cache", "unshared",
+               "shared_cold", "shared_resume")
+
+
+def check_prefix(base):
+    """CI gate for the prefix-caching leg: the chunk-sweep / high-water
+    accounting is host-deterministic and must match the committed
+    baseline; the shared run must sweep the shared portion exactly once
+    (1/N of the unshared run), every mode must stay token-exact, and
+    warmup must cover every compile bucket."""
+    cur = prefix_leg()
+    bad = [k for k in PREFIX_KEYS if cur[k] != base[k]]
+    for k in bad:
+        print(f"MISMATCH {k}: current {cur[k]!r} != baseline {base[k]!r}")
+    if not cur["token_exact_all_modes"]:
+        print("REGRESSION: prefix caching changed generated tokens")
+        bad.append("token_exact_all_modes")
+    n = cur["n_requests"]
+    if cur["shared_cold"]["prefill_chunks_on_prefix"] * n != \
+            cur["unshared"]["prefill_chunks_on_prefix"]:
+        print("REGRESSION: shared run did not sweep the shared prefix "
+              f"exactly once per unique prefix "
+              f"({cur['shared_cold']['prefill_chunks_on_prefix']} * {n} "
+              f"!= {cur['unshared']['prefill_chunks_on_prefix']})")
+        bad.append("prefill_chunks_on_prefix")
+    if cur["shared_cold"]["high_water"] >= cur["unshared"]["high_water"]:
+        print("REGRESSION: sharing did not reduce KV-pool high-water "
+              f"({cur['shared_cold']['high_water']} vs "
+              f"{cur['unshared']['high_water']})")
+        bad.append("high_water")
+    if cur["new_buckets_after_warmup"] != 0:
+        print("REGRESSION: prefix caching compiled "
+              f"{cur['new_buckets_after_warmup']} fresh buckets after "
+              "warmup")
+        bad.append("new_buckets_after_warmup")
+    if bad:
+        return 1
+    print(f"prefix leg OK: {cur['unshared']['prefill_chunks_on_prefix']} "
+          f"-> {cur['shared_cold']['prefill_chunks_on_prefix']} "
+          f"prefix-portion chunk sweeps (1/{n}), high-water "
+          f"{cur['unshared']['high_water']} -> "
+          f"{cur['shared_cold']['high_water']}, token-exact, 0 new "
+          "buckets")
+    return 0
+
+
 TRACE_KEYS = ("chunk", "workload", "steps_traced", "steps_untraced",
               "new_buckets_after_warmup", "span_counts",
               "expected_span_counts", "spans_recorded",
@@ -767,6 +934,12 @@ def main():
                          "tracing-on vs -off step parity, overhead wall "
                          "times, and a flight-recorder dump roundtrip "
                          "(works on CPU via interpret mode)")
+    ap.add_argument("--prefix", action="store_true",
+                    help="automatic prefix caching: N requests sharing "
+                         "a long prompt prefix — chunk sweeps over the "
+                         "shared portion must drop to 1/N and KV-pool "
+                         "high-water accordingly, token-exact in every "
+                         "mode (works on CPU via interpret mode)")
     ap.add_argument("--chunk", type=int, default=64,
                     help="prefill chunk size for the --prefill leg")
     args = ap.parse_args()
@@ -785,13 +958,16 @@ def main():
         if "trace" in base:
             ran = True
             rc |= check_trace(base["trace"])
+        if "prefix" in base:
+            ran = True
+            rc |= check_prefix(base["prefix"])
         if not ran:
-            print(f"{args.check}: no 'ragged'/'spec'/'trace' section "
-                  "to gate")
+            print(f"{args.check}: no 'ragged'/'spec'/'trace'/'prefix' "
+                  "section to gate")
             return 1
         return rc
     if args.ragged or args.metrics or args.prefill or args.spec \
-            or args.no_spec or args.trace:
+            or args.no_spec or args.trace or args.prefix:
         out = {}
         if args.ragged:
             out["ragged"] = ragged_leg()
@@ -822,6 +998,9 @@ def main():
         if args.trace:
             # after --metrics for the same reason as --prefill
             out["trace"] = trace_leg()
+        if args.prefix:
+            # after --metrics too: it drives the serving engine
+            out["prefix"] = prefix_leg()
         if args.json:
             with open(args.json, "w") as f:
                 json.dump(out, f, indent=1)
