@@ -24,6 +24,15 @@ from paddle_tpu.inference import FusedMultiTransformerEngine
 def run_continuous(engine, rng, V, args):
     from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
                                         GenerationRequest)
+    if not args.no_flight_recorder:
+        # server-style entrypoints arm by default with bounded
+        # retention: an anomaly mid-serve leaves evidence without a
+        # human having opted in first (disable with --no-flight-recorder)
+        from paddle_tpu.observability import tracing
+        fr = tracing.arm_default(args.flight_dir)
+        print(f"flight recorder armed: {fr._dir} "
+              f"(max_dumps={fr.max_dumps}, replay dumps with "
+              "tools/request_trace.py)")
     cb = ContinuousBatchingEngine(engine, num_blocks=33, block_size=16,
                                   max_batch=args.batch,
                                   prefill_chunk=args.prefill_chunk,
@@ -115,6 +124,14 @@ def main():
                     help="(--continuous only) dump per-request lifecycle "
                          "spans + metrics after the run; replay with "
                          "tools/request_trace.py")
+    ap.add_argument("--flight-dir", default=None,
+                    help="(--continuous only) flight-recorder dump dir "
+                         "(default: $PADDLE_TPU_FLIGHT_DIR or the "
+                         "system tmpdir; retention keeps it bounded)")
+    ap.add_argument("--no-flight-recorder", action="store_true",
+                    help="(--continuous only) do not arm the anomaly "
+                         "flight recorder (armed by default with "
+                         "bounded retention)")
     args = ap.parse_args()
 
     rng = np.random.default_rng(0)

@@ -375,6 +375,15 @@ class ContinuousBatchingEngine:
     chunk-width) compile keys are untouched. Default OFF: the committed
     serving baselines predate the reuse pool's effect on the free-list
     gauges.
+
+    `monitor` (optional, observability/slo.SLOMonitor) attaches the
+    serving SLO engine: every step() ends with a host-side
+    `monitor.tick()` — on the monitor's cadence that samples the
+    metrics registry into windowed time-series rings and evaluates the
+    declared objectives' multi-window burn rates (a breach counts into
+    slo_breaches_total, lands on the timeline, and fires the flight
+    recorder's `slo_burn_rate` trigger). Pure host math: token-exact-
+    neutral with zero effect on the compile-bucket keyspace.
     """
 
     SLO_WINDOW = 8      # decode-TPOT samples per controller decision
@@ -382,7 +391,8 @@ class ContinuousBatchingEngine:
     def __init__(self, engine, num_blocks, block_size, max_batch=8,
                  temperature=0.0, top_p=1.0, seed=0, prefill_chunk=64,
                  token_budget=None, spec_k=0, spec_ngram=2,
-                 tpot_slo=None, min_prefill_chunk=64, prefix_cache=False):
+                 tpot_slo=None, min_prefill_chunk=64, prefix_cache=False,
+                 monitor=None):
         import jax
 
         self.engine = engine
@@ -455,6 +465,11 @@ class ContinuousBatchingEngine:
         # THIS engine's numbers)
         self.cache_stats = {"hit_blocks": 0, "miss_blocks": 0,
                             "cow_copies": 0}
+        # SLO monitor (observability/slo.SLOMonitor or anything with a
+        # host-side tick()): sampled on a cadence from the end of every
+        # step — pure host math over the registry, so it is token-exact-
+        # neutral and touches no compile key by construction
+        self.monitor = monitor
         kvh = self.caches[0].shape[1]
         num_q = engine.num_heads
         self._pack = default_pack(self.max_batch, num_q // kvh)
@@ -818,6 +833,8 @@ class ContinuousBatchingEngine:
         active = [i for i, r in enumerate(self.slots) if r is not None]
         self._update_pool_gauges()
         if not active:
+            if self.monitor is not None:
+                self.monitor.tick()     # keep sampling through idle ticks
             return len(self.queue)
         if self._prefix_on:
             # admission + wavefront prefix matching: map every full
@@ -1082,6 +1099,12 @@ class ContinuousBatchingEngine:
         # engine is prompt-bound
         _metrics.serve_effective_tokens_per_step().set(emitted)
         self._maybe_shrink_chunk()
+        if self.monitor is not None:
+            # host-side cadence hook: registry sample + burn-rate pass
+            # when the monitor's cadence elapsed, a monotonic compare
+            # otherwise — AFTER the step's own metrics landed, so a
+            # breach evaluation always sees this step's samples
+            self.monitor.tick()
         return len(self.queue) + self.num_active
 
     def _rewind_blocks(self, i, new_end):

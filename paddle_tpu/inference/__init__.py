@@ -308,16 +308,22 @@ def _dispatch_span(name, fn):
     is why `paged_step` spans make recompiles visible on the timeline)
     plus enqueue, NOT device completion. The wrapper is plain host code
     wrapping the jitted callable, so the record never runs under a
-    tracer (the GL105 contract)."""
+    tracer (the GL105 contract). The duration also lands in the
+    `dispatch_seconds{program}` histogram so the windowed time-series
+    layer (observability/timeseries.py) can answer "did DISPATCH get
+    slower over the last N seconds" — the signal that separates a
+    model-side regression from queueing in the SLO engine's view."""
     import time as _time
 
+    from ..observability import instrument as _instrument
     from ..observability import tracing as _tracing
 
     def call(*args, **kwargs):
         t0 = _time.perf_counter()
         out = fn(*args, **kwargs)
-        _tracing.get_tracer().record_span(
-            name, t0 * 1e6, (_time.perf_counter() - t0) * 1e6)
+        dur = _time.perf_counter() - t0
+        _tracing.get_tracer().record_span(name, t0 * 1e6, dur * 1e6)
+        _instrument.dispatch_seconds().labels(program=name).observe(dur)
         return out
 
     call.__wrapped__ = fn

@@ -5,9 +5,14 @@ A process-wide registry of counters, gauges, and fixed-bucket histograms
 snapshot, chrome-trace counter events merged into the profiler
 timeline), a jax.monitoring compile watch (compile_watch.py), the
 standard instrument set for serving/training/dispatch (instrument.py),
-and per-request lifecycle tracing + the anomaly flight recorder
+per-request lifecycle tracing + the anomaly flight recorder
 (tracing.py: bounded span ring, chrome per-request lanes,
-anomaly-triggered dumps of the last N seconds of spans + metrics).
+anomaly-triggered dumps of the last N seconds of spans + metrics,
+bounded dump retention with a manifest index), windowed time series
+over the registry (timeseries.py: rate/delta-quantile/gauge-stats over
+the last N seconds), and the serving SLO engine (slo.py: declarative
+objectives, SRE-style multi-window burn rates, breach -> counter +
+timeline event + slo_burn_rate flight dump).
 
 Contract: record calls are HOST-SIDE ONLY — never inside a jitted
 function. The runtime guard is the ``float()`` coercion in metrics.py
@@ -48,7 +53,11 @@ from .instrument import watch_ops
 # binds the `tracing` attribute on this package.
 from .tracing import (SpanRecorder, FlightRecorder, get_tracer,
                       get_flight_recorder, chrome_span_events,
-                      request_summary, load_dump, write_dump)
+                      request_summary, load_dump, write_dump,
+                      arm_default, load_manifest)
+from .timeseries import TimeSeries
+from .slo import (Objective, SLOEngine, SLOMonitor, validate_report,
+                  json_safe, DEFAULT_WINDOWS)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -57,5 +66,7 @@ __all__ = [
     "install_compile_watch", "compile_watch_installed", "watch_ops",
     "tracing", "SpanRecorder", "FlightRecorder", "get_tracer",
     "get_flight_recorder", "chrome_span_events", "request_summary",
-    "load_dump", "write_dump",
+    "load_dump", "write_dump", "arm_default", "load_manifest",
+    "timeseries", "TimeSeries", "slo", "Objective", "SLOEngine",
+    "SLOMonitor", "validate_report", "json_safe", "DEFAULT_WINDOWS",
 ]

@@ -24,14 +24,24 @@ from .metrics import get_registry
 __all__ = ["to_prometheus", "to_json", "chrome_counter_events"]
 
 
-def _esc(v):
+def _esc_label(v):
+    """Label-VALUE escaping (text exposition 0.0.4): backslash, double
+    quote, newline — the value sits inside double quotes."""
     return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
         "\n", r"\n")
 
 
+def _esc_help(v):
+    """HELP-text escaping is a DIFFERENT rule in the same format:
+    only backslash and newline. Help text is not quoted, so escaping
+    `"` (as the old shared `_esc` did) rendered help strings containing
+    quotes as literal `\\"` in every scrape."""
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
+
+
 def _labelstr(names, values, extra=()):
-    pairs = [f'{n}="{_esc(v)}"' for n, v in zip(names, values)]
-    pairs += [f'{n}="{_esc(v)}"' for n, v in extra]
+    pairs = [f'{n}="{_esc_label(v)}"' for n, v in zip(names, values)]
+    pairs += [f'{n}="{_esc_label(v)}"' for n, v in extra]
     return "{" + ",".join(pairs) + "}" if pairs else ""
 
 
@@ -52,7 +62,7 @@ def to_prometheus(registry=None):
     registry = registry or get_registry()
     lines = []
     for m in registry.metrics():
-        lines.append(f"# HELP {m.name} {_esc(m.help)}")
+        lines.append(f"# HELP {m.name} {_esc_help(m.help)}")
         lines.append(f"# TYPE {m.name} {m.kind}")
         # copy child state under the lock: a concurrent observe() between
         # reading the buckets and the count would otherwise emit a scrape

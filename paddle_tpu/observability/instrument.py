@@ -16,7 +16,8 @@ from .metrics import DEFAULT_LATENCY_BUCKETS, get_registry
 
 __all__ = [
     "watch_ops", "serve_ttft", "serve_tpot", "serve_queue_wait",
-    "serve_step_seconds", "serve_tokens_total", "serve_requests_total",
+    "serve_step_seconds", "dispatch_seconds", "serve_tokens_total",
+    "serve_requests_total",
     "serve_inflight", "serve_queue_depth", "serve_tokens_per_s",
     "kv_blocks_free", "kv_blocks_used", "kv_blocks_high_water",
     "kv_alloc_failures", "serve_bucket_recompiles",
@@ -53,6 +54,14 @@ def serve_step_seconds():
     return get_registry().histogram(
         "serve_step_seconds",
         help="one scheduler tick + compiled decode step (host wall)")
+
+
+def dispatch_seconds():
+    return get_registry().histogram(
+        "dispatch_seconds",
+        help="compiled-program dispatch (trace/lower/compile on a fresh "
+             "bucket + enqueue, NOT device completion), per program",
+        labels=("program",))
 
 
 def serve_tokens_total():

@@ -293,6 +293,11 @@ class MetricsRegistry:
         self._metrics = {}
         self._samples = collections.deque(maxlen=timeline_capacity)
         self.timeline_enabled = True
+        # samples the bounded ring evicted (oldest-first): a long
+        # serving run's chrome timeline silently starts mid-flight
+        # otherwise — the drop count makes the truncation visible
+        # (snapshot()'s "_timeline" entry, serve_monitor's dashboard)
+        self.timeline_dropped = 0
 
     # -- family constructors (get-or-create, type-checked) ---------------
     def _family(self, cls, name, help, labels, **kw):
@@ -336,11 +341,22 @@ class MetricsRegistry:
         # and these samples merge into that chrome stream — a different
         # timebase would land the counter track nowhere near the ranges.
         if self.timeline_enabled:
+            if len(self._samples) == self._samples.maxlen:
+                self.timeline_dropped += 1      # deque evicts the oldest
             self._samples.append((time.perf_counter() * 1e6, name, value))
 
     def timeline(self):
         with self._lock:
             return list(self._samples)
+
+    def timeline_stats(self):
+        """{'samples','capacity','dropped'}: how much of the recorded
+        history the bounded ring still holds — `dropped` > 0 means a
+        chrome export of this timeline is truncated at the front."""
+        with self._lock:
+            return {"samples": len(self._samples),
+                    "capacity": self._samples.maxlen,
+                    "dropped": self.timeline_dropped}
 
     # -- snapshot ---------------------------------------------------------
     def snapshot(self):
@@ -363,6 +379,12 @@ class MetricsRegistry:
                         children[cname] = {"value": child.value}
                 entry["children"] = children
                 out[name] = entry
+            # ring-truncation marker (never a real family: names with a
+            # leading underscore are reserved). "kind"/"children" keep
+            # the family shape so generic consumers iterate safely.
+            out["_timeline"] = {"kind": "meta", "help": "", "children": {},
+                                "labelnames": [],
+                                **self.timeline_stats()}
         return out
 
     def reset(self):
@@ -372,6 +394,7 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.clear()
             self._samples.clear()
+            self.timeline_dropped = 0
 
 
 _registry = MetricsRegistry()

@@ -42,6 +42,7 @@ import collections
 import contextlib
 import json
 import os
+import tempfile
 import threading
 import time
 
@@ -50,10 +51,19 @@ from .metrics import _host_float, get_registry
 __all__ = [
     "SpanRecorder", "FlightRecorder", "get_tracer", "get_flight_recorder",
     "span", "event", "chrome_span_events", "request_summary", "load_dump",
-    "write_dump", "DUMP_SCHEMA",
+    "write_dump", "arm_default", "load_manifest", "DUMP_SCHEMA",
+    "MANIFEST_SCHEMA", "MANIFEST_NAME",
 ]
 
 DUMP_SCHEMA = "paddle_tpu.flight_recorder/1"
+MANIFEST_SCHEMA = "paddle_tpu.flight_manifest/1"
+MANIFEST_NAME = "flightrec_manifest.json"
+
+# server-entrypoint retention defaults (arm_default): bounded enough
+# that a long-running server can never fill a disk with evidence, deep
+# enough that a p99 incident's dump survives until a human looks
+DEFAULT_MAX_DUMPS = 16
+DEFAULT_MAX_BYTES = 64 * 1024 * 1024
 
 # chrome tids for span lanes: far away from thread idents (host ranges)
 # and from tid 0 (metric counters) so per-request lanes group cleanly
@@ -302,38 +312,145 @@ class FlightRecorder:
     registry to ``<dir>/flightrec_<reason>_<ms>_<seq>.json`` — but only
     when armed (`arm(dir)`), and at most once per `min_interval_s` per
     reason, so a repeating anomaly leaves evidence without flooding the
-    disk. Triggers wired in today: ``kv_alloc_failure`` and
-    ``post_warmup_recompile`` and ``tpot_slo_breach``
-    (incubate/nn/continuous_batching.py), ``comm_watchdog_stall``
-    (distributed/comm_watchdog.py), plus ``manual`` via write_dump()."""
+    disk. `max_dumps`/`max_bytes` bound the dir regardless (oldest-first
+    rotation + a manifest index — the long-running-server policy
+    `arm_default()` turns on). Triggers wired in today:
+    ``kv_alloc_failure`` and ``post_warmup_recompile`` and
+    ``tpot_slo_breach`` (incubate/nn/continuous_batching.py),
+    ``slo_burn_rate`` (observability/slo.py burn-rate breaches),
+    ``comm_watchdog_stall`` (distributed/comm_watchdog.py), plus
+    ``manual`` via write_dump()."""
 
-    def __init__(self, recorder=None, window_s=30.0, min_interval_s=2.0):
+    def __init__(self, recorder=None, window_s=30.0, min_interval_s=2.0,
+                 max_dumps=None, max_bytes=None):
         self.recorder = recorder    # None = the process-wide tracer
         self.window_s = float(window_s)
         self.min_interval_s = float(min_interval_s)
+        self.max_dumps = max_dumps      # retention: None = unbounded
+        self.max_bytes = max_bytes
         self._lock = threading.Lock()
         self._dir = None
         self._last = {}             # reason -> last-dump perf_counter
         self._seq = 0
+        self._manifest = []         # retained-dump index (armed dir)
+        self.evicted_total = 0      # dumps rotated out by retention
         self.dumps = []             # paths written this process
 
     @property
     def armed(self):
         return self._dir is not None
 
-    def arm(self, out_dir, window_s=None, min_interval_s=None):
-        """Start dumping into `out_dir` (created on first dump)."""
+    def arm(self, out_dir, window_s=None, min_interval_s=None,
+            max_dumps=None, max_bytes=None):
+        """Start dumping into `out_dir` (created on first dump).
+        `max_dumps`/`max_bytes` bound the dir: after every write the
+        oldest dumps rotate out until both limits hold (the newest dump
+        always survives), and a manifest index
+        (``<dir>/flightrec_manifest.json``) lists what is retained. An
+        existing manifest in the dir is adopted, so a restarted server
+        keeps rotating the same evidence window instead of leaking the
+        previous process's dumps."""
+        # validate BEFORE mutating: a rejected arm() must leave the
+        # recorder exactly as it was (a caught ValueError must not leave
+        # it armed with an evict-everything quota)
+        if max_dumps is not None and int(max_dumps) < 1:
+            raise ValueError("max_dumps must be >= 1")
+        if max_bytes is not None and int(max_bytes) < 1:
+            raise ValueError("max_bytes must be >= 1")
         with self._lock:
             self._dir = str(out_dir)
             if window_s is not None:
                 self.window_s = float(window_s)
             if min_interval_s is not None:
                 self.min_interval_s = float(min_interval_s)
+            if max_dumps is not None:
+                self.max_dumps = int(max_dumps)
+            if max_bytes is not None:
+                self.max_bytes = int(max_bytes)
+            self._manifest = self._adopt_manifest(self._dir)
         return self
 
     def disarm(self):
         with self._lock:
             self._dir = None
+            self._manifest = []
+
+    # -- retention --------------------------------------------------------
+    @staticmethod
+    def _adopt_manifest(out_dir):
+        """Entries of an existing manifest whose files still exist —
+        a fresh arm() of a dir a previous process dumped into continues
+        its rotation instead of orphaning the old files."""
+        try:
+            data = load_manifest(out_dir)
+        except (OSError, ValueError):
+            return []
+        return [dict(e) for e in data["dumps"]
+                if os.path.exists(os.path.join(out_dir, e["file"]))]
+
+    def _retain(self, path, reason, rec):
+        """Register a just-written dump in the manifest and rotate the
+        oldest dumps out until max_dumps/max_bytes hold (newest always
+        kept). Runs on the serving thread: any OSError is recorded, not
+        raised — retention must never take down the step."""
+        out_dir = os.path.dirname(path)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        evicted = []
+        io_error = None
+        # the manifest WRITE stays under the lock too: two concurrent
+        # triggers (serving thread + watchdog thread, different reasons
+        # so both clear the cooldown) must not interleave state-mutate
+        # and write — the loser would persist a stale manifest missing
+        # the winner's dump, orphaning it from rotation forever
+        with self._lock:
+            if self._dir is None or out_dir != self._dir:
+                return              # explicit-path dump: not managed
+            self._manifest.append(
+                {"file": os.path.basename(path), "reason": str(reason),
+                 "time": time.time(), "bytes": int(size),
+                 "seq": self._seq})
+            total = sum(e["bytes"] for e in self._manifest)
+            while len(self._manifest) > 1 and (
+                    (self.max_dumps is not None
+                     and len(self._manifest) > self.max_dumps)
+                    or (self.max_bytes is not None
+                        and total > self.max_bytes)):
+                e = self._manifest.pop(0)   # oldest-first
+                total -= e["bytes"]
+                evicted.append(e)
+                self.evicted_total += 1
+            manifest = [dict(e) for e in self._manifest]
+            try:
+                for e in evicted:
+                    try:
+                        os.remove(os.path.join(out_dir, e["file"]))
+                    except FileNotFoundError:
+                        pass
+                tmp = os.path.join(out_dir, MANIFEST_NAME + ".tmp")
+                with open(tmp, "w") as f:
+                    json.dump({"schema": MANIFEST_SCHEMA,
+                               "evicted_total": self.evicted_total,
+                               "dumps": manifest}, f, indent=1)
+                os.replace(tmp, os.path.join(out_dir, MANIFEST_NAME))
+            except OSError as e:
+                io_error = e
+        if io_error is not None:
+            rec.event("flight_retention_failed", error=str(io_error))
+            return
+        if evicted:
+            rec.event("flight_dump_evicted", count=len(evicted))
+            get_registry().counter(
+                "flight_recorder_dumps_evicted_total",
+                help="dumps rotated out by the retention policy").inc(
+                    len(evicted))
+
+    def retained(self):
+        """Manifest snapshot: the dumps retention currently keeps."""
+        with self._lock:
+            return [dict(e) for e in self._manifest]
 
     def trigger(self, reason, request=None, **context):
         """Record the anomaly; write a dump when armed + off cooldown.
@@ -380,6 +497,7 @@ class FlightRecorder:
             return None
         with self._lock:
             self.dumps.append(path)
+        self._retain(path, reason, rec)
         get_registry().counter(
             "flight_recorder_dumps_total",
             help="anomaly dumps written by the flight recorder",
@@ -421,6 +539,10 @@ class FlightRecorder:
         out = self._write(path, reason, rec, request, context)
         with self._lock:
             self.dumps.append(out)
+        # a manual dump landing INSIDE the armed dir participates in
+        # retention like any trigger; explicit paths elsewhere are the
+        # caller's to manage
+        self._retain(out, reason, rec)
         return out
 
 
@@ -436,6 +558,43 @@ def get_flight_recorder():
 def write_dump(path, reason="manual", request=None, **context):
     """Dump the process-wide span ring + metrics snapshot to `path`."""
     return _flight.dump_to(path, reason=reason, request=request, **context)
+
+
+def arm_default(out_dir=None, window_s=None,
+                max_dumps=DEFAULT_MAX_DUMPS, max_bytes=DEFAULT_MAX_BYTES):
+    """Server-entrypoint arming policy: the process flight recorder,
+    bounded retention on. Long-running serve loops (serve_llama
+    --continuous, serve_bench, serve_monitor) call this by default so a
+    production p99 incident ships with its own evidence — the ROADMAP's
+    "arm-by-default + dump retention" item. Dir resolution:
+    `out_dir` arg > $PADDLE_TPU_FLIGHT_DIR > <tmp>/paddle_tpu_flightrec.
+    Returns the armed recorder (disarm() to opt back out)."""
+    if out_dir is None:
+        out_dir = os.environ.get("PADDLE_TPU_FLIGHT_DIR") or os.path.join(
+            tempfile.gettempdir(), "paddle_tpu_flightrec")
+    return _flight.arm(out_dir, window_s=window_s, max_dumps=max_dumps,
+                       max_bytes=max_bytes)
+
+
+def load_manifest(dump_dir):
+    """Load + schema-validate a retention manifest
+    (``<dir>/flightrec_manifest.json``; stdlib only, same contract as
+    load_dump). Raises ValueError on anything that is not a v1
+    manifest, OSError when the dir has none."""
+    path = os.path.join(str(dump_dir), MANIFEST_NAME)
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or data.get("schema") != MANIFEST_SCHEMA:
+        raise ValueError(
+            f"{path}: not a {MANIFEST_SCHEMA} manifest (schema="
+            f"{data.get('schema') if isinstance(data, dict) else None!r})")
+    if not isinstance(data.get("dumps"), list):
+        raise ValueError(f"{path}: manifest dumps is not a list")
+    for i, e in enumerate(data["dumps"]):
+        if not {"file", "reason", "time", "bytes"} <= set(e):
+            raise ValueError(f"{path}: manifest entry {i} malformed: "
+                             f"{sorted(e)}")
+    return data
 
 
 def load_dump(path):

@@ -43,8 +43,10 @@ def test_graftlint_imports():
     # metrics record calls inside jitted functions (GL105); the
     # speculative-decode PR's rule: donated-buffer reuse (GL107); the
     # tracing PR's rule: jitted closures over self./module arrays
-    # (GL108, the int4 compile-payload-bloat hazard)
-    assert {"GL104", "GL105", "GL107", "GL108"} <= set(gl.RULES), \
+    # (GL108, the int4 compile-payload-bloat hazard); the SLO PR's
+    # rule: dict/set keying on device arrays (GL110, the hash-forces-
+    # a-sync hazard the prefix index's host-bytes block_key avoids)
+    assert {"GL104", "GL105", "GL107", "GL108", "GL110"} <= set(gl.RULES), \
         sorted(gl.RULES)
 
 

@@ -9,6 +9,7 @@ forced post-warmup bucket recompile must each produce a flight dump
 that reconstructs the offending request's timeline and loads through
 tools/request_trace.py AND the stdlib-only schema validator."""
 import json
+import os
 import threading
 
 import numpy as np
@@ -170,6 +171,28 @@ def test_lifecycle_span_counts_are_host_math():
     assert len(steps) == cb._step_count
     assert len([s for s in eng_spans if s["name"] == "paged_step"]) == \
         cb._step_count
+
+
+def test_dispatch_seconds_histogram_mirrors_spans():
+    """_dispatch_span lands every dispatch in dispatch_seconds{program}
+    too (ISSUE 8): the windowed time-series layer needs a HISTOGRAM to
+    answer "did dispatch get slower over the last N seconds" — span
+    count and histogram count must agree per program."""
+    obs.get_registry().reset()
+    workload = [(5, 3), (11, 4)]
+    cb, reqs, out = _serve(workload)
+    tr = obs.get_tracer()
+    snap = obs.get_registry().snapshot()
+    kids = snap["dispatch_seconds"]["children"]
+    spans_for = lambda name: len([s for s in tr.spans()
+                                  if s["request"] is None
+                                  and s["name"] == name])
+    assert kids["paged_step"]["count"] == cb._step_count == \
+        spans_for("paged_step")
+    # every dispatch program the histogram saw agrees with its span lane
+    for program, child in kids.items():
+        assert child["count"] == spans_for(program), (program, kids)
+        assert child["sum"] > 0
 
 
 def test_explain_digest():
@@ -391,6 +414,122 @@ def test_manual_dump_records_path(tmp_path):
     assert fr.dump_to(out) == out
     assert fr.dumps == [out]
     assert tracing.load_dump(out)["reason"] == "manual"
+
+
+# -- flight-recorder retention (ISSUE 8) -----------------------------------
+
+def _dump_names(d):
+    return sorted(f.name for f in d.glob("flightrec_*.json")
+                  if f.name != tracing.MANIFEST_NAME)
+
+
+def test_retention_rotates_oldest_first_with_manifest(tmp_path):
+    """max_dumps=3: five triggers keep exactly the NEWEST three on
+    disk, the manifest lists them oldest-first and stays consistent
+    with the dir, and every retained dump still loads."""
+    rec = tracing.SpanRecorder()
+    rec.event("tick", request="r")
+    fr = tracing.FlightRecorder(recorder=rec, min_interval_s=0.0)
+    fr.arm(tmp_path, max_dumps=3)
+    paths = [fr.trigger(f"reason{i}") for i in range(5)]
+    assert all(p is not None for p in paths)
+    kept = _dump_names(tmp_path)
+    assert len(kept) == 3
+    # the two OLDEST rotated out, the newest three survived
+    assert sorted(os.path.basename(p) for p in paths[2:]) == kept
+    assert not os.path.exists(paths[0]) and not os.path.exists(paths[1])
+    assert fr.evicted_total == 2
+    man = tracing.load_manifest(tmp_path)
+    entries = man["dumps"]
+    assert [e["file"] for e in entries] == \
+        [os.path.basename(p) for p in paths[2:]]     # oldest-first
+    assert [e["reason"] for e in entries] == \
+        ["reason2", "reason3", "reason4"]
+    assert man["evicted_total"] == 2
+    for e in entries:
+        loaded = tracing.load_dump(str(tmp_path / e["file"]))
+        assert loaded["reason"] == e["reason"]
+        assert e["bytes"] == os.path.getsize(tmp_path / e["file"])
+    # `dumps` stays the full process history; `retained()` the survivors
+    assert len(fr.dumps) == 5
+    assert [e["file"] for e in fr.retained()] == kept and \
+        sorted(e["file"] for e in fr.retained()) == kept
+
+
+def test_retention_max_bytes_under_large_dumps(tmp_path):
+    """max_bytes with injected LARGE dumps: the dir's total stays under
+    the cap (the newest dump always survives, even alone over-budget),
+    and the manifest byte accounting matches the files."""
+    rec = tracing.SpanRecorder(capacity=4096)
+    for i in range(300):                # inflate every dump to ~40KB+
+        rec.event("pad", request="r", note="x" * 120, i=i)
+    fr = tracing.FlightRecorder(recorder=rec, min_interval_s=0.0,
+                                window_s=1e9)
+    fr.arm(tmp_path)
+    one = fr.trigger("probe")
+    size = os.path.getsize(one)
+    os.remove(one)
+    fr.disarm()
+    fr.arm(tmp_path, max_bytes=int(size * 2.5))
+    for i in range(4):
+        fr.trigger(f"big{i}")
+    kept = _dump_names(tmp_path)
+    assert len(kept) == 2, kept         # 2 fit under 2.5x, 3 would not
+    total = sum(os.path.getsize(tmp_path / f) for f in kept)
+    assert total <= size * 2.5
+    assert fr.evicted_total == 2
+    man = tracing.load_manifest(tmp_path)
+    assert sum(e["bytes"] for e in man["dumps"]) == total
+    # a single dump larger than the whole budget still survives (the
+    # newest is never evicted — evidence beats the quota)
+    fr.disarm()
+    fr.arm(tmp_path, max_bytes=1)
+    p = fr.trigger("oversized")
+    assert p is not None and os.path.exists(p)
+    assert _dump_names(tmp_path) == [os.path.basename(p)]
+
+
+def test_retention_rearm_adopts_manifest(tmp_path):
+    """A restarted server re-arming the same dir continues the SAME
+    rotation window instead of orphaning the previous process's dumps."""
+    rec = tracing.SpanRecorder()
+    rec.event("tick")
+    fr1 = tracing.FlightRecorder(recorder=rec, min_interval_s=0.0)
+    fr1.arm(tmp_path, max_dumps=2)
+    first = [fr1.trigger(f"gen1_{i}") for i in range(2)]
+    # "new process": a fresh recorder adopts the manifest on arm()
+    fr2 = tracing.FlightRecorder(recorder=rec, min_interval_s=0.0)
+    fr2.arm(tmp_path, max_dumps=2)
+    assert [e["file"] for e in fr2.retained()] == \
+        [os.path.basename(p) for p in first]
+    p3 = fr2.trigger("gen2_0")
+    kept = _dump_names(tmp_path)
+    assert len(kept) == 2
+    assert os.path.basename(p3) in kept
+    assert not os.path.exists(first[0])     # gen-1's oldest rotated out
+    man = tracing.load_manifest(tmp_path)
+    assert [e["reason"] for e in man["dumps"]] == ["gen1_1", "gen2_0"]
+
+
+def test_retention_ignores_explicit_paths_outside_dir(tmp_path):
+    """dump_to() to an explicit path OUTSIDE the armed dir is the
+    caller's file: never rotated, never in the manifest."""
+    rec = tracing.SpanRecorder()
+    rec.event("tick")
+    fr = tracing.FlightRecorder(recorder=rec, min_interval_s=0.0)
+    armed = tmp_path / "armed"
+    fr.arm(armed, max_dumps=1)
+    keepme = str(tmp_path / "elsewhere" / "keep.json")
+    fr.dump_to(keepme)
+    fr.trigger("a")
+    fr.trigger("b")                     # rotates "a" out
+    assert os.path.exists(keepme)
+    assert len(_dump_names(armed)) == 1
+    assert all(e["file"] != "keep.json" for e in fr.retained())
+    # a manual dump INSIDE the armed dir participates like any trigger
+    fr.dump_to(str(armed / "flightrec_manual_x.json"))
+    assert [e["reason"] for e in fr.retained()] == ["manual"]
+    assert len(_dump_names(armed)) == 1
 
 
 # -- exporters / profiler merge --------------------------------------------

@@ -1,0 +1,418 @@
+"""Serving SLO engine (ISSUE 8): windowed time series + burn rates.
+
+Three layers, cheapest first: the time-series ring's windowed queries
+(rate / delta-quantile / fraction-over on a synthetic clock — pure
+host math, no jax), the burn-rate evaluator's multi-window semantics
+(fast-window-only cliffs, slow-window-only slow burns, both, the
+min_count guard, the zero-budget ratio), and the live serving engine
+with an attached SLOMonitor — where the acceptance contract lives: a
+deliberately tightened objective must produce a breach, a nonzero
+slo_breaches_total, and an `slo_burn_rate` flight dump, while a healthy
+monitor must be token-exact-neutral with zero new compile buckets."""
+import json
+import math
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import tracing
+from paddle_tpu.observability.slo import Objective, SLOEngine, SLOMonitor
+from paddle_tpu.observability.timeseries import TimeSeries
+
+
+def _reg_ts(capacity=1024):
+    reg = obs.MetricsRegistry()
+    return reg, TimeSeries(registry=reg, capacity=capacity)
+
+
+# -- time-series ring ------------------------------------------------------
+
+def test_windowed_counter_rate_and_delta():
+    reg, ts = _reg_ts()
+    c = reg.counter("req_total")
+    c.inc(0)
+    ts.sample(now=0.0)
+    c.inc(100)
+    ts.sample(now=10.0)
+    c.inc(40)
+    ts.sample(now=20.0)
+    # window (10, 20]: baseline is the t=10 sample
+    assert ts.delta("req_total", 10.0, now=20.0) == 40
+    assert ts.rate("req_total", 10.0, now=20.0) == 4.0
+    # window past the ring start: falls back to the oldest sample
+    assert ts.delta("req_total", 100.0, now=20.0) == 140
+    assert ts.rate("req_total", 100.0, now=20.0) == 7.0
+    # one sample = no window
+    reg2, ts2 = _reg_ts()
+    reg2.counter("x_total").inc()
+    ts2.sample(now=0.0)
+    assert ts2.rate("x_total", 10.0, now=0.0) is None
+
+
+def test_counter_reset_reads_as_no_data():
+    reg, ts = _reg_ts()
+    c = reg.counter("r_total")
+    c.inc(50)
+    ts.sample(now=0.0)
+    reg.reset()                         # value falls back to 0
+    reg.counter("r_total").inc(3)
+    ts.sample(now=10.0)
+    assert ts.delta("r_total", 20.0, now=10.0) is None
+    assert ts.rate("r_total", 20.0, now=10.0) is None
+
+
+def test_delta_quantile_sees_only_window_observations():
+    reg, ts = _reg_ts()
+    h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0, 10.0))
+    h.observe(5.0)                      # pre-window outlier
+    ts.sample(now=0.0)
+    for _ in range(99):
+        h.observe(0.05)
+    h.observe(5.0)
+    ts.sample(now=10.0)
+    # lifetime p50 is polluted by nothing, but lifetime p99 sees TWO
+    # outliers; the window sees exactly one in a hundred
+    q50 = ts.quantile("lat_seconds", 0.5, 10.0, now=10.0)
+    assert q50 is not None and 0.01 < q50 <= 0.1
+    assert ts.count("lat_seconds", 10.0, now=10.0) == 100
+    frac = ts.fraction_over("lat_seconds", 1.0, 10.0, now=10.0)
+    assert frac == pytest.approx(0.01)
+    # empty window: None, not 0 (absence of traffic is not a latency)
+    ts.sample(now=20.0)
+    assert ts.quantile("lat_seconds", 0.5, 5.0, now=20.0) is None
+
+
+def test_fraction_over_interpolates_inside_bucket():
+    reg, ts = _reg_ts()
+    h = reg.histogram("lat_seconds", buckets=(1.0, 2.0))
+    h.labels()                          # create the child pre-baseline
+    ts.sample(now=0.0)
+    for _ in range(10):
+        h.observe(1.5)                  # all land in the (1, 2] bucket
+    ts.sample(now=1.0)
+    # threshold mid-bucket: linear interpolation says half are above
+    assert ts.fraction_over("lat_seconds", 1.5, 10.0, now=1.0) == \
+        pytest.approx(0.5)
+    assert ts.fraction_over("lat_seconds", 0.5, 10.0, now=1.0) == 1.0
+    assert ts.fraction_over("lat_seconds", 2.0, 10.0, now=1.0) == 0.0
+
+
+def test_gauge_stats_and_bounded_ring():
+    reg, ts = _reg_ts(capacity=4)
+    g = reg.gauge("depth")
+    for i, t in enumerate((0.0, 1.0, 2.0, 3.0)):
+        g.set(i)
+        ts.sample(now=t)
+    st = ts.gauge_stats("depth", 2.5, now=3.0)
+    assert st == {"min": 1.0, "max": 3.0, "mean": 2.0, "last": 3.0,
+                  "samples": 3}
+    assert ts.gauge_stats("depth", 2.5, now=100.0) is None
+    assert ts.dropped == 0
+    for t in (4.0, 5.0):
+        ts.sample(now=t)
+    assert len(ts.ring("depth")) == 4       # bounded
+    assert ts.dropped == 2                  # and the loss is visible
+    assert ts.ring("depth")[0][0] == 2.0    # oldest-first eviction
+
+
+# -- objective + burn-rate semantics ---------------------------------------
+
+def test_objective_validation():
+    with pytest.raises(ValueError, match="unknown kind"):
+        Objective("x", "median", 1.0)
+    with pytest.raises(ValueError, match="0 < q < 1"):
+        Objective("x", "quantile", 1.0, metric="m", q=1.5)
+    with pytest.raises(ValueError, match="needs num"):
+        Objective("x", "ratio", 0.1)
+    with pytest.raises(ValueError, match="duplicate objective"):
+        SLOEngine([{"name": "a", "kind": "ratio", "max": 0.1,
+                    "num": "n", "den": "d"}] * 2)
+    o = Objective.from_dict({"name": "ttft_p99", "kind": "quantile",
+                             "metric": "m", "q": 0.99, "max": 0.5})
+    assert o.to_dict()["q"] == 0.99
+    assert "p99" in o.describe()
+
+
+def _ttft_engine(reg, ts, windows):
+    ring = tracing.SpanRecorder()
+    fr = tracing.FlightRecorder(recorder=ring, min_interval_s=0.0)
+    eng = SLOEngine(
+        [{"name": "ttft_p99", "kind": "quantile",
+          "metric": "ttft_seconds", "q": 0.99, "max": 0.1}],
+        windows=windows, timeseries=ts, registry=reg, recorder=ring,
+        flight_recorder=fr)
+    return eng, ring, fr
+
+
+WINDOWS = ({"name": "fast", "window_s": 2.0, "burn_threshold": 14.0},
+           {"name": "slow", "window_s": 60.0, "burn_threshold": 2.0})
+
+
+def test_fast_window_catches_cliff_slow_stays_quiet():
+    """A sudden cliff: the last 2 seconds are 100% bad (burn 100x over
+    a 1% budget) but diluted to ~1x over the full hour-style window —
+    exactly the case the fast window exists for."""
+    reg, ts = _reg_ts()
+    h = reg.histogram("ttft_seconds", buckets=(0.01, 0.1, 1.0))
+    h.labels()
+    ts.sample(now=0.0)
+    for _ in range(990):
+        h.observe(0.05)                 # healthy era
+    ts.sample(now=58.0)
+    for _ in range(10):
+        h.observe(0.5)                  # the cliff
+    ts.sample(now=60.0)
+    eng, ring, fr = _ttft_engine(reg, ts, WINDOWS)
+    rep = eng.evaluate(now=60.0)
+    fast = rep["objectives"][0]["windows"]["fast"]
+    slow = rep["objectives"][0]["windows"]["slow"]
+    assert fast["breached"] and fast["burn_rate"] == pytest.approx(100.0)
+    assert not slow["breached"] and slow["burn_rate"] == pytest.approx(
+        1.0, rel=1e-6)
+    assert rep["breaches"] == 1
+    assert eng.breach_counts == {("ttft_p99", "fast"): 1}
+    assert [s["name"] for s in ring.spans()].count("slo_breach") == 1
+
+
+def test_slow_window_catches_slow_burn_fast_stays_quiet():
+    """A sustained 3x burn: never enough to trip the 14x fast alarm,
+    but it exhausts the budget 3x too fast — the slow window's job."""
+    reg, ts = _reg_ts()
+    h = reg.histogram("ttft_seconds", buckets=(0.01, 0.1, 1.0))
+    h.labels()
+    ts.sample(now=0.0)
+    for i in range(900):
+        h.observe(0.5 if i % 100 < 3 else 0.05)     # 3% bad, uniform
+    ts.sample(now=58.0)
+    for i in range(100):
+        h.observe(0.5 if i < 3 else 0.05)           # same mix, last 2s
+    ts.sample(now=60.0)
+    eng, ring, fr = _ttft_engine(reg, ts, WINDOWS)
+    rep = eng.evaluate(now=60.0)
+    fast = rep["objectives"][0]["windows"]["fast"]
+    slow = rep["objectives"][0]["windows"]["slow"]
+    assert not fast["breached"] and fast["burn_rate"] == pytest.approx(3.0)
+    assert slow["breached"] and slow["burn_rate"] == pytest.approx(3.0)
+    assert eng.breach_counts == {("ttft_p99", "slow"): 1}
+
+
+def test_both_windows_breach_on_total_outage():
+    reg, ts = _reg_ts()
+    h = reg.histogram("ttft_seconds", buckets=(0.01, 0.1, 1.0))
+    h.labels()
+    ts.sample(now=0.0)
+    for _ in range(100):
+        h.observe(0.5)
+    ts.sample(now=58.0)
+    for _ in range(100):
+        h.observe(0.5)
+    ts.sample(now=60.0)
+    eng, ring, fr = _ttft_engine(reg, ts, WINDOWS)
+    rep = eng.evaluate(now=60.0)
+    assert rep["breaches"] == 2
+    assert rep["objectives"][0]["windows"]["fast"]["breached"]
+    assert rep["objectives"][0]["windows"]["slow"]["breached"]
+    assert eng.breaches_total == 2
+    obs.validate_report(rep)
+
+
+def test_breach_counts_into_registry_and_dumps(tmp_path):
+    reg, ts = _reg_ts()
+    h = reg.histogram("ttft_seconds", buckets=(0.01, 0.1, 1.0))
+    h.labels()
+    ts.sample(now=0.0)
+    for _ in range(50):
+        h.observe(0.5)
+    ts.sample(now=60.0)
+    eng, ring, fr = _ttft_engine(reg, ts, WINDOWS)
+    fr.arm(tmp_path)
+    rep = eng.evaluate(now=60.0)
+    assert rep["breaches"] >= 1
+    # counter (in the engine's registry), timeline event, flight dump
+    kids = reg.snapshot()["slo_breaches_total"]["children"]
+    assert sum(c["value"] for c in kids.values()) == rep["breaches"]
+    dumps = list(tmp_path.glob("flightrec_slo_burn_rate_*.json"))
+    assert dumps, "breach fired no slo_burn_rate dump"
+    dump = tracing.load_dump(str(dumps[0]))
+    assert dump["reason"] == "slo_burn_rate"
+    assert dump["context"]["objective"] == "ttft_p99"
+    assert dump["context"]["burn_rate"] > 0
+
+
+def test_min_count_guard_and_empty_windows():
+    """Two slow requests at startup are not a p99 regression: below
+    min_count the window does not evaluate at all."""
+    reg, ts = _reg_ts()
+    h = reg.histogram("ttft_seconds", buckets=(0.01, 0.1, 1.0))
+    h.labels()
+    ts.sample(now=0.0)
+    h.observe(0.5)
+    h.observe(0.5)
+    ts.sample(now=1.0)
+    eng = SLOEngine(
+        [{"name": "ttft_p99", "kind": "quantile",
+          "metric": "ttft_seconds", "q": 0.99, "max": 0.1,
+          "min_count": 10}],
+        windows=WINDOWS, timeseries=ts, registry=reg,
+        recorder=tracing.SpanRecorder(),
+        flight_recorder=tracing.FlightRecorder(
+            recorder=tracing.SpanRecorder()))
+    rep = eng.evaluate(now=1.0)
+    assert rep["breaches"] == 0
+    assert rep["objectives"][0]["windows"]["fast"] is None
+    assert rep["objectives"][0]["windows"]["slow"] is None
+    obs.validate_report(rep)
+
+
+def test_ratio_objective_zero_budget_is_infinite_burn():
+    """kv_alloc_failure ratio < 0: ANY failure is an infinite burn (the
+    strictest spelling of 'this must never happen')."""
+    reg, ts = _reg_ts()
+    num = reg.counter("fail_total")
+    den = reg.counter("tok_total")
+    num.inc(0)
+    den.inc(0)
+    ts.sample(now=0.0)
+    den.inc(1000)
+    num.inc(1)
+    ts.sample(now=10.0)
+    ring = tracing.SpanRecorder()
+    eng = SLOEngine(
+        [{"name": "alloc_fail", "kind": "ratio", "max": 0.0,
+          "num": "fail_total", "den": "tok_total"}],
+        windows=[{"name": "fast", "window_s": 30.0,
+                  "burn_threshold": 1.0}],
+        timeseries=ts, registry=reg, recorder=ring,
+        flight_recorder=tracing.FlightRecorder(recorder=ring))
+    rep = eng.evaluate(now=10.0)
+    ev = rep["objectives"][0]["windows"]["fast"]
+    assert ev["breached"] and math.isinf(ev["burn_rate"])
+    assert rep["breaches"] == 1
+    obs.validate_report(rep)            # inf burn must stay schema-clean
+    # serialization boundary: the inf must never reach a report file as
+    # a bare `Infinity` literal (RFC 8259 has none) — json_safe spells
+    # it "+Inf" and the result round-trips through a strict encoder
+    safe = obs.json_safe(rep)
+    rt = json.loads(json.dumps(safe, allow_nan=False))
+    assert rt["objectives"][0]["windows"]["fast"]["burn_rate"] == "+Inf"
+    obs.validate_report(rt)
+
+
+def test_monitor_cadence_gates_evaluations():
+    reg, ts = _reg_ts()
+    reg.counter("c_total").inc()
+    mon = SLOMonitor(
+        [{"name": "r", "kind": "ratio", "max": 1.0, "num": "c_total",
+          "den": "c_total"}],
+        windows=[{"name": "fast", "window_s": 5.0,
+                  "burn_threshold": 100.0}],
+        cadence_s=1.0, registry=reg,
+        recorder=tracing.SpanRecorder(),
+        flight_recorder=tracing.FlightRecorder(
+            recorder=tracing.SpanRecorder()))
+    assert mon.tick(now=0.0) is not None        # first tick evaluates
+    assert mon.tick(now=0.5) is None            # inside the cadence
+    assert mon.tick(now=0.99) is None
+    assert mon.tick(now=1.0) is not None
+    assert mon.engine.evaluations == 2
+    assert mon.force(now=1.5) is not None       # force ignores cadence
+    assert mon.last_report is not None
+    assert mon.breaches_total == 0
+
+
+# -- live serving engine ---------------------------------------------------
+
+def _tiny_engine(seed=0):
+    from test_chunked_prefill import _tiny_engine as _cached
+    return _cached(seed=seed, max_seq_len=32)
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    old = fa._INTERPRET
+    fa._INTERPRET = True
+    yield
+    fa._INTERPRET = old
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    obs.get_tracer().clear()
+    obs.get_flight_recorder().disarm()
+    yield
+    obs.get_flight_recorder().disarm()
+
+
+def _serve(workload, monitor=None, seed=11, **engine_kw):
+    from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                        GenerationRequest)
+
+    eng, V = _tiny_engine()
+    rng = np.random.default_rng(seed)
+    kw = dict(num_blocks=12, block_size=8, max_batch=2, prefill_chunk=4)
+    kw.update(engine_kw)
+    cb = ContinuousBatchingEngine(eng, monitor=monitor, **kw)
+    reqs = [GenerationRequest(rng.integers(1, V, p).astype(np.int32), n)
+            for p, n in workload]
+    for r in reqs:
+        cb.submit(r)
+    out = cb.run()
+    return cb, [out[r.request_id] for r in reqs]
+
+
+def test_tightened_objective_breaches_on_live_engine(tmp_path):
+    """The acceptance contract: deliberately tightening an objective
+    in-memory (a p99 TPOT bound no interpreter can meet) produces a
+    breach, a nonzero slo_breaches_total, an slo_breach timeline event,
+    and an slo_burn_rate flight dump that loads."""
+    reg = obs.get_registry()
+    before = sum(
+        c["value"] for c in reg.snapshot().get(
+            "slo_breaches_total", {}).get("children", {}).values())
+    obs.get_flight_recorder().arm(tmp_path)
+    mon = SLOMonitor(
+        [{"name": "tpot_p99_tight", "kind": "quantile",
+          "metric": "serve_time_per_output_token_seconds",
+          "q": 0.99, "max": 1e-9}],
+        windows=[{"name": "fast", "window_s": 5.0,
+                  "burn_threshold": 1.0}],
+        cadence_s=0.0)                  # every step samples + evaluates
+    cb, outs = _serve([(5, 8), (9, 6)], monitor=mon)
+    assert mon.breaches_total > 0
+    after = sum(
+        c["value"] for c in reg.snapshot()["slo_breaches_total"]
+        ["children"].values())
+    assert after - before == mon.breaches_total
+    names = [s["name"] for s in obs.get_tracer().spans()]
+    assert "slo_breach" in names
+    dumps = list(tmp_path.glob("flightrec_slo_burn_rate_*.json"))
+    assert dumps, "live breach fired no slo_burn_rate dump"
+    dump = tracing.load_dump(str(dumps[0]))
+    assert dump["reason"] == "slo_burn_rate"
+    assert dump["context"]["objective"] == "tpot_p99_tight"
+    # the dump carries the serving spans of the breach window
+    assert any(s["name"] == "decode" for s in dump["spans"])
+    obs.validate_report(mon.last_report)
+
+
+def test_monitor_is_token_exact_neutral_and_compile_stable():
+    """The PR 6 trace-leg contract extended to the SLO engine: monitor
+    on vs off — identical tokens, identical step counts, zero new
+    compile buckets."""
+    workload = [(5, 4), (11, 3)]
+    cb_warm, _ = _serve(workload)       # warm the compile caches
+    warm = set(cb_warm._seen_buckets)
+    mon = SLOMonitor(
+        [{"name": "ttft_p99", "kind": "quantile",
+          "metric": "serve_ttft_seconds", "q": 0.99, "max": 60.0}],
+        cadence_s=0.0)
+    cb_on, out_on = _serve(workload, monitor=mon)
+    cb_off, out_off = _serve(workload)
+    assert out_on == out_off, "SLO monitoring changed generated tokens"
+    assert cb_on._step_count == cb_off._step_count
+    assert (set(cb_on._seen_buckets) | set(cb_off._seen_buckets)) \
+        <= warm, "monitoring leaked a fresh compile bucket"
+    assert mon.engine.evaluations >= 1
+    assert mon.breaches_total == 0      # generous objective stays quiet

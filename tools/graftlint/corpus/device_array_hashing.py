@@ -1,0 +1,95 @@
+# graftlint-corpus-expect: GL110 GL110 GL110 GL110 GL110
+"""Dict/set keying on jax device arrays (GL110): hashing an Array
+forces a blocking device->host sync per probe AND compares by
+value-of-the-moment — a donated or mutated buffer silently changes the
+key under the container, so the same logical token can miss its own
+index entry. The clean idiom is the prefix index's block_key: ONE bulk
+np.asarray() transfer, then host int/tuple keys (the tripwires below
+must stay silent)."""
+import jax
+import numpy as np
+
+
+def _decode_step(w, caches, toks):
+    return toks, caches
+
+
+def block_key(parent, tokens):
+    # the host-bytes idiom the serving prefix index uses: keys are
+    # built from HOST ints, never device arrays
+    return (parent, tuple(int(t) for t in tokens))
+
+
+class PrefixServer:
+    def __init__(self):
+        self._paged_step = jax.jit(_decode_step)
+        self.w = {}
+        self.caches = []
+        self._index = {}            # block_key -> physical block
+        self._seen = set()
+        self.finished = dict()
+
+    def serve_bad_set_membership(self, slab):
+        out, self.caches = self._paged_step(self.w, self.caches, slab)
+        tok = out[0, 0]
+        if tok in self._seen:       # hash(Array): sync + moment-value
+            return True
+        self._seen.add(int(tok))
+        return False
+
+    def serve_bad_dict_key(self, slab):
+        out, self.caches = self._paged_step(self.w, self.caches, slab)
+        # keying the index by the device value: every probe syncs, and
+        # a donated `out` buffer rewrites the key retroactively
+        self._index[out[0, 0]] = 7
+        return self._index
+
+    def serve_bad_dict_get(self, slab):
+        out, self.caches = self._paged_step(self.w, self.caches, slab)
+        return self.finished.get(out[0, 0])
+
+    def serve_bad_set_add(self, slab):
+        out, self.caches = self._paged_step(self.w, self.caches, slab)
+        self._seen.add(out[0, 0])   # stores a device handle as a key
+        return len(self._seen)
+
+    def serve_bad_list_membership(self, slab, accepted):
+        out, self.caches = self._paged_step(self.w, self.caches, slab)
+        draft = out[0, 0]
+        # lists hash nothing but `in` still runs __eq__ per element —
+        # one device sync per comparison
+        return draft in accepted
+
+    # -- clean-idiom tripwires: none of these may flag -------------------
+
+    def serve_clean_host_keys(self, slab):
+        out, self.caches = self._paged_step(self.w, self.caches, slab)
+        out = np.asarray(out)           # ONE bulk transfer launders
+        if out[0, 0] in self._seen:     # host scalar: plain hashing
+            return True
+        self._seen.add(int(out[0, 0]))
+        self._index[block_key(None, out[0])] = 3
+        return False
+
+    def serve_clean_array_indexing(self, slab, i):
+        out, self.caches = self._paged_step(self.w, self.caches, slab)
+        # subscripting the DEVICE ARRAY by a host index is indexing,
+        # not hashing — no container, no key
+        return out[i, 0]
+
+    def serve_clean_host_container_host_key(self, reqs):
+        # host ints keying host dicts never flag, device code or not
+        done = {}
+        for r in reqs:
+            done[int(r)] = True
+        return done
+
+    def serve_clean_shape_metadata_key(self, slab):
+        out, self.caches = self._paged_step(self.w, self.caches, slab)
+        # .shape/.dtype are HOST metadata — hashing them never syncs
+        shape = out.shape
+        if shape in self._seen:
+            return True
+        self._seen.add(shape)
+        self._index[(out.shape[0], str(out.dtype))] = 1
+        return False

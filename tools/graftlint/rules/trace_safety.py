@@ -50,6 +50,17 @@ free host math — which is also why a whole-array `np.asarray` of a value
 produced INSIDE the same loop never flags, while scalar casts always do
 and a loop-invariant `np.asarray` (result bound outside the loop) flags
 as a hoistable repeated transfer.
+
+GL110 flags dict/set membership on — or dict keying by — a jax device
+array: `x in some_set`, `d[x]`, `d.get(x)`, `s.add(x)` where `x` is a
+compiled program's result. Hashing/equality on an Array forces a
+blocking device sync per probe AND compares by value-of-the-moment — a
+donated or mutated buffer silently changes the key under the container,
+so the same logical token can miss its own index entry. The prefix
+index hashes HOST token ints for exactly this reason
+(continuous_batching.block_key: `tuple(int(t) for t in tokens)` over
+host lists — the clean idiom the corpus tripwires pin); a device result
+laundered through one bulk `np.asarray()` is host data and never flags.
 """
 import ast
 
@@ -514,6 +525,25 @@ def _root_name(expr):
     return expr.id if isinstance(expr, ast.Name) else None
 
 
+# jax.Array attributes that return plain HOST objects — accessing them
+# neither transfers nor keeps the result on device, so `out.shape`,
+# `out.dtype.name`, `out.shape[0]` are host values, not device bindings
+_HOST_META_ATTRS = frozenset({
+    "shape", "dtype", "ndim", "size", "nbytes", "itemsize", "device",
+    "devices", "sharding", "weak_type", "is_deleted"})
+
+
+def _touches_host_meta(expr):
+    """True when the subscript/attribute chain reads a host metadata
+    attribute anywhere (`out.shape[0]` -> host int, not device)."""
+    while isinstance(expr, (ast.Subscript, ast.Attribute)):
+        if isinstance(expr, ast.Attribute) and \
+                expr.attr in _HOST_META_ATTRS:
+            return True
+        expr = expr.value
+    return False
+
+
 def _device_bindings(fn, jit_names, np_aliases):
     """{name: [assign nodes]} for names bound from a device call in
     `fn`, minus names laundered host-side via a whole-array
@@ -544,6 +574,30 @@ def _device_bindings(fn, jit_names, np_aliases):
                 for el in names:
                     if isinstance(el, ast.Name):
                         bound.setdefault(el.id, []).append(node)
+    # propagate through pure access: `tok = out[0, 0]` is still a device
+    # value when `out` is (slicing/attribute access never transfers) —
+    # fixpoint over the function's assignments. Host METADATA attributes
+    # (`out.shape`, `.dtype`, ...) are plain host objects and stop the
+    # propagation.
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                    node.value, (ast.Name, ast.Subscript, ast.Attribute)):
+                continue
+            if _touches_host_meta(node.value):
+                continue
+            root = _root_name(node.value)
+            if root not in bound or root in cleared:
+                continue
+            for t in node.targets:
+                names = t.elts if isinstance(t, ast.Tuple) else [t]
+                for el in names:
+                    if isinstance(el, ast.Name) and el.id not in bound \
+                            and el.id not in cleared:
+                        bound[el.id] = [node]
+                        changed = True
     return {k: v for k, v in bound.items() if k not in cleared}
 
 
@@ -595,3 +649,113 @@ def host_sync_in_serve_loop(ctx):
                         "OUTSIDE it: the same device->host transfer "
                         "repeats every iteration — hoist the conversion "
                         "above the loop"), node
+
+
+_DICT_SET_CALLS = {"dict", "set", "frozenset", "OrderedDict",
+                   "defaultdict", "Counter"}
+
+
+def _dict_set_names(ctx):
+    """Plain and `self.`-attribute names this file ever binds to a dict
+    or set (literal, comprehension, or stdlib constructor) — the
+    containers whose __contains__/__getitem__/.get/.add HASH their
+    argument."""
+    out = set()
+    for stmt in ast.walk(ctx.tree):
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        hashy = isinstance(value, (ast.Dict, ast.Set, ast.DictComp,
+                                   ast.SetComp))
+        if not hashy and isinstance(value, ast.Call):
+            f = value.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            hashy = name in _DICT_SET_CALLS
+        if not hashy:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                out.add(t.attr)
+    return out
+
+
+def _container_name(expr):
+    """`d` / `self._index` -> the name GL110 matched against
+    _dict_set_names; None for anything it can't see through."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+_GL110_MSG = (
+    "forces a blocking device->host sync per probe (Array.__hash__/"
+    "__eq__) and compares by value-of-the-moment — a donated/mutated "
+    "buffer changes the key under the container. Hash HOST data "
+    "instead: one bulk np.asarray(), then int()/tuple() keys "
+    "(continuous_batching.block_key hashes host token ints for exactly "
+    "this reason)")
+
+
+@rule("GL110", "device-array-hash-key", "trace-safety")
+def device_array_hash_key(ctx):
+    """Dict/set membership on — or dict keying by — a jax device array
+    (a compiled program's un-laundered result): `x in s`, `d[x]`,
+    `d.get(x)`, `s.add(x)`. Hashing an Array forces a device sync per
+    probe and keys on the value-of-the-moment; the prefix index's
+    block_key hashes host token bytes for exactly this reason."""
+    jit_names = _jit_bound_names(ctx)
+    containers = _dict_set_names(ctx)
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        dev = _device_bindings(fn, jit_names, ctx.numpy_aliases)
+        if not dev:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Compare):
+                # membership: the HASHED/COMPARED operand is the left
+                # side of each `in`/`not in` (works on sets, dicts, and
+                # lists — a device value on either side of `in` syncs)
+                for i, op in enumerate(node.ops):
+                    if not isinstance(op, (ast.In, ast.NotIn)):
+                        continue
+                    left = node.left if i == 0 else node.comparators[i - 1]
+                    root = _root_name(left)
+                    if root in dev and not _touches_host_meta(left):
+                        yield ctx.finding(
+                            "GL110", node,
+                            f"membership test on device result `{root}` "
+                            + _GL110_MSG), node
+            elif isinstance(node, ast.Subscript):
+                if _container_name(node.value) not in containers:
+                    continue        # array indexing is not hashing
+                sl = node.slice
+                elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+                for e in elts:
+                    root = _root_name(e)
+                    if root in dev and not _touches_host_meta(e):
+                        yield ctx.finding(
+                            "GL110", node,
+                            f"dict/set keyed by device result `{root}` "
+                            + _GL110_MSG), node
+                        break
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("get", "add", "setdefault",
+                                           "pop", "discard") \
+                    and node.args \
+                    and _container_name(node.func.value) in containers:
+                root = _root_name(node.args[0])
+                if root in dev and not _touches_host_meta(node.args[0]):
+                    yield ctx.finding(
+                        "GL110", node,
+                        f".{node.func.attr}() keyed by device result "
+                        f"`{root}` " + _GL110_MSG), node

@@ -10,9 +10,15 @@ cd "$(dirname "$0")/.."
 python tools/metrics_snapshot.py --selfcheck
 python -m tools.graftlint --selftest
 python -m tools.graftlint paddle_tpu/ tests/ tools/ "$@"
-# prefix-caching serving gate (host-deterministic chunk-sweep /
-# high-water accounting; ~20 s on CPU via interpret mode). Skip with
-# LINT_SKIP_SERVE=1 when iterating on pure static-analysis changes.
+# serving gates (host-deterministic step/chunk/span accounting; a few
+# minutes total on CPU via interpret mode). Skip with LINT_SKIP_SERVE=1
+# when iterating on pure static-analysis changes.
 if [ "${LINT_SKIP_SERVE:-0}" != "1" ]; then
+  python tools/serve_bench.py --check tools/serve_ragged.json
+  python tools/serve_bench.py --check tools/serve_spec.json
   python tools/serve_bench.py --check tools/serve_prefix.json
+  # SLO-monitor gate: heavy-tail workload, windowed p99s under the
+  # declared objectives, zero burn-rate breaches, monitor neutrality
+  python tools/serve_monitor.py --check tools/serve_slo.json \
+    --no-flight-recorder
 fi

@@ -10,12 +10,16 @@ Two jobs:
   registry.
 * ``python tools/metrics_snapshot.py --selfcheck`` exercises the whole
   metrics core — registry, concurrency, histogram bucket edges, all
-  three exporters — plus the tracing span ring (wraparound, concurrent
-  recording, the tracer arg guard) and the flight-recorder dump schema
-  (write -> stdlib json load -> ``tracing.load_dump`` validation ->
-  ``request_summary`` replay), and exits non-zero on any violation.
+  three exporters (incl. the 0.0.4 help-vs-label escaping split) —
+  plus the tracing span ring (wraparound, concurrent recording, the
+  tracer arg guard), the flight-recorder dump schema (write -> stdlib
+  json load -> ``tracing.load_dump`` validation -> ``request_summary``
+  replay) and retention manifest, the windowed time-series ring
+  (rate / delta-quantile / gauge stats on a synthetic clock), and the
+  SLO engine (burn-rate breach -> counter + ``validate_report`` schema
+  + ``slo_burn_rate`` dump), and exits non-zero on any violation.
   Wired into tools/lint.sh so the tier-0 gate
-  (tests/test_graftlint_gate.py) catches a broken metrics/tracing
+  (tests/test_graftlint_gate.py) catches a broken metrics/tracing/SLO
   subsystem before any test imports jax.
 
 The selfcheck must run in a bare container: paddle_tpu/__init__ imports
@@ -140,6 +144,20 @@ def selfcheck():
                    'sc_latency_seconds_bucket{le="+Inf"} 5',
                    'sc_depth{queue="a"} 4'):
         check(needle in prom, f"prometheus output missing {needle!r}")
+    # exposition 0.0.4 escaping SPLIT: help text escapes only \ and
+    # newline (quotes stay raw — help is unquoted); label VALUES escape
+    # the quote too (they sit inside quotes)
+    reg.counter('sc_esc_total', help='say "hi"\nback\\slash',
+                labels=("q",)).labels(q='a"b\\c').inc()
+    prom = obs.to_prometheus(reg)
+    check('# HELP sc_esc_total say "hi"\\nback\\\\slash' in prom,
+          "help escaping wrong (quotes must stay raw, \\n/\\\\ escape): "
+          + [l for l in prom.splitlines()
+             if l.startswith("# HELP sc_esc_total")][0])
+    check('sc_esc_total{q="a\\"b\\\\c"} 1' in prom,
+          "label-value escaping wrong: "
+          + [l for l in prom.splitlines()
+             if l.startswith("sc_esc_total{")][0])
     snap = json.loads(obs.to_json(reg))
     check(set(snap) == {"time", "metrics"}, "json envelope wrong")
     check(snap["metrics"]["sc_requests_total"]["children"][""]["value"]
@@ -224,6 +242,115 @@ def selfcheck():
             pass
     finally:
         shutil.rmtree(d, ignore_errors=True)
+
+    # timeseries ring: windowed rate / delta-quantile / gauge stats on
+    # a synthetic clock (explicit now= — determinism is the contract)
+    reg2 = obs.MetricsRegistry()
+    ts = obs.TimeSeries(registry=reg2, capacity=8)
+    c2 = reg2.counter("ts_total")
+    h2 = reg2.histogram("ts_seconds", buckets=(0.1, 1.0, 10.0))
+    g2 = reg2.gauge("ts_depth")
+    c2.inc(0); g2.set(0)            # create children before sampling
+    h2.observe(0.05)
+    ts.sample(now=0.0)
+    c2.inc(50)
+    for v in (0.5, 0.5, 5.0):
+        h2.observe(v)
+    g2.set(4)
+    ts.sample(now=10.0)
+    check(ts.rate("ts_total", 10.0, now=10.0) == 5.0,
+          f"windowed counter rate wrong: "
+          f"{ts.rate('ts_total', 10.0, now=10.0)}")
+    q = ts.quantile("ts_seconds", 0.5, 10.0, now=10.0)
+    check(q is not None and 0.1 < q <= 1.0,
+          f"delta-histogram median {q} outside its bucket (the 0.05 "
+          "observed BEFORE the window must not count)")
+    check(ts.count("ts_seconds", 10.0, now=10.0) == 3,
+          "windowed observation count wrong")
+    frac = ts.fraction_over("ts_seconds", 1.0, 10.0, now=10.0)
+    check(frac is not None and abs(frac - 1 / 3) < 1e-9,
+          f"fraction_over wrong: {frac} != 1/3")
+    st = ts.gauge_stats("ts_depth", 20.0, now=10.0)
+    check(st == {"min": 0.0, "max": 4.0, "mean": 2.0, "last": 4.0,
+                 "samples": 2}, f"gauge stats wrong: {st}")
+    for i in range(20):             # bounded ring: drops are counted
+        ts.sample(now=20.0 + i)
+    check(len(ts.ring("ts_total")) == 8 and ts.dropped > 0,
+          f"timeseries ring not bounded: len="
+          f"{len(ts.ring('ts_total'))} dropped={ts.dropped}")
+
+    # registry timeline ring: overflow must be visible, not silent
+    reg3 = obs.MetricsRegistry(timeline_capacity=4)
+    g3 = reg3.gauge("tl_depth")
+    for i in range(10):
+        g3.set(i)
+    tstats = reg3.timeline_stats()
+    check(tstats == {"samples": 4, "capacity": 4, "dropped": 6},
+          f"timeline drop accounting wrong: {tstats}")
+    check(reg3.snapshot().get("_timeline", {}).get("dropped") == 6,
+          "snapshot() does not carry the timeline drop count")
+
+    # SLO engine: synthetic breach -> counter + schema + burn-rate
+    # flight dump with retention manifest
+    reg4 = obs.MetricsRegistry()
+    ts4 = obs.TimeSeries(registry=reg4)
+    lat = reg4.histogram("slo_ttft_seconds", buckets=(0.01, 0.1, 1.0))
+    lat.observe(0.005)
+    ts4.sample(now=0.0)
+    for _ in range(10):
+        lat.observe(0.5)            # 100% of the window over a 0.1 SLO
+    ts4.sample(now=5.0)
+    ring4 = obs.tracing.SpanRecorder()
+    fr4 = obs.tracing.FlightRecorder(recorder=ring4, min_interval_s=0.0)
+    eng = obs.SLOEngine(
+        [{"name": "ttft_p99", "kind": "quantile",
+          "metric": "slo_ttft_seconds", "q": 0.99, "max": 0.1}],
+        windows=[{"name": "fast", "window_s": 10.0,
+                  "burn_threshold": 14.0}],
+        timeseries=ts4, registry=reg4, recorder=ring4,
+        flight_recorder=fr4)
+    d4 = tempfile.mkdtemp(prefix="sc_slo_")
+    try:
+        fr4.arm(d4, max_dumps=2)
+        rep = eng.evaluate(now=5.0)
+        obs.validate_report(rep)    # schema contract
+        check(rep["breaches"] == 1 and eng.breaches_total == 1,
+              f"synthetic cliff did not breach: {rep['breaches']}")
+        ev = rep["objectives"][0]["windows"]["fast"]
+        check(ev["breached"] and ev["burn_rate"] >= 14.0,
+              f"burn rate wrong: {ev}")
+        snap4 = reg4.snapshot()
+        bc = snap4.get("slo_breaches_total", {}).get("children", {})
+        check(sum(ch["value"] for ch in bc.values()) == 1,
+              f"slo_breaches_total not counted: {bc}")
+        dumps4 = [f for f in os.listdir(d4)
+                  if f.startswith("flightrec_slo_burn_rate")]
+        check(len(dumps4) == 1, f"no slo_burn_rate dump: {dumps4}")
+        man = obs.tracing.load_manifest(d4)
+        check([e["file"] for e in man["dumps"]] == dumps4
+              and man["dumps"][0]["reason"] == "slo_burn_rate",
+              f"retention manifest wrong: {man}")
+        # a healthy stream must NOT breach: the cliff era ends at t=5;
+        # by t=16 the 10s window holds only healthy observations
+        lat2 = reg4.histogram("slo_ttft_seconds")
+        ts4.sample(now=6.0)
+        for _ in range(10):
+            lat2.observe(0.005)
+        ts4.sample(now=16.0)
+        rep2 = eng.evaluate(now=16.0)
+        check(eng.breaches_total == 1,
+              f"healthy window breached: {rep2['breaches']}")
+        ev2 = rep2["objectives"][0]["windows"]["fast"]
+        check(ev2 is not None and ev2["burn_rate"] == 0.0
+              and not ev2["breached"],
+              f"healthy burn rate not zero: {ev2}")
+        try:
+            obs.validate_report({"schema": "something/else"})
+            check(False, "validate_report accepted a foreign schema")
+        except ValueError:
+            pass
+    finally:
+        shutil.rmtree(d4, ignore_errors=True)
     return failures
 
 

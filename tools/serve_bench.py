@@ -942,7 +942,15 @@ def main():
                          "mode (works on CPU via interpret mode)")
     ap.add_argument("--chunk", type=int, default=64,
                     help="prefill chunk size for the --prefill leg")
+    ap.add_argument("--no-flight-recorder", action="store_true",
+                    help="do not arm the anomaly flight recorder "
+                         "(server-style entrypoints arm by default with "
+                         "bounded retention; legs that manage their own "
+                         "arming still override it)")
     args = ap.parse_args()
+    if not args.no_flight_recorder:
+        from paddle_tpu.observability import tracing
+        tracing.arm_default()
     import jax
     if args.check:
         with open(args.check) as f:
