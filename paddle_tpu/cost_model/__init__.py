@@ -1,11 +1,18 @@
 """paddle.cost_model parity (reference python/paddle/cost_model/
 cost_model.py:33 — CostModel.profile_measure runs a static program under
-the profiler and reports per-op cost).
+the profiler and reports per-op cost), rebuilt on the observability cost
+catalog (observability/costs.py).
 
-Here profile_measure executes the recorded static Program through the
-Executor with the host tracer active and returns wall-time (the
-whole-program XLA executable is the schedulable unit on TPU — per-op cost
-splits are what the profiler's chrome trace shows)."""
+The whole-program XLA executable is the schedulable unit on TPU, and the
+static Executor already AOT-compiles and caches it
+(static/program.py: ``jax.jit(...).lower(arrays).compile()``) — so the
+compiled artifacts carry XLA's own cost/memory analyses for free.
+``profile_measure`` now reports, per compiled program: wall time,
+cost-analysis FLOPs and bytes accessed, and the memory-analysis
+argument/output/temp/peak-HBM sizes — the same catalog entries (and
+``program_flops{program}`` / ``program_bytes{program}`` /
+``program_peak_hbm{program}`` gauges) the serving and pretrain dispatch
+paths feed."""
 import time
 
 import numpy as np
@@ -32,9 +39,13 @@ class CostModel:
     def profile_measure(self, startup_program, main_program, device="gpu",
                         fetch_cost_list=("time",)):
         """Run the program once for warmup/compile, then measure; returns
-        {"time": ms, "fetches": [...]} (reference returns cost via the
-        profiler protobuf)."""
+        {"time": ms, "fetch_cost_list": [...], "programs": {name:
+        {flops, bytes_accessed, peak_hbm, arg_bytes, out_bytes,
+        temp_bytes, ...}}} — the per-program rows come straight from the
+        Executor's cached XLA executables through the cost catalog
+        (reference returns cost via the profiler protobuf)."""
         from .. import static
+        from ..observability import costs as _costs
         import paddle_tpu as paddle
 
         paddle.enable_static()
@@ -46,15 +57,45 @@ class CostModel:
                     if callable(getattr(main_program, "feed_names", None)) \
                     else []:
                 feeds[var] = np.random.random((10, 1)).astype("float32")
+            # fetch EVERY terminal output (produced, never consumed by a
+            # later op — not just the last op's: a program with two
+            # independent heads must keep both): an empty fetch list
+            # would let XLA dead-code-eliminate the whole module and the
+            # cost analysis would (truthfully) report a zero-flop program
+            fetch = []
+            ops = getattr(main_program, "ops", None) or []
+            consumed = {id(t) for rec in ops for _, t in rec.tensor_slots}
+            seen = set()
+            for rec in ops:
+                for t in rec.out_tensors:
+                    if id(t) not in consumed and id(t) not in seen:
+                        seen.add(id(t))
+                        fetch.append(t)
             # warmup compiles; the measured run reuses the executable
             try:
-                exe.run(main_program, feed=feeds or None)
+                exe.run(main_program, feed=feeds or None,
+                        fetch_list=fetch)
             except Exception:
                 feeds = {"X": np.random.random((10, 1)).astype("float32")}
-                exe.run(main_program, feed=feeds)
+                exe.run(main_program, feed=feeds, fetch_list=fetch)
             t0 = time.perf_counter()
-            exe.run(main_program, feed=feeds or None)
+            exe.run(main_program, feed=feeds or None, fetch_list=fetch)
             elapsed_ms = (time.perf_counter() - t0) * 1000.0
+            # the Executor's executable cache holds the real compiled
+            # artifacts: catalog every one (cost_analysis/memory_analysis
+            # are graceful no-ops on backends lacking them)
+            catalog = _costs.get_cost_catalog()
+            programs = {}
+            compiled = getattr(main_program, "_compiled", {})
+            for i, executable in enumerate(compiled.values()):
+                name = "static_program" if len(compiled) == 1 \
+                    else f"static_program_{i}"
+                entry = catalog.analyze_compiled(name, executable,
+                                                 source="static")
+                if entry is not None:
+                    programs[name] = entry
         finally:
             paddle.disable_static()
-        return {"time": elapsed_ms, "fetch_cost_list": list(fetch_cost_list)}
+        return {"time": elapsed_ms,
+                "fetch_cost_list": list(fetch_cost_list),
+                "programs": programs}

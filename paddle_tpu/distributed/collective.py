@@ -395,6 +395,42 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
 from . import comm_watchdog as _watchdog  # noqa: E402
 
 
+def _payload_nbytes(x):
+    """Host-side payload size of a collective argument: Tensors/arrays
+    by their nbytes (tracers report their aval size — shape metadata,
+    no device sync), lists/tuples summed, everything else 0. Never
+    raises: telemetry must not take down a collective."""
+    try:
+        if isinstance(x, (list, tuple)):
+            return sum(_payload_nbytes(t) for t in x)
+        a = x.data if isinstance(x, Tensor) else x
+        nb = getattr(a, "nbytes", None)
+        if nb is not None:
+            return int(nb)
+        shape = getattr(a, "shape", None)
+        dt = getattr(a, "dtype", None)
+        if shape is None or dt is None:
+            return 0
+        size = 1
+        for s in shape:
+            size *= int(s)
+        return size * int(getattr(dt, "itemsize", None)
+                          or np.dtype(dt).itemsize)
+    except Exception:
+        return 0
+
+
+# collectives whose FIRST positional arg is the OUTPUT container (the
+# payload rides second): attributing args[0] would record the shard-
+# sized output — an 8-rank reduce_scatter would under-report its
+# payload 8x — and a preallocated output tensor has nonzero nbytes, so
+# a "fall back when zero" heuristic never fires. Index the payload arg
+# explicitly per signature instead.
+_PAYLOAD_ARG = {"all_gather": 1, "reduce_scatter": 1, "scatter": 1,
+                "all_to_all": 1, "all_to_all_single": 1,
+                "alltoall": 1, "alltoall_single": 1}
+
+
 def _watched(fn):
     import functools
     import inspect
@@ -402,7 +438,10 @@ def _watched(fn):
         params = list(inspect.signature(fn).parameters)
         group_pos = params.index("group")
     except (ValueError, TypeError):
-        group_pos = None
+        params, group_pos = [], None
+    payload_pos = _PAYLOAD_ARG.get(fn.__name__, 0)
+    payload_name = params[payload_pos] if payload_pos < len(params) \
+        else None
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
@@ -411,7 +450,18 @@ def _watched(fn):
         group = kwargs.get("group")
         if group is None and group_pos is not None and len(args) > group_pos:
             group = args[group_pos]  # positionally-passed group
-        with _watchdog.task_scope(fn.__name__, group):
+        if len(args) > payload_pos:
+            payload = args[payload_pos]
+        else:
+            # keyword call shape (reduce_scatter(out, tensor_or_tensor_
+            # list=parts)): look the payload parameter up by name —
+            # falling back to args[0] would attribute the shard-sized
+            # OUTPUT, the exact under-report the index map exists to fix
+            payload = kwargs.get(payload_name) if payload_name else None
+            if payload is None and args:
+                payload = args[0]
+        nbytes = _payload_nbytes(payload) if payload is not None else 0
+        with _watchdog.task_scope(fn.__name__, group, nbytes=nbytes):
             return fn(*args, **kwargs)
     wrapper.__wrapped_collective__ = fn
     return wrapper

@@ -38,16 +38,33 @@ def _inflight_gauge():
 
 def _collective_seconds():
     return _registry().histogram(
-        "comm_collective_seconds",
-        help="host wall time of eager collective dispatches")
+        "collective_seconds",
+        help="host wall time of eager collective dispatches",
+        labels=("op", "axis"))
+
+
+def _collective_bytes():
+    return _registry().counter(
+        "collective_bytes_total",
+        help="payload bytes moved by eager collectives",
+        labels=("op", "axis"))
+
+
+def _collective_bandwidth():
+    return _registry().gauge(
+        "collective_bandwidth_bytes_per_s",
+        help="algorithmic bandwidth of the last completed collective "
+             "(payload bytes / host wall; ring-algorithm bus bandwidth "
+             "is a fixed multiple per op)",
+        labels=("op", "axis"))
 
 __all__ = ["CommTask", "CommTaskManager", "enable_comm_watchdog",
            "disable_comm_watchdog", "comm_task_manager"]
 
 
 class CommTask:
-    __slots__ = ("task_id", "op", "group", "seq", "start", "end", "nbytes",
-                 "reported")
+    __slots__ = ("task_id", "op", "group", "seq", "start", "start_pc",
+                 "end", "nbytes", "reported")
 
     def __init__(self, task_id, op, group, seq, nbytes=0):
         self.task_id = task_id
@@ -55,8 +72,11 @@ class CommTask:
         self.group = group
         self.seq = seq
         self.start = time.monotonic()
+        # span timebase (perf_counter — the tracing/profiler clock, a
+        # different epoch from the monotonic interval clock above)
+        self.start_pc = time.perf_counter()
         self.end = None
-        self.nbytes = nbytes
+        self.nbytes = int(nbytes or 0)
         self.reported = False
 
     @property
@@ -67,11 +87,24 @@ class CommTask:
     def elapsed(self):
         return (self.end or time.monotonic()) - self.start
 
+    @property
+    def bandwidth(self):
+        """Algorithmic bytes/s so far (a hung task's figure is the
+        FLOOR its payload has been moving at); None without a payload
+        size or before any time has passed."""
+        el = self.elapsed
+        if not self.nbytes or el <= 0:
+            return None
+        return self.nbytes / el
+
     def as_dict(self):
+        bw = self.bandwidth
         return {"task_id": self.task_id, "op": self.op,
                 "group": str(self.group), "seq": self.seq,
                 "elapsed_s": round(self.elapsed, 3), "done": self.done,
-                "nbytes": self.nbytes}
+                "nbytes": self.nbytes,
+                "bandwidth_bytes_per_s":
+                    None if bw is None else round(bw, 1)}
 
 
 class CommTaskManager:
@@ -93,7 +126,10 @@ class CommTaskManager:
 
     # -- task lifecycle (called from collective.py) --------------------
     def start_task(self, op, group=None, nbytes=0):
-        gname = getattr(group, "axis_name", None) or str(group)
+        # None = the default flat communicator: label it 'world' so the
+        # (op, axis) metric children read as an axis, not a repr
+        gname = getattr(group, "axis_name", None) or (
+            "world" if group is None else str(group))
         with self._lock:
             self._next_id += 1
             seq = self._seq.get(gname, 0) + 1
@@ -110,7 +146,23 @@ class CommTaskManager:
             self._tasks.pop(task.task_id, None)
             n = len(self._tasks)
         _inflight_gauge().set(n)
-        _collective_seconds().observe(task.elapsed)
+        # bytes + latency per (op, axis): the telemetry the ROADMAP's
+        # TP/disaggregated-serving work sizes its collectives against
+        el = task.elapsed
+        _collective_seconds().labels(op=task.op,
+                                     axis=task.group).observe(el)
+        if task.nbytes:
+            _collective_bytes().labels(op=task.op,
+                                       axis=task.group).inc(task.nbytes)
+            if el > 0:
+                _collective_bandwidth().labels(
+                    op=task.op, axis=task.group).set(task.nbytes / el)
+        # timeline span on the profiler clock: collectives line up
+        # against the serve/train host ranges in one chrome view
+        _tracing.get_tracer().record_span(
+            "collective", task.start_pc * 1e6, el * 1e6,
+            op=task.op, axis=str(task.group), seq=task.seq,
+            nbytes=task.nbytes)
 
     # -- watchdog ------------------------------------------------------
     def register_hang_hook(self, fn):
@@ -149,11 +201,22 @@ class CommTaskManager:
                 self._dump(hung)
 
     def _dump(self, hung):
+        outstanding = self.outstanding()
+        # what the collectives were MOVING, not just how long they sat:
+        # payload totals plus each task's bandwidth floor (as_dict
+        # carries the per-task figure) — a hang at 0 bytes/s is a dead
+        # link, a hang at a trickle is congestion/slow-peer
         report = {
             "time": time.time(),
             "hung_tasks": [t.as_dict() for t in hung],
-            "outstanding": self.outstanding(),
+            "outstanding": outstanding,
             "group_sequences": self.group_sequences(),
+            "nbytes": {
+                "hung_total": sum(t.nbytes for t in hung),
+                "outstanding_total": sum(t["nbytes"]
+                                         for t in outstanding),
+            },
+            "bandwidth": self._bandwidth_snapshot(),
         }
         log.error("comm watchdog: %d collective(s) exceeded %.0fs timeout: %s",
                   len(hung), self.timeout,
@@ -172,6 +235,16 @@ class CommTaskManager:
                 fn(report)
             except Exception:
                 pass
+
+    @staticmethod
+    def _bandwidth_snapshot():
+        """Last-completed bandwidth per (op, axis) from the registry —
+        the healthy baseline the hung tasks' floors compare against."""
+        g = _registry().get("collective_bandwidth_bytes_per_s")
+        if g is None:
+            return {}
+        return {",".join(k): round(c.value, 1)
+                for k, c in list(g._children.items())}
 
     def start(self):
         if self._thread is None or not self._thread.is_alive():

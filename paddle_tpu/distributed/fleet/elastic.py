@@ -196,7 +196,9 @@ class ElasticClusterManager:
             hb_key = self._key("hb", nid)
             if not self.store.check(hb_key):
                 continue
-            if now - float(self.store.get(hb_key)) < self.ttl_s:
+            # cross-process freshness: the heartbeat stamp came from
+            # ANOTHER node's clock — wall time is the shared timebase
+            if now - float(self.store.get(hb_key)) < self.ttl_s:  # graftlint: disable=GL111
                 alive.append(nid)
         return sorted(alive)
 

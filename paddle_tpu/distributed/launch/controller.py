@@ -173,7 +173,10 @@ class Controller:
         where no launcher survives to announce the failure — surface
         through the heartbeat TTL."""
         ttl = self.args.heartbeat_s * 5
-        start = time.time()
+        # local elapsed-time bookkeeping: monotonic (GL111 — an NTP
+        # step would fire or starve the TTL check); the CROSS-PROCESS
+        # heartbeat stamps themselves stay wall-clock in master.py
+        start = time.monotonic()
         last_hb_check = 0.0
         try:
             while True:
@@ -189,7 +192,7 @@ class Controller:
                     self._kill_worker(proc)
                     return (f"peer rank {failed['rank']} failed: "
                             f"{failed['reason']}")
-                now = time.time()
+                now = time.monotonic()
                 if (self.args.nnodes > 1 and now - start > ttl
                         and now - last_hb_check > self.args.heartbeat_s):
                     last_hb_check = now

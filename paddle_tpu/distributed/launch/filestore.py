@@ -69,7 +69,9 @@ class FileStore:
                 break
             except FileExistsError:
                 try:
-                    age = time.time() - os.stat(lock).st_mtime
+                    # cross-process staleness: st_mtime is wall clock
+                    # written by whichever host created the lock
+                    age = time.time() - os.stat(lock).st_mtime  # graftlint: disable=GL111
                     if age > self.LOCK_STALE_S:
                         os.unlink(lock)  # holder died; next loop re-races
                         continue

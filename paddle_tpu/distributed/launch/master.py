@@ -86,7 +86,9 @@ class Master:
         if not self.store.check(key):
             return True  # never beat yet — still starting
         ts = float(self.store.get(key))
-        return (time.time() - ts) < ttl_s
+        # cross-process freshness: the stamp was written by ANOTHER
+        # host's clock — wall time is the only shared timebase here
+        return (time.time() - ts) < ttl_s  # graftlint: disable=GL111
 
     def announce_failure(self, rank, reason, generation=0):
         """Failure keys are generation-scoped and never deleted — peers of

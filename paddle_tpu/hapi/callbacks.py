@@ -75,11 +75,13 @@ class ProgBarLogger(Callback):
         self.verbose = verbose
 
     def on_begin(self, mode, logs=None):
-        self._start = time.time()
+        # monotonic, not time.time(): these stamps only ever feed
+        # durations, and an NTP step mid-epoch would corrupt them (GL111)
+        self._start = time.monotonic()
 
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
-        self._epoch_start = time.time()
+        self._epoch_start = time.monotonic()
 
     def on_batch_end(self, mode, step, logs=None):
         if self.verbose and step % self.log_freq == 0:
@@ -89,7 +91,7 @@ class ProgBarLogger(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
-            dt = time.time() - self._epoch_start
+            dt = time.monotonic() - self._epoch_start
             items = " - ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
                                if isinstance(v, (int, float)) and k != "step")
             print(f"Epoch {epoch} done in {dt:.1f}s: {items}")

@@ -384,6 +384,13 @@ class ContinuousBatchingEngine:
     slo_breaches_total, lands on the timeline, and fires the flight
     recorder's `slo_burn_rate` trigger). Pure host math: token-exact-
     neutral with zero effect on the compile-bucket keyspace.
+
+    `memory_watch` (optional, observability/memory.MemoryMonitor) is
+    the device-resource counterpart: the same end-of-step tick()
+    cadence drives HBM/census accounting gauges and the `hbm_pressure`
+    flight trigger when headroom drops below the monitor's threshold —
+    the OOM black box, armed next to the SLO engine. Host-side only,
+    token-exact-neutral by the same construction.
     """
 
     SLO_WINDOW = 8      # decode-TPOT samples per controller decision
@@ -392,7 +399,7 @@ class ContinuousBatchingEngine:
                  temperature=0.0, top_p=1.0, seed=0, prefill_chunk=64,
                  token_budget=None, spec_k=0, spec_ngram=2,
                  tpot_slo=None, min_prefill_chunk=64, prefix_cache=False,
-                 monitor=None):
+                 monitor=None, memory_watch=None):
         import jax
 
         self.engine = engine
@@ -470,6 +477,9 @@ class ContinuousBatchingEngine:
         # step — pure host math over the registry, so it is token-exact-
         # neutral and touches no compile key by construction
         self.monitor = monitor
+        # HBM/census accounting on the same tick cadence (memory.py
+        # MemoryMonitor): gauges + the hbm_pressure flight trigger
+        self.memory_watch = memory_watch
         kvh = self.caches[0].shape[1]
         num_q = engine.num_heads
         self._pack = default_pack(self.max_batch, num_q // kvh)
@@ -835,6 +845,8 @@ class ContinuousBatchingEngine:
         if not active:
             if self.monitor is not None:
                 self.monitor.tick()     # keep sampling through idle ticks
+            if self.memory_watch is not None:
+                self.memory_watch.tick()
             return len(self.queue)
         if self._prefix_on:
             # admission + wavefront prefix matching: map every full
@@ -1105,6 +1117,9 @@ class ContinuousBatchingEngine:
             # otherwise — AFTER the step's own metrics landed, so a
             # breach evaluation always sees this step's samples
             self.monitor.tick()
+        if self.memory_watch is not None:
+            # same cadence contract: HBM/census gauges + hbm_pressure
+            self.memory_watch.tick()
         return len(self.queue) + self.num_active
 
     def _rewind_blocks(self, i, new_end):

@@ -301,7 +301,34 @@ def _get_phi_kernel_name(op_name):
     return op_name
 
 
-def _dispatch_span(name, fn):
+def _arg_signature(args, kwargs, static_argnums=()):
+    """Hashable shape/dtype signature of a jitted call — the same
+    information that keys jax's executable cache, computed host-side:
+    array leaves collapse to (shape, dtype) so VALUES never over-key
+    (a work list with different block ids is the same program), while
+    STATIC args keep their values (they key the compile). Used by the
+    dispatch wrappers to attribute cost analyses once per signature."""
+    import jax
+
+    static = {i: a for i, a in enumerate(args) if i in set(static_argnums)}
+    dyn = tuple(a for i, a in enumerate(args) if i not in static)
+
+    def freeze(x):
+        leaves, treedef = jax.tree_util.tree_flatten(x)
+        return (str(treedef), tuple(
+            (tuple(l.shape), str(l.dtype))
+            if hasattr(l, "shape") and hasattr(l, "dtype")
+            else ("py", type(l).__name__) for l in leaves))
+
+    def freeze_static(x):
+        leaves, treedef = jax.tree_util.tree_flatten(x)
+        return (str(treedef), tuple(leaves))
+
+    return (freeze((dyn, kwargs or {})),
+            tuple((i, freeze_static(a)) for i, a in sorted(static.items())))
+
+
+def _dispatch_span(name, fn, static_argnums=()):
     """Host-side span around a compiled program's dispatch (tracing.py
     ring; perf_counter timebase). jax dispatch is async: the measured
     interval covers trace/lower/compile (first call per bucket — which
@@ -312,13 +339,41 @@ def _dispatch_span(name, fn):
     `dispatch_seconds{program}` histogram so the windowed time-series
     layer (observability/timeseries.py) can answer "did DISPATCH get
     slower over the last N seconds" — the signal that separates a
-    model-side regression from queueing in the SLO engine's view."""
+    model-side regression from queueing in the SLO engine's view.
+
+    When the cost catalog is enabled (observability/costs.py — opt-in:
+    an analysis pays one extra backend compile), the FIRST call per
+    arg signature additionally AOT-analyzes the program and lands its
+    FLOPs/bytes/HBM in the catalog — the signature set mirrors jax's
+    own executable-cache keys, so analyses happen exactly at the cache
+    misses the compile watch sees, BEFORE the call so donated buffers
+    are still alive for lowering."""
     import time as _time
 
+    from ..observability import costs as _costs
     from ..observability import instrument as _instrument
     from ..observability import tracing as _tracing
 
+    seen = set()
+    seen_gen = [None]
+
     def call(*args, **kwargs):
+        catalog = _costs.get_cost_catalog()
+        if catalog.enabled:
+            if seen_gen[0] != catalog.generation:
+                # the catalog was reset: warm signatures must
+                # re-attribute or the cleared gauges stay empty until
+                # an unseen shape arrives (possibly never)
+                seen.clear()
+                seen_gen[0] = catalog.generation
+            try:
+                sig = _arg_signature(args, kwargs, static_argnums)
+            except Exception:
+                sig = None
+            if sig is not None and sig not in seen:
+                seen.add(sig)
+                catalog.analyze_jitted(name, fn, args, kwargs,
+                                       signature=f"sig{len(seen)}")
         t0 = _time.perf_counter()
         out = fn(*args, **kwargs)
         dur = _time.perf_counter() - t0
@@ -601,10 +656,12 @@ class FusedMultiTransformerEngine:
         # `paged_step` span on its first bucket sighting = compile)
         self._paged_step = _dispatch_span(
             "paged_step", jax.jit(paged_step, static_argnums=(8,),
-                                  donate_argnums=(1,)))
+                                  donate_argnums=(1,)),
+            static_argnums=(8,))
         self._paged_rewind = _dispatch_span(
             "paged_rewind", jax.jit(paged_rewind, static_argnums=(4,),
-                                    donate_argnums=(0,)))
+                                    donate_argnums=(0,)),
+            static_argnums=(4,))
         self._paged_copy = _dispatch_span(
             "paged_copy", jax.jit(paged_copy, donate_argnums=(0,)))
 

@@ -237,6 +237,7 @@ def make_train_step(model, mesh, meta, donate=True):
     donate_argnums = (0, 1) if donate else ()
     with mesh:
         jitted = jax.jit(step, donate_argnums=donate_argnums)
+    attributed = []     # cost catalog: analyze the step program once
 
     def run(params, opt_state, batch):
         # jit traces lazily at the first call — force training mode for the
@@ -261,11 +262,27 @@ def make_train_step(model, mesh, meta, donate=True):
             ids = batch.get("input_ids") if isinstance(batch, dict) \
                 else None
             tokens = int(np.prod(ids.shape)) if ids is not None else 0
+            from ..observability import costs as _costs
+            catalog = _costs.get_cost_catalog()
+            if catalog.enabled and not attributed:
+                # once, BEFORE the first dispatch (donation hasn't
+                # consumed params/opt_state yet): AOT-analyze the whole
+                # fwd+bwd+AdamW program into the cost catalog — flops /
+                # bytes / peak HBM under `pretrain_step`, the numbers
+                # the train_obs gate brackets. Opt-in: the analysis
+                # pays one extra backend compile.
+                attributed.append(True)
+                with mesh:
+                    catalog.analyze_jitted(
+                        "pretrain_step", jitted,
+                        (params, opt_state, batch))
             t0 = time.monotonic()
             with mesh:
                 out = jitted(params, opt_state, batch)
             dur = time.monotonic() - t0
             _metrics.train_step_seconds().observe(dur)
+            _metrics.dispatch_seconds().labels(
+                program="pretrain_step").observe(dur)
             _metrics.train_steps_total().inc()
             if tokens:
                 _metrics.train_tokens_total().inc(tokens)

@@ -10,9 +10,15 @@ per-request lifecycle tracing + the anomaly flight recorder
 anomaly-triggered dumps of the last N seconds of spans + metrics,
 bounded dump retention with a manifest index), windowed time series
 over the registry (timeseries.py: rate/delta-quantile/gauge-stats over
-the last N seconds), and the serving SLO engine (slo.py: declarative
+the last N seconds), the serving SLO engine (slo.py: declarative
 objectives, SRE-style multi-window burn rates, breach -> counter +
-timeline event + slo_burn_rate flight dump).
+timeline event + slo_burn_rate flight dump), the per-program cost
+catalog (costs.py: XLA cost/memory analyses as program_flops /
+program_bytes / program_peak_hbm gauges with derived arithmetic-
+intensity, MFU, and roofline figures against the dispatch-latency
+histograms), and live-array / HBM accounting (memory.py: census by
+shape/dtype/owner, per-device memory gauges with high-water, the
+hbm_pressure flight trigger, and sharded-pytree skew gauges).
 
 Contract: record calls are HOST-SIDE ONLY — never inside a jitted
 function. The runtime guard is the ``float()`` coercion in metrics.py
@@ -58,6 +64,11 @@ from .tracing import (SpanRecorder, FlightRecorder, get_tracer,
 from .timeseries import TimeSeries
 from .slo import (Objective, SLOEngine, SLOMonitor, validate_report,
                   json_safe, DEFAULT_WINDOWS)
+from .costs import (CostCatalog, get_cost_catalog, peak_flops,
+                    peak_bandwidth)
+from .memory import (live_array_census, census_diff, record_census,
+                     tag_arrays, device_memory, MemoryMonitor,
+                     shard_skew)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -69,4 +80,8 @@ __all__ = [
     "load_dump", "write_dump", "arm_default", "load_manifest",
     "timeseries", "TimeSeries", "slo", "Objective", "SLOEngine",
     "SLOMonitor", "validate_report", "json_safe", "DEFAULT_WINDOWS",
+    "costs", "CostCatalog", "get_cost_catalog", "peak_flops",
+    "peak_bandwidth", "memory", "live_array_census", "census_diff",
+    "record_census", "tag_arrays", "device_memory", "MemoryMonitor",
+    "shard_skew",
 ]

@@ -1,0 +1,372 @@
+"""Live-array census + device-memory accounting + HBM-pressure trigger.
+
+The memory half of the cost/memory observability layer (costs.py is the
+compute half): WHERE the bytes are, not just how many the programs
+touch.
+
+* ``live_array_census()`` — every live ``jax.Array`` in the process
+  grouped by ``dtype[shape]`` (or an owner tag registered via
+  ``tag_arrays()``): {group: {count, bytes}}. The serving engine's leak
+  contract rides on this — after submit/retire churn the census must
+  return to its pre-admission state (tests/test_cost_memory.py pins
+  it), because a leaked KV slab is invisible to the allocator's own
+  block accounting.
+* ``record_census()`` — census into ``live_arrays{group}`` /
+  ``live_array_bytes{group}`` gauges plus process totals with
+  high-water tracking.
+* ``MemoryMonitor`` — per-device in-use/limit gauges (PJRT
+  ``memory_stats()`` where the backend has it, census bytes as the
+  fallback) and the ``hbm_pressure`` flight-recorder trigger: when
+  headroom drops below ``min_headroom_frac`` of the budget, the span
+  window + metrics snapshot dump fires — the OOM's black box, written
+  BEFORE the allocator starts failing. ``tick()`` is cadence-gated so
+  a serving engine can call it every step (the SLOMonitor pattern).
+* ``shard_skew()`` — per-device byte placement of a sharded pytree and
+  the max/mean skew ratio, the load-balance gauge for the virtual
+  8-device mesh legs (a skewed TP/FSDP layout shows up here before it
+  shows up as a straggler collective).
+
+Same constraints as every observability module: stdlib-only at import
+(jax is touched lazily and its absence degrades to empty censuses, so
+the bare-container selfcheck can exercise the monitor with injected
+numbers), host-side only, lock-free reads of jax's own bookkeeping.
+"""
+import threading
+import time
+import weakref
+
+from .metrics import get_registry
+from .tracing import get_flight_recorder
+
+__all__ = [
+    "live_array_census", "census_diff", "record_census", "tag_arrays",
+    "device_memory", "MemoryMonitor", "shard_skew",
+]
+
+# id(array) -> (weakref, owner tag): tags survive exactly as long as the
+# array; a dead weakref drops out of the census grouping automatically
+_tags = {}
+_tags_lock = threading.Lock()
+
+
+def tag_arrays(owner, arrays):
+    """Attribute arrays to an owner for census grouping (jax arrays take
+    weakrefs; the tag dies with the array)."""
+    with _tags_lock:
+        for a in arrays:
+            try:
+                ref = weakref.ref(a)
+            except TypeError:
+                continue
+            _tags[id(a)] = (ref, str(owner))
+
+
+def _tag_of(arr):
+    with _tags_lock:
+        ent = _tags.get(id(arr))
+        if ent is None:
+            return None
+        ref, owner = ent
+        live = ref()
+        if live is None or live is not arr:
+            del _tags[id(arr)]      # id reused by a different object
+            return None
+        return owner
+
+
+def _gc_tags():
+    with _tags_lock:
+        dead = [k for k, (ref, _) in _tags.items() if ref() is None]
+        for k in dead:
+            del _tags[k]
+
+
+def live_array_census(collect=True):
+    """{group: {"count": n, "bytes": b}} over ``jax.live_arrays()``;
+    group is the owner tag when registered, else ``dtype[shape]``.
+    Returns {} without jax (bare container). ``collect=True`` runs a
+    gc pass first so droppable references don't read as leaks."""
+    try:
+        import jax
+    except Exception:
+        return {}
+    if collect:
+        import gc
+        gc.collect()
+    _gc_tags()
+    out = {}
+    for a in jax.live_arrays():
+        try:
+            key = _tag_of(a) or f"{a.dtype}{list(a.shape)}"
+            nbytes = int(a.nbytes)
+        except Exception:
+            continue
+        ent = out.setdefault(key, {"count": 0, "bytes": 0})
+        ent["count"] += 1
+        ent["bytes"] += nbytes
+    return out
+
+
+def census_diff(before, after):
+    """{group: {"count": delta, "bytes": delta}} for groups that
+    changed — empty dict == no leak (the step-boundary contract)."""
+    out = {}
+    for key in set(before) | set(after):
+        b = before.get(key, {"count": 0, "bytes": 0})
+        a = after.get(key, {"count": 0, "bytes": 0})
+        dc, db = a["count"] - b["count"], a["bytes"] - b["bytes"]
+        if dc or db:
+            out[key] = {"count": dc, "bytes": db}
+    return out
+
+
+def record_census(census=None, registry=None):
+    """Land a census in the registry: per-group count/bytes gauges plus
+    process totals with a high-water mark. ``census=None`` takes a live
+    one (pass a dict to replay a synthetic census — the selfcheck
+    path). Returns the census."""
+    if census is None:
+        census = live_array_census()
+    reg = registry if registry is not None else get_registry()
+    counts = reg.gauge("live_arrays",
+                       help="live jax arrays by census group",
+                       labels=("group",))
+    sizes = reg.gauge("live_array_bytes",
+                      help="bytes held by live jax arrays, by group",
+                      labels=("group",))
+    total_c = total_b = 0
+    for key, ent in census.items():
+        counts.labels(group=key).set(ent["count"])
+        sizes.labels(group=key).set(ent["bytes"])
+        total_c += ent["count"]
+        total_b += ent["bytes"]
+    # groups that vanished since the last census must read 0, not keep
+    # exporting their last value forever (a freed 4 GB KV cache would
+    # otherwise look resident on every later scrape)
+    for fam in (counts, sizes):
+        for key in list(fam._children):
+            if key and key[0] not in census:
+                fam.labels(group=key[0]).set(0)
+    reg.gauge("live_arrays_total",
+              help="live jax arrays in the process").set(total_c)
+    reg.gauge("live_array_bytes_total",
+              help="bytes held by all live jax arrays").set(total_b)
+    reg.gauge("live_array_bytes_high_water",
+              help="peak bytes ever held by live arrays "
+                   "(census-time high-water)").set_max(total_b)
+    return census
+
+
+def device_memory():
+    """Per-device memory stats from PJRT: {device: {"bytes_in_use":,
+    "bytes_limit":, "peak_bytes_in_use":}} — only devices whose backend
+    reports stats (CPU reports none; the census is the fallback)."""
+    try:
+        import jax
+    except Exception:
+        return {}
+    out = {}
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        out[str(d)] = {
+            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "bytes_limit": int(stats.get("bytes_limit", 0)),
+            "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+        }
+    return out
+
+
+def shard_skew(tree, registry=None):
+    """Per-device byte placement of a (possibly sharded) array pytree:
+    sets ``shard_bytes{device}`` gauges and the ``shard_skew`` ratio
+    (max device bytes / mean device bytes; 1.0 == perfectly balanced).
+    Returns {"devices": {...}, "skew": r} — {} without jax or on an
+    empty tree."""
+    try:
+        import jax
+    except Exception:
+        return {}
+    per_device = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if not shards:
+            continue
+        for s in shards:
+            try:
+                per_device[str(s.device)] = per_device.get(
+                    str(s.device), 0) + int(s.data.nbytes)
+            except Exception:
+                continue
+    if not per_device:
+        return {}
+    reg = registry if registry is not None else get_registry()
+    g = reg.gauge("shard_bytes",
+                  help="bytes of the last skew-checked pytree resident "
+                       "per device", labels=("device",))
+    for dev, b in per_device.items():
+        g.labels(device=dev).set(b)
+    # devices absent from THIS pytree read 0, not their previous value
+    # (the record_census stale-group contract): the per-device view
+    # must agree with the skew ratio computed right here
+    for key in list(g._children):
+        if key and key[0] not in per_device:
+            g.labels(device=key[0]).set(0)
+    mean = sum(per_device.values()) / len(per_device)
+    skew = max(per_device.values()) / mean if mean > 0 else 0.0
+    reg.gauge("shard_skew",
+              help="max/mean per-device bytes of the last skew-checked "
+                   "pytree (1.0 = balanced)").set(skew)
+    return {"devices": per_device, "skew": skew}
+
+
+class MemoryMonitor:
+    """Cadence-gated HBM accounting + pressure trigger (the SLOMonitor
+    shape: construct once, ``tick()`` from the serve/train loop).
+
+    ``budget_bytes`` is the accounting ceiling: the device's
+    ``bytes_limit`` when PJRT reports one, else whatever the caller
+    declares (a CPU test budget, a fraction of host RAM, ...). When
+    in-use bytes leave less than ``min_headroom_frac`` of the budget
+    free, the flight recorder fires ``hbm_pressure`` — once per
+    recorder cooldown, with the in-use/budget/headroom context in the
+    dump. No budget -> gauges only, never a trigger.
+
+    ``interval_s`` defaults to 1s (the SLOMonitor cadence): on
+    backends without PJRT memory stats an accounting pass is a full
+    census — gc pass included — and running THAT per decode step would
+    inflate the very latencies the SLO engine next to it measures.
+    ``interval_s=0`` opts into per-tick accounting (tests)."""
+
+    def __init__(self, budget_bytes=None, min_headroom_frac=0.1,
+                 interval_s=1.0, registry=None, flight_recorder=None):
+        self.budget_bytes = None if budget_bytes is None \
+            else float(budget_bytes)
+        self.min_headroom_frac = float(min_headroom_frac)
+        if not 0.0 <= self.min_headroom_frac < 1.0:
+            raise ValueError("min_headroom_frac must be in [0, 1)")
+        self.interval_s = float(interval_s)
+        self._registry = registry
+        self._flight = flight_recorder
+        self._last_tick = None
+        self.pressure_events = 0
+        self.last_report = None
+
+    def _reg(self):
+        return self._registry if self._registry is not None \
+            else get_registry()
+
+    def tick(self, now=None):
+        """Cadence gate around update(): cheap monotonic compare when
+        the interval has not elapsed (the per-step serving hook)."""
+        now = time.monotonic() if now is None else now
+        if self._last_tick is not None \
+                and now - self._last_tick < self.interval_s:
+            return None
+        self._last_tick = now
+        return self.update()
+
+    def update(self, in_use_bytes=None, budget_bytes=None):
+        """One accounting pass: census + device stats -> gauges, then
+        the pressure check. ``in_use_bytes`` overrides the measured
+        value (synthetic numbers — the selfcheck path)."""
+        reg = self._reg()
+        budget = budget_bytes if budget_bytes is not None \
+            else self.budget_bytes
+        devs = device_memory() if in_use_bytes is None else {}
+        census_bytes = None
+        if in_use_bytes is None:
+            if devs:
+                in_use = reg.gauge(
+                    "hbm_device_bytes_in_use",
+                    help="per-device memory in use (PJRT stats)",
+                    labels=("device",))
+                limit_g = reg.gauge(
+                    "hbm_device_bytes_limit",
+                    help="per-device memory capacity (PJRT stats)",
+                    labels=("device",))
+                peak_g = reg.gauge(
+                    "hbm_device_bytes_peak",
+                    help="per-device peak memory in use (PJRT stats)",
+                    labels=("device",))
+                for dev, st in devs.items():
+                    in_use.labels(device=dev).set(st["bytes_in_use"])
+                    if st["bytes_limit"]:
+                        limit_g.labels(device=dev).set(st["bytes_limit"])
+                    if st["peak_bytes_in_use"]:
+                        peak_g.labels(device=dev).set(
+                            st["peak_bytes_in_use"])
+                in_use_bytes = sum(d["bytes_in_use"] for d in devs.values())
+                limits = sum(d["bytes_limit"] for d in devs.values())
+                if budget is None and limits:
+                    budget = float(limits)
+            else:
+                census = record_census(registry=reg)
+                census_bytes = sum(e["bytes"] for e in census.values())
+                in_use_bytes = census_bytes
+        # pressure is PER DEVICE where the backend reports limits: an
+        # unbalanced placement (the condition shard_skew exists to
+        # catch) can OOM device 0 while the fleet AGGREGATE still reads
+        # 20% full — the trigger below uses the worst device's headroom
+        worst_dev = None
+        for dev, st in devs.items():
+            if st["bytes_limit"]:
+                h = max(0.0, (st["bytes_limit"] - st["bytes_in_use"])
+                        / st["bytes_limit"])
+                if worst_dev is None or h < worst_dev[1]:
+                    worst_dev = (dev, h)
+        in_use_bytes = float(in_use_bytes)
+        g = reg.gauge("hbm_bytes_in_use",
+                      help="device memory in use (PJRT stats, or live-"
+                           "array census bytes where the backend "
+                           "reports none)")
+        g.set(in_use_bytes)
+        reg.gauge("hbm_bytes_high_water",
+                  help="peak observed hbm_bytes_in_use").set_max(
+                      in_use_bytes)
+        headroom = None
+        if budget:
+            headroom = max(0.0, (budget - in_use_bytes) / budget)
+            reg.gauge("hbm_bytes_budget",
+                      help="accounting ceiling for the pressure check "
+                           "(device bytes_limit, or a declared "
+                           "budget)").set(budget)
+            reg.gauge("hbm_headroom_frac",
+                      help="(budget - in_use) / budget; the hbm_pressure"
+                           " trigger fires below min_headroom_frac").set(
+                          headroom)
+        # the trigger evaluates the TIGHTEST headroom it can see: the
+        # worst single device when per-device limits exist, the declared
+        # budget otherwise
+        eff_headroom = headroom
+        if worst_dev is not None and (eff_headroom is None
+                                      or worst_dev[1] < eff_headroom):
+            eff_headroom = worst_dev[1]
+        pressure = eff_headroom is not None \
+            and eff_headroom < self.min_headroom_frac
+        report = {"in_use_bytes": in_use_bytes,
+                  "budget_bytes": budget,
+                  "headroom_frac": headroom,
+                  "worst_device": None if worst_dev is None
+                  else {"device": worst_dev[0],
+                        "headroom_frac": worst_dev[1]},
+                  "census_bytes": census_bytes,
+                  "devices": devs,
+                  "pressure": pressure}
+        self.last_report = report
+        if pressure:
+            self.pressure_events += 1
+            fr = self._flight if self._flight is not None \
+                else get_flight_recorder()
+            ctx = {"in_use_bytes": in_use_bytes,
+                   "budget_bytes": budget,
+                   "headroom_frac": eff_headroom,
+                   "min_headroom_frac": self.min_headroom_frac}
+            if worst_dev is not None:
+                ctx["worst_device"] = worst_dev[0]
+            fr.trigger("hbm_pressure", **ctx)
+        return report

@@ -45,9 +45,11 @@ def test_graftlint_imports():
     # tracing PR's rule: jitted closures over self./module arrays
     # (GL108, the int4 compile-payload-bloat hazard); the SLO PR's
     # rule: dict/set keying on device arrays (GL110, the hash-forces-
-    # a-sync hazard the prefix index's host-bytes block_key avoids)
-    assert {"GL104", "GL105", "GL107", "GL108", "GL110"} <= set(gl.RULES), \
-        sorted(gl.RULES)
+    # a-sync hazard the prefix index's host-bytes block_key avoids);
+    # the cost-observability PR's rule: wall-clock interval arithmetic
+    # (GL111, time.time() differences as durations — NTP-step hazard)
+    assert {"GL104", "GL105", "GL107", "GL108", "GL110",
+            "GL111"} <= set(gl.RULES), sorted(gl.RULES)
 
 
 def test_tree_is_clean():

@@ -1,0 +1,114 @@
+# graftlint-corpus-expect: GL111 GL111 GL111 GL111 GL111 GL111
+"""Wall-clock interval arithmetic (GL111): `time.time()` differences
+used as durations, and `time.time()` stamps fed to latency histograms.
+time.time() steps under NTP slew — the "latency" goes negative (or
+jumps by the correction) exactly when the fleet's clocks are fixed.
+Durations belong on time.monotonic(); the span/profiler timebase is
+time.perf_counter(); wall clock is for TIMESTAMPING — the clean
+tripwires below (dump metadata, filename stamps, deadline comparisons,
+monotonic intervals) must stay silent."""
+import json
+import time
+
+from paddle_tpu.observability import get_registry
+
+
+def bad_direct_difference(t_submit):
+    # EXPECT GL111: direct time.time() on one side of a subtraction
+    return time.time() - t_submit
+
+
+def bad_tracked_names():
+    start = time.time()
+    do_work()
+    now = time.time()
+    elapsed = now - start           # EXPECT GL111: both sides wall clock
+    return elapsed
+
+
+class EpochTimer:
+    def begin(self):
+        self._epoch_start = time.time()
+
+    def end(self):
+        # EXPECT GL111: self-attribute assigned from time.time()
+        return time.time() - self._epoch_start
+
+
+def bad_observe_interval(h):
+    t0 = time.time()
+    do_work()
+    # EXPECT GL111: the subtraction inside the observe arg
+    h.observe(time.time() - t0)
+
+
+def bad_observe_stamp():
+    h = get_registry().histogram("req_latency_seconds")
+    # EXPECT GL111: an absolute wall-clock stamp is not a latency
+    h.observe(time.time())
+
+
+# -- clean tripwires: legitimate wall-clock use ---------------------------
+
+def ok_dump_metadata(report, path):
+    # timestamping: no arithmetic, never flags
+    report["time"] = time.time()
+    with open(path, "w") as f:
+        json.dump(report, f)
+
+
+def ok_filename_stamp(dump_dir):
+    return f"{dump_dir}/dump_{int(time.time() * 1000)}.json"
+
+
+def ok_deadline_compare():
+    # deadline idiom is a COMPARISON, not interval arithmetic (still
+    # wall-clock-fragile, but the rule targets durations)
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if do_work():
+            return True
+    return False
+
+
+def ok_monotonic_interval(h):
+    t0 = time.monotonic()
+    do_work()
+    h.observe(time.monotonic() - t0)
+
+
+def ok_name_reuse_across_scopes(h):
+    # `start`/`now` are wall-clock stamps in bad_tracked_names' scope
+    # ONLY — name taint is per lexical scope, so this correct monotonic
+    # interval under the same identifiers must stay clean
+    start = time.monotonic()
+    do_work()
+    now = time.monotonic()
+    h.observe(now - start)
+
+
+BOOT_STAMP = time.time()        # module-level timestamp: fine as is
+
+
+def bad_module_stamp_interval():
+    # EXPECT GL111 (in the expect header): the module-level wall-clock
+    # stamp IS visible here — uptime arithmetic on it steps under NTP
+    return time.time() - BOOT_STAMP
+
+
+def ok_module_name_shadowed(h):
+    # a local rebinding SHADOWS the module stamp: this BOOT_STAMP is a
+    # monotonic value, not the wall-clock one — must stay clean
+    BOOT_STAMP = time.monotonic()
+    do_work()
+    h.observe(time.monotonic() - BOOT_STAMP)
+
+
+def ok_perf_counter_span():
+    t0 = time.perf_counter()
+    do_work()
+    return (time.perf_counter() - t0) * 1e6
+
+
+def do_work():
+    return True

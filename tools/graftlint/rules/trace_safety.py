@@ -61,6 +61,19 @@ index hashes HOST token ints for exactly this reason
 (continuous_batching.block_key: `tuple(int(t) for t in tokens)` over
 host lists — the clean idiom the corpus tripwires pin); a device result
 laundered through one bulk `np.asarray()` is host data and never flags.
+
+GL111 flags wall-clock interval arithmetic: a `time.time()` difference
+used as a duration (`time.time() - t0`, `now - start` where both came
+from `time.time()`), or a `time.time()` value fed to a latency
+histogram's `.observe()`. `time.time()` steps under NTP slew/adjtime —
+a negative or wildly wrong "latency" lands in the histograms exactly
+when the fleet's clocks are being corrected. The repo's latency
+bookkeeping deliberately splits `time.monotonic()` for intervals from
+`time.perf_counter()` for the span/profiler timebase; wall clock is for
+TIMESTAMPING only (`"time": time.time()` in dump metadata, filename
+stamps — never flagged) and for cross-process freshness checks against
+stamps another host wrote (wall clock is the only shared timebase —
+those sites carry an explicit disable comment).
 """
 import ast
 
@@ -759,3 +772,132 @@ def device_array_hash_key(ctx):
                         "GL110", node,
                         f".{node.func.attr}() keyed by device result "
                         f"`{root}` " + _GL110_MSG), node
+
+
+def _is_time_time_call(node):
+    """A direct `time.time()` call expression."""
+    return (isinstance(node, ast.Call) and not node.args
+            and not node.keywords
+            and _attr_chain(node.func) == "time.time")
+
+
+def _walltime_names_own(scope):
+    """Names (and `self.x` attribute names) bound to a bare
+    `time.time()` in `scope`'s OWN lexical body (nested function bodies
+    are separate scopes — a `t0 = time.time()` in one function must not
+    poison an unrelated `t0 = time.monotonic()` elsewhere in the file):
+    `t0 = time.time()`, `self._start = time.time()`. Arithmetic on the
+    stamp at the assignment (`time.time() + 5` — a deadline) does NOT
+    mark the name: deadlines are compared, not subtracted, and marking
+    them would flag the `while time.time() < deadline` idiom's
+    bookkeeping."""
+    names, attrs = set(), set()
+    walk = _own_scope_walk(scope) if isinstance(
+        scope, (ast.FunctionDef, ast.AsyncFunctionDef)) else (
+            n for st in scope.body for n in _module_scope_walk(st))
+    for node in walk:
+        if isinstance(node, ast.Assign) and _is_time_time_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    attrs.add(t.attr)
+    return names, attrs
+
+
+def _module_scope_walk(node):
+    """ast.walk pruned at def/lambda boundaries (class bodies run at
+    module scope, so they are walked; a def is yielded — its name binds
+    here — but its body is never descended into, even when the def
+    itself is the statement the walk starts from)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+_GL111_MSG = (
+    "wall clock steps under NTP slew — a negative or wildly wrong "
+    "interval lands exactly when the fleet's clocks are corrected. Use "
+    "time.monotonic() for durations (time.perf_counter() on the "
+    "span/profiler timebase); time.time() is for timestamping only. A "
+    "cross-process freshness check against a stamp another host wrote "
+    "is the one legitimate case — suppress it with a comment saying so")
+
+
+@rule("GL111", "wallclock-interval", "trace-safety")
+def wallclock_interval(ctx):
+    """`time.time()` differences used as durations, and `time.time()`
+    values fed to `.observe()`. Timestamping (`"time": time.time()`
+    dict metadata, filename stamps, deadline comparisons) never flags.
+    Name taint is scoped: a plain name counts as wall-clock only where
+    its `= time.time()` binding is lexically visible (own function +
+    enclosing chain + module level); `self.x` attribute stamps stay
+    file-wide (assignment and use commonly sit in different methods)."""
+    module_names, _ = _walltime_names_own(ctx.tree)
+    # attribute stamps are collected FILE-wide: `self._t0 = time.time()`
+    # in one method is read in another by design
+    attrs = set()
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Assign) and _is_time_time_call(n.value):
+            for t in n.targets:
+                if isinstance(t, ast.Attribute):
+                    attrs.add(t.attr)
+    fn_scope = {}   # FunctionDef -> (own walltime names, own assigned)
+
+    def scope_of(fn):
+        if fn not in fn_scope:
+            wall = _walltime_names_own(fn)[0]
+            assigned = {a.arg for a in fn.args.args
+                        + fn.args.kwonlyargs + fn.args.posonlyargs}
+            for n in _own_scope_walk(fn):
+                if isinstance(n, ast.Name) and isinstance(
+                        n.ctx, (ast.Store, ast.Del)):
+                    assigned.add(n.id)
+            fn_scope[fn] = (wall, assigned)
+        return fn_scope[fn]
+
+    def names_for(node):
+        # lexical visibility with SHADOWING: walk the enclosing chain
+        # outermost-first; a scope that rebinds a name (param or any
+        # non-walltime assignment) clears the outer taint — a local
+        # `start = time.monotonic()` is not the module's `start` stamp
+        visible = set(module_names)
+        for fn in reversed(ctx.enclosing_functions(node)):
+            wall, assigned = scope_of(fn)
+            visible = (visible - assigned) | wall
+        return visible
+
+    def is_walltime(node, names):
+        if _is_time_time_call(node):
+            return True
+        if isinstance(node, ast.Name) and node.id in names:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in attrs:
+            return True
+        return False
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            names = names_for(node)
+            if is_walltime(node.left, names) \
+                    or is_walltime(node.right, names):
+                yield ctx.finding(
+                    "GL111", node,
+                    "time.time() difference used as a duration: "
+                    + _GL111_MSG), node
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "observe" and node.args:
+            # a BARE wall-clock stamp observed into a histogram (a
+            # subtraction inside the arg already flagged above)
+            if is_walltime(node.args[0], names_for(node)):
+                yield ctx.finding(
+                    "GL111", node,
+                    "time.time() value fed to a histogram: an absolute "
+                    "wall-clock stamp is not a latency, and "
+                    + _GL111_MSG), node

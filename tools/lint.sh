@@ -21,4 +21,9 @@ if [ "${LINT_SKIP_SERVE:-0}" != "1" ]; then
   # declared objectives, zero burn-rate breaches, monitor neutrality
   python tools/serve_monitor.py --check tools/serve_slo.json \
     --no-flight-recorder
+  # train_obs gate: per-program cost/memory attribution (FLOPs, bytes,
+  # peak HBM, MFU for the paged step / rewind / COW copy / pretrain
+  # step), token-exact-neutral telemetry, census leak check — "MFU is
+  # a number the CI checks", the training-side serve-gate analogue
+  python tools/cost_report.py --check tools/train_obs.json
 fi

@@ -15,9 +15,13 @@ Two jobs:
   tracer arg guard), the flight-recorder dump schema (write -> stdlib
   json load -> ``tracing.load_dump`` validation -> ``request_summary``
   replay) and retention manifest, the windowed time-series ring
-  (rate / delta-quantile / gauge stats on a synthetic clock), and the
+  (rate / delta-quantile / gauge stats on a synthetic clock), the
   SLO engine (burn-rate breach -> counter + ``validate_report`` schema
-  + ``slo_burn_rate`` dump), and exits non-zero on any violation.
+  + ``slo_burn_rate`` dump), the cost catalog (record -> program_*
+  gauge sections -> derived intensity/MFU/roofline against a synthetic
+  dispatch histogram), and the memory layer (synthetic census ->
+  live_array gauges; MemoryMonitor headroom breach -> ``hbm_pressure``
+  dump schema), and exits non-zero on any violation.
   Wired into tools/lint.sh so the tier-0 gate
   (tests/test_graftlint_gate.py) catches a broken metrics/tracing/SLO
   subsystem before any test imports jax.
@@ -351,6 +355,100 @@ def selfcheck():
             pass
     finally:
         shutil.rmtree(d4, ignore_errors=True)
+
+    # cost catalog: record -> program_* gauges -> derived MFU/roofline
+    # against a synthetic dispatch histogram (all host numbers — the
+    # jax-artifact analyses are exercised by the train_obs gate)
+    reg5 = obs.MetricsRegistry()
+    cat = obs.CostCatalog(registry=reg5)
+    e = cat.record("sc_step", flops=2e9, bytes_accessed=1e9,
+                   arg_bytes=6e8, out_bytes=1e8, temp_bytes=3e8,
+                   signature="s0")
+    check(e["intensity"] == 2.0 and e["peak_hbm"] == 1e9,
+          f"catalog intensity/peak wrong: {e}")
+    snap5 = reg5.snapshot()
+    for fam in ("program_flops", "program_bytes",
+                "program_peak_hbm_bytes", "program_arithmetic_intensity"):
+        v = snap5.get(fam, {}).get("children", {}).get("sc_step",
+                                                       {}).get("value")
+        check(v is not None and v > 0,
+              f"catalog gauge {fam} missing from the snapshot: {v}")
+    h5 = reg5.histogram("dispatch_seconds", labels=("program",))
+    h5.labels(program="sc_step").observe(0.01)
+    derived = cat.derive(registry=reg5, peak_flops_override=1e12,
+                         peak_bw_override=1e11)
+    row = derived.get("sc_step")
+    check(row is not None and row["mfu"] is not None
+          and 0 < row["mfu"] <= 1.0,
+          f"derived MFU wrong: {row}")
+    # intensity 2.0 * bw 1e11 = 2e11 attainable < 1e12 peak: the
+    # program is bandwidth-bound, so roofline_frac > mfu
+    check(row["roofline_frac"] > row["mfu"],
+          f"roofline did not clamp to bandwidth: {row}")
+    check(reg5.snapshot()["program_mfu"]["children"]["sc_step"]["value"]
+          == row["mfu"], "program_mfu gauge not set")
+    # re-analysis updates, second signature recorded
+    cat.record("sc_step", flops=4e9, bytes_accessed=1e9, signature="s1")
+    ent = cat.entries()["sc_step"]
+    check(ent["analyses"] == 2 and len(ent["signatures"]) == 2,
+          f"catalog signature history wrong: {ent}")
+    check(len(cat.table()) == 1 and cat.table()[0]["signatures"] == 2,
+          "catalog table wrong")
+
+    # memory layer: synthetic census -> gauges; monitor breach ->
+    # hbm_pressure dump with a validated schema + context
+    reg6 = obs.MetricsRegistry()
+    census = {"kv_cache": {"count": 4, "bytes": 4096},
+              "float32[8, 8]": {"count": 2, "bytes": 512}}
+    obs.record_census(census, registry=reg6)
+    snap6 = reg6.snapshot()
+    check(snap6["live_arrays"]["children"]["kv_cache"]["value"] == 4
+          and snap6["live_array_bytes_total"]["children"][""]["value"]
+          == 4608, f"census gauges wrong")
+    check(obs.census_diff(census, census) == {},
+          "identical censuses diffed nonempty")
+    diff = obs.census_diff(census, {"kv_cache": {"count": 5,
+                                                 "bytes": 5120}})
+    check(diff == {"kv_cache": {"count": 1, "bytes": 1024},
+                   "float32[8, 8]": {"count": -2, "bytes": -512}},
+          f"census diff wrong: {diff}")
+    ring6 = obs.tracing.SpanRecorder()
+    fr6 = obs.tracing.FlightRecorder(recorder=ring6, min_interval_s=0.0)
+    try:
+        obs.MemoryMonitor(min_headroom_frac=1.5)
+        check(False, "min_headroom_frac >= 1 not rejected")
+    except ValueError:
+        pass
+    mon = obs.MemoryMonitor(budget_bytes=1000.0, min_headroom_frac=0.2,
+                            registry=reg6, flight_recorder=fr6)
+    rep = mon.update(in_use_bytes=500.0)
+    check(rep["pressure"] is False and rep["headroom_frac"] == 0.5,
+          f"healthy headroom misreported: {rep}")
+    d6 = tempfile.mkdtemp(prefix="sc_hbm_")
+    try:
+        fr6.arm(d6, window_s=60.0)
+        rep = mon.update(in_use_bytes=950.0)
+        check(rep["pressure"] is True and mon.pressure_events == 1,
+              f"pressure not detected: {rep}")
+        dumps = [f for f in os.listdir(d6)
+                 if f.startswith("flightrec_hbm_pressure")]
+        check(len(dumps) == 1, f"no hbm_pressure dump: {dumps}")
+        if dumps:
+            dump = obs.tracing.load_dump(os.path.join(d6, dumps[0]))
+            check(dump["reason"] == "hbm_pressure"
+                  and dump["context"].get("in_use_bytes") == 950
+                  and dump["context"].get("budget_bytes") == 1000
+                  and dump["context"].get("min_headroom_frac") == 0.2,
+                  f"hbm_pressure dump context wrong: {dump['context']}")
+        g6 = reg6.snapshot()
+        check(g6["hbm_bytes_in_use"]["children"][""]["value"] == 950.0
+              and g6["hbm_bytes_high_water"]["children"][""]["value"]
+              == 950.0
+              and abs(g6["hbm_headroom_frac"]["children"][""]["value"]
+                      - 0.05) < 1e-9,
+              "hbm gauges wrong after pressure update")
+    finally:
+        shutil.rmtree(d6, ignore_errors=True)
     return failures
 
 
