@@ -175,4 +175,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    # operator abort (Ctrl-C / sys.exit mid-serve) leaves evidence
+    # instead of dying mid-step with none: the shared wrapper writes an
+    # operator_abort flight dump (span window + full metrics snapshot)
+    from paddle_tpu.observability import tracing
+    sys.exit(tracing.run_with_abort_evidence(main))

@@ -6,6 +6,7 @@ from .layers import (FusedMultiHeadAttention, FusedFeedForward,  # noqa: F401
                      FusedBiasDropoutResidualLayerNorm,
                      FusedLinear, FusedDropoutAdd, FusedMultiTransformer)
 from .continuous_batching import (BlockAllocator,  # noqa: F401
-                                  GenerationRequest,
+                                  GenerationRequest, RequestResult,
+                                  KVAllocFailure,
                                   ContinuousBatchingEngine,
                                   propose_draft_tokens)

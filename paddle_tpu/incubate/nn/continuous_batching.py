@@ -31,6 +31,19 @@ step itself); the device program's shape is keyed only by the bucketed
 work-list length, so admission and retirement never trigger recompiles
 past the first few power-of-two buckets.
 
+Resilience (ISSUE 11): the engine degrades instead of crashing.
+Requests carry a priority class, optional step/wall deadlines, and can
+be cancelled mid-flight; when an allocation or admission cannot be
+satisfied the scheduler preempts the lowest-priority victim TO BLOCKS
+(KV pages freed, request re-queued — with the prefix cache on, its
+published blocks make re-prefill mostly a block-table copy) and
+`kv_alloc_failure` is a per-request failure only when no victim
+exists; pressure-aware admission sheds the lowest-priority queued work
+when the SLO engine is burning budget or HBM headroom collapses. Every
+request ends with a structured terminal status (`RequestResult`) in
+`engine.finished`; survivors stay token-exact by construction (each
+slot's tokens depend only on its own KV under greedy decoding).
+
 Reference bar: vLLM's continuous batching scheduler + "Ragged Paged
 Attention" (PAPERS.md); the reference framework's analogue is the
 block_multihead_attention serving stack.
@@ -45,8 +58,19 @@ from ...observability import tracing as _tracing
 from ...ops.pallas.paged_attention import (build_ragged_work, default_pack,
                                            next_pow2)
 
-__all__ = ["BlockAllocator", "GenerationRequest", "ContinuousBatchingEngine",
+__all__ = ["BlockAllocator", "GenerationRequest", "RequestResult",
+           "KVAllocFailure", "ContinuousBatchingEngine",
            "propose_draft_tokens", "block_key"]
+
+
+class KVAllocFailure(RuntimeError):
+    """The KV pool (free list AND reuse pool) could not produce a
+    block. A RuntimeError subclass so pre-existing `except
+    RuntimeError` / pytest.raises(RuntimeError) callers keep working,
+    but the engine's preemption/degradation backstop catches THIS type
+    only — a device-side RuntimeError (XLA OOM, compile failure)
+    escaping a compiled call must surface, not be misread as an
+    allocation failure and silently demoted to a per-request error."""
 
 
 def block_key(parent, tokens):
@@ -113,6 +137,11 @@ class BlockAllocator:
     PHYSICAL blocks held by requests (pooled blocks are reusable cache,
     not in use) and is structurally non-negative; `high_water` tracks
     peak physical use — a block shared by 8 requests counts once."""
+
+    # the exhaustion type, reachable from an allocator handle (fault
+    # injectors raise `type(cb.allocator).OutOfBlocks` without an
+    # import; the engine's degradation backstop catches exactly this)
+    OutOfBlocks = KVAllocFailure
 
     def __init__(self, num_blocks, reserved=1):
         if num_blocks <= reserved:
@@ -182,7 +211,7 @@ class BlockAllocator:
             _metrics.prefix_cache_evictions().inc()
         else:
             _metrics.kv_alloc_failures().inc()
-            raise RuntimeError("BlockAllocator: out of cache blocks")
+            raise KVAllocFailure("BlockAllocator: out of cache blocks")
         self._ref[b] = 1
         self._bump_high_water()
         return b
@@ -252,12 +281,64 @@ class BlockAllocator:
         return b
 
 
+class RequestResult(list):
+    """Terminal record of one request in ``engine.finished``: the
+    generated token list (it IS a list, so everything that compares
+    ``finished[rid]`` against plain token lists keeps working) plus the
+    structured status the resilience layer records. ``status`` is one
+    of STATUSES; ``reason`` the machine-readable cause (e.g.
+    ``kv_alloc_failure``, ``slo_burn``); ``preemptions`` how many times
+    the request was preempted-and-resumed on the way here. A live
+    request additionally passes through the transient ``preempted``
+    status while it waits in the queue for re-admission."""
+
+    STATUSES = ("finished", "cancelled", "deadline_exceeded", "failed",
+                "shed", "rejected")
+
+    def __init__(self, tokens=(), status="finished", reason=None,
+                 preemptions=0):
+        super().__init__(int(t) for t in tokens)
+        if status not in self.STATUSES:
+            raise ValueError(f"unknown terminal status {status!r} "
+                             f"(have {self.STATUSES})")
+        self.status = status
+        self.reason = reason
+        self.preemptions = int(preemptions)
+
+    def __repr__(self):
+        extra = f", reason={self.reason!r}" if self.reason else ""
+        return (f"RequestResult({list.__repr__(self)}, "
+                f"status={self.status!r}{extra})")
+
+
 class GenerationRequest:
-    """One serving request: prompt ids in, up to max_new_tokens out."""
+    """One serving request: prompt ids in, up to max_new_tokens out.
+
+    Resilience knobs (all optional):
+
+    * ``priority`` — scheduling class, 0 = most important (the
+      default). Admission runs in (priority, arrival) order; when the
+      KV pool can't satisfy an allocation or a higher-priority
+      admission, the NEWEST request of the strictly-lowest priority is
+      preempted to blocks; pressure shedding removes the lowest class
+      first (never below the engine's ``shed_priority_min``).
+    * ``deadline_steps`` / ``deadline_s`` — retire the request (status
+      ``deadline_exceeded``, partial tokens kept) once that many engine
+      steps / monotonic seconds have passed since submit, whether it is
+      queued or mid-flight.
+    * ``spec_k`` — per-request cap on speculative draft length, at most
+      the engine's own ``spec_k`` (a larger value is a structured
+      rejection at submit: the sample-gather width is engine-static).
+    * ``temperature`` — must match the engine's temperature when given;
+      per-request sampling is not supported and is rejected at submit
+      instead of corrupting the batch mid-step.
+    """
 
     _next_id = 0
 
-    def __init__(self, prompt_ids, max_new_tokens, request_id=None):
+    def __init__(self, prompt_ids, max_new_tokens, request_id=None,
+                 priority=0, deadline_steps=None, deadline_s=None,
+                 spec_k=None, temperature=None):
         self.prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not self.prompt:
             raise ValueError("empty prompt")
@@ -273,10 +354,42 @@ class GenerationRequest:
             # a later auto-assigned id can never silently collide with it
             GenerationRequest._next_id = request_id + 1
         self.request_id = request_id
+        self.priority = int(priority)
+        if self.priority < 0:
+            raise ValueError("priority must be >= 0 (0 = most important)")
+        self.deadline_steps = None if deadline_steps is None \
+            else int(deadline_steps)
+        if self.deadline_steps is not None and self.deadline_steps < 1:
+            raise ValueError("deadline_steps must be >= 1")
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0")
+        self.spec_k = None if spec_k is None else int(spec_k)
+        if self.spec_k is not None and self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
+        self.temperature = None if temperature is None \
+            else float(temperature)
+        if self.temperature is not None and self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        # lifecycle status: new -> queued -> running -> terminal
+        # (RequestResult.STATUSES), with the transient `preempted`
+        # between running and re-queued
+        self.status = "new"
+        self.status_reason = None
+        self.preemptions = 0
+        self._cancel = False    # processed at the next retire pass
+        self._seq = None        # submission order (admission tie-break)
+        self._admit_seq = None  # admission order (victim tie-break)
+        self._submit_step = None
         # runtime state (owned by the engine)
         self.blocks = []        # physical cache blocks, in table order
         self.progress = 0       # prompt tokens consumed so far
         self.generated = []
+        # prefill source/target: for a fresh request the prompt itself;
+        # a preempted-and-resumed request re-prefills prompt + every
+        # token it already emitted (the KV it lost), then decodes on
+        self._prefill_src = self.prompt
+        self._resume_len = len(self.prompt)
         # speculative-decode acceptance bookkeeping (engine-owned):
         # drafts proposed for / accepted by this request's verification
         self.spec_drafted = 0
@@ -391,6 +504,26 @@ class ContinuousBatchingEngine:
     flight trigger when headroom drops below the monitor's threshold —
     the OOM black box, armed next to the SLO engine. Host-side only,
     token-exact-neutral by the same construction.
+
+    Resilience (ISSUE 11): requests carry a priority class and optional
+    deadlines, `cancel()` retires them mid-flight through the normal
+    block-free path, and allocation/admission pressure preempts the
+    newest strictly-lower-priority victim TO BLOCKS (KV freed, request
+    re-queued; with the prefix cache on its published blocks make
+    re-prefill mostly a block-table copy, and resumption is token-exact
+    under greedy decoding because each slot's tokens depend only on its
+    own KV). `kv_alloc_failure` is a per-request failure — dump,
+    structured `failed` status, serving continues — only when no victim
+    exists. `shed_on_pressure=True` additionally lets the admission
+    gate shed the lowest-priority queued class (priority >=
+    `shed_priority_min`) while the attached SLO monitor reports burn-
+    rate breaches or the memory watch reports HBM pressure. Every
+    terminal path records a `RequestResult` (a list of the generated
+    tokens + `status`/`reason`/`preemptions`) in `engine.finished`.
+    All of it is host-side scheduling: work-list/slab shapes stay on
+    the same bucketed compile treadmill, and default-config behavior
+    (priority 0, no deadlines, shedding off) is bit-identical to the
+    pre-resilience engine.
     """
 
     SLO_WINDOW = 8      # decode-TPOT samples per controller decision
@@ -399,7 +532,8 @@ class ContinuousBatchingEngine:
                  temperature=0.0, top_p=1.0, seed=0, prefill_chunk=64,
                  token_budget=None, spec_k=0, spec_ngram=2,
                  tpot_slo=None, min_prefill_chunk=64, prefix_cache=False,
-                 monitor=None, memory_watch=None):
+                 monitor=None, memory_watch=None, shed_on_pressure=False,
+                 shed_priority_min=1):
         import jax
 
         self.engine = engine
@@ -480,6 +614,19 @@ class ContinuousBatchingEngine:
         # HBM/census accounting on the same tick cadence (memory.py
         # MemoryMonitor): gauges + the hbm_pressure flight trigger
         self.memory_watch = memory_watch
+        # pressure-aware admission (OFF by default: the committed serve
+        # baselines predate shedding): when the attached SLO monitor's
+        # last evaluation breached, or the memory watch reported HBM
+        # pressure, the admission gate sheds the lowest-priority queued
+        # class (never below shed_priority_min — priority-0 work is not
+        # sheddable by default) as a STRUCTURED rejection, before the
+        # pool exhausts and preemption has to do it the hard way
+        self.shed_on_pressure = bool(shed_on_pressure)
+        self.shed_priority_min = int(shed_priority_min)
+        if self.shed_priority_min < 0:
+            raise ValueError("shed_priority_min must be >= 0")
+        self._submit_counter = 0
+        self._admit_counter = 0
         kvh = self.caches[0].shape[1]
         num_q = engine.num_heads
         self._pack = default_pack(self.max_batch, num_q // kvh)
@@ -507,39 +654,169 @@ class ContinuousBatchingEngine:
         # the retired ones — no linear scan per submit
         if rid in self._ids or rid in self.finished:
             raise ValueError(f"duplicate request_id {rid}")
+        # unsupported CONFIG combos are a structured per-request
+        # rejection, not an exception: the caller that would have hit a
+        # mid-step raise (or a silently skewed output distribution)
+        # gets a terminal record instead, and the serve loop never sees
+        # the bad request at all
+        reason = self._reject_reason(request)
+        if reason is not None:
+            request.status = "rejected"
+            request.status_reason = reason
+            self.finished[rid] = RequestResult(
+                (), status="rejected", reason=reason)
+            _metrics.serve_rejected().labels(reason=reason).inc()
+            _tracing.get_tracer().event(
+                "reject", request=rid, status="rejected", reason=reason)
+            return "rejected"
         request.submit_time = time.monotonic()
         request._submit_pc = time.perf_counter()
+        request._submit_step = self._step_count
+        request._seq = self._submit_counter
+        self._submit_counter += 1
+        request.status = "queued"
         self.queue.append(request)
         self._ids.add(rid)
         _metrics.serve_queue_depth().set(len(self.queue))
         _tracing.get_tracer().event(
             "submit", request=rid, prompt_tokens=len(request.prompt),
-            max_new_tokens=request.max_new_tokens)
+            max_new_tokens=request.max_new_tokens,
+            priority=request.priority)
+        return "queued"
+
+    def _reject_reason(self, request):
+        """Submission-time screen for per-request knobs the engine
+        cannot honor mid-flight. Reasons are a small FIXED label set
+        (they feed a labeled counter — the GL112 contract)."""
+        if request.temperature is not None \
+                and request.temperature != self._temp:
+            # the fused sampler takes ONE batch temperature; honoring a
+            # different per-request value would re-key the compiled
+            # step or skew every other slot's sampling stream
+            return "temperature_override"
+        # past this point any per-request temperature EQUALS the
+        # engine's, so the speculation check reads the engine's
+        k_req = request.spec_k
+        if (k_req or 0) > 0 and self._temp > 0.0:
+            # greedy verification only (engine-level spec_k>0 + temp>0
+            # is already refused at construction; this is the
+            # per-request echo of the same contract: speculation asked
+            # of a sampling engine)
+            return "spec_sampled"
+        if k_req is not None and k_req > self.spec_k:
+            # the sample-gather width W = 1 + engine.spec_k is static
+            # per compiled bucket: a wider per-request span cannot be
+            # verified without a fresh compile keyspace
+            return "spec_k_exceeds_engine"
+        return None
 
     @property
     def num_active(self):
         return sum(r is not None for r in self.slots)
 
+    def _deadline_passed(self, req, now=None):
+        if req.deadline_steps is not None \
+                and req._submit_step is not None \
+                and self._step_count - req._submit_step \
+                >= req.deadline_steps:
+            return True
+        if req.deadline_s is not None and req.submit_time is not None:
+            now = time.monotonic() if now is None else now
+            if now - req.submit_time >= req.deadline_s:
+                return True
+        return False
+
+    def _finish_slot(self, i, status, reason=None):
+        """Terminal retirement of slot i, whatever the cause: free its
+        KV (registered blocks park in the prefix pool — the ISSUE-5
+        rewind/free discipline; shared blocks just decref), clear the
+        table row, and record the structured RequestResult. Every
+        terminal path funnels through here so the allocator bookkeeping
+        can't diverge between finish/cancel/deadline/failure."""
+        req = self.slots[i]
+        self.allocator.free(req.blocks)
+        req.blocks = []
+        self.slots[i] = None
+        self.tables[i] = 0
+        self.lens[i] = 0
+        req.status = status
+        req.status_reason = reason
+        self.finished[req.request_id] = RequestResult(
+            req.generated, status=status, reason=reason,
+            preemptions=req.preemptions)
+        self._ids.discard(req.request_id)
+        _tracing.get_tracer().event(
+            "retire", request=req.request_id, status=status,
+            generated=len(req.generated),
+            spec_drafted=req.spec_drafted,
+            spec_accepted=req.spec_accepted)
+
+    def _terminal_queued(self, req, status, reason=None):
+        """Terminal record for a request that never (re)entered a slot
+        this round: queued cancel/deadline/shed. Holds no blocks by
+        construction (a preempted request gave its blocks back when it
+        left its slot), so this is pure bookkeeping."""
+        req.status = status
+        req.status_reason = reason
+        self.finished[req.request_id] = RequestResult(
+            req.generated, status=status, reason=reason,
+            preemptions=req.preemptions)
+        self._ids.discard(req.request_id)
+        _metrics.serve_queue_depth().set(len(self.queue))
+
     def _retire(self):
         retired = 0
+        now = time.monotonic()
+        tr = _tracing.get_tracer()
         for i, req in enumerate(self.slots):
-            if req is not None and req.done:
-                self.allocator.free(req.blocks)
-                req.blocks = []
-                self.slots[i] = None
-                self.tables[i] = 0
-                self.lens[i] = 0
-                self.finished[req.request_id] = list(req.generated)
-                self._ids.discard(req.request_id)
+            if req is None:
+                continue
+            if req.done:
+                self._finish_slot(i, "finished")
+                _metrics.serve_requests_total().inc()
                 retired += 1
-                _tracing.get_tracer().event(
-                    "retire", request=req.request_id,
-                    generated=len(req.generated),
-                    spec_drafted=req.spec_drafted,
-                    spec_accepted=req.spec_accepted)
+            elif req._cancel:
+                _metrics.serve_cancelled().inc()
+                tr.event("cancel", request=req.request_id,
+                         status="cancelled",
+                         generated=len(req.generated))
+                self._finish_slot(i, "cancelled")
+                retired += 1
+            elif self._deadline_passed(req, now):
+                _metrics.serve_deadline_exceeded().inc()
+                tr.event("deadline_exceeded", request=req.request_id,
+                         status="deadline_exceeded",
+                         generated=len(req.generated),
+                         deadline_steps=req.deadline_steps)
+                self._finish_slot(i, "deadline_exceeded", "in_flight")
+                retired += 1
         if retired:
-            _metrics.serve_requests_total().inc(retired)
             self._update_pool_gauges()
+
+    def cancel(self, request_id):
+        """Retire a request mid-flight. A queued request (including a
+        preempted one awaiting re-admission) leaves immediately; an
+        active request is flagged and retired at the top of the next
+        step — its KV blocks go back to the pool through the same free
+        path as normal retirement, so mid-speculation or mid-prefill
+        state is reclaimed exactly. Terminal status `cancelled`, with
+        whatever tokens were already generated. Returns True when the
+        request was found live, False when it is unknown or already
+        terminal. Host-thread API: call between steps, like submit()."""
+        for req in self.queue:
+            if req.request_id == request_id:
+                self.queue.remove(req)
+                _metrics.serve_cancelled().inc()
+                _tracing.get_tracer().event(
+                    "cancel", request=request_id, status="cancelled",
+                    generated=len(req.generated))
+                self._terminal_queued(req, "cancelled")
+                return True
+        for req in self.slots:
+            if req is not None and req.request_id == request_id:
+                req._cancel = True
+                return True
+        return False
 
     def _update_pool_gauges(self):
         _metrics.kv_blocks_free().set(self.allocator.num_free)
@@ -552,58 +829,220 @@ class ContinuousBatchingEngine:
             _metrics.kv_blocks_prefix_resident().set(
                 self.allocator.num_registered)
 
+    def _admission_pressure(self):
+        """Shed signal for the admission gate: the attached SLO
+        monitor's last burn-rate evaluation breached (PR 8), or the
+        memory watch reported HBM pressure (PR 9). Returns the fixed
+        reason label, or None when admission should run normally."""
+        if not self.shed_on_pressure:
+            return None
+        rep = getattr(self.monitor, "last_report", None) \
+            if self.monitor is not None else None
+        if rep and rep.get("breaches", 0) > 0:
+            return "slo_burn"
+        mrep = getattr(self.memory_watch, "last_report", None) \
+            if self.memory_watch is not None else None
+        if mrep and mrep.get("pressure"):
+            return "hbm_pressure"
+        return None
+
+    def _cull_queue(self):
+        """Queued-side lifecycle pass before admission: drop requests
+        whose deadline already passed (structured terminal record, not
+        a wasted admission) and — under pressure — shed the lowest
+        sheddable priority class."""
+        if not self.queue:
+            return
+        now = time.monotonic()
+        tr = _tracing.get_tracer()
+        for req in [r for r in self.queue
+                    if self._deadline_passed(r, now)]:
+            self.queue.remove(req)
+            _metrics.serve_deadline_exceeded().inc()
+            # a preempted request can expire while re-queued: it still
+            # carries the tokens it generated before eviction
+            tr.event("deadline_exceeded", request=req.request_id,
+                     status="deadline_exceeded",
+                     generated=len(req.generated),
+                     deadline_steps=req.deadline_steps)
+            self._terminal_queued(req, "deadline_exceeded", "queued")
+        reason = self._admission_pressure()
+        if reason is None:
+            return
+        sheddable = [r for r in self.queue
+                     if r.priority >= self.shed_priority_min]
+        if not sheddable:
+            return
+        # one class per admission pass: shedding is a relief valve, not
+        # a queue flush — the worst class goes first, the next only if
+        # pressure persists into the next step
+        worst = max(r.priority for r in sheddable)
+        for req in [r for r in sheddable if r.priority == worst]:
+            self.queue.remove(req)
+            _metrics.serve_shed().labels(reason=reason).inc()
+            tr.event("shed", request=req.request_id, status="shed",
+                     reason=reason, priority=req.priority)
+            self._terminal_queued(req, "shed", reason)
+
+    def _pick_victim(self, below, exclude=None):
+        """Preemption victim: the NEWEST-admitted active request of the
+        strictly-lowest priority class below `below` (priority value
+        strictly greater — equal classes never preempt each other, so
+        two requests can't thrash swapping the same blocks). Returns
+        the slot index or None."""
+        best = None
+        for j, r in enumerate(self.slots):
+            if r is None or j == exclude or r.priority <= below:
+                continue
+            key = (r.priority, r._admit_seq or 0)
+            if best is None or key > best[0]:
+                best = (key, j)
+        return None if best is None else best[1]
+
+    def _preempt_slot(self, i, reason, q_lens=None, drafts=None):
+        """Preempt slot i TO BLOCKS: free its KV pages (registered
+        blocks park in the prefix reuse pool, so with the cache on its
+        re-prefill is mostly a block-table copy), re-queue the request
+        with its original arrival order (it sorts back to the front of
+        its class), and cancel any work the current step had scheduled
+        for it. The request keeps every token it generated; resumption
+        re-prefills prompt + generated and decodes on, token-exact
+        under greedy verification by construction."""
+        req = self.slots[i]
+        freed = len(req.blocks)
+        self.allocator.free(req.blocks)
+        req.blocks = []
+        self.slots[i] = None
+        self.tables[i] = 0
+        self.lens[i] = 0
+        req.status = "preempted"
+        req.preemptions += 1
+        req.progress = 0
+        req._cow_reserve = 0
+        self.queue.append(req)
+        if q_lens is not None:
+            q_lens[i] = 0
+        if drafts is not None:
+            drafts.pop(i, None)
+        self._sched_info.pop(i, None)
+        _metrics.serve_preemptions().labels(reason=reason).inc()
+        _tracing.get_tracer().event(
+            "preempt", request=req.request_id, reason=reason,
+            priority=req.priority, generated=len(req.generated),
+            blocks_freed=freed)
+        _tracing.get_flight_recorder().trigger(
+            "preemption", request=req.request_id, preempt_reason=reason,
+            step=self._step_count, priority=req.priority,
+            blocks_freed=freed, generated=len(req.generated))
+        self._update_pool_gauges()
+
     def _admit(self):
-        # FIFO with worst-case reservation: the head request waits until
-        # its full footprint fits, so admitted requests always finish.
-        # Matched shared blocks count as held (len(r.blocks)), and a
-        # request that mapped a shared tail block it must still write
-        # into keeps one COW block reserved on top; the pool side is
-        # num_available because alloc() reclaims the LRU reuse pool
-        # before failing.
+        # Priority admission with worst-case reservation: candidates in
+        # (priority, arrival) order — all-default-priority traffic is
+        # exactly the old FIFO — and a candidate is only admitted when
+        # the pool covers its FULL footprint, so admitted requests
+        # always finish. Matched shared blocks count as held
+        # (len(r.blocks)), a mapped shared tail block keeps one COW
+        # block reserved on top, and the pool side is num_available
+        # because alloc() reclaims the LRU reuse pool before failing.
+        # A blocked candidate first tries to preempt strictly-lower-
+        # priority victims; if still blocked it blocks the line (no
+        # lower-priority request may slip past and starve it).
+        self._cull_queue()
+        if not self.queue:
+            return
         reserved = sum(
             r.blocks_needed(self.block_size) - len(r.blocks)
             + r._cow_reserve
             for r in self.slots if r is not None)
-        for i in range(self.max_batch):
-            if not self.queue:
-                break
-            if self.slots[i] is not None:
-                continue
-            need = self.queue[0].blocks_needed(self.block_size)
-            if reserved + need > self.allocator.num_available:
-                # KV starvation: the head request is blocked on pool
-                # capacity, not on a free slot — the queue-wait outlier
-                # the flight recorder's timeline should explain
+        for req in sorted(self.queue,
+                          key=lambda r: (r.priority, r._seq or 0)):
+            need = req.blocks_needed(self.block_size)
+            slot_free = any(s is None for s in self.slots)
+            # feasibility FIRST: preempting victim v raises admission
+            # slack by exactly v.blocks_needed + v._cow_reserve (its
+            # outstanding reservation returns AND its held blocks free)
+            # — if even evicting every strictly-lower-priority victim
+            # cannot cover the candidate, preempt NOBODY: destroying
+            # in-flight work to still end up blocked buys nothing
+            victims_gain = sum(
+                r.blocks_needed(self.block_size) + r._cow_reserve
+                for r in self.slots
+                if r is not None and r.priority > req.priority)
+            if reserved + need > self.allocator.num_available \
+                    + victims_gain:
+                # KV starvation no preemption can fix: the candidate is
+                # blocked on pool capacity — the queue-wait outlier the
+                # flight recorder's timeline should explain
                 _tracing.get_tracer().event(
-                    "admit_blocked", request=self.queue[0].request_id,
+                    "admit_blocked", request=req.request_id,
                     blocks_needed=need, blocks_reserved=reserved,
                     blocks_free=self.allocator.num_free,
                     blocks_available=self.allocator.num_available)
                 break
-            req = self.queue.popleft()
+            if not slot_free:
+                # every slot busy: a strictly-lower-priority victim
+                # yields its SLOT (and its blocks) to the candidate —
+                # otherwise a full batch of background work would
+                # head-of-line-block front-door traffic forever
+                victim = self._pick_victim(below=req.priority)
+                if victim is None:
+                    break
+                vr = self.slots[victim]
+                reserved -= (vr.blocks_needed(self.block_size)
+                             - len(vr.blocks) + vr._cow_reserve)
+                self._preempt_slot(victim, "admission")
+            while reserved + need > self.allocator.num_available:
+                # feasible by the check above: evict newest-lowest
+                # until the candidate fits
+                victim = self._pick_victim(below=req.priority)
+                if victim is None:
+                    break
+                vr = self.slots[victim]
+                reserved -= (vr.blocks_needed(self.block_size)
+                             - len(vr.blocks) + vr._cow_reserve)
+                self._preempt_slot(victim, "admission")
+            if reserved + need > self.allocator.num_available:
+                _tracing.get_tracer().event(
+                    "admit_blocked", request=req.request_id,
+                    blocks_needed=need, blocks_reserved=reserved,
+                    blocks_free=self.allocator.num_free,
+                    blocks_available=self.allocator.num_available)
+                break
+            i = min(i for i in range(self.max_batch)
+                    if self.slots[i] is None)
+            self.queue.remove(req)
             reserved += need
             req.blocks = []
             req.progress = 0
-            req.generated = []
-            req.spec_drafted = 0
-            req.spec_accepted = 0
             req.cached_prefix = 0
             req._prefix_key = None
             req._registered = 0
+            # resumption source: a fresh request prefills its prompt; a
+            # preempted one re-prefills prompt + everything it already
+            # emitted (the KV it gave back), then decode continues from
+            # the exact token it was preempted at
+            req._prefill_src = req.prompt if not req.generated \
+                else req.prompt + [int(t) for t in req.generated]
+            req._resume_len = len(req._prefill_src)
             if self._prefix_on:
-                # the prompt's chained key ladder is a pure function of
-                # the prompt: hash it ONCE here so the per-step
+                # the chained key ladder is a pure function of the
+                # prefill source: hash it ONCE here so the per-step
                 # scheduler dedup and wavefront probes index into it
                 # instead of rehashing up to a chunk of tokens per slot
                 # per step
                 ks, k = [], None
                 bs = self.block_size
-                for b in range(len(req.prompt) // bs):
-                    k = block_key(k, req.prompt[b * bs:(b + 1) * bs])
+                src = req._prefill_src
+                for b in range(len(src) // bs):
+                    k = block_key(k, src[b * bs:(b + 1) * bs])
                     ks.append(k)
                 req._prompt_keys = ks
             req._miss_frontier = -1
             req._cow_reserve = 0
+            req.status = "running"
+            req._admit_seq = self._admit_counter
+            self._admit_counter += 1
             req.admit_time = time.monotonic()
             if req.submit_time is not None:
                 _metrics.serve_queue_wait().observe(
@@ -614,6 +1053,11 @@ class ContinuousBatchingEngine:
             _tracing.get_tracer().record_span(
                 "queue_wait", start_pc * 1e6, (adm_pc - start_pc) * 1e6,
                 request=req.request_id, blocks_reserved=need)
+            if req.preemptions:
+                _tracing.get_tracer().event(
+                    "resume", request=req.request_id,
+                    generated=len(req.generated),
+                    preemptions=req.preemptions)
             self.slots[i] = req
             self.tables[i] = 0
             self.lens[i] = 0
@@ -638,10 +1082,11 @@ class ContinuousBatchingEngine:
         writes. Returns the number of tokens newly mapped."""
         req = self.slots[i]
         bs = self.block_size
+        src = req._prefill_src
         mapped = 0
         while True:
             p = req.progress
-            if p % bs != 0 or p + bs > len(req.prompt):
+            if p % bs != 0 or p + bs > len(src):
                 break
             key = req._prompt_keys[p // bs]
             blk = self.allocator.acquire(key)
@@ -666,9 +1111,9 @@ class ContinuousBatchingEngine:
             self.cache_stats["hit_blocks"] += 1
             _metrics.prefix_cache_hits().inc()
         if mapped:
-            if req.progress == len(req.prompt):
-                # whole prompt cached: leave the LAST prompt token to
-                # the scheduler — sampling the first output token needs
+            if req.progress == req._resume_len:
+                # whole prefill source cached: leave the LAST token to
+                # the scheduler — sampling the next output token needs
                 # its forward pass. progress stays mid-block, so the
                 # write goes through COW on the shared tail block.
                 req.progress -= 1
@@ -692,20 +1137,18 @@ class ContinuousBatchingEngine:
         old = req.blocks[idx]
         try:
             new = self.allocator.alloc()
-        except RuntimeError:
+        except KVAllocFailure:
             # admission reserved the COW footprint (_cow_reserve), so
             # this alloc cannot fail — if it does (a reservation bug,
-            # an injected fault), dump the timeline like the step's
-            # block-grow guard does, then re-raise
+            # an injected fault), leave the COW-specific evidence on
+            # the timeline and re-raise to the step's grow guard, which
+            # preempts a lower-priority victim or (with no victim)
+            # demotes this to a per-request failure with a dump
             _tracing.get_tracer().event(
                 "stall_alloc", request=req.request_id,
                 blocks_held=len(req.blocks),
                 blocks_free=self.allocator.num_free,
                 cow_block_index=idx)
-            _tracing.get_flight_recorder().trigger(
-                "kv_alloc_failure", request=req.request_id,
-                step=self._step_count,
-                blocks_free=self.allocator.num_free)
             raise
         self.caches = self.engine._paged_copy(
             self.caches, np.int32(old), np.int32(new))
@@ -767,7 +1210,7 @@ class ContinuousBatchingEngine:
         decode_slots = []
         for i in active:
             req = self.slots[i]
-            if req.progress >= len(req.prompt):
+            if req.progress >= req._resume_len:
                 q_lens[i] = 1
                 used += 1
                 decode_slots.append(i)
@@ -777,7 +1220,7 @@ class ContinuousBatchingEngine:
         pending = set()     # block keys being computed by a slot THIS step
         for i in active:
             req = self.slots[i]
-            rem = len(req.prompt) - req.progress
+            rem = req._resume_len - req.progress
             if rem <= 0:
                 continue
             keys = []
@@ -813,6 +1256,13 @@ class ContinuousBatchingEngine:
         if self.spec_k:
             for i in decode_slots:
                 req = self.slots[i]
+                # per-request spec cap: a request may ask for SHORTER
+                # draft spans than the engine's spec_k (submit()
+                # rejected anything wider)
+                k_cap = self.spec_k if req.spec_k is None \
+                    else min(req.spec_k, self.spec_k)
+                if k_cap <= 0:
+                    continue
                 # a span of 1+k emits at most k+1 tokens: cap k at
                 # rem_gen-1 so acceptance can never exceed the request
                 rem_gen = req.max_new_tokens - len(req.generated)
@@ -821,13 +1271,85 @@ class ContinuousBatchingEngine:
                 if room <= 0:
                     continue
                 d = propose_draft_tokens(req.prompt + req.generated,
-                                         min(self.spec_k, room),
+                                         min(k_cap, room),
                                          self.spec_ngram)
                 if d:
                     drafts[i] = d
                     q_lens[i] += len(d)
                     used += len(d)
         return q_lens, drafts
+
+    def _fail_slot(self, i, reason, q_lens, drafts):
+        """Demote an unsatisfiable allocation from an engine crash to a
+        per-request failure: dump the timeline (the kv_alloc_failure
+        flight trigger — same evidence the old re-raise left, minus the
+        dead process), record the structured terminal status, and hand
+        the slot's blocks back. Only reached when no preemptible victim
+        exists."""
+        req = self.slots[i]
+        tr = _tracing.get_tracer()
+        tr.event("stall_alloc", request=req.request_id,
+                 blocks_held=len(req.blocks),
+                 blocks_free=self.allocator.num_free,
+                 tokens_wanted=int(q_lens[i]))
+        tr.event("request_failed", request=req.request_id,
+                 status="failed", reason=reason)
+        _tracing.get_flight_recorder().trigger(
+            "kv_alloc_failure", request=req.request_id,
+            step=self._step_count, blocks_free=self.allocator.num_free)
+        _metrics.serve_failed().labels(reason=reason).inc()
+        self._finish_slot(i, "failed", reason)
+        q_lens[i] = 0
+        drafts.pop(i, None)
+        self._sched_info.pop(i, None)
+        self._update_pool_gauges()
+
+    def _grow_slot(self, i, q_lens, drafts):
+        """COW + block-grow for the span slot i computes this step.
+        Admission reserved the worst-case footprint, so the allocs here
+        cannot fail in normal flow; when one DOES (a reservation bug,
+        an injected fault), the scheduler preempts the newest strictly-
+        lower-priority victim to blocks and retries — the step loses
+        the victim's work this tick, nobody crashes — and only with no
+        victim left does the request itself fail (per-request, with a
+        kv_alloc_failure dump)."""
+        while self.slots[i] is not None:
+            req = self.slots[i]
+            try:
+                end = int(self.lens[i] + q_lens[i])
+                if self._prefix_on and q_lens[i]:
+                    # copy-on-write BEFORE the step writes: any
+                    # existing block this step's span appends into that
+                    # other holders still read gets a private copy (the
+                    # whole-prompt-cached tail block is the natural
+                    # case)
+                    lo = int(self.lens[i]) // self.block_size
+                    hi = (end - 1) // self.block_size
+                    for idx in range(lo, min(hi + 1, len(req.blocks))):
+                        if self.allocator.refcount(req.blocks[idx]) > 1:
+                            self._cow_block(i, idx)
+                    # the first write settled every sharing conflict
+                    # this request can ever have (it only appends at
+                    # its tail): release the admission-side COW
+                    # reservation even when the other holder retired
+                    # first and no copy was needed
+                    req._cow_reserve = 0
+                while len(req.blocks) * self.block_size < end:
+                    blk = self.allocator.alloc()
+                    req.blocks.append(blk)
+                    self.tables[i, len(req.blocks) - 1] = blk
+                return
+            except KVAllocFailure:
+                # the allocator's exhaustion type ONLY: a device-side
+                # RuntimeError out of the COW copy dispatch must
+                # propagate, not be demoted to a per-request failure
+                victim = self._pick_victim(below=req.priority, exclude=i)
+                if victim is None:
+                    self._fail_slot(i, "kv_alloc_failure", q_lens,
+                                    drafts)
+                    return
+                self._preempt_slot(victim, "kv_alloc", q_lens=q_lens,
+                                   drafts=drafts)
 
     def step(self):
         """One scheduler tick + one compiled mixed prefill/decode step.
@@ -856,48 +1378,21 @@ class ContinuousBatchingEngine:
             # the block its leader registered last step)
             for i in active:
                 req = self.slots[i]
-                if req.progress < len(req.prompt):
+                if req.progress < req._resume_len:
                     self._extend_match(i)
         q_lens, drafts = self._schedule_tokens(active)
         for i in active:
-            # grow the block list to cover every token this step appends
-            # (a prompt chunk may cross several block boundaries);
-            # admission reserved the worst-case footprint, so alloc()
-            # cannot fail here — if it DOES (a reservation bug, an
-            # injected fault), that is exactly the anomaly the flight
-            # recorder exists for: dump the timeline, then re-raise
-            req = self.slots[i]
-            end = int(self.lens[i] + q_lens[i])
-            if self._prefix_on and q_lens[i]:
-                # copy-on-write BEFORE the step writes: any existing
-                # block this step's span appends into that other
-                # holders still read gets a private copy (the
-                # whole-prompt-cached tail block is the natural case)
-                lo = int(self.lens[i]) // self.block_size
-                hi = (end - 1) // self.block_size
-                for idx in range(lo, min(hi + 1, len(req.blocks))):
-                    if self.allocator.refcount(req.blocks[idx]) > 1:
-                        self._cow_block(i, idx)
-                # the first write settled every sharing conflict this
-                # request can ever have (it only appends at its tail):
-                # release the admission-side COW reservation even when
-                # the other holder retired first and no copy was needed
-                req._cow_reserve = 0
-            try:
-                while len(req.blocks) * self.block_size < end:
-                    blk = self.allocator.alloc()
-                    req.blocks.append(blk)
-                    self.tables[i, len(req.blocks) - 1] = blk
-            except RuntimeError:
-                tr.event("stall_alloc", request=req.request_id,
-                         blocks_held=len(req.blocks),
-                         blocks_free=self.allocator.num_free,
-                         tokens_wanted=int(q_lens[i]))
-                _tracing.get_flight_recorder().trigger(
-                    "kv_alloc_failure", request=req.request_id,
-                    step=self._step_count,
-                    blocks_free=self.allocator.num_free)
-                raise
+            self._grow_slot(i, q_lens, drafts)
+        # preemption/failure may have vacated slots mid-grow: the rest
+        # of the step only sees the survivors (their q_lens are zeroed,
+        # their table rows parked)
+        active = [i for i in active if self.slots[i] is not None]
+        if not active:
+            if self.monitor is not None:
+                self.monitor.tick()
+            if self.memory_watch is not None:
+                self.memory_watch.tick()
+            return len(self.queue) + self.num_active
         # token slab [B, C]: C is the widest span this step, bucketed to
         # a power of two (1 for an all-decode step) so slab shapes — and
         # the programs they key — stay off the per-prompt-length
@@ -908,8 +1403,9 @@ class ContinuousBatchingEngine:
         for i in active:
             req = self.slots[i]
             n = int(q_lens[i])
-            if req.progress < len(req.prompt):
-                slab[i, :n] = req.prompt[req.progress:req.progress + n]
+            if req.progress < req._resume_len:
+                slab[i, :n] = \
+                    req._prefill_src[req.progress:req.progress + n]
             elif n:
                 # decode: last real token, then the speculative drafts
                 # (if granted) — the step verifies the whole span
@@ -931,7 +1427,7 @@ class ContinuousBatchingEngine:
             n = int(q_lens[i])
             if n == 0:
                 continue
-            if req.progress < len(req.prompt):
+            if req.progress < req._resume_len:
                 sel[i, 0] = n - 1
             else:
                 sel[i, :n] = np.arange(n)
@@ -974,24 +1470,24 @@ class ContinuousBatchingEngine:
             req = self.slots[i]
             n = int(q_lens[i])
             if n == 0:
-                if req.progress < len(req.prompt):
+                if req.progress < req._resume_len:
                     if i in self._pending_stalls:
                         # deferred on purpose: another slot is computing
                         # this slot's next block THIS step — next step's
                         # wavefront match maps it for free
                         tr.event("stall_cache_pending",
                                  request=req.request_id,
-                                 prompt_remaining=len(req.prompt)
+                                 prompt_remaining=req._resume_len
                                  - req.progress)
                     else:
                         # budget starvation: the prompt wanted a chunk
                         # and got zero work-list entries this step
                         tr.event("stall_budget", request=req.request_id,
-                                 prompt_remaining=len(req.prompt)
+                                 prompt_remaining=req._resume_len
                                  - req.progress,
                                  token_budget=self.token_budget)
                 continue        # starved prefill slot: stalled this step
-            if req.progress < len(req.prompt):
+            if req.progress < req._resume_len:
                 requested, granted = self._sched_info.get(i, (n, n))
                 slot_spans.append((i, req.request_id, "prefill_chunk",
                                    {"width": n, "granted": granted,
@@ -999,7 +1495,7 @@ class ContinuousBatchingEngine:
                                     "progress": req.progress + n}))
                 self.lens[i] += n
                 req.progress += n
-                if req.progress == len(req.prompt):
+                if req.progress == req._resume_len:
                     # the chunk ended the prompt: sel column 0 carried
                     # its last valid position — that sample is the
                     # request's FIRST output token

@@ -328,6 +328,29 @@ def _arg_signature(args, kwargs, static_argnums=()):
             tuple((i, freeze_static(a)) for i, a in sorted(static.items())))
 
 
+# host-side fault-injection point (paddle_tpu/testing/faults.py): a
+# per-program dispatch delay in seconds, applied on the HOST before the
+# compiled call is enqueued. This is how the chaos harness makes a step
+# "slow/stalled" deterministically without touching the device program
+# — the delay lands inside the dispatch span, so the flight recorder
+# and the dispatch_seconds{program} histogram see exactly what a real
+# stall would look like. Empty in production; never consulted under a
+# tracer (the wrapper is plain host code).
+_dispatch_delay = {}
+
+
+def set_dispatch_delay(program, delay_s):
+    """Testing hook: stall `program`'s dispatches by `delay_s` host
+    seconds (0/None clears). Returns the previous value so callers can
+    restore — the fault injector scopes it per step."""
+    prev = _dispatch_delay.get(program)
+    if not delay_s:
+        _dispatch_delay.pop(program, None)
+    else:
+        _dispatch_delay[program] = float(delay_s)
+    return prev
+
+
 def _dispatch_span(name, fn, static_argnums=()):
     """Host-side span around a compiled program's dispatch (tracing.py
     ring; perf_counter timebase). jax dispatch is async: the measured
@@ -375,6 +398,11 @@ def _dispatch_span(name, fn, static_argnums=()):
                 catalog.analyze_jitted(name, fn, args, kwargs,
                                        signature=f"sig{len(seen)}")
         t0 = _time.perf_counter()
+        delay = _dispatch_delay.get(name)
+        if delay:
+            # injected stall (testing hook above): inside the span and
+            # the histogram on purpose — evidence looks like the fault
+            _time.sleep(delay)
         out = fn(*args, **kwargs)
         dur = _time.perf_counter() - t0
         _tracing.get_tracer().record_span(name, t0 * 1e6, dur * 1e6)
@@ -385,7 +413,7 @@ def _dispatch_span(name, fn, static_argnums=()):
     return call
 
 
-__all__ += ["FusedMultiTransformerEngine"]
+__all__ += ["FusedMultiTransformerEngine", "set_dispatch_delay"]
 __all__ += ["DataType", "PlaceType", "Tensor", "PredictorPool", "XpuConfig",
             "get_version", "get_num_bytes_of_data_type",
             "get_trt_compile_version", "get_trt_runtime_version",

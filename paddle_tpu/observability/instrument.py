@@ -25,6 +25,8 @@ __all__ = [
     "serve_effective_tokens_per_step", "serve_prefill_chunk",
     "prefix_cache_hits", "prefix_cache_misses", "prefix_cache_evictions",
     "prefix_cache_cow", "kv_blocks_shared", "kv_blocks_prefix_resident",
+    "serve_preemptions", "serve_cancelled", "serve_shed",
+    "serve_deadline_exceeded", "serve_failed", "serve_rejected",
     "train_step_seconds", "train_tokens_total", "train_steps_total",
     "train_tokens_per_s",
 ]
@@ -161,6 +163,54 @@ def kv_blocks_prefix_resident():
         "kv_blocks_prefix_resident",
         help="physical blocks resident in the prefix index (held by "
              "requests or parked in the LRU reuse pool)")
+
+
+# -- serving resilience (preemption / cancellation / shedding) -----------
+# reason labels are drawn from small FIXED sets (the engine spells them
+# as literals), never from request ids or prompt content — the GL112
+# bounded-cardinality contract
+
+def serve_preemptions():
+    return get_registry().counter(
+        "serve_preemptions_total",
+        help="requests preempted to blocks (KV freed, request re-queued "
+             "for prefix-cache-assisted re-prefill)", labels=("reason",))
+
+
+def serve_cancelled():
+    return get_registry().counter(
+        "serve_requests_cancelled_total",
+        help="requests retired mid-flight (or dequeued) by cancel()")
+
+
+def serve_shed():
+    return get_registry().counter(
+        "serve_requests_shed_total",
+        help="queued low-priority requests shed by pressure-aware "
+             "admission before the KV pool exhausted", labels=("reason",))
+
+
+def serve_deadline_exceeded():
+    return get_registry().counter(
+        "serve_requests_deadline_exceeded_total",
+        help="requests retired at their step/wall deadline with a "
+             "partial generation")
+
+
+def serve_failed():
+    return get_registry().counter(
+        "serve_requests_failed_total",
+        help="per-request failures that used to be engine crashes "
+             "(kv_alloc_failure with no preemptible victim)",
+        labels=("reason",))
+
+
+def serve_rejected():
+    return get_registry().counter(
+        "serve_requests_rejected_total",
+        help="requests rejected at submit() for unsupported config "
+             "combos (structured, instead of a mid-step raise)",
+        labels=("reason",))
 
 
 # -- speculative decode (prompt-lookup drafts + budgeted verify) ---------

@@ -135,9 +135,12 @@ def record_census(census=None, registry=None):
                       help="bytes held by live jax arrays, by group",
                       labels=("group",))
     total_c = total_b = 0
+    # census groups are dtype[shape]/owner-tag strings: bounded by the
+    # program's own array-shape set (and stale groups are zeroed below,
+    # so even that set can't ratchet) — not per-request identity
     for key, ent in census.items():
-        counts.labels(group=key).set(ent["count"])
-        sizes.labels(group=key).set(ent["bytes"])
+        counts.labels(group=key).set(ent["count"])      # graftlint: disable=GL112
+        sizes.labels(group=key).set(ent["bytes"])       # graftlint: disable=GL112
         total_c += ent["count"]
         total_b += ent["bytes"]
     # groups that vanished since the last census must read 0, not keep
@@ -208,8 +211,9 @@ def shard_skew(tree, registry=None):
     g = reg.gauge("shard_bytes",
                   help="bytes of the last skew-checked pytree resident "
                        "per device", labels=("device",))
+    # device ids are the fixed hardware topology, not traffic-scoped
     for dev, b in per_device.items():
-        g.labels(device=dev).set(b)
+        g.labels(device=dev).set(b)     # graftlint: disable=GL112
     # devices absent from THIS pytree read 0, not their previous value
     # (the record_census stale-group contract): the per-device view
     # must agree with the skew ratio computed right here
@@ -293,12 +297,15 @@ class MemoryMonitor:
                     "hbm_device_bytes_peak",
                     help="per-device peak memory in use (PJRT stats)",
                     labels=("device",))
+                # device ids: fixed hardware set, bounded by topology
                 for dev, st in devs.items():
-                    in_use.labels(device=dev).set(st["bytes_in_use"])
+                    in_use.labels(device=dev).set(      # graftlint: disable=GL112
+                        st["bytes_in_use"])
                     if st["bytes_limit"]:
-                        limit_g.labels(device=dev).set(st["bytes_limit"])
+                        limit_g.labels(device=dev).set(  # graftlint: disable=GL112
+                            st["bytes_limit"])
                     if st["peak_bytes_in_use"]:
-                        peak_g.labels(device=dev).set(
+                        peak_g.labels(device=dev).set(   # graftlint: disable=GL112
                             st["peak_bytes_in_use"])
                 in_use_bytes = sum(d["bytes_in_use"] for d in devs.values())
                 limits = sum(d["bytes_limit"] for d in devs.values())

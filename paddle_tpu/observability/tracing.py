@@ -51,8 +51,9 @@ from .metrics import _host_float, get_registry
 __all__ = [
     "SpanRecorder", "FlightRecorder", "get_tracer", "get_flight_recorder",
     "span", "event", "chrome_span_events", "request_summary", "load_dump",
-    "write_dump", "arm_default", "load_manifest", "DUMP_SCHEMA",
-    "MANIFEST_SCHEMA", "MANIFEST_NAME",
+    "write_dump", "arm_default", "load_manifest", "operator_abort_dump",
+    "run_with_abort_evidence", "DUMP_SCHEMA", "MANIFEST_SCHEMA",
+    "MANIFEST_NAME",
 ]
 
 DUMP_SCHEMA = "paddle_tpu.flight_recorder/1"
@@ -247,6 +248,8 @@ def request_summary(request, spans=None, recorder=None):
                    "cache_pending": 0},
         "spec": {"drafted": 0, "accepted": 0, "accept_rate": None,
                  "rewinds": 0, "blocks_freed": 0},
+        "preemptions": 0,
+        "status": None,
         "retired": False,
     }
     first_token_us = None
@@ -289,9 +292,19 @@ def request_summary(request, spans=None, recorder=None):
             out["stalls"]["cache_pending"] += 1
         elif name == "admit_blocked":
             out["stalls"]["admit_blocked"] += 1
+        elif name == "preempt":
+            out["preemptions"] += 1
+            out["status"] = "preempted"
+        elif name in ("cancel", "shed", "reject", "deadline_exceeded",
+                      "request_failed"):
+            # terminal lifecycle events carry the structured status the
+            # engine recorded on the request (the retire event below
+            # overrides for requests that went on to finish)
+            out["status"] = args.get("status", out["status"])
         elif name == "retire":
             out["retired"] = True
             out["generated_tokens"] = args.get("generated")
+            out["status"] = args.get("status", "finished")
     if out["spec"]["drafted"]:
         out["spec"]["accept_rate"] = round(
             out["spec"]["accepted"] / out["spec"]["drafted"], 4)
@@ -315,10 +328,15 @@ class FlightRecorder:
     disk. `max_dumps`/`max_bytes` bound the dir regardless (oldest-first
     rotation + a manifest index — the long-running-server policy
     `arm_default()` turns on). Triggers wired in today:
-    ``kv_alloc_failure`` and ``post_warmup_recompile`` and
+    ``kv_alloc_failure`` (now a PER-REQUEST failure: fired only when no
+    preemptible victim exists), ``preemption`` (a victim's KV went back
+    to blocks and the request re-queued), ``post_warmup_recompile`` and
     ``tpot_slo_breach`` (incubate/nn/continuous_batching.py),
     ``slo_burn_rate`` (observability/slo.py burn-rate breaches),
-    ``comm_watchdog_stall`` (distributed/comm_watchdog.py), plus
+    ``hbm_pressure`` (observability/memory.py),
+    ``comm_watchdog_stall`` (distributed/comm_watchdog.py),
+    ``operator_abort`` (serve entrypoints catching
+    KeyboardInterrupt/SystemExit — `operator_abort_dump()`), plus
     ``manual`` via write_dump()."""
 
     def __init__(self, recorder=None, window_s=30.0, min_interval_s=2.0,
@@ -574,6 +592,65 @@ def arm_default(out_dir=None, window_s=None,
             tempfile.gettempdir(), "paddle_tpu_flightrec")
     return _flight.arm(out_dir, window_s=window_s, max_dumps=max_dumps,
                        max_bytes=max_bytes)
+
+
+def operator_abort_dump(signal="KeyboardInterrupt", **context):
+    """Final evidence write for an operator-initiated shutdown: serve
+    entrypoints call this from their KeyboardInterrupt/SystemExit
+    handlers so a Ctrl-C mid-incident still leaves a flight dump (the
+    whole span window + a full metrics snapshot) instead of a dead
+    process and no trail. When the process recorder is armed the dump
+    goes through the normal trigger path (retention + manifest);
+    unarmed processes get a best-effort dump in the default flight dir
+    — unless NOTHING has run yet (recorder unarmed and the span ring
+    empty: an argparse --help / bad-flag SystemExit has no evidence to
+    preserve and must not litter dump files). Never raises: shutdown
+    evidence must not turn an abort into a crash. Returns the dump
+    path or None."""
+    try:
+        if _flight.armed:
+            return _flight.trigger("operator_abort", signal=str(signal),
+                                   **context)
+        if len(get_tracer()) == 0:
+            return None
+        out_dir = os.environ.get("PADDLE_TPU_FLIGHT_DIR") or os.path.join(
+            tempfile.gettempdir(), "paddle_tpu_flightrec")
+        path = os.path.join(
+            out_dir, f"flightrec_operator_abort_"
+                     f"{int(time.time() * 1000)}_0.json")
+        return _flight.dump_to(path, reason="operator_abort",
+                               signal=str(signal), **context)
+    except Exception:
+        return None
+
+
+def run_with_abort_evidence(fn):
+    """Entrypoint wrapper shared by serve_llama / serve_bench /
+    serve_monitor: run `fn()` and translate an operator abort
+    (KeyboardInterrupt, or a SystemExit raised MID-RUN) into an
+    `operator_abort` flight dump + the conventional exit code (130 for
+    Ctrl-C). Returns the process exit code; one implementation so the
+    three entrypoints cannot drift."""
+    import sys
+
+    try:
+        rc = fn()
+        return 0 if rc is None else rc
+    except (KeyboardInterrupt, SystemExit) as e:
+        path = operator_abort_dump(signal=type(e).__name__)
+        if path:
+            print(f"\noperator abort ({type(e).__name__}): flight dump "
+                  f"+ metrics snapshot -> {path}", file=sys.stderr)
+        if isinstance(e, KeyboardInterrupt):
+            return 130
+        # preserve SystemExit conventions: sys.exit() -> 0,
+        # sys.exit(int) -> that code, sys.exit("msg") -> print + 1
+        if e.code is None:
+            return 0
+        if isinstance(e.code, int):
+            return e.code
+        print(e.code, file=sys.stderr)
+        return 1
 
 
 def load_manifest(dump_dir):

@@ -1,0 +1,4 @@
+"""Test/chaos support utilities (deterministic fault injection)."""
+from .faults import FaultInjector, seeded_plan  # noqa: F401
+
+__all__ = ["FaultInjector", "seeded_plan"]

@@ -47,9 +47,12 @@ def test_graftlint_imports():
     # rule: dict/set keying on device arrays (GL110, the hash-forces-
     # a-sync hazard the prefix index's host-bytes block_key avoids);
     # the cost-observability PR's rule: wall-clock interval arithmetic
-    # (GL111, time.time() differences as durations — NTP-step hazard)
+    # (GL111, time.time() differences as durations — NTP-step hazard);
+    # the resilience PR's rule: unbounded metric label cardinality
+    # (GL112, .labels() fed from loop variables / request identity —
+    # one child series per distinct value, forever)
     assert {"GL104", "GL105", "GL107", "GL108", "GL110",
-            "GL111"} <= set(gl.RULES), sorted(gl.RULES)
+            "GL111", "GL112"} <= set(gl.RULES), sorted(gl.RULES)
 
 
 def test_tree_is_clean():

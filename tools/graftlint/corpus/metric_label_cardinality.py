@@ -1,0 +1,86 @@
+# graftlint-corpus-expect: GL112 GL112 GL112 GL112
+"""Known-bad: unbounded metric label cardinality (GL112).
+
+Reconstructs the leak class the ROADMAP seeded: a registry label fed
+from request ids / raw prompt content / an f-string over a loop
+variable mints one child series per distinct value, forever — a
+long-lived serve loop leaks registry memory and bloats every scrape
+with zero symptoms until the exporter times out. The clean tripwires
+pin the two legitimate idioms: labels drawn from small FIXED literal
+sets, and loop-variable interpolations BUCKETED through a function
+call (the serve_bucket_recompiles pow2 idiom — the value set is O(log)
+by construction even though the site sits in the hot loop).
+"""
+from paddle_tpu.observability import instrument as metrics
+
+
+def serve_loop_label_leak(engine, registry):
+    counter = registry.counter("bad_requests_total", labels=("req",))
+    for req in engine.queue:
+        # BAD: one child per request id, unbounded over the server's
+        # lifetime
+        counter.labels(req=req.request_id).inc()                # GL112
+
+
+def fstring_loop_variable(registry, work_items):
+    c = registry.counter("bad_items_total", labels=("item",))
+    for item in work_items:
+        # BAD: f-string over the raw loop variable — same leak with a
+        # formatting step in the middle
+        c.labels(item=f"work_{item}").inc()                     # GL112
+
+
+def prompt_content_label(registry, req):
+    g = registry.gauge("bad_prompt_gauge", labels=("p",))
+    # BAD: raw prompt content as a label value — unbounded AND huge
+    g.labels(p=str(req.prompt)).set(1)                          # GL112
+
+
+def laundered_request_identity(registry, rid):
+    # BAD: request identity through str() is still one child per
+    # request — laundering the type does not bound the set
+    registry.counter("bad_rid_total",
+                     labels=("r",)).labels(r=str(rid)).inc()    # GL112
+
+
+# -- clean tripwires: these must NOT flag --------------------------------
+
+def next_pow2(n):
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def bounded_bucket_label(registry, work_lens):
+    """The serve_bucket_recompiles idiom: the interpolated values are
+    BUCKETED through a call, so the label set is O(log) by
+    construction even inside the serve loop."""
+    c = registry.counter("serve_bucket_total", labels=("bucket",))
+    for n in work_lens:
+        c.labels(bucket=f"{next_pow2(n)}").inc()
+
+
+def fixed_literal_labels(registry, requests):
+    """Status labels from a fixed literal set: bounded, loop or not."""
+    c = registry.counter("requests_by_status", labels=("status",))
+    for req in requests:
+        status = "finished" if req.done else "running"
+        c.labels(status=status).inc()
+
+
+def loop_invariant_label(registry, shard_names):
+    """A label that is NOT the loop variable (bound once outside)."""
+    kind = "fleet"
+    g = registry.gauge("shard_bytes", labels=("kind",))
+    for _ in shard_names:
+        g.labels(kind=kind).set(0)
+
+
+def op_counter_callback(registry):
+    """The watch_ops idiom: a callback parameter is not a loop
+    variable and op names are a fixed finite set."""
+    def count(name, n_inputs, outs):
+        registry.counter("op_calls_total",
+                         labels=("op",)).labels(op=name).inc()
+    return count

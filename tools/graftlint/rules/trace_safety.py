@@ -74,6 +74,19 @@ TIMESTAMPING only (`"time": time.time()` in dump metadata, filename
 stamps — never flagged) and for cross-process freshness checks against
 stamps another host wrote (wall clock is the only shared timebase —
 those sites carry an explicit disable comment).
+
+GL112 flags unbounded metric label cardinality: a `.labels(x=...)`
+call fed from a loop variable, an f-string interpolating a loop
+variable, or request-scoped identity (`request_id`/`rid`/prompt
+content) grows one child series PER DISTINCT VALUE, forever — a
+long-lived serve loop leaks registry memory and blows up every
+Prometheus scrape, silently. Labels must come from small FIXED sets
+(status/reason literals) or values bounded BY CONSTRUCTION — the
+serve_bucket_recompiles bucket label is the canonical clean case: the
+interpolated values are pow2-bucketed, so the set is O(log) even
+though the site sits in the serve loop; the rule reads an f-string
+whose interpolations are function CALLS as exactly that bucketing
+idiom (the corpus tripwire pins it).
 """
 import ast
 
@@ -901,3 +914,101 @@ def wallclock_interval(ctx):
                     "time.time() value fed to a histogram: an absolute "
                     "wall-clock stamp is not a latency, and "
                     + _GL111_MSG), node
+
+
+# identifiers that carry per-request identity: one label child per
+# request = unbounded cardinality wherever the site sits
+_GL112_UNBOUNDED = {"request_id", "rid", "prompt", "prompt_text",
+                    "user_id", "session_id", "trace_id"}
+
+_GL112_MSG = (
+    "grows one metric child PER DISTINCT VALUE forever — a long-lived "
+    "serve loop leaks registry memory and bloats every scrape. Label "
+    "values must come from small fixed sets (status/reason literals) "
+    "or be bounded by construction; bucket first (next_pow2-style — an "
+    "f-string whose interpolations are function calls reads as that "
+    "idiom), or put per-request identity in SPANS "
+    "(tracing.event(request=...)), never in metric labels")
+
+
+def _gl112_loop_targets(ctx, node):
+    """Names bound by every lexically-enclosing for-loop/comprehension
+    of `node` — the per-iteration values a .labels() in the loop body
+    would mint a fresh child for."""
+    out = set()
+    cur = ctx.parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.For):
+            for el in ast.walk(cur.target):
+                if isinstance(el, ast.Name):
+                    out.add(el.id)
+        elif isinstance(cur, (ast.ListComp, ast.SetComp, ast.DictComp,
+                              ast.GeneratorExp)):
+            for gen in cur.generators:
+                for el in ast.walk(gen.target):
+                    if isinstance(el, ast.Name):
+                        out.add(el.id)
+        cur = ctx.parent(cur)
+    return out
+
+
+def _gl112_ident(expr):
+    """The per-request-identity name an expression carries, if any:
+    `request_id`, `req.request_id`, `str(rid)` all count — identity
+    laundered through str()/repr() is still one child per request."""
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+            and expr.func.id in ("str", "repr", "format") and expr.args:
+        expr = expr.args[0]
+    if isinstance(expr, ast.Name) and expr.id in _GL112_UNBOUNDED:
+        return expr.id
+    if isinstance(expr, ast.Attribute) and expr.attr in _GL112_UNBOUNDED:
+        return expr.attr
+    return None
+
+
+@rule("GL112", "metric-label-cardinality", "trace-safety")
+def metric_label_cardinality(ctx):
+    """`.labels(x=...)` fed from a loop variable, an f-string
+    interpolating a loop variable, or request-scoped identity
+    (request_id / raw prompt content): unbounded label cardinality.
+    Bucketed interpolations (function calls inside the f-string) and
+    fixed literal labels never flag."""
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "labels" and node.keywords):
+            continue
+        loop_vars = None    # computed lazily: parent walks aren't free
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue            # **kwargs: opaque, let it pass
+            v = kw.value
+            why = None
+            ident = _gl112_ident(v)
+            if ident is not None:
+                why = (f"label `{kw.arg}` carries per-request identity "
+                       f"`{ident}`")
+            else:
+                if loop_vars is None:
+                    loop_vars = _gl112_loop_targets(ctx, node)
+                if isinstance(v, ast.Name) and v.id in loop_vars:
+                    why = (f"label `{kw.arg}` is the enclosing loop's "
+                           f"variable `{v.id}`")
+                elif isinstance(v, ast.JoinedStr):
+                    for part in v.values:
+                        if not isinstance(part, ast.FormattedValue):
+                            continue
+                        e = part.value
+                        pid = _gl112_ident(e)
+                        if pid is not None:
+                            why = (f"label `{kw.arg}` interpolates "
+                                   f"per-request identity `{pid}`")
+                            break
+                        if isinstance(e, ast.Name) and e.id in loop_vars:
+                            why = (f"label `{kw.arg}` interpolates the "
+                                   f"enclosing loop's variable `{e.id}` "
+                                   "unbucketed")
+                            break
+            if why:
+                yield ctx.finding("GL112", node, why + ": "
+                                  + _GL112_MSG), node

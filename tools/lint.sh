@@ -21,6 +21,12 @@ if [ "${LINT_SKIP_SERVE:-0}" != "1" ]; then
   # declared objectives, zero burn-rate breaches, monitor neutrality
   python tools/serve_monitor.py --check tools/serve_slo.json \
     --no-flight-recorder
+  # chaos gate: injected alloc outages / dispatch stalls / dump-write
+  # failures / mid-stream cancels + priority preemption — the engine
+  # must degrade per-request (never crash), survivors and preempted-
+  # and-resumed requests stay token-exact, KV/refcount gauges return
+  # to baseline, 0 new compile buckets after warmup
+  python tools/serve_chaos.py --check tools/serve_chaos.json
   # train_obs gate: per-program cost/memory attribution (FLOPs, bytes,
   # peak HBM, MFU for the paged step / rewind / COW copy / pretrain
   # step), token-exact-neutral telemetry, census leak check — "MFU is

@@ -19,9 +19,12 @@ Two jobs:
   SLO engine (burn-rate breach -> counter + ``validate_report`` schema
   + ``slo_burn_rate`` dump), the cost catalog (record -> program_*
   gauge sections -> derived intensity/MFU/roofline against a synthetic
-  dispatch histogram), and the memory layer (synthetic census ->
+  dispatch histogram), the memory layer (synthetic census ->
   live_array gauges; MemoryMonitor headroom breach -> ``hbm_pressure``
-  dump schema), and exits non-zero on any violation.
+  dump schema), and the resilience telemetry (preemption/cancel/shed
+  counter families; ``preemption`` and ``operator_abort`` dump schemas
+  with their request_summary digests), and exits non-zero on any
+  violation.
   Wired into tools/lint.sh so the tier-0 gate
   (tests/test_graftlint_gate.py) catches a broken metrics/tracing/SLO
   subsystem before any test imports jax.
@@ -449,6 +452,66 @@ def selfcheck():
               "hbm gauges wrong after pressure update")
     finally:
         shutil.rmtree(d6, ignore_errors=True)
+
+    # resilience telemetry (ISSUE 11): the preemption/cancel/shed
+    # counter families, and the `preemption` / `operator_abort` dump
+    # schemas with their request_summary digests — all stdlib-only
+    reg7 = obs.MetricsRegistry()
+    pre = reg7.counter("serve_preemptions_total", labels=("reason",))
+    pre.labels(reason="kv_alloc").inc()
+    pre.labels(reason="admission").inc(2)
+    reg7.counter("serve_requests_cancelled_total").inc()
+    reg7.counter("serve_requests_shed_total",
+                 labels=("reason",)).labels(reason="slo_burn").inc()
+    reg7.counter("serve_requests_failed_total",
+                 labels=("reason",)).labels(
+                     reason="kv_alloc_failure").inc()
+    snap7 = reg7.snapshot()
+    ch = snap7["serve_preemptions_total"]["children"]
+    check(sum(c["value"] for c in ch.values()) == 3 and len(ch) == 2,
+          f"preemption counter children wrong: {ch}")
+    prom7 = obs.to_prometheus(reg7)
+    check('serve_preemptions_total{reason="admission"} 2' in prom7,
+          "preemption counter missing from exposition")
+    ring7 = obs.tracing.SpanRecorder()
+    ring7.event("submit", request="pr1", prompt_tokens=8, priority=2)
+    ring7.event("preempt", request="pr1", reason="admission",
+                priority=2, generated=3, blocks_freed=2)
+    ring7.event("resume", request="pr1", generated=3, preemptions=1)
+    ring7.event("retire", request="pr1", status="finished", generated=6,
+                spec_drafted=0, spec_accepted=0)
+    ring7.event("cancel", request="pr2", status="cancelled", generated=1)
+    digest = obs.tracing.request_summary("pr1", recorder=ring7)
+    check(digest["preemptions"] == 1 and digest["status"] == "finished"
+          and digest["retired"],
+          f"preempt/resume digest wrong: {digest}")
+    digest2 = obs.tracing.request_summary("pr2", recorder=ring7)
+    check(digest2["status"] == "cancelled" and not digest2["retired"],
+          f"cancel digest wrong: {digest2}")
+    fr7 = obs.tracing.FlightRecorder(recorder=ring7, min_interval_s=0.0)
+    d7 = tempfile.mkdtemp(prefix="sc_resil_")
+    try:
+        fr7.arm(d7, window_s=60.0)
+        p = fr7.trigger("preemption", request="pr1",
+                        preempt_reason="kv_alloc", step=7,
+                        blocks_freed=2, generated=3)
+        dump = obs.tracing.load_dump(p)
+        check(dump["reason"] == "preemption"
+              and dump["context"].get("preempt_reason") == "kv_alloc"
+              and dump["context"].get("blocks_freed") == 2
+              and "pr1" in dump["requests"],
+              f"preemption dump context wrong: {dump['context']}")
+        check(any(s["name"] == "preempt" for s in dump["spans"]),
+              "preemption dump lost the preempt event")
+        p2 = fr7.trigger("operator_abort", signal="KeyboardInterrupt",
+                         step=9)
+        dump2 = obs.tracing.load_dump(p2)
+        check(dump2["reason"] == "operator_abort"
+              and dump2["context"].get("signal") == "KeyboardInterrupt"
+              and isinstance(dump2["metrics"], dict),
+              f"operator_abort dump wrong: {dump2['context']}")
+    finally:
+        shutil.rmtree(d7, ignore_errors=True)
     return failures
 
 

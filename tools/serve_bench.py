@@ -1055,4 +1055,8 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    # operator abort mid-leg writes the operator_abort flight dump
+    # (span window + full metrics snapshot) before exiting, so an
+    # interrupted bench still ships the evidence it gathered
+    from paddle_tpu.observability import tracing
+    sys.exit(tracing.run_with_abort_evidence(main))

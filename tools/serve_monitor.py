@@ -414,4 +414,8 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    # operator abort mid-run writes the operator_abort flight dump
+    # (span window + full metrics snapshot) before exiting — a monitor
+    # killed mid-incident must not take its evidence along
+    from paddle_tpu.observability import tracing
+    sys.exit(tracing.run_with_abort_evidence(main))
