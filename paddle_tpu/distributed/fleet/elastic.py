@@ -11,6 +11,8 @@ import json
 import threading
 import time
 
+from ...observability import tracing as _tracing
+
 
 class ElasticStatus:
     COMPLETED = "completed"
@@ -46,7 +48,12 @@ class ElasticManager:
         while not self._stop.wait(self.heartbeat_s):
             try:
                 self.master.heartbeat(self.rank)
-            except Exception:
+            except Exception as e:
+                # a dead heartbeat thread makes PEERS declare this node
+                # gone: leave evidence on the local timeline instead of
+                # dying silently (GL113 discipline)
+                _tracing.get_tracer().event(
+                    "heartbeat_failed", status="failed", reason=str(e))
                 return
 
     def _watch_loop(self):
@@ -58,7 +65,12 @@ class ElasticManager:
                     continue
                 try:
                     alive = self.master.peer_alive(r, self.ttl_s)
-                except Exception:
+                except Exception as e:
+                    # the watcher dying silently means dead peers are
+                    # never detected again — record the terminal cause
+                    _tracing.get_tracer().event(
+                        "peer_watch_failed", status="failed",
+                        reason=str(e))
                     return
                 with self._lock:
                     if not alive:
@@ -156,7 +168,9 @@ class ElasticClusterManager:
         while not self._stop.wait(self.heartbeat_s):
             try:
                 self._beat()
-            except Exception:
+            except Exception as e:
+                _tracing.get_tracer().event(
+                    "heartbeat_failed", status="failed", reason=str(e))
                 return
 
     def withdraw(self):

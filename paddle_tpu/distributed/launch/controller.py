@@ -12,6 +12,7 @@ import threading
 import time
 
 from .master import Master, free_port
+from ...observability import tracing as _tracing
 
 
 class Controller:
@@ -95,7 +96,11 @@ class Controller:
         while not self._hb_stop.wait(self.args.heartbeat_s):
             try:
                 self.master.heartbeat(rank)
-            except Exception:
+            except Exception as e:
+                # dying silently here makes the master expire this rank
+                # with zero local evidence — record the cause first
+                _tracing.get_tracer().event(
+                    "heartbeat_failed", status="failed", reason=str(e))
                 return
 
     def run(self):

@@ -44,7 +44,7 @@ def _serve_conn(conn):
             try:
                 result = fn(*args, **kwargs)
                 conn.send(("ok", result))
-            except Exception as e:  # propagate remote exceptions
+            except Exception as e:  # graftlint: disable=GL113 - the exception IS the response: it is pickled back to the rpc caller, who re-raises it
                 conn.send(("err", e))
     finally:
         conn.close()

@@ -627,6 +627,21 @@ class ContinuousBatchingEngine:
             raise ValueError("shed_priority_min must be >= 0")
         self._submit_counter = 0
         self._admit_counter = 0
+        # streaming fanout (ISSUE 12, the serving gateway's engine-side
+        # half): host-side emission hooks, fired on the stepper thread.
+        # `on_token(request_id, tokens, step)` fires for every committed
+        # emission — the first token a finished prefill samples, and each
+        # verified decode span (token + accepted drafts) — AFTER the
+        # accept/rewind settled, so a hooked consumer never sees a token
+        # the engine later takes back. `on_terminal(request_id, result)`
+        # fires exactly once per request, whenever a RequestResult lands
+        # in `finished` (finish/cancel/deadline/failure/shed/reject).
+        # Pure host callbacks on host data: token-exact-neutral with
+        # zero effect on the compile-bucket keyspace by construction.
+        # Hooks must not raise — an exception propagates into step() (or
+        # submit()) like any scheduler bug would.
+        self.on_token = None
+        self.on_terminal = None
         kvh = self.caches[0].shape[1]
         num_q = engine.num_heads
         self._pack = default_pack(self.max_batch, num_q // kvh)
@@ -663,11 +678,13 @@ class ContinuousBatchingEngine:
         if reason is not None:
             request.status = "rejected"
             request.status_reason = reason
-            self.finished[rid] = RequestResult(
-                (), status="rejected", reason=reason)
+            res = RequestResult((), status="rejected", reason=reason)
+            self.finished[rid] = res
             _metrics.serve_rejected().labels(reason=reason).inc()
             _tracing.get_tracer().event(
                 "reject", request=rid, status="rejected", reason=reason)
+            if self.on_terminal is not None:
+                self.on_terminal(rid, res)
             return "rejected"
         request.submit_time = time.monotonic()
         request._submit_pc = time.perf_counter()
@@ -741,15 +758,18 @@ class ContinuousBatchingEngine:
         self.lens[i] = 0
         req.status = status
         req.status_reason = reason
-        self.finished[req.request_id] = RequestResult(
+        res = RequestResult(
             req.generated, status=status, reason=reason,
             preemptions=req.preemptions)
+        self.finished[req.request_id] = res
         self._ids.discard(req.request_id)
         _tracing.get_tracer().event(
             "retire", request=req.request_id, status=status,
             generated=len(req.generated),
             spec_drafted=req.spec_drafted,
             spec_accepted=req.spec_accepted)
+        if self.on_terminal is not None:
+            self.on_terminal(req.request_id, res)
 
     def _terminal_queued(self, req, status, reason=None):
         """Terminal record for a request that never (re)entered a slot
@@ -758,11 +778,14 @@ class ContinuousBatchingEngine:
         left its slot), so this is pure bookkeeping."""
         req.status = status
         req.status_reason = reason
-        self.finished[req.request_id] = RequestResult(
+        res = RequestResult(
             req.generated, status=status, reason=reason,
             preemptions=req.preemptions)
+        self.finished[req.request_id] = res
         self._ids.discard(req.request_id)
         _metrics.serve_queue_depth().set(len(self.queue))
+        if self.on_terminal is not None:
+            self.on_terminal(req.request_id, res)
 
     def _retire(self):
         retired = 0
@@ -1685,6 +1708,8 @@ class ContinuousBatchingEngine:
         elif req._last_token_time is not None:
             _metrics.serve_tpot().observe(now - req._last_token_time)
         req._last_token_time = now
+        if self.on_token is not None:
+            self.on_token(req.request_id, [int(tok)], self._step_count)
 
     def _append_span(self, req, toks, now):
         """Record a verified decode span (the mandatory token + accepted
@@ -1709,6 +1734,9 @@ class ContinuousBatchingEngine:
             _metrics.serve_tpot().observe(interval / len(toks))
             self._tpot_window.append(interval)
         req._last_token_time = now
+        if self.on_token is not None:
+            self.on_token(req.request_id, [int(t) for t in toks],
+                          self._step_count)
 
     def declare_warm(self):
         """Mark the compile-bucket warmup phase over: from here on, any

@@ -160,7 +160,7 @@ def _worker_loop(dataset, index_queue, data_queue, collate, init_fn, wid,
             elif use_shm:
                 batch = _to_shm(batch)
             data_queue.put((seq, batch, None))
-        except Exception as e:
+        except Exception as e:  # graftlint: disable=GL113 - the exception rides the resync stub to the consumer, which re-raises it
             # resync stub: the consumer drains exactly the records this
             # batch managed to push before failing (keeps the per-worker
             # FIFO aligned for persistent pools)
@@ -286,7 +286,7 @@ class DataLoader:
                     try:
                         r.close()
                         r.free()
-                    except Exception:
+                    except Exception:  # graftlint: disable=GL113 - best-effort shm cleanup on an already-failing path; the OUTER handler records the fallback
                         pass
                 self._rings = None
         procs = [ctx.Process(
@@ -378,7 +378,7 @@ class DataLoader:
                     try:
                         sseq, stale, _err = self._queue_get(data_queue,
                                                             procs)
-                    except Exception:
+                    except Exception:  # graftlint: disable=GL113 - bounded abandoned-epoch drain: break exits, a dead worker just ends the drain early
                         break
                     received += 1
                     if stale is not None and self.use_shared_memory:
@@ -392,7 +392,7 @@ class DataLoader:
                                 _from_shm(stale)
                             else:
                                 _from_shm(stale)  # attach + unlink
-                        except Exception:
+                        except Exception:  # graftlint: disable=GL113 - best-effort shm unlink of ABANDONED results during teardown; nothing downstream consumes them
                             pass
 
     @staticmethod
@@ -426,7 +426,7 @@ class DataLoader:
         for _ in range(int(stub[1])):
             try:
                 ring.pop(timeout_ms=1000)
-            except Exception:
+            except Exception:  # graftlint: disable=GL113 - bounded orphan drain: break exits; the worker's error already rode the resync stub
                 break
 
     def _free_rings(self):

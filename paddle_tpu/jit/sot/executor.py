@@ -996,7 +996,7 @@ class Executor:
                 jump = handler(ins, mode)
             except NoReplay:
                 raise
-            except Exception as e:
+            except Exception as e:  # graftlint: disable=GL113 - this IS CPython's exception semantics: the table routes covered offsets to their handler, uncovered ones re-raise out of the frame
                 # consult the exception table: a covered offset jumps to
                 # its handler with the stack trimmed (3.12 semantics);
                 # an uncovered offset propagates out of the frame

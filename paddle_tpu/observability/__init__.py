@@ -48,7 +48,8 @@ Quick tour::
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       DEFAULT_LATENCY_BUCKETS, exponential_buckets,
                       get_registry)
-from .exporters import chrome_counter_events, to_json, to_prometheus
+from .exporters import (chrome_counter_events, parse_prometheus, to_json,
+                        to_prometheus)
 from .compile_watch import install as install_compile_watch
 from .compile_watch import installed as compile_watch_installed
 from .instrument import watch_ops
@@ -59,8 +60,8 @@ from .instrument import watch_ops
 # binds the `tracing` attribute on this package.
 from .tracing import (SpanRecorder, FlightRecorder, get_tracer,
                       get_flight_recorder, chrome_span_events,
-                      request_summary, load_dump, write_dump,
-                      arm_default, load_manifest)
+                      request_summary, requests_seen, load_dump,
+                      write_dump, arm_default, load_manifest)
 from .timeseries import TimeSeries
 from .slo import (Objective, SLOEngine, SLOMonitor, validate_report,
                   json_safe, DEFAULT_WINDOWS)
@@ -74,10 +75,12 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_LATENCY_BUCKETS", "exponential_buckets", "get_registry",
     "to_prometheus", "to_json", "chrome_counter_events",
+    "parse_prometheus",
     "install_compile_watch", "compile_watch_installed", "watch_ops",
     "tracing", "SpanRecorder", "FlightRecorder", "get_tracer",
     "get_flight_recorder", "chrome_span_events", "request_summary",
-    "load_dump", "write_dump", "arm_default", "load_manifest",
+    "requests_seen", "load_dump", "write_dump", "arm_default",
+    "load_manifest",
     "timeseries", "TimeSeries", "slo", "Objective", "SLOEngine",
     "SLOMonitor", "validate_report", "json_safe", "DEFAULT_WINDOWS",
     "costs", "CostCatalog", "get_cost_catalog", "peak_flops",

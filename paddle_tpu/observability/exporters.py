@@ -17,11 +17,13 @@ stdlib only, same reason as metrics.py.
 """
 import json
 import math
+import re
 import time
 
 from .metrics import get_registry
 
-__all__ = ["to_prometheus", "to_json", "chrome_counter_events"]
+__all__ = ["to_prometheus", "to_json", "chrome_counter_events",
+           "parse_prometheus"]
 
 
 def _esc_label(v):
@@ -109,6 +111,89 @@ def to_json(registry=None, indent=None):
     return json.dumps({"time": time.time(),
                        "metrics": registry.snapshot()},
                       indent=indent, sort_keys=True)
+
+
+_SAMPLE_RE = re.compile(
+    r'^([A-Za-z_:][A-Za-z0-9_:]*)(\{(.*)\})?\s+(\S+)\s*$')
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:\\.|[^"\\])*)"')
+
+
+_UNESC_RE = re.compile(r"\\(.)")
+
+
+def _unesc_label(v):
+    # ONE left-to-right pass: sequential .replace() calls corrupt a
+    # literal backslash-then-n ('\\' + 'n' escapes to '\\\\n', which a
+    # naive '\\n'-first pass turns into backslash + real newline).
+    # Unknown escapes keep their backslash, like Prometheus' parser.
+    return _UNESC_RE.sub(
+        lambda m: {"n": "\n", '"': '"', "\\": "\\"}.get(
+            m.group(1), "\\" + m.group(1)), v)
+
+
+def _parse_value(v):
+    if v == "+Inf":
+        return math.inf
+    if v == "-Inf":
+        return -math.inf
+    if v == "NaN":
+        return math.nan
+    return float(v)
+
+
+def parse_prometheus(text):
+    """Parse text exposition 0.0.4 back into
+    ``{family: {"kind": str|None, "help": str|None,
+    "samples": [(name, {label: value}, float), ...]}}``.
+
+    The inverse of :func:`to_prometheus`, close enough for a scraper:
+    histogram series land under their family name (``x_bucket`` /
+    ``x_sum`` / ``x_count`` grouped under ``x`` once a ``# TYPE x
+    histogram`` header announced it; standalone they are their own
+    family). This is what ``tools/serve_monitor.py --scrape`` renders a
+    dashboard from and the gateway gate validates /metrics with —
+    stdlib-only, same contract as the rest of the module."""
+    out = {}
+    histograms = set()
+
+    def fam(name):
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) \
+                    and base[:-len(suffix)] in histograms:
+                base = base[:-len(suffix)]
+                break
+        return out.setdefault(base, {"kind": None, "help": None,
+                                     "samples": []})
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            out.setdefault(name, {"kind": None, "help": None,
+                                  "samples": []})["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            out.setdefault(name, {"kind": None, "help": None,
+                                  "samples": []})["kind"] = kind.strip()
+            if kind.strip() == "histogram":
+                histograms.add(name)
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        name, _, labelstr, value = m.groups()
+        labels = {k: _unesc_label(v)
+                  for k, v in _LABEL_RE.findall(labelstr or "")}
+        fam(name)["samples"].append((name, labels, _parse_value(value)))
+    return out
 
 
 def chrome_counter_events(registry=None, pid=None, since_us=None,

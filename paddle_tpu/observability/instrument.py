@@ -27,6 +27,10 @@ __all__ = [
     "prefix_cache_cow", "kv_blocks_shared", "kv_blocks_prefix_resident",
     "serve_preemptions", "serve_cancelled", "serve_shed",
     "serve_deadline_exceeded", "serve_failed", "serve_rejected",
+    "gateway_request_seconds", "gateway_stream_seconds",
+    "gateway_responses", "gateway_live_connections",
+    "gateway_live_streams", "gateway_sse_pending_events",
+    "gateway_sse_events", "gateway_health_transitions",
     "train_step_seconds", "train_tokens_total", "train_steps_total",
     "train_tokens_per_s",
 ]
@@ -211,6 +215,67 @@ def serve_rejected():
         help="requests rejected at submit() for unsupported config "
              "combos (structured, instead of a mid-step raise)",
         labels=("reason",))
+
+
+# -- serving gateway (HTTP/SSE front door) -------------------------------
+# every label value below comes from a small FIXED set the gateway
+# spells as literals (route names, SSE event types, health states, HTTP
+# codes the gateway itself emits) — the GL112 bounded-cardinality
+# contract; per-request identity lives in spans, never in labels
+
+def gateway_request_seconds():
+    return get_registry().histogram(
+        "gateway_request_seconds",
+        help="HTTP request handling wall time (headers-in to "
+             "response-flushed; SSE streams count separately)",
+        labels=("route",))
+
+
+def gateway_stream_seconds():
+    return get_registry().histogram(
+        "gateway_stream_seconds",
+        help="SSE stream lifetime: headers sent -> terminal event "
+             "flushed (or client gone)")
+
+
+def gateway_responses():
+    return get_registry().counter(
+        "gateway_responses_total",
+        help="HTTP responses by route and status code (codes are the "
+             "gateway's own fixed set)", labels=("route", "code"))
+
+
+def gateway_live_connections():
+    return get_registry().gauge(
+        "gateway_live_connections",
+        help="TCP connections currently open against the gateway")
+
+
+def gateway_live_streams():
+    return get_registry().gauge(
+        "gateway_live_streams",
+        help="SSE token streams currently open")
+
+
+def gateway_sse_pending_events():
+    return get_registry().gauge(
+        "gateway_sse_pending_events",
+        help="SSE events queued for delivery but not yet written — "
+             "sustained growth means a slow client (backpressure)")
+
+
+def gateway_sse_events():
+    return get_registry().counter(
+        "gateway_sse_events_total",
+        help="SSE events written, by event type (fixed set: "
+             "accepted/token/end)", labels=("event",))
+
+
+def gateway_health_transitions():
+    return get_registry().counter(
+        "gateway_health_transitions_total",
+        help="/healthz state changes (ok <-> degraded)",
+        labels=("to",))
 
 
 # -- speculative decode (prompt-lookup drafts + budgeted verify) ---------

@@ -357,3 +357,12 @@ class SLOMonitor:
         self._last = now
         self.timeseries.sample(now)
         return self.engine.evaluate(now)
+
+    def report(self, now=None):
+        """The /slo endpoint's view: the last cadence evaluation when
+        one exists, else a fresh forced one — a gateway scraped before
+        the first cadence tick still answers with a schema-valid
+        report instead of null. Call on the thread that owns tick()
+        (the gateway routes it through the stepper)."""
+        rep = self.engine.last_report
+        return rep if rep is not None else self.force(now)

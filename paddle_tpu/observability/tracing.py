@@ -50,10 +50,10 @@ from .metrics import _host_float, get_registry
 
 __all__ = [
     "SpanRecorder", "FlightRecorder", "get_tracer", "get_flight_recorder",
-    "span", "event", "chrome_span_events", "request_summary", "load_dump",
-    "write_dump", "arm_default", "load_manifest", "operator_abort_dump",
-    "run_with_abort_evidence", "DUMP_SCHEMA", "MANIFEST_SCHEMA",
-    "MANIFEST_NAME",
+    "span", "event", "chrome_span_events", "request_summary",
+    "requests_seen", "load_dump", "write_dump", "arm_default",
+    "load_manifest", "operator_abort_dump", "run_with_abort_evidence",
+    "DUMP_SCHEMA", "MANIFEST_SCHEMA", "MANIFEST_NAME",
 ]
 
 DUMP_SCHEMA = "paddle_tpu.flight_recorder/1"
@@ -222,6 +222,24 @@ def chrome_span_events(recorder=None, pid=None, since_us=None,
 
 
 # -- per-request summary ---------------------------------------------------
+
+def requests_seen(recorder=None, limit=None):
+    """Distinct request ids in the span ring, oldest-first (the
+    gateway's /requests listing: the ring is the one place every
+    request's lifecycle already lands, live and retired alike, so the
+    control plane needs no second registry). `limit` keeps the NEWEST
+    n ids."""
+    rec = recorder if recorder is not None else get_tracer()
+    seen = {}
+    for s in rec.spans():
+        r = s["request"]
+        if r is not None and r not in seen:
+            seen[r] = True
+    ids = list(seen)
+    if limit is not None and len(ids) > limit:
+        ids = ids[-int(limit):]
+    return ids
+
 
 def request_summary(request, spans=None, recorder=None):
     """`request.explain()`-style digest of one request's lifecycle from

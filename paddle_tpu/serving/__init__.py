@@ -1,0 +1,43 @@
+"""paddle_tpu.serving — the async HTTP/SSE front door (ISSUE 12).
+
+The production gateway over the continuous-batching engine: a
+dedicated stepper thread owns the engine (stepper.py), an asyncio
+HTTP/1.1 server streams per-token SSE and serves the observability
+control plane (gateway.py — /v1/generate, /v1/requests/{id},
+/metrics, /slo, /requests, /dumps, /healthz), and sse.py is the
+framing both sides (and the gate's client) share.
+
+Contract: stdlib-only at import time, same as paddle_tpu.observability
+— jax and numpy are touched lazily at request time — so
+``tools/metrics_snapshot.py --selfcheck`` validates the gateway's
+schemas and metric families in a bare container, and a monitoring
+sidecar can import the SSE parser without an accelerator stack.
+
+Quick tour::
+
+    from paddle_tpu import serving
+
+    stepper = serving.EngineStepper(cb).start()   # cb: the engine
+    gw = serving.ServingGateway(stepper, monitor=mon, port=8000)
+    # ... await gw.start(); await gw.serve_forever()
+    # or, blocking: serving.run_gateway(cb, port=8000, monitor=mon)
+
+Entrypoint: ``python examples/serve_gateway.py`` (arm-by-default
+flight recorder + operator-abort evidence, like every serve tool).
+Gate: ``tools/serve_gateway.py --check tools/serve_gateway.json`` in
+``tools/lint.sh``.
+"""
+from .sse import format_event, iter_events, parse_events
+from .stepper import EngineStepper
+from .gateway import (ServingGateway, run_gateway,
+                      validate_generate_body, validate_healthz,
+                      HEALTHZ_SCHEMA, REQUESTS_SCHEMA, DUMPS_SCHEMA,
+                      STATUS_HTTP)
+
+__all__ = [
+    "format_event", "iter_events", "parse_events",
+    "EngineStepper", "ServingGateway", "run_gateway",
+    "validate_generate_body", "validate_healthz",
+    "HEALTHZ_SCHEMA", "REQUESTS_SCHEMA", "DUMPS_SCHEMA", "STATUS_HTTP",
+    "sse", "stepper", "gateway",
+]

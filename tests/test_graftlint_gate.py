@@ -50,9 +50,12 @@ def test_graftlint_imports():
     # (GL111, time.time() differences as durations — NTP-step hazard);
     # the resilience PR's rule: unbounded metric label cardinality
     # (GL112, .labels() fed from loop variables / request identity —
-    # one child series per distinct value, forever)
+    # one child series per distinct value, forever); the gateway PR's
+    # rule: swallowed cancellation (GL113, a broad except in a
+    # serve/step/stream loop that neither re-raises nor records a
+    # structured terminal status — an infinite retry with no evidence)
     assert {"GL104", "GL105", "GL107", "GL108", "GL110",
-            "GL111", "GL112"} <= set(gl.RULES), sorted(gl.RULES)
+            "GL111", "GL112", "GL113"} <= set(gl.RULES), sorted(gl.RULES)
 
 
 def test_tree_is_clean():

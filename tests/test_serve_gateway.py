@@ -1,0 +1,602 @@
+"""Serving gateway (ISSUE 12): the HTTP/SSE front door's streaming
+contract, resilience surface, and observability control plane.
+
+The contract under test: what a client receives over the wire is
+EXACTLY what the engine computes — streamed tokens byte-identical to
+``engine.generate()``, SSE event order matching the span ring, typed
+terminal events (cancel/deadline/shed/reject/failed) with the mapped
+HTTP codes, mid-stream cancellation reclaiming KV to baseline, and
+/healthz degrading on the same pressure signals the scheduler's
+admission gate reads. Faults ride the PR-11 harness
+(paddle_tpu/testing/faults.py); the real-TCP gate twin is
+tools/serve_gateway.py --check.
+"""
+import asyncio
+import http.client
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import serving
+from paddle_tpu.incubate.nn import ContinuousBatchingEngine
+from paddle_tpu.observability import (parse_prometheus, tracing,
+                                      validate_report)
+from paddle_tpu.serving import validate_generate_body, validate_healthz
+from paddle_tpu.testing import FaultInjector
+
+
+def _cached_engine(seed=0):
+    # the CACHED serving engine (identical weights/config per seed):
+    # one compile bill for every serving test file in the tier-1 window
+    from test_chunked_prefill import _tiny_engine as _cached
+    return _cached(seed=seed, max_seq_len=64)
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    old = fa._INTERPRET
+    fa._INTERPRET = True
+    yield
+    fa._INTERPRET = old
+
+
+def _prompt(rng, v, n):
+    return rng.integers(1, v, n).astype(np.int32)
+
+
+def _ref(eng, prompt, n):
+    return eng.generate(np.asarray(prompt, np.int32)[None, :],
+                        max_new_tokens=n)[0, :n].tolist()
+
+
+class FlagMonitor:
+    """Deterministic stand-in for the SLO monitor: /healthz and the
+    shed gate both read last_report['breaches'] — same surface as
+    SLOMonitor, wall clock replaced by a test-owned flag."""
+
+    def __init__(self):
+        self.burn = False
+
+    @property
+    def last_report(self):
+        return {"breaches": 1 if self.burn else 0}
+
+    def tick(self, now=None):
+        return None
+
+
+class Harness:
+    """A live gateway on 127.0.0.1: the asyncio loop runs in a
+    background thread (the stepper has its own), tests speak real HTTP
+    over http.client, synchronously."""
+
+    def __init__(self, cb, monitor=None, memory_watch=None):
+        self.cb = cb
+        self.stepper = serving.EngineStepper(cb).start()
+        self.gw = serving.ServingGateway(
+            self.stepper, monitor=monitor, memory_watch=memory_watch)
+        self.loop = asyncio.new_event_loop()
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._ready.wait(30), "gateway failed to start"
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_until_complete(self.gw.start())
+        self._ready.set()
+        self.loop.run_forever()
+
+    def close(self):
+        asyncio.run_coroutine_threadsafe(
+            self.gw.close(), self.loop).result(10)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(10)
+        self.stepper.stop()
+
+    def engine_call(self, fn):
+        return self.stepper.call(fn).result(30)
+
+    # -- sync HTTP client --------------------------------------------------
+    def request(self, method, path, body=None):
+        conn = http.client.HTTPConnection("127.0.0.1", self.gw.port,
+                                          timeout=60)
+        payload = None if body is None else json.dumps(body)
+        headers = {"Content-Type": "application/json"} if payload else {}
+        conn.request(method, path, body=payload, headers=headers)
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        return resp.status, data
+
+    def get_json(self, path):
+        code, data = self.request("GET", path)
+        return code, json.loads(data)
+
+    def post_json(self, body):
+        code, data = self.request("POST", "/v1/generate", body)
+        return code, json.loads(data)
+
+    def stream(self, body, on_token=None):
+        """POST a streaming generate, return (status, events). The SSE
+        frames are parsed incrementally; `on_token(n_events, payload)`
+        fires per token event (mid-stream cancel hooks in here)."""
+        conn = http.client.HTTPConnection("127.0.0.1", self.gw.port,
+                                          timeout=120)
+        conn.request("POST", "/v1/generate", body=json.dumps(body),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            data = json.loads(resp.read())
+            conn.close()
+            return resp.status, [("error", data)]
+        events, etype, data, ntok = [], None, [], 0
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.decode().rstrip("\r\n")
+            if line == "":
+                if data:
+                    ev = (etype or "message",
+                          json.loads("\n".join(data)))
+                    events.append(ev)
+                    if ev[0] == "token":
+                        ntok += 1
+                        if on_token is not None:
+                            on_token(ntok, ev[1])
+                    if ev[0] == "end":
+                        break
+                etype, data = None, []
+                continue
+            field, _, value = line.partition(":")
+            value = value[1:] if value.startswith(" ") else value
+            if field == "event":
+                etype = value
+            elif field == "data":
+                data.append(value)
+        conn.close()
+        return 200, events
+
+
+def _tokens(events):
+    return [t for e, p in events if e == "token" for t in p["tokens"]]
+
+
+def _end(events):
+    ends = [p for e, p in events if e == "end"]
+    return ends[0] if ends else None
+
+
+def _leak_free(cb):
+    a = cb.allocator
+    return (a.num_used == 0 and not a._ref
+            and a.num_free + a.num_pooled == a.num_blocks - a.reserved)
+
+
+@pytest.fixture(scope="module")
+def eng():
+    engine, _v = _cached_engine()
+    return engine
+
+
+@pytest.fixture(scope="module")
+def gw(eng):
+    cb = ContinuousBatchingEngine(eng, num_blocks=40, block_size=8,
+                                  max_batch=4, prefill_chunk=8,
+                                  spec_k=2)
+    h = Harness(cb)
+    yield h
+    h.close()
+
+
+@pytest.fixture(scope="module")
+def rngv(eng):
+    return np.random.default_rng(7), 128     # V of the tiny engine
+
+
+# -- pure units (no server) -------------------------------------------------
+
+class TestValidation:
+    def test_generate_body_happy(self):
+        spec, err = validate_generate_body(
+            {"prompt": [1, 2, 3], "max_new_tokens": 4, "priority": 1,
+             "spec_k": 0, "stream": False})
+        assert err is None
+        assert spec["prompt"] == [1, 2, 3] and spec["stream"] is False
+
+    @pytest.mark.parametrize("bad", [
+        {"max_new_tokens": 4},
+        {"prompt": [], "max_new_tokens": 4},
+        {"prompt": [1, "x"], "max_new_tokens": 4},
+        {"prompt": [1], "max_new_tokens": 0},
+        {"prompt": [1], "max_new_tokens": 2, "priority": -1},
+        {"prompt": [1], "max_new_tokens": 2, "deadline_steps": 0},
+        {"prompt": [1], "max_new_tokens": 2, "deadline_s": 0},
+        {"prompt": [1], "max_new_tokens": 2, "stream": 1},
+        {"prompt": [1], "max_new_tokens": 2, "nope": True},
+        [1, 2],
+    ])
+    def test_generate_body_rejects(self, bad):
+        spec, err = validate_generate_body(bad)
+        assert spec is None and isinstance(err, str)
+
+    def test_sse_roundtrip(self):
+        frames = (serving.format_event("token", {"tokens": [1]})
+                  + serving.format_event("end", {"status": "finished"}))
+        assert serving.parse_events(frames) == [
+            ("token", {"tokens": [1]}), ("end", {"status": "finished"})]
+
+    def test_healthz_schema(self):
+        good = {"schema": serving.HEALTHZ_SCHEMA, "status": "ok",
+                "reason": None, "inflight": 0, "queue_depth": 0,
+                "steps": 1, "finished": 0}
+        assert validate_healthz(good) is good
+        with pytest.raises(ValueError):
+            validate_healthz(dict(good, status="degraded", reason=None))
+        with pytest.raises(ValueError):
+            validate_healthz({"schema": "x"})
+
+
+# -- streaming contract -----------------------------------------------------
+
+class TestStreaming:
+    def test_stream_token_exact_vs_generate(self, gw, eng, rngv):
+        rng, v = rngv
+        p = _prompt(rng, v, 9)
+        ref = _ref(eng, p, 6)
+        code, events = gw.stream(
+            {"prompt": [int(t) for t in p], "max_new_tokens": 6,
+             "request_id": "tx1"})
+        assert code == 200
+        assert events[0][0] == "accepted"
+        assert events[0][1]["request"] == "tx1"
+        end = _end(events)
+        assert end["status"] == "finished" and end["reason"] is None
+        assert _tokens(events) == ref          # byte-identical stream
+        assert end["tokens"] == ref            # and the terminal recap
+        # indices contiguous, nothing after `end`
+        idx = [p["index"] for e, p in events if e == "token"]
+        assert idx == list(range(len(idx)))
+        assert events[-1][0] == "end"
+
+    def test_sse_order_matches_span_ring(self, gw, eng, rngv):
+        rng, v = rngv
+        p = _prompt(rng, v, 11)
+        code, events = gw.stream(
+            {"prompt": [int(t) for t in p], "max_new_tokens": 7,
+             "request_id": "tx2"})
+        assert code == 200
+        expected = []
+        for s in tracing.get_tracer().spans(request="tx2"):
+            a = s["args"] or {}
+            if s["name"] == "prefill_chunk" and a.get("progress") == 11:
+                expected.append(1)
+            elif s["name"] == "decode":
+                expected.append(a.get("emitted", 1))
+        got = [len(p["tokens"]) for e, p in events if e == "token"]
+        assert got == expected and sum(got) == 7
+
+    def test_nonstream_finished(self, gw, eng, rngv):
+        rng, v = rngv
+        p = _prompt(rng, v, 6)
+        ref = _ref(eng, p, 5)
+        code, resp = gw.post_json(
+            {"prompt": [int(t) for t in p], "max_new_tokens": 5,
+             "request_id": "tx3", "stream": False})
+        assert code == 200
+        assert resp["status"] == "finished" and resp["tokens"] == ref
+
+    def test_concurrent_interleaving_token_exact(self, gw, eng, rngv):
+        rng, v = rngv
+        prompts = [_prompt(rng, v, n) for n in (5, 12, 17)]
+        news = [6, 4, 7]
+        refs = [_ref(eng, p, n) for p, n in zip(prompts, news)]
+        results = [None] * 3
+
+        def drive(j):
+            results[j] = gw.stream(
+                {"prompt": [int(t) for t in prompts[j]],
+                 "max_new_tokens": news[j], "request_id": f"cc{j}"})
+
+        threads = [threading.Thread(target=drive, args=(j,))
+                   for j in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        for j in range(3):
+            code, events = results[j]
+            assert code == 200
+            assert _end(events)["status"] == "finished"
+            assert _tokens(events) == refs[j], f"stream {j} diverged"
+        assert gw.engine_call(_leak_free)
+
+
+# -- lifecycle control ------------------------------------------------------
+
+class TestLifecycle:
+    def test_cancel_mid_stream_frees_blocks(self, gw, eng, rngv):
+        rng, v = rngv
+        p = _prompt(rng, v, 9)
+        ref = _ref(eng, p, 30)
+        del_codes = []
+
+        def cancel_after_2(n, payload):
+            if n == 2:
+                code, _ = gw.request("DELETE", "/v1/requests/txc")
+                del_codes.append(code)
+
+        code, events = gw.stream(
+            {"prompt": [int(t) for t in p], "max_new_tokens": 30,
+             "request_id": "txc"}, on_token=cancel_after_2)
+        assert code == 200 and del_codes == [200]
+        end = _end(events)
+        assert end["status"] == "cancelled"
+        toks = _tokens(events)
+        assert len(toks) >= 2 and toks == ref[:len(toks)]
+        assert gw.engine_call(_leak_free)      # KV gauges at baseline
+
+    def test_cancel_unknown_is_404(self, gw):
+        code, resp = gw.get_json("/healthz")   # warm the connection path
+        code, _ = gw.request("DELETE", "/v1/requests/never-submitted")
+        assert code == 404
+
+    def test_deadline_stream_and_http_code(self, gw, eng, rngv):
+        rng, v = rngv
+        # 20-token prompt, chunk 8: cannot prefill inside 1 step, so
+        # the deadline retires it with a typed terminal event
+        p = _prompt(rng, v, 20)
+        code, events = gw.stream(
+            {"prompt": [int(t) for t in p], "max_new_tokens": 4,
+             "request_id": "txd1", "deadline_steps": 1})
+        assert code == 200
+        end = _end(events)
+        assert end["status"] == "deadline_exceeded"
+        code, resp = gw.post_json(
+            {"prompt": [int(t) for t in p], "max_new_tokens": 4,
+             "request_id": "txd2", "deadline_steps": 1,
+             "stream": False})
+        assert code == 504 and resp["status"] == "deadline_exceeded"
+        assert gw.engine_call(_leak_free)
+
+    def test_reject_structured_422(self, gw):
+        code, resp = gw.post_json(
+            {"prompt": [1, 2, 3], "max_new_tokens": 2,
+             "request_id": "txr", "spec_k": 99})
+        assert code == 422
+        assert resp["status"] == "rejected"
+        assert resp["reason"] == "spec_k_exceeds_engine"
+
+    def test_bad_body_is_400(self, gw):
+        code, resp = gw.post_json({"prompt": [1], "max_new_tokens": 0})
+        assert code == 400 and resp["error"] == "bad_request"
+        code, data = gw.request("POST", "/v1/generate", body=None)
+        assert code == 400
+
+    def test_oversized_body_is_413(self, gw):
+        import socket
+        s = socket.create_connection(("127.0.0.1", gw.gw.port),
+                                     timeout=30)
+        s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Length: 9000000\r\n\r\n")
+        head = s.recv(65536).decode()
+        s.close()
+        assert " 413 " in head.splitlines()[0]
+        assert "payload_too_large" in head
+
+    def test_client_disconnect_cancels_engine_side(self, gw, eng,
+                                                   rngv):
+        """A client that vanishes mid-stream must not leave the engine
+        generating into the void: the pump's abort handler cancels the
+        request, KV returns to baseline, and the backpressure gauge
+        drains back to zero."""
+        import socket
+        import time
+
+        rng, v = rngv
+        p = [int(t) for t in _prompt(rng, v, 7)]
+        body = json.dumps({"prompt": p, "max_new_tokens": 40,
+                           "request_id": "gone1"}).encode()
+        s = socket.create_connection(("127.0.0.1", gw.gw.port),
+                                     timeout=30)
+        s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Type: application/json\r\n"
+                  b"Content-Length: " + str(len(body)).encode()
+                  + b"\r\n\r\n" + body)
+        buf = b""
+        while b"event: token" not in buf:
+            buf += s.recv(4096)
+        s.close()           # vanish mid-stream, no DELETE
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            res = gw.engine_call(
+                lambda cb: cb.finished.get("gone1"))
+            if res is not None:
+                break
+            time.sleep(0.05)
+        assert res is not None, "engine-side request never terminated"
+        assert res.status == "cancelled"
+        assert len(res) < 40    # it did NOT run to completion
+        assert gw.engine_call(_leak_free)
+        # the abort drain returns the backpressure gauge to zero
+        from paddle_tpu.observability import instrument as inst
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if inst.gateway_sse_pending_events().labels().value == 0:
+                break
+            time.sleep(0.05)
+        assert inst.gateway_sse_pending_events().labels().value == 0
+
+    def test_duplicate_stream_id_is_409(self, gw, eng, rngv):
+        rng, v = rngv
+        p = [int(t) for t in _prompt(rng, v, 5)]
+        code, _ = gw.post_json({"prompt": p, "max_new_tokens": 2,
+                                "request_id": "dup1", "stream": False})
+        assert code == 200
+        # engine-side duplicate (already in finished) -> 409, not a
+        # silent overwrite
+        code, resp = gw.post_json({"prompt": p, "max_new_tokens": 2,
+                                   "request_id": "dup1",
+                                   "stream": False})
+        assert code == 409
+
+    def test_injected_alloc_outage_fails_per_request(self, gw, eng,
+                                                     rngv):
+        """The PR-11 fault harness through the front door: a sustained
+        alloc outage degrades the REQUEST (typed SSE terminal, reason
+        kv_alloc_failure), never the server."""
+        rng, v = rngv
+        p = _prompt(rng, v, 6)
+        inj = FaultInjector().fail_alloc(steps=range(0, 40))
+        with inj.attach(gw.cb):
+            code, events = gw.stream(
+                {"prompt": [int(t) for t in p], "max_new_tokens": 4,
+                 "request_id": "txf"})
+        assert code == 200
+        end = _end(events)
+        assert end["status"] == "failed"
+        assert end["reason"] == "kv_alloc_failure"
+        assert inj.injected["alloc"] >= 1
+        assert gw.engine_call(_leak_free)
+        # the server survived: the next request streams normally
+        ref = _ref(eng, p, 3)
+        code, events = gw.stream(
+            {"prompt": [int(t) for t in p], "max_new_tokens": 3,
+             "request_id": "txf2"})
+        assert _tokens(events) == ref
+
+
+# -- observability control plane -------------------------------------------
+
+class TestControlPlane:
+    def test_metrics_endpoint_parses(self, gw):
+        code, data = gw.request("GET", "/metrics")
+        assert code == 200
+        fams = parse_prometheus(data.decode())
+        for fam in ("gateway_responses_total", "gateway_request_seconds",
+                    "gateway_stream_seconds", "gateway_sse_events_total",
+                    "serve_ttft_seconds", "kv_blocks_free"):
+            assert fam in fams, f"{fam} missing from /metrics"
+        assert fams["gateway_request_seconds"]["kind"] == "histogram"
+
+    def test_healthz_ok_schema(self, gw):
+        code, hz = gw.get_json("/healthz")
+        assert code == 200
+        validate_healthz(hz)
+        assert hz["status"] == "ok" and hz["reason"] is None
+
+    def test_slo_404_without_monitor(self, gw):
+        code, resp = gw.get_json("/slo")
+        assert code == 404 and resp["error"] == "no_monitor"
+
+    def test_requests_digests(self, gw, eng, rngv):
+        rng, v = rngv
+        p = _prompt(rng, v, 5)
+        gw.post_json({"prompt": [int(t) for t in p],
+                      "max_new_tokens": 3, "request_id": "txq",
+                      "stream": False})
+        code, listing = gw.get_json("/requests")
+        assert code == 200 and listing["schema"] == serving.REQUESTS_SCHEMA
+        assert any(d["request"] == "txq" for d in listing["requests"])
+        code, digest = gw.get_json("/requests/txq")
+        assert code == 200
+        assert digest["request"] == "txq" and digest["retired"] is True
+        assert digest["generated_tokens"] == 3
+        code, _ = gw.get_json("/requests/none-such")
+        assert code == 404
+
+    def test_dumps_endpoints(self, gw, tmp_path):
+        fr = tracing.get_flight_recorder()
+        fr.arm(str(tmp_path))
+        try:
+            tracing.write_dump(
+                str(tmp_path / "flightrec_manual_gwtest_0.json"),
+                reason="manual")
+            code, dumps = gw.get_json("/dumps")
+            assert code == 200 and dumps["armed"] is True
+            assert dumps["schema"] == serving.DUMPS_SCHEMA
+            names = [e["file"] for e in dumps["retained"]]
+            assert "flightrec_manual_gwtest_0.json" in names
+            code, blob = gw.request(
+                "GET", "/dumps/flightrec_manual_gwtest_0.json")
+            assert code == 200
+            assert json.loads(blob)["schema"].startswith(
+                "paddle_tpu.flight_recorder/")
+            code, _ = gw.request("GET", "/dumps/../etc/passwd")
+            assert code == 404
+            code, _ = gw.request("GET", "/dumps/flightrec_none.json")
+            assert code == 404
+        finally:
+            fr.disarm()
+
+    def test_unknown_route_404(self, gw):
+        code, _ = gw.request("GET", "/no/such/route")
+        assert code == 404
+        code, _ = gw.request("PUT", "/v1/generate")
+        assert code == 405
+
+
+# -- pressure + compile-stability ------------------------------------------
+
+class TestPressureAndWarmth:
+    def test_healthz_flips_and_shed_under_breach(self, eng, rngv):
+        rng, v = rngv
+        mon = FlagMonitor()
+        cb = ContinuousBatchingEngine(
+            eng, num_blocks=40, block_size=8, max_batch=4,
+            prefill_chunk=8, monitor=mon, shed_on_pressure=True,
+            shed_priority_min=1)
+        h = Harness(cb, monitor=mon)
+        try:
+            code, hz = h.get_json("/healthz")
+            assert code == 200 and hz["status"] == "ok"
+            mon.burn = True
+            code, hz = h.get_json("/healthz")
+            assert code == 503
+            validate_healthz(hz)
+            assert hz["status"] == "degraded" and hz["reason"] == "slo_burn"
+            # a queued low-priority stream is shed as a typed terminal
+            p = [int(t) for t in _prompt(rng, v, 6)]
+            code, events = h.stream(
+                {"prompt": p, "max_new_tokens": 4, "request_id": "sh1",
+                 "priority": 2})
+            end = _end(events)
+            assert end["status"] == "shed" and end["reason"] == "slo_burn"
+            mon.burn = False
+            code, hz = h.get_json("/healthz")
+            assert code == 200 and hz["status"] == "ok"
+            # /slo reads the stub's last_report (no SLOMonitor.report)
+            code, rep = h.get_json("/slo")
+            assert code == 200 and rep["breaches"] == 0
+        finally:
+            h.close()
+
+    def test_healthz_degrades_on_hbm_pressure(self, eng):
+        class MemStub:
+            last_report = {"pressure": True, "headroom_frac": 0.01}
+
+        cb = ContinuousBatchingEngine(eng, num_blocks=12, block_size=8,
+                                      max_batch=2)
+        h = Harness(cb, memory_watch=MemStub())
+        try:
+            code, hz = h.get_json("/healthz")
+            assert code == 503 and hz["reason"] == "hbm_pressure"
+        finally:
+            h.close()
+
+    def test_zero_new_buckets_after_warmup(self, gw, eng, rngv):
+        rng, v = rngv
+        p = [int(t) for t in _prompt(rng, v, 13)]
+        body = {"prompt": p, "max_new_tokens": 5, "request_id": "wa"}
+        code, events = gw.stream(body)
+        ref = _tokens(events)
+        gw.engine_call(lambda cb: cb.declare_warm())
+        warm = gw.engine_call(lambda cb: set(cb._seen_buckets))
+        code, events = gw.stream(dict(body, request_id="wb"))
+        assert _tokens(events) == ref
+        after = gw.engine_call(lambda cb: set(cb._seen_buckets))
+        assert after == warm, f"new buckets after warmup: {after - warm}"

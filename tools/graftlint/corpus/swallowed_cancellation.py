@@ -1,0 +1,96 @@
+# graftlint-corpus-expect: GL113 GL113 GL113
+"""Known-bad: swallowed cancellation in a serve loop (GL113).
+
+Reconstructs the hazard the ROADMAP seeded after the ISSUE-11
+resilience work: a broad ``except`` inside a serve/step/stream loop
+that neither re-raises nor records a structured terminal status turns
+a real failure — including a cancellation — into an infinite silent
+retry: the loop spins, the request never reaches ``engine.finished``,
+no counter moves, no span lands, and the operator sees a wedge with
+no evidence. The clean tripwires pin the two legitimate shapes: the
+serving gateway's stream pump (broad except, but it CANCELS the
+engine-side request — a structured terminal status still lands) and
+the stepper's crash handler (fans a structured ``failed`` status out
+to every subscriber before stopping).
+"""
+
+
+def serve_loop_swallows_everything(engine):
+    while engine.queue or engine.num_active:
+        try:
+            engine.step()
+        except Exception:                                   # GL113
+            # BAD: the alloc failure / cancellation / device error is
+            # gone; the loop re-enters with the same state forever
+            continue
+
+
+def stream_pump_drops_errors(queue, writer):
+    while True:
+        ev = queue.get()
+        try:
+            writer.write(ev)
+        except RuntimeError:                                # GL113
+            # BAD: the client is gone but the engine-side request
+            # keeps generating into the void — nobody cancelled it,
+            # nothing terminal was recorded
+            pass
+
+
+def worker_loop_logs_and_spins(engine, log):
+    for req in engine.queue:
+        try:
+            engine.admit(req)
+        except BaseException:                               # GL113
+            # BAD: logging is not a terminal status — the request is
+            # still queued and will fail the same way next pass
+            log.append("admit blew up")
+
+
+# -- clean tripwires: these must NOT flag --------------------------------
+
+def pump_stream_cancels_on_failure(stepper, queue, writer, rid):
+    """The gateway idiom: the broad except is fine BECAUSE the handler
+    routes the request into the structured-terminal machinery
+    (cancel() retires it through the normal block-free path)."""
+    while True:
+        ev = queue.get()
+        try:
+            writer.write(ev)
+        except Exception:
+            stepper.cancel(rid)
+            return "aborted"
+
+
+def run_loop_records_structured_status(engine, tracer):
+    """Recording the terminal status (status=/reason= keywords) is the
+    other sanctioned shape — evidence lands even though the loop
+    survives."""
+    while engine.queue or engine.num_active:
+        try:
+            engine.step()
+        except Exception as e:
+            tracer.event("request_failed", status="failed",
+                         reason=str(e))
+            break
+
+
+def step_loop_reraises(engine):
+    """Re-raising after evidence is always fine."""
+    while True:
+        try:
+            engine.step()
+        except Exception:
+            engine.dump_evidence()
+            raise
+
+
+def serve_loop_narrow_except(engine):
+    """A NARROW exception type is the author catching exactly what
+    they mean to — KVAllocFailure here is the allocator's own
+    exhaustion type, not a broad net."""
+    while engine.queue:
+        try:
+            engine.step()
+        except KVAllocFailure:      # noqa: F821 - corpus fixture
+            engine.backoff()

@@ -89,6 +89,7 @@ whose interpolations are function CALLS as exactly that bucketing
 idiom (the corpus tripwire pins it).
 """
 import ast
+import re
 
 from ..core import in_pallas, rule
 
@@ -964,6 +965,102 @@ def _gl112_ident(expr):
     if isinstance(expr, ast.Attribute) and expr.attr in _GL112_UNBOUNDED:
         return expr.attr
     return None
+
+
+_GL113_LOOPFN = re.compile(
+    r"(serve|stream|step|pump|drain|poll|worker|loop|run|drive|tick)",
+    re.IGNORECASE)
+
+# exception types broad enough to swallow a cancellation / real
+# failure alongside whatever the author meant to catch
+_GL113_BROAD = {"Exception", "BaseException", "RuntimeError"}
+
+# a handler that invokes the structured-terminal machinery is the
+# resilience layer doing its job: per-request failure paths are named
+# like these across the engine/gateway (_fail_slot, _finish_slot,
+# _terminal_queued, cancel, operator_abort_dump, close, ...)
+_GL113_OK_CALL = ("fail", "finish", "terminal", "abort", "reject",
+                  "cancel", "shed", "retire", "close", "shutdown",
+                  "record_result")
+
+_GL113_MSG = (
+    "a broad except inside a serve/step/stream loop that neither "
+    "re-raises nor records a structured terminal status silently "
+    "converts a real failure (including a cancellation) into an "
+    "infinite retry — the loop spins, the request never terminates, "
+    "and nothing lands in engine.finished or on the timeline. "
+    "Re-raise, narrow the exception type, or record the structured "
+    "terminal status (the resilience layer's per-request-failure "
+    "discipline: _fail_slot/_finish_slot-style calls, or an event "
+    "carrying status=/reason=)")
+
+
+def _gl113_broad(handler):
+    """Does this except clause catch one of the broad types?"""
+    t = handler.type
+    if t is None:
+        return True                  # bare except: broadest of all
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        name = e.id if isinstance(e, ast.Name) else (
+            e.attr if isinstance(e, ast.Attribute) else None)
+        if name in _GL113_BROAD:
+            return True
+    return False
+
+
+def _gl113_records_terminal(handler):
+    """Does the handler body re-raise, or call into the structured
+    terminal-status machinery (a call with a status=/reason= keyword,
+    or a callee whose name spells a terminal action)?"""
+    for st in handler.body:
+        for n in ast.walk(st):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                if any(kw.arg in ("status", "reason")
+                       for kw in n.keywords if kw.arg):
+                    return True
+                fname = n.func.attr if isinstance(n.func, ast.Attribute) \
+                    else (n.func.id if isinstance(n.func, ast.Name)
+                          else "")
+                low = fname.lower()
+                if any(tok in low for tok in _GL113_OK_CALL):
+                    return True
+    return False
+
+
+@rule("GL113", "swallowed-cancellation", "trace-safety")
+def swallowed_cancellation(ctx):
+    """Broad `except` (Exception / BaseException / RuntimeError / bare)
+    inside a loop of a serve/step/stream-shaped function that neither
+    re-raises nor records a structured terminal status. The ISSUE-11
+    resilience discipline enforced statically: degradation must be
+    per-request and VISIBLE — a swallowed failure in a serving loop is
+    an infinite retry with no evidence trail."""
+    seen = set()
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _GL113_LOOPFN.search(fn.name):
+            continue
+        for loop in _own_scope_walk(fn):
+            if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
+                continue
+            for sub in ast.walk(loop):
+                if not isinstance(sub, ast.Try):
+                    continue
+                for h in sub.handlers:
+                    if id(h) in seen:
+                        continue
+                    seen.add(id(h))
+                    if _gl113_broad(h) \
+                            and not _gl113_records_terminal(h):
+                        yield ctx.finding(
+                            "GL113", h,
+                            f"broad except in the `{fn.name}` loop "
+                            "swallows cancellations/failures: "
+                            + _GL113_MSG), h
 
 
 @rule("GL112", "metric-label-cardinality", "trace-safety")

@@ -27,6 +27,12 @@ if [ "${LINT_SKIP_SERVE:-0}" != "1" ]; then
   # and-resumed requests stay token-exact, KV/refcount gauges return
   # to baseline, 0 new compile buckets after warmup
   python tools/serve_chaos.py --check tools/serve_chaos.json
+  # gateway gate: the HTTP/SSE front door — concurrent streams (token-
+  # exact vs engine.generate(), SSE order == span ring), a mid-stream
+  # cancel (KV gauges back to baseline), a deadline, a shed + /healthz
+  # degradation, a structured rejection, control-plane schema parses,
+  # 0 new compile buckets after warmup
+  python tools/serve_gateway.py --check tools/serve_gateway.json
   # train_obs gate: per-program cost/memory attribution (FLOPs, bytes,
   # peak HBM, MFU for the paged step / rewind / COW copy / pretrain
   # step), token-exact-neutral telemetry, census leak check — "MFU is

@@ -35,6 +35,7 @@ paddle_tpu/observability STANDALONE by path (it is stdlib-only by
 contract — that load failing IS a selfcheck failure).
 """
 import argparse
+import importlib
 import importlib.util
 import json
 import os
@@ -42,6 +43,7 @@ import shutil
 import sys
 import tempfile
 import threading
+import types
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -60,6 +62,24 @@ def _load_observability():
     sys.modules["paddle_tpu.observability"] = mod
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_serving():
+    """paddle_tpu.serving, stdlib-only: when the real package is not
+    loaded, a NAMESPACE stub stands in for `paddle_tpu` (its __init__
+    imports jax, which a bare container lacks) so the serving package's
+    relative imports resolve against the standalone observability load
+    above. The serving package importing without jax/numpy IS part of
+    the contract under test."""
+    mod = sys.modules.get("paddle_tpu.serving")
+    if mod is not None:
+        return mod
+    if "paddle_tpu" not in sys.modules:
+        stub = types.ModuleType("paddle_tpu")
+        stub.__path__ = [os.path.join(REPO_ROOT, "paddle_tpu")]
+        sys.modules["paddle_tpu"] = stub
+    _load_observability()
+    return importlib.import_module("paddle_tpu.serving")
 
 
 def dump(fmt="json", registry=None, obs=None):
@@ -512,6 +532,125 @@ def selfcheck():
               f"operator_abort dump wrong: {dump2['context']}")
     finally:
         shutil.rmtree(d7, ignore_errors=True)
+
+    # serving gateway (ISSUE 12): the front-door package must import
+    # stdlib-only, its SSE framing must round-trip, its body/healthz
+    # validators must hold their contracts, its metric families must
+    # export under fixed label sets, and parse_prometheus must invert
+    # to_prometheus — all in a bare (jax-less) container
+    try:
+        srv = _load_serving()
+    except Exception as e:
+        failures.append(
+            f"standalone (pre-jax) serving import failed: {e}")
+        return failures
+    frame = srv.format_event("token", {"tokens": [5, 9], "step": 3,
+                                       "request": "r1", "index": 0})
+    check(frame.startswith(b"event: token\ndata: ")
+          and frame.endswith(b"\n\n"),
+          f"SSE frame framing wrong: {frame!r}")
+    evs = srv.parse_events(frame + srv.format_event(
+        "end", {"status": "finished", "tokens": [5, 9]}))
+    check(evs == [("token", {"tokens": [5, 9], "step": 3,
+                             "request": "r1", "index": 0}),
+                  ("end", {"status": "finished", "tokens": [5, 9]})],
+          f"SSE parse roundtrip wrong: {evs}")
+    inc = list(srv.iter_events([":comment\n", "data: {\"a\": 1}\n",
+                                "\n"]))
+    check(inc == [("message", {"a": 1})],
+          f"SSE bare-data/comment handling wrong: {inc}")
+
+    spec, err = srv.validate_generate_body(
+        {"prompt": [1, 2], "max_new_tokens": 4, "priority": 1,
+         "deadline_steps": 3, "spec_k": 2, "stream": False})
+    check(err is None and spec["prompt"] == [1, 2]
+          and spec["stream"] is False and spec["deadline_steps"] == 3,
+          f"generate-body happy path wrong: {spec} {err}")
+    for bad in ({"prompt": [], "max_new_tokens": 1},
+                {"prompt": [1], "max_new_tokens": 0},
+                {"prompt": [1.5], "max_new_tokens": 1},
+                {"prompt": [1], "max_new_tokens": 1, "priority": -1},
+                {"prompt": [1], "max_new_tokens": 1, "stream": "yes"},
+                {"prompt": [1], "max_new_tokens": 1, "bogus": 1},
+                "not a dict"):
+        s, e = srv.validate_generate_body(bad)
+        check(s is None and isinstance(e, str),
+              f"generate-body validator let {bad!r} through")
+
+    hz = {"schema": srv.HEALTHZ_SCHEMA, "status": "ok", "reason": None,
+          "inflight": 0, "queue_depth": 0, "steps": 5, "finished": 2}
+    check(srv.validate_healthz(hz) is hz, "healthz happy path rejected")
+    srv.validate_healthz(dict(hz, status="degraded",
+                              reason="slo_burn"))
+    for bad in (dict(hz, schema="x/1"),
+                dict(hz, status="meh"),
+                dict(hz, status="degraded", reason=None),
+                {k: v for k, v in hz.items() if k != "steps"},
+                dict(hz, inflight=-1)):
+        try:
+            srv.validate_healthz(bad)
+            check(False, f"validate_healthz accepted {bad!r}")
+        except ValueError:
+            pass
+
+    # gateway metric families: fixed label sets, present in exposition
+    inst = obs.instrument
+    inst.gateway_request_seconds().labels(route="generate").observe(0.01)
+    inst.gateway_stream_seconds().observe(0.5)
+    inst.gateway_responses().labels(route="generate", code="200").inc()
+    inst.gateway_live_connections().set(2)
+    inst.gateway_live_streams().set(1)
+    inst.gateway_sse_pending_events().set(0)
+    inst.gateway_sse_events().labels(event="token").inc(3)
+    inst.gateway_health_transitions().labels(to="degraded").inc()
+    prom8 = obs.to_prometheus()
+    for needle in ("# TYPE gateway_request_seconds histogram",
+                   'gateway_responses_total{route="generate",code="200"} 1',
+                   "gateway_live_connections 2",
+                   'gateway_sse_events_total{event="token"} 3',
+                   'gateway_health_transitions_total{to="degraded"} 1'):
+        check(needle in prom8,
+              f"gateway family missing from exposition: {needle!r}")
+    parsed = obs.parse_prometheus(prom8)
+    check(parsed["gateway_request_seconds"]["kind"] == "histogram"
+          and any(n == "gateway_request_seconds_count"
+                  and lbl.get("route") == "generate" and v == 1
+                  for n, lbl, v
+                  in parsed["gateway_request_seconds"]["samples"]),
+          "parse_prometheus lost the gateway histogram")
+    check(any(n == "gateway_responses_total" and v == 1
+              and lbl == {"route": "generate", "code": "200"}
+              for n, lbl, v
+              in parsed["gateway_responses_total"]["samples"]),
+          "parse_prometheus lost the labeled counter")
+    # escaping survives the roundtrip (the PR-8 help/label split)
+    reg9 = obs.MetricsRegistry()
+    reg9.counter("rt_esc_total", labels=("q",)).labels(
+        q='a"b\\c\nd').inc()
+    rt = obs.parse_prometheus(obs.to_prometheus(reg9))
+    check(rt["rt_esc_total"]["samples"][0][1]["q"] == 'a"b\\c\nd',
+          f"label escaping did not round-trip: "
+          f"{rt['rt_esc_total']['samples']}")
+    # the adversarial case: a LITERAL backslash followed by 'n' (a
+    # Windows path, a repr'd error) — unescaping must run one
+    # left-to-right pass, not sequential replaces
+    reg10 = obs.MetricsRegistry()
+    reg10.counter("rt_esc2_total", labels=("p",)).labels(
+        p="back\\nslash\\\\x").inc()
+    rt2 = obs.parse_prometheus(obs.to_prometheus(reg10))
+    check(rt2["rt_esc2_total"]["samples"][0][1]["p"]
+          == "back\\nslash\\\\x",
+          f"literal-backslash label did not round-trip: "
+          f"{rt2['rt_esc2_total']['samples']}")
+    lone = obs.parse_prometheus('x_bucket{le="+Inf"} 3\n')
+    check(lone["x_bucket"]["samples"]
+          == [("x_bucket", {"le": "+Inf"}, 3.0)],
+          f"parse_prometheus mishandled a bucket sample: {lone}")
+    try:
+        obs.parse_prometheus("not a metric line at all {{{")
+        check(False, "parse_prometheus accepted garbage")
+    except ValueError:
+        pass
     return failures
 
 

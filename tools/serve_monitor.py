@@ -26,9 +26,18 @@ host-deterministic; wall-clock latencies are evaluated only against
 the generous declared objectives (off-TPU they time the Pallas
 interpreter, not the chip — same caveat as every serve_bench leg).
 
+``--scrape URL`` flips the tool into a CROSS-PROCESS dashboard: it
+polls a live serving gateway's ``/metrics`` (Prometheus text, parsed
+with ``observability.parse_prometheus``) and ``/healthz`` instead of
+the in-process registry, and renders the same one-line dashboard —
+stdlib-only (the standalone observability load), so the sidecar runs
+in a bare container next to any ``examples/serve_gateway.py``.
+
 Usage:
   python tools/serve_monitor.py [--dashboard-every N] [--json OUT]
   python tools/serve_monitor.py --check tools/serve_slo.json
+  python tools/serve_monitor.py --scrape http://127.0.0.1:8000 \
+      [--scrape-interval S] [--scrape-count N]
 """
 import argparse
 import json
@@ -177,6 +186,89 @@ def render_dashboard(monitor, registry, tick, out=sys.stdout):
                           f"{ev['burn_rate']:.1f}x "
                           f"(bad {ev['bad_fraction']:.2%} of "
                           f"{ev['count']})", file=out)
+
+
+def _fam_sum(fams, name):
+    """Sum of a family's non-histogram samples, or None when absent."""
+    fam = fams.get(name)
+    if not fam:
+        return None
+    vals = [v for n, _, v in fam["samples"] if n == name]
+    return sum(vals) if vals else None
+
+
+def _fam_last(fams, name):
+    fam = fams.get(name)
+    if not fam:
+        return None
+    for n, _, v in fam["samples"]:
+        if n == name:
+            return v
+    return None
+
+
+def scrape_leg(url, interval_s=2.0, count=0, out=sys.stdout):
+    """Poll a live gateway's /metrics + /healthz and render the
+    dashboard cross-process. `count` 0 = forever. Returns 0 once the
+    poll budget is spent, 1 if every poll failed."""
+    import time
+    import urllib.error
+    import urllib.request
+
+    from tools.metrics_snapshot import _load_observability
+
+    obs = _load_observability()
+    base = url.rstrip("/")
+    if base.endswith("/metrics"):
+        base = base[: -len("/metrics")]
+    prev_tokens = prev_t = None
+    polls = ok_polls = 0
+    while count == 0 or polls < count:
+        if polls:
+            time.sleep(interval_s)
+        polls += 1
+        try:
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=5) as r:
+                fams = obs.parse_prometheus(r.read().decode())
+        except (OSError, ValueError) as e:
+            print(f"[scrape {polls}] {base}/metrics unreachable: {e}",
+                  file=out)
+            continue
+        ok_polls += 1
+        try:
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=5) as r:
+                health = f"ok({r.status})"
+        except urllib.error.HTTPError as e:
+            health = f"degraded({e.code})"
+        except OSError:
+            health = "unreachable"
+        now = time.monotonic()
+        tokens = _fam_sum(fams, "serve_tokens_total")
+        rate = None
+        if tokens is not None and prev_tokens is not None \
+                and now > prev_t:
+            rate = (tokens - prev_tokens) / (now - prev_t)
+        prev_tokens, prev_t = tokens, now
+
+        def g(name):
+            v = _fam_last(fams, name)
+            return "-" if v is None else f"{v:g}"
+
+        breaches = _fam_sum(fams, "slo_breaches_total")
+        print(f"[scrape {polls:3d}] health {health}"
+              f" | inflight {g('serve_inflight_requests')}"
+              f" queue {g('serve_queue_depth')}"
+              f" | kv free {g('kv_blocks_free')}"
+              f" | conns {g('gateway_live_connections')}"
+              f" streams {g('gateway_live_streams')}"
+              f" sse-pending {g('gateway_sse_pending_events')}"
+              f" | tokens {int(tokens) if tokens is not None else '-'}"
+              f" ({'-' if rate is None else f'{rate:.1f}/s'})"
+              f" | breaches {int(breaches) if breaches is not None else 0}",
+              file=out)
+    return 0 if ok_polls else 1
 
 
 def monitor_leg(config=None, dashboard_every=0):
@@ -389,7 +481,21 @@ def main():
                     help="do not arm the flight recorder (armed by "
                          "default with bounded retention — the "
                          "server-entrypoint policy)")
+    ap.add_argument("--scrape", metavar="URL", default=None,
+                    help="poll a live gateway's /metrics + /healthz "
+                         "instead of driving an in-process engine "
+                         "(cross-process dashboard; stdlib-only)")
+    ap.add_argument("--scrape-interval", type=float, default=2.0,
+                    help="seconds between scrape polls")
+    ap.add_argument("--scrape-count", type=int, default=0,
+                    help="number of polls (0 = forever)")
     args = ap.parse_args()
+
+    if args.scrape:
+        # a sidecar scraper neither serves nor dumps: no engine, no
+        # flight recorder, no jax
+        return scrape_leg(args.scrape, args.scrape_interval,
+                          args.scrape_count)
 
     from paddle_tpu.observability import tracing
     if not args.no_flight_recorder:
