@@ -393,7 +393,17 @@ class FlightRecorder:
             raise ValueError("max_dumps must be >= 1")
         if max_bytes is not None and int(max_bytes) < 1:
             raise ValueError("max_bytes must be >= 1")
+        # the manifest ADOPTION (a disk read) happens before the lock:
+        # arming must not stall a concurrent trigger/record behind file
+        # IO (GL115) — only the state flip is serialized. On a re-arm of
+        # the dir we are ALREADY rotating, the in-memory manifest is the
+        # authority (a trigger may have retained a dump between the read
+        # above and the lock below — adopting the disk copy would orphan
+        # it); the disk read only seeds a dir this process isn't
+        # tracking yet.
+        adopted = self._adopt_manifest(str(out_dir))
         with self._lock:
+            rearming_same_dir = self._dir == str(out_dir)
             self._dir = str(out_dir)
             if window_s is not None:
                 self.window_s = float(window_s)
@@ -403,7 +413,8 @@ class FlightRecorder:
                 self.max_dumps = int(max_dumps)
             if max_bytes is not None:
                 self.max_bytes = int(max_bytes)
-            self._manifest = self._adopt_manifest(self._dir)
+            if not rearming_same_dir:
+                self._manifest = adopted
         return self
 
     def disarm(self):
@@ -460,17 +471,22 @@ class FlightRecorder:
                 self.evicted_total += 1
             manifest = [dict(e) for e in self._manifest]
             try:
+                # deliberate GL115 exceptions: eviction + manifest write
+                # stay under the lock so two concurrent triggers can't
+                # interleave state-mutate and write (the loser would
+                # persist a stale manifest orphaning the winner's dump
+                # from rotation); _retain runs per-DUMP, not per-step
                 for e in evicted:
                     try:
-                        os.remove(os.path.join(out_dir, e["file"]))
+                        os.remove(os.path.join(out_dir, e["file"]))  # graftlint: disable=GL115 - manifest-rotation atomicity (see above)
                     except FileNotFoundError:
                         pass
                 tmp = os.path.join(out_dir, MANIFEST_NAME + ".tmp")
-                with open(tmp, "w") as f:
-                    json.dump({"schema": MANIFEST_SCHEMA,
+                with open(tmp, "w") as f:  # graftlint: disable=GL115 - same manifest-atomicity exception
+                    json.dump({"schema": MANIFEST_SCHEMA,  # graftlint: disable=GL115 - same manifest-atomicity exception
                                "evicted_total": self.evicted_total,
                                "dumps": manifest}, f, indent=1)
-                os.replace(tmp, os.path.join(out_dir, MANIFEST_NAME))
+                os.replace(tmp, os.path.join(out_dir, MANIFEST_NAME))  # graftlint: disable=GL115 - same manifest-atomicity exception
             except OSError as e:
                 io_error = e
         if io_error is not None:

@@ -93,6 +93,19 @@ _REASONS = {
 _MAX_BODY = 8 * 1024 * 1024
 _MAX_HEADER_LINES = 100
 
+
+def _read_file(path):
+    """Blocking dump-file read, offloaded via run_in_executor — the
+    event loop never waits on a disk (the GL114 discipline)."""
+    with open(path, "rb") as f:
+        return f.read()
+
+
+# strong references to in-flight aborted-stream drain tasks (the GL116
+# clean shape: the done-callback drops the reference when the drain
+# completes, so the set stays empty at quiescence)
+_drain_tasks = set()
+
 _GENERATE_FIELDS = {
     "prompt", "max_new_tokens", "request_id", "priority",
     "deadline_steps", "deadline_s", "spec_k", "temperature", "stream",
@@ -495,8 +508,14 @@ class ServingGateway:
             _tracing.get_tracer().event(
                 "stream_aborted", request=rid, status="cancelled",
                 reason="client_gone")
-            asyncio.get_running_loop().create_task(
+            # the drain task holds a strong reference in _drain_tasks
+            # until done (the loop only weak-refs running tasks — a
+            # bare create_task could be GC'd mid-drain and its
+            # exception would vanish: the GL116 discipline)
+            task = asyncio.get_running_loop().create_task(
                 self._drain_stream(next_event))
+            _drain_tasks.add(task)
+            task.add_done_callback(_drain_tasks.discard)
             return "aborted"
 
     @staticmethod
@@ -597,8 +616,11 @@ class ServingGateway:
                 writer, route, 404, {"error": "not_found", "file": arg})
         path = os.path.join(fr._dir, arg)
         try:
-            with open(path, "rb") as f:
-                blob = f.read()
+            # a dump can be megabytes: the disk read runs on an executor
+            # thread so a slow volume can't freeze every live SSE stream
+            # (GL114 — `_read_file` is thread-entry by construction)
+            blob = await asyncio.get_running_loop().run_in_executor(
+                None, _read_file, path)
         except OSError:
             return await self._respond(
                 writer, route, 404, {"error": "not_found", "file": arg})

@@ -35,10 +35,10 @@ def test_graftlint_imports():
         import tools.graftlint as gl
     finally:
         sys.path.remove(REPO_ROOT)
-    assert len(gl.RULES) >= 14, sorted(gl.RULES)
+    assert len(gl.RULES) >= 23, sorted(gl.RULES)
     families = {r.family for r in gl.RULES.values()}
     assert families >= {"trace-safety", "shard-map", "pallas-bounds",
-                        "hygiene", "donation"}, families
+                        "hygiene", "donation", "concurrency"}, families
     # the observability PR's rules: interpret=True literals (GL104),
     # metrics record calls inside jitted functions (GL105); the
     # speculative-decode PR's rule: donated-buffer reuse (GL107); the
@@ -53,9 +53,17 @@ def test_graftlint_imports():
     # one child series per distinct value, forever); the gateway PR's
     # rule: swallowed cancellation (GL113, a broad except in a
     # serve/step/stream loop that neither re-raises nor records a
-    # structured terminal status — an infinite retry with no evidence)
-    assert {"GL104", "GL105", "GL107", "GL108", "GL110",
-            "GL111", "GL112", "GL113"} <= set(gl.RULES), sorted(gl.RULES)
+    # structured terminal status — an infinite retry with no evidence);
+    # the v2 PR's concurrency family, powered by the phase-1 project
+    # index: blocking calls in async context incl. interprocedurally
+    # reachable ones (GL114 — the gateway dump-read hazard), locks held
+    # across blocking ops or compiled dispatch (GL115 — the flight-
+    # recorder arm()-adoption hazard), fire-and-forget asyncio tasks
+    # (GL116 — the gateway drain-task hazard), and stale/unknown
+    # suppression comments (GL117 — suppression rot made visible)
+    assert {"GL104", "GL105", "GL107", "GL108", "GL110", "GL111",
+            "GL112", "GL113", "GL114", "GL115", "GL116",
+            "GL117"} <= set(gl.RULES), sorted(gl.RULES)
 
 
 def test_tree_is_clean():
@@ -97,6 +105,131 @@ def test_metrics_selfcheck():
         capture_output=True, text=True, timeout=300, cwd=REPO_ROOT)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "metrics selfcheck: OK" in proc.stdout, proc.stdout
+
+
+def test_tree_run_is_within_budget_and_reports_phases():
+    """The tier-0 gate must stay CHEAP as rules accumulate: one
+    full-tree run (parse+index once, all 23+ rules) inside a hard wall
+    budget, with the per-phase split printed so a regression is
+    attributable. The committed tree runs in ~10s on a loaded 2-core
+    box; 180s is the never-flake ceiling that still catches an
+    accidental re-parse-per-rule regression (which would be
+    O(rules x files) ~ minutes)."""
+    import time
+    t0 = time.monotonic()
+    proc = _run_lint("paddle_tpu/", "tests/", "tools/")
+    wall = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert wall < 180.0, f"full-tree graftlint took {wall:.1f}s"
+    assert "phase1 parse+index" in proc.stdout, proc.stdout
+    assert "phase2 rules" in proc.stdout, proc.stdout
+
+
+def test_concurrency_corpus_roundtrip():
+    """The four GL114-GL117 corpus files each reconstruct a fixed real
+    hazard: caught codes fire exactly, clean tripwires stay silent
+    (any unexpected code fails), and each file's suppression-honored
+    demo is consumed (so GL117 does not flag it)."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        from tools.graftlint.core import lint_file
+        from tools.graftlint.selftest import corpus_expectations
+    finally:
+        sys.path.remove(REPO_ROOT)
+    from collections import Counter
+    corpus = os.path.join(REPO_ROOT, "tools", "graftlint", "corpus")
+    expected_files = {
+        "blocking_async_handler.py": "GL114",
+        "lock_across_blocking.py": "GL115",
+        "fire_and_forget_task.py": "GL116",
+        "stale_suppression.py": "GL117",
+    }
+    for name, code in expected_files.items():
+        path = os.path.join(corpus, name)
+        assert os.path.exists(path), f"missing corpus file {name}"
+        expected = Counter(corpus_expectations(path))
+        assert expected[code] >= 1, (name, expected)
+        findings, suppressed = lint_file(path, in_corpus=True)
+        got = Counter(f.code for f in findings)
+        assert got == expected, (
+            f"{name}: expected {dict(expected)}, got {dict(got)}:\n"
+            + "\n".join(f.render() for f in findings))
+        # every file carries one honored-suppression demo
+        assert suppressed >= 1, f"{name}: suppression demo not consumed"
+
+
+def test_interprocedural_blocking_call_is_caught():
+    """THE v2 capability: a blocking call only reachable through a
+    helper — lexically nowhere near an `async def`, so per-function
+    matching must miss it — flags via the call-graph color, and the
+    finding explains the path. Control: the same helper with an
+    additional SYNC caller must NOT flag (not 'reachable only from
+    async')."""
+    staging = os.path.join(REPO_ROOT, "paddle_tpu", "_graftlint_gate_tmp")
+    os.makedirs(staging, exist_ok=True)
+    hazard = (
+        "import time\n"
+        "async def stream_events(w):\n"
+        "    for c in _prepare():\n"
+        "        w.write(c)\n"
+        "def _prepare():\n"
+        "    time.sleep(0.2)\n"
+        "    return [b'x']\n")
+    try:
+        dst = os.path.join(staging, "interproc_case.py")
+        with open(dst, "w") as f:
+            f.write(hazard)
+        proc = _run_lint("--no-baseline", dst)
+        assert proc.returncode != 0, (
+            "helper-only-reachable blocking call NOT caught:\n"
+            + proc.stdout)
+        assert "GL114" in proc.stdout, proc.stdout
+        assert "_prepare" in proc.stdout, proc.stdout
+        assert "reachable only from async" in proc.stdout, proc.stdout
+        # control: one sync caller breaks the only-from-async property
+        with open(dst, "w") as f:
+            f.write(hazard + "def sync_user():\n    return _prepare()\n")
+        proc = _run_lint("--no-baseline", dst)
+        assert proc.returncode == 0, (
+            "helper with a sync caller should NOT flag (not reachable "
+            "ONLY from async):\n" + proc.stdout)
+    finally:
+        shutil.rmtree(staging, ignore_errors=True)
+
+
+def test_jsonl_output_is_parseable():
+    """--jsonl emits one JSON object per finding with the documented
+    fields — incl. suppressed findings, flagged — and keeps the exit
+    code contract."""
+    staging = os.path.join(REPO_ROOT, "paddle_tpu", "_graftlint_gate_tmp")
+    os.makedirs(staging, exist_ok=True)
+    try:
+        src = os.path.join(REPO_ROOT, "tools", "graftlint", "corpus",
+                           "stale_suppression.py")
+        dst = os.path.join(staging, "stale_suppression.py")
+        shutil.copyfile(src, dst)
+        proc = _run_lint("--jsonl", "--no-baseline", dst)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        rows = [json.loads(ln) for ln in proc.stdout.splitlines() if ln]
+        assert rows, proc.stdout
+        for r in rows:
+            assert {"rule", "path", "line", "col", "message",
+                    "suppressed", "baselined"} <= set(r), r
+        codes = {r["rule"] for r in rows if not r["suppressed"]}
+        assert "GL117" in codes, rows
+        # the honored GL401 demo surfaces as a suppressed=true row
+        assert any(r["rule"] == "GL401" and r["suppressed"]
+                   for r in rows), rows
+    finally:
+        shutil.rmtree(staging, ignore_errors=True)
+
+
+def test_changed_mode_runs():
+    """--changed (the pre-commit fast path) must work in any git
+    state: exit 0 on a clean diff of a clean tree, and never crash."""
+    proc = _run_lint("--changed")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "graftlint: OK" in proc.stdout, proc.stdout
 
 
 def test_introduced_corpus_snippet_fails():

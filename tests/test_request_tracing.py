@@ -522,6 +522,29 @@ def test_retention_rearm_adopts_manifest(tmp_path):
     assert [e["reason"] for e in man["dumps"]] == ["gen1_1", "gen2_0"]
 
 
+def test_rearm_same_dir_keeps_inmemory_manifest(tmp_path):
+    """Re-arming the dir a LIVE recorder is already rotating (e.g. to
+    adjust quotas) must keep the in-memory manifest, not re-read disk:
+    the adoption read runs outside the lock (GL115), so a dump retained
+    between that read and the state flip would otherwise be orphaned
+    from rotation by the stale disk copy."""
+    rec = tracing.SpanRecorder()
+    rec.event("tick")
+    fr = tracing.FlightRecorder(recorder=rec, min_interval_s=0.0)
+    fr.arm(tmp_path, max_dumps=4)
+    p = fr.trigger("live")
+    # simulate the worst-case stale read: the on-disk manifest vanishes
+    # entirely between the re-arm's read and its lock acquisition
+    os.remove(os.path.join(tmp_path, tracing.MANIFEST_NAME))
+    fr.arm(tmp_path, max_dumps=2)           # quota tweak, same dir
+    assert [e["file"] for e in fr.retained()] == [os.path.basename(p)]
+    assert fr.max_dumps == 2                # the quota change applied
+    # a fresh recorder (new process) still adopts from disk
+    fr2 = tracing.FlightRecorder(recorder=rec, min_interval_s=0.0)
+    fr2.arm(tmp_path, max_dumps=2)
+    assert fr2.retained() == []             # disk manifest was removed
+
+
 def test_retention_ignores_explicit_paths_outside_dir(tmp_path):
     """dump_to() to an explicit path OUTSIDE the armed dir is the
     caller's file: never rotated, never in the manifest."""

@@ -383,8 +383,16 @@ class TestLifecycle:
                                      timeout=30)
         s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
                   b"Content-Length: 9000000\r\n\r\n")
-        head = s.recv(65536).decode()
+        # Connection: close semantics — read until EOF; a single recv
+        # can race the body into a second segment and flake
+        data = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
         s.close()
+        head = data.decode()
         assert " 413 " in head.splitlines()[0]
         assert "payload_too_large" in head
 

@@ -1,10 +1,24 @@
 """graftlint — framework-aware static analysis for this repo.
 
+v2 is a two-phase, project-wide analyzer: phase 1 parses every file
+once into a shared module index + direct call graph and colors each
+function with its execution context (async-handler / serve-loop /
+jitted / holds-lock / thread-entry — see project.py); phase 2 runs the
+rules against the shared ASTs, with the concurrency family (GL114+)
+reading interprocedural context from the index.
+
 Run it:            python -m tools.graftlint paddle_tpu/ tests/ tools/
+Changed-only:      python -m tools.graftlint --changed  (git-diff scope;
+                   phase 1 still indexes the whole tree for call-graph
+                   accuracy — the fast pre-commit loop)
+Machine output:    python -m tools.graftlint --jsonl <paths>
 Self-test corpus:  python -m tools.graftlint --selftest
 List rules:        python -m tools.graftlint --list-rules
 Suppress a line:   trailing `# graftlint: disable=GL201` (comma list; a
-                   comment anywhere on a multi-line statement's span works)
+                   comment anywhere on a multi-line statement's span
+                   works). Suppressions are CHECKED: one no finding
+                   consumes — or naming an unknown rule id — flags
+                   GL117 (stale-suppression), so rot is visible.
 Suppress a file:   `# graftlint: disable-file=GL103` on its own line
 Baseline:          tools/graftlint_baseline.json — triaged pre-existing
                    findings, reported but non-fatal; regenerate with

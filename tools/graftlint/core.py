@@ -2,7 +2,7 @@
 
 Framework-aware static analysis for this repo (stdlib `ast` only — the
 linter must import in a bare CI container, before jax, before anything).
-Three of the four rule families encode bugs PR 1 fixed by hand:
+Three of the four original rule families encode bugs PR 1 fixed by hand:
 
 * the `from jax import shard_map` import skew that silently wiped 43 of
   47 test files off the collection (trace-safety family),
@@ -11,20 +11,33 @@ Three of the four rule families encode bugs PR 1 fixed by hand:
 * the `update_paged_kv_cache` out-of-bounds block-table write (Pallas
   bounds family).
 
+The analyzer runs in TWO PHASES. Phase 1 parses every file exactly once
+into a `FileContext` (AST, cached node list, parent links, suppression
+sets) and builds one `ProjectIndex` over the whole set (module index,
+direct call graph, execution-context colors — see project.py). Phase 2
+runs the rules: every rule shares the phase-1 AST via `ctx.walk()` (a
+cached node list — no re-parse, no re-walk of the tree per family) and
+reads interprocedural context through `ctx.project`.
+
 A rule is a function `fn(ctx) -> iterable[Finding]` registered with the
-`@rule(...)` decorator. Rules see one `FileContext` per file: parsed AST,
-source lines, parent links, and per-line suppression sets. Findings that
-carry a `# graftlint: disable=CODE` comment anywhere on the offending
-statement's line span are dropped; findings listed in the committed
-baseline (tools/graftlint_baseline.json) are reported but don't fail the
-run — the baseline is the triage ledger for pre-existing, understood
-debt (today: the partial-auto shard_map sites that need a newer jax).
+`@rule(...)` decorator. Findings that carry a `# graftlint:
+disable=CODE` comment anywhere on the offending statement's line span
+are dropped — and CONSUMED: the post-phase GL117 rule flags any
+suppression comment no finding consumed (stale) or naming an unknown
+rule id, so suppressions rot visibly. Findings listed in the committed
+baseline (tools/graftlint_baseline.json) are reported but don't fail
+the run — the baseline is the triage ledger for pre-existing,
+understood debt (today: the partial-auto shard_map sites that need a
+newer jax).
 """
 from __future__ import annotations
 
 import ast
+import io
 import json
 import re
+import time
+import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -56,10 +69,11 @@ class Finding:
 class Rule:
     code: str
     name: str
-    family: str        # trace-safety | shard-map | pallas-bounds | hygiene
+    family: str        # trace-safety | ... | concurrency
     doc: str
     fn: object
     applies: object    # fn(ctx) -> bool
+    phase: str = "scan"   # "scan" | "post" (post rules read scan output)
 
 
 RULES: dict[str, Rule] = {}
@@ -69,15 +83,17 @@ def _applies_everywhere(ctx):
     return True
 
 
-def rule(code, name, family, applies=_applies_everywhere):
+def rule(code, name, family, applies=_applies_everywhere, phase="scan"):
     """Register a rule. `applies(ctx)` scopes it (e.g. Pallas rules only
     look at kernel files); corpus files always pass the scope check so the
-    self-test corpus exercises every family regardless of layout."""
+    self-test corpus exercises every family regardless of layout.
+    `phase="post"` rules run after every scan rule on the file and may
+    read `ctx.used_suppressions` (GL117's staleness oracle)."""
 
     def deco(fn):
         RULES[code] = Rule(code=code, name=name, family=family,
                            doc=(fn.__doc__ or "").strip(), fn=fn,
-                           applies=applies)
+                           applies=applies, phase=phase)
         return fn
 
     return deco
@@ -92,7 +108,12 @@ def in_pallas(ctx):
 
 
 class FileContext:
-    """Everything a rule needs about one file, parsed once."""
+    """Everything a rule needs about one file, parsed once (phase 1).
+
+    `walk()` hands every rule the SAME cached node list — the tree is
+    walked once at parse time, not once per rule family — and
+    `project` (attached by the runner) is the phase-1 ProjectIndex for
+    interprocedural context."""
 
     def __init__(self, path, source, in_corpus=False):
         self.path = str(path)          # repo-relative posix
@@ -100,31 +121,57 @@ class FileContext:
         self.lines = source.splitlines()
         self.in_corpus = in_corpus
         self.tree = ast.parse(source, filename=self.path)
+        self.project = None            # ProjectIndex, set by the runner
+        self.used_suppressions = set()  # (line, code) consumed by findings
         self._parents = {}
+        self._all_nodes = []
         for node in ast.walk(self.tree):
+            self._all_nodes.append(node)
             for child in ast.iter_child_nodes(node):
                 self._parents[child] = node
-        # per-line and file-level suppressions from comments
+        # per-line and file-level suppressions, from REAL comment tokens
+        # only — a `# graftlint: disable=...` spelled inside a docstring
+        # (this package's own docs do it) is prose, not a suppression,
+        # and must not feed GL117's staleness ledger
         self.line_suppress = {}
         self.file_suppress = set()
-        for i, ln in enumerate(self.lines, 1):
-            m = _SUPPRESS_FILE_RE.search(ln)
+        for i, text in sorted(self._comments().items()):
+            m = _SUPPRESS_FILE_RE.search(text)
             if m:
                 self.file_suppress.update(
                     c.strip() for c in m.group(1).split(",") if c.strip())
                 continue
-            m = _SUPPRESS_RE.search(ln)
+            m = _SUPPRESS_RE.search(text)
             if m:
                 self.line_suppress[i] = {
                     c.strip() for c in m.group(1).split(",") if c.strip()}
         # names numpy is bound to in this module (`import numpy as np`)
         self.numpy_aliases = set()
-        for node in ast.walk(self.tree):
+        for node in self._all_nodes:
             if isinstance(node, ast.Import):
                 for a in node.names:
                     if a.name == "numpy" or a.name.startswith("numpy."):
                         self.numpy_aliases.add(
                             a.asname or a.name.split(".")[0])
+
+    def _comments(self):
+        """{line: text} for every COMMENT token in the file (the file
+        already parsed, so tokenize failing is a fallback path, not the
+        common one)."""
+        out = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.source).readline):
+                if tok.type == tokenize.COMMENT:
+                    out[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return dict(enumerate(self.lines, 1))
+        return out
+
+    def walk(self):
+        """The file's nodes, walked ONCE at parse time — every rule
+        iterates this cached list instead of re-walking the tree."""
+        return self._all_nodes
 
     def parent(self, node):
         return self._parents.get(node)
@@ -143,28 +190,45 @@ class FileContext:
         return Finding(code=code, path=self.path, line=node.lineno,
                        col=node.col_offset, message=message)
 
-    def is_suppressed(self, finding, node=None):
-        codes = {finding.code, "all"}
-        if codes & self.file_suppress:
-            return True
+    def suppression_hits(self, finding, node=None):
+        """The (line, code) suppression entries this finding consumes;
+        empty == not suppressed. Line 0 stands for a file-level
+        `disable-file=` entry. The runner records every hit into
+        `used_suppressions` so GL117 can flag the UNUSED remainder."""
+        hits = []
+        for code in (finding.code, "all"):
+            if code in self.file_suppress:
+                hits.append((0, code))
         lo = finding.line
         hi = getattr(node, "end_lineno", None) or finding.line
         # a suppression comment anywhere on the offending statement's
         # physical line span counts (multi-line calls put the comment at
         # the end)
         for ln in range(lo, hi + 1):
-            if codes & self.line_suppress.get(ln, set()):
-                return True
-        return False
+            present = self.line_suppress.get(ln, set())
+            for code in (finding.code, "all"):
+                if code in present:
+                    hits.append((ln, code))
+        return hits
+
+    def is_suppressed(self, finding, node=None):
+        return bool(self.suppression_hits(finding, node))
 
 
 @dataclass
 class RunResult:
     new: list = field(default_factory=list)
     baselined: list = field(default_factory=list)
-    suppressed: int = 0
+    suppressed_findings: list = field(default_factory=list)
     files: int = 0
     parse_errors: list = field(default_factory=list)
+    # per-phase wall time: phase 1 = parse + index, phase 2 = rules
+    phase1_s: float = 0.0
+    phase2_s: float = 0.0
+
+    @property
+    def suppressed(self):
+        return len(self.suppressed_findings)
 
     @property
     def ok(self):
@@ -202,21 +266,37 @@ def relpath(f):
         return f.as_posix()
 
 
+def _lint_ctx(ctx):
+    """Phase 2 for one already-parsed file: scan rules first (recording
+    which suppressions their findings consume), then post rules (GL117
+    reads the consumption ledger). Returns (findings, suppressed)."""
+    findings, suppressed = [], []
+    for phase in ("scan", "post"):
+        for r in RULES.values():
+            if r.phase != phase or not r.applies(ctx):
+                continue
+            for item in r.fn(ctx):
+                f, node = item if isinstance(item, tuple) else (item, None)
+                hits = ctx.suppression_hits(f, node)
+                if hits:
+                    ctx.used_suppressions.update(hits)
+                    suppressed.append(f)
+                else:
+                    findings.append(f)
+    return findings, suppressed
+
+
 def lint_file(path, in_corpus=False):
-    """All raw findings for one file (suppressions applied, no baseline)."""
+    """All raw findings for one file (suppressions applied, no
+    baseline). Builds a single-file ProjectIndex, so intra-file
+    interprocedural context (the corpus and the introduced-snippet
+    gate) still resolves; returns (findings, n_suppressed)."""
+    from .project import ProjectIndex
     source = Path(path).read_text()
     ctx = FileContext(relpath(path), source, in_corpus=in_corpus)
-    findings, suppressed = [], 0
-    for r in RULES.values():
-        if not r.applies(ctx):
-            continue
-        for item in r.fn(ctx):
-            f, node = item if isinstance(item, tuple) else (item, None)
-            if ctx.is_suppressed(f, node):
-                suppressed += 1
-            else:
-                findings.append(f)
-    return findings, suppressed
+    ctx.project = ProjectIndex([ctx])
+    findings, suppressed = _lint_ctx(ctx)
+    return findings, len(suppressed)
 
 
 def load_baseline(path=DEFAULT_BASELINE):
@@ -244,21 +324,44 @@ def write_baseline(findings, path=DEFAULT_BASELINE, notes=None):
     Path(path).write_text(json.dumps(payload, indent=2) + "\n")
 
 
-def run(paths, baseline_path=DEFAULT_BASELINE, use_baseline=True):
+def run(paths, baseline_path=DEFAULT_BASELINE, use_baseline=True,
+        rule_paths=None):
+    """Two-phase tree run. Phase 1 parses every file under `paths` once
+    and builds the shared ProjectIndex; phase 2 runs the rules — over
+    every parsed file, or (``rule_paths``, the --changed fast path) a
+    subset, with cross-file colors still computed from the FULL parse
+    set so interprocedural context doesn't shrink with the diff."""
     from . import rules  # noqa: F401 — registers all rule modules
+    from .project import ProjectIndex
     baseline = load_baseline(baseline_path) if use_baseline else set()
     res = RunResult()
+
+    t0 = time.perf_counter()
+    ctxs = []
     for f in iter_py_files(paths):
         res.files += 1
         try:
-            findings, nsup = lint_file(f)
+            ctxs.append(FileContext(relpath(f), Path(f).read_text()))
         except SyntaxError as e:
             res.parse_errors.append(f"{relpath(f)}: {e}")
+    index = ProjectIndex(ctxs)
+    res.phase1_s = time.perf_counter() - t0
+
+    only = None
+    if rule_paths is not None:
+        only = {relpath(p) for p in rule_paths}
+    t1 = time.perf_counter()
+    for ctx in ctxs:
+        if only is not None and ctx.path not in only:
             continue
-        res.suppressed += nsup
+        ctx.project = index
+        findings, suppressed = _lint_ctx(ctx)
+        res.suppressed_findings.extend(suppressed)
         for fd in findings:
             (res.baselined if fd.baseline_key() in baseline
              else res.new).append(fd)
+    res.phase2_s = time.perf_counter() - t1
+
     res.new.sort(key=lambda f: (f.path, f.line, f.code))
     res.baselined.sort(key=lambda f: (f.path, f.line, f.code))
     return res

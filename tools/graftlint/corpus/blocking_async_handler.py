@@ -1,0 +1,104 @@
+# graftlint-corpus-expect: GL114 GL114 GL114 GL114 GL114 GL114
+"""Known-bad corpus: blocking calls in async context (GL114).
+
+Reconstructs the PR-13 gateway bug fixed by hand: `_h_dump_file` read a
+flight dump with a sync `open()`/`.read()` INSIDE an `async def` — a
+slow volume would have frozen every live SSE stream in the process,
+with no traceback and no metric. The interprocedural half is the
+point of the v2 engine: the same hazard buried in a sync helper only
+reachable from async context must flag too (per-function matching
+cannot see it).
+
+Clean tripwires: awaited asyncio spellings, timeout-carrying waits,
+the run_in_executor offload (its target is thread-entry by
+construction), and a blocking helper that ALSO has a sync caller
+(not "reachable only from async" — flagging it would punish shared
+utility code).
+"""
+import asyncio
+import queue
+import time
+
+_q = queue.Queue()
+
+
+# -- caught: blocking directly inside async defs -----------------------------
+
+async def handle_dump(path):
+    with open(path, "rb") as f:      # expect GL114: sync open()
+        return f.read()              # expect GL114: handle .read()
+
+
+async def poll_with_sleep():
+    time.sleep(0.5)                  # expect GL114: time.sleep()
+    return 1
+
+
+async def wait_for_result(pool, job):
+    fut = pool.submit(job)
+    return fut.result()              # expect GL114: no-timeout result()
+
+
+async def drain_queue():
+    return _q.get()                  # expect GL114: queue.get() no timeout
+
+
+# -- caught: interprocedural — blocking only reachable from async ------------
+
+async def stream_tokens(writer):
+    for tok in _fetch_chunk():
+        writer.write(tok)
+
+
+def _fetch_chunk():
+    # only stream_tokens() calls this: it runs ON the event loop even
+    # though nothing here is spelled `async`
+    time.sleep(0.01)                 # expect GL114: via the call graph
+    return [b"t"]
+
+
+# -- clean: the loop-friendly spellings --------------------------------------
+
+async def handle_dump_clean(path):
+    loop = asyncio.get_running_loop()
+    # the executor target is colored thread-entry: blocking there is
+    # the FIX, not a finding (the gateway's _read_file shape)
+    return await loop.run_in_executor(None, _read_blob, path)
+
+
+def _read_blob(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+async def polite_poll():
+    await asyncio.sleep(0.5)         # awaited: the loop keeps breathing
+    ev = await _aq.get()             # asyncio.Queue, awaited
+    return ev
+
+
+_aq = asyncio.Queue()
+
+
+async def bounded_wait():
+    return _q.get(timeout=0.1)       # timeout= yields eventually: clean
+
+
+def shared_helper():
+    # blocking, but ALSO called from sync_caller below — NOT "reachable
+    # only from async", so the async rules leave it alone
+    time.sleep(0.01)
+    return 2
+
+
+async def async_caller():
+    return shared_helper()
+
+
+def sync_caller():
+    return shared_helper()
+
+
+async def suppressed_site():
+    time.sleep(0.0)  # graftlint: disable=GL114 - corpus demo: suppression honored
+    return 3

@@ -1,4 +1,5 @@
 # graftlint-corpus-expect: none
+# graftlint-corpus-rule: GL101 GL102 GL103 GL104 GL201 GL301 GL302 GL401 GL402 GL403
 """False-positive tripwire: the CORRECT spellings of every pattern the
 rules hunt. If any rule fires here, it drifted into noise."""
 import os

@@ -1,0 +1,71 @@
+# graftlint-corpus-expect: GL116 GL116 GL116
+"""Known-bad corpus: fire-and-forget asyncio tasks (GL116).
+
+Reconstructs the PR-13 gateway bug fixed by hand: the aborted-stream
+drain was spawned as a bare ``loop.create_task(...)`` — the event loop
+holds only a WEAK reference to running tasks, so the drain could be
+garbage-collected mid-flight and any exception inside it vanished
+silently (the backpressure gauge would leak with no evidence). The fix
+parks the task in a module-level set with
+``add_done_callback(set.discard)``.
+
+Clean tripwires: the kept-reference + done-callback shape, an awaited
+task, a gathered task, and a task returned to the caller.
+"""
+import asyncio
+
+
+async def _drain(q):
+    while (await q.get())["type"] != "end":
+        pass
+
+
+# -- caught ------------------------------------------------------------------
+
+async def abort_bad(q):
+    asyncio.create_task(_drain(q))          # expect GL116: bare statement
+    return "aborted"
+
+
+async def abort_bad_loop(q):
+    loop = asyncio.get_running_loop()
+    loop.create_task(_drain(q))             # expect GL116: bare statement
+    return "aborted"
+
+
+async def abort_bad_unused(q):
+    task = asyncio.create_task(_drain(q))   # expect GL116: never read
+    return "aborted"
+
+
+# -- clean -------------------------------------------------------------------
+
+_tasks = set()
+
+
+async def abort_clean_parked(q):
+    # the gateway's drain shape: strong ref until done, then dropped
+    task = asyncio.create_task(_drain(q))
+    _tasks.add(task)
+    task.add_done_callback(_tasks.discard)
+    return "aborted"
+
+
+async def abort_clean_awaited(q):
+    task = asyncio.create_task(_drain(q))
+    await task
+    return "done"
+
+
+async def abort_clean_gathered(q):
+    await asyncio.gather(asyncio.create_task(_drain(q)))
+    return "done"
+
+
+async def abort_clean_returned(q):
+    return asyncio.create_task(_drain(q))   # caller owns the task
+
+
+async def abort_suppressed(q):
+    asyncio.create_task(_drain(q))  # graftlint: disable=GL116 - corpus demo: suppression honored
+    return "aborted"

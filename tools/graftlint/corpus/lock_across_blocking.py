@@ -1,0 +1,87 @@
+# graftlint-corpus-expect: GL115 GL115 GL115 GL115 GL115
+"""Known-bad corpus: lock held across blocking ops / dispatch (GL115).
+
+Reconstructs the PR-13 flight-recorder hazard fixed by hand: arm()
+adopted the retention manifest — a disk read — while holding the
+recorder lock, so a slow volume at arm time stalled every concurrent
+trigger/record behind file IO (the fix reads BEFORE taking the lock).
+The dispatch case is the serving registry's nightmare shape: one XLA
+program execution under a lock serializes every thread behind the
+device.
+
+Clean tripwires: the snapshot-under-lock/write-after discipline, the
+condition-variable wait (wait() RELEASES the lock — it's the idiom,
+not the hazard), and compute-only critical sections.
+"""
+import json
+import os
+import threading
+import time
+
+import jax
+
+
+def _step_impl(x):
+    return x
+
+
+class MetricsRing:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._entries = []
+        self._step = jax.jit(_step_impl)
+
+    # -- caught: blocking/dispatch inside the with-body ----------------------
+
+    def flush_bad(self, path):
+        with self._lock:
+            with open(path, "w") as f:             # expect GL115: file IO
+                json.dump(self._entries, f)        # expect GL115: file IO
+            self._entries.clear()
+
+    def backoff_bad(self):
+        with self._lock:
+            time.sleep(0.05)                       # expect GL115: sleep
+
+    def record_bad(self, x):
+        with self._lock:
+            out = self._step(x)                    # expect GL115: dispatch
+            self._entries.append(out)
+
+    # -- caught: interprocedural — the IO hides in a helper ------------------
+
+    def adopt_bad(self, path):
+        with self._lock:
+            self._entries = self._load_manifest(path)
+
+    def _load_manifest(self, path):
+        # only adopt_bad() calls this: it runs with the lock held
+        if not os.path.exists(path):
+            return []
+        with open(path) as f:                      # expect GL115: via graph
+            return json.load(f)
+
+    # -- clean: snapshot under the lock, slow work after ---------------------
+
+    def flush_clean(self, path):
+        with self._lock:
+            snapshot = list(self._entries)
+            self._entries.clear()
+        with open(path, "w") as f:
+            json.dump(snapshot, f)
+
+    def record_clean(self, x):
+        out = self._step(x)        # dispatch first, lock only the append
+        with self._lock:
+            self._entries.append(out)
+
+    def wait_for_work(self):
+        with self._cond:
+            while not self._entries:
+                self._cond.wait()  # releases the lock: the idiom
+            return self._entries.pop()
+
+    def flush_suppressed(self, path):
+        with self._lock:
+            os.replace(path + ".tmp", path)  # graftlint: disable=GL115 - corpus demo: reasoned exception honored

@@ -1,0 +1,42 @@
+# graftlint-corpus-expect: GL117 GL117 GL117
+"""Known-bad corpus: rotted suppression comments (GL117).
+
+The tree carries 25+ reasoned `# graftlint: disable=` comments; until
+v2 nothing checked they still point at a live finding. A suppression
+whose hazard is gone is camouflage for the NEXT real finding on that
+line, and a typo'd rule id never suppressed anything to begin with.
+The scan phase records every (line, code) a suppressed finding
+consumed; GL117 flags the unconsumed remainder.
+
+Clean tripwires: a suppression a real finding DOES consume (the
+bare-except demo below), and prose in docstrings that merely MENTIONS
+the disable spelling — like this one: `# graftlint: disable=GL101` —
+which is a string, not a comment, and must not feed the ledger.
+"""
+import time
+
+
+def rotted_under_the_comment():
+    # the classic rot: the except was once bare, someone narrowed it,
+    # the suppression stayed — GL401 no longer fires here
+    try:
+        return 1
+    except Exception:  # graftlint: disable=GL401 - expect GL117: stale since the except was narrowed
+        return 0
+
+
+def truly_bare():
+    try:
+        return 1
+    except:  # noqa: E722  # graftlint: disable=GL401 - consumed: GL401 fires here and is suppressed (clean tripwire)
+        return 0
+
+
+def stale_site():
+    x = 1 + 1  # graftlint: disable=GL109 - expect GL117: no GL109 ever fires on plain host math
+    return x
+
+
+def unknown_rule():
+    t = time.monotonic()  # graftlint: disable=GL999 - expect GL117: unknown rule id
+    return t

@@ -1,0 +1,558 @@
+"""graftlint phase 1: the project-wide module index + context colors.
+
+PR 12 made the serving stack genuinely concurrent — an asyncio gateway,
+a dedicated engine-stepper thread, watchdog/heartbeat threads, and
+lock-protected observability rings all share one process — and the
+hazards that now matter most are invisible to a per-function matcher: a
+blocking call buried two calls deep under an ``async def`` handler
+stalls every live SSE stream, and a lock held across a compiled
+dispatch serializes the whole registry. This module is the engine those
+rules need: every file is parsed ONCE (by core.run) into a
+:class:`FileContext`, and a :class:`ProjectIndex` built over the whole
+set records
+
+* the **module index** — top-level defs, classes/methods, and import
+  bindings per module (dotted names derived from repo-relative paths,
+  relative imports resolved against the file's package), plus
+* the **direct call graph** — bare-name calls through the lexical
+  chain, ``self.method()`` through the enclosing class, and
+  ``alias.fn()`` through intra-package import aliases (direct calls
+  only: no inheritance, no higher-order dataflow), plus
+* **execution-context colors** per function, propagated over that
+  graph:
+
+  ``async-handler``  async defs, and functions reachable ONLY from
+                     async-colored callers (the "only" keeps a helper
+                     shared with sync paths out of the async rules);
+  ``serve-loop``     the serve/step/stream-shaped loop functions GL113
+                     already patterns on;
+  ``jitted``         decorator- or ``jax.jit(fn)``-bound compiled
+                     functions;
+  ``thread-entry``   targets of ``threading.Thread(target=...)``,
+                     ``run_in_executor``, ``executor.submit``, and
+                     ``create_task`` — code that runs OFF the caller's
+                     context (a thread-entry function is never colored
+                     async-reachable: offloading IS the fix GL114
+                     recommends);
+  ``holds-lock``     functions called (transitively) from inside a
+                     ``with <lock>:`` region, where the lock names/
+                     attrs are bound to ``threading.Lock/RLock/
+                     Condition/Semaphore`` anywhere in the indexed set
+                     (attribute names are pooled project-wide, so
+                     ``with registry._lock:`` colors even in a file
+                     that never constructs the lock).
+
+Each derived color carries a human-readable provenance (``via``) so a
+finding can say HOW the context reaches the flagged line — the
+difference between a lint message and a call-stack explanation.
+
+Single-file lints (the selftest corpus, the introduced-snippet gate)
+build a one-file index: intra-file interprocedural reasoning still
+works, cross-file edges simply don't exist.
+
+stdlib ``ast`` only, same as the rest of the linter.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _attr_chain(node):
+    """Dotted-name string for Attribute/Name chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jitish(expr):
+    if isinstance(expr, ast.Name):
+        return expr.id in _JIT_NAMES
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _JIT_NAMES
+    if isinstance(expr, ast.Call):
+        if _is_jitish(expr.func):
+            return True  # @jax.jit(static_argnums=...)
+        f = expr.func
+        is_partial = ((isinstance(f, ast.Name) and f.id == "partial")
+                      or (isinstance(f, ast.Attribute)
+                          and f.attr == "partial"))
+        if is_partial:
+            return any(_is_jitish(a) for a in expr.args)
+    return False
+
+
+def own_scope_walk(fn):
+    """Walk the nodes of `fn`'s OWN lexical scope: everything reachable
+    without crossing into a nested def/lambda body. The nested node
+    itself is yielded (its name binds here, and its decorators/argument
+    defaults evaluate here) — its body is a separate scope."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            stack.extend(getattr(node, "decorator_list", ()))
+            stack.extend(d for d in node.args.defaults if d is not None)
+            stack.extend(d for d in node.args.kw_defaults
+                         if d is not None)
+        else:
+            stack.extend(ast.iter_child_nodes(node))
+
+
+ASYNC_HANDLER = "async-handler"
+SERVE_LOOP = "serve-loop"
+JITTED = "jitted"
+THREAD_ENTRY = "thread-entry"
+HOLDS_LOCK = "holds-lock"
+
+_SERVE_SHAPE = re.compile(
+    r"(serve|stream|step|pump|drain|poll|worker|loop|run|drive|tick)",
+    re.IGNORECASE)
+
+# threading constructors whose bound names make `with <name>:` a
+# lock-held region (Condition guards state the same way; its wait()
+# RELEASES the lock, which is why wait() is not in any blocking set)
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+
+
+@dataclass
+class FunctionInfo:
+    """One function/method/nested def in the index."""
+    qualname: str                 # "<relpath>::Outer.inner"
+    path: str
+    name: str
+    node: object
+    is_async: bool
+    cls: str | None = None        # enclosing class name (methods only)
+    lexical_parent: object = None  # FunctionInfo of the enclosing def
+    nested: dict = field(default_factory=dict)   # name -> qualname
+    colors: set = field(default_factory=set)
+    via: dict = field(default_factory=dict)      # color -> provenance
+
+    @property
+    def shortname(self):
+        return self.qualname.split("::", 1)[1]
+
+
+class _ModuleFacts:
+    __slots__ = ("path", "module", "defs", "classes", "aliases",
+                 "from_imports")
+
+    def __init__(self, path, module):
+        self.path = path
+        self.module = module
+        self.defs = {}          # top-level fn name -> qualname
+        self.classes = {}       # class name -> {method name -> qualname}
+        self.aliases = {}       # bound name -> dotted module
+        self.from_imports = {}  # bound name -> (module, original name)
+
+
+def _module_name(path):
+    """Dotted module name for a repo-relative posix path; packages
+    (__init__.py) take the package's own name."""
+    p = path[:-3] if path.endswith(".py") else path
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+def lock_bindings(ctx, extra_attrs=()):
+    """(plain names, attribute names) bound to a threading lock ctor in
+    this file — ``g_lock = threading.Lock()`` / ``self._lock =
+    threading.RLock()``. `extra_attrs` pools attribute names seen
+    project-wide (a file may guard with a lock another module built)."""
+    names, attrs = set(), set(extra_attrs)
+    for node in ctx.walk():
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Call):
+            continue
+        f = node.value.func
+        ctor = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if ctor not in _LOCK_CTORS:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                attrs.add(t.attr)
+    return names, attrs
+
+
+def lock_regions(ctx, names, attrs):
+    """(with_node, lock_spelling) for every ``with <lock>:`` region —
+    the spans whose bodies execute while the lock is held."""
+    out = []
+    for node in ctx.walk():
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            e = item.context_expr
+            spelled = None
+            if isinstance(e, ast.Name) and e.id in names:
+                spelled = e.id
+            elif isinstance(e, ast.Attribute) and e.attr in attrs:
+                spelled = _attr_chain(e) or e.attr
+            if spelled is not None:
+                out.append((node, spelled))
+                break
+    return out
+
+
+def jitted_nodes(ctx):
+    """id()s of every function NODE this file binds to a compiled
+    program: decorator form plus `jax.jit(fn)` call-binding form."""
+    defs = {}
+    for node in ctx.walk():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    out = set()
+    for node in ctx.walk():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and any(_is_jitish(d) for d in node.decorator_list):
+            out.add(id(node))
+        elif isinstance(node, ast.Call) and _is_jitish(node.func) \
+                and node.args and isinstance(node.args[0], ast.Name):
+            for fn in defs.get(node.args[0].id, ()):
+                out.add(id(fn))
+    return out
+
+
+class ProjectIndex:
+    """Phase-1 product: module index + call graph + colors over a set
+    of already-parsed FileContexts. Built once per run and shared by
+    every rule (core attaches it as ``ctx.project``)."""
+
+    def __init__(self, ctxs):
+        self.files = {ctx.path: ctx for ctx in ctxs}
+        self.modules = {}        # dotted module -> _ModuleFacts
+        self.functions = {}      # qualname -> FunctionInfo
+        self._by_node = {}       # id(node) -> FunctionInfo
+        self.edges = {}          # caller qualname -> set(callee qualname)
+        self.lock_attr_names = set()   # pooled `self._lock`-style names
+        for ctx in ctxs:
+            self._collect_defs(ctx)
+        for ctx in ctxs:
+            names, attrs = lock_bindings(ctx)
+            self.lock_attr_names |= attrs
+        self._thread_entries = {}      # qualname -> provenance str
+        self._lock_seeds = {}          # qualname -> provenance str
+        self._sync_called = set()      # qualnames called at import time
+        for ctx in ctxs:
+            self._collect_edges(ctx)
+        self._color()
+
+    # -- lookups (rule API) -------------------------------------------------
+    def info(self, node):
+        """FunctionInfo for a def node, or None."""
+        return self._by_node.get(id(node))
+
+    def colors(self, node):
+        fi = self._by_node.get(id(node))
+        return fi.colors if fi is not None else set()
+
+    def via(self, node, color):
+        fi = self._by_node.get(id(node))
+        return fi.via.get(color) if fi is not None else None
+
+    def functions_in(self, path):
+        ctx = self.files.get(path)
+        if ctx is None:
+            return []
+        return [fi for fi in self.functions.values() if fi.path == path]
+
+    # -- phase 1a: defs / classes / imports ---------------------------------
+    def _collect_defs(self, ctx):
+        facts = _ModuleFacts(ctx.path, _module_name(ctx.path))
+        self.modules[facts.module] = facts
+
+        for node in ctx.walk():
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        facts.aliases[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        facts.aliases[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                base = self._from_base(facts, node)
+                for a in node.names:
+                    facts.from_imports[a.asname or a.name] = (base, a.name)
+
+        def visit(body, scope, cls, parent_fi, top):
+            for st in body:
+                if isinstance(st, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                    qual = f"{ctx.path}::" + ".".join(scope + [st.name])
+                    fi = FunctionInfo(
+                        qualname=qual, path=ctx.path, name=st.name,
+                        node=st,
+                        is_async=isinstance(st, ast.AsyncFunctionDef),
+                        cls=cls, lexical_parent=parent_fi)
+                    self.functions[qual] = fi
+                    self._by_node[id(st)] = fi
+                    if parent_fi is not None:
+                        parent_fi.nested[st.name] = qual
+                    if top:
+                        facts.defs[st.name] = qual
+                    if cls is not None:
+                        facts.classes.setdefault(cls, {})[st.name] = qual
+                    visit(st.body, scope + [st.name], None, fi, False)
+                elif isinstance(st, ast.ClassDef):
+                    visit(st.body, scope + [st.name], st.name, parent_fi,
+                          False)
+                else:
+                    # a def under if/try/with/for still binds in the
+                    # SAME scope — descend through compound statements
+                    # so conditional helpers aren't invisible to the
+                    # index (and to the async/lock coloring)
+                    for sub in (getattr(st, "body", None),
+                                getattr(st, "orelse", None),
+                                getattr(st, "finalbody", None)):
+                        if isinstance(sub, list):
+                            visit(sub, scope, cls, parent_fi, top)
+                    for h in getattr(st, "handlers", []) or []:
+                        visit(h.body, scope, cls, parent_fi, top)
+
+        visit(ctx.tree.body, [], None, None, True)
+
+    def _from_base(self, facts, node):
+        """Absolute dotted module a from-import pulls from, relative
+        levels resolved against this file's package."""
+        if node.level == 0:
+            return node.module or ""
+        parts = facts.module.split(".")
+        is_pkg = facts.path.endswith("/__init__.py")
+        pkg = parts if is_pkg else parts[:-1]
+        pkg = pkg[: max(0, len(pkg) - (node.level - 1))]
+        if node.module:
+            pkg = pkg + node.module.split(".")
+        return ".".join(pkg)
+
+    # -- phase 1b: call edges + spawn targets -------------------------------
+    def _resolve_bare(self, facts, fi, name):
+        """A bare-name call: lexical nested defs outward, then the
+        module's top-level defs, then intra-project from-imports.
+        `fi` is None for module-scope call sites."""
+        cur = fi
+        while cur is not None:
+            q = cur.nested.get(name)
+            if q is not None:
+                return q
+            cur = cur.lexical_parent
+        q = facts.defs.get(name)
+        if q is not None:
+            return q
+        imp = facts.from_imports.get(name)
+        if imp is not None:
+            mod, orig = imp
+            target = self.modules.get(mod)
+            if target is not None:
+                return target.defs.get(orig)
+        return None
+
+    def _resolve_chain(self, facts, chain):
+        """`alias.fn` / `pkg.sub.fn` through import bindings."""
+        mod_part, _, fname = chain.rpartition(".")
+        if not mod_part:
+            return None
+        root, _, rest = mod_part.partition(".")
+        dotted = None
+        if root in facts.aliases:
+            dotted = facts.aliases[root] + (("." + rest) if rest else "")
+        else:
+            imp = facts.from_imports.get(root)
+            if imp is not None:            # `from . import sse` binds a
+                mod, orig = imp            # submodule name
+                cand = f"{mod}.{orig}" if mod else orig
+                if cand in self.modules:
+                    dotted = cand + (("." + rest) if rest else "")
+        if dotted is None:
+            return None
+        target = self.modules.get(dotted)
+        if target is None:
+            return None
+        return target.defs.get(fname)
+
+    def _resolve_ref(self, facts, fi, expr):
+        """A function REFERENCE (spawn target): plain name, self.method,
+        or alias.fn. For `create_task(coro())` the caller passes
+        expr.func already."""
+        if isinstance(expr, ast.Name):
+            return self._resolve_bare(facts, fi, expr.id)
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self" and fi is not None \
+                    and fi.cls is not None:
+                meths = facts.classes.get(fi.cls, {})
+                return meths.get(expr.attr)
+            chain = _attr_chain(expr)
+            if chain:
+                return self._resolve_chain(facts, chain)
+        return None
+
+    def _module_scope_calls(self, ctx):
+        """Call nodes that run at IMPORT time: module body and class
+        bodies, pruned at def/lambda boundaries. A function called here
+        runs on the sync import path — its `async-handler` propagation
+        must die (it is not reachable ONLY from async)."""
+        stack = list(ctx.tree.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _collect_edges(self, ctx):
+        facts = self.modules[_module_name(ctx.path)]
+        names, attrs = lock_bindings(ctx, extra_attrs=self.lock_attr_names)
+        regions = lock_regions(ctx, names, attrs)
+        lock_nodes = {}            # id(node) -> lock spelling, per region
+        for region, spelled in regions:
+            for n in ast.walk(region):
+                lock_nodes.setdefault(id(n), spelled)
+
+        for node in self._module_scope_calls(ctx):
+            f = node.func
+            target = None
+            if isinstance(f, ast.Name):
+                target = self._resolve_bare(facts, None, f.id)
+            elif isinstance(f, ast.Attribute):
+                target = self._resolve_ref(facts, None, f)
+            if target is not None:
+                self._sync_called.add(target)
+
+        fns = [fi for fi in self.functions.values() if fi.path == ctx.path]
+        for fi in fns:
+            callees = self.edges.setdefault(fi.qualname, set())
+            for node in own_scope_walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = None
+                f = node.func
+                if isinstance(f, ast.Name):
+                    target = self._resolve_bare(facts, fi, f.id)
+                elif isinstance(f, ast.Attribute):
+                    target = self._resolve_ref(facts, fi, f)
+                if target is not None:
+                    callees.add(target)
+                    if id(node) in lock_nodes:
+                        self._lock_seeds.setdefault(
+                            target,
+                            f"called under `with {lock_nodes[id(node)]}:`"
+                            f" at {ctx.path}:{node.lineno}")
+                self._spawn_target(facts, fi, node)
+
+    def _spawn_target(self, facts, fi, node):
+        """Record thread/executor/task targets of this call, if any."""
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        refs = []
+        if fname == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    refs.append(kw.value)
+        elif fname == "run_in_executor" and len(node.args) >= 2:
+            refs.append(node.args[1])
+        elif fname in ("submit", "create_task", "ensure_future") \
+                and node.args:
+            a = node.args[0]
+            # create_task takes a coroutine OBJECT: resolve its call
+            refs.append(a.func if isinstance(a, ast.Call) else a)
+        where = f"{fi.path}:{node.lineno}" if fi else ""
+        for ref in refs:
+            q = self._resolve_ref(facts, fi, ref)
+            if q is not None:
+                self._thread_entries.setdefault(
+                    q, f"spawned as a {fname} target at {where}")
+
+    # -- phase 1c: colors ---------------------------------------------------
+    def _color(self):
+        callers = {}
+        for caller, callees in self.edges.items():
+            for callee in callees:
+                callers.setdefault(callee, set()).add(caller)
+
+        for q, fi in self.functions.items():
+            if fi.is_async:
+                fi.colors.add(ASYNC_HANDLER)
+                fi.via[ASYNC_HANDLER] = None          # directly async
+            if q in self._thread_entries:
+                fi.colors.add(THREAD_ENTRY)
+                fi.via[THREAD_ENTRY] = self._thread_entries[q]
+            # serve-loop is computed for rule authors, not yet read by
+            # GL114-117: it is the color the GL113 shape heuristic and
+            # the seeded unjoined-thread-at-shutdown rule key on
+            if _SERVE_SHAPE.search(fi.name) and any(
+                    isinstance(n, (ast.While, ast.For, ast.AsyncFor))
+                    for n in own_scope_walk(fi.node)):
+                fi.colors.add(SERVE_LOOP)
+        for ctx in self.files.values():
+            for nid in jitted_nodes(ctx):
+                fi = self._by_node.get(nid)
+                if fi is not None:
+                    fi.colors.add(JITTED)
+
+        # async-handler propagation: a function with at least one
+        # in-graph caller, ALL of whose callers are async-colored,
+        # runs only on the event loop. thread-entry/jitted functions
+        # never inherit (offloading is the sanctioned escape hatch),
+        # and a function called at module scope runs on the sync
+        # import path — never "only from async".
+        changed = True
+        while changed:
+            changed = False
+            for q, fi in self.functions.items():
+                if ASYNC_HANDLER in fi.colors \
+                        or THREAD_ENTRY in fi.colors \
+                        or JITTED in fi.colors \
+                        or q in self._sync_called:
+                    continue
+                cs = callers.get(q)
+                if not cs:
+                    continue
+                infos = [self.functions[c] for c in cs]
+                if all(ASYNC_HANDLER in c.colors for c in infos):
+                    # min() keeps the provenance chain deterministic
+                    # across runs (callers is a set)
+                    witness = self.functions[min(cs)]
+                    chain = witness.via.get(ASYNC_HANDLER)
+                    head = f"`{witness.shortname}`"
+                    fi.colors.add(ASYNC_HANDLER)
+                    fi.via[ASYNC_HANDLER] = (
+                        f"{chain} -> {head}" if chain else
+                        f"async `{witness.shortname}`")
+                    changed = True
+
+        # holds-lock: seeds are calls made inside a lock region;
+        # everything a lock-holding function calls runs under the lock
+        # too, so the color flows to all transitive callees.
+        pending = list(self._lock_seeds.items())
+        while pending:
+            q, why = pending.pop()
+            fi = self.functions.get(q)
+            if fi is None or HOLDS_LOCK in fi.colors:
+                continue
+            fi.colors.add(HOLDS_LOCK)
+            fi.via[HOLDS_LOCK] = why
+            for callee in self.edges.get(q, ()):
+                if callee in self.functions and HOLDS_LOCK not in \
+                        self.functions[callee].colors:
+                    pending.append(
+                        (callee, f"{why} -> `{fi.shortname}`"))

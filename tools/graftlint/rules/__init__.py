@@ -6,6 +6,10 @@ Codes are grouped by family:
   GL106  MXU dot hygiene    (preferred_element_type on every MXU dot)
   GL107  buffer donation    (reads of donate_argnums arguments after
                              the jitted call)
+  GL114+ concurrency        (context-colored: blocking calls in async
+                             context, locks held across blocking ops or
+                             compiled dispatch, fire-and-forget tasks,
+                             stale suppressions)
   GL2xx  shard_map hygiene  (partial-auto call shapes)
   GL3xx  Pallas bounds      (unclamped dynamic indexing, tile shapes)
   GL4xx  repo hygiene       (bare except, mutable defaults, import-time env)
@@ -16,3 +20,4 @@ from . import donation        # noqa: F401
 from . import shard_map_hygiene  # noqa: F401
 from . import pallas_bounds   # noqa: F401
 from . import hygiene         # noqa: F401
+from . import concurrency     # noqa: F401

@@ -1,0 +1,516 @@
+"""Async/concurrency rules (GL114-GL117) — the context-sensitive family
+the two-phase engine exists for.
+
+PR 12 put an asyncio gateway, a dedicated engine-stepper thread,
+watchdog/heartbeat threads, and lock-protected observability rings in
+one process. The hazards that now matter are CONTEXTUAL: the same
+`time.sleep()` that is fine in a thread entry stalls every live SSE
+stream when it runs on the event loop, and the same `open()` that is
+fine in a CLI serializes the whole metrics registry when it runs under
+the registry lock. Per-function matching cannot see context — these
+rules read it from the phase-1 ProjectIndex (`ctx.project`).
+
+GL114 blocking-call-in-async-context: `time.sleep`, sync `open()` /
+file-handle `.read()`/`.write()`, blocking socket/subprocess ops,
+`queue.Queue.get/put` with no `timeout=`, `Future.result()` /
+`Event.wait()` with no timeout — in an `async def`, or in a function
+the call graph shows is reachable ONLY from async context. The event
+loop runs one callback at a time: one blocked coroutine freezes every
+concurrent handler and every live SSE stream, with no traceback and no
+metric — just p99s through the roof. The sanctioned escapes are
+`await asyncio.sleep()`, `await loop.run_in_executor(None, fn)` (the
+executor target is colored thread-entry and exempt by construction —
+the gateway's dump-file read is the in-tree shape), and `timeout=` on
+queue/future waits.
+
+GL115 lock-held-across-blocking-or-dispatch: a `with <lock>:` body (or
+a function the call graph shows runs under one) that performs file IO,
+sleeps, joins a thread, blocks on a queue, or dispatches a compiled
+program. Every other thread touching that lock — the serving step, the
+watchdog, every metrics record — stalls behind one slow syscall or a
+whole XLA program execution. Move the slow work outside the region
+(snapshot under the lock, write after), or document the deliberate
+exceptions with a reasoned suppression (the flight recorder's manifest
+write holds its lock for multi-thread rotation atomicity — exactly
+that shape).
+
+GL116 fire-and-forget-task: `asyncio.create_task(...)` /
+`loop.create_task(...)` / `ensure_future(...)` whose task object is
+dropped (bare statement) or bound to a name nothing ever reads. The
+event loop holds only a WEAK reference to running tasks, so the task
+can be garbage-collected mid-flight, and an exception inside it
+vanishes silently (at best a "Task exception was never retrieved" at
+interpreter exit). Keep a strong reference and consume the result:
+await it, gather it, or park it in a module-level set with
+`add_done_callback(set.discard)` — the gateway's aborted-stream drain
+is the in-tree clean shape.
+
+GL117 stale-suppression: a `# graftlint: disable=GLxxx` comment that
+no finding consumed (the hazard it pointed at is gone — or was never
+there), or naming a rule id that doesn't exist. Suppressions are
+reasoned exceptions; once the code under one changes, the comment
+becomes camouflage for the NEXT real finding on that line. The scan
+phase records every (line, code) a suppressed finding consumed;
+whatever remains is rot."""
+import ast
+
+from ..core import RULES, in_paddle_tpu, rule, Finding
+from ..project import (ASYNC_HANDLER, HOLDS_LOCK, _attr_chain,
+                       lock_bindings, lock_regions, own_scope_walk)
+from .trace_safety import _jit_bound_names, _DEVICE_ATTR_PREFIX
+
+# -- blocking-op detection ---------------------------------------------------
+
+# dotted call chains that block outright, wherever they appear
+_BLOCKING_CHAINS = {
+    "time.sleep": ("time.sleep()", "sleep"),
+    "socket.create_connection": ("socket.create_connection()", "socket"),
+    "subprocess.run": ("subprocess.run()", "subprocess"),
+    "subprocess.call": ("subprocess.call()", "subprocess"),
+    "subprocess.check_call": ("subprocess.check_call()", "subprocess"),
+    "subprocess.check_output": ("subprocess.check_output()", "subprocess"),
+}
+
+# per-kind remedies, phrased for the context the rule flags
+_ASYNC_HINTS = {
+    "sleep": "await asyncio.sleep() instead",
+    "io": ("offload file IO with await loop.run_in_executor(None, ...) "
+           "— the event loop must never wait on a disk"),
+    "socket": "use asyncio streams (open_connection/start_server)",
+    "subprocess": "use asyncio's subprocess API or an executor",
+    "queue": ("pass timeout= (or get_nowait/put_nowait + backoff), or "
+              "bridge through an asyncio.Queue"),
+    "future": "asyncio.wrap_future + await it, or pass timeout=",
+    "event": "pass timeout=, or bridge through an asyncio.Event",
+    "join": "pass timeout= (an unbounded join can deadlock the loop)",
+}
+_LOCK_HINTS = {
+    "sleep": "sleep outside the region",
+    "io": "snapshot state under the lock, do the IO after releasing it",
+    "socket": "talk to the network outside the region",
+    "subprocess": "spawn outside the region",
+    "queue": "pass timeout=, or move the wait outside the region "
+             "(waiting on a queue while holding a lock is a deadlock "
+             "waiting for its second participant)",
+    "future": "pass timeout=, or resolve the future outside the region",
+    "event": "pass timeout=, or wait outside the region",
+    "join": "join outside the region (the joined thread may need this "
+            "very lock to finish)",
+    "dispatch": ("dispatch outside the region — the stepper steps "
+                 "outside its condition lock for exactly this reason"),
+}
+
+# file IO spelled as os/shutil module calls (GL115's manifest-write shape)
+_IO_CHAINS = {
+    "os.remove", "os.replace", "os.rename", "os.makedirs", "os.unlink",
+    "os.rmdir", "shutil.rmtree", "shutil.copyfile", "shutil.copy",
+    "shutil.move", "json.dump",
+}
+
+# attribute calls that are file IO on ANY receiver (pathlib idiom)
+_PATH_IO_ATTRS = {"read_text", "write_text", "read_bytes", "write_bytes"}
+
+# attribute calls that are file IO when the receiver came from open()
+_HANDLE_ATTRS = {"read", "write", "readline", "readlines", "writelines",
+                 "flush"}
+
+# blocking socket methods (receiver bound from socket.socket(...))
+_SOCKET_ATTRS = {"accept", "recv", "recvfrom", "connect", "sendall"}
+
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+                "JoinableQueue"}
+
+
+class _FileFacts:
+    """File-wide binding sets the blocking detectors type against:
+    which names/attributes hold queues, events, threads, sockets,
+    Popen handles. Collected once per file (self-attribute bindings in
+    one method are read in another by design)."""
+
+    __slots__ = ("queues", "events", "threads", "sockets", "popens",
+                 "sleep_names")
+
+    def __init__(self, ctx):
+        self.queues = set()
+        self.events = set()
+        self.threads = set()
+        self.sockets = set()
+        self.popens = set()
+        self.sleep_names = set()      # `from time import sleep`
+        queue_ok = set()              # names Queue-like ctors are bound to
+        for node in ctx.walk():
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    bound = a.asname or a.name
+                    if mod == "time" and a.name == "sleep":
+                        self.sleep_names.add(bound)
+                    elif mod in ("queue", "multiprocessing") \
+                            and a.name in _QUEUE_CTORS:
+                        queue_ok.add(bound)
+                    elif mod == "threading" and a.name == "Event":
+                        queue_ok.add("Event:" + bound)
+                    elif mod == "threading" and a.name == "Thread":
+                        queue_ok.add("Thread:" + bound)
+        for node in ctx.walk():
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            chain = _attr_chain(node.value.func)
+            f = node.value.func
+            bare = f.id if isinstance(f, ast.Name) else None
+            bucket = None
+            if chain in ("queue.Queue", "queue.LifoQueue",
+                         "queue.PriorityQueue", "queue.SimpleQueue",
+                         "multiprocessing.Queue",
+                         "multiprocessing.JoinableQueue") \
+                    or (bare in queue_ok):
+                bucket = self.queues
+            elif chain == "threading.Event" \
+                    or (bare and "Event:" + bare in queue_ok):
+                bucket = self.events
+            elif chain == "threading.Thread" \
+                    or (bare and "Thread:" + bare in queue_ok):
+                bucket = self.threads
+            elif chain in ("socket.socket", "socket.create_connection"):
+                bucket = self.sockets
+            elif chain == "subprocess.Popen":
+                bucket = self.popens
+            if bucket is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    bucket.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    bucket.add(t.attr)
+
+
+def _receiver_key(expr):
+    """`q` -> "q", `self._q` / `obj._q` -> "_q", else None."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _has_timeout(call, block_arg_index=None):
+    """A queue/future/event wait that carries any timeout (or an
+    explicit non-blocking flag) yields the thread — not a hazard.
+    `block_arg_index` recognizes the queue `(block, timeout)` positional
+    tail: index 0 for `get(block, timeout)`, 1 for
+    `put(item, block, timeout)` — `q.get(True, 5)` times out,
+    `q.put(x, False)` doesn't block at all."""
+    for kw in call.keywords:
+        if kw.arg == "timeout" and not (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is None):
+            return True
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    if block_arg_index is not None:
+        if len(call.args) >= block_arg_index + 2:
+            return True             # positional timeout present
+        if len(call.args) > block_arg_index and isinstance(
+                call.args[block_arg_index], ast.Constant) \
+                and call.args[block_arg_index].value is False:
+            return True             # positional block=False
+    return False
+
+
+def _blocking_ops(ctx, nodes, facts, jit_names=None):
+    """Yield (node, what, kind) for every blocking call in `nodes`
+    (an iterable from one lexical scope); `kind` keys the per-context
+    remedy tables. With `jit_names`, compiled-program dispatches count
+    too (the GL115 variant)."""
+    nodes = list(nodes)
+    handles = set()          # names bound from open() in this scope
+    futures = set()          # names bound from <x>.submit(...) / Future()
+    for node in nodes:
+        targets = values = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            targets, value = [node.optional_vars], node.context_expr
+        else:
+            continue
+        if not isinstance(value, ast.Call):
+            continue
+        f = value.func
+        if isinstance(f, ast.Name) and f.id == "open":
+            dest = handles
+        elif isinstance(f, ast.Attribute) and f.attr == "submit":
+            dest = futures
+        elif _attr_chain(f) in ("concurrent.futures.Future",
+                                "futures.Future"):
+            dest = futures
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                dest.add(t.id)
+
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        chain = _attr_chain(f)
+        if chain in _BLOCKING_CHAINS:
+            what, kind = _BLOCKING_CHAINS[chain]
+            yield node, f"blocking {what}", kind
+            continue
+        if chain in _IO_CHAINS:
+            yield node, f"file IO `{chain}()`", "io"
+            continue
+        if isinstance(f, ast.Name):
+            if f.id == "open":
+                yield node, "sync `open()`", "io"
+            elif f.id in facts.sleep_names and chain == f.id:
+                yield node, "blocking time.sleep()", "sleep"
+            elif jit_names is not None and f.id in jit_names:
+                yield node, \
+                    f"compiled-program dispatch `{f.id}()`", "dispatch"
+            continue
+        if not isinstance(f, ast.Attribute):
+            continue
+        recv = _receiver_key(f.value)
+        if f.attr in _PATH_IO_ATTRS:
+            yield node, f"file IO `.{f.attr}()`", "io"
+        elif f.attr in _HANDLE_ATTRS and isinstance(f.value, ast.Name) \
+                and f.value.id in handles:
+            yield node, f"file `.{f.attr}()` on an open() handle", "io"
+        elif f.attr in _SOCKET_ATTRS and recv in facts.sockets:
+            yield node, f"blocking socket `.{f.attr}()`", "socket"
+        elif f.attr in ("communicate", "wait") and recv in facts.popens:
+            yield node, f"Popen `.{f.attr}()`", "subprocess"
+        elif f.attr in ("get", "put") and recv in facts.queues \
+                and not _has_timeout(
+                    node, block_arg_index=0 if f.attr == "get" else 1):
+            yield node, f"queue `.{f.attr}()` with no timeout=", "queue"
+        elif f.attr == "result" and not node.args \
+                and not _has_timeout(node) \
+                and (recv in futures
+                     or (isinstance(f.value, ast.Call)
+                         and isinstance(f.value.func, ast.Attribute)
+                         and f.value.func.attr == "submit")):
+            yield node, "Future.result() with no timeout", "future"
+        elif f.attr == "wait" and recv in facts.events \
+                and not node.args and not _has_timeout(node):
+            yield node, "Event.wait() with no timeout", "event"
+        elif f.attr == "join" and recv in facts.threads \
+                and not node.args and not _has_timeout(node):
+            yield node, "Thread.join() with no timeout", "join"
+        elif jit_names is not None and (
+                f.attr in jit_names
+                or f.attr.startswith(_DEVICE_ATTR_PREFIX)):
+            yield node, \
+                f"compiled-program dispatch `{f.attr}()`", "dispatch"
+
+
+def _region_nodes(with_node):
+    """Nodes of a lock region's body, pruned at nested def/lambda
+    boundaries (a def's body runs later, not under the lock)."""
+    stack = list(with_node.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _awaited(ctx, node):
+    return isinstance(ctx.parent(node), ast.Await)
+
+
+# -- GL114 -------------------------------------------------------------------
+
+_GL114_MSG = (
+    "the event loop runs one callback at a time — while this blocks, "
+    "EVERY concurrent handler and live SSE stream in the process "
+    "freezes, with no traceback and no metric, just p99s through the "
+    "roof")
+
+
+@rule("GL114", "blocking-call-in-async-context", "concurrency",
+      applies=in_paddle_tpu)
+def blocking_call_in_async_context(ctx):
+    """Blocking calls in an `async def`, or in a function the phase-1
+    call graph shows is reachable ONLY from async context — the
+    interprocedural half is the point: a sleep two helpers deep under a
+    handler stalls the loop exactly as hard as one spelled inline."""
+    idx = ctx.project
+    if idx is None:
+        return
+    facts = _FileFacts(ctx)
+    for fi in idx.functions_in(ctx.path):
+        if ASYNC_HANDLER not in fi.colors:
+            continue
+        via = fi.via.get(ASYNC_HANDLER)
+        for node, what, kind in _blocking_ops(
+                ctx, own_scope_walk(fi.node), facts):
+            if _awaited(ctx, node):
+                continue        # the loop-friendly spelling
+            if via is None:
+                where = f"inside `async def {fi.name}`"
+            else:
+                where = (f"in `{fi.shortname}`, reachable only from "
+                         f"async context (via {via})")
+            yield ctx.finding(
+                "GL114", node,
+                f"{what} {where}: {_GL114_MSG} — "
+                f"{_ASYNC_HINTS[kind]}"), node
+
+
+# -- GL115 -------------------------------------------------------------------
+
+_GL115_MSG = (
+    "every thread that touches this lock — the serving step, the "
+    "watchdog, every metrics record — stalls behind it. Snapshot under "
+    "the lock, do the slow work after (a deliberate exception, like the "
+    "flight recorder's manifest-rotation atomicity, documents itself "
+    "with a reasoned suppression)")
+
+
+@rule("GL115", "lock-held-across-blocking-or-dispatch", "concurrency",
+      applies=in_paddle_tpu)
+def lock_held_across_blocking(ctx):
+    """File IO / sleep / thread-join / blocking queue ops / compiled-
+    program dispatch inside a `with <lock>:` body, or anywhere in a
+    function the call graph shows runs under one."""
+    idx = ctx.project
+    if idx is None:
+        return
+    facts = _FileFacts(ctx)
+    jit_names = _jit_bound_names(ctx)
+    extra = idx.lock_attr_names if idx is not None else ()
+    names, attrs = lock_bindings(ctx, extra_attrs=extra)
+    seen = set()
+    for region, spelled in lock_regions(ctx, names, attrs):
+        for node, what, kind in _blocking_ops(
+                ctx, _region_nodes(region), facts, jit_names=jit_names):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            yield ctx.finding(
+                "GL115", node,
+                f"{what} while holding `{spelled}`: {_GL115_MSG} — "
+                f"{_LOCK_HINTS[kind]}"), node
+    for fi in idx.functions_in(ctx.path):
+        if HOLDS_LOCK not in fi.colors:
+            continue
+        via = fi.via.get(HOLDS_LOCK)
+        for node, what, kind in _blocking_ops(
+                ctx, own_scope_walk(fi.node), facts, jit_names=jit_names):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            yield ctx.finding(
+                "GL115", node,
+                f"{what} in `{fi.shortname}`, which runs with a lock "
+                f"held ({via}): {_GL115_MSG} — {_LOCK_HINTS[kind]}"), node
+
+
+# -- GL116 -------------------------------------------------------------------
+
+_GL116_MSG = (
+    "the loop keeps only a WEAK reference to running tasks — a dropped "
+    "task can be garbage-collected mid-flight, and an exception inside "
+    "it vanishes silently. Keep a strong reference and consume the "
+    "result: await/gather it, or park it in a module-level set with "
+    "add_done_callback(set.discard) (the gateway's aborted-stream drain "
+    "is the in-tree shape)")
+
+
+def _is_task_spawn(node):
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) \
+            and f.attr in ("create_task", "ensure_future"):
+        return f.attr
+    if isinstance(f, ast.Name) and f.id == "ensure_future":
+        return f.id
+    return None
+
+
+@rule("GL116", "fire-and-forget-task", "concurrency",
+      applies=in_paddle_tpu)
+def fire_and_forget_task(ctx):
+    """`create_task(...)` / `ensure_future(...)` whose task object is a
+    bare statement, or bound to a name nothing ever reads — no await,
+    no done-callback, no strong reference."""
+    for node in ctx.walk():
+        spawn = _is_task_spawn(node)
+        if spawn is None:
+            continue
+        parent = ctx.parent(node)
+        if isinstance(parent, ast.Expr):
+            yield ctx.finding(
+                "GL116", node,
+                f"fire-and-forget `{spawn}(...)`: the task object is "
+                f"dropped on the floor — {_GL116_MSG}"), node
+            continue
+        if isinstance(parent, ast.Assign) \
+                and len(parent.targets) == 1 \
+                and isinstance(parent.targets[0], ast.Name):
+            name = parent.targets[0].id
+            fns = ctx.enclosing_functions(node)
+            scope = fns[0] if fns else ctx.tree
+            used = any(
+                isinstance(n, ast.Name) and n.id == name
+                and isinstance(n.ctx, ast.Load)
+                for n in ast.walk(scope))
+            if not used:
+                yield ctx.finding(
+                    "GL116", node,
+                    f"`{spawn}(...)` bound to `{name}` which nothing "
+                    f"ever reads: still fire-and-forget — {_GL116_MSG}"
+                ), node
+
+
+# -- GL117 (post phase) ------------------------------------------------------
+
+_GL117_STALE = (
+    "no finding consumed this suppression — the hazard it pointed at is "
+    "gone (or was never here). A stale disable is camouflage for the "
+    "NEXT real finding on this line: remove the comment (or re-point it "
+    "at the rule that actually fires)")
+
+
+def _judge_suppression(ctx, line, code, used, where):
+    at = line if line > 0 else 1
+    if code != "all" and code not in RULES:
+        return Finding(
+            code="GL117", path=ctx.path, line=at, col=0,
+            message=(f"{where} names unknown rule id `{code}`: nothing "
+                     "can ever consume it — fix the id (see "
+                     "--list-rules) or remove the comment"))
+    if (line, code) not in used:
+        label = "blanket `disable=all`" if code == "all" \
+            else f"`disable={code}`"
+        return Finding(
+            code="GL117", path=ctx.path, line=at, col=0,
+            message=f"stale {where} ({label}): {_GL117_STALE}")
+    return None
+
+
+@rule("GL117", "stale-suppression", "concurrency", phase="post")
+def stale_suppression(ctx):
+    """A `# graftlint: disable=` comment no finding consumed, or naming
+    an unknown rule id. Runs in the post phase: the scan rules have
+    already recorded every (line, code) their suppressed findings
+    consumed into `ctx.used_suppressions`."""
+    used = ctx.used_suppressions
+    for line in sorted(ctx.line_suppress):
+        for code in sorted(ctx.line_suppress[line]):
+            f = _judge_suppression(ctx, line, code, used,
+                                   "suppression comment")
+            if f is not None:
+                yield f, None
+    for code in sorted(ctx.file_suppress):
+        f = _judge_suppression(ctx, 0, code, used,
+                               "file-level suppression")
+        if f is not None:
+            yield f, None

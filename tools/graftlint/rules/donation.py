@@ -56,7 +56,7 @@ def _donating_bindings(ctx):
     """{dotted name: donated positions} for every jit-with-donation
     binding visible in this file."""
     out = {}
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
                 and _is_jitish(node.value.func):
             pos = _donated_positions(node.value)
@@ -97,7 +97,7 @@ def donated_buffer_reuse(ctx):
     bindings = _donating_bindings(ctx)
     if not bindings:
         return
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.Call):
             continue
         chain = _target_chain(node.func)

@@ -19,7 +19,7 @@ from ..core import rule, in_paddle_tpu
 @rule("GL401", "bare-except", "hygiene", applies=in_paddle_tpu)
 def bare_except(ctx):
     """`except:` with no exception type."""
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, ast.ExceptHandler) and node.type is None:
             yield ctx.finding(
                 "GL401", node,
@@ -31,7 +31,7 @@ def bare_except(ctx):
 @rule("GL402", "mutable-default-arg", "hygiene", applies=in_paddle_tpu)
 def mutable_default_arg(ctx):
     """def f(x=[]) / f(x={}) / f(x=set()): one shared object across calls."""
-    for fn in ast.walk(ctx.tree):
+    for fn in ctx.walk():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         defaults = list(fn.args.defaults) + [

@@ -31,14 +31,14 @@ def mxu_dot_preferred(ctx):
     pallas_scope = in_pallas(ctx)
     jit_nodes = set()
     if not pallas_scope:
-        for fn in ast.walk(ctx.tree):
+        for fn in ctx.walk():
             if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and any(_is_jitish(d) for d in fn.decorator_list):
                 for n in ast.walk(fn):
                     jit_nodes.add(id(n))
         if not jit_nodes:
             return
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.Call):
             continue
         f = node.func

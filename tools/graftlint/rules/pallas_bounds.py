@@ -148,7 +148,7 @@ def unclamped_dynamic_index(ctx):
             taint_cache[fn] = _tainted_names(fn)
         return taint_cache[fn]
 
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         # x.at[IDX] — jnp functional updates and ref.at DMA slices alike
         if isinstance(node, ast.Subscript) \
                 and isinstance(node.value, ast.Attribute) \
@@ -177,7 +177,7 @@ def unclamped_dynamic_index(ctx):
 def block_shape_tile(ctx):
     """Literal BlockSpec block shapes whose trailing dims don't divide the
     (8, 128) TPU tile."""
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.Call):
             continue
         f = node.func

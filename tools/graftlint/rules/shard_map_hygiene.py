@@ -30,7 +30,7 @@ def partial_auto_shard_map(ctx):
     """shard_map(..., axis_names=...) / shard_map(..., auto=...): manual
     over a subset of mesh axes, the partial-auto mode jax 0.4.x crashes
     on."""
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.Call):
             continue
         if _callee_name(node) != "shard_map":

@@ -92,22 +92,15 @@ import ast
 import re
 
 from ..core import in_pallas, rule
+# shared AST helpers live with the phase-1 engine; re-exported here for
+# the other rule families that import them from this module
+from ..project import _attr_chain, _is_jitish, own_scope_walk  # noqa: F401
+
+_own_scope_walk = own_scope_walk
 
 # the one module allowed to touch raw jax shard_map / CompilerParams
 # spellings: it IS the resolver
 COMPAT_MODULE = "paddle_tpu/framework/compat.py"
-
-
-def _attr_chain(node):
-    """Dotted-name string for Attribute/Name chains, '' otherwise."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
 
 
 @rule("GL101", "raw-shard-map-import", "trace-safety")
@@ -120,7 +113,7 @@ def raw_shard_map_import(ctx):
            "time and (if reachable from a test module) silently removes the "
            "module from collection — route through "
            "paddle_tpu.framework.compat.resolve_shard_map")
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, ast.ImportFrom):
             mod = node.module or ""
             if mod in ("jax", "jax.experimental") and any(
@@ -143,7 +136,7 @@ def compiler_params_direct(ctx):
     access outside the compat resolver."""
     if ctx.path == COMPAT_MODULE:
         return
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if (isinstance(node, ast.Attribute)
                 and node.attr in ("CompilerParams", "TPUCompilerParams")):
             yield ctx.finding(
@@ -154,32 +147,12 @@ def compiler_params_direct(ctx):
                 "works"), node
 
 
-_JIT_NAMES = {"jit", "pjit"}
-
-
-def _is_jitish(expr):
-    if isinstance(expr, ast.Name):
-        return expr.id in _JIT_NAMES
-    if isinstance(expr, ast.Attribute):
-        return expr.attr in _JIT_NAMES
-    if isinstance(expr, ast.Call):
-        if _is_jitish(expr.func):
-            return True  # @jax.jit(static_argnums=...)
-        f = expr.func
-        is_partial = ((isinstance(f, ast.Name) and f.id == "partial")
-                      or (isinstance(f, ast.Attribute)
-                          and f.attr == "partial"))
-        if is_partial:
-            return any(_is_jitish(a) for a in expr.args)
-    return False
-
-
 @rule("GL103", "host-op-in-jit", "trace-safety")
 def host_op_in_jit(ctx):
     """print / .item() / numpy calls inside a jax.jit- or pjit-decorated
     function: print fires at trace time (zero or one time, not per step),
     .item() forces a device sync, np.* constant-folds under the trace."""
-    for fn in ast.walk(ctx.tree):
+    for fn in ctx.walk():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         if not any(_is_jitish(d) for d in fn.decorator_list):
@@ -220,7 +193,7 @@ def interpret_literal(ctx):
     """Hard-coded `interpret=True` at a call site — route through the
     kernel module's `_interpret()`/`_interpret_mode()` helper so tests
     flip ONE switch and production never ships the interpreter."""
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.Call):
             continue
         for kw in node.keywords:
@@ -257,7 +230,7 @@ def _observability_names(ctx):
     `paddle_tpu` alias would flag every paddle_tpu.* call in the
     file)."""
     mod_aliases, symbols, dotted = set(), set(), set()
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name == "paddle_tpu.observability" or \
@@ -306,7 +279,7 @@ def observability_in_jit(ctx):
     mod_aliases, symbols, dotted = _observability_names(ctx)
     if not mod_aliases and not symbols and not dotted:
         return
-    for fn in ast.walk(ctx.tree):
+    for fn in ctx.walk():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         if not any(_is_jitish(d) for d in fn.decorator_list):
@@ -336,12 +309,12 @@ def _jitted_functions(ctx):
     where `fn` is a function defined in this file — the engines' idiom:
     `self._step = jax.jit(step, donate_argnums=(1,))`)."""
     defs = {}
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             defs.setdefault(node.name, []).append(node)
     jitted = []
     seen = set()
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                 and any(_is_jitish(d) for d in node.decorator_list):
             if id(node) not in seen:
@@ -361,7 +334,7 @@ def _array_aliases(ctx):
     ...) — the constructors whose module-level results are almost
     certainly arrays."""
     aliases = set(ctx.numpy_aliases)
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name in ("jax.numpy",) and a.asname:
@@ -406,26 +379,6 @@ def _param_names(a):
     if a.kwarg:
         names.add(a.kwarg.arg)
     return names
-
-
-def _own_scope_walk(fn):
-    """Walk the nodes of `fn`'s OWN lexical scope: everything reachable
-    without crossing into a nested def/lambda body. The nested node
-    itself is yielded (its name binds here, and its decorators/argument
-    defaults evaluate here) — its body is a separate scope."""
-    body = fn.body if isinstance(fn.body, list) else [fn.body]
-    stack = list(body)
-    while stack:
-        node = stack.pop()
-        yield node
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)):
-            stack.extend(getattr(node, "decorator_list", ()))
-            stack.extend(d for d in node.args.defaults if d is not None)
-            stack.extend(d for d in node.args.kw_defaults
-                         if d is not None)
-        else:
-            stack.extend(ast.iter_child_nodes(node))
 
 
 def _local_names(fn):
@@ -508,7 +461,7 @@ def _jit_bound_names(ctx):
     _dispatch_span("...", jax.jit(fn, ...))`, and decorator-factory
     wrappers. A CALL of one of these names is a device dispatch."""
     out = set()
-    for stmt in ast.walk(ctx.tree):
+    for stmt in ctx.walk():
         if isinstance(stmt, ast.Assign):
             targets, value = stmt.targets, stmt.value
         elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
@@ -635,7 +588,7 @@ def host_sync_in_serve_loop(ctx):
     (the serving-loop analogue of GL103's .item()). Convert ONCE with a
     bulk np.asarray() and do host math on the copy."""
     jit_names = _jit_bound_names(ctx)
-    for fn in ast.walk(ctx.tree):
+    for fn in ctx.walk():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         dev = _device_bindings(fn, jit_names, ctx.numpy_aliases)
@@ -688,7 +641,7 @@ def _dict_set_names(ctx):
     containers whose __contains__/__getitem__/.get/.add HASH their
     argument."""
     out = set()
-    for stmt in ast.walk(ctx.tree):
+    for stmt in ctx.walk():
         if isinstance(stmt, ast.Assign):
             targets, value = stmt.targets, stmt.value
         elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
@@ -740,7 +693,7 @@ def device_array_hash_key(ctx):
     block_key hashes host token bytes for exactly this reason."""
     jit_names = _jit_bound_names(ctx)
     containers = _dict_set_names(ctx)
-    for fn in ast.walk(ctx.tree):
+    for fn in ctx.walk():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         dev = _device_bindings(fn, jit_names, ctx.numpy_aliases)
@@ -856,7 +809,7 @@ def wallclock_interval(ctx):
     # attribute stamps are collected FILE-wide: `self._t0 = time.time()`
     # in one method is read in another by design
     attrs = set()
-    for n in ast.walk(ctx.tree):
+    for n in ctx.walk():
         if isinstance(n, ast.Assign) and _is_time_time_call(n.value):
             for t in n.targets:
                 if isinstance(t, ast.Attribute):
@@ -895,7 +848,7 @@ def wallclock_interval(ctx):
             return True
         return False
 
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
             names = names_for(node)
             if is_walltime(node.left, names) \
@@ -1039,7 +992,7 @@ def swallowed_cancellation(ctx):
     per-request and VISIBLE — a swallowed failure in a serving loop is
     an infinite retry with no evidence trail."""
     seen = set()
-    for fn in ast.walk(ctx.tree):
+    for fn in ctx.walk():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         if not _GL113_LOOPFN.search(fn.name):
@@ -1070,7 +1023,7 @@ def metric_label_cardinality(ctx):
     (request_id / raw prompt content): unbounded label cardinality.
     Bucketed interpolations (function calls inside the f-string) and
     fixed literal labels never flag."""
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
                 and node.func.attr == "labels" and node.keywords):

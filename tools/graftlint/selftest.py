@@ -5,11 +5,15 @@ line:
 
     # graftlint-corpus-expect: GL101 GL103 GL103
 
-(`none` asserts the file is CLEAN — the false-positive tripwire). The
-self-test fails if any declared code is missing, if a `none` file raises
-anything, or if any rule family has no corpus coverage at all — so a
-refactor that silently lobotomizes a rule family fails CI the same way a
-reintroduced bug would.
+(`none` asserts the file is CLEAN — the false-positive tripwire; a
+clean file must ALSO declare which rules' correct spellings it pins
+with `# graftlint-corpus-rule: GL101 GL103 ...`). The self-test fails
+if any declared code is missing, if a `none` file raises anything, if
+any rule family has no corpus coverage at all, or if a corpus file is
+ORPHANED — claimed by no registered rule (its expect/rule header names
+only retired codes) — so a refactor that silently lobotomizes a rule
+family, or a dead fixture that outlives its rule, fails CI the same
+way a reintroduced bug would.
 """
 import re
 import sys
@@ -20,9 +24,10 @@ from .core import CORPUS_DIR, RULES, lint_file
 from . import rules  # noqa: F401
 
 _EXPECT_RE = re.compile(r"#\s*graftlint-corpus-expect:\s*(.+)")
+_CLAIM_RE = re.compile(r"#\s*graftlint-corpus-rule:\s*(.+)")
 
 FAMILIES = ("trace-safety", "mxu", "donation", "shard-map",
-            "pallas-bounds", "hygiene")
+            "pallas-bounds", "hygiene", "concurrency")
 
 
 def corpus_expectations(path):
@@ -33,6 +38,16 @@ def corpus_expectations(path):
             "`# graftlint-corpus-expect:` header")
     toks = m.group(1).split()
     return [] if toks == ["none"] else toks
+
+
+def corpus_claims(path):
+    """The rule codes a corpus file is CLAIMED by: its expected codes,
+    plus (clean tripwires) the `# graftlint-corpus-rule:` header."""
+    claims = list(corpus_expectations(path))
+    m = _CLAIM_RE.search(Path(path).read_text())
+    if m:
+        claims.extend(m.group(1).split())
+    return claims
 
 
 def run_selftest(out=sys.stdout):
@@ -67,6 +82,21 @@ def run_selftest(out=sys.stdout):
             failures.append(
                 f"{f.name}: unexpected codes {sorted(extra)} — extend the "
                 "expect header if intentional")
+    for f in files:
+        # orphan check: a fixture no registered rule claims is dead
+        # weight that reads as coverage — fail it out of the corpus
+        claims = corpus_claims(f)
+        known = [c for c in claims if c in RULES]
+        unknown = [c for c in claims if c not in RULES]
+        if unknown:
+            failures.append(
+                f"{f.name}: claims unregistered rule(s) {sorted(set(unknown))}"
+                " — retire the fixture with the rule, or fix the header")
+        if not known:
+            failures.append(
+                f"{f.name}: ORPHANED — claimed by no registered rule "
+                "(clean tripwires must name their rules in a "
+                "`# graftlint-corpus-rule:` header)")
     for fam in FAMILIES:
         if fam not in covered_families:
             failures.append(

@@ -1,9 +1,14 @@
 #!/usr/bin/env bash
 # Static-analysis entry point: rule self-test corpus first (a lobotomized
-# rule must not green-light the tree scan), then the tree scan itself.
-# Extra args pass through to the tree scan, e.g.
+# rule must not green-light the tree scan; the selftest also fails any
+# ORPHANED corpus file no registered rule claims), then the full-tree
+# two-phase scan — its summary prints the per-phase timing split
+# (phase1 parse+index, phase2 rules) so a gate-cost regression is
+# attributable at a glance. Extra args pass through to the tree scan:
 #   tools/lint.sh --show-baselined
 #   tools/lint.sh --write-baseline      # triage mode: regenerate baseline
+# Fast pre-commit loop (diff-scoped phase 2, full-tree phase 1):
+#   python -m tools.graftlint --changed
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
