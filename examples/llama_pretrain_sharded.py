@@ -32,7 +32,24 @@ def main():
     ap.add_argument("--metrics", action="store_true",
                     help="count XLA compiles + step time/tokens-per-s "
                          "and print the metrics snapshot at the end")
+    ap.add_argument("--health", action="store_true",
+                    help="training health monitoring: per-layer-group "
+                         "gradient telemetry + divergence detection "
+                         "(TrainHealthMonitor), step-phase breakdown, "
+                         "and the arm-by-default flight recorder — a "
+                         "NaN'd loss or a starved pipeline leaves a "
+                         "dump instead of a ruined run")
     args = ap.parse_args()
+
+    monitor = None
+    if args.health:
+        from paddle_tpu import observability as obs
+        from paddle_tpu.observability import tracing
+        # serve entrypoints arm by default (PR 8); with --health the
+        # pretrain example does too: breach dumps land in
+        # $PADDLE_TPU_FLIGHT_DIR (or the tmp default) under retention
+        tracing.arm_default()
+        monitor = obs.TrainHealthMonitor()
 
     if args.metrics:
         from paddle_tpu import observability as obs
@@ -48,19 +65,47 @@ def main():
     mesh = pretrain.make_mesh(n_dev, dp=args.dp, fsdp=args.fsdp,
                               mp=args.mp, sp=args.sp)
     params, opt_state, meta = pretrain.make_train_state(model, mesh)
-    step = pretrain.make_train_step(model, mesh, meta)
+    step = pretrain.make_train_step(model, mesh, meta, monitor=monitor)
 
     rng = np.random.default_rng(0)
-    for i in range(args.steps):
-        batch = pretrain.shard_batch(
-            {"input_ids": rng.integers(0, cfg.vocab_size,
-                                       (args.batch, args.seq)).astype(
-                                           np.int32),
-             "labels": rng.integers(0, cfg.vocab_size,
-                                    (args.batch, args.seq)).astype(
-                                        np.int32)}, mesh)
+
+    def gen_batches():
+        for _ in range(args.steps):
+            yield {"input_ids": rng.integers(
+                       0, cfg.vocab_size,
+                       (args.batch, args.seq)).astype(np.int32),
+                   "labels": rng.integers(
+                       0, cfg.vocab_size,
+                       (args.batch, args.seq)).astype(np.int32)}
+
+    batches = gen_batches()
+    if monitor is not None:
+        # data-pipeline telemetry: per-batch wait + stall detection on
+        # the same monitor (a real run would set instrument=True on
+        # its DataLoader instead)
+        from paddle_tpu.observability import train_health
+        batches = train_health.instrument_loader(batches,
+                                                 monitor=monitor)
+    for i, host_batch in enumerate(batches):
+        batch = pretrain.shard_batch(host_batch, mesh)
         params, opt_state, loss, gnorm = step(params, opt_state, batch)
         print(f"step {i}: loss {float(loss):.4f} gnorm {float(gnorm):.3f}")
+
+    if monitor is not None:
+        rep = monitor.report()
+        print(f"train health: {rep['breaches_total']} breaches over "
+              f"{rep['steps_observed']} monitored steps "
+              f"({rep['breach_counts'] or 'all checks quiet'})")
+        from paddle_tpu import observability as obs
+        snap = obs.get_registry().snapshot()
+        groups = snap.get("train_group_grad_norm", {}).get("children",
+                                                           {})
+        ratios = snap.get("train_group_update_ratio",
+                          {}).get("children", {})
+        for label in groups:
+            print(f"  {label:>14}: grad_norm "
+                  f"{groups[label]['value']:.4f}  upd/param "
+                  f"{ratios.get(label, {}).get('value', 0):.2e}")
 
     if args.metrics:
         reg = obs.get_registry()

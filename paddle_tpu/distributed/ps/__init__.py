@@ -520,6 +520,7 @@ class PsServer:
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
         self._threads = []
+        self._conns = []        # live handler connections (for stop())
         self._barrier_count = 0
         self._barrier_world = barrier_world_size
         self._barrier_cond = threading.Condition()
@@ -612,11 +613,36 @@ class PsServer:
             th = threading.Thread(target=self._handle, args=(conn,),
                                   daemon=True)
             th.start()
-            self._threads.append(th)
+            # prune finished handlers so a long-lived server's thread
+            # (and connection) lists stay bounded by its CONCURRENT
+            # connection count
+            live = [(t, c) for t, c in zip(self._threads, self._conns)
+                    if t.is_alive()]
+            live.append((th, conn))
+            self._threads = [t for t, _ in live]
+            self._conns = [c for _, c in live]
         self._sock.close()
 
     def stop(self):
         self._stop.set()
+        # GL118: signal, then join with a timeout. An idle handler sits
+        # in a blocking recv that never observes the event — shut its
+        # connection down FIRST so the recv returns and the thread
+        # exits, instead of every join timing out with the thread still
+        # alive (the teardown race this stop() exists to prevent).
+        # shutdown(), not just close(): closing an fd another thread is
+        # blocked recv()ing on does not reliably wake that thread
+        for c in list(self._conns):     # serve loop may still append
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in list(self._threads):
+            t.join(timeout=2.0)
 
 
 class PsClient:

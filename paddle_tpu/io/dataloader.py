@@ -197,8 +197,18 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=False, timeout=0, worker_init_fn=None,
-                 persistent_workers=False, use_process_workers=False):
+                 persistent_workers=False, use_process_workers=False,
+                 instrument=False):
         self.dataset = dataset
+        # instrument=True wraps iteration with the training-health
+        # data-pipeline telemetry (observability/train_health.py:
+        # per-batch wait histogram + `data_wait` chrome spans,
+        # queue-depth gauge, stall detector). Off by default: the
+        # loader stays importable/usable without the observability
+        # stack in the loop.
+        self.instrument = bool(instrument)
+        self.health_monitor = None      # TrainHealthMonitor, optional
+        self._live_queue = None         # thread-prefetch queue, live
         self.collate_fn = collate_fn or default_collate_fn
         self._custom_collate = collate_fn is not None
         self.num_workers = num_workers
@@ -453,6 +463,20 @@ class DataLoader:
             pass
 
     def __iter__(self):
+        if self.instrument:
+            # lazy import: the observability stack only loads when the
+            # caller opted into telemetry
+            from ..observability import train_health as _th
+            return _th.instrument_loader(
+                self._iter_impl(), monitor=self.health_monitor,
+                queue_depth=self._queue_depth)
+        return self._iter_impl()
+
+    def _queue_depth(self):
+        q = self._live_queue
+        return q.qsize() if q is not None else 0
+
+    def _iter_impl(self):
         if self.num_workers == 0:
             yield from self._batches()
             return
@@ -497,13 +521,22 @@ class DataLoader:
             except BaseException as e:  # propagate into consumer
                 err.append(e)
             finally:
-                try:
-                    q.put_nowait(sentinel)
-                except queue.Full:
-                    pass  # consumer is gone; closed flag ends the thread
+                # the sentinel gets the SAME closed-flag retry loop as
+                # data puts: a put_nowait here dropped it whenever the
+                # consumer was merely SLOW (queue still full at epoch
+                # end), leaving the consumer blocked on q.get() forever
+                # — exposed by the instrumented-loader stall test, which
+                # slows the consumer by a histogram observe per batch
+                while not closed.is_set():
+                    try:
+                        q.put(sentinel, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
+        self._live_queue = q
         try:
             while True:
                 b = q.get()
@@ -513,6 +546,7 @@ class DataLoader:
         finally:
             # consumer abandoned mid-epoch (break in a training loop):
             # unblock and retire the producer instead of leaking it
+            self._live_queue = None
             closed.set()
             try:
                 while True:

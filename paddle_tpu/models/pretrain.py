@@ -202,13 +202,64 @@ def _adamw(params, grads, opt_state, lr, b1, b2, eps, wd, clip):
     return new_params, new_state, gnorm
 
 
-def make_train_step(model, mesh, meta, donate=True):
+def _pack_telemetry(loss, gnorm, params, grads, new_params, spec):
+    """In-graph per-layer-group telemetry: ONE packed f32 vector —
+    [loss, gnorm, then (grad_norm, param_norm, update_norm,
+    nonfinite_count) per group in spec order] — so the host fetches
+    every per-group figure in ONE bulk transfer on the telemetry
+    cadence, never one sync per tensor (the GL109 discipline). Pure
+    extra outputs of the step program: the loss/update math is
+    untouched, which is what makes telemetry-on loss-bit-exact."""
+    rows = []
+    for _label, names in spec.groups:
+        g2 = p2 = u2 = nf = jnp.float32(0.0)
+        for n in names:
+            g = grads[n].astype(jnp.float32)
+            p = params[n].astype(jnp.float32)
+            q = new_params[n].astype(jnp.float32)
+            g2 = g2 + jnp.sum(jnp.square(g))
+            p2 = p2 + jnp.sum(jnp.square(p))
+            u2 = u2 + jnp.sum(jnp.square(q - p))
+            nf = nf + jnp.sum((~jnp.isfinite(g)).astype(jnp.float32))
+        rows.append(jnp.stack([jnp.sqrt(g2), jnp.sqrt(p2),
+                               jnp.sqrt(u2), nf]))
+    head = jnp.stack([loss.astype(jnp.float32),
+                      gnorm.astype(jnp.float32)])
+    return jnp.concatenate([head] + rows)
+
+
+def make_train_step(model, mesh, meta, donate=True, telemetry=False,
+                    telemetry_every=1, monitor=None):
     """Jitted (params, opt_state, batch) -> (params, opt_state, loss, gnorm).
     batch = {input_ids: [B,S] int32, labels: [B,S] int32}, sharded
-    ('dp','fsdp') × 'sp' by `shard_batch`."""
+    ('dp','fsdp') × 'sp' by `shard_batch`.
+
+    ``telemetry=True`` (implied by ``monitor=``) grows the jitted step
+    with the packed per-layer-group health vector (`_pack_telemetry`)
+    and the step-phase breakdown (data-wait / host / dispatch
+    histograms + `train` chrome-lane spans). The vector stays on
+    device; every ``telemetry_every`` steps the wrapper fetches it in
+    one bulk `np.asarray`, lands the train_group_* gauges, and hands
+    the unpacked dict to the ``TrainHealthMonitor`` when one is
+    attached. Telemetry must be a pure observer: loss-bit-exact vs
+    telemetry-off and compile-count-neutral after warmup — both gated
+    by tools/train_monitor.py --check.
+
+    ``run(..., lr_scale=)`` routes through a SECOND jitted program
+    with the scale as a traced argument (built on first use — the
+    default path's program is byte-identical with or without it);
+    testing/faults.py uses it to inject lr-spike faults without
+    touching the step treadmill."""
     buffers = meta["buffers"]
     lr, (b1, b2) = meta["lr"], meta["betas"]
     eps, wd, clip = meta["eps"], meta["weight_decay"], meta["grad_clip"]
+    telemetry = telemetry or monitor is not None
+    spec = None
+    if telemetry:
+        from ..observability import train_health as _th
+        params0, _ = state_arrays(model)
+        spec = _th.build_telemetry_spec(
+            {n: p.ndim for n, p in params0.items()})
     # AMP-O2 master-weight pattern (reference amp/auto_cast.py O2 +
     # GradScaler master weights): optimizer holds fp32 params, the jitted
     # step computes fwd/bwd in bf16 casts — no loss scaling needed on TPU
@@ -228,18 +279,33 @@ def make_train_step(model, mesh, meta, donate=True):
         _, loss = out
         return loss.astype(jnp.float32)
 
-    def step(params, opt_state, batch):
+    def _step_impl(params, opt_state, batch, eff_lr):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         new_params, new_state, gnorm = _adamw(
-            params, grads, opt_state, lr, b1, b2, eps, wd, clip)
-        return new_params, new_state, loss, gnorm
+            params, grads, opt_state, eff_lr, b1, b2, eps, wd, clip)
+        if spec is None:
+            return new_params, new_state, loss, gnorm
+        vec = _pack_telemetry(loss, gnorm, params, grads, new_params,
+                              spec)
+        return new_params, new_state, loss, gnorm, vec
+
+    def step(params, opt_state, batch):
+        return _step_impl(params, opt_state, batch, lr)
+
+    def step_scaled(params, opt_state, batch, lr_scale):
+        return _step_impl(params, opt_state, batch, lr * lr_scale)
 
     donate_argnums = (0, 1) if donate else ()
     with mesh:
         jitted = jax.jit(step, donate_argnums=donate_argnums)
+    jitted_scaled = []  # built on first lr_scale= use (fault injection)
     attributed = []     # cost catalog: analyze the step program once
+    # step-phase bookkeeping (telemetry mode): host time between
+    # dispatches minus whatever the instrumented loader reported as
+    # data wait = the python/bookkeeping share of the step
+    phase = {"step": 0, "last_exit": None}
 
-    def run(params, opt_state, batch):
+    def run(params, opt_state, batch, lr_scale=None):
         # jit traces lazily at the first call — force training mode for the
         # duration so recompute/dropout gates see training=True at trace
         # time, and expose the mesh as the global ProcessMesh so mesh-aware
@@ -276,25 +342,80 @@ def make_train_step(model, mesh, meta, donate=True):
                     catalog.analyze_jitted(
                         "pretrain_step", jitted,
                         (params, opt_state, batch))
+            host_s = data_wait_s = 0.0
+            if spec is not None:
+                from ..observability import train_health as _th
+                from ..observability import tracing as _tracing
+                enter = time.perf_counter()
+                data_wait_s = _th.pop_data_wait()
+                if phase["last_exit"] is not None:
+                    gap = enter - phase["last_exit"]
+                    host_s = max(0.0, gap - data_wait_s)
+                    _metrics.train_host_seconds().observe(host_s)
+                    _tracing.get_tracer().record_span(
+                        "train_host", (enter - host_s) * 1e6,
+                        host_s * 1e6, request="train",
+                        step=phase["step"])
             t0 = time.monotonic()
             with mesh:
-                out = jitted(params, opt_state, batch)
+                if lr_scale is None:
+                    out = jitted(params, opt_state, batch)
+                else:
+                    if not jitted_scaled:
+                        jitted_scaled.append(jax.jit(
+                            step_scaled,
+                            donate_argnums=donate_argnums))
+                    out = jitted_scaled[0](params, opt_state, batch,
+                                           jnp.float32(lr_scale))
             dur = time.monotonic() - t0
             _metrics.train_step_seconds().observe(dur)
             _metrics.dispatch_seconds().labels(
                 program="pretrain_step").observe(dur)
             _metrics.train_steps_total().inc()
+            tok_per_s = None
             if tokens:
                 _metrics.train_tokens_total().inc(tokens)
                 if dur > 0:
-                    _metrics.train_tokens_per_s().set(tokens / dur)
+                    tok_per_s = tokens / dur
+                    _metrics.train_tokens_per_s().set(tok_per_s)
+            if spec is not None:
+                out = _telemetry_hook(out, dur, tok_per_s, data_wait_s)
             return out
         finally:
             set_mesh(prev_mesh)
             if not was_training:
                 model.eval()
 
+    def _telemetry_hook(out, dispatch_s, tok_per_s, data_wait_s):
+        """Host-side telemetry tail of one step: chrome-lane spans
+        every step; the ONE bulk vector fetch only on the telemetry
+        cadence. Returns the caller-facing 4-tuple."""
+        from ..observability import train_health as _th
+        from ..observability import tracing as _tracing
+        i = phase["step"]
+        phase["step"] = i + 1
+        rec = _tracing.get_tracer()
+        end = time.perf_counter()
+        rec.record_span("train_step", (end - dispatch_s) * 1e6,
+                        dispatch_s * 1e6, request="train", step=i,
+                        data_wait_s=data_wait_s)
+        params_out, opt_out, loss, gnorm, vec = out
+        if i % max(1, int(telemetry_every)) == 0:
+            arr = np.asarray(vec)       # ONE bulk D2H for all groups
+            unpacked = spec.unpack(arr.tolist())
+            if monitor is not None:
+                monitor.observe_step(i, unpacked["loss"],
+                                     unpacked["gnorm"],
+                                     groups=unpacked["groups"],
+                                     tokens_per_s=tok_per_s)
+            else:
+                _th.record_telemetry(unpacked)
+        phase["last_exit"] = time.perf_counter()
+        return params_out, opt_out, loss, gnorm
+
     run._jitted = jitted
+    run._telemetry_spec = spec
+    run._monitor = monitor
     return run
 
 

@@ -67,6 +67,9 @@ from .slo import (Objective, SLOEngine, SLOMonitor, validate_report,
                   json_safe, DEFAULT_WINDOWS)
 from .costs import (CostCatalog, get_cost_catalog, peak_flops,
                     peak_bandwidth)
+from .train_health import (TelemetrySpec, build_telemetry_spec,
+                           TrainHealthMonitor, record_telemetry,
+                           instrument_loader, breach_summary)
 from .memory import (live_array_census, census_diff, record_census,
                      tag_arrays, device_memory, MemoryMonitor,
                      shard_skew)
@@ -84,7 +87,11 @@ __all__ = [
     "timeseries", "TimeSeries", "slo", "Objective", "SLOEngine",
     "SLOMonitor", "validate_report", "json_safe", "DEFAULT_WINDOWS",
     "costs", "CostCatalog", "get_cost_catalog", "peak_flops",
-    "peak_bandwidth", "memory", "live_array_census", "census_diff",
+    "peak_bandwidth",
+    "train_health", "TelemetrySpec", "build_telemetry_spec",
+    "TrainHealthMonitor", "record_telemetry", "instrument_loader",
+    "breach_summary",
+    "memory", "live_array_census", "census_diff",
     "record_census", "tag_arrays", "device_memory", "MemoryMonitor",
     "shard_skew",
 ]

@@ -32,7 +32,7 @@ __all__ = [
     "gateway_live_streams", "gateway_sse_pending_events",
     "gateway_sse_events", "gateway_health_transitions",
     "train_step_seconds", "train_tokens_total", "train_steps_total",
-    "train_tokens_per_s",
+    "train_tokens_per_s", "train_host_seconds",
 ]
 
 
@@ -343,6 +343,22 @@ def train_tokens_per_s():
     return get_registry().gauge(
         "train_tokens_per_s",
         help="batch tokens / host wall of the last dispatched step")
+
+
+# -- training health (step-phase breakdown) ------------------------------
+# the step splits into data-wait (loader) vs host (python between
+# dispatches) vs dispatch (train_step_seconds above). The data-pipeline
+# families (train_data_wait_seconds, train_data_batches_total,
+# train_data_queue_depth, train_data_stalls_total) and the per-layer-
+# group telemetry gauges + breach counter are OWNED by
+# train_health.py — it needs per-test registries, which these
+# process-registry accessors can't take
+
+def train_host_seconds():
+    return get_registry().histogram(
+        "train_host_seconds",
+        help="host wall between dispatches not spent waiting on data "
+             "(optimizer bookkeeping, logging, sharding the batch)")
 
 
 # -- op dispatch ----------------------------------------------------------

@@ -35,7 +35,7 @@ def test_graftlint_imports():
         import tools.graftlint as gl
     finally:
         sys.path.remove(REPO_ROOT)
-    assert len(gl.RULES) >= 23, sorted(gl.RULES)
+    assert len(gl.RULES) >= 24, sorted(gl.RULES)
     families = {r.family for r in gl.RULES.values()}
     assert families >= {"trace-safety", "shard-map", "pallas-bounds",
                         "hygiene", "donation", "concurrency"}, families
@@ -60,10 +60,13 @@ def test_graftlint_imports():
     # across blocking ops or compiled dispatch (GL115 — the flight-
     # recorder arm()-adoption hazard), fire-and-forget asyncio tasks
     # (GL116 — the gateway drain-task hazard), and stale/unknown
-    # suppression comments (GL117 — suppression rot made visible)
+    # suppression comments (GL117 — suppression rot made visible);
+    # the train-health PR's rule: daemon threads a long-lived object's
+    # stop()/close() never joins (GL118 — the PsServer handler-thread
+    # hazard; the comm watchdog's join-with-timeout is the clean shape)
     assert {"GL104", "GL105", "GL107", "GL108", "GL110", "GL111",
             "GL112", "GL113", "GL114", "GL115", "GL116",
-            "GL117"} <= set(gl.RULES), sorted(gl.RULES)
+            "GL117", "GL118"} <= set(gl.RULES), sorted(gl.RULES)
 
 
 def test_tree_is_clean():
@@ -126,7 +129,7 @@ def test_tree_run_is_within_budget_and_reports_phases():
 
 
 def test_concurrency_corpus_roundtrip():
-    """The four GL114-GL117 corpus files each reconstruct a fixed real
+    """The five GL114-GL118 corpus files each reconstruct a fixed real
     hazard: caught codes fire exactly, clean tripwires stay silent
     (any unexpected code fails), and each file's suppression-honored
     demo is consumed (so GL117 does not flag it)."""
@@ -143,6 +146,7 @@ def test_concurrency_corpus_roundtrip():
         "lock_across_blocking.py": "GL115",
         "fire_and_forget_task.py": "GL116",
         "stale_suppression.py": "GL117",
+        "unjoined_thread_shutdown.py": "GL118",
     }
     for name, code in expected_files.items():
         path = os.path.join(corpus, name)

@@ -1,0 +1,422 @@
+"""Training health monitor (ISSUE 14): per-layer-group telemetry,
+divergence detection, step-phase breakdown.
+
+Covers the contract the train_health gate (tools/train_monitor.py)
+drives end to end, at unit granularity and tier-1 speed:
+
+* telemetry spec grouping (bounded GL112-safe label set) + packed
+  vector round-trip — pure host code, no jax;
+* detector fire/no-fire matrix on SYNTHETIC clocks (every
+  TrainHealthMonitor entry point takes now=);
+* telemetry-on loss-bit-exactness + monitor-off bit-neutrality on the
+  real sharded train step;
+* injected NaN batch -> breach + dump -> training CONTINUES (degrade,
+  don't crash — the PR-11 discipline);
+* instrumented-dataloader stall detection.
+"""
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import train_health as th
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.observability.tracing import FlightRecorder, SpanRecorder
+
+
+# -- telemetry spec ----------------------------------------------------------
+
+class TestTelemetrySpec:
+    NAMES = {
+        "llama.embed_tokens.weight": 2,
+        "llama.layers.0.self_attn.q_proj.weight": 2,
+        "llama.layers.1.mlp.up_proj.weight": 2,
+        "llama.layers.2.self_attn.o_proj.weight": 2,
+        "llama.layers.3.mlp.down_proj.weight": 2,
+        "llama.layers.0.input_layernorm.weight": 1,
+        "llama.layers.3.self_attn.q_proj.bias": 1,
+        "llama.norm.weight": 1,
+        "lm_head.weight": 2,
+    }
+
+    def test_grouping_bounded_and_stable(self):
+        spec = th.build_telemetry_spec(self.NAMES, max_block_buckets=2)
+        assert spec.labels == ("embed", "blocks_00_01", "blocks_02_03",
+                               "norm_bias", "head")
+        groups = dict(spec.groups)
+        assert "llama.embed_tokens.weight" in groups["embed"]
+        assert "lm_head.weight" in groups["head"]
+        # rank-1 params go to norm_bias regardless of their layer index
+        assert "llama.layers.3.self_attn.q_proj.bias" \
+            in groups["norm_bias"]
+        assert "llama.layers.1.mlp.up_proj.weight" \
+            in groups["blocks_00_01"]
+        assert "llama.layers.2.self_attn.o_proj.weight" \
+            in groups["blocks_02_03"]
+        # a 40-layer model still gets the same bucket COUNT
+        big = {f"m.layers.{i}.w.weight": 2 for i in range(40)}
+        spec_big = th.build_telemetry_spec(big, max_block_buckets=4)
+        assert len(spec_big.labels) <= 4 + 4   # buckets + fixed groups
+
+    def test_unpack_round_trip(self):
+        spec = th.build_telemetry_spec(self.NAMES, max_block_buckets=2)
+        vec = [0.0] * len(spec)
+        vec[0], vec[1] = 3.25, 1.5          # loss, gnorm
+        off = len(th.HEADER_FIELDS)
+        vec[off:off + 4] = [2.0, 8.0, 0.4, 1.0]   # first group
+        out = spec.unpack(vec)
+        assert out["loss"] == 3.25 and out["gnorm"] == 1.5
+        first = out["groups"][spec.labels[0]]
+        assert first["grad_norm"] == 2.0
+        assert first["update_ratio"] == pytest.approx(0.05)
+        assert out["nonfinite_total"] == 1.0
+        with pytest.raises(ValueError):
+            spec.unpack(vec[:-1])
+
+
+# -- monitor fire/no-fire matrix (synthetic clock) ---------------------------
+
+def _mon(tmp_path, **kw):
+    reg = MetricsRegistry()
+    rec = SpanRecorder()
+    flight = FlightRecorder(recorder=rec, min_interval_s=0.0)
+    flight.arm(str(tmp_path))
+    defaults = dict(window_s=100.0, min_count=3, loss_spike_mads=6.0,
+                    grad_spike_mads=6.0, mad_floor_frac=0.05,
+                    update_ratio_bounds=(1e-9, 1.0), data_stall_s=0.5,
+                    cooldown_s=1000.0, registry=reg, recorder=rec,
+                    flight_recorder=flight)
+    defaults.update(kw)
+    return th.TrainHealthMonitor(**defaults), reg, rec, flight
+
+
+def _groups(ratio=0.005, nonfinite=0.0):
+    return {"embed": {"grad_norm": 0.5, "param_norm": 2.0,
+                      "update_norm": ratio * 2.0,
+                      "update_ratio": ratio, "nonfinite": nonfinite}}
+
+
+class TestMonitorChecks:
+    def test_healthy_run_never_fires(self, tmp_path):
+        mon, reg, rec, flight = _mon(tmp_path)
+        for i in range(20):
+            mon.observe_step(i, 4.8 + 0.01 * math.sin(i), 1.3,
+                             groups=_groups(), now=float(i))
+        assert mon.breaches_total == 0
+        assert flight.dumps == []
+
+    def test_min_count_guards_warmup(self, tmp_path):
+        mon, *_ = _mon(tmp_path, min_count=5)
+        # a huge step-2 loss with only 2 prior samples must not judge
+        mon.observe_step(0, 4.8, 1.3, now=0.0)
+        mon.observe_step(1, 4.8, 1.3, now=1.0)
+        mon.observe_step(2, 400.0, 1.3, now=2.0)
+        assert mon.breach_counts.get("loss_spike") is None
+
+    def test_loss_spike_fires_once_with_cooldown(self, tmp_path):
+        mon, reg, rec, flight = _mon(tmp_path)
+        for i in range(6):
+            mon.observe_step(i, 4.8, 1.3, now=float(i))
+        for i in range(6, 10):      # sustained divergence
+            mon.observe_step(i, 50.0, 1.3, now=float(i))
+        assert mon.breach_counts == {"loss_spike": 1}
+        snap = reg.snapshot()["train_health_breaches_total"]["children"]
+        assert snap["loss_spike"]["value"] == 1.0
+        dump = obs.load_dump(flight.dumps[0])
+        assert dump["reason"] == "loss_divergence"
+        digest = th.breach_summary(dump)
+        assert digest["check"] == "loss_spike"
+        assert digest["breach_events"] >= 1
+
+    def test_decreasing_loss_is_not_a_spike(self, tmp_path):
+        mon, *_ = _mon(tmp_path)
+        for i in range(12):
+            mon.observe_step(i, 10.0 - 0.5 * i, 1.3, now=float(i))
+        assert mon.breaches_total == 0
+
+    def test_grad_spike(self, tmp_path):
+        mon, _, _, flight = _mon(tmp_path)
+        for i in range(6):
+            mon.observe_step(i, 4.8, 1.3, now=float(i))
+        mon.observe_step(6, 4.8, 40.0, now=6.0)
+        assert mon.breach_counts == {"grad_spike": 1}
+        assert obs.load_dump(flight.dumps[0])["reason"] \
+            == "grad_norm_spike"
+
+    def test_non_finite_transition_fires_exactly_once(self, tmp_path):
+        mon, _, _, flight = _mon(tmp_path, cooldown_s=0.0)
+        mon.observe_step(0, 4.8, 1.3, now=0.0)
+        for i in range(1, 5):       # poisoned forever after
+            mon.observe_step(i, float("nan"), float("nan"),
+                             now=float(i))
+        # transition-triggered even with cooldown disabled
+        assert mon.breach_counts == {"non_finite": 1}
+        assert obs.load_dump(flight.dumps[0])["reason"] \
+            == "non_finite_loss"
+        # recovery then re-poisoning fires again
+        mon.observe_step(5, 4.8, 1.3, now=5.0)
+        mon.observe_step(6, float("inf"), 1.3, now=6.0)
+        assert mon.breach_counts == {"non_finite": 2}
+
+    def test_nonfinite_group_grads_fire_without_nan_loss(self, tmp_path):
+        mon, *_ = _mon(tmp_path)
+        mon.observe_step(0, 4.8, 1.3,
+                         groups=_groups(nonfinite=3.0), now=0.0)
+        assert mon.breach_counts == {"non_finite": 1}
+
+    def test_update_ratio_bounds(self, tmp_path):
+        mon, _, _, flight = _mon(tmp_path)
+        mon.observe_step(0, 4.8, 1.3, groups=_groups(ratio=5.0),
+                         now=0.0)
+        assert mon.breach_counts == {"update_ratio": 1}
+        assert obs.load_dump(flight.dumps[0])["reason"] \
+            == "loss_divergence"
+        mon2, *_ = _mon(tmp_path / "2")
+        mon2.observe_step(0, 4.8, 1.3, groups=_groups(ratio=1e-12),
+                          now=0.0)
+        assert mon2.breach_counts == {"update_ratio": 1}
+
+    def test_throughput_regression(self, tmp_path):
+        mon, *_ = _mon(tmp_path, throughput_drop_frac=0.5)
+        for i in range(6):
+            mon.observe_step(i, 4.8, 1.3, tokens_per_s=1000.0,
+                             now=float(i))
+        mon.observe_step(6, 4.8, 1.3, tokens_per_s=100.0, now=6.0)
+        assert mon.breach_counts == {"throughput": 1}
+
+    def test_data_stall(self, tmp_path):
+        mon, reg, _, flight = _mon(tmp_path)
+        assert not mon.observe_data_wait(0.1, now=0.0)
+        assert mon.observe_data_wait(2.0, now=1.0)
+        assert mon.breach_counts == {"data_stall": 1}
+        assert obs.load_dump(flight.dumps[0])["reason"] == "data_stall"
+        snap = reg.snapshot()
+        assert snap["train_data_stalls_total"][
+            "children"][""]["value"] == 1.0
+
+    def test_breach_summary_rejects_foreign_dump(self, tmp_path):
+        with pytest.raises(ValueError):
+            th.breach_summary({"reason": "slo_burn_rate"})
+
+    def test_from_config_round_trip(self, tmp_path):
+        cfg = {"window_s": 60.0, "min_count": 7,
+               "update_ratio_bounds": [1e-8, 2.0],
+               "data_stall_s": 0.25}
+        mon = th.TrainHealthMonitor.from_config(
+            cfg, registry=MetricsRegistry())
+        assert mon.window_s == 60.0 and mon.min_count == 7
+        assert mon.update_ratio_bounds == (1e-8, 2.0)
+        with pytest.raises(ValueError):
+            th.TrainHealthMonitor(window_s=0)
+        with pytest.raises(ValueError):
+            th.TrainHealthMonitor(update_ratio_bounds=(2.0, 1.0))
+
+
+# -- real train step integration ---------------------------------------------
+
+def _tiny_setup(telemetry=False, monitor=None):
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, pretrain
+    paddle.seed(7)
+    m = LlamaForCausalLM(LlamaConfig.tiny(dtype="float32"))
+    mesh = pretrain.make_mesh(8, dp=2, fsdp=2, mp=2)
+    params, opt_state, meta = pretrain.make_train_state(m, mesh)
+    step = pretrain.make_train_step(m, mesh, meta, telemetry=telemetry,
+                                    monitor=monitor)
+    return mesh, params, opt_state, step
+
+
+def _tiny_batches(n, corrupt_at=None):
+    from paddle_tpu.testing.faults import TrainFaultInjector
+    rng = np.random.default_rng(3)
+    out = []
+    for i in range(n):
+        b = {"input_ids": rng.integers(0, 128, (8, 16)).astype(np.int32),
+             "labels": rng.integers(0, 128, (8, 16)).astype(np.int32)}
+        if corrupt_at == i:
+            b["input_ids"] = b["input_ids"].copy()
+            b["input_ids"][0, :4] = TrainFaultInjector.OOV_TOKEN
+        out.append(b)
+    return out
+
+
+class TestTrainStepTelemetry:
+    def _losses(self, telemetry=False, monitor=None, steps=3):
+        from paddle_tpu.models import pretrain
+        mesh, params, opt_state, step = _tiny_setup(
+            telemetry=telemetry, monitor=monitor)
+        losses = []
+        for b in _tiny_batches(steps):
+            params, opt_state, loss, gnorm = step(
+                params, opt_state, pretrain.shard_batch(b, mesh))
+            losses.append(float(loss))
+        return losses, step
+
+    def test_telemetry_and_monitor_bit_neutral(self, tmp_path):
+        base, _ = self._losses()
+        on, step_on = self._losses(telemetry=True)
+        assert base == on       # loss-bit-exact
+        mon, *_ = _mon(tmp_path)
+        monitored, _ = self._losses(monitor=mon)
+        assert base == monitored
+        assert mon.steps_observed == 3 and mon.breaches_total == 0
+        spec = step_on._telemetry_spec
+        assert "embed" in spec.labels and "head" in spec.labels
+
+    def test_telemetry_gauges_land(self, tmp_path):
+        mon, reg, *_ = _mon(tmp_path)
+        self._losses(monitor=mon, steps=2)
+        snap = reg.snapshot()
+        grads = snap["train_group_grad_norm"]["children"]
+        assert "embed" in grads and "head" in grads
+        assert all(v["value"] >= 0 for v in grads.values())
+        assert snap["train_loss"]["children"][""]["value"] > 0
+
+    def test_nan_batch_dumps_and_training_continues(self, tmp_path):
+        from paddle_tpu.models import pretrain
+        # registry=None: the flight dump embeds the PROCESS registry
+        # snapshot, so the group-telemetry-in-dump assertion below
+        # needs the monitor recording there (the production wiring)
+        mon, reg, rec, flight = _mon(tmp_path, registry=None)
+        mesh, params, opt_state, step = _tiny_setup(monitor=mon)
+        for b in _tiny_batches(5, corrupt_at=2):
+            # degrade, don't crash: the poisoned step must not raise
+            params, opt_state, loss, gnorm = step(
+                params, opt_state, pretrain.shard_batch(b, mesh))
+        assert not math.isfinite(float(loss))   # state stays poisoned
+        assert mon.breach_counts.get("non_finite") == 1
+        dump = obs.load_dump(flight.dumps[0])
+        assert dump["reason"] == "non_finite_loss"
+        digest = th.breach_summary(dump)
+        assert digest["check"] == "non_finite"
+        # the dump's metrics snapshot carries the group telemetry
+        assert digest["group_grad_norm"]
+
+    def test_lr_scale_program_is_isolated(self):
+        """lr_scale=None never touches the scaled program; a scaled
+        step changes the update but not the loss of THAT step."""
+        from paddle_tpu.models import pretrain
+        mesh, params, opt_state, step = _tiny_setup(telemetry=True)
+        batches = _tiny_batches(3)
+        p1, o1, loss_a, _ = step(params, opt_state,
+                                 pretrain.shard_batch(batches[0], mesh))
+        p1, o1, loss_b, _ = step(p1, o1,
+                                 pretrain.shard_batch(batches[1], mesh),
+                                 lr_scale=1000.0)
+        p1, o1, loss_c, _ = step(p1, o1,
+                                 pretrain.shard_batch(batches[2], mesh))
+        assert math.isfinite(float(loss_b))
+        assert float(loss_c) > float(loss_a)    # the blow-up landed
+
+
+# -- instrumented loader -----------------------------------------------------
+
+class TestInstrumentedLoader:
+    def test_wait_histogram_and_spans(self, tmp_path):
+        mon, reg, rec, flight = _mon(tmp_path)
+        batches = list(range(4))
+        out = list(th.instrument_loader(iter(batches), monitor=mon))
+        assert out == batches
+        snap = reg.snapshot() if reg is not None else {}
+        # histogram/counter land in the PROCESS registry (the loader
+        # wrapper instruments globally; the monitor only judges)
+        proc = obs.get_registry().snapshot()
+        assert proc["train_data_batches_total"][
+            "children"][""]["value"] >= 4
+        waits = [s for s in rec.spans() if s["name"] == "data_wait"] \
+            or [s for s in obs.get_tracer().spans()
+                if s["name"] == "data_wait"]
+        assert len(waits) >= 4
+
+    def test_stall_detector_fires_through_dataloader(self, tmp_path):
+        import time as _time
+        from paddle_tpu.io import DataLoader
+        mon, reg, rec, flight = _mon(tmp_path, data_stall_s=0.05)
+
+        class SlowAt:
+            def __init__(self, n, slow_at):
+                self.n, self.slow_at = n, slow_at
+            def __len__(self):
+                return self.n
+            def __getitem__(self, i):
+                if i == self.slow_at:
+                    _time.sleep(0.3)
+                return np.asarray([i], np.int64)
+
+        loader = DataLoader(SlowAt(8, 5), batch_size=2, num_workers=1,
+                            instrument=True,
+                            collate_fn=lambda rows: np.stack(rows))
+        loader.health_monitor = mon
+        seen = sum(1 for _ in loader)
+        assert seen == 4
+        assert mon.breach_counts.get("data_stall", 0) >= 1
+        assert any("data_stall" in os.path.basename(p)
+                   for p in flight.dumps)
+
+    def test_pending_wait_accumulates_and_pops(self):
+        th.pop_data_wait()
+        th.add_data_wait(0.25)
+        th.add_data_wait(0.5)
+        assert th.pop_data_wait() == pytest.approx(0.75)
+        assert th.pop_data_wait() == 0.0
+
+
+# -- fault injector ----------------------------------------------------------
+
+class TestTrainFaultInjector:
+    def test_schedule_and_counts(self):
+        from paddle_tpu.testing.faults import TrainFaultInjector
+        inj = TrainFaultInjector().nan_batch(2).lr_spike(
+            3, factor=10.0).stall_loader(1, delay_s=0.01)
+        b = {"input_ids": np.zeros((2, 4), np.int32),
+             "labels": np.zeros((2, 4), np.int32)}
+        same = inj.adjust_batch(0, b)
+        assert same is b
+        bad = inj.adjust_batch(2, b)
+        assert bad["input_ids"][0, 0] == TrainFaultInjector.OOV_TOKEN
+        assert b["input_ids"][0, 0] == 0    # original untouched
+        assert inj.lr_scale_for(0) is None
+        assert inj.lr_scale_for(3) == 10.0
+        wrapped = list(inj.wrap_loader([10, 11, 12]))
+        assert wrapped == [10, 11, 12]
+        assert inj.injected == {"nan_batch": 1, "lr_spike": 1,
+                                "loader_stall": 1}
+
+
+# -- GL118 tree-scan fix regression ------------------------------------------
+
+class TestPsServerShutdown:
+    def test_stop_retires_idle_handlers_promptly(self):
+        """The GL118 fix this PR landed: PsServer.stop() must signal,
+        unblock (shutdown the handler connections — an idle handler
+        sits in a blocking recv that never sees the event), and join —
+        returning promptly with zero daemon threads left to race
+        interpreter teardown."""
+        import socket
+        import threading
+        import time as _time
+        from paddle_tpu.distributed.ps import PsServer
+
+        srv = PsServer(port=0)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        # an idle client: connects, never handshakes — its handler
+        # blocks in recv with no timeout
+        c = socket.create_connection((srv.host, srv.port))
+        deadline = _time.monotonic() + 5.0
+        while not any(th.is_alive() for th in srv._threads):
+            assert _time.monotonic() < deadline, "handler never spawned"
+            _time.sleep(0.01)
+        t0 = _time.monotonic()
+        srv.stop()
+        took = _time.monotonic() - t0
+        t.join(timeout=3.0)
+        assert took < 1.5, f"stop() stalled {took:.2f}s"
+        assert not any(th.is_alive() for th in srv._threads)
+        assert not t.is_alive()
+        c.close()

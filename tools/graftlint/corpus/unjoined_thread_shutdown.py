@@ -1,0 +1,138 @@
+# graftlint-corpus-expect: GL118 GL118
+"""Known-bad corpus: daemon threads a long-lived object never joins at
+shutdown (GL118).
+
+Reconstructs the PsServer bug fixed by hand in ISSUE 14: the parameter
+server's accept loop parked every per-connection handler thread in
+``self._threads``, and ``stop()`` only set the stop event — the
+handlers raced interpreter teardown (waking mid-GC on torn-down
+modules) and their in-flight connection writes were simply abandoned.
+The fix signals, then joins each with a timeout.
+
+Clean tripwires: the comm-watchdog shape (signal then
+``join(timeout=)``), the loop-join over a thread list, a class with no
+shutdown-shaped method (nothing promises a lifecycle), and a
+non-daemon thread (blocks exit loudly instead of racing it).
+"""
+import threading
+
+
+# -- caught ------------------------------------------------------------------
+
+class WatchdogBad:
+    """The hazard shape: stop() signals and returns, never joins."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(     # expect GL118
+            target=self._poll, daemon=True)
+        self._thread.start()
+
+    def _poll(self):
+        while not self._stop.wait(0.5):
+            pass
+
+    def stop(self):
+        self._stop.set()        # ...and the thread races teardown
+
+
+class ServerBad:
+    """The list-append shape: handlers parked, close() joins nothing."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._threads = []
+
+    def serve(self, conns):
+        for conn in conns:
+            th = threading.Thread(target=self._handle, args=(conn,),
+                                  daemon=True)   # expect GL118
+            th.start()
+            self._threads.append(th)
+
+    def _handle(self, conn):
+        while not self._stop.is_set():
+            conn.recv()
+
+    def close(self):
+        self._stop.set()
+
+
+# -- clean -------------------------------------------------------------------
+
+class WatchdogClean:
+    """The comm-watchdog shape: signal, then join WITH A TIMEOUT."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._poll, daemon=True)
+        self._thread.start()
+
+    def _poll(self):
+        while not self._stop.wait(0.5):
+            pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+
+
+class PoolClean:
+    """Loop-join over the stored list retires every worker."""
+
+    def __init__(self, n):
+        self._stop = threading.Event()
+        self._threads = []
+        for _ in range(n):
+            t = threading.Thread(target=self._work, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _work(self):
+        self._stop.wait()
+
+    def shutdown(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
+class FireAndForgetHelper:
+    """No stop/close/shutdown method: the class never promises a
+    lifecycle, so there is no broken start/stop pairing to flag (the
+    rpc-style module helpers are this shape)."""
+
+    def __init__(self):
+        self._thread = threading.Thread(target=lambda: None,
+                                        daemon=True)
+        self._thread.start()
+
+
+class NonDaemonClean:
+    """A non-daemon thread BLOCKS interpreter exit — a loud, different
+    failure, out of GL118's scope."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        self._stop.wait()
+
+    def stop(self):
+        self._stop.set()
+
+
+class SuppressedDemo:
+    """Suppression-honored demo: the disable comment is CONSUMED by a
+    real finding here, so GL117 stays quiet about it."""
+
+    def __init__(self):
+        self._thread = threading.Thread(  # graftlint: disable=GL118 - demo: deliberate unjoined helper for the suppression round-trip
+            target=lambda: None, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        pass

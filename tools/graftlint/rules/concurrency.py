@@ -1,4 +1,4 @@
-"""Async/concurrency rules (GL114-GL117) — the context-sensitive family
+"""Async/concurrency rules (GL114-GL118) — the context-sensitive family
 the two-phase engine exists for.
 
 PR 12 put an asyncio gateway, a dedicated engine-stepper thread,
@@ -51,7 +51,18 @@ there), or naming a rule id that doesn't exist. Suppressions are
 reasoned exceptions; once the code under one changes, the comment
 becomes camouflage for the NEXT real finding on that line. The scan
 phase records every (line, code) a suppressed finding consumed;
-whatever remains is rot."""
+whatever remains is rot.
+
+GL118 unjoined-thread-at-shutdown: a `threading.Thread(daemon=True)` a
+class stores on `self` when the class has a stop/close/shutdown-shaped
+method that never join()s it. A daemon thread races interpreter
+teardown: at process exit it can wake mid-GC on torn-down modules and
+any cleanup it owns silently never runs. The pairing is per-class —
+signal, then `join(timeout=...)` (the comm watchdog's stop() is the
+in-tree clean shape); a stop that only sets the event and returns is
+the hazard. Classes with no shutdown-shaped method are out of scope
+(nothing promises a lifecycle), as are non-daemon threads (they block
+exit loudly instead of racing it)."""
 import ast
 
 from ..core import RULES, in_paddle_tpu, rule, Finding
@@ -514,3 +525,159 @@ def stale_suppression(ctx):
                                "file-level suppression")
         if f is not None:
             yield f, None
+
+
+# -- GL118 -------------------------------------------------------------------
+
+_GL118_MSG = (
+    "a daemon thread a long-lived object starts but never join()s races "
+    "interpreter teardown: at shutdown it can wake mid-GC on torn-down "
+    "modules (random `'NoneType' object is not callable` spew), and any "
+    "cleanup it owns silently never runs. stop()/close() must join it "
+    "WITH A TIMEOUT after signaling — the comm watchdog's "
+    "`self._stop.set(); self._thread.join(timeout=2.0)` is the in-tree "
+    "clean shape (a stop that only sets the event and returns is "
+    "exactly this hazard)")
+
+# a method with one of these names is the object's shutdown promise —
+# the per-class start/stop pairing the rule checks
+_SHUTDOWN_NAMES = {"stop", "close", "shutdown", "terminate",
+                   "stop_server", "__exit__"}
+
+
+def _is_daemon_thread_ctor(node):
+    """`threading.Thread(..., daemon=True)` / `Thread(..., daemon=True)`
+    calls. Non-daemon threads are out of scope: they BLOCK interpreter
+    exit instead of racing it (a different, louder failure)."""
+    if not isinstance(node, ast.Call):
+        return False
+    chain = _attr_chain(node.func)
+    if chain not in ("threading.Thread", "Thread"):
+        return False
+    return any(kw.arg == "daemon"
+               and isinstance(kw.value, ast.Constant)
+               and kw.value.value is True for kw in node.keywords)
+
+
+def _self_attr(node):
+    """'x' for a `self.x` attribute expression, else None."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _thread_holders(methods):
+    """self attributes that hold daemon threads this class constructs:
+    `self.x = Thread(...)`, `t = Thread(...); self.x = t` (also via a
+    list/tuple literal), and `self.x.append(t)`. Maps attr -> the node
+    to report (the ctor or the storing statement)."""
+    holders = {}
+    for m in methods:
+        local_threads = set()
+        for node in ast.walk(m):
+            if isinstance(node, ast.Assign):
+                if _is_daemon_thread_ctor(node.value):
+                    for tgt in node.targets:
+                        attr = _self_attr(tgt)
+                        if attr is not None:
+                            holders.setdefault(attr, node.value)
+                        elif isinstance(tgt, ast.Name):
+                            local_threads.add(tgt.id)
+                    continue
+                v = node.value
+                names = []
+                if isinstance(v, ast.Name):
+                    names = [v.id]
+                elif isinstance(v, (ast.List, ast.Tuple)):
+                    names = [e.id for e in v.elts
+                             if isinstance(e, ast.Name)]
+                if any(nm in local_threads for nm in names):
+                    for tgt in node.targets:
+                        attr = _self_attr(tgt)
+                        if attr is not None:
+                            holders.setdefault(attr, node)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("append", "add"):
+                attr = _self_attr(node.func.value)
+                if attr is not None and any(
+                        isinstance(a, ast.Name)
+                        and a.id in local_threads for a in node.args):
+                    holders.setdefault(attr, node)
+    return holders
+
+
+def _joined_attrs(methods):
+    """self attributes some method of the class join()s — directly
+    (`self.x.join(...)`), or through a loop/alias variable bound from
+    the attribute (`for t in self._threads: t.join(...)`,
+    `t = self._thread; t.join()`)."""
+    joined = set()
+    for m in methods:
+        aliases = {}        # local name -> self attr it came from
+        for node in ast.walk(m):
+            if isinstance(node, ast.For) \
+                    and isinstance(node.target, ast.Name):
+                for sub in ast.walk(node.iter):
+                    attr = _self_attr(sub)
+                    if attr is not None:
+                        aliases[node.target.id] = attr
+            elif isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                attr = _self_attr(node.value)
+                if attr is not None:
+                    aliases[node.targets[0].id] = attr
+        for node in ast.walk(m):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"):
+                continue
+            recv = node.func.value
+            attr = _self_attr(recv)
+            if attr is not None:
+                joined.add(attr)
+            elif isinstance(recv, ast.Name) and recv.id in aliases:
+                joined.add(aliases[recv.id])
+    return joined
+
+
+@rule("GL118", "unjoined-thread-at-shutdown", "concurrency",
+      applies=in_paddle_tpu)
+def unjoined_thread_at_shutdown(ctx):
+    """A `threading.Thread(daemon=True)` a class stores on `self` when
+    the class promises shutdown (a stop/close/shutdown-named method)
+    but no method ever join()s that attribute. Detection is the
+    per-class start/stop pairing over the same spawn shapes the
+    phase-1 thread-entry color indexes; when the project index knows
+    the spawn target, the finding names it."""
+    for cls in ctx.walk():
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        shutdowns = sorted(m.name for m in methods
+                           if m.name in _SHUTDOWN_NAMES)
+        if not shutdowns:
+            continue    # nothing promises shutdown: out of scope
+        holders = _thread_holders(methods)
+        if not holders:
+            continue
+        joined = _joined_attrs(methods)
+        for attr in sorted(set(holders) - joined):
+            node = holders[attr]
+            target = ""
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        tname = _attr_chain(kw.value)
+                        if tname:
+                            target = f" (target `{tname}`)"
+            yield ctx.finding(
+                "GL118", node,
+                f"daemon thread stored in `self.{attr}`{target} is "
+                f"never join()ed by `{cls.name}.{'`/`'.join(shutdowns)}"
+                f"`: {_GL118_MSG}"), node

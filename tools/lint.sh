@@ -43,4 +43,10 @@ if [ "${LINT_SKIP_SERVE:-0}" != "1" ]; then
   # step), token-exact-neutral telemetry, census leak check — "MFU is
   # a number the CI checks", the training-side serve-gate analogue
   python tools/cost_report.py --check tools/train_obs.json
+  # train_health gate: per-layer-group gradient telemetry + divergence
+  # detection on a sharded pretrain — telemetry-on loss-bit-exact and
+  # compile-neutral, healthy run breach-free, and each injected fault
+  # (NaN batch, lr spike, throttled loader) fires exactly its
+  # detector(s) once with a schema-valid flight dump
+  python tools/train_monitor.py --check tools/train_health.json
 fi

@@ -21,9 +21,15 @@ Two jobs:
   gauge sections -> derived intensity/MFU/roofline against a synthetic
   dispatch histogram), the memory layer (synthetic census ->
   live_array gauges; MemoryMonitor headroom breach -> ``hbm_pressure``
-  dump schema), and the resilience telemetry (preemption/cancel/shed
+  dump schema), the resilience telemetry (preemption/cancel/shed
   counter families; ``preemption`` and ``operator_abort`` dump schemas
-  with their request_summary digests), and exits non-zero on any
+  with their request_summary digests), and the training health layer
+  (ISSUE 14: telemetry-spec grouping/packing, the train_group_* gauge
+  families under their bounded label sets, the TrainHealthMonitor
+  detector matrix on a synthetic clock with all four dump reasons —
+  ``non_finite_loss`` / ``grad_norm_spike`` / ``loss_divergence`` /
+  ``data_stall`` — loadable with their ``breach_summary`` digests, and
+  the instrumented-loader surfaces), and exits non-zero on any
   violation.
   Wired into tools/lint.sh so the tier-0 gate
   (tests/test_graftlint_gate.py) catches a broken metrics/tracing/SLO
@@ -532,6 +538,133 @@ def selfcheck():
               f"operator_abort dump wrong: {dump2['context']}")
     finally:
         shutil.rmtree(d7, ignore_errors=True)
+
+    # training health (ISSUE 14): telemetry spec grouping + packed
+    # layout, the train_group_* gauge families (bounded GL112-safe
+    # label sets), the TrainHealthMonitor detector matrix on a
+    # synthetic clock, all FOUR dump reasons (non_finite_loss /
+    # grad_norm_spike / loss_divergence / data_stall) loadable with
+    # their breach_summary digests, and the instrumented-loader
+    # surfaces — stdlib-only like everything above
+    th = obs.train_health
+    specA = th.build_telemetry_spec(
+        {"m.embed_tokens.weight": 2, "m.layers.0.attn.q.weight": 2,
+         "m.layers.1.mlp.up.weight": 2, "m.layers.0.norm.weight": 1,
+         "lm_head.weight": 2}, max_block_buckets=2)
+    check(specA.labels == ("embed", "blocks_00_00", "blocks_01_01",
+                           "norm_bias", "head"),
+          f"telemetry grouping wrong: {specA.labels}")
+    vecA = [0.0] * len(specA)
+    vecA[0], vecA[1] = 5.0, 1.25
+    off = len(th.HEADER_FIELDS)
+    vecA[off:off + 4] = [1.0, 4.0, 0.2, 0.0]
+    upA = specA.unpack(vecA)
+    check(upA["loss"] == 5.0 and upA["groups"]["embed"]["update_ratio"]
+          == 0.05, f"telemetry unpack wrong: {upA}")
+    try:
+        specA.unpack(vecA[:-1])
+        check(False, "short telemetry vector not rejected")
+    except ValueError:
+        pass
+    regT = obs.MetricsRegistry()
+    th.record_telemetry(upA, registry=regT)
+    snapT = regT.snapshot()
+    for fam in ("train_loss", "train_grad_norm",
+                "train_group_grad_norm", "train_group_param_norm",
+                "train_group_update_ratio", "train_group_nonfinite"):
+        check(fam in snapT, f"telemetry gauge family missing: {fam}")
+    check(snapT["train_group_grad_norm"]["children"]["embed"]["value"]
+          == 1.0, "group gauge value wrong")
+    check(set(snapT["train_group_grad_norm"]["children"])
+          == set(specA.labels),
+          "group gauge label set != spec labels (cardinality leak?)")
+
+    ringT = obs.tracing.SpanRecorder()
+    frT = obs.tracing.FlightRecorder(recorder=ringT, min_interval_s=0.0)
+    dT = tempfile.mkdtemp(prefix="sc_trainhealth_")
+    try:
+        frT.arm(dT)
+        monT = obs.TrainHealthMonitor(
+            window_s=100.0, min_count=3, loss_spike_mads=6.0,
+            grad_spike_mads=6.0, update_ratio_bounds=(1e-9, 1.0),
+            data_stall_s=0.5, cooldown_s=1000.0, registry=regT,
+            recorder=ringT, flight_recorder=frT)
+        groupsOK = {"embed": {"grad_norm": 0.5, "param_norm": 2.0,
+                              "update_norm": 0.01,
+                              "update_ratio": 0.005, "nonfinite": 0.0}}
+        for i in range(6):          # healthy baseline: quiet
+            monT.observe_step(i, 4.8, 1.3, groups=groupsOK,
+                              now=float(i))
+        check(monT.breaches_total == 0,
+              f"healthy synthetic run breached: {monT.breach_counts}")
+        # loss spike -> loss_divergence; sustained -> still once
+        monT.observe_step(6, 60.0, 1.3, now=6.0)
+        monT.observe_step(7, 60.0, 1.3, now=7.0)
+        # grad spike -> grad_norm_spike
+        monT.observe_step(8, 4.8, 50.0, now=8.0)
+        # NaN -> non_finite_loss, transition-fired exactly once
+        monT.observe_step(9, float("nan"), float("nan"), now=9.0)
+        monT.observe_step(10, float("nan"), float("nan"), now=10.0)
+        # loader stall -> data_stall
+        check(monT.observe_data_wait(2.0, now=11.0) is True,
+              "data stall not detected")
+        check(monT.breach_counts == {"loss_spike": 1, "grad_spike": 1,
+                                     "non_finite": 1, "data_stall": 1},
+              f"detector matrix wrong: {monT.breach_counts}")
+        bcT = regT.snapshot()["train_health_breaches_total"]["children"]
+        check(sum(c["value"] for c in bcT.values()) == 4,
+              f"breach counter family wrong: {bcT}")
+        reasons = sorted(
+            obs.load_dump(p)["reason"] for p in frT.dumps)
+        check(reasons == ["data_stall", "grad_norm_spike",
+                          "loss_divergence", "non_finite_loss"],
+              f"train-health dump reasons wrong: {reasons}")
+        for p in frT.dumps:         # all four schemas + digests
+            dump = obs.load_dump(p)
+            dg = th.breach_summary(dump)
+            check(dg["reason"] == dump["reason"]
+                  and dg["check"] in th.CHECKS
+                  and th.DUMP_REASONS[dg["check"]] == dump["reason"],
+                  f"breach digest wrong for {dump['reason']}: {dg}")
+        try:
+            th.breach_summary({"reason": "slo_burn_rate"})
+            check(False, "breach_summary accepted a foreign dump")
+        except ValueError:
+            pass
+        # the instrumented loader: wait histogram + batch counter +
+        # data_wait spans, stall routed through the monitor
+        regL = obs.MetricsRegistry()
+        ringL = obs.tracing.SpanRecorder()
+        outL = list(th.instrument_loader(
+            iter([1, 2, 3]), registry=regL, recorder=ringL,
+            queue_depth=lambda: 2))
+        check(outL == [1, 2, 3], "instrumented loader altered batches")
+        snapL = regL.snapshot()
+        check(snapL["train_data_batches_total"]["children"][""]["value"]
+              == 3, "loader batch counter wrong")
+        check(snapL["train_data_wait_seconds"]["children"][""]["count"]
+              == 3, "loader wait histogram wrong")
+        check(snapL["train_data_queue_depth"]["children"][""]["value"]
+              == 2, "loader queue-depth gauge wrong")
+        check(sum(1 for s in ringL.spans()
+                  if s["name"] == "data_wait") == 3,
+              "data_wait spans missing")
+        th.pop_data_wait()          # drain the module accumulator
+        th.add_data_wait(0.5)
+        check(th.pop_data_wait() == 0.5 and th.pop_data_wait() == 0.0,
+              "pending data-wait accumulator wrong")
+        try:
+            obs.TrainHealthMonitor(window_s=0)
+            check(False, "window_s=0 not rejected")
+        except ValueError:
+            pass
+        try:
+            obs.TrainHealthMonitor(update_ratio_bounds=(2.0, 1.0))
+            check(False, "inverted update_ratio_bounds not rejected")
+        except ValueError:
+            pass
+    finally:
+        shutil.rmtree(dT, ignore_errors=True)
 
     # serving gateway (ISSUE 12): the front-door package must import
     # stdlib-only, its SSE framing must round-trip, its body/healthz
