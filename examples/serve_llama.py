@@ -71,6 +71,16 @@ def run_continuous(engine, rng, V, args):
     if drafted:
         print(f"  speculative: {sum(r.spec_accepted for r in reqs)}"
               f"/{drafted} drafts accepted")
+    if cb.tp > 1:
+        from paddle_tpu import observability as obs
+        rows = cb.device_kv_report()
+        comm = obs.get_registry().get("collective_bytes_total")
+        total = sum(c.value for c in comm._children.values()) \
+            if comm is not None else 0
+        print(f"  tensor parallel: tp={cb.tp}, per-device KV high-water "
+              f"{rows[0]['kv_bytes_high_water']} B (1/{cb.tp} of "
+              f"single-chip), collective payload {int(total)} B "
+              f"(psum over 'tp')")
     if args.prefix_cache:
         cached = {r.request_id: cb.explain(r.request_id)
                   ["cached_prefix_tokens"] for r in reqs}
@@ -132,7 +142,30 @@ def main():
                     help="(--continuous only) do not arm the anomaly "
                          "flight recorder (armed by default with "
                          "bounded retention)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="(--continuous only) tensor-parallel width: "
+                         "shard the paged serving path over a tp-device "
+                         "mesh (kv-head-sharded cache + work-list "
+                         "kernel, Megatron column/row weight split, one "
+                         "scheduler brain on the host). Off-TPU the "
+                         "mesh is virtual CPU devices. Requires heads/"
+                         "kv-heads/FFN divisible by tp (here: tp in "
+                         "{1, 2, 4})")
     args = ap.parse_args()
+    if args.tp > 1:
+        if not args.continuous:
+            ap.error("--tp needs --continuous (the paged serving path "
+                     "is the sharded one; dense generate() is "
+                     "single-chip)")
+        # must land before the first jax backend init: off-TPU the tp
+        # mesh runs on virtual CPU devices (the dryrun_multichip
+        # pattern)
+        import os
+        flag = f"--xla_force_host_platform_device_count={args.tp}"
+        if "xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
 
     rng = np.random.default_rng(0)
     V, E, H, G, D, L, F = 512, 128, 8, 4, 16, 4, 344
@@ -154,7 +187,8 @@ def main():
         weights, num_heads=H, head_dim=D, max_seq_len=SMAX,
         dtype="float32", norm_type="rmsnorm", activation="swiglu",
         gqa_group_size=G,
-        weight_quant=None if args.quant == "none" else args.quant)
+        weight_quant=None if args.quant == "none" else args.quant,
+        tp=args.tp)
 
     if args.continuous:
         import jax

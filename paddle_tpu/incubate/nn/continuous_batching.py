@@ -524,6 +524,21 @@ class ContinuousBatchingEngine:
     the same bucketed compile treadmill, and default-config behavior
     (priority 0, no deadlines, shedding off) is bit-identical to the
     pre-resilience engine.
+
+    Tensor-parallel serving: hand in an engine built with ``tp > 1``
+    and the SAME scheduler drives the whole device mesh — admission,
+    chunk budgeting, spec accept/rewind, prefix matching, and
+    preemption all compute once on the host and dispatch one
+    shard_map'd step program (the paged KV cache and the ragged kernel
+    shard over kv-heads; inference/tp_layout.py). The bucketed
+    (work-list length, chunk width) compile keys are untouched — zero
+    new buckets after warmup holds per mesh shape — and the scheduler
+    additionally records the step's collective payload
+    (``collective_bytes_total{op="psum",axis="tp"}`` + a ``collective``
+    timeline span) and per-device KV-bytes gauges (1/tp of the
+    single-chip figure by construction). Token-exact vs the tp=1
+    engine in every mode, pinned by tests/test_serve_tp.py and the
+    serve_bench --tp gate.
     """
 
     SLO_WINDOW = 8      # decode-TPOT samples per controller decision
@@ -627,6 +642,24 @@ class ContinuousBatchingEngine:
             raise ValueError("shed_priority_min must be >= 0")
         self._submit_counter = 0
         self._admit_counter = 0
+        # tensor-parallel serving (engine built with tp > 1): the
+        # scheduler stays a single host-side brain — every decision
+        # above computes once and drives ONE shard_map'd mesh program —
+        # but the step dispatch gains collective telemetry (the two
+        # row-parallel psums per layer, attributed analytically through
+        # the PR-9 comm-task path) and the pool gauges gain a
+        # per-device bytes view (each device holds 1/tp of every
+        # block's kv heads). tp == 1 leaves ALL of it dormant: the
+        # committed single-chip baselines stay byte-stable.
+        self._tp = int(getattr(engine, "tp", 1) or 1)
+        self._comm_seconds = {}     # request id -> comm-window seconds
+        self._comm_tasks = None
+        if self._tp > 1:
+            from ...distributed.comm_watchdog import comm_task_manager
+            self._comm_tasks = comm_task_manager
+            self._kv_dev_block_bytes = engine.kv_device_block_bytes(
+                self.block_size)
+            _metrics.serve_tp_degree().set(self._tp)
         # streaming fanout (ISSUE 12, the serving gateway's engine-side
         # half): host-side emission hooks, fired on the stepper thread.
         # `on_token(request_id, tokens, step)` fires for every committed
@@ -731,6 +764,29 @@ class ContinuousBatchingEngine:
     def num_active(self):
         return sum(r is not None for r in self.slots)
 
+    @property
+    def tp(self):
+        """Tensor-parallel width of the underlying engine's mesh."""
+        return self._tp
+
+    def device_kv_report(self):
+        """Per-device paged-KV accounting for the mesh-aware health
+        surfaces (gateway /healthz, serve_monitor --scrape): one row
+        per device with its kv-head-shard byte figures. Single-chip
+        engines report one device whose block bytes cover ALL kv
+        heads, so the shape is uniform for consumers."""
+        if self._tp > 1:
+            per_block = self._kv_dev_block_bytes
+        else:
+            fn = getattr(self.engine, "kv_device_block_bytes", None)
+            per_block = fn(self.block_size) if fn is not None else 0
+        return [{
+            "device": d,
+            "kv_bytes_used": self.allocator.num_used * per_block,
+            "kv_bytes_high_water": self.allocator.high_water * per_block,
+            "kv_blocks_used": self.allocator.num_used,
+        } for d in range(self._tp)]
+
     def _deadline_passed(self, req, now=None):
         if req.deadline_steps is not None \
                 and req._submit_step is not None \
@@ -761,6 +817,10 @@ class ContinuousBatchingEngine:
         res = RequestResult(
             req.generated, status=status, reason=reason,
             preemptions=req.preemptions)
+        # comm attribution moves onto the terminal record: the live
+        # dict must not grow one entry per request forever (explain()
+        # falls back to the RequestResult after retirement)
+        res.comm_s = self._comm_seconds.pop(req.request_id, 0.0)
         self.finished[req.request_id] = res
         self._ids.discard(req.request_id)
         _tracing.get_tracer().event(
@@ -781,6 +841,7 @@ class ContinuousBatchingEngine:
         res = RequestResult(
             req.generated, status=status, reason=reason,
             preemptions=req.preemptions)
+        res.comm_s = self._comm_seconds.pop(req.request_id, 0.0)
         self.finished[req.request_id] = res
         self._ids.discard(req.request_id)
         _metrics.serve_queue_depth().set(len(self.queue))
@@ -851,6 +912,20 @@ class ContinuousBatchingEngine:
             _metrics.kv_blocks_shared().set(self.allocator.num_shared)
             _metrics.kv_blocks_prefix_resident().set(
                 self.allocator.num_registered)
+        if self._tp > 1:
+            # per-device bytes view of the same pool: the allocator is
+            # one flat host-side block-id space, every device holds the
+            # kv-head shard of every block, so the per-device figures
+            # are symmetric by construction — surfaced per device so
+            # the mesh dashboard (serve_monitor --scrape, /healthz)
+            # shows the fleet, not a silently-device-0 number
+            used = _metrics.kv_device_bytes_used()
+            hw = _metrics.kv_device_bytes_high_water()
+            used_b = self.allocator.num_used * self._kv_dev_block_bytes
+            hw_b = self.allocator.high_water * self._kv_dev_block_bytes
+            for d in range(self._tp):   # bounded by mesh topology
+                used.labels(device=str(d)).set(used_b)
+                hw.labels(device=str(d)).set(hw_b)
 
     def _admission_pressure(self):
         """Shed signal for the admission gate: the attached SLO
@@ -1478,6 +1553,18 @@ class ContinuousBatchingEngine:
                     "post_warmup_recompile", bucket=f"{t_total}x{c}",
                     step=self._step_count)
         self._key, sub = jax.random.split(self._key)
+        comm_task = None
+        if self._comm_tasks is not None:
+            # the TP step's per-layer reduces, attributed through the
+            # PR-9 collective path: payload bytes are pure aval math
+            # (tp_step_comm_bytes — 2 psums/layer over the [B, C, E]
+            # partial activations), the window is the dispatch-to-sync
+            # span that CONTAINS the reduces, so the (psum, tp)
+            # bandwidth gauge is a floor and collective_bytes_total
+            # attributes the comms cost exactly
+            comm_task = self._comm_tasks.start_task(
+                "psum", group="tp",
+                nbytes=self.engine.tp_step_comm_bytes(self.max_batch, c))
         pc_step = time.perf_counter()
         toks2, self.caches = self.engine._paged_step(
             self.engine._w, self.caches, slab, q_arr, sel,
@@ -1486,6 +1573,16 @@ class ContinuousBatchingEngine:
         toks2 = np.asarray(toks2)      # [B, W]: a sample per sel column
         t_done = time.monotonic()
         pc_done = time.perf_counter()
+        if comm_task is not None:
+            # end AFTER the host read above synced the program: the
+            # collective span covers real execution, not async enqueue
+            self._comm_tasks.end_task(comm_task)
+            comm_dur = comm_task.elapsed
+            for i in active:
+                if q_lens[i]:
+                    rid = self.slots[i].request_id
+                    self._comm_seconds[rid] = self._comm_seconds.get(
+                        rid, 0.0) + comm_dur
         emitted = 0
         rewinds = []    # (slot, new_end, old_end): rejected draft spans
         slot_spans = []  # (slot, request_id, span name, args) this step
@@ -1752,8 +1849,24 @@ class ContinuousBatchingEngine:
         wait, chunk grants, stalls, spec accept rate) — the
         `request.explain()` view tools/request_trace.py renders from
         flight dumps, here served live. Spans are a bounded ring: a
-        long-retired request may have aged out."""
-        return _tracing.request_summary(request_id)
+        long-retired request may have aged out.
+
+        Under tensor-parallel serving the digest additionally reports
+        ``comm_s`` — the summed collective-bearing step windows this
+        request was active in (the host-side attribution the per-step
+        `collective` span records) — and the mesh width ``tp``."""
+        out = _tracing.request_summary(request_id)
+        if self._tp > 1:
+            out["tp"] = self._tp
+            # live requests accumulate in the dict; terminal ones carry
+            # their figure on the RequestResult (the dict entry is
+            # popped at retirement so it cannot grow unboundedly)
+            if request_id in self._comm_seconds:
+                out["comm_s"] = self._comm_seconds[request_id]
+            else:
+                out["comm_s"] = getattr(
+                    self.finished.get(request_id), "comm_s", 0.0)
+        return out
 
     def run(self, max_steps=100000):
         """Drive step() until every submitted request has finished.

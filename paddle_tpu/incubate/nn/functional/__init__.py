@@ -437,7 +437,7 @@ def fused_multi_transformer(
         trans_qkvw=True, ring_id=-1, norm_type="layernorm",
         use_neox_rotary_style=False, gqa_group_size=-1, name=None,
         block_tables=None, ragged_work=None, ragged_pack=None,
-        chunk_lens=None, _dequant=None, _mm=None):
+        chunk_lens=None, _dequant=None, _mm=None, _tp_reduce=None):
     """Whole-decoder-stack fused transformer (reference
     fused_multi_transformer op: python/paddle/incubate/nn/functional/
     fused_transformer.py:1053 over
@@ -560,6 +560,13 @@ def fused_multi_transformer(
     # weight-only-quant serving path, ops/pallas/quant_matmul.py), the
     # four projection matmuls run the in-kernel-dequant GEMM instead of
     # dequantize-then-einsum — quantized bytes are all that leave HBM
+    # _tp_reduce: the tensor-parallel serving hook (inference/tp_layout
+    # Megatron split). Applied to the ROW-parallel matmul outputs —
+    # attention out-projection and ffn2 — BEFORE their bias adds, where
+    # each device holds a partial sum over its weight-row shard; inside
+    # the engine's shard_map'd step it is a psum over the 'tp' axis
+    # (two per layer), identity when serving single-chip
+    tp_red = _tp_reduce or (lambda x: x)
 
     def impl(xa, lns, lnb, qkvw, qkvb, linw, linb, flns, flnb, f1w, f1b,
              f2w, f2b, caches, pres, rotary, tstep, mask, slens, qlens,
@@ -764,6 +771,7 @@ def fused_multi_transformer(
                            "lin", li).reshape(b, s, -1)
             else:
                 attn = ctx.reshape(b, s, nh * hd) @ dq(linw[li], "lin", li)
+            attn = tp_red(attn)
             if linb and linb[li] is not None:
                 attn = attn + linb[li]
             if training and dropout_rate:
@@ -798,6 +806,7 @@ def fused_multi_transformer(
                          li).reshape(b, s, -1)
             else:
                 f2 = f1 @ dq(f2w[li], "f2", li)
+            f2 = tp_red(f2)
             if f2b and f2b[li] is not None:
                 f2 = f2 + f2b[li]
             h = resid2 * residual_alpha + f2

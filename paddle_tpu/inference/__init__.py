@@ -431,12 +431,28 @@ class FusedMultiTransformerEngine:
     weights: dict with keys matching fused_multi_transformer's list args
     (ln_scales, qkv_weights, ...), plus 'embedding' [V, E] and 'lm_head'
     [E, V]. All values may be paddle Tensors or jax arrays.
+
+    ``tp > 1`` shards the PAGED serving path over a one-axis tensor-
+    parallel device mesh (inference/tp_layout.py): qkv/ffn1 weights
+    split column-wise (per-head / per-feature), out-proj/ffn2 split
+    row-wise with one psum each per layer, and the paged KV cache —
+    plus the ragged work-list kernel's grid — shards over KV HEADS, so
+    per-device cache HBM drops by the TP factor. The three paged
+    programs (`_paged_step`/`_paged_rewind`/`_paged_copy`) become
+    shard_map'd mesh programs with the SAME host-facing signatures and
+    compile-key treadmill: the host-side scheduler stays single-brain
+    and drives the whole mesh with one dispatch per step. Requires
+    `num_heads`, kv heads, and the FFN width all divisible by tp, and
+    tp visible devices. The dense `generate()` path is deliberately
+    NOT mesh-aware (serving runs through ContinuousBatchingEngine);
+    token-exactness vs a single-chip engine is gated by
+    tools/serve_bench --tp and tests/test_serve_tp.py.
     """
 
     def __init__(self, weights, num_heads, head_dim, max_seq_len=2048,
                  norm_type="layernorm", activation="gelu",
                  use_neox_rotary_style=False, dtype="bfloat16",
-                 gqa_group_size=-1, weight_quant=None):
+                 gqa_group_size=-1, weight_quant=None, tp=1):
         import jax
         import jax.numpy as jnp
         from ..incubate.nn.functional import fused_multi_transformer
@@ -461,6 +477,49 @@ class FusedMultiTransformerEngine:
         kw = dict(norm_type=norm_type, activation=activation,
                   use_neox_rotary_style=use_neox_rotary_style,
                   gqa_group_size=gqa_group_size)
+        # tensor-parallel serving (tp_layout.py): weights repacked +
+        # device_put onto a one-axis mesh, and the paged programs below
+        # become shard_map'd mesh programs. paged_kw is the PER-DEVICE
+        # view the shard_map body computes with: local head counts and
+        # the two row-parallel psums per layer.
+        self.tp = int(tp) if tp else 1
+        if self.tp < 1:
+            # reject at construction like the divisibility errors: a
+            # negative width would serve single-chip while poisoning
+            # every mesh-aware surface (healthz mesh.tp, per-device
+            # gauges) downstream
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        self._mesh = None
+        self._w_specs = None
+        paged_kw = kw
+        if self.tp > 1:
+            if weight_quant:
+                raise ValueError(
+                    "weight_quant with tp > 1 is not supported yet: the "
+                    "packed int4/int8 layouts need their own per-device "
+                    "repacking (serve quantized single-chip, or dense "
+                    "tensor-parallel)")
+            import numpy as _np
+            from jax.sharding import Mesh
+            from ..ops.pallas.paged_attention import kv_head_shard
+            from .tp_layout import shard_serving_weights, validate_tp
+            kvh_n = self._gqa or num_heads
+            ffn_dim = int(self._w["ffn2_weights"][0].shape[0])
+            validate_tp(num_heads, kvh_n, ffn_dim, self.tp)
+            kv_head_shard(kvh_n, self.tp)   # same grid on every device
+            devs = jax.devices()
+            if len(devs) < self.tp:
+                raise ValueError(
+                    f"tp={self.tp} needs {self.tp} devices, "
+                    f"have {len(devs)}")
+            self._mesh = Mesh(_np.array(devs[:self.tp]), ("tp",))
+            self._w, self._w_specs = shard_serving_weights(
+                self._w, self._mesh, num_heads, kvh_n,
+                activation.endswith("glu"), self.tp)
+            paged_kw = dict(kw)
+            if self._gqa:
+                paged_kw["gqa_group_size"] = self._gqa // self.tp
+            paged_kw["_tp_reduce"] = lambda x: jax.lax.psum(x, "tp")
         # weight-only quantized serving: pack the matmul weights at load
         # (int4 = half the int8 tier's weight HBM) and dequantize inside
         # the op, fused into the operand load
@@ -638,7 +697,7 @@ class FusedMultiTransformerEngine:
                 seq_lens=Tensor(lens), chunk_lens=Tensor(qlens),
                 rotary_embs=w.get("rotary_embs"),
                 block_tables=tables, ragged_work=rwork,
-                ragged_pack=rpack, **kw)
+                ragged_pack=rpack, **paged_kw)
             bidx = jnp.arange(out.data.shape[0])
             picked = out.data[bidx[:, None], sel]        # [B, W, E]
             logits = picked @ w["lm_head"]               # [B, W, V]
@@ -682,16 +741,79 @@ class FusedMultiTransformerEngine:
         # continuous-batching engine's per-request lanes line up against
         # these on one chrome timeline (a slow step with a fat
         # `paged_step` span on its first bucket sighting = compile)
+        if self.tp == 1:
+            jit_paged_step = jax.jit(paged_step, static_argnums=(8,),
+                                     donate_argnums=(1,))
+            jit_paged_rewind = jax.jit(paged_rewind, static_argnums=(4,),
+                                       donate_argnums=(0,))
+            jit_paged_copy = jax.jit(paged_copy, donate_argnums=(0,))
+        else:
+            # mesh programs: the SAME paged bodies run per-device under
+            # shard_map — weights arrive as their layout shards, the
+            # caches as kv-head shards, every host-built array (slab,
+            # sel, tables, lens, work list) replicated — and the
+            # sampled tokens come back replicated, so the host reads
+            # ONE array exactly as in the single-chip case. Static args
+            # (rpack / rewind span) stay OUTSIDE the shard_map via
+            # closure, keeping the bucketed compile-key treadmill
+            # identical per mesh shape. check_vma=False: the per-layer
+            # psums make the residual stream replicated by construction
+            # (jax-0.4.x's replication checker cannot see through the
+            # Pallas kernel).
+            from ..framework.compat import resolve_shard_map
+            from jax.sharding import PartitionSpec as _P
+            _shard_map = resolve_shard_map()
+            mesh = self._mesh
+            w_specs = self._w_specs
+            n_layers = self._n_layers
+            cspecs = [_P(None, "tp")] * n_layers
+            rep = _P()
+
+            def paged_step_tp(w, caches, toks, qlens, sel, tables, lens,
+                              rwork, rpack, temp, topp, key):
+                def local(w, caches, toks, qlens, sel, tables, lens,
+                          rwork, temp, topp, key):
+                    return paged_step(w, caches, toks, qlens, sel,
+                                      tables, lens, rwork, rpack, temp,
+                                      topp, key)
+                f = _shard_map(
+                    local, mesh=mesh,
+                    in_specs=(w_specs, cspecs, rep, rep, rep, rep, rep,
+                              (rep,) * 9, rep, rep, rep),
+                    out_specs=(rep, cspecs),
+                    axis_names=("tp",), check_vma=False)
+                return f(w, caches, toks, qlens, sel, tables, lens,
+                         rwork, temp, topp, key)
+
+            def paged_rewind_tp(caches, tables, new_lens, old_lens,
+                                span):
+                def local(caches, tables, new_lens, old_lens):
+                    return paged_rewind(caches, tables, new_lens,
+                                        old_lens, span)
+                f = _shard_map(
+                    local, mesh=mesh,
+                    in_specs=(cspecs, rep, rep, rep), out_specs=cspecs,
+                    axis_names=("tp",), check_vma=False)
+                return f(caches, tables, new_lens, old_lens)
+
+            def paged_copy_tp(caches, src_block, dst_block):
+                f = _shard_map(
+                    paged_copy, mesh=mesh,
+                    in_specs=(cspecs, rep, rep), out_specs=cspecs,
+                    axis_names=("tp",), check_vma=False)
+                return f(caches, src_block, dst_block)
+
+            jit_paged_step = jax.jit(paged_step_tp, static_argnums=(8,),
+                                     donate_argnums=(1,))
+            jit_paged_rewind = jax.jit(paged_rewind_tp,
+                                       static_argnums=(4,),
+                                       donate_argnums=(0,))
+            jit_paged_copy = jax.jit(paged_copy_tp, donate_argnums=(0,))
         self._paged_step = _dispatch_span(
-            "paged_step", jax.jit(paged_step, static_argnums=(8,),
-                                  donate_argnums=(1,)),
-            static_argnums=(8,))
+            "paged_step", jit_paged_step, static_argnums=(8,))
         self._paged_rewind = _dispatch_span(
-            "paged_rewind", jax.jit(paged_rewind, static_argnums=(4,),
-                                    donate_argnums=(0,)),
-            static_argnums=(4,))
-        self._paged_copy = _dispatch_span(
-            "paged_copy", jax.jit(paged_copy, donate_argnums=(0,)))
+            "paged_rewind", jit_paged_rewind, static_argnums=(4,))
+        self._paged_copy = _dispatch_span("paged_copy", jit_paged_copy)
 
     def _build_quant_mm(self, weights, dtype):
         """Repack the projection weights into the Pallas kernel's int4
@@ -757,13 +879,55 @@ class FusedMultiTransformerEngine:
         """Per-layer paged KV caches [2, KVH, num_blocks, block_size, D]
         for the continuous-batching serving path
         (incubate.nn.ContinuousBatchingEngine owns the block allocator
-        that hands slices of these out to requests)."""
+        that hands slices of these out to requests). Under tp > 1 each
+        layer's cache is placed sharded over KV HEADS — the GLOBAL
+        (logical) shape is unchanged, each device holds a
+        [2, KVH/tp, num_blocks, block_size, D] shard, so the host-side
+        allocator keeps one flat block-id space while per-device cache
+        HBM is 1/tp of the single-chip figure."""
         import jax.numpy as jnp
         dtype = dtype or self._dtype
-        kvh = self._gqa or self._w["qkv_weights"][0].shape[1]
+        kvh = self._gqa or self.num_heads
+        if self.tp > 1:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sh = NamedSharding(self._mesh, P(None, "tp"))
+            return [jax.device_put(
+                jnp.zeros((2, kvh, num_blocks, block_size,
+                           self.head_dim), dtype), sh)
+                for _ in range(self._n_layers)]
         return [jnp.zeros((2, kvh, num_blocks, block_size,
                            self.head_dim), dtype)
                 for _ in range(self._n_layers)]
+
+    # -- tensor-parallel accounting (host math; tp == 1 degenerates) ------
+    def kv_device_block_bytes(self, block_size):
+        """Bytes ONE allocator block occupies PER DEVICE across every
+        layer's cache shard: L x 2(K,V) x KVH/tp x block_size x D x
+        itemsize. The per-device KV high-water in bytes is
+        `allocator.high_water * this` — the capacity win the TP gate
+        asserts (1/tp of the single-chip figure for the same
+        workload)."""
+        import jax.numpy as jnp
+        kvh = self._gqa or self.num_heads
+        itemsize = jnp.dtype(self._dtype).itemsize
+        return (self._n_layers * 2 * (kvh // self.tp)
+                * int(block_size) * self.head_dim * itemsize)
+
+    def tp_step_comm_bytes(self, batch, width):
+        """Analytic per-step collective payload of the TP paged step:
+        two row-parallel psums per layer, each reducing a
+        [batch, width, E] partial activation — the aval math the
+        serving loop hands the comm-task registry so
+        `collective_bytes_total{op="psum",axis="tp"}` attributes the
+        step's comms cost without a device round trip. 0 when tp == 1
+        (no collectives in the program)."""
+        if self.tp <= 1:
+            return 0
+        import jax.numpy as jnp
+        e = int(self._w["embedding"].shape[1])
+        itemsize = jnp.dtype(self._dtype).itemsize
+        return 2 * self._n_layers * int(batch) * int(width) * e * itemsize
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
                  top_p=1.0, seed=None, prompt_lens=None):
@@ -781,6 +945,13 @@ class FusedMultiTransformerEngine:
         import numpy as np
         import jax
         import jax.numpy as jnp
+        if self.tp > 1:
+            raise NotImplementedError(
+                "generate() serves the dense single-chip cache; a "
+                "tensor-parallel engine serves through "
+                "ContinuousBatchingEngine's paged path (token-exact vs "
+                "a tp=1 engine's generate() — the serve_tp gate pins "
+                "it). Build the reference engine with tp=1.")
         if seed is None:
             from ..core import random as _rng
             key = _rng.next_key()

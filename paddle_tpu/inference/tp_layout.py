@@ -1,0 +1,242 @@
+"""Tensor-parallel serving layout: the PartitionSpec catalog + weight
+repacking that shards `FusedMultiTransformerEngine`'s paged serving path
+over a one-axis `tp` device mesh.
+
+Megatron-style split (reference bar: the SpecLayout PartitionSpec
+catalogs production TPU serving stacks keep next to their meshes):
+
+  * QKV projection — COLUMN-parallel over attention heads: each device
+    computes `num_heads/tp` query heads and `kv_heads/tp` KV heads from
+    the full hidden state. The paged KV cache shards over the SAME
+    kv-head axis, so every device appends into — and attends over —
+    exactly the heads it projected: the ragged work-list kernel runs
+    unchanged on a `kv_heads/tp`-head local cache shard, and per-device
+    KV HBM drops by the TP factor.
+  * attention out-projection — ROW-parallel: each device contracts its
+    local heads' context rows against its `[H*D/tp, E]` weight rows and
+    the partial sums reduce with ONE `psum` over `tp` per layer.
+  * FFN up (ffn1) — column-parallel; FFN down (ffn2) — row-parallel
+    with the layer's second `psum`.
+  * embeddings / lm_head / norm scales and biases — replicated (they
+    are small at serving shapes; the residual stream stays replicated,
+    which is what keeps the host-side scheduler single-brain: it ships
+    ONE slab and reads ONE sampled-token array back).
+
+Packed layouts need ROW/COLUMN REORDERING before a contiguous
+`PartitionSpec` split is meaningful:
+
+  * The GQA-packed qkv weight `[H + 2G, D, E]` interleaves q-heads,
+    then k-heads, then v-heads. A naive axis-0 split hands device 1 a
+    mix of late q-heads and early k-heads. `repack_gqa_qkv` reorders
+    rows so each device's contiguous block is itself a valid GQA
+    packing `[H/tp + 2G/tp, D, E]`.
+  * A *glu ffn1 weight `[E, 2F]` pairs activation column j with gate
+    column j+F. A contiguous 2F/tp split breaks the pairing (device
+    d's local `split(2)` would gate a-columns against the WRONG
+    g-columns). `repack_glu_ffn1` reorders columns so each device's
+    block is `[a_d | g_d]` — locally splittable, and its activation
+    output lines up with ffn2's contiguous row shard.
+
+Both repacks are pure permutations: `unpack_*` inverts them exactly,
+and the single-chip result is reproduced token-for-token (pinned by
+tests/test_serve_tp.py and the serve_bench --tp gate).
+"""
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["ServeSpecLayout", "validate_tp", "repack_gqa_qkv",
+           "unpack_gqa_qkv", "repack_glu_ffn1", "shard_serving_weights",
+           "serving_weight_specs"]
+
+
+@dataclass(frozen=True)
+class ServeSpecLayout:
+    """Canonical PartitionSpecs for the fused-transformer serving
+    weights over a one-axis tensor-parallel mesh (SpecLayout shape:
+    one method per parameter family, axis names are data)."""
+
+    tp_axis: str = "tp"
+
+    def _ps(self, *dims):
+        from jax.sharding import PartitionSpec as P
+        return P(*dims)
+
+    def qkv(self, gqa_packed):
+        """[H+2G, D, E] GQA packing shards rows (after repack_gqa_qkv);
+        the MHA [3, H, D, E] layout shards the head axis directly."""
+        if gqa_packed:
+            return self._ps(self.tp_axis, None, None)
+        return self._ps(None, self.tp_axis, None, None)
+
+    def qkv_bias(self, gqa_packed):
+        if gqa_packed:
+            return self._ps(self.tp_axis, None)
+        return self._ps(None, self.tp_axis, None)
+
+    def out_proj(self):
+        """[H*D, E] row-parallel: the layer's first psum."""
+        return self._ps(self.tp_axis, None)
+
+    def ffn1(self):
+        """[E, F'] column-parallel (F' = 2F for *glu, repacked)."""
+        return self._ps(None, self.tp_axis)
+
+    def ffn1_bias(self):
+        return self._ps(self.tp_axis)
+
+    def ffn2(self):
+        """[F, E] row-parallel: the layer's second psum."""
+        return self._ps(self.tp_axis, None)
+
+    def replicated(self):
+        """Embeddings, lm_head, norm scales/biases, out-proj/ffn2
+        biases (added AFTER the psum), rotary tables."""
+        return self._ps()
+
+    def kv_cache(self):
+        """[2, KVH, NB, BS, D] per-layer paged cache: kv-heads over tp
+        — each device owns KVH/tp heads of EVERY block, so the block
+        allocator stays a single host-side brain while per-device
+        cache HBM drops by the TP factor."""
+        return self._ps(None, self.tp_axis)
+
+
+def validate_tp(num_heads, kv_heads, dim_feedforward, tp):
+    """The divisibility contract a head-sharded serving engine needs;
+    raises with the exact failing axis so misconfiguration is a
+    constructor error, not a mid-step reshape explosion."""
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    for what, n in (("num_heads", num_heads), ("kv_heads", kv_heads),
+                    ("dim_feedforward", dim_feedforward)):
+        if n % tp != 0:
+            raise ValueError(
+                f"tensor-parallel serving needs {what} ({n}) divisible "
+                f"by tp ({tp}) — each device owns {what}/tp of them")
+    return tp
+
+
+def _gqa_row_order(num_q, num_kv, tp):
+    """Row permutation for the [H+2G, D, E] packing: per-device blocks
+    [q_d | k_d | v_d] so a contiguous axis-0 split is a valid local
+    GQA packing."""
+    hq, hk = num_q // tp, num_kv // tp
+    order = []
+    for d in range(tp):
+        order.extend(range(d * hq, (d + 1) * hq))                 # q rows
+        order.extend(range(num_q + d * hk, num_q + (d + 1) * hk))  # k rows
+        order.extend(range(num_q + num_kv + d * hk,                # v rows
+                           num_q + num_kv + (d + 1) * hk))
+    return np.asarray(order)
+
+
+def repack_gqa_qkv(w, num_q, num_kv, tp):
+    """Reorder a GQA-packed qkv weight [H+2G, D, E] (or bias [H+2G, D])
+    so each of tp contiguous row blocks is itself GQA-packed over the
+    device's local heads."""
+    order = _gqa_row_order(num_q, num_kv, tp)
+    return np.asarray(w)[order]
+
+
+def unpack_gqa_qkv(w, num_q, num_kv, tp):
+    """Inverse permutation of repack_gqa_qkv (tests pin the round
+    trip)."""
+    order = _gqa_row_order(num_q, num_kv, tp)
+    inv = np.empty_like(order)
+    inv[order] = np.arange(order.shape[0])
+    return np.asarray(w)[inv]
+
+
+def _glu_col_order(two_f, tp):
+    f = two_f // 2
+    fl = f // tp
+    order = []
+    for d in range(tp):
+        order.extend(range(d * fl, (d + 1) * fl))          # a-columns
+        order.extend(range(f + d * fl, f + (d + 1) * fl))  # g-columns
+    return np.asarray(order)
+
+
+def repack_glu_ffn1(w, tp, axis=-1):
+    """Reorder a *glu ffn1 weight's [E, 2F] columns (or bias [2F]) into
+    per-device [a_d | g_d] blocks: the local `split(2, axis=-1)` then
+    pairs activation column j with ITS gate column, and the local
+    activation output is ffn2's contiguous row shard in order."""
+    w = np.asarray(w)
+    order = _glu_col_order(w.shape[axis], tp)
+    return np.take(w, order, axis=axis)
+
+
+def serving_weight_specs(weights, layout=None):
+    """PartitionSpec pytree MIRRORING a FusedMultiTransformerEngine
+    weight dict (same keys, lists stay lists): the `in_specs` side of
+    the shard_map'd paged programs. `weights` may hold arrays or
+    shapes; only the key set and list lengths matter."""
+    layout = layout or ServeSpecLayout()
+    # the engine stores GQA-packed qkv as [H+2G, D, E] (rank 3) and the
+    # MHA layout as [3, H, D, E] (rank 4) — the spec follows the rank
+    sample = weights["qkv_weights"][0]
+    gqa_packed = len(getattr(sample, "shape", np.shape(sample))) == 3
+
+    def per_layer(spec, n):
+        return [spec] * n
+
+    specs = {}
+    for k, v in weights.items():
+        if k == "qkv_weights":
+            specs[k] = per_layer(layout.qkv(gqa_packed), len(v))
+        elif k == "qkv_biases":
+            specs[k] = per_layer(layout.qkv_bias(gqa_packed), len(v))
+        elif k == "linear_weights":
+            specs[k] = per_layer(layout.out_proj(), len(v))
+        elif k == "ffn1_weights":
+            specs[k] = per_layer(layout.ffn1(), len(v))
+        elif k == "ffn1_biases":
+            specs[k] = per_layer(layout.ffn1_bias(), len(v))
+        elif k == "ffn2_weights":
+            specs[k] = per_layer(layout.ffn2(), len(v))
+        elif isinstance(v, (list, tuple)):
+            # norm scales/biases, linear/ffn2 biases (post-psum adds)
+            specs[k] = per_layer(layout.replicated(), len(v))
+        else:
+            specs[k] = layout.replicated()   # embedding / lm_head / rope
+    return specs
+
+
+def shard_serving_weights(weights, mesh, num_q, num_kv, glu, tp,
+                          layout=None):
+    """Repack + device_put a FusedMultiTransformerEngine weight dict
+    onto the tp mesh per the layout catalog. Returns (sharded weights,
+    spec pytree). `weights` values are jax/numpy arrays (the engine
+    already cast dtypes); repacking happens host-side on numpy views.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    layout = layout or ServeSpecLayout()
+    sample = weights["qkv_weights"][0]
+    gqa_packed = len(sample.shape) == 3
+    repacked = {}
+    for k, v in weights.items():
+        if k in ("qkv_weights", "qkv_biases") and gqa_packed and tp > 1:
+            repacked[k] = [repack_gqa_qkv(np.asarray(w), num_q, num_kv,
+                                          tp) for w in v]
+        elif k in ("ffn1_weights", "ffn1_biases") and glu and tp > 1:
+            repacked[k] = [repack_glu_ffn1(np.asarray(w), tp) for w in v]
+        else:
+            repacked[k] = v
+    specs = serving_weight_specs(weights, layout=layout)
+
+    def put(arr, spec):
+        return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+
+    sharded = {}
+    for k, v in repacked.items():
+        if isinstance(v, (list, tuple)):
+            sharded[k] = [put(a, s) for a, s in zip(v, specs[k])]
+        else:
+            sharded[k] = put(v, specs[k])
+    return sharded, specs

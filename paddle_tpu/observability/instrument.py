@@ -320,6 +320,35 @@ def serve_prefill_chunk():
              "shrinks it one pow2 bucket when decode latency degrades)")
 
 
+# -- tensor-parallel serving (kv-head-sharded paged cache) ---------------
+
+def serve_tp_degree():
+    return get_registry().gauge(
+        "serve_tp_degree",
+        help="tensor-parallel width of the serving engine's device "
+             "mesh (1 = single-chip)")
+
+
+def kv_device_bytes_used():
+    # per-device children are bounded by the mesh topology (tp <=
+    # device count), not by traffic — the same contract as the
+    # shard_bytes/hbm_device_* families in observability/memory.py
+    return get_registry().gauge(
+        "kv_device_bytes_used",
+        help="paged-KV cache bytes held by in-flight requests on each "
+             "device's kv-head shard (blocks_used x per-device block "
+             "bytes; drops by the TP factor vs single-chip)",
+        labels=("device",))
+
+
+def kv_device_bytes_high_water():
+    return get_registry().gauge(
+        "kv_device_bytes_high_water",
+        help="peak per-device paged-KV bytes ever in use (the serve_tp "
+             "gate asserts 1/tp of the single-chip figure)",
+        labels=("device",))
+
+
 # -- training (pretrain loop) --------------------------------------------
 
 def train_step_seconds():

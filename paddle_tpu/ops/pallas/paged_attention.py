@@ -46,6 +46,17 @@ allocator that owns the tables is host code anyway; under `jax.jit` the
 caller passes the arrays in (`work=`) and the list length stays static
 per compile (bucket it — `bucket_to=next_pow2` — so mixed-progress
 serving batches reuse a handful of programs).
+
+Tensor-parallel serving shards this kernel over KV HEADS (the grid's
+first axis): each device of a `tp` mesh holds a [KVH/tp, NB, BS, D]
+cache shard plus the query heads of its kv groups, and runs the SAME
+work list over its local heads (`kv_head_shard` spells the ownership
+contract). Nothing in the kernel changes — the per-device call is just
+a smaller-KVH instance — which is exactly the property that makes the
+work-list design shard cleanly: work items are (sequence, block) pairs,
+head-blind by construction, so one host-built list drives every shard
+of one compiled mesh step (inference/tp_layout.py + the engine's
+shard_map'd paged programs).
 """
 import functools
 import math
@@ -162,6 +173,34 @@ def next_pow2(n):
     """Work-list bucketing for serving: compile one program per power of
     two instead of one per distinct total block count."""
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def kv_head_shard(num_kv_heads, tp, rank=None):
+    """Kv-head ownership under tensor-parallel serving: the ragged
+    kernel's grid is (kv_head, work item), so the natural multi-chip
+    split hands each of `tp` devices a contiguous `num_kv_heads/tp`
+    head slice of the paged cache — the WORK LIST itself is head-blind
+    (one entry per (sequence, cache block)) and replicates verbatim,
+    which is what lets the host build it once for the whole mesh.
+
+    Returns (start, count) for `rank`, or just `count` when rank is
+    None (the per-device head budget). Raises when the heads don't
+    split evenly: a ragged head split would give devices different
+    grid shapes and break the shared (work-list length, chunk width)
+    compile keys."""
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if num_kv_heads % tp != 0:
+        raise ValueError(
+            f"kv heads ({num_kv_heads}) must divide evenly over tp "
+            f"({tp}): every device must run the same (kvh, work) grid")
+    count = num_kv_heads // tp
+    if rank is None:
+        return count
+    if not 0 <= int(rank) < tp:
+        raise ValueError(f"rank {rank} outside [0, {tp})")
+    return int(rank) * count, count
 
 
 def build_ragged_work(block_tables, context_lens, block_size, pack,

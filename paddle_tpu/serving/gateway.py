@@ -192,6 +192,23 @@ def validate_healthz(payload):
     for k in ("inflight", "queue_depth", "steps", "finished"):
         if not _is_int(payload[k]) or payload[k] < 0:
             raise ValueError(f"healthz {k} must be a non-negative int")
+    mesh = payload.get("mesh")
+    if mesh is not None:
+        # mesh-aware health (tensor-parallel serving): tp width + one
+        # row PER DEVICE — a load balancer sizing by KV headroom must
+        # see every device's shard, not a silently-device-0 figure
+        if not _is_int(mesh.get("tp")) or mesh["tp"] < 1:
+            raise ValueError("healthz mesh.tp must be a positive int")
+        devs = mesh.get("devices")
+        if not isinstance(devs, list) or len(devs) != mesh["tp"]:
+            raise ValueError(
+                "healthz mesh.devices must list exactly tp entries")
+        for row in devs:
+            for k in ("device", "kv_bytes_used", "kv_bytes_high_water"):
+                if not _is_int(row.get(k)) or row[k] < 0:
+                    raise ValueError(
+                        f"healthz mesh device row needs non-negative "
+                        f"int {k}")
     return payload
 
 
@@ -639,6 +656,21 @@ class ServingGateway:
             "steps": int(self.engine._step_count),
             "finished": len(self.engine.finished),
         }
+        report = getattr(self.engine, "device_kv_report", None)
+        if report is not None:
+            # mesh block: tp width + per-device paged-KV bytes (each
+            # device holds 1/tp of every block's kv heads under TP
+            # serving; single-chip reports its one device) — the
+            # "gauges assume a single pool" gap the TP issue names
+            rows = report()
+            payload["mesh"] = {
+                "tp": int(getattr(self.engine, "tp", 1) or 1),
+                "devices": [{
+                    "device": int(r["device"]),
+                    "kv_bytes_used": int(r["kv_bytes_used"]),
+                    "kv_bytes_high_water": int(r["kv_bytes_high_water"]),
+                } for r in rows],
+            }
         await self._respond(writer, route,
                             200 if status == "ok" else 503, payload)
 

@@ -63,10 +63,14 @@ def test_graftlint_imports():
     # suppression comments (GL117 — suppression rot made visible);
     # the train-health PR's rule: daemon threads a long-lived object's
     # stop()/close() never joins (GL118 — the PsServer handler-thread
-    # hazard; the comm watchdog's join-with-timeout is the clean shape)
+    # hazard; the comm watchdog's join-with-timeout is the clean shape);
+    # the TP-serving PR's rule: end-of-stream sentinels dropped at
+    # producer exit (GL119 — put_nowait in a finally with queue.Full
+    # swallowed while a get() loop waits; the PR-14 DataLoader prefetch
+    # hang, whose closed-flag retry loop is the clean shape)
     assert {"GL104", "GL105", "GL107", "GL108", "GL110", "GL111",
             "GL112", "GL113", "GL114", "GL115", "GL116",
-            "GL117", "GL118"} <= set(gl.RULES), sorted(gl.RULES)
+            "GL117", "GL118", "GL119"} <= set(gl.RULES), sorted(gl.RULES)
 
 
 def test_tree_is_clean():
@@ -129,7 +133,7 @@ def test_tree_run_is_within_budget_and_reports_phases():
 
 
 def test_concurrency_corpus_roundtrip():
-    """The five GL114-GL118 corpus files each reconstruct a fixed real
+    """The six GL114-GL119 corpus files each reconstruct a fixed real
     hazard: caught codes fire exactly, clean tripwires stay silent
     (any unexpected code fails), and each file's suppression-honored
     demo is consumed (so GL117 does not flag it)."""
@@ -147,6 +151,7 @@ def test_concurrency_corpus_roundtrip():
         "fire_and_forget_task.py": "GL116",
         "stale_suppression.py": "GL117",
         "unjoined_thread_shutdown.py": "GL118",
+        "dropped_queue_sentinel.py": "GL119",
     }
     for name, code in expected_files.items():
         path = os.path.join(corpus, name)

@@ -1,0 +1,190 @@
+# graftlint-corpus-expect: GL119 GL119
+# graftlint-corpus-rule: GL119
+"""Known-bad corpus: end-of-stream sentinel dropped at producer exit
+(GL119).
+
+Reconstructs the PR-14 DataLoader prefetch hang: the thread-prefetch
+producer pushed batches with a closed-flag retry loop, but its
+epoch-end SENTINEL went through a bare ``put_nowait`` inside the
+``finally:`` — whenever the consumer was merely slow (queue still full
+at epoch end) the ``queue.Full`` swallow dropped the sentinel and the
+consumer blocked on ``q.get()`` forever, with no traceback anywhere.
+The instrumented-loader stall test exposed it by slowing the consumer
+one histogram-observe per batch.
+
+Clean tripwires: the FIXED producer (sentinel gets the same closed-flag
+retry loop as data puts), a ``put(..., timeout=)`` retry shape, a
+handler that re-raises, and a sentinel put on a queue nothing in the
+file ever get()-loops on (no consumer to hang).
+"""
+import queue
+import threading
+
+
+# -- caught ------------------------------------------------------------------
+
+class PrefetchBad:
+    """The hazard: data puts retry, the sentinel does not."""
+
+    _SENTINEL = object()
+
+    def __init__(self, batches):
+        self._q = queue.Queue(maxsize=4)
+        self._batches = batches
+        self._closed = threading.Event()
+
+    def _producer(self):
+        try:
+            for b in self._batches:
+                while not self._closed.is_set():
+                    try:
+                        self._q.put(b, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        finally:
+            try:
+                self._q.put_nowait(self._SENTINEL)   # expect GL119
+            except queue.Full:
+                pass        # ...and the consumer waits forever
+
+    def __iter__(self):
+        threading.Thread(target=self._producer, daemon=True).start()
+        while True:
+            b = self._q.get()
+            if b is self._SENTINEL:
+                break
+            yield b
+
+
+def feed_bare(q, items, done):
+    """The no-handler variant: put_nowait raises Full into the dying
+    producer thread — equally invisible to the blocked consumer."""
+    try:
+        for it in items:
+            q.put(it, timeout=0.5)
+    finally:
+        q.put_nowait(done)                           # expect GL119
+
+
+def drain_bare(q, done):
+    while True:
+        item = q.get()
+        if item is done:
+            return
+
+
+# -- clean: the fixed retry-loop shape (must NOT flag) -----------------------
+
+class PrefetchFixed:
+    """The PR-14 fix: the sentinel gets the SAME closed-flag retry loop
+    as data puts — full queue means wait-and-retry, not drop."""
+
+    _SENTINEL = object()
+
+    def __init__(self, batches):
+        self._q = queue.Queue(maxsize=4)
+        self._batches = batches
+        self._closed = threading.Event()
+
+    def _producer(self):
+        try:
+            for b in self._batches:
+                while not self._closed.is_set():
+                    try:
+                        self._q.put(b, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        finally:
+            while not self._closed.is_set():
+                try:
+                    self._q.put(self._SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def __iter__(self):
+        threading.Thread(target=self._producer, daemon=True).start()
+        while True:
+            b = self._q.get()
+            if b is self._SENTINEL:
+                break
+            yield b
+
+
+# -- clean: put_nowait retried in a loop inside the finally ------------------
+
+def feed_retry_nowait(q, items, done, closed):
+    """put_nowait is fine when a loop retries it until it lands."""
+    try:
+        for it in items:
+            q.put(it, timeout=0.5)
+    finally:
+        while not closed.is_set():
+            try:
+                q.put_nowait(done)
+                break
+            except queue.Full:
+                continue
+
+
+def drain_retry(q, done):
+    while True:
+        if q.get() is done:
+            return
+
+
+# -- clean: handler re-raises (the drop is at least LOUD) --------------------
+
+def feed_reraise(q, items, done):
+    try:
+        for it in items:
+            q.put(it, timeout=0.5)
+    finally:
+        try:
+            q.put_nowait(done)
+        except queue.Full:
+            raise RuntimeError("consumer stalled: sentinel undeliverable")
+
+
+def drain_reraise(q, done):
+    while True:
+        if q.get() is done:
+            return
+
+
+# -- suppression demo (honored: the corpus roundtrip counts it) --------------
+
+def feed_suppressed(q, items, done):
+    """A reasoned exception: this pipeline's consumer treats starvation
+    past a deadline as end-of-stream, so a dropped sentinel only costs
+    the timeout."""
+    try:
+        for it in items:
+            q.put(it, timeout=0.5)
+    finally:
+        try:
+            q.put_nowait(done)  # graftlint: disable=GL119 - consumer side has a deadline fallback; a dropped sentinel costs one timeout, not a hang
+        except queue.Full:
+            pass
+
+
+def drain_suppressed(q, done):
+    while True:
+        if q.get() is done:
+            return
+
+
+# -- clean: no consumer get()-loop in the file -------------------------------
+
+def fire_and_forget_status(status_q, final):
+    """A status queue nothing here blocks on: dropping the last sample
+    under pressure is a (documented) best-effort tradeoff, not a hang."""
+    try:
+        final["steps"] += 1
+    finally:
+        try:
+            status_q.put_nowait(final)
+        except queue.Full:
+            pass

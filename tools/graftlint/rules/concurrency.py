@@ -1,4 +1,4 @@
-"""Async/concurrency rules (GL114-GL118) — the context-sensitive family
+"""Async/concurrency rules (GL114-GL119) — the context-sensitive family
 the two-phase engine exists for.
 
 PR 12 put an asyncio gateway, a dedicated engine-stepper thread,
@@ -62,7 +62,18 @@ signal, then `join(timeout=...)` (the comm watchdog's stop() is the
 in-tree clean shape); a stop that only sets the event and returns is
 the hazard. Classes with no shutdown-shaped method are out of scope
 (nothing promises a lifecycle), as are non-daemon threads (they block
-exit loudly instead of racing it)."""
+exit loudly instead of racing it).
+
+GL119 dropped-queue-sentinel: `put_nowait()` of an end-of-stream
+sentinel inside a `finally:` whose `except queue.Full` swallows (or
+with no handler at all), on a queue some loop elsewhere in the file
+blocks on with `get()`. The producer exits believing it signalled the
+end; the consumer waits forever on a sentinel that was dropped because
+the queue happened to be full at that instant — the PR-14 DataLoader
+prefetch hang, reconstructed in the corpus. The sanctioned shape is
+the closed-flag retry loop the fixed producer uses for data AND
+sentinel puts alike; `put(..., timeout=)` inside a loop and handlers
+that re-raise or record are exempt."""
 import ast
 
 from ..core import RULES, in_paddle_tpu, rule, Finding
@@ -681,3 +692,131 @@ def unjoined_thread_at_shutdown(ctx):
                 f"daemon thread stored in `self.{attr}`{target} is "
                 f"never join()ed by `{cls.name}.{'`/`'.join(shutdowns)}"
                 f"`: {_GL118_MSG}"), node
+
+
+# -- GL119 -------------------------------------------------------------------
+
+_GL119_MSG = (
+    "a sentinel dropped at producer exit leaves the consumer blocked on "
+    "get() forever — the queue being merely FULL at epoch end is the "
+    "common case, not the rare one (the PR-14 DataLoader prefetch "
+    "hang). Give the sentinel the same closed-flag retry loop as data "
+    "puts: `while not closed.is_set(): try: q.put(sentinel, "
+    "timeout=...); break; except queue.Full: continue`")
+
+
+def _swallows(handler):
+    """An except body that only pass/continue-s (no re-raise, no retry
+    semantics of its own)."""
+    return all(isinstance(s, (ast.Pass, ast.Continue))
+               for s in handler.body)
+
+
+def _catches_full(handler):
+    """Handler type covers queue.Full: the exact class, a bare except,
+    or a broad Exception/BaseException."""
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        chain = _attr_chain(n)
+        if chain.endswith("Full") or chain in ("Exception",
+                                               "BaseException"):
+            return True
+    return False
+
+
+def _in_retry_loop(ctx, node, stop):
+    """A While/For between `node` and `stop` means the put is retried
+    until it lands — the fixed DataLoader shape, not the hazard."""
+    cur = ctx.parent(node)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, (ast.While, ast.For)):
+            return True
+        cur = ctx.parent(cur)
+    return False
+
+
+def _get_loops(ctx, scope_nodes):
+    """Receiver keys of blocking `X.get()` calls that sit inside a
+    loop — the consumer side whose unblocking depends on the
+    sentinel arriving."""
+    keys = set()
+    for node in scope_nodes:
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"):
+            continue
+        if _has_timeout(node, block_arg_index=0):
+            continue
+        if not any(isinstance(p, (ast.While, ast.For))
+                   for p in _ancestors(ctx, node)):
+            continue
+        k = _receiver_key(node.func.value)
+        if k:
+            keys.add(k)
+    return keys
+
+
+def _ancestors(ctx, node):
+    cur = ctx.parent(node)
+    while cur is not None:
+        yield cur
+        cur = ctx.parent(cur)
+
+
+@rule("GL119", "dropped-queue-sentinel", "concurrency",
+      applies=in_paddle_tpu)
+def dropped_queue_sentinel(ctx):
+    """`put_nowait()` of an end-of-stream sentinel inside a `finally:`
+    whose `except queue.Full` (or a broad except) swallows — paired
+    with a blocking `get()` loop on the same queue elsewhere in the
+    file. `put_nowait` raises `Full` whenever the consumer is merely
+    SLOW (queue full at producer exit); the swallowed exception drops
+    the sentinel on the floor and the consumer blocks forever with no
+    traceback anywhere. Found by hand in PR 14: the DataLoader
+    thread-prefetch producer's epoch-end sentinel — the fix (the same
+    closed-flag retry loop data puts already used) is the in-tree
+    clean shape. A put inside a retry While/For, a `put(...,
+    timeout=)`, and a handler that re-raises or records are all
+    exempt; so is a queue no consumer in the file ever get()-loops on
+    (nothing to hang)."""
+    consumers = _get_loops(ctx, ctx.walk())
+    if not consumers:
+        return
+    for t in ctx.walk():
+        if not isinstance(t, ast.Try) or not t.finalbody:
+            continue
+        for fin_stmt in t.finalbody:
+            for node in ast.walk(fin_stmt):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "put_nowait"):
+                    continue
+                key = _receiver_key(node.func.value)
+                if key not in consumers:
+                    continue
+                if _in_retry_loop(ctx, node, t):
+                    continue
+                # the innermost Try ABOVE the put (inside the finally)
+                # decides the swallow: except Full/broad with only
+                # pass/continue loses the sentinel silently; no
+                # handler at all raises into the dying producer, which
+                # drops it just as silently for the consumer
+                swallowed = True
+                for anc in _ancestors(ctx, node):
+                    if anc is t:
+                        break
+                    if isinstance(anc, ast.Try) and anc.handlers:
+                        swallowed = any(
+                            _catches_full(h) and _swallows(h)
+                            for h in anc.handlers)
+                        break
+                if not swallowed:
+                    continue
+                yield ctx.finding(
+                    "GL119", node,
+                    f"put_nowait on `{key}` in a finally: with its "
+                    f"Full swallowed, while `{key}.get()` loops "
+                    f"elsewhere in this file: {_GL119_MSG}"), node

@@ -22,6 +22,13 @@ if [ "${LINT_SKIP_SERVE:-0}" != "1" ]; then
   python tools/serve_bench.py --check tools/serve_ragged.json
   python tools/serve_bench.py --check tools/serve_spec.json
   python tools/serve_bench.py --check tools/serve_prefix.json
+  # tensor-parallel gate: on the virtual 8-device mesh the kv-head-
+  # sharded engine must stay token-exact vs single-chip at TP=2/4/8
+  # across plain/chunked/spec/prefix, per-device KV high-water bytes
+  # must be exactly 1/tp, the per-step psum payload must match the
+  # committed aval math, and warmup must cover every compile bucket
+  # per mesh shape
+  python tools/serve_bench.py --check tools/serve_tp.json
   # SLO-monitor gate: heavy-tail workload, windowed p99s under the
   # declared objectives, zero burn-rate breaches, monitor neutrality
   python tools/serve_monitor.py --check tools/serve_slo.json \

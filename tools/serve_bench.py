@@ -757,6 +757,254 @@ def prefix_leg(n_requests=8, prefix_len=448, suffix_len=8, chunk=64,
     return out
 
 
+def _tiny_tp_engine(weights, tp):
+    """One engine per mesh width over SHARED weights: 8 q heads / 8 kv
+    heads (GQA packing) so the kv-head axis splits at tp = 1/2/4/8 on
+    the virtual 8-device mesh."""
+    from paddle_tpu.inference import FusedMultiTransformerEngine
+
+    return FusedMultiTransformerEngine(
+        dict(weights), num_heads=8, head_dim=8, max_seq_len=64,
+        dtype="float32", norm_type="rmsnorm", activation="swiglu",
+        gqa_group_size=8, tp=tp)
+
+
+def _tp_weights(rng):
+    V, E, H, G, D, L, F = 128, 64, 8, 8, 8, 2, 96
+
+    def mk(*shape, scale=0.05):
+        return (rng.standard_normal(shape) * scale).astype("float32")
+
+    import numpy as np
+    w = dict(
+        ln_scales=[np.ones(E, np.float32) for _ in range(L)],
+        qkv_weights=[mk(H + 2 * G, D, E) for _ in range(L)],
+        linear_weights=[mk(H * D, E) for _ in range(L)],
+        ffn_ln_scales=[np.ones(E, np.float32) for _ in range(L)],
+        ffn1_weights=[mk(E, 2 * F) for _ in range(L)],
+        ffn2_weights=[mk(F, E) for _ in range(L)],
+        embedding=mk(V, E), lm_head=mk(E, V))
+    return w, V, L, E
+
+
+def tp_leg(tps=(1, 2, 4, 8)):
+    """Tensor-parallel serving on the virtual 8-device mesh
+    (`__graft_entry__.dryrun_multichip` pattern: force the CPU platform,
+    fake the device count). For each mesh width the SAME host-side
+    scheduler drives the kv-head-sharded engine through plain / chunked
+    / spec / prefix workloads; the gated claims are host-deterministic:
+
+      * token-exact vs the tp=1 engine in every mode,
+      * per-device KV high-water BYTES exactly 1/tp of single-chip
+        (same block count — each device holds KVH/tp heads of every
+        block),
+      * per-step collective payload (2 psums/layer over the [B, C, E]
+        slab) matches the aval math and lands in
+        collective_bytes_total{op="psum",axis="tp"},
+      * zero new compile buckets after warmup, per mesh shape.
+
+    Wall time is not measured: off-TPU it times the Pallas interpreter
+    (the per-device grid is 1/tp of the single-chip one, so the
+    interpret-mode total is ~constant in tp — a real mesh splits it)."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                        GenerationRequest)
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        fa._INTERPRET = True
+    need = max(tps)
+    if len(jax.devices()) < need:
+        raise RuntimeError(
+            f"tp leg needs {need} devices (run with "
+            f"--xla_force_host_platform_device_count={need}; the --tp "
+            "flag sets it when it runs before jax initializes)")
+    rng = np.random.default_rng(0)
+    weights, V, L, E = _tp_weights(rng)
+    block_size = 8
+    workload = [(5, 4), (11, 3), (3, 6), (8, 2)]
+    pattern = [7, 23, 41, 11]
+    prefix_toks = rng.integers(1, V, 24).astype(np.int32)
+    uid = [0]
+
+    def tag(p):
+        uid[0] += 1
+        return f"{p}{uid[0]}"
+
+    def modes(engine):
+        out = {}
+        runs = {}
+
+        def drive(cb, reqs):
+            for r in reqs:
+                cb.submit(r)
+            res = cb.run()
+            return [list(res[r.request_id]) for r in reqs]
+
+        # plain FIFO over the ragged mix
+        cb = ContinuousBatchingEngine(engine, num_blocks=24,
+                                      block_size=block_size, max_batch=4)
+        prng = np.random.default_rng(7)
+        toks = drive(cb, [GenerationRequest(
+            prng.integers(1, V, p).astype(np.int32), n,
+            request_id=tag("tp_pl")) for p, n in workload])
+        runs["plain"] = {"outputs": toks, "steps": cb._step_count,
+                         "high_water_blocks": cb.allocator.high_water}
+        # chunked prefill under a token budget (+ the warm-replay
+        # bucket gate rides this config)
+        cb = ContinuousBatchingEngine(engine, num_blocks=24,
+                                      block_size=block_size, max_batch=4,
+                                      prefill_chunk=4, token_budget=6)
+        prng = np.random.default_rng(7)
+        toks = drive(cb, [GenerationRequest(
+            prng.integers(1, V, p).astype(np.int32), n,
+            request_id=tag("tp_ch")) for p, n in workload])
+        cb.declare_warm()
+        warm = set(cb._seen_buckets)
+        prng = np.random.default_rng(5)
+        drive(cb, [GenerationRequest(
+            prng.integers(1, V, p).astype(np.int32), n,
+            request_id=tag("tp_cw")) for p, n in workload])
+        runs["chunked"] = {
+            "outputs": toks, "steps": cb._step_count,
+            "new_buckets_after_warmup":
+                len(set(cb._seen_buckets) - warm)}
+        # speculative decode on the repetitive workload
+        cb = ContinuousBatchingEngine(engine, num_blocks=24,
+                                      block_size=block_size, max_batch=2,
+                                      prefill_chunk=8, spec_k=4)
+        reqs = [GenerationRequest(np.asarray(pattern * 6, np.int32), 10,
+                                  request_id=tag("tp_sp")),
+                GenerationRequest(np.asarray(pattern * 3, np.int32), 10,
+                                  request_id=tag("tp_sp"))]
+        toks = drive(cb, reqs)
+        runs["spec"] = {"outputs": toks, "steps": cb._step_count,
+                        "drafted": sum(r.spec_drafted for r in reqs),
+                        "accepted": sum(r.spec_accepted for r in reqs)}
+        # prefix cache over a shared preamble
+        cb = ContinuousBatchingEngine(engine, num_blocks=24,
+                                      block_size=block_size, max_batch=4,
+                                      prefill_chunk=8, prefix_cache=True)
+        prng = np.random.default_rng(3)
+        toks = drive(cb, [GenerationRequest(
+            np.concatenate([prefix_toks,
+                            prng.integers(1, V, 3).astype(np.int32)]),
+            4, request_id=tag("tp_pf")) for _ in range(4)])
+        runs["prefix"] = {"outputs": toks, "steps": cb._step_count,
+                          "cache_hits": cb.cache_stats["hit_blocks"],
+                          "cow_copies": cb.cache_stats["cow_copies"]}
+        out["runs"] = runs
+        out["tokens"] = sum(
+            len(t) for t in runs["plain"]["outputs"])
+        out["kv_device_high_water_bytes"] = (
+            runs["plain"]["high_water_blocks"]
+            * engine.kv_device_block_bytes(block_size))
+        return out
+
+    reg = obs.get_registry()
+
+    def coll_bytes():
+        fam = reg.get("collective_bytes_total")
+        return sum(c.value for c in fam._children.values()) \
+            if fam is not None else 0.0
+
+    per_tp = {}
+    for tp in tps:
+        b0 = coll_bytes()
+        engine = _tiny_tp_engine(weights, tp)
+        r = modes(engine)
+        r["collective_bytes"] = int(coll_bytes() - b0)
+        per_tp[str(tp)] = r
+        print(f"tp[{tp}]: plain {r['runs']['plain']['steps']} steps / "
+              f"{r['tokens']} tokens, spec "
+              f"{r['runs']['spec']['accepted']}/"
+              f"{r['runs']['spec']['drafted']} accepted, per-device KV "
+              f"high-water {r['kv_device_high_water_bytes']} B, "
+              f"collective {r['collective_bytes']} B, "
+              f"{r['runs']['chunked']['new_buckets_after_warmup']} new "
+              "buckets after warmup")
+
+    base = per_tp[str(tps[0])]
+    exact = {}
+    for tp in tps[1:]:
+        exact[str(tp)] = all(
+            per_tp[str(tp)]["runs"][m]["outputs"]
+            == base["runs"][m]["outputs"]
+            for m in ("plain", "chunked", "spec", "prefix"))
+    out = {
+        "interpret": not on_tpu,
+        "shape": {"V": V, "E": E, "H": 8, "KVH": 8, "D": 8, "L": L,
+                  "block_size": block_size},
+        "tps": list(tps),
+        "workload": [list(w) for w in workload],
+        "token_exact": exact,
+        "steps": {m: base["runs"][m]["steps"]
+                  for m in ("plain", "chunked", "spec", "prefix")},
+        "spec": {"drafted": base["runs"]["spec"]["drafted"],
+                 "accepted": base["runs"]["spec"]["accepted"]},
+        "prefix": {"cache_hits": base["runs"]["prefix"]["cache_hits"],
+                   "cow_copies": base["runs"]["prefix"]["cow_copies"]},
+        "effective_tokens_per_step": round(
+            base["tokens"] / base["runs"]["plain"]["steps"], 4),
+        "kv_high_water_blocks": base["runs"]["plain"]
+        ["high_water_blocks"],
+        "kv_device_high_water_bytes": {
+            str(tp): per_tp[str(tp)]["kv_device_high_water_bytes"]
+            for tp in tps},
+        "collective_bytes": {
+            str(tp): per_tp[str(tp)]["collective_bytes"] for tp in tps},
+        "new_buckets_after_warmup": {
+            str(tp): per_tp[str(tp)]["runs"]["chunked"]
+            ["new_buckets_after_warmup"] for tp in tps},
+    }
+    print(f"tp leg: token-exact {exact}, per-device KV high-water "
+          f"{out['kv_device_high_water_bytes']} (1/tp scaling), "
+          f"eff tokens/step {out['effective_tokens_per_step']}")
+    return out
+
+
+TP_KEYS = ("shape", "tps", "workload", "token_exact", "steps", "spec",
+           "prefix", "effective_tokens_per_step", "kv_high_water_blocks",
+           "kv_device_high_water_bytes", "collective_bytes",
+           "new_buckets_after_warmup")
+
+
+def check_tp(base):
+    """CI gate for tensor-parallel serving: every mode token-exact vs
+    single-chip at TP=2/4/8, per-device KV high-water bytes exactly
+    1/tp of the single-chip figure, deterministic collective payload,
+    and zero new compile buckets after warmup on every mesh shape —
+    all against the committed baseline."""
+    cur = tp_leg()
+    bad = [k for k in TP_KEYS if cur[k] != base[k]]
+    for k in bad:
+        print(f"MISMATCH {k}: current {cur[k]!r} != baseline {base[k]!r}")
+    if not all(cur["token_exact"].values()):
+        print("REGRESSION: tensor-parallel serving is not token-exact "
+              f"vs single-chip: {cur['token_exact']}")
+        bad.append("token_exact")
+    hw = cur["kv_device_high_water_bytes"]
+    for tp, v in hw.items():
+        if int(tp) > 1 and v * int(tp) != hw["1"]:
+            print(f"REGRESSION: per-device KV high-water at tp={tp} is "
+                  f"{v}, not 1/{tp} of single-chip {hw['1']}")
+            bad.append("kv_device_high_water_bytes")
+    if any(cur["new_buckets_after_warmup"].values()):
+        print("REGRESSION: a mesh shape compiled fresh buckets after "
+              f"warmup: {cur['new_buckets_after_warmup']}")
+        bad.append("new_buckets_after_warmup")
+    if bad:
+        return 1
+    print(f"tp leg OK: TP={cur['tps']} token-exact, per-device KV "
+          f"high-water {hw} (1/tp), collective "
+          f"{cur['collective_bytes']} B, 0 new buckets")
+    return 0
+
+
 PREFIX_KEYS = ("n_requests", "prefix_len", "suffix_len", "chunk",
                "block_size", "new_tokens", "token_exact_all_modes",
                "new_buckets_after_warmup", "cache", "unshared",
@@ -940,6 +1188,13 @@ def main():
                          "shared portion must drop to 1/N and KV-pool "
                          "high-water accordingly, token-exact in every "
                          "mode (works on CPU via interpret mode)")
+    ap.add_argument("--tp", action="store_true",
+                    help="tensor-parallel serving on the virtual "
+                         "8-device mesh: token-exactness vs single-chip "
+                         "at TP=1/2/4/8 across plain/chunked/spec/"
+                         "prefix, per-device KV high-water = 1/tp, "
+                         "collective payload accounting, 0 new buckets "
+                         "after warmup (works on CPU)")
     ap.add_argument("--chunk", type=int, default=64,
                     help="prefill chunk size for the --prefill leg")
     ap.add_argument("--no-flight-recorder", action="store_true",
@@ -948,13 +1203,32 @@ def main():
                          "bounded retention; legs that manage their own "
                          "arming still override it)")
     args = ap.parse_args()
+    base = None
+    if args.check:
+        with open(args.check) as f:
+            base = json.load(f)
+    if args.tp or (base is not None and "tp" in base):
+        # the tp leg needs the 8-device virtual mesh, and XLA reads
+        # this flag at BACKEND INIT — set it before anything touches
+        # jax.devices() (the dryrun_multichip pattern; a real TPU pod
+        # with >= 8 chips skips the fake)
+        flag = "--xla_force_host_platform_device_count=8"
+        if "xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
     if not args.no_flight_recorder:
         from paddle_tpu.observability import tracing
         tracing.arm_default()
     import jax
+    if args.tp or (base is not None and "tp" in base):
+        if jax.devices()[0].platform != "tpu" \
+                or len(jax.devices()) < 8:
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:  # already initialized on cpu: fine
+                pass
     if args.check:
-        with open(args.check) as f:
-            base = json.load(f)
         rc = 0
         ran = False
         if "ragged" in base:
@@ -969,13 +1243,16 @@ def main():
         if "prefix" in base:
             ran = True
             rc |= check_prefix(base["prefix"])
+        if "tp" in base:
+            ran = True
+            rc |= check_tp(base["tp"])
         if not ran:
-            print(f"{args.check}: no 'ragged'/'spec'/'trace'/'prefix' "
-                  "section to gate")
+            print(f"{args.check}: no 'ragged'/'spec'/'trace'/'prefix'/"
+                  "'tp' section to gate")
             return 1
         return rc
     if args.ragged or args.metrics or args.prefill or args.spec \
-            or args.no_spec or args.trace or args.prefix:
+            or args.no_spec or args.trace or args.prefix or args.tp:
         out = {}
         if args.ragged:
             out["ragged"] = ragged_leg()
@@ -1009,6 +1286,9 @@ def main():
         if args.prefix:
             # after --metrics too: it drives the serving engine
             out["prefix"] = prefix_leg()
+        if args.tp:
+            # last for the same registry-isolation reason
+            out["tp"] = tp_leg()
         if args.json:
             with open(args.json, "w") as f:
                 json.dump(out, f, indent=1)

@@ -207,6 +207,20 @@ def _fam_last(fams, name):
     return None
 
 
+def _fam_per_label(fams, name, label):
+    """{label value: sample value} for a labeled gauge family — the
+    per-device view the mesh dashboard renders (empty when the scraped
+    engine never exported the family, i.e. single-chip)."""
+    fam = fams.get(name)
+    if not fam:
+        return {}
+    out = {}
+    for n, labels, v in fam["samples"]:
+        if n == name and label in (labels or {}):
+            out[labels[label]] = v
+    return out
+
+
 def scrape_leg(url, interval_s=2.0, count=0, out=sys.stdout):
     """Poll a live gateway's /metrics + /healthz and render the
     dashboard cross-process. `count` 0 = forever. Returns 0 once the
@@ -257,10 +271,23 @@ def scrape_leg(url, interval_s=2.0, count=0, out=sys.stdout):
             return "-" if v is None else f"{v:g}"
 
         breaches = _fam_sum(fams, "slo_breaches_total")
+        # mesh-aware view: a TP engine exports per-device KV/HBM
+        # gauges — render every device's shard, not a silent device-0
+        # aggregate (single-chip gateways simply lack the family)
+        dev_kv = _fam_per_label(fams, "kv_device_bytes_used", "device")
+        tp_w = _fam_last(fams, "serve_tp_degree")
+        mesh = ""
+        if dev_kv:
+            cells = " ".join(
+                f"{d}:{int(v) // 1024}K"
+                for d, v in sorted(dev_kv.items(),
+                                   key=lambda kv: int(kv[0])))
+            mesh = (f" | tp {int(tp_w) if tp_w else len(dev_kv)}"
+                    f" kv/dev [{cells}]")
         print(f"[scrape {polls:3d}] health {health}"
               f" | inflight {g('serve_inflight_requests')}"
               f" queue {g('serve_queue_depth')}"
-              f" | kv free {g('kv_blocks_free')}"
+              f" | kv free {g('kv_blocks_free')}{mesh}"
               f" | conns {g('gateway_live_connections')}"
               f" streams {g('gateway_live_streams')}"
               f" sse-pending {g('gateway_sse_pending_events')}"
