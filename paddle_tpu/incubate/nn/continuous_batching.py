@@ -548,7 +548,7 @@ class ContinuousBatchingEngine:
                  token_budget=None, spec_k=0, spec_ngram=2,
                  tpot_slo=None, min_prefill_chunk=64, prefix_cache=False,
                  monitor=None, memory_watch=None, shed_on_pressure=False,
-                 shed_priority_min=1):
+                 shed_priority_min=1, autotune_cache=None):
         import jax
 
         self.engine = engine
@@ -678,6 +678,28 @@ class ContinuousBatchingEngine:
         kvh = self.caches[0].shape[1]
         num_q = engine.num_heads
         self._pack = default_pack(self.max_batch, num_q // kvh)
+        # committed autotune winners (ops/pallas/autotune.py): passing a
+        # cache (path or dict) opts the scheduler into the swept
+        # (pack, prefill_chunk) for this EXACT shape class — resolved
+        # once here, zero per-step host cost. The tuned chunk comes out
+        # of the sweep's pow2 candidate family, so the warmup treadmill
+        # covers the same (t_total, c) compile buckets it always did; a
+        # missing/stale/foreign cache degrades to the defaults above,
+        # never raises (the committed serving baselines run untuned).
+        if autotune_cache is not None:
+            from ...ops.pallas import autotune as _autotune
+            cache_d = _autotune.load_serve_cache(autotune_cache)
+            cfg = None
+            if cache_d is not None:
+                cfg = _autotune.serve_winner(
+                    cache_d, _autotune.serve_shape_class(
+                        kvh, num_q // kvh, self.block_size,
+                        engine.head_dim,
+                        getattr(engine, "_dtype", "float32")))
+            if cfg is not None:
+                self._pack = max(1, min(int(cfg["pack"]),
+                                        self.max_batch))
+                self.prefill_chunk = max(1, int(cfg["prefill_chunk"]))
 
     # -- scheduling ---------------------------------------------------------
 

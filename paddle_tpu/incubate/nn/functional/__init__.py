@@ -437,7 +437,8 @@ def fused_multi_transformer(
         trans_qkvw=True, ring_id=-1, norm_type="layernorm",
         use_neox_rotary_style=False, gqa_group_size=-1, name=None,
         block_tables=None, ragged_work=None, ragged_pack=None,
-        chunk_lens=None, _dequant=None, _mm=None, _tp_reduce=None):
+        chunk_lens=None, kv_buffer_depth=2, _dequant=None, _mm=None,
+        _tp_reduce=None):
     """Whole-decoder-stack fused transformer (reference
     fused_multi_transformer op: python/paddle/incubate/nn/functional/
     fused_transformer.py:1053 over
@@ -675,7 +676,8 @@ def fused_multi_transformer(
                     ctx = ragged_paged_attention(
                         q[:, 0], kc, vc, tables_a, ln + 1, scale=scale,
                         work=(tuple(rwork), None, rwork[0].shape[0],
-                              ragged_pack))
+                              ragged_pack),
+                        buffer_depth=kv_buffer_depth)
                     ctx = ctx[:, None].astype(xa.dtype)   # [B, 1, H, D]
                 else:
                     ql = jnp.asarray(qlens).reshape(-1)
@@ -684,8 +686,9 @@ def fused_multi_transformer(
                     ctx = ragged_paged_attention(
                         q, kc, vc, tables_a, ln + ql, scale=scale,
                         work=(tuple(rwork), None, rwork[0].shape[0],
-                              ragged_pack),
-                        q_lens=ql).astype(xa.dtype)       # [B, C, H, D]
+                              ragged_pack), q_lens=ql,
+                        buffer_depth=kv_buffer_depth
+                        ).astype(xa.dtype)                # [B, C, H, D]
                 new_caches.append(jnp.stack([kc, vc]))
             elif tstep is not None and caches:
                 # decode: append the new token, attend over the valid cache

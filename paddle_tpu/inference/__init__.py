@@ -452,7 +452,8 @@ class FusedMultiTransformerEngine:
     def __init__(self, weights, num_heads, head_dim, max_seq_len=2048,
                  norm_type="layernorm", activation="gelu",
                  use_neox_rotary_style=False, dtype="bfloat16",
-                 gqa_group_size=-1, weight_quant=None, tp=1):
+                 gqa_group_size=-1, weight_quant=None, tp=1,
+                 kv_buffer_depth=None, autotune_cache=None):
         import jax
         import jax.numpy as jnp
         from ..incubate.nn.functional import fused_multi_transformer
@@ -493,16 +494,10 @@ class FusedMultiTransformerEngine:
         self._w_specs = None
         paged_kw = kw
         if self.tp > 1:
-            if weight_quant:
-                raise ValueError(
-                    "weight_quant with tp > 1 is not supported yet: the "
-                    "packed int4/int8 layouts need their own per-device "
-                    "repacking (serve quantized single-chip, or dense "
-                    "tensor-parallel)")
             import numpy as _np
             from jax.sharding import Mesh
             from ..ops.pallas.paged_attention import kv_head_shard
-            from .tp_layout import shard_serving_weights, validate_tp
+            from .tp_layout import validate_tp
             kvh_n = self._gqa or num_heads
             ffn_dim = int(self._w["ffn2_weights"][0].shape[0])
             validate_tp(num_heads, kvh_n, ffn_dim, self.tp)
@@ -513,17 +508,29 @@ class FusedMultiTransformerEngine:
                     f"tp={self.tp} needs {self.tp} devices, "
                     f"have {len(devs)}")
             self._mesh = Mesh(_np.array(devs[:self.tp]), ("tp",))
-            self._w, self._w_specs = shard_serving_weights(
-                self._w, self._mesh, num_heads, kvh_n,
-                activation.endswith("glu"), self.tp)
             paged_kw = dict(kw)
             if self._gqa:
                 paged_kw["gqa_group_size"] = self._gqa // self.tp
             paged_kw["_tp_reduce"] = lambda x: jax.lax.psum(x, "tp")
+            if weight_quant == "int4":
+                # the row-parallel specs split the PACKED nibble axis
+                # (lin [K/2, E] / ffn2 [F/2, E]): each device's
+                # contiguous row span must cover whole (2i, 2i+1)
+                # nibble pairs or its unpack reconstructs rows that
+                # straddle the device boundary
+                for what, n in (("num_heads*head_dim",
+                                 num_heads * head_dim),
+                                ("dim_feedforward", ffn_dim)):
+                    if (n // self.tp) % 2 != 0:
+                        raise ValueError(
+                            f"int4 weight_quant with tp={self.tp} needs "
+                            f"{what}/tp ({n}//{self.tp}) even — packed "
+                            "int4 rows split in (2i, 2i+1) pairs")
         # weight-only quantized serving: pack the matmul weights at load
         # (int4 = half the int8 tier's weight HBM) and dequantize inside
         # the op, fused into the operand load
         self.weight_quant = weight_quant
+        tp_dequant = None
         if weight_quant in ("int4", "int8"):
             # int4 on TPU: the Pallas weight-only GEMM FIRST
             # (ops/pallas/quant_matmul.py — streams the packed bytes,
@@ -533,9 +540,11 @@ class FusedMultiTransformerEngine:
             # program ARGUMENTS (closure capture would inline ~350 MB of
             # constants into the compile payload). int8 stays on the XLA
             # dequant path (measured equal-or-better: XLA fuses the
-            # int8->bf16 convert into the operand load).
+            # int8->bf16 convert into the operand load). The Pallas GEMM
+            # is single-chip only: under tp the XLA dequant path runs
+            # per-device on the weight shards instead.
             mm = None
-            if weight_quant == "int4" \
+            if weight_quant == "int4" and self.tp == 1 \
                     and jax.devices()[0].platform == "tpu":
                 try:
                     mm = self._build_quant_mm(weights, dtype)
@@ -568,6 +577,12 @@ class FusedMultiTransformerEngine:
                     qscales[kind] = scs
                     return packed
 
+                # quantization happens GLOBALLY (pre-shard, from the
+                # full weights) in every case — under tp the per-device
+                # shards are then exact row/column slices of the SAME
+                # packed values + scales the dense engine serves, which
+                # is what makes quantized tensor-parallel serving
+                # token-exact vs the dense weight_quant generate()
                 self._w["qkv_weights"] = _quant(
                     "qkv", self._w["qkv_weights"], -1)
                 self._w["linear_weights"] = _quant(
@@ -577,17 +592,66 @@ class FusedMultiTransformerEngine:
                 self._w["ffn2_weights"] = _quant(
                     "f2", self._w["ffn2_weights"], 0)
                 cdt = dtype
+                if self.tp == 1:
+                    def dq(w, kind, li):
+                        sc = qscales[kind][li]
+                        if weight_quant == "int4":
+                            full = _unpack_int4(
+                                w, axis=-1 if kind == "qkv" else 0)
+                        else:
+                            full = w
+                        return (full.astype(jnp.float32) * sc).astype(cdt)
 
-                def dq(w, kind, li):
-                    sc = qscales[kind][li]
-                    if weight_quant == "int4":
-                        full = _unpack_int4(
-                            w, axis=-1 if kind == "qkv" else 0)
-                    else:
-                        full = w
-                    return (full.astype(jnp.float32) * sc).astype(cdt)
+                    kw["_dequant"] = dq
+                else:
+                    # tensor-parallel: the scales become WEIGHTS —
+                    # tp_layout shards each alongside its packed
+                    # projection (qkv/ffn1 scales follow their repack +
+                    # split; lin/ffn2 scales are per-OUTPUT-channel so
+                    # they replicate) — and dequantization runs
+                    # per-device at the top of the shard_map'd step
+                    # body, reconstructing exactly this device's shard
+                    # of the dense engine's dequantized weights
+                    self._w["qkv_wscales"] = qscales["qkv"]
+                    self._w["linear_wscales"] = qscales["lin"]
+                    self._w["ffn1_wscales"] = qscales["f1"]
+                    self._w["ffn2_wscales"] = qscales["f2"]
+                    is4 = weight_quant == "int4"
 
-                kw["_dequant"] = dq
+                    def tp_dequant(w):
+                        w = dict(w)
+                        for key, skey, axis in (
+                                ("qkv_weights", "qkv_wscales", -1),
+                                ("linear_weights", "linear_wscales", 0),
+                                ("ffn1_weights", "ffn1_wscales", 0),
+                                ("ffn2_weights", "ffn2_wscales", 0)):
+                            scs = w.pop(skey)
+                            w[key] = [
+                                ((_unpack_int4(p, axis=axis) if is4
+                                  else p).astype(jnp.float32)
+                                 * sc).astype(cdt)
+                                for p, sc in zip(w[key], scs)]
+                        return w
+        if self.tp > 1:
+            from .tp_layout import shard_serving_weights
+            self._w, self._w_specs = shard_serving_weights(
+                self._w, self._mesh, num_heads, kvh_n,
+                activation.endswith("glu"), self.tp)
+        # KV DMA pipeline depth for the ragged kernel: an explicit arg
+        # wins, else the committed autotune cache's winner for this
+        # engine's shape class, else the classic double buffer. Resolved
+        # ONCE here (closure into the paged step) — zero per-step cost.
+        from ..ops.pallas import autotune as _autotune
+        self._autotune_cache = None if autotune_cache is None \
+            else _autotune.load_serve_cache(autotune_cache)
+        if kv_buffer_depth is None:
+            kvh_l = self._gqa or num_heads
+            cfg = _autotune.serve_winner_for_engine(
+                self._autotune_cache, kvh_l, num_heads // kvh_l,
+                head_dim, dtype) if self._autotune_cache else None
+            kv_buffer_depth = cfg["buffer_depth"] if cfg else 2
+        self.kv_buffer_depth = int(kv_buffer_depth)
+        paged_kw["kv_buffer_depth"] = self.kv_buffer_depth
 
         def lists(w):
             def g(name):
@@ -688,6 +752,11 @@ class FusedMultiTransformerEngine:
             chunk still pays for one lm_head position per slot.
             Padding columns of sel repeat a valid index; their samples
             are computed and ignored."""
+            if tp_dequant is not None:
+                # quantized tensor-parallel serving: reconstruct this
+                # device's dense weight shards from the packed bytes +
+                # scales (runs inside the shard_map body, on shards)
+                w = tp_dequant(w)
             h = w["embedding"][toks]             # [B, C, E]
             from ..core.tensor import Tensor
             cts = [Tensor(c) for c in caches]

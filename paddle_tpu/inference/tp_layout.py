@@ -187,18 +187,28 @@ def serving_weight_specs(weights, layout=None):
     for k, v in weights.items():
         if k == "qkv_weights":
             specs[k] = per_layer(layout.qkv(gqa_packed), len(v))
+        elif k == "qkv_wscales":
+            # weight-quant scales [ht, hd, 1]: per-ROW of the packed
+            # qkv layout, so they repack + split with their projection
+            specs[k] = per_layer(layout.qkv(gqa_packed), len(v))
         elif k == "qkv_biases":
             specs[k] = per_layer(layout.qkv_bias(gqa_packed), len(v))
         elif k == "linear_weights":
             specs[k] = per_layer(layout.out_proj(), len(v))
         elif k == "ffn1_weights":
             specs[k] = per_layer(layout.ffn1(), len(v))
+        elif k == "ffn1_wscales":
+            # [1, 2F] per-COLUMN scales: column-parallel like ffn1
+            # (and glu-repacked with it)
+            specs[k] = per_layer(layout.ffn1(), len(v))
         elif k == "ffn1_biases":
             specs[k] = per_layer(layout.ffn1_bias(), len(v))
         elif k == "ffn2_weights":
             specs[k] = per_layer(layout.ffn2(), len(v))
         elif isinstance(v, (list, tuple)):
-            # norm scales/biases, linear/ffn2 biases (post-psum adds)
+            # norm scales/biases, linear/ffn2 biases (post-psum adds),
+            # and the linear/ffn2 weight-quant scales ([1, E]: per-
+            # OUTPUT-channel of a row-parallel matmul — replicated)
             specs[k] = per_layer(layout.replicated(), len(v))
         else:
             specs[k] = layout.replicated()   # embedding / lm_head / rope
@@ -221,10 +231,12 @@ def shard_serving_weights(weights, mesh, num_q, num_kv, glu, tp,
     gqa_packed = len(sample.shape) == 3
     repacked = {}
     for k, v in weights.items():
-        if k in ("qkv_weights", "qkv_biases") and gqa_packed and tp > 1:
+        if k in ("qkv_weights", "qkv_biases", "qkv_wscales") \
+                and gqa_packed and tp > 1:
             repacked[k] = [repack_gqa_qkv(np.asarray(w), num_q, num_kv,
                                           tp) for w in v]
-        elif k in ("ffn1_weights", "ffn1_biases") and glu and tp > 1:
+        elif k in ("ffn1_weights", "ffn1_biases", "ffn1_wscales") \
+                and glu and tp > 1:
             repacked[k] = [repack_glu_ffn1(np.asarray(w), tp) for w in v]
         else:
             repacked[k] = v

@@ -33,6 +33,8 @@ __all__ = [
     "gateway_sse_events", "gateway_health_transitions",
     "train_step_seconds", "train_tokens_total", "train_steps_total",
     "train_tokens_per_s", "train_host_seconds",
+    "autotune_trials", "autotune_cache_hits", "autotune_cache_misses",
+    "autotune_winner",
 ]
 
 
@@ -388,6 +390,41 @@ def train_host_seconds():
         "train_host_seconds",
         help="host wall between dispatches not spent waiting on data "
              "(optimizer bookkeeping, logging, sharding the batch)")
+
+
+# -- kernel autotuning (ops/pallas/autotune.py) --------------------------
+
+def autotune_trials():
+    # the kernel label is the family prefix of the tune key (flash_bshd,
+    # ragged_paged_attention, ...), never the shape-bearing key itself —
+    # a handful of Pallas kernels exist, so the child set stays bounded
+    return get_registry().counter(
+        "autotune_trials_total",
+        help="candidate kernel configs timed (device) or scored "
+             "(analytic model) by the autotuner",
+        labels=("kernel",))
+
+
+def autotune_cache_hits():
+    return get_registry().counter(
+        "autotune_cache_hits_total",
+        help="autotune winner-cache lookups that found an entry "
+             "(engine-construction time only: the zero-per-step-cost "
+             "contract)")
+
+
+def autotune_cache_misses():
+    return get_registry().counter(
+        "autotune_cache_misses_total",
+        help="autotune winner-cache lookups that fell back to defaults")
+
+
+def autotune_winner():
+    return get_registry().gauge(
+        "autotune_winner_config",
+        help="last swept winner's tunable values, one child per "
+             "(kernel, param): pack / prefill_chunk / buffer_depth",
+        labels=("kernel", "param"))
 
 
 # -- op dispatch ----------------------------------------------------------

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .tuning import cparams as _cparams
+from .autotune import cparams as _cparams
 
 LANES = 128
 STRIP = 8          # f32 sublane tile: [STRIP, T] layout for lse/loss strips

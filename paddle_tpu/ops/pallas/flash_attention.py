@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .tuning import cparams as _cparams
+from .autotune import cparams as _cparams
 
 DEFAULT_BLOCK_Q = 2048  # round-5 on v5e (bf16 dot operands): fwd device
 DEFAULT_BLOCK_K = 2048  # time 1.63 ms vs 2.2 ms at (1024, 2048); bwd tiles

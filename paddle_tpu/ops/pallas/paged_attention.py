@@ -299,7 +299,7 @@ def build_ragged_work(block_tables, context_lens, block_size, pack,
 def _ragged_kernel(ws, wg, wr, wblk, wpos, wfirst, wlast, wqs, wql,
                    q_ref, k_hbm, v_hbm, o_ref,
                    kbuf, vbuf, ksem, vsem, m_scr, l_scr, acc,
-                   *, block_size, scale, group_q, chunk):
+                   *, block_size, scale, group_q, chunk, depth=2):
     hh = pl.program_id(0)
     t = pl.program_id(1)
     nt = pl.num_programs(1)
@@ -318,18 +318,23 @@ def _ragged_kernel(ws, wg, wr, wblk, wpos, wfirst, wlast, wqs, wql,
         return pltpu.make_async_copy(
             v_hbm.at[hh, blk], vbuf.at[slot], vsem.at[slot])
 
-    # double buffering: warm slot 0 at t == 0, then start t+1's copy
-    # before waiting on t's — the next KV block is in flight over HBM
-    # while this one multiplies
+    # multi-buffering, `depth` slots (depth=2 is classic double
+    # buffering): t == 0 warms entries 0..depth-2, then every step
+    # starts entry t+depth-1's copy before waiting on t's — up to
+    # depth-1 KV blocks are in flight over HBM while this one
+    # multiplies. depth=1 degenerates to a serial start-then-wait
+    # pipeline (the autotuner's lower bound). The grid length is
+    # static, so the warmup loop unrolls at trace time.
     @pl.when(t == 0)
     def _warmup():
-        kdma(0, 0).start()
-        vdma(0, 0).start()
+        for i in range(min(depth - 1, nt)):
+            kdma(i % depth, i).start()
+            vdma(i % depth, i).start()
 
-    @pl.when(t + 1 < nt)
+    @pl.when(t + depth - 1 < nt)
     def _prefetch_next():
-        kdma((t + 1) % 2, t + 1).start()
-        vdma((t + 1) % 2, t + 1).start()
+        kdma((t + depth - 1) % depth, t + depth - 1).start()
+        vdma((t + depth - 1) % depth, t + depth - 1).start()
 
     @pl.when(wfirst[t] == 1)
     def _init():
@@ -337,13 +342,13 @@ def _ragged_kernel(ws, wg, wr, wblk, wpos, wfirst, wlast, wqs, wql,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc[...] = jnp.zeros_like(acc)
 
-    kdma(t % 2, t).wait()
-    vdma(t % 2, t).wait()
+    kdma(t % depth, t).wait()
+    vdma(t % depth, t).wait()
 
     span = chunk * group_q                            # rows per sequence
     q = q_ref[0, 0].astype(jnp.float32)              # [pack*chunk*G, D]
-    k = kbuf[t % 2].astype(jnp.float32)              # [BS, D]
-    v = vbuf[t % 2].astype(jnp.float32)              # [BS, D]
+    k = kbuf[t % depth].astype(jnp.float32)          # [BS, D]
+    v = vbuf[t % depth].astype(jnp.float32)          # [BS, D]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale   # [pack*chunk*G, BS]
@@ -414,7 +419,8 @@ def default_pack(batch, group_q):
 
 
 def ragged_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
-                           scale=None, pack=None, work=None, q_lens=None):
+                           scale=None, pack=None, work=None, q_lens=None,
+                           buffer_depth=2):
     """Mixed decode/prefill attention over a paged KV cache, ragged grid.
 
     q:            [B, H, D] — one query token per sequence (decode), or
@@ -441,8 +447,17 @@ def ragged_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
                   a pack carried by `work` wins; passing a CONFLICTING
                   explicit pack raises. The list's q spans must fit the
                   slab (q_len <= C) — under jit this cannot be checked.
+    buffer_depth: KV DMA pipeline slots (static; autotunable). 2 is the
+                  classic double buffer; 1 serializes copy/compute;
+                  deeper keeps more blocks in flight at depth x
+                  2 x block_size x D x itemsize VMEM. Pure scheduling —
+                  results are bit-identical across depths.
     returns       [B, H, D] or [B, C, H, D], matching q
     """
+    buffer_depth = int(buffer_depth)
+    if not 1 <= buffer_depth <= 8:
+        raise ValueError(
+            f"buffer_depth must be in [1, 8], got {buffer_depth}")
     squeeze = q.ndim == 3
     if squeeze:
         q = q[:, None]
@@ -491,10 +506,10 @@ def ragged_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
         out_specs=pl.BlockSpec(
             (1, 1, pg, d), lambda hh, t, ws, wg, *_: (wg[t], hh, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, block_size, d), k_cache.dtype),
-            pltpu.VMEM((2, block_size, d), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((buffer_depth, block_size, d), k_cache.dtype),
+            pltpu.VMEM((buffer_depth, block_size, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((buffer_depth,)),
+            pltpu.SemaphoreType.DMA((buffer_depth,)),
             pltpu.VMEM((pg, LANES), jnp.float32),
             pltpu.VMEM((pg, LANES), jnp.float32),
             pltpu.VMEM((pg, d), jnp.float32),
@@ -502,7 +517,8 @@ def ragged_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
     )
     out = pl.pallas_call(
         functools.partial(_ragged_kernel, block_size=block_size,
-                          scale=float(scale), group_q=g, chunk=c),
+                          scale=float(scale), group_q=g, chunk=c,
+                          depth=buffer_depth),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((ngroups, kvh, pg, d), q.dtype),
         interpret=_interpret_mode(),

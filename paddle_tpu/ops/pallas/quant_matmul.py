@@ -37,7 +37,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .tuning import cparams as _cparams
+from .autotune import cparams as _cparams
 
 DEFAULT_BLOCK_N = 512
 

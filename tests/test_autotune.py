@@ -1,0 +1,422 @@
+"""Serving-kernel autotune + quantized paged serving (interpret mode).
+
+Two contracts under test:
+
+* the serve-autotune cache (ops/pallas/autotune.py): shape-class /
+  bucket keys are stable strings keyed like the scheduler's compile
+  buckets; an interpret-mode sweep is bit-deterministic (model-ranked,
+  never wall-clocked) and round-trips through the committed JSON
+  byte-stably; a stale/foreign/corrupt cache degrades engines to
+  untuned defaults instead of crashing; and engines pick committed
+  winners up at CONSTRUCTION — no re-sweep, zero per-step host cost.
+
+* int4/int8 weight-only serving under continuous batching: the paged
+  path must be TOKEN-EXACT vs the dense ``weight_quant`` engine's
+  ``generate()`` in every scheduler mode (plain / chunked / budgeted /
+  spec / prefix) at tp=1 AND tp=2 (global quantize-then-shard makes
+  the per-device shards exact slices of the dense engine's packed
+  values), including the spec-decode rewind and the prefix-cache
+  copy-on-write on quantized caches, with zero new compile buckets
+  after warmup.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import autotune as at
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    old = fa._INTERPRET
+    fa._INTERPRET = True
+    yield
+    fa._INTERPRET = old
+
+
+_uid = [0]
+
+
+def _tag(prefix):
+    _uid[0] += 1
+    return f"{prefix}{_uid[0]}"
+
+
+def _mk_weights(seed, V, E, H, G, D, L, F):
+    rng = np.random.default_rng(seed)
+
+    def mk(*shape, scale=0.05):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    return dict(
+        ln_scales=[np.ones(E, np.float32) for _ in range(L)],
+        qkv_weights=[mk(H + 2 * G, D, E) for _ in range(L)],
+        linear_weights=[mk(H * D, E) for _ in range(L)],
+        ffn_ln_scales=[np.ones(E, np.float32) for _ in range(L)],
+        ffn1_weights=[mk(E, 2 * F) for _ in range(L)],
+        ffn2_weights=[mk(F, E) for _ in range(L)],
+        embedding=mk(V, E), lm_head=mk(E, V))
+
+
+def _run(cb, reqs):
+    for r in reqs:
+        cb.submit(r)
+    out = cb.run()
+    return [[int(t) for t in out[r.request_id]] for r in reqs]
+
+
+# -- sweep fixtures: one decode + one prefill bucket of the tiny
+#    kvh2/g2/d16/bs8 shape class, ranked by the analytic model ----------
+
+LENS = [8, 14, 6, 10]
+
+
+def _sweep(cache=None):
+    cache = at.sweep_ragged_serve(2, 2, 16, 8, LENS, chunk=None,
+                                  measure=False, cache=cache)
+    return at.sweep_ragged_serve(2, 2, 16, 8, LENS, chunk=8,
+                                 measure=False, cache=cache)
+
+
+class TestCacheKeys:
+    def test_shape_class_is_stable(self):
+        assert at.serve_shape_class(2, 2, 8, 16, "float32") == \
+            "kvh2_g2_bs8_d16_float32"
+        # bfloat16 spells stably even when np.dtype can't resolve it
+        assert at.serve_shape_class(8, 1, 16, 128, "bfloat16") == \
+            "kvh8_g1_bs16_d128_bfloat16"
+
+    def test_bucket_key_matches_scheduler_treadmill(self):
+        # the EXACT (t_total, chunk) pair _seen_buckets tracks
+        assert at.serve_bucket_key(8, 1) == "t8_c1"
+        assert at.serve_bucket_key(16, 8) == "t16_c8"
+
+    def test_candidates_stay_in_the_pow2_family(self):
+        cands = at.ragged_candidates(4, 2, chunk=8)
+        chunks = {c["prefill_chunk"] for c in cands}
+        assert chunks == {1, 2, 4, 8}       # never mints a new bucket
+        assert {c["pack"] for c in cands} == {1, 2, 4}
+        decode = at.ragged_candidates(4, 2, chunk=None)
+        assert {c["prefill_chunk"] for c in decode} == {1}
+
+
+class TestSweep:
+    def test_interpret_sweep_is_deterministic(self):
+        # model-ranked (never wall-clocked): sweep twice, same cache
+        assert _sweep() == _sweep()
+
+    def test_persistence_roundtrip(self, tmp_path):
+        cache = _sweep()
+        p = tmp_path / "serve.json"
+        at.save_serve_cache(cache, str(p))
+        loaded = at.load_serve_cache(str(p))
+        assert loaded == cache
+        assert loaded["schema"] == at.SERVE_SCHEMA
+        sec = loaded["shapes"]["kvh2_g2_bs8_d16_float32"]
+        assert set(sec["buckets"]) == {"t16_c1", "t16_c8"} or \
+            all(b.startswith("t") for b in sec["buckets"])
+        for b in sec["buckets"].values():
+            assert b["trials"] > 0 and not b["suspect"]
+
+    def test_save_is_byte_stable(self, tmp_path):
+        # the file is COMMITTED and gated: re-runs must not churn it
+        cache = _sweep()
+        p1, p2 = tmp_path / "a.json", tmp_path / "b.json"
+        at.save_serve_cache(cache, str(p1))
+        at.save_serve_cache(_sweep(), str(p2))
+        assert p1.read_bytes() == p2.read_bytes()
+
+    def test_decode_bucket_never_votes_prefill_chunk_down(self):
+        # the decode bucket's pinned chunk=1 must not talk the
+        # scheduler into one-token-at-a-time prefill
+        cache = _sweep()
+        win = cache["shapes"]["kvh2_g2_bs8_d16_float32"]["winner"]
+        assert win["prefill_chunk"] > 1
+
+    def test_committed_cache_file_loads(self):
+        import pathlib
+        p = pathlib.Path(__file__).resolve().parents[1] \
+            / "tools" / "serve_autotune.json"
+        cache = at.load_serve_cache(str(p))
+        # the gate baseline doubles as the engine-loadable cache (the
+        # extra "gate" key must not fail schema validation)
+        assert cache is not None
+        assert cache["schema"] == at.SERVE_SCHEMA
+        assert cache["shapes"]
+
+
+class TestStaleCacheDegrades:
+    def test_foreign_or_broken_caches_reject_as_none(self, tmp_path):
+        good = _sweep()
+        assert at.load_serve_cache(good) is good      # dict passthrough
+        stale = dict(good, schema="paddle_tpu.serve_autotune/0")
+        assert at.load_serve_cache(stale) is None
+        assert at.load_serve_cache({"schema": at.SERVE_SCHEMA}) is None
+        assert at.load_serve_cache(
+            {"schema": at.SERVE_SCHEMA, "shapes": "nope"}) is None
+        bad_winner = {
+            "schema": at.SERVE_SCHEMA,
+            "shapes": {"kvh2_g2_bs8_d16_float32": {
+                "winner": {"pack": 0, "prefill_chunk": 8,
+                           "buffer_depth": 2},
+                "buckets": {}}}}
+        assert at.load_serve_cache(bad_winner) is None
+        assert at.load_serve_cache(str(tmp_path / "missing.json")) is None
+        garbled = tmp_path / "garbled.json"
+        garbled.write_text("{not json")
+        assert at.load_serve_cache(str(garbled)) is None
+
+    def test_engine_degrades_to_defaults_not_crash(self, tmp_path):
+        stale = tmp_path / "stale.json"
+        stale.write_text('{"schema": "somebody_else/9", "shapes": {}}')
+        eng = _pickup_engine(autotune_cache=str(stale))
+        assert eng.kv_buffer_depth == 2               # untuned default
+        from paddle_tpu.incubate.nn import ContinuousBatchingEngine
+        cb = ContinuousBatchingEngine(
+            eng, num_blocks=24, block_size=8, max_batch=4,
+            prefill_chunk=4, autotune_cache=str(stale))
+        assert cb.prefill_chunk == 4                  # caller's value
+
+
+class TestGenericHarness:
+    def test_times_then_caches_then_persists(self, tmp_path, monkeypatch):
+        import jax.numpy as jnp
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "at.json"))
+        monkeypatch.setattr(at, "_mem", None)
+        calls = []
+
+        def run(cand):
+            calls.append(cand)
+            return jnp.ones(4) * cand[0]
+
+        key = "unit_gemm:m8"
+        cands = [(1, "a"), (2, "b")]
+        win = at.autotune(key, cands, run, reps=1)
+        assert win in cands
+        n = len(calls)
+        assert n >= 2 * len(cands)          # warmup + timed rep each
+        # in-memory hit: no new kernel launches
+        assert at.autotune(key, cands, run, reps=1) == win
+        assert len(calls) == n
+        # persistence: drop the in-memory cache, reload from disk
+        monkeypatch.setattr(at, "_mem", None)
+        assert at.autotune(key, cands, run, reps=1) == win
+        assert len(calls) == n
+
+    def test_failing_candidates_are_skipped(self, tmp_path, monkeypatch):
+        import jax.numpy as jnp
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "at.json"))
+        monkeypatch.setattr(at, "_mem", None)
+
+        def run(cand):
+            if cand == "bad":
+                raise ValueError("block shape rejected")
+            return jnp.zeros(2)
+
+        assert at.autotune("unit_skip:x", ["bad", "ok"], run, reps=1) \
+            == "ok"
+        with pytest.raises(RuntimeError, match="every candidate"):
+            at.autotune("unit_all_bad:x", ["bad"], run, reps=1)
+
+
+# -- engine pickup: committed winners resolve at construction -----------
+
+def _pickup_engine(**kw):
+    from paddle_tpu.inference import FusedMultiTransformerEngine
+    return FusedMultiTransformerEngine(
+        _mk_weights(0, 128, 64, 4, 2, 16, 2, 96), num_heads=4,
+        head_dim=16, max_seq_len=64, dtype="float32",
+        norm_type="rmsnorm", activation="swiglu", gqa_group_size=2, **kw)
+
+
+def _pickup_cache(pack=2, prefill_chunk=8, buffer_depth=4):
+    win = {"pack": pack, "prefill_chunk": prefill_chunk,
+           "buffer_depth": buffer_depth}
+    return {"schema": at.SERVE_SCHEMA, "kernel": "ragged_paged_attention",
+            "shapes": {"kvh2_g2_bs8_d16_float32": {
+                "winner": dict(win),
+                "buckets": {"t16_c8": dict(win)}}}}
+
+
+class TestEnginePickup:
+    def test_winner_lookup_prefers_exact_bucket(self):
+        cache = _pickup_cache()
+        cache["shapes"]["kvh2_g2_bs8_d16_float32"]["buckets"]["t16_c8"] \
+            ["buffer_depth"] = 1
+        exact = at.serve_winner(cache, "kvh2_g2_bs8_d16_float32",
+                                bucket="t16_c8")
+        assert exact["buffer_depth"] == 1
+        agg = at.serve_winner(cache, "kvh2_g2_bs8_d16_float32",
+                              bucket="t64_c4")     # unseen bucket
+        assert agg["buffer_depth"] == 4
+        assert at.serve_winner(cache, "kvh8_g1_bs8_d128_float32") is None
+
+    def test_engine_ctor_matches_ignoring_block_size(self):
+        # the paged block_size belongs to the scheduler: the engine
+        # matches its (kvh, group, head_dim, dtype) across any bs
+        cfg = at.serve_winner_for_engine(_pickup_cache(), 2, 2, 16,
+                                         "float32")
+        assert cfg["buffer_depth"] == 4
+        assert at.serve_winner_for_engine(_pickup_cache(), 2, 2, 128,
+                                          "float32") is None
+
+    def test_engine_picks_tuned_buffer_depth(self):
+        eng = _pickup_engine(autotune_cache=_pickup_cache())
+        assert eng.kv_buffer_depth == 4
+
+    def test_explicit_buffer_depth_beats_cache(self):
+        eng = _pickup_engine(autotune_cache=_pickup_cache(),
+                             kv_buffer_depth=1)
+        assert eng.kv_buffer_depth == 1
+
+    def test_cb_picks_pack_and_chunk_without_resweep(self, monkeypatch):
+        # construction must only READ the committed cache — a re-sweep
+        # here would burn minutes of host time per engine start
+        def boom(*a, **kw):
+            raise AssertionError("engine construction re-swept")
+
+        monkeypatch.setattr(at, "sweep_ragged_serve", boom)
+        from paddle_tpu.incubate.nn import ContinuousBatchingEngine
+        eng = _pickup_engine(autotune_cache=_pickup_cache())
+        cb = ContinuousBatchingEngine(
+            eng, num_blocks=24, block_size=8, max_batch=4,
+            autotune_cache=_pickup_cache())
+        assert cb._pack == 2
+        assert cb.prefill_chunk == 8
+
+    def test_cb_clamps_tuned_pack_to_max_batch(self):
+        from paddle_tpu.incubate.nn import ContinuousBatchingEngine
+        cb = ContinuousBatchingEngine(
+            _pickup_engine(), num_blocks=24, block_size=8, max_batch=4,
+            autotune_cache=_pickup_cache(pack=16))
+        assert cb._pack == 4
+
+
+# -- quantized paged serving: token-exact vs dense weight_quant ----------
+#
+# tiny TP-able shape: 4 q heads / 2 kv heads split evenly at tp=2, and
+# H*D/tp and F/tp stay even so int4's packed nibble pairs never
+# straddle a device boundary
+
+QV, QE, QH, QG, QD, QL, QF = 64, 32, 4, 2, 8, 2, 32
+QWORK = [(5, 2), (9, 2), (3, 3), (8, 2)]
+_qrng = np.random.default_rng(7)
+QPROMPTS = [_qrng.integers(1, QV, p).astype(np.int32) for p, _ in QWORK]
+QSPEC_PROMPTS = [np.asarray([7, 23, 41, 11] * 4, np.int32),
+                 np.asarray([7, 23, 41, 11] * 2, np.int32)]
+QPREFIX = np.random.default_rng(3).integers(1, QV, 16).astype(np.int32)
+
+QMODES = {"plain": {}, "chunked": {"prefill_chunk": 4},
+          "budgeted": {"prefill_chunk": 4, "token_budget": 6}}
+
+_QENG, _QREF, _QSPEC, _QPFX = {}, {}, {}, {}
+
+
+def _qeng(kind, tp):
+    if (kind, tp) not in _QENG:
+        from paddle_tpu.inference import FusedMultiTransformerEngine
+        _QENG[(kind, tp)] = FusedMultiTransformerEngine(
+            _mk_weights(0, QV, QE, QH, QG, QD, QL, QF), num_heads=QH,
+            head_dim=QD, max_seq_len=64, dtype="float32",
+            norm_type="rmsnorm", activation="swiglu",
+            gqa_group_size=QG, weight_quant=kind, tp=tp)
+    return _QENG[(kind, tp)]
+
+
+def _qcb(kind, tp, **kw):
+    from paddle_tpu.incubate.nn import ContinuousBatchingEngine
+    ckw = dict(num_blocks=24, block_size=8, max_batch=4)
+    ckw.update(kw)
+    return ContinuousBatchingEngine(_qeng(kind, tp), **ckw)
+
+
+def _qref(kind, prompt, n):
+    """The truth: the DENSE weight_quant engine's generate()."""
+    key = (kind, prompt.tobytes(), n)
+    if key not in _QREF:
+        out = _qeng(kind, 1).generate(prompt[None], max_new_tokens=n)
+        _QREF[key] = [int(t) for t in np.asarray(out)[0]]
+    return _QREF[key]
+
+
+def _qreqs(tag, prompts, news):
+    from paddle_tpu.incubate.nn import GenerationRequest
+    return [GenerationRequest(p.copy(), n, request_id=_tag(tag))
+            for p, n in zip(prompts, news)]
+
+
+def _qspec(kind, tp):
+    if (kind, tp) not in _QSPEC:
+        cb = _qcb(kind, tp, max_batch=2, prefill_chunk=8, spec_k=4)
+        reqs = _qreqs(f"qs{kind}{tp}_", QSPEC_PROMPTS, [8, 8])
+        toks = _run(cb, reqs)
+        _QSPEC[(kind, tp)] = (toks, [
+            cb._step_count, sum(r.spec_drafted for r in reqs),
+            sum(r.spec_accepted for r in reqs)])
+    return _QSPEC[(kind, tp)]
+
+
+def _qprefix(kind, tp):
+    if (kind, tp) not in _QPFX:
+        cb = _qcb(kind, tp, prefill_chunk=8, prefix_cache=True)
+        # identical block-aligned prompts: the whole prompt maps from
+        # cache and the replayed last token writes INSIDE the shared
+        # tail block — the copy-on-write trigger, now on a quantized
+        # engine's caches
+        reqs = _qreqs(f"qp{kind}{tp}_", [QPREFIX] * 3, [3] * 3)
+        toks = _run(cb, reqs)
+        _QPFX[(kind, tp)] = (toks, dict(cb.cache_stats),
+                             cb.allocator.num_used)
+    return _QPFX[(kind, tp)]
+
+
+class TestQuantPagedTokenExact:
+    """int8/int4 weight-only engines under continuous batching, every
+    scheduler mode, tp=1 and tp=2 — greedy ids must equal the dense
+    weight_quant generate() exactly."""
+
+    @pytest.mark.parametrize("tp", [1, 2])
+    @pytest.mark.parametrize("mode", sorted(QMODES))
+    @pytest.mark.parametrize("kind", ["int8", "int4"])
+    def test_scheduler_modes(self, kind, mode, tp):
+        cb = _qcb(kind, tp, **QMODES[mode])
+        got = _run(cb, _qreqs(f"q{kind}{mode}{tp}_", QPROMPTS,
+                              [n for _, n in QWORK]))
+        assert got == [_qref(kind, p, n)
+                       for p, (_, n) in zip(QPROMPTS, QWORK)]
+
+    @pytest.mark.parametrize("tp", [1, 2])
+    @pytest.mark.parametrize("kind", ["int8", "int4"])
+    def test_spec_decode_with_rewind(self, kind, tp):
+        toks, stats = _qspec(kind, tp)
+        assert toks == [_qref(kind, p, 8) for p in QSPEC_PROMPTS]
+        # the repeating pattern guarantees accepted drafts, so the
+        # paged REWIND ran on the quantized cache; the draft/accept
+        # accounting must not depend on the mesh shape
+        assert stats[2] > 0
+        assert stats == _qspec(kind, 1)[1]
+
+    @pytest.mark.parametrize("tp", [1, 2])
+    @pytest.mark.parametrize("kind", ["int8", "int4"])
+    def test_prefix_cache_cow(self, kind, tp):
+        toks, stats, used = _qprefix(kind, tp)
+        assert toks == [_qref(kind, QPREFIX, 3)] * 3
+        assert stats["hit_blocks"] >= 2       # followers mapped blocks
+        assert stats["cow_copies"] >= 1       # divergent tail write
+        assert used == 0                      # all blocks retired
+        assert stats == _qprefix(kind, 1)[1]
+
+    @pytest.mark.parametrize("kind,tp", [("int8", 1), ("int4", 2)])
+    def test_zero_new_buckets_after_warm(self, kind, tp):
+        cb = _qcb(kind, tp, prefill_chunk=4, token_budget=6)
+        _run(cb, _qreqs(f"qw{kind}{tp}_", QPROMPTS,
+                        [n for _, n in QWORK]))
+        cb.declare_warm()
+        warm = set(cb._seen_buckets)
+        fresh = [np.random.default_rng(5).integers(1, QV, p)
+                 .astype(np.int32) for p, _ in QWORK]
+        _run(cb, _qreqs(f"qw{kind}{tp}b_", fresh,
+                        [n for _, n in QWORK]))
+        assert set(cb._seen_buckets) == warm

@@ -97,7 +97,7 @@ class TestResolveCompilerParams:
         assert obj.vmem_limit_bytes == 1 << 20
 
     def test_pallas_tuning_routes_through_resolver(self):
-        from paddle_tpu.ops.pallas.tuning import VMEM_LIMIT, cparams
+        from paddle_tpu.ops.pallas.autotune import VMEM_LIMIT, cparams
         obj = cparams()
         assert obj.vmem_limit_bytes == VMEM_LIMIT
         assert isinstance(obj, resolve_compiler_params())

@@ -1,0 +1,92 @@
+# graftlint-corpus-expect: GL120 GL120 GL120 GL120
+"""Mesh/NamedSharding construction on the serving hot path (GL120):
+a fresh Mesh per step is a NEW jit cache key — the dispatch it feeds
+recompiles every iteration — and device enumeration at construction
+stalls the host inside the loop. The clean idiom is construction-time
+meshes closed over by the step functions (the tripwires below must
+stay silent)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _decode_step(w, caches, toks):
+    return toks, caches
+
+
+class Server:
+    def __init__(self):
+        # construction time is the RIGHT place: never flags
+        self._mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+        self._sh = NamedSharding(self._mesh, P(None, "tp"))
+        self._paged_step = jax.jit(_decode_step)
+        self.w = {}
+        self.caches = []
+
+    def drain_bad_mesh_in_dispatch_loop(self, slabs):
+        outs = []
+        for slab in slabs:
+            sh = NamedSharding(self._mesh, P("tp"))     # fresh per step
+            slab = jax.device_put(slab, sh)
+            out, self.caches = self._paged_step(self.w, self.caches, slab)
+            outs.append(out)
+        return outs
+
+    def pump_bad_while_loop_mesh(self, feed):
+        while feed.pending():
+            mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))  # per step
+            slab = feed.take(mesh)
+            _, self.caches = self._paged_step(self.w, self.caches, slab)
+
+    def step_bad_per_call_wrapper(self, slab):
+        # serve/step-shaped AND dispatching: the mesh is rebuilt per
+        # CALL even though no lexical loop wraps it — the caller's loop
+        # lives in another file
+        mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+        slab = jax.device_put(slab, NamedSharding(mesh, P("tp")))
+        out, self.caches = self._paged_step(self.w, self.caches, slab)
+        return out
+
+    # -- clean-idiom tripwires: none of these may flag -------------------
+
+    def step_clean_closed_over(self, slab):
+        # the hot path reuses the ctor's mesh/sharding: silent
+        slab = jax.device_put(slab, self._sh)
+        out, self.caches = self._paged_step(self.w, self.caches, slab)
+        return out
+
+    def shard_params_clean_setup_loop(self, params, specs):
+        # a setup loop that only device_puts (no compiled dispatch):
+        # one NamedSharding per weight at load time is construction,
+        # not the hot path
+        out = {}
+        for k, v in params.items():
+            out[k] = jax.device_put(v, NamedSharding(self._mesh,
+                                                     specs[k]))
+        return out
+
+    def replay_clean_hoisted(self, slabs):
+        # dispatch loop with the sharding HOISTED above it: silent
+        # (the function name is not serve/step-shaped, and the ctor
+        # sits outside the loop)
+        sh = NamedSharding(self._mesh, P("tp"))
+        outs = []
+        for slab in slabs:
+            slab = jax.device_put(slab, sh)
+            out, self.caches = self._paged_step(self.w, self.caches, slab)
+            outs.append(out)
+        return outs
+
+    def run_clean_no_dispatch(self):
+        # loop-shaped NAME but no compiled dispatch anywhere: building
+        # a mesh here is setup, not a hot path
+        return Mesh(np.array(jax.devices()[:2]), ("tp",))
+
+
+def new_caches_clean_module_fn(n_layers, mesh):
+    # hoisted above the per-layer comprehension (the new_paged_caches
+    # idiom): silent
+    sh = NamedSharding(mesh, P(None, "tp"))
+    return [jax.device_put(jnp.zeros((2, 4, 8, 8, 16)), sh)
+            for _ in range(n_layers)]

@@ -1016,6 +1016,78 @@ def swallowed_cancellation(ctx):
                             + _GL113_MSG), h
 
 
+_GL120_CTORS = ("Mesh", "NamedSharding")
+
+_GL120_MSG = (
+    "a FRESH Mesh/NamedSharding per call is a new jit cache key — the "
+    "dispatch it feeds recompiles (or at best re-hashes device lists) "
+    "every step, and device enumeration at construction is a host-side "
+    "stall in the hot loop. Build the mesh and shardings ONCE at "
+    "construction time and close over them (inference/__init__.py "
+    "builds self._mesh in the ctor; new_paged_caches hoists its "
+    "NamedSharding above the per-layer comprehension)")
+
+
+def _gl120_callee(node):
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+@rule("GL120", "inline-mesh-in-hot-path", "trace-safety")
+def inline_mesh_in_hot_path(ctx):
+    """Mesh()/NamedSharding() constructed on the serving hot path:
+    inside a for/while loop that also dispatches a compiled program
+    (the step loop), or anywhere in a serve/step-loop-shaped function
+    that dispatches one (the per-call wrapper — it runs per request by
+    construction). Construction time (`__init__`, module level, setup
+    loops that only device_put) never flags: that is the RIGHT place
+    to build them."""
+    jit_names = _jit_bound_names(ctx)
+    flagged = set()
+    for fn in ctx.walk():
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name == "__init__":
+            continue
+        dispatches = any(_is_device_call(n, jit_names)
+                         for n in _own_scope_walk(fn))
+        # (a) the step loop: a ctor call inside a loop that also
+        # dispatches — the canonical picket-fence shape
+        for loop in _own_scope_walk(fn):
+            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            if not any(_is_device_call(n, jit_names)
+                       for n in ast.walk(loop)):
+                continue
+            for node in ast.walk(loop):
+                if isinstance(node, ast.Call) \
+                        and _gl120_callee(node) in _GL120_CTORS \
+                        and id(node) not in flagged:
+                    flagged.add(id(node))
+                    yield ctx.finding(
+                        "GL120", node,
+                        f"{_gl120_callee(node)}() constructed inside "
+                        f"`{fn.name}`'s dispatch loop: " + _GL120_MSG), node
+        # (b) the per-call wrapper: a serve/step-shaped function that
+        # dispatches a compiled program builds its mesh per CALL even
+        # when the ctor sits outside any lexical loop
+        if not dispatches or not _GL113_LOOPFN.search(fn.name):
+            continue
+        for node in _own_scope_walk(fn):
+            if isinstance(node, ast.Call) \
+                    and _gl120_callee(node) in _GL120_CTORS \
+                    and id(node) not in flagged:
+                flagged.add(id(node))
+                yield ctx.finding(
+                    "GL120", node,
+                    f"{_gl120_callee(node)}() constructed per call of "
+                    f"the dispatching `{fn.name}`: " + _GL120_MSG), node
+
+
 @rule("GL112", "metric-label-cardinality", "trace-safety")
 def metric_label_cardinality(ctx):
     """`.labels(x=...)` fed from a loop variable, an f-string

@@ -56,4 +56,11 @@ if [ "${LINT_SKIP_SERVE:-0}" != "1" ]; then
   # (NaN batch, lr spike, throttled loader) fires exactly its
   # detector(s) once with a schema-valid flight dump
   python tools/train_monitor.py --check tools/train_health.json
+  # autotune + quantized-serving gate: the committed winner table must
+  # reproduce bit-for-bit from the interpret-mode cost model (sweep is
+  # host-deterministic), the tuned engine stays token-exact vs the
+  # default config with 0 new compile buckets after warmup, and int8/
+  # int4 weight-only engines under continuous batching match the dense
+  # weight_quant generate() across all scheduler modes
+  python tools/serve_bench.py --check tools/serve_autotune.json
 fi

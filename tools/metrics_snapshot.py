@@ -784,6 +784,34 @@ def selfcheck():
         check(False, "parse_prometheus accepted garbage")
     except ValueError:
         pass
+
+    # kernel-autotune families (ISSUE 16): sweep accounting and the
+    # winner-config gauges must export under their bounded label sets
+    # (kernel names are code literals, `param` is a fixed 3-tuple) —
+    # stdlib-only like everything above
+    inst.autotune_trials().labels(kernel="ragged_paged_attention").inc(9)
+    inst.autotune_cache_hits().inc(2)
+    inst.autotune_cache_misses().inc()
+    for param, val in (("pack", 4), ("prefill_chunk", 8),
+                       ("buffer_depth", 2)):
+        inst.autotune_winner().labels(
+            kernel="ragged_paged_attention", param=param).set(val)  # graftlint: disable=GL112 - fixed 3-element literal label set
+    prom11 = obs.to_prometheus()
+    for needle in (
+            'autotune_trials_total{kernel="ragged_paged_attention"} 9',
+            "autotune_cache_hits_total 2",
+            "autotune_cache_misses_total 1",
+            "# TYPE autotune_winner_config gauge",
+            'autotune_winner_config{kernel="ragged_paged_attention"'
+            ',param="buffer_depth"} 2'):
+        check(needle in prom11,
+              f"autotune family missing from exposition: {needle!r}")
+    parsed11 = obs.parse_prometheus(prom11)
+    check(any(n == "autotune_winner_config" and v == 8
+              and lbl.get("param") == "prefill_chunk"
+              for n, lbl, v
+              in parsed11["autotune_winner_config"]["samples"]),
+          "parse_prometheus lost the autotune winner gauge")
     return failures
 
 

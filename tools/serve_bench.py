@@ -238,21 +238,21 @@ def ragged_leg(iters=4):
     return out
 
 
-def _tiny_cpu_engine(rng, max_seq_len):
-    """The CPU-sized serving engine both the --metrics and --prefill legs
-    drive (V=128/E=64/L=2, GQA 4q/2kv). Takes the caller's rng so the
-    weight draws stay at the head of its stream — prompt draws follow
-    from the same generator, keeping committed baselines reproducible."""
+_TINY_DIMS = (128, 64, 4, 2, 16, 2, 96)     # V, E, H, G, D, L, F
+
+
+def _tiny_cpu_weights(rng):
+    """Raw fp32 weights for the CPU-sized serving engine (V=128/E=64/
+    L=2, GQA 4q/2kv) — split out so the --quant leg can build dense AND
+    weight-quant engines over the SAME draws."""
     import numpy as np
 
-    from paddle_tpu.inference import FusedMultiTransformerEngine
-
-    V, E, H, G, D, L, F = 128, 64, 4, 2, 16, 2, 96
+    V, E, H, G, D, L, F = _TINY_DIMS
 
     def mk(*shape, scale=0.05):
         return (rng.standard_normal(shape) * scale).astype(np.float32)
 
-    w = dict(
+    return dict(
         ln_scales=[np.ones(E, np.float32) for _ in range(L)],
         qkv_weights=[mk(H + 2 * G, D, E) for _ in range(L)],
         linear_weights=[mk(H * D, E) for _ in range(L)],
@@ -260,10 +260,21 @@ def _tiny_cpu_engine(rng, max_seq_len):
         ffn1_weights=[mk(E, 2 * F) for _ in range(L)],
         ffn2_weights=[mk(F, E) for _ in range(L)],
         embedding=mk(V, E), lm_head=mk(E, V))
+
+
+def _tiny_cpu_engine(rng, max_seq_len, **engine_kw):
+    """The CPU-sized serving engine both the --metrics and --prefill legs
+    drive. Takes the caller's rng so the weight draws stay at the head
+    of its stream — prompt draws follow from the same generator, keeping
+    committed baselines reproducible. Extra kwargs (weight_quant,
+    autotune_cache, ...) pass through to the engine constructor."""
+    from paddle_tpu.inference import FusedMultiTransformerEngine
+
+    V, E, H, G, D, L, F = _TINY_DIMS
     eng = FusedMultiTransformerEngine(
-        w, num_heads=H, head_dim=D, max_seq_len=max_seq_len,
-        dtype="float32", norm_type="rmsnorm", activation="swiglu",
-        gqa_group_size=G)
+        _tiny_cpu_weights(rng), num_heads=H, head_dim=D,
+        max_seq_len=max_seq_len, dtype="float32", norm_type="rmsnorm",
+        activation="swiglu", gqa_group_size=G, **engine_kw)
     return eng, V
 
 
@@ -1144,6 +1155,275 @@ def check_ragged(base):
     return 0
 
 
+AUTOTUNE_WORKLOAD = [(5, 3), (11, 4), (3, 5), (8, 2)]
+
+
+def _autotune_sweep(at, measure):
+    """The committed sweep: the tiny engine's shape class (kvh=2, g=2,
+    block=8, d=16, f32) over its two occupancy buckets — the decode
+    bucket at the workload's post-prefill length spread, and the
+    chunk-8 prefill bucket."""
+    lens = [p + n for p, n in AUTOTUNE_WORKLOAD]
+    cache = None
+    for chunk in (None, 8):
+        cache = at.sweep_ragged_serve(
+            2, 2, 16, 8, lens, chunk=chunk, measure=measure, cache=cache)
+    return cache
+
+
+def autotune_leg():
+    """Serving-kernel autotune end to end: sweep the ragged kernel's
+    (pack, prefill_chunk, buffer_depth) per occupancy bucket, rank by
+    the deterministic analytic model (this leg is the CI gate — on a
+    real TPU, run sweep_ragged_serve with measure=True to re-tune), and
+    drive the SAME continuous-batching workload untuned vs tuned-from-
+    cache: token ids must match exactly, the tuned engine must mint
+    zero new compile buckets after warmup, and a second sweep must
+    reproduce the winner table bit-for-bit."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                        GenerationRequest)
+    from paddle_tpu.ops.pallas import autotune as at
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        fa._INTERPRET = True
+    cache = _autotune_sweep(at, measure=False)
+    deterministic = _autotune_sweep(at, measure=False) == cache
+    shape_cls = at.serve_shape_class(2, 2, 8, 16, "float32")
+
+    def drive(tune):
+        rng = np.random.default_rng(0)
+        eng, V = _tiny_cpu_engine(rng, max_seq_len=64,
+                                  autotune_cache=tune)
+        cb = ContinuousBatchingEngine(eng, num_blocks=24, block_size=8,
+                                      max_batch=4, autotune_cache=tune)
+
+        def submit():
+            prng = np.random.default_rng(7)
+            reqs = [GenerationRequest(
+                prng.integers(1, V, p).astype(np.int32), n)
+                for p, n in AUTOTUNE_WORKLOAD]
+            for r in reqs:
+                cb.submit(r)
+            return reqs
+        reqs = submit()
+        out = cb.run()
+        toks = [list(map(int, out[r.request_id])) for r in reqs]
+        steps = cb._step_count
+        warm = set(cb._seen_buckets)
+        submit()                     # same workload again: warm replay
+        cb.run()
+        return {
+            "tokens": toks, "steps": steps,
+            "new_buckets": len(set(cb._seen_buckets) - warm),
+            "config": {"pack": cb._pack,
+                       "prefill_chunk": cb.prefill_chunk,
+                       "kv_buffer_depth": eng.kv_buffer_depth},
+        }
+
+    default = drive(None)
+    tuned = drive(cache)
+    ntok = sum(n for _, n in AUTOTUNE_WORKLOAD)
+    out = {
+        "interpret": not on_tpu,
+        "shape_class": shape_cls,
+        "winner": dict(cache["shapes"][shape_cls]["winner"]),
+        "buckets": {
+            k: {p: b[p] for p in ("pack", "prefill_chunk",
+                                  "buffer_depth")}
+            for k, b in cache["shapes"][shape_cls]["buckets"].items()},
+        "deterministic": deterministic,
+        # lists, not tuples: the committed baseline round-trips JSON
+        "workload": [list(t) for t in AUTOTUNE_WORKLOAD],
+        "tokens": ntok,
+        "steps_default": default["steps"],
+        "steps_tuned": tuned["steps"],
+        "steps_per_token_default": round(default["steps"] / ntok, 4),
+        "steps_per_token_tuned": round(tuned["steps"] / ntok, 4),
+        "token_exact_tuned_vs_default":
+            tuned["tokens"] == default["tokens"],
+        "default_config": default["config"],
+        "tuned_config": tuned["config"],
+        "new_buckets_after_warmup_tuned": tuned["new_buckets"],
+        "cache": cache,
+    }
+    print(f"autotune[{shape_cls}]: winner {out['winner']}, "
+          f"{out['steps_tuned']} tuned vs {out['steps_default']} default "
+          f"steps for {ntok} tokens; "
+          f"{out['new_buckets_after_warmup_tuned']} new buckets after "
+          "warmup; deterministic="
+          f"{out['deterministic']}")
+    return out
+
+
+AUTOTUNE_KEYS = ("shape_class", "winner", "buckets", "deterministic",
+                 "workload", "tokens", "steps_default", "steps_tuned",
+                 "token_exact_tuned_vs_default", "default_config",
+                 "tuned_config", "new_buckets_after_warmup_tuned")
+
+
+def check_autotune(base):
+    """CI gate for the committed serve-autotune cache: a fresh
+    model-ranked sweep must reproduce the committed winner table
+    bit-for-bit, the tuned engine must stay token-exact vs the default
+    one with zero new compile buckets after warmup, and the gate
+    metadata must match the committed figures exactly."""
+    cur = autotune_leg()
+    bad = []
+    if cur["cache"]["shapes"] != base.get("shapes"):
+        print("MISMATCH winner table: re-sweep disagrees with the "
+              "committed shapes section — regenerate with "
+              "`serve_bench --autotune --quant --json "
+              "tools/serve_autotune.json` if the model changed")
+        bad.append("shapes")
+    gate = base.get("gate", {}).get("autotune", {})
+    for k in AUTOTUNE_KEYS:
+        if cur[k] != gate.get(k):
+            print(f"MISMATCH {k}: current {cur[k]!r} != baseline "
+                  f"{gate.get(k)!r}")
+            bad.append(k)
+    for k, want in (("deterministic", True),
+                    ("token_exact_tuned_vs_default", True)):
+        if cur[k] is not want:
+            print(f"REGRESSION: {k} is {cur[k]}")
+            bad.append(k)
+    if cur["new_buckets_after_warmup_tuned"] != 0:
+        print("REGRESSION: tuned engine compiled "
+              f"{cur['new_buckets_after_warmup_tuned']} fresh buckets "
+              "after warmup")
+        bad.append("new_buckets_after_warmup_tuned")
+    if bad:
+        return 1
+    print(f"autotune leg OK: winner {cur['winner']}, tuned engine "
+          f"token-exact in {cur['steps_tuned']} steps, 0 new buckets")
+    return 0
+
+
+def quant_leg(kinds=("int8", "int4")):
+    """int4/int8 weight-only serving on the PAGED path: for each quant
+    kind, the continuous-batching engine built over the SAME quantized
+    weights must emit greedy token ids EXACTLY matching the dense
+    weight_quant engine's generate() in every scheduler mode
+    (plain / chunked / budgeted / speculative / prefix-cached), with
+    zero new compile buckets after warmup."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                        GenerationRequest)
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        fa._INTERPRET = True
+    V, E, H, G, D, L, F = _TINY_DIMS
+    workload = [(5, 4), (11, 3), (3, 6), (8, 2)]
+    prng = np.random.default_rng(7)
+    prompts = [prng.integers(1, V, p).astype(np.int32)
+               for p, _ in workload]
+    pattern = [7, 23, 41, 11]
+    spec_prompts = [np.asarray(pattern * 6, np.int32),
+                    np.asarray(pattern * 3, np.int32)]
+    pfx_rng = np.random.default_rng(3)
+    prefix = pfx_rng.integers(1, V, 24).astype(np.int32)
+    pfx_prompts = [np.concatenate(
+        [prefix, pfx_rng.integers(1, V, 3).astype(np.int32)])
+        for _ in range(4)]
+
+    modes = {
+        "plain": ({}, prompts, [n for _, n in workload]),
+        "chunked": ({"prefill_chunk": 4}, prompts,
+                    [n for _, n in workload]),
+        "budgeted": ({"prefill_chunk": 4, "token_budget": 6}, prompts,
+                     [n for _, n in workload]),
+        "spec": ({"max_batch": 2, "prefill_chunk": 8, "spec_k": 4},
+                 spec_prompts, [10, 10]),
+        "prefix": ({"prefill_chunk": 8, "prefix_cache": True},
+                   pfx_prompts, [4, 4, 4, 4]),
+    }
+
+    token_exact, steps, new_buckets = {}, {}, {}
+    for kind in kinds:
+        eng, _ = _tiny_cpu_engine(np.random.default_rng(0),
+                                  max_seq_len=64, weight_quant=kind)
+        refs = {m: [list(map(int, eng.generate(
+            p[None], max_new_tokens=n)[0]))
+            for p, n in zip(ps, ns)]
+            for m, (_, ps, ns) in modes.items()}
+        token_exact[kind], steps[kind] = {}, {}
+        for m, (kw, ps, ns) in modes.items():
+            ckw = dict(num_blocks=24, block_size=8, max_batch=4)
+            ckw.update(kw)
+            cb = ContinuousBatchingEngine(eng, **ckw)
+            reqs = [GenerationRequest(p.copy(), n)
+                    for p, n in zip(ps, ns)]
+            for r in reqs:
+                cb.submit(r)
+            out = cb.run()
+            got = [list(map(int, out[r.request_id])) for r in reqs]
+            token_exact[kind][m] = got == refs[m]
+            steps[kind][m] = cb._step_count
+            if m == "chunked":
+                warm = set(cb._seen_buckets)
+                for r in [GenerationRequest(p.copy(), n)
+                          for p, n in zip(ps, ns)]:
+                    cb.submit(r)
+                cb.run()
+                new_buckets[kind] = len(set(cb._seen_buckets) - warm)
+    out = {
+        "interpret": not on_tpu,
+        "kinds": list(kinds),
+        "modes": sorted(modes),
+        "workload": [list(t) for t in workload],
+        "token_exact": token_exact,
+        "steps": steps,
+        "new_buckets_after_warmup": new_buckets,
+    }
+    for kind in kinds:
+        ok = all(token_exact[kind].values())
+        print(f"quant[{kind}]: paged-vs-dense token ids "
+              f"{'EXACT' if ok else 'MISMATCH'} across "
+              f"{len(modes)} modes; {new_buckets[kind]} new buckets "
+              "after warmup")
+    return out
+
+
+QUANT_KEYS = ("kinds", "modes", "workload", "token_exact", "steps",
+              "new_buckets_after_warmup")
+
+
+def check_quant(base):
+    """CI gate for quantized paged serving: token ids must match the
+    dense weight_quant generate() in EVERY mode for EVERY kind, the
+    deterministic step counts must match the committed baseline, and
+    the warm replay must mint zero fresh compile buckets."""
+    cur = quant_leg()
+    bad = [k for k in QUANT_KEYS if cur[k] != base.get(k)]
+    for k in bad:
+        print(f"MISMATCH {k}: current {cur[k]!r} != baseline "
+              f"{base.get(k)!r}")
+    for kind, per_mode in cur["token_exact"].items():
+        for m, ok in per_mode.items():
+            if not ok:
+                print(f"REGRESSION: {kind} paged serving diverged from "
+                      f"dense weight_quant generate() in mode {m}")
+                bad.append(f"token_exact.{kind}.{m}")
+    for kind, n in cur["new_buckets_after_warmup"].items():
+        if n != 0:
+            print(f"REGRESSION: {kind} engine compiled {n} fresh "
+                  "buckets after warmup")
+            bad.append(f"new_buckets.{kind}")
+    if bad:
+        return 1
+    print(f"quant leg OK: {'/'.join(cur['kinds'])} token-exact across "
+          f"{len(cur['modes'])} modes, 0 new buckets")
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", default=None)
@@ -1195,6 +1475,20 @@ def main():
                          "prefix, per-device KV high-water = 1/tp, "
                          "collective payload accounting, 0 new buckets "
                          "after warmup (works on CPU)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="serving-kernel autotune leg: sweep the ragged "
+                         "kernel's (pack, prefill_chunk, buffer_depth) "
+                         "per occupancy bucket, model-ranked "
+                         "deterministically, and drive tuned-vs-default "
+                         "engines token-exact (works on CPU; with "
+                         "--json the serve cache + gate metadata land "
+                         "in ONE engine-loadable file)")
+    ap.add_argument("--quant", action="store_true",
+                    help="int4/int8 weight-only serving on the paged "
+                         "path: continuous-batching token ids vs the "
+                         "dense weight_quant engine's generate() in "
+                         "every scheduler mode (works on CPU via "
+                         "interpret mode)")
     ap.add_argument("--chunk", type=int, default=64,
                     help="prefill chunk size for the --prefill leg")
     ap.add_argument("--no-flight-recorder", action="store_true",
@@ -1229,6 +1523,14 @@ def main():
             except Exception:  # already initialized on cpu: fine
                 pass
     if args.check:
+        if base.get("schema", "").startswith("paddle_tpu.serve_autotune"):
+            # the committed serve-autotune cache doubles as the gate
+            # baseline: shapes = the winner table engines load, gate =
+            # the leg metadata (extra top-level keys are ignored by
+            # load_serve_cache by design)
+            rc = check_autotune(base)
+            rc |= check_quant(base.get("gate", {}).get("quant", {}))
+            return rc
         rc = 0
         ran = False
         if "ragged" in base:
@@ -1251,6 +1553,28 @@ def main():
                   "'tp' section to gate")
             return 1
         return rc
+    if args.autotune or args.quant:
+        # these two produce the ONE committed file tools/serve_autotune
+        # .json: the serve cache engines load (schema/kernel/shapes)
+        # with the gate metadata alongside under "gate"
+        at_out = autotune_leg() if args.autotune else None
+        q_out = quant_leg() if args.quant else None
+        if args.json:
+            doc = dict(at_out.pop("cache")) if at_out else {}
+            doc["gate"] = {}
+            if at_out:
+                doc["gate"]["autotune"] = at_out
+            if q_out:
+                doc["gate"]["quant"] = q_out
+            from paddle_tpu.ops.pallas.autotune import save_serve_cache
+            if "schema" in doc:
+                save_serve_cache(doc, args.json)
+            else:
+                with open(args.json, "w") as f:
+                    json.dump(doc, f, indent=1, sort_keys=True)
+                    f.write("\n")
+            print(f"wrote {args.json}")
+        return 0
     if args.ragged or args.metrics or args.prefill or args.spec \
             or args.no_spec or args.trace or args.prefix or args.tp:
         out = {}
