@@ -86,7 +86,13 @@ class EngineStepper:
 
     @property
     def running(self):
-        return self._thread.is_alive() and self.error is None
+        # `error` is written by the step thread under `_cond` — read
+        # it under the same lock, or a caller polling `running` can
+        # observe the liveness flip before the error lands and report
+        # "healthy" for a dying stepper
+        with self._cond:
+            err = self.error
+        return self._thread.is_alive() and err is None
 
     def hold(self):
         """Pause stepping (commands still drain): submissions enqueue

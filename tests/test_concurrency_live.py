@@ -1,0 +1,190 @@
+"""Live-drive concurrency regression: hammer the stepper surface the
+lockset sweep fixed (GL121 — `running` read `error` lock-free while
+the step thread wrote it under `_cond`) and assert no torn state.
+
+Same spirit as the PR-14 PsServer join test: real threads, bounded
+waits, invariants checked from OUTSIDE the lock. The invariant the
+fix establishes: `error` is write-once under `_cond` and `running`
+reads it under the same lock, so any thread that has OBSERVED the
+error must from then on see `running` False — the old unlocked read
+could report "healthy" for a stepper that had already recorded its
+death. The crash path also re-pins the fanout contract under
+concurrency: every live stream gets a structured `failed` terminal,
+later commands fail with the recorded error, and `running` called
+from INSIDE an event callback (the stepper's own thread, mid-fanout)
+must not deadlock on `_cond`.
+
+stdlib + a fake engine only — no jax import, costs milliseconds.
+"""
+import threading
+import time
+
+from paddle_tpu.serving.stepper import EngineStepper
+
+
+class _Req:
+    def __init__(self, rid):
+        self.request_id = rid
+
+
+class _Result(list):
+    status = "stop"
+    reason = "stop_token"
+    preemptions = 0
+
+
+class FakeEngine:
+    """One-token-per-request engine: submit enqueues, step pops one
+    request, fans a token + terminal out, optionally crashes after a
+    set number of steps. Only touched from the stepper thread (the
+    engine contract)."""
+
+    def __init__(self, crash_after=None):
+        self.queue = []
+        self.num_active = 0
+        self.on_token = None
+        self.on_terminal = None
+        self.stepped = 0
+        self._crash_after = crash_after
+
+    def submit(self, request):
+        self.queue.append(request.request_id)
+        return "queued"
+
+    def cancel(self, request_id):
+        try:
+            self.queue.remove(request_id)
+            return True
+        except ValueError:
+            return False
+
+    def step(self):
+        self.stepped += 1
+        if self._crash_after is not None \
+                and self.stepped > self._crash_after:
+            raise RuntimeError("injected step crash")
+        if self.queue:
+            rid = self.queue.pop(0)
+            self.on_token(rid, [7], self.stepped)
+            self.on_terminal(rid, _Result([7]))
+
+
+def _poll_invariant(stepper, stop, violations):
+    """Once `error` is observably set, `running` must be False —
+    forever (error is write-once). The unlocked pre-fix read could
+    interleave `is_alive()` True with a not-yet-visible error."""
+    while not stop.is_set():
+        err = stepper.error
+        if err is not None and stepper.running:
+            violations.append(err)
+
+
+def test_stepper_hammer_no_torn_state():
+    eng = FakeEngine()
+    st = EngineStepper(eng, name="hammer-stepper").start()
+    stop = threading.Event()
+    violations = []
+    pollers = [threading.Thread(target=_poll_invariant,
+                                args=(st, stop, violations), daemon=True)
+               for _ in range(3)]
+    for p in pollers:
+        p.start()
+
+    events = {}
+    ev_lock = threading.Lock()
+    futs = []
+    futs_lock = threading.Lock()
+
+    def producer(base):
+        for i in range(30):
+            rid = f"r{base}-{i}"
+            with ev_lock:
+                events[rid] = []
+            f = st.submit(_Req(rid), on_event=events[rid].append)
+            with futs_lock:
+                futs.append(f)
+
+    threads = [threading.Thread(target=producer, args=(b,), daemon=True)
+               for b in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+        assert not t.is_alive(), "producer wedged"
+
+    assert all(f.result(30) == "queued" for f in futs)
+    # drain: every queued request must terminate (bounded wait)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if st.call(lambda e: len(e.queue)).result(30) == 0:
+            break
+        time.sleep(0.01)
+    st.stop()
+    stop.set()
+    for p in pollers:
+        p.join(10)
+        assert not p.is_alive(), "poller wedged"
+
+    assert not violations, f"running==True observed after error: {violations}"
+    assert st.error is None and not st.running
+    # fanout integrity under the hammer: exactly one token event then
+    # one terminal per request, indices intact — no torn subscriptions
+    assert len(events) == 180
+    for rid, evs in events.items():
+        kinds = [e["type"] for e in evs]
+        assert kinds == ["token", "end"], (rid, evs)
+        assert evs[0]["index"] == 0 and evs[0]["tokens"] == [7]
+        assert evs[1]["status"] == "stop"
+
+
+def test_stepper_crash_is_not_torn():
+    eng = FakeEngine(crash_after=2)
+    st = EngineStepper(eng, name="crash-stepper").start()
+    stop = threading.Event()
+    violations = []
+    pollers = [threading.Thread(target=_poll_invariant,
+                                args=(st, stop, violations), daemon=True)
+               for _ in range(3)]
+    for p in pollers:
+        p.start()
+
+    running_seen_in_callback = []
+    terminals = []
+
+    def on_event(ev):
+        # the stepper's own thread, mid-fanout: `running` takes
+        # `_cond` now — this call deadlocking would wedge the join
+        # below, failing the test by timeout
+        running_seen_in_callback.append(st.running)
+        if ev["type"] == "end":
+            terminals.append(ev)
+
+    futs = [st.submit(_Req(f"c{i}"), on_event=on_event)
+            for i in range(8)]
+    assert all(f.result(30) == "queued" for f in futs)
+
+    st._thread.join(30)
+    assert not st._thread.is_alive(), "stepper did not stop on crash"
+    stop.set()
+    for p in pollers:
+        p.join(10)
+        assert not p.is_alive(), "poller wedged"
+
+    assert not violations, f"running==True observed after error: {violations}"
+    assert isinstance(st.error, RuntimeError)
+    assert not st.running
+    assert running_seen_in_callback, "fanout never ran"
+    # every stream terminated: the 2 served requests got their stop
+    # terminals, the rest structured `failed` — silence is forbidden
+    assert len(terminals) == 8
+    statuses = sorted(t["status"] for t in terminals)
+    assert statuses == ["failed"] * 6 + ["stop"] * 2, statuses
+    assert all(t["reason"] == "engine_error"
+               for t in terminals if t["status"] == "failed")
+    # commands after death fail fast with the recorded error
+    late = st.submit(_Req("late"))
+    try:
+        late.result(10)
+        raise AssertionError("post-crash submit did not fail")
+    except RuntimeError as e:
+        assert "injected step crash" in str(e)

@@ -61,6 +61,64 @@ def _emit_jsonl(res, out=sys.stdout):
         }, sort_keys=True), file=out)
 
 
+def _emit_sarif(res, out=sys.stdout):
+    """SARIF 2.1.0, minimal: rule id, level, message, physical
+    location — enough for CI diff annotation. New findings are
+    `error`, baselined `note`, suppressed findings carry the SARIF
+    `suppressions` property (so a viewer greys them out instead of
+    losing them)."""
+    results = []
+    rows = ([(f, "error", False) for f in res.new]
+            + [(f, "note", True) for f in res.baselined]
+            + [(f, "note", False) for f in res.suppressed_findings])
+    seen_rules = {}
+    for f, level, baselined in sorted(
+            rows, key=lambda r: (r[0].path, r[0].line, r[0].code)):
+        seen_rules.setdefault(f.code, None)
+        entry = {
+            "ruleId": f.code,
+            "level": level,
+            "message": {"text": f.message},
+            "locations": [{"physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": max(1, f.line),
+                           "startColumn": f.col + 1},
+            }}],
+        }
+        if f in res.suppressed_findings and not baselined:
+            entry["suppressions"] = [{"kind": "inSource"}]
+        elif baselined:
+            entry["suppressions"] = [{"kind": "external"}]
+        results.append(entry)
+    for err in res.parse_errors:
+        path, _, msg = err.partition(": ")
+        results.append({
+            "ruleId": "PARSE_ERROR", "level": "error",
+            "message": {"text": msg or err},
+            "locations": [{"physicalLocation": {
+                "artifactLocation": {"uri": path},
+                "region": {"startLine": 1, "startColumn": 1}}}],
+        })
+    doc = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "tools/graftlint (this repository)",
+                "rules": [
+                    {"id": code,
+                     "shortDescription": {"text": RULES[code].name}}
+                    for code in sorted(seen_rules) if code in RULES],
+            }},
+            "results": results,
+        }],
+    }
+    json.dump(doc, out, indent=2, sort_keys=True)
+    print(file=out)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
@@ -84,6 +142,10 @@ def main(argv=None):
     ap.add_argument("--jsonl", action="store_true",
                     help="machine-readable output: one JSON object per "
                          "finding (incl. suppressed + baselined, flagged)")
+    ap.add_argument("--sarif", action="store_true",
+                    help="SARIF 2.1.0 output (minimal: rule id, level, "
+                         "message, physical location) for CI diff "
+                         "annotation; same exit-code contract as --jsonl")
     ap.add_argument("--changed", action="store_true",
                     help="lint only git-changed .py files (phase 1 still "
                          "indexes the whole tree for call-graph context)")
@@ -142,6 +204,10 @@ def main(argv=None):
 
     if args.jsonl:
         _emit_jsonl(res)
+        return 1 if (res.new or res.parse_errors) else 0
+
+    if args.sarif:
+        _emit_sarif(res)
         return 1 if (res.new or res.parse_errors) else 0
 
     for f in res.parse_errors:

@@ -57,6 +57,10 @@ class Finding:
     line: int
     col: int
     message: str
+    # additional witness sites ((path, line) pairs) in possibly OTHER
+    # files — a lock-order cycle has two acquisition chains; a
+    # suppression at any listed site suppresses the whole finding
+    extra_sites: tuple = ()
 
     def render(self):
         return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
@@ -74,6 +78,10 @@ class Rule:
     fn: object
     applies: object    # fn(ctx) -> bool
     phase: str = "scan"   # "scan" | "post" (post rules read scan output)
+    # "file": findings derive from the scanned file alone. "project":
+    # findings (and the suppressions they consume) can span files, so
+    # a scoped run (--changed) must not judge their suppressions stale
+    scope: str = "file"
 
 
 RULES: dict[str, Rule] = {}
@@ -83,17 +91,20 @@ def _applies_everywhere(ctx):
     return True
 
 
-def rule(code, name, family, applies=_applies_everywhere, phase="scan"):
+def rule(code, name, family, applies=_applies_everywhere, phase="scan",
+         scope="file"):
     """Register a rule. `applies(ctx)` scopes it (e.g. Pallas rules only
     look at kernel files); corpus files always pass the scope check so the
     self-test corpus exercises every family regardless of layout.
     `phase="post"` rules run after every scan rule on the file and may
-    read `ctx.used_suppressions` (GL117's staleness oracle)."""
+    read `ctx.used_suppressions` (GL117's staleness oracle).
+    `scope="project"` declares that findings (and the suppressions they
+    consume, via `Finding.extra_sites`) can span files."""
 
     def deco(fn):
         RULES[code] = Rule(code=code, name=name, family=family,
                            doc=(fn.__doc__ or "").strip(), fn=fn,
-                           applies=applies, phase=phase)
+                           applies=applies, phase=phase, scope=scope)
         return fn
 
     return deco
@@ -122,6 +133,7 @@ class FileContext:
         self.in_corpus = in_corpus
         self.tree = ast.parse(source, filename=self.path)
         self.project = None            # ProjectIndex, set by the runner
+        self.scan_scoped = False       # True when phase 2 is a subset
         self.used_suppressions = set()  # (line, code) consumed by findings
         self._parents = {}
         self._all_nodes = []
@@ -186,9 +198,10 @@ class FileContext:
             cur = self.parent(cur)
         return out
 
-    def finding(self, code, node, message):
+    def finding(self, code, node, message, extra_sites=()):
         return Finding(code=code, path=self.path, line=node.lineno,
-                       col=node.col_offset, message=message)
+                       col=node.col_offset, message=message,
+                       extra_sites=tuple(extra_sites))
 
     def suppression_hits(self, finding, node=None):
         """The (line, code) suppression entries this finding consumes;
@@ -266,24 +279,57 @@ def relpath(f):
         return f.as_posix()
 
 
-def _lint_ctx(ctx):
+def _consume_suppression(ctx, index, f, node):
+    """True when `f` is suppressed — by a comment on its own statement
+    span, or (project-scope findings) at any of its `extra_sites`, which
+    may live in ANOTHER file. Consumption is recorded in the ledger of
+    the file holding the comment, so GL117 judges every comment against
+    the whole run, not one file's slice."""
+    hits = ctx.suppression_hits(f, node)
+    if hits:
+        ctx.used_suppressions.update(hits)
+        return True
+    for site in f.extra_sites:
+        p, ln = site
+        octx = ctx if p == ctx.path else (
+            index.files.get(p) if index is not None else None)
+        if octx is None:
+            continue
+        present = octx.line_suppress.get(ln, set())
+        for code in (f.code, "all"):
+            if code in present:
+                octx.used_suppressions.add((ln, code))
+                return True
+        for code in (f.code, "all"):
+            if code in octx.file_suppress:
+                octx.used_suppressions.add((0, code))
+                return True
+    return False
+
+
+def _run_rules(ctx, index, phase):
+    """One rule phase over one already-parsed file. Returns
+    (findings, suppressed)."""
+    findings, suppressed = [], []
+    for r in RULES.values():
+        if r.phase != phase or not r.applies(ctx):
+            continue
+        for item in r.fn(ctx):
+            f, node = item if isinstance(item, tuple) else (item, None)
+            if _consume_suppression(ctx, index, f, node):
+                suppressed.append(f)
+            else:
+                findings.append(f)
+    return findings, suppressed
+
+
+def _lint_ctx(ctx, index=None):
     """Phase 2 for one already-parsed file: scan rules first (recording
     which suppressions their findings consume), then post rules (GL117
     reads the consumption ledger). Returns (findings, suppressed)."""
-    findings, suppressed = [], []
-    for phase in ("scan", "post"):
-        for r in RULES.values():
-            if r.phase != phase or not r.applies(ctx):
-                continue
-            for item in r.fn(ctx):
-                f, node = item if isinstance(item, tuple) else (item, None)
-                hits = ctx.suppression_hits(f, node)
-                if hits:
-                    ctx.used_suppressions.update(hits)
-                    suppressed.append(f)
-                else:
-                    findings.append(f)
-    return findings, suppressed
+    f1, s1 = _run_rules(ctx, index, "scan")
+    f2, s2 = _run_rules(ctx, index, "post")
+    return f1 + f2, s1 + s2
 
 
 def lint_file(path, in_corpus=False):
@@ -295,7 +341,7 @@ def lint_file(path, in_corpus=False):
     source = Path(path).read_text()
     ctx = FileContext(relpath(path), source, in_corpus=in_corpus)
     ctx.project = ProjectIndex([ctx])
-    findings, suppressed = _lint_ctx(ctx)
+    findings, suppressed = _lint_ctx(ctx, ctx.project)
     return findings, len(suppressed)
 
 
@@ -351,13 +397,25 @@ def run(paths, baseline_path=DEFAULT_BASELINE, use_baseline=True,
     if rule_paths is not None:
         only = {relpath(p) for p in rule_paths}
     t1 = time.perf_counter()
+    scanned = []
     for ctx in ctxs:
         if only is not None and ctx.path not in only:
             continue
         ctx.project = index
-        findings, suppressed = _lint_ctx(ctx)
-        res.suppressed_findings.extend(suppressed)
-        for fd in findings:
+        ctx.scan_scoped = only is not None
+        scanned.append(ctx)
+    # ALL scan rules run before ANY post rule: a project-scope finding
+    # scanned out of file A may consume a suppression comment in file
+    # B, and B's GL117 pass must see that consumption (running post
+    # per-file interleaved would judge B's ledger before A wrote to it)
+    results = {}
+    for ctx in scanned:
+        results[ctx.path] = _run_rules(ctx, index, "scan")
+    for ctx in scanned:
+        findings, suppressed = results[ctx.path]
+        f2, s2 = _run_rules(ctx, index, "post")
+        res.suppressed_findings.extend(suppressed + s2)
+        for fd in findings + f2:
             (res.baselined if fd.baseline_key() in baseline
              else res.new).append(fd)
     res.phase2_s = time.perf_counter() - t1

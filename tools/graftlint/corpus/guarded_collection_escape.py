@@ -1,0 +1,80 @@
+# graftlint-corpus-expect: GL123 GL123
+"""Known-bad corpus: guarded-collection escape (GL123).
+
+A collection every mutation site guards with the same lock, then
+iterated / `len()`'d OUTSIDE the lock from a different execution
+context: iteration observes the container across many bytecodes, so a
+concurrent append lands mid-walk ("list changed size during
+iteration", torn snapshots).
+
+Clean tripwires: the snapshot-under-lock-then-iterate idiom (the read
+happens INSIDE the guard; walking the private snapshot after is
+fine), and a single-context class (no concurrency, nothing to
+escape).
+"""
+import threading
+
+
+class EventLog:
+    """Bad: `_append_one` (thread context) appends under `_lock`; the
+    readers below walk the live list from the caller's thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []
+        self._thread = threading.Thread(target=self._append_one,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _append_one(self):
+        with self._lock:
+            self._events.append("tick")
+
+    def dump(self):
+        return [e for e in self._events]       # expect GL123: live iteration
+
+    def size(self):
+        return len(self._events)               # expect GL123: live len()
+
+    def probe(self):
+        # approximate size is fine for telemetry — documented exception
+        return len(self._events)  # graftlint: disable=GL123 - corpus demo: len() is atomic enough for a gauge
+
+
+class SafeLog:
+    """Clean: snapshot under the lock, iterate the snapshot."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []
+        self._thread = threading.Thread(target=self._append_one,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _append_one(self):
+        with self._lock:
+            self._events.append("tick")
+
+    def dump(self):
+        with self._lock:
+            snap = list(self._events)          # read INSIDE the guard
+        return [e for e in snap]
+
+
+class LocalBatch:
+    """Clean: every access runs from the same (main) context."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+
+    def add(self, row):
+        with self._lock:
+            self._rows.append(row)
+
+    def flush(self):
+        return list(self._rows)

@@ -1,0 +1,99 @@
+# graftlint-corpus-expect: GL122 GL122
+"""Known-bad corpus: lock-order cycles (GL122).
+
+The two-lock shape: one path nests `g_sched -> g_stats`, another nests
+`g_stats -> g_sched` — two threads entering from opposite ends
+deadlock, each holding what the other needs. The pair flags ONCE,
+anchored at the earlier acquisition chain, with the other chain in
+the finding's extra sites. The one-lock shape: a plain Lock
+re-acquired through a helper CALLED with the lock already held (the
+entry-lock propagation) — the second acquire blocks forever.
+
+Clean tripwires: RLock re-entry through a helper (reentrancy is the
+DESIGN), and two locks always nested in the same order. The
+suppressed pair at the bottom pins extra-site consumption: the
+reasoned comment sits on the SECOND chain, not the anchor, and still
+quiets the finding.
+"""
+import threading
+
+g_sched = threading.Lock()
+g_stats = threading.Lock()
+
+
+def publish():
+    with g_sched:
+        with g_stats:                  # expect GL122: opposite of scrape()
+            pass
+
+
+def scrape():
+    with g_stats:
+        with g_sched:                  # the other half of the cycle
+            pass
+
+
+# -- one-lock cycle: plain Lock re-acquired via a helper ---------------------
+
+g_reg = threading.Lock()
+
+
+def register(name):
+    with g_reg:
+        _reindex(name)                 # helper runs WITH g_reg held
+
+
+def _reindex(name):
+    with g_reg:                        # expect GL122: re-acquire, blocks forever
+        return name
+
+
+# -- clean: RLock re-entry is reentrant-by-construction ----------------------
+
+g_trace = threading.RLock()
+
+
+def trace(msg):
+    with g_trace:
+        _emit(msg)
+
+
+def _emit(msg):
+    with g_trace:                      # clean: RLock, re-entry is the design
+        return msg
+
+
+# -- clean: consistent nesting order everywhere ------------------------------
+
+g_io = threading.Lock()
+g_fmt = threading.Lock()
+
+
+def render():
+    with g_io:
+        with g_fmt:                    # clean: io -> fmt, same as flush()
+            pass
+
+
+def flush():
+    with g_io:
+        with g_fmt:
+            pass
+
+
+# -- suppressed pair: the reason rides on the SECOND chain -------------------
+
+g_pool = threading.Lock()
+g_meta = threading.Lock()
+
+
+def grow():
+    with g_pool:
+        with g_meta:                   # anchor chain of the suppressed pair
+            pass
+
+
+def shrink():
+    with g_meta:
+        with g_pool:  # graftlint: disable=GL122 - corpus demo: shrink() runs only before the pool threads start
+            pass

@@ -1,0 +1,103 @@
+# graftlint-corpus-expect: GL121 GL121
+"""Known-bad corpus: inconsistent-guard data race (GL121).
+
+Reconstructs the stepper hazard the tree scan caught: `error` is
+written by the step thread under `_cond`, but the `running` property
+read it lock-free from the caller's thread — a poller could observe
+the liveness flip before the error landed (the fix reads under the
+same lock).
+
+Clean tripwires pin the false-positive walls: a class whose accesses
+all run in ONE execution context never flags (no concurrency), a
+deliberately lock-free class infers no guard (nothing to enforce),
+writes in `__init__` are exempt (they happen before any thread can
+see the object), and an ALIAS of the guard (`l = self._lock; with
+l:`) resolves to the same identity — pooled lock-name coloring would
+not know that.
+"""
+import threading
+
+
+class TelemetrySink:
+    """Bad: `_drain` (thread context) writes under `_lock`; the
+    readers below run from the caller's thread with no lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.error = None           # __init__ write: exempt, pre-publication
+        self.total = 0
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _drain(self):
+        with self._lock:
+            self.total = self.total + 1
+            self.error = RuntimeError("drain failed")
+
+    def healthy(self):
+        return self.error is None                  # expect GL121: lock-free read
+
+    def count(self):
+        return self.total                          # expect GL121: lock-free read
+
+    def snapshot(self):
+        # clean: the alias resolves to the SAME lock identity
+        l = self._lock
+        with l:
+            return (self.error, self.total)
+
+    def probe(self):
+        # a deliberate, documented lock-free read stays quiet WITH a reason
+        return self.total  # graftlint: disable=GL121 - corpus demo: monotonic int, torn reads impossible on CPython
+
+
+class SingleThreadStats:
+    """Clean: every access runs from the same (main) context — mixed
+    locking discipline without concurrency is style, not a race."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    def bump(self):
+        with self._lock:
+            self.hits = self.hits + 1
+
+    def read(self):
+        return self.hits
+
+
+class LockFreeCursor:
+    """Clean: no write site holds any lock, so no guard is inferred —
+    the documented single-driver engines stay quiet."""
+
+    def __init__(self):
+        self._pos = 0
+        self._thread = threading.Thread(target=self._advance, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _advance(self):
+        self._pos = self._pos + 1
+
+    def tell(self):
+        return self._pos
+
+
+class Prefetcher:
+    """Clean: `depth` is written only in __init__, BEFORE the worker
+    thread starts — publication-by-construction, not a race."""
+
+    def __init__(self, depth):
+        self._lock = threading.Lock()
+        self.depth = depth
+        self._thread = threading.Thread(target=self._fill, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _fill(self):
+        return self.depth

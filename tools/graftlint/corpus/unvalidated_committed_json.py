@@ -1,0 +1,62 @@
+# graftlint-corpus-expect: GL124 GL124
+"""Known-bad corpus: unvalidated committed-JSON loads (GL124).
+
+The gate-tool hazard the tree scan caught twice: `json.load` a
+committed baseline/cache/trace artifact, then subscript it bare — a
+hand-edited or stale-schema file turns into a naked KeyError at gate
+time instead of a diagnosis naming the file.
+
+Clean shapes pin the degrade paths the rule honors (the
+`load_serve_cache` validate-or-return-None contract): `.get()` with a
+default, a membership check before indexing, `isinstance` validation
+of the structure, and a try/except around the load.
+"""
+import json
+
+
+def read_budget_bad():
+    with open("tools/budget_baseline.json") as f:
+        data = json.load(f)
+    return data["phase2_s"]                 # expect GL124: no schema check
+
+
+def read_manifest_bad():
+    raw = json.load(open("cache/serve_manifest.json"))
+    return raw["programs"]                  # expect GL124: no degrade path
+
+
+def read_budget_get():
+    with open("tools/budget_baseline.json") as f:
+        data = json.load(f)
+    return data.get("phase2_s", 0.0)        # clean: .get with a default
+
+
+def read_budget_checked():
+    with open("tools/budget_baseline.json") as f:
+        data = json.load(f)
+    if "phase2_s" not in data:
+        raise SystemExit("budget_baseline.json: missing phase2_s")
+    return data["phase2_s"]                 # clean: membership-checked
+
+
+def read_budget_validated():
+    with open("tools/budget_baseline.json") as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        return None
+    return data["phase2_s"]                 # clean: isinstance validation
+
+
+def read_budget_guarded_load():
+    try:
+        with open("tools/budget_baseline.json") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return data["phase2_s"]                 # clean: load inside try
+
+
+def read_fixture_known():
+    with open("tests/data/tiny_trace.json") as f:
+        data = json.load(f)
+    return data["traceEvents"]  # graftlint: disable=GL124 - corpus demo: fixture is written by the test itself

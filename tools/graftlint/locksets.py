@@ -1,0 +1,391 @@
+"""graftlint lockset analysis — the Eraser/RacerD-style layer over the
+phase-1 lock identities.
+
+Built lazily ONCE per :class:`~.project.ProjectIndex` (see
+``ProjectIndex.locksets()``) and shared by every lockset rule, this
+index records, per function:
+
+* **access sites** — every ``self.attr`` read/write (and reads/writes
+  of mutable module globals) with the lock identities held LEXICALLY at
+  that point, classified as plain read, plain write, collection
+  mutation (``.append``/``[k] =``/...), or escape-read (iteration,
+  ``len()``, ``.copy()``/``.items()``, membership);
+* **acquisitions** — every resolved ``with <lock>:`` with the locks
+  already held, the raw material for the lock-order digraph;
+* **entry locks** — a fixpoint over the call graph: a function called
+  while a lock is held runs WITH that lock, so its accesses and
+  acquisitions inherit it (``effective lockset = lexical ∪ entry``);
+* **execution contexts** — per-function sets over
+  {thread-entry, async-handler, serve-loop, main}, propagated
+  caller→callee (a thread entry keeps only its own context: its body
+  never runs on the caller's thread).
+
+Soundness posture: a ``with`` whose context expression LOOKS like a
+lock (pooled names) but resolves to no single identity pushes the
+``UNKNOWN`` sentinel — sites under it are excluded from both guard
+inference and flagging, and unknown heads contribute no order edges.
+Wrong-identity guessing is how lockset tools drown users; unknown is
+cheap and honest.
+
+stdlib ``ast`` only, like the rest of the linter.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .project import (ASYNC_HANDLER, SERVE_LOOP, THREAD_ENTRY,
+                      _module_name, lock_bindings)
+
+UNKNOWN = "?"
+
+# collection methods that mutate the receiver in place
+_MUTATORS = {"append", "add", "extend", "insert", "remove", "discard",
+             "pop", "popitem", "popleft", "appendleft", "clear",
+             "update", "setdefault"}
+# receiver methods that read the WHOLE collection (escape-reads when
+# called outside the guard)
+_SNAPSHOT_READS = {"copy", "items", "keys", "values"}
+# builtins whose argument is consumed wholesale
+_ITER_FNS = {"len", "list", "sorted", "tuple", "set", "dict", "sum",
+             "min", "max", "any", "all", "frozenset"}
+# module-scope ctors that bind a MUTABLE container (the module-global
+# shared-state index only tracks these — tracking every global name
+# would drown the analysis in constants and imports)
+_CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                    "OrderedDict", "Counter"}
+
+
+@dataclass
+class Access:
+    """One shared-state touch: (path, cls, attr) is the state key
+    (cls None == module global)."""
+    path: str
+    cls: str | None
+    attr: str
+    line: int
+    col: int
+    kind: str        # "read" | "write" | "mut" | "iter"
+    lexical: tuple   # lock identities held lexically (may hold UNKNOWN)
+    fn: object       # FunctionInfo
+    node: object
+
+
+@dataclass
+class Acquisition:
+    """One resolved `with <lock>:` — `lexical` is what was already
+    held (lexically) when this lock was taken."""
+    ident: str
+    path: str
+    line: int
+    lexical: tuple
+    fn: object
+
+
+class LocksetIndex:
+    def __init__(self, index):
+        self.index = index
+        self.accesses = []       # list[Access]
+        self.acquisitions = []   # list[Acquisition]
+        self._call_sites = []    # (caller FunctionInfo, callee qual,
+                                 #  lexical held, line)
+        self.entry = {}          # qualname -> {identity: provenance}
+        self.contexts = {}       # qualname -> frozenset(context strs)
+        self._groups_by_path = None
+        self._order_edges = None
+        for ctx in index.files.values():
+            self._scan_file(ctx)
+        self._propagate_entry()
+        self._propagate_contexts()
+
+    # -- query API ----------------------------------------------------------
+    def effective(self, access):
+        """lexical ∪ entry locks — the set actually held at the site."""
+        out = set(access.lexical)
+        out.update(self.entry.get(access.fn.qualname, ()))
+        return out
+
+    def tainted(self, access):
+        """True when an unresolved-but-lockish region covers the site:
+        the lockset is incomplete, so neither infer from nor flag it."""
+        return UNKNOWN in self.effective(access)
+
+    def context_of(self, fi):
+        return self.contexts.get(fi.qualname, frozenset(("main",)))
+
+    def groups_in(self, path):
+        """This file's shared-state groups, sorted: [((path, cls|None,
+        attr), [Access, ...]), ...]. Grouped ONCE for the whole index —
+        the per-file rules must not rebuild an O(all accesses) dict
+        per scanned file (that is O(files x accesses) over a tree
+        run)."""
+        if self._groups_by_path is None:
+            groups = {}
+            for a in self.accesses:
+                groups.setdefault((a.path, a.cls, a.attr), []).append(a)
+            by_path = {}
+            for key in sorted(groups,
+                              key=lambda k: (k[0], k[1] or "", k[2])):
+                by_path.setdefault(key[0], []).append(
+                    (key, groups[key]))
+            self._groups_by_path = by_path
+        return self._groups_by_path.get(path, ())
+
+    def order_edges(self):
+        """{(held, acquired): (path, line, description)} — one witness
+        per ordered identity pair, entry locks included as heads.
+        Computed once and cached (GL122 queries it per scanned file)."""
+        if self._order_edges is not None:
+            return self._order_edges
+        edges = {}
+        for acq in self.acquisitions:
+            ent = self.entry.get(acq.fn.qualname, {})
+            heads = list(dict.fromkeys(acq.lexical)) \
+                + [i for i in ent if i not in acq.lexical]
+            for h in heads:
+                if UNKNOWN in (h, acq.ident):
+                    continue
+                key = (h, acq.ident)
+                if key in edges:
+                    continue
+                locks = self.index.locks
+                ha = locks[h].short if h in locks else h
+                hb = locks[acq.ident].short if acq.ident in locks \
+                    else acq.ident
+                via = "" if h in acq.lexical else \
+                    f" (entered holding it via {ent[h]})"
+                edges[key] = (
+                    acq.path, acq.line,
+                    f"`{acq.fn.shortname}` takes `{hb}` while holding "
+                    f"`{ha}`{via}")
+        self._order_edges = edges
+        return edges
+
+    # -- collection ---------------------------------------------------------
+    def _module_globals(self, ctx):
+        """Module-scope names bound to mutable containers, plus names
+        any function declares `global` — the module-global half of the
+        shared-state index."""
+        out = set()
+
+        def scan(body):
+            for st in body:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue
+                if isinstance(st, ast.Assign):
+                    v = st.value
+                    is_container = isinstance(
+                        v, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                            ast.ListComp, ast.SetComp))
+                    if isinstance(v, ast.Call):
+                        f = v.func
+                        name = f.attr if isinstance(f, ast.Attribute) \
+                            else (f.id if isinstance(f, ast.Name)
+                                  else None)
+                        is_container = name in _CONTAINER_CTORS
+                    if is_container:
+                        for t in st.targets:
+                            if isinstance(t, ast.Name):
+                                out.add(t.id)
+                for sub in (getattr(st, "body", None),
+                            getattr(st, "orelse", None),
+                            getattr(st, "finalbody", None)):
+                    if isinstance(sub, list):
+                        scan(sub)
+                for h in getattr(st, "handlers", []) or []:
+                    scan(h.body)
+
+        scan(ctx.tree.body)
+        for node in ctx.walk():
+            if isinstance(node, ast.Global):
+                out.update(node.names)
+        return out
+
+    def _scan_file(self, ctx):
+        index = self.index
+        facts = index.modules.get(_module_name(ctx.path))
+        names, attrs = lock_bindings(ctx,
+                                     extra_attrs=index.lock_attr_names)
+        mod_globals = self._module_globals(ctx)
+
+        def lockish(e):
+            return (isinstance(e, ast.Name) and e.id in names) or \
+                   (isinstance(e, ast.Attribute) and e.attr in attrs)
+
+        for fi in index.functions_in(ctx.path):
+            aliases = {}
+            # names this function binds locally WITHOUT a `global`
+            # declaration shadow same-named module globals
+            declared_global = {n for node in ast.walk(fi.node)
+                               if isinstance(node, ast.Global)
+                               for n in node.names}
+            locals_ = {a.arg for a in fi.node.args.args}
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Store) \
+                        and node.id not in declared_global:
+                    locals_.add(node.id)
+
+            def visit(node, held, fi=fi, aliases=aliases,
+                      declared_global=declared_global, locals_=locals_):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    return          # separate scope: its own fi
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    cur = held
+                    for item in node.items:
+                        visit(item.context_expr, cur)
+                        ident = index.resolve_lock(
+                            ctx, fi, item.context_expr, aliases)
+                        if ident is not None:
+                            self.acquisitions.append(Acquisition(
+                                ident, ctx.path, node.lineno, cur, fi))
+                            cur = cur + (ident,)
+                        elif lockish(item.context_expr):
+                            cur = cur + (UNKNOWN,)
+                    for st in node.body:
+                        visit(st, cur)
+                    return
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    ident = index.resolve_lock(ctx, fi, node.value,
+                                               aliases)
+                    if ident is not None:
+                        aliases[node.targets[0].id] = ident
+                self._record(ctx, facts, fi, node, held,
+                             mod_globals, declared_global, locals_)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held)
+
+            for st in fi.node.body:
+                visit(st, ())
+
+    def _record(self, ctx, facts, fi, node, held, mod_globals,
+                declared_global, locals_):
+        index = self.index
+        if isinstance(node, ast.Call):
+            f = node.func
+            target = None
+            if isinstance(f, ast.Name):
+                target = index._resolve_bare(facts, fi, f.id)
+            elif isinstance(f, ast.Attribute):
+                target = index._resolve_ref(facts, fi, f)
+            if target is not None:
+                self._call_sites.append((fi, target, held, node.lineno))
+            return
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" and fi.cls is not None:
+            # the lock objects themselves are not shared STATE
+            if node.attr in index.lock_attr_names:
+                return
+            kind = self._classify(ctx, node)
+            self.accesses.append(Access(
+                ctx.path, fi.cls, node.attr, node.lineno,
+                node.col_offset, kind, held, fi, node))
+            return
+        if isinstance(node, ast.Name) and node.id in mod_globals:
+            # a local binding of the same name shadows the global
+            if node.id in locals_ and node.id not in declared_global:
+                return
+            if (ctx.path, node.id) in index._global_locks:
+                return
+            kind = self._classify(ctx, node)
+            self.accesses.append(Access(
+                ctx.path, None, node.id, node.lineno, node.col_offset,
+                kind, held, fi, node))
+
+    def _classify(self, ctx, node):
+        """read / write / mut / iter for one reference site."""
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            return "write"
+        p = ctx.parent(node)
+        if isinstance(p, ast.Attribute):
+            gp = ctx.parent(p)
+            if isinstance(gp, ast.Call) and gp.func is p:
+                if p.attr in _MUTATORS:
+                    return "mut"
+                if p.attr in _SNAPSHOT_READS:
+                    return "iter"
+            return "read"
+        if isinstance(p, ast.Subscript) and p.value is node:
+            if isinstance(p.ctx, (ast.Store, ast.Del)):
+                return "mut"
+            return "read"
+        if isinstance(p, ast.For) and p.iter is node:
+            return "iter"
+        if isinstance(p, ast.comprehension) and p.iter is node:
+            return "iter"
+        if isinstance(p, ast.Call) and node in p.args \
+                and isinstance(p.func, ast.Name) \
+                and p.func.id in _ITER_FNS:
+            return "iter"
+        if isinstance(p, ast.Compare) and node in p.comparators \
+                and any(isinstance(op, (ast.In, ast.NotIn))
+                        for op in p.ops):
+            return "iter"
+        return "read"
+
+    # -- propagation --------------------------------------------------------
+    def _propagate_entry(self):
+        """entry[callee] ⊇ lexical-at-call-site ∪ entry[caller]: a
+        function called under a lock RUNS under it, transitively."""
+        entry = {}
+        changed = True
+        while changed:
+            changed = False
+            for caller, callee, lexical, line in self._call_sites:
+                if callee not in self.index.functions:
+                    continue
+                src = dict.fromkeys(lexical)
+                src.update(entry.get(caller.qualname, {}))
+                if not src:
+                    continue
+                tgt = entry.setdefault(callee, {})
+                for ident in src:
+                    if ident not in tgt:
+                        tgt[ident] = f"{caller.path}:{line}"
+                        changed = True
+        self.entry = entry
+
+    def _propagate_contexts(self):
+        """Execution-context sets over the full call graph. Base: a
+        thread target runs (only) on its thread; an async def on the
+        event loop; an uncalled serve-shaped loop on its driver. A
+        function nobody in-graph calls runs from "main" (the CLI/test
+        path); everything else unions its callers' contexts."""
+        index = self.index
+        callers = {}
+        for caller, callees in index.edges.items():
+            for callee in callees:
+                callers.setdefault(callee, set()).add(caller)
+        ctxs = {}
+        for q, fi in index.functions.items():
+            s = set()
+            if THREAD_ENTRY in fi.colors:
+                s.add("thread-entry")
+            if ASYNC_HANDLER in fi.colors:
+                s.add("async-handler")
+            if SERVE_LOOP in fi.colors and not callers.get(q):
+                s.add("serve-loop")
+            if not s and not callers.get(q):
+                s.add("main")
+            ctxs[q] = s
+        changed = True
+        while changed:
+            changed = False
+            for q, fi in index.functions.items():
+                if THREAD_ENTRY in fi.colors:
+                    continue        # its body never runs on a caller
+                got = ctxs[q]
+                before = len(got)
+                for c in callers.get(q, ()):
+                    got |= ctxs[c]
+                if len(got) != before:
+                    changed = True
+        for q, s in ctxs.items():
+            if not s:
+                s.add("main")
+        self.contexts = {q: frozenset(s) for q, s in ctxs.items()}

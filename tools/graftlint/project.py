@@ -46,6 +46,16 @@ Each derived color carries a human-readable provenance (``via``) so a
 finding can say HOW the context reaches the flagged line — the
 difference between a lint message and a call-stack explanation.
 
+Alongside the pooled coloring, the index records **per-object lock
+identity** (:class:`LockInfo`): module-global locks as
+``<path>::name``, class-attr locks as ``<path>::Class.attr`` —
+resolved through local aliases, from-imports, and the enclosing class
+by :meth:`ProjectIndex.resolve_lock`. The pooled names answer "is a
+lock held"; identity answers "is the RIGHT lock held" — the
+:mod:`locksets` analyses (data races GL121, lock-order cycles GL122,
+guarded-collection escapes GL123) are built on it via
+:meth:`ProjectIndex.locksets`.
+
 Single-file lints (the selftest corpus, the introduced-snippet gate)
 build a one-file index: intra-file interprocedural reasoning still
 works, cross-file edges simply don't exist.
@@ -125,6 +135,26 @@ _SERVE_SHAPE = re.compile(
 # RELEASES the lock, which is why wait() is not in any blocking set)
 _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
                "BoundedSemaphore"}
+
+
+@dataclass(frozen=True)
+class LockInfo:
+    """One lock OBJECT the project constructs — the unit of identity
+    the lockset analyses reason about. Pooled attr-name coloring
+    (``lock_attr_names``) can prove "a lock is held"; identity can
+    prove "the *wrong* lock is held" and "these two locks nest in
+    opposite orders"."""
+    identity: str          # "<relpath>::name" | "<relpath>::Class.attr"
+    kind: str              # Lock | RLock | Condition | Semaphore | ...
+    path: str
+    line: int
+    cls: str | None = None
+    attr: str | None = None
+
+    @property
+    def short(self):
+        """Human spelling for findings: `Class.attr` / `name`."""
+        return self.identity.split("::", 1)[1]
 
 
 @dataclass
@@ -247,6 +277,15 @@ class ProjectIndex:
         for ctx in ctxs:
             names, attrs = lock_bindings(ctx)
             self.lock_attr_names |= attrs
+        # per-object lock identity (the lockset analyses' unit): module
+        # globals as "<path>::name", class attrs as "<path>::Cls.attr"
+        self.locks = {}          # identity -> LockInfo
+        self._global_locks = {}  # (path, name) -> identity
+        self._attr_locks = {}    # (path, cls, attr) -> identity
+        self._locks_by_attr = {}  # attr name -> set(identity)
+        for ctx in ctxs:
+            self._collect_lock_identities(ctx)
+        self._locksets = None    # lazy LocksetIndex (built on demand)
         self._thread_entries = {}      # qualname -> provenance str
         self._lock_seeds = {}          # qualname -> provenance str
         self._sync_called = set()      # qualnames called at import time
@@ -272,6 +311,145 @@ class ProjectIndex:
         if ctx is None:
             return []
         return [fi for fi in self.functions.values() if fi.path == path]
+
+    def locksets(self):
+        """The Eraser/RacerD-style lockset index (access sites with
+        held-lock sets, lock-order acquisitions, execution-context
+        sets), built lazily ONCE per ProjectIndex and shared by every
+        lockset rule."""
+        if self._locksets is None:
+            from .locksets import LocksetIndex
+            self._locksets = LocksetIndex(self)
+        return self._locksets
+
+    # -- lock identity ------------------------------------------------------
+    def _collect_lock_identities(self, ctx):
+        def record(identity, ctor, node, cls=None, attr=None):
+            if identity not in self.locks:
+                self.locks[identity] = LockInfo(
+                    identity=identity, kind=ctor, path=ctx.path,
+                    line=node.lineno, cls=cls, attr=attr)
+
+        def ctor_of(value):
+            if not isinstance(value, ast.Call):
+                return None
+            f = value.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            return name if name in _LOCK_CTORS else None
+
+        def scan_module(body):
+            """Module-scope Assigns (descending through if/try, like
+            the def index) bind module-global lock identities."""
+            for st in body:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue
+                if isinstance(st, ast.Assign):
+                    ctor = ctor_of(st.value)
+                    if ctor:
+                        for t in st.targets:
+                            if isinstance(t, ast.Name):
+                                ident = f"{ctx.path}::{t.id}"
+                                record(ident, ctor, st)
+                                self._global_locks[(ctx.path, t.id)] = \
+                                    ident
+                for sub in (getattr(st, "body", None),
+                            getattr(st, "orelse", None),
+                            getattr(st, "finalbody", None)):
+                    if isinstance(sub, list):
+                        scan_module(sub)
+                for h in getattr(st, "handlers", []) or []:
+                    scan_module(h.body)
+
+        scan_module(ctx.tree.body)
+
+        def bind_class_attr(cls_name, attr, ctor, node):
+            ident = f"{ctx.path}::{cls_name}.{attr}"
+            record(ident, ctor, node, cls=cls_name, attr=attr)
+            self._attr_locks[(ctx.path, cls_name, attr)] = ident
+            self._locks_by_attr.setdefault(attr, set()).add(ident)
+
+        for node in ctx.walk():
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for st in node.body:
+                # class-body `_lock = Lock()` is a class attribute the
+                # instances share; it reads as `self._lock` too
+                if isinstance(st, ast.Assign):
+                    ctor = ctor_of(st.value)
+                    if ctor:
+                        for t in st.targets:
+                            if isinstance(t, ast.Name):
+                                bind_class_attr(node.name, t.id, ctor, st)
+                if not isinstance(st, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                for sub in ast.walk(st):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    ctor = ctor_of(sub.value)
+                    if not ctor:
+                        continue
+                    for t in sub.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            bind_class_attr(node.name, t.attr, ctor, sub)
+
+    def resolve_lock(self, ctx, fi, expr, aliases=None):
+        """Per-object identity for a lock REFERENCE, or None when the
+        object cannot be pinned. Resolution order: local alias (`l =
+        self._lock; with l:` — same identity), this file's module
+        globals, from-imported globals, `self.attr` through the
+        enclosing class, `alias.g_lock` through import bindings, and
+        finally an attr name exactly ONE class in the project binds.
+        Ambiguity returns None — the lockset analyses treat an
+        unresolved-but-lockish region as unknown rather than guessing."""
+        if isinstance(expr, ast.Name):
+            if aliases and expr.id in aliases:
+                return aliases[expr.id]
+            ident = self._global_locks.get((ctx.path, expr.id))
+            if ident is not None:
+                return ident
+            facts = self.modules.get(_module_name(ctx.path))
+            if facts is not None:
+                imp = facts.from_imports.get(expr.id)
+                if imp is not None:
+                    mod, orig = imp
+                    target = self.modules.get(mod)
+                    if target is not None:
+                        return self._global_locks.get(
+                            (target.path, orig))
+            return None
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            if isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self" \
+                    and fi is not None and fi.cls is not None:
+                ident = self._attr_locks.get((ctx.path, fi.cls, attr))
+                if ident is not None:
+                    return ident
+            chain = _attr_chain(expr)
+            if chain and "." in chain:
+                mod_part, _, lname = chain.rpartition(".")
+                facts = self.modules.get(_module_name(ctx.path))
+                if facts is not None:
+                    root, _, rest = mod_part.partition(".")
+                    if root in facts.aliases:
+                        dotted = facts.aliases[root] \
+                            + (("." + rest) if rest else "")
+                        target = self.modules.get(dotted)
+                        if target is not None:
+                            ident = self._global_locks.get(
+                                (target.path, lname))
+                            if ident is not None:
+                                return ident
+            idents = self._locks_by_attr.get(attr, ())
+            if len(idents) == 1:
+                return next(iter(idents))
+            return None
+        return None
 
     # -- phase 1a: defs / classes / imports ---------------------------------
     def _collect_defs(self, ctx):

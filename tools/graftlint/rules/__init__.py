@@ -10,6 +10,10 @@ Codes are grouped by family:
                              context, locks held across blocking ops or
                              compiled dispatch, fire-and-forget tasks,
                              stale suppressions)
+  GL121+ locksets           (per-object lock identity: inconsistent-
+                             guard data races, lock-order cycles,
+                             guarded-collection escapes)
+  GL124  unvalidated-committed-json (hygiene family, tools/ included)
   GL2xx  shard_map hygiene  (partial-auto call shapes)
   GL3xx  Pallas bounds      (unclamped dynamic indexing, tile shapes)
   GL4xx  repo hygiene       (bare except, mutable defaults, import-time env)
@@ -21,3 +25,4 @@ from . import shard_map_hygiene  # noqa: F401
 from . import pallas_bounds   # noqa: F401
 from . import hygiene         # noqa: F401
 from . import concurrency     # noqa: F401
+from . import locksets        # noqa: F401

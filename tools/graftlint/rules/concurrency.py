@@ -509,6 +509,13 @@ def _judge_suppression(ctx, line, code, used, where):
             message=(f"{where} names unknown rule id `{code}`: nothing "
                      "can ever consume it — fix the id (see "
                      "--list-rules) or remove the comment"))
+    if ctx.scan_scoped and code in RULES \
+            and RULES[code].scope == "project":
+        # a project-scope finding (e.g. a GL122 cycle) anchored in an
+        # UNSCANNED file may be what consumes this suppression — a
+        # diff-scoped run has no way to know, so it must not cry stale
+        # over evidence it did not collect (the full-tree run judges)
+        return None
     if (line, code) not in used:
         label = "blanket `disable=all`" if code == "all" \
             else f"`disable={code}`"
@@ -523,7 +530,13 @@ def stale_suppression(ctx):
     """A `# graftlint: disable=` comment no finding consumed, or naming
     an unknown rule id. Runs in the post phase: the scan rules have
     already recorded every (line, code) their suppressed findings
-    consumed into `ctx.used_suppressions`."""
+    consumed into `ctx.used_suppressions` — across the WHOLE scanned
+    set, since a project-scope finding in one file can consume a
+    suppression in another. In a diff-scoped run (--changed),
+    suppressions naming project-scope rules are not judged at all:
+    their consuming finding may be anchored in a file the scoped run
+    never scanned, and a false "stale" here would have the developer
+    delete a suppression the full-tree gate still needs."""
     used = ctx.used_suppressions
     for line in sorted(ctx.line_suppress):
         for code in sorted(ctx.line_suppress[line]):

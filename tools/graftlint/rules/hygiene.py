@@ -10,10 +10,21 @@ configuration before the launcher/test-harness can set it (this repo's
 conftest must reconfigure XLA *before* the first jax import precisely
 because of this class of bug); read env inside the function that needs
 it, or through utils/flags.
+
+GL124 unvalidated-committed-json (tools/ included — the gate scripts
+are where the hazard lives): `json.load` of a committed baseline/cache
+file followed by bare subscripting with no schema check and no degrade
+path. A hand-edited or stale-schema file then crashes the GATE with a
+KeyError instead of a diagnosis. The clean shape is the
+`load_serve_cache` contract: validate schema + structure, return
+None/default, caller degrades — `.get()` with a default, a membership
+check, `isinstance` validation, or a try/except around the load all
+count as a degrade path.
 """
 import ast
 
 from ..core import rule, in_paddle_tpu
+from ..project import _attr_chain
 
 
 @rule("GL401", "bare-except", "hygiene", applies=in_paddle_tpu)
@@ -73,6 +84,109 @@ def env_read_at_import(ctx):
                         "utils/flags)"), st
 
     yield from scan(ctx.tree.body)
+
+
+def _tools_or_pkg(ctx):
+    """GL124's beat: the gate tools and the package — NOT tests, whose
+    loads assert on fixtures they themselves wrote."""
+    if ctx.path.startswith("tests/"):
+        return False
+    return ctx.path.startswith(("tools/", "paddle_tpu/")) \
+        or ctx.in_corpus
+
+
+def _function_scopes(ctx):
+    """(scope label, nodes) per function (own lexical scope) plus the
+    module body — the unit the guard heuristic judges over."""
+    from ..project import own_scope_walk
+    for node in ctx.walk():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, list(own_scope_walk(node))
+    module_nodes = []
+    for st in ctx.tree.body:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue    # _walk_outside_defs prunes defs met as
+        module_nodes.extend(_walk_outside_defs(st))  # children only
+    yield "<module>", module_nodes
+
+
+def _guard_evidence(nodes, loaded):
+    """Any degrade path in scope for the loaded names: `.get()` on the
+    payload, a membership test against it, isinstance validation, or
+    the load itself inside a try. Coarse by design — the rule hunts
+    loaders with NO safety net, not ones with a different net."""
+    for n in nodes:
+        if isinstance(n, ast.Attribute) and n.attr == "get" \
+                and isinstance(n.value, ast.Name) \
+                and n.value.id in loaded:
+            return True
+        if isinstance(n, ast.Compare) \
+                and any(isinstance(op, (ast.In, ast.NotIn))
+                        for op in n.ops) \
+                and any(isinstance(c, ast.Name) and c.id in loaded
+                        for c in n.comparators):
+            return True
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "isinstance" and n.args:
+            a = n.args[0]
+            if isinstance(a, ast.Name) and a.id in loaded:
+                return True
+            if isinstance(a, ast.Subscript) \
+                    and isinstance(a.value, ast.Name) \
+                    and a.value.id in loaded:
+                return True
+    return False
+
+
+@rule("GL124", "unvalidated-committed-json", "hygiene",
+      applies=_tools_or_pkg)
+def unvalidated_committed_json(ctx):
+    """`x = json.load(...)` of a committed .json artifact, then
+    `x["key"]` with no `.get`/membership/isinstance/try anywhere in the
+    scope: the gate dies with a KeyError the moment the file is
+    hand-edited or its schema drifts. Validate and degrade (the
+    `load_serve_cache` validate-or-return-None contract) or fail with
+    a diagnosis that names the file and the missing key."""
+    for label, nodes in _function_scopes(ctx):
+        loaded = set()
+        load_in_try = set()
+        json_const = any(
+            isinstance(n, ast.Constant) and isinstance(n.value, str)
+            and ".json" in n.value for n in nodes)
+        if not json_const:
+            continue        # not a committed-artifact loader
+        trys = [n for n in nodes if isinstance(n, ast.Try)
+                and n.handlers]
+        for n in nodes:
+            if not (isinstance(n, ast.Assign)
+                    and isinstance(n.value, ast.Call)
+                    and _attr_chain(n.value.func) == "json.load"):
+                continue
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    loaded.add(t.id)
+                    if any(n in ast.walk(tr) for tr in trys):
+                        load_in_try.add(t.id)
+        if not loaded or _guard_evidence(nodes, loaded):
+            continue
+        for n in nodes:
+            if isinstance(n, ast.Subscript) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id in loaded \
+                    and n.value.id not in load_in_try \
+                    and isinstance(n.slice, ast.Constant) \
+                    and isinstance(n.slice.value, str):
+                yield ctx.finding(
+                    "GL124", n,
+                    f"`{n.value.id}` comes straight from `json.load` "
+                    f"in `{label}` and `[{n.slice.value!r}]` has no "
+                    "schema check and no degrade path — a hand-edited "
+                    "or stale-schema committed file turns into a bare "
+                    "KeyError at gate time. Validate-or-degrade like "
+                    "`load_serve_cache` (check a schema key, "
+                    "isinstance the structure, return a default), or "
+                    "raise a diagnosis naming the file"), n
+                break       # one finding per loader scope is enough
 
 
 def _walk_outside_defs(node):

@@ -1,0 +1,282 @@
+"""Lockset rules (GL121-GL123) — Eraser/RacerD-style data-race and
+deadlock detection over per-object lock identity.
+
+The concurrency family (GL114-GL119) pattern-matches hazard SHAPES;
+this family reasons about lock OBJECTS. Phase 1 resolves every
+``threading.Lock/RLock/Condition/Semaphore`` the project constructs to
+an identity (module-global ``<path>::name``, class-attr
+``<path>::Class.attr`` — aliases and from-imports included), and the
+lockset index (project.locksets()) records every shared-state access
+with the identities actually held there, every nested acquisition, and
+per-function execution contexts.
+
+GL121 inconsistent-guard data race: an attribute (or mutable module
+global) touched from ≥2 execution contexts whose WRITE sites show a
+majority lock discipline — any access not holding that inferred guard
+is a race window, reported with both witness paths (the guarded write
+and the unguarded access, each with its context and lockset). A class
+with no lock discipline at all never flags (no guard to infer — the
+documented single-driver engines stay clean), and ``__init__`` is
+exempt (runs before any thread can see the object).
+
+GL122 lock-order cycle: nested ``with``-acquisitions plus transitive
+holds-lock calls build a lock-order digraph per identity; a cycle
+(A→B on one path, B→A on another) flags ONCE with both acquisition
+chains — the second chain rides in ``Finding.extra_sites`` so a
+suppression at either end quiets the pair. Re-acquiring a plain
+(non-reentrant) ``Lock`` on one path is the one-lock cycle and flags
+the same way; RLock/Condition re-entry does not.
+
+GL123 guarded-collection escape: a collection attribute mutated under
+a lock but iterated / ``len()``'d / copied outside that lock from a
+different execution context — iteration observes the container
+mid-mutation ("dictionary changed size during iteration", torn lists).
+The snapshot-under-lock-then-iterate idiom reads the collection INSIDE
+the guard and therefore never flags.
+"""
+import ast
+
+from ..core import in_paddle_tpu, rule
+from ..locksets import UNKNOWN
+
+
+def _short(idx, ident):
+    info = idx.locks.get(ident)
+    return info.short if info is not None else ident
+
+
+def _fmt_ctxs(ctxs):
+    return "/".join(sorted(ctxs))
+
+
+def _fmt_locks(idx, locks):
+    locks = sorted(l for l in locks if l != UNKNOWN)
+    if not locks:
+        return "no lock"
+    return "`" + "`, `".join(_short(idx, l) for l in locks) + "`"
+
+
+def _is_init(a):
+    return a.cls is not None and a.fn.name == "__init__" \
+        and a.fn.cls == a.cls
+
+
+def _majority_guard(ls, writes):
+    """The lock identity held at a strict majority of (untainted,
+    non-init) write sites, or None. None == no discipline to enforce:
+    a deliberately lock-free class infers no guard and never flags."""
+    counted = [w for w in writes if not ls.tainted(w)]
+    if not counted:
+        return None
+    tally = {}
+    for w in counted:
+        for ident in ls.effective(w):
+            if ident != UNKNOWN:
+                tally[ident] = tally.get(ident, 0) + 1
+    best = None
+    for ident, n in sorted(tally.items()):
+        if 2 * n > len(counted) and (best is None or n > best[1]):
+            best = (ident, n)
+    return best[0] if best else None
+
+
+def _label(a):
+    return f"`{a.cls}.{a.attr}`" if a.cls else f"module global `{a.attr}`"
+
+
+# -- GL121 -------------------------------------------------------------------
+
+@rule("GL121", "inconsistent-guard-data-race", "locksets",
+      applies=in_paddle_tpu)
+def inconsistent_guard_data_race(ctx):
+    """Shared state accessed from ≥2 execution contexts where the
+    write sites' majority lock discipline names a guard — flag every
+    access whose effective lockset (lexical + entry locks) misses it,
+    with the guarded write as the other witness path. Both halves of
+    the Eraser candidate-set idea, on real identities: pooled names
+    would call `with other._lock:` guarded."""
+    idx = ctx.project
+    if idx is None:
+        return
+    ls = idx.locksets()
+    for (path, cls, attr), accs in ls.groups_in(ctx.path):
+        live = [a for a in accs if not _is_init(a)]
+        if any(a.kind == "mut" for a in live):
+            continue        # collection discipline is GL123's beat
+        colors = set()
+        for a in live:
+            colors |= ls.context_of(a.fn)
+        if len(colors) < 2:
+            continue        # single-context state cannot race
+        writes = [a for a in live if a.kind == "write"]
+        guard = _majority_guard(ls, writes)
+        if guard is None:
+            continue
+        witness = next(a for a in writes
+                       if guard in ls.effective(a))
+        flagged = [a for a in live
+                   if not ls.tainted(a)
+                   and guard not in ls.effective(a)]
+        for a in sorted(flagged, key=lambda a: (a.line, a.col)):
+            yield ctx.finding(
+                "GL121", a.node,
+                f"{_label(a)} is guarded by `{_short(idx, guard)}` at "
+                f"its write sites (e.g. `{witness.fn.shortname}` "
+                f"{witness.path}:{witness.line}, context "
+                f"{_fmt_ctxs(ls.context_of(witness.fn))}) but this "
+                f"{a.kind} in `{a.fn.shortname}` (context "
+                f"{_fmt_ctxs(ls.context_of(a.fn))}) holds "
+                f"{_fmt_locks(idx, ls.effective(a))} — a data race "
+                "window: take the same lock here, or document the "
+                "deliberate lock-free access with a reasoned "
+                "suppression"), a.node
+
+
+# -- GL122 -------------------------------------------------------------------
+
+def _reaches(edges_by_src, start, goal):
+    """True when `goal` is reachable from `start` over the order
+    edges; returns the path as a list of identities (incl. both ends)
+    or None."""
+    seen = {start: None}
+    queue = [start]
+    while queue:
+        cur = queue.pop(0)
+        for nxt in edges_by_src.get(cur, ()):
+            if nxt in seen:
+                continue
+            seen[nxt] = cur
+            if nxt == goal:
+                path = [nxt]
+                while path[-1] is not None and path[-1] != start:
+                    path.append(seen[path[-1]])
+                return list(reversed(path))
+            queue.append(nxt)
+    return None
+
+
+@rule("GL122", "lock-order-cycle", "locksets", applies=in_paddle_tpu,
+      scope="project")
+def lock_order_cycle(ctx):
+    """A cycle in the lock-order digraph: identity A held while B is
+    acquired on one path, B (transitively) held while A is acquired on
+    another — two threads entering from opposite ends deadlock, each
+    holding what the other needs. Acquisition chains cross function
+    and file boundaries via entry-lock propagation, so the finding is
+    anchored at the earliest chain site and carries the other in
+    extra_sites (a suppression at either end quiets the pair). The
+    one-lock cycle — re-acquiring a plain Lock you already hold —
+    flags too; RLock/Condition are reentrant-by-construction."""
+    idx = ctx.project
+    if idx is None:
+        return
+    ls = idx.locksets()
+    edges = ls.order_edges()
+    edges_by_src = {}
+    for (a, b) in edges:
+        if a != b:
+            edges_by_src.setdefault(a, set()).add(b)
+
+    # one-lock cycles: self-edge on a non-reentrant kind
+    for (a, b), (path, line, desc) in sorted(edges.items()):
+        if a != b or path != ctx.path:
+            continue
+        info = idx.locks.get(a)
+        if info is None or info.kind != "Lock":
+            continue
+        node = ast.AST()
+        node.lineno, node.col_offset = line, 0
+        yield ctx.finding(
+            "GL122", node,
+            f"`{_short(idx, a)}` is a plain (non-reentrant) Lock and "
+            f"this path re-acquires it while already holding it — "
+            f"{desc}; the second acquire blocks forever on the first. "
+            "Use RLock only if re-entry is the DESIGN; otherwise "
+            "restructure so the inner call runs outside the region"
+        ), None
+
+    # two-or-more-lock cycles, one finding per unordered pair
+    reported = set()
+    for (a, b), (path, line, desc) in sorted(edges.items()):
+        if a == b:
+            continue
+        back = _reaches(edges_by_src, b, a)
+        if back is None:
+            continue
+        pair = frozenset((a, b))
+        if pair in reported:
+            continue
+        # the return chain's witness: the first hop out of b
+        hop = edges[(back[0], back[1])]
+        site1 = (path, line)
+        site2 = (hop[0], hop[1])
+        anchor, other = (site1, site2) if site1 <= site2 \
+            else (site2, site1)
+        if anchor[0] != ctx.path:
+            continue        # the file holding the anchor reports it
+        reported.add(pair)
+        d1, d2 = (desc, hop[2]) if anchor == site1 else (hop[2], desc)
+        chain = " -> ".join(f"`{_short(idx, i)}`" for i in back)
+        node = ast.AST()
+        node.lineno, node.col_offset = anchor[1], 0
+        yield ctx.finding(
+            "GL122", node,
+            f"lock-order cycle between `{_short(idx, a)}` and "
+            f"`{_short(idx, b)}`: {d1} ({site1[0]}:{site1[1]}), while "
+            f"{d2} ({site2[0]}:{site2[1]}"
+            + (f"; return chain {chain}" if len(back) > 2 else "")
+            + ") — two threads entering from opposite ends deadlock, "
+            "each holding what the other needs. Pick ONE order and "
+            "nest consistently (or drop to a single lock)",
+            extra_sites=(other,)), None
+
+
+# -- GL123 -------------------------------------------------------------------
+
+@rule("GL123", "guarded-collection-escape", "locksets",
+      applies=in_paddle_tpu)
+def guarded_collection_escape(ctx):
+    """A collection attribute every mutation site guards with the same
+    lock, iterated/len'd/copied OUTSIDE that lock from a different
+    execution context. Iteration is the sharpest reader: it observes
+    the container across many bytecodes, so a concurrent append lands
+    mid-walk ("dictionary changed size during iteration", torn
+    snapshots). The clean idiom — `with lock: snap = list(self.items)`
+    then iterate `snap` — reads INSIDE the guard and never flags."""
+    idx = ctx.project
+    if idx is None:
+        return
+    ls = idx.locksets()
+    for (path, cls, attr), accs in ls.groups_in(ctx.path):
+        live = [a for a in accs if not _is_init(a)]
+        muts = [a for a in live if a.kind == "mut"
+                and not ls.tainted(a)]
+        if not muts:
+            continue
+        common = set.intersection(*(ls.effective(m) for m in muts))
+        common.discard(UNKNOWN)
+        if not common:
+            continue        # not lock-disciplined: nothing to escape
+        guard = sorted(common)[0]
+        mut_colors = set()
+        for m in muts:
+            mut_colors |= ls.context_of(m.fn)
+        witness = muts[0]
+        for a in sorted((x for x in live if x.kind == "iter"),
+                        key=lambda x: (x.line, x.col)):
+            if ls.tainted(a) or guard in ls.effective(a):
+                continue
+            if len(mut_colors | ls.context_of(a.fn)) < 2:
+                continue    # single-threaded class: no concurrency
+            yield ctx.finding(
+                "GL123", a.node,
+                f"{_label(a)} is mutated under "
+                f"`{_short(idx, guard)}` (e.g. "
+                f"`{witness.fn.shortname}` {witness.path}:"
+                f"{witness.line}, context "
+                f"{_fmt_ctxs(mut_colors)}) but this iteration/"
+                f"snapshot in `{a.fn.shortname}` (context "
+                f"{_fmt_ctxs(ls.context_of(a.fn))}) runs outside it — "
+                "a concurrent mutation lands mid-walk. Snapshot under "
+                "the lock (`with lock: snap = list(...)`) and iterate "
+                "the snapshot"), a.node

@@ -27,7 +27,7 @@ _EXPECT_RE = re.compile(r"#\s*graftlint-corpus-expect:\s*(.+)")
 _CLAIM_RE = re.compile(r"#\s*graftlint-corpus-rule:\s*(.+)")
 
 FAMILIES = ("trace-safety", "mxu", "donation", "shard-map",
-            "pallas-bounds", "hygiene", "concurrency")
+            "pallas-bounds", "hygiene", "concurrency", "locksets")
 
 
 def corpus_expectations(path):

@@ -2,9 +2,12 @@
 # Static-analysis entry point: rule self-test corpus first (a lobotomized
 # rule must not green-light the tree scan; the selftest also fails any
 # ORPHANED corpus file no registered rule claims), then the full-tree
-# two-phase scan — its summary prints the per-phase timing split
-# (phase1 parse+index, phase2 rules) so a gate-cost regression is
-# attributable at a glance. Extra args pass through to the tree scan:
+# two-phase scan — all 30 rules incl. the lockset family (GL121-GL123
+# data-race/deadlock detection over per-object lock identity) and
+# GL124 committed-JSON hygiene run in this default pass. The summary
+# prints the per-phase timing split (phase1 parse+index, phase2 rules)
+# so a gate-cost regression is attributable at a glance. Extra args
+# pass through to the tree scan (e.g. --sarif for CI annotation):
 #   tools/lint.sh --show-baselined
 #   tools/lint.sh --write-baseline      # triage mode: regenerate baseline
 # Fast pre-commit loop (diff-scoped phase 2, full-tree phase 1):

@@ -42,7 +42,11 @@ def _module_device_ms(trace_dir):
                          recursive=True))[-1]
     with gzip.open(f) as fh:
         tr = json.load(fh)
-    ev = tr["traceEvents"]
+    ev = tr.get("traceEvents")
+    if not isinstance(ev, list):
+        raise SystemExit(
+            f"serve_bench: {f} has no traceEvents list — "
+            "profiler schema drift or truncated capture")
     tids = {e["tid"]: e["args"]["name"] for e in ev
             if e.get("ph") == "M" and e.get("name") == "thread_name"
             and e.get("pid") == 3}

@@ -49,7 +49,11 @@ def parse(trace_dir, iters):
                          recursive=True))[-1]
     with gzip.open(f) as fh:
         tr = json.load(fh)
-    ev = tr["traceEvents"]
+    ev = tr.get("traceEvents")
+    if not isinstance(ev, list):
+        raise SystemExit(
+            f"step_profile: {f} has no traceEvents list — "
+            "profiler schema drift or truncated capture")
     tids = {e["tid"]: e["args"]["name"] for e in ev
             if e.get("ph") == "M" and e.get("name") == "thread_name"
             and e.get("pid") == 3}
