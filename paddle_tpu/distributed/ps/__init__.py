@@ -276,7 +276,7 @@ class SparseTable:
                 key = int(key)
                 row = self.rows.get(key)
                 if row is None and self._admit(key):
-                    row = self._init()
+                    row = self._init()  # graftlint: disable=GL125 - admission+init are atomic BY CONTRACT (two pulls must not double-admit), and the default initializer samples self._rng, which this very lock guards; initializers are documented pure-sampling, never table re-entrant
                     self.rows[key] = row
                 if row is not None:
                     out[i] = row
@@ -309,7 +309,7 @@ class SparseTable:
                 if row is None:
                     if not self._admit(key):
                         continue
-                    row = self._init()
+                    row = self._init()  # graftlint: disable=GL125 - same contract as pull(): atomic admit+init under the row lock, pure-sampling initializer (default mutates the lock-guarded self._rng)
                     self.rows[key] = row
                 row += deltas[i]
 

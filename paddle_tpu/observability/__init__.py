@@ -63,6 +63,10 @@ from .tracing import (SpanRecorder, FlightRecorder, get_tracer,
                       request_summary, requests_seen, load_dump,
                       write_dump, arm_default, load_manifest)
 from .timeseries import TimeSeries
+from .fleet_obs import (RankExporter, FleetMonitor, merge_snapshots,
+                        snapshot_from_prometheus, merged_quantile,
+                        gauge_rollups, load_rank_snapshot,
+                        load_fleet_manifest, discover_snapshots)
 from .slo import (Objective, SLOEngine, SLOMonitor, validate_report,
                   json_safe, DEFAULT_WINDOWS)
 from .costs import (CostCatalog, get_cost_catalog, peak_flops,
@@ -84,6 +88,9 @@ __all__ = [
     "get_flight_recorder", "chrome_span_events", "request_summary",
     "requests_seen", "load_dump", "write_dump", "arm_default",
     "load_manifest",
+    "fleet_obs", "RankExporter", "FleetMonitor", "merge_snapshots",
+    "snapshot_from_prometheus", "merged_quantile", "gauge_rollups",
+    "load_rank_snapshot", "load_fleet_manifest", "discover_snapshots",
     "timeseries", "TimeSeries", "slo", "Objective", "SLOEngine",
     "SLOMonitor", "validate_report", "json_safe", "DEFAULT_WINDOWS",
     "costs", "CostCatalog", "get_cost_catalog", "peak_flops",

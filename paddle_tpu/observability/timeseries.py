@@ -83,6 +83,45 @@ class TimeSeries:
                                       child.sum, child.count)))
                     else:
                         rows.append((sname, fam.kind, None, child.value))
+        self._append_rows(rows, now)
+        return now
+
+    def sample_snapshot(self, snapshot, now):
+        """Append one ``registry.snapshot()`` DICT into the rings — the
+        fleet-mirroring path (fleet_obs): a remote rank's exported
+        snapshot replays into the same windowed machinery sample()
+        feeds live, so delta/rate/quantile work identically on mirrored
+        data. `now` is the REMOTE rank's monotonic clock (from its
+        snapshot's clock stamp) — per-rank rings keep per-rank
+        timebases, never mixed. Reserved meta entries ("_timeline")
+        are skipped."""
+        now = float(now)
+        rows = []
+        for name, fam in snapshot.items():
+            kind = fam.get("kind")
+            if name.startswith("_") or kind not in ("counter", "gauge",
+                                                    "histogram"):
+                continue
+            labelnames = fam.get("labelnames") or []
+            for ckey, child in (fam.get("children") or {}).items():
+                if ckey:
+                    kv = ",".join(f"{n}={v}" for n, v in
+                                  zip(labelnames, ckey.split(",")))
+                    sname = f"{name}{{{kv}}}"
+                else:
+                    sname = name
+                if kind == "histogram":
+                    rows.append((sname, kind, tuple(fam["buckets"]),
+                                 (tuple(child["bucket_counts"]),
+                                  float(child["sum"]),
+                                  int(child["count"]))))
+                else:
+                    rows.append((sname, kind, None,
+                                 float(child["value"])))
+        self._append_rows(rows, now)
+        return now
+
+    def _append_rows(self, rows, now):
         with self._lock:
             self.samples_taken += 1
             for sname, kind, buckets, payload in rows:
@@ -96,7 +135,6 @@ class TimeSeries:
                 if len(ring) == self.capacity:
                     self.dropped += 1
                 ring.append((now, payload))
-        return now
 
     # -- ring access ------------------------------------------------------
     def names(self):

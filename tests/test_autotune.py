@@ -22,6 +22,10 @@ Two contracts under test:
 import numpy as np
 import pytest
 
+# Tier-1 window: ~130s of interpret-mode sweeps on the 1-core CI box —
+# runs in the `pytest -m slow` tier (split recorded in BASELINE.md).
+pytestmark = pytest.mark.slow
+
 from paddle_tpu.ops.pallas import autotune as at
 from paddle_tpu.ops.pallas import flash_attention as fa
 

@@ -389,9 +389,12 @@ class TestRPC:
             [sys.executable, str(script), str(r), f"127.0.0.1:{port}"],
             env=env, cwd=REPO, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True) for r in range(2)]
-        # generous timeout: under a fully-loaded host (parallel suite
-        # runs) the two interpreters can take minutes just to import jax
-        outs = [p.communicate(timeout=300) for p in procs]
+        # generous readiness wait: under a fully-loaded 1-core host the
+        # two interpreters can take MINUTES each just to import jax
+        # before the TCPStore rendezvous even starts, and the 300s wait
+        # flaked there (the test passes in isolation). The wait is a
+        # deadline for hung workers, not a latency bar — keep it wide.
+        outs = [p.communicate(timeout=900) for p in procs]
         assert all(p.returncode == 0 for p in procs), outs
         assert "RPC OK" in outs[0][0]
 

@@ -19,6 +19,10 @@ permutation bug cannot hide behind an accidentally-symmetric weight.
 import numpy as np
 import pytest
 
+# Tier-1 window: ~100s of TP=2 interpret-mode serving on the 1-core CI
+# box — runs in the `pytest -m slow` tier (split in BASELINE.md).
+pytestmark = pytest.mark.slow
+
 from paddle_tpu.ops.pallas import flash_attention as fa
 from paddle_tpu.ops.pallas import paged_attention as pa
 

@@ -1,0 +1,95 @@
+# graftlint-corpus-expect: GL125 GL125 GL125
+"""Known-bad corpus: user-supplied callback invoked under an internal
+lock (GL125).
+
+All three recorded shapes trip here: a loop variable walking the
+``self._subs`` callback collection, a constructor-supplied
+``self._on_drop``, and a plain function parameter — each called while
+``_lock`` is held. The hazard GL122 cannot see: the callback's body is
+USER code, so a callback that calls back into ``subscribe()``
+deadlocks on the plain Lock, and a callback taking a user lock whose
+other holders call this class inverts the lock order — both invisible
+until the user's lock is in-tree.
+
+Clean tripwires: the snapshot-then-call idiom (callback list copied
+INSIDE the guard, callables invoked OUTSIDE it — the loop variable
+walks a private local, not a ``self`` collection), a ctor-fed callable
+invoked with no lock held, and an unresolved ``self.<attr>()`` that is
+NOT constructor-supplied (a subclass hook slot) even under the lock.
+"""
+import threading
+
+
+class Notifier:
+    """Bad: every subscriber fires while `_lock` is held."""
+
+    def __init__(self, on_drop=None):
+        self._lock = threading.Lock()
+        self._subs = []
+        self._on_drop = on_drop
+
+    def subscribe(self, cb):
+        with self._lock:
+            self._subs.append(cb)
+
+    def publish(self, evt):
+        with self._lock:
+            for cb in self._subs:
+                cb(evt)             # expect GL125: loop-var callback under _lock
+
+    def drop_all(self, evt):
+        with self._lock:
+            self._subs.clear()
+            self._on_drop(evt)      # expect GL125: ctor-supplied callable under _lock
+
+    def probe(self, check):
+        with self._lock:
+            check(len(self._subs))  # expect GL125: parameter invoked under _lock
+
+    def flush(self, sink):
+        with self._lock:
+            sink(list(self._subs))  # graftlint: disable=GL125 - suppression demo: sink is documented re-entrancy-free (a plain file write), and the handoff must be atomic with the clear below
+            self._subs.clear()
+
+
+class SafeNotifier:
+    """Clean: snapshot-then-call — the subscriber list is copied
+    INSIDE the guard and every user callable runs OUTSIDE it."""
+
+    def __init__(self, on_drop=None):
+        self._lock = threading.Lock()
+        self._subs = []
+        self._on_drop = on_drop
+
+    def subscribe(self, cb):
+        with self._lock:
+            self._subs.append(cb)
+
+    def publish(self, evt):
+        with self._lock:
+            snap = list(self._subs)
+        for cb in snap:             # walks the private snapshot
+            cb(evt)
+
+    def drop_all(self, evt):
+        with self._lock:
+            self._subs.clear()
+        if self._on_drop is not None:
+            self._on_drop(evt)      # lock released first: clean
+
+
+class HookSlot:
+    """Clean: `self._step()` is an overridable slot the class itself
+    populates (NOT constructor-supplied) — out of GL125's scope even
+    though it runs under the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._step = self._default_step
+
+    def _default_step(self):
+        return 0
+
+    def tick(self):
+        with self._lock:
+            return self._step()

@@ -82,11 +82,31 @@ class Acquisition:
     fn: object
 
 
+@dataclass
+class OpaqueCall:
+    """One call whose callee resolves to NO in-tree function but whose
+    shape says "user-supplied callable": a function parameter invoked
+    directly, a loop variable iterating a ``self.<attr>`` collection,
+    or an unresolved ``self.<attr>(...)``. Only these shapes are
+    recorded (recording every external call would swamp the index);
+    GL125 filters them by effective lockset."""
+    path: str
+    line: int
+    col: int
+    shape: str       # "param" | "loopvar" | "attr"
+    name: str        # parameter / loop-var / attribute name
+    source: str | None   # loopvar: the self attr the loop iterates
+    lexical: tuple
+    fn: object
+    node: object
+
+
 class LocksetIndex:
     def __init__(self, index):
         self.index = index
         self.accesses = []       # list[Access]
         self.acquisitions = []   # list[Acquisition]
+        self.opaque_calls = []   # list[OpaqueCall]
         self._call_sites = []    # (caller FunctionInfo, callee qual,
                                  #  lexical held, line)
         self.entry = {}          # qualname -> {identity: provenance}
@@ -226,6 +246,24 @@ class LocksetIndex:
                         and isinstance(node.ctx, ast.Store) \
                         and node.id not in declared_global:
                     locals_.add(node.id)
+            fa = fi.node.args
+            params = {p.arg for p in (fa.posonlyargs + fa.args
+                                      + fa.kwonlyargs)} - {"self", "cls"}
+            for va in (fa.vararg, fa.kwarg):
+                if va is not None:
+                    params.add(va.arg)
+            # loop vars iterating a self.<attr> collection: candidate
+            # callback carriers for the opaque-call record
+            loopvars = {}
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.For) \
+                        and isinstance(node.target, ast.Name):
+                    for sub in ast.walk(node.iter):
+                        if isinstance(sub, ast.Attribute) \
+                                and isinstance(sub.value, ast.Name) \
+                                and sub.value.id == "self":
+                            loopvars[node.target.id] = sub.attr
+                            break
 
             def visit(node, held, fi=fi, aliases=aliases,
                       declared_global=declared_global, locals_=locals_):
@@ -255,7 +293,8 @@ class LocksetIndex:
                     if ident is not None:
                         aliases[node.targets[0].id] = ident
                 self._record(ctx, facts, fi, node, held,
-                             mod_globals, declared_global, locals_)
+                             mod_globals, declared_global, locals_,
+                             params, loopvars)
                 for child in ast.iter_child_nodes(node):
                     visit(child, held)
 
@@ -263,7 +302,7 @@ class LocksetIndex:
                 visit(st, ())
 
     def _record(self, ctx, facts, fi, node, held, mod_globals,
-                declared_global, locals_):
+                declared_global, locals_, params, loopvars):
         index = self.index
         if isinstance(node, ast.Call):
             f = node.func
@@ -274,6 +313,22 @@ class LocksetIndex:
                 target = index._resolve_ref(facts, fi, f)
             if target is not None:
                 self._call_sites.append((fi, target, held, node.lineno))
+            else:
+                shape = name = source = None
+                if isinstance(f, ast.Name):
+                    if f.id in params:
+                        shape, name = "param", f.id
+                    elif f.id in loopvars:
+                        shape, name = "loopvar", f.id
+                        source = loopvars[f.id]
+                elif isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == "self" and fi.cls is not None:
+                    shape, name = "attr", f.attr
+                if shape is not None:
+                    self.opaque_calls.append(OpaqueCall(
+                        ctx.path, node.lineno, node.col_offset, shape,
+                        name, source, held, fi, node))
             return
         if isinstance(node, ast.Attribute) \
                 and isinstance(node.value, ast.Name) \

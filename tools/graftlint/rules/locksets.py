@@ -1,5 +1,5 @@
-"""Lockset rules (GL121-GL123) — Eraser/RacerD-style data-race and
-deadlock detection over per-object lock identity.
+"""Lockset rules (GL121-GL123, GL125) — Eraser/RacerD-style data-race
+and deadlock detection over per-object lock identity.
 
 The concurrency family (GL114-GL119) pattern-matches hazard SHAPES;
 this family reasons about lock OBJECTS. Phase 1 resolves every
@@ -33,6 +33,17 @@ different execution context — iteration observes the container
 mid-mutation ("dictionary changed size during iteration", torn lists).
 The snapshot-under-lock-then-iterate idiom reads the collection INSIDE
 the guard and therefore never flags.
+
+GL125 callback-under-lock: a USER-SUPPLIED callable (a function
+parameter, a loop variable over a ``self.<attr>`` callback collection,
+or a ``self.<attr>`` assigned from a constructor parameter) invoked
+while an in-tree lock is held. The callback's body is user code:
+GL122's lock-order digraph cannot see its locks, so the re-entrancy
+deadlock (the callback calls back into the API that takes the same
+lock) and the lock-order inversion (the callback takes a user lock its
+other callers hold OUTSIDE ours) are both invisible to it until the
+user's lock is in-tree — too late. The snapshot-then-call idiom (copy
+the callback list under the lock, invoke outside) never flags.
 """
 import ast
 
@@ -280,3 +291,76 @@ def guarded_collection_escape(ctx):
                 "a concurrent mutation lands mid-walk. Snapshot under "
                 "the lock (`with lock: snap = list(...)`) and iterate "
                 "the snapshot"), a.node
+
+
+# -- GL125 -------------------------------------------------------------------
+
+def _ctor_param_attr(idx, oc):
+    """True when `self.<attr>` is assigned from an ``__init__``
+    parameter in the SAME class+file — the stored-callback shape. An
+    unresolved ``self.<attr>(...)`` that is NOT ctor-fed (a subclass
+    hook, a jitted callable built in-method) stays out of GL125's
+    scope: only user-injected callables are the hazard."""
+    for fi in idx.functions_in(oc.path):
+        if fi.cls != oc.fn.cls or fi.name != "__init__":
+            continue
+        fa = fi.node.args
+        params = {p.arg for p in (fa.posonlyargs + fa.args
+                                  + fa.kwonlyargs)} - {"self"}
+        for node in ast.walk(fi.node):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1):
+                continue
+            t = node.targets[0]
+            if not (isinstance(t, ast.Attribute) and t.attr == oc.name
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name) and sub.id in params:
+                    return True
+    return False
+
+
+_SHAPE_DESC = {
+    "param": "the `{name}` parameter (caller-supplied callable)",
+    "loopvar": "`{name}`, iterating the `self.{source}` callback "
+               "collection",
+    "attr": "`self.{name}`, a constructor-supplied callable",
+}
+
+
+@rule("GL125", "callback-under-lock", "locksets", applies=in_paddle_tpu)
+def callback_under_lock(ctx):
+    """A user-supplied callable invoked while holding an in-tree lock.
+    The callback's locks live in USER code, so the two classic failures
+    are invisible to GL122 until it is too late: re-entrancy (the
+    callback calls the API that takes the lock it is already under —
+    instant deadlock on a plain Lock) and cross-domain order inversion
+    (the callback takes a user lock whose other holders call us). Same
+    cure as GL123's escape: snapshot state under the lock, run the
+    callback OUTSIDE it."""
+    idx = ctx.project
+    if idx is None:
+        return
+    ls = idx.locksets()
+    for oc in sorted((o for o in ls.opaque_calls
+                      if o.path == ctx.path),
+                     key=lambda o: (o.line, o.col)):
+        eff = ls.effective(oc)      # OpaqueCall duck-types Access here
+        eff.discard(UNKNOWN)
+        if not eff or ls.tainted(oc):
+            continue
+        if oc.shape == "attr" and not _ctor_param_attr(idx, oc):
+            continue
+        what = _SHAPE_DESC[oc.shape].format(name=oc.name,
+                                            source=oc.source)
+        yield ctx.finding(
+            "GL125", oc.node,
+            f"`{oc.fn.shortname}` invokes {what} while holding "
+            f"{_fmt_locks(idx, eff)} — the callback's own locks are "
+            "user code, so neither the re-entrant call back into this "
+            "API (deadlock on a plain Lock) nor a lock-order inversion "
+            "through a user lock is visible to GL122. Snapshot what "
+            "the callback needs under the lock, then invoke it after "
+            "release"), oc.node

@@ -2,9 +2,10 @@
 # Static-analysis entry point: rule self-test corpus first (a lobotomized
 # rule must not green-light the tree scan; the selftest also fails any
 # ORPHANED corpus file no registered rule claims), then the full-tree
-# two-phase scan — all 30 rules incl. the lockset family (GL121-GL123
-# data-race/deadlock detection over per-object lock identity) and
-# GL124 committed-JSON hygiene run in this default pass. The summary
+# two-phase scan — all 31 rules incl. the lockset family (GL121-GL123
+# data-race/deadlock detection over per-object lock identity, GL125
+# callback-under-lock) and GL124 committed-JSON hygiene run in this
+# default pass. The summary
 # prints the per-phase timing split (phase1 parse+index, phase2 rules)
 # so a gate-cost regression is attributable at a glance. Extra args
 # pass through to the tree scan (e.g. --sarif for CI annotation):
@@ -66,4 +67,12 @@ if [ "${LINT_SKIP_SERVE:-0}" != "1" ]; then
   # int4 weight-only engines under continuous batching match the dense
   # weight_quant generate() across all scheduler modes
   python tools/serve_bench.py --check tools/serve_autotune.json
+  # fleet-observability gate: REAL multi-process ranks (serving stepper
+  # + dp-sharded pretrain) mirroring through RankExporter into one
+  # fleet dir while the parent FleetMonitor polls live — healthy leg
+  # breach-free with merged counters bit-equal the per-rank sums and
+  # merged-histogram quantiles equal pooled ground truth; injected
+  # set_dispatch_delay leg fires the straggler detector on exactly
+  # that rank with a request_trace-loadable fleet_straggler dump
+  python tools/fleet_obs.py --check tools/fleet_obs.json
 fi

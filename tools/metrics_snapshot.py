@@ -812,6 +812,129 @@ def selfcheck():
               for n, lbl, v
               in parsed11["autotune_winner_config"]["samples"]),
           "parse_prometheus lost the autotune winner gauge")
+
+    # fleet layer (ISSUE 18): per-rank mirroring through RankExporter
+    # (atomic snapshot files + manifest, seq adoption), merge math
+    # (counters sum exactly, fixed-bucket histograms merge exactly so
+    # fleet quantiles are real, gauges keep rank-labeled children with
+    # rollups), the prometheus-scrape ingestion path, and the
+    # FleetMonitor straggler detector fire/no-fire on synthetic
+    # clocks with a schema-valid fleet_straggler dump — stdlib-only
+    # like everything above
+    fdir = tempfile.mkdtemp(prefix="sc_fleet_")
+    try:
+        regs = []
+        for rank in range(2):
+            freg = obs.MetricsRegistry()
+            freg.counter("fl_tokens_total").inc(10 * (rank + 1))
+            fh = freg.histogram("fl_step_seconds",
+                                buckets=(0.01, 0.1, 1.0))
+            for v in ((0.005, 0.05) if rank == 0 else (0.5, 2.0)):
+                fh.observe(v)
+            freg.gauge("fl_depth").set(float(rank + 3))
+            regs.append(freg)
+        exps = [obs.RankExporter(fdir, r, 2, run_id="sc",
+                                 registry=regs[r], interval_s=0.0)
+                for r in range(2)]
+        for e in exps:
+            e.export()
+        snaps = obs.discover_snapshots(fdir, run_id="sc")
+        check(sorted(snaps) == [0, 1],
+              f"fleet discovery missed ranks: {sorted(snaps)}")
+        man = obs.load_fleet_manifest(fdir)
+        check(man["run_id"] == "sc"
+              and sorted(man["ranks"]) == ["0", "1"]
+              and all(man["ranks"][str(r)]["seq"] == snaps[r]["seq"]
+                      for r in snaps),
+              "fleet manifest does not round-trip the rank files")
+        # adoption: a re-armed exporter continues the rank's seq
+        check(obs.RankExporter(fdir, 0, 2, run_id="sc",
+                               registry=regs[0]).seq
+              == snaps[0]["seq"],
+              "re-armed RankExporter did not adopt the previous seq")
+        view = obs.merge_snapshots(snaps)
+        tok = view["metrics"]["fl_tokens_total"]["children"][""]
+        check(tok["value"] == 30.0,
+              f"fleet counter sum not exact: {tok['value']}")
+        hch = view["metrics"]["fl_step_seconds"]["children"][""]
+        check(hch["bucket_counts"] == [1, 1, 1, 1]
+              and hch["count"] == 4,
+              f"fleet histogram merge not exact: {hch}")
+        q95 = obs.merged_quantile(view, "fl_step_seconds", 0.95)
+        check(q95 is not None and 0.1 < q95 <= 1.0,
+              f"fleet p95 {q95} outside the pooled crossing bucket")
+        dfam = view["metrics"]["fl_depth"]
+        check(dfam["labelnames"] == ["rank"]
+              and dfam["children"]["0"]["value"] == 3.0
+              and dfam["children"]["1"]["value"] == 4.0,
+              "merged gauge lost its rank-labeled children")
+        roll = obs.gauge_rollups(view, "fl_depth")[""]
+        check(roll["min"] == 3.0 and roll["max"] == 4.0
+              and roll["mean"] == 3.5,
+              f"gauge rollups wrong: {roll}")
+        # scrape path: exposition text -> snapshot -> same merge
+        scraped = obs.snapshot_from_prometheus(
+            obs.to_prometheus(regs[0]))
+        sch = scraped["fl_step_seconds"]["children"][""]
+        check(sch["bucket_counts"]
+              == regs[0].snapshot()["fl_step_seconds"]["children"][""][
+                  "bucket_counts"],
+              "snapshot_from_prometheus did not de-cumulate buckets")
+        # straggler detector on synthetic clocks: rank 1's dispatch
+        # mean sits far over the fleet median; rank 0 must stay quiet
+        ddir = os.path.join(fdir, "dumps")
+        monf = obs.FleetMonitor(window_s=60.0, min_count=3,
+                                mad_factor=4.0, abs_floor_s=0.005,
+                                checks=(("dispatch",
+                                         "fl_dispatch_seconds"),),
+                                registry=obs.MetricsRegistry(),
+                                dump_dir=ddir, min_interval_s=0.0)
+        sregs, shs, seqs = [], [], [0, 0, 0]
+
+        def feed(rank, t):
+            seqs[rank] += 1
+            monf.ingest({"schema": obs.fleet_obs.SNAPSHOT_SCHEMA,
+                         "run_id": "sc", "rank": rank, "world_size": 3,
+                         "seq": seqs[rank],
+                         "clock": {"time": 0.0,
+                                   "monotonic": 100.0 + t,
+                                   "perf_us": 0.0},
+                         "metrics": sregs[rank].snapshot(),
+                         "spans": []})
+
+        for rank in range(3):
+            sregs.append(obs.MetricsRegistry())
+            shs.append(sregs[rank].histogram(
+                "fl_dispatch_seconds", buckets=(0.01, 0.1, 1.0, 10.0)))
+        for t in range(5):
+            for rank in range(3):
+                if t:
+                    shs[rank].observe(0.02)
+                feed(rank, t)
+        check(monf.check() == [],
+              "straggler detector fired on a symmetric healthy fleet")
+        for t in range(5, 8):
+            for rank in range(3):
+                shs[rank].observe(0.02 if rank < 2 else 2.0)
+                feed(rank, t)
+        fired = monf.check()
+        check(len(fired) == 1 and fired[0]["rank"] == 2
+              and fired[0]["check"] == "dispatch",
+              f"straggler detector wrong breach set: {fired}")
+        fdumps = [f for f in os.listdir(ddir)
+                  if f.startswith("flightrec_fleet_straggler")]
+        check(len(fdumps) == 1,
+              f"expected one fleet_straggler dump: {fdumps}")
+        if fdumps:
+            fd = obs.load_dump(os.path.join(ddir, fdumps[0]))
+            fctx = fd.get("context", {})
+            check(fd["reason"] == "fleet_straggler"
+                  and fctx.get("rank") == 2
+                  and sum(json.loads(fctx["rank_hist"])) > 0
+                  and sum(json.loads(fctx["fleet_hist"])) > 0,
+                  "fleet_straggler dump schema/witnesses wrong")
+    finally:
+        shutil.rmtree(fdir, ignore_errors=True)
     return failures
 
 

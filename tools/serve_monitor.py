@@ -33,11 +33,22 @@ the in-process registry, and renders the same one-line dashboard —
 stdlib-only (the standalone observability load), so the sidecar runs
 in a bare container next to any ``examples/serve_gateway.py``.
 
+Repeat ``--scrape`` for a FLEET dashboard over N replicas: each
+target's scrape converts through
+``observability.snapshot_from_prometheus`` and the round merges with
+``merge_snapshots`` (fleet_obs), so the rendered tokens/s is the
+exact-summed fleet counter and the latency line shows REAL fleet
+p50/p95/p99 (merged fixed-bucket histograms — never averages of
+per-replica quantiles), plus a quorum ``/healthz`` rollup (majority of
+targets healthy = fleet healthy) and a per-rank inflight/queue strip.
+
 Usage:
   python tools/serve_monitor.py [--dashboard-every N] [--json OUT]
   python tools/serve_monitor.py --check tools/serve_slo.json
   python tools/serve_monitor.py --scrape http://127.0.0.1:8000 \
       [--scrape-interval S] [--scrape-count N]
+  python tools/serve_monitor.py --scrape http://host-a:8000 \
+      --scrape http://host-b:8000 --scrape http://host-c:8000
 """
 import argparse
 import json
@@ -298,6 +309,117 @@ def scrape_leg(url, interval_s=2.0, count=0, out=sys.stdout):
     return 0 if ok_polls else 1
 
 
+def _merged_counter(view, name):
+    fam = view["metrics"].get(name)
+    if not fam or fam.get("kind") != "counter":
+        return None
+    vals = [c["value"] for c in fam["children"].values()]
+    return sum(vals) if vals else None
+
+
+def _rank_gauge_strip(view, name):
+    """'r0:3 r1:5 ...' from a merged gauge's appended rank label."""
+    fam = view["metrics"].get(name)
+    if not fam or fam.get("kind") != "gauge":
+        return ""
+    cells = {}
+    for ckey, child in fam["children"].items():
+        rank = ckey.rsplit(",", 1)[-1] if ckey else ckey
+        cells[rank] = cells.get(rank, 0.0) + child["value"]
+    return " ".join(f"r{r}:{v:g}" for r, v in
+                    sorted(cells.items(), key=lambda kv: kv[0]))
+
+
+def scrape_fleet(urls, interval_s=2.0, count=0, out=sys.stdout):
+    """Poll N live gateways and render the AGGREGATED dashboard: each
+    round's scrapes convert through snapshot_from_prometheus and merge
+    with merge_snapshots, so tokens/s is the exact fleet counter sum,
+    the latency cells are real merged-histogram quantiles, and health
+    is a quorum rollup over the targets' /healthz answers. A partially
+    reachable fleet still renders (the view covers the ranks that
+    answered); a round where NO target answers counts as failed."""
+    import time
+    import urllib.error
+    import urllib.request
+
+    from tools.metrics_snapshot import _load_observability
+
+    obs = _load_observability()
+    bases = []
+    for u in urls:
+        base = u.rstrip("/")
+        if base.endswith("/metrics"):
+            base = base[: -len("/metrics")]
+        bases.append(base)
+    world = len(bases)
+    quorum = world // 2 + 1
+    prev_tokens = prev_t = None
+    polls = ok_polls = 0
+    while count == 0 or polls < count:
+        if polls:
+            time.sleep(interval_s)
+        polls += 1
+        snaps, health = {}, {}
+        for rank, base in enumerate(bases):
+            try:
+                with urllib.request.urlopen(base + "/metrics",
+                                            timeout=5) as r:
+                    snaps[rank] = {
+                        "rank": rank, "world_size": world,
+                        "metrics": obs.snapshot_from_prometheus(
+                            r.read().decode())}
+            except (OSError, ValueError) as e:
+                health[rank] = "unreachable"
+                print(f"[fleet {polls}] r{rank} {base}/metrics "
+                      f"unreachable: {e}", file=out)
+                continue
+            try:
+                with urllib.request.urlopen(base + "/healthz",
+                                            timeout=5) as r:
+                    health[rank] = "ok"
+            except urllib.error.HTTPError:
+                health[rank] = "degraded"
+            except OSError:
+                health[rank] = "unreachable"
+        if not snaps:
+            continue
+        ok_polls += 1
+        view = obs.merge_snapshots(snaps)
+        n_ok = sum(1 for h in health.values() if h == "ok")
+        rollup = "ok" if n_ok >= quorum else \
+            ("degraded" if n_ok else "down")
+        now = time.monotonic()
+        tokens = _merged_counter(view, "serve_tokens_total")
+        rate = None
+        if tokens is not None and prev_tokens is not None \
+                and now > prev_t:
+            rate = (tokens - prev_tokens) / (now - prev_t)
+        prev_tokens, prev_t = tokens, now
+
+        def pcts(name):
+            cells = []
+            for q in (0.5, 0.95, 0.99):
+                try:
+                    v = obs.merged_quantile(view, name, q)
+                except (KeyError, ValueError):
+                    v = None
+                cells.append("-" if v is None else f"{v * 1e3:.0f}")
+            return "/".join(cells)
+
+        breaches = _merged_counter(view, "slo_breaches_total")
+        print(f"[fleet {polls:3d}] quorum {rollup} ({n_ok}/{world} ok,"
+              f" {len(snaps)} scraped)"
+              f" | ttft p50/95/99 {pcts('serve_ttft_seconds')}ms"
+              f" tpot {pcts('serve_tpot_seconds')}ms"
+              f" | inflight [{_rank_gauge_strip(view, 'serve_inflight_requests')}]"
+              f" queue [{_rank_gauge_strip(view, 'serve_queue_depth')}]"
+              f" | tokens {int(tokens) if tokens is not None else '-'}"
+              f" ({'-' if rate is None else f'{rate:.1f}/s'})"
+              f" | breaches {int(breaches) if breaches is not None else 0}",
+              file=out)
+    return 0 if ok_polls else 1
+
+
 def monitor_leg(config=None, dashboard_every=0):
     """The full leg: warmup run -> monitored run (SLO engine attached)
     -> unmonitored run; neutrality + bucket accounting + windowed
@@ -508,10 +630,14 @@ def main():
                     help="do not arm the flight recorder (armed by "
                          "default with bounded retention — the "
                          "server-entrypoint policy)")
-    ap.add_argument("--scrape", metavar="URL", default=None,
+    ap.add_argument("--scrape", metavar="URL", action="append",
+                    default=None,
                     help="poll a live gateway's /metrics + /healthz "
                          "instead of driving an in-process engine "
-                         "(cross-process dashboard; stdlib-only)")
+                         "(cross-process dashboard; stdlib-only). "
+                         "Repeat for a FLEET: N targets merge into one "
+                         "aggregated dashboard with real fleet "
+                         "quantiles and a quorum /healthz rollup")
     ap.add_argument("--scrape-interval", type=float, default=2.0,
                     help="seconds between scrape polls")
     ap.add_argument("--scrape-count", type=int, default=0,
@@ -521,7 +647,10 @@ def main():
     if args.scrape:
         # a sidecar scraper neither serves nor dumps: no engine, no
         # flight recorder, no jax
-        return scrape_leg(args.scrape, args.scrape_interval,
+        if len(args.scrape) > 1:
+            return scrape_fleet(args.scrape, args.scrape_interval,
+                                args.scrape_count)
+        return scrape_leg(args.scrape[0], args.scrape_interval,
                           args.scrape_count)
 
     from paddle_tpu.observability import tracing
