@@ -9,4 +9,5 @@ from .continuous_batching import (BlockAllocator,  # noqa: F401
                                   GenerationRequest, RequestResult,
                                   KVAllocFailure,
                                   ContinuousBatchingEngine,
-                                  propose_draft_tokens)
+                                  propose_draft_tokens,
+                                  block_key, prompt_block_keys)

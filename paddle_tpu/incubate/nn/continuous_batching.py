@@ -60,7 +60,7 @@ from ...ops.pallas.paged_attention import (build_ragged_work, default_pack,
 
 __all__ = ["BlockAllocator", "GenerationRequest", "RequestResult",
            "KVAllocFailure", "ContinuousBatchingEngine",
-           "propose_draft_tokens", "block_key"]
+           "propose_draft_tokens", "block_key", "prompt_block_keys"]
 
 
 class KVAllocFailure(RuntimeError):
@@ -84,6 +84,21 @@ def block_key(parent, tokens):
     key (its KV really is different: rope positions and attention
     context differ)."""
     return (parent, tuple(int(t) for t in tokens))
+
+
+def prompt_block_keys(prompt_ids, block_size):
+    """The chained key ladder of a prompt's FULL blocks — the same
+    math admission hashes into ``req._prompt_keys``, exposed as a pure
+    host-side function so a routing layer can compute a request's
+    prefix identity WITHOUT an engine (the router matches this chain
+    against each replica's published ``prefix_index_summary()``).
+    Returns [] when the prompt doesn't cover one full block."""
+    ks, k = [], None
+    src = [int(t) for t in prompt_ids]
+    for b in range(len(src) // block_size):
+        k = block_key(k, src[b * block_size:(b + 1) * block_size])
+        ks.append(k)
+    return ks
 
 
 def propose_draft_tokens(tokens, max_k, ngram=2):
@@ -263,6 +278,15 @@ class BlockAllocator:
     def lookup(self, key):
         """Index probe without side effects: block id or None."""
         return self._index.get(key)
+
+    def index_keys(self):
+        """Snapshot of every content key currently resolvable by
+        ``acquire()`` — held blocks AND pooled (freed-but-registered)
+        ones. This is the prefix-index summary a routing layer
+        publishes: a router matching a prompt's block-key chain against
+        it knows exactly which leading blocks this allocator can map
+        without a prefill sweep."""
+        return frozenset(self._index)
 
     def acquire(self, key):
         """Index hit -> the physical block with its refcount bumped
@@ -809,6 +833,17 @@ class ContinuousBatchingEngine:
             "kv_blocks_used": self.allocator.num_used,
         } for d in range(self._tp)]
 
+    def prefix_index_summary(self):
+        """The prefix-routing summary this replica publishes: the
+        frozenset of chained block keys its allocator can currently
+        map without a prefill sweep (empty when prefix caching is
+        off). Read on the stepper thread that owns the engine — the
+        router refreshes its cached copy from terminal fanout, which
+        runs on exactly that thread."""
+        if not self._prefix_on:
+            return frozenset()
+        return self.allocator.index_keys()
+
     def _deadline_passed(self, req, now=None):
         if req.deadline_steps is not None \
                 and req._submit_step is not None \
@@ -1151,13 +1186,8 @@ class ContinuousBatchingEngine:
                 # scheduler dedup and wavefront probes index into it
                 # instead of rehashing up to a chunk of tokens per slot
                 # per step
-                ks, k = [], None
-                bs = self.block_size
-                src = req._prefill_src
-                for b in range(len(src) // bs):
-                    k = block_key(k, src[b * bs:(b + 1) * bs])
-                    ks.append(k)
-                req._prompt_keys = ks
+                req._prompt_keys = prompt_block_keys(
+                    req._prefill_src, self.block_size)
             req._miss_frontier = -1
             req._cow_reserve = 0
             req.status = "running"

@@ -31,6 +31,9 @@ __all__ = [
     "gateway_responses", "gateway_live_connections",
     "gateway_live_streams", "gateway_sse_pending_events",
     "gateway_sse_events", "gateway_health_transitions",
+    "routed_requests", "router_affinity_hits", "router_affinity_misses",
+    "router_resubmits", "router_replica_inflight",
+    "router_replicas_live",
     "train_step_seconds", "train_tokens_total", "train_steps_total",
     "train_tokens_per_s", "train_host_seconds",
     "autotune_trials", "autotune_cache_hits", "autotune_cache_misses",
@@ -278,6 +281,54 @@ def gateway_health_transitions():
         "gateway_health_transitions_total",
         help="/healthz state changes (ok <-> degraded)",
         labels=("to",))
+
+
+# -- multi-replica router (data-parallel engine pool) --------------------
+# `replica` is world-bounded (one value per pool slot, like `device`)
+# and `policy` is the router's fixed literal set — GL112-safe.
+
+def routed_requests():
+    return get_registry().counter(
+        "routed_requests_total",
+        help="requests routed to a replica, by policy and pool slot",
+        labels=("policy", "replica"))
+
+
+def router_affinity_hits():
+    return get_registry().counter(
+        "router_affinity_hits_total",
+        help="prefix-affinity routes that matched a replica's "
+             "published prefix index (>= 1 leading block mapped free)")
+
+
+def router_affinity_misses():
+    return get_registry().counter(
+        "router_affinity_misses_total",
+        help="prefix-affinity routes that fell back to least-loaded "
+             "(no index match, or the imbalance cap vetoed the match)")
+
+
+def router_resubmits():
+    return get_registry().counter(
+        "router_resubmits_total",
+        help="queued requests resubmitted to a survivor after their "
+             "replica's step() crashed, by the SURVIVOR's pool slot",
+        labels=("replica",))
+
+
+def router_replica_inflight():
+    return get_registry().gauge(
+        "router_replica_inflight",
+        help="requests the router currently has routed to each "
+             "replica (submit -> terminal, queued + active)",
+        labels=("replica",))
+
+
+def router_replicas_live():
+    return get_registry().gauge(
+        "router_replicas_live",
+        help="replicas currently accepting routes (pool size minus "
+             "drained)")
 
 
 # -- speculative decode (prompt-lookup drafts + budgeted verify) ---------

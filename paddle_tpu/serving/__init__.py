@@ -29,6 +29,8 @@ Gate: ``tools/serve_gateway.py --check tools/serve_gateway.json`` in
 """
 from .sse import format_event, iter_events, parse_events
 from .stepper import EngineStepper
+from .router import (EngineRouter, RoutingPolicy, RoundRobinPolicy,
+                     LeastLoadedPolicy, PrefixAffinityPolicy, POLICIES)
 from .gateway import (ServingGateway, run_gateway,
                       validate_generate_body, validate_healthz,
                       HEALTHZ_SCHEMA, REQUESTS_SCHEMA, DUMPS_SCHEMA,
@@ -37,7 +39,9 @@ from .gateway import (ServingGateway, run_gateway,
 __all__ = [
     "format_event", "iter_events", "parse_events",
     "EngineStepper", "ServingGateway", "run_gateway",
+    "EngineRouter", "RoutingPolicy", "RoundRobinPolicy",
+    "LeastLoadedPolicy", "PrefixAffinityPolicy", "POLICIES",
     "validate_generate_body", "validate_healthz",
     "HEALTHZ_SCHEMA", "REQUESTS_SCHEMA", "DUMPS_SCHEMA", "STATUS_HTTP",
-    "sse", "stepper", "gateway",
+    "sse", "stepper", "gateway", "router",
 ]

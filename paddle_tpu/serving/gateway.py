@@ -92,6 +92,12 @@ _REASONS = {
 
 _MAX_BODY = 8 * 1024 * 1024
 _MAX_HEADER_LINES = 100
+# HTTP/1.1 keep-alive bounds: an idle reused socket is reaped after
+# this many seconds, and one socket serves at most this many requests
+# before the gateway closes it (a rotation backstop against a client
+# pinning one connection forever)
+_KEEPALIVE_IDLE_S = 75.0
+_MAX_KEEPALIVE_REQUESTS = 1000
 
 
 def _read_file(path):
@@ -213,7 +219,9 @@ def validate_healthz(payload):
 
 
 class ServingGateway:
-    """One asyncio HTTP server over one EngineStepper.
+    """One asyncio HTTP server over one EngineStepper — or over an
+    EngineRouter fronting N of them (the router presents the same
+    submit/cancel/call/error surface, so the pool is invisible here).
 
     ``monitor`` / ``memory_watch`` are the SAME objects the engine was
     constructed with (the gateway only reads their ``last_report`` for
@@ -299,10 +307,15 @@ class ServingGateway:
         return method, target, headers, body
 
     def _write_head(self, writer, status, ctype, length=None, extra=()):
+        # the per-connection keep-alive verdict is pinned on the writer
+        # by _handle (HTTP/1.1 default) and cleared by the SSE path —
+        # a stream's framing is "read until close", so it must not
+        # invite a second request on the same socket
+        keep = getattr(writer, "_pt_keep_alive", False)
         lines = [f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}",
                  f"Content-Type: {ctype}",
                  "Cache-Control: no-store",
-                 "Connection: close"]
+                 "Connection: keep-alive" if keep else "Connection: close"]
         if length is not None:
             lines.append(f"Content-Length: {length}")
         lines.extend(f"{k}: {v}" for k, v in extra)
@@ -355,29 +368,58 @@ class ServingGateway:
         return "unknown", self._h_not_found, None
 
     async def _handle(self, reader, writer):
+        """Per-connection loop: HTTP/1.1 keep-alive by default, so a
+        load generator or router-fronted client reuses one socket
+        instead of paying a TCP handshake per request. `Connection:
+        close` (or an SSE stream, whose framing is read-until-close)
+        ends the loop after the response; an idle reused socket is
+        reaped after _KEEPALIVE_IDLE_S."""
         conns = _metrics.gateway_live_connections()
         conns.inc()
-        t0 = time.perf_counter()
         route = "unknown"
         try:
-            try:
-                parsed = await self._read_request(reader)
-            except ValueError as e:
-                # client-side limit violation, not a server bug
-                await self._respond(
-                    writer, route, 413,
-                    {"error": "payload_too_large", "reason": str(e)})
-                return
-            if parsed is None:
-                return
-            method, target, headers, body = parsed
-            path = target.split("?", 1)[0]
-            route, handler, arg = self._route(method, path)
-            await handler(writer, route, headers, body, arg)
+            for served in range(_MAX_KEEPALIVE_REQUESTS):
+                route = "unknown"
+                t0 = time.perf_counter()
+                try:
+                    if served == 0:
+                        parsed = await self._read_request(reader)
+                    else:
+                        parsed = await asyncio.wait_for(
+                            self._read_request(reader),
+                            _KEEPALIVE_IDLE_S)
+                except asyncio.TimeoutError:
+                    return              # idle keep-alive socket reaped
+                except ValueError as e:
+                    # client-side limit violation, not a server bug
+                    await self._respond(
+                        writer, route, 413,
+                        {"error": "payload_too_large",
+                         "reason": str(e)})
+                    return
+                if parsed is None:
+                    return
+                method, target, headers, body = parsed
+                # HTTP/1.1: persistent unless the client opts out
+                keep = (headers.get("connection", "").lower()
+                        != "close"
+                        and served + 1 < _MAX_KEEPALIVE_REQUESTS)
+                writer._pt_keep_alive = keep
+                path = target.split("?", 1)[0]
+                route, handler, arg = self._route(method, path)
+                try:
+                    await handler(writer, route, headers, body, arg)
+                finally:
+                    _metrics.gateway_request_seconds().labels(
+                        route=route).observe(time.perf_counter() - t0)
+                # a handler may have withdrawn keep-alive (SSE)
+                if not getattr(writer, "_pt_keep_alive", False):
+                    return
         except Exception as e:
             # a handler bug answers 500 with a structured reason,
             # never a silently dropped connection (and never a dead
             # accept loop — asyncio isolates us per-connection)
+            writer._pt_keep_alive = False
             try:
                 await self._respond(
                     writer, route, 500,
@@ -385,8 +427,6 @@ class ServingGateway:
             except OSError:
                 pass        # client already gone
         finally:
-            _metrics.gateway_request_seconds().labels(
-                route=route).observe(time.perf_counter() - t0)
             conns.dec()
             try:
                 writer.close()
@@ -482,7 +522,9 @@ class ServingGateway:
                 {"request": rid, "status": ev["status"],
                  "reason": ev.get("reason"), "tokens": ev["tokens"],
                  "preemptions": ev.get("preemptions", 0)})
-        # SSE stream
+        # SSE stream: read-until-close framing — withdraw keep-alive
+        # before the head goes out
+        writer._pt_keep_alive = False
         self._write_head(writer, 200, "text/event-stream")
         _metrics.gateway_responses().labels(route=route,
                                             code="200").inc()

@@ -596,6 +596,72 @@ class TestPressureAndWarmth:
         finally:
             h.close()
 
+    def test_two_requests_one_socket_keepalive(self, gw):
+        """HTTP/1.1 keep-alive: two control-plane requests ride ONE
+        TCP socket — the server answers Connection: keep-alive and
+        keeps the connection open for the next request."""
+        conn = http.client.HTTPConnection("127.0.0.1", gw.gw.port,
+                                          timeout=60)
+        conn.request("GET", "/healthz")
+        r1 = conn.getresponse()
+        r1.read()
+        assert r1.status == 200
+        assert r1.getheader("Connection") == "keep-alive"
+        sock = conn.sock
+        assert sock is not None
+        conn.request("GET", "/healthz")
+        r2 = conn.getresponse()
+        r2.read()
+        assert r2.status == 200
+        assert conn.sock is sock, "second request re-dialed the server"
+        conn.close()
+
+    def test_connection_close_honored(self, gw):
+        """A client sending Connection: close gets a close answer and
+        EOF right after the body — the one-shot read-to-EOF clients
+        (tools/serve_gateway.py) depend on it."""
+        import socket
+        s = socket.create_connection(("127.0.0.1", gw.gw.port),
+                                     timeout=30)
+        s.sendall(b"GET /healthz HTTP/1.1\r\nHost: x\r\n"
+                  b"Connection: close\r\n\r\n")
+        data = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break               # server closed: EOF framing works
+            data += chunk
+        s.close()
+        head = data.decode()
+        assert " 200 " in head.splitlines()[0]
+        assert "Connection: close" in head
+
+    def test_sse_withdraws_keepalive(self, gw, rngv):
+        """A streaming response is read-until-close framed: the SSE
+        head must answer Connection: close and the server must close
+        the socket after the `end` event."""
+        import socket
+        rng, v = rngv
+        body = json.dumps({"prompt": [int(t) for t in _prompt(rng, v, 5)],
+                           "max_new_tokens": 2,
+                           "request_id": "ka-sse"}).encode()
+        s = socket.create_connection(("127.0.0.1", gw.gw.port),
+                                     timeout=120)
+        s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Type: application/json\r\n"
+                  b"Content-Length: " + str(len(body)).encode()
+                  + b"\r\n\r\n" + body)
+        data = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+        s.close()
+        head, _, rest = data.partition(b"\r\n\r\n")
+        assert b"Connection: close" in head
+        assert b"event: end" in rest
+
     def test_zero_new_buckets_after_warmup(self, gw, eng, rngv):
         rng, v = rngv
         p = [int(t) for t in _prompt(rng, v, 13)]

@@ -1,0 +1,338 @@
+"""Multi-replica serving (ISSUE 19): EngineRouter behind the gateway.
+
+The contract under test: a pool of N replicas is indistinguishable
+from one engine at the API — the gateway serves concurrent SSE
+streams token-exact across replicas, a mid-stream cancel returns
+every replica's KV gauges to baseline, a duplicate request id is a
+409 no matter WHICH replica retired the original, and the affinity
+policy's imbalance cap falls back to least-loaded instead of piling
+onto a busy match. Policy units run against a bare RouteView; the
+crash/drain + perf-counter twin is tools/serve_replica.py --check.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import serving
+from paddle_tpu.incubate.nn import (ContinuousBatchingEngine,
+                                    GenerationRequest)
+from paddle_tpu.serving import (EngineRouter, LeastLoadedPolicy,
+                                PrefixAffinityPolicy, RoundRobinPolicy)
+from paddle_tpu.serving.router import POLICIES, RouteView
+
+from test_serve_gateway import (Harness, _end, _leak_free, _prompt,
+                                _ref, _tokens)
+
+
+def _cached_engine(seed=0):
+    from test_chunked_prefill import _tiny_engine as _cached
+    return _cached(seed=seed, max_seq_len=64)
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    old = fa._INTERPRET
+    fa._INTERPRET = True
+    yield
+    fa._INTERPRET = old
+
+
+@pytest.fixture(scope="module")
+def eng():
+    engine, _v = _cached_engine()
+    return engine
+
+
+@pytest.fixture(scope="module")
+def rngv():
+    return np.random.default_rng(11), 128
+
+
+def _make_pool(eng, replicas=2, policy="round_robin", **policy_kw):
+    steppers = [serving.EngineStepper(
+        ContinuousBatchingEngine(eng, num_blocks=40, block_size=8,
+                                 max_batch=4, prefill_chunk=8,
+                                 prefix_cache=True),
+        name=f"test-replica-{i}") for i in range(replicas)]
+    return EngineRouter(steppers, policy=policy, **policy_kw).start()
+
+
+class RouterHarness(Harness):
+    """The gateway Harness over an EngineRouter instead of a single
+    stepper: same real-TCP loop thread, same sync client."""
+
+    def __init__(self, eng, replicas=2, policy="round_robin",
+                 **policy_kw):
+        router = _make_pool(eng, replicas=replicas, policy=policy,
+                            **policy_kw)
+        self.router = router
+        self.cb = router.steppers[0].engine
+        self.stepper = router          # the gateway's "stepper" surface
+        self.gw = serving.ServingGateway(router)
+        import asyncio
+        self.loop = asyncio.new_event_loop()
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._ready.wait(30), "gateway failed to start"
+
+    def replica_call(self, i, fn):
+        return self.router.steppers[i].call(fn).result(30)
+
+
+class _Collect:
+    def __init__(self):
+        self.events = []
+        self.done = threading.Event()
+
+    def __call__(self, ev):
+        self.events.append(ev)
+        if ev["type"] == "end":
+            self.done.set()
+
+    @property
+    def tokens(self):
+        return [t for e in self.events if e["type"] == "token"
+                for t in e["tokens"]]
+
+
+# -- policy units (no threads, no engines) ----------------------------------
+
+class TestPolicies:
+    def test_registry(self):
+        assert set(POLICIES) == {"round_robin", "least_loaded",
+                                 "prefix_affinity"}
+        with pytest.raises(ValueError):
+            EngineRouter([], policy="round_robin")
+
+    def test_unknown_policy_rejected(self, eng):
+        steppers = [serving.EngineStepper(
+            ContinuousBatchingEngine(eng, num_blocks=8, block_size=8))]
+        with pytest.raises(ValueError, match="routing policy"):
+            EngineRouter(steppers, policy="best_effort")
+
+    def test_round_robin_skips_drained(self):
+        p = RoundRobinPolicy()
+        view = RouteView((0, 2), {0: 0, 2: 0}, {}, ())
+        assert [p.choose(view) for _ in range(4)] == [0, 2, 0, 2]
+
+    def test_least_loaded_ties_to_lowest_slot(self):
+        p = LeastLoadedPolicy()
+        assert p.choose(RouteView((0, 1, 2), {0: 2, 1: 1, 2: 1},
+                                  {}, ())) == 1
+        assert p.choose(RouteView((0, 1, 2), {0: 1, 1: 1, 2: 1},
+                                  {}, ())) == 0
+
+    def test_affinity_longest_match_wins(self):
+        p = PrefixAffinityPolicy()
+        view = RouteView((0, 1), {0: 0, 1: 0},
+                         {0: frozenset({"a"}),
+                          1: frozenset({"a", "b"})},
+                         ("a", "b", "c"))
+        assert p.choose(view) == (1, "hit")
+
+    def test_affinity_no_match_falls_back(self):
+        p = PrefixAffinityPolicy()
+        view = RouteView((0, 1), {0: 3, 1: 1},
+                         {0: frozenset({"x"}), 1: frozenset()},
+                         ("a", "b"))
+        assert p.choose(view) == (1, "miss")   # least-loaded fallback
+
+    def test_affinity_imbalance_cap_vetoes_full_replica(self):
+        # the matched replica is "full" (cap more in-flight than the
+        # idlest survivor): affinity must NOT pile on — least-loaded
+        # fallback takes the miss
+        p = PrefixAffinityPolicy(imbalance_cap=2)
+        view = RouteView((0, 1), {0: 3, 1: 0},
+                         {0: frozenset({"a", "b"}), 1: frozenset()},
+                         ("a", "b"))
+        assert p.choose(view) == (1, "miss")
+        assert PrefixAffinityPolicy(imbalance_cap=3).choose(view) \
+            == (0, "hit")
+        with pytest.raises(ValueError):
+            PrefixAffinityPolicy(imbalance_cap=0)
+
+
+# -- the pool behind a live gateway ----------------------------------------
+
+@pytest.fixture(scope="module")
+def pool(eng):
+    h = RouterHarness(eng, replicas=2, policy="round_robin")
+    yield h
+    h.close()
+
+
+class TestPoolGateway:
+    def test_concurrent_streams_across_replicas_token_exact(
+            self, pool, eng, rngv):
+        rng, v = rngv
+        prompts = [_prompt(rng, v, n) for n in (6, 11, 15, 9)]
+        news = [5, 4, 6, 3]
+        refs = [_ref(eng, p, n) for p, n in zip(prompts, news)]
+        results = [None] * 4
+
+        def drive(j):
+            results[j] = pool.stream(
+                {"prompt": [int(t) for t in prompts[j]],
+                 "max_new_tokens": news[j], "request_id": f"rt{j}"})
+
+        threads = [threading.Thread(target=drive, args=(j,))
+                   for j in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        for j in range(4):
+            code, events = results[j]
+            assert code == 200
+            assert _end(events)["status"] == "finished"
+            assert _tokens(events) == refs[j], f"stream {j} diverged"
+        # round robin spread the four arrivals two per replica
+        per_replica = [pool.replica_call(
+            i, lambda c: sum(1 for r in c.finished if str(r)
+                             .startswith("rt"))) for i in range(2)]
+        assert sorted(per_replica) == [2, 2]
+        assert all(pool.replica_call(i, _leak_free) for i in range(2))
+
+    def test_cancel_mid_stream_frees_kv_on_owner(self, pool, eng,
+                                                 rngv):
+        rng, v = rngv
+        p = _prompt(rng, v, 9)
+        ref = _ref(eng, p, 30)
+        del_codes = []
+
+        def cancel_after_2(n, payload):
+            if n == 2:
+                code, _ = pool.request("DELETE", "/v1/requests/rcan")
+                del_codes.append(code)
+
+        code, events = pool.stream(
+            {"prompt": [int(t) for t in p], "max_new_tokens": 30,
+             "request_id": "rcan"}, on_token=cancel_after_2)
+        assert code == 200 and del_codes == [200]
+        end = _end(events)
+        assert end["status"] == "cancelled"
+        toks = _tokens(events)
+        assert len(toks) >= 2 and toks == ref[:len(toks)]
+        assert all(pool.replica_call(i, _leak_free) for i in range(2))
+
+    def test_duplicate_rid_across_replicas_409(self, pool, rngv):
+        rng, v = rngv
+        p = [int(t) for t in _prompt(rng, v, 5)]
+        code, _ = pool.post_json({"prompt": p, "max_new_tokens": 2,
+                                  "request_id": "rdup",
+                                  "stream": False})
+        assert code == 200
+        # the retry would rotate to the OTHER replica, which never saw
+        # the id — the router must still answer 409, repeatedly
+        for _ in range(2):
+            code, resp = pool.post_json(
+                {"prompt": p, "max_new_tokens": 2,
+                 "request_id": "rdup", "stream": False})
+            assert code == 409
+        owner = [i for i in range(2) if pool.replica_call(
+            i, lambda c: "rdup" in c.finished)]
+        assert len(owner) == 1      # never re-ran on the twin
+
+    def test_live_duplicate_409_and_healthz_pool(self, pool, rngv):
+        rng, v = rngv
+        p = [int(t) for t in _prompt(rng, v, 6)]
+        got = {}
+        started = threading.Event()
+
+        def drive():
+            def first(n, payload):
+                started.set()
+            got["res"] = pool.stream(
+                {"prompt": p, "max_new_tokens": 25,
+                 "request_id": "rlive"}, on_token=first)
+
+        t = threading.Thread(target=drive)
+        t.start()
+        assert started.wait(120)
+        code, resp = pool.post_json({"prompt": p, "max_new_tokens": 2,
+                                     "request_id": "rlive",
+                                     "stream": False})
+        assert code == 409
+        code, _ = pool.request("DELETE", "/v1/requests/rlive")
+        assert code == 200
+        t.join(120)
+        assert _end(got["res"][1])["status"] == "cancelled"
+        code, hz = pool.get_json("/healthz")
+        assert code == 200 and hz["status"] == "ok"
+        assert hz["steps"] > 0      # pool-aggregated step count
+
+
+# -- affinity fallback on a live pool --------------------------------------
+
+class TestAffinityFallback:
+    def test_full_replica_falls_back_to_least_loaded(self, eng, rngv):
+        """Prime replica 0 with a family prefix, hold the pool, stack
+        affinity hits onto replica 0 until the imbalance cap trips:
+        the next shared-prefix request must route to replica 1 (a
+        recorded miss), and every stream still finishes token-exact."""
+        rng, v = rngv
+        router = _make_pool(eng, replicas=2, policy="prefix_affinity",
+                            imbalance_cap=1)
+        try:
+            base = [int(t) for t in _prompt(rng, v, 19)]
+            n = 3
+
+            def submit(rid, wait=True):
+                sub = _Collect()
+                router.submit(GenerationRequest(
+                    np.asarray(base, np.int32), n, request_id=rid),
+                    on_event=sub).result(60)
+                if wait:
+                    assert sub.done.wait(180), rid
+                return sub
+            prime = submit("aff0")          # cold: fallback -> replica 0
+            assert router.replica_summary(0)    # summary published
+            router.hold()
+            subs = [submit(f"aff{j}", wait=False) for j in (1, 2, 3)]
+            placed = [router._entries[f"aff{j}"].replica
+                      for j in (1, 2, 3)]
+            # hits stack on the matched replica until cap (1) trips,
+            # then least-loaded takes the overflow to replica 1
+            assert placed == [0, 0, 1]
+            router.release()
+            for sub in subs:
+                assert sub.done.wait(180)
+            ref = _ref(eng, base, n)
+            assert prime.tokens == ref
+            for sub in subs:
+                assert sub.tokens == ref
+        finally:
+            router.stop()
+
+
+# -- the heavy matrix (slow lane) ------------------------------------------
+
+@pytest.mark.slow
+class TestReplicaMatrix:
+    @pytest.mark.parametrize("policy", sorted(POLICIES))
+    def test_three_replica_pool_token_exact(self, eng, rngv, policy):
+        rng, v = rngv
+        router = _make_pool(eng, replicas=3, policy=policy)
+        try:
+            prompts = [[int(t) for t in _prompt(rng, v, 5 + 3 * j)]
+                       for j in range(6)]
+            refs = [_ref(eng, p, 4) for p in prompts]
+            subs = []
+            for j, p in enumerate(prompts):
+                sub = _Collect()
+                subs.append(sub)
+                router.submit(GenerationRequest(
+                    np.asarray(p, np.int32), 4,
+                    request_id=f"mx-{policy}-{j}"),
+                    on_event=sub).result(60)
+            for j, sub in enumerate(subs):
+                assert sub.done.wait(300), f"{policy} stream {j}"
+                assert sub.events[-1]["status"] == "finished"
+                assert sub.tokens == refs[j]
+            for i in range(3):
+                assert router.steppers[i].call(_leak_free).result(30)
+        finally:
+            router.stop()

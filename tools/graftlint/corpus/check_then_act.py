@@ -1,0 +1,133 @@
+# graftlint-corpus-expect: GL126 GL126 GL126
+"""Known-bad corpus: check-then-act split across two guarded regions
+of the same lock (GL126).
+
+The TOCTOU shape the lockset index can prove: a membership test of
+shared state under ``_lock`` in one ``with`` region, and the keyed
+mutation it gates in a LATER, separate ``with`` region of the same
+lock — the lock drops in between, so a concurrent holder invalidates
+the check before the act (stale ``del`` raises KeyError, a
+``not in`` guard double-inserts, a stale id resubmits twice).
+
+Clean tripwires: the merged-region idiom (check and act inside ONE
+``with``), the re-validate idiom (the act's region re-checks the
+membership itself — stale checks are harmless when the act re-asks),
+an act whose check lives under a DIFFERENT lock (that is GL121's
+inconsistent-guard territory, not a split region of one discipline),
+and a suppression demo for a documented benign race.
+"""
+import threading
+
+
+class SplitRegistry:
+    """Bad: every act releases the lock its check held."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = {}
+        self._done = {}
+
+    def retire(self, k):
+        with self._lock:
+            present = k in self._jobs
+        if present:
+            with self._lock:
+                del self._jobs[k]   # expect GL126: stale `in` check — key may be gone
+
+    def put_once(self, k, v):
+        with self._lock:
+            fresh = k not in self._jobs
+        if not fresh:
+            return False
+        with self._lock:
+            self._jobs[k] = v       # expect GL126: `not in` gate went stale — double-insert
+        return True
+
+    def promote(self, k):
+        with self._lock:
+            ok = k in self._jobs
+        self._audit(k)
+        if ok:
+            with self._lock:
+                self._done[k] = self._jobs.pop(k)  # expect GL126: pop gated by a check the lock no longer covers
+
+    def _audit(self, k):
+        return k
+
+
+class TwoLockRegistry:
+    """Clean for GL126: the check holds a DIFFERENT lock than the act
+    — not a split of ONE lock's discipline (two-lock inconsistency is
+    GL121's beat once threads touch it)."""
+
+    def __init__(self):
+        self._probe_lock = threading.Lock()
+        self._write_lock = threading.Lock()
+        self._jobs = {}
+
+    def retire(self, k):
+        with self._probe_lock:
+            present = k in self._jobs
+        if present:
+            with self._write_lock:
+                self._jobs.pop(k, None)
+
+
+class MergedRegistry:
+    """Clean: check and act share ONE guarded region — the lock holds
+    across both, nothing can interleave."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = {}
+
+    def retire(self, k):
+        with self._lock:
+            if k in self._jobs:
+                del self._jobs[k]
+
+    def put_once(self, k, v):
+        with self._lock:
+            if k not in self._jobs:
+                self._jobs[k] = v
+                return True
+        return False
+
+
+class RevalidatingRegistry:
+    """Clean: the fast-path check may go stale, but the act's region
+    RE-CHECKS under the lock before mutating — the canonical fix."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = {}
+
+    def retire_if_idle(self, k):
+        with self._lock:
+            present = k in self._jobs       # advisory fast-path peek
+        if not present:
+            return False
+        with self._lock:
+            if k in self._jobs:             # re-validated: atomic act
+                del self._jobs[k]
+                return True
+        return False
+
+
+class SuppressedRegistry:
+    """The benign-race escape hatch: a documented last-writer-wins
+    overwrite where a stale `not in` only costs a redundant write."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}
+
+    def memo(self, k, build):
+        with self._lock:
+            missing = k not in self._cache
+        if missing:
+            v = build(k)
+            with self._lock:
+                self._cache[k] = v  # graftlint: disable=GL126 - suppression demo: idempotent memo — a racing double-build writes the same value, and build() must run OUTSIDE the lock (GL125)
+        with self._lock:
+            return self._cache[k]

@@ -34,6 +34,16 @@ mid-mutation ("dictionary changed size during iteration", torn lists).
 The snapshot-under-lock-then-iterate idiom reads the collection INSIDE
 the guard and therefore never flags.
 
+GL126 check-then-act atomicity: a membership test of shared state
+(``if k in self._d``) under lock L in one ``with`` region, and a
+keyed mutation of the SAME state under the SAME lock in a LATER,
+separate ``with`` region of the same function — the lock is released
+between check and act, so another thread can invalidate the check
+before the act runs (the classic TOCTOU split: ``del d[k]`` raising,
+double-insert, double-free). The clean idiom — re-validating the
+membership INSIDE the act's region, or merging the two regions —
+never flags.
+
 GL125 callback-under-lock: a USER-SUPPLIED callable (a function
 parameter, a loop variable over a ``self.<attr>`` callback collection,
 or a ``self.<attr>`` assigned from a constructor parameter) invoked
@@ -320,6 +330,116 @@ def _ctor_param_attr(idx, oc):
                 if isinstance(sub, ast.Name) and sub.id in params:
                     return True
     return False
+
+
+# -- GL126 -------------------------------------------------------------------
+
+def _is_membership(ctx, a):
+    """True when access `a` is the object of an ``in`` / ``not in``
+    test (the kind classifier folds all iteration shapes into "iter";
+    the check-then-act hazard is specifically the membership probe)."""
+    p = ctx.parent(a.node)
+    while isinstance(p, ast.Attribute):
+        # `k in self._d.keys()` — climb to the Compare through the
+        # attribute/call chain
+        p = ctx.parent(p)
+    if isinstance(p, ast.Call):
+        p = ctx.parent(p)
+    return (isinstance(p, ast.Compare)
+            and any(isinstance(op, (ast.In, ast.NotIn))
+                    for op in p.ops))
+
+
+def _lock_regions(ls, ctx):
+    """{fn qualname: [(ident, lo, hi)]} for every resolved ``with
+    <lock>:`` in this file — the acquisition list knows line + ident,
+    the AST supplies the region extent."""
+    by_fn = {}
+    for acq in ls.acquisitions:
+        if acq.path != ctx.path:
+            continue
+        by_fn.setdefault(acq.fn.qualname, []).append(acq)
+    out = {}
+    for q, acqs in by_fn.items():
+        fi = acqs[0].fn
+        lines = {a.line: a.ident for a in acqs}
+        regions = []
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.With) and node.lineno in lines:
+                hi = max((getattr(n, "end_lineno", node.lineno) or
+                          node.lineno) for n in ast.walk(node))
+                regions.append((lines[node.lineno], node.lineno, hi))
+        out[q] = regions
+    return out
+
+
+@rule("GL126", "check-then-act-atomicity", "locksets",
+      applies=in_paddle_tpu)
+def check_then_act_atomicity(ctx):
+    """A membership test of shared state under lock L in one guarded
+    region, and a keyed mutation of the same state under the same L in
+    a LATER separate region of the same function: the lock drops
+    between check and act, so the checked fact can be invalidated by
+    another holder before the act runs — `if k in d` ... `del d[k]`
+    raises, `if k not in d` ... `d[k] = v` double-inserts. Atomicity
+    needs ONE region (merge them) or a re-check inside the act's
+    region (which never flags)."""
+    idx = ctx.project
+    if idx is None:
+        return
+    ls = idx.locksets()
+    regions_by_fn = _lock_regions(ls, ctx)
+    if not regions_by_fn:
+        return
+    for (path, cls, attr), accs in ls.groups_in(ctx.path):
+        checks = [a for a in accs
+                  if a.kind == "iter" and not ls.tainted(a)
+                  and _is_membership(ctx, a)]
+        if not checks:
+            continue
+        acts = [a for a in accs if a.kind == "mut"
+                and not ls.tainted(a)]
+        reported = set()
+        for m in sorted(acts, key=lambda a: (a.line, a.col)):
+            regions = regions_by_fn.get(m.fn.qualname, ())
+            m_regions = [(i, lo, hi) for (i, lo, hi) in regions
+                         if lo <= m.line <= hi and i != UNKNOWN]
+            if not m_regions or m.line in reported:
+                continue
+            # the clean idiom: the act's own region re-validates
+            if any(lo <= c.line <= hi
+                   for (_, lo, hi) in m_regions
+                   for c in checks if c.fn is m.fn):
+                continue
+            hit = None
+            for c in checks:
+                if c.fn is not m.fn:
+                    continue
+                for (ci, clo, chi) in regions:
+                    if ci == UNKNOWN or not clo <= c.line <= chi:
+                        continue
+                    for (mi, mlo, mhi) in m_regions:
+                        if mi == ci and mlo > chi:
+                            hit = (c, ci)
+                            break
+                    if hit:
+                        break
+                if hit:
+                    break
+            if hit is None:
+                continue
+            c, ident = hit
+            reported.add(m.line)
+            yield ctx.finding(
+                "GL126", m.node,
+                f"check-then-act split on {_label(m)}: its membership "
+                f"is tested under `{_short(idx, ident)}` at "
+                f"{c.path}:{c.line} but this {m.kind} runs in a "
+                f"SEPARATE `with` region of the same lock — the lock "
+                "drops between check and act, so another holder can "
+                "invalidate the check first (stale delete raises, "
+                "conditional insert doubles). Merge the two regions, "
+                "or re-validate the membership inside this one"), m.node
 
 
 _SHAPE_DESC = {

@@ -2,9 +2,10 @@
 # Static-analysis entry point: rule self-test corpus first (a lobotomized
 # rule must not green-light the tree scan; the selftest also fails any
 # ORPHANED corpus file no registered rule claims), then the full-tree
-# two-phase scan — all 31 rules incl. the lockset family (GL121-GL123
+# two-phase scan — all 32 rules incl. the lockset family (GL121-GL123
 # data-race/deadlock detection over per-object lock identity, GL125
-# callback-under-lock) and GL124 committed-JSON hygiene run in this
+# callback-under-lock, GL126 check-then-act split across two guarded
+# regions) and GL124 committed-JSON hygiene run in this
 # default pass. The summary
 # prints the per-phase timing split (phase1 parse+index, phase2 rules)
 # so a gate-cost regression is attributable at a glance. Extra args
@@ -49,6 +50,14 @@ if [ "${LINT_SKIP_SERVE:-0}" != "1" ]; then
   # degradation, a structured rejection, control-plane schema parses,
   # 0 new compile buckets after warmup
   python tools/serve_gateway.py --check tools/serve_gateway.json
+  # multi-replica router gate: N independent engine replicas behind one
+  # EngineRouter — every policy (round_robin / least_loaded /
+  # prefix_affinity) token-exact vs a single-engine reference on a
+  # shared-prefix workload, prefix_affinity strictly beats round_robin
+  # on cached-prefix tokens AND prefill sweep tokens (committed exact
+  # counts), a crashed replica's queued request resubmits to a survivor
+  # token-exact, 0 new compile buckets after per-replica warmup
+  python tools/serve_replica.py --check tools/serve_replica.json
   # train_obs gate: per-program cost/memory attribution (FLOPs, bytes,
   # peak HBM, MFU for the paged step / rewind / COW copy / pretrain
   # step), token-exact-neutral telemetry, census leak check — "MFU is

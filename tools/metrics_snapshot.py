@@ -785,6 +785,64 @@ def selfcheck():
     except ValueError:
         pass
 
+    # multi-replica router (ISSUE 19): the policy registry and the
+    # route-choice math are pure stdlib (no engine, no jax), and the
+    # router/replica metric families must export under their bounded
+    # label sets (`policy` a fixed literal set, `replica` world-bounded
+    # like `device`)
+    check(set(srv.POLICIES)
+          == {"round_robin", "least_loaded", "prefix_affinity"},
+          f"routing policy registry drifted: {sorted(srv.POLICIES)}")
+    RouteView = srv.router.RouteView
+    rr = srv.RoundRobinPolicy()
+    rv = RouteView((0, 1), {0: 3, 1: 0},
+                   {0: frozenset({"k1"}), 1: frozenset()},
+                   ("k1", "k2"))
+    check([rr.choose(rv) for _ in range(4)] == [0, 1, 0, 1],
+          "round-robin rotation wrong")
+    check(srv.LeastLoadedPolicy().choose(rv) == 1,
+          "least-loaded did not pick the idle replica")
+    aff = srv.PrefixAffinityPolicy(imbalance_cap=4)
+    check(aff.choose(rv) == (0, "hit"),
+          "affinity missed the replica holding the prefix")
+    check(srv.PrefixAffinityPolicy(imbalance_cap=2).choose(rv)
+          == (1, "miss"),
+          "imbalance cap did not veto the overloaded match")
+    check(srv.PrefixAffinityPolicy().choose(
+        RouteView((0, 1), {0: 0, 1: 0}, {0: frozenset(),
+                                         1: frozenset()},
+                  ("k1",))) == (0, "miss"),
+          "no-match affinity did not fall back to least-loaded")
+    try:
+        srv.EngineRouter([])
+        check(False, "empty replica pool not rejected")
+    except ValueError:
+        pass
+    inst.routed_requests().labels(policy="prefix_affinity",
+                                  replica="0").inc(2)
+    inst.router_affinity_hits().inc()
+    inst.router_affinity_misses().inc()
+    inst.router_resubmits().labels(replica="1").inc()
+    inst.router_replica_inflight().labels(replica="0").set(2)
+    inst.router_replicas_live().set(2)
+    promR = obs.to_prometheus()
+    for needle in (
+            'routed_requests_total{policy="prefix_affinity",'
+            'replica="0"} 2',
+            "router_affinity_hits_total 1",
+            "router_affinity_misses_total 1",
+            'router_resubmits_total{replica="1"} 1',
+            'router_replica_inflight{replica="0"} 2',
+            "router_replicas_live 2"):
+        check(needle in promR,
+              f"router family missing from exposition: {needle!r}")
+    parsedR = obs.parse_prometheus(promR)
+    check(any(n == "routed_requests_total" and v == 2
+              and lbl == {"policy": "prefix_affinity", "replica": "0"}
+              for n, lbl, v
+              in parsedR["routed_requests_total"]["samples"]),
+          "parse_prometheus lost the routed-requests counter")
+
     # kernel-autotune families (ISSUE 16): sweep accounting and the
     # winner-config gauges must export under their bounded label sets
     # (kernel names are code literals, `param` is a fixed 3-tuple) —

@@ -42,6 +42,21 @@ def render_request(dump, request, out=sys.stdout):
     for key in ("prompt_tokens", "generated_tokens", "queue_wait_s",
                 "ttft_s", "tpot_s", "retired"):
         print(f"  {key}: {digest[key]}", file=out)
+    # router lane: which replica served this request (and any crash
+    # resubmission hops), from the EngineRouter's route/resubmit events
+    hops = []
+    for s in sorted((x for x in spans
+                     if x["name"] in ("route", "resubmit")),
+                    key=lambda x: x["ts_us"]):
+        a = s["args"]
+        if s["name"] == "route":
+            hops.append(f"replica {a.get('replica')} "
+                        f"[{a.get('policy')}]")
+        else:
+            hops.append(f"resubmit -> replica {a.get('replica')} "
+                        f"({a.get('reason')})")
+    if hops:
+        print(f"  routing: {' ; '.join(hops)}", file=out)
     chunks = digest["prefill_chunks"]
     if chunks:
         granted = sum(c["granted"] or 0 for c in chunks)

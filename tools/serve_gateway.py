@@ -114,7 +114,10 @@ class BurnFlagMonitor:
 async def _request(port, method, path, body=None):
     reader, writer = await asyncio.open_connection("127.0.0.1", port)
     payload = b"" if body is None else json.dumps(body).encode()
-    head = f"{method} {path} HTTP/1.1\r\nHost: gw\r\n"
+    # one-shot client: opt out of HTTP/1.1 keep-alive so read-to-EOF
+    # below terminates (the gateway honors Connection: close)
+    head = (f"{method} {path} HTTP/1.1\r\nHost: gw\r\n"
+            "Connection: close\r\n")
     if payload:
         head += ("Content-Type: application/json\r\n"
                  f"Content-Length: {len(payload)}\r\n")

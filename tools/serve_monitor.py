@@ -232,6 +232,21 @@ def _fam_per_label(fams, name, label):
     return out
 
 
+def replica_strip(fams):
+    """' | replicas N [0:a 1:b]' from the router's per-replica
+    inflight gauge — empty for a single-engine gateway (the family
+    only exists when an EngineRouter fronts a pool)."""
+    repl = _fam_per_label(fams, "router_replica_inflight", "replica")
+    if not repl:
+        return ""
+    live = _fam_last(fams, "router_replicas_live")
+    cells = " ".join(
+        f"{r}:{v:g}" for r, v in sorted(repl.items(),
+                                        key=lambda kv: int(kv[0])))
+    n = int(live) if live is not None else len(repl)
+    return f" | replicas {n}/{len(repl)} [{cells}]"
+
+
 def scrape_leg(url, interval_s=2.0, count=0, out=sys.stdout):
     """Poll a live gateway's /metrics + /healthz and render the
     dashboard cross-process. `count` 0 = forever. Returns 0 once the
@@ -299,6 +314,7 @@ def scrape_leg(url, interval_s=2.0, count=0, out=sys.stdout):
               f" | inflight {g('serve_inflight_requests')}"
               f" queue {g('serve_queue_depth')}"
               f" | kv free {g('kv_blocks_free')}{mesh}"
+              f"{replica_strip(fams)}"
               f" | conns {g('gateway_live_connections')}"
               f" streams {g('gateway_live_streams')}"
               f" sse-pending {g('gateway_sse_pending_events')}"
