@@ -49,13 +49,15 @@ Attention" (PAPERS.md); the reference framework's analogue is the
 block_multihead_attention serving stack.
 """
 import collections
+import os
 import time
 
 import numpy as np
 
 from ...observability import instrument as _metrics
 from ...observability import tracing as _tracing
-from ...ops.pallas.paged_attention import (build_ragged_work, default_pack,
+from ...ops.pallas.paged_attention import (RaggedWorkBuilder,
+                                           build_ragged_work, default_pack,
                                            next_pow2)
 
 __all__ = ["BlockAllocator", "GenerationRequest", "RequestResult",
@@ -158,6 +160,11 @@ class BlockAllocator:
     # import; the engine's degradation backstop catches exactly this)
     OutOfBlocks = KVAllocFailure
 
+    # bounded prefix-index delta log: long enough to absorb every
+    # register/evict between two consecutive summary refreshes on a
+    # realistic workload; overflow just costs one full-walk rebuild
+    INDEX_LOG = 128
+
     def __init__(self, num_blocks, reserved=1):
         if num_blocks <= reserved:
             raise ValueError(
@@ -172,6 +179,8 @@ class BlockAllocator:
         self._pool = collections.OrderedDict()  # rc==0 but reusable, LRU
         self.high_water = 0     # max PHYSICAL blocks ever in use at once
         self.evictions = 0      # pooled blocks reclaimed for fresh allocs
+        self.index_epoch = 0    # bumps on every index add/remove
+        self._index_log = collections.deque(maxlen=self.INDEX_LOG)
 
     @property
     def num_free(self):
@@ -222,6 +231,8 @@ class BlockAllocator:
             b, key = self._pool.popitem(last=False)
             del self._index[key]
             del self._key_of[b]
+            self.index_epoch += 1
+            self._index_log.append((False, key))
             self.evictions += 1
             _metrics.prefix_cache_evictions().inc()
         else:
@@ -273,6 +284,8 @@ class BlockAllocator:
             return False
         self._index[key] = b
         self._key_of[b] = key
+        self.index_epoch += 1
+        self._index_log.append((True, key))
         return True
 
     def lookup(self, key):
@@ -287,6 +300,20 @@ class BlockAllocator:
         it knows exactly which leading blocks this allocator can map
         without a prefill sweep."""
         return frozenset(self._index)
+
+    def index_delta_since(self, epoch):
+        """Ordered ``(added, key)`` ops replaying the prefix index from
+        `epoch` to ``index_epoch``, or None when the bounded log no
+        longer reaches back that far (caller rebuilds from
+        ``index_keys()``). Replay is order-sensitive: a key can leave
+        the index (LRU reclaim) and re-enter under a new block."""
+        n = self.index_epoch - epoch
+        if n < 0 or n > len(self._index_log):
+            return None
+        if n == 0:
+            return self.index_epoch, ()
+        log = list(self._index_log)
+        return self.index_epoch, tuple(log[len(log) - n:])
 
     def acquire(self, key):
         """Index hit -> the physical block with its refcount bumped
@@ -572,7 +599,9 @@ class ContinuousBatchingEngine:
                  token_budget=None, spec_k=0, spec_ngram=2,
                  tpot_slo=None, min_prefill_chunk=64, prefix_cache=False,
                  monitor=None, memory_watch=None, shed_on_pressure=False,
-                 shed_priority_min=1, autotune_cache=None):
+                 shed_priority_min=1, autotune_cache=None,
+                 host_fastpath=True, host_debug_check=False,
+                 overlap_fetch=False):
         import jax
 
         self.engine = engine
@@ -724,6 +753,59 @@ class ContinuousBatchingEngine:
                 self._pack = max(1, min(int(cfg["pack"]),
                                         self.max_batch))
                 self.prefill_chunk = max(1, int(cfg["prefill_chunk"]))
+        # host fast path (ISSUE 20): incremental work lists + in-place
+        # step inputs. Built AFTER autotune so the builder bakes in the
+        # final pack. ON by default — every array it hands the compiled
+        # step is elementwise identical to the from-scratch build (the
+        # committed serving baselines stay byte-stable); OFF keeps the
+        # legacy per-step-rebuild path alive as the reference the debug
+        # cross-check and the host bench leg compare against.
+        self._host_fastpath = bool(host_fastpath)
+        self._host_debug = bool(host_debug_check) or bool(
+            os.environ.get("PADDLE_TPU_HOST_DEBUG_CHECK"))
+        # overlap is OPT-IN: it reorders token-independent host
+        # bookkeeping (non-completing prefill advancement, stall
+        # events, monitor/memory ticks) to before the token fetch, so
+        # tick cadence sees last step's samples — token-exact (pinned
+        # by serve_bench --host in every mode), but not span/metric-
+        # order-identical, hence not the default
+        self._overlap_fetch = bool(overlap_fetch)
+        self._work_builder = RaggedWorkBuilder(
+            self.max_batch, self.max_blocks, self.block_size,
+            self._pack) if self._host_fastpath else None
+        # persistent step-input buffers, keyed by the same bucketed
+        # widths that key the compiles — steady state allocates nothing
+        self._slab_bufs = {}        # c -> [B, c] int32
+        self._sel_bufs = {}         # w_sel -> [B, w_sel] int32
+        self._q_arr_buf = np.zeros(self.max_batch, np.int32)
+        self._attn_buf = np.zeros(self.max_batch, np.int32)
+        self._rw_old_buf = np.zeros(self.max_batch, np.int32)
+        self._ztab_buf = None       # lazily: only prefix-on rewinds
+        self._input_copy_bytes = 0  # engine-local mirror of the counter
+        self._overlap_steps = 0
+        self._last_host_phases = {}
+        self._wb_last = (0, 0, 0, 0)    # registry-mirrored builder state
+
+    def host_stats(self):
+        """Engine-local host-fast-path accounting (the process registry
+        aggregates across engines; tests and serve_bench want THIS
+        engine's numbers): work-segment reuse/rebuild and assembly-mode
+        counts from the work-list builder, step-input copy bytes,
+        overlap-mode step count, and the last step's host-phase split
+        in seconds."""
+        wb = self._work_builder
+        return {
+            "fastpath": self._host_fastpath,
+            "overlap": self._overlap_fetch,
+            "segments_reused": wb.segments_reused if wb else 0,
+            "segments_rebuilt": wb.segments_rebuilt if wb else 0,
+            "assemblies_full": wb.assemblies_full if wb else 0,
+            "assemblies_incremental":
+                wb.assemblies_incremental if wb else 0,
+            "input_copy_bytes": self._input_copy_bytes,
+            "overlap_steps": self._overlap_steps,
+            "phases": dict(self._last_host_phases),
+        }
 
     # -- scheduling ---------------------------------------------------------
 
@@ -844,6 +926,22 @@ class ContinuousBatchingEngine:
             return frozenset()
         return self.allocator.index_keys()
 
+    def prefix_index_version(self):
+        """Monotonic version of :meth:`prefix_index_summary`: bumps on
+        every index add/evict. Pinned at 0 when prefix caching is off
+        (the summary is the constant empty set)."""
+        return self.allocator.index_epoch if self._prefix_on else 0
+
+    def prefix_index_delta(self, since_version):
+        """Incremental complement to :meth:`prefix_index_summary`: the
+        new version plus the ordered ``(added, key)`` ops since
+        `since_version`, or None when the allocator's bounded delta
+        log has aged out (the caller falls back to the full summary
+        walk). Same thread contract as the summary."""
+        if not self._prefix_on:
+            return 0, ()
+        return self.allocator.index_delta_since(since_version)
+
     def _deadline_passed(self, req, now=None):
         if req.deadline_steps is not None \
                 and req._submit_step is not None \
@@ -855,6 +953,39 @@ class ContinuousBatchingEngine:
             if now - req.submit_time >= req.deadline_s:
                 return True
         return False
+
+    def _count_input_bytes(self, n):
+        # the legacy per-step-rebuild path's copy bill: bytes freshly
+        # allocated for compiled-step inputs. The fast path never calls
+        # this — the "copy bytes drop to 0" half of the ISSUE-20 gate.
+        self._input_copy_bytes += int(n)
+        _metrics.serve_input_copy_bytes().inc(int(n))
+
+    def _check_host_state(self, attn_lens, q_arr, work, t_total, pack):
+        """Debug cross-check (host_debug_check=True, or the
+        PADDLE_TPU_HOST_DEBUG_CHECK env var): the incremental work list
+        must equal a from-scratch `build_ragged_work` over the same
+        persistent tables/lens, elementwise including padding. A
+        mismatch means a table-writing site forgot `_dirty_slot` — fail
+        the step loudly instead of serving a stale block mapping."""
+        ref, _, rtot, rpack = build_ragged_work(
+            self.tables, attn_lens, self.block_size, self._pack,
+            bucket_to=next_pow2, q_lens=q_arr)
+        if rtot != t_total or rpack != pack or not all(
+                np.array_equal(a, b) for a, b in zip(ref, work)):
+            raise AssertionError(
+                "host fast path diverged from the from-scratch "
+                f"work-list rebuild at step {self._step_count}: a "
+                "block-table mutation site is missing its _dirty_slot "
+                "mark")
+
+    def _dirty_slot(self, i):
+        # slot i's block-table row just changed: its cached work-list
+        # segment is stale. Every table-writing site funnels through
+        # here (admit / prefix match / COW / grow / rewind / preempt /
+        # retire) — the dirty-slot schedule the host bench leg pins.
+        if self._work_builder is not None:
+            self._work_builder.mark_dirty(i)
 
     def _finish_slot(self, i, status, reason=None):
         """Terminal retirement of slot i, whatever the cause: free its
@@ -869,6 +1000,7 @@ class ContinuousBatchingEngine:
         self.slots[i] = None
         self.tables[i] = 0
         self.lens[i] = 0
+        self._dirty_slot(i)
         req.status = status
         req.status_reason = reason
         res = RequestResult(
@@ -1070,6 +1202,7 @@ class ContinuousBatchingEngine:
         self.slots[i] = None
         self.tables[i] = 0
         self.lens[i] = 0
+        self._dirty_slot(i)
         req.status = "preempted"
         req.preemptions += 1
         req.progress = 0
@@ -1211,6 +1344,7 @@ class ContinuousBatchingEngine:
             self.slots[i] = req
             self.tables[i] = 0
             self.lens[i] = 0
+            self._dirty_slot(i)
 
     # -- automatic prefix caching -------------------------------------------
 
@@ -1253,6 +1387,7 @@ class ContinuousBatchingEngine:
             idx = len(req.blocks)
             req.blocks.append(blk)
             self.tables[i, idx] = blk
+            self._dirty_slot(i)
             req._prefix_key = key
             req._registered += 1
             req.progress += bs
@@ -1305,6 +1440,7 @@ class ContinuousBatchingEngine:
         self.allocator.free([old])      # decref; other holders keep it
         req.blocks[idx] = new
         self.tables[i, idx] = new
+        self._dirty_slot(i)
         req._cow_reserve = 0
         self.cache_stats["cow_copies"] += 1
         _metrics.prefix_cache_cow().inc()
@@ -1488,6 +1624,7 @@ class ContinuousBatchingEngine:
                     blk = self.allocator.alloc()
                     req.blocks.append(blk)
                     self.tables[i, len(req.blocks) - 1] = blk
+                    self._dirty_slot(i)
                 return
             except KVAllocFailure:
                 # the allocator's exhaustion type ONLY: a device-side
@@ -1543,13 +1680,26 @@ class ContinuousBatchingEngine:
             if self.memory_watch is not None:
                 self.memory_watch.tick()
             return len(self.queue) + self.num_active
+        pc_sched = time.perf_counter()
         # token slab [B, C]: C is the widest span this step, bucketed to
         # a power of two (1 for an all-decode step) so slab shapes — and
         # the programs they key — stay off the per-prompt-length
         # treadmill. Idle slots and budget-starved prefill slots have
         # q_len 0: zero slab tokens, zero work entries, output ignored.
+        # Fast path: per-width persistent buffers zero-filled in place —
+        # a steady-state step allocates nothing (a fresh width keys a
+        # fresh compile anyway, so buffer creation rides warmup).
         c = int(next_pow2(int(q_lens.max())))
-        slab = np.zeros((self.max_batch, c), np.int32)
+        if self._host_fastpath:
+            slab = self._slab_bufs.get(c)
+            if slab is None:
+                slab = np.zeros((self.max_batch, c), np.int32)
+                self._slab_bufs[c] = slab
+            else:
+                slab.fill(0)
+        else:
+            slab = np.zeros((self.max_batch, c), np.int32)
+            self._count_input_bytes(slab.nbytes)
         for i in active:
             req = self.slots[i]
             n = int(q_lens[i])
@@ -1571,7 +1721,16 @@ class ContinuousBatchingEngine:
         # function of c and the engine-static spec_k, so the (t_total,
         # c) bucket pair still keys every compile.
         w_sel = min(c, 1 + self.spec_k)
-        sel = np.zeros((self.max_batch, w_sel), np.int32)
+        if self._host_fastpath:
+            sel = self._sel_bufs.get(w_sel)
+            if sel is None:
+                sel = np.zeros((self.max_batch, w_sel), np.int32)
+                self._sel_bufs[w_sel] = sel
+            else:
+                sel.fill(0)
+        else:
+            sel = np.zeros((self.max_batch, w_sel), np.int32)
+            self._count_input_bytes(sel.nbytes)
         for i in active:
             req = self.slots[i]
             n = int(q_lens[i])
@@ -1581,11 +1740,28 @@ class ContinuousBatchingEngine:
                 sel[i, 0] = n - 1
             else:
                 sel[i, :n] = np.arange(n)
-        q_arr = q_lens.astype(np.int32)
-        attn_lens = (self.lens + q_arr).astype(np.int32)
-        work, _, t_total, pack = build_ragged_work(
-            self.tables, attn_lens, self.block_size, self._pack,
-            bucket_to=next_pow2, q_lens=q_arr)
+        if self._host_fastpath:
+            # in-place step inputs: the persistent int32 views mutate
+            # under np.copyto/np.add, and the work list assembles
+            # incrementally — only slots the dirty schedule touched
+            # rebuild their segments (RaggedWorkBuilder)
+            q_arr = self._q_arr_buf
+            q_arr[:] = q_lens
+            attn_lens = self._attn_buf
+            np.add(self.lens, q_arr, out=attn_lens)
+            work, _, t_total, pack = self._work_builder.build(
+                self.tables, attn_lens, q_arr)
+            if self._host_debug:
+                self._check_host_state(attn_lens, q_arr, work, t_total,
+                                       pack)
+        else:
+            q_arr = q_lens.astype(np.int32)
+            attn_lens = (self.lens + q_arr).astype(np.int32)
+            work, _, t_total, pack = build_ragged_work(
+                self.tables, attn_lens, self.block_size, self._pack,
+                bucket_to=next_pow2, q_lens=q_arr)
+            self._count_input_bytes(q_arr.nbytes + attn_lens.nbytes
+                                    + sum(a.nbytes for a in work))
         # the (padded work-list length, slab width) pair is the ONLY
         # shape the scheduler varies step to step — a pair not seen
         # before keys a fresh compile of the step program
@@ -1618,10 +1794,69 @@ class ContinuousBatchingEngine:
                 "psum", group="tp",
                 nbytes=self.engine.tp_step_comm_bytes(self.max_batch, c))
         pc_step = time.perf_counter()
+        # tables/lens go in as the persistent scheduler arrays
+        # themselves: jit snapshots committed numpy arguments at
+        # dispatch, so host mutation AFTER this call (the overlap
+        # window below, next step's bookkeeping) can never race the
+        # device read — the per-step asarray round-trip the fast path
+        # retired was pure copy discipline
         toks2, self.caches = self.engine._paged_step(
             self.engine._w, self.caches, slab, q_arr, sel,
-            np.asarray(self.tables), np.asarray(self.lens), tuple(work),
+            self.tables, self.lens, tuple(work),
             pack, np.float32(self._temp), np.float32(self._topp), sub)
+        pc_disp = time.perf_counter()
+        pc_ovl = pc_disp
+        ticked = False
+        emitted = 0
+        rewinds = []    # (slot, new_end, old_end): rejected draft spans
+        slot_spans = []  # (slot, request_id, span name, args) this step
+        pre_done = set()    # slots the overlap window fully handled
+        if self._overlap_fetch:
+            # overlap window: host work that cannot depend on this
+            # step's sampled tokens runs while the device executes —
+            # starved-slot stall bookkeeping, prefill-chunk advancement
+            # for chunks that do NOT complete their prompt (the prompt
+            # is immutable; only the completing chunk samples a token),
+            # and the monitor/memory tick cadence (which consequently
+            # evaluates the PREVIOUS step's samples — the eager path
+            # ticks after commit). Token-exact in every scheduler mode
+            # (pinned by serve_bench --host): nothing here feeds the
+            # accept/rewind loop.
+            for i in active:
+                req = self.slots[i]
+                n = int(q_lens[i])
+                if n == 0:
+                    if req.progress < req._resume_len:
+                        if i in self._pending_stalls:
+                            tr.event("stall_cache_pending",
+                                     request=req.request_id,
+                                     prompt_remaining=req._resume_len
+                                     - req.progress)
+                        else:
+                            tr.event("stall_budget",
+                                     request=req.request_id,
+                                     prompt_remaining=req._resume_len
+                                     - req.progress,
+                                     token_budget=self.token_budget)
+                    pre_done.add(i)
+                elif req.progress < req._resume_len \
+                        and req.progress + n < req._resume_len:
+                    requested, granted = self._sched_info.get(i, (n, n))
+                    slot_spans.append(
+                        (i, req.request_id, "prefill_chunk",
+                         {"width": n, "granted": granted,
+                          "requested": requested,
+                          "progress": req.progress + n}))
+                    self.lens[i] += n
+                    req.progress += n
+                    pre_done.add(i)
+            if self.monitor is not None:
+                self.monitor.tick()
+            if self.memory_watch is not None:
+                self.memory_watch.tick()
+            ticked = True
+            self._overlap_steps += 1
+            pc_ovl = time.perf_counter()
         toks2 = np.asarray(toks2)      # [B, W]: a sample per sel column
         t_done = time.monotonic()
         pc_done = time.perf_counter()
@@ -1635,10 +1870,9 @@ class ContinuousBatchingEngine:
                     rid = self.slots[i].request_id
                     self._comm_seconds[rid] = self._comm_seconds.get(
                         rid, 0.0) + comm_dur
-        emitted = 0
-        rewinds = []    # (slot, new_end, old_end): rejected draft spans
-        slot_spans = []  # (slot, request_id, span name, args) this step
         for i in active:
+            if i in pre_done:
+                continue        # settled in the overlap window above
             req = self.slots[i]
             n = int(q_lens[i])
             if n == 0:
@@ -1735,15 +1969,35 @@ class ContinuousBatchingEngine:
                             else:
                                 shared_drops.append((i, idx))
                 if shared_drops:
-                    ztab = self.tables.copy()
+                    if self._host_fastpath:
+                        # persistent retarget scratch (lazy: only
+                        # prefix-on rewinds with shared drops ever
+                        # need a diverging table view)
+                        if self._ztab_buf is None:
+                            self._ztab_buf = self.tables.copy()
+                        else:
+                            np.copyto(self._ztab_buf, self.tables)
+                        ztab = self._ztab_buf
+                    else:
+                        ztab = self.tables.copy()
+                        self._count_input_bytes(ztab.nbytes)
                     for i, idx in shared_drops:
                         ztab[i, idx] = 0
-            new_l = self.lens.copy()
-            old_l = self.lens.copy()
+            if self._host_fastpath:
+                # persistent-buffer discipline (GL109 family): new_l IS
+                # the settled lens array — jit snapshots it at dispatch
+                # — and old_l reuses one preallocated scratch row
+                new_l = self.lens
+                old_l = self._rw_old_buf
+                np.copyto(old_l, self.lens)
+            else:
+                new_l = self.lens.copy()
+                old_l = self.lens.copy()
+                self._count_input_bytes(new_l.nbytes + old_l.nbytes)
             for i, _, oe in rewinds:
                 old_l[i] = oe
             self.caches = self.engine._paged_rewind(
-                self.caches, np.asarray(ztab), new_l, old_l, c)
+                self.caches, ztab, new_l, old_l, c)
             for i, ne, _ in rewinds:
                 blocks_freed[i] = self._rewind_blocks(i, ne)
             self._update_pool_gauges()
@@ -1767,7 +2021,12 @@ class ContinuousBatchingEngine:
         dur = t_done - t_begin
         tr.record_span("serve_step", pc_begin * 1e6,
                        (pc_done - pc_begin) * 1e6, step=self._step_count,
-                       work=t_total, chunk=c, emitted=emitted)
+                       work=t_total, chunk=c, emitted=emitted,
+                       host_sched_us=int((pc_sched - pc_begin) * 1e6),
+                       host_build_us=int((pc_step - pc_sched) * 1e6),
+                       host_dispatch_us=int((pc_disp - pc_step) * 1e6),
+                       host_overlap_us=int((pc_ovl - pc_disp) * 1e6),
+                       host_fetch_us=int((pc_done - pc_ovl) * 1e6))
         self._step_count += 1
         _metrics.serve_step_seconds().observe(dur)
         if emitted:
@@ -1779,15 +2038,52 @@ class ContinuousBatchingEngine:
         # engine is prompt-bound
         _metrics.serve_effective_tokens_per_step().set(emitted)
         self._maybe_shrink_chunk()
-        if self.monitor is not None:
-            # host-side cadence hook: registry sample + burn-rate pass
+        if not ticked:
+            # host-side cadence hooks: registry sample + burn-rate pass
             # when the monitor's cadence elapsed, a monotonic compare
             # otherwise — AFTER the step's own metrics landed, so a
-            # breach evaluation always sees this step's samples
-            self.monitor.tick()
-        if self.memory_watch is not None:
-            # same cadence contract: HBM/census gauges + hbm_pressure
-            self.memory_watch.tick()
+            # breach evaluation always sees this step's samples (the
+            # overlap window already ticked, one step behind, when
+            # overlap_fetch is on)
+            if self.monitor is not None:
+                self.monitor.tick()
+            if self.memory_watch is not None:
+                # same cadence contract: HBM/census + hbm_pressure
+                self.memory_watch.tick()
+        pc_end = time.perf_counter()
+        phases = {"schedule": pc_sched - pc_begin,
+                  "build": pc_step - pc_sched,
+                  "dispatch": pc_disp - pc_step,
+                  "overlap": pc_ovl - pc_disp,
+                  "fetch": pc_done - pc_ovl,
+                  "commit": pc_end - pc_done}
+        self._last_host_phases = phases
+        hp = _metrics.serve_host_phase_seconds()
+        hp.labels(phase="schedule").observe(phases["schedule"])
+        hp.labels(phase="build").observe(phases["build"])
+        hp.labels(phase="dispatch").observe(phases["dispatch"])
+        hp.labels(phase="overlap").observe(phases["overlap"])
+        hp.labels(phase="fetch").observe(phases["fetch"])
+        hp.labels(phase="commit").observe(phases["commit"])
+        wb = self._work_builder
+        if wb is not None:
+            # registry mirror of the builder's monotonic counters: inc
+            # by this step's delta so the process-wide families stay
+            # exact sums across engines
+            last = self._wb_last
+            cur = (wb.segments_reused, wb.segments_rebuilt,
+                   wb.assemblies_incremental, wb.assemblies_full)
+            segs = _metrics.serve_work_segments()
+            if cur[0] > last[0]:
+                segs.labels(event="reused").inc(cur[0] - last[0])
+            if cur[1] > last[1]:
+                segs.labels(event="rebuilt").inc(cur[1] - last[1])
+            asm = _metrics.serve_work_assemblies()
+            if cur[2] > last[2]:
+                asm.labels(mode="incremental").inc(cur[2] - last[2])
+            if cur[3] > last[3]:
+                asm.labels(mode="full").inc(cur[3] - last[3])
+            self._wb_last = cur
         return len(self.queue) + self.num_active
 
     def _rewind_blocks(self, i, new_end):
@@ -1808,6 +2104,7 @@ class ContinuousBatchingEngine:
         while len(req.blocks) > need:
             blk = req.blocks.pop()
             self.tables[i, len(req.blocks)] = 0
+            self._dirty_slot(i)
             self.allocator.free([blk])
             freed += 1
         return freed
@@ -1908,6 +2205,11 @@ class ContinuousBatchingEngine:
         request was active in (the host-side attribution the per-step
         `collective` span records) — and the mesh width ``tp``."""
         out = _tracing.request_summary(request_id)
+        # ISSUE 20: the engine's last-step host-phase split (seconds,
+        # schedule/build/dispatch/overlap/fetch/commit) rides on every
+        # digest — the live counterpart of the per-step `host` args the
+        # serve_step spans carry into flight dumps
+        out["host_phases"] = dict(self._last_host_phases)
         if self._tp > 1:
             out["tp"] = self._tp
             # live requests accumulate in the dict; terminal ones carry

@@ -38,6 +38,8 @@ __all__ = [
     "train_tokens_per_s", "train_host_seconds",
     "autotune_trials", "autotune_cache_hits", "autotune_cache_misses",
     "autotune_winner",
+    "serve_host_phase_seconds", "serve_work_segments",
+    "serve_work_assemblies", "serve_input_copy_bytes",
 ]
 
 
@@ -65,6 +67,45 @@ def serve_step_seconds():
     return get_registry().histogram(
         "serve_step_seconds",
         help="one scheduler tick + compiled decode step (host wall)")
+
+
+def serve_host_phase_seconds():
+    return get_registry().histogram(
+        "serve_host_phase_seconds",
+        help="host side of one serving step, split by phase: schedule "
+             "(retire/admit/chunk grants/grow), build (slab/sel/work-"
+             "list assembly), dispatch (compiled-step enqueue), overlap "
+             "(token-independent host work hidden under device "
+             "execution), fetch (block on sampled tokens), commit "
+             "(accept/rewind/emission bookkeeping)",
+        labels=("phase",))     # bounded: the six phases above
+
+
+def serve_work_segments():
+    return get_registry().counter(
+        "serve_work_segments_total",
+        help="per-slot ragged work-list segments per step, by outcome: "
+             "reused (buffer entry already correct) vs rebuilt (slot "
+             "dirtied by admit/grow/COW/rewind/preempt/retire)",
+        labels=("event",))     # bounded: reused | rebuilt
+
+
+def serve_work_assemblies():
+    return get_registry().counter(
+        "serve_work_assemblies_total",
+        help="work-list assemblies by mode: incremental (layout + "
+             "bucket unchanged, only dirty segments rewritten) vs full "
+             "(re-laid out into the bucket buffer)",
+        labels=("mode",))      # bounded: incremental | full
+
+
+def serve_input_copy_bytes():
+    return get_registry().counter(
+        "serve_step_input_copy_bytes_total",
+        help="bytes freshly allocated/copied for compiled-step inputs "
+             "(slab, sel, work list, q/attn lens) — 0 in steady state "
+             "on the host fast path, nonzero only on the legacy "
+             "per-step-rebuild path")
 
 
 def dispatch_seconds():

@@ -296,6 +296,191 @@ def build_ragged_work(block_tables, context_lens, block_size, pack,
     return arrs, t_real, t_total, pack
 
 
+class RaggedWorkBuilder:
+    """Incremental `build_ragged_work`: same nine arrays, same padding,
+    same bucket math — assembled into persistent per-bucket buffers
+    instead of per-step Python lists.
+
+    The serving invariant this exploits: a steady-state decode slot's
+    (seq, block) entries are STRUCTURALLY constant step to step — its
+    seq/group/row/position columns never change, and its block-id column
+    only changes when the allocator touches the slot's table row
+    (admit, grow, COW, rewind, preempt, retire). The engine marks
+    exactly those sites dirty; everything else reuses the segment
+    already sitting in the buffer. Only the per-entry query span
+    (q_start, q_len) is refreshed every step — q_start advances with
+    every committed token, so it can never be cached — as one scalar
+    slice-fill per active slot.
+
+    Two assembly modes, chosen per step:
+      * incremental — the per-slot segment layout AND the padded bucket
+        match the previous step: only dirtied slots' block columns are
+        rewritten (at unchanged offsets), flags and padding stand.
+      * full — layout or bucket changed: every active slot's segment is
+        re-laid out (vectorized row-slice copies, still no Python entry
+        lists), flags recomputed, the pad tail refreshed.
+
+    Counters (`segments_reused` / `segments_rebuilt` / `assemblies_*`)
+    count ACTIVE slots only, so a steady-state decode step scores 100%
+    reuse — the number `serve_bench --host` pins.
+
+    The returned arrays are views of the persistent bucket buffer: jit
+    copies committed host arguments at dispatch, so mutating them on
+    the NEXT build is safe once the previous step was dispatched."""
+
+    def __init__(self, batch, max_blocks, block_size, pack,
+                 bucket_to=next_pow2):
+        self.batch = int(batch)
+        self.max_blocks = int(max_blocks)
+        self.block_size = int(block_size)
+        self.pack = max(1, min(int(pack), self.batch))
+        self.bucket_to = bucket_to
+        b = self.batch
+        # per-slot cached state: block-column validity (dirty flag) and
+        # the segment length the buffer currently holds for the slot
+        self._dirty = np.ones(b, bool)      # nothing cached yet
+        self._seg_n = np.full(b, -1, np.int64)
+        # scratch (size-b host math, reused every step)
+        self._ncov = np.zeros(b, np.int64)
+        self._seglen = np.zeros(b, np.int64)
+        self._off = np.zeros(b + 1, np.int64)
+        self._arange = np.arange(self.max_blocks, dtype=np.int32)
+        self._pad_pos = (1 << 30) // self.block_size
+        # bucket buffers: t_total -> (nine arrays, state dict). `state`
+        # remembers the layout the buffer holds so a return to the same
+        # bucket after a detour still re-lays out correctly.
+        self._bufs = {}
+        self._last_total = None     # bucket used by the previous build
+        self._empty = tuple(np.zeros(0, np.int32) for _ in range(9))
+        # counters — monotonic, read by the engine's host_stats
+        self.segments_reused = 0
+        self.segments_rebuilt = 0
+        self.assemblies_full = 0
+        self.assemblies_incremental = 0
+
+    def mark_dirty(self, slot):
+        """Invalidate slot's cached block column. Call from every site
+        that writes the slot's block-table row."""
+        self._dirty[slot] = True
+
+    def mark_all_dirty(self):
+        self._dirty[:] = True
+
+    def _bucket_buf(self, t_total):
+        ent = self._bufs.get(t_total)
+        if ent is None:
+            arrs = [np.zeros(t_total, np.int32) for _ in range(9)]
+            arrs[4][:] = self._pad_pos     # wpos: fully-masked sentinel
+            ent = (tuple(arrs), {"seglen": None, "t_real": 0,
+                                 "last_grp": -1})
+            self._bufs[t_total] = ent
+        return ent
+
+    def build(self, block_tables, context_lens, q_lens):
+        """Drop-in for `build_ragged_work(tables, lens, block_size,
+        pack, bucket_to=..., q_lens=...)` over the persistent engine
+        arrays. `context_lens` counts the TOTAL span (len + q) exactly
+        like the from-scratch builder."""
+        b = self.batch
+        bs = self.block_size
+        ql = q_lens
+        # n_cov per slot: blocks the attention span touches, clipped to
+        # the table width (over-capacity lens walk only real blocks)
+        np.floor_divide(
+            np.asarray(context_lens, np.int64) + (bs - 1), bs,
+            out=self._ncov)
+        np.minimum(self._ncov, self.max_blocks, out=self._ncov)
+        np.multiply(self._ncov, ql > 0, out=self._seglen)
+        np.cumsum(self._seglen, out=self._off[1:])
+        t_real = int(self._off[b])
+        if t_real == 0:
+            # no work entries at all (every active slot budget-starved):
+            # the from-scratch builder skips bucketing and returns nine
+            # empty arrays — reproduce that, and force a full re-layout
+            # on the next nonempty step
+            self._last_total = None
+            return self._empty, 0, 0, self.pack
+        t_total = t_real
+        if self.bucket_to is not None:
+            t_total = max(t_real, int(self.bucket_to(t_real)))
+        arrs, state = self._bucket_buf(t_total)
+        ws, wg, wr, wblk, wpos, wfirst, wlast, wqs, wql = arrs
+        # incremental only when this very buffer was written by the
+        # PREVIOUS build (dirty flags are global, not per-bucket: after
+        # a detour through another bucket they no longer describe this
+        # buffer's staleness) and the slot layout is unchanged
+        incremental = (
+            t_total == self._last_total
+            and state["seglen"] is not None
+            and np.array_equal(state["seglen"], self._seglen))
+        reused = rebuilt = 0
+        active = np.nonzero(self._seglen)[0]
+        for s in active:
+            off = int(self._off[s])
+            n = int(self._seglen[s])
+            fresh = bool(self._dirty[s]) or int(self._seg_n[s]) != n
+            if fresh:
+                rebuilt += 1
+            else:
+                reused += 1
+            if not incremental or fresh:
+                end = off + n
+                if not incremental:
+                    ws[off:end] = s
+                    wg[off:end] = s // self.pack
+                    wr[off:end] = s % self.pack
+                    wpos[off:end] = self._arange[:n]
+                wblk[off:end] = block_tables[s, :n]
+                self._seg_n[s] = n
+                self._dirty[s] = False
+            # the query span changes every step a token commits: always
+            # refreshed, never part of the cached segment
+            q = int(ql[s])
+            wqs[off:off + n] = max(int(context_lens[s]) - q, 0)
+            wql[off:off + n] = q
+        if not incremental:
+            # group flags: one first/last pair per nonempty group, over
+            # the contiguous span its packed slots occupy
+            wfirst[:t_real] = 0
+            wlast[:t_real] = 0
+            for g in range(-(-b // self.pack)):
+                lo = int(self._off[g * self.pack])
+                hi = int(self._off[min((g + 1) * self.pack, b)])
+                if hi > lo:
+                    wfirst[lo] = 1
+                    wlast[hi - 1] = 1
+            # pad maintenance: entries the previous layout filled past
+            # this one's t_real revert to the masked sentinel, and the
+            # pad tail's group id tracks the last REAL group (same
+            # q/out block: no pipeline flush)
+            old_real = state["t_real"]
+            if t_real < old_real:
+                ws[t_real:old_real] = 0
+                wr[t_real:old_real] = 0
+                wblk[t_real:old_real] = 0
+                wpos[t_real:old_real] = self._pad_pos
+                wfirst[t_real:old_real] = 0
+                wlast[t_real:old_real] = 0
+                wqs[t_real:old_real] = 0
+                wql[t_real:old_real] = 0
+            last_grp = int(wg[t_real - 1])
+            if t_real != old_real or last_grp != state["last_grp"]:
+                wg[t_real:t_total] = last_grp
+            state["t_real"] = t_real
+            state["last_grp"] = last_grp
+            if state["seglen"] is None:
+                state["seglen"] = self._seglen.copy()
+            else:
+                np.copyto(state["seglen"], self._seglen)
+            self.assemblies_full += 1
+        else:
+            self.assemblies_incremental += 1
+        self.segments_reused += reused
+        self.segments_rebuilt += rebuilt
+        self._last_total = t_total
+        return arrs, t_real, t_total, self.pack
+
+
 def _ragged_kernel(ws, wg, wr, wblk, wpos, wfirst, wlast, wqs, wql,
                    q_ref, k_hbm, v_hbm, o_ref,
                    kbuf, vbuf, ksem, vsem, m_scr, l_scr, acc,

@@ -238,6 +238,15 @@ class EngineRouter:
         self._inflight = {i: 0 for i in range(len(self.steppers))}
         self._summaries = {i: frozenset()
                            for i in range(len(self.steppers))}
+        # None until the first full walk seeds a version; slot i is
+        # only ever written from replica i's stepper thread
+        self._summary_versions = {i: None
+                                  for i in range(len(self.steppers))}
+        # pinned by tests: how the cached summaries stay fresh —
+        # bounded delta replay vs full index walks
+        self.summary_delta_refreshes = 0
+        self.summary_full_refreshes = 0
+        self.summary_keys_replayed = 0
         self._drained = set()
         self.engine = _PoolEngineView(self)
         _metrics.router_replicas_live().set(len(self.steppers))
@@ -426,17 +435,53 @@ class EngineRouter:
                 if self._resubmit(rid, entry, ev):
                     return              # stream continues elsewhere
             else:
-                eng = self.steppers[entry.replica].engine
-                publish = getattr(eng, "prefix_index_summary", None)
-                if publish is not None:
-                    summary = publish()
-                    with self._lock:
-                        self._summaries[entry.replica] = summary
+                self._refresh_summary(entry.replica)
             self._drop_entry(rid)
             if entry.on_event is not None:
                 entry.on_event(ev)
 
         return emit
+
+    def _refresh_summary(self, i):
+        """Refresh pool slot i's published prefix summary after a
+        terminal, on that replica's stepper thread (the one safe place
+        to touch its allocator). Incremental when the engine's bounded
+        delta log still covers our cached version — replay only the
+        keys that entered/left the index since — and a full
+        ``prefix_index_summary()`` walk when the log aged out, the
+        engine predates the delta API, or this is the first terminal."""
+        eng = self.steppers[i].engine
+        delta_fn = getattr(eng, "prefix_index_delta", None)
+        # slot i's version is only written from THIS stepper thread
+        # (terminal fanout is serialized per replica), so the unlocked
+        # read cannot race a writer
+        since = self._summary_versions[i]
+        if delta_fn is not None and since is not None:
+            got = delta_fn(since)
+            if got is not None:
+                version, ops = got
+                with self._lock:
+                    cur = set(self._summaries[i])
+                    for added, key in ops:
+                        if added:
+                            cur.add(key)
+                        else:
+                            cur.discard(key)
+                    self._summaries[i] = frozenset(cur)
+                    self._summary_versions[i] = version
+                    self.summary_delta_refreshes += 1
+                    self.summary_keys_replayed += len(ops)
+                return
+        publish = getattr(eng, "prefix_index_summary", None)
+        if publish is None:
+            return
+        summary = publish()
+        version_fn = getattr(eng, "prefix_index_version", None)
+        version = version_fn() if version_fn is not None else None
+        with self._lock:
+            self._summaries[i] = summary
+            self._summary_versions[i] = version
+            self.summary_full_refreshes += 1
 
     def _resubmit(self, rid, entry, ev):
         """A replica died under this request. Queued (never-streamed)

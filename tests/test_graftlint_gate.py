@@ -35,7 +35,7 @@ def test_graftlint_imports():
         import tools.graftlint as gl
     finally:
         sys.path.remove(REPO_ROOT)
-    assert len(gl.RULES) >= 32, sorted(gl.RULES)
+    assert len(gl.RULES) >= 33, sorted(gl.RULES)
     families = {r.family for r in gl.RULES.values()}
     assert families >= {"trace-safety", "shard-map", "pallas-bounds",
                         "hygiene", "donation", "concurrency",
@@ -92,11 +92,17 @@ def test_graftlint_imports():
     # two guarded regions of the same lock (GL126 — `if k in d` in one
     # `with`, `del d[k]` in a later one: the lock drops between check
     # and act; merged regions and re-validate-under-the-act's-lock are
+    # the clean shapes);
+    # the host-fast-path PR's rule: blocking waits under a CONTENDED
+    # lock identity (GL127 — untimed Future.result()/IO while holding
+    # a lock ≥2 execution contexts acquire; held = lexical ∪ entry
+    # fixpoint, so the attribute-held future GL115 cannot track flags
+    # too; timed waits, Condition.wait and snapshot-then-resolve are
     # the clean shapes)
     assert {"GL104", "GL105", "GL107", "GL108", "GL110", "GL111",
             "GL112", "GL113", "GL114", "GL115", "GL116",
             "GL117", "GL118", "GL119", "GL120", "GL121", "GL122",
-            "GL123", "GL124", "GL125", "GL126"} <= set(gl.RULES), \
+            "GL123", "GL124", "GL125", "GL126", "GL127"} <= set(gl.RULES), \
         sorted(gl.RULES)
 
 
@@ -163,7 +169,7 @@ def test_tree_run_is_within_budget_and_reports_phases():
 
 
 def test_concurrency_corpus_roundtrip():
-    """The GL114-GL119 concurrency corpus files plus the GL121-GL126
+    """The GL114-GL119 concurrency corpus files plus the GL121-GL127
     lockset/hygiene files each reconstruct a fixed real hazard: caught
     codes fire exactly, clean tripwires stay silent (any unexpected
     code fails), and each file's suppression-honored demo is consumed
@@ -189,6 +195,7 @@ def test_concurrency_corpus_roundtrip():
         "unvalidated_committed_json.py": "GL124",
         "callback_under_lock.py": "GL125",
         "check_then_act.py": "GL126",
+        "blocking_call_under_lock.py": "GL127",
     }
     for name, code in expected_files.items():
         path = os.path.join(corpus, name)

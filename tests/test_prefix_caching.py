@@ -204,6 +204,77 @@ class TestAllocatorSharing:
         assert a.num_available == a.num_free + a.num_pooled == 4
 
 
+class TestIndexDeltaLog:
+    """The bounded delta log behind the router's incremental summary
+    refresh: epoch bumps track EXACTLY the two index mutation sites
+    (register add, LRU-reclaim remove), replay reconstructs
+    ``index_keys()`` bit-exact, and an aged-out epoch returns None
+    instead of a silently-truncated delta."""
+
+    def _replay(self, base, ops):
+        cur = set(base)
+        for added, key in ops:
+            (cur.add if added else cur.discard)(key)
+        return frozenset(cur)
+
+    def test_epoch_bumps_only_on_index_mutation(self):
+        a = BlockAllocator(6)
+        b = a.alloc()
+        assert a.index_epoch == 0                  # alloc: no index op
+        k = block_key(None, [1])
+        a.register(b, k)
+        assert a.index_epoch == 1
+        a.register(b, k)                           # no-op repeat
+        assert a.index_epoch == 1
+        a.free([b])                                # parks, stays indexed
+        assert a.index_epoch == 1
+        assert a.acquire(k) == b                   # resurrect: no op
+        assert a.index_epoch == 1
+
+    def test_delta_replay_matches_index_keys(self):
+        a = BlockAllocator(4)                      # 3 allocatable
+        e0, base = a.index_epoch, a.index_keys()
+        blocks = [a.alloc() for _ in range(3)]
+        keys = [block_key(None, [i]) for i in range(3)]
+        for b, k in zip(blocks, keys):
+            a.register(b, k)
+        for b in blocks:
+            a.free([b])
+        a.alloc()                                  # reclaims, removes keys[0]
+        e1, ops = a.index_delta_since(e0)
+        assert e1 == a.index_epoch == 4            # 3 adds + 1 remove
+        assert self._replay(base, ops) == a.index_keys()
+        # empty delta at the current epoch
+        assert a.index_delta_since(e1) == (e1, ())
+
+    def test_key_leaving_and_reentering_replays_in_order(self):
+        a = BlockAllocator(3)                      # 2 allocatable
+        k = block_key(None, [7])
+        b1, b2 = a.alloc(), a.alloc()
+        a.register(b1, k)
+        e0, base = a.index_epoch, a.index_keys()
+        a.free([b1])                               # parks b1 under k
+        a.free([b2])                               # plain free
+        a.alloc()                                  # takes the free block
+        got = a.alloc()                            # reclaims b1: k leaves
+        assert got == b1 and a.lookup(k) is None
+        a.register(b1, k)                          # k re-enters
+        e1, ops = a.index_delta_since(e0)
+        assert [added for added, _ in ops] == [False, True]
+        assert self._replay(base, ops) == a.index_keys() \
+            == frozenset({k})
+
+    def test_aged_out_epoch_returns_none(self):
+        a = BlockAllocator(4)
+        a._index_log = __import__("collections").deque(maxlen=2)
+        blocks = [a.alloc() for _ in range(3)]
+        for i, b in enumerate(blocks):
+            a.register(b, block_key(None, [i]))
+        assert a.index_delta_since(0) is None      # 3 ops, log holds 2
+        assert a.index_delta_since(1) is not None  # last 2 still covered
+        assert a.index_delta_since(a.index_epoch + 1) is None  # future
+
+
 def _serve(eng, prompts, news, ids=None, cb=None, **kw):
     kw.setdefault("num_blocks", 24)
     kw.setdefault("block_size", 8)

@@ -270,6 +270,22 @@ def _load_with_cli(path):
     return dump, buf.getvalue()
 
 
+def test_render_rolls_up_serve_step_host_phases(tmp_path):
+    """The engine lane of a rendered dump ends with one host-phase
+    rollup line summing the serve_step spans' host_*_us args — the
+    CLI answer to "is the host the bottleneck" (ISSUE 20)."""
+    _serve([(5, 3), (11, 4)])
+    path = tmp_path / "dump.json"
+    tracing.write_dump(str(path), reason="manual")
+    _, text = _load_with_cli(str(path))
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("host phases over ")]
+    assert len(lines) == 1
+    for phase in ("sched=", "build=", "dispatch=", "overlap=",
+                  "fetch="):
+        assert phase in lines[0], (phase, lines[0])
+
+
 def test_injected_alloc_failure_dumps_flight_record(tmp_path):
     """An injected KV alloc failure mid-step with NO preemptible victim
     is a PER-REQUEST failure (ISSUE 11 demoted the old engine crash):

@@ -308,6 +308,48 @@ class TestAffinityFallback:
             router.stop()
 
 
+# -- incremental summary refresh (terminal fanout) --------------------------
+
+def _summary_truth(cb):
+    return cb.prefix_index_summary(), cb.prefix_index_version()
+
+
+class TestSummaryDeltaRefresh:
+    def test_terminal_refresh_replays_deltas_after_first_walk(
+            self, eng, rngv):
+        """The cached per-replica summary stays exact WITHOUT a full
+        index walk per terminal: the first terminal on each replica
+        seeds version+summary (one full walk each), every later one
+        replays the allocator's bounded delta log — counters pinned,
+        cache bit-equal the engine's ground truth."""
+        rng, v = rngv
+        router = _make_pool(eng, replicas=2)    # round_robin: 0,1,0,1
+        try:
+            def submit(rid, plen):
+                sub = _Collect()
+                router.submit(GenerationRequest(
+                    np.asarray(_prompt(rng, v, plen), np.int32), 3,
+                    request_id=rid), on_event=sub).result(60)
+                assert sub.done.wait(180), rid
+            submit("sd0", 17)
+            submit("sd1", 19)
+            # first terminal per replica: full walks only
+            assert router.summary_full_refreshes == 2
+            assert router.summary_delta_refreshes == 0
+            submit("sd2", 21)
+            submit("sd3", 23)
+            assert router.summary_full_refreshes == 2   # never again
+            assert router.summary_delta_refreshes == 2
+            assert router.summary_keys_replayed > 0     # fresh prefixes
+            for i in range(2):
+                truth, version = router.steppers[i].call(
+                    _summary_truth).result(30)
+                assert router.replica_summary(i) == truth
+                assert router._summary_versions[i] == version
+        finally:
+            router.stop()
+
+
 # -- the heavy matrix (slow lane) ------------------------------------------
 
 @pytest.mark.slow

@@ -1,0 +1,120 @@
+# graftlint-corpus-expect: GL127 GL127
+"""Known-bad corpus: blocking wait under a contended lock (GL127).
+
+Reconstructs the stepper hazard the host fast path is built to avoid:
+a command future parked on ``self`` and resolved with an untimed
+``result()`` while holding the very lock the step thread takes every
+iteration — the whole serve loop queues behind a wait whose completion
+may itself need the lock. GL115 cannot see this shape (it tracks
+futures through local names only); GL127 reasons about the lock
+IDENTITY: held = lexical region ∪ entry-lockset fixpoint, and only a
+lock acquired from ≥2 execution contexts project-wide flags.
+
+Clean tripwires pin the false-positive walls: a timed ``result()`` is
+bounded (clean), the snapshot-the-future-under-the-lock-resolve-it-
+outside idiom is the prescribed fix (clean), a lock only ONE context
+ever takes has nobody to queue behind the wait (clean), and
+``Condition.wait()`` RELEASES its lock while waiting, so it is exempt
+by construction, not by pattern-matching.
+"""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class CommandStepper:
+    """Bad: `_lock` is taken by the step thread (`_run`, thread
+    context) AND the submitting caller (main context) — contended —
+    yet two paths wait on the attribute-held future under it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=1)
+        self._fut = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        with self._lock:
+            self._advance()
+
+    def _advance(self):
+        pass
+
+    def submit(self, job):
+        with self._lock:
+            self._fut = self._pool.submit(job)
+
+    def flush(self):
+        with self._lock:
+            return self._fut.result()           # expect GL127: untimed wait, lock contended
+
+    def drain(self):
+        with self._lock:
+            return self._settle()
+
+    def _settle(self):
+        # entry-held: no lexical `with` here, but the fixpoint knows
+        # this helper only runs under `_lock` (called from `drain`)
+        return self._fut.result()               # expect GL127: entry-lockset wait
+
+    def flush_timed(self):
+        # clean: the wait is bounded — a slow job stalls us 2s, not forever
+        with self._lock:
+            return self._fut.result(timeout=2.0)
+
+    def flush_after(self):
+        # clean: the prescribed fix — snapshot the future under the
+        # lock, resolve it AFTER release; contenders never queue
+        with self._lock:
+            fut = self._fut
+        return fut.result()
+
+    def flush_documented(self):
+        # a deliberate, documented under-lock wait stays quiet WITH a reason
+        with self._lock:
+            return self._fut.result()  # graftlint: disable=GL127 - corpus demo: shutdown-only path, step thread already joined
+
+
+class SingleDriverQueue:
+    """Clean: `_lock` is only ever taken from the main context — no
+    second thread exists to queue behind the wait, so the untimed
+    `result()` under it is style, not a stall."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=1)
+        self._fut = None
+
+    def submit(self, job):
+        with self._lock:
+            self._fut = self._pool.submit(job)
+
+    def flush(self):
+        with self._lock:
+            return self._fut.result()
+
+
+class TickBarrier:
+    """Clean: ``Condition.wait()`` RELEASES `_cond` while blocked —
+    contenders take the lock freely during the wait, so there is
+    nothing to flag even though `_cond` is contended."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ticks = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        with self._cond:
+            self._ticks = self._ticks + 1
+            self._cond.notify_all()
+
+    def await_tick(self):
+        with self._cond:
+            self._cond.wait()
+            return self._ticks

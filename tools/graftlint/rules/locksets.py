@@ -1,5 +1,5 @@
-"""Lockset rules (GL121-GL123, GL125) — Eraser/RacerD-style data-race
-and deadlock detection over per-object lock identity.
+"""Lockset rules (GL121-GL123, GL125-GL127) — Eraser/RacerD-style
+data-race and deadlock detection over per-object lock identity.
 
 The concurrency family (GL114-GL119) pattern-matches hazard SHAPES;
 this family reasons about lock OBJECTS. Phase 1 resolves every
@@ -54,11 +54,30 @@ lock) and the lock-order inversion (the callback takes a user lock its
 other callers hold OUTSIDE ours) are both invisible to it until the
 user's lock is in-tree — too late. The snapshot-then-call idiom (copy
 the callback list under the lock, invoke outside) never flags.
+
+GL127 blocking-call-under-lock: a blocking wait — file/socket/
+subprocess IO, untimed queue/event waits, an untimed
+``Future.result()`` — while holding a lock IDENTITY that the index
+shows is CONTENDED (acquired from ≥2 distinct execution contexts
+project-wide). GL115 pattern-matches lexical ``with <lock>:`` shapes;
+this rule reasons about the lock object: the held set is the lexical
+region's identity ∪ the entry-lockset fixpoint (a helper only ever
+called under the serve loop's condition flags too), and a lock only
+one context ever takes never flags (nobody can queue behind the
+wait). It also sees the one wait GL115 structurally cannot: an
+attribute-held future (``self._fut = pool.submit(...)`` …
+``self._fut.result()``) — `_blocking_ops` tracks futures through
+local names only. ``Condition.wait()`` stays exempt by construction
+(it RELEASES the lock while waiting), as do timed waits and the
+snapshot-the-future-under-the-lock-resolve-it-outside idiom.
 """
 import ast
 
 from ..core import in_paddle_tpu, rule
 from ..locksets import UNKNOWN
+from ..project import _attr_chain, own_scope_walk
+from .concurrency import (_FileFacts, _LOCK_HINTS, _blocking_ops,
+                          _has_timeout)
 
 
 def _short(idx, ident):
@@ -484,3 +503,112 @@ def callback_under_lock(ctx):
             "through a user lock is visible to GL122. Snapshot what "
             "the callback needs under the lock, then invoke it after "
             "release"), oc.node
+
+
+# -- GL127 -------------------------------------------------------------------
+
+def _attr_futures(ctx):
+    """Attribute names assigned from ``<executor>.submit(...)`` (or a
+    bare ``Future()`` ctor) anywhere in this file — the attribute-held
+    future `_blocking_ops` structurally cannot see: it tracks futures
+    through LOCAL name bindings only, so ``self._fut.result()`` slips
+    past GL115 even inside a lexical lock region."""
+    out = set()
+    for node in ctx.walk():
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Call):
+            continue
+        f = node.value.func
+        is_fut = isinstance(f, ast.Attribute) and f.attr == "submit"
+        if not is_fut:
+            chain = _attr_chain(f)
+            is_fut = chain in ("concurrent.futures.Future",
+                               "futures.Future", "Future")
+        if not is_fut:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Attribute):
+                out.add(t.attr)
+    return out
+
+
+def _gl127_sites(ctx, fi, facts, attr_futs):
+    """(node, what, kind) blocking waits in `fi`'s own scope: the
+    shared `_blocking_ops` detectors plus the attribute-held
+    ``Future.result()`` wait they cannot see. ``Condition.wait()``
+    never appears (facts track Event objects, not Conditions — and a
+    condition wait RELEASES its lock, so exempting it is semantics,
+    not a gap)."""
+    nodes = list(own_scope_walk(fi.node))
+    seen = set()
+    for node, what, kind in _blocking_ops(ctx, nodes, facts):
+        seen.add(id(node))
+        yield node, what, kind
+    for node in nodes:
+        if not isinstance(node, ast.Call) or id(node) in seen:
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "result" \
+                and not node.args and not _has_timeout(node) \
+                and isinstance(f.value, ast.Attribute) \
+                and f.value.attr in attr_futs:
+            yield node, (f"untimed `result()` on the attribute-held "
+                         f"future `{f.value.attr}`"), "future"
+
+
+_GL127_MSG = (
+    "every other context that takes this lock — the stepper thread, "
+    "the watchdog, the caller issuing the next request — queues behind "
+    "this wait for its full duration, and if the waited-on work needs "
+    "the same lock to finish, that is a deadlock, not a stall")
+
+
+@rule("GL127", "blocking-call-under-lock", "locksets",
+      applies=in_paddle_tpu)
+def blocking_call_under_lock(ctx):
+    """A blocking wait while holding a lock identity acquired from ≥2
+    distinct execution contexts project-wide. Held = lexical region
+    identity ∪ entry-lockset fixpoint; a single-context lock never
+    flags (nobody to queue behind the wait); timed waits and
+    ``Condition.wait()`` are exempt."""
+    idx = ctx.project
+    if idx is None:
+        return
+    ls = idx.locksets()
+    # identity -> union of execution contexts acquiring it, PROJECT-
+    # wide: one acquiring context means no second thread can contend,
+    # so a blocking wait under it inconveniences nobody.
+    acq_ctxs = {}
+    for acq in ls.acquisitions:
+        if acq.ident == UNKNOWN:
+            continue
+        acq_ctxs.setdefault(acq.ident, set()).update(
+            ls.context_of(acq.fn))
+    regions_by_fn = _lock_regions(ls, ctx)
+    facts = _FileFacts(ctx)
+    attr_futs = _attr_futures(ctx)
+    for fi in idx.functions_in(ctx.path):
+        regions = regions_by_fn.get(fi.qualname, ())
+        entry = set(ls.entry.get(fi.qualname, ()))
+        entry.discard(UNKNOWN)
+        if not regions and not entry:
+            continue
+        for node, what, kind in _gl127_sites(ctx, fi, facts,
+                                             attr_futs):
+            line = node.lineno
+            held = {i for (i, lo, hi) in regions
+                    if lo <= line <= hi and i != UNKNOWN}
+            held.update(entry)
+            hot = sorted(i for i in held
+                         if len(acq_ctxs.get(i, ())) >= 2)
+            if not hot:
+                continue
+            ctxs = set()
+            for i in hot:
+                ctxs.update(acq_ctxs[i])
+            yield ctx.finding(
+                "GL127", node,
+                f"{what} in `{fi.shortname}` while holding "
+                f"{_fmt_locks(idx, set(hot))}, a lock contended from "
+                f"{_fmt_ctxs(ctxs)} contexts: {_GL127_MSG} — "
+                f"{_LOCK_HINTS.get(kind, 'move the wait outside the region')}"), node

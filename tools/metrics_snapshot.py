@@ -539,6 +539,41 @@ def selfcheck():
     finally:
         shutil.rmtree(d7, ignore_errors=True)
 
+    # host-step fast path (ISSUE 20): the serve_host_phase_seconds
+    # histogram's bounded six-phase label set, the work-segment /
+    # assembly counter families, and the step-input copy-bytes counter
+    # whose steady-state zero the serve_host gate pins — stdlib-only
+    reg8 = obs.MetricsRegistry()
+    hp8 = reg8.histogram("serve_host_phase_seconds", labels=("phase",))
+    hp8.labels(phase="schedule").observe(1e-3)
+    hp8.labels(phase="build").observe(2e-3)
+    hp8.labels(phase="dispatch").observe(3e-3)
+    hp8.labels(phase="overlap").observe(0.0)
+    hp8.labels(phase="fetch").observe(4e-3)
+    hp8.labels(phase="commit").observe(1e-3)
+    kids8 = reg8.snapshot()["serve_host_phase_seconds"]["children"]
+    check(sorted(kids8) == ["build", "commit", "dispatch", "fetch",
+                            "overlap", "schedule"]
+          and all(c["count"] == 1 for c in kids8.values()),
+          f"host-phase histogram children wrong: {sorted(kids8)}")
+    segs8 = reg8.counter("serve_work_segments_total", labels=("event",))
+    segs8.labels(event="reused").inc(15)
+    segs8.labels(event="rebuilt").inc(3)
+    asm8 = reg8.counter("serve_work_assemblies_total", labels=("mode",))
+    asm8.labels(mode="incremental").inc(5)
+    asm8.labels(mode="full").inc(1)
+    copy8 = reg8.counter("serve_step_input_copy_bytes_total")
+    copy8.inc(0)        # the fast path's steady state: increments of 0
+    prom8 = obs.to_prometheus(reg8)
+    for needle in ('serve_work_segments_total{event="reused"} 15',
+                   'serve_work_segments_total{event="rebuilt"} 3',
+                   'serve_work_assemblies_total{mode="incremental"} 5',
+                   'serve_work_assemblies_total{mode="full"} 1',
+                   "serve_step_input_copy_bytes_total 0",
+                   'serve_host_phase_seconds_bucket{phase="fetch",'
+                   'le="+Inf"} 1'):
+        check(needle in prom8, f"prometheus output missing {needle!r}")
+
     # training health (ISSUE 14): telemetry spec grouping + packed
     # layout, the train_group_* gauge families (bounded GL112-safe
     # label sets), the TrainHealthMonitor detector matrix on a

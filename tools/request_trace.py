@@ -33,6 +33,27 @@ def _fmt_args(args):
     return " ".join(f"{k}={v}" for k, v in sorted(args.items()))
 
 
+_HOST_PHASES = ("host_sched_us", "host_build_us", "host_dispatch_us",
+                "host_overlap_us", "host_fetch_us")
+
+
+def _render_host_phases(engine_spans, out):
+    """Host-side phase split of the serve_step lane: where each step's
+    wall time went around the device dispatch (scheduler admit/preempt,
+    work-list build, dispatch, overlapped host work, token fetch) —
+    one rollup line answering "is the host the bottleneck" without
+    grepping span args. Dumps predating the args render nothing."""
+    steps = [s for s in engine_spans if s["name"] == "serve_step"
+             and all(k in s["args"] for k in _HOST_PHASES)]
+    if not steps:
+        return
+    parts = " ".join(
+        f"{k[len('host_'):-len('_us')]}="
+        f"{sum(s['args'][k] for s in steps) / 1e3:.3f}ms"
+        for k in _HOST_PHASES)
+    print(f"host phases over {len(steps)} steps: {parts}", file=out)
+
+
 def render_request(dump, request, out=sys.stdout):
     """One request's digest + span timeline from a loaded dump."""
     tracing = _load_observability().tracing
@@ -108,6 +129,7 @@ def render_dump(dump, request=None, as_json=False, out=sys.stdout):
         for s in engine:
             names[s["name"]] = names.get(s["name"], 0) + 1
         print(f"\nengine lane: {_fmt_args(names)}", file=out)
+        _render_host_phases(engine, out)
 
 
 def main():
